@@ -1,0 +1,72 @@
+"""incubator_mxnet_tpu — a TPU-native deep learning framework.
+
+A from-scratch re-design of the capabilities of Apache MXNet (incubating)
+for TPU hardware: JAX/XLA is the kernel generator and async runtime,
+``jax.sharding`` + ``shard_map`` over a device ``Mesh`` is the distribution
+substrate, and Pallas provides hand-written TPU kernels for the hot paths.
+
+The public API mirrors the reference framework's Python surface
+(``mx.nd``, ``mx.sym``, ``mx.gluon``, ``mx.autograd``, ``mx.optimizer``,
+``mx.kvstore``, ``mx.io``) so that users of the reference can switch with
+minimal friction, while the internals are idiomatic TPU-first designs —
+not a port.  Reference: /root/reference (Apache MXNet), surveyed in
+SURVEY.md at the repo root.
+
+Typical use::
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, autograd, gluon
+
+    x = nd.ones((2, 3), ctx=mx.tpu())
+    with autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+"""
+
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import engine
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import autograd
+from . import random
+from . import initializer
+from . import init  # alias namespace like mx.init
+from . import optimizer
+from .optimizer import lr_scheduler
+from . import symbol
+from . import symbol as sym
+from . import gluon
+from . import kvstore
+from . import kvstore as kv
+from . import io
+from . import recordio
+from . import image
+from . import parallel
+from . import models
+from . import profiler
+from . import runtime
+from . import amp
+from . import numpy as np  # mx.np NumPy-compatible namespace
+from . import numpy_extension as npx
+from . import callback
+from . import monitor
+from . import visualization as viz
+from . import test_utils
+from . import util
+from .util import is_np_array, set_np, reset_np
+from .attribute import AttrScope
+from .name import NameManager
+
+# Convenience re-exports matching the reference's top level (mx.nd.array,
+# mx.metric, ...).
+from .gluon import metric
+
+
+def tpu_context_available():
+    """True when a real TPU backend is attached to this process."""
+    return num_tpus() > 0

@@ -1,0 +1,10 @@
+"""Automatic mixed precision (reference python/mxnet/contrib/amp/).
+
+TPU story: bf16 is the native MXU compute type and needs no loss scaling
+(same exponent range as fp32), so ``amp.init(dtype='bfloat16')`` is the
+default and the reference's fp16 + dynamic LossScaler machinery
+(loss_scaler.py) is kept for API parity / fp16 experiments.
+"""
+from .amp import init, init_trainer, convert_block, scale_loss, unscale
+from .loss_scaler import LossScaler
+from . import lists

@@ -1,0 +1,64 @@
+"""AMP entry points (reference contrib/amp/amp.py:47-389)."""
+from __future__ import annotations
+
+import contextlib
+
+from ..base import dtype_from_any
+from .loss_scaler import LossScaler
+
+_state = {"initialized": False, "dtype": None, "scaler": None}
+
+
+def init(target_dtype="bfloat16"):
+    """Enable mixed precision (reference amp.py:47 init).
+
+    bfloat16 (TPU native): params stay fp32-master-on-demand, compute in
+    bf16 via block casting; no loss scaling needed.  float16: enables the
+    dynamic LossScaler.
+    """
+    _state["initialized"] = True
+    _state["dtype"] = dtype_from_any(target_dtype)
+    if target_dtype in ("float16", "fp16"):
+        _state["scaler"] = LossScaler()
+    return _state
+
+
+def init_trainer(trainer):
+    """Attach the loss scaler to a Trainer (reference amp.py init_trainer)."""
+    trainer._amp_loss_scaler = _state.get("scaler")
+    return trainer
+
+
+def convert_block(block, target_dtype="bfloat16", fp32_ops=None):
+    """Cast a Block's parameters to the low-precision dtype, keeping
+    norm-layer scale/offset params in fp32 (reference convert_model
+    behavior via cast lists)."""
+    from . import lists
+    keep_fp32_suffixes = ("gamma", "beta", "running_mean", "running_var",
+                          "moving_mean", "moving_var")
+    for name, p in block.collect_params().items():
+        if name.endswith(keep_fp32_suffixes):
+            continue
+        p.cast(target_dtype)
+    return block
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """``with amp.scale_loss(loss, trainer) as scaled: scaled.backward()``."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        yield loss
+        return
+    scaled = loss * scaler.loss_scale
+    trainer._scale = 1.0 / scaler.loss_scale
+    yield scaled
+    overflow = scaler.has_overflow(trainer._params)
+    scaler.update_scale(overflow)
+    trainer._amp_skip_update = overflow
+
+
+def unscale(trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is not None:
+        trainer._scale = 1.0

@@ -1,0 +1,23 @@
+"""Op cast lists (reference contrib/amp/lists/symbol_fp16.py).
+
+Three classes, mirroring the reference's allow/deny structure:
+* LP16_FUNCS — always run in low precision (MXU-bound matmul/conv)
+* FP32_FUNCS — numerically sensitive, keep fp32
+* WIDEST_TYPE_CASTS — follow the widest input type
+"""
+
+LP16_FUNCS = [
+    "FullyConnected", "Convolution", "Deconvolution", "dot", "batch_dot",
+    "matmul", "linalg_gemm2", "RNN", "dot_product_attention",
+]
+
+FP32_FUNCS = [
+    "softmax", "log_softmax", "SoftmaxOutput", "BatchNorm", "LayerNorm",
+    "GroupNorm", "InstanceNorm", "RMSNorm", "norm", "mean", "sum", "exp",
+    "log", "erfinv", "power", "ctc_loss", "logsumexp", "var", "std",
+]
+
+WIDEST_TYPE_CASTS = [
+    "add", "subtract", "multiply", "divide", "maximum", "minimum", "where",
+    "concat", "stack",
+]

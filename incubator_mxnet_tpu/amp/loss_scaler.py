@@ -1,0 +1,37 @@
+"""Dynamic loss scaler (reference contrib/amp/loss_scaler.py)."""
+from __future__ import annotations
+
+import numpy as onp
+
+
+class LossScaler:
+    """Doubles the scale every ``scale_window`` overflow-free steps and
+    halves it on overflow — the reference's dynamic scaling policy."""
+
+    def __init__(self, init_scale=2 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.05):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any gradient is non-finite."""
+        for p in params:
+            if p.grad_req != "null" and p._data is not None and \
+                    p._data.grad is not None:
+                g = p.grad().asnumpy()
+                if not onp.isfinite(g).all():
+                    return True
+        return False
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0
+        return self.loss_scale

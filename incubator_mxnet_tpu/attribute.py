@@ -1,0 +1,39 @@
+"""AttrScope: scoped symbol attributes (reference python/mxnet/attribute.py)."""
+from __future__ import annotations
+
+import threading
+
+_state = threading.local()
+
+
+class AttrScope:
+    """``with AttrScope(group='stage1'):`` — attributes attached to every
+    symbol created inside the scope (used by group2ctx-style model
+    parallelism in the reference, symbol.py:1608)."""
+
+    def __init__(self, **kwargs):
+        self._attrs = kwargs
+
+    @staticmethod
+    def current_attrs() -> dict:
+        stack = getattr(_state, "stack", None)
+        merged = {}
+        if stack:
+            for scope in stack:
+                merged.update(scope._attrs)
+        return merged
+
+    def get(self, attrs=None):
+        merged = dict(AttrScope.current_attrs())
+        if attrs:
+            merged.update(attrs)
+        return merged
+
+    def __enter__(self):
+        if not hasattr(_state, "stack"):
+            _state.stack = []
+        _state.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _state.stack.pop()

@@ -1,0 +1,313 @@
+"""Autograd: record/pause scopes, tape, backward.
+
+TPU-native re-design of the reference imperative autograd
+(src/imperative/imperative.cc — ``MarkVariables`` :133, ``RecordOp`` :204,
+``Backward`` :376; scope API python/mxnet/autograd.py:120-370).
+
+Design: while ``record()`` is active, every differentiable op executes
+under ``jax.vjp`` and the residual-holding vjp closure is appended to a
+thread-local tape.  ``backward()`` walks the tape in reverse program
+order, calling the stored closures and accumulating cotangents into
+``NDArray.grad`` buffers honouring grad_req write/add/null — the same
+observable semantics as the reference's dynamic grad-graph executor,
+without building an explicit graph (program order IS the topological
+order for a tape).
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+__all__ = [
+    "record", "pause", "train_mode", "predict_mode", "is_recording",
+    "is_training", "set_recording", "set_training", "backward", "grad",
+    "mark_variables", "get_symbol", "Function",
+]
+
+_state = threading.local()
+
+
+def _tls():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+        _state.tape = []
+    return _state
+
+
+class _TapeNode:
+    __slots__ = ("op", "vjp_fn", "nd_inputs", "outputs", "saved_out_data")
+
+    def __init__(self, op, vjp_fn, nd_inputs, outputs):
+        self.op = op
+        self.vjp_fn = vjp_fn
+        self.nd_inputs = nd_inputs
+        self.outputs = outputs
+
+
+def _record(op, vjp_fn, all_inputs, nd_inputs, input_slots, outputs):
+    outs = outputs if isinstance(outputs, (list, tuple)) else (outputs,)
+    node = _TapeNode(op, vjp_fn, nd_inputs, outs)
+    for o in outs:
+        o._tape_node = node
+    _tls().tape.append(node)
+
+
+# ---------------------------------------------------------------------------
+# Scopes (reference python/mxnet/autograd.py:120-179)
+# ---------------------------------------------------------------------------
+
+def is_recording() -> bool:
+    return _tls().recording
+
+
+def is_training() -> bool:
+    return _tls().training
+
+
+def set_recording(is_rec: bool) -> bool:
+    t = _tls()
+    prev, t.recording = t.recording, is_rec
+    return prev
+
+
+def set_training(train: bool) -> bool:
+    t = _tls()
+    prev, t.training = t.training, train
+    return prev
+
+
+@contextmanager
+def _scope(rec, train):
+    t = _tls()
+    prev_rec, prev_train = t.recording, t.training
+    if rec is not None:
+        t.recording = rec
+    if train is not None:
+        t.training = train
+    try:
+        yield
+    finally:
+        t.recording, t.training = prev_rec, prev_train
+
+
+def record(train_mode=True):  # noqa: D401 - reference name
+    """``with autograd.record():`` enable recording (and train mode)."""
+    return _scope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _scope(False, train_mode)
+
+
+def train_mode():
+    return _scope(None, True)
+
+
+def predict_mode():
+    return _scope(None, False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Associate gradient buffers with variables (reference imperative.cc:133)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._grad = g
+        var._grad_req = req
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
+             create_graph=False):
+    """Run the backward pass from ``heads`` (reference imperative.cc:376).
+
+    Cotangents flow tape-reverse; for each recorded op the stored vjp
+    closure turns output cotangents into input cotangents.  Gradients
+    land in ``x.grad`` for every array that had ``attach_grad`` called
+    (grad_req 'write' overwrites, 'add' accumulates across backward calls).
+    """
+    from .ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    tape = _tls().tape
+    if not tape:
+        # heads may be leaves with no recorded ops: grad = head_grad
+        for h, hg in zip(heads, head_grads):
+            if h._grad_req != "null" and h._grad is not None:
+                g = hg.data if hg is not None else jnp.ones_like(h.data)
+                _accumulate_leaf(h, g)
+        return
+
+    # cotangent accumulator keyed by NDArray identity
+    cot: dict[int, object] = {}
+    alive: dict[int, NDArray] = {}
+
+    def add_cot(arr, g):
+        if g is None:
+            return
+        key = id(arr)
+        if key in cot:
+            cot[key] = cot[key] + g
+        else:
+            cot[key] = g
+            alive[key] = arr
+
+    for h, hg in zip(heads, head_grads):
+        g = hg.data if hg is not None else jnp.ones_like(h.data)
+        add_cot(h, g)
+
+    needed = _mark_needed(tape, heads)
+
+    for node in reversed(tape):
+        if node not in needed:
+            continue
+        out_cots = []
+        any_cot = False
+        for o in node.outputs:
+            g = cot.get(id(o))
+            if g is None:
+                g = jnp.zeros(o.shape, o.dtype)
+            else:
+                any_cot = True
+            out_cots.append(g)
+        if not any_cot:
+            continue
+        seed = out_cots[0] if len(node.outputs) == 1 else tuple(out_cots)
+        in_cots = node.vjp_fn(seed)
+        for x, g in zip(node.nd_inputs, in_cots):
+            if isinstance(g, jax.Array) and g.dtype != jax.dtypes.float0:
+                add_cot(x, g)
+
+    for key, arr in alive.items():
+        if arr._grad_req not in (None, "null") and arr._grad is not None:
+            _accumulate_leaf(arr, cot[key])
+
+    if not retain_graph:
+        _tls().tape = []
+        for key, arr in alive.items():
+            arr._tape_node = None
+
+
+def _mark_needed(tape, heads):
+    """Subset of tape nodes reachable (backwards) from heads."""
+    needed = set()
+    frontier = {id(h) for h in heads}
+    for node in reversed(tape):
+        if any(id(o) in frontier for o in node.outputs):
+            needed.add(node)
+            for x in node.nd_inputs:
+                frontier.add(id(x))
+    return needed
+
+
+def _accumulate_leaf(arr, g):
+    g = jnp.asarray(g, arr.dtype)
+    if arr._grad_req == "add":
+        arr._grad._set_data(arr._grad.data + g)
+    else:  # write
+        arr._grad._set_data(g)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of heads w.r.t. variables (reference autograd.py:271)."""
+    from .ndarray import NDArray
+
+    if isinstance(variables, NDArray):
+        variables = [variables]
+        single = True
+    else:
+        single = False
+    saved = [(v._grad, v._grad_req) for v in variables]
+    for v in variables:
+        v._grad = _zeros_like_nd(v)
+        v._grad_req = "write"
+    try:
+        backward(heads, head_grads,
+                 retain_graph=bool(retain_graph or create_graph),
+                 train_mode=train_mode, create_graph=create_graph)
+        grads = [v.grad.copy() for v in variables]
+    finally:
+        for v, (g, req) in zip(variables, saved):
+            v._grad, v._grad_req = g, req
+    return grads[0] if single else grads
+
+
+def _zeros_like_nd(v):
+    from .ndarray import NDArray
+
+    return NDArray(jnp.zeros(v.shape, v.dtype), ctx=v.ctx)
+
+
+def get_symbol(x):
+    """Reference parity stub: returns the traced symbol for an output.
+
+    The reference builds an nnvm graph during recording
+    (autograd.py:get_symbol).  Our tape has no symbol identity; use
+    ``gluon.HybridBlock.export`` / the symbol API for graph capture.
+    """
+    raise NotImplementedError(
+        "get_symbol is not supported on the tape-based autograd; "
+        "hybridize the block and use export() instead")
+
+
+class Function:
+    """Custom differentiable function (reference autograd.py:368 Function).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` operating on NDArrays.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+
+        with pause():
+            outputs = self.forward(*inputs)
+        outs = outputs if isinstance(outputs, (list, tuple)) else (outputs,)
+        if is_recording():
+            func = self
+
+            def vjp_fn(out_cots):
+                if not isinstance(out_cots, tuple):
+                    out_cots = (out_cots,)
+                with pause():
+                    in_grads = func.backward(
+                        *[NDArray(g) for g in out_cots])
+                if not isinstance(in_grads, (list, tuple)):
+                    in_grads = (in_grads,)
+                return tuple(g.data for g in in_grads)
+
+            nd_inputs = [x for x in inputs if isinstance(x, NDArray)]
+            _record(None, vjp_fn, inputs, nd_inputs,
+                    list(range(len(nd_inputs))), outs)
+        return outputs
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError

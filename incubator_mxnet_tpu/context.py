@@ -1,0 +1,146 @@
+"""Device context abstraction.
+
+TPU-native counterpart of the reference ``Context`` (include/mxnet/base.h:90-116
+and python/mxnet/context.py).  A ``Context`` names a logical device
+(``cpu()``, ``gpu()``, ``tpu()``); it resolves lazily to a concrete JAX
+device.  On machines without the requested platform the context falls back
+to the default JAX backend so code written for ``tpu()`` runs under the
+CPU test harness unchanged (this is the ``check_consistency`` bridge —
+reference python/mxnet/test_utils.py:1428).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_tpus"]
+
+_context_stack = threading.local()
+
+
+def _devices_for(platform: str):
+    try:
+        return jax.devices(platform)
+    except RuntimeError:
+        return []
+
+
+class Context:
+    """A logical device: ``Context('tpu', 0)``.
+
+    devtypes mirror the reference enum (cpu=1, gpu=2, cpu_pinned=3,
+    cpu_shared=5) with tpu added as the first-class accelerator type.
+    """
+
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "cpu_shared", 6: "tpu"}
+    devstr2type = {v: k for k, v in devtype2str.items()}
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if isinstance(device_type, Context):
+            device_type, device_id = device_type.device_type, device_type.device_id
+        if device_type not in self.devstr2type:
+            raise ValueError(f"unknown device type {device_type!r}")
+        self.device_type = device_type
+        self.device_id = int(device_id)
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def device_typeid(self) -> int:
+        return self.devstr2type[self.device_type]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    __str__ = __repr__
+
+    # -- resolution to a physical JAX device ------------------------------
+    @property
+    def jax_device(self):
+        """The concrete jax.Device this context maps to.
+
+        tpu→tpu devices when present, else the default backend (CPU test
+        harness); gpu→tpu/gpu accelerator if present (so reference scripts
+        that say ``mx.gpu(0)`` run on the TPU chip), else default.
+        """
+        platform = self.device_type
+        if platform in ("cpu_pinned", "cpu_shared"):
+            platform = "cpu"
+        devs = _devices_for(platform)
+        if not devs and platform == "gpu":
+            devs = _devices_for("tpu")
+        if not devs and platform == "tpu":
+            # Some TPU-attached platforms register under a different name
+            # (e.g. the experimental 'axon' tunnel); jax.devices() returns
+            # the accelerator first.
+            default = jax.devices()
+            if default and default[0].platform != "cpu":
+                devs = default
+        if not devs:
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+    def empty_cache(self):
+        """Release cached device memory back to the platform.
+
+        The reference frees the GPU pool (storage per-device release);
+        under PJRT, buffers are freed eagerly when unreferenced, so this
+        only triggers a GC-level sweep.
+        """
+        import gc
+
+        gc.collect()
+
+    def __enter__(self):
+        if not hasattr(_context_stack, "contexts"):
+            _context_stack.contexts = []
+        _context_stack.contexts.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _context_stack.contexts.pop()
+
+
+def current_context() -> Context:
+    """The innermost ``with ctx:`` context, defaulting to cpu(0).
+
+    Matches reference semantics (python/mxnet/context.py current_context):
+    default context is cpu; ops placed explicitly via ctx args.
+    """
+    stack = getattr(_context_stack, "contexts", None)
+    if stack:
+        return stack[-1]
+    return Context("cpu", 0)
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    return Context("gpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def num_gpus() -> int:
+    return len(_devices_for("gpu"))
+
+
+def num_tpus() -> int:
+    devs = _devices_for("tpu")
+    if not devs:
+        devs = [d for d in jax.devices() if d.platform not in ("cpu",)]
+    return len(devs)

@@ -1,0 +1,281 @@
+"""Dependency engine — async scheduling with read/write variable ordering.
+
+TPU-native re-design of the reference dependency engine (src/engine/,
+include/mxnet/engine.h:117-318).  On GPU the reference engine is the whole
+async story: every op is pushed with const/mutable vars and executed by
+per-device worker pools (threaded_engine_perdevice.cc:47-158).  On TPU the
+*device-side* asynchrony is already provided by PJRT's async dispatch —
+XLA executables launch asynchronously and `jax.Array`s are futures.  What
+remains engine-shaped, and what this module provides:
+
+* ``Var`` with a version counter (reference include/mxnet/engine.h:44-61) so
+  mutation ordering over shared buffers is observable/testable.
+* ``push(fn, const_vars, mutable_vars)`` honouring read/write dependency
+  ordering — reads of a version may proceed concurrently; writes serialize
+  (reference threaded_engine.h:101-229 ``VersionedVarBlock`` queues).
+* Exception capture on vars, rethrown at ``wait_for_var``/``wait_for_all``
+  (reference threaded_engine.cc:422-522) — the async-error contract that
+  ``NDArray.asnumpy`` relies on.
+* Two implementations selected by ``MXNET_ENGINE_TYPE`` (reference
+  src/engine/engine.cc:33-45): ``NaiveEngine`` (synchronous, for
+  debugging) and ``ThreadedEngine`` (worker pool).  Device kernels do NOT
+  run on these threads — they only sequence host-side closures (data
+  pipeline stages, checkpoint IO, KVStore server logic); device compute is
+  sequenced by JAX program order.
+"""
+from __future__ import annotations
+
+import threading
+import traceback
+from collections import deque
+
+from .base import get_env
+
+__all__ = ["Var", "Engine", "NaiveEngine", "ThreadedEngine", "get_engine", "set_engine"]
+
+
+class Var:
+    """A scheduling variable with a version counter.
+
+    Reference: engine::Var (include/mxnet/engine.h:44-61) — ``version()``
+    bumps on each write completion, which is how the reference detects
+    stale reads; we keep the same contract.
+    """
+
+    __slots__ = ("_lock", "_version", "_pending_writes", "_pending_reads",
+                 "_queue", "_exc", "name")
+
+    def __init__(self, name: str = ""):
+        self._lock = threading.Lock()
+        self._version = 0
+        self._pending_writes = 0
+        self._pending_reads = 0
+        self._queue: deque = deque()  # waiting (op, is_write) entries
+        self._exc = None
+        self.name = name
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def __repr__(self):
+        return f"Var({self.name or hex(id(self))}, v{self._version})"
+
+
+class _OpBlock:
+    __slots__ = ("fn", "const_vars", "mutable_vars", "wait_count", "lock",
+                 "done", "exc", "name")
+
+    def __init__(self, fn, const_vars, mutable_vars, name):
+        self.fn = fn
+        self.const_vars = const_vars
+        self.mutable_vars = mutable_vars
+        self.wait_count = 0
+        self.lock = threading.Lock()
+        self.done = threading.Event()
+        self.exc = None
+        self.name = name
+
+
+class Engine:
+    """Abstract engine interface (reference include/mxnet/engine.h:117)."""
+
+    def new_variable(self, name: str = "") -> Var:
+        return Var(name)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), name="op"):
+        raise NotImplementedError
+
+    def push_sync(self, fn, const_vars=(), mutable_vars=(), name="op"):
+        op = self.push(fn, const_vars, mutable_vars, name)
+        op.done.wait()
+        if op.exc is not None:
+            raise op.exc
+        return op
+
+    def wait_for_var(self, var: Var):
+        raise NotImplementedError
+
+    def wait_for_all(self):
+        raise NotImplementedError
+
+    def throw_pending(self, var: Var):
+        with var._lock:
+            exc, var._exc = var._exc, None
+        if exc is not None:
+            raise exc
+
+
+class NaiveEngine(Engine):
+    """Synchronous engine: run on push (reference naive_engine.cc:51)."""
+
+    def push(self, fn, const_vars=(), mutable_vars=(), name="op"):
+        op = _OpBlock(fn, tuple(const_vars), tuple(mutable_vars), name)
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 - engine boundary
+            op.exc = e
+            for v in op.mutable_vars:
+                v._exc = e
+        for v in op.mutable_vars:
+            v._version += 1
+        op.done.set()
+        return op
+
+    def wait_for_var(self, var):
+        self.throw_pending(var)
+
+    def wait_for_all(self):
+        pass
+
+
+class ThreadedEngine(Engine):
+    """Worker-pool engine with RW dependency queues.
+
+    Re-implements the scheduling core of threaded_engine.h:101-229:
+    each Var keeps a FIFO of waiting ops; concurrent readers of the same
+    version run in parallel, writers are exclusive.  Host closures only.
+    """
+
+    def __init__(self, num_workers: int | None = None):
+        self._num_workers = num_workers or get_env("MXNET_CPU_WORKER_NTHREADS", 4, int)
+        self._ready: deque = deque()
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self._shutdown = False
+        self._threads = [
+            threading.Thread(target=self._worker_loop, daemon=True,
+                             name=f"mxtpu-engine-{i}")
+            for i in range(self._num_workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- dependency bookkeeping ------------------------------------------
+    def push(self, fn, const_vars=(), mutable_vars=(), name="op"):
+        const_vars = tuple(const_vars)
+        mutable_vars = tuple(mutable_vars)
+        dup = set(const_vars) & set(mutable_vars)
+        if dup:
+            const_vars = tuple(v for v in const_vars if v not in dup)
+        op = _OpBlock(fn, const_vars, mutable_vars, name)
+        with self._cv:
+            self._inflight += 1
+        blocked = 0
+        for v in const_vars:
+            with v._lock:
+                if v._pending_writes > 0 or v._queue:
+                    v._queue.append((op, False))
+                    blocked += 1
+                else:
+                    v._pending_reads += 1
+        for v in mutable_vars:
+            with v._lock:
+                if v._pending_writes > 0 or v._pending_reads > 0 or v._queue:
+                    v._queue.append((op, True))
+                    blocked += 1
+                else:
+                    v._pending_writes += 1
+        with op.lock:
+            op.wait_count += blocked
+            ready = op.wait_count == 0 and blocked == 0
+        if ready:
+            self._enqueue(op)
+        else:
+            # account for deps that resolved between our scan and now
+            self._maybe_ready(op, delta=0)
+        return op
+
+    def _maybe_ready(self, op, delta):
+        with op.lock:
+            op.wait_count -= delta
+            ready = op.wait_count == 0
+        if ready and delta != 0:
+            self._enqueue(op)
+
+    def _enqueue(self, op):
+        with self._cv:
+            self._ready.append(op)
+            self._cv.notify()
+
+    def _release_var(self, v: Var, was_write: bool, exc):
+        to_wake = []
+        with v._lock:
+            if was_write:
+                v._pending_writes -= 1
+                v._version += 1
+                if exc is not None:
+                    v._exc = exc
+            else:
+                v._pending_reads -= 1
+            # drain queue head: a run of reads, or one write
+            while v._queue:
+                op, is_write = v._queue[0]
+                if is_write:
+                    if v._pending_reads == 0 and v._pending_writes == 0:
+                        v._queue.popleft()
+                        v._pending_writes += 1
+                        to_wake.append(op)
+                    break
+                if v._pending_writes > 0:
+                    break
+                v._queue.popleft()
+                v._pending_reads += 1
+                to_wake.append(op)
+        for op in to_wake:
+            self._maybe_ready(op, delta=1)
+
+    def _worker_loop(self):
+        while True:
+            with self._cv:
+                while not self._ready and not self._shutdown:
+                    self._cv.wait()
+                if self._shutdown and not self._ready:
+                    return
+                op = self._ready.popleft()
+            exc = None
+            try:
+                op.fn()
+            except Exception as e:  # noqa: BLE001 - engine boundary
+                exc = e
+                exc._engine_traceback = traceback.format_exc()
+                op.exc = e
+            for v in op.const_vars:
+                self._release_var(v, was_write=False, exc=None)
+            for v in op.mutable_vars:
+                self._release_var(v, was_write=True, exc=exc)
+            op.done.set()
+            with self._cv:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._cv.notify_all()
+
+    # -- waits ------------------------------------------------------------
+    def wait_for_var(self, var: Var):
+        probe = self.push(lambda: None, const_vars=(var,), name="wait_for_var")
+        probe.done.wait()
+        self.throw_pending(var)
+
+    def wait_for_all(self):
+        with self._cv:
+            while self._inflight:
+                self._cv.wait()
+
+
+_engine_lock = threading.Lock()
+_engine: Engine | None = None
+
+
+def get_engine() -> Engine:
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            kind = get_env("MXNET_ENGINE_TYPE", "ThreadedEngine")
+            _engine = NaiveEngine() if kind == "NaiveEngine" else ThreadedEngine()
+        return _engine
+
+
+def set_engine(engine: Engine):
+    global _engine
+    with _engine_lock:
+        _engine = engine

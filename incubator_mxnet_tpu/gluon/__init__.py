@@ -1,0 +1,14 @@
+"""Gluon: the define-by-run API (reference python/mxnet/gluon/)."""
+from .block import Block, HybridBlock, SymbolBlock, CachedOp
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import metric
+from . import data
+from . import model_zoo
+from . import utils
+from . import contrib
+from .utils import split_and_load

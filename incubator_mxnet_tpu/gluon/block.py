@@ -1,0 +1,528 @@
+"""Block / HybridBlock: the define-by-run API with whole-graph compilation.
+
+TPU-native re-design of the reference Gluon core
+(python/mxnet/gluon/block.py — Block :251, HybridBlock :854, hybridize
+:1172 → _build_cache :985 → CachedOp; C++ side src/imperative/cached_op.h).
+
+The reference's CachedOp traces the block into an NNVM graph and replays
+it through the engine.  Here ``hybridize()`` compiles the *entire* block
+into one XLA executable via ``jax.jit``:
+
+* Tracing: parameters are temporarily mapped to tracer-backed NDArrays
+  (see parameter._TraceParams), the block's ``forward`` runs once under
+  ``jax.jit`` tracing, and the jaxpr is compiled.  This is the analog of
+  deferred-compute tracing (reference block.py:1340) + whole-graph bind.
+* Autograd: when recording, the compiled forward runs under ``jax.vjp``
+  and lands on the tape as a *single* node — backward through the block
+  is one compiled XLA call (the CachedOp::Backward analog).
+* Mutable state (BatchNorm moving stats): collected during tracing as
+  extra outputs and written back after execution, replacing the
+  reference's in-place aux-state mutation with a functional round-trip.
+* static_alloc → XLA buffer donation of input activations;
+  static_shape → cache keyed on input shapes (shape buckets).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as onp
+
+from .. import autograd
+from .. import random as _random
+from ..context import current_context
+from ..ndarray import NDArray
+from .parameter import Parameter, ParameterDict, _TraceParams, \
+    DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "CachedOp"]
+
+_state_updates = threading.local()
+
+
+def register_state_update(param: Parameter, new_value):
+    """BatchNorm-style aux-state update: defer if tracing, else apply."""
+    collector = getattr(_state_updates, "stack", None)
+    if collector:
+        collector[-1].append((param, new_value))
+    else:
+        with autograd.pause():
+            param._check_and_get()._set_data(
+                new_value.data if isinstance(new_value, NDArray) else new_value)
+
+
+class _CollectStateUpdates:
+    def __enter__(self):
+        if not hasattr(_state_updates, "stack"):
+            _state_updates.stack = []
+        self.updates = []
+        _state_updates.stack.append(self.updates)
+        return self.updates
+
+    def __exit__(self, *exc):
+        _state_updates.stack.pop()
+
+
+class Block:
+    """Base building block (reference gluon/block.py:251)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._prefix = prefix or ""
+        self._children: dict[str, Block] = {}
+        self._reg_params: dict[str, Parameter] = {}
+        self._forward_hooks: list = []
+        self._forward_pre_hooks: list = []
+        self._shared_params = params
+
+    # -- registration -----------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            self.__dict__.setdefault("_children", {})[name] = value
+        elif isinstance(value, Parameter):
+            self.__dict__.setdefault("_reg_params", {})[name] = value
+            if not value.name or value.name == "param":
+                value.name = name
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+        return block
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._prefix.rstrip("_") or type(self).__name__.lower()
+
+    def name_scope(self):
+        """Compat no-op scope (the reference used it for name prefixes)."""
+        from ..name import Prefix
+        return Prefix(self._prefix)
+
+    @property
+    def params(self) -> ParameterDict:
+        d = ParameterDict(self._prefix)
+        for name, p in self._reg_params.items():
+            d._add(p.name if p.name != "param" else name, p)
+        return d
+
+    def collect_params(self, select=None) -> ParameterDict:
+        """All params of self + descendants, qualified names
+        (reference block.py collect_params)."""
+        out = ParameterDict(self._prefix)
+        self._collect_params_into(out, prefix="")
+        if select is not None:
+            import re
+            pat = re.compile(select)
+            filtered = ParameterDict(self._prefix)
+            for k, v in out.items():
+                if pat.match(k):
+                    filtered._add(k, v)
+            return filtered
+        return out
+
+    def _collect_params_into(self, out: ParameterDict, prefix: str):
+        for name, p in self._reg_params.items():
+            out._add(prefix + name, p)
+        for cname, child in self._children.items():
+            child._collect_params_into(out, prefix + cname + ".")
+
+    # -- lifecycle --------------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init=init, ctx=ctx,
+                                         force_reinit=force_reinit)
+        return self
+
+    def cast(self, dtype):
+        for p in self.collect_params().values():
+            p.cast(dtype)
+        for child in self._children.values():
+            pass  # params already collected recursively
+        self._cast_hook(dtype)
+        return self
+
+    def _cast_hook(self, dtype):
+        for child in self._children.values():
+            child._cast_hook(dtype)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def reset_ctx(self, ctx):
+        self.collect_params().reset_ctx(ctx)
+
+    # -- persistence (reference block.py:440 save_parameters / :496 load) -
+    def save_parameters(self, filename, deduplicate=False):
+        from .. import ndarray as nd
+        arrays = {}
+        for name, p in self.collect_params().items():
+            arrays[name] = p.data()
+        nd.save(filename, arrays)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        from .. import ndarray as nd
+        loaded = nd.load(filename)
+        if isinstance(loaded, list):
+            raise ValueError("expected dict-of-arrays params file")
+        params = self.collect_params()
+        for name, p in params.items():
+            if name in loaded:
+                if p._data is None:
+                    p.shape = loaded[name].shape
+                    p.initialize(ctx=ctx)
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise KeyError(f"parameter {name} missing in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(params.keys())
+            if extra:
+                raise KeyError(f"extra params in file: {sorted(extra)}")
+
+    save_params = save_parameters
+    load_params = load_parameters
+
+    # -- hooks ------------------------------------------------------------
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return _HookHandle(self._forward_hooks, hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return _HookHandle(self._forward_pre_hooks, hook)
+
+    # -- call -------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Print per-block output shapes (reference block.py summary)."""
+        rows = []
+
+        def add_hooks(block, prefix):
+            def hook(blk, ins, out):
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                shapes = [tuple(o.shape) for o in outs if isinstance(o, NDArray)]
+                nparams = sum(int(onp.prod(p.shape)) for p in
+                              blk._reg_params.values()
+                              if p._shape_complete())
+                rows.append((prefix or type(blk).__name__, shapes, nparams))
+            handles.append(block.register_forward_hook(hook))
+            for name, c in block._children.items():
+                add_hooks(c, f"{prefix}.{name}" if prefix else name)
+
+        handles: list = []
+        add_hooks(self, "")
+        try:
+            self(*inputs)
+        finally:
+            for h in handles:
+                h.detach()
+        print(f"{'Layer':<40} {'Output shape':<24} {'Params':>12}")
+        print("-" * 78)
+        for name, shapes, nparams in rows:
+            print(f"{name:<40} {str(shapes):<24} {nparams:>12}")
+        total = sum(int(onp.prod(p.shape)) for p in
+                    self.collect_params().values() if p._shape_complete())
+        print("-" * 78)
+        print(f"Total params: {total}")
+
+    def __repr__(self):
+        lines = [type(self).__name__ + "("]
+        for name, child in self._children.items():
+            child_repr = repr(child).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {child_repr}")
+        lines.append(")")
+        return "\n".join(lines)
+
+
+class _HookHandle:
+    def __init__(self, hook_list, hook):
+        self._list = hook_list
+        self._hook = hook
+
+    def detach(self):
+        if self._hook in self._list:
+            self._list.remove(self._hook)
+
+
+class CachedOp:
+    """Whole-block compiled executable (reference src/imperative/cached_op.h:365).
+
+    One instance per hybridized block; caches one compiled program per
+    (input shapes, dtypes, training-mode) signature — the TPU analog of
+    the reference's per-bucket executors.
+    """
+
+    def __init__(self, block: "HybridBlock", static_alloc=False,
+                 static_shape=False):
+        self.block = block
+        self.static_alloc = static_alloc
+        self.static_shape = static_shape
+        self._cache: dict = {}
+
+    def _ordered_params(self):
+        return list(self.block.collect_params().values())
+
+    def _build(self, sig, params, training):
+        entry = {"single": True, "su_params": []}
+
+        def pure(param_vals, input_vals, key):
+            mapping = {p: NDArray(v) for p, v in zip(params, param_vals)}
+            with _TraceParams(mapping), _random.key_scope(key), \
+                    autograd._scope(None, training), _CollectStateUpdates() as su:
+                outs = self.block.forward(*[NDArray(v) for v in input_vals])
+            if isinstance(outs, (list, tuple)):
+                entry["single"] = False
+                out_vals = tuple(o.data for o in outs)
+            else:
+                out_vals = (outs.data,)
+            entry["su_params"] = [p for p, _ in su]
+            upd_vals = tuple(v.data if isinstance(v, NDArray) else v
+                             for _, v in su)
+            return out_vals, upd_vals
+
+        entry["jfn"] = jax.jit(pure, donate_argnums=(1,) if self.static_alloc else ())
+        return entry
+
+    def __call__(self, *inputs):
+        params = self._ordered_params()
+        # deferred shape inference: fall back to one eager pass
+        for p in params:
+            if p._data is None and p._deferred_init_args is not None:
+                return self.block.forward(*inputs)
+        raw_params = [p._check_and_get().data for p in params]
+        raw_inputs = [x.data for x in inputs]
+        training = autograd.is_training()
+        sig = (tuple((tuple(a.shape), str(a.dtype)) for a in raw_inputs),
+               training, len(raw_params))
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = self._build(sig, params, training)
+            self._cache[sig] = entry
+        jfn = entry["jfn"]
+        key = _random.next_key()
+
+        recording = autograd.is_recording()
+        grad_params = [p for p in params if p.grad_req != "null"]
+        need_grad = recording and (
+            grad_params or any(x._in_graph() for x in inputs))
+        if need_grad:
+            out_vals, vjp_fn, upd_vals = jax.vjp(
+                lambda ps, xs: jfn(ps, xs, key), raw_params, raw_inputs,
+                has_aux=True)
+        else:
+            out_vals, upd_vals = jfn(raw_params, raw_inputs, key)
+
+        out_nds = tuple(NDArray(v, ctx=inputs[0].ctx if inputs else current_context())
+                        for v in out_vals)
+        # apply collected state updates (moving stats)
+        for p, v in zip(entry["su_params"], upd_vals):
+            with autograd.pause():
+                p._check_and_get()._set_data(v)
+
+        if need_grad:
+            nd_inputs = [p._data for p in params] + \
+                [x for x in inputs if isinstance(x, NDArray)]
+
+            def tape_vjp(seed):
+                if not isinstance(seed, tuple):
+                    seed = (seed,)
+                grad_ps, grad_xs = vjp_fn(seed)
+                return tuple(grad_ps) + tuple(grad_xs)
+
+            autograd._record(None, tape_vjp, inputs, nd_inputs,
+                             list(range(len(nd_inputs))), out_nds)
+        return out_nds[0] if entry["single"] else out_nds
+
+
+class HybridBlock(Block):
+    """Block that can compile to a single XLA program
+    (reference gluon/block.py:854)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._cached_op: CachedOp | None = None
+        self._flags = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  **kwargs):
+        """Enable whole-graph compilation (reference block.py:1172)."""
+        self._active = active
+        self._flags = {"static_alloc": static_alloc,
+                       "static_shape": static_shape}
+        self._cached_op = None
+        for child in self._children.values():
+            if isinstance(child, HybridBlock):
+                child._active = False  # only the outermost block compiles
+        return self
+
+    def _get_cached_op(self):
+        if self._cached_op is None:
+            self._cached_op = CachedOp(self, **self._flags)
+        return self._cached_op
+
+    def __call__(self, *args, **kwargs):
+        if self._active and args and all(
+                isinstance(a, NDArray) and
+                not isinstance(a.data, jax.core.Tracer) for a in args):
+            for hook in self._forward_pre_hooks:
+                hook(self, args)
+            out = self._get_cached_op()(*args)
+            for hook in self._forward_hooks:
+                hook(self, args, out)
+            return out
+        return super().__call__(*args, **kwargs)
+
+    # -- reference hybrid_forward compatibility ---------------------------
+    def forward(self, *args, **kwargs):
+        if type(self).hybrid_forward is not HybridBlock.hybrid_forward:
+            from .. import ndarray as F
+            param_kwargs = {name: p.data() for name, p in
+                            self._reg_params.items()}
+            return self.hybrid_forward(F, *args, **param_kwargs, **kwargs)
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement forward() or "
+            f"hybrid_forward()")
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- functional bridge (TPU-first: feeds pjit/shard_map) --------------
+    def functional(self):
+        """Return ``(params_dict, apply_fn)`` for pure-functional use.
+
+        ``apply_fn(params_dict, *inputs, training=False, key=None)`` is a
+        pure function suitable for ``jax.jit``/``pjit``/``shard_map`` —
+        the bridge from the imperative Gluon API to SPMD training (used
+        by the parallel layer; no reference equivalent, SURVEY.md §7
+        stage 10).
+        """
+        named = list(self.collect_params().items())
+        params_dict = {name: p.data().data for name, p in named}
+        name2param = {name: p for name, p in named}
+
+        def apply_fn(pvals, *input_vals, training=False, key=None):
+            key = key if key is not None else jax.random.PRNGKey(0)
+            mapping = {name2param[n]: NDArray(v) for n, v in pvals.items()}
+            with _TraceParams(mapping), _random.key_scope(key), \
+                    autograd._scope(None, training), _CollectStateUpdates():
+                outs = self.forward(*[NDArray(v) for v in input_vals])
+            if isinstance(outs, (list, tuple)):
+                return tuple(o.data for o in outs)
+            return outs.data
+
+        return params_dict, apply_fn
+
+    def infer_shape(self, *args):
+        """Resolve deferred parameter shapes by abstract evaluation."""
+        self.forward(*args)  # eager pass performs deferred init
+
+    def export(self, path, epoch=0, remove_amp_cast=True, example_inputs=None):
+        """Serialize graph + params (reference block.py:1248 export).
+
+        TPU re-design of the symbol.json deployment format: the traced
+        forward is serialized as a portable StableHLO program
+        (``jax.export``) in ``path-symbol.stablehlo`` with a JSON
+        manifest in ``path-symbol.json``, plus ``path-%04d.params``.
+        This is the deploy artifact the reference's C predict API loaded
+        (SURVEY.md §2.1 "C API": predict maps to serialized StableHLO).
+        """
+        import json as _json
+        from jax import export as jax_export
+        from .. import ndarray as nd
+
+        if example_inputs is None:
+            raise ValueError(
+                "export needs example_inputs=(x, ...) to trace the graph")
+        params = self.collect_params()
+        named = list(params.items())
+        pvals = [p.data().data for _, p in named]
+        ivals = [x.data if isinstance(x, NDArray) else x
+                 for x in example_inputs]
+
+        def pure(param_vals, input_vals):
+            mapping = {p: NDArray(v)
+                       for (_, p), v in zip(named, param_vals)}
+            with _TraceParams(mapping), autograd._scope(None, False), \
+                    _CollectStateUpdates():
+                outs = self.forward(*[NDArray(v) for v in input_vals])
+            if isinstance(outs, (list, tuple)):
+                return tuple(o.data for o in outs)
+            return outs.data
+
+        exported = jax_export.export(jax.jit(pure))(pvals, ivals)
+        with open(f"{path}-symbol.stablehlo", "wb") as f:
+            f.write(exported.serialize())
+        manifest = {
+            "format": "stablehlo",
+            "inputs": [{"shape": list(v.shape), "dtype": str(v.dtype)}
+                       for v in ivals],
+            "params": [name for name, _ in named],
+        }
+        with open(f"{path}-symbol.json", "w") as f:
+            _json.dump(manifest, f, indent=2)
+        arrays = {f"arg:{k}": p.data() for k, p in params.items()}
+        nd.save(f"{path}-{epoch:04d}.params", arrays)
+        return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
+
+
+class SymbolBlock(HybridBlock):
+    """Run a Symbol graph as a Block (reference block.py:1410).
+
+    Construct with ``SymbolBlock(outputs, inputs)`` or
+    ``SymbolBlock.imports(symbol_file, input_names, param_file)``.
+    """
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__()
+        self._symbol_outputs = outputs
+        self._symbol_inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        input_names = {s.name for s in self._symbol_inputs}
+        arg_names = outputs.list_arguments() if not isinstance(outputs, list) \
+            else outputs[0].list_arguments()
+        for name in arg_names:
+            if name not in input_names:
+                p = Parameter(name, allow_deferred_init=True)
+                if params and name in params:
+                    data = params[name]
+                    p.shape = data.shape
+                    p.initialize(ctx=current_context())
+                    p.set_data(data)
+                self._reg_params[name] = p
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from .. import symbol as sym_mod
+        from .. import ndarray as nd
+        sym = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.var(n) for n in input_names]
+        params = None
+        if param_file:
+            loaded = nd.load(param_file)
+            params = {k.split(":", 1)[-1]: v for k, v in loaded.items()}
+        return SymbolBlock(sym, inputs, params=params)
+
+    def forward(self, *args):
+        bindings = {s.name: a for s, a in zip(self._symbol_inputs, args)}
+        for name, p in self._reg_params.items():
+            bindings[name] = p.data()
+        return self._symbol_outputs.eval_with(bindings)

@@ -1,0 +1,98 @@
+"""Estimator: high-level fit loop (reference gluon/contrib/estimator/estimator.py)."""
+from __future__ import annotations
+
+from .... import autograd
+from ....context import current_context
+from ... import metric as metric_mod
+from ...trainer import Trainer
+from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
+                            TrainBegin, TrainEnd, MetricHandler,
+                            LoggingHandler)
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 trainer=None, context=None, evaluation_loss=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = train_metrics or [metric_mod.Accuracy()]
+        self.val_metrics = val_metrics or [metric_mod.Accuracy()]
+        self.context = context or current_context()
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "sgd", {"learning_rate": 0.001})
+        self.evaluation_loss = evaluation_loss or loss
+        self.train_loss_metric = metric_mod.Loss("train_loss")
+
+    def prepare_loss_and_metrics(self):
+        return self.train_metrics, self.val_metrics
+
+    def evaluate(self, val_data, batch_axis=0):
+        for m in self.val_metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = batch[0], batch[1]
+            data = data.as_in_context(self.context)
+            pred = self.net(data)
+            for m in self.val_metrics:
+                m.update([label], [pred])
+        return {m.get()[0]: m.get()[1] for m in self.val_metrics}
+
+    def fit(self, train_data, val_data=None, epochs=1, event_handlers=None,
+            batch_axis=0):
+        handlers = list(event_handlers or [])
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(self.train_metrics))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler())
+        for h in handlers:
+            if hasattr(h, "bind"):
+                h.bind(self)
+
+        estimator_ref = self
+        for h in handlers:
+            if isinstance(h, TrainBegin):
+                h.train_begin(estimator_ref)
+        self.current_epoch = 0
+        self.batch_idx = 0
+        stop = False
+        for epoch in range(epochs):
+            self.current_epoch = epoch
+            for h in handlers:
+                if isinstance(h, EpochBegin):
+                    h.epoch_begin(estimator_ref)
+            for batch in train_data:
+                for h in handlers:
+                    if isinstance(h, BatchBegin):
+                        h.batch_begin(estimator_ref, batch=batch)
+                data, label = batch[0], batch[1]
+                data = data.as_in_context(self.context)
+                label = label if not hasattr(label, "as_in_context") \
+                    else label.as_in_context(self.context)
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                loss.backward()
+                bs = data.shape[batch_axis]
+                self.trainer.step(bs)
+                self.train_loss_metric.update(None, [loss])
+                for m in self.train_metrics:
+                    m.update([label], [pred])
+                self.batch_idx += 1
+                for h in handlers:
+                    if isinstance(h, BatchEnd):
+                        if h.batch_end(estimator_ref, batch=batch,
+                                       pred=pred, label=label, loss=loss):
+                            stop = True
+                if stop:
+                    break
+            if val_data is not None:
+                self.evaluate(val_data)
+            for h in handlers:
+                if isinstance(h, EpochEnd):
+                    if h.epoch_end(estimator_ref):
+                        stop = True
+            if stop:
+                break
+        for h in handlers:
+            if isinstance(h, TrainEnd):
+                h.train_end(estimator_ref)

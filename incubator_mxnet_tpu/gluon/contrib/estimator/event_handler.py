@@ -1,0 +1,183 @@
+"""Event handlers incl. checkpoint/resume (reference
+gluon/contrib/estimator/event_handler.py:336 CheckpointHandler,
+resume_from_checkpoint :371-403 — the framework's checkpoint-restart
+recovery story, SURVEY.md §5.3/5.4)."""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        return False
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        return False
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+
+    def batch_end(self, estimator, *args, **kwargs):
+        return self.max_batch is not None and \
+            estimator.batch_idx >= self.max_batch
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        return self.max_epoch is not None and \
+            estimator.current_epoch + 1 >= self.max_epoch
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        return False
+
+
+class ValidationHandler(BatchEnd, EpochEnd):
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        if (estimator.current_epoch + 1) % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+        return False
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
+    def __init__(self, log_interval="epoch", metrics=None):
+        self.log_interval = log_interval
+        self.metrics = metrics
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self._start = time.time()
+        logging.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        logging.info("Training end; total time %.1fs",
+                     time.time() - self._start)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        msgs = []
+        for m in estimator.train_metrics + [estimator.train_loss_metric]:
+            name, value = m.get()
+            msgs.append(f"{name}={value:.4f}")
+        logging.info("Epoch %d: %s", estimator.current_epoch, " ".join(msgs))
+        return False
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Periodic / best-k checkpointing with resume (reference :336-403)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5,
+                 resume_from_checkpoint=False):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.max_checkpoints = max_checkpoints
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.best = None
+        self.saved = []
+        os.makedirs(model_dir, exist_ok=True)
+
+    def train_begin(self, estimator, *args, **kwargs):
+        if self.resume_from_checkpoint:
+            ckpts = sorted(
+                f for f in os.listdir(self.model_dir)
+                if f.startswith(self.model_prefix) and f.endswith(".params")
+                and "epoch" in f)
+            if ckpts:
+                latest = ckpts[-1]
+                epoch = int(latest.split("epoch")[1].split("-")[0]
+                            .split(".")[0])
+                estimator.net.load_parameters(
+                    os.path.join(self.model_dir, latest))
+                states = os.path.join(
+                    self.model_dir, latest.replace(".params", ".states"))
+                if os.path.exists(states):
+                    estimator.trainer.load_states(states)
+                estimator.current_epoch = epoch + 1
+                logging.info("Resumed from %s (epoch %d)", latest, epoch)
+
+    def _save(self, estimator, tag):
+        base = os.path.join(self.model_dir, f"{self.model_prefix}-{tag}")
+        estimator.net.save_parameters(base + ".params")
+        estimator.trainer.save_states(base + ".states")
+        self.saved.append(base)
+        while len(self.saved) > self.max_checkpoints:
+            old = self.saved.pop(0)
+            for ext in (".params", ".states"):
+                try:
+                    os.remove(old + ext)
+                except FileNotFoundError:
+                    pass
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        if (estimator.current_epoch + 1) % self.epoch_period == 0:
+            self._save(estimator, f"epoch{estimator.current_epoch}")
+            if self.save_best and self.monitor is not None:
+                name, value = self.monitor.get()
+                if self.best is None or value > self.best:
+                    self.best = value
+                    base = os.path.join(self.model_dir,
+                                        f"{self.model_prefix}-best")
+                    estimator.net.save_parameters(base + ".params")
+        return False
+
+
+class EarlyStoppingHandler(EpochEnd):
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.best = None
+        self.wait = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        name, value = self.monitor.get()
+        if self.best is None or value > self.best + self.min_delta:
+            self.best = value
+            self.wait = 0
+            return False
+        self.wait += 1
+        return self.wait > self.patience

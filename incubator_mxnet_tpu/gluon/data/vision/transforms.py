@@ -1,0 +1,124 @@
+"""Vision transforms (reference gluon/data/vision/transforms.py)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from .... import ndarray as nd
+from ....ndarray import NDArray
+from ...block import Block
+from ...nn.basic_layers import Sequential
+
+
+class Compose(Sequential):
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(Block):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (reference transforms.ToTensor)."""
+
+    def forward(self, x):
+        out = x.astype("float32") / 255.0
+        if out.ndim == 3:
+            return out.transpose((2, 0, 1))
+        return out.transpose((0, 3, 1, 2))
+
+
+class Normalize(Block):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = onp.asarray(mean, "float32").reshape(-1, 1, 1)
+        self._std = onp.asarray(std, "float32").reshape(-1, 1, 1)
+
+    def forward(self, x):
+        return (x - nd.array(self._mean, ctx=x.ctx)) / \
+            nd.array(self._std, ctx=x.ctx)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        import jax.image
+        h, w = self._size[1], self._size[0]
+        if x.ndim == 3:
+            out = jax.image.resize(x.data.astype("float32"),
+                                   (h, w, x.shape[2]), method="bilinear")
+        else:
+            out = jax.image.resize(x.data.astype("float32"),
+                                   (x.shape[0], h, w, x.shape[3]),
+                                   method="bilinear")
+        return NDArray(out.astype(x.data.dtype), ctx=x.ctx)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[-3], x.shape[-2]
+        y0, x0 = max((H - h) // 2, 0), max((W - w) // 2, 0)
+        return x[..., y0:y0 + h, x0:x0 + w, :]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        H, W = x.shape[-3], x.shape[-2]
+        area = H * W
+        for _ in range(10):
+            target = onp.random.uniform(*self._scale) * area
+            ar = onp.random.uniform(*self._ratio)
+            w = int(round((target * ar) ** 0.5))
+            h = int(round((target / ar) ** 0.5))
+            if w <= W and h <= H:
+                x0 = onp.random.randint(0, W - w + 1)
+                y0 = onp.random.randint(0, H - h + 1)
+                crop = x[..., y0:y0 + h, x0:x0 + w, :]
+                return Resize(self._size).forward(crop)
+        return Resize(self._size).forward(x)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if onp.random.rand() < 0.5:
+            return x[..., ::-1, :] if x.ndim == 3 else x
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if onp.random.rand() < 0.5:
+            return NDArray(x.data[::-1], ctx=x.ctx) if x.ndim == 3 else x
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        alpha = 1.0 + onp.random.uniform(-self._b, self._b)
+        return (x.astype("float32") * alpha).clip(0, 255).astype(str(x.dtype))

@@ -1,0 +1,244 @@
+"""Loss functions (reference python/mxnet/gluon/loss.py)."""
+from __future__ import annotations
+
+from ..ndarray import NDArray
+from ..ops.registry import invoke
+from .block import HybridBlock
+
+__all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
+           "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
+           "KLDivLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
+           "LogisticLoss", "TripletLoss", "CosineEmbeddingLoss", "CTCLoss"]
+
+
+def _apply_weighting(loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+def _reshape_like(pred, label):
+    if label.shape != pred.shape:
+        label = label.reshape(pred.shape)
+    return label
+
+
+class Loss(HybridBlock):
+    """Base loss (reference loss.py:54): weight + batch_axis, mean over
+    non-batch axes."""
+
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def _mean(self, loss):
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        if axes:
+            return invoke("mean", loss, axis=axes)
+        return loss
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = invoke("square", label - pred)
+        loss = _apply_weighting(loss, self._weight / 2, sample_weight)
+        return self._mean(loss)
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = invoke("abs", label - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean(loss)
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def forward(self, pred, label, sample_weight=None, pos_weight=None):
+        label = _reshape_like(pred, label)
+        if not self._from_sigmoid:
+            # max(x,0) - x*z + log(1+exp(-|x|)) — numerically stable BCE
+            loss = invoke("relu", pred) - pred * label + \
+                invoke("log1p", invoke("exp", -invoke("abs", pred)))
+            if pos_weight is not None:
+                loss = loss + (pos_weight - 1) * label * (
+                    invoke("log1p", invoke("exp", -invoke("abs", pred))) +
+                    invoke("relu", -pred))
+        else:
+            eps = 1e-12
+            loss = -(invoke("log", pred + eps) * label +
+                     invoke("log", 1.0 - pred + eps) * (1.0 - label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean(loss)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Softmax CE (reference loss.py SoftmaxCrossEntropyLoss)."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = invoke("log_softmax", pred, axis=self._axis)
+        if self._sparse_label:
+            loss = -invoke("pick", pred, label, axis=self._axis, keepdims=True)
+        else:
+            label = _reshape_like(pred, label)
+            loss = -invoke("sum", pred * label, axis=self._axis, keepdims=True)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean(loss)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = invoke("log_softmax", pred, axis=self._axis)
+        loss = label * (invoke("log", label + 1e-12) - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean(loss)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = invoke("abs", label - pred)
+        loss = invoke("where", loss > self._rho,
+                      loss - 0.5 * self._rho,
+                      (0.5 / self._rho) * invoke("square", loss))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean(loss)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = invoke("relu", self._margin - pred * label)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean(loss)
+
+
+class SquaredHingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = invoke("square", invoke("relu", self._margin - pred * label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean(loss)
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed",
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._label_format = label_format
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        if self._label_format == "signed":
+            label = (label + 1.0) / 2.0
+        loss = invoke("relu", pred) - pred * label + \
+            invoke("log1p", invoke("exp", -invoke("abs", pred)))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean(loss)
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, positive, negative, sample_weight=None):
+        positive = _reshape_like(pred, positive)
+        negative = _reshape_like(pred, negative)
+        loss = invoke("sum", invoke("square", pred - positive) -
+                      invoke("square", pred - negative),
+                      axis=tuple(range(1, pred.ndim)))
+        loss = invoke("relu", loss + self._margin)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return loss
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, input1, input2, label, sample_weight=None):
+        def cos_sim(a, b):
+            num = invoke("sum", a * b, axis=-1)
+            den = invoke("norm", a, axis=-1) * invoke("norm", b, axis=-1)
+            return num / (den + 1e-12)
+
+        sim = cos_sim(input1, input2)
+        label = label.reshape((-1,))
+        loss = invoke("where", label == 1, 1.0 - sim,
+                      invoke("relu", sim - self._margin))
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class CTCLoss(Loss):
+    """CTC (reference loss.py CTCLoss; op src/operator/nn/ctc_loss.cc)."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+        super().__init__(weight, 0, **kwargs)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def forward(self, pred, label, pred_lengths=None, label_lengths=None,
+                sample_weight=None):
+        from .. import ndarray as nd
+        if self._layout == "NTC":
+            pred = pred.transpose((1, 0, 2))
+        if self._label_layout == "TN":
+            label = label.transpose((1, 0))
+        B = pred.shape[1]
+        if pred_lengths is None:
+            pred_lengths = nd.full((B,), pred.shape[0], dtype="int32",
+                                   ctx=pred.ctx)
+        if label_lengths is None:
+            label_lengths = nd.full((B,), label.shape[1], dtype="int32",
+                                    ctx=pred.ctx)
+        loss = invoke("ctc_loss", pred, label, pred_lengths, label_lengths)
+        return _apply_weighting(loss, self._weight, sample_weight)

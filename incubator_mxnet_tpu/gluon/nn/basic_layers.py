@@ -1,0 +1,347 @@
+"""Core layers (reference python/mxnet/gluon/nn/basic_layers.py)."""
+from __future__ import annotations
+
+from ... import initializer as init_mod
+from ... import random as _random
+from ...ndarray import NDArray
+from ...ops.registry import invoke
+from ..block import Block, HybridBlock, register_state_update
+from ..parameter import Parameter
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Flatten",
+           "Lambda", "HybridLambda", "Embedding", "Activation", "LeakyReLU",
+           "PReLU", "ELU", "SELU", "GELU", "Swish", "SiLU", "BatchNorm",
+           "LayerNorm", "GroupNorm", "InstanceNorm", "Identity"]
+
+
+class Sequential(Block):
+    """Stack of blocks applied in order (reference basic_layers.py:46)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+        return self
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = ()
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        children = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*children[key])
+            return net
+        return children[key]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class HybridSequential(Sequential, HybridBlock):
+    """Hybridizable Sequential (reference basic_layers.py:106)."""
+
+    def __init__(self, prefix=None, params=None):
+        HybridBlock.__init__(self, prefix, params)
+
+    forward = Sequential.forward
+
+
+class Dense(HybridBlock):
+    """Fully connected layer (reference basic_layers.py Dense)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._flatten = flatten
+        self._activation = activation
+        self._use_bias = use_bias
+        self.weight = Parameter("weight", shape=(units, in_units), dtype=dtype,
+                                init=weight_initializer,
+                                allow_deferred_init=True)
+        if use_bias:
+            self.bias = Parameter("bias", shape=(units,), dtype=dtype,
+                                  init=bias_initializer or init_mod.Zero(),
+                                  allow_deferred_init=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.weight.shape[1] == 0:
+            in_units = x.size // x.shape[0] if self._flatten else x.shape[-1]
+            self.weight.shape = (self._units, in_units)
+            self.weight._finish_deferred_init()
+        if self._use_bias and self.bias._data is None:
+            self.bias._finish_deferred_init()
+        args = [x, self.weight.data()]
+        if self._use_bias:
+            args.append(self.bias.data())
+        out = invoke("FullyConnected", *args, num_hidden=self._units,
+                     no_bias=not self._use_bias, flatten=self._flatten)
+        if self._activation:
+            out = invoke("Activation", out, act_type=self._activation)
+        return out
+
+
+class Embedding(HybridBlock):
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = Parameter("weight", shape=(input_dim, output_dim),
+                                dtype=dtype, init=weight_initializer)
+
+    def forward(self, x):
+        return invoke("Embedding", x, self.weight.data(),
+                      input_dim=self._input_dim, output_dim=self._output_dim)
+
+
+class Dropout(HybridBlock):
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def forward(self, x):
+        from ... import autograd
+        if not autograd.is_training() or self._rate <= 0:
+            return x
+        key = NDArray(_random.next_key(), ctx=x.ctx)
+        return invoke("Dropout", x, key, p=self._rate, mode="training",
+                      axes=self._axes)
+
+
+class Flatten(HybridBlock):
+    def forward(self, x):
+        return x.reshape((x.shape[0], -1))
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+class Lambda(Block):
+    def __init__(self, function, **kwargs):
+        super().__init__(**kwargs)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function, **kwargs):
+        super().__init__(**kwargs)
+        self._func = function
+
+    def forward(self, *args):
+        if isinstance(self._func, str):
+            from ... import ndarray as F
+            return getattr(F, self._func)(*args)
+        return self._func(*args)
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation, **kwargs):
+        super().__init__(**kwargs)
+        self._act_type = activation
+
+    def forward(self, x):
+        return invoke("Activation", x, act_type=self._act_type)
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha=0.01, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return invoke("leaky_relu", x, slope=self._alpha)
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer=init_mod.Constant(0.25),
+                 in_channels=1, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = Parameter("alpha", shape=(in_channels,),
+                               init=alpha_initializer)
+
+    def forward(self, x):
+        return invoke("prelu", x, self.alpha.data())
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return invoke("elu", x, alpha=self._alpha)
+
+
+class SELU(HybridBlock):
+    def forward(self, x):
+        return invoke("selu", x)
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximation="erf", **kwargs):
+        super().__init__(**kwargs)
+        self._approx = approximation
+
+    def forward(self, x):
+        return invoke("gelu" if self._approx == "erf" else "gelu_tanh", x)
+
+
+class Swish(HybridBlock):
+    def __init__(self, beta=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._beta = beta
+
+    def forward(self, x):
+        if self._beta == 1.0:
+            return invoke("silu", x)
+        return x * invoke("sigmoid", self._beta * x)
+
+
+SiLU = Swish
+
+
+class BatchNorm(HybridBlock):
+    """Batch normalization with moving stats (reference basic_layers.py
+    BatchNorm; op semantics src/operator/nn/batch_norm.cc).
+
+    Moving mean/var are aux parameters (grad_req null); their update is
+    routed through ``register_state_update`` so hybridized graphs stay
+    pure (updates returned as extra outputs and applied post-step).
+    """
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape, init=init_mod.One(),
+                               allow_deferred_init=True,
+                               differentiable=scale)
+        self.beta = Parameter("beta", shape=shape, init=init_mod.Zero(),
+                              allow_deferred_init=True,
+                              differentiable=center)
+        self.running_mean = Parameter("running_mean", shape=shape,
+                                      grad_req="null",
+                                      init=init_mod.Zero(),
+                                      allow_deferred_init=True)
+        self.running_var = Parameter("running_var", shape=shape,
+                                     grad_req="null",
+                                     init=init_mod.One(),
+                                     allow_deferred_init=True)
+
+    def _ensure_init(self, x):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            if p._data is None:
+                p.shape = (c,)
+                p._finish_deferred_init()
+
+    def forward(self, x):
+        from ... import autograd
+        self._ensure_init(x)
+        training = autograd.is_training() and not self._use_global_stats
+        if training:
+            out, new_mean, new_var = invoke(
+                "BatchNorm", x, self.gamma.data(), self.beta.data(),
+                self.running_mean.data(), self.running_var.data(),
+                eps=self._epsilon, momentum=self._momentum,
+                fix_gamma=not self._scale, training=True)
+            register_state_update(self.running_mean, new_mean)
+            register_state_update(self.running_var, new_var)
+            return out
+        return invoke("BatchNorm", x, self.gamma.data(), self.beta.data(),
+                      self.running_mean.data(), self.running_var.data(),
+                      eps=self._epsilon, momentum=self._momentum,
+                      fix_gamma=not self._scale, training=False)
+
+
+class LayerNorm(HybridBlock):
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._epsilon = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape, init=init_mod.One(),
+                               allow_deferred_init=True, differentiable=scale)
+        self.beta = Parameter("beta", shape=shape, init=init_mod.Zero(),
+                              allow_deferred_init=True, differentiable=center)
+
+    def forward(self, x):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta):
+            if p._data is None:
+                p.shape = (c,)
+                p._finish_deferred_init()
+        return invoke("LayerNorm", x, self.gamma.data(), self.beta.data(),
+                      axis=self._axis, eps=self._epsilon)
+
+
+class GroupNorm(HybridBlock):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape, init=init_mod.One(),
+                               allow_deferred_init=True, differentiable=scale)
+        self.beta = Parameter("beta", shape=shape, init=init_mod.Zero(),
+                              allow_deferred_init=True, differentiable=center)
+
+    def forward(self, x):
+        c = x.shape[1]
+        for p in (self.gamma, self.beta):
+            if p._data is None:
+                p.shape = (c,)
+                p._finish_deferred_init()
+        return invoke("GroupNorm", x, self.gamma.data(), self.beta.data(),
+                      num_groups=self._num_groups, eps=self._epsilon)
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._epsilon = epsilon
+        shape = (in_channels,) if in_channels else (0,)
+        self.gamma = Parameter("gamma", shape=shape, init=init_mod.One(),
+                               allow_deferred_init=True, differentiable=scale)
+        self.beta = Parameter("beta", shape=shape, init=init_mod.Zero(),
+                              allow_deferred_init=True, differentiable=center)
+
+    def forward(self, x):
+        c = x.shape[1]
+        for p in (self.gamma, self.beta):
+            if p._data is None:
+                p.shape = (c,)
+                p._finish_deferred_init()
+        return invoke("InstanceNorm", x, self.gamma.data(), self.beta.data(),
+                      eps=self._epsilon)

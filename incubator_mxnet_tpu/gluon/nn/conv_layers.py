@@ -1,0 +1,210 @@
+"""Convolution and pooling layers (reference gluon/nn/conv_layers.py)."""
+from __future__ import annotations
+
+from ... import initializer as init_mod
+from ...ops.registry import invoke
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+
+
+def _tuple(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, in_channels, activation, use_bias,
+                 weight_initializer, bias_initializer, ndim,
+                 transpose=False, output_padding=0, **kwargs):
+        super().__init__(**kwargs)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = _tuple(kernel_size, ndim)
+        self._strides = _tuple(strides, ndim)
+        self._padding = _tuple(padding, ndim)
+        self._dilation = _tuple(dilation, ndim)
+        self._groups = groups
+        self._activation = activation
+        self._use_bias = use_bias
+        self._ndim = ndim
+        self._transpose = transpose
+        self._output_padding = _tuple(output_padding, ndim)
+        if transpose:
+            wshape = (in_channels, channels // groups) + self._kernel
+        else:
+            wshape = (channels, (in_channels // groups) if in_channels else 0) \
+                + self._kernel
+        self.weight = Parameter("weight", shape=wshape,
+                                init=weight_initializer or init_mod.Xavier(),
+                                allow_deferred_init=True)
+        if use_bias:
+            self.bias = Parameter("bias", shape=(channels,),
+                                  init=bias_initializer or init_mod.Zero(),
+                                  allow_deferred_init=True)
+        else:
+            self.bias = None
+
+    def _ensure_init(self, x):
+        c_in = x.shape[1]
+        if self.weight._data is None:
+            if self._transpose:
+                self.weight.shape = (c_in, self._channels // self._groups) \
+                    + self._kernel
+            else:
+                self.weight.shape = (self._channels, c_in // self._groups) \
+                    + self._kernel
+            self.weight._finish_deferred_init()
+        if self._use_bias and self.bias._data is None:
+            self.bias._finish_deferred_init()
+
+    def forward(self, x):
+        self._ensure_init(x)
+        args = [x, self.weight.data()]
+        if self._use_bias:
+            args.append(self.bias.data())
+        if self._transpose:
+            out = invoke("Deconvolution", *args, kernel=self._kernel,
+                         stride=self._strides, pad=self._padding,
+                         dilate=self._dilation, adj=self._output_padding,
+                         num_filter=self._channels, num_group=self._groups,
+                         no_bias=not self._use_bias)
+        else:
+            out = invoke("Convolution", *args, kernel=self._kernel,
+                         stride=self._strides, pad=self._padding,
+                         dilate=self._dilation, num_filter=self._channels,
+                         num_group=self._groups, no_bias=not self._use_bias)
+        if self._activation:
+            out = invoke("Activation", out, act_type=self._activation)
+        return out
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCW", in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, 1, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", in_channels=0,
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, 2, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, 3, **kwargs)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, 1,
+                         transpose=True, output_padding=output_padding,
+                         **kwargs)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, 2,
+                         transpose=True, output_padding=output_padding,
+                         **kwargs)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", in_channels=0,
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, 3,
+                         transpose=True, output_padding=output_padding,
+                         **kwargs)
+
+
+class _Pool(HybridBlock):
+    def __init__(self, pool_size, strides, padding, global_pool, pool_type,
+                 ndim, count_include_pad=True, **kwargs):
+        super().__init__(**kwargs)
+        self._kernel = _tuple(pool_size, ndim)
+        self._strides = _tuple(strides if strides is not None else pool_size, ndim)
+        self._padding = _tuple(padding, ndim)
+        self._global = global_pool
+        self._pool_type = pool_type
+        self._count_include_pad = count_include_pad
+
+    def forward(self, x):
+        return invoke("Pooling", x, kernel=self._kernel,
+                      pool_type=self._pool_type, global_pool=self._global,
+                      stride=self._strides, pad=self._padding,
+                      count_include_pad=self._count_include_pad)
+
+
+def _make_pool(name, pool_type, ndim, global_pool):
+    if global_pool:
+        class P(_Pool):
+            def __init__(self, layout=None, **kwargs):
+                super().__init__(1, 1, 0, True, pool_type, ndim, **kwargs)
+    else:
+        class P(_Pool):
+            def __init__(self, pool_size=2, strides=None, padding=0,
+                         layout=None, ceil_mode=False, count_include_pad=True,
+                         **kwargs):
+                super().__init__(pool_size, strides, padding, False, pool_type,
+                                 ndim, count_include_pad, **kwargs)
+    P.__name__ = P.__qualname__ = name
+    return P
+
+
+MaxPool1D = _make_pool("MaxPool1D", "max", 1, False)
+MaxPool2D = _make_pool("MaxPool2D", "max", 2, False)
+MaxPool3D = _make_pool("MaxPool3D", "max", 3, False)
+AvgPool1D = _make_pool("AvgPool1D", "avg", 1, False)
+AvgPool2D = _make_pool("AvgPool2D", "avg", 2, False)
+AvgPool3D = _make_pool("AvgPool3D", "avg", 3, False)
+GlobalMaxPool1D = _make_pool("GlobalMaxPool1D", "max", 1, True)
+GlobalMaxPool2D = _make_pool("GlobalMaxPool2D", "max", 2, True)
+GlobalMaxPool3D = _make_pool("GlobalMaxPool3D", "max", 3, True)
+GlobalAvgPool1D = _make_pool("GlobalAvgPool1D", "avg", 1, True)
+GlobalAvgPool2D = _make_pool("GlobalAvgPool2D", "avg", 2, True)
+GlobalAvgPool3D = _make_pool("GlobalAvgPool3D", "avg", 3, True)
+
+
+class ReflectionPad2D(HybridBlock):
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        p = padding if isinstance(padding, (list, tuple)) else (padding,) * 4
+        self._padding = ((0, 0), (0, 0), (p[0], p[1]),
+                         (p[2], p[3])) if len(p) == 4 else p
+
+    def forward(self, x):
+        return invoke("pad", x, pad_width=self._padding, mode="reflect")

@@ -1,0 +1,279 @@
+"""Recurrent cells (reference python/mxnet/gluon/rnn/rnn_cell.py).
+
+Cells are fine-grained Blocks for custom recurrences; ``unroll`` runs a
+Python loop eagerly or is captured by hybridize into a static graph.
+The fused layers in rnn_layer.py are the performance path.
+"""
+from __future__ import annotations
+
+from ... import initializer as init_mod
+from ... import ndarray as nd
+from ...ops.registry import invoke
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ResidualCell", "ZoneoutCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._modified = False
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=nd.zeros, ctx=None, **kwargs):
+        return [func(info["shape"], ctx=ctx, **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch, ctx=inputs.ctx)
+        states = begin_state
+        outputs = []
+        for t in range(length):
+            idx = [slice(None)] * inputs.ndim
+            idx[axis] = t
+            out, states = self(inputs[tuple(idx)], states)
+            outputs.append(out)
+        if merge_outputs or merge_outputs is None:
+            outputs = invoke("stack", *outputs, axis=axis)
+        return outputs, states
+
+    def forward(self, inputs, states):
+        raise NotImplementedError
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self.i2h_weight = Parameter("i2h_weight", shape=(hidden_size, input_size),
+                                    init=init_mod.Xavier(), allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight", shape=(hidden_size, hidden_size),
+                                    init=init_mod.Xavier())
+        self.i2h_bias = Parameter("i2h_bias", shape=(hidden_size,),
+                                  init=init_mod.Zero())
+        self.h2h_bias = Parameter("h2h_bias", shape=(hidden_size,),
+                                  init=init_mod.Zero())
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _ensure(self, x, factor=1):
+        if self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (self._hidden_size * factor, x.shape[-1])
+            self.i2h_weight._finish_deferred_init()
+
+    def forward(self, inputs, states):
+        self._ensure(inputs)
+        i2h = invoke("FullyConnected", inputs, self.i2h_weight.data(),
+                     self.i2h_bias.data(), num_hidden=self._hidden_size,
+                     flatten=False)
+        h2h = invoke("FullyConnected", states[0], self.h2h_weight.data(),
+                     self.h2h_bias.data(), num_hidden=self._hidden_size,
+                     flatten=False)
+        out = invoke("Activation", i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        H = hidden_size
+        self.i2h_weight = Parameter("i2h_weight", shape=(4 * H, input_size),
+                                    init=init_mod.Xavier(), allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight", shape=(4 * H, H),
+                                    init=init_mod.Xavier())
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * H,), init=init_mod.Zero())
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * H,), init=init_mod.Zero())
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        if self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (4 * self._hidden_size, inputs.shape[-1])
+            self.i2h_weight._finish_deferred_init()
+        H = self._hidden_size
+        gates = invoke("FullyConnected", inputs, self.i2h_weight.data(),
+                       self.i2h_bias.data(), num_hidden=4 * H, flatten=False) + \
+            invoke("FullyConnected", states[0], self.h2h_weight.data(),
+                   self.h2h_bias.data(), num_hidden=4 * H, flatten=False)
+        i, f, g, o = invoke("split", gates, num_outputs=4, axis=-1)
+        c = invoke("sigmoid", f) * states[1] + \
+            invoke("sigmoid", i) * invoke("tanh", g)
+        h = invoke("sigmoid", o) * invoke("tanh", c)
+        return h, [h, c]
+
+
+class GRUCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        H = hidden_size
+        self.i2h_weight = Parameter("i2h_weight", shape=(3 * H, input_size),
+                                    init=init_mod.Xavier(), allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight", shape=(3 * H, H),
+                                    init=init_mod.Xavier())
+        self.i2h_bias = Parameter("i2h_bias", shape=(3 * H,), init=init_mod.Zero())
+        self.h2h_bias = Parameter("h2h_bias", shape=(3 * H,), init=init_mod.Zero())
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        if self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (3 * self._hidden_size, inputs.shape[-1])
+            self.i2h_weight._finish_deferred_init()
+        H = self._hidden_size
+        i2h = invoke("FullyConnected", inputs, self.i2h_weight.data(),
+                     self.i2h_bias.data(), num_hidden=3 * H, flatten=False)
+        h2h = invoke("FullyConnected", states[0], self.h2h_weight.data(),
+                     self.h2h_bias.data(), num_hidden=3 * H, flatten=False)
+        i2h_r, i2h_z, i2h_n = invoke("split", i2h, num_outputs=3, axis=-1)
+        h2h_r, h2h_z, h2h_n = invoke("split", h2h, num_outputs=3, axis=-1)
+        r = invoke("sigmoid", i2h_r + h2h_r)
+        z = invoke("sigmoid", i2h_z + h2h_z)
+        n = invoke("tanh", i2h_n + r * h2h_n)
+        out = (1.0 - z) * n + z * states[0]
+        return out, [out]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return sum((c.state_info(batch_size)
+                    for c in self._children.values()), [])
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return sum((c.begin_state(batch_size, **kwargs)
+                    for c in self._children.values()), [])
+
+    def forward(self, inputs, states):
+        next_states = []
+        pos = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            inputs, st = cell(inputs, states[pos:pos + n])
+            pos += n
+            next_states.extend(st)
+        return inputs, next_states
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, inputs, states):
+        from ... import autograd, random as _random
+        from ...ndarray import NDArray as _ND
+        if self._rate and autograd.is_training():
+            key = _ND(_random.next_key(), ctx=inputs.ctx)
+            inputs = invoke("Dropout", inputs, key, p=self._rate,
+                            mode="training")
+        return inputs, states
+
+
+class ResidualCell(RecurrentCell):
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+    def forward(self, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
+
+
+class ZoneoutCell(RecurrentCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        self._prev_output = None
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+    def forward(self, inputs, states):
+        from ... import autograd
+        from ... import ndarray as nd_mod
+        out, new_states = self.base_cell(inputs, states)
+        if autograd.is_training():
+            def mask(rate, like):
+                return nd_mod.random.bernoulli(1 - rate, like.shape,
+                                               ctx=like.ctx)
+            if self._zo:
+                prev = self._prev_output if self._prev_output is not None \
+                    else nd_mod.zeros_like(out)
+                m = mask(self._zo, out)
+                out = m * out + (1 - m) * prev
+            if self._zs:
+                new_states = [mask(self._zs, ns) * ns + (1 - mask(self._zs, ns)) * s
+                              for ns, s in zip(new_states, states)]
+        self._prev_output = out
+        return out, new_states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + \
+            self.r_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.l_cell.begin_state(batch_size, **kwargs) + \
+            self.r_cell.begin_state(batch_size, **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch, ctx=inputs.ctx)
+        nl = len(self.l_cell.state_info())
+        l_out, l_states = self.l_cell.unroll(
+            length, inputs, begin_state[:nl], layout, True)
+        rev = invoke("flip", inputs, axis=axis)
+        r_out, r_states = self.r_cell.unroll(
+            length, rev, begin_state[nl:], layout, True)
+        r_out = invoke("flip", r_out, axis=axis)
+        out = invoke("concat", l_out, r_out, dim=-1)
+        return out, l_states + r_states
+
+    def forward(self, inputs, states):
+        raise NotImplementedError("BidirectionalCell supports unroll() only")

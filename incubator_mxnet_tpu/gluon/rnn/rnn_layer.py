@@ -1,0 +1,120 @@
+"""Fused RNN layers over the lax.scan RNN op.
+
+Reference: python/mxnet/gluon/rnn/rnn_layer.py (_RNNLayer) backed by the
+cuDNN fused ``RNN`` op (src/operator/rnn-inl.h); here the op is a
+lax.scan whose per-step body fuses into MXU matmuls (BASELINE config 5).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import initializer as init_mod
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from ...ops.registry import invoke
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+_NGATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, mode, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._mode = mode
+        self.params_flat = Parameter(
+            "rnn_param", shape=(self._param_size(input_size),) if input_size
+            else (0,), init=i2h_weight_initializer or init_mod.Xavier(),
+            allow_deferred_init=True)
+
+    def _param_size(self, input_size):
+        if not input_size:
+            return 0
+        ng = _NGATES[self._mode]
+        H, D = self._hidden_size, self._dir
+        size = 0
+        for layer in range(self._num_layers):
+            in_dim = input_size if layer == 0 else H * D
+            size += D * ng * H * (in_dim + H)  # weights
+        for layer in range(self._num_layers):
+            size += D * 2 * ng * H  # biases
+        return size
+
+    def state_info(self, batch_size=0):
+        num = self._num_layers * self._dir
+        shapes = [{"shape": (num, batch_size, self._hidden_size),
+                   "__layout__": "LNC"}]
+        if self._mode == "lstm":
+            shapes.append({"shape": (num, batch_size, self._hidden_size),
+                           "__layout__": "LNC"})
+        return shapes
+
+    def begin_state(self, batch_size=0, func=nd.zeros, ctx=None, **kwargs):
+        return [func(info["shape"], ctx=ctx, **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def forward(self, inputs, states=None):
+        if self._layout == "NTC":
+            inputs = inputs.transpose((1, 0, 2))
+        T, B, I = inputs.shape
+        if self.params_flat._data is None:
+            self.params_flat.shape = (self._param_size(I),)
+            self.params_flat._finish_deferred_init()
+        return_states = states is not None
+        if states is None:
+            states = self.begin_state(B, ctx=inputs.ctx,
+                                      dtype=str(inputs.dtype))
+        if isinstance(states, NDArray):
+            states = [states]
+        args = [inputs, self.params_flat.data(), states[0]]
+        if self._mode == "lstm":
+            args.append(states[1])
+        outs = invoke("RNN", *args, state_size=self._hidden_size,
+                      num_layers=self._num_layers, mode=self._mode,
+                      bidirectional=self._dir == 2, p=self._dropout)
+        if self._mode == "lstm":
+            out, hN, cN = outs
+            new_states = [hN, cN]
+        else:
+            out, hN = outs
+            new_states = [hN]
+        if self._layout == "NTC":
+            out = out.transpose((1, 0, 2))
+        if return_states:
+            return out, new_states
+        return out
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, mode, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "lstm", **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "gru", **kwargs)

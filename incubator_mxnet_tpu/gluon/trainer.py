@@ -1,0 +1,114 @@
+"""Trainer: applies an optimizer to a set of Parameters with KVStore sync.
+
+Reference: python/mxnet/gluon/trainer.py:29 — `_init_kvstore` :183,
+`step` :329, `_allreduce_grads` :380-404.  On TPU the gradient sync is an
+XLA collective (psum over the device mesh) handled by the kvstore layer;
+single-device training is a straight optimizer application.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt_mod
+from ..ndarray import NDArray
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict,)) or hasattr(params, "values"):
+            self._param_names = list(params.keys()) if hasattr(params, "keys") else None
+            params = list(params.values())
+        else:
+            params = list(params)
+            self._param_names = [p.name for p in params]
+        self._params = params
+        self._scale = 1.0
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._optimizer = optimizer
+            if optimizer_params:
+                raise ValueError(
+                    "optimizer_params must be None when optimizer is an instance")
+        else:
+            optimizer_params = optimizer_params or {}
+            self._optimizer = opt_mod.create(optimizer, **optimizer_params)
+        self._optimizer.param_dict = {
+            i: p for i, p in enumerate(self._params)}
+        self._updaters = [opt_mod.get_updater(self._optimizer)]
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._compression_params = compression_params
+        self._update_on_kvstore = update_on_kvstore
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _init_kvstore(self):
+        """Create the kvstore lazily (reference trainer.py:183)."""
+        from .. import kvstore as kv_mod
+        if self._kvstore_type is None:
+            self._kvstore = None
+        elif isinstance(self._kvstore_type, str):
+            self._kvstore = kv_mod.create(self._kvstore_type)
+            if self._compression_params:
+                self._kvstore.set_gradient_compression(self._compression_params)
+        else:
+            self._kvstore = self._kvstore_type
+        self._kv_initialized = True
+        if self._kvstore is not None:
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.init(i, p.data())
+
+    def allreduce_grads(self):
+        """Sum gradients across devices/workers (reference trainer.py:380)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is None or self._kvstore.num_workers <= 1:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null":
+                grad = p.grad()
+                self._kvstore.pushpull(i, grad, out=grad,
+                                       priority=-i)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce + rescale + optimizer update (reference trainer.py:329)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        updater = self._updaters[0]
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._data is None:
+                continue
+            updater(i, p.grad(), p.data())
+
+    def zero_grad(self):
+        for p in self._params:
+            if p.grad_req != "null" and p._data is not None:
+                p.zero_grad()
+
+    def save_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updaters[0].set_states(f.read())

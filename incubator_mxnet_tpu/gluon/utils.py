@@ -1,0 +1,73 @@
+"""Gluon utilities (reference python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+from .. import ndarray as nd
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download", "shape_is_known"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Slice a batch along batch_axis into num_slice chunks
+    (reference utils.py split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"batch size {size} not divisible by {num_slice} slices")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(begin, end)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    if not isinstance(data, NDArray):
+        data = nd.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so their joint L2 norm ≤ max_norm
+    (reference utils.py clip_global_norm)."""
+    total = jnp.zeros(())
+    for a in arrays:
+        total = total + jnp.sum(jnp.square(a.data.astype(jnp.float32)))
+    norm = jnp.sqrt(total)
+    scale = jnp.minimum(max_norm / (norm + 1e-12), 1.0)
+    for a in arrays:
+        a._set_data(a.data * scale.astype(a.data.dtype))
+    return float(norm) if check_isfinite else NDArray(norm)
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    raise RuntimeError("network egress is unavailable; provide local files")
+
+
+def shape_is_known(shape):
+    if shape is None:
+        return False
+    return all(s > 0 for s in shape)

@@ -1,0 +1,268 @@
+"""Image IO and augmentation (reference python/mxnet/image/).
+
+The reference decodes via OpenCV inside the C++ iterator
+(src/io/image_aug_default.cc).  Here decode uses cv2 if present, else
+Pillow, else raw numpy codecs — and augmenters are pure-numpy host-side
+transforms (TPU does not help with JPEG decode; keeping host decode off
+the device path mirrors the reference's design).
+"""
+from __future__ import annotations
+
+import io as _io
+import random as _pyrandom
+
+import numpy as onp
+
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["imread", "imdecode", "imencode", "imresize", "resize_short",
+           "center_crop", "random_crop", "fixed_crop", "color_normalize",
+           "CreateAugmenter", "Augmenter", "ImageIter"]
+
+
+def _decode_bytes(buf: bytes, flag=1):
+    try:
+        import cv2
+        arr = onp.frombuffer(buf, dtype=onp.uint8)
+        img = cv2.imdecode(arr, 1 if flag else 0)
+        if img is None:
+            raise ValueError("cv2 failed to decode image")
+        if flag:
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        return img
+    except ImportError:
+        pass
+    try:
+        from PIL import Image
+        img = Image.open(_io.BytesIO(buf))
+        img = img.convert("RGB" if flag else "L")
+        arr = onp.asarray(img)
+        if not flag:
+            arr = arr[..., None]
+        return arr
+    except ImportError as e:
+        raise RuntimeError("no image decoder available (cv2/PIL)") from e
+
+
+def imdecode_np(buf, flag=1):
+    return _decode_bytes(bytes(buf), flag)
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    return nd.array(_decode_bytes(bytes(buf), flag))
+
+
+def imencode(img, fmt=".jpg", quality=95) -> bytes:
+    if isinstance(img, NDArray):
+        img = img.asnumpy()
+    img = onp.asarray(img, dtype=onp.uint8)
+    try:
+        import cv2
+        ok, buf = cv2.imencode(fmt, cv2.cvtColor(img, cv2.COLOR_RGB2BGR),
+                               [cv2.IMWRITE_JPEG_QUALITY, quality])
+        if not ok:
+            raise ValueError("cv2 encode failed")
+        return bytes(buf)
+    except ImportError:
+        pass
+    from PIL import Image
+    bio = _io.BytesIO()
+    Image.fromarray(img.squeeze() if img.shape[-1] == 1 else img).save(
+        bio, format="JPEG" if fmt in (".jpg", ".jpeg") else "PNG",
+        quality=quality)
+    return bio.getvalue()
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    import jax.image
+    data = src.data if isinstance(src, NDArray) else onp.asarray(src)
+    out = jax.image.resize(data.astype("float32"), (h, w, data.shape[2]),
+                           method="bilinear")
+    return NDArray(out.astype(str(src.dtype) if isinstance(src, NDArray)
+                              else data.dtype.name))
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(size * h / w)
+    else:
+        new_w, new_h = int(size * w / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != tuple(size):
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = max((w - new_w) // 2, 0)
+    y0 = max((h - new_h) // 2, 0)
+    return fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src.astype("float32") - nd.array(onp.asarray(mean, "float32"))
+    if std is not None:
+        src = src / nd.array(onp.asarray(std, "float32"))
+    return src
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return resize_short(src, self.size)
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return center_crop(src, self.size)[0]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return random_crop(src, self.size)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return NDArray(src.data[:, ::-1], ctx=src.ctx)
+        return src
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Standard augmenter chain (reference image.py CreateAugmenter)."""
+    auglist: list[Augmenter] = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(
+            mean if mean is not None else [0, 0, 0],
+            std if std is not None else [1, 1, 1]))
+    return auglist
+
+
+class ImageIter:
+    """Image iterator over RecordIO or file list (reference image.py ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, aug_list=None, **kwargs):
+        from . import recordio as rio
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter((3,) + self.data_shape[1:])
+        self._records = []
+        if path_imgrec:
+            idx_path = path_imgrec[:path_imgrec.rfind(".")] + ".idx"
+            rec = rio.MXIndexedRecordIO(idx_path, path_imgrec, "r") \
+                if __import__("os").path.exists(idx_path) \
+                else rio.MXRecordIO(path_imgrec, "r")
+            if hasattr(rec, "keys") and rec.keys:
+                for k in rec.keys:
+                    self._records.append(rec.read_idx(k))
+            else:
+                while True:
+                    buf = rec.read()
+                    if buf is None:
+                        break
+                    self._records.append(buf)
+        self._order = list(range(len(self._records)))
+        self._shuffle = shuffle
+        self._cursor = 0
+        self.reset()
+
+    def reset(self):
+        self._cursor = 0
+        if self._shuffle:
+            _pyrandom.shuffle(self._order)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from . import recordio as rio
+        from .io import DataBatch
+        if self._cursor + self.batch_size > len(self._records):
+            raise StopIteration
+        imgs, labels = [], []
+        for i in range(self.batch_size):
+            buf = self._records[self._order[self._cursor + i]]
+            header, img_buf = rio.unpack(buf)
+            img = imdecode(img_buf)
+            for aug in self.auglist:
+                img = aug(img)
+            imgs.append(img.transpose((2, 0, 1)).astype("float32"))
+            labels.append(header.label)
+        self._cursor += self.batch_size
+        data = nd.stack(*imgs, axis=0)
+        label = nd.array(onp.asarray(labels, "float32"))
+        return DataBatch(data=[data], label=[label])
+
+    next = __next__

@@ -1,0 +1,5 @@
+"""``mx.init`` alias namespace (reference exposes initializers there too)."""
+from .initializer import (  # noqa: F401
+    Initializer, Zero, One, Constant, Uniform, Normal, Orthogonal, Xavier,
+    MSRAPrelu, Bilinear, LSTMBias, Mixed, register, create,
+)

@@ -1,0 +1,211 @@
+"""Weight initializers (reference python/mxnet/initializer.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .base import registry
+from . import random as _random
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
+           "register", "create"]
+
+_reg = registry("initializer")
+register = _reg.register
+create = _reg.create
+
+
+class Initializer:
+    """Base initializer; callable on (name, NDArray) or just NDArray."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr=None):
+        if arr is None:
+            name, arr = "", name
+        name = getattr(name, "name", name) or ""
+        if name.endswith("gamma"):
+            self._init_one(arr)
+        elif name.endswith(("beta", "bias", "moving_mean", "running_mean")):
+            self._init_zero(arr)
+        elif name.endswith(("moving_var", "running_var")):
+            self._init_one(arr)
+        else:
+            self._init_weight(name, arr)
+
+    def init_array(self, arr):
+        self._init_weight("", arr)
+
+    def _init_zero(self, arr):
+        arr._set_data(jnp.zeros(arr.shape, arr.data.dtype))
+
+    def _init_one(self, arr):
+        arr._set_data(jnp.ones(arr.shape, arr.data.dtype))
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_zero(arr)
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_one(arr)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr._set_data(jnp.full(arr.shape, self.value, arr.data.dtype))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        key = _random.next_key()
+        arr._set_data(jax.random.uniform(
+            key, arr.shape, jnp.float32, -self.scale, self.scale
+        ).astype(arr.data.dtype))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        key = _random.next_key()
+        arr._set_data((self.sigma * jax.random.normal(
+            key, arr.shape, jnp.float32)).astype(arr.data.dtype))
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        key = _random.next_key()
+        nout = arr.shape[0]
+        nin = int(jnp.prod(jnp.asarray(arr.shape[1:])))
+        a = jax.random.normal(key, (nout, nin), jnp.float32)
+        q, r = jnp.linalg.qr(a if nout >= nin else a.T)
+        q = q if nout >= nin else q.T
+        q = q * jnp.sign(jnp.diagonal(r))[..., None] if nout >= nin else q
+        arr._set_data((self.scale * q[:nout, :nin]).reshape(arr.shape).astype(arr.data.dtype))
+
+
+@register
+class Xavier(Initializer):
+    """Glorot init (reference initializer.py Xavier)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = magnitude
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            fan_in, fan_out = shape[0], shape[0]
+        else:
+            for s in shape[2:]:
+                hw_scale *= s
+            fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in,
+                  "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / max(factor, 1e-12))
+        key = _random.next_key()
+        if self.rnd_type == "uniform":
+            val = jax.random.uniform(key, shape, jnp.float32, -scale, scale)
+        else:
+            val = scale * jax.random.normal(key, shape, jnp.float32)
+        arr._set_data(val.astype(arr.data.dtype))
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        import numpy as onp
+        shape = arr.shape
+        weight = onp.zeros(shape, dtype="float32")
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._set_data(jnp.asarray(weight).astype(arr.data.dtype))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 (reference initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        import numpy as onp
+        b = onp.zeros(arr.shape, "float32")
+        n = arr.shape[0] // 4
+        b[n:2 * n] = self.forget_bias
+        arr._set_data(jnp.asarray(b).astype(arr.data.dtype))
+
+
+_reg.alias("zeros")(Zero)
+_reg.alias("ones")(One)
+_reg.alias("gaussian")(Normal)
+
+
+class Mixed:
+    """Patterned initializer dispatch (reference initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        import re
+        self.map = [(re.compile(p), init) for p, init in zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise ValueError(f"parameter {name} did not match any pattern")

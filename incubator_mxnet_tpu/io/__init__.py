@@ -1,0 +1,331 @@
+"""Data iterators (reference python/mxnet/io/ + src/io/).
+
+``DataIter``/``NDArrayIter`` keep the reference's batch-iterator protocol
+(DataBatch with data/label/pad) so Module-style training loops run
+unchanged; prefetch happens on a background thread feeding device puts
+(the PrefetcherIter analog, src/io/iter_prefetcher.h).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+
+
+class DataDesc:
+    def __init__(self, name, shape, dtype="float32", layout="NCHW"):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.layout = layout
+
+    def __repr__(self):
+        return f"DataDesc[{self.name},{self.shape},{self.dtype},{self.layout}]"
+
+
+class DataBatch:
+    def __init__(self, data=None, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Base iterator (reference io/io.py:179)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    @property
+    def provide_data(self):
+        return []
+
+    @property
+    def provide_label(self):
+        return []
+
+
+class NDArrayIter(DataIter):
+    """Iterate dense arrays in batches (reference io/io.py:490)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = self._init_data(data, data_name)
+        self.label = self._init_data(label, label_name) if label is not None \
+            else []
+        self.num_data = self.data[0][1].shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._order = onp.arange(self.num_data)
+        self.reset()
+
+    @staticmethod
+    def _init_data(data, default_name):
+        if data is None:
+            return []
+        if isinstance(data, (onp.ndarray, NDArray)):
+            data = [(default_name, data)]
+        elif isinstance(data, dict):
+            data = list(data.items())
+        elif isinstance(data, (list, tuple)):
+            data = [(f"{default_name}_{i}" if i else default_name, d)
+                    for i, d in enumerate(data)]
+        out = []
+        for name, d in data:
+            if isinstance(d, NDArray):
+                d = d.asnumpy()
+            d = onp.asarray(d)
+            if d.dtype == onp.float64:
+                d = d.astype(onp.float32)
+            out.append((name, d))
+        return out
+
+    @property
+    def provide_data(self):
+        return [DataDesc(name, (self.batch_size,) + d.shape[1:],
+                         d.dtype.name) for name, d in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(name, (self.batch_size,) + d.shape[1:],
+                         d.dtype.name) for name, d in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            onp.random.shuffle(self._order)
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        lo = self.cursor
+        hi = min(lo + self.batch_size, self.num_data)
+        pad = self.batch_size - (hi - lo)
+        idx = self._order[lo:hi]
+        if pad:
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            idx = onp.concatenate([idx, self._order[:pad]])
+
+        def take(arrays):
+            return [nd.array(d[idx]) for _, d in arrays]
+
+        return DataBatch(data=take(self.data), label=take(self.label),
+                         pad=pad, index=idx,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+
+class ResizeIter(DataIter):
+    """Truncate/extend an iterator to a fixed number of batches
+    (reference io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def next(self):
+        if self.cur == self.size:
+            raise StopIteration
+        try:
+            batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            batch = self.data_iter.next()
+        self.cur += 1
+        return batch
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (reference io.py PrefetchingIter /
+    C++ iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        import threading
+        import queue
+        if not isinstance(iters, list):
+            iters = [iters]
+        assert len(iters) == 1, "single backing iter supported"
+        self.iter = iters[0]
+        super().__init__(self.iter.batch_size)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=4)
+        self._stop = False
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        import threading
+
+        def worker():
+            while not self._stop:
+                try:
+                    batch = self.iter.next()
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                self._queue.put(batch)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop = True
+        if self._thread is not None:
+            while not self._queue.empty():
+                self._queue.get_nowait()
+            self._thread.join(timeout=5)
+        self.iter.reset()
+        self._stop = False
+        self._start()
+
+    def next(self):
+        batch = self._queue.get()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+    @property
+    def provide_data(self):
+        return self.iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.iter.provide_label
+
+
+class CSVIter(DataIter):
+    """CSV file iterator (reference src/io/iter_csv.cc)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = onp.loadtxt(data_csv, delimiter=",", dtype=onp.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = onp.loadtxt(label_csv, delimiter=",", dtype=onp.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        else:
+            label = onp.zeros((data.shape[0], 1), onp.float32)
+        self._inner = NDArrayIter(data, label, batch_size,
+                                  last_batch_handle="pad" if round_batch
+                                  else "discard")
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+
+class MNISTIter(DataIter):
+    """MNIST iterator (reference src/io/iter_mnist.cc); reads idx files or
+    falls back to the synthetic dataset."""
+
+    def __init__(self, image="train-images-idx3-ubyte", label=None,
+                 batch_size=128, shuffle=True, flat=False, input_shape=None,
+                 **kwargs):
+        super().__init__(batch_size)
+        from ..gluon.data.vision import MNIST
+        train = "train" in image
+        ds = MNIST(train=train)
+        data = ds._data.asnumpy().astype("float32") / 255.0
+        data = data.transpose(0, 3, 1, 2)
+        if flat:
+            data = data.reshape(data.shape[0], -1)
+        self._inner = NDArrayIter(data, ds._label.astype("float32"),
+                                  batch_size, shuffle=shuffle)
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+
+def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224),
+                    batch_size=128, shuffle=False, **kwargs):
+    """RecordIO image iterator (reference src/io/iter_image_recordio_2.cc).
+
+    Returns a prefetching iterator over decoded+augmented image batches.
+    """
+    from ..image import ImageIter
+    inner = ImageIter(batch_size, data_shape, path_imgrec=path_imgrec,
+                      shuffle=shuffle, **kwargs)
+
+    class _Adapter(DataIter):
+        def __init__(self):
+            super().__init__(batch_size)
+
+        def reset(self):
+            inner.reset()
+
+        def next(self):
+            return next(inner)
+
+    return PrefetchingIter(_Adapter())

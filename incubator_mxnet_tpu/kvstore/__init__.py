@@ -1,0 +1,12 @@
+"""KVStore: data-parallel communication (reference src/kvstore/ + python/mxnet/kvstore/).
+
+TPU re-design (SURVEY.md §5.8): ``local``/``device`` reduce over
+process-local device copies; ``dist_sync``/``dist_async`` ride XLA
+collectives over ICI/DCN through ``jax.distributed``-style process
+groups.  The ``KVStoreBase`` plugin registry (reference
+python/mxnet/kvstore/base.py:74-220) is preserved as the extension
+point (Horovod/BytePS adapters plugged in there).
+"""
+from .base import KVStoreBase, register, create
+from .kvstore import KVStore, LocalKVStore, DeviceKVStore, DistKVStore
+from .gradient_compression import GradientCompression

@@ -1,0 +1,86 @@
+"""KVStoreBase plugin registry (reference python/mxnet/kvstore/base.py)."""
+from __future__ import annotations
+
+__all__ = ["KVStoreBase", "register", "create"]
+
+_KVSTORES: dict[str, type] = {}
+
+
+def register(klass):
+    """Register a kvstore implementation under its OPT_TYPES names."""
+    names = getattr(klass, "OPT_TYPES", [klass.__name__.lower()])
+    for n in names:
+        _KVSTORES[n.lower()] = klass
+    return klass
+
+
+def create(name="local", **kwargs):
+    """Create a kvstore by type string (reference kvstore.py:54 create).
+
+    Types mirror the reference factory (src/kvstore/kvstore.cc:41-84):
+    local | device | nccl(→device) | dist_sync | dist_device_sync |
+    dist_async | p3 — plus any plugin registered via ``register``.
+    """
+    name = name.lower()
+    if name not in _KVSTORES:
+        raise ValueError(
+            f"unknown kvstore type {name!r}; known: {sorted(_KVSTORES)}")
+    return _KVSTORES[name](**kwargs)
+
+
+class KVStoreBase:
+    """Capability-queryable interface (reference base.py:74)."""
+
+    OPT_TYPES: list[str] = []
+
+    # capability flags (reference base.py is_capable)
+    OPTIMIZER = "optimizer"
+    PUSH_PULL = "push_pull"
+
+    @staticmethod
+    def is_capable(capability):
+        return False
+
+    @property
+    def type(self):
+        return type(self).OPT_TYPES[0]
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def init(self, key, value):
+        raise NotImplementedError
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out=out, priority=priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out=out, priority=priority)
+
+    def set_optimizer(self, optimizer):
+        raise NotImplementedError
+
+    def set_gradient_compression(self, compression_params):
+        raise NotImplementedError
+
+    def barrier(self):
+        pass
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise NotImplementedError
+
+    def load_optimizer_states(self, fname):
+        raise NotImplementedError

@@ -1,0 +1,51 @@
+"""2-bit gradient compression with error feedback.
+
+Reference: src/kvstore/gradient_compression.h:38-131 (.cc/.cu kernels).
+TPU re-design: the quantize/dequantize round-trip is a fused XLA kernel;
+residual (error-feedback) state is kept per-key on device.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GradientCompression"]
+
+
+class GradientCompression:
+    def __init__(self, type="2bit", threshold=0.5):
+        if type not in ("2bit", "1bit", "none"):
+            raise ValueError(f"unsupported compression type {type}")
+        self.type = type
+        self.threshold = float(threshold)
+        self._residual: dict = {}
+
+        @jax.jit
+        def _round_trip_2bit(grad, residual, threshold):
+            acc = grad + residual
+            q = jnp.where(acc >= threshold, threshold,
+                          jnp.where(acc <= -threshold, -threshold, 0.0))
+            return q, acc - q
+
+        @jax.jit
+        def _round_trip_1bit(grad, residual, threshold):
+            acc = grad + residual
+            q = jnp.where(acc >= 0, threshold, -threshold)
+            return q, acc - q
+
+        self._rt2 = _round_trip_2bit
+        self._rt1 = _round_trip_1bit
+
+    def compress_decompress(self, grad, key=None):
+        """Quantize-then-dequantize with error feedback (what the wire
+        round trip computes end-to-end)."""
+        if self.type == "none":
+            return grad
+        k = key if key is not None else (grad.shape, str(grad.dtype))
+        residual = self._residual.get(k)
+        if residual is None:
+            residual = jnp.zeros_like(grad)
+        fn = self._rt2 if self.type == "2bit" else self._rt1
+        q, new_residual = fn(grad, residual, self.threshold)
+        self._residual[k] = new_residual
+        return q

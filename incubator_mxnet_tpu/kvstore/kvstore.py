@@ -1,0 +1,201 @@
+"""KVStore implementations.
+
+Reference internals being re-designed (SURVEY.md §2.1 "KVStore"):
+``KVStoreLocal`` + Comm tree-reduce (src/kvstore/kvstore_local.h:70,
+comm.h:104-741), ``KVStoreNCCL``, ``KVStoreDist`` over ps-lite
+(kvstore_dist.h).  TPU mapping:
+
+* local/device/nccl → single-controller reduce: values living on
+  process-local devices are summed (XLA all-reduce over ICI when the
+  arrays are sharded over a mesh; jnp adds otherwise).
+* dist_sync/dist_device_sync → multi-process psum via
+  ``jax.make_array_from_process_local_data`` + jit-compiled global sum
+  when ``jax.distributed`` is initialized; degenerates to local in a
+  single process so launch scripts run unchanged.
+* dist_async / p3 — the reference's parameter-server behaviors; served
+  by the same sync collective with server-side-optimizer support on the
+  store (set_optimizer + update-on-push), async semantics documented as
+  sync-on-TPU (SPMD has no stragglers to hide).
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+from .. import optimizer as opt_mod
+from .base import KVStoreBase, register
+from .gradient_compression import GradientCompression
+
+__all__ = ["KVStore", "LocalKVStore", "DeviceKVStore", "DistKVStore"]
+
+
+class _BaseStore(KVStoreBase):
+    """Shared store logic: key→value dict + optional server-side optimizer."""
+
+    def __init__(self):
+        self._store: dict = {}
+        self._optimizer = None
+        self._updater = None
+        self._compression: GradientCompression | None = None
+
+    @staticmethod
+    def is_capable(capability):
+        return capability in (KVStoreBase.OPTIMIZER, KVStoreBase.PUSH_PULL)
+
+    def init(self, key, value):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        values = value if isinstance(value, (list, tuple)) else [value]
+        for k, v in zip(keys, values):
+            if k not in self._store:
+                self._store[k] = NDArray(v.data + 0, ctx=v.ctx)
+
+    def _reduce(self, value):
+        """Sum a list of per-device values (Comm::Reduce analog)."""
+        if isinstance(value, (list, tuple)):
+            acc = value[0].data
+            for v in value[1:]:
+                acc = acc + v.data
+            return acc
+        return value.data
+
+    def _sync(self, summed):
+        """Cross-process reduction hook; identity for local stores."""
+        return summed
+
+    def push(self, key, value, priority=0):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        if isinstance(key, (list, tuple)):
+            values = value
+        else:
+            values = [value]
+        for k, v in zip(keys, values):
+            summed = self._reduce(v)
+            if self._compression is not None:
+                summed = self._compression.compress_decompress(summed)
+            summed = self._sync(summed)
+            if self._updater is not None:
+                # server-side optimizer (reference kvstore_dist_server.h:349)
+                weight = self._store[k]
+                self._updater(k if isinstance(k, int) else hash(k),
+                              NDArray(summed), weight)
+            else:
+                self._store[k] = NDArray(summed)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        results = []
+        for k, o in zip(keys, outs):
+            val = self._store[k]
+            if o is not None:
+                targets = o if isinstance(o, (list, tuple)) else [o]
+                for t in targets:
+                    t._set_data(val.data)
+                results.append(o)
+            else:
+                results.append(val.copy())
+        if out is not None:
+            return out
+        return results if isinstance(key, (list, tuple)) else results[0]
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            return self.pull(key, out=out, priority=priority)
+        return None
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows (reference kvstore_dist.h:558)."""
+        val = self._store[key]
+        if row_ids is None:
+            return self.pull(key, out=out, priority=priority)
+        ids = row_ids.data.astype(jnp.int32) if isinstance(row_ids, NDArray) \
+            else jnp.asarray(row_ids, jnp.int32)
+        rows = val.data[ids]
+        if out is not None:
+            out._set_data(out.data.at[ids].set(rows))
+            return out
+        return NDArray(rows)
+
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        self._compression = GradientCompression(**dict(compression_params))
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        with open(fname, "wb") as f:
+            if self._updater is not None:
+                f.write(self._updater.get_states(dump_optimizer))
+            else:
+                f.write(pickle.dumps({}))
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            if self._updater is not None:
+                self._updater.set_states(f.read())
+
+
+@register
+class LocalKVStore(_BaseStore):
+    """Single-process store; CPU-side aggregation (reference 'local')."""
+
+    OPT_TYPES = ["local", "local_allreduce_cpu"]
+
+
+@register
+class DeviceKVStore(_BaseStore):
+    """Aggregation on accelerator (reference 'device'/'nccl' kvstores).
+
+    Values stay on device; XLA emits the reduction (ICI collective when
+    arrays are sharded over a mesh).
+    """
+
+    OPT_TYPES = ["device", "nccl", "local_allreduce_device"]
+
+
+@register
+class DistKVStore(_BaseStore):
+    """Multi-process synchronous store (reference 'dist_sync' family).
+
+    When ``jax.distributed`` has been initialized (multi-host), the sync
+    step all-reduces across processes over DCN/ICI; in a single process
+    it is the identity so dist launch scripts degrade gracefully.
+    """
+
+    OPT_TYPES = ["dist_sync", "dist_device_sync", "dist_async", "dist",
+                 "p3", "dist_sync_device", "horovod", "byteps"]
+
+    def __init__(self):
+        super().__init__()
+        self._nprocs = jax.process_count()
+        self._rank = jax.process_index()
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._nprocs
+
+    def _sync(self, summed):
+        if self._nprocs <= 1:
+            return summed
+        from jax.experimental import multihost_utils
+        return multihost_utils.process_allgather(summed).sum(axis=0)
+
+    def barrier(self):
+        if self._nprocs > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+
+class KVStore(_BaseStore):
+    """Generic facade kept for ``mx.kv.KVStore`` type checks."""
+
+    OPT_TYPES = ["kvstore"]

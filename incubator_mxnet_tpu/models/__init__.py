@@ -1,0 +1,12 @@
+"""Model families.
+
+``transformer`` — the flagship TPU-native decoder LM with full 5-axis
+(dp/pp/tp/sp/ep) sharding support; drives ``__graft_entry__.dryrun_multichip``.
+``lstm_lm`` — LSTM language model (BASELINE config 5, reference example/rnn).
+``bert`` — BERT-style encoder (BASELINE config 3, gluon-nlp lineage).
+Vision models live in ``gluon.model_zoo.vision`` (reference layout).
+"""
+from . import transformer
+from .transformer import TransformerLM, TransformerConfig
+from .lstm_lm import LSTMLanguageModel
+from .bert import BERTEncoder, BERTModel

@@ -1,0 +1,40 @@
+"""LSTM language model (BASELINE config 5; reference example/rnn/word_lm).
+
+Gluon block over the fused lax.scan RNN op — the path that replaces the
+reference's cuDNN RNN kernels.
+"""
+from __future__ import annotations
+
+from ..gluon import nn, rnn
+from ..gluon.block import HybridBlock
+
+
+class LSTMLanguageModel(HybridBlock):
+    def __init__(self, vocab_size, embed_size=200, hidden_size=200,
+                 num_layers=2, dropout=0.5, tie_weights=False, **kwargs):
+        super().__init__(**kwargs)
+        self.drop = nn.Dropout(dropout)
+        self.encoder = nn.Embedding(vocab_size, embed_size)
+        self.rnn = rnn.LSTM(hidden_size, num_layers, dropout=dropout,
+                            input_size=embed_size)
+        self.decoder = nn.Dense(vocab_size, in_units=hidden_size)
+        self._hidden_size = hidden_size
+
+    def begin_state(self, batch_size, ctx=None, **kwargs):
+        return self.rnn.begin_state(batch_size, ctx=ctx, **kwargs)
+
+    def forward(self, inputs, state=None):
+        """inputs (T, B) int → logits (T, B, V)."""
+        emb = self.drop(self.encoder(inputs))
+        if state is None:
+            output = self.rnn(emb)
+            out_state = None
+        else:
+            output, out_state = self.rnn(emb, state)
+        output = self.drop(output)
+        decoded = self.decoder(
+            output.reshape((-1, self._hidden_size))).reshape(
+            (output.shape[0], output.shape[1], -1))
+        if out_state is None:
+            return decoded
+        return decoded, out_state

@@ -1,0 +1,61 @@
+"""Monitor: tap intermediate outputs during training (reference monitor.py).
+
+The reference installs an engine-level callback on every executor op
+(MXExecutorSetMonitorCallback).  Here blocks expose a forward hook
+mechanism; Monitor installs stat functions over named outputs.
+"""
+from __future__ import annotations
+
+import re
+
+from .ndarray import NDArray
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        self.interval = interval
+        self.stat_func = stat_func or (lambda x: NDArray(abs(x.data).mean()))
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.queue = []
+        self.step = 0
+        self.activated = False
+        self._handles = []
+
+    def install(self, block):
+        """Attach to a gluon Block: records every child block's output."""
+
+        def make_hook(name):
+            def hook(blk, inputs, output):
+                if self.activated and self.re_pattern.match(name):
+                    outs = output if isinstance(output, (list, tuple)) else [output]
+                    for i, o in enumerate(outs):
+                        if isinstance(o, NDArray):
+                            self.queue.append(
+                                (self.step, f"{name}_output{i}",
+                                 self.stat_func(o)))
+            return hook
+
+        for name, child in block._children.items():
+            self._handles.append(child.register_forward_hook(make_hook(name)))
+        return self
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.activated = True
+        self.queue = []
+
+    def toc(self):
+        if not self.activated:
+            self.step += 1
+            return []
+        self.activated = False
+        self.step += 1
+        res = [(n, k, v.asnumpy()) for n, k, v in self.queue]
+        if self.sort:
+            res.sort(key=lambda x: x[1])
+        return res
+
+    def toc_print(self):
+        for n, k, v in self.toc():
+            print(f"Batch: {n:7d} {k:30s} {v}")

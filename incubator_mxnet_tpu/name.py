@@ -1,0 +1,44 @@
+"""NameManager: automatic unique naming (reference python/mxnet/name.py)."""
+from __future__ import annotations
+
+import threading
+
+_state = threading.local()
+
+
+class NameManager:
+    def __init__(self):
+        self._counter: dict[str, int] = {}
+
+    @staticmethod
+    def current() -> "NameManager":
+        if not getattr(_state, "stack", None):
+            _state.stack = [NameManager()]
+        return _state.stack[-1]
+
+    def get(self, name, hint):
+        if name:
+            return name
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return f"{hint}{idx}"
+
+    def __enter__(self):
+        if not hasattr(_state, "stack"):
+            _state.stack = [NameManager()]
+        _state.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _state.stack.pop()
+
+
+class Prefix(NameManager):
+    """Prepend a fixed prefix to all auto names (reference name.py Prefix)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return name if name else self._prefix + super().get(name, hint)

@@ -1,0 +1,288 @@
+"""The ``nd`` namespace: NDArray + every registered op as a function.
+
+Counterpart of reference ``python/mxnet/ndarray/`` (21 kLoC): there the op
+functions are code-generated at import from the C++ registry
+(register.py:115); here they are generated from the Python-side op
+registry — same architecture, one registry feeding every frontend.
+"""
+from __future__ import annotations
+
+import builtins as _builtins
+import functools as _functools
+import struct as _struct
+
+import numpy as _onp
+import jax as _jax
+import jax.numpy as _jnp
+
+from ..base import dtype_from_any as _dtype_from_any
+from ..context import Context, current_context
+from .ndarray import NDArray, _wrap_outputs, _to_jax
+from ..ops import registry as _registry
+from ..ops.registry import invoke as _invoke
+from ..ops import control_flow as _cf
+
+# ---------------------------------------------------------------------------
+# generated op wrappers (reference python/mxnet/ndarray/register.py:115)
+# ---------------------------------------------------------------------------
+
+def _make_wrapper(op_name):
+    op = _registry.get_op(op_name)
+
+    def fn(*args, out=None, **kwargs):
+        return _invoke(op, *args, out=out, **kwargs)
+
+    fn.__name__ = op_name
+    fn.__qualname__ = op_name
+    fn.__doc__ = (op.fn.__doc__ or f"Operator {op_name} (auto-generated wrapper).")
+    return fn
+
+
+_g = globals()
+for _name in _registry.list_ops():
+    if _name not in _g:
+        _g[_name] = _make_wrapper(_name)
+
+# pythonic aliases matching the reference nd namespace
+dot = _g["dot"]
+concatenate = _g["concat"]
+elemwise_add = _g["add"]
+waitall = None  # set below
+
+
+class _Contrib:
+    """``nd.contrib`` namespace (foreach/while_loop/cond + extras)."""
+
+    foreach = staticmethod(_cf.foreach)
+    while_loop = staticmethod(_cf.while_loop)
+    cond = staticmethod(_cf.cond)
+
+    @staticmethod
+    def boolean_mask(data, index, axis=0):
+        """Dynamic-shape boolean mask — eager only (host round-trip).
+
+        Reference src/operator/contrib/boolean_mask.cc.  XLA cannot
+        express dynamic output shapes; the concrete-value path is the
+        documented TPU fallback.
+        """
+        mask = _onp.asarray(index.asnumpy()).astype(bool)
+        return NDArray(data.data[_onp.nonzero(mask)[0]] if axis == 0
+                       else _jnp.compress(mask, data.data, axis=axis),
+                       ctx=data.ctx)
+
+    @staticmethod
+    def arange_like(data, start=0.0, step=1.0, axis=None):
+        n = data.size if axis is None else data.shape[axis]
+        out = _jnp.arange(n, dtype=_jnp.float32) * step + start
+        if axis is None:
+            out = out.reshape(data.shape)
+        return NDArray(out, ctx=data.ctx)
+
+
+contrib = _Contrib()
+
+
+class _LinalgNS:
+    def __getattr__(self, name):
+        return _g["linalg_" + name]
+
+
+linalg = _LinalgNS()
+
+# ---------------------------------------------------------------------------
+# creation ops
+# ---------------------------------------------------------------------------
+
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray from any array-like (reference nd.array)."""
+    return NDArray(source_array, ctx=ctx or current_context(), dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype="float32", **kwargs):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(_jnp.zeros(shape, _dtype_from_any(dtype)), ctx=ctx or current_context())
+
+
+def ones(shape, ctx=None, dtype="float32", **kwargs):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(_jnp.ones(shape, _dtype_from_any(dtype)), ctx=ctx or current_context())
+
+
+def full(shape, val, ctx=None, dtype="float32"):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(_jnp.full(shape, val, _dtype_from_any(dtype)), ctx=ctx or current_context())
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros_like(a, **kw):
+    return NDArray(_jnp.zeros_like(a.data), ctx=a.ctx)
+
+
+def ones_like(a, **kw):
+    return NDArray(_jnp.ones_like(a.data), ctx=a.ctx)
+
+
+def full_like(a, fill_value, **kw):
+    return NDArray(_jnp.full_like(a.data, fill_value), ctx=a.ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    out = _jnp.arange(start, stop, step, dtype=_dtype_from_any(dtype))
+    if repeat > 1:
+        out = _jnp.repeat(out, repeat)
+    return NDArray(out, ctx=ctx or current_context())
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32"):
+    return NDArray(_jnp.linspace(start, stop, num, endpoint=endpoint,
+                                 dtype=_dtype_from_any(dtype)),
+                   ctx=ctx or current_context())
+
+
+def eye(N, M=None, k=0, ctx=None, dtype="float32"):
+    return NDArray(_jnp.eye(N, M, k, dtype=_dtype_from_any(dtype)),
+                   ctx=ctx or current_context())
+
+
+def meshgrid(*arrays, indexing="xy"):
+    outs = _jnp.meshgrid(*[a.data for a in arrays], indexing=indexing)
+    return [NDArray(o, ctx=arrays[0].ctx) for o in outs]
+
+
+def from_dlpack(capsule):
+    return NDArray(_jnp.asarray(_jax.dlpack.from_dlpack(capsule)))
+
+
+def to_dlpack_for_read(arr):
+    return arr.data.__dlpack__()
+
+
+to_dlpack_for_write = to_dlpack_for_read
+
+
+def waitall():
+    """Block until all async work completes and surface errors
+    (reference MXNDArrayWaitAll)."""
+    from .. import engine
+    engine.get_engine().wait_for_all()
+    (_jax.effects_barrier if hasattr(_jax, "effects_barrier") else lambda: None)()
+
+
+def add_n(*args, out=None):
+    acc = args[0].data
+    for a in args[1:]:
+        acc = acc + a.data
+    return _wrap_outputs(acc, args, out=out)
+
+
+ElementWiseSum = add_n
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Utility mirrored from gluon.utils: slice batch across contexts."""
+    n = len(ctx_list)
+    if not isinstance(data, NDArray):
+        data = array(data)
+    size = data.shape[batch_axis]
+    step = size // n
+    slices = []
+    for i, ctx in enumerate(ctx_list):
+        begin = i * step
+        end = (i + 1) * step if i < n - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(begin, end)
+        slices.append(data[tuple(idx)].as_in_context(ctx))
+    return slices
+
+
+# ---------------------------------------------------------------------------
+# serialization (reference src/ndarray/ndarray.cc:1679-1924 TLV format;
+# redesigned as a simple tagged binary container, same capabilities:
+# list-of-arrays and dict-of-arrays round trip, used by .params files)
+# ---------------------------------------------------------------------------
+
+_MAGIC = b"MXTPU001"
+
+
+def save(fname, data):
+    """Save a list or dict of NDArrays (reference nd.save)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        items = [(k, v) for k, v in data.items()]
+    else:
+        items = [("", v) for v in data]
+    with open(fname, "wb") as f:
+        f.write(_MAGIC)
+        f.write(_struct.pack("<q", len(items)))
+        for key, arr in items:
+            kb = key.encode()
+            np_val = arr.asnumpy() if arr.data.dtype != _jnp.bfloat16 else \
+                _onp.asarray(arr.data.astype(_jnp.float32))
+            dtype_name = arr.data.dtype.name
+            db = np_val.tobytes() if dtype_name != "bfloat16" else np_val.astype("float32").tobytes()
+            shape = arr.shape
+            f.write(_struct.pack("<q", len(kb)))
+            f.write(kb)
+            dn = dtype_name.encode()
+            f.write(_struct.pack("<q", len(dn)))
+            f.write(dn)
+            f.write(_struct.pack("<q", len(shape)))
+            for s in shape:
+                f.write(_struct.pack("<q", s))
+            f.write(_struct.pack("<q", len(db)))
+            f.write(db)
+
+
+def load(fname):
+    """Load arrays saved by :func:`save` (reference nd.load)."""
+    with open(fname, "rb") as f:
+        magic = f.read(8)
+        if magic != _MAGIC:
+            raise ValueError(f"{fname}: not a {_MAGIC.decode()} file")
+        n = _struct.unpack("<q", f.read(8))[0]
+        out = {}
+        keyed = True
+        arrays = []
+        for _ in range(n):
+            klen = _struct.unpack("<q", f.read(8))[0]
+            key = f.read(klen).decode()
+            dlen = _struct.unpack("<q", f.read(8))[0]
+            dtype_name = f.read(dlen).decode()
+            ndim = _struct.unpack("<q", f.read(8))[0]
+            shape = tuple(_struct.unpack("<q", f.read(8))[0] for _ in range(ndim))
+            nbytes = _struct.unpack("<q", f.read(8))[0]
+            buf = f.read(nbytes)
+            if dtype_name == "bfloat16":
+                np_val = _onp.frombuffer(buf, dtype="float32").reshape(shape)
+                arr = NDArray(_jnp.asarray(np_val).astype(_jnp.bfloat16))
+            else:
+                np_val = _onp.frombuffer(buf, dtype=dtype_name).reshape(shape)
+                arr = NDArray(np_val)
+            if not key:
+                keyed = False
+            arrays.append((key, arr))
+        if keyed and _builtins.any(k for k, _ in arrays):
+            return {k: v for k, v in arrays}
+        return [v for _, v in arrays]
+
+
+def save_parameters(fname, params):
+    save(fname, params)
+
+
+def load_parameters(fname):
+    return load(fname)
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    from ..image import imdecode as _imdecode
+    return _imdecode(buf, flag=flag, to_rgb=to_rgb)
+
+
+from . import random  # noqa: E402  (needs creation ops above)
+from . import sparse  # noqa: E402
+from .random import uniform as random_uniform_eager  # noqa: F401

@@ -1,0 +1,598 @@
+"""NDArray: the imperative data plane over PJRT buffers.
+
+TPU-native re-design of the reference NDArray (include/mxnet/ndarray.h:82,
+src/ndarray/) — SURVEY.md §7 stage 1.  The reference NDArray is a shared
+``Chunk`` (ndarray.h:820-1091) holding mutable device memory plus an engine
+variable; views (slice/reshape) alias the chunk, and in-place ops mutate it.
+
+XLA buffers are immutable, so mutation is re-designed functionally:
+
+* ``_Chunk`` holds the current ``jax.Array`` *value* plus an engine ``Var``
+  whose version bumps on every write — in-place ops (``x += y``,
+  ``x[1:3] = v``) compute a new value with ``Array.at[...]`` (which XLA
+  turns into an in-place donation when safe) and swap it into the chunk.
+* Views created by basic slicing / ``reshape`` share the chunk and record
+  an index / shape transform: reads re-slice the current chunk value
+  (lazy, fused by XLA), writes scatter back into the chunk — so mutation
+  through a view is visible through the base and vice versa, matching
+  reference view semantics.
+* ``wait_to_read`` / ``asnumpy`` block on the underlying buffer, and
+  surface async device errors there, mirroring the engine's exception
+  propagation contract (reference threaded_engine.cc:422-522).
+
+The array may also wrap a JAX tracer — the same class flows through
+``hybridize`` tracing, which is how whole blocks compile to one XLA
+program (the CachedOp analog).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_from_any, integer_types, numeric_types
+from ..context import Context, current_context
+from .. import engine as _engine_mod
+
+__all__ = ["NDArray", "_wrap_outputs", "_to_jax"]
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _to_jax(value, ctx: Context | None = None, dtype=None):
+    """Convert arbitrary input to a jax.Array placed on ctx's device."""
+    dtype = dtype_from_any(dtype)
+    if isinstance(value, NDArray):
+        value = value.data
+    if _is_tracer(value):
+        return value.astype(dtype) if dtype is not None else value
+    if isinstance(value, jax.Array):
+        arr = value if dtype is None else value.astype(dtype)
+    else:
+        if dtype is None and not isinstance(value, onp.ndarray):
+            # python lists/scalars default to float32 (reference
+            # nd.array semantics: dtype defaults to float32 unless the
+            # source carries a dtype)
+            dtype = jnp.dtype(jnp.float32)
+        np_val = onp.asarray(value, dtype=None if dtype is None else onp.dtype(dtype.name) if dtype.name != "bfloat16" else None)
+        if dtype is not None and dtype.name == "bfloat16":
+            arr = jnp.array(np_val).astype(jnp.bfloat16)
+        else:
+            if np_val.dtype == onp.float64 and dtype is None:
+                np_val = np_val.astype(onp.float32)  # default_dtype like reference
+            # jnp.array (copy) — NOT asarray: the CPU backend may zero-copy
+            # alias numpy buffers, and chunks must own their storage
+            arr = jnp.array(np_val)
+        if dtype is not None:
+            arr = arr.astype(dtype)
+    if ctx is not None and not _is_tracer(arr):
+        dev = ctx.jax_device
+        if not (arr.committed and next(iter(arr.devices())) == dev) :
+            arr = jax.device_put(arr, dev)
+    return arr
+
+
+class _Chunk:
+    """Shared storage cell: current value + engine var (version counter)."""
+
+    __slots__ = ("array", "var", "ctx")
+
+    def __init__(self, array, ctx):
+        self.array = array
+        self.ctx = ctx
+        self.var = _engine_mod.get_engine().new_variable("ndarray")
+
+    def write(self, new_array):
+        self.array = new_array
+        self.var._version += 1
+
+
+class NDArray:
+    """A multi-dimensional array on a device context.
+
+    Mirrors the user-facing surface of the reference NDArray
+    (python/mxnet/ndarray/ndarray.py): numpy conversion, arithmetic with
+    broadcasting, slicing with view semantics, in-place mutation,
+    ``attach_grad``/``backward`` autograd hooks, context movement.
+    """
+
+    __slots__ = ("_chunk", "_index", "_vshape", "_grad", "_grad_req",
+                 "_tape_node", "__weakref__")
+
+    def __init__(self, data, ctx: Context | None = None, dtype=None,
+                 _chunk: _Chunk | None = None, _index=None, _vshape=None):
+        if _chunk is not None:
+            self._chunk = _chunk
+        else:
+            if ctx is None:
+                ctx = current_context() if not isinstance(data, NDArray) else data.ctx
+            self._chunk = _Chunk(_to_jax(data, ctx, dtype), ctx)
+        self._index = _index
+        self._vshape = _vshape
+        self._grad = None
+        self._grad_req = None
+        self._tape_node = None
+
+    # ------------------------------------------------------------------
+    # storage access
+    # ------------------------------------------------------------------
+    @property
+    def data(self):
+        """Current value as a jax.Array (views re-slice lazily)."""
+        a = self._chunk.array
+        if self._index is not None:
+            a = a[self._index]
+        if self._vshape is not None:
+            a = a.reshape(self._vshape)
+        return a
+
+    def _set_data(self, new):
+        """Functional write-back honouring view aliasing."""
+        if isinstance(new, onp.ndarray):
+            # force a device copy: the CPU backend may zero-copy alias the
+            # numpy buffer, which the caller is free to mutate/free
+            new = jnp.array(new)
+        if self._index is None and self._vshape is None:
+            self._chunk.write(new)
+        elif self._index is not None:
+            base = self._chunk.array
+            target_shape = base[self._index].shape
+            self._chunk.write(base.at[self._index].set(
+                jnp.broadcast_to(jnp.asarray(new, base.dtype), target_shape)))
+        else:  # pure reshape view
+            self._chunk.write(jnp.reshape(jnp.asarray(new),
+                                          self._chunk.array.shape))
+
+    @property
+    def _is_view(self):
+        return self._index is not None or self._vshape is not None
+
+    def _in_graph(self):
+        return (self._grad_req not in (None, "null")) or self._tape_node is not None
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.data.shape)
+
+    @property
+    def dtype(self):
+        return onp.dtype(self.data.dtype.name) if self.data.dtype.name != "bfloat16" else self.data.dtype
+
+    @property
+    def ndim(self):
+        return self.data.ndim
+
+    @property
+    def size(self):
+        return int(onp.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ctx(self) -> Context:
+        return self._chunk.ctx
+
+    context = ctx
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    @property
+    def handle(self):  # reference parity: opaque handle
+        return self._chunk
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __repr__(self):
+        if _is_tracer(self._chunk.array):
+            return f"NDArray(traced, shape={self.shape}) @{self.ctx}"
+        return f"\n{self.asnumpy()}\n<NDArray {self.shape} @{self.ctx}>"
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("ambiguous truth value of multi-element NDArray")
+        return bool(self.asnumpy().item())
+
+    def __float__(self):
+        return float(self.asnumpy().item())
+
+    def __int__(self):
+        return int(self.asnumpy().item())
+
+    def __index__(self):
+        return int(self)
+
+    # ------------------------------------------------------------------
+    # host transfer / sync
+    # ------------------------------------------------------------------
+    def asnumpy(self) -> onp.ndarray:
+        """Blocking copy to host (reference ndarray.py asnumpy).
+
+        This is the async-error surface: exceptions raised by device
+        execution propagate here.
+        """
+        a = self.data
+        if _is_tracer(a):
+            raise RuntimeError("cannot asnumpy() a traced NDArray inside hybridize")
+        if a.dtype == jnp.bfloat16:
+            return onp.asarray(a.astype(jnp.float32))
+        return onp.asarray(a)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("the array is not scalar")
+        return self.asnumpy().reshape(()).item()
+
+    def item(self):
+        return self.asscalar()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def wait_to_read(self):
+        a = self.data
+        if not _is_tracer(a):
+            jax.block_until_ready(a)
+        _engine_mod.get_engine().throw_pending(self._chunk.var)
+
+    def wait_to_write(self):
+        self.wait_to_read()
+
+    # ------------------------------------------------------------------
+    # copies / context movement
+    # ------------------------------------------------------------------
+    def copy(self) -> "NDArray":
+        return NDArray(self.data + 0, ctx=self.ctx)
+
+    def copyto(self, other):
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self.data, other.jax_device), ctx=other)
+        other._set_data(_to_jax(self.data, other.ctx, other.data.dtype))
+        return other
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self.ctx:
+            return self
+        return self.copyto(ctx)
+
+    as_in_ctx = as_in_context
+
+    def astype(self, dtype, copy=True):
+        dt = dtype_from_any(dtype)
+        if not copy and jnp.dtype(self.data.dtype) == dt:
+            return self
+        return NDArray(self.data.astype(dt), ctx=self.ctx)
+
+    def detach(self) -> "NDArray":
+        out = NDArray(self.data, ctx=self.ctx)
+        return out
+
+    # ------------------------------------------------------------------
+    # autograd
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate a gradient buffer (reference ndarray.py attach_grad)."""
+        self._grad = NDArray(jnp.zeros(self.shape, self.data.dtype), ctx=self.ctx)
+        self._grad_req = grad_req
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad._set_data(jnp.zeros(self._grad.shape, self._grad.data.dtype))
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _is_basic_index(key):
+        if isinstance(key, (slice, *integer_types)) or key is None or key is Ellipsis:
+            return True
+        if isinstance(key, tuple):
+            return all(isinstance(k, (slice, *integer_types)) or k is None or k is Ellipsis
+                       for k in key)
+        return False
+
+    def __getitem__(self, key):
+        if isinstance(key, NDArray):
+            key = key.data
+        if self._is_basic_index(key) and not self._is_view and not _is_tracer(self._chunk.array):
+            # view sharing the chunk (reference: slice returns a view of
+            # the same Chunk — ndarray.h views share shandle)
+            return NDArray(None, _chunk=self._chunk, _index=key)
+        return NDArray(self.data[key], ctx=self.ctx)
+
+    def __setitem__(self, key, value):
+        if isinstance(key, NDArray):
+            key = key.data
+        if isinstance(value, NDArray):
+            value = value.data
+        base = self._chunk.array
+        if self._is_view:
+            # write through the composed view
+            data = self.data.at[key].set(jnp.asarray(value, base.dtype)
+                                         if not isinstance(value, (int, float)) else value)
+            self._set_data(data)
+            return
+        if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
+            new = jnp.broadcast_to(jnp.asarray(value, base.dtype), base.shape)
+            self._chunk.write(jnp.asarray(new))
+            return
+        self._chunk.write(base.at[key].set(
+            value if isinstance(value, (int, float)) else jnp.asarray(value, base.dtype)))
+
+    def slice(self, begin, end, step=None):
+        idx = tuple(slice(b, e, s) for b, e, s in
+                    zip(begin, end, step or [None] * len(begin)))
+        return self[idx]
+
+    def take(self, indices, axis=0, mode="clip"):
+        from ..ops.registry import invoke
+        return invoke("take", self, indices, axis=axis, mode=mode)
+
+    # ------------------------------------------------------------------
+    # shape manipulation (view-producing where the reference's are views)
+    # ------------------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        # resolve -1 and 0 (reference reshape special codes 0 = copy dim)
+        shape = list(shape)
+        for i, s in enumerate(shape):
+            if s == 0 and i < self.ndim:
+                shape[i] = self.shape[i]
+        if -1 in shape:
+            known = int(onp.prod([s for s in shape if s != -1])) or 1
+            shape[shape.index(-1)] = self.size // known
+        shape = tuple(int(s) for s in shape)
+        if not self._is_view and not _is_tracer(self._chunk.array):
+            return NDArray(None, _chunk=self._chunk, _vshape=shape)
+        return NDArray(self.data.reshape(shape), ctx=self.ctx)
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def expand_dims(self, axis):
+        return NDArray(jnp.expand_dims(self.data, axis), ctx=self.ctx)
+
+    def squeeze(self, axis=None):
+        return NDArray(jnp.squeeze(self.data, axis), ctx=self.ctx)
+
+    def flatten(self):
+        return self.reshape((self.shape[0], -1)) if self.ndim > 1 else self.reshape((-1,))
+
+    def transpose(self, axes=None):
+        return NDArray(jnp.transpose(self.data, axes), ctx=self.ctx)
+
+    def swapaxes(self, a, b):
+        return NDArray(jnp.swapaxes(self.data, a, b), ctx=self.ctx)
+
+    def broadcast_to(self, shape):
+        return NDArray(jnp.broadcast_to(self.data, shape), ctx=self.ctx)
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def tile(self, reps):
+        return NDArray(jnp.tile(self.data, reps), ctx=self.ctx)
+
+    def repeat(self, repeats, axis=None):
+        return NDArray(jnp.repeat(self.data, repeats, axis=axis), ctx=self.ctx)
+
+    def pad(self, pad_width, mode="constant", constant_value=0):
+        return NDArray(jnp.pad(self.data, pad_width, mode=mode,
+                               constant_values=constant_value), ctx=self.ctx)
+
+    def diag(self, k=0):
+        return NDArray(jnp.diag(self.data, k), ctx=self.ctx)
+
+    def tostype(self, stype):
+        if stype != "default":
+            from . import sparse
+            return sparse.cast_storage(self, stype)
+        return self
+
+    def as_np_ndarray(self):
+        from .. import numpy as mxnp
+        return mxnp.ndarray(self.data, ctx=self.ctx)
+
+    # ------------------------------------------------------------------
+    # arithmetic (delegates to the op registry for autograd integration)
+    # ------------------------------------------------------------------
+    def _binop(self, name, other, reverse=False):
+        from ..ops.registry import invoke
+
+        if isinstance(other, NDArray) or isinstance(other, numeric_types):
+            a, b = (other, self) if reverse else (self, other)
+            return invoke(name, a, b)
+        return NotImplemented
+
+    def __add__(self, o): return self._binop("add", o)
+    def __radd__(self, o): return self._binop("add", o, True)
+    def __sub__(self, o): return self._binop("subtract", o)
+    def __rsub__(self, o): return self._binop("subtract", o, True)
+    def __mul__(self, o): return self._binop("multiply", o)
+    def __rmul__(self, o): return self._binop("multiply", o, True)
+    def __truediv__(self, o): return self._binop("divide", o)
+    def __rtruediv__(self, o): return self._binop("divide", o, True)
+    def __floordiv__(self, o): return self._binop("floor_divide", o)
+    def __rfloordiv__(self, o): return self._binop("floor_divide", o, True)
+    def __mod__(self, o): return self._binop("mod", o)
+    def __rmod__(self, o): return self._binop("mod", o, True)
+    def __pow__(self, o): return self._binop("power", o)
+    def __rpow__(self, o): return self._binop("power", o, True)
+    def __matmul__(self, o): return self._binop("matmul", o)
+
+    def __neg__(self):
+        from ..ops.registry import invoke
+        return invoke("negative", self)
+
+    def __abs__(self):
+        from ..ops.registry import invoke
+        return invoke("abs", self)
+
+    def __eq__(self, o): return self._cmp("equal", o)
+    def __ne__(self, o): return self._cmp("not_equal", o)
+    def __lt__(self, o): return self._cmp("lesser", o)
+    def __le__(self, o): return self._cmp("lesser_equal", o)
+    def __gt__(self, o): return self._cmp("greater", o)
+    def __ge__(self, o): return self._cmp("greater_equal", o)
+
+    def _cmp(self, name, other):
+        from ..ops.registry import invoke
+        if isinstance(other, (NDArray, *numeric_types)):
+            return invoke(name, self, other)
+        return NotImplemented
+
+    __hash__ = object.__hash__
+
+    # in-place: mutate the chunk (not recorded — reference raises on
+    # in-place mutation of arrays needing grad inside record scope too)
+    def _inplace(self, name, other):
+        from .. import autograd
+        if autograd.is_recording() and self._in_graph():
+            raise RuntimeError(
+                "in-place operations on arrays in the autograd graph are "
+                "not supported inside record()")
+        o = other.data if isinstance(other, NDArray) else other
+        fn = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+              "div": jnp.divide}[name]
+        self._set_data(fn(self.data, o).astype(self.data.dtype))
+        return self
+
+    def __iadd__(self, o): return self._inplace("add", o)
+    def __isub__(self, o): return self._inplace("sub", o)
+    def __imul__(self, o): return self._inplace("mul", o)
+    def __itruediv__(self, o): return self._inplace("div", o)
+
+    # ------------------------------------------------------------------
+    # reductions & common math as methods
+    # ------------------------------------------------------------------
+    def _op(self, name, **kw):
+        from ..ops.registry import invoke
+        return invoke(name, self, **kw)
+
+    def sum(self, axis=None, keepdims=False):
+        return self._op("sum", axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._op("mean", axis=axis, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return self._op("prod", axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._op("max", axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._op("min", axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        return self._op("argmax", axis=axis, keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        return self._op("argmin", axis=axis, keepdims=keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return self._op("norm", ord=ord, axis=axis, keepdims=keepdims)
+
+    def clip(self, a_min=None, a_max=None):
+        return self._op("clip", a_min=a_min, a_max=a_max)
+
+    def abs(self):
+        return self._op("abs")
+
+    def sqrt(self):
+        return self._op("sqrt")
+
+    def square(self):
+        return self._op("square")
+
+    def exp(self):
+        return self._op("exp")
+
+    def log(self):
+        return self._op("log")
+
+    def sigmoid(self):
+        return self._op("sigmoid")
+
+    def tanh(self):
+        return self._op("tanh")
+
+    def relu(self):
+        return self._op("relu")
+
+    def softmax(self, axis=-1):
+        return self._op("softmax", axis=axis)
+
+    def log_softmax(self, axis=-1):
+        return self._op("log_softmax", axis=axis)
+
+    def dot(self, other):
+        from ..ops.registry import invoke
+        return invoke("dot", self, other)
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0):
+        return self._op("one_hot", depth=depth, on_value=on_value,
+                        off_value=off_value)
+
+    def topk(self, k=1, axis=-1, ret_typ="indices", is_ascend=False):
+        return self._op("topk", k=k, axis=axis, ret_typ=ret_typ,
+                        is_ascend=is_ascend)
+
+    def sort(self, axis=-1, is_ascend=True):
+        return self._op("sort", axis=axis, is_ascend=is_ascend)
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return self._op("argsort", axis=axis, is_ascend=is_ascend)
+
+
+def _wrap_outputs(out_data, inputs, out=None):
+    """Wrap raw jax outputs into NDArrays on the inferred context."""
+    ctx = None
+    for x in inputs:
+        if isinstance(x, NDArray):
+            ctx = x.ctx
+            break
+    if ctx is None:
+        ctx = current_context()
+
+    def wrap_one(a, target):
+        if target is not None:
+            target._set_data(a)
+            return target
+        nd = NDArray.__new__(NDArray)
+        nd._chunk = _Chunk(a, ctx)
+        nd._index = None
+        nd._vshape = None
+        nd._grad = None
+        nd._grad_req = None
+        nd._tape_node = None
+        return nd
+
+    if isinstance(out_data, (tuple, list)):
+        outs = out if isinstance(out, (tuple, list)) else [None] * len(out_data)
+        return tuple(wrap_one(a, t) for a, t in zip(out_data, outs))
+    return wrap_one(out_data, out)

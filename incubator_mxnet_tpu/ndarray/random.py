@@ -1,0 +1,85 @@
+"""Eager random samplers: ``nd.random.*`` (reference python/mxnet/ndarray/random.py).
+
+Keys are drawn from the global stream (``mx.random.seed``); inside
+hybridize tracing, keys derive from the CachedOp's key input so compiled
+graphs stay pure (see random.py module docstring for the contract).
+"""
+from __future__ import annotations
+
+from .. import random as _random
+from ..context import current_context
+from ..ops.registry import get_op
+from .ndarray import NDArray
+
+__all__ = ["uniform", "normal", "randn", "randint", "gamma", "exponential",
+           "poisson", "negative_binomial", "multinomial", "shuffle",
+           "bernoulli", "seed"]
+
+seed = _random.seed
+
+
+def _sample(op_name, shape, dtype, ctx, out, **params):
+    op = get_op(op_name)
+    shape = (shape,) if isinstance(shape, int) else tuple(shape or ())
+    key = _random.next_key()
+    data = op.fn(key, shape=shape, dtype=dtype, **params)
+    nd = NDArray(data, ctx=ctx or current_context())
+    if out is not None:
+        out._set_data(nd.data)
+        return out
+    return nd
+
+
+def uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    return _sample("random_uniform", shape, dtype, ctx, out, low=low, high=high)
+
+
+def normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    return _sample("random_normal", shape, dtype, ctx, out, loc=loc, scale=scale)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
+    return normal(loc, scale, shape, dtype=dtype, ctx=ctx)
+
+
+def randint(low, high=None, shape=(), dtype="int32", ctx=None, out=None):
+    return _sample("random_randint", shape, dtype, ctx, out, low=low, high=high)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    return _sample("random_gamma", shape, dtype, ctx, out, alpha=alpha, beta=beta)
+
+
+def exponential(scale=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    return _sample("random_exponential", shape, dtype, ctx, out, lam=1.0 / scale)
+
+
+def poisson(lam=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    return _sample("random_poisson", shape, dtype, ctx, out, lam=lam)
+
+
+def negative_binomial(k=1, p=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    return _sample("random_negative_binomial", shape, dtype, ctx, out, k=k, p=p)
+
+
+def bernoulli(p=0.5, shape=(), dtype="float32", ctx=None, out=None):
+    return _sample("random_bernoulli", shape, dtype, ctx, out, p=p)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32"):
+    op = get_op("sample_multinomial")
+    key = _random.next_key()
+    out = op.fn(data.data, key, shape=shape, get_prob=get_prob)
+    if get_prob:
+        return NDArray(out[0], ctx=data.ctx), NDArray(out[1], ctx=data.ctx)
+    return NDArray(out, ctx=data.ctx)
+
+
+def shuffle(data, out=None):
+    op = get_op("shuffle")
+    key = _random.next_key()
+    nd = NDArray(op.fn(data.data, key), ctx=data.ctx)
+    if out is not None:
+        out._set_data(nd.data)
+        return out
+    return nd

@@ -1,0 +1,159 @@
+"""Sparse NDArray storage types: row_sparse and csr.
+
+Reference: include/mxnet/ndarray.h:61-82 storage types + src/operator
+sparse kernels; kvstore pulls row_sparse shards (kvstore_dist.h:558).
+
+TPU design decision (SURVEY.md §7 "Sparse storage"): the MXU has no
+sparse gather/scatter path, so sparse arrays here are *index + values*
+containers with the same API (``indices``, ``data``, ``tostype``,
+arithmetic against dense) whose compute lowers to dense segment ops
+(gather / scatter-add).  This keeps capability parity — row-sparse
+gradients, sparse pull, sparse optimizer updates — with documented dense
+fallback performance.
+"""
+from __future__ import annotations
+
+import numpy as onp
+import jax.numpy as jnp
+
+from ..context import current_context
+from .ndarray import NDArray
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "cast_storage", "zeros"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common behavior: dense materialization via ``todense``."""
+
+    __slots__ = ()
+
+    @property
+    def stype(self):
+        raise NotImplementedError
+
+    def todense(self) -> NDArray:
+        return NDArray(self.data, ctx=self.ctx)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self.todense()
+        return cast_storage(self, stype)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows at ``indices`` hold ``values``; all other rows are zero."""
+
+    __slots__ = ("_rs_indices", "_rs_values", "_dense_shape")
+
+    def __init__(self, values, indices, shape, ctx=None):
+        self._rs_indices = jnp.asarray(indices, jnp.int64 if False else jnp.int32)
+        self._rs_values = jnp.asarray(values)
+        self._dense_shape = tuple(shape)
+        dense = jnp.zeros(shape, self._rs_values.dtype).at[self._rs_indices].set(
+            self._rs_values)
+        super().__init__(dense, ctx=ctx or current_context())
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self):
+        return NDArray(self._rs_indices, ctx=self.ctx)
+
+    @property
+    def values(self):
+        return NDArray(self._rs_values, ctx=self.ctx)
+
+    def retain(self, indices):
+        """Keep only the given rows (reference sparse_retain op)."""
+        idx = jnp.asarray(indices.data if isinstance(indices, NDArray) else indices,
+                          jnp.int32)
+        vals = self.data[idx]
+        return RowSparseNDArray(vals, idx, self._dense_shape, ctx=self.ctx)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix."""
+
+    __slots__ = ("_csr_data", "_csr_indices", "_csr_indptr", "_dense_shape")
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        self._csr_data = jnp.asarray(data)
+        self._csr_indices = jnp.asarray(indices, jnp.int32)
+        self._csr_indptr = jnp.asarray(indptr, jnp.int32)
+        self._dense_shape = tuple(shape)
+        dense = onp.zeros(shape, dtype=onp.asarray(self._csr_data).dtype)
+        indptr_np = onp.asarray(self._csr_indptr)
+        indices_np = onp.asarray(self._csr_indices)
+        data_np = onp.asarray(self._csr_data)
+        for row in range(shape[0]):
+            lo, hi = indptr_np[row], indptr_np[row + 1]
+            dense[row, indices_np[lo:hi]] = data_np[lo:hi]
+        super().__init__(dense, ctx=ctx or current_context())
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indices(self):
+        return NDArray(self._csr_indices, ctx=self.ctx)
+
+    @property
+    def indptr(self):
+        return NDArray(self._csr_indptr, ctx=self.ctx)
+
+    @property
+    def data_array(self):
+        return NDArray(self._csr_data, ctx=self.ctx)
+
+
+def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
+    if isinstance(arg, tuple) and len(arg) == 2:
+        values, indices = arg
+        values = values.data if isinstance(values, NDArray) else jnp.asarray(values)
+        return RowSparseNDArray(values, indices, shape, ctx=ctx)
+    dense = NDArray(arg, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg, shape=None, ctx=None, dtype=None):
+    if isinstance(arg, tuple) and len(arg) == 3:
+        data, indices, indptr = arg
+        return CSRNDArray(data, indices, indptr, shape, ctx=ctx)
+    dense = NDArray(arg, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def cast_storage(arr, stype):
+    """Dense ↔ sparse conversion (reference tensor/cast_storage-inl.h)."""
+    if stype == "default":
+        return NDArray(arr.data, ctx=arr.ctx)
+    np_val = onp.asarray(arr.data)
+    if stype == "row_sparse":
+        nz_rows = onp.nonzero(np_val.reshape(np_val.shape[0], -1).any(axis=1))[0]
+        return RowSparseNDArray(np_val[nz_rows], nz_rows, np_val.shape, ctx=arr.ctx)
+    if stype == "csr":
+        if np_val.ndim != 2:
+            raise ValueError("csr requires 2-D")
+        indptr = [0]
+        indices, data = [], []
+        for row in np_val:
+            nz = onp.nonzero(row)[0]
+            indices.extend(nz.tolist())
+            data.extend(row[nz].tolist())
+            indptr.append(len(indices))
+        return CSRNDArray(onp.asarray(data, np_val.dtype), indices, indptr,
+                          np_val.shape, ctx=arr.ctx)
+    raise ValueError(f"unknown stype {stype}")
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            jnp.zeros((0,) + tuple(shape[1:]), dtype), jnp.zeros((0,), jnp.int32),
+            shape, ctx=ctx)
+    from . import zeros as dense_zeros
+    return dense_zeros(shape, ctx=ctx, dtype=dtype)

@@ -1,0 +1,78 @@
+"""``mx.npx``: operators beyond the NumPy standard
+(reference python/mxnet/numpy_extension/)."""
+from __future__ import annotations
+
+from ..ndarray import NDArray
+from ..ops.registry import invoke
+from ..util import is_np_array, set_np, reset_np  # noqa: F401
+
+
+def _op(name):
+    def fn(*args, **kwargs):
+        return invoke(name, *args, **kwargs)
+
+    fn.__name__ = name
+    return fn
+
+
+softmax = _op("softmax")
+log_softmax = _op("log_softmax")
+relu = _op("relu")
+sigmoid = _op("sigmoid")
+activation = _op("Activation")
+batch_norm = _op("BatchNorm")
+layer_norm = _op("LayerNorm")
+group_norm = _op("GroupNorm")
+fully_connected = _op("FullyConnected")
+convolution = _op("Convolution")
+pooling = _op("Pooling")
+one_hot = _op("one_hot")
+pick = _op("pick")
+topk = _op("topk")
+embedding = _op("Embedding")
+gather_nd = _op("gather_nd")
+rnn = _op("RNN")
+sequence_mask = _op("SequenceMask")
+smooth_l1 = _op("smooth_l1")
+gelu = _op("gelu")
+leaky_relu = _op("leaky_relu")
+
+
+def reshape_like(lhs, rhs):
+    return invoke("reshape_like", lhs, rhs)
+
+
+def waitall():
+    from .. import ndarray as nd
+    nd.waitall()
+
+
+def load(fname):
+    from .. import ndarray as nd
+    return nd.load(fname)
+
+
+def save(fname, data):
+    from .. import ndarray as nd
+    return nd.save(fname, data)
+
+
+def set_np_shape(active=True):
+    return active
+
+
+class cpu:  # noqa: N801 — reference exposes npx.cpu()/npx.gpu()
+    def __new__(cls, device_id=0):
+        from ..context import cpu as _cpu
+        return _cpu(device_id)
+
+
+class gpu:  # noqa: N801
+    def __new__(cls, device_id=0):
+        from ..context import gpu as _gpu
+        return _gpu(device_id)
+
+
+def num_gpus():
+    from ..context import num_gpus as _n
+    return _n()

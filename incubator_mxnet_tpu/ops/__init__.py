@@ -1,0 +1,25 @@
+"""Operator library: registry + op definitions lowering to XLA/Pallas.
+
+TPU-native counterpart of the reference's ``src/operator`` (~200 kLoC of
+C++/CUDA kernels behind an NNVM registry — SURVEY.md §2.1).  Here each op
+is a pure JAX function registered with metadata (name, aliases,
+differentiability); "FCompute" becomes "emit XLA" and the backward pass is
+derived with ``jax.vjp`` instead of hand-registered FGradient nodes.
+"""
+from .registry import (
+    Op,
+    register,
+    get_op,
+    list_ops,
+    invoke,
+)
+from . import elemwise  # noqa: F401  (registration side effects)
+from . import reduce_ops  # noqa: F401
+from . import shape_ops  # noqa: F401
+from . import index_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import linalg_ops  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import sequence_ops  # noqa: F401
+from . import control_flow  # noqa: F401
+from . import sort_ops  # noqa: F401

@@ -1,0 +1,95 @@
+"""Control-flow ops: foreach / while_loop / cond.
+
+Reference: src/operator/control_flow.cc (``_foreach`` :63, ``_while_loop``
+:525-825, ``_cond``) runs subgraphs imperatively through LoopState.  On
+TPU these lower directly to ``lax.scan`` / ``lax.while_loop`` /
+``lax.cond`` so the whole loop compiles into one XLA computation —
+data-dependent Python loops would break jit tracing (SURVEY.md §7).
+
+These take *callables* over NDArrays, so they are not registry ops; they
+work both eagerly and under hybridize tracing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _to_raw(tree):
+    from ..ndarray import NDArray
+
+    return jax.tree_util.tree_map(
+        lambda x: x.data if isinstance(x, NDArray) else x, tree,
+        is_leaf=lambda x: isinstance(x, NDArray))
+
+
+def _wrap(tree):
+    from ..ndarray import NDArray
+
+    return jax.tree_util.tree_map(lambda x: NDArray(x), tree)
+
+
+def foreach(body, data, init_states):
+    """Scan `body(step_data, states) -> (out, new_states)` over axis 0.
+
+    Reference semantics of ``mx.nd.contrib.foreach`` (control_flow.cc:63).
+    """
+    raw_data = _to_raw(data)
+    raw_states = _to_raw(init_states)
+
+    def step(states, x):
+        out, new_states = body(_wrap(x), _wrap(states))
+        return _to_raw(new_states), _to_raw(out)
+
+    final_states, outs = lax.scan(step, raw_states, raw_data)
+    return _wrap(outs), _wrap(final_states)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, max_iterations=None):
+    """``mx.nd.contrib.while_loop`` → lax.while_loop with iteration cap.
+
+    The reference caps iterations via max_iterations and stacks per-step
+    outputs; we keep the carried-state portion (step outputs require
+    static shapes under XLA — use ``foreach`` for scan-style output
+    collection).
+    """
+    raw = _to_raw(loop_vars)
+    if max_iterations is None:
+        def c(state):
+            return jnp.asarray(cond_fn(*_wrap(state)).data
+                               if hasattr(cond_fn(*_wrap(state)), "data")
+                               else cond_fn(*_wrap(state))).reshape(())
+
+        def b(state):
+            return _to_raw(body_fn(*_wrap(state)))
+
+        out = lax.while_loop(lambda s: jnp.bool_(c(s)), b, tuple(raw))
+        return _wrap(out)
+
+    def c2(carry):
+        i, state = carry
+        pred = cond_fn(*_wrap(state))
+        pred = pred.data if hasattr(pred, "data") else pred
+        return jnp.logical_and(i < max_iterations, jnp.asarray(pred).reshape(()).astype(bool))
+
+    def b2(carry):
+        i, state = carry
+        return i + 1, _to_raw(body_fn(*_wrap(state)))
+
+    _, out = lax.while_loop(c2, b2, (jnp.asarray(0), tuple(raw)))
+    return _wrap(out)
+
+
+def cond(pred, then_func, else_func, inputs=()):
+    """``mx.nd.contrib.cond`` → lax.cond (both branches traced)."""
+    p = pred.data if hasattr(pred, "data") else pred
+    raw = _to_raw(tuple(inputs))
+    out = lax.cond(
+        jnp.asarray(p).reshape(()).astype(bool),
+        lambda xs: _to_raw(then_func(*_wrap(xs))),
+        lambda xs: _to_raw(else_func(*_wrap(xs))),
+        raw)
+    return _wrap(out)

@@ -1,0 +1,171 @@
+"""Elementwise binary/unary operators.
+
+Covers the reference's ``src/operator/tensor/elemwise_*`` and
+``mshadow_op.h`` families (SURVEY.md §2.1 "tensor ops", 36,944 LoC of
+C++/CUDA) as jnp/lax one-liners: XLA generates and fuses the kernels that
+the reference hand-wrote or expression-templated via mshadow.
+Broadcasting follows NumPy rules, which subsumes the reference's split
+``elemwise_*`` (same-shape) and ``broadcast_*`` op families — both names
+are registered for compatibility.
+"""
+import jax
+import jax.numpy as jnp
+from jax import nn as jnn
+from jax.scipy import special as jsp
+
+from .registry import register
+
+
+def _binary(name, fn, aliases=()):
+    register(name, num_inputs=2, aliases=aliases)(fn)
+
+
+_binary("add", lambda a, b: jnp.add(a, b), aliases=("elemwise_add", "broadcast_add", "broadcast_plus", "_plus"))
+_binary("subtract", lambda a, b: jnp.subtract(a, b), aliases=("elemwise_sub", "broadcast_sub", "broadcast_minus", "_minus"))
+_binary("multiply", lambda a, b: jnp.multiply(a, b), aliases=("elemwise_mul", "broadcast_mul", "_mul"))
+_binary("divide", lambda a, b: jnp.divide(a, b), aliases=("elemwise_div", "broadcast_div", "_div"))
+_binary("floor_divide", lambda a, b: jnp.floor_divide(a, b))
+_binary("mod", lambda a, b: jnp.mod(a, b), aliases=("broadcast_mod",))
+_binary("power", lambda a, b: jnp.power(a, b), aliases=("broadcast_power", "_power"))
+_binary("maximum", lambda a, b: jnp.maximum(a, b), aliases=("broadcast_maximum", "_maximum"))
+_binary("minimum", lambda a, b: jnp.minimum(a, b), aliases=("broadcast_minimum", "_minimum"))
+_binary("hypot", lambda a, b: jnp.hypot(a, b), aliases=("broadcast_hypot",))
+_binary("arctan2", lambda a, b: jnp.arctan2(a, b))
+
+
+def _cmp(name, fn, aliases=()):
+    register(name, num_inputs=2, differentiable=False, aliases=aliases)(fn)
+
+
+_cmp("equal", lambda a, b: jnp.equal(a, b).astype(jnp.result_type(a)), aliases=("broadcast_equal",))
+_cmp("not_equal", lambda a, b: jnp.not_equal(a, b).astype(jnp.result_type(a)), aliases=("broadcast_not_equal",))
+_cmp("greater", lambda a, b: jnp.greater(a, b).astype(jnp.result_type(a)), aliases=("broadcast_greater",))
+_cmp("greater_equal", lambda a, b: jnp.greater_equal(a, b).astype(jnp.result_type(a)), aliases=("broadcast_greater_equal",))
+_cmp("lesser", lambda a, b: jnp.less(a, b).astype(jnp.result_type(a)), aliases=("broadcast_lesser",))
+_cmp("lesser_equal", lambda a, b: jnp.less_equal(a, b).astype(jnp.result_type(a)), aliases=("broadcast_lesser_equal",))
+_cmp("logical_and", lambda a, b: jnp.logical_and(a, b).astype(jnp.result_type(a)), aliases=("broadcast_logical_and",))
+_cmp("logical_or", lambda a, b: jnp.logical_or(a, b).astype(jnp.result_type(a)), aliases=("broadcast_logical_or",))
+_cmp("logical_xor", lambda a, b: jnp.logical_xor(a, b).astype(jnp.result_type(a)), aliases=("broadcast_logical_xor",))
+
+
+def _unary(name, fn, aliases=(), differentiable=True):
+    register(name, num_inputs=1, aliases=aliases, differentiable=differentiable)(fn)
+
+
+_unary("negative", jnp.negative, aliases=("_np_negative",))
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("floor", jnp.floor)
+_unary("ceil", jnp.ceil)
+_unary("round", jnp.round)
+_unary("rint", jnp.rint)
+_unary("trunc", jnp.trunc)
+_unary("fix", jnp.trunc)
+_unary("exp", jnp.exp)
+_unary("expm1", jnp.expm1)
+_unary("log", jnp.log)
+_unary("log1p", jnp.log1p)
+_unary("log2", jnp.log2)
+_unary("log10", jnp.log10)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("square", jnp.square)
+_unary("reciprocal", lambda x: 1.0 / x)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("erf", jsp.erf)
+_unary("erfinv", jsp.erfinv)
+_unary("gamma", lambda x: jnp.exp(jsp.gammaln(x)))
+_unary("gammaln", jsp.gammaln)
+_unary("digamma", jsp.digamma)
+_unary("relu", jnn.relu)
+_unary("sigmoid", jnn.sigmoid)
+_unary("softsign", jnn.soft_sign)
+_unary("softplus", jnn.softplus, aliases=("softrelu",))
+_unary("gelu", lambda x: jnn.gelu(x, approximate=False))
+_unary("gelu_tanh", lambda x: jnn.gelu(x, approximate=True))
+_unary("silu", jnn.silu, aliases=("swish",))
+_unary("mish", lambda x: x * jnp.tanh(jnn.softplus(x)))
+_unary("hard_sigmoid", lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0))
+_unary("isnan", lambda x: jnp.isnan(x), differentiable=False)
+_unary("isinf", lambda x: jnp.isinf(x), differentiable=False)
+_unary("isfinite", lambda x: jnp.isfinite(x), differentiable=False)
+_unary("logical_not", lambda x: jnp.logical_not(x).astype(jnp.result_type(x)),
+       differentiable=False)
+_unary("stop_gradient", jax.lax.stop_gradient, aliases=("BlockGrad", "block_grad"))
+_unary("identity", lambda x: x + 0, aliases=("_copy",))
+_unary("zeros_like", jnp.zeros_like, differentiable=False)
+_unary("ones_like", jnp.ones_like, differentiable=False)
+_unary("nan_to_num", jnp.nan_to_num)
+
+
+@register("leaky_relu", num_inputs=1)
+def leaky_relu(x, slope=0.25):
+    return jnn.leaky_relu(x, negative_slope=slope)
+
+
+@register("elu", num_inputs=1)
+def elu(x, alpha=1.0):
+    return jnn.elu(x, alpha=alpha)
+
+
+@register("selu", num_inputs=1)
+def selu(x):
+    return jnn.selu(x)
+
+
+@register("prelu", num_inputs=2)
+def prelu(x, gamma):
+    # gamma broadcasts over channel dim 1 (reference LeakyReLU act_type='prelu')
+    shape = [1] * x.ndim
+    if x.ndim > 1:
+        shape[1] = -1
+    g = gamma.reshape(shape) if gamma.ndim == 1 else gamma
+    return jnp.where(x >= 0, x, g * x)
+
+
+@register("hard_swish", num_inputs=1)
+def hard_swish(x):
+    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+@register("clip", num_inputs=1)
+def clip(x, a_min=None, a_max=None):
+    return jnp.clip(x, a_min, a_max)
+
+
+@register("where", num_inputs=3)
+def where(cond, a, b):
+    return jnp.where(cond.astype(bool) if cond.dtype != jnp.bool_ else cond, a, b)
+
+
+@register("cast", num_inputs=1, aliases=("Cast",))
+def cast(x, dtype="float32"):
+    from ..base import dtype_from_any
+    return x.astype(dtype_from_any(dtype))
+
+
+@register("smooth_l1", num_inputs=1)
+def smooth_l1(x, scalar=1.0):
+    s2 = scalar * scalar
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2)
+
+
+@register("lerp", num_inputs=3)
+def lerp(a, b, t):
+    return a + (b - a) * t

@@ -1,0 +1,94 @@
+"""Indexing / gather / scatter ops (reference src/operator/tensor/indexing_op*)."""
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("take", num_inputs=2)
+def take(x, indices, axis=0, mode="clip"):
+    return jnp.take(x, indices.astype(jnp.int32), axis=axis, mode=mode)
+
+
+@register("Embedding", num_inputs=2, aliases=("embedding",))
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False):
+    """Embedding lookup (reference src/operator/tensor/indexing_op.h Embedding).
+
+    On TPU this is a gather from an HBM-resident table; XLA lowers it to a
+    dynamic-gather that the reference implemented as AddTakeGrad kernels.
+    """
+    return jnp.take(weight, data.astype(jnp.int32), axis=0, mode="clip")
+
+
+@register("one_hot", num_inputs=1, differentiable=False)
+def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..base import dtype_from_any
+    dt = dtype_from_any(dtype)
+    eye = jnp.equal(
+        indices.astype(jnp.int32)[..., None],
+        jnp.arange(depth, dtype=jnp.int32))
+    return jnp.where(eye, jnp.asarray(on_value, dt), jnp.asarray(off_value, dt))
+
+
+@register("gather_nd", num_inputs=2)
+def gather_nd(data, indices):
+    """Reference semantics: indices[0..M-1] index the first M dims of data."""
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return data[idx]
+
+
+@register("scatter_nd", num_inputs=2)
+def scatter_nd(data, indices, shape=None):
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return jnp.zeros(shape, data.dtype).at[idx].set(data)
+
+
+@register("index_add_nd", num_inputs=3, aliases=("_scatter_set_nd",))
+def index_add_nd(base, indices, updates):
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return base.at[idx].add(updates)
+
+
+@register("pick", num_inputs=2)
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis)
+    out = jnp.take_along_axis(data, idx, axis=axis, mode=mode)
+    if not keepdims:
+        out = jnp.squeeze(out, axis)
+    return out
+
+
+@register("take_along_axis", num_inputs=2)
+def take_along_axis(data, indices, axis=0):
+    return jnp.take_along_axis(data, indices.astype(jnp.int32), axis=axis)
+
+
+@register("where_index", num_inputs=1, differentiable=False)
+def where_index(cond, size=None, fill_value=-1):
+    """Static-shape nonzero: returns `size` indices padded with fill_value.
+
+    TPU-first replacement for dynamic-shape np.where(cond): the output
+    length must be static under XLA, so callers pass an upper bound.
+    """
+    flat = cond.reshape(-1).astype(bool)
+    n = flat.shape[0] if size is None else size
+    idx = jnp.nonzero(flat, size=n, fill_value=fill_value)[0]
+    return idx.astype(jnp.int32)
+
+
+@register("masked_fill", num_inputs=2)
+def masked_fill(data, mask, value=0.0):
+    return jnp.where(mask.astype(bool), jnp.asarray(value, data.dtype), data)
+
+
+@register("index_copy", num_inputs=3)
+def index_copy(base, index, updates):
+    return base.at[index.astype(jnp.int32)].set(updates)
+
+
+@register("index_array", num_inputs=1, differentiable=False)
+def index_array(x, axes=None):
+    shape = x.shape
+    axes = axes or tuple(range(len(shape)))
+    grids = jnp.meshgrid(*[jnp.arange(shape[a]) for a in axes], indexing="ij")
+    return jnp.stack(grids, axis=-1).astype(jnp.int64 if False else jnp.int32)

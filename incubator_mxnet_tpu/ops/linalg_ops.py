@@ -1,0 +1,142 @@
+"""Linear algebra ops (reference src/operator/tensor/dot-inl.h, la_op.h)."""
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("dot", num_inputs=2)
+def dot(a, b, transpose_a=False, transpose_b=False):
+    """Reference dot semantics (tensor/dot-inl.h): contract last axis of a
+    with first axis of b (2-D case = matmul).  MXU-bound via dot_general."""
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+    if transpose_b:
+        b = jnp.swapaxes(b, 0, 1) if b.ndim >= 2 else b
+    if a.ndim == 0 or b.ndim == 0:
+        return a * b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot", num_inputs=2)
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("matmul", num_inputs=2)
+def matmul(a, b):
+    return jnp.matmul(a, b)
+
+
+@register("einsum")
+def einsum(*operands, subscripts=None, optimize=False):
+    return jnp.einsum(subscripts, *operands)
+
+
+@register("linalg_gemm2", num_inputs=2)
+def linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_gemm", num_inputs=3)
+def linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b) + beta * c
+
+
+@register("linalg_potrf", num_inputs=1)
+def linalg_potrf(a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("linalg_trsm", num_inputs=2)
+def linalg_trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    from jax.scipy.linalg import solve_triangular
+    if rightside:
+        x = solve_triangular(a, jnp.swapaxes(alpha * b, -1, -2),
+                             trans=0 if not transpose else 1, lower=lower)
+        return jnp.swapaxes(x, -1, -2)
+    return solve_triangular(a, alpha * b, trans=0 if not transpose else 1,
+                            lower=lower)
+
+
+@register("linalg_sumlogdiag", num_inputs=1)
+def linalg_sumlogdiag(a):
+    return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_syrk", num_inputs=1)
+def linalg_syrk(a, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+
+@register("linalg_extractdiag", num_inputs=1)
+def linalg_extractdiag(a, offset=0):
+    return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag", num_inputs=1)
+def linalg_makediag(a, offset=0):
+    n = a.shape[-1] + abs(offset)
+    eye = jnp.eye(n, k=offset, dtype=a.dtype)
+    return a[..., None] * eye[-a.shape[-1]:, :] if offset >= 0 else a[..., None] * eye[:a.shape[-1], :]
+
+
+@register("linalg_inverse", num_inputs=1, aliases=("inverse",))
+def linalg_inverse(a):
+    return jnp.linalg.inv(a)
+
+
+@register("linalg_det", num_inputs=1, aliases=("det",))
+def linalg_det(a):
+    return jnp.linalg.det(a)
+
+
+@register("linalg_slogdet", num_inputs=1, aliases=("slogdet",))
+def linalg_slogdet(a):
+    sign, logdet = jnp.linalg.slogdet(a)
+    return sign, logdet
+
+
+@register("linalg_svd", num_inputs=1, differentiable=False)
+def linalg_svd(a):
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    return u, s, vt
+
+
+@register("linalg_maketrian", num_inputs=1)
+def linalg_maketrian(a, offset=0, lower=True):
+    n = a.shape[-1]
+    # inverse of extracting a triangle into packed form: approximate parity
+    k = int((((8 * n + 1) ** 0.5) - 1) / 2)
+    out = jnp.zeros(a.shape[:-1] + (k, k), a.dtype)
+    idx = jnp.tril_indices(k) if lower else jnp.triu_indices(k)
+    return out.at[..., idx[0], idx[1]].set(a)
+
+
+@register("khatri_rao")
+def khatri_rao(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[-1])
+    return out
+
+
+@register("moments", num_inputs=1)
+def moments(x, axes=None, keepdims=False):
+    mean = jnp.mean(x, axis=tuple(axes) if axes else None, keepdims=keepdims)
+    var = jnp.var(x, axis=tuple(axes) if axes else None, keepdims=keepdims)
+    return mean, var

@@ -1,0 +1,332 @@
+"""Neural-network ops: conv, pooling, norm layers, softmax, dropout.
+
+TPU-native counterpart of reference ``src/operator/nn/`` (19.4 kLoC + cuDNN
+and MKL-DNN wrappers — SURVEY.md §2.1).  Every op lowers to XLA HLO
+(conv_general_dilated, reduce_window, dot_general) so the MXU does the
+FLOPs; layout is kept NCHW to match the reference's default data layout,
+with XLA free to relayout internally for the systolic array.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import nn as jnn
+
+from .registry import register
+
+
+def _pair(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    return v if len(v) == n else v * n
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected / dense
+# ---------------------------------------------------------------------------
+
+@register("FullyConnected", aliases=("fully_connected", "dense"))
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    """y = x @ W^T + b with reference layout W:(num_hidden, in_units)
+    (reference src/operator/nn/fully_connected.cc)."""
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    y = lax.dot_general(
+        x, weight,
+        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    y = y.astype(x.dtype)
+    if bias is not None and not no_bias:
+        y = y + bias
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Convolution
+# ---------------------------------------------------------------------------
+
+@register("Convolution", aliases=("conv", "convolution"))
+def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False,
+                layout=None):
+    """N-D convolution, NCHW layout, weight (O, I/group, *K).
+
+    Reference: src/operator/nn/convolution.cc.  Lowers to a single
+    conv_general_dilated — XLA's conv already does implicit im2col +
+    MXU-tiled matmul, subsuming the reference's cuDNN algo selection.
+    """
+    nd = x.ndim - 2
+    stride = _pair(stride or 1, nd)
+    dilate = _pair(dilate or 1, nd)
+    pad = _pair(pad or 0, nd)
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if nd == 2 else
+        (("NCW", "OIW", "NCW") if nd == 1 else ("NCDHW", "OIDHW", "NCDHW")))
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    y = y.astype(x.dtype)
+    if bias is not None and not no_bias:
+        y = y + bias.reshape((1, -1) + (1,) * nd)
+    return y
+
+
+@register("Deconvolution", aliases=("deconvolution",))
+def deconvolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
+                  pad=None, adj=None, num_filter=None, num_group=1,
+                  no_bias=True, layout=None):
+    """Transposed convolution (reference src/operator/nn/deconvolution.cc)."""
+    nd = x.ndim - 2
+    stride = _pair(stride or 1, nd)
+    pad = _pair(pad or 0, nd)
+    dilate = _pair(dilate or 1, nd)
+    adj = _pair(adj or 0, nd)
+    kernel = weight.shape[2:]
+    # conv_transpose with IOHW kernel: weight layout (in, out/group, *K)
+    pads = []
+    for k, s, p, a, d in zip(kernel, stride, pad, adj, dilate):
+        eff_k = (k - 1) * d + 1
+        pads.append((eff_k - 1 - p, eff_k - 1 - p + a))
+    y = lax.conv_transpose(
+        x, weight, strides=stride, padding=pads,
+        rhs_dilation=dilate,
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, weight.shape,
+            ("NCHW", "IOHW", "NCHW") if nd == 2 else
+            (("NCW", "IOW", "NCW") if nd == 1 else ("NCDHW", "IODHW", "NCDHW"))),
+        transpose_kernel=True)
+    y = y.astype(x.dtype)
+    if bias is not None and not no_bias:
+        y = y + bias.reshape((1, -1) + (1,) * nd)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+@register("Pooling", aliases=("pooling",))
+def pooling(x, kernel=None, pool_type="max", global_pool=False, stride=None,
+            pad=None, count_include_pad=True, pooling_convention="valid"):
+    """Max/avg/sum/lp pooling via reduce_window (reference nn/pooling.cc)."""
+    nd = x.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, x.ndim))
+        if pool_type == "max":
+            out = jnp.max(x, axis=axes, keepdims=True)
+        else:
+            out = jnp.mean(x, axis=axes, keepdims=True)
+        return out
+    kernel = _pair(kernel, nd)
+    stride = _pair(stride or kernel, nd)
+    pad = _pair(pad or 0, nd)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, pads)
+    summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+    if pool_type == "sum":
+        return summed
+    if count_include_pad or all(p == 0 for p in pad):
+        denom = 1.0
+        for k in kernel:
+            denom *= k
+        return summed / denom
+    ones = jnp.ones_like(x)
+    counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+    return summed / counts
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+@register("BatchNorm", aliases=("batch_norm",))
+def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
+               momentum=0.9, fix_gamma=False, use_global_stats=False,
+               axis=1, output_mean_var=False, training=False):
+    """BatchNorm (reference src/operator/nn/batch_norm.cc).
+
+    Pure function: in training mode returns (out, new_moving_mean,
+    new_moving_var); the stateful moving-average update is applied by the
+    gluon layer (reference mutates aux states in place).
+    """
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+    bshape = [1] * x.ndim
+    bshape[axis % x.ndim] = x.shape[axis % x.ndim]
+    bshape = tuple(bshape)
+    if training and not use_global_stats:
+        mean = jnp.mean(x, axis=reduce_axes)
+        var = jnp.var(x, axis=reduce_axes)
+        new_mean = momentum * moving_mean + (1 - momentum) * mean
+        new_var = momentum * moving_var + (1 - momentum) * var
+        x_hat = (x - mean.reshape(bshape)) * lax.rsqrt(var.reshape(bshape) + eps)
+        out = x_hat * gamma.reshape(bshape) + beta.reshape(bshape)
+        return out, new_mean, new_var
+    x_hat = (x - moving_mean.reshape(bshape)) * lax.rsqrt(
+        moving_var.reshape(bshape) + eps)
+    return x_hat * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("LayerNorm", aliases=("layer_norm",))
+def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
+    """LayerNorm (reference src/operator/nn/layer_norm.cc) — a single fused
+    XLA subgraph (mean/var/normalize fuse into one kernel on TPU)."""
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    x_hat = (x - mean) * lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[axis % x.ndim] = x.shape[axis % x.ndim]
+    return x_hat * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("GroupNorm", aliases=("group_norm",))
+def group_norm(x, gamma, beta, num_groups=1, eps=1e-5):
+    n, c = x.shape[:2]
+    g = num_groups
+    y = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, y.ndim))
+    mean = jnp.mean(y, axis=axes, keepdims=True)
+    var = jnp.var(y, axis=axes, keepdims=True)
+    y = (y - mean) * lax.rsqrt(var + eps)
+    y = y.reshape(x.shape)
+    shape = (1, c) + (1,) * (x.ndim - 2)
+    return y * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("InstanceNorm", aliases=("instance_norm",))
+def instance_norm(x, gamma, beta, eps=1e-3):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    return y * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("L2Normalization", aliases=("l2_normalization",))
+def l2_normalization(x, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, x.ndim))
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True) + eps)
+    return x / norm
+
+
+@register("RMSNorm", aliases=("rms_norm",))
+def rms_norm(x, gamma, axis=-1, eps=1e-6):
+    """TPU-era addition (not in the reference): used by the transformer stack."""
+    ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    y = (x.astype(jnp.float32) * lax.rsqrt(ms + eps)).astype(x.dtype)
+    return y * gamma
+
+
+# ---------------------------------------------------------------------------
+# Softmax family
+# ---------------------------------------------------------------------------
+
+@register("softmax")
+def softmax(x, axis=-1, temperature=None, length=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    if length is not None:
+        mask = jnp.arange(x.shape[axis]) < length[..., None]
+        x = jnp.where(mask, x, -jnp.inf)
+    return jnn.softmax(x, axis=axis)
+
+
+@register("log_softmax")
+def log_softmax(x, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jnn.log_softmax(x, axis=axis)
+
+
+@register("softmin")
+def softmin(x, axis=-1):
+    return jnn.softmax(-x, axis=axis)
+
+
+@register("SoftmaxOutput", aliases=("softmax_output",))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1,
+                   use_ignore=False, multi_output=False, normalization="null"):
+    """Forward = softmax; the symbol-API loss op (reference
+    src/operator/softmax_output.cc).  Gradient injection is handled by the
+    symbol executor which treats this as cross-entropy w.r.t. data."""
+    return jnn.softmax(data, axis=-1)
+
+
+@register("SoftmaxActivation")
+def softmax_activation(x, mode="instance"):
+    if mode == "channel":
+        return jnn.softmax(x, axis=1)
+    return jnn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# Dropout (key is an explicit input — functional PRNG)
+# ---------------------------------------------------------------------------
+
+@register("Dropout", aliases=("dropout",))
+def dropout(x, key, p=0.5, mode="training", axes=()):
+    if p <= 0.0 or mode != "training":
+        return x + 0
+    shape = list(x.shape)
+    for a in axes:
+        shape[a] = 1
+    keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+    return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+
+
+@register("Activation", aliases=("activation",))
+def activation(x, act_type="relu"):
+    fns = {"relu": jnn.relu, "sigmoid": jnn.sigmoid, "tanh": jnp.tanh,
+           "softrelu": jnn.softplus, "softsign": jnn.soft_sign,
+           "gelu": jnn.gelu, "silu": jnn.silu, "swish": jnn.silu,
+           "mish": lambda v: v * jnp.tanh(jnn.softplus(v)),
+           "log_sigmoid": jnn.log_sigmoid}
+    return fns[act_type](x)
+
+
+@register("LRN", aliases=("lrn",))
+def lrn(x, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response norm across channels (reference src/operator/nn/lrn.cc)."""
+    sq = jnp.square(x)
+    half = nsize // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half)) + ((0, 0),) * (x.ndim - 2))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(nsize))
+    return x / jnp.power(knorm + alpha * acc / nsize, beta)
+
+
+# ---------------------------------------------------------------------------
+# Attention (TPU-era: backs the transformer stack; reference has only
+# contrib BERT-era fused ops, src/operator/contrib/transformer.cc)
+# ---------------------------------------------------------------------------
+
+@register("dot_product_attention")
+def dot_product_attention(q, k, v, mask=None, scale=None, causal=False):
+    """(B, H, T, D) scaled dot-product attention as one fused XLA region."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bhtd,bhsd->bhts", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        t, s = logits.shape[-2:]
+        cm = jnp.tril(jnp.ones((t, s), bool))
+        logits = jnp.where(cm, logits, -jnp.inf)
+    if mask is not None:
+        logits = jnp.where(mask.astype(bool), logits, -jnp.inf)
+    probs = jnn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bhsd->bhtd", probs, v)

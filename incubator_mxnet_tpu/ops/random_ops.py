@@ -1,0 +1,109 @@
+"""Random sampling ops over the threefry PRNG.
+
+Reference: src/operator/random/ (3.9 kLoC of per-device sampler kernels
+over Philox/MT states).  Here each sampler is a pure function of an
+explicit key; the eager wrappers in ``ndarray.random`` draw keys from the
+global stream (see ``random.py`` for the documented seeding contract).
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import dtype_from_any
+
+
+@register("random_uniform", differentiable=False)
+def random_uniform(key, low=0.0, high=1.0, shape=(), dtype="float32"):
+    dt = dtype_from_any(dtype)
+    if jnp.issubdtype(dt, jnp.integer):
+        return jax.random.randint(key, shape, int(low), int(high), dtype=dt)
+    return jax.random.uniform(key, shape, dtype=dt, minval=low, maxval=high)
+
+
+@register("random_normal", differentiable=False)
+def random_normal(key, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    dt = dtype_from_any(dtype)
+    return loc + scale * jax.random.normal(key, shape, dtype=dt)
+
+
+@register("random_gamma", differentiable=False)
+def random_gamma(key, alpha=1.0, beta=1.0, shape=(), dtype="float32"):
+    dt = dtype_from_any(dtype)
+    return jax.random.gamma(key, alpha, shape, dtype=dt) * beta
+
+
+@register("random_exponential", differentiable=False)
+def random_exponential(key, lam=1.0, shape=(), dtype="float32"):
+    dt = dtype_from_any(dtype)
+    return jax.random.exponential(key, shape, dtype=dt) / lam
+
+
+@register("random_poisson", differentiable=False)
+def random_poisson(key, lam=1.0, shape=(), dtype="float32"):
+    dt = dtype_from_any(dtype)
+    return jax.random.poisson(key, lam, shape).astype(dt)
+
+
+@register("random_negative_binomial", differentiable=False)
+def random_negative_binomial(key, k=1, p=1.0, shape=(), dtype="float32"):
+    dt = dtype_from_any(dtype)
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape).astype(dt)
+
+
+@register("random_randint", differentiable=False)
+def random_randint(key, low=0, high=None, shape=(), dtype="int32"):
+    dt = dtype_from_any(dtype)
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(key, shape, int(low), int(high), dtype=dt)
+
+
+@register("random_bernoulli", differentiable=False)
+def random_bernoulli(key, p=0.5, shape=(), dtype="float32"):
+    dt = dtype_from_any(dtype)
+    return jax.random.bernoulli(key, p, shape).astype(dt)
+
+
+@register("sample_multinomial", num_inputs=2, differentiable=False)
+def sample_multinomial(data, key, shape=(), get_prob=False):
+    """Categorical sampling over last-axis probabilities (reference
+    src/operator/random/sample_multinomial_op.h)."""
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    n = 1
+    for s in (shape if isinstance(shape, tuple) else (shape,)):
+        n *= s if s else 1
+    out_shape = data.shape[:-1] + (tuple(shape) if shape else ())
+    samples = jax.random.categorical(
+        key, logits[..., None, :].repeat(max(n, 1), axis=-2), axis=-1)
+    samples = samples.reshape(out_shape if shape else data.shape[:-1])
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jnp.log(jnp.maximum(data, 1e-37)),
+            samples.reshape(data.shape[:-1] + (-1,)).astype(jnp.int32),
+            axis=-1).reshape(samples.shape)
+        return samples.astype(jnp.int32), lp
+    return samples.astype(jnp.int32)
+
+
+@register("shuffle", num_inputs=2, differentiable=False)
+def shuffle(data, key):
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("random_permutation", differentiable=False)
+def random_permutation(key, n=1, dtype="int32"):
+    return jax.random.permutation(key, n).astype(dtype_from_any(dtype))
+
+
+@register("gumbel_softmax", num_inputs=2)
+def gumbel_softmax(logits, key, tau=1.0, hard=False):
+    g = jax.random.gumbel(key, logits.shape, logits.dtype)
+    y = jax.nn.softmax((logits + g) / tau, axis=-1)
+    if hard:
+        idx = jnp.argmax(y, axis=-1, keepdims=True)
+        y_hard = jnp.zeros_like(y).at[
+            tuple(jnp.indices(idx.shape[:-1])) + (idx[..., 0],)].set(1.0)
+        y = y_hard + jax.lax.stop_gradient(-y) + y
+    return y

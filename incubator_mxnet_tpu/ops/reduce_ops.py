@@ -1,0 +1,119 @@
+"""Reductions (reference src/operator/tensor/broadcast_reduce_op* family)."""
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _axis(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+@register("sum", num_inputs=1, aliases=("sum_axis",))
+def sum_(x, axis=None, keepdims=False, exclude=False):
+    axis = _exclude(x, axis, exclude)
+    return jnp.sum(x, axis=axis, keepdims=keepdims)
+
+
+@register("mean", num_inputs=1)
+def mean(x, axis=None, keepdims=False, exclude=False):
+    axis = _exclude(x, axis, exclude)
+    return jnp.mean(x, axis=axis, keepdims=keepdims)
+
+
+@register("prod", num_inputs=1)
+def prod(x, axis=None, keepdims=False, exclude=False):
+    axis = _exclude(x, axis, exclude)
+    return jnp.prod(x, axis=axis, keepdims=keepdims)
+
+
+@register("max", num_inputs=1, aliases=("max_axis",))
+def max_(x, axis=None, keepdims=False, exclude=False):
+    axis = _exclude(x, axis, exclude)
+    return jnp.max(x, axis=axis, keepdims=keepdims)
+
+
+@register("min", num_inputs=1, aliases=("min_axis",))
+def min_(x, axis=None, keepdims=False, exclude=False):
+    axis = _exclude(x, axis, exclude)
+    return jnp.min(x, axis=axis, keepdims=keepdims)
+
+
+@register("nansum", num_inputs=1)
+def nansum(x, axis=None, keepdims=False):
+    return jnp.nansum(x, axis=_axis(axis), keepdims=keepdims)
+
+
+@register("nanprod", num_inputs=1)
+def nanprod(x, axis=None, keepdims=False):
+    return jnp.nanprod(x, axis=_axis(axis), keepdims=keepdims)
+
+
+@register("argmax", num_inputs=1, differentiable=False)
+def argmax(x, axis=None, keepdims=False):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdims).astype(jnp.float32)
+    return out
+
+
+@register("argmin", num_inputs=1, differentiable=False)
+def argmin(x, axis=None, keepdims=False):
+    return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register("norm", num_inputs=1)
+def norm(x, ord=2, axis=None, keepdims=False):
+    if axis is None:
+        x2 = x.reshape(-1)
+        return jnp.linalg.norm(x2, ord=ord, keepdims=False).reshape(
+            (1,) * (x.ndim if keepdims else 0) or (1,))[0 if not keepdims else ...]
+    return jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdims)
+
+
+@register("logsumexp", num_inputs=1)
+def logsumexp(x, axis=None, keepdims=False):
+    from jax.scipy.special import logsumexp as lse
+    return lse(x, axis=_axis(axis), keepdims=keepdims)
+
+
+@register("cumsum", num_inputs=1)
+def cumsum(x, axis=None, dtype=None):
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+@register("cumprod", num_inputs=1)
+def cumprod(x, axis=None, dtype=None):
+    return jnp.cumprod(x, axis=axis, dtype=dtype)
+
+
+@register("all", num_inputs=1, differentiable=False)
+def all_(x, axis=None, keepdims=False):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdims)
+
+
+@register("any", num_inputs=1, differentiable=False)
+def any_(x, axis=None, keepdims=False):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdims)
+
+
+@register("var", num_inputs=1)
+def var(x, axis=None, ddof=0, keepdims=False):
+    return jnp.var(x, axis=_axis(axis), ddof=ddof, keepdims=keepdims)
+
+
+@register("std", num_inputs=1)
+def std(x, axis=None, ddof=0, keepdims=False):
+    return jnp.std(x, axis=_axis(axis), ddof=ddof, keepdims=keepdims)
+
+
+def _exclude(x, axis, exclude):
+    """Reference reduce ops support exclude=True → reduce all BUT axis."""
+    axis = _axis(axis)
+    if not exclude:
+        return axis
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % x.ndim for a in axis)
+    return tuple(i for i in range(x.ndim) if i not in axis)

@@ -1,0 +1,131 @@
+"""Op registry and the eager invoke path.
+
+Re-designs the reference's NNVM op registry + imperative invoke
+(575 ``NNVM_REGISTER_OP`` sites, include/mxnet/op_attr_types.h:125-332;
+``Imperative::Invoke`` src/imperative/imperative.cc:49-130) for XLA:
+
+* An ``Op`` is a *pure JAX function* plus metadata.  Shape/dtype inference
+  (reference FInferShape/FInferType) falls out of JAX abstract evaluation,
+  so there are no per-op inference functions to register.
+* Eager execution wraps the function in ``jax.jit`` per static-kwarg
+  signature — the analog of the reference pushing an FCompute closure to
+  the engine, except XLA fuses the op internally and PJRT makes it async.
+* When autograd is recording, the forward runs under ``jax.vjp`` and the
+  residual-holding vjp closure is stored on the tape (the analog of
+  FGradient + the autograd graph in imperative.cc:204 RecordOp).
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+import jax
+
+__all__ = ["Op", "register", "get_op", "list_ops", "invoke"]
+
+_OPS: dict[str, "Op"] = {}
+_lock = threading.Lock()
+
+
+def _hashable(v):
+    if isinstance(v, list):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v
+
+
+class Op:
+    """A registered operator.
+
+    Parameters
+    ----------
+    name : canonical op name (reference op names kept where sensible)
+    fn : pure function ``fn(*arrays, **static_params) -> array | tuple``
+    differentiable : False for integer/discrete outputs (argmax, one_hot...)
+    num_inputs : informational; varargs ops pass -1
+    """
+
+    def __init__(self, name, fn, differentiable=True, num_inputs=-1, aliases=()):
+        self.name = name
+        self.fn = fn
+        self.differentiable = differentiable
+        self.num_inputs = num_inputs
+        self.aliases = tuple(aliases)
+        self._jit_cache: dict = {}
+
+    def jitted(self, kwarg_names: tuple):
+        jfn = self._jit_cache.get(kwarg_names)
+        if jfn is None:
+            jfn = jax.jit(self.fn, static_argnames=kwarg_names)
+            self._jit_cache[kwarg_names] = jfn
+        return jfn
+
+    def __call__(self, *arrays, **kwargs):
+        """Raw call on jax arrays (no NDArray wrapping, no autograd)."""
+        kwargs = {k: _hashable(v) for k, v in kwargs.items()}
+        return self.jitted(tuple(sorted(kwargs)))(*arrays, **kwargs)
+
+    def __repr__(self):
+        return f"Op({self.name})"
+
+
+def register(name, differentiable=True, num_inputs=-1, aliases=()):
+    """Decorator: register a pure JAX function as an operator."""
+
+    def deco(fn):
+        op = Op(name, fn, differentiable=differentiable,
+                num_inputs=num_inputs, aliases=aliases)
+        with _lock:
+            _OPS[name] = op
+            for a in aliases:
+                _OPS[a] = op
+        return op
+
+    return deco
+
+
+def get_op(name: str) -> Op:
+    try:
+        return _OPS[name]
+    except KeyError:
+        raise KeyError(f"operator {name!r} is not registered") from None
+
+
+def list_ops():
+    return sorted(set(_OPS))
+
+
+def invoke(op: "Op | str", *inputs, out=None, **kwargs):
+    """Execute an op on NDArrays with autograd integration.
+
+    The eager path of the framework — counterpart of
+    ``Imperative::Invoke`` (reference src/imperative/imperative.cc:98).
+    """
+    from .. import autograd
+    from ..ndarray import NDArray, _wrap_outputs
+
+    if isinstance(op, str):
+        op = get_op(op)
+    raw = [x.data if isinstance(x, NDArray) else x for x in inputs]
+    kwargs = {k: _hashable(v) for k, v in kwargs.items()}
+
+    recording = autograd.is_recording()
+    need_grad = (
+        recording
+        and op.differentiable
+        and any(isinstance(x, NDArray) and x._in_graph() for x in inputs)
+    )
+    if need_grad:
+        fn = functools.partial(op.fn, **kwargs)
+        out_data, vjp_fn = jax.vjp(fn, *raw)
+    else:
+        out_data = op.jitted(tuple(sorted(kwargs)))(*raw, **kwargs)
+        vjp_fn = None
+
+    outputs = _wrap_outputs(out_data, inputs, out=out)
+    if need_grad:
+        nd_inputs = [x for x in inputs if isinstance(x, NDArray)]
+        input_slots = [i for i, x in enumerate(inputs) if isinstance(x, NDArray)]
+        autograd._record(op, vjp_fn, inputs, nd_inputs, input_slots, outputs)
+    return outputs

@@ -1,0 +1,192 @@
+"""Sequence ops (reference src/operator/sequence_*.cc) + fused RNN.
+
+The fused RNN op is the TPU re-design of the reference's cuDNN-backed
+``RNN`` operator (src/operator/rnn-inl.h): a ``lax.scan`` over time steps
+whose body is a fused matmul cell — XLA pipelines the scan on-chip, which
+is the TPU analog of cuDNN's persistent RNN kernels (BASELINE config 5).
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import nn as jnn
+
+from .registry import register
+
+
+@register("SequenceMask", num_inputs=2, aliases=("sequence_mask",))
+def sequence_mask(data, sequence_length, use_sequence_length=True, value=0.0,
+                  axis=0):
+    """Zero out steps beyond each sequence's length; time axis = `axis`."""
+    if not use_sequence_length:
+        return data + 0
+    steps = jnp.arange(data.shape[axis])
+    # mask shape: broadcast (T, B) against data (T, B, ...) or (B, T, ...)
+    if axis == 0:
+        mask = steps[:, None] < sequence_length[None, :]
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    else:
+        mask = steps[None, :] < sequence_length[:, None]
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceLast", num_inputs=2, aliases=("sequence_last",))
+def sequence_last(data, sequence_length, use_sequence_length=True, axis=0):
+    if not use_sequence_length:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    moved = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    return jnp.take_along_axis(
+        moved, last.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0)[0]
+
+
+@register("SequenceReverse", num_inputs=2, aliases=("sequence_reverse",))
+def sequence_reverse(data, sequence_length, use_sequence_length=True, axis=0):
+    moved = jnp.moveaxis(data, axis, 0)
+    T = moved.shape[0]
+    if not use_sequence_length:
+        return jnp.moveaxis(moved[::-1], 0, axis)
+    t = jnp.arange(T)[:, None]
+    lens = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(t < lens, lens - 1 - t, t)  # reverse within length
+    out = jnp.take_along_axis(
+        moved, src.reshape(src.shape + (1,) * (moved.ndim - 2)), axis=0)
+    return jnp.moveaxis(out, 0, axis)
+
+
+# ---------------------------------------------------------------------------
+# Fused RNN via lax.scan
+# ---------------------------------------------------------------------------
+
+def _lstm_cell(x, h, c, wx, wh, b):
+    gates = x @ wx.T + h @ wh.T + b
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c_new = jnn.sigmoid(f) * c + jnn.sigmoid(i) * jnp.tanh(g)
+    h_new = jnn.sigmoid(o) * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _gru_cell(x, h, wx, wh, b):
+    """Gate order r,z,n matching the reference's cuDNN GRU (rnn_impl.h)."""
+    xw = x @ wx.T
+    hw = h @ wh.T
+    hidden = wh.shape[0] // 3
+    xr, xz, xn = jnp.split(xw + b[:3 * hidden], 3, axis=-1)
+    hr, hz, hn = jnp.split(hw + b[3 * hidden:], 3, axis=-1)
+    r = jnn.sigmoid(xr + hr)
+    z = jnn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    return (1 - z) * n + z * h
+
+
+def _rnn_cell(x, h, wx, wh, b, act):
+    y = x @ wx.T + h @ wh.T + b
+    return jnp.tanh(y) if act == "tanh" else jnn.relu(y)
+
+
+@register("RNN", aliases=("rnn",))
+def fused_rnn(data, params, state, state_cell=None, state_size=None,
+              num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+              state_outputs=True, projection_size=None):
+    """Fused multi-layer RNN: data (T, B, I) → (T, B, D*H).
+
+    Weight packing follows the reference's flat-parameter layout
+    (rnn-inl.h GetRnnParamSize): per layer & direction, [Wx, Wh, bx, bh].
+    """
+    T, B, I = data.shape
+    H = state_size
+    ngates = {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}[mode]
+    D = 2 if bidirectional else 1
+    act = "tanh" if mode != "rnn_relu" else "relu"
+
+    # unpack flat params
+    offset = 0
+
+    def take(n, shape):
+        nonlocal offset
+        w = lax.dynamic_slice(params, (offset,), (n,)).reshape(shape)
+        offset += n
+        return w
+
+    layer_ws = []
+    for layer in range(num_layers):
+        in_dim = I if layer == 0 else H * D
+        dirs = []
+        for _ in range(D):
+            wx = take(ngates * H * in_dim, (ngates * H, in_dim))
+            wh = take(ngates * H * H, (ngates * H, H))
+            dirs.append((wx, wh))
+        layer_ws.append(dirs)
+    layer_bs = []
+    for layer in range(num_layers):
+        dirs = []
+        for _ in range(D):
+            bx = take(ngates * H, (ngates * H,))
+            bh = take(ngates * H, (ngates * H,))
+            dirs.append(bx + bh if mode != "gru" else jnp.concatenate([bx, bh]))
+        layer_bs.append(dirs)
+
+    h0 = state  # (num_layers*D, B, H)
+    c0 = state_cell if mode == "lstm" else None
+    out = data
+    h_finals, c_finals = [], []
+    for layer in range(num_layers):
+        dir_outs = []
+        for d in range(D):
+            wx, wh = layer_ws[layer][d]
+            b = layer_bs[layer][d]
+            idx = layer * D + d
+            hs0 = h0[idx]
+            seq = out if d == 0 else out[::-1]
+
+            if mode == "lstm":
+                cs0 = c0[idx]
+
+                def step(carry, x):
+                    h, c = carry
+                    h2, c2 = _lstm_cell(x, h, c, wx, wh, b)
+                    return (h2, c2), h2
+
+                (hT, cT), ys = lax.scan(step, (hs0, cs0), seq)
+                c_finals.append(cT)
+            elif mode == "gru":
+                def step(h, x):
+                    h2 = _gru_cell(x, h, wx, wh, b)
+                    return h2, h2
+
+                hT, ys = lax.scan(step, hs0, seq)
+            else:
+                def step(h, x):
+                    h2 = _rnn_cell(x, h, wx, wh, b, act)
+                    return h2, h2
+
+                hT, ys = lax.scan(step, hs0, seq)
+            h_finals.append(hT)
+            dir_outs.append(ys if d == 0 else ys[::-1])
+        out = dir_outs[0] if D == 1 else jnp.concatenate(dir_outs, axis=-1)
+
+    hN = jnp.stack(h_finals)
+    if mode == "lstm":
+        cN = jnp.stack(c_finals)
+        return out, hN, cN
+    return out, hN
+
+
+@register("ctc_loss", num_inputs=4, aliases=("CTCLoss",))
+def ctc_loss(data, label, data_lengths, label_lengths, blank_label="first"):
+    """CTC loss (reference src/operator/nn/ctc_loss.cc) via optax.
+
+    data: (T, B, V) unnormalized activations; label: (B, L) int labels.
+    """
+    import optax
+    logits = jnp.moveaxis(data, 0, 1)  # (B, T, V)
+    T = logits.shape[1]
+    L = label.shape[1]
+    logit_pad = (jnp.arange(T)[None, :] >= data_lengths[:, None]).astype(jnp.float32)
+    label_pad = (jnp.arange(L)[None, :] >= label_lengths[:, None]).astype(jnp.float32)
+    blank_id = 0 if blank_label == "first" else logits.shape[-1] - 1
+    labels = label.astype(jnp.int32)
+    return optax.ctc_loss(logits, logit_pad, labels, label_pad,
+                          blank_id=blank_id)

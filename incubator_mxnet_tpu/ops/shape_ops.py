@@ -1,0 +1,185 @@
+"""Shape/layout manipulation ops (reference src/operator/tensor/matrix_op*)."""
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("reshape", num_inputs=1, aliases=("Reshape",))
+def reshape(x, shape=None):
+    return jnp.reshape(x, shape)
+
+
+@register("transpose", num_inputs=1)
+def transpose(x, axes=None):
+    return jnp.transpose(x, axes if axes else None)
+
+
+@register("swapaxes", num_inputs=1, aliases=("SwapAxis",))
+def swapaxes(x, dim1=0, dim2=1):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("expand_dims", num_inputs=1)
+def expand_dims(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@register("squeeze", num_inputs=1)
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis)
+
+
+@register("flatten", num_inputs=1, aliases=("Flatten",))
+def flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("concat", aliases=("Concat", "concatenate"))
+def concat(*xs, dim=1, axis=None):
+    return jnp.concatenate(xs, axis=dim if axis is None else axis)
+
+
+@register("stack")
+def stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+@register("split", num_inputs=1, aliases=("SliceChannel",))
+def split(x, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+@register("split_v2", num_inputs=1)
+def split_v2(x, indices_or_sections=1, axis=0, squeeze_axis=False):
+    parts = jnp.split(x, indices_or_sections, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@register("flip", num_inputs=1, aliases=("reverse",))
+def flip(x, axis=0):
+    return jnp.flip(x, axis)
+
+
+@register("tile", num_inputs=1)
+def tile(x, reps=()):
+    return jnp.tile(x, reps)
+
+
+@register("repeat", num_inputs=1)
+def repeat(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("pad", num_inputs=1, aliases=("Pad",))
+def pad(x, pad_width=None, mode="constant", constant_value=0.0):
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    kw = {"constant_values": constant_value} if mode == "constant" else {}
+    return jnp.pad(x, pad_width, mode=jmode, **kw)
+
+
+@register("broadcast_to", num_inputs=1)
+def broadcast_to(x, shape=None):
+    shape = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+@register("broadcast_like", num_inputs=2)
+def broadcast_like(x, like):
+    return jnp.broadcast_to(x, like.shape)
+
+
+@register("broadcast_axis", num_inputs=1, aliases=("broadcast_axes",))
+def broadcast_axis(x, axis=(), size=()):
+    axis = (axis,) if isinstance(axis, int) else axis
+    size = (size,) if isinstance(size, int) else size
+    shape = list(x.shape)
+    for a, s in zip(axis, size):
+        shape[a] = s
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@register("slice_axis", num_inputs=1)
+def slice_axis(x, axis=0, begin=0, end=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_op", num_inputs=1, aliases=("slice",))
+def slice_op(x, begin=(), end=(), step=None):
+    idx = tuple(slice(b, e, s) for b, e, s in
+                zip(begin, end, step or (None,) * len(begin)))
+    return x[idx]
+
+
+@register("slice_like", num_inputs=2)
+def slice_like(x, like, axes=()):
+    axes = axes or tuple(range(min(x.ndim, like.ndim)))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a] = slice(0, like.shape[a])
+    return x[tuple(idx)]
+
+
+@register("diag", num_inputs=1)
+def diag(x, k=0):
+    return jnp.diag(x, k) if x.ndim <= 2 else jnp.diagonal(x, offset=k)
+
+
+@register("depth_to_space", num_inputs=1)
+def depth_to_space(x, block_size=1):
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape(n, b, b, c // (b * b), h, w)
+    y = y.transpose(0, 3, 4, 1, 5, 2)
+    return y.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth", num_inputs=1)
+def space_to_depth(x, block_size=1):
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape(n, c, h // b, b, w // b, b)
+    y = y.transpose(0, 3, 5, 1, 2, 4)
+    return y.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("shape_array", num_inputs=1, differentiable=False)
+def shape_array(x):
+    return jnp.array(x.shape, dtype=jnp.int64 if False else jnp.int32)
+
+
+@register("size_array", num_inputs=1, differentiable=False)
+def size_array(x):
+    return jnp.array([x.size], dtype=jnp.int32)
+
+
+@register("reshape_like", num_inputs=2)
+def reshape_like(x, like):
+    return jnp.reshape(x, like.shape)
+
+
+@register("roll", num_inputs=1)
+def roll(x, shift=0, axis=None):
+    return jnp.roll(x, shift, axis)
+
+
+@register("rot90", num_inputs=1)
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+@register("tril", num_inputs=1)
+def tril(x, k=0):
+    return jnp.tril(x, k)
+
+
+@register("triu", num_inputs=1)
+def triu(x, k=0):
+    return jnp.triu(x, k)

@@ -1,0 +1,55 @@
+"""Ordering ops (reference src/operator/tensor/ordering_op*)."""
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("sort", num_inputs=1)
+def sort(x, axis=-1, is_ascend=True):
+    y = jnp.sort(x, axis=axis)
+    return y if is_ascend else jnp.flip(y, axis=axis)
+
+
+@register("argsort", num_inputs=1, differentiable=False)
+def argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    from ..base import dtype_from_any
+    y = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        y = jnp.flip(y, axis=axis)
+    return y.astype(dtype_from_any(dtype))
+
+
+@register("topk", num_inputs=1, differentiable=False)
+def topk(x, k=1, axis=-1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    from ..base import dtype_from_any
+    dt = dtype_from_any(dtype)
+    moved = jnp.moveaxis(x, axis, -1)
+    vals, idxs = lax.top_k(-moved if is_ascend else moved, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idxs = jnp.moveaxis(idxs, -1, axis)
+    if ret_typ == "indices":
+        return idxs.astype(dt)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idxs.astype(dt)
+    # mask
+    moved_mask = jnp.zeros(moved.shape, x.dtype)
+    moved_mask = moved_mask.at[
+        tuple(jnp.indices(idxs_moved_shape := (jnp.moveaxis(idxs, axis, -1)).shape)[:-1])
+        + (jnp.moveaxis(idxs, axis, -1).astype(jnp.int32),)].set(1)
+    return jnp.moveaxis(moved_mask, -1, axis)
+
+
+@register("searchsorted", num_inputs=2, differentiable=False)
+def searchsorted(a, v, side="left"):
+    return jnp.searchsorted(a, v, side=side).astype(jnp.int32)
+
+
+@register("unique", num_inputs=1, differentiable=False)
+def unique(x, size=None, fill_value=0):
+    """Static-size unique (XLA needs static shapes; callers pass bound)."""
+    return jnp.unique(x, size=size or x.size, fill_value=fill_value)

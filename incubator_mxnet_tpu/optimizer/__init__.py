@@ -1,0 +1,8 @@
+"""Optimizers (reference python/mxnet/optimizer/ — 17 algorithms)."""
+from .optimizer import (
+    Optimizer, Updater, get_updater, register, create,
+    SGD, SGLD, Signum, DCASGD, NAG, AdaGrad, AdaDelta, Adam, AdamW, Adamax,
+    Nadam, FTRL, FTML, LARS, LAMB, RMSProp, LBSGD, Test,
+)
+from . import lr_scheduler
+from .lr_scheduler import LRScheduler

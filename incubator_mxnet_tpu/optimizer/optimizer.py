@@ -1,0 +1,677 @@
+"""Optimizer algorithms.
+
+TPU-native counterpart of the reference optimizer suite
+(python/mxnet/optimizer/, 3.5 kLoC + fused C++/CUDA update kernels in
+src/operator/optimizer_op*.cc).  Each ``update`` is a pure jnp expression
+— XLA fuses the whole update into one kernel, which is what the
+reference's hand-fused ``multi_sgd_update``/``lamb_update_phase1`` kernels
+achieved manually.  Multi-precision (fp32 master weights for bf16/fp16
+params) follows the reference's ``multi_precision`` flag.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..base import registry
+from ..ndarray import NDArray
+
+__all__ = ["Optimizer", "Updater", "get_updater", "register", "create"]
+
+_reg = registry("optimizer")
+
+
+def register(cls):
+    return _reg.register(cls)
+
+
+def create(name, **kwargs):
+    return _reg.create(name, **kwargs)
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer/optimizer.py Optimizer).
+
+    State is kept per-parameter-index like the reference (create_state /
+    update(index, weight, grad, state)); the Trainer drives it.
+    """
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = 0.01 if learning_rate is None else learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.num_update = begin_num_update
+        self.begin_num_update = begin_num_update
+        self._index_update_count: dict[int, int] = {}
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self.lr_mult: dict = {}
+        self.wd_mult: dict = {}
+
+    # -- reference API ----------------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler overwrites learning rate")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.learning_rate
+        if index in self.param_dict:
+            lr *= getattr(self.param_dict[index], "lr_mult", 1.0)
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= getattr(self.param_dict[index], "wd_mult", 1.0)
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    # -- to implement -----------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.data.dtype in (jnp.float16, jnp.bfloat16):
+            master = NDArray(weight.data.astype(jnp.float32), ctx=weight.ctx)
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.data.dtype in (jnp.float16, jnp.bfloat16):
+            master, mstate = state
+            g32 = NDArray(grad.data.astype(jnp.float32), ctx=grad.ctx)
+            self.update(index, master, g32, mstate)
+            weight._set_data(master.data.astype(weight.data.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- shared gradient preprocessing ------------------------------------
+    def _prep(self, index, weight, grad):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = grad.data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return lr, wd, g
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.learning_rate})"
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and weight decay (reference optimizer/sgd.py).
+
+    state = momentum buffer; update matches the reference formula:
+    mom = momentum*mom - lr*(grad + wd*w); w += mom.
+    """
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        lr, wd, g = self._prep(index, weight, grad)
+        w = weight.data
+        g = g.astype(w.dtype) + wd * w
+        if state is not None:
+            mom = self.momentum * state.data - lr * g
+            state._set_data(mom)
+            weight._set_data(w + mom)
+        else:
+            weight._set_data(w - lr * g)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (reference optimizer/sgld.py)."""
+
+    def update(self, index, weight, grad, state):
+        import jax
+        from .. import random as _random
+        lr, wd, g = self._prep(index, weight, grad)
+        w = weight.data
+        noise = jax.random.normal(_random.next_key(), w.shape, jnp.float32) * \
+            math.sqrt(lr)
+        weight._set_data(w - lr / 2 * (g + wd * w) + noise.astype(w.dtype))
+
+
+@register
+class Signum(Optimizer):
+    """signSGD with momentum (reference optimizer/sgd.py Signum)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        lr, wd, g = self._prep(index, weight, grad)
+        w = weight.data
+        if state is not None:
+            mom = self.momentum * state.data - (1 - self.momentum) * (g + wd * w)
+            state._set_data(mom)
+            weight._set_data((1 - lr * self.wd_lh) * w + lr * jnp.sign(mom))
+        else:
+            weight._set_data((1 - lr * self.wd_lh) * w - lr * jnp.sign(g + wd * w))
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer/dcasgd.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx) \
+            if self.momentum != 0.0 else None
+        prev = NDArray(weight.data + 0, ctx=weight.ctx)
+        return (mom, prev)
+
+    def update(self, index, weight, grad, state):
+        lr, wd, g = self._prep(index, weight, grad)
+        mom, prev = state
+        w = weight.data
+        comp = g + wd * w + self.lamda * g * g * (w - prev.data)
+        if mom is not None:
+            m = self.momentum * mom.data - lr * comp
+            mom._set_data(m)
+            new_w = w + m
+        else:
+            new_w = w - lr * comp
+        prev._set_data(new_w)
+        weight._set_data(new_w)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference optimizer/nag.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        lr, wd, g = self._prep(index, weight, grad)
+        w = weight.data
+        g = g + wd * w
+        if state is not None:
+            mom = self.momentum * state.data + g
+            state._set_data(mom)
+            weight._set_data(w - lr * (g + self.momentum * mom))
+        else:
+            weight._set_data(w - lr * g)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, eps=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        lr, wd, g = self._prep(index, weight, grad)
+        w = weight.data
+        hist = state.data + g * g
+        state._set_data(hist)
+        weight._set_data(w - lr * (g / jnp.sqrt(hist + self.float_stable_eps)
+                                   + wd * w))
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        _, wd, g = self._prep(index, weight, grad)
+        acc_g, acc_delta = state
+        w = weight.data
+        g = g + wd * w
+        new_acc_g = self.rho * acc_g.data + (1 - self.rho) * g * g
+        delta = jnp.sqrt(acc_delta.data + self.epsilon) / \
+            jnp.sqrt(new_acc_g + self.epsilon) * g
+        new_acc_delta = self.rho * acc_delta.data + (1 - self.rho) * delta * delta
+        acc_g._set_data(new_acc_g)
+        acc_delta._set_data(new_acc_delta)
+        weight._set_data(w - delta)
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference optimizer/adam.py) with bias correction."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        lr, wd, g = self._prep(index, weight, grad)
+        t = self._index_update_count[index]
+        m, v = state
+        w = weight.data
+        g = g + wd * w
+        new_m = self.beta1 * m.data + (1 - self.beta1) * g
+        new_v = self.beta2 * v.data + (1 - self.beta2) * g * g
+        m._set_data(new_m)
+        v._set_data(new_v)
+        coef = lr * math.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        weight._set_data(w - coef * new_m / (jnp.sqrt(new_v) + self.epsilon))
+
+
+@register
+class AdamW(Adam):
+    """Decoupled weight decay Adam (reference contrib adamw.py)."""
+
+    def update(self, index, weight, grad, state):
+        lr, wd, g = self._prep(index, weight, grad)
+        t = self._index_update_count[index]
+        m, v = state
+        w = weight.data
+        new_m = self.beta1 * m.data + (1 - self.beta1) * g
+        new_v = self.beta2 * v.data + (1 - self.beta2) * g * g
+        m._set_data(new_m)
+        v._set_data(new_v)
+        coef = lr * math.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        weight._set_data(w - coef * new_m / (jnp.sqrt(new_v) + self.epsilon)
+                         - lr * wd * w)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        lr, wd, g = self._prep(index, weight, grad)
+        t = self._index_update_count[index]
+        m, u = state
+        w = weight.data
+        g = g + wd * w
+        new_m = self.beta1 * m.data + (1 - self.beta1) * g
+        new_u = jnp.maximum(self.beta2 * u.data, jnp.abs(g))
+        m._set_data(new_m)
+        u._set_data(new_u)
+        weight._set_data(w - lr / (1 - self.beta1 ** t) * new_m / (new_u + 1e-8))
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        lr, wd, g = self._prep(index, weight, grad)
+        t = self._index_update_count[index]
+        m, v = state
+        w = weight.data
+        g = g + wd * w
+        mom_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        mom_t1 = self.beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule *= mom_t
+        m_sched_next = self.m_schedule * mom_t1
+        g_prime = g / (1 - self.m_schedule)
+        new_m = self.beta1 * m.data + (1 - self.beta1) * g
+        new_v = self.beta2 * v.data + (1 - self.beta2) * g * g
+        m._set_data(new_m)
+        v._set_data(new_v)
+        m_prime = new_m / (1 - m_sched_next)
+        v_prime = new_v / (1 - self.beta2 ** t)
+        m_bar = (1 - mom_t) * g_prime + mom_t1 * m_prime
+        weight._set_data(w - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon))
+
+
+@register
+class FTRL(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        lr, wd, g = self._prep(index, weight, grad)
+        z, n = state
+        new_n = n.data + g * g
+        sigma = (jnp.sqrt(new_n) - jnp.sqrt(n.data)) / lr
+        new_z = z.data + g - sigma * weight.data
+        z._set_data(new_z)
+        n._set_data(new_n)
+        new_w = jnp.where(
+            jnp.abs(new_z) > self.lamda1,
+            -(new_z - jnp.sign(new_z) * self.lamda1) /
+            ((self.beta + jnp.sqrt(new_n)) / lr + wd),
+            jnp.zeros_like(weight.data))
+        weight._set_data(new_w)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
+        return (z(), z(), z())
+
+    def update(self, index, weight, grad, state):
+        lr, wd, g = self._prep(index, weight, grad)
+        t = self._index_update_count[index]
+        d, v, z = state
+        w = weight.data
+        g = g + wd * w
+        new_v = self.beta2 * v.data + (1 - self.beta2) * g * g
+        d_t = (1 - self.beta1 ** t) / lr * \
+            (jnp.sqrt(new_v / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d_t - self.beta1 * d.data
+        new_z = self.beta1 * z.data + (1 - self.beta1) * g - sigma * w
+        d._set_data(d_t)
+        v._set_data(new_v)
+        z._set_data(new_z)
+        weight._set_data(-new_z / d_t)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference optimizer/lars.py)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        lr, wd, g = self._prep(index, weight, grad)
+        w = weight.data
+        w_norm = jnp.linalg.norm(w.reshape(-1))
+        g_norm = jnp.linalg.norm(g.reshape(-1))
+        trust = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon),
+            jnp.ones(()))
+        g = (g + wd * w) * trust
+        if state is not None:
+            mom = self.momentum * state.data - lr * g
+            state._set_data(mom)
+            weight._set_data(w + mom)
+        else:
+            weight._set_data(w - lr * g)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise Adam for large batches (reference optimizer/lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        lr, wd, g = self._prep(index, weight, grad)
+        t = self._index_update_count[index]
+        m, v = state
+        w = weight.data
+        new_m = self.beta1 * m.data + (1 - self.beta1) * g
+        new_v = self.beta2 * v.data + (1 - self.beta2) * g * g
+        m._set_data(new_m)
+        v._set_data(new_v)
+        mh, vh = new_m, new_v
+        if self.bias_correction:
+            mh = new_m / (1 - self.beta1 ** t)
+            vh = new_v / (1 - self.beta2 ** t)
+        r = mh / (jnp.sqrt(vh) + self.epsilon) + wd * w
+        w_norm = jnp.linalg.norm(w.reshape(-1))
+        if self.lower_bound is not None:
+            w_norm = jnp.maximum(w_norm, self.lower_bound)
+        if self.upper_bound is not None:
+            w_norm = jnp.minimum(w_norm, self.upper_bound)
+        r_norm = jnp.linalg.norm(r.reshape(-1))
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm,
+                          jnp.ones(()))
+        weight._set_data(w - lr * ratio * r)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.epsilon = epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
+        if self.centered:
+            return (z(), z(), z())
+        return (z(),)
+
+    def update(self, index, weight, grad, state):
+        lr, wd, g = self._prep(index, weight, grad)
+        w = weight.data
+        g = g + wd * w
+        if self.centered:
+            n, mg, delta = state
+            new_n = (1 - self.gamma1) * g * g + self.gamma1 * n.data
+            new_mg = (1 - self.gamma1) * g + self.gamma1 * mg.data
+            new_delta = self.gamma2 * delta.data - \
+                lr * g / jnp.sqrt(new_n - new_mg * new_mg + self.epsilon)
+            n._set_data(new_n)
+            mg._set_data(new_mg)
+            delta._set_data(new_delta)
+            new_w = w + new_delta
+        else:
+            (n,) = state
+            new_n = (1 - self.gamma1) * g * g + self.gamma1 * n.data
+            n._set_data(new_n)
+            new_w = w - lr * g / jnp.sqrt(new_n + self.epsilon)
+        if self.clip_weights:
+            new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
+        weight._set_data(new_w)
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style adaptive rates (reference lbsgd.py).
+
+    Kept as SGD + warmup semantics; layer-wise scaling handled by LARS."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, warmup_strategy="linear",
+                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
+                 begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(learning_rate=learning_rate, momentum=momentum, **kwargs)
+        self.warmup_strategy = warmup_strategy
+
+
+@register
+class Test(Optimizer):
+    """Reference parity: trivial optimizer used by tests (optimizer.py Test)."""
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        weight._set_data(weight.data + grad.data * self.rescale_grad)
+        state._set_data(weight.data)
+
+
+# alias names matching the reference string registry
+_reg.alias("sgd")(SGD)
+_reg.alias("sgld")(SGLD)
+_reg.alias("signum")(Signum)
+_reg.alias("dcasgd")(DCASGD)
+_reg.alias("nag")(NAG)
+_reg.alias("adagrad")(AdaGrad)
+_reg.alias("adadelta")(AdaDelta)
+_reg.alias("adam")(Adam)
+_reg.alias("adamw")(AdamW)
+_reg.alias("adamax")(Adamax)
+_reg.alias("nadam")(Nadam)
+_reg.alias("ftrl")(FTRL)
+_reg.alias("ftml")(FTML)
+_reg.alias("lars")(LARS)
+_reg.alias("lamb")(LAMB)
+_reg.alias("rmsprop")(RMSProp)
+_reg.alias("lbsgd")(LBSGD)
+
+
+class Updater:
+    """Applies an optimizer by index (reference optimizer/updater.py)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: dict = {}
+        self.states_synced: dict = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+        states = {
+            k: (v.asnumpy() if isinstance(v, NDArray) else
+                tuple(s.asnumpy() if isinstance(s, NDArray) else s for s in v)
+                if isinstance(v, tuple) else v)
+            for k, v in self.states.items()}
+        return pickle.dumps((states, self.optimizer) if dump_optimizer else states)
+
+    def set_states(self, states_bytes):
+        import pickle
+        data = pickle.loads(states_bytes)
+        if isinstance(data, tuple):
+            states, self.optimizer = data
+        else:
+            states = data
+
+        def restore(v, like):
+            if isinstance(v, tuple):
+                return tuple(restore(s, None) for s in v)
+            if v is None:
+                return None
+            return NDArray(v)
+
+        self.states = {k: restore(v, None) for k, v in states.items()}
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,24 @@
+"""Parallelism: SPMD over device meshes.
+
+This layer is NEW capability relative to the reference (SURVEY.md §2.3:
+MXNet 1.x has data-parallel KVStore + coarse group2ctx model parallelism;
+TP/PP/SP/CP/EP are absent).  TPU-first design: a named ``Mesh`` over the
+chips, sharding rules per parameter/activation, XLA collectives over ICI
+inserted by GSPMD or explicitly via ``shard_map``:
+
+* dp  — batch sharding (KVStore allreduce becomes a psum fused into the
+  backward pass)
+* tp  — tensor parallelism: heads/ffn sharded, psum on the row-parallel
+  matmul outputs
+* sp  — sequence/context parallelism: ring attention via collective
+  ppermute (blockwise KV rotation), or Ulysses all-to-all head scatter
+* pp  — pipeline parallelism: collective-permute microbatch pipeline
+* ep  — expert parallelism: experts sharded over the mesh with
+  all-to-all token routing
+"""
+from .mesh import make_mesh, mesh_rules, shard_params, local_mesh
+from .ring_attention import ring_attention
+from .ulysses import ulysses_attention
+from .pipeline import pipeline_forward
+from .moe import MoELayer, moe_forward
+from .data_parallel import make_data_parallel_train_step

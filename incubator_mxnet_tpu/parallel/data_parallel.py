@@ -1,0 +1,47 @@
+"""Data-parallel training step builder.
+
+The TPU equivalent of KVStore('device') + Trainer (reference
+trainer.py:380 _allreduce_grads): instead of pushing gradients through a
+store, the whole train step is jit-compiled with batch sharded over the
+'dp' mesh axis — GSPMD fuses the gradient all-reduce into the backward
+pass over ICI, which is strictly better than a separate allreduce phase.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_data_parallel_train_step"]
+
+
+def make_data_parallel_train_step(loss_fn, mesh: Mesh, optimizer_update,
+                                  batch_spec=P("dp"), donate_params=True):
+    """Build ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
+
+    loss_fn(params, batch) -> scalar; optimizer_update(grads, opt_state,
+    params) -> (updates, new_opt_state) [optax-style].
+    """
+    replicated = NamedSharding(mesh, P())
+    batch_sharding = jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, batch_spec), None,
+        is_leaf=lambda x: True)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, new_opt_state = optimizer_update(grads, opt_state, params)
+        new_params = jax.tree_util.tree_map(lambda p, u: p + u, params,
+                                            updates)
+        return new_params, new_opt_state, loss
+
+    def place(params, opt_state, batch):
+        params = jax.device_put(params, replicated)
+        opt_state = jax.device_put(opt_state, replicated)
+        batch = jax.tree_util.tree_map(
+            lambda b: jax.device_put(b, NamedSharding(mesh, batch_spec)),
+            batch)
+        return params, opt_state, batch
+
+    step.place = place
+    return step

@@ -1,0 +1,196 @@
+"""Profiler: same Python API as the reference over JAX/XLA tracing.
+
+Reference: src/profiler/ + python/mxnet/profiler.py — chrome://tracing
+JSON dumps, aggregate tables, scoped tasks/counters (§5.1 of SURVEY.md).
+TPU design: ``jax.profiler`` produces xprof/perfetto traces of device
+execution; this module adds (a) the reference's set_config/start/stop/
+dumps API, (b) host-side scoped events collected into chrome-trace JSON,
+(c) aggregate duration tables.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import jax
+
+__all__ = ["set_config", "set_state", "start", "stop", "dump", "dumps",
+           "pause", "resume", "Task", "Frame", "Counter", "Marker", "scope"]
+
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": True,
+    "profile_api": True,
+    "aggregate_stats": False,
+    "xprof_dir": None,
+}
+_state = {"running": False, "xprof_active": False}
+_events: list[dict] = []
+_events_lock = threading.Lock()
+_aggregate: dict[str, list[float]] = {}
+
+
+def set_config(**kwargs):
+    _config.update(kwargs)
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        start()
+    else:
+        stop()
+
+
+def start(profile_process="worker"):
+    _state["running"] = True
+    xdir = _config.get("xprof_dir")
+    if xdir:
+        try:
+            jax.profiler.start_trace(xdir)
+            _state["xprof_active"] = True
+        except Exception:  # already tracing or unsupported platform
+            _state["xprof_active"] = False
+
+
+def stop(profile_process="worker"):
+    _state["running"] = False
+    if _state.get("xprof_active"):
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            _state["xprof_active"] = False
+
+
+def pause(profile_process="worker"):
+    _state["running"] = False
+
+
+def resume(profile_process="worker"):
+    _state["running"] = True
+
+
+def is_running():
+    return _state["running"]
+
+
+def _emit(name, category, start_us, dur_us, args=None):
+    with _events_lock:
+        _events.append({
+            "name": name, "cat": category, "ph": "X",
+            "ts": start_us, "dur": dur_us,
+            "pid": os.getpid(), "tid": threading.get_ident(),
+            "args": args or {},
+        })
+        _aggregate.setdefault(name, []).append(dur_us)
+
+
+class scope:
+    """``with profiler.scope('fwd'):`` — host-side chrome-trace event +
+    a jax.profiler.TraceAnnotation so the region shows up in xprof too."""
+
+    def __init__(self, name, category="operation"):
+        self.name = name
+        self.category = category
+        self._jax_ctx = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        try:
+            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ctx.__enter__()
+        except Exception:
+            self._jax_ctx = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(*exc)
+        if _state["running"]:
+            t1 = time.perf_counter_ns()
+            _emit(self.name, self.category, self._t0 // 1000,
+                  (t1 - self._t0) // 1000)
+
+
+class Task:
+    """User-scoped profiler task (reference profiler.h:557 ProfileTask)."""
+
+    def __init__(self, domain=None, name="task"):
+        self.name = name
+        self._scope = None
+
+    def start(self):
+        self._scope = scope(self.name, "task")
+        self._scope.__enter__()
+
+    def stop(self):
+        if self._scope is not None:
+            self._scope.__exit__(None, None, None)
+            self._scope = None
+
+
+Frame = Task
+Marker = Task
+
+
+class Counter:
+    """Named counter (reference profiler.h:768 ProfileCounter)."""
+
+    def __init__(self, domain=None, name="counter", value=0):
+        self.name = name
+        self.value = value
+
+    def set_value(self, value):
+        self.value = value
+        if _state["running"]:
+            with _events_lock:
+                _events.append({"name": self.name, "ph": "C",
+                                "ts": time.perf_counter_ns() // 1000,
+                                "pid": os.getpid(),
+                                "args": {"value": value}})
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+
+def dumps(reset=False, format="table"):
+    """Aggregate stats as a printable table (reference profiler.py:316)."""
+    lines = [f"{'Name':<40} {'Calls':>8} {'Total(us)':>12} {'Mean(us)':>12}"]
+    with _events_lock:
+        for name, durs in sorted(_aggregate.items()):
+            lines.append(f"{name:<40} {len(durs):>8} {sum(durs):>12.1f} "
+                         f"{sum(durs) / len(durs):>12.1f}")
+        if reset:
+            _aggregate.clear()
+    return "\n".join(lines)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome://tracing JSON to the configured filename."""
+    with _events_lock:
+        payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    with open(_config["filename"], "w") as f:
+        json.dump(payload, f)
+    return _config["filename"]
+
+
+def device_memory_profile():
+    """HBM allocation snapshot (reference storage_profiler.cc analog)."""
+    stats = {}
+    for d in jax.devices():
+        try:
+            ms = d.memory_stats()
+            if ms:
+                stats[str(d)] = {"bytes_in_use": ms.get("bytes_in_use"),
+                                 "peak_bytes_in_use": ms.get("peak_bytes_in_use"),
+                                 "bytes_limit": ms.get("bytes_limit")}
+        except Exception:
+            continue
+    return stats

@@ -1,0 +1,88 @@
+"""Global random state over JAX's counter-based threefry PRNG.
+
+The reference keeps per-device Philox/MT generator states inside a
+ResourceManager (include/mxnet/random_generator.h, src/resource.cc) and ops
+request ``kRandom`` resources.  On TPU the idiomatic design is explicit
+functional keys; this module bridges the two worlds:
+
+* Eager mode: a process-global seed + monotonically increasing counter;
+  each random op folds the counter into the seed key, so ``mx.random.seed(n)``
+  gives reproducible streams (documented contract: streams are threefry,
+  NOT bitwise-equal to the reference's Philox/MT — SURVEY.md §7 "RNG parity").
+* Traced mode (hybridize/CachedOp): the tracer installs a base key that is
+  an *input* to the compiled program via ``key_scope``; random ops split
+  from it deterministically, keeping compiled graphs pure.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "key_scope", "uniform", "normal", "randint",
+           "current_seed"]
+
+_state = threading.local()
+_global = {"seed": 0, "counter": 0}
+_lock = threading.Lock()
+
+
+def seed(seed_state: int, ctx=None):  # ctx accepted for API parity
+    """Reset the global stream (reference python/mxnet/random.py seed)."""
+    with _lock:
+        _global["seed"] = int(seed_state)
+        _global["counter"] = 0
+
+
+def current_seed() -> int:
+    return _global["seed"]
+
+
+class key_scope:
+    """Install a traced base key: random ops inside derive from it."""
+
+    def __init__(self, key):
+        self.key = key
+
+    def __enter__(self):
+        stack = getattr(_state, "keys", None)
+        if stack is None:
+            stack = _state.keys = []
+        stack.append([self.key, 0])
+        return self
+
+    def __exit__(self, *exc):
+        _state.keys.pop()
+
+
+def next_key():
+    """A fresh PRNG key: traced-scope derived if tracing, else global."""
+    stack = getattr(_state, "keys", None)
+    if stack:
+        entry = stack[-1]
+        entry[1] += 1
+        return jax.random.fold_in(entry[0], entry[1])
+    with _lock:
+        _global["counter"] += 1
+        counter = _global["counter"]
+        base = _global["seed"]
+    return jax.random.fold_in(jax.random.PRNGKey(base), counter)
+
+
+# Convenience eager samplers (the full op set lives in ndarray.random).
+def uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    from . import ndarray as nd
+
+    return nd.random.uniform(low, high, shape, dtype=dtype, ctx=ctx, out=out)
+
+
+def normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    from . import ndarray as nd
+
+    return nd.random.normal(loc, scale, shape, dtype=dtype, ctx=ctx, out=out)
+
+
+def randint(low, high=None, shape=(), dtype="int32", ctx=None, out=None):
+    from . import ndarray as nd
+
+    return nd.random.randint(low, high, shape, dtype=dtype, ctx=ctx, out=out)

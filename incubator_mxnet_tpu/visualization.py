@@ -1,0 +1,35 @@
+"""Network visualization (reference python/mxnet/visualization.py)."""
+from __future__ import annotations
+
+
+def print_summary(symbol, shape=None):
+    """Print a layer-by-layer summary of a Symbol graph."""
+    nodes = symbol._topo_order()
+    print(f"{'Layer':<30} {'Op':<20} {'Inputs'}")
+    print("-" * 70)
+    for node in nodes:
+        inputs = ", ".join(i.name for i in node.inputs)
+        print(f"{node.name:<30} {node.op_name or 'var':<20} {inputs}")
+    print("-" * 70)
+    print(f"Total nodes: {len(nodes)}")
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz plot; returns a graphviz.Digraph if graphviz is available."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError("plot_network requires graphviz") from e
+    dot = Digraph(name=title)
+    for node in symbol._topo_order():
+        if hide_weights and node.op_name is None and (
+                node.name.endswith(("weight", "bias", "gamma", "beta"))):
+            continue
+        dot.node(node.name, f"{node.op_name or 'data'}\n{node.name}")
+        for inp in node.inputs:
+            if hide_weights and inp.op_name is None and (
+                    inp.name.endswith(("weight", "bias", "gamma", "beta"))):
+                continue
+            dot.edge(inp.name, node.name)
+    return dot

@@ -1,0 +1,31 @@
+"""Test harness config: 8 virtual CPU devices (multi-chip sharding tests).
+
+Tests always run on the CPU backend (the TPU chip serves bench/dryrun):
+a site plugin may programmatically set jax_platforms, so the env var
+alone is not enough — we override via jax.config before any backend
+initialization.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = \
+        (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as _onp
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    """Reproducible RNG per test (reference @with_seed fixture,
+    tests/python/unittest/common.py)."""
+    import incubator_mxnet_tpu as mx
+    _onp.random.seed(0)
+    mx.random.seed(0)
+    yield

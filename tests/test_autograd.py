@@ -1,0 +1,145 @@
+"""Autograd tests (reference tests/python/unittest/test_autograd.py)."""
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal, \
+    check_numeric_gradient
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain_rule():
+    x = nd.array([0.5, 1.5])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x) * x
+        z = y.sum()
+    z.backward()
+    expected = onp.exp(x.asnumpy()) * (1 + x.asnumpy())
+    assert_almost_equal(x.grad, expected, rtol=1e-5)
+
+
+def test_multiple_inputs():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b + a).sum()
+    c.backward()
+    assert_almost_equal(a.grad, b.asnumpy() + 1)
+    assert_almost_equal(b.grad, a.asnumpy())
+
+
+def test_grad_req_add_accumulates():
+    x = nd.array([2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * x
+        y.backward()
+    assert_almost_equal(x.grad, onp.array([12.0]))
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, onp.array([30.0, 300.0]))
+
+
+def test_is_recording_and_training_scopes():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    assert not autograd.is_recording()
+
+
+def test_detach_stops_gradient():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).detach()
+        z = y * x
+    z.backward()
+    assert_almost_equal(x.grad, onp.array([4.0]))  # only d(y*x)/dx = y
+
+
+def test_stop_gradient_op():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.stop_gradient(x * x) + x
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([1.0]))
+
+
+def test_autograd_grad_api():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    (g,) = autograd.grad([y], [x])
+    assert_almost_equal(g, onp.array([27.0]))
+
+
+def test_numeric_gradient_matmul():
+    a = nd.array(onp.random.rand(3, 4).astype("float32"))
+    b = nd.array(onp.random.rand(4, 2).astype("float32"))
+    check_numeric_gradient(lambda x, y: nd.dot(x, y).sum(), [a, b],
+                           rtol=5e-2, atol=5e-3)
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    x = nd.array([3.0])
+    x.attach_grad()
+    sq = Square()
+    with autograd.record():
+        y = sq(x)
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([6.0]))
+
+
+def test_branching_graph():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        a = x * 2
+        b = a + x       # two paths into x
+        c = b.sum()
+    c.backward()
+    assert_almost_equal(x.grad, onp.array([3.0, 3.0]))
+
+
+def test_mark_variables():
+    x = nd.array([5.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * 4
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([4.0]))
