@@ -1,0 +1,76 @@
+"""Headline benchmark: ResNet-50 training throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+
+Baseline: the reference's published ResNet-50 training number,
+363.69 img/s at batch=128 on 1x V100
+(docs/static_site/src/pages/api/faq/perf.md:254; BASELINE.md).
+
+The benchmark path is the framework's fused train step (fuse.py):
+forward + backward + SGD-momentum update + BatchNorm stat updates in a
+single donated-buffer XLA program, bf16 compute via AMP conversion —
+the TPU analog of hybridize(static_alloc=True) + multi-tensor SGD.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    bs = int(os.environ.get("BENCH_BATCH", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    baseline = 363.69  # img/s, reference ResNet-50 train bs=128 on V100
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, amp
+    from incubator_mxnet_tpu.fuse import make_fused_train_step
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    ctx = mx.tpu()
+    net = vision.resnet50_v1()
+    net.initialize(ctx=ctx)
+    net(nd.random.uniform(shape=(1, 3, 32, 32), ctx=ctx))  # resolve shapes
+    if dtype == "bfloat16":
+        amp.convert_block(net, "bfloat16")
+
+    step = make_fused_train_step(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+
+    x = jnp.asarray(onp.random.rand(bs, 3, 224, 224), jnp.float32)
+    if dtype == "bfloat16":
+        x = x.astype(jnp.bfloat16)
+    y = jnp.asarray(onp.random.randint(0, 1000, (bs,)), jnp.int32)
+
+    loss = step(x, y)  # compile + first step
+    for _ in range(max(warmup - 1, 0)):
+        loss = step(x, y)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = bs * steps / dt
+    print(json.dumps({
+        "metric": f"resnet50_train_img_per_sec_bs{bs}_{dtype}",
+        "value": round(imgs_per_sec, 2),
+        "unit": "img/s",
+        "vs_baseline": round(imgs_per_sec / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,192 @@
+"""Whole-step compilation: forward + backward + optimizer in ONE XLA program.
+
+The TPU analog of the reference's op-bulking + static_alloc CachedOp
+(graph_executor.cc:1422 InitOpSegs; cached_op.h static paths): instead of
+pushing hundreds of small ops per step, the entire train step — loss,
+gradients, optimizer update, BatchNorm moving-stat updates — compiles to
+a single donated-buffer XLA executable.  This is the framework's
+performance path for benchmarks and large-scale training; the eager
+Trainer remains the flexible path.
+
+Optimizer math is shared with ``optimizer/optimizer.py`` by construction:
+the fused updates below implement the same formulas (SGD+momentum, NAG,
+Adam, AdamW) as pure pytree transforms.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray import NDArray
+
+__all__ = ["FusedTrainStep", "make_fused_train_step", "sgd_init", "adam_init"]
+
+
+def _tree_map(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def sgd_init(params):
+    return {"mom": _tree_map(jnp.zeros_like, params)}
+
+
+def adam_init(params):
+    return {"m": _tree_map(jnp.zeros_like, params),
+            "v": _tree_map(jnp.zeros_like, params),
+            "t": jnp.zeros((), jnp.int32)}
+
+
+def _sgd_update(grads, state, params, lr, momentum, wd):
+    new_mom = _tree_map(
+        lambda p, g, m: momentum * m - lr * (g + wd * p),
+        params, grads, state["mom"])
+    new_params = _tree_map(lambda p, m2: (p + m2).astype(p.dtype),
+                           params, new_mom)
+    return new_params, {"mom": new_mom}
+
+
+def _nag_update(grads, state, params, lr, momentum, wd):
+    """Nesterov momentum, same formula as optimizer.py NAG.update."""
+    new_mom = _tree_map(lambda p, g, m: momentum * m + g + wd * p,
+                        params, grads, state["mom"])
+    new_params = _tree_map(
+        lambda p, g, m2: (p - lr * (g + wd * p + momentum * m2)).astype(p.dtype),
+        params, grads, new_mom)
+    return new_params, {"mom": new_mom}
+
+
+def _adam_update(grads, state, params, lr, b1, b2, eps, wd):
+    t = state["t"] + 1
+    tf = t.astype(jnp.float32)
+    corr = jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
+    new_m = _tree_map(lambda g, m, p: b1 * m + (1 - b1) * (g + wd * p),
+                      grads, state["m"], params)
+    new_v = _tree_map(lambda g, v, p: b2 * v + (1 - b2) * jnp.square(g + wd * p),
+                      grads, state["v"], params)
+    new_params = _tree_map(
+        lambda p, m2, v2: (p - lr * corr * m2 /
+                           (jnp.sqrt(v2) + eps)).astype(p.dtype),
+        params, new_m, new_v)
+    return new_params, {"m": new_m, "v": new_v, "t": t}
+
+
+def _adamw_update(grads, state, params, lr, b1, b2, eps, wd):
+    """Decoupled weight decay, same formula as optimizer.py AdamW.update."""
+    t = state["t"] + 1
+    tf = t.astype(jnp.float32)
+    corr = jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
+    new_m = _tree_map(lambda g, m: b1 * m + (1 - b1) * g, grads, state["m"])
+    new_v = _tree_map(lambda g, v: b2 * v + (1 - b2) * jnp.square(g),
+                      grads, state["v"])
+    new_params = _tree_map(
+        lambda p, m2, v2: (p - lr * corr * m2 / (jnp.sqrt(v2) + eps)
+                           - lr * wd * p).astype(p.dtype),
+        params, new_m, new_v)
+    return new_params, {"m": new_m, "v": new_v, "t": t}
+
+
+class FusedTrainStep:
+    """Compiled train step over a gluon block.
+
+    Usage::
+
+        step = make_fused_train_step(net, loss_fn, "sgd",
+                                     {"learning_rate": 0.1, "momentum": 0.9})
+        for batch in data:
+            loss = step(x, y)     # one XLA program; params live on device
+        step.write_back()          # sync updated params into the Block
+    """
+
+    def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
+                 mesh=None, batch_spec=None, donate=True):
+        self.block = block
+        self.loss_block = loss_fn
+        opt_params = dict(optimizer_params or {})
+        self.lr = opt_params.get("learning_rate", 0.01)
+        self.momentum = opt_params.get("momentum", 0.0)
+        self.wd = opt_params.get("wd", 0.0)
+        self.optimizer = optimizer
+        params_all, apply_fn = block.functional()
+        self._apply = apply_fn
+        # split trainable vs aux (grad_req null → moving stats etc.)
+        named = list(block.collect_params().items())
+        self._trainable_names = [n for n, p in named if p.grad_req != "null"]
+        self._aux_names = [n for n, p in named if p.grad_req == "null"]
+        # copy the initial values: the step donates its param buffers, and
+        # donating the Block's live arrays would delete them out from
+        # under any eval pass on the block itself
+        self.params = {n: jnp.array(params_all[n])
+                       for n in self._trainable_names}
+        self.aux = {n: jnp.array(params_all[n]) for n in self._aux_names}
+        if optimizer in ("sgd", "nag"):
+            self.opt_state = sgd_init(self.params)
+        elif optimizer in ("adam", "adamw"):
+            self.opt_state = adam_init(self.params)
+        else:
+            raise ValueError(
+                f"fused step supports sgd/nag/adam/adamw; got {optimizer!r} "
+                f"(use the eager Trainer for others)")
+        self._key = jax.random.PRNGKey(0)
+        self._step_fn = self._build(mesh, batch_spec, donate)
+        self._last = None
+
+    def _build(self, mesh, batch_spec, donate):
+        loss_block = self.loss_block
+        apply = self._apply
+        lr, momentum, wd = self.lr, self.momentum, self.wd
+        optimizer = self.optimizer
+
+        def loss_of(params, aux, x, y, key):
+            out, updates = apply({**params, **aux}, x, training=True,
+                                 key=key, with_updates=True)
+            if isinstance(out, tuple):
+                out = out[0]
+            loss = loss_block(NDArray(out), NDArray(y))
+            return jnp.mean(loss.data), updates
+
+        def step(params, aux, opt_state, x, y, key):
+            (loss, updates), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params, aux, x, y, key)
+            if optimizer == "sgd":
+                new_params, new_state = _sgd_update(grads, opt_state, params,
+                                                    lr, momentum, wd)
+            elif optimizer == "nag":
+                new_params, new_state = _nag_update(grads, opt_state, params,
+                                                    lr, momentum, wd)
+            elif optimizer == "adamw":
+                new_params, new_state = _adamw_update(
+                    grads, opt_state, params, lr, 0.9, 0.999, 1e-8, wd)
+            else:
+                new_params, new_state = _adam_update(
+                    grads, opt_state, params, lr, 0.9, 0.999, 1e-8, wd)
+            new_aux = {**aux, **{k: v for k, v in updates.items() if k in aux}}
+            return new_params, new_aux, new_state, loss
+
+        donate_argnums = (0, 1, 2) if donate else ()
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            bspec = NamedSharding(mesh, batch_spec or P("dp"))
+            return jax.jit(step, donate_argnums=donate_argnums,
+                           in_shardings=(None, None, None, bspec, bspec, None))
+        return jax.jit(step, donate_argnums=donate_argnums)
+
+    def __call__(self, x, y):
+        xv = x.data if isinstance(x, NDArray) else x
+        yv = y.data if isinstance(y, NDArray) else y
+        self._key, sub = jax.random.split(self._key)
+        self.params, self.aux, self.opt_state, loss = self._step_fn(
+            self.params, self.aux, self.opt_state, xv, yv, sub)
+        self._last = loss
+        return loss
+
+    def write_back(self):
+        """Copy updated params back into the Block's Parameters."""
+        all_params = dict(self.block.collect_params().items())
+        for name, val in {**self.params, **self.aux}.items():
+            all_params[name]._check_and_get()._set_data(val)
+
+
+def make_fused_train_step(block, loss_fn, optimizer="sgd",
+                          optimizer_params=None, **kwargs):
+    return FusedTrainStep(block, loss_fn, optimizer, optimizer_params,
+                          **kwargs)

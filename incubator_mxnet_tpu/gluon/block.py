@@ -417,16 +417,26 @@ class HybridBlock(Block):
         named = list(self.collect_params().items())
         params_dict = {name: p.data().data for name, p in named}
         name2param = {name: p for name, p in named}
+        param2name = {p: name for name, p in named}
 
-        def apply_fn(pvals, *input_vals, training=False, key=None):
+        def apply_fn(pvals, *input_vals, training=False, key=None,
+                     with_updates=False):
             key = key if key is not None else jax.random.PRNGKey(0)
             mapping = {name2param[n]: NDArray(v) for n, v in pvals.items()}
             with _TraceParams(mapping), _random.key_scope(key), \
-                    autograd._scope(None, training), _CollectStateUpdates():
+                    autograd._scope(None, training), \
+                    _CollectStateUpdates() as su:
                 outs = self.forward(*[NDArray(v) for v in input_vals])
             if isinstance(outs, (list, tuple)):
-                return tuple(o.data for o in outs)
-            return outs.data
+                out = tuple(o.data for o in outs)
+            else:
+                out = outs.data
+            if with_updates:
+                updates = {param2name[p]: (v.data if isinstance(v, NDArray)
+                                           else v)
+                           for p, v in su if p in param2name}
+                return out, updates
+            return out
 
         return params_dict, apply_fn
 

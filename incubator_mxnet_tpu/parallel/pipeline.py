@@ -19,7 +19,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 __all__ = ["pipeline_forward"]
 
 
-def _pipeline_sharded(stage_params, microbatches, stage_fn, axis_name):
+def _pipeline_sharded(stage_params, microbatches, stage_fn, axis_name,
+                      strip_stage_axis):
     """Run inside shard_map over 'pp'.
 
     stage_params: this rank's stage parameters (leading pp axis stripped).
@@ -28,6 +29,11 @@ def _pipeline_sharded(stage_params, microbatches, stage_fn, axis_name):
     """
     npp = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
+    if strip_stage_axis:
+        # one layer per stage: drop the local (size-1) slice axis so
+        # stage_fn sees per-stage params; multi-layer stages keep the
+        # stacked slice and stage_fn iterates it
+        stage_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
     n_micro = microbatches.shape[0]
     total_steps = n_micro + npp - 1
     mb_shape = microbatches.shape[1:]
@@ -50,7 +56,8 @@ def _pipeline_sharded(stage_params, microbatches, stage_fn, axis_name):
                                                 emit_idx < n_micro))
         outputs = lax.cond(
             valid,
-            lambda o: o.at[jnp.clip(emit_idx, 0, n_micro - 1)].set(out),
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, out, jnp.clip(emit_idx, 0, n_micro - 1), axis=0),
             lambda o: o,
             outputs)
         # shift activations to next stage
@@ -81,8 +88,16 @@ def pipeline_forward(stacked_params, x, stage_fn, mesh: Mesh, n_micro=4,
     assert B % n_micro == 0
     micro = x.reshape(n_micro, B // n_micro, *x.shape[1:])
 
+    npp = mesh.shape[axis_name]
+    leading = {leaf.shape[0] for leaf in jax.tree_util.tree_leaves(
+        stacked_params)}
+    assert len(leading) == 1, "stacked_params leaves must share the stage axis"
+    stack = leading.pop()
+    assert stack % npp == 0, \
+        f"layer stack ({stack}) must divide the pp axis ({npp})"
     fn = functools.partial(_pipeline_sharded, stage_fn=stage_fn,
-                           axis_name=axis_name)
+                           axis_name=axis_name,
+                           strip_stage_axis=(stack == npp))
     param_specs = jax.tree_util.tree_map(lambda _: param_spec, stacked_params)
     mapped = jax.shard_map(
         fn, mesh=mesh,
