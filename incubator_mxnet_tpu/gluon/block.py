@@ -79,6 +79,17 @@ class Block:
         if isinstance(value, Block):
             self.__dict__.setdefault("_children", {})[name] = value
         elif isinstance(value, Parameter):
+            shared = self.__dict__.get("_shared_params")
+            if shared is not None:
+                # parameter sharing (reference Block(params=...) semantics):
+                # an existing parameter of the same name is reused
+                match = None
+                for k, p in shared.items():
+                    if k == name or k.endswith("." + name):
+                        match = p
+                        break
+                if match is not None:
+                    value = match
             self.__dict__.setdefault("_reg_params", {})[name] = value
             if not value.name or value.name == "param":
                 value.name = name
@@ -519,8 +530,15 @@ class SymbolBlock(HybridBlock):
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
+        import json as _json
         from .. import symbol as sym_mod
         from .. import ndarray as nd
+        with open(symbol_file) as f:
+            manifest = _json.load(f)
+        if manifest.get("format") == "stablehlo":
+            # HybridBlock.export deploy artifact: portable StableHLO
+            # program + params (the predict-API path, SURVEY.md §2.1)
+            return _StableHLOBlock(symbol_file, manifest, param_file)
         sym = sym_mod.load(symbol_file)
         if isinstance(input_names, str):
             input_names = [input_names]
@@ -536,3 +554,43 @@ class SymbolBlock(HybridBlock):
         for name, p in self._reg_params.items():
             bindings[name] = p.data()
         return self._symbol_outputs.eval_with(bindings)
+
+
+class _StableHLOBlock(HybridBlock):
+    """Deserialized ``HybridBlock.export`` artifact, runnable as a Block.
+
+    The TPU analog of loading prefix-symbol.json into the reference's
+    C predict API (c_predict_api.cc): the graph arrives as a compiled
+    StableHLO program, so inference needs no Python model definition.
+    """
+
+    def __init__(self, symbol_file, manifest, param_file):
+        super().__init__()
+        from jax import export as jax_export
+        path = symbol_file[:-len("-symbol.json")] \
+            if symbol_file.endswith("-symbol.json") else symbol_file
+        with open(f"{path}-symbol.stablehlo", "rb") as f:
+            self._exported = jax_export.deserialize(f.read())
+        self._param_names = manifest["params"]
+        params = {}
+        if param_file:
+            from .. import ndarray as nd
+            loaded = nd.load(param_file)
+            params = {k.split(":", 1)[-1]: v for k, v in loaded.items()}
+        for name in self._param_names:
+            p = Parameter(name, allow_deferred_init=True)
+            if name in params:
+                data = params[name]
+                p.shape = data.shape
+                p.initialize(ctx=current_context())
+                p.set_data(data)
+            self._reg_params[name] = p
+
+    def forward(self, *args):
+        pvals = [self._reg_params[n].data().data for n in self._param_names]
+        ivals = [x.data if isinstance(x, NDArray) else x for x in args]
+        out = self._exported.call(pvals, ivals)
+        if isinstance(out, (list, tuple)):
+            outs = [NDArray(o) for o in out]
+            return outs[0] if len(outs) == 1 else outs
+        return NDArray(out)

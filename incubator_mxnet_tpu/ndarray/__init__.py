@@ -50,6 +50,25 @@ elemwise_add = _g["add"]
 waitall = None  # set below
 
 
+def Dropout(data, key=None, p=0.5, mode=None, axes=(), out=None, **_ignored):
+    """MXNet-parity dropout: applies only under autograd train mode
+    (reference src/operator/nn/dropout-inl.h mode semantics); the PRNG
+    key is drawn from the global stream when not given."""
+    from .. import autograd
+    from .. import random as _random
+    if mode is None:
+        mode = "training" if autograd.is_training() else "inference"
+    if mode != "training" or p <= 0.0:
+        return identity(data, out=out)
+    if key is None:
+        key = _random.next_key()
+    return _invoke("Dropout", data, key, p=p, mode="training", axes=axes,
+                   out=out)
+
+
+dropout = Dropout
+
+
 class _Contrib:
     """``nd.contrib`` namespace (foreach/while_loop/cond + extras)."""
 

@@ -64,7 +64,8 @@ def while_loop(cond_fn, body_fn, loop_vars, max_iterations=None):
                                else cond_fn(*_wrap(state))).reshape(())
 
         def b(state):
-            return _to_raw(body_fn(*_wrap(state)))
+            out = _to_raw(body_fn(*_wrap(state)))
+            return tuple(out) if isinstance(out, (list, tuple)) else (out,)
 
         out = lax.while_loop(lambda s: jnp.bool_(c(s)), b, tuple(raw))
         return _wrap(out)
@@ -77,7 +78,9 @@ def while_loop(cond_fn, body_fn, loop_vars, max_iterations=None):
 
     def b2(carry):
         i, state = carry
-        return i + 1, _to_raw(body_fn(*_wrap(state)))
+        out = _to_raw(body_fn(*_wrap(state)))
+        out = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+        return i + 1, out
 
     _, out = lax.while_loop(c2, b2, (jnp.asarray(0), tuple(raw)))
     return _wrap(out)

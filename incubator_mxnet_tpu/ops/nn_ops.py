@@ -97,8 +97,8 @@ def deconvolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
         rhs_dilation=dilate,
         dimension_numbers=lax.conv_dimension_numbers(
             x.shape, weight.shape,
-            ("NCHW", "IOHW", "NCHW") if nd == 2 else
-            (("NCW", "IOW", "NCW") if nd == 1 else ("NCDHW", "IODHW", "NCDHW"))),
+            ("NCHW", "OIHW", "NCHW") if nd == 2 else
+            (("NCW", "OIW", "NCW") if nd == 1 else ("NCDHW", "OIDHW", "NCDHW"))),
         transpose_kernel=True)
     y = y.astype(x.dtype)
     if bias is not None and not no_bias:

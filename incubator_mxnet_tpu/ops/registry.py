@@ -17,9 +17,11 @@ Re-designs the reference's NNVM op registry + imperative invoke
 from __future__ import annotations
 
 import functools
+import inspect
 import threading
 
 import jax
+import numpy as _onp
 
 __all__ = ["Op", "register", "get_op", "list_ops", "invoke"]
 
@@ -53,6 +55,15 @@ class Op:
         self.num_inputs = num_inputs
         self.aliases = tuple(aliases)
         self._jit_cache: dict = {}
+        try:
+            sig = inspect.signature(fn)
+            self._has_varargs = any(
+                p.kind is inspect.Parameter.VAR_POSITIONAL
+                for p in sig.parameters.values())
+            self._sig = None if self._has_varargs else sig
+        except (TypeError, ValueError):
+            self._has_varargs = True
+            self._sig = None
 
     def jitted(self, kwarg_names: tuple):
         jfn = self._jit_cache.get(kwarg_names)
@@ -107,25 +118,72 @@ def invoke(op: "Op | str", *inputs, out=None, **kwargs):
 
     if isinstance(op, str):
         op = get_op(op)
-    raw = [x.data if isinstance(x, NDArray) else x for x in inputs]
-    kwargs = {k: _hashable(v) for k, v in kwargs.items()}
+
+    def _is_array(v):
+        return isinstance(v, (NDArray, jax.Array, _onp.ndarray))
+
+    if op._sig is not None and any(not _is_array(x) for x in inputs):
+        # Positional static params (MXNet style, e.g. swapaxes(x, 0, 2)):
+        # bind to the op signature and shunt non-arrays into kwargs so
+        # jit treats them as static instead of tracing them.
+        try:
+            bound = op._sig.bind(*inputs, **kwargs)
+        except TypeError:
+            bound = None
+        if bound is not None:
+            new_inputs, new_kwargs = [], {}
+            for pname, val in bound.arguments.items():
+                param = op._sig.parameters[pname]
+                if param.kind is inspect.Parameter.VAR_KEYWORD:
+                    new_kwargs.update(val)
+                elif _is_array(val) and param.kind in (
+                        inspect.Parameter.POSITIONAL_ONLY,
+                        inspect.Parameter.POSITIONAL_OR_KEYWORD):
+                    # arrays must stay a positional prefix; a static that
+                    # precedes an array forces keyword calling below
+                    new_kwargs[pname] = val
+                else:
+                    new_kwargs[pname] = val
+            # split: leading positional arrays stay positional while the
+            # remainder go by keyword (jit supports array kwargs)
+            for pname in list(bound.arguments):
+                val = new_kwargs.get(pname)
+                if _is_array(val):
+                    new_inputs.append(new_kwargs.pop(pname))
+                else:
+                    break
+            inputs, kwargs = tuple(new_inputs), new_kwargs
+    kw_arrays = {k: v for k, v in kwargs.items() if _is_array(v)}
+    kwargs = {k: _hashable(v) for k, v in kwargs.items() if k not in kw_arrays}
+    all_in = list(inputs) + list(kw_arrays.values())
+    kw_names = tuple(kw_arrays)
+    raw = [x.data if isinstance(x, NDArray) else x for x in all_in]
+    n_pos = len(inputs)
 
     recording = autograd.is_recording()
     need_grad = (
         recording
         and op.differentiable
-        and any(isinstance(x, NDArray) and x._in_graph() for x in inputs)
+        and any(isinstance(x, NDArray) and x._in_graph() for x in all_in)
     )
     if need_grad:
-        fn = functools.partial(op.fn, **kwargs)
+        static = kwargs
+
+        def fn(*arrs):
+            return op.fn(*arrs[:n_pos],
+                         **dict(zip(kw_names, arrs[n_pos:])), **static)
+
         out_data, vjp_fn = jax.vjp(fn, *raw)
     else:
-        out_data = op.jitted(tuple(sorted(kwargs)))(*raw, **kwargs)
+        jfn = op.jitted(tuple(sorted(kwargs)))
+        out_data = jfn(*raw[:n_pos], **dict(zip(kw_names, raw[n_pos:])),
+                       **kwargs)
         vjp_fn = None
 
-    outputs = _wrap_outputs(out_data, inputs, out=out)
+    outputs = _wrap_outputs(out_data, inputs if inputs else all_in, out=out)
     if need_grad:
-        nd_inputs = [x for x in inputs if isinstance(x, NDArray)]
-        input_slots = [i for i, x in enumerate(inputs) if isinstance(x, NDArray)]
-        autograd._record(op, vjp_fn, inputs, nd_inputs, input_slots, outputs)
+        nd_inputs = [x for x in all_in if isinstance(x, NDArray)]
+        input_slots = [i for i, x in enumerate(all_in)
+                       if isinstance(x, NDArray)]
+        autograd._record(op, vjp_fn, all_in, nd_inputs, input_slots, outputs)
     return outputs

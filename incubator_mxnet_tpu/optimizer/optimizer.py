@@ -27,7 +27,10 @@ def register(cls):
 
 
 def create(name, **kwargs):
-    return _reg.create(name, **kwargs)
+    try:
+        return _reg.create(name, **kwargs)
+    except KeyError:
+        raise ValueError(f"unknown optimizer {name!r}") from None
 
 
 class Optimizer:
@@ -124,9 +127,11 @@ class Optimizer:
 
     # -- shared gradient preprocessing ------------------------------------
     def _prep(self, index, weight, grad):
+        # count first: the scheduler sees the post-increment num_update
+        # (reference Optimizer.update order)
+        self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
-        self._update_count(index)
         g = grad.data * self.rescale_grad
         if self.clip_gradient is not None:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)

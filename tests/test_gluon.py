@@ -1,0 +1,304 @@
+"""Gluon layer/block tests (reference tests/python/unittest/test_gluon.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+from incubator_mxnet_tpu.gluon import nn, loss as gloss, metric as gmetric
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _init(net):
+    net.initialize()
+    return net
+
+
+def test_dense_forward_shape_and_params():
+    net = _init(nn.Dense(4, in_units=3))
+    x = nd.random.uniform(shape=(2, 3))
+    y = net(x)
+    assert y.shape == (2, 4)
+    params = net.collect_params()
+    assert any("weight" in k for k in params.keys())
+    w = net.weight.data()
+    assert_almost_equal(y, x.asnumpy() @ w.asnumpy().T
+                        + net.bias.data().asnumpy(), rtol=1e-5)
+
+
+def test_dense_deferred_shape_init():
+    net = nn.Dense(4)  # in_units inferred on first call
+    net.initialize()
+    y = net(nd.ones((5, 7)))
+    assert y.shape == (5, 4)
+    assert net.weight.shape == (4, 7)
+
+
+def test_sequential_and_hybrid_sequential():
+    for cls in (nn.Sequential, nn.HybridSequential):
+        net = cls()
+        net.add(nn.Dense(8, activation="relu"))
+        net.add(nn.Dense(3))
+        net.initialize()
+        out = net(nd.ones((2, 5)))
+        assert out.shape == (2, 3)
+        assert len(net) == 2
+        assert isinstance(net[0], nn.Dense)
+
+
+def test_hybridize_consistency():
+    """Eager vs hybridized (traced+jit) outputs must match —
+    the CachedOp correctness contract (reference block.py:1044)."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(8), nn.LayerNorm(),
+            nn.Dense(2))
+    net.initialize()
+    x = nd.random.uniform(shape=(4, 10))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-5, atol=1e-6)
+    # second call hits the cache
+    assert_almost_equal(net(x).asnumpy(), hybrid, rtol=1e-6)
+
+
+def test_hybridize_static_alloc_grad():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(6, activation="tanh"), nn.Dense(1))
+    net.initialize()
+    x = nd.random.uniform(shape=(3, 4))
+
+    def loss_of(net):
+        with autograd.record():
+            y = net(x)
+            l = (y * y).sum()
+        l.backward()
+        return {k: p.grad().asnumpy() for k, p in
+                net.collect_params().items() if p.grad_req != "null"}
+
+    eager_grads = loss_of(net)
+    net.hybridize(static_alloc=True)
+    hybrid_grads = loss_of(net)
+    for k in eager_grads:
+        assert_almost_equal(eager_grads[k], hybrid_grads[k], rtol=1e-4,
+                            atol=1e-5)
+
+
+def test_conv2d_block():
+    net = _init(nn.Conv2D(4, kernel_size=3, padding=1, in_channels=2))
+    y = net(nd.ones((1, 2, 8, 8)))
+    assert y.shape == (1, 4, 8, 8)
+    net2 = _init(nn.Conv2D(4, kernel_size=3, strides=2))
+    assert net2(nd.ones((1, 2, 9, 9))).shape == (1, 4, 4, 4)
+
+
+def test_conv_transpose_block():
+    net = _init(nn.Conv2DTranspose(3, kernel_size=2, strides=2, in_channels=2))
+    y = net(nd.ones((1, 2, 4, 4)))
+    assert y.shape == (1, 3, 8, 8)
+
+
+def test_pool_blocks():
+    x = nd.random.uniform(shape=(2, 3, 8, 8))
+    assert nn.MaxPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    assert nn.GlobalMaxPool2D()(x).shape == (2, 3, 1, 1)
+
+
+def test_batchnorm_running_stats_update():
+    net = _init(nn.BatchNorm(in_channels=3))
+    x = nd.random.uniform(1, 3, shape=(8, 3, 4, 4))
+    before = net.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    after = net.running_mean.data().asnumpy()
+    assert not onp.allclose(before, after)  # stats moved toward batch mean
+    # inference uses running stats: output differs from training pass
+    out_inf = net(x)
+    assert out_inf.shape == x.shape
+
+
+def test_embedding_block():
+    net = _init(nn.Embedding(10, 4))
+    y = net(nd.array([[1, 2], [3, 4]], dtype="int32"))
+    assert y.shape == (2, 2, 4)
+
+
+def test_dropout_block_train_vs_inference():
+    net = _init(nn.Dropout(0.5))
+    x = nd.ones((100,))
+    assert_almost_equal(net(x), x)  # inference = identity
+    with autograd.record():
+        y = net(x)
+    assert (y.asnumpy() == 0).any()
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(5, in_units=3), nn.Dense(2, in_units=5))
+    net.initialize()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(5, in_units=3), nn.Dense(2, in_units=5))
+    net2.load_parameters(f)
+    x = nd.random.uniform(shape=(2, 3))
+    assert_almost_equal(net(x), net2(x).asnumpy())
+
+
+def test_export_and_symbolblock_import(tmp_path):
+    from incubator_mxnet_tpu.gluon import SymbolBlock
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3, activation="relu"), nn.Dense(2, in_units=4))
+    net.initialize()
+    net.hybridize()
+    x = nd.random.uniform(shape=(2, 3))
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / "model")
+    net.export(prefix, epoch=0, example_inputs=(x,))
+    net2 = SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                               prefix + "-0000.params")
+    assert_almost_equal(net2(x), ref, rtol=1e-5)
+
+
+def test_parameter_grad_req_and_shared():
+    from incubator_mxnet_tpu.gluon import Parameter
+    p = Parameter("w", shape=(2, 2))
+    p.initialize()
+    p.grad_req = "null"
+    shared = _init(nn.Dense(3, in_units=3))
+    tied = nn.Dense(3, in_units=3, params=shared.collect_params())
+    x = nd.ones((1, 3))
+    assert_almost_equal(shared(x), tied(x).asnumpy())
+
+
+def test_losses_match_formulas():
+    pred = nd.array([[1.0, 2.0], [0.5, 0.1]])
+    label = nd.array([[0.9, 2.2], [0.0, 0.0]])
+    l2 = gloss.L2Loss()(pred, label).asnumpy()
+    assert_almost_equal(l2, ((pred.asnumpy() - label.asnumpy()) ** 2)
+                        .mean(axis=1) / 2, rtol=1e-5)
+    l1 = gloss.L1Loss()(pred, label).asnumpy()
+    assert_almost_equal(l1, onp.abs(pred.asnumpy() - label.asnumpy())
+                        .mean(axis=1), rtol=1e-5)
+
+
+def test_softmax_ce_loss():
+    pred = nd.array([[5.0, 1.0, 1.0], [1.0, 5.0, 1.0]])
+    label = nd.array([0, 1])
+    l = gloss.SoftmaxCrossEntropyLoss()(pred, label)
+    x = pred.asnumpy()
+    lse = onp.log(onp.exp(x).sum(1))
+    expect = lse - x[onp.arange(2), [0, 1]]
+    assert_almost_equal(l, expect, rtol=1e-5)
+
+
+def test_sigmoid_bce_and_hinge():
+    pred = nd.array([[0.5], [-0.5]])
+    label = nd.array([[1.0], [0.0]])
+    bce = gloss.SigmoidBinaryCrossEntropyLoss()(pred, label).asnumpy()
+    p = 1 / (1 + onp.exp(-pred.asnumpy()))
+    expect = -(label.asnumpy() * onp.log(p)
+               + (1 - label.asnumpy()) * onp.log(1 - p)).mean(1)
+    assert_almost_equal(bce, expect, rtol=1e-4)
+    h = gloss.HingeLoss()(nd.array([[0.4]]), nd.array([[1.0]])).asnumpy()
+    assert h == pytest.approx([0.6], rel=1e-5)
+
+
+def test_metrics():
+    acc = gmetric.Accuracy()
+    acc.update(nd.array([0, 1, 1]), nd.array([[0.9, 0.1], [0.2, 0.8],
+                                              [0.7, 0.3]]))
+    name, val = acc.get()
+    assert val == pytest.approx(2 / 3)
+    mse = gmetric.MSE()
+    mse.update(nd.array([1.0, 2.0]), nd.array([1.5, 2.0]))
+    assert mse.get()[1] == pytest.approx(0.125)
+    topk = gmetric.TopKAccuracy(top_k=2)
+    topk.update(nd.array([2]), nd.array([[0.1, 0.5, 0.4]]))
+    assert topk.get()[1] == 1.0
+    comp = gmetric.CompositeEvalMetric()
+    comp.add(gmetric.Accuracy())
+    comp.update(nd.array([1]), nd.array([[0.1, 0.9]]))
+    names, vals = comp.get()
+    assert vals[0] == 1.0
+
+
+def test_block_hooks_and_apply():
+    calls = []
+    net = _init(nn.Dense(2, in_units=2))
+    h = net.register_forward_hook(lambda blk, inp, out: calls.append("post"))
+    net.register_forward_pre_hook(lambda blk, inp: calls.append("pre"))
+    net(nd.ones((1, 2)))
+    assert calls == ["pre", "post"]
+    h.detach()
+    seen = []
+    net.apply(lambda b: seen.append(type(b).__name__))
+    assert "Dense" in seen
+
+
+def test_lambda_blocks():
+    lam = nn.HybridLambda(lambda x: x * 2)
+    assert lam(nd.ones((2,))).asnumpy().tolist() == [2, 2]
+    lam2 = nn.Lambda(lambda x: x + 1)
+    assert lam2(nd.ones((2,))).asnumpy().tolist() == [2, 2]
+
+
+def test_activation_blocks():
+    x = nd.array([-1.0, 1.0])
+    assert nn.Activation("relu")(x).asnumpy().tolist() == [0, 1]
+    assert nn.LeakyReLU(0.1)(x).asnumpy()[0] == pytest.approx(-0.1)
+    for blk in (nn.ELU(), nn.SELU(), nn.GELU(), nn.SiLU(), nn.PReLU(),
+                nn.Swish()):
+        if hasattr(blk, "initialize"):
+            blk.initialize()
+        assert blk(x).shape == (2,)
+
+
+def test_norm_blocks():
+    x = nd.random.uniform(shape=(2, 4, 3, 3))
+    for blk in (nn.LayerNorm(), nn.GroupNorm(num_groups=2),
+                nn.InstanceNorm()):
+        blk.initialize()
+        assert blk(x).shape == x.shape
+
+
+def test_trainer_sgd_step_decreases_loss():
+    from incubator_mxnet_tpu.gluon import Trainer
+    net = _init(nn.Dense(1, in_units=2))
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.3})
+    x = nd.random.uniform(shape=(16, 2))
+    target = (x.asnumpy() @ onp.array([[2.0], [-1.0]])).astype("float32")
+    losses = []
+    for _ in range(60):
+        with autograd.record():
+            l = gloss.L2Loss()(net(x), nd.array(target))
+            l = l.mean()
+        l.backward()
+        trainer.step(1)
+        losses.append(float(l.asnumpy()))
+    assert losses[-1] < losses[0] * 0.2
+
+
+def test_trainer_learning_rate_and_states(tmp_path):
+    from incubator_mxnet_tpu.gluon import Trainer
+    net = _init(nn.Dense(1, in_units=1))
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    assert tr.learning_rate == pytest.approx(0.01)
+    tr.set_learning_rate(0.5)
+    assert tr.learning_rate == pytest.approx(0.5)
+    with autograd.record():
+        l = net(nd.ones((1, 1))).sum()
+    l.backward()
+    tr.step(1)
+    f = str(tmp_path / "trainer.states")
+    tr.save_states(f)
+    tr.load_states(f)
+
+
+def test_summary_runs(capsys):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    net.summary(nd.ones((1, 3)))
+    assert "Total params" in capsys.readouterr().out

@@ -1,0 +1,165 @@
+"""Optimizer tests (reference tests/python/unittest/test_optimizer.py).
+
+Every registered optimizer must reduce a convex quadratic; specific
+update rules are cross-checked against hand-rolled NumPy where the
+formula is simple (SGD-momentum, Adam).
+"""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, optimizer as opt
+from incubator_mxnet_tpu.optimizer import lr_scheduler
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+ALL_OPTS = ["sgd", "sgld", "signum", "dcasgd", "nag", "adagrad", "adadelta",
+            "adam", "adamw", "adamax", "nadam", "ftrl", "ftml", "lars",
+            "lamb", "rmsprop", "lbsgd"]
+
+
+def _minimize(name, steps=60, lr=0.1, **kw):
+    """Run `steps` updates of x on f(x)=0.5*||x-t||^2; return final gap."""
+    o = opt.create(name, learning_rate=lr, **kw)
+    target = onp.array([1.0, -2.0, 3.0], "float32")
+    w = nd.zeros((3,))
+    state = o.create_state(0, w)
+    for _ in range(steps):
+        grad = nd.array(w.asnumpy() - target)
+        o.update(0, w, grad, state)
+    return float(onp.abs(w.asnumpy() - target).max())
+
+
+@pytest.mark.parametrize("name", ALL_OPTS)
+def test_optimizer_minimizes_quadratic(name):
+    start_gap = 3.0
+    # adadelta ignores lr (classic rule): needs more steps to accumulate
+    gap = _minimize(name, steps=400) if name == "adadelta" else _minimize(name)
+    assert gap < start_gap * 0.7, f"{name} failed to make progress: {gap}"
+
+
+def test_create_unknown_raises():
+    with pytest.raises(ValueError):
+        opt.create("not_an_optimizer")
+
+
+def test_sgd_momentum_matches_numpy():
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9)
+    w = nd.array([1.0, 2.0])
+    g = nd.array([0.5, -0.5])
+    state = o.create_state(0, w)
+    # step 1: mom = -lr*g ; w += mom
+    o.update(0, w, g, state)
+    expect_mom = -0.1 * g.asnumpy()
+    expect_w = onp.array([1.0, 2.0]) + expect_mom
+    assert_almost_equal(w, expect_w, rtol=1e-5)
+    # step 2: mom = 0.9*mom - lr*g
+    o.update(0, w, g, state)
+    expect_mom = 0.9 * expect_mom - 0.1 * g.asnumpy()
+    expect_w = expect_w + expect_mom
+    assert_almost_equal(w, expect_w, rtol=1e-5)
+
+
+def test_sgd_weight_decay():
+    o = opt.create("sgd", learning_rate=0.1, wd=0.1)
+    w = nd.array([1.0])
+    o.update(0, w, nd.array([0.0]), o.create_state(0, w))
+    # pure decay: w -= lr * wd * w
+    assert w.asnumpy()[0] == pytest.approx(1.0 - 0.1 * 0.1, rel=1e-5)
+
+
+def test_adam_first_step_matches_formula():
+    o = opt.create("adam", learning_rate=0.1, beta1=0.9, beta2=0.999,
+                   epsilon=1e-8)
+    w = nd.array([1.0])
+    g = nd.array([2.0])
+    state = o.create_state(0, w)
+    o.update(0, w, g, state)
+    m = 0.1 * 2.0
+    v = 0.001 * 4.0
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    expect = 1.0 - 0.1 * mhat / (onp.sqrt(vhat) + 1e-8)
+    assert w.asnumpy()[0] == pytest.approx(expect, rel=1e-4)
+
+
+def test_clip_gradient():
+    o = opt.create("sgd", learning_rate=1.0, clip_gradient=0.5)
+    w = nd.array([0.0])
+    o.update(0, w, nd.array([10.0]), o.create_state(0, w))
+    assert w.asnumpy()[0] == pytest.approx(-0.5, rel=1e-5)
+
+
+def test_rescale_grad():
+    o = opt.create("sgd", learning_rate=1.0, rescale_grad=0.25)
+    w = nd.array([0.0])
+    o.update(0, w, nd.array([4.0]), o.create_state(0, w))
+    assert w.asnumpy()[0] == pytest.approx(-1.0, rel=1e-5)
+
+
+def test_lr_mult_and_wd_mult():
+    o = opt.create("sgd", learning_rate=1.0)
+    o.set_lr_mult({0: 0.1})
+    w = nd.array([0.0])
+    o.update(0, w, nd.array([1.0]), o.create_state(0, w))
+    assert w.asnumpy()[0] == pytest.approx(-0.1, rel=1e-5)
+
+
+def test_multi_precision_master_weights():
+    o = opt.create("sgd", learning_rate=0.1, multi_precision=True)
+    w = nd.ones((4,)).astype("float16")
+    state = o.create_state_multi_precision(0, w)
+    master = state[0]
+    assert str(master.data.dtype) == "float32"
+    o.update_multi_precision(0, w, nd.ones((4,)).astype("float16"), state)
+    assert str(w.data.dtype) == "float16"
+    assert w.asnumpy()[0] == pytest.approx(0.9, rel=1e-2)
+
+
+def test_factor_scheduler():
+    s = lr_scheduler.FactorScheduler(step=2, factor=0.5, base_lr=1.0)
+    assert s(0) == 1.0
+    assert s(3) == 0.5  # boundary is exclusive (reference semantics)
+    assert s(5) == 0.25
+
+
+def test_multifactor_scheduler():
+    s = lr_scheduler.MultiFactorScheduler(step=[3, 6], factor=0.1,
+                                          base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(4) == pytest.approx(0.1)
+    assert s(7) == pytest.approx(0.01)
+
+
+def test_poly_and_cosine_schedulers():
+    p = lr_scheduler.PolyScheduler(max_update=10, base_lr=1.0, pwr=2)
+    assert p(0) == 1.0
+    assert p(10) <= p(5) <= p(0)
+    c = lr_scheduler.CosineScheduler(max_update=10, base_lr=1.0,
+                                     final_lr=0.0)
+    assert c(0) == pytest.approx(1.0)
+    assert c(10) == pytest.approx(0.0, abs=1e-6)
+    assert 0.0 < c(5) < 1.0
+
+
+def test_optimizer_with_scheduler_advances():
+    sched = lr_scheduler.FactorScheduler(step=1, factor=0.5, base_lr=1.0)
+    o = opt.create("sgd", learning_rate=1.0, lr_scheduler=sched)
+    w = nd.array([0.0])
+    st = o.create_state(0, w)
+    o.update(0, w, nd.array([1.0]), st)   # num_update=1: still base lr
+    first = w.asnumpy()[0]
+    assert first == pytest.approx(-1.0, rel=1e-5)
+    o.update(0, w, nd.array([1.0]), st)   # num_update=2: decayed once
+    assert w.asnumpy()[0] == pytest.approx(first - 0.5, rel=1e-5)
+
+
+def test_updater_serialization(tmp_path):
+    from incubator_mxnet_tpu.optimizer import Updater
+    o = opt.create("adam", learning_rate=0.1)
+    u = Updater(o)
+    w = nd.array([1.0, 2.0])
+    u(0, nd.array([0.1, 0.1]), w)
+    blob = u.get_states()
+    u2 = Updater(opt.create("adam", learning_rate=0.1))
+    u2.set_states(blob)
+    assert set(u2.states) == set(u.states)
