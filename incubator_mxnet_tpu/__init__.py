@@ -40,6 +40,9 @@ from . import optimizer
 from .optimizer import lr_scheduler
 from . import symbol
 from . import symbol as sym
+from . import model
+from . import module
+from . import module as mod
 from . import gluon
 from . import kvstore
 from . import kvstore as kv

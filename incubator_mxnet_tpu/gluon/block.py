@@ -83,13 +83,19 @@ class Block:
             if shared is not None:
                 # parameter sharing (reference Block(params=...) semantics):
                 # an existing parameter of the same name is reused
-                match = None
-                for k, p in shared.items():
-                    if k == name or k.endswith("." + name):
-                        match = p
-                        break
-                if match is not None:
-                    value = match
+                if name in shared:
+                    value = shared[name]
+                else:
+                    suffix = [p for k, p in shared.items()
+                              if k.endswith("." + name)]
+                    if len(suffix) == 1:
+                        value = suffix[0]
+                    elif len(suffix) > 1:
+                        raise ValueError(
+                            f"shared params have multiple candidates for "
+                            f"{name!r}: pass an unambiguous params dict "
+                            "(e.g. layer.collect_params(), not the whole "
+                            "net's)")
             self.__dict__.setdefault("_reg_params", {})[name] = value
             if not value.name or value.name == "param":
                 value.name = name
@@ -516,11 +522,14 @@ class SymbolBlock(HybridBlock):
         self._symbol_outputs = outputs
         self._symbol_inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         input_names = {s.name for s in self._symbol_inputs}
-        arg_names = outputs.list_arguments() if not isinstance(outputs, list) \
-            else outputs[0].list_arguments()
-        for name in arg_names:
+        out0 = outputs[0] if isinstance(outputs, list) else outputs
+        arg_names = out0.list_arguments()
+        aux_names = out0.list_auxiliary_states()
+        for name in arg_names + aux_names:
             if name not in input_names:
-                p = Parameter(name, allow_deferred_init=True)
+                p = Parameter(name, allow_deferred_init=True,
+                              grad_req="null" if name in aux_names
+                              else "write")
                 if params and name in params:
                     data = params[name]
                     p.shape = data.shape

@@ -1,0 +1,54 @@
+"""Checkpoint helpers for the symbolic API (reference python/mxnet/model.py).
+
+``save_checkpoint`` writes ``prefix-symbol.json`` (graph) +
+``prefix-####.params`` (weights with ``arg:``/``aux:`` prefixes — the
+reference's on-disk contract, model.py:189), ``load_checkpoint`` reads
+them back.
+"""
+from __future__ import annotations
+
+from . import ndarray as nd
+from . import symbol as sym_mod
+
+__all__ = ["save_checkpoint", "load_checkpoint", "load_params",
+           "BatchEndParam"]
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in (arg_params or {}).items()}
+    save_dict.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
+    nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+
+def load_params(prefix, epoch):
+    """Load only the parameter dicts of a checkpoint."""
+    save_dict = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:                       # unprefixed (gluon-style) entry
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    symbol = sym_mod.load(f"{prefix}-symbol.json")
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params
+
+
+class BatchEndParam:
+    """Callback payload (reference model.py BatchEndParam namedtuple)."""
+
+    def __init__(self, epoch, nbatch, eval_metric, locals=None):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = locals

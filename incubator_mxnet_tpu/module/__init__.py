@@ -1,0 +1,16 @@
+"""Legacy symbolic Module API (reference python/mxnet/module/).
+
+``Module`` wraps a Symbol with contexts, parameters and an optimizer;
+``BucketingModule`` adds per-bucket executors for variable-length data.
+Executors are whole-graph XLA programs; multi-context data parallelism
+slices the batch and sums gradients (DataParallelExecutorGroup), while
+scale-out training should use the kvstore/pjit substrate in
+``incubator_mxnet_tpu.parallel``.
+"""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+from .executor_group import DataParallelExecutorGroup, decide_slices
+
+__all__ = ["BaseModule", "Module", "BucketingModule",
+           "DataParallelExecutorGroup", "decide_slices"]

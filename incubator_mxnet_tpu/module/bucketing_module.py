@@ -1,0 +1,189 @@
+"""BucketingModule: variable-length training via per-bucket executors.
+
+Reference: python/mxnet/module/bucketing_module.py — one executor per
+sequence-length bucket, all sharing weights.  TPU re-design: each bucket
+is a separate XLA compilation (jit cache keyed on shape — exactly the
+recompilation-avoidance policy SURVEY.md §5.7 maps bucketing onto);
+parameters are shared by copying the master module's arrays into each
+bucket module at switch time (arrays are device buffers — sharing is by
+reference, no host copies).
+"""
+from __future__ import annotations
+
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=None,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None):
+        super().__init__(logger=logger)
+        assert default_bucket_key is not None
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._work_load_list = work_load_list
+        self._fixed_param_names = fixed_param_names
+        self._state_names = state_names
+        self._buckets: dict = {}
+        self._curr_module: Module | None = None
+        self._curr_bucket_key = None
+        self._grad_req = "write"
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    @property
+    def symbol(self):
+        assert self.binded
+        return self._curr_module.symbol
+
+    @property
+    def data_names(self):
+        if self.binded:
+            return self._curr_module.data_names
+        sym, data_names, _ = self._call_sym_gen(self._default_bucket_key)
+        return data_names
+
+    @property
+    def output_names(self):
+        if self.binded:
+            return self._curr_module.output_names
+        sym, _, _ = self._call_sym_gen(self._default_bucket_key)
+        return sym.list_outputs()
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._curr_module.data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._curr_module.label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._curr_module.output_shapes
+
+    def _call_sym_gen(self, bucket_key):
+        return self._sym_gen(bucket_key)
+
+    def _gen_module(self, bucket_key):
+        sym, data_names, label_names = self._call_sym_gen(bucket_key)
+        return Module(sym, data_names=data_names, label_names=label_names,
+                      logger=self.logger, context=self._context,
+                      work_load_list=self._work_load_list,
+                      fixed_param_names=self._fixed_param_names,
+                      state_names=self._state_names)
+
+    # -- bind / params ----------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
+        module = self._gen_module(self._default_bucket_key)
+        module.bind(data_shapes, label_shapes, for_training,
+                    inputs_need_grad, force_rebind=False, grad_req=grad_req)
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+        self._buckets[self._default_bucket_key] = module
+        self.binded = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Switch to (creating if needed) the module for bucket_key."""
+        assert self.binded, "call bind before switching buckets"
+        if bucket_key not in self._buckets:
+            module = self._gen_module(bucket_key)
+            module.bind(data_shapes, label_shapes, self.for_training,
+                        self.inputs_need_grad, force_rebind=False,
+                        grad_req=self._grad_req)
+            if self.params_initialized:
+                arg_params, aux_params = self.get_params()
+                module.init_params(arg_params=arg_params,
+                                   aux_params=aux_params, force_init=True,
+                                   allow_missing=False)
+                if self._curr_module.optimizer_initialized:
+                    module._optimizer = self._curr_module._optimizer
+                    module._updater = self._curr_module._updater
+                    module._kvstore = self._curr_module._kvstore
+                    module._update_on_kvstore = \
+                        self._curr_module._update_on_kvstore
+                    module.optimizer_initialized = True
+            self._buckets[bucket_key] = module
+        else:
+            module = self._buckets[bucket_key]
+            if self.params_initialized:
+                arg_params, aux_params = self.get_params()
+                module.init_params(arg_params=arg_params,
+                                   aux_params=aux_params, force_init=True)
+        self._curr_module = module
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        assert self.binded
+        if self.params_initialized and not force_init:
+            return
+        self._curr_module.init_params(initializer=initializer,
+                                      arg_params=arg_params,
+                                      aux_params=aux_params,
+                                      allow_missing=allow_missing,
+                                      force_init=force_init,
+                                      allow_extra=allow_extra)
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.params_initialized
+        return self._curr_module.get_params()
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        self._curr_module.init_optimizer(kvstore, optimizer, optimizer_params,
+                                         force_init=force_init)
+        self.optimizer_initialized = True
+
+    # -- compute ----------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        bucket_key = getattr(data_batch, "bucket_key",
+                             self._default_bucket_key)
+        self.switch_bucket(bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads=out_grads)
+
+    def update(self):
+        assert self.optimizer_initialized or \
+            self._curr_module.optimizer_initialized
+        self._curr_module.update()
+        # propagate updated params so other buckets see them at switch
+        arg_params, aux_params = self._curr_module.get_params()
+        self._curr_module._arg_params = arg_params
+        self._curr_module._aux_params = aux_params
+
+    def update_metric(self, eval_metric, labels):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(
+            merge_multi_context=merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._curr_module.get_input_grads(
+            merge_multi_context=merge_multi_context)

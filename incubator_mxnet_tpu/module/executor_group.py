@@ -1,0 +1,208 @@
+"""Data-parallel executor group: one compiled executor per context.
+
+Reference: python/mxnet/module/executor_group.py (DataParallelExecutorGroup
+:144, decide_slices :282, forward :445, backward :581).  TPU re-design:
+each context's executor is one whole-graph XLA program (Symbol.simple_bind
+→ jax.jit), so "bulking"/memory planning are XLA's job; batch slicing and
+gradient summation across the context list are kept so legacy
+multi-device Module scripts run unchanged.  For real TPU scale-out the
+kvstore/pjit path (incubator_mxnet_tpu.parallel) is preferred.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax.numpy as jnp
+
+from ..context import current_context
+from ..ndarray import NDArray
+from .. import ndarray as nd
+
+__all__ = ["DataParallelExecutorGroup", "decide_slices"]
+
+
+def decide_slices(batch_size, num_ctx, workload=None):
+    """Split [0, batch_size) into per-context slices (reference :282)."""
+    if workload is None:
+        workload = [1] * num_ctx
+    assert len(workload) == num_ctx
+    total = sum(workload)
+    sizes = [batch_size * w // total for w in workload]
+    # distribute remainder to the first contexts
+    rem = batch_size - sum(sizes)
+    for i in range(rem):
+        sizes[i % num_ctx] += 1
+    slices = []
+    start = 0
+    for s in sizes:
+        slices.append(slice(start, start + s))
+        start += s
+    return slices
+
+
+def _slice_array(arr, slc):
+    data = arr.data if isinstance(arr, NDArray) else jnp.asarray(arr)
+    return data[slc]
+
+
+class DataParallelExecutorGroup:
+    """Binds a symbol on every context with the batch sliced along axis 0."""
+
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad=False,
+                 shared_group=None, fixed_param_names=None, grad_req="write",
+                 state_names=None):
+        self.symbol = symbol
+        self.contexts = list(contexts) if contexts else [current_context()]
+        self.param_names = list(param_names)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.data_shapes = list(data_shapes)
+        self.label_shapes = list(label_shapes) if label_shapes else None
+        self.data_names = [d[0] if isinstance(d, (tuple, list)) else d.name
+                           for d in self.data_shapes]
+        self.label_names = ([l[0] if isinstance(l, (tuple, list)) else l.name
+                             for l in self.label_shapes]
+                            if self.label_shapes else [])
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+
+        batch_size = self._shape_of(self.data_shapes[0])[0]
+        self.batch_size = batch_size
+        self.slices = decide_slices(batch_size, len(self.contexts), workload)
+
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in self.arg_names}
+        for n in self.arg_names:
+            if n in self.fixed_param_names or (
+                    n in self.data_names and not inputs_need_grad) or (
+                    n in self.label_names):
+                grad_req[n] = "null"
+        self.grad_req = grad_req
+
+        self.execs = []
+        for ctx, slc in zip(self.contexts, self.slices):
+            kwargs = {}
+            for d in self.data_shapes:
+                name, shape = self._name_shape(d)
+                kwargs[name] = (slc.stop - slc.start,) + tuple(shape[1:])
+            if self.label_shapes:
+                for l in self.label_shapes:
+                    name, shape = self._name_shape(l)
+                    kwargs[name] = (slc.stop - slc.start,) + tuple(shape[1:])
+            # params: shape comes from infer or must be provided by caller
+            ex = self._bind_one(ctx, kwargs)
+            self.execs.append(ex)
+
+    @staticmethod
+    def _shape_of(desc):
+        return tuple(desc[1] if isinstance(desc, (tuple, list)) else desc.shape)
+
+    @staticmethod
+    def _name_shape(desc):
+        if isinstance(desc, (tuple, list)):
+            return desc[0], tuple(desc[1])
+        return desc.name, tuple(desc.shape)
+
+    def _bind_one(self, ctx, input_shapes):
+        # simple_bind performs backward shape inference (param shapes from
+        # data shapes) via Symbol._infer_args_from
+        return self.symbol.simple_bind(ctx=ctx, grad_req=self.grad_req,
+                                       **input_shapes)
+
+    # -- parameters -------------------------------------------------------
+    def set_params(self, arg_params, aux_params=None, allow_extra=False):
+        for ex in self.execs:
+            for name, val in arg_params.items():
+                if name in ex.arg_dict:
+                    ex.arg_dict[name]._set_data(
+                        val.data if isinstance(val, NDArray) else
+                        jnp.asarray(val))
+                elif not allow_extra:
+                    raise ValueError(f"unknown parameter {name}")
+            if aux_params:
+                for name, val in aux_params.items():
+                    if name in getattr(ex, "aux_dict", {}):
+                        ex.aux_dict[name]._set_data(
+                            val.data if isinstance(val, NDArray) else
+                            jnp.asarray(val))
+
+    def get_params(self, arg_params, aux_params):
+        """Copy current (first-executor) params out (reference :350)."""
+        ex = self.execs[0]
+        for name in self.param_names:
+            if name in ex.arg_dict:
+                arg_params[name] = NDArray(ex.arg_dict[name].data)
+        for name, val in getattr(ex, "aux_dict", {}).items():
+            aux_params[name] = NDArray(val.data)
+
+    # -- compute ----------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        data = data_batch.data
+        label = getattr(data_batch, "label", None)
+        for ex, slc in zip(self.execs, self.slices):
+            feed = {}
+            for name, arr in zip(self.data_names, data):
+                feed[name] = _slice_array(arr, slc)
+            if label is not None and self.label_names:
+                for name, arr in zip(self.label_names, label):
+                    feed[name] = _slice_array(arr, slc)
+            ex.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        assert self.for_training, "re-bind with for_training=True"
+        for i, (ex, slc) in enumerate(zip(self.execs, self.slices)):
+            og = out_grads
+            if og is not None:
+                og = [_slice_array(g, slc) for g in
+                      (og if isinstance(og, (list, tuple)) else [og])]
+            ex.backward(out_grads=og)
+
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def get_outputs(self, merge_multi_context=True):
+        all_outs = [ex.outputs for ex in self.execs]
+        if not merge_multi_context:
+            return all_outs
+        n_out = len(all_outs[0])
+        merged = []
+        for i in range(n_out):
+            parts = [outs[i].data for outs in all_outs]
+            merged.append(NDArray(jnp.concatenate(parts, axis=0)
+                                  if len(parts) > 1 else parts[0]))
+        return merged
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        grads = []
+        for name in self.data_names:
+            parts = [ex.grad_dict[name].data for ex in self.execs
+                     if ex.grad_dict.get(name) is not None]
+            grads.append(NDArray(jnp.concatenate(parts, axis=0)
+                                 if len(parts) > 1 else parts[0]))
+        return grads
+
+    def grad_arrays_for(self, name):
+        """Per-context gradient buffers for one parameter."""
+        return [ex.grad_dict[name] for ex in self.execs
+                if ex.grad_dict.get(name) is not None]
+
+    def sum_grad(self, name):
+        """Sum gradients for `name` across contexts (local allreduce)."""
+        bufs = self.grad_arrays_for(name)
+        if not bufs:
+            return None
+        total = bufs[0].data
+        for b in bufs[1:]:
+            total = total + b.data
+        return NDArray(total)
+
+    def update_metric(self, eval_metric, labels):
+        for ex, slc in zip(self.execs, self.slices):
+            lbl = [NDArray(_slice_array(l, slc)) for l in labels]
+            eval_metric.update(lbl, ex.outputs)

@@ -1,0 +1,234 @@
+"""Module: symbol + contexts + parameters + optimizer state.
+
+Reference: python/mxnet/module/module.py.  TPU re-design: binding builds
+a DataParallelExecutorGroup whose per-context executors are whole-graph
+XLA programs; `update` runs the optimizer's Updater over summed
+gradients (a local allreduce), or pushes through a kvstore when one is
+given — the same contract as the reference (model.py:87 decides
+update_on_kvstore).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import initializer as _initializer
+from .. import optimizer as _opt
+from ..context import current_context
+from ..ndarray import NDArray
+from .base_module import BaseModule
+from .executor_group import DataParallelExecutorGroup
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=None, context=None,
+                 work_load_list=None, fixed_param_names=None,
+                 state_names=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        if context is None:
+            context = [current_context()]
+        if not isinstance(context, (list, tuple)):
+            context = [context]
+        self._context = list(context)
+        self._work_load_list = work_load_list
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._arg_params = None
+        self._aux_params = None
+        self._exec_group = None
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+        self._update_on_kvstore = False
+        self._data_shapes = None
+        self._label_shapes = None
+
+    # -- properties -------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        outs = self._exec_group.execs[0].outputs
+        if outs:
+            return list(zip(self.output_names, [o.shape for o in outs]))
+        return None
+
+    # -- bind -------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._data_shapes = self._parse_data_desc(data_shapes)
+        self._label_shapes = (self._parse_data_desc(label_shapes)
+                              if label_shapes else None)
+        self._exec_group = DataParallelExecutorGroup(
+            self._symbol, self._context, self._work_load_list,
+            self._data_shapes, self._label_shapes, self._param_names,
+            for_training, inputs_need_grad=inputs_need_grad,
+            fixed_param_names=self._fixed_param_names, grad_req=grad_req)
+        self.binded = True
+        if shared_module is not None and shared_module.params_initialized:
+            arg_params, aux_params = shared_module.get_params()
+            self.init_params(arg_params=arg_params, aux_params=aux_params,
+                             force_init=True)
+
+    # -- params -----------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        assert self.binded, "call bind before init_params"
+        if self.params_initialized and not force_init:
+            return
+        if initializer is None and (arg_params is None):
+            initializer = _initializer.Uniform(0.01)
+        ex = self._exec_group.execs[0]
+        self._arg_params = {}
+        self._aux_params = {}
+        for name in self._param_names:
+            buf = NDArray(ex.arg_dict[name].data)
+            if arg_params is not None and name in arg_params:
+                src = arg_params[name]
+                buf._set_data(src.data if isinstance(src, NDArray)
+                              else jnp.asarray(src))
+            elif initializer is not None:
+                initializer(name, buf)
+            elif not allow_missing:
+                raise ValueError(f"no value for parameter {name}")
+            self._arg_params[name] = buf
+        for name in self._aux_names:
+            buf = NDArray(ex.aux_dict[name].data)
+            if aux_params is not None and name in aux_params:
+                src = aux_params[name]
+                buf._set_data(src.data if isinstance(src, NDArray)
+                              else jnp.asarray(src))
+            self._aux_params[name] = buf
+        self._exec_group.set_params(self._arg_params, self._aux_params,
+                                    allow_extra=True)
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.params_initialized
+        # aux states live in the executors (updated by BN forward)
+        self._exec_group.get_params(self._arg_params, self._aux_params)
+        return self._arg_params, self._aux_params
+
+    # -- optimizer --------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            optimizer = _opt.create(optimizer, **dict(optimizer_params))
+        optimizer.param_idx2name = {i: n
+                                    for i, n in enumerate(self._param_names)}
+        self._optimizer = optimizer
+        self._updater = _opt.get_updater(optimizer)
+        kv = kvstore
+        if isinstance(kv, str):
+            from ..kvstore import create as kv_create
+            kv = kv_create(kv) if kv else None
+        self._kvstore = kv
+        if kv is not None and getattr(kv, "is_capable", None) and \
+                kv.is_capable("optimizer"):
+            try:
+                kv.set_optimizer(optimizer)
+                self._update_on_kvstore = True
+            except (NotImplementedError, AttributeError):
+                self._update_on_kvstore = False
+        if kv is not None:
+            for i, name in enumerate(self._param_names):
+                kv.init(i, self._arg_params[name])
+        self.optimizer_initialized = True
+
+    # -- compute ----------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        self._exec_group.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec_group.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply one optimizer step over context-summed gradients."""
+        assert self.optimizer_initialized
+        for i, name in enumerate(self._param_names):
+            grad = self._exec_group.sum_grad(name)
+            if grad is None:
+                continue
+            weight = self._arg_params[name]
+            if self._kvstore is not None and self._update_on_kvstore:
+                self._kvstore.push(i, grad)
+                self._kvstore.pull(i, out=weight)
+            elif self._kvstore is not None:
+                self._kvstore.push(i, grad)
+                agg = self._kvstore.pull(i)
+                self._updater(i, agg if agg is not None else grad, weight)
+            else:
+                self._updater(i, grad, weight)
+        self._exec_group.set_params(self._arg_params, allow_extra=True)
+
+    def update_metric(self, eval_metric, labels):
+        self._exec_group.update_metric(eval_metric, labels)
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded
+        return self._exec_group.get_outputs(
+            merge_multi_context=merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.inputs_need_grad
+        return self._exec_group.get_input_grads(
+            merge_multi_context=merge_multi_context)
+
+    def reshape(self, data_shapes, label_shapes=None):
+        """Re-bind for new batch shapes, keeping parameters."""
+        arg_params, aux_params = self.get_params()
+        self.bind(data_shapes, label_shapes, for_training=self.for_training,
+                  inputs_need_grad=self.inputs_need_grad, force_rebind=True)
+        self.init_params(arg_params=arg_params, aux_params=aux_params,
+                         force_init=True)
+
+    def load_optimizer_states(self, fname):
+        if self._updater is not None:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+
+    def save_optimizer_states(self, fname):
+        if self._updater is not None:
+            with open(fname, "wb") as f:
+                f.write(self._updater.get_states())

@@ -58,7 +58,9 @@ def Dropout(data, key=None, p=0.5, mode=None, axes=(), out=None, **_ignored):
     from .. import random as _random
     if mode is None:
         mode = "training" if autograd.is_training() else "inference"
-    if mode != "training" or p <= 0.0:
+    # reference src/operator/nn/dropout-inl.h:348: drop when
+    # (is_train || mode == kAlways)
+    if mode not in ("training", "always") or p <= 0.0:
         return identity(data, out=out)
     if key is None:
         key = _random.next_key()

@@ -6,6 +6,8 @@ and MKL-DNN wrappers — SURVEY.md §2.1).  Every op lowers to XLA HLO
 FLOPs; layout is kept NCHW to match the reference's default data layout,
 with XLA free to relayout internally for the systolic array.
 """
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -259,13 +261,154 @@ def softmin(x, axis=-1):
     return jnn.softmax(-x, axis=axis)
 
 
+def _zero_cotangent(x):
+    """Zero cotangent matching JAX's rules (float0 for integer inputs)."""
+    if jnp.issubdtype(x.dtype, jnp.floating) or jnp.issubdtype(
+            x.dtype, jnp.complexfloating):
+        return jnp.zeros_like(x)
+    import numpy as _onp
+    return _onp.zeros(x.shape, jax.dtypes.float0)
+
+
+def _loss_norm(grad, label, grad_scale, ignore_label, use_ignore,
+               normalization):
+    if normalization == "batch":
+        grad = grad / grad.shape[0]
+    elif normalization == "valid" and use_ignore:
+        valid = jnp.sum(label != ignore_label)
+        grad = grad / jnp.maximum(valid, 1).astype(grad.dtype)
+    elif normalization == "valid":
+        grad = grad / grad.shape[0]
+    return grad * grad_scale
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _softmax_output(data, label, grad_scale, ignore_label, use_ignore,
+                    multi_output, normalization):
+    axis = 1 if multi_output else -1
+    return jnn.softmax(data, axis=axis)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        multi_output, normalization):
+    axis = 1 if multi_output else -1
+    p = jnn.softmax(data, axis=axis)
+    return p, (p, label)
+
+
+def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, multi_output,
+                        normalization, res, g):
+    # Loss layer: the incoming cotangent is ignored (reference
+    # src/operator/softmax_output-inl.h backward) — backward() on the
+    # executor injects the cross-entropy gradient directly.
+    del g
+    p, label = res
+    axis = 1 if multi_output else -1
+    nclass = p.shape[axis]
+    if label.ndim == p.ndim:       # soft / one-hot labels
+        onehot = label.astype(p.dtype)
+        ilabel = jnp.argmax(label, axis=axis)
+    else:
+        ilabel = label.astype(jnp.int32)
+        onehot = jnn.one_hot(ilabel, nclass, axis=axis, dtype=p.dtype)
+    grad = p - onehot
+    if use_ignore:
+        mask = (ilabel != ignore_label)
+        grad = grad * jnp.expand_dims(mask, axis).astype(p.dtype)
+    grad = _loss_norm(grad, ilabel, grad_scale, ignore_label, use_ignore,
+                      normalization)
+    return grad, _zero_cotangent(label)
+
+
+_softmax_output.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
 @register("SoftmaxOutput", aliases=("softmax_output",))
 def softmax_output(data, label, grad_scale=1.0, ignore_label=-1,
-                   use_ignore=False, multi_output=False, normalization="null"):
+                   use_ignore=False, multi_output=False, normalization="null",
+                   **_ignored):
     """Forward = softmax; the symbol-API loss op (reference
-    src/operator/softmax_output.cc).  Gradient injection is handled by the
-    symbol executor which treats this as cross-entropy w.r.t. data."""
-    return jnn.softmax(data, axis=-1)
+    src/operator/softmax_output.cc).  The registered vjp ignores the
+    incoming cotangent and injects the cross-entropy gradient, so
+    ``Executor.backward()`` with implicit head ones matches the reference."""
+    return _softmax_output(data, label, float(grad_scale), int(ignore_label),
+                           bool(use_ignore), bool(multi_output), normalization)
+
+
+def _make_regression_output(name, fwd_fn, grad_fn):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def core(data, label, grad_scale):
+        return fwd_fn(data)
+
+    def core_fwd(data, label, grad_scale):
+        return fwd_fn(data), (data, label)
+
+    def core_bwd(grad_scale, res, g):
+        del g
+        data, label = res
+        lbl = label.astype(data.dtype).reshape(data.shape)
+        # reference src/operator/regression_output-inl.h: grad is scaled by
+        # grad_scale / num_output where num_output = per-sample output count
+        num_output = max(data.size // data.shape[0], 1)
+        grad = grad_fn(fwd_fn(data), lbl) * (grad_scale / num_output)
+        return grad, _zero_cotangent(label)
+
+    core.defvjp(core_fwd, core_bwd)
+
+    def op(data, label, grad_scale=1.0, **_ignored):
+        return core(data, label, float(grad_scale))
+
+    op.__name__ = name
+    op.__doc__ = (f"{name}: symbol-API regression loss layer (reference "
+                  "src/operator/regression_output-inl.h); vjp injects the "
+                  "loss gradient, normalized by batch size.")
+    return op
+
+
+register("LinearRegressionOutput", aliases=("linear_regression_output",))(
+    _make_regression_output("LinearRegressionOutput",
+                            lambda d: d, lambda o, l: o - l))
+register("MAERegressionOutput", aliases=("mae_regression_output",))(
+    _make_regression_output("MAERegressionOutput",
+                            lambda d: d, lambda o, l: jnp.sign(o - l)))
+register("LogisticRegressionOutput", aliases=("logistic_regression_output",))(
+    _make_regression_output("LogisticRegressionOutput",
+                            jnn.sigmoid, lambda o, l: o - l))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _make_loss_core(data, grad_scale, valid_thresh, normalization):
+    return data
+
+
+def _make_loss_fwd(data, grad_scale, valid_thresh, normalization):
+    return data, data
+
+
+def _make_loss_bwd(grad_scale, valid_thresh, normalization, data, g):
+    del g
+    grad = jnp.full(data.shape, grad_scale, data.dtype)
+    if normalization == "batch":
+        grad = grad / data.shape[0]
+    elif normalization == "valid":
+        # reference src/operator/make_loss-inl.h:108: divide by the count
+        # of elements above valid_thresh
+        valid = jnp.sum(data > valid_thresh).astype(data.dtype)
+        grad = grad / jnp.maximum(valid, 1.0)
+    return (grad,)
+
+
+_make_loss_core.defvjp(_make_loss_fwd, _make_loss_bwd)
+
+
+@register("MakeLoss", aliases=("make_loss",))
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null",
+              **_ignored):
+    """Treat any symbol as a loss head (reference src/operator/make_loss.cc):
+    forward is identity, backward seeds grad_scale (batch- or
+    valid-count-normalized), ignoring the incoming cotangent."""
+    return _make_loss_core(data, float(grad_scale), float(valid_thresh),
+                           normalization)
 
 
 @register("SoftmaxActivation")

@@ -136,12 +136,6 @@ def invoke(op: "Op | str", *inputs, out=None, **kwargs):
                 param = op._sig.parameters[pname]
                 if param.kind is inspect.Parameter.VAR_KEYWORD:
                     new_kwargs.update(val)
-                elif _is_array(val) and param.kind in (
-                        inspect.Parameter.POSITIONAL_ONLY,
-                        inspect.Parameter.POSITIONAL_OR_KEYWORD):
-                    # arrays must stay a positional prefix; a static that
-                    # precedes an array forces keyword calling below
-                    new_kwargs[pname] = val
                 else:
                     new_kwargs[pname] = val
             # split: leading positional arrays stay positional while the

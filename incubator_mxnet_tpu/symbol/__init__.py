@@ -42,6 +42,87 @@ class _SymNode:
         self.output_index = output_index
 
 
+# Layer ops whose trailing array inputs are learnable parameters that the
+# symbol wrapper auto-creates as variables (reference: NNVM FListInputNames;
+# MXNet creates `{name}_weight` etc. when not passed).  Order matters: it is
+# the op's positional array-input order, with optional bias always last.
+_LAYER_VARS = {
+    "FullyConnected": ("data", "weight", "bias"),
+    "Convolution": ("data", "weight", "bias"),
+    "Deconvolution": ("data", "weight", "bias"),
+    "BatchNorm": ("data", "gamma", "beta", "moving_mean", "moving_var"),
+    "LayerNorm": ("data", "gamma", "beta"),
+    "GroupNorm": ("data", "gamma", "beta"),
+    "InstanceNorm": ("data", "gamma", "beta"),
+    "Embedding": ("data", "weight"),
+    "SoftmaxOutput": ("data", "label"),
+    "LinearRegressionOutput": ("data", "label"),
+    "MAERegressionOutput": ("data", "label"),
+    "LogisticRegressionOutput": ("data", "label"),
+}
+_AUX_ROLES = {"moving_mean", "moving_var"}
+# roles auto-created as *label* variables rather than params
+_LABEL_ROLES = {"label"}
+# ops that take a `training` static flag and, when training, return
+# (out, *aux_updates) — the executor applies the updates to aux state.
+_TRAIN_FLAG_OPS = {"BatchNorm"}
+
+
+def _infer_layer_param_shapes(op_name, kwargs, in_shape):
+    """Backward shape inference: parameter shapes from the data shape.
+
+    The reference does this inside each op's FInferShape
+    (e.g. src/operator/nn/fully_connected.cc); here one table covers the
+    layer ops so ``simple_bind`` can allocate parameters from data shapes
+    alone.  Returns {role: shape}.
+    """
+    k = kwargs
+    if op_name == "FullyConnected":
+        nh = int(k["num_hidden"])
+        in_units = (int(_prod(in_shape[1:])) if k.get("flatten", True)
+                    else int(in_shape[-1]))
+        p = {"weight": (nh, in_units)}
+        if not k.get("no_bias", False):
+            p["bias"] = (nh,)
+        return p
+    if op_name == "Convolution":
+        kern = tuple(k["kernel"])
+        nf = int(k["num_filter"])
+        ng = int(k.get("num_group", 1))
+        p = {"weight": (nf, int(in_shape[1]) // ng) + kern}
+        if not k.get("no_bias", False):
+            p["bias"] = (nf,)
+        return p
+    if op_name == "Deconvolution":
+        kern = tuple(k["kernel"])
+        nf = int(k["num_filter"])
+        ng = int(k.get("num_group", 1))
+        p = {"weight": (int(in_shape[1]), nf // ng) + kern}
+        if not k.get("no_bias", True):
+            p["bias"] = (nf,)
+        return p
+    if op_name == "BatchNorm":
+        c = int(in_shape[int(k.get("axis", 1))])
+        return {"gamma": (c,), "beta": (c,), "moving_mean": (c,),
+                "moving_var": (c,)}
+    if op_name == "LayerNorm":
+        c = int(in_shape[int(k.get("axis", -1))])
+        return {"gamma": (c,), "beta": (c,)}
+    if op_name in ("GroupNorm", "InstanceNorm"):
+        c = int(in_shape[1])
+        return {"gamma": (c,), "beta": (c,)}
+    if op_name == "Embedding":
+        return {"weight": (int(k["input_dim"]), int(k["output_dim"]))}
+    return {}
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
 class Symbol:
     """An output (or group of outputs) of a symbolic graph."""
 
@@ -91,7 +172,8 @@ class Symbol:
         return order
 
     def list_arguments(self):
-        return [n.name for n in self._topo_order() if n.op_name is None]
+        return [n.name for n in self._topo_order()
+                if n.op_name is None and not n.attrs.get("__aux__")]
 
     def list_inputs(self):
         return self.list_arguments()
@@ -133,8 +215,15 @@ class Symbol:
                 [jnp.float32] * len(self._nodes), [])
 
     # -- evaluation -------------------------------------------------------
-    def _evaluate(self, bindings: dict):
-        """Evaluate the DAG with jax values bound to variable names."""
+    def _evaluate(self, bindings: dict, training=False, aux_updates=None):
+        """Evaluate the DAG with jax values bound to variable names.
+
+        training=True passes the train flag to stateful-norm ops
+        (_TRAIN_FLAG_OPS); their extra outputs (updated moving stats) are
+        collected into ``aux_updates`` as {aux_var_name: new_value} — the
+        executor applies them after the step (the reference mutates aux
+        NDArrays inside the op; here state is threaded functionally).
+        """
         values: dict[int, object] = {}
         for node in self._topo_order():
             if node.op_name is None:
@@ -144,9 +233,91 @@ class Symbol:
             else:
                 op = _registry.get_op(node.op_name)
                 args = [values[id(i)][i.output_index] for i in node.inputs]
-                out = op.fn(*args, **node.kwargs)
-                values[id(node)] = out if isinstance(out, tuple) else (out,)
+                kwargs = node.kwargs
+                if training and node.op_name in _TRAIN_FLAG_OPS:
+                    out = op.fn(*args, training=True, **kwargs)
+                    if isinstance(out, tuple):
+                        # out = (y, *new_aux) — map extras onto aux inputs
+                        aux_in = [i for i in node.inputs
+                                  if i.op_name is None
+                                  and i.attrs.get("__aux__")]
+                        if aux_updates is not None:
+                            for var, new in zip(aux_in, out[1:]):
+                                aux_updates[var.name] = new
+                        values[id(node)] = (out[0],)
+                    else:
+                        # e.g. BatchNorm(use_global_stats=True) returns a
+                        # single array even in train mode
+                        values[id(node)] = (out,)
+                else:
+                    out = op.fn(*args, **kwargs)
+                    values[id(node)] = out if isinstance(out, tuple) else (out,)
         return [values[id(n)][n.output_index] for n in self._nodes]
+
+    def _infer_args_from(self, known: dict):
+        """Infer remaining argument/aux shapes from known input shapes.
+
+        Walks the DAG in topo order; variable inputs of layer ops with
+        unknown shapes get shapes from ``_infer_layer_param_shapes``
+        (backward inference, mirroring per-op FInferShape in the
+        reference); op output shapes come from jax.eval_shape (forward
+        inference).  Returns {var_name: shape} for every variable not in
+        ``known``.
+        """
+        shapes: dict[int, tuple] = {}   # id(node) -> tuple of output shapes
+        dtypes: dict[int, tuple] = {}
+        inferred: dict[str, tuple] = {}
+
+        def var_shape(node):
+            if node.name in known:
+                return tuple(known[node.name])
+            return inferred.get(node.name)
+
+        for node in self._topo_order():
+            if node.op_name is None:
+                s = var_shape(node)
+                shapes[id(node)] = (s,)
+                is_int = node.attrs.get("__dtype__") == "int32"
+                dtypes[id(node)] = (jnp.int32 if is_int else jnp.float32,)
+                continue
+            # backward-infer any still-unknown variable inputs
+            roles = _LAYER_VARS.get(node.op_name)
+            first = node.inputs[0] if node.inputs else None
+            data_shape = (shapes[id(first)][first.output_index]
+                          if first is not None else None)
+            if roles and data_shape is not None:
+                rule = _infer_layer_param_shapes(node.op_name, node.kwargs,
+                                                 data_shape)
+                for inp, role in zip(node.inputs, roles):
+                    if (inp.op_name is None and var_shape(inp) is None
+                            and role in rule):
+                        inferred[inp.name] = tuple(rule[role])
+                        shapes[id(inp)] = (tuple(rule[role]),)
+                    if (inp.op_name is None and role in _LABEL_ROLES
+                            and var_shape(inp) is None and data_shape):
+                        inferred[inp.name] = (data_shape[0],)
+                        shapes[id(inp)] = ((data_shape[0],),)
+            missing = [i.name for i in node.inputs
+                       if i.op_name is None
+                       and shapes[id(i)][i.output_index] is None]
+            if missing:
+                raise ValueError(
+                    f"cannot infer shapes for variables {missing} feeding "
+                    f"op {node.op_name!r} ({node.name}); bind with explicit "
+                    "shapes for them")
+            specs = []
+            for i in node.inputs:
+                specs.append(jax.ShapeDtypeStruct(
+                    shapes[id(i)][i.output_index],
+                    dtypes[id(i)][i.output_index]))
+            op = _registry.get_op(node.op_name)
+            out_abs = jax.eval_shape(
+                lambda *a, _op=op, _kw=node.kwargs: _op.fn(*a, **_kw), *specs)
+            if not isinstance(out_abs, tuple):
+                out_abs = (out_abs,)
+            shapes[id(node)] = tuple(tuple(o.shape) for o in out_abs)
+            dtypes[id(node)] = tuple(o.dtype for o in out_abs)
+        return inferred
 
     def eval_with(self, bindings: dict):
         """Eager evaluation with NDArray bindings (used by SymbolBlock)."""
@@ -170,16 +341,28 @@ class Symbol:
         """
         from .executor import Executor
         arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        known = {n: tuple(s) for n, s in kwargs.items()}
+        needed = (set(arg_names) | set(aux_names)) - set(known)
+        inferred = self._infer_args_from(known) if needed else {}
+        all_shapes = {**inferred, **known}
+        missing = [n for n in arg_names + aux_names if n not in all_shapes]
+        if missing:
+            raise ValueError(f"simple_bind needs shapes for {missing}")
+        dev = ctx or current_context()
         arg_arrays = {}
         for name in arg_names:
-            if name not in kwargs:
-                raise ValueError(f"simple_bind needs shape for {name}")
-            shape = kwargs[name]
             dtype = (type_dict or {}).get(name, "float32")
             arg_arrays[name] = NDArray(
-                jnp.zeros(tuple(shape), dtype_from_any(dtype)),
-                ctx=ctx or current_context())
-        return Executor(self, arg_arrays, grad_req=grad_req, ctx=ctx)
+                jnp.zeros(tuple(all_shapes[name]), dtype_from_any(dtype)),
+                ctx=dev)
+        aux_arrays = {}
+        for name in aux_names:
+            init = jnp.ones if name.endswith("_var") else jnp.zeros
+            aux_arrays[name] = NDArray(
+                init(tuple(all_shapes[name]), jnp.float32), ctx=dev)
+        return Executor(self, arg_arrays, aux_dict=aux_arrays,
+                        grad_req=grad_req, ctx=ctx)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
@@ -253,6 +436,42 @@ def _apply(op_name, sym_inputs, kwargs, name=None):
     kwargs = {k: v for k, v in kwargs.items() if v is not None}
     # determine output arity by abstract evaluation later; assume 1 for now
     node = _SymNode(op_name, name, in_nodes, kwargs,
+                    attrs=AttrScope.current_attrs())
+    return Symbol(node)
+
+
+def _apply_layer(op_name, canon, args, kwargs, name=None):
+    """Apply a layer op, auto-creating missing parameter/label variables
+    (the reference behavior: ``sym.FullyConnected(data, num_hidden=10,
+    name='fc1')`` creates fc1_weight/fc1_bias, src/operator registration
+    FListInputNames + python/mxnet/symbol auto-var logic)."""
+    roles = _LAYER_VARS[canon]
+    name = NameManager.current().get(name, canon.lower())
+    by_role: dict[str, Symbol] = {}
+    pos = [a for a in args if isinstance(a, Symbol)]
+    for role, s in zip(roles, pos):
+        by_role[role] = s
+    for role in roles:
+        if role in kwargs and isinstance(kwargs[role], Symbol):
+            by_role[role] = kwargs.pop(role)
+    static = {k: v for k, v in kwargs.items()
+              if not isinstance(v, Symbol) and v is not None}
+    no_bias = static.get("no_bias",
+                         canon == "Deconvolution")  # deconv default no_bias
+    in_syms = []
+    for role in roles:
+        if role == "bias" and no_bias:
+            continue
+        if role in by_role:
+            in_syms.append(by_role[role])
+            continue
+        attrs = AttrScope.current_attrs()
+        if role in _AUX_ROLES:
+            attrs["__aux__"] = "1"
+        vnode = _SymNode(None, f"{name}_{role}", [], {}, attrs=attrs)
+        in_syms.append(Symbol(vnode))
+    in_nodes = [s._nodes[0] for s in in_syms]
+    node = _SymNode(canon, name, in_nodes, static,
                     attrs=AttrScope.current_attrs())
     return Symbol(node)
 
@@ -363,8 +582,17 @@ for _name, _fn in [
 # ---------------------------------------------------------------------------
 
 def _make_sym_wrapper(op_name):
+    canon = _registry.get_op(op_name).name
+
     def fn(*args, name=None, **kwargs):
+        if canon in _LAYER_VARS:
+            return _apply_layer(op_name, canon, args, kwargs, name=name)
         sym_inputs = [a for a in args if isinstance(a, Symbol)]
+        # Symbol-valued kwargs (e.g. data=x) become inputs, in signature order
+        sym_kw = [(k, v) for k, v in kwargs.items() if isinstance(v, Symbol)]
+        for k, v in sym_kw:
+            kwargs.pop(k)
+            sym_inputs.append(v)
         return _apply(op_name, sym_inputs, kwargs, name=name)
 
     fn.__name__ = op_name

@@ -2,10 +2,13 @@
 
 Reference: src/executor/graph_executor.cc (GraphExecutor::Init :395,
 RunOps :1518) + python/mxnet/executor.py.  TPU re-design: ``bind`` JIT-
-compiles the whole graph (and its gradient, via jax.vjp) into two XLA
+compiles the whole graph (and its gradient, via jax.vjp) into XLA
 programs — XLA performs the memory planning (MXPlanMemory analog),
 common-subexpression elimination and fusion that the reference
-implemented as NNVM passes.
+implemented as NNVM passes.  Auxiliary states (BatchNorm moving stats)
+are threaded functionally: train-mode forward returns their updates,
+which the executor applies afterwards (the reference mutates them inside
+the op kernel).
 """
 from __future__ import annotations
 
@@ -18,12 +21,18 @@ __all__ = ["Executor"]
 
 
 class Executor:
-    def __init__(self, symbol, arg_dict, args_grad=None, grad_req="write",
-                 ctx=None):
+    def __init__(self, symbol, arg_dict, args_grad=None, aux_dict=None,
+                 grad_req="write", ctx=None):
         self._symbol = symbol
         self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
         self.arg_dict = {name: arg_dict[name] for name in self._arg_names}
         self.arg_arrays = [self.arg_dict[n] for n in self._arg_names]
+        self.aux_dict = dict(aux_dict or {})
+        for n in self._aux_names:
+            if n not in self.aux_dict:
+                raise ValueError(f"missing auxiliary state {n}")
+        self.aux_arrays = [self.aux_dict[n] for n in self._aux_names]
         if isinstance(grad_req, str):
             grad_req = {n: grad_req for n in self._arg_names}
         self._grad_req = grad_req
@@ -36,26 +45,41 @@ class Executor:
             args_grad = dict(zip(self._arg_names, args_grad))
         self.grad_dict = args_grad
         self.grad_arrays = [self.grad_dict.get(n) for n in self._arg_names]
-        self.aux_dict = {}
-        self.aux_arrays = []
         self.outputs: list[NDArray] = []
         self._vjp_fn = None
 
-        def fwd(vals):
-            return tuple(symbol._evaluate(dict(zip(self._arg_names, vals))))
+        def fwd_infer(vals, aux):
+            bindings = dict(zip(self._arg_names, vals))
+            bindings.update(zip(self._aux_names, aux))
+            return tuple(symbol._evaluate(bindings))
 
-        self._jit_fwd = jax.jit(fwd)
-        self._fwd = fwd
+        def fwd_train(vals, aux):
+            bindings = dict(zip(self._arg_names, vals))
+            bindings.update(zip(self._aux_names, aux))
+            updates: dict = {}
+            outs = tuple(symbol._evaluate(bindings, training=True,
+                                          aux_updates=updates))
+            return outs, updates
+
+        self._jit_infer = jax.jit(fwd_infer)
+        self._fwd_train = fwd_train
 
     def forward(self, is_train=False, **kwargs):
         for name, val in kwargs.items():
             self.arg_dict[name]._set_data(
                 val.data if isinstance(val, NDArray) else jnp.asarray(val))
         vals = [self.arg_dict[n].data for n in self._arg_names]
+        aux = [self.aux_dict[n].data for n in self._aux_names]
         if is_train:
-            outs, self._vjp_fn = jax.vjp(self._fwd, vals)
+            outs, vjp, aux_updates = jax.vjp(
+                self._fwd_train, vals, aux, has_aux=True)
+            self._vjp_fn = vjp
+            # apply moving-stat updates now (reference semantics: BN
+            # updates its aux states during the forward pass)
+            for name, new in aux_updates.items():
+                self.aux_dict[name]._set_data(new)
         else:
-            outs = self._jit_fwd(vals)
+            outs = self._jit_infer(vals, aux)
             self._vjp_fn = None
         self.outputs = [NDArray(o) for o in outs]
         return self.outputs
@@ -70,7 +94,7 @@ class Executor:
         else:
             out_grads = [g.data if isinstance(g, NDArray) else g
                          for g in out_grads]
-        (grads,) = self._vjp_fn(tuple(out_grads))
+        grads, _aux_grads = self._vjp_fn(tuple(out_grads))
         for name, g in zip(self._arg_names, grads):
             req = self._grad_req.get(name, "null")
             if req == "null" or self.grad_dict.get(name) is None:
@@ -89,6 +113,14 @@ class Executor:
                     val.data if isinstance(val, NDArray) else jnp.asarray(val))
             elif not allow_extra_params:
                 raise ValueError(f"unknown param {name}")
+        if aux_params:
+            for name, val in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._set_data(
+                        val.data if isinstance(val, NDArray)
+                        else jnp.asarray(val))
+                elif not allow_extra_params:
+                    raise ValueError(f"unknown aux state {name}")
 
     @property
     def output_dict(self):
