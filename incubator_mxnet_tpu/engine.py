@@ -262,6 +262,140 @@ class ThreadedEngine(Engine):
                 self._cv.wait()
 
 
+class NativeEngine(Engine):
+    """Dependency engine backed by the C++ runtime (src/engine.cc).
+
+    Same semantics as ThreadedEngine — RW var queues, version bump on
+    write, sticky exception propagation (reference
+    threaded_engine.cc:422-522) — but scheduling, worker threads and
+    dependency bookkeeping run natively; Python closures are invoked via
+    a single ctypes trampoline. Selected with
+    ``MXNET_ENGINE_TYPE=NativeEngine``.
+    """
+
+    class _Var:
+        __slots__ = ("handle", "name", "_version", "_exc", "_engine",
+                     "__weakref__")
+
+        def __init__(self, handle, name, engine):
+            self.handle = handle
+            self.name = name
+            self._version = 0
+            self._exc = None
+            self._engine = engine
+
+        def __del__(self):
+            # ordered teardown: the native side frees the var once all
+            # pending ops on it drain (engine.cc DeleteVar)
+            eng = self._engine
+            if self.handle is not None and eng is not None \
+                    and getattr(eng, "_lib", None) is not None:
+                try:
+                    eng._lib.MXTEngineDeleteVar(eng._h, self.handle)
+                except Exception:  # interpreter teardown
+                    pass
+                self.handle = None
+
+    def __init__(self, num_workers: int | None = None):
+        from . import native
+        if not native.available():
+            raise RuntimeError("native runtime library not built")
+        self._native = native
+        self._lib = native.lib
+        import ctypes
+        self._ctypes = ctypes
+        self._libc = ctypes.CDLL(None)
+        self._libc.strdup.restype = ctypes.c_void_p
+        self._libc.strdup.argtypes = [ctypes.c_char_p]
+        h = ctypes.c_void_p()
+        nw = num_workers or get_env("MXNET_CPU_WORKER_NTHREADS", 0, int)
+        native.check_call(self._lib.MXTEngineCreate(nw, ctypes.byref(h)))
+        self._h = h
+        self._ops: dict[int, object] = {}
+        self._ops_lock = threading.Lock()
+        self._next_token = [1]
+
+        libc = self._libc
+
+        @native.ENGINE_FN
+        def _trampoline(ctx, err_out):
+            token = int(ctx)
+            with self._ops_lock:
+                fn, done_evt, holder = self._ops.pop(token)
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 - engine boundary
+                msg = f"{type(e).__name__}: {e}"
+                err_out[0] = libc.strdup(msg.encode("utf-8", "replace"))
+                holder.append(e)
+            finally:
+                done_evt.set()
+
+        self._trampoline = _trampoline  # keep alive for the engine lifetime
+
+    def new_variable(self, name: str = ""):
+        h = self._ctypes.c_void_p()
+        self._native.check_call(
+            self._lib.MXTEngineNewVar(self._h, self._ctypes.byref(h)))
+        return NativeEngine._Var(h, name, self)
+
+    def _var_array(self, vars_):
+        arr = (self._ctypes.c_void_p * len(vars_))()
+        for i, v in enumerate(vars_):
+            arr[i] = v.handle
+        return arr
+
+    def push(self, fn, const_vars=(), mutable_vars=(), name="op", priority=0):
+        const_vars = tuple(const_vars)
+        mutable_vars = tuple(mutable_vars)
+        dup = set(id(v) for v in const_vars) & set(id(v) for v in mutable_vars)
+        if dup:
+            const_vars = tuple(v for v in const_vars if id(v) not in dup)
+        done_evt = threading.Event()
+        holder: list = []
+        with self._ops_lock:
+            token = self._next_token[0]
+            self._next_token[0] += 1
+            self._ops[token] = (fn, done_evt, holder)
+        for v in mutable_vars:
+            v._version += 1
+        self._native.check_call(self._lib.MXTEnginePush(
+            self._h, self._trampoline, self._ctypes.c_void_p(token),
+            self._var_array(const_vars), len(const_vars),
+            self._var_array(mutable_vars), len(mutable_vars), priority))
+
+        class _Handle:
+            done = done_evt
+            _holder = holder
+
+            @property
+            def exc(self):
+                return holder[0] if holder else None
+        return _Handle()
+
+    def push_sync(self, fn, const_vars=(), mutable_vars=(), name="op"):
+        op = self.push(fn, const_vars, mutable_vars, name)
+        op.done.wait()
+        if op.exc is not None:
+            raise op.exc
+        return op
+
+    def wait_for_var(self, var):
+        rc = self._lib.MXTEngineWaitForVar(self._h, var.handle)
+        if rc != 0:
+            msg = self._lib.MXTGetLastError().decode("utf-8", "replace")
+            raise RuntimeError(msg)
+
+    def wait_for_all(self):
+        rc = self._lib.MXTEngineWaitAll(self._h)
+        if rc != 0:
+            msg = self._lib.MXTGetLastError().decode("utf-8", "replace")
+            raise RuntimeError(msg)
+
+    def throw_pending(self, var):
+        self.wait_for_var(var)
+
+
 _engine_lock = threading.Lock()
 _engine: Engine | None = None
 
@@ -271,7 +405,12 @@ def get_engine() -> Engine:
     with _engine_lock:
         if _engine is None:
             kind = get_env("MXNET_ENGINE_TYPE", "ThreadedEngine")
-            _engine = NaiveEngine() if kind == "NaiveEngine" else ThreadedEngine()
+            if kind == "NaiveEngine":
+                _engine = NaiveEngine()
+            elif kind == "NativeEngine":
+                _engine = NativeEngine()
+            else:
+                _engine = ThreadedEngine()
         return _engine
 
 

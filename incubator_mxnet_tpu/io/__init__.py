@@ -13,7 +13,8 @@ from .. import ndarray as nd
 from ..ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "NativeImageRecordIter"]
 
 
 class DataDesc:
@@ -308,12 +309,118 @@ class MNISTIter(DataIter):
         return self._inner.provide_label
 
 
+class NativeImageRecordIter(DataIter):
+    """RecordIO image iterator on the C++ pipeline (src/image_iter.cc):
+    threaded JPEG decode + augment + batch assembly + prefetch, the
+    counterpart of the reference ImageRecordIOParser2 → BatchLoader →
+    PrefetcherIter stack (src/io/iter_image_recordio_2.cc:52-179)."""
+
+    def __init__(self, path_imgrec, data_shape=(3, 224, 224), batch_size=128,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0, resize=0,
+                 round_batch=True, preprocess_threads=0, prefetch_buffer=4,
+                 seed=0, label_width=1, data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        from .. import native
+        import ctypes
+        if not native.available():
+            raise RuntimeError("native runtime library not built")
+        self._native = native
+        self._ctypes = ctypes
+        c, h, w = data_shape
+        p = native.ImageIterParams(
+            path_imgrec=path_imgrec.encode(), batch_size=batch_size,
+            channels=c, height=h, width=w,
+            mean_r=mean_r, mean_g=mean_g, mean_b=mean_b,
+            std_r=std_r, std_g=std_g, std_b=std_b, scale=scale,
+            resize=resize, rand_crop=int(rand_crop),
+            rand_mirror=int(rand_mirror), shuffle=int(shuffle),
+            round_batch=int(round_batch), num_threads=preprocess_threads,
+            prefetch=prefetch_buffer, seed=seed, label_width=label_width)
+        handle = ctypes.c_void_p()
+        native.check_call(native.lib.MXTImageIterCreate(
+            ctypes.byref(p), ctypes.byref(handle)))
+        self._h = handle
+        self._shape = (batch_size, c, h, w)
+        self._label_width = label_width
+        self._data_name = data_name
+        self._label_name = label_name
+        self._data_buf = onp.empty(self._shape, dtype=onp.float32)
+        self._label_buf = onp.empty((batch_size, label_width),
+                                    dtype=onp.float32)
+
+    def __del__(self):
+        lib = getattr(getattr(self, "_native", None), "lib", None)
+        if getattr(self, "_h", None) is not None and lib is not None:
+            lib.MXTImageIterFree(self._h)
+            self._h = None
+
+    @property
+    def num_samples(self):
+        n = self._ctypes.c_uint64()
+        self._native.check_call(self._native.lib.MXTImageIterNumSamples(
+            self._h, self._ctypes.byref(n)))
+        return n.value
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name, self._shape)]
+
+    @property
+    def provide_label(self):
+        shape = ((self.batch_size,) if self._label_width == 1
+                 else (self.batch_size, self._label_width))
+        return [DataDesc(self._label_name, shape)]
+
+    def reset(self):
+        self._native.check_call(self._native.lib.MXTImageIterReset(self._h))
+
+    def next(self):
+        ct = self._ctypes
+        count = ct.c_int()
+        pad = ct.c_int()
+        self._native.check_call(self._native.lib.MXTImageIterNext(
+            self._h,
+            self._data_buf.ctypes.data_as(ct.POINTER(ct.c_float)),
+            self._label_buf.ctypes.data_as(ct.POINTER(ct.c_float)),
+            ct.byref(count), ct.byref(pad)))
+        if count.value == 0:
+            raise StopIteration
+        label = self._label_buf[:, 0] if self._label_width == 1 \
+            else self._label_buf
+        # pad counts slots metrics must discount: wrap-around duplicates
+        # under round_batch, or empty tail slots otherwise
+        # (the reference's num_batch_padd)
+        total_pad = pad.value + (self.batch_size - count.value)
+        return DataBatch(data=[nd.array(self._data_buf.copy())],
+                         label=[nd.array(label.copy())],
+                         pad=total_pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
 def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224),
                     batch_size=128, shuffle=False, **kwargs):
     """RecordIO image iterator (reference src/io/iter_image_recordio_2.cc).
 
-    Returns a prefetching iterator over decoded+augmented image batches.
+    Uses the native C++ decode/augment/prefetch pipeline when the runtime
+    library is built and the requested options are ones it implements;
+    requests for augmentations only the Python ImageIter supports
+    (rotation, HSL jitter, …) take the Python path so behavior does not
+    silently depend on whether libmxtpu.so was built.
     """
+    from .. import native
+    _native_kwargs = {
+        "rand_crop", "rand_mirror", "mean_r", "mean_g", "mean_b",
+        "std_r", "std_g", "std_b", "scale", "resize", "round_batch",
+        "preprocess_threads", "prefetch_buffer", "seed", "label_width",
+        "data_name", "label_name",
+    }
+    if native.available() and set(kwargs) <= _native_kwargs:
+        return NativeImageRecordIter(path_imgrec, data_shape, batch_size,
+                                     shuffle=shuffle, **kwargs)
     from ..image import ImageIter
     inner = ImageIter(batch_size, data_shape, path_imgrec=path_imgrec,
                       shuffle=shuffle, **kwargs)

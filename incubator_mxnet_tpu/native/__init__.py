@@ -1,0 +1,161 @@
+"""ctypes binding to the native runtime library (libmxtpu.so).
+
+The C++ sources live in ``src/`` at the repo root (recordio, dependency
+engine, pooled storage, image-record pipeline — the TPU-native
+counterparts of the reference's src/engine, src/storage, src/io). This
+module finds the built library, lazily building it with ``make`` when a
+toolchain is present (the role of libinfo.py:25 find_lib_path +
+base.py:339 _load_lib in the reference). Everything degrades gracefully:
+``lib`` is None when no library can be loaded, and pure-Python fallbacks
+take over.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+__all__ = ["lib", "check_call", "ImageIterParams", "ENGINE_FN", "available"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_LIB_PATH = os.path.join(_HERE, "libmxtpu.so")
+_lock = threading.Lock()
+
+
+class ImageIterParams(ctypes.Structure):
+    """Mirror of MXTImageIterParams (src/include/mxt/c_api.h)."""
+
+    _fields_ = [
+        ("path_imgrec", ctypes.c_char_p),
+        ("batch_size", ctypes.c_int),
+        ("channels", ctypes.c_int),
+        ("height", ctypes.c_int),
+        ("width", ctypes.c_int),
+        ("mean_r", ctypes.c_float),
+        ("mean_g", ctypes.c_float),
+        ("mean_b", ctypes.c_float),
+        ("std_r", ctypes.c_float),
+        ("std_g", ctypes.c_float),
+        ("std_b", ctypes.c_float),
+        ("scale", ctypes.c_float),
+        ("resize", ctypes.c_int),
+        ("rand_crop", ctypes.c_int),
+        ("rand_mirror", ctypes.c_int),
+        ("shuffle", ctypes.c_int),
+        ("round_batch", ctypes.c_int),
+        ("num_threads", ctypes.c_int),
+        ("prefetch", ctypes.c_int),
+        ("seed", ctypes.c_uint64),
+        ("label_width", ctypes.c_int),
+    ]
+
+
+ENGINE_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p,
+                             ctypes.POINTER(ctypes.c_char_p))
+
+
+def _try_build() -> bool:
+    """Build libmxtpu.so from src/ if sources and g++ are present.
+
+    Failures are reported on stderr (not swallowed) so a silent fallback
+    to the pure-Python paths is always explained. Set
+    MXNET_NATIVE_AUTOBUILD=0 to skip building at import.
+    """
+    makefile = os.path.join(_REPO, "src", "Makefile")
+    if not os.path.exists(makefile):
+        return False
+    if os.environ.get("MXNET_NATIVE_AUTOBUILD", "1") == "0":
+        return False
+    try:
+        proc = subprocess.run(["make", "-C", os.path.join(_REPO, "src")],
+                              capture_output=True, timeout=600, text=True)
+    except (OSError, subprocess.SubprocessError) as e:
+        import sys
+        print(f"[incubator_mxnet_tpu] native build failed ({e}); "
+              "falling back to pure-Python runtime", file=sys.stderr)
+        return False
+    if proc.returncode != 0:
+        import sys
+        tail = "\n".join((proc.stderr or "").splitlines()[-15:])
+        print("[incubator_mxnet_tpu] native build failed; falling back to "
+              f"pure-Python runtime. Last compiler output:\n{tail}",
+              file=sys.stderr)
+        return False
+    return os.path.exists(_LIB_PATH)
+
+
+def _declare(dll: ctypes.CDLL) -> ctypes.CDLL:
+    u64 = ctypes.c_uint64
+    vp = ctypes.c_void_p
+    dll.MXTGetLastError.restype = ctypes.c_char_p
+    dll.MXTGetLastError.argtypes = []
+    # recordio
+    dll.MXTRecordIOWriterCreate.argtypes = [ctypes.c_char_p, ctypes.POINTER(vp)]
+    dll.MXTRecordIOWriterWrite.argtypes = [vp, ctypes.c_char_p, u64]
+    dll.MXTRecordIOWriterTell.argtypes = [vp, ctypes.POINTER(u64)]
+    dll.MXTRecordIOWriterFree.argtypes = [vp]
+    dll.MXTRecordIOReaderCreate.argtypes = [ctypes.c_char_p, ctypes.POINTER(vp)]
+    dll.MXTRecordIOReaderNext.argtypes = [vp, ctypes.POINTER(ctypes.c_void_p),
+                                          ctypes.POINTER(u64)]
+    dll.MXTRecordIOReaderSeek.argtypes = [vp, u64]
+    dll.MXTRecordIOReaderTell.argtypes = [vp, ctypes.POINTER(u64)]
+    dll.MXTRecordIOReaderFree.argtypes = [vp]
+    # engine
+    dll.MXTEngineCreate.argtypes = [ctypes.c_int, ctypes.POINTER(vp)]
+    dll.MXTEngineNewVar.argtypes = [vp, ctypes.POINTER(vp)]
+    dll.MXTEngineVarVersion.argtypes = [vp, vp, ctypes.POINTER(u64)]
+    dll.MXTEnginePush.argtypes = [vp, ENGINE_FN, vp, ctypes.POINTER(vp),
+                                  ctypes.c_int, ctypes.POINTER(vp),
+                                  ctypes.c_int, ctypes.c_int]
+    dll.MXTEngineWaitForVar.argtypes = [vp, vp]
+    dll.MXTEngineWaitAll.argtypes = [vp]
+    dll.MXTEngineDeleteVar.argtypes = [vp, vp]
+    dll.MXTEngineFree.argtypes = [vp]
+    # storage
+    dll.MXTStorageAlloc.argtypes = [u64, ctypes.POINTER(vp)]
+    dll.MXTStorageFree.argtypes = [vp, u64]
+    dll.MXTStorageStats.argtypes = [ctypes.POINTER(u64), ctypes.POINTER(u64)]
+    dll.MXTStorageReleaseAll.argtypes = []
+    # image iter
+    dll.MXTImageIterCreate.argtypes = [ctypes.POINTER(ImageIterParams),
+                                       ctypes.POINTER(vp)]
+    dll.MXTImageIterNext.argtypes = [vp, ctypes.POINTER(ctypes.c_float),
+                                     ctypes.POINTER(ctypes.c_float),
+                                     ctypes.POINTER(ctypes.c_int),
+                                     ctypes.POINTER(ctypes.c_int)]
+    dll.MXTImageIterReset.argtypes = [vp]
+    dll.MXTImageIterNumSamples.argtypes = [vp, ctypes.POINTER(u64)]
+    dll.MXTImageIterFree.argtypes = [vp]
+    dll.MXTImdecode.argtypes = [ctypes.c_char_p, u64,
+                                ctypes.POINTER(ctypes.c_ubyte),
+                                ctypes.POINTER(ctypes.c_int),
+                                ctypes.POINTER(ctypes.c_int)]
+    return dll
+
+
+def _load() -> "ctypes.CDLL | None":
+    if os.environ.get("MXNET_NATIVE_LIB_DISABLE", "0") == "1":
+        return None
+    with _lock:
+        if not os.path.exists(_LIB_PATH) and not _try_build():
+            return None
+        try:
+            return _declare(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            return None
+
+
+lib = _load()
+
+
+def available() -> bool:
+    return lib is not None
+
+
+def check_call(rc: int) -> None:
+    """Raise the native error as a Python exception (c_api_error analog)."""
+    if rc != 0:
+        msg = lib.MXTGetLastError().decode("utf-8", "replace")
+        raise RuntimeError(f"native runtime error: {msg}")

@@ -19,6 +19,7 @@ __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IndexedRecordIO",
            "IRHeader", "pack", "unpack", "pack_img", "unpack_img"]
 
 _MAGIC = 0xced7230a
+_MAGIC_BYTES = struct.pack("<I", _MAGIC)
 
 
 class MXRecordIO:
@@ -31,19 +32,38 @@ class MXRecordIO:
         self.open()
 
     def open(self):
+        from . import native
+        self._nh = None
         if self.flag == "w":
-            self.record = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.record = open(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError(f"invalid flag {self.flag}")
+        if native.available():
+            import ctypes
+            h = ctypes.c_void_p()
+            create = (native.lib.MXTRecordIOWriterCreate if self.writable
+                      else native.lib.MXTRecordIOReaderCreate)
+            native.check_call(create(self.uri.encode(), ctypes.byref(h)))
+            self._nh = h
+            self.record = True  # truthy marker: stream is open
+        else:
+            self.record = open(self.uri, "wb" if self.writable else "rb")
         self.pid = os.getpid()
 
     def close(self):
-        if self.record is not None:
+        if getattr(self, "_nh", None) is not None:
+            from . import native
+            free = (native.lib.MXTRecordIOWriterFree if self.writable
+                    else native.lib.MXTRecordIOReaderFree)
+            native.check_call(free(self._nh))
+            self._nh = None
+            self.record = None
+        elif self.record is not None and self.record is not True:
             self.record.close()
+            self.record = None
+        else:
             self.record = None
 
     def __del__(self):
@@ -52,6 +72,7 @@ class MXRecordIO:
     def __getstate__(self):
         d = dict(self.__dict__)
         d["record"] = None
+        d["_nh"] = None
         return d
 
     def __setstate__(self, d):
@@ -64,29 +85,84 @@ class MXRecordIO:
 
     def write(self, buf: bytes):
         assert self.writable
-        self.record.write(struct.pack("<II", _MAGIC, len(buf)))
-        self.record.write(buf)
-        pad = (4 - len(buf) % 4) % 4
-        if pad:
-            self.record.write(b"\x00" * pad)
+        if self._nh is not None:
+            from . import native
+            native.check_call(
+                native.lib.MXTRecordIOWriterWrite(self._nh, buf, len(buf)))
+            return
+        # pure-Python fallback: split payloads at magic words like dmlc
+        # recordio so readers can always resync (recordio.h SplitWrite)
+        splits = [i for i in range(0, len(buf) - 3, 4)
+                  if buf[i:i + 4] == _MAGIC_BYTES]
+        chunks = []
+        if not splits:
+            chunks.append((0, buf))
+        else:
+            bounds = [0] + [s for s in splits] + [len(buf)]
+            for k in range(len(bounds) - 1):
+                lo = bounds[k] + (4 if k > 0 else 0)
+                cflag = 1 if k == 0 else (3 if k == len(bounds) - 2 else 2)
+                chunks.append((cflag, buf[lo:bounds[k + 1]]))
+        for cflag, chunk in chunks:
+            lrec = (cflag << 29) | len(chunk)
+            self.record.write(struct.pack("<II", _MAGIC, lrec))
+            self.record.write(chunk)
+            pad = (4 - len(chunk) % 4) % 4
+            if pad:
+                self.record.write(b"\x00" * pad)
 
     def tell(self):
+        if self._nh is not None:
+            from . import native
+            import ctypes
+            pos = ctypes.c_uint64()
+            fn = (native.lib.MXTRecordIOWriterTell if self.writable
+                  else native.lib.MXTRecordIOReaderTell)
+            native.check_call(fn(self._nh, ctypes.byref(pos)))
+            return pos.value
         return self.record.tell()
+
+    def _seek(self, pos):
+        assert not self.writable
+        if self._nh is not None:
+            from . import native
+            native.check_call(native.lib.MXTRecordIOReaderSeek(self._nh, pos))
+        else:
+            self.record.seek(pos)
 
     def read(self):
         assert not self.writable
-        header = self.record.read(8)
-        if len(header) < 8:
-            return None
-        magic, lrec = struct.unpack("<II", header)
-        if magic != _MAGIC:
-            raise IOError(f"invalid record magic {magic:#x} in {self.uri}")
-        length = lrec & ((1 << 29) - 1)
-        buf = self.record.read(length)
-        pad = (4 - length % 4) % 4
-        if pad:
-            self.record.read(pad)
-        return buf
+        if self._nh is not None:
+            from . import native
+            import ctypes
+            buf = ctypes.c_void_p()
+            size = ctypes.c_uint64()
+            native.check_call(native.lib.MXTRecordIOReaderNext(
+                self._nh, ctypes.byref(buf), ctypes.byref(size)))
+            if not buf.value:
+                return None
+            return ctypes.string_at(buf.value, size.value)
+        parts = []
+        multipart = False
+        while True:
+            header = self.record.read(8)
+            if len(header) < 8:
+                return None if not multipart else b"".join(parts)
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _MAGIC:
+                raise IOError(f"invalid record magic {magic:#x} in {self.uri}")
+            cflag = (lrec >> 29) & 7
+            length = lrec & ((1 << 29) - 1)
+            if multipart:
+                parts.append(_MAGIC_BYTES)
+            parts.append(self.record.read(length))
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.record.read(pad)
+            if cflag in (0, 3):
+                break
+            multipart = True
+        return b"".join(parts)
 
 
 class MXIndexedRecordIO(MXRecordIO):
@@ -115,7 +191,7 @@ class MXIndexedRecordIO(MXRecordIO):
         super().close()
 
     def seek(self, idx):
-        self.record.seek(self.idx[idx])
+        self._seek(self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)

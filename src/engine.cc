@@ -1,0 +1,388 @@
+// Threaded dependency engine — the TPU-native re-design of the reference
+// scheduler (src/engine/threaded_engine.h:101-229, threaded_engine.cc).
+// Semantics preserved:
+//   * ops declare const (read) and mutable (write) vars;
+//   * concurrent readers of one version run in parallel, writers are
+//     exclusive and bump the version (engine.h:44-61 Var versioning);
+//   * priority ordering in the ready queue (engine.h:189);
+//   * exceptions stick to the vars an op would have written and rethrow
+//     at WaitForVar/WaitForAll (threaded_engine.cc:422-522); ops whose
+//     inputs carry an exception are skipped and propagate it.
+// What is NOT re-created: per-device worker pools / CUDA streams — device
+// async belongs to PJRT; this engine orders host-side closures (data
+// pipeline stages, Python callbacks, checkpoint IO) around it.
+#include <atomic>
+#include <condition_variable>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "error.h"
+#include "include/mxt/c_api.h"
+
+namespace mxt {
+
+struct OpBlock;
+
+struct Var {
+  std::mutex mu;
+  // waiting ops: (op, is_write). Head run of reads may proceed together.
+  std::deque<std::pair<OpBlock*, bool>> queue;
+  int pending_reads = 0;
+  int pending_writes = 0;
+  std::atomic<uint64_t> version{0};
+  std::string exception;  // sticky error message, "" = none
+  bool has_exception = false;
+  bool to_delete = false;  // freed by the last ReleaseVar once drained
+};
+
+struct OpBlock {
+  MXTEngineFn fn;
+  void* ctx;
+  std::vector<Var*> const_vars;
+  std::vector<Var*> mutable_vars;
+  int priority;
+  uint64_t seq;  // FIFO tiebreak within a priority level
+  std::atomic<int> wait_count{0};
+  // wait-probes must execute even when an input var carries an
+  // exception, or the waiter would never wake (user ops are skipped and
+  // propagate instead, threaded_engine.cc:481-522)
+  bool always_run = false;
+};
+
+struct OpCompare {
+  bool operator()(const OpBlock* a, const OpBlock* b) const {
+    if (a->priority != b->priority) return a->priority < b->priority;
+    return a->seq > b->seq;  // earlier push first
+  }
+};
+
+class ThreadedEngine {
+ public:
+  explicit ThreadedEngine(int num_workers) {
+    if (num_workers <= 0) {
+      unsigned hw = std::thread::hardware_concurrency();
+      num_workers = hw ? static_cast<int>(hw) : 2;
+    }
+    for (int i = 0; i < num_workers; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~ThreadedEngine() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      shutdown_ = true;
+      cv_.notify_all();
+    }
+    for (auto& t : workers_) t.join();
+    for (Var* v : all_vars_) delete v;
+  }
+
+  Var* NewVar() {
+    Var* v = new Var();
+    std::lock_guard<std::mutex> lk(vars_mu_);
+    all_vars_.push_back(v);
+    return v;
+  }
+
+  void Push(MXTEngineFn fn, void* ctx, std::vector<Var*> cvars,
+            std::vector<Var*> mvars, int priority, bool always_run = false) {
+    auto* op = new OpBlock();
+    op->fn = fn;
+    op->ctx = ctx;
+    op->const_vars = std::move(cvars);
+    op->mutable_vars = std::move(mvars);
+    op->priority = priority;
+    op->always_run = always_run;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      op->seq = next_seq_++;
+      ++inflight_;
+    }
+    // Register dependencies (AppendRead/WriteDependency,
+    // threaded_engine.h:136-165). wait_count starts at 1 (guard) + one
+    // per dep BEFORE any registration, so a concurrent ReleaseVar
+    // satisfying a just-queued dep can never drive it to zero while we
+    // are still registering the remaining vars.
+    op->wait_count.store(
+        1 + static_cast<int>(op->const_vars.size() + op->mutable_vars.size()),
+        std::memory_order_relaxed);
+    for (Var* v : op->const_vars) {
+      bool ready;
+      {
+        std::lock_guard<std::mutex> lk(v->mu);
+        ready = v->pending_writes == 0 && v->queue.empty();
+        if (ready)
+          ++v->pending_reads;
+        else
+          v->queue.emplace_back(op, false);
+      }
+      if (ready) op->wait_count.fetch_sub(1, std::memory_order_acq_rel);
+    }
+    for (Var* v : op->mutable_vars) {
+      bool ready;
+      {
+        std::lock_guard<std::mutex> lk(v->mu);
+        ready = v->pending_writes == 0 && v->pending_reads == 0 &&
+                v->queue.empty();
+        if (ready)
+          ++v->pending_writes;
+        else
+          v->queue.emplace_back(op, true);
+      }
+      if (ready) op->wait_count.fetch_sub(1, std::memory_order_acq_rel);
+    }
+    // drop the guard; if no dep remained (or all resolved already), run
+    if (op->wait_count.fetch_sub(1, std::memory_order_acq_rel) == 1)
+      Enqueue(op);
+  }
+
+  void WaitForVar(Var* v) {
+    // Push a read probe and wait for it (Engine::WaitForVar semantics).
+    std::mutex done_mu;
+    std::condition_variable done_cv;
+    bool done = false;
+    struct Probe {
+      std::mutex* mu;
+      std::condition_variable* cv;
+      bool* done;
+    } probe{&done_mu, &done_cv, &done};
+    auto fn = [](void* ctx, char**) {
+      auto* p = static_cast<Probe*>(ctx);
+      std::lock_guard<std::mutex> lk(*p->mu);
+      *p->done = true;
+      p->cv->notify_all();
+    };
+    Push(fn, &probe, {v}, {}, /*priority=*/0x7fffffff, /*always_run=*/true);
+    std::unique_lock<std::mutex> lk(done_mu);
+    done_cv.wait(lk, [&] { return done; });
+    std::lock_guard<std::mutex> vlk(v->mu);
+    if (v->has_exception) {
+      // pop on first rethrow (MXNet clears var exceptions once surfaced,
+      // threaded_engine.cc:433-440) so a handled error doesn't poison
+      // every later wait on the same array
+      std::string msg = std::move(v->exception);
+      v->has_exception = false;
+      v->exception.clear();
+      throw std::runtime_error(msg);
+    }
+  }
+
+  void WaitAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    idle_cv_.wait(lk, [&] { return inflight_ == 0; });
+    std::lock_guard<std::mutex> vlk(vars_mu_);
+    for (Var* v : all_vars_) {
+      std::lock_guard<std::mutex> lk2(v->mu);
+      if (v->has_exception) {
+        std::string msg = std::move(v->exception);
+        v->has_exception = false;
+        v->exception.clear();
+        throw std::runtime_error(msg);
+      }
+    }
+  }
+
+  void DeleteVar(Var* v) {
+    // Unlink from the registry now; free once all pending ops drain
+    // (Engine::DeleteVariable ordering, engine.h:232-244). The last
+    // ReleaseVar claims the deletion under the var lock.
+    {
+      std::lock_guard<std::mutex> lk(vars_mu_);
+      for (auto it = all_vars_.begin(); it != all_vars_.end(); ++it) {
+        if (*it == v) {
+          all_vars_.erase(it);
+          break;
+        }
+      }
+    }
+    bool free_now;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      v->to_delete = true;
+      free_now = v->queue.empty() && v->pending_reads == 0 &&
+                 v->pending_writes == 0;
+      if (free_now) v->to_delete = false;  // claim
+    }
+    if (free_now) delete v;
+  }
+
+ private:
+  void Enqueue(OpBlock* op) {
+    std::lock_guard<std::mutex> lk(mu_);
+    ready_.push(op);
+    cv_.notify_one();
+  }
+
+  void SatisfyDep(OpBlock* op) {
+    if (op->wait_count.fetch_sub(1, std::memory_order_acq_rel) == 1)
+      Enqueue(op);
+  }
+
+  // CompleteRead/WriteDependency (threaded_engine.h:146-165): release the
+  // var and wake the next run of readers or the next writer.
+  void ReleaseVar(Var* v, bool was_write, const char* err) {
+    std::vector<OpBlock*> to_wake;
+    bool free_now = false;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (was_write) {
+        --v->pending_writes;
+        v->version.fetch_add(1, std::memory_order_relaxed);
+        if (err && !v->has_exception) {
+          v->exception = err;
+          v->has_exception = true;
+        }
+      } else {
+        --v->pending_reads;
+      }
+      while (!v->queue.empty()) {
+        OpBlock* op = v->queue.front().first;
+        bool is_write = v->queue.front().second;
+        if (is_write) {
+          if (v->pending_reads == 0 && v->pending_writes == 0) {
+            v->queue.pop_front();
+            ++v->pending_writes;
+            to_wake.push_back(op);
+          }
+          break;
+        }
+        if (v->pending_writes > 0) break;
+        v->queue.pop_front();
+        ++v->pending_reads;
+        to_wake.push_back(op);
+      }
+      if (v->to_delete && v->queue.empty() && v->pending_reads == 0 &&
+          v->pending_writes == 0) {
+        v->to_delete = false;  // claim the deletion
+        free_now = true;
+      }
+    }
+    for (OpBlock* op : to_wake) SatisfyDep(op);
+    if (free_now) delete v;
+  }
+
+  void WorkerLoop() {
+    while (true) {
+      OpBlock* op;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return shutdown_ || !ready_.empty(); });
+        if (shutdown_ && ready_.empty()) return;
+        op = ready_.top();
+        ready_.pop();
+      }
+      // Exception propagation: if any input/output var already failed,
+      // skip the body and spread the message (threaded_engine.cc:481-522).
+      const char* upstream = nullptr;
+      std::string upstream_msg;
+      if (!op->always_run) {
+        for (Var* v : op->const_vars) {
+          std::lock_guard<std::mutex> lk(v->mu);
+          if (v->has_exception) {
+            upstream_msg = v->exception;
+            upstream = upstream_msg.c_str();
+            break;
+          }
+        }
+        if (!upstream)
+          for (Var* v : op->mutable_vars) {
+            std::lock_guard<std::mutex> lk(v->mu);
+            if (v->has_exception) {
+              upstream_msg = v->exception;
+              upstream = upstream_msg.c_str();
+              break;
+            }
+          }
+      }
+      char* err = nullptr;
+      if (!upstream) {
+        op->fn(op->ctx, &err);
+      }
+      const char* msg = upstream ? upstream : err;
+      for (Var* v : op->const_vars) ReleaseVar(v, false, nullptr);
+      for (Var* v : op->mutable_vars) ReleaseVar(v, true, msg);
+      if (err) std::free(err);
+      delete op;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (--inflight_ == 0) idle_cv_.notify_all();
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_, idle_cv_;
+  std::priority_queue<OpBlock*, std::vector<OpBlock*>, OpCompare> ready_;
+  uint64_t next_seq_ = 0;
+  int inflight_ = 0;
+  bool shutdown_ = false;
+  std::vector<std::thread> workers_;
+  std::mutex vars_mu_;
+  std::vector<Var*> all_vars_;
+};
+
+}  // namespace mxt
+
+// ---------------- C ABI ------------------------------------------------
+
+int MXTEngineCreate(int num_workers, EngineHandle* out) {
+  MXT_API_BEGIN();
+  *out = new mxt::ThreadedEngine(num_workers);
+  MXT_API_END();
+}
+
+int MXTEngineNewVar(EngineHandle e, VarHandle* out) {
+  MXT_API_BEGIN();
+  *out = static_cast<mxt::ThreadedEngine*>(e)->NewVar();
+  MXT_API_END();
+}
+
+int MXTEngineVarVersion(EngineHandle, VarHandle v, uint64_t* out) {
+  MXT_API_BEGIN();
+  *out = static_cast<mxt::Var*>(v)->version.load();
+  MXT_API_END();
+}
+
+int MXTEnginePush(EngineHandle e, MXTEngineFn fn, void* ctx,
+                  VarHandle* const_vars, int num_const,
+                  VarHandle* mutable_vars, int num_mutable, int priority) {
+  MXT_API_BEGIN();
+  std::vector<mxt::Var*> cv(num_const), mv(num_mutable);
+  for (int i = 0; i < num_const; ++i) cv[i] = static_cast<mxt::Var*>(const_vars[i]);
+  for (int i = 0; i < num_mutable; ++i) mv[i] = static_cast<mxt::Var*>(mutable_vars[i]);
+  static_cast<mxt::ThreadedEngine*>(e)->Push(fn, ctx, std::move(cv), std::move(mv),
+                                             priority);
+  MXT_API_END();
+}
+
+int MXTEngineWaitForVar(EngineHandle e, VarHandle v) {
+  MXT_API_BEGIN();
+  static_cast<mxt::ThreadedEngine*>(e)->WaitForVar(static_cast<mxt::Var*>(v));
+  MXT_API_END();
+}
+
+int MXTEngineWaitAll(EngineHandle e) {
+  MXT_API_BEGIN();
+  static_cast<mxt::ThreadedEngine*>(e)->WaitAll();
+  MXT_API_END();
+}
+
+int MXTEngineDeleteVar(EngineHandle e, VarHandle v) {
+  MXT_API_BEGIN();
+  static_cast<mxt::ThreadedEngine*>(e)->DeleteVar(static_cast<mxt::Var*>(v));
+  MXT_API_END();
+}
+
+int MXTEngineFree(EngineHandle e) {
+  MXT_API_BEGIN();
+  delete static_cast<mxt::ThreadedEngine*>(e);
+  MXT_API_END();
+}

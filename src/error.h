@@ -1,0 +1,32 @@
+// Thread-local error plumbing behind the C ABI (role of the reference's
+// src/c_api/c_api_error.cc error ring).
+#ifndef MXT_ERROR_H_
+#define MXT_ERROR_H_
+
+#include <exception>
+#include <stdexcept>
+#include <string>
+
+namespace mxt {
+
+std::string& LastError();
+void SetLastError(const std::string& msg);
+
+}  // namespace mxt
+
+// Every C ABI entry point wraps its body so C++ exceptions become rc=-1
+// plus MXTGetLastError().
+#define MXT_API_BEGIN() try {
+#define MXT_API_END()                         \
+  }                                           \
+  catch (const std::exception& e) {           \
+    mxt::SetLastError(e.what());              \
+    return -1;                                \
+  }                                           \
+  catch (...) {                               \
+    mxt::SetLastError("unknown C++ exception"); \
+    return -1;                                \
+  }                                           \
+  return 0;
+
+#endif  // MXT_ERROR_H_

@@ -1,0 +1,485 @@
+// Multi-threaded image-record iterator: the TPU-native counterpart of
+// the reference's ImageRecordIOParser2 + BatchLoader + PrefetcherIter
+// stack (src/io/iter_image_recordio_2.cc:52-179, iter_batchloader.h,
+// iter_prefetcher.h). Differences by design: decode workers write
+// directly into per-batch NCHW float buffers (no intermediate NDArray),
+// and the prefetch queue hands whole batches to Python, which device_puts
+// them — PJRT's async transfer gives the compute/IO overlap the reference
+// got from engine-tracked prefetch NDArrays.
+//
+// Record payload layout matches python/mxnet/recordio.py pack():
+//   IRHeader = [flag:u32][label:f32][id:u64][id2:u64]  (24 bytes, LE)
+//   if flag > 0: `flag` float32 labels follow, then the encoded image.
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "error.h"
+#include "include/mxt/c_api.h"
+
+namespace mxt {
+
+static const uint32_t kMagic = 0xced7230a;
+
+// ---------------- JPEG decode (libjpeg, memory source) -----------------
+
+struct JpegErrorMgr {
+  jpeg_error_mgr pub;
+  std::jmp_buf jmp;
+};
+
+static void JpegErrorExit(j_common_ptr cinfo) {
+  auto* mgr = reinterpret_cast<JpegErrorMgr*>(cinfo->err);
+  std::longjmp(mgr->jmp, 1);
+}
+
+// Decode JPEG bytes to HWC RGB uint8. Throws on malformed input.
+static void DecodeJpeg(const unsigned char* buf, uint64_t size,
+                       std::vector<unsigned char>* out, int* h, int* w) {
+  jpeg_decompress_struct cinfo;
+  JpegErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = JpegErrorExit;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_decompress(&cinfo);
+    throw std::runtime_error("jpeg decode failed");
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf),
+               static_cast<unsigned long>(size));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = static_cast<int>(cinfo.output_width);
+  *h = static_cast<int>(cinfo.output_height);
+  out->resize(static_cast<size_t>(*w) * *h * 3);
+  size_t stride = static_cast<size_t>(*w) * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row = out->data() + cinfo.output_scanline * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+}
+
+// Bilinear resize HWC uint8 RGB.
+static void ResizeBilinear(const unsigned char* src, int sh, int sw,
+                           unsigned char* dst, int dh, int dw) {
+  float ys = dh > 1 ? static_cast<float>(sh - 1) / (dh - 1) : 0.f;
+  float xs = dw > 1 ? static_cast<float>(sw - 1) / (dw - 1) : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    float fy = y * ys;
+    int y0 = static_cast<int>(fy);
+    int y1 = std::min(y0 + 1, sh - 1);
+    float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = x * xs;
+      int x0 = static_cast<int>(fx);
+      int x1 = std::min(x0 + 1, sw - 1);
+      float wx = fx - x0;
+      for (int c = 0; c < 3; ++c) {
+        float v00 = src[(y0 * sw + x0) * 3 + c];
+        float v01 = src[(y0 * sw + x1) * 3 + c];
+        float v10 = src[(y1 * sw + x0) * 3 + c];
+        float v11 = src[(y1 * sw + x1) * 3 + c];
+        float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                  v10 * wy * (1 - wx) + v11 * wy * wx;
+        dst[(y * dw + x) * 3 + c] =
+            static_cast<unsigned char>(v + 0.5f);
+      }
+    }
+  }
+}
+
+// ---------------- Iterator ---------------------------------------------
+
+struct Batch {
+  std::vector<float> data;
+  std::vector<float> label;
+  int count = 0;                // slots filled
+  int pad = 0;                  // trailing wrap-around duplicates
+  std::atomic<int> remaining{0};
+  std::string error;
+  std::mutex err_mu;
+};
+
+class ImageRecordIter {
+ public:
+  explicit ImageRecordIter(const MXTImageIterParams& p) : p_(p) {
+    if (p_.channels != 3 && p_.channels != 1)
+      throw std::runtime_error("channels must be 1 or 3");
+    if (p_.label_width <= 0) p_.label_width = 1;
+    if (p_.num_threads <= 0) {
+      unsigned hw = std::thread::hardware_concurrency();
+      p_.num_threads = hw > 1 ? static_cast<int>(hw) : 2;
+    }
+    if (p_.prefetch <= 0) p_.prefetch = 4;
+    IndexFile();
+    rng_.seed(p_.seed ? p_.seed : 5489u);
+    Reset();
+    for (int i = 0; i < p_.num_threads; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~ImageRecordIter() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      shutdown_ = true;
+    }
+    task_cv_.notify_all();
+    ready_cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  uint64_t NumSamples() const { return offsets_.size(); }
+
+  void Reset() {
+    std::unique_lock<std::mutex> lk(mu_);
+    // wait until all scheduled decode work drained before reshuffling
+    drain_cv_.wait(lk, [&] { return tasks_.empty() && inflight_tasks_ == 0; });
+    order_.resize(offsets_.size());
+    for (size_t i = 0; i < order_.size(); ++i) order_[i] = i;
+    if (p_.shuffle) std::shuffle(order_.begin(), order_.end(), rng_);
+    ready_.clear();
+    pending_.clear();
+    cursor_ = 0;
+    next_emit_ = 0;
+    next_sched_ = 0;
+    ScheduleLocked();
+  }
+
+  // Returns slot count (0 = epoch end). Copies into caller memory.
+  int Next(float* data, float* label, int* pad) {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (true) {
+      auto it = ready_.find(next_emit_);
+      if (it != ready_.end()) {
+        std::shared_ptr<Batch> b = it->second;
+        ready_.erase(it);
+        ++next_emit_;
+        ScheduleLocked();
+        lk.unlock();
+        if (!b->error.empty()) throw std::runtime_error(b->error);
+        std::memcpy(data, b->data.data(), b->data.size() * sizeof(float));
+        std::memcpy(label, b->label.data(), b->label.size() * sizeof(float));
+        if (pad) *pad = b->pad;
+        return b->count;
+      }
+      if (next_emit_ >= total_batches_) return 0;  // epoch end
+      ready_cv_.wait(lk);
+    }
+  }
+
+ private:
+  struct Task {
+    uint64_t sample;            // index into order_
+    std::shared_ptr<Batch> batch;
+    int slot;
+  };
+
+  // Scan the recordio file once, remembering each record's (offset, len).
+  void IndexFile() {
+    std::FILE* fp = std::fopen(p_.path_imgrec, "rb");
+    if (!fp)
+      throw std::runtime_error(std::string("cannot open ") + p_.path_imgrec);
+    uint64_t pos = 0;
+    while (true) {
+      uint32_t header[2];
+      if (std::fread(header, 4, 2, fp) != 2) break;
+      if (header[0] != kMagic) break;
+      uint32_t len = header[1] & ((1u << 29u) - 1u);
+      uint32_t cflag = (header[1] >> 29u) & 7u;
+      uint64_t pad = (4 - (len & 3)) & 3;
+      if (cflag == 0 || cflag == 1) offsets_.push_back(pos);
+      pos += 8 + len + pad;
+      if (std::fseek(fp, static_cast<long>(pos), SEEK_SET) != 0) break;
+    }
+    std::fclose(fp);
+    if (offsets_.empty())
+      throw std::runtime_error("no records found in imgrec file");
+    fd_ = std::fopen(p_.path_imgrec, "rb");
+    total_batches_ =
+        (offsets_.size() + p_.batch_size - 1) / p_.batch_size;
+  }
+
+  // Schedule decode tasks for up to `prefetch` batches ahead (holding mu_).
+  void ScheduleLocked() {
+    while (next_sched_ < total_batches_ &&
+           next_sched_ < next_emit_ + static_cast<uint64_t>(p_.prefetch)) {
+      uint64_t b = next_sched_++;
+      uint64_t begin = b * p_.batch_size;
+      uint64_t end = std::min<uint64_t>(begin + p_.batch_size, order_.size());
+      int count = static_cast<int>(end - begin);
+      auto batch = std::make_shared<Batch>();
+      size_t dsz = static_cast<size_t>(p_.batch_size) * p_.channels *
+                   p_.height * p_.width;
+      batch->data.assign(dsz, 0.f);
+      batch->label.assign(static_cast<size_t>(p_.batch_size) * p_.label_width,
+                          0.f);
+      int fill = p_.batch_size;
+      if (!p_.round_batch) fill = count;
+      batch->count = fill;
+      batch->pad = fill - count;  // wrap-around duplicates (num_batch_padd)
+      batch->remaining.store(fill, std::memory_order_relaxed);
+      pending_[b] = batch;
+      for (int s = 0; s < fill; ++s) {
+        uint64_t sample_pos;
+        if (static_cast<uint64_t>(s) < end - begin) {
+          sample_pos = order_[begin + s];
+        } else {
+          // round_batch: wrap tail from the epoch start (io.cc round_batch)
+          sample_pos = order_[(begin + s) % order_.size()];
+        }
+        tasks_.push_back(Task{sample_pos, batch, s});
+        ++inflight_tasks_;
+      }
+      task_cv_.notify_all();
+    }
+  }
+
+  void WorkerLoop() {
+    std::mt19937 lrng(std::random_device{}());
+    std::vector<unsigned char> raw, decoded, resized, payload;
+    while (true) {
+      Task t;
+      uint64_t batch_id = 0;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        task_cv_.wait(lk, [&] { return shutdown_ || !tasks_.empty(); });
+        if (shutdown_) return;
+        t = tasks_.front();
+        tasks_.pop_front();
+      }
+      try {
+        ReadRecord(t.sample, &payload);
+        ProcessSample(payload, t, lrng);
+      } catch (const std::exception& e) {
+        std::lock_guard<std::mutex> lk(t.batch->err_mu);
+        if (t.batch->error.empty()) t.batch->error = e.what();
+      }
+      bool batch_done =
+          t.batch->remaining.fetch_sub(1, std::memory_order_acq_rel) == 1;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        --inflight_tasks_;
+        if (inflight_tasks_ == 0 && tasks_.empty()) drain_cv_.notify_all();
+        if (batch_done) {
+          // find this batch's id and move pending → ready
+          for (auto it = pending_.begin(); it != pending_.end(); ++it) {
+            if (it->second == t.batch) {
+              batch_id = it->first;
+              ready_[batch_id] = it->second;
+              pending_.erase(it);
+              ready_cv_.notify_all();
+              break;
+            }
+          }
+        }
+      }
+    }
+  }
+
+  // pread-style random record fetch (thread-safe via file mutex; decode
+  // dominates, so serialized reads are fine even multi-threaded).
+  void ReadRecord(uint64_t sample, std::vector<unsigned char>* payload) {
+    uint64_t off = offsets_[sample];
+    std::lock_guard<std::mutex> lk(file_mu_);
+    if (std::fseek(fd_, static_cast<long>(off), SEEK_SET) != 0)
+      throw std::runtime_error("seek failed");
+    payload->clear();
+    bool multipart = false;
+    while (true) {
+      uint32_t header[2];
+      if (std::fread(header, 4, 2, fd_) != 2)
+        throw std::runtime_error("truncated record header");
+      if (header[0] != kMagic) throw std::runtime_error("bad record magic");
+      uint32_t cflag = (header[1] >> 29u) & 7u;
+      uint32_t len = header[1] & ((1u << 29u) - 1u);
+      size_t old = payload->size();
+      if (multipart) {
+        payload->resize(old + 4 + len);
+        std::memcpy(payload->data() + old, &kMagic, 4);
+        old += 4;
+      } else {
+        payload->resize(len);
+      }
+      if (len && std::fread(payload->data() + old, 1, len, fd_) != len)
+        throw std::runtime_error("truncated record payload");
+      uint64_t pad = (4 - (len & 3)) & 3;
+      if (pad) std::fseek(fd_, static_cast<long>(pad), SEEK_CUR);
+      if (cflag == 0 || cflag == 3) break;
+      multipart = true;
+    }
+  }
+
+  void ProcessSample(const std::vector<unsigned char>& payload, const Task& t,
+                     std::mt19937& lrng) {
+    if (payload.size() < 24) throw std::runtime_error("record too short");
+    uint32_t flag;
+    float label0;
+    std::memcpy(&flag, payload.data(), 4);
+    std::memcpy(&label0, payload.data() + 4, 4);
+    size_t img_off = 24;
+    float* lbl = t.batch->label.data() +
+                 static_cast<size_t>(t.slot) * p_.label_width;
+    if (flag == 0) {
+      lbl[0] = label0;
+    } else {
+      if (payload.size() < 24 + 4ull * flag)
+        throw std::runtime_error("record labels truncated");
+      for (uint32_t i = 0; i < flag && i < static_cast<uint32_t>(p_.label_width);
+           ++i)
+        std::memcpy(&lbl[i], payload.data() + 24 + 4ull * i, 4);
+      img_off += 4ull * flag;
+    }
+    // decode
+    std::vector<unsigned char> decoded;
+    int h = 0, w = 0;
+    DecodeJpeg(payload.data() + img_off, payload.size() - img_off, &decoded,
+               &h, &w);
+    // resize: shorter side to p_.resize (keeping aspect) or direct
+    std::vector<unsigned char> sized;
+    int rh, rw;
+    if (p_.resize > 0) {
+      if (h < w) {
+        rh = p_.resize;
+        rw = static_cast<int>(std::lround(static_cast<double>(w) * rh / h));
+      } else {
+        rw = p_.resize;
+        rh = static_cast<int>(std::lround(static_cast<double>(h) * rw / w));
+      }
+    } else {
+      rh = p_.height;
+      rw = p_.width;
+    }
+    rh = std::max(rh, p_.height);
+    rw = std::max(rw, p_.width);
+    sized.resize(static_cast<size_t>(rh) * rw * 3);
+    ResizeBilinear(decoded.data(), h, w, sized.data(), rh, rw);
+    // crop to (height, width)
+    int y0, x0;
+    if (p_.rand_crop) {
+      y0 = rh > p_.height
+               ? std::uniform_int_distribution<int>(0, rh - p_.height)(lrng)
+               : 0;
+      x0 = rw > p_.width
+               ? std::uniform_int_distribution<int>(0, rw - p_.width)(lrng)
+               : 0;
+    } else {
+      y0 = (rh - p_.height) / 2;
+      x0 = (rw - p_.width) / 2;
+    }
+    bool mirror =
+        p_.rand_mirror && std::uniform_int_distribution<int>(0, 1)(lrng);
+    // normalize + NCHW write into the batch slot
+    float mean[3] = {p_.mean_r, p_.mean_g, p_.mean_b};
+    float stdv[3] = {p_.std_r > 0 ? p_.std_r : 1.f,
+                     p_.std_g > 0 ? p_.std_g : 1.f,
+                     p_.std_b > 0 ? p_.std_b : 1.f};
+    float inv_scale = p_.scale > 0 ? 1.f / p_.scale : 1.f;
+    size_t plane = static_cast<size_t>(p_.height) * p_.width;
+    float* out = t.batch->data.data() +
+                 static_cast<size_t>(t.slot) * p_.channels * plane;
+    for (int y = 0; y < p_.height; ++y) {
+      for (int x = 0; x < p_.width; ++x) {
+        int sx = mirror ? (p_.width - 1 - x) : x;
+        const unsigned char* px =
+            sized.data() + ((y0 + y) * static_cast<size_t>(rw) + x0 + sx) * 3;
+        if (p_.channels == 3) {
+          for (int c = 0; c < 3; ++c)
+            out[c * plane + y * p_.width + x] =
+                (px[c] * inv_scale - mean[c]) / stdv[c];
+        } else {
+          float grey = 0.299f * px[0] + 0.587f * px[1] + 0.114f * px[2];
+          out[y * p_.width + x] = (grey * inv_scale - mean[0]) / stdv[0];
+        }
+      }
+    }
+  }
+
+  MXTImageIterParams p_;
+  std::vector<uint64_t> offsets_;
+  std::vector<uint64_t> order_;
+  std::FILE* fd_ = nullptr;
+  std::mutex file_mu_;
+  std::mt19937_64 rng_;
+
+  std::mutex mu_;
+  std::condition_variable task_cv_, ready_cv_, drain_cv_;
+  std::deque<Task> tasks_;
+  std::map<uint64_t, std::shared_ptr<Batch>> pending_, ready_;
+  uint64_t cursor_ = 0, next_emit_ = 0, next_sched_ = 0, total_batches_ = 0;
+  int inflight_tasks_ = 0;
+  bool shutdown_ = false;
+  std::vector<std::thread> workers_;
+};
+
+}  // namespace mxt
+
+// ---------------- C ABI ------------------------------------------------
+
+int MXTImageIterCreate(const MXTImageIterParams* p, ImageIterHandle* out) {
+  MXT_API_BEGIN();
+  *out = new mxt::ImageRecordIter(*p);
+  MXT_API_END();
+}
+
+int MXTImageIterNext(ImageIterHandle h, float* data, float* label,
+                     int* out_count, int* out_pad) {
+  MXT_API_BEGIN();
+  *out_count =
+      static_cast<mxt::ImageRecordIter*>(h)->Next(data, label, out_pad);
+  MXT_API_END();
+}
+
+int MXTImageIterReset(ImageIterHandle h) {
+  MXT_API_BEGIN();
+  static_cast<mxt::ImageRecordIter*>(h)->Reset();
+  MXT_API_END();
+}
+
+int MXTImageIterNumSamples(ImageIterHandle h, uint64_t* out) {
+  MXT_API_BEGIN();
+  *out = static_cast<mxt::ImageRecordIter*>(h)->NumSamples();
+  MXT_API_END();
+}
+
+int MXTImageIterFree(ImageIterHandle h) {
+  MXT_API_BEGIN();
+  delete static_cast<mxt::ImageRecordIter*>(h);
+  MXT_API_END();
+}
+
+int MXTImdecode(const char* buf, uint64_t size, unsigned char* out, int* h,
+                int* w) {
+  MXT_API_BEGIN();
+  std::vector<unsigned char> decoded;
+  int hh, ww;
+  mxt::DecodeJpeg(reinterpret_cast<const unsigned char*>(buf), size, &decoded,
+                  &hh, &ww);
+  if (out) {
+    if (*h < hh || *w < ww) throw std::runtime_error("imdecode buffer too small");
+    std::memcpy(out, decoded.data(), decoded.size());
+  }
+  *h = hh;
+  *w = ww;
+  MXT_API_END();
+}

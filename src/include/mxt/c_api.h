@@ -1,0 +1,111 @@
+/* C ABI for the TPU-native runtime library (libmxtpu).
+ *
+ * Role parity with the reference's C API boundary (include/mxnet/c_api.h):
+ * every function returns 0 on success, -1 on failure with the message
+ * retrievable via MXTGetLastError() (reference src/c_api/c_api_error.cc).
+ * Consumed from Python via ctypes (incubator_mxnet_tpu/native/__init__.py).
+ */
+#ifndef MXT_C_API_H_
+#define MXT_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* RecordIOHandle;
+typedef void* EngineHandle;
+typedef void* VarHandle;
+typedef void* ImageIterHandle;
+
+/* Thread-local last-error message (reference src/c_api/c_api_error.cc). */
+const char* MXTGetLastError(void);
+
+/* ---------------- RecordIO (dmlc-core recordio wire format) ----------- */
+/* [magic:u32][cflag:3|len:29][data][pad to 4]; records longer than the
+ * chunk bound are split with cflag start/middle/end markers. */
+int MXTRecordIOWriterCreate(const char* uri, RecordIOHandle* out);
+int MXTRecordIOWriterWrite(RecordIOHandle h, const char* buf, uint64_t size);
+int MXTRecordIOWriterTell(RecordIOHandle h, uint64_t* pos);
+int MXTRecordIOWriterFree(RecordIOHandle h);
+
+int MXTRecordIOReaderCreate(const char* uri, RecordIOHandle* out);
+/* Read next record. On EOF returns 0 with *size = 0 and *buf = NULL.
+ * The returned buffer is owned by the reader until the next call. */
+int MXTRecordIOReaderNext(RecordIOHandle h, const char** buf, uint64_t* size);
+int MXTRecordIOReaderSeek(RecordIOHandle h, uint64_t pos);
+int MXTRecordIOReaderTell(RecordIOHandle h, uint64_t* pos);
+int MXTRecordIOReaderFree(RecordIOHandle h);
+
+/* ---------------- Dependency engine ----------------------------------- */
+/* Async scheduler preserving the reference Engine semantics
+ * (include/mxnet/engine.h:117-318): ops declare const (read) and mutable
+ * (write) vars; readers of one version run concurrently, writers are
+ * exclusive and bump the version; exceptions stick to vars and rethrow
+ * at wait points (threaded_engine.cc:422-522). */
+typedef void (*MXTEngineFn)(void* ctx, char** err_msg /* strdup'd */);
+
+int MXTEngineCreate(int num_workers, EngineHandle* out);
+int MXTEngineNewVar(EngineHandle e, VarHandle* out);
+int MXTEngineVarVersion(EngineHandle e, VarHandle v, uint64_t* out);
+int MXTEnginePush(EngineHandle e, MXTEngineFn fn, void* ctx,
+                  VarHandle* const_vars, int num_const,
+                  VarHandle* mutable_vars, int num_mutable, int priority);
+/* Blocks until all ops touching v completed; rc != 0 if an exception is
+ * stored on the var (message via MXTGetLastError). */
+int MXTEngineWaitForVar(EngineHandle e, VarHandle v);
+int MXTEngineWaitAll(EngineHandle e);
+int MXTEngineDeleteVar(EngineHandle e, VarHandle v);
+int MXTEngineFree(EngineHandle e);
+
+/* ---------------- Pooled host storage --------------------------------- */
+/* Size-bucketed recycling pool for staging buffers (reference
+ * src/storage/pooled_storage_manager.h:53-214, CPU analog). */
+int MXTStorageAlloc(uint64_t size, void** out);
+int MXTStorageFree(void* ptr, uint64_t size);
+int MXTStorageStats(uint64_t* bytes_allocated, uint64_t* bytes_pooled);
+int MXTStorageReleaseAll(void);
+
+/* ---------------- ImageRecordIter pipeline ----------------------------- */
+/* Multi-threaded JPEG decode + augment + batch + prefetch, the
+ * counterpart of src/io/iter_image_recordio_2.cc + iter_batchloader.h +
+ * iter_prefetcher.h. Output is NCHW float32, (x/scale - mean)/std. */
+typedef struct {
+  const char* path_imgrec;
+  int batch_size;
+  int channels, height, width;   /* data_shape */
+  float mean_r, mean_g, mean_b;
+  float std_r, std_g, std_b;
+  float scale;                   /* divide raw pixels first; 1 = none */
+  int resize;                    /* shorter-side resize; 0 = direct resize */
+  int rand_crop, rand_mirror, shuffle;
+  int round_batch;               /* wrap tail batch from epoch start */
+  int num_threads, prefetch;
+  uint64_t seed;
+  int label_width;
+} MXTImageIterParams;
+
+int MXTImageIterCreate(const MXTImageIterParams* p, ImageIterHandle* out);
+/* Copies one batch into caller buffers: data has batch*c*h*w floats,
+ * label has batch*label_width floats. *out_count = slots filled
+ * (< batch_size at a non-round tail); 0 means epoch end. *out_pad =
+ * trailing slots that are wrap-around duplicates under round_batch
+ * (the reference's num_batch_padd) — metrics must discount them. */
+int MXTImageIterNext(ImageIterHandle h, float* data, float* label,
+                     int* out_count, int* out_pad);
+int MXTImageIterReset(ImageIterHandle h);
+int MXTImageIterFree(ImageIterHandle h);
+int MXTImageIterNumSamples(ImageIterHandle h, uint64_t* out);
+
+/* Decode one JPEG buffer to HWC uint8 RGB (for mx.image.imdecode).
+ * Caller provides out sized max_h*max_w*3 after a first probe call with
+ * out=NULL that fills the h and w outputs. */
+int MXTImdecode(const char* buf, uint64_t size, unsigned char* out,
+                int* h, int* w);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* MXT_C_API_H_ */

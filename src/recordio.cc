@@ -1,0 +1,190 @@
+// RecordIO reader/writer, wire-compatible with dmlc-core recordio
+// (reference 3rdparty/dmlc-core/include/dmlc/recordio.h, mirrored by
+// python/mxnet/recordio.py). Each record:
+//   [kMagic:u32][cflag:3|len:29][payload][zero pad to 4-byte boundary]
+// Payloads containing the magic word are split at those positions and
+// re-joined on read using cflag 1(start)/2(middle)/3(end).
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "error.h"
+#include "include/mxt/c_api.h"
+
+namespace mxt {
+
+static const uint32_t kMagic = 0xced7230a;
+
+static uint32_t EncodeLRec(uint32_t cflag, uint32_t length) {
+  return (cflag << 29u) | (length & ((1u << 29u) - 1u));
+}
+static uint32_t DecodeFlag(uint32_t rec) { return (rec >> 29u) & 7u; }
+static uint32_t DecodeLength(uint32_t rec) { return rec & ((1u << 29u) - 1u); }
+
+class RecordIOWriter {
+ public:
+  explicit RecordIOWriter(const char* uri) : fp_(std::fopen(uri, "wb")) {
+    if (!fp_) throw std::runtime_error(std::string("cannot open for write: ") + uri);
+  }
+  ~RecordIOWriter() {
+    if (fp_) std::fclose(fp_);
+  }
+
+  void Write(const char* buf, uint64_t size) {
+    // Find magic-word occurrences (4-byte aligned scan like dmlc) and
+    // split the record there so readers can always resync on kMagic.
+    std::vector<uint64_t> splits;
+    for (uint64_t i = 0; i + 4 <= size; i += 4) {
+      uint32_t w;
+      std::memcpy(&w, buf + i, 4);
+      if (w == kMagic) splits.push_back(i);
+    }
+    if (splits.empty()) {
+      WriteChunk(0, buf, size);
+    } else {
+      uint64_t begin = 0;
+      for (size_t k = 0; k <= splits.size(); ++k) {
+        uint64_t end = (k < splits.size()) ? splits[k] : size;
+        uint32_t cflag = (k == 0) ? 1u : (k == splits.size() ? 3u : 2u);
+        WriteChunk(cflag, buf + begin, end - begin);
+        begin = end + ((k < splits.size()) ? 4 : 0);
+      }
+    }
+    if (std::fflush(fp_) != 0) throw std::runtime_error("recordio flush failed");
+  }
+
+  uint64_t Tell() { return static_cast<uint64_t>(std::ftell(fp_)); }
+
+ private:
+  void WriteChunk(uint32_t cflag, const char* buf, uint64_t size) {
+    uint32_t header[2] = {kMagic, EncodeLRec(cflag, static_cast<uint32_t>(size))};
+    if (std::fwrite(header, 4, 2, fp_) != 2) throw std::runtime_error("write failed");
+    if (size && std::fwrite(buf, 1, size, fp_) != size)
+      throw std::runtime_error("write failed");
+    static const char zeros[4] = {0, 0, 0, 0};
+    uint64_t pad = (4 - (size & 3)) & 3;
+    if (pad && std::fwrite(zeros, 1, pad, fp_) != pad)
+      throw std::runtime_error("write failed");
+  }
+
+  std::FILE* fp_;
+};
+
+class RecordIOReader {
+ public:
+  explicit RecordIOReader(const char* uri) : fp_(std::fopen(uri, "rb")) {
+    if (!fp_) throw std::runtime_error(std::string("cannot open for read: ") + uri);
+  }
+  ~RecordIOReader() {
+    if (fp_) std::fclose(fp_);
+  }
+
+  // Returns false on clean EOF.
+  bool Next(const char** buf, uint64_t* size) {
+    record_.clear();
+    uint32_t cflag = 0;
+    bool in_multipart = false;
+    while (true) {
+      uint32_t header[2];
+      size_t got = std::fread(header, 4, 2, fp_);
+      if (got == 0 && !in_multipart) return false;  // EOF at record boundary
+      if (got != 2) throw std::runtime_error("recordio: truncated header");
+      if (header[0] != kMagic) throw std::runtime_error("recordio: bad magic");
+      cflag = DecodeFlag(header[1]);
+      uint64_t len = DecodeLength(header[1]);
+      size_t old = record_.size();
+      if (in_multipart) {
+        // re-insert the magic word that the writer split on
+        record_.resize(old + 4 + len);
+        std::memcpy(&record_[old], &kMagic, 4);
+        old += 4;
+      } else {
+        record_.resize(len);
+      }
+      if (len && std::fread(&record_[old], 1, len, fp_) != len)
+        throw std::runtime_error("recordio: truncated payload");
+      uint64_t pad = (4 - (len & 3)) & 3;
+      if (pad && std::fseek(fp_, static_cast<long>(pad), SEEK_CUR) != 0)
+        throw std::runtime_error("recordio: truncated pad");
+      if (cflag == 0 || cflag == 3) break;
+      in_multipart = true;
+    }
+    *buf = record_.data();
+    *size = record_.size();
+    return true;
+  }
+
+  void Seek(uint64_t pos) {
+    if (std::fseek(fp_, static_cast<long>(pos), SEEK_SET) != 0)
+      throw std::runtime_error("recordio: seek failed");
+  }
+  uint64_t Tell() { return static_cast<uint64_t>(std::ftell(fp_)); }
+
+ private:
+  std::FILE* fp_;
+  std::vector<char> record_;
+};
+
+}  // namespace mxt
+
+// ---------------- C ABI ------------------------------------------------
+
+int MXTRecordIOWriterCreate(const char* uri, RecordIOHandle* out) {
+  MXT_API_BEGIN();
+  *out = new mxt::RecordIOWriter(uri);
+  MXT_API_END();
+}
+
+int MXTRecordIOWriterWrite(RecordIOHandle h, const char* buf, uint64_t size) {
+  MXT_API_BEGIN();
+  static_cast<mxt::RecordIOWriter*>(h)->Write(buf, size);
+  MXT_API_END();
+}
+
+int MXTRecordIOWriterTell(RecordIOHandle h, uint64_t* pos) {
+  MXT_API_BEGIN();
+  *pos = static_cast<mxt::RecordIOWriter*>(h)->Tell();
+  MXT_API_END();
+}
+
+int MXTRecordIOWriterFree(RecordIOHandle h) {
+  MXT_API_BEGIN();
+  delete static_cast<mxt::RecordIOWriter*>(h);
+  MXT_API_END();
+}
+
+int MXTRecordIOReaderCreate(const char* uri, RecordIOHandle* out) {
+  MXT_API_BEGIN();
+  *out = new mxt::RecordIOReader(uri);
+  MXT_API_END();
+}
+
+int MXTRecordIOReaderNext(RecordIOHandle h, const char** buf, uint64_t* size) {
+  MXT_API_BEGIN();
+  if (!static_cast<mxt::RecordIOReader*>(h)->Next(buf, size)) {
+    *buf = nullptr;
+    *size = 0;
+  }
+  MXT_API_END();
+}
+
+int MXTRecordIOReaderSeek(RecordIOHandle h, uint64_t pos) {
+  MXT_API_BEGIN();
+  static_cast<mxt::RecordIOReader*>(h)->Seek(pos);
+  MXT_API_END();
+}
+
+int MXTRecordIOReaderTell(RecordIOHandle h, uint64_t* pos) {
+  MXT_API_BEGIN();
+  *pos = static_cast<mxt::RecordIOReader*>(h)->Tell();
+  MXT_API_END();
+}
+
+int MXTRecordIOReaderFree(RecordIOHandle h) {
+  MXT_API_BEGIN();
+  delete static_cast<mxt::RecordIOReader*>(h);
+  MXT_API_END();
+}

@@ -1,0 +1,112 @@
+// Size-bucketed recycling host-buffer pool — CPU analog of the reference
+// GPUPooledStorageManager (src/storage/pooled_storage_manager.h:53-214):
+// frees go back to a per-size free list instead of the OS; sizes are
+// rounded up to reduce bucket fragmentation. Used for staging batches
+// before device_put and as scratch for the native data pipeline.
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <new>
+#include <stdexcept>
+#include <vector>
+
+#include "error.h"
+#include "include/mxt/c_api.h"
+
+namespace mxt {
+
+std::string& LastError() {
+  static thread_local std::string err;
+  return err;
+}
+
+void SetLastError(const std::string& msg) { LastError() = msg; }
+
+namespace {
+
+constexpr uint64_t kPageSize = 4096;  // MXNET_GPU_MEM_POOL_PAGE_SIZE analog
+constexpr uint64_t kAlign = 64;
+
+uint64_t RoundSize(uint64_t size) {
+  if (size < kPageSize) {
+    // round to next power of two below a page
+    uint64_t r = kAlign;
+    while (r < size) r <<= 1;
+    return r;
+  }
+  return (size + kPageSize - 1) / kPageSize * kPageSize;
+}
+
+struct Pool {
+  std::mutex mu;
+  std::map<uint64_t, std::vector<void*>> free_lists;
+  uint64_t bytes_allocated = 0;  // live, handed to callers
+  uint64_t bytes_pooled = 0;     // cached in free lists
+};
+
+Pool& pool() {
+  static Pool p;
+  return p;
+}
+
+}  // namespace
+}  // namespace mxt
+
+int MXTStorageAlloc(uint64_t size, void** out) {
+  MXT_API_BEGIN();
+  uint64_t rounded = mxt::RoundSize(size);
+  auto& p = mxt::pool();
+  {
+    std::lock_guard<std::mutex> lk(p.mu);
+    auto it = p.free_lists.find(rounded);
+    if (it != p.free_lists.end() && !it->second.empty()) {
+      *out = it->second.back();
+      it->second.pop_back();
+      p.bytes_pooled -= rounded;
+      p.bytes_allocated += rounded;
+      return 0;
+    }
+  }
+  void* ptr = nullptr;
+  if (posix_memalign(&ptr, mxt::kAlign, rounded) != 0 || !ptr)
+    throw std::bad_alloc();
+  {
+    std::lock_guard<std::mutex> lk(p.mu);
+    p.bytes_allocated += rounded;
+  }
+  *out = ptr;
+  MXT_API_END();
+}
+
+int MXTStorageFree(void* ptr, uint64_t size) {
+  MXT_API_BEGIN();
+  uint64_t rounded = mxt::RoundSize(size);
+  auto& p = mxt::pool();
+  std::lock_guard<std::mutex> lk(p.mu);
+  p.free_lists[rounded].push_back(ptr);
+  p.bytes_allocated -= rounded;
+  p.bytes_pooled += rounded;
+  MXT_API_END();
+}
+
+int MXTStorageStats(uint64_t* bytes_allocated, uint64_t* bytes_pooled) {
+  MXT_API_BEGIN();
+  auto& p = mxt::pool();
+  std::lock_guard<std::mutex> lk(p.mu);
+  *bytes_allocated = p.bytes_allocated;
+  *bytes_pooled = p.bytes_pooled;
+  MXT_API_END();
+}
+
+int MXTStorageReleaseAll(void) {
+  MXT_API_BEGIN();
+  auto& p = mxt::pool();
+  std::lock_guard<std::mutex> lk(p.mu);
+  for (auto& kv : p.free_lists)
+    for (void* ptr : kv.second) std::free(ptr);
+  p.free_lists.clear();
+  p.bytes_pooled = 0;
+  MXT_API_END();
+}
+
+const char* MXTGetLastError(void) { return mxt::LastError().c_str(); }

@@ -1,0 +1,363 @@
+"""Tests for the C++ native runtime (src/): recordio wire format, the
+dependency engine, the pooled storage manager, and the image-record
+pipeline. Mirrors the reference's C++ gtest coverage
+(tests/cpp/engine/threaded_engine_test.cc, storage/storage_test.cc) plus
+recordio round-trips from tests/python/unittest/test_recordio.py.
+"""
+import io as pyio
+import os
+import struct
+import threading
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import native, recordio
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library not built")
+
+
+# ---------------- recordio ---------------------------------------------
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    records = [b"hello", b"x" * 1000, b"", b"tail-unaligned-7"]
+    for r in records:
+        w.write(r)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(rec)
+    r.close()
+    assert got == records
+
+
+def test_recordio_magic_payload(tmp_path):
+    """Payloads containing the magic word are split + rejoined."""
+    magic = struct.pack("<I", 0xced7230a)
+    payload = b"abcd" + magic + b"efgh" + magic + magic + b"z"
+    path = str(tmp_path / "m.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(payload)
+    w.write(magic)  # record that IS the magic
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == payload
+    assert r.read() == magic
+    assert r.read() is None
+    r.close()
+
+
+def test_recordio_python_native_interop(tmp_path):
+    """Native writer → Python reader and vice versa (wire compat)."""
+    payload = [b"one", b"two" * 123, struct.pack("<I", 0xced7230a) + b"x"]
+    npath = str(tmp_path / "n.rec")
+    w = recordio.MXRecordIO(npath, "w")  # native path
+    for p in payload:
+        w.write(p)
+    w.close()
+    os.environ["MXNET_NATIVE_LIB_DISABLE"] = "1"
+    try:
+        import importlib
+        # force the pure-python branch by reloading with the lib disabled
+        r = recordio.MXRecordIO.__new__(recordio.MXRecordIO)
+        r.uri, r.flag = npath, "r"
+        r.record = open(npath, "rb")
+        r.writable = False
+        r._nh = None
+        got = [r.read() for _ in range(3)]
+        assert got == payload
+        r.record.close()
+    finally:
+        del os.environ["MXNET_NATIVE_LIB_DISABLE"]
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "i.rec")
+    idx = str(tmp_path / "i.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(10):
+        w.write_idx(i, f"record-{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    assert r.read_idx(7) == b"record-7"
+    assert r.read_idx(2) == b"record-2"
+    r.close()
+
+
+def test_pack_unpack_roundtrip(tmp_path):
+    header = recordio.IRHeader(0, 3.0, 42, 0)
+    s = recordio.pack(header, b"imagebytes")
+    h2, body = recordio.unpack(s)
+    assert h2.label == 3.0 and h2.id == 42 and body == b"imagebytes"
+    # multi-label
+    header = recordio.IRHeader(3, [1.0, 2.0, 3.0], 7, 0)
+    h3, body = recordio.unpack(recordio.pack(header, b"xy"))
+    assert list(h3.label) == [1.0, 2.0, 3.0] and body == b"xy"
+
+
+# ---------------- engine ------------------------------------------------
+
+def _make_engine():
+    from incubator_mxnet_tpu.engine import NativeEngine
+    return NativeEngine(num_workers=4)
+
+
+def test_native_engine_write_ordering():
+    eng = _make_engine()
+    v = eng.new_variable("x")
+    acc = []
+    for i in range(50):
+        eng.push(lambda i=i: acc.append(i), mutable_vars=(v,))
+    eng.wait_for_var(v)
+    assert acc == list(range(50))
+
+
+def test_native_engine_parallel_reads():
+    eng = _make_engine()
+    v = eng.new_variable("x")
+    barrier = threading.Barrier(3, timeout=10)
+    hits = []
+
+    def reader():
+        barrier.wait()  # all three readers must be in flight at once
+        hits.append(1)
+
+    for _ in range(3):
+        eng.push(reader, const_vars=(v,))
+    eng.wait_for_all()
+    assert len(hits) == 3
+
+
+def test_native_engine_read_write_exclusion():
+    eng = _make_engine()
+    v = eng.new_variable("x")
+    state = {"val": 0}
+    seen = []
+    eng.push(lambda: state.__setitem__("val", 1), mutable_vars=(v,))
+    eng.push(lambda: seen.append(state["val"]), const_vars=(v,))
+    eng.push(lambda: state.__setitem__("val", 2), mutable_vars=(v,))
+    eng.push(lambda: seen.append(state["val"]), const_vars=(v,))
+    eng.wait_for_all()
+    assert seen == [1, 2]
+
+
+def test_native_engine_exception_propagation():
+    eng = _make_engine()
+    v = eng.new_variable("x")
+
+    def boom():
+        raise ValueError("deliberate")
+
+    eng.push(boom, mutable_vars=(v,))
+    # dependent op must be skipped, not run
+    ran = []
+    eng.push(lambda: ran.append(1), const_vars=(v,))
+    with pytest.raises(RuntimeError, match="deliberate"):
+        eng.wait_for_var(v)
+    assert ran == []
+
+
+def test_native_engine_version_counter():
+    import ctypes
+    eng = _make_engine()
+    v = eng.new_variable("x")
+    for _ in range(5):
+        eng.push(lambda: None, mutable_vars=(v,))
+    eng.wait_for_all()
+    out = ctypes.c_uint64()
+    native.check_call(native.lib.MXTEngineVarVersion(
+        eng._h, v.handle, ctypes.byref(out)))
+    assert out.value == 5
+
+
+# ---------------- storage ----------------------------------------------
+
+def test_storage_pool_recycles():
+    import ctypes
+    p1 = ctypes.c_void_p()
+    native.check_call(native.lib.MXTStorageAlloc(1 << 20, ctypes.byref(p1)))
+    native.check_call(native.lib.MXTStorageFree(p1, 1 << 20))
+    p2 = ctypes.c_void_p()
+    native.check_call(native.lib.MXTStorageAlloc(1 << 20, ctypes.byref(p2)))
+    assert p1.value == p2.value  # same buffer came back from the pool
+    native.check_call(native.lib.MXTStorageFree(p2, 1 << 20))
+    alloc = ctypes.c_uint64()
+    pooled = ctypes.c_uint64()
+    native.check_call(native.lib.MXTStorageStats(ctypes.byref(alloc),
+                                                 ctypes.byref(pooled)))
+    assert pooled.value >= 1 << 20
+    native.check_call(native.lib.MXTStorageReleaseAll())
+
+
+# ---------------- image pipeline ---------------------------------------
+
+def _write_jpeg_rec(tmp_path, n=12, size=(40, 32)):
+    """Pack n solid-color JPEGs (label = red value / 10) into a .rec."""
+    from PIL import Image
+    path = str(tmp_path / "img.rec")
+    w = recordio.MXRecordIO(path, "w")
+    colors = []
+    for i in range(n):
+        rgb = (i * 10 % 256, (i * 30 + 5) % 256, (i * 7 + 99) % 256)
+        img = Image.new("RGB", size, rgb)
+        buf = pyio.BytesIO()
+        img.save(buf, format="JPEG", quality=95)
+        w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                              buf.getvalue()))
+        colors.append(rgb)
+    w.close()
+    return path, colors
+
+
+def test_image_record_iter(tmp_path):
+    path, colors = _write_jpeg_rec(tmp_path, n=12)
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                               batch_size=4, shuffle=False,
+                               preprocess_threads=2)
+    assert it.num_samples == 12
+    batches = list(it)
+    assert len(batches) == 3
+    for b_idx, batch in enumerate(batches):
+        data = batch.data[0].asnumpy()
+        label = batch.label[0].asnumpy()
+        assert data.shape == (4, 3, 16, 16)
+        for s in range(4):
+            i = b_idx * 4 + s
+            assert label[s] == i
+            # solid color survives decode+resize to ~the same value
+            r, g, b = colors[i]
+            got = data[s].mean(axis=(1, 2))
+            assert abs(got[0] - r) < 3 and abs(got[1] - g) < 3 \
+                and abs(got[2] - b) < 3
+    # reset → same again
+    it.reset()
+    again = list(it)
+    assert len(again) == 3
+
+
+def test_image_record_iter_augment_normalize(tmp_path):
+    path, colors = _write_jpeg_rec(tmp_path, n=4)
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                               batch_size=4, shuffle=False, scale=255.0,
+                               mean_r=0.5, mean_g=0.5, mean_b=0.5,
+                               std_r=0.5, std_g=0.5, std_b=0.5)
+    batch = next(iter(it))
+    data = batch.data[0].asnumpy()
+    r0 = colors[0][0]
+    expect = (r0 / 255.0 - 0.5) / 0.5
+    assert abs(data[0, 0].mean() - expect) < 0.05
+
+
+def test_image_record_iter_shuffle_epoch(tmp_path):
+    path, _ = _write_jpeg_rec(tmp_path, n=16)
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                               batch_size=8, shuffle=True, seed=3)
+    labels1 = onp.concatenate([b.label[0].asnumpy() for b in it])
+    it.reset()
+    labels2 = onp.concatenate([b.label[0].asnumpy() for b in it])
+    assert sorted(labels1) == list(range(16))
+    assert sorted(labels2) == list(range(16))
+    # different epoch order (shuffled), same sample set
+    assert not onp.array_equal(labels1, labels2)
+
+
+def test_imdecode_native():
+    from PIL import Image
+    import ctypes
+    img = Image.new("RGB", (20, 10), (200, 100, 50))
+    buf = pyio.BytesIO()
+    img.save(buf, format="JPEG", quality=95)
+    raw = buf.getvalue()
+    h = ctypes.c_int(0)
+    w = ctypes.c_int(0)
+    native.check_call(native.lib.MXTImdecode(raw, len(raw), None,
+                                             ctypes.byref(h), ctypes.byref(w)))
+    assert (h.value, w.value) == (10, 20)
+    out = onp.empty((10, 20, 3), dtype=onp.uint8)
+    native.check_call(native.lib.MXTImdecode(
+        raw, len(raw), out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        ctypes.byref(h), ctypes.byref(w)))
+    assert abs(int(out[:, :, 0].mean()) - 200) < 3
+
+
+# ---------------- im2rec tool ------------------------------------------
+
+def test_im2rec_tool(tmp_path):
+    from PIL import Image
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    binary = os.path.join(repo, "tools", "bin", "im2rec")
+    if not os.path.exists(binary):
+        pytest.skip("im2rec not built")
+    imgdir = tmp_path / "imgs"
+    imgdir.mkdir()
+    lines = []
+    for i in range(5):
+        name = f"im{i}.jpg"
+        Image.new("RGB", (30 + i, 25), (i * 40, 10, 200)).save(
+            str(imgdir / name), quality=95)
+        lines.append(f"{i}\t{float(i)}\t{name}")
+    lst = tmp_path / "list.lst"
+    lst.write_text("\n".join(lines) + "\n")
+    rec = tmp_path / "out.rec"
+    subprocess.run([binary, str(lst), str(imgdir), str(rec)], check=True,
+                   capture_output=True)
+    # readable by the iterator
+    it = mx.io.ImageRecordIter(path_imgrec=str(rec), data_shape=(3, 16, 16),
+                               batch_size=5, shuffle=False)
+    batch = next(iter(it))
+    assert sorted(batch.label[0].asnumpy().tolist()) == [0, 1, 2, 3, 4]
+
+
+def test_native_engine_push_sync_raises():
+    eng = _make_engine()
+
+    def boom():
+        raise ValueError("sync-boom")
+
+    with pytest.raises(ValueError, match="sync-boom"):
+        eng.push_sync(boom)
+
+
+def test_native_engine_exception_cleared_after_rethrow():
+    eng = _make_engine()
+    v = eng.new_variable("x")
+    eng.push(lambda: (_ for _ in ()).throw(ValueError("once")),
+             mutable_vars=(v,))
+    with pytest.raises(RuntimeError, match="once"):
+        eng.wait_for_var(v)
+    # handled: later waits on the same var succeed (no sticky poison)
+    eng.push(lambda: None, mutable_vars=(v,))
+    eng.wait_for_var(v)
+    eng.wait_for_all()
+
+
+def test_native_engine_var_deletion():
+    eng = _make_engine()
+    v = eng.new_variable("tmp")
+    hits = []
+    eng.push(lambda: hits.append(1), mutable_vars=(v,))
+    eng.wait_for_all()
+    del v  # __del__ → MXTEngineDeleteVar; freed natively after drain
+    eng.wait_for_all()
+    assert hits == [1]
+
+
+def test_image_record_iter_round_batch_pad(tmp_path):
+    path, _ = _write_jpeg_rec(tmp_path, n=10)
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                               batch_size=8, shuffle=False, round_batch=True)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].pad == 0
+    # tail: 2 real + 6 wrap-around duplicates → pad 6 (num_batch_padd)
+    assert batches[1].pad == 6
+    assert batches[1].data[0].shape == (8, 3, 8, 8)

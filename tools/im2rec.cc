@@ -1,0 +1,237 @@
+// im2rec: pack an image list into a RecordIO shard (TPU-native framework's
+// counterpart of the reference tools/im2rec.cc — same .lst and .rec
+// formats, libjpeg instead of OpenCV for the optional resize re-encode).
+//
+// Usage: im2rec <prefix.lst> <image_root> <output.rec> [resize=0] [quality=95]
+//   .lst line: <index>\t<label...>\t<relative/path>
+// With resize>0 the shorter side is scaled to `resize` and the image is
+// re-encoded as JPEG quality `quality`; otherwise bytes pass through.
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <cmath>
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+static const uint32_t kMagic = 0xced7230a;
+
+struct JpegErr {
+  jpeg_error_mgr pub;
+  std::jmp_buf jmp;
+};
+static void ErrExit(j_common_ptr c) {
+  std::longjmp(reinterpret_cast<JpegErr*>(c->err)->jmp, 1);
+}
+
+static bool Decode(const std::vector<unsigned char>& in,
+                   std::vector<unsigned char>* out, int* h, int* w) {
+  jpeg_decompress_struct ci;
+  JpegErr je;
+  ci.err = jpeg_std_error(&je.pub);
+  je.pub.error_exit = ErrExit;
+  if (setjmp(je.jmp)) {
+    jpeg_destroy_decompress(&ci);
+    return false;
+  }
+  jpeg_create_decompress(&ci);
+  jpeg_mem_src(&ci, const_cast<unsigned char*>(in.data()),
+               static_cast<unsigned long>(in.size()));
+  jpeg_read_header(&ci, TRUE);
+  ci.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&ci);
+  *w = ci.output_width;
+  *h = ci.output_height;
+  out->resize(static_cast<size_t>(*w) * *h * 3);
+  size_t stride = static_cast<size_t>(*w) * 3;
+  while (ci.output_scanline < ci.output_height) {
+    unsigned char* row = out->data() + ci.output_scanline * stride;
+    jpeg_read_scanlines(&ci, &row, 1);
+  }
+  jpeg_finish_decompress(&ci);
+  jpeg_destroy_decompress(&ci);
+  return true;
+}
+
+static void Encode(const std::vector<unsigned char>& rgb, int h, int w,
+                   int quality, std::vector<unsigned char>* out) {
+  jpeg_compress_struct ci;
+  jpeg_error_mgr jerr;
+  ci.err = jpeg_std_error(&jerr);
+  jpeg_create_compress(&ci);
+  unsigned char* mem = nullptr;
+  unsigned long mem_size = 0;
+  jpeg_mem_dest(&ci, &mem, &mem_size);
+  ci.image_width = w;
+  ci.image_height = h;
+  ci.input_components = 3;
+  ci.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&ci);
+  jpeg_set_quality(&ci, quality, TRUE);
+  jpeg_start_compress(&ci, TRUE);
+  size_t stride = static_cast<size_t>(w) * 3;
+  while (ci.next_scanline < ci.image_height) {
+    const unsigned char* row = rgb.data() + ci.next_scanline * stride;
+    unsigned char* rows[1] = {const_cast<unsigned char*>(row)};
+    jpeg_write_scanlines(&ci, rows, 1);
+  }
+  jpeg_finish_compress(&ci);
+  out->assign(mem, mem + mem_size);
+  jpeg_destroy_compress(&ci);
+  free(mem);
+}
+
+static void Resize(const std::vector<unsigned char>& src, int sh, int sw,
+                   std::vector<unsigned char>* dst, int dh, int dw) {
+  dst->resize(static_cast<size_t>(dh) * dw * 3);
+  float ys = dh > 1 ? static_cast<float>(sh - 1) / (dh - 1) : 0.f;
+  float xs = dw > 1 ? static_cast<float>(sw - 1) / (dw - 1) : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    float fy = y * ys;
+    int y0 = static_cast<int>(fy), y1 = std::min(y0 + 1, sh - 1);
+    float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = x * xs;
+      int x0 = static_cast<int>(fx), x1 = std::min(x0 + 1, sw - 1);
+      float wx = fx - x0;
+      for (int c = 0; c < 3; ++c) {
+        float v = src[(y0 * sw + x0) * 3 + c] * (1 - wy) * (1 - wx) +
+                  src[(y0 * sw + x1) * 3 + c] * (1 - wy) * wx +
+                  src[(y1 * sw + x0) * 3 + c] * wy * (1 - wx) +
+                  src[(y1 * sw + x1) * 3 + c] * wy * wx;
+        (*dst)[(y * dw + x) * 3 + c] = static_cast<unsigned char>(v + 0.5f);
+      }
+    }
+  }
+}
+
+static void WriteRecord(std::FILE* fp, const std::vector<unsigned char>& rec) {
+  // Single-chunk write; payloads containing the magic are split like
+  // dmlc recordio so readers can resync.
+  std::vector<size_t> splits;
+  for (size_t i = 0; i + 4 <= rec.size(); i += 4) {
+    uint32_t word;
+    std::memcpy(&word, rec.data() + i, 4);
+    if (word == kMagic) splits.push_back(i);
+  }
+  auto emit = [&](uint32_t cflag, const unsigned char* buf, size_t n) {
+    uint32_t header[2] = {kMagic,
+                          (cflag << 29u) | (static_cast<uint32_t>(n) &
+                                            ((1u << 29u) - 1u))};
+    std::fwrite(header, 4, 2, fp);
+    if (n) std::fwrite(buf, 1, n, fp);
+    static const char zeros[4] = {0, 0, 0, 0};
+    size_t pad = (4 - (n & 3)) & 3;
+    if (pad) std::fwrite(zeros, 1, pad, fp);
+  };
+  if (splits.empty()) {
+    emit(0, rec.data(), rec.size());
+    return;
+  }
+  size_t begin = 0;
+  for (size_t k = 0; k <= splits.size(); ++k) {
+    size_t end = k < splits.size() ? splits[k] : rec.size();
+    uint32_t cflag = k == 0 ? 1u : (k == splits.size() ? 3u : 2u);
+    emit(cflag, rec.data() + begin, end - begin);
+    begin = end + (k < splits.size() ? 4 : 0);
+  }
+}
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::cerr << "usage: im2rec <list.lst> <image_root> <out.rec> "
+                 "[resize=0] [quality=95]\n";
+    return 1;
+  }
+  std::string lst = argv[1], root = argv[2], out = argv[3];
+  int resize = argc > 4 ? std::atoi(argv[4]) : 0;
+  int quality = argc > 5 ? std::atoi(argv[5]) : 95;
+
+  std::ifstream fin(lst);
+  if (!fin) {
+    std::cerr << "cannot open " << lst << "\n";
+    return 1;
+  }
+  std::FILE* frec = std::fopen(out.c_str(), "wb");
+  if (!frec) {
+    std::cerr << "cannot open " << out << "\n";
+    return 1;
+  }
+  std::string line;
+  size_t count = 0, failed = 0;
+  while (std::getline(fin, line)) {
+    if (line.empty()) continue;
+    std::vector<std::string> fields;
+    std::stringstream ss(line);
+    std::string tok;
+    while (std::getline(ss, tok, '\t')) fields.push_back(tok);
+    if (fields.size() < 3) continue;
+    uint64_t idx = std::strtoull(fields[0].c_str(), nullptr, 10);
+    std::vector<float> labels;
+    for (size_t i = 1; i + 1 < fields.size(); ++i)
+      labels.push_back(std::strtof(fields[i].c_str(), nullptr));
+    std::string path = root.empty() ? fields.back() : root + "/" + fields.back();
+
+    std::ifstream fimg(path, std::ios::binary);
+    if (!fimg) {
+      std::cerr << "skip (missing): " << path << "\n";
+      ++failed;
+      continue;
+    }
+    std::vector<unsigned char> bytes(
+        (std::istreambuf_iterator<char>(fimg)), std::istreambuf_iterator<char>());
+
+    if (resize > 0) {
+      std::vector<unsigned char> rgb, sized, enc;
+      int h, w;
+      if (!Decode(bytes, &rgb, &h, &w)) {
+        std::cerr << "skip (decode failed): " << path << "\n";
+        ++failed;
+        continue;
+      }
+      int nh, nw;
+      if (h < w) {
+        nh = resize;
+        nw = static_cast<int>(std::lround(static_cast<double>(w) * nh / h));
+      } else {
+        nw = resize;
+        nh = static_cast<int>(std::lround(static_cast<double>(h) * nw / w));
+      }
+      Resize(rgb, h, w, &sized, nh, nw);
+      Encode(sized, nh, nw, quality, &bytes);
+    }
+
+    // IRHeader (python/mxnet/recordio.py pack): flag counts extra labels
+    uint32_t flag = labels.size() > 1 ? static_cast<uint32_t>(labels.size()) : 0;
+    float label0 = labels.empty() ? 0.f : labels[0];
+    std::vector<unsigned char> rec(24 + (flag ? 4 * labels.size() : 0) +
+                                   bytes.size());
+    std::memcpy(rec.data(), &flag, 4);
+    std::memcpy(rec.data() + 4, &label0, 4);
+    std::memcpy(rec.data() + 8, &idx, 8);
+    uint64_t id2 = 0;
+    std::memcpy(rec.data() + 16, &id2, 8);
+    size_t off = 24;
+    if (flag) {
+      std::memcpy(rec.data() + off, labels.data(), 4 * labels.size());
+      off += 4 * labels.size();
+    }
+    std::memcpy(rec.data() + off, bytes.data(), bytes.size());
+    WriteRecord(frec, rec);
+    ++count;
+    if (count % 1000 == 0) std::cerr << "packed " << count << " images\n";
+  }
+  std::fclose(frec);
+  std::cerr << "done: " << count << " packed, " << failed << " skipped → "
+            << out << "\n";
+  return 0;
+}
