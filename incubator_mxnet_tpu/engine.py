@@ -318,12 +318,18 @@ class NativeEngine(Engine):
         libc = self._libc
 
         @native.ENGINE_FN
-        def _trampoline(ctx, err_out):
+        def _trampoline(ctx, upstream_err, err_out):
             token = int(ctx)
             with self._ops_lock:
                 fn, done_evt, holder = self._ops.pop(token)
             try:
-                fn()
+                if upstream_err is not None:
+                    # op skipped: an input var carries a sticky exception
+                    # (engine.cc WorkerLoop); release waiters, record it
+                    holder.append(RuntimeError(
+                        upstream_err.decode("utf-8", "replace")))
+                else:
+                    fn()
             except Exception as e:  # noqa: BLE001 - engine boundary
                 msg = f"{type(e).__name__}: {e}"
                 err_out[0] = libc.strdup(msg.encode("utf-8", "replace"))

@@ -394,8 +394,11 @@ class NativeImageRecordIter(DataIter):
         # under round_batch, or empty tail slots otherwise
         # (the reference's num_batch_padd)
         total_pad = pad.value + (self.batch_size - count.value)
-        return DataBatch(data=[nd.array(self._data_buf.copy())],
-                         label=[nd.array(label.copy())],
+        # jnp.array(copy=True) is the single host→device copy; the reused
+        # staging buffers must not be aliased by the device array
+        import jax.numpy as jnp
+        return DataBatch(data=[nd.array(jnp.array(self._data_buf))],
+                         label=[nd.array(jnp.array(label))],
                          pad=total_pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
@@ -421,9 +424,29 @@ def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224),
     if native.available() and set(kwargs) <= _native_kwargs:
         return NativeImageRecordIter(path_imgrec, data_shape, batch_size,
                                      shuffle=shuffle, **kwargs)
-    from ..image import ImageIter
-    inner = ImageIter(batch_size, data_shape, path_imgrec=path_imgrec,
-                      shuffle=shuffle, **kwargs)
+    from ..image import CreateAugmenter, ImageIter
+    if set(kwargs) <= _native_kwargs:
+        # Python fallback honors the same options as the native pipeline:
+        # fold scale into mean/std ((px/s − m)/σ == (px − m·s)/(σ·s)) and
+        # map crop/mirror/resize onto the augmenter chain.
+        s = kwargs.get("scale", 1.0) or 1.0
+        mean = [kwargs.get("mean_r", 0.0) * s, kwargs.get("mean_g", 0.0) * s,
+                kwargs.get("mean_b", 0.0) * s]
+        std = [max(kwargs.get("std_r", 1.0), 1e-12) * s,
+               max(kwargs.get("std_g", 1.0), 1e-12) * s,
+               max(kwargs.get("std_b", 1.0), 1e-12) * s]
+        aug = CreateAugmenter(data_shape,
+                              resize=kwargs.get("resize", 0),
+                              rand_crop=bool(kwargs.get("rand_crop", False)),
+                              rand_mirror=bool(kwargs.get("rand_mirror",
+                                                          False)),
+                              mean=mean, std=std)
+        inner = ImageIter(batch_size, data_shape, path_imgrec=path_imgrec,
+                          shuffle=shuffle, aug_list=aug,
+                          label_width=kwargs.get("label_width", 1))
+    else:
+        inner = ImageIter(batch_size, data_shape, path_imgrec=path_imgrec,
+                          shuffle=shuffle, **kwargs)
 
     class _Adapter(DataIter):
         def __init__(self):

@@ -41,7 +41,7 @@ class TransformerConfig:
     dtype: str = "bfloat16"
     use_moe: bool = False
     n_experts: int = 8
-    attention: str = "gspmd"  # 'gspmd' | 'ring'
+    attention: str = "gspmd"  # 'gspmd' | 'ring' | 'flash' (pallas kernel)
 
     @property
     def head_dim(self):
@@ -126,6 +126,24 @@ class TransformerLM:
         cfg = self.cfg
         if cfg.attention == "ring" and mesh is not None:
             return ring_attention(q, k, v, mesh, axis_name="sp", causal=True)
+        if cfg.attention == "flash":
+            from ..ops.pallas_kernels import flash_attention
+            if mesh is not None and "sp" in mesh.axis_names \
+                    and mesh.shape["sp"] > 1:
+                # flash kernel is per-(b,h); sequence sharding needs the
+                # ring schedule instead of an all-gather of K/V
+                return ring_attention(q, k, v, mesh, axis_name="sp",
+                                      causal=True)
+            if mesh is not None:
+                # keep batch/head shards local: run the kernel inside
+                # shard_map so GSPMD doesn't all-gather q/k/v
+                from jax.experimental.shard_map import shard_map
+                spec = P("dp", "tp", None, None)
+                fa = shard_map(
+                    lambda q, k, v: flash_attention(q, k, v, causal=True),
+                    mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+                return fa(q, k, v).astype(q.dtype)
+            return flash_attention(q, k, v, causal=True).astype(q.dtype)
         logits = jnp.einsum("bhtd,bhsd->bhts", q, k,
                             preferred_element_type=jnp.float32)
         logits = logits / (cfg.head_dim ** 0.5)

@@ -52,7 +52,7 @@ class ImageIterParams(ctypes.Structure):
     ]
 
 
-ENGINE_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p,
+ENGINE_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_char_p,
                              ctypes.POINTER(ctypes.c_char_p))
 
 

@@ -182,7 +182,12 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
 @register("LayerNorm", aliases=("layer_norm",))
 def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
     """LayerNorm (reference src/operator/nn/layer_norm.cc) — a single fused
-    XLA subgraph (mean/var/normalize fuse into one kernel on TPU)."""
+    XLA subgraph, or the hand-fused Pallas kernel for the common
+    trailing-axis case on TPU (ops/pallas_kernels.fused_layer_norm)."""
+    if isinstance(axis, int) and axis in (-1, x.ndim - 1) and gamma.ndim == 1:
+        from . import pallas_kernels as pk
+        if pk.use_pallas():
+            return pk.fused_layer_norm(x, gamma, beta, float(eps))
     mean = jnp.mean(x, axis=axis, keepdims=True)
     var = jnp.var(x, axis=axis, keepdims=True)
     x_hat = (x - mean) * lax.rsqrt(var + eps)
@@ -244,8 +249,17 @@ def softmax(x, axis=-1, temperature=None, length=None):
     if temperature is not None and temperature != 1.0:
         x = x / temperature
     if length is not None:
-        mask = jnp.arange(x.shape[axis]) < length[..., None]
+        # length has x's shape minus `axis` (reference use_length semantics,
+        # softmax-inl.h): build the valid mask along that axis explicitly
+        ax = axis % x.ndim
+        shape = [1] * x.ndim
+        shape[ax] = x.shape[ax]
+        idx = jnp.arange(x.shape[ax]).reshape(shape)
+        mask = idx < jnp.expand_dims(length, ax)
         x = jnp.where(mask, x, -jnp.inf)
+    from . import pallas_kernels as pk
+    if isinstance(axis, int) and pk.use_pallas():
+        return pk.fused_softmax(x, axis)
     return jnn.softmax(x, axis=axis)
 
 

@@ -1,0 +1,454 @@
+"""Hand-written Pallas TPU kernels for bandwidth-bound hot ops.
+
+The reference hand-fuses these with NVRTC-generated CUDA (softmax
+src/operator/nn/softmax-inl.h, layernorm src/operator/nn/layer_norm.cc —
+both memory-bound rowwise reductions) and has no flash attention (it
+predates it). The TPU-native design keeps XLA as the default fuser and
+reaches for Pallas only where a manual schedule beats it:
+
+* ``fused_softmax``   — one VMEM-resident pass per row block, fused
+  max/exp/sum, custom fused backward.
+* ``fused_layer_norm``— single pass mean/rstd + affine, backward kernel
+  emitting dx and per-block dgamma/dbeta partials.
+* ``flash_attention`` — blockwise online-softmax attention, O(T) memory,
+  q-block grid with an inner lax.fori_loop over KV blocks; backward is a
+  memory-efficient KV-block scan (recompute, no T×T materialization).
+
+Kernels run in interpret mode off-TPU so CPU tests exercise identical
+code paths; wrappers pad to TPU tile boundaries ((8,128) f32) and mask.
+``MXNET_USE_PALLAS`` ∈ {"0","1","auto"} gates dispatch from the op layer
+(auto = only on TPU backends).
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["fused_softmax", "fused_layer_norm", "flash_attention",
+           "use_pallas", "interpret_mode"]
+
+_NEG_INF = -1e30
+
+
+def interpret_mode() -> bool:
+    """Pallas interpret mode: on unless running on a real TPU backend."""
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:  # backend init failure → interpreter is safe
+        return True
+
+
+@functools.cache
+def use_pallas() -> bool:
+    flag = os.environ.get("MXNET_USE_PALLAS", "auto").lower()
+    if flag in ("0", "false", "off"):
+        return False
+    if flag in ("1", "true", "on"):
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _pad_rows_cols(x2d, row_mult, col_mult):
+    rows, cols = x2d.shape
+    pr, pc = _round_up(rows, row_mult), _round_up(cols, col_mult)
+    if (pr, pc) != (rows, cols):
+        x2d = jnp.pad(x2d, ((0, pr - rows), (0, pc - cols)))
+    return x2d, rows, cols
+
+
+# ======================================================================
+# fused softmax
+# ======================================================================
+
+_BLOCK_ROWS = 256
+_MAX_COLS = 16384  # one row must fit VMEM; beyond this fall back to XLA
+
+
+def _softmax_fwd_kernel(x_ref, o_ref, *, n_cols):
+    x = x_ref[:].astype(jnp.float32)
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    x = jnp.where(col < n_cols, x, _NEG_INF)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    s = jnp.sum(e, axis=-1, keepdims=True)
+    o_ref[:] = (e / s).astype(o_ref.dtype)
+
+
+def _softmax_bwd_kernel(y_ref, g_ref, o_ref):
+    y = y_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    inner = jnp.sum(y * g, axis=-1, keepdims=True)
+    o_ref[:] = (y * (g - inner)).astype(o_ref.dtype)
+
+
+def _rowwise_call(kernel, out_dtype, n_inputs, x2d_list):
+    rows_p, cols_p = x2d_list[0].shape
+    block_r = min(_BLOCK_ROWS, rows_p)
+    spec = pl.BlockSpec((block_r, cols_p), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows_p, cols_p), out_dtype),
+        grid=(pl.cdiv(rows_p, block_r),),
+        in_specs=[spec] * n_inputs,
+        out_specs=spec,
+        interpret=interpret_mode(),
+    )(*x2d_list)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def fused_softmax(x, axis=-1):
+    """Numerically-stable softmax as a single Pallas pass per row block
+    (reference softmax FCompute, src/operator/nn/softmax-inl.h)."""
+    return _fused_softmax_impl(x, axis)
+
+
+def _fused_softmax_impl(x, axis):
+    if x.shape[axis] > _MAX_COLS or x.ndim == 0:
+        return jax.nn.softmax(x, axis=axis)
+    moved = jnp.moveaxis(x, axis, -1)
+    lead = moved.shape[:-1]
+    x2d = moved.reshape(-1, moved.shape[-1])
+    x2d_p, rows, cols = _pad_rows_cols(x2d, 8, 128)
+    out = _rowwise_call(
+        functools.partial(_softmax_fwd_kernel, n_cols=cols),
+        x.dtype, 1, [x2d_p])
+    out = out[:rows, :cols].reshape(*lead, cols)
+    return jnp.moveaxis(out, -1, axis)
+
+
+def _fused_softmax_fwd(x, axis):
+    y = _fused_softmax_impl(x, axis)
+    return y, y
+
+
+def _fused_softmax_bwd(axis, y, g):
+    if y.shape[axis] > _MAX_COLS:
+        inner = jnp.sum(y * g, axis=axis, keepdims=True)
+        return (y * (g - inner),)
+    ym = jnp.moveaxis(y, axis, -1)
+    gm = jnp.moveaxis(g, axis, -1)
+    lead = ym.shape[:-1]
+    y2d, rows, cols = _pad_rows_cols(ym.reshape(-1, ym.shape[-1]), 8, 128)
+    g2d, _, _ = _pad_rows_cols(gm.reshape(-1, gm.shape[-1]), 8, 128)
+    dx = _rowwise_call(_softmax_bwd_kernel, y.dtype, 2, [y2d, g2d])
+    dx = dx[:rows, :cols].reshape(*lead, cols)
+    return (jnp.moveaxis(dx, -1, axis),)
+
+
+fused_softmax.defvjp(_fused_softmax_fwd, _fused_softmax_bwd)
+
+
+# ======================================================================
+# fused layer norm (normalize over the last axis)
+# ======================================================================
+
+def _ln_fwd_kernel(x_ref, gamma_ref, beta_ref, o_ref, mean_ref, rstd_ref,
+                   *, n_cols, eps):
+    x = x_ref[:].astype(jnp.float32)
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    valid = col < n_cols
+    xv = jnp.where(valid, x, 0.0)
+    mean = jnp.sum(xv, axis=-1, keepdims=True) / n_cols
+    diff = jnp.where(valid, x - mean, 0.0)
+    var = jnp.sum(diff * diff, axis=-1, keepdims=True) / n_cols
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = diff * rstd
+    g = gamma_ref[:].astype(jnp.float32)
+    b = beta_ref[:].astype(jnp.float32)
+    o_ref[:] = (xhat * g + b).astype(o_ref.dtype)
+    mean_ref[:] = mean.astype(jnp.float32)
+    rstd_ref[:] = rstd.astype(jnp.float32)
+
+
+def _ln_bwd_kernel(x_ref, g_ref, gamma_ref, mean_ref, rstd_ref,
+                   dx_ref, dgamma_ref, dbeta_ref, *, n_cols):
+    x = x_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    gamma = gamma_ref[:].astype(jnp.float32)
+    mean = mean_ref[:]
+    rstd = rstd_ref[:]
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    valid = col < n_cols
+    xhat = jnp.where(valid, (x - mean) * rstd, 0.0)
+    gv = jnp.where(valid, g, 0.0)
+    # dx = rstd * (gγ − mean(gγ) − xhat·mean(gγ·xhat))
+    ggam = gv * gamma
+    m1 = jnp.sum(ggam, axis=-1, keepdims=True) / n_cols
+    m2 = jnp.sum(ggam * xhat, axis=-1, keepdims=True) / n_cols
+    dx = (ggam - m1 - xhat * m2) * rstd
+    dx_ref[:] = jnp.where(valid, dx, 0.0).astype(dx_ref.dtype)
+    # per-row-block partials, reduced across blocks by the caller
+    dgamma_ref[:] = jnp.sum(gv * xhat, axis=0, keepdims=True)
+    dbeta_ref[:] = jnp.sum(gv, axis=0, keepdims=True)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_layer_norm(x, gamma, beta, eps=1e-5):
+    """LayerNorm over the trailing axis in one fused pass (reference
+    LayerNormCompute, src/operator/nn/layer_norm.cc)."""
+    y, _, _ = _ln_fwd(x, gamma, beta, eps)
+    return y
+
+
+def _ln_fwd(x, gamma, beta, eps):
+    lead = x.shape[:-1]
+    cols = x.shape[-1]
+    x2d = x.reshape(-1, cols)
+    x2d_p, rows, _ = _pad_rows_cols(x2d, 8, 128)
+    rows_p, cols_p = x2d_p.shape
+    gamma_p = jnp.pad(gamma.astype(x.dtype), (0, cols_p - cols))
+    beta_p = jnp.pad(beta.astype(x.dtype), (0, cols_p - cols))
+    block_r = min(_BLOCK_ROWS, rows_p)
+    grid = (pl.cdiv(rows_p, block_r),)
+    row_spec = pl.BlockSpec((block_r, cols_p), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((1, cols_p), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    stat_spec = pl.BlockSpec((block_r, 1), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    y, mean, rstd = pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, n_cols=cols, eps=eps),
+        out_shape=(jax.ShapeDtypeStruct((rows_p, cols_p), x.dtype),
+                   jax.ShapeDtypeStruct((rows_p, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((rows_p, 1), jnp.float32)),
+        grid=grid,
+        in_specs=[row_spec, vec_spec, vec_spec],
+        out_specs=(row_spec, stat_spec, stat_spec),
+        interpret=interpret_mode(),
+    )(x2d_p, gamma_p.reshape(1, -1), beta_p.reshape(1, -1))
+    return y[:rows, :cols].reshape(*lead, cols), mean, rstd
+
+
+def _fused_ln_fwd(x, gamma, beta, eps):
+    y, mean, rstd = _ln_fwd(x, gamma, beta, eps)
+    return y, (x, gamma, mean, rstd)
+
+
+def _fused_ln_bwd(eps, res, g):
+    x, gamma, mean, rstd = res
+    lead = x.shape[:-1]
+    cols = x.shape[-1]
+    x2d = x.reshape(-1, cols)
+    g2d = g.reshape(-1, cols)
+    x2d_p, rows, _ = _pad_rows_cols(x2d, 8, 128)
+    g2d_p, _, _ = _pad_rows_cols(g2d, 8, 128)
+    rows_p, cols_p = x2d_p.shape
+    gamma_p = jnp.pad(gamma.astype(jnp.float32), (0, cols_p - cols))
+    block_r = min(_BLOCK_ROWS, rows_p)
+    n_blocks = pl.cdiv(rows_p, block_r)
+    row_spec = pl.BlockSpec((block_r, cols_p), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((1, cols_p), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    stat_spec = pl.BlockSpec((block_r, 1), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    part_spec = pl.BlockSpec((1, cols_p), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    dx, dgamma_part, dbeta_part = pl.pallas_call(
+        functools.partial(_ln_bwd_kernel, n_cols=cols),
+        out_shape=(jax.ShapeDtypeStruct((rows_p, cols_p), x.dtype),
+                   jax.ShapeDtypeStruct((n_blocks, cols_p), jnp.float32),
+                   jax.ShapeDtypeStruct((n_blocks, cols_p), jnp.float32)),
+        grid=(n_blocks,),
+        in_specs=[row_spec, row_spec, vec_spec, stat_spec, stat_spec],
+        out_specs=(row_spec, part_spec, part_spec),
+        interpret=interpret_mode(),
+    )(x2d_p, g2d_p, gamma_p.reshape(1, -1), mean, rstd)
+    dx = dx[:rows, :cols].reshape(*lead, cols)
+    dgamma = dgamma_part.sum(axis=0)[:cols].astype(gamma.dtype)
+    dbeta = dbeta_part.sum(axis=0)[:cols].astype(gamma.dtype)
+    return dx, dgamma, dbeta
+
+
+fused_layer_norm.defvjp(_fused_ln_fwd, _fused_ln_bwd)
+
+
+# ======================================================================
+# flash attention (blockwise online softmax)
+# ======================================================================
+
+_BQ = 128
+_BK = 128
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal,
+                      t_kv, block_k):
+    """One q block vs the whole (padded) KV sequence, online softmax."""
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (BQ, D)
+    bq, d = q.shape
+    n_kv = k_ref.shape[1] // block_k
+    qi = pl.program_id(1)
+
+    def body(kb, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        col = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = col < t_kv
+        if causal:
+            row = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            mask = jnp.logical_and(mask, col <= row)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    init = (jnp.zeros((bq, d), jnp.float32),
+            jnp.full((bq, 1), _NEG_INF, jnp.float32),
+            jnp.zeros((bq, 1), jnp.float32))
+    if causal:
+        # only blocks up to (and including) the diagonal contribute
+        n_live = jnp.minimum(((qi + 1) * bq + block_k - 1) // block_k, n_kv)
+    else:
+        n_live = n_kv
+    acc, _, l = jax.lax.fori_loop(0, n_live, body, init)
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd_impl(q, k, v, sm_scale, causal):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    dp = _round_up(d, 128)
+    tqp = _round_up(tq, _BQ)
+    tkp = _round_up(tk, _BK)
+    pad4 = lambda x, tp: jnp.pad(
+        x, ((0, 0), (0, 0), (0, tp - x.shape[2]), (0, dp - d)))
+    qp = pad4(q, tqp).reshape(b * h, tqp, dp)
+    kp = pad4(k, tkp).reshape(b * h, tkp, dp)
+    vp = pad4(v, tkp).reshape(b * h, tkp, dp)
+    grid = (b * h, tqp // _BQ)
+    q_spec = pl.BlockSpec((1, _BQ, dp), lambda bh, i: (bh, i, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, tkp, dp), lambda bh, i: (bh, 0, 0),
+                           memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
+                          causal=causal, t_kv=tk, block_k=_BK),
+        out_shape=jax.ShapeDtypeStruct((b * h, tqp, dp), q.dtype),
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        interpret=interpret_mode(),
+    )(qp, kp, vp)
+    return out.reshape(b, h, tqp, dp)[:, :, :tq, :d]
+
+
+def _attn_bwd_reference(q, k, v, sm_scale, causal, g):
+    """Memory-efficient backward: scan over KV blocks, recomputing
+    attention weights blockwise (never materializes the T×T matrix)."""
+    fp32 = jnp.float32
+    qf, kf, vf, gf = (t.astype(fp32) for t in (q, k, v, g))
+    tq, tk = q.shape[2], k.shape[2]
+    row = jnp.arange(tq)[:, None]
+
+    # pass 1: softmax stats per q row, blockwise
+    def stat_step(carry, kb):
+        m_prev, l_prev = carry
+        ks = jax.lax.dynamic_slice_in_dim(kf, kb * _BK, _BK, axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, ks) * sm_scale
+        col = kb * _BK + jnp.arange(_BK)[None, :]
+        mask = col < tk
+        if causal:
+            mask = jnp.logical_and(mask, col <= row)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m_prev, s.max(-1))
+        l_new = l_prev * jnp.exp(m_prev - m_new) + \
+            jnp.exp(s - m_new[..., None]).sum(-1)
+        return (m_new, l_new), None
+
+    tkp = _round_up(tk, _BK)
+    kf = jnp.pad(kf, ((0, 0), (0, 0), (0, tkp - tk), (0, 0)))
+    vf = jnp.pad(vf, ((0, 0), (0, 0), (0, tkp - tk), (0, 0)))
+    n_kv = tkp // _BK
+    b, h = q.shape[:2]
+    m0 = jnp.full((b, h, tq), _NEG_INF, fp32)
+    l0 = jnp.zeros((b, h, tq), fp32)
+    (m, l), _ = jax.lax.scan(stat_step, (m0, l0), jnp.arange(n_kv))
+    l = jnp.maximum(l, 1e-30)
+
+    # delta = rowsum(dO * O) computed blockwise from recomputed O
+    def out_step(carry, kb):
+        acc = carry
+        ks = jax.lax.dynamic_slice_in_dim(kf, kb * _BK, _BK, axis=2)
+        vs = jax.lax.dynamic_slice_in_dim(vf, kb * _BK, _BK, axis=2)
+        p = _block_probs(qf, ks, kb, m, l, sm_scale, causal, tk, row)
+        return acc + jnp.einsum("bhqk,bhkd->bhqd", p, vs), None
+
+    o, _ = jax.lax.scan(out_step, jnp.zeros_like(qf), jnp.arange(n_kv))
+    delta = (gf * o).sum(-1)
+
+    def grad_step(carry, kb):
+        dq = carry
+        ks = jax.lax.dynamic_slice_in_dim(kf, kb * _BK, _BK, axis=2)
+        vs = jax.lax.dynamic_slice_in_dim(vf, kb * _BK, _BK, axis=2)
+        p = _block_probs(qf, ks, kb, m, l, sm_scale, causal, tk, row)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vs)
+        ds = p * (dp - delta[..., None]) * sm_scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, ks)
+        dk_b = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+        return dq, (dk_b, dv_b)
+
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        grad_step, jnp.zeros_like(qf), jnp.arange(n_kv))
+    # (n_kv, b, h, BK, d) → (b, h, n_kv·BK, d), trimmed to tk
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, h, tkp, -1)[:, :, :tk]
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, h, tkp, -1)[:, :, :tk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _block_probs(qf, ks, kb, m, l, sm_scale, causal, tk, row):
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, ks) * sm_scale
+    col = kb * _BK + jnp.arange(_BK)[None, :]
+    mask = col < tk
+    if causal:
+        mask = jnp.logical_and(mask, col <= row)
+    s = jnp.where(mask, s, _NEG_INF)
+    return jnp.exp(s - m[..., None]) / l[..., None]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_core(q, k, v, sm_scale, causal):
+    return _flash_fwd_impl(q, k, v, sm_scale, causal)
+
+
+def _flash_vjp_fwd(q, k, v, sm_scale, causal):
+    return _flash_fwd_impl(q, k, v, sm_scale, causal), (q, k, v)
+
+
+def _flash_vjp_bwd(sm_scale, causal, res, g):
+    q, k, v = res
+    return _attn_bwd_reference(q, k, v, sm_scale, causal, g)
+
+
+_flash_core.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, sm_scale=None, causal=False):
+    """Blockwise attention, O(T) memory: softmax(QKᵀ·scale)·V.
+
+    Shapes (B, H, T, D). New capability relative to the reference (which
+    caps sequence length by device memory, SURVEY.md §5.7); pairs with
+    parallel/ring_attention.py for the sequence-parallel path.
+    """
+    scale = float(sm_scale) if sm_scale is not None else q.shape[-1] ** -0.5
+    return _flash_core(q, k, v, scale, bool(causal))

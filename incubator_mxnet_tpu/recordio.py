@@ -47,6 +47,10 @@ class MXRecordIO:
                       else native.lib.MXTRecordIOReaderCreate)
             native.check_call(create(self.uri.encode(), ctypes.byref(h)))
             self._nh = h
+            # cache the free fn now: close() may run at interpreter
+            # teardown when module globals are already None
+            self._nh_free = (native.lib.MXTRecordIOWriterFree if self.writable
+                             else native.lib.MXTRecordIOReaderFree)
             self.record = True  # truthy marker: stream is open
         else:
             self.record = open(self.uri, "wb" if self.writable else "rb")
@@ -54,10 +58,10 @@ class MXRecordIO:
 
     def close(self):
         if getattr(self, "_nh", None) is not None:
-            from . import native
-            free = (native.lib.MXTRecordIOWriterFree if self.writable
-                    else native.lib.MXTRecordIOReaderFree)
-            native.check_call(free(self._nh))
+            try:
+                self._nh_free(self._nh)
+            except Exception:  # interpreter teardown
+                pass
             self._nh = None
             self.record = None
         elif self.record is not None and self.record is not True:
@@ -73,6 +77,7 @@ class MXRecordIO:
         d = dict(self.__dict__)
         d["record"] = None
         d["_nh"] = None
+        d.pop("_nh_free", None)
         return d
 
     def __setstate__(self, d):
@@ -140,7 +145,9 @@ class MXRecordIO:
             native.check_call(native.lib.MXTRecordIOReaderNext(
                 self._nh, ctypes.byref(buf), ctypes.byref(size)))
             if not buf.value:
-                return None
+                return None  # EOF (empty records come back non-NULL)
+            if size.value == 0:
+                return b""
             return ctypes.string_at(buf.value, size.value)
         parts = []
         multipart = False

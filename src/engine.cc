@@ -154,7 +154,7 @@ class ThreadedEngine {
       std::condition_variable* cv;
       bool* done;
     } probe{&done_mu, &done_cv, &done};
-    auto fn = [](void* ctx, char**) {
+    auto fn = [](void* ctx, const char*, char**) {
       auto* p = static_cast<Probe*>(ctx);
       std::lock_guard<std::mutex> lk(*p->mu);
       *p->done = true;
@@ -302,10 +302,11 @@ class ThreadedEngine {
             }
           }
       }
+      // The callback ALWAYS fires (once) so host-side waiters are
+      // released even for skipped ops; upstream != NULL tells it to
+      // propagate instead of running user work.
       char* err = nullptr;
-      if (!upstream) {
-        op->fn(op->ctx, &err);
-      }
+      op->fn(op->ctx, upstream, &err);
       const char* msg = upstream ? upstream : err;
       for (Var* v : op->const_vars) ReleaseVar(v, false, nullptr);
       for (Var* v : op->mutable_vars) ReleaseVar(v, true, msg);

@@ -45,7 +45,12 @@ int MXTRecordIOReaderFree(RecordIOHandle h);
  * (write) vars; readers of one version run concurrently, writers are
  * exclusive and bump the version; exceptions stick to vars and rethrow
  * at wait points (threaded_engine.cc:422-522). */
-typedef void (*MXTEngineFn)(void* ctx, char** err_msg /* strdup'd */);
+/* Invoked exactly once per pushed op, even when the op is skipped
+ * because an input var carries an exception — then upstream_err is the
+ * non-NULL sticky message and the callback must NOT run user work, only
+ * release waiters. On failure the callback strdups into *err_msg. */
+typedef void (*MXTEngineFn)(void* ctx, const char* upstream_err,
+                            char** err_msg);
 
 int MXTEngineCreate(int num_workers, EngineHandle* out);
 int MXTEngineNewVar(EngineHandle e, VarHandle* out);

@@ -112,7 +112,10 @@ class RecordIOReader {
       if (cflag == 0 || cflag == 3) break;
       in_multipart = true;
     }
-    *buf = record_.data();
+    // Empty records are valid; return a non-NULL sentinel so the C ABI
+    // can distinguish "zero-length record" from EOF (NULL).
+    static const char kEmpty[1] = {0};
+    *buf = record_.empty() ? kEmpty : record_.data();
     *size = record_.size();
     return true;
   }

@@ -361,3 +361,54 @@ def test_image_record_iter_round_batch_pad(tmp_path):
     # tail: 2 real + 6 wrap-around duplicates → pad 6 (num_batch_padd)
     assert batches[1].pad == 6
     assert batches[1].data[0].shape == (8, 3, 8, 8)
+
+
+def test_native_engine_wait_after_upstream_failure_releases():
+    """A waiter on an op skipped due to upstream failure must not hang
+    (the callback always fires; engine.cc WorkerLoop)."""
+    eng = _make_engine()
+    v = eng.new_variable("x")
+
+    def boom():
+        raise ValueError("upstream")
+
+    eng.push(boom, mutable_vars=(v,))
+    op = eng.push(lambda: None, const_vars=(v,))
+    assert op.done.wait(timeout=10), "skipped op never released its waiter"
+    assert isinstance(op.exc, RuntimeError)
+    with pytest.raises(RuntimeError, match="upstream"):
+        eng.wait_for_var(v)
+    assert not eng._ops  # no leaked callback registrations
+
+
+def test_recordio_empty_first_record(tmp_path):
+    """Zero-length record at file start must not read as EOF."""
+    path = str(tmp_path / "e.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(b"")
+    w.write(b"hello")
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == b""
+    assert r.read() == b"hello"
+    assert r.read() is None
+    r.close()
+
+
+def test_image_record_iter_python_fallback_parity(tmp_path, monkeypatch):
+    """The pure-Python fallback applies the same scale/mean/std as the
+    native pipeline (no silent behavior drift when the lib is absent)."""
+    path, colors = _write_jpeg_rec(tmp_path, n=4)
+    kwargs = dict(path_imgrec=path, data_shape=(3, 16, 16), batch_size=4,
+                  shuffle=False, scale=255.0, mean_r=0.5, mean_g=0.5,
+                  mean_b=0.5, std_r=0.5, std_g=0.5, std_b=0.5)
+    nat = next(iter(mx.io.ImageRecordIter(**kwargs))).data[0].asnumpy()
+    import incubator_mxnet_tpu.native as native_mod
+    monkeypatch.setattr(native_mod, "lib", None)
+    fb_iter = mx.io.ImageRecordIter(**kwargs)
+    assert not isinstance(fb_iter, mx.io.NativeImageRecordIter)
+    fb = next(iter(fb_iter)).data[0].asnumpy()
+    assert fb.shape == nat.shape
+    # same normalization applied (decode/resize differ slightly per path)
+    onp.testing.assert_allclose(fb.mean(axis=(0, 2, 3)),
+                                nat.mean(axis=(0, 2, 3)), atol=0.05)

@@ -127,11 +127,14 @@ static void WriteRecord(std::FILE* fp, const std::vector<unsigned char>& rec) {
     uint32_t header[2] = {kMagic,
                           (cflag << 29u) | (static_cast<uint32_t>(n) &
                                             ((1u << 29u) - 1u))};
-    std::fwrite(header, 4, 2, fp);
-    if (n) std::fwrite(buf, 1, n, fp);
     static const char zeros[4] = {0, 0, 0, 0};
     size_t pad = (4 - (n & 3)) & 3;
-    if (pad) std::fwrite(zeros, 1, pad, fp);
+    if (std::fwrite(header, 4, 2, fp) != 2 ||
+        (n && std::fwrite(buf, 1, n, fp) != n) ||
+        (pad && std::fwrite(zeros, 1, pad, fp) != pad)) {
+      std::cerr << "FATAL: short write to output shard (disk full?)\n";
+      std::exit(2);
+    }
   };
   if (splits.empty()) {
     emit(0, rec.data(), rec.size());
