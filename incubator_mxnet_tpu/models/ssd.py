@@ -1,0 +1,130 @@
+"""SSD single-shot detector (reference example/ssd + the SSD symbol it
+builds from src/operator/contrib/multibox_*; BASELINE config 4).
+
+Gluon-style definition: a conv backbone is downsampled through scale
+stages; every stage emits class and box convolutions plus multibox_prior
+anchors. Targets/decoding ride the contrib detection ops
+(ops/contrib_ops.py), so training and inference both stay inside one XLA
+program — no host round-trips in the loop.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..gluon import nn, HybridBlock, loss as gloss
+from ..ndarray import NDArray
+from .. import ndarray as nd
+from ..ops import contrib_ops as _det
+
+
+def _feature_block(channels):
+    blk = nn.HybridSequential()
+    blk.add(nn.Conv2D(channels, 3, padding=1),
+            nn.BatchNorm(), nn.Activation("relu"),
+            nn.Conv2D(channels, 3, padding=1),
+            nn.BatchNorm(), nn.Activation("relu"),
+            nn.MaxPool2D(2))
+    return blk
+
+
+class SSD(HybridBlock):
+    """Multi-scale SSD head over a simple VGG-style backbone.
+
+    num_classes excludes background. sizes/ratios follow the reference
+    example/ssd convention: one (sizes, ratios) pair per scale stage.
+    """
+
+    def __init__(self, num_classes=20,
+                 sizes=((0.2, 0.272), (0.37, 0.447), (0.54, 0.619),
+                        (0.71, 0.79), (0.88, 0.961)),
+                 ratios=((1, 2, 0.5),) * 5,
+                 base_channels=16, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.sizes = sizes
+        self.ratios = ratios
+        self._num_stages = len(sizes)
+        for i in range(self._num_stages):
+            na = len(sizes[i]) + len(ratios[i]) - 1
+            setattr(self, f"stage{i}",
+                    _feature_block(base_channels * min(2 ** i, 4))
+                    if i < self._num_stages - 1 else nn.GlobalMaxPool2D())
+            setattr(self, f"cls{i}",
+                    nn.Conv2D(na * (num_classes + 1), 3, padding=1))
+            setattr(self, f"box{i}", nn.Conv2D(na * 4, 3, padding=1))
+
+    def forward(self, x):
+        anchors, cls_preds, box_preds = [], [], []
+        for i in range(self._num_stages):
+            x = getattr(self, f"stage{i}")(x)
+            a = _det.multibox_prior.fn(
+                x.data if isinstance(x, NDArray) else x,
+                sizes=self.sizes[i], ratios=self.ratios[i])
+            c = getattr(self, f"cls{i}")(x)
+            b = getattr(self, f"box{i}")(x)
+            anchors.append(NDArray(a, ctx=x.ctx) if isinstance(x, NDArray)
+                           else a)
+            # (B, A·K, H, W) → (B, H·W·A, K) flattening per stage
+            cls_preds.append(self._flatten_pred(c, self.num_classes + 1))
+            box_preds.append(self._flatten_pred(b, 4))
+        anchors = nd.concat(*anchors, dim=1) if isinstance(anchors[0], NDArray) \
+            else jnp.concatenate(anchors, axis=1)
+        cls_preds = nd.concat(*cls_preds, dim=1)
+        box_preds = nd.concat(*box_preds, dim=1)
+        # (B, N, C+1) → (B, C+1, N) as the contrib ops expect
+        cls_preds = cls_preds.transpose((0, 2, 1))
+        return anchors, cls_preds, box_preds.reshape((box_preds.shape[0], -1))
+
+    @staticmethod
+    def _flatten_pred(p, k):
+        # (B, A·K, H, W) → (B, H, W, A·K) → (B, H·W·A, K)
+        t = p.transpose((0, 2, 3, 1))
+        return t.reshape((t.shape[0], -1, k))
+
+    # -- training / inference helpers ----------------------------------
+    def targets(self, anchors, labels, cls_preds,
+                overlap_threshold=0.5, negative_mining_ratio=3.0):
+        """MultiBoxTarget wrapper (cls_target uses 0 = background)."""
+        return nd.contrib.MultiBoxTarget(
+            anchors, labels, cls_preds,
+            overlap_threshold=overlap_threshold,
+            negative_mining_ratio=negative_mining_ratio)
+
+    def detections(self, cls_preds, box_preds, anchors, nms_threshold=0.45,
+                   threshold=0.01, nms_topk=400):
+        probs = nd.softmax(cls_preds, axis=1)
+        return nd.contrib.MultiBoxDetection(
+            probs, box_preds, anchors, nms_threshold=nms_threshold,
+            threshold=threshold, nms_topk=nms_topk)
+
+
+class SSDLoss:
+    """cls softmax-CE (ignoring hard-negative-mined anchors) + smooth-L1
+    box loss, the reference example/ssd training objective."""
+
+    def __init__(self, lambd=1.0):
+        self.lambd = lambd
+
+    def __call__(self, cls_preds, box_preds, cls_target, loc_target,
+                 loc_mask):
+        # per-anchor CE over the class axis; anchors marked ignore_label
+        # by hard negative mining contribute nothing
+        logp = nd.log_softmax(cls_preds, axis=1)          # (B, C+1, N)
+        ignore = cls_target < 0
+        safe = nd.where(ignore, nd.zeros_like(cls_target), cls_target)
+        ce = -nd.pick(logp.transpose((0, 2, 1)), safe, axis=-1)  # (B, N)
+        valid = 1.0 - ignore.astype("float32")
+        cls_loss = (ce * valid).sum(axis=-1) / nd.maximum(
+            valid.sum(axis=-1), nd.ones((1,)))
+        # smooth-L1 on masked offsets, normalized by positive count
+        diff = (box_preds - loc_target) * loc_mask
+        ad = nd.abs(diff)
+        sl1 = nd.where(ad < 1.0, 0.5 * diff * diff, ad - 0.5)
+        npos = nd.maximum(loc_mask.sum(axis=-1), nd.ones((1,)))
+        box_loss = sl1.sum(axis=-1) / npos
+        return cls_loss + self.lambd * box_loss
+
+
+def ssd_300(num_classes=20, **kwargs):
+    """Standard-config constructor (reference example/ssd symbol zoo)."""
+    return SSD(num_classes=num_classes, **kwargs)

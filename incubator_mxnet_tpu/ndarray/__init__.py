@@ -102,6 +102,18 @@ class _Contrib:
 
 contrib = _Contrib()
 
+# detection op family (reference mx.nd.contrib.MultiBox*/box_* surface;
+# ops defined in ops/contrib_ops.py, wrappers generated above)
+for _cname, _gname in (
+        ("MultiBoxPrior", "_contrib_MultiBoxPrior"),
+        ("MultiBoxTarget", "_contrib_MultiBoxTarget"),
+        ("MultiBoxDetection", "_contrib_MultiBoxDetection"),
+        ("box_nms", "_contrib_box_nms"),
+        ("box_iou", "_contrib_box_iou"),
+        ("bipartite_matching", "_contrib_bipartite_matching"),
+        ("ROIAlign", "_contrib_ROIAlign")):
+    setattr(_Contrib, _cname, staticmethod(_g[_gname]))
+
 
 class _LinalgNS:
     def __getattr__(self, name):

@@ -366,46 +366,63 @@ class NDArray:
             known = int(onp.prod([s for s in shape if s != -1])) or 1
             shape[shape.index(-1)] = self.size // known
         shape = tuple(int(s) for s in shape)
+        if self._grad_live():
+            return self._op("reshape", shape=shape)
         if not self._is_view and not _is_tracer(self._chunk.array):
             return NDArray(None, _chunk=self._chunk, _vshape=shape)
         return NDArray(self.data.reshape(shape), ctx=self.ctx)
 
+    def _grad_live(self):
+        """True when this array is on the live autograd tape — view/shape
+        methods must then route through the op registry so the recorded
+        graph stays connected (Imperative::RecordOp analog)."""
+        from .. import autograd
+        return autograd.is_recording() and self._in_graph()
+
     def reshape_like(self, other):
         return self.reshape(other.shape)
 
+    # shape/view methods route through the op registry unconditionally so
+    # recording and eager paths share ONE implementation (invoke() already
+    # takes the fast jitted path when no gradient is live); only reshape
+    # above keeps its chunk-sharing view special case for in-place ops
     def expand_dims(self, axis):
-        return NDArray(jnp.expand_dims(self.data, axis), ctx=self.ctx)
+        return self._op("expand_dims", axis=axis)
 
     def squeeze(self, axis=None):
-        return NDArray(jnp.squeeze(self.data, axis), ctx=self.ctx)
+        return self._op("squeeze", axis=axis)
 
     def flatten(self):
         return self.reshape((self.shape[0], -1)) if self.ndim > 1 else self.reshape((-1,))
 
     def transpose(self, axes=None):
-        return NDArray(jnp.transpose(self.data, axes), ctx=self.ctx)
+        return self._op("transpose", axes=tuple(axes) if axes else None)
 
     def swapaxes(self, a, b):
-        return NDArray(jnp.swapaxes(self.data, a, b), ctx=self.ctx)
+        return self._op("swapaxes", dim1=a, dim2=b)
 
     def broadcast_to(self, shape):
-        return NDArray(jnp.broadcast_to(self.data, shape), ctx=self.ctx)
+        return self._op("broadcast_to", shape=tuple(shape))
 
     def broadcast_like(self, other):
         return self.broadcast_to(other.shape)
 
     def tile(self, reps):
-        return NDArray(jnp.tile(self.data, reps), ctx=self.ctx)
+        return self._op("tile", reps=tuple(reps)
+                        if isinstance(reps, (tuple, list)) else reps)
 
     def repeat(self, repeats, axis=None):
-        return NDArray(jnp.repeat(self.data, repeats, axis=axis), ctx=self.ctx)
+        return self._op("repeat", repeats=repeats, axis=axis)
 
     def pad(self, pad_width, mode="constant", constant_value=0):
-        return NDArray(jnp.pad(self.data, pad_width, mode=mode,
-                               constant_values=constant_value), ctx=self.ctx)
+        if isinstance(pad_width, (tuple, list)):
+            pad_width = tuple(tuple(p) if isinstance(p, (tuple, list)) else p
+                              for p in pad_width)
+        return self._op("pad", pad_width=pad_width, mode=mode,
+                        constant_value=constant_value)
 
     def diag(self, k=0):
-        return NDArray(jnp.diag(self.data, k), ctx=self.ctx)
+        return self._op("diag", k=k)
 
     def tostype(self, stype):
         if stype != "default":

@@ -23,3 +23,4 @@ from . import random_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import sort_ops  # noqa: F401
+from . import contrib_ops  # noqa: F401

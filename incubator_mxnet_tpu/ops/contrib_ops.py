@@ -1,0 +1,424 @@
+"""Detection / contrib operators, TPU-first.
+
+Re-designs of the reference's SSD op family (src/operator/contrib/
+multibox_prior-inl.h, multibox_target-inl.h, multibox_detection-inl.h,
+bounding_box-inl.h, src/operator/roi_pooling.cc, contrib/roi_align.cc).
+Everything is static-shape and vectorized: NMS is a fixed-topk pairwise
+suppression loop (lax.fori_loop over a (K,K) IoU matrix) instead of the
+reference's data-dependent CPU/GPU queues — invalid slots are -1-filled,
+matching the reference's output convention.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = [
+    "box_iou", "multibox_prior", "multibox_target", "multibox_detection",
+    "box_nms", "bipartite_matching", "roi_pooling", "roi_align",
+]
+
+
+# ----------------------------------------------------------------------
+# geometry helpers
+# ----------------------------------------------------------------------
+
+def _corner_iou(a, b):
+    """IoU between corner-format boxes a (..., Na, 4) and b (..., Nb, 4)."""
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.clip(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.clip(a[..., 2] - a[..., 0], 0.0) * \
+        jnp.clip(a[..., 3] - a[..., 1], 0.0)
+    area_b = jnp.clip(b[..., 2] - b[..., 0], 0.0) * \
+        jnp.clip(b[..., 3] - b[..., 1], 0.0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("_contrib_box_iou", aliases=("box_iou",))
+def box_iou(lhs, rhs, format="corner"):
+    """Pairwise IoU (reference _contrib_box_iou, bounding_box-inl.h)."""
+    if format == "center":
+        lhs = _center_to_corner(lhs)
+        rhs = _center_to_corner(rhs)
+    return _corner_iou(lhs, rhs)
+
+
+def _center_to_corner(b):
+    x, y, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], axis=-1)
+
+
+# ----------------------------------------------------------------------
+# MultiBoxPrior
+# ----------------------------------------------------------------------
+
+@register("_contrib_MultiBoxPrior", aliases=("multibox_prior",),
+          differentiable=False)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor boxes per feature-map pixel (reference multibox_prior-inl.h):
+    per cell, len(sizes)+len(ratios)-1 boxes — (s_i, r_0) for every size
+    plus (s_0, r_j) for j>0; centers at ((x+offset)·step) normalized.
+    Output (1, H·W·A, 4) corner format."""
+    sizes = tuple(float(s) for s in sizes)
+    ratios = tuple(float(r) for r in ratios)
+    h, w = data.shape[-2], data.shape[-1]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    cy, cx = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)
+
+    wh = []
+    for s in sizes:
+        r = ratios[0]
+        wh.append((s * (r ** 0.5), s / (r ** 0.5)))
+    for r in ratios[1:]:
+        s = sizes[0]
+        wh.append((s * (r ** 0.5), s / (r ** 0.5)))
+    wh = jnp.asarray(wh, jnp.float32)  # (A, 2): (w, h)
+
+    cxy = jnp.stack([cx, cy], axis=-1)[:, :, None, :]          # (H, W, 1, 2)
+    half = wh[None, None, :, :] / 2.0                          # (1, 1, A, 2)
+    boxes = jnp.concatenate([cxy - half, cxy + half], axis=-1)  # (H, W, A, 4)
+    boxes = boxes.reshape(1, h * w * wh.shape[0], 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+# ----------------------------------------------------------------------
+# MultiBoxTarget
+# ----------------------------------------------------------------------
+
+@register("_contrib_MultiBoxTarget", aliases=("multibox_target",),
+          differentiable=False)
+def multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Match anchors to ground truth (reference multibox_target-inl.h).
+
+    anchors (1, N, 4) corner; labels (B, M, 5) rows [cls, x0, y0, x1, y1]
+    with cls = -1 padding; cls_preds (B, C+1, N) for hard negative mining.
+    Returns loc_target (B, N·4), loc_mask (B, N·4), cls_target (B, N)
+    where cls_target is 0 for background and gt_class+1 for matches.
+    """
+    anchors = anchors.reshape(-1, 4)
+    n = anchors.shape[0]
+    variances = jnp.asarray(variances, jnp.float32)
+
+    def one_sample(lab, cls_pred):
+        valid = lab[:, 0] >= 0                       # (M,)
+        gt = lab[:, 1:5]
+        iou = _corner_iou(anchors, gt)               # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        # stage 1: bipartite — each gt grabs its best anchor; invalid
+        # (padding) gts scatter into a dump slot so they can't clobber
+        # a real match at the same index
+        best_anchor = jnp.argmax(iou, axis=0)        # (M,)
+        ba = jnp.where(valid, best_anchor, n)
+        forced = jnp.zeros((n + 1,), bool).at[ba].set(True)[:n]
+        forced_gt = jnp.zeros((n + 1,), jnp.int32).at[ba].set(
+            jnp.arange(lab.shape[0], dtype=jnp.int32))[:n]
+        # stage 2: threshold matches
+        best_gt = jnp.argmax(iou, axis=1)            # (N,)
+        best_iou = jnp.max(iou, axis=1)
+        matched = forced | (best_iou >= overlap_threshold)
+        match_gt = jnp.where(forced, forced_gt, best_gt)
+
+        gt_cls = lab[match_gt, 0]
+        cls_target = jnp.where(matched, gt_cls + 1.0, 0.0)
+
+        # hard negative mining: keep top (ratio × #pos) negatives by
+        # background confidence gap, others → ignore_label
+        if negative_mining_ratio > 0:
+            probs = jax.nn.softmax(cls_pred, axis=0)    # (C+1, N)
+            neg_score = 1.0 - probs[0]                  # confidence not-bg
+            neg_score = jnp.where(matched, -1.0, neg_score)
+            num_pos = jnp.sum(matched)
+            max_neg = (num_pos * negative_mining_ratio).astype(jnp.int32)
+            order = jnp.argsort(-neg_score)
+            rank = jnp.zeros((n,), jnp.int32).at[order].set(jnp.arange(n))
+            keep_neg = (~matched) & (rank < max_neg)
+            cls_target = jnp.where(matched | keep_neg, cls_target,
+                                   float(ignore_label))
+
+        # location targets: encode matched gt vs anchor with variances
+        g = gt[match_gt]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        aw = jnp.maximum(anchors[:, 2] - anchors[:, 0], 1e-12)
+        ah = jnp.maximum(anchors[:, 3] - anchors[:, 1], 1e-12)
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-12)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-12)
+        loc = jnp.stack([
+            (gcx - acx) / aw / variances[0],
+            (gcy - acy) / ah / variances[1],
+            jnp.log(gw / aw) / variances[2],
+            jnp.log(gh / ah) / variances[3],
+        ], axis=-1)                                   # (N, 4)
+        mask = matched[:, None].astype(jnp.float32) * jnp.ones((1, 4))
+        return (loc * mask).reshape(-1), mask.reshape(-1), cls_target
+
+    loc_t, loc_m, cls_t = jax.vmap(one_sample)(labels, cls_preds)
+    return loc_t, loc_m, cls_t
+
+
+# ----------------------------------------------------------------------
+# NMS + MultiBoxDetection
+# ----------------------------------------------------------------------
+
+def _nms_keep(boxes, scores, ids, iou_threshold, force_suppress, topk):
+    """Greedy NMS over score-sorted boxes; returns sorted order + keep
+    mask (static shapes; invalid entries must carry score<=0)."""
+    k = min(topk, scores.shape[0]) if topk > 0 else scores.shape[0]
+    order = jnp.argsort(-scores)[:k]
+    b = boxes[order]
+    s = scores[order]
+    c = ids[order]
+    iou = _corner_iou(b, b)                          # (k, k)
+    same_cls = (c[:, None] == c[None, :]) | bool(force_suppress)
+    overlap = (iou > iou_threshold) & same_cls
+
+    def body(i, alive):
+        row = overlap[i] & alive[i] & (jnp.arange(k) > i)
+        return alive & ~row
+
+    alive = lax.fori_loop(0, k, body, s > 0)
+    return order, alive
+
+
+@register("_contrib_box_nms", aliases=("box_nms",), differentiable=False)
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    """Generic NMS (reference bounding_box-inl.h BoxNMS): rows failing
+    the score threshold or suppressed are overwritten with -1."""
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[None]
+
+    def one(batch):
+        boxes = batch[:, coord_start:coord_start + 4]
+        if in_format == "center":
+            boxes = _center_to_corner(boxes)
+        scores = batch[:, score_index]
+        ids = (batch[:, id_index] if id_index >= 0
+               else jnp.zeros_like(scores))
+        valid = scores > valid_thresh
+        if background_id >= 0 and id_index >= 0:
+            valid &= ids != background_id
+        scores = jnp.where(valid, scores, 0.0)
+        n = batch.shape[0]
+        order, alive = _nms_keep(boxes, scores, ids, overlap_thresh,
+                                 force_suppress, topk if topk > 0 else n)
+        # compact: survivors first in score order, everything else -1
+        # (suppressed rows scatter into a dump slot that is dropped)
+        rows = batch
+        if in_format == "center" and out_format == "corner":
+            rows = rows.at[:, coord_start:coord_start + 4].set(boxes)
+        elif in_format == "corner" and out_format == "center":
+            c = rows[:, coord_start:coord_start + 4]
+            rows = rows.at[:, coord_start:coord_start + 4].set(
+                jnp.stack([(c[:, 0] + c[:, 2]) / 2, (c[:, 1] + c[:, 3]) / 2,
+                           c[:, 2] - c[:, 0], c[:, 3] - c[:, 1]], axis=-1))
+        rank = jnp.cumsum(alive) - 1
+        dest = jnp.where(alive, rank, n)
+        out = jnp.full((n + 1, batch.shape[1]), -1.0, batch.dtype)
+        out = out.at[dest].set(rows[order])
+        return out[:n]
+
+    out = jax.vmap(one)(data)
+    return out[0] if squeeze else out
+
+
+@register("_contrib_MultiBoxDetection", aliases=("multibox_detection",),
+          differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchors, clip=True,
+                       threshold=0.01, background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + per-class NMS (reference multibox_detection-inl.h).
+
+    cls_prob (B, C+1, N), loc_pred (B, N·4), anchors (1, N, 4) →
+    (B, N, 6) rows [cls_id, score, x0, y0, x1, y1], suppressed = -1.
+    cls_id excludes background (class 0 → id 0 is first foreground).
+    """
+    anchors = anchors.reshape(-1, 4)
+    n = anchors.shape[0]
+    variances = jnp.asarray(variances, jnp.float32)
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+
+    def one(prob, loc):
+        loc = loc.reshape(n, 4)
+        cx = loc[:, 0] * variances[0] * aw + acx
+        cy = loc[:, 1] * variances[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * variances[2]) * aw
+        h = jnp.exp(loc[:, 3] * variances[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                          axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best foreground class per anchor (reference picks argmax != bg)
+        fg = jnp.concatenate([prob[:background_id],
+                              prob[background_id + 1:]], axis=0)  # (C, N)
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        keep_score = score > threshold
+        score = jnp.where(keep_score, score, 0.0)
+        rows = jnp.concatenate([cls_id[:, None], score[:, None], boxes],
+                               axis=-1)
+        rows = jnp.where(keep_score[:, None], rows, -1.0)
+        return box_nms.fn(rows, overlap_thresh=nms_threshold,
+                          valid_thresh=0.0, topk=nms_topk, coord_start=2,
+                          score_index=1, id_index=0,
+                          force_suppress=force_suppress)
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+@register("_contrib_bipartite_matching", aliases=("bipartite_matching",),
+          differentiable=False)
+def bipartite_matching(data, threshold=0.5, is_ascend=False, topk=-1):
+    """Greedy bipartite matching on a score matrix (reference
+    bounding_box-inl.h BipartiteMatching): iteratively pick the global
+    best (row, col) pair, zero its row+col. Returns (row_match, col_match)
+    with -1 for unmatched."""
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[None]
+
+    def one(mat):
+        rows, cols = mat.shape
+        sign = 1.0 if not is_ascend else -1.0
+        m = mat * sign
+        limit = min(rows, cols) if topk <= 0 else min(topk, rows, cols)
+
+        def body(_, carry):
+            m, rmatch, cmatch = carry
+            flat = jnp.argmax(m)
+            r, c = flat // cols, flat % cols
+            orig = m[r, c] * sign  # value in the caller's scale
+            # matching stops at the threshold (descend: ≥, ascend: ≤)
+            ok = jnp.isfinite(m[r, c]) & \
+                (orig >= threshold if not is_ascend else orig <= threshold)
+            rmatch = jnp.where(ok, rmatch.at[r].set(c.astype(jnp.float32)),
+                               rmatch)
+            cmatch = jnp.where(ok, cmatch.at[c].set(r.astype(jnp.float32)),
+                               cmatch)
+            m = jnp.where(ok, m.at[r, :].set(-jnp.inf).at[:, c].set(-jnp.inf),
+                          m)
+            return m, rmatch, cmatch
+
+        init = (m, jnp.full((rows,), -1.0), jnp.full((cols,), -1.0))
+        _, rmatch, cmatch = lax.fori_loop(0, limit, body, init)
+        return rmatch, cmatch
+
+    r, c = jax.vmap(one)(data)
+    return (r[0], c[0]) if squeeze else (r, c)
+
+
+# ----------------------------------------------------------------------
+# ROI pooling / align
+# ----------------------------------------------------------------------
+
+@register("ROIPooling", aliases=("roi_pooling",))
+def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """Max pooling over ROI bins (reference src/operator/roi_pooling.cc).
+    rois (R, 5): [batch_idx, x0, y0, x1, y1] in image coords."""
+    ph, pw = pooled_size
+    _, c, h, w = data.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x0 = jnp.round(roi[1] * spatial_scale)
+        y0 = jnp.round(roi[2] * spatial_scale)
+        x1 = jnp.round(roi[3] * spatial_scale)
+        y1 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x1 - x0 + 1, 1.0)
+        rh = jnp.maximum(y1 - y0 + 1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = data[b]                                 # (C, H, W)
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        # bin index of each pixel (or -1 outside the roi)
+        yb = jnp.floor((ys - y0) / bin_h)
+        xb = jnp.floor((xs - x0) / bin_w)
+        y_in = (ys >= y0) & (ys <= y1)
+        x_in = (xs >= x0) & (xs <= x1)
+        yb = jnp.where(y_in, jnp.clip(yb, 0, ph - 1), -1).astype(jnp.int32)
+        xb = jnp.where(x_in, jnp.clip(xb, 0, pw - 1), -1).astype(jnp.int32)
+        y_onehot = yb[:, None] == jnp.arange(ph)[None, :]   # (H, ph)
+        x_onehot = xb[:, None] == jnp.arange(pw)[None, :]   # (W, pw)
+        cell = y_onehot[None, :, None, :, None] & \
+            x_onehot[None, None, :, None, :]                 # (1,H,W,ph,pw)
+        vals = jnp.where(cell, img[:, :, :, None, None], -jnp.inf)
+        out = jnp.max(vals, axis=(1, 2))                     # (C, ph, pw)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_ROIAlign", aliases=("roi_align",))
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=2, position_sensitive=False):
+    """Bilinear ROI align (reference src/operator/contrib/roi_align.cc),
+    average-pooled sample grid per bin."""
+    ph, pw = pooled_size
+    sr = max(int(sample_ratio), 1)
+    _, c, h, w = data.shape
+
+    def bilinear(img, y, x):
+        y = jnp.clip(y, 0.0, h - 1.0)
+        x = jnp.clip(x, 0.0, w - 1.0)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wy = y - y0
+        wx = x - x0
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x1]
+        v10 = img[:, y1, x0]
+        v11 = img[:, y1, x1]
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x0 = roi[1] * spatial_scale
+        y0 = roi[2] * spatial_scale
+        x1 = roi[3] * spatial_scale
+        y1 = roi[4] * spatial_scale
+        rw = jnp.maximum(x1 - x0, 1.0)
+        rh = jnp.maximum(y1 - y0, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = data[b]
+        iy = jnp.arange(ph, dtype=jnp.float32)
+        ix = jnp.arange(pw, dtype=jnp.float32)
+        sy = jnp.arange(sr, dtype=jnp.float32)
+        ys = y0 + (iy[:, None] + (sy[None, :] + 0.5) / sr) * bin_h  # (ph,sr)
+        xs = x0 + (ix[:, None] + (sy[None, :] + 0.5) / sr) * bin_w  # (pw,sr)
+        yy = ys.reshape(-1)                                          # ph·sr
+        xx = xs.reshape(-1)                                          # pw·sr
+        grid = jax.vmap(lambda y: jax.vmap(lambda x: bilinear(img, y, x))(xx))(yy)
+        grid = grid.reshape(ph, sr, pw, sr, c)
+        return jnp.mean(grid, axis=(1, 3)).transpose(2, 0, 1)  # (C, ph, pw)
+
+    return jax.vmap(one_roi)(rois)

@@ -1,0 +1,196 @@
+"""Detection op family + SSD (reference tests/python/unittest/
+test_contrib_* and example/ssd coverage) and the autograd-view
+regression the SSD work exposed."""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.ops import contrib_ops as co
+
+
+def test_multibox_prior_layout():
+    x = nd.zeros((1, 3, 4, 6))
+    a = nd.contrib.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2, 0.5))
+    # A = len(sizes)+len(ratios)-1 = 4
+    assert a.shape == (1, 4 * 6 * 4, 4)
+    an = a.asnumpy()[0]
+    # first cell center: ((0+.5)/6, (0+.5)/4) = (1/12, 1/8); size .5 box
+    onp.testing.assert_allclose(
+        an[0], [1 / 12 - .25, 1 / 8 - .25, 1 / 12 + .25, 1 / 8 + .25],
+        atol=1e-6)
+    # ratio-2 box: w = s·√2, h = s/√2
+    w = an[2, 2] - an[2, 0]
+    h = an[2, 3] - an[2, 1]
+    onp.testing.assert_allclose(w / h, 2.0, rtol=1e-5)
+
+
+def test_box_iou_known_values():
+    a = nd.array(onp.array([[0., 0., 2., 2.]], onp.float32))
+    b = nd.array(onp.array([[1., 1., 3., 3.], [0., 0., 2., 2.],
+                            [5., 5., 6., 6.]], onp.float32))
+    iou = nd.contrib.box_iou(a, b).asnumpy()
+    onp.testing.assert_allclose(iou[0], [1 / 7, 1.0, 0.0], atol=1e-6)
+
+
+def test_multibox_target_matching():
+    x = nd.zeros((1, 3, 8, 8))
+    anchors = nd.contrib.MultiBoxPrior(x, sizes=(0.25, 0.35), ratios=(1, 2))
+    labels = nd.array(onp.array(
+        [[[1, 0.1, 0.1, 0.35, 0.35], [-1, 0, 0, 0, 0]]], onp.float32))
+    cls_preds = nd.zeros((1, 3, anchors.shape[1]))
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(anchors, labels,
+                                                    cls_preds)
+    n = anchors.shape[1]
+    assert loc_t.shape == (1, n * 4) and cls_t.shape == (1, n)
+    ct = cls_t.asnumpy()[0]
+    assert (ct == 2).sum() >= 1      # gt class 1 → target 2
+    assert (ct == 0).sum() > n // 2  # most anchors are background
+    # masked loc targets are finite and nonzero only where matched
+    lm = loc_m.asnumpy()[0].reshape(n, 4)
+    lt = loc_t.asnumpy()[0].reshape(n, 4)
+    assert onp.all(lt[lm[:, 0] == 0] == 0)
+    assert onp.isfinite(lt).all()
+
+
+def test_multibox_target_hard_negative_mining():
+    x = nd.zeros((1, 3, 8, 8))
+    anchors = nd.contrib.MultiBoxPrior(x, sizes=(0.25,), ratios=(1,))
+    labels = nd.array(onp.array([[[0, 0.4, 0.4, 0.6, 0.6]]], onp.float32))
+    n = anchors.shape[1]
+    cls_preds = nd.random.uniform(shape=(1, 2, n))
+    _, _, cls_t = nd.contrib.MultiBoxTarget(
+        anchors, labels, cls_preds, negative_mining_ratio=3.0)
+    ct = cls_t.asnumpy()[0]
+    num_pos = (ct > 0).sum()
+    num_neg = (ct == 0).sum()
+    num_ign = (ct == -1).sum()
+    assert num_ign > 0                       # mining ignored some anchors
+    assert num_neg <= 3 * max(num_pos, 1)    # ratio respected
+
+
+def test_box_nms_suppression_and_compaction():
+    rows = nd.array(onp.array([
+        [0, 0.9, 0.10, 0.10, 0.50, 0.50],
+        [0, 0.8, 0.12, 0.12, 0.52, 0.52],   # overlaps row 0, same class
+        [1, 0.7, 0.11, 0.11, 0.51, 0.51],   # overlaps, different class
+        [0, 0.6, 0.60, 0.60, 0.90, 0.90],   # disjoint
+    ], onp.float32))
+    out = nd.contrib.box_nms(rows, overlap_thresh=0.5, id_index=0).asnumpy()
+    assert out[0, 1] == pytest.approx(0.9)
+    assert out[1, 1] == pytest.approx(0.7)   # other class survives
+    assert out[2, 1] == pytest.approx(0.6)
+    assert (out[3] == -1).all()
+    out2 = nd.contrib.box_nms(rows, overlap_thresh=0.5, id_index=0,
+                              force_suppress=True).asnumpy()
+    assert out2[1, 1] == pytest.approx(0.6)  # cross-class suppressed
+
+
+def test_multibox_detection_decodes_offsets():
+    anchors = nd.array(onp.array([[[0.2, 0.2, 0.4, 0.4],
+                                   [0.6, 0.6, 0.8, 0.8]]], onp.float32))
+    cls_prob = nd.array(onp.array(
+        [[[0.1, 0.9], [0.2, 0.05], [0.7, 0.05]]], onp.float32))  # (1,3,2)
+    loc = onp.zeros((1, 8), onp.float32)
+    det = nd.contrib.MultiBoxDetection(cls_prob, nd.array(loc), anchors,
+                                       threshold=0.1).asnumpy()[0]
+    best = det[det[:, 1] > 0]
+    assert len(best) >= 1
+    # anchor 0: class argmax over foreground rows {cls1: 0.2, cls2: 0.7}
+    assert best[0][0] == 1.0  # second foreground class (id 1)
+    onp.testing.assert_allclose(best[0][2:], [0.2, 0.2, 0.4, 0.4], atol=1e-5)
+
+
+def test_bipartite_matching():
+    score = nd.array(onp.array([[0.9, 0.2], [0.8, 0.7]], onp.float32))
+    rmatch, cmatch = nd.contrib.bipartite_matching(score, threshold=0.1)
+    r = rmatch.asnumpy()
+    c = cmatch.asnumpy()
+    assert r[0] == 0 and r[1] == 1  # row0→col0 (0.9), row1→col1 (0.7)
+    assert c[0] == 0 and c[1] == 1
+
+
+def test_roi_pooling_and_align():
+    data = onp.zeros((1, 1, 4, 4), onp.float32)
+    data[0, 0] = onp.arange(16).reshape(4, 4)
+    rois = nd.array(onp.array([[0, 0, 0, 3, 3]], onp.float32))
+    rp = nd.ROIPooling(nd.array(data), rois, pooled_size=(2, 2),
+                       spatial_scale=1.0).asnumpy()
+    onp.testing.assert_allclose(rp[0, 0], [[5, 7], [13, 15]])
+    ra = nd.contrib.ROIAlign(nd.array(data), rois, pooled_size=(2, 2),
+                             spatial_scale=1.0, sample_ratio=2).asnumpy()
+    assert ra.shape == (1, 1, 2, 2)
+    assert ra[0, 0, 0, 0] < ra[0, 0, 1, 1]  # preserves ordering
+
+
+def test_roi_align_gradients_flow():
+    data = nd.random.uniform(shape=(1, 2, 8, 8))
+    data.attach_grad()
+    rois = nd.array(onp.array([[0, 1, 1, 6, 6]], onp.float32))
+    with autograd.record():
+        out = nd.contrib.ROIAlign(data, rois, pooled_size=(3, 3),
+                                  spatial_scale=1.0)
+        s = out.sum()
+    s.backward()
+    assert float(abs(data.grad.asnumpy()).sum()) > 0
+
+
+# ---------------- autograd view regression ------------------------------
+
+def test_view_methods_keep_tape():
+    """transpose/reshape/expand_dims/... must stay on the autograd tape
+    (regression: they bypassed the op registry and silently zeroed
+    upstream gradients)."""
+    x = nd.random.uniform(shape=(2, 3, 4))
+    x.attach_grad()
+    cases = {
+        "transpose": lambda v: v.transpose((1, 0, 2)),
+        "reshape": lambda v: v.reshape((2, 12)),
+        "expand+squeeze": lambda v: v.expand_dims(0).squeeze(0),
+        "tile": lambda v: v.tile((2, 1, 1)),
+        "swapaxes": lambda v: v.swapaxes(0, 2),
+        "repeat": lambda v: v.repeat(2, axis=1),
+        "pad": lambda v: v.pad(((0, 0), (1, 1), (0, 0))),
+        "flatten": lambda v: v.flatten(),
+    }
+    for name, fn in cases.items():
+        with autograd.record():
+            s = fn(x * 1.0).sum()
+        s.backward()
+        g = x.grad.asnumpy()
+        assert onp.all(g != 0), f"{name} broke the tape"
+        x.grad[:] = 0
+
+
+# ---------------- SSD end-to-end ----------------------------------------
+
+def test_ssd_overfits_tiny_batch():
+    from incubator_mxnet_tpu.models.ssd import SSD, SSDLoss
+    mx.random.seed(0)
+    net = SSD(num_classes=2, sizes=((0.3, 0.4), (0.6, 0.7)),
+              ratios=((1, 2),) * 2, base_channels=8)
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 3, 32, 32))
+    labels = nd.array(onp.array([[[0, .1, .1, .45, .45]],
+                                 [[1, .5, .5, .95, .95]]], onp.float32))
+    lossfn = SSDLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    first = last = None
+    for i in range(40):
+        with autograd.record():
+            anchors, cls_preds, box_preds = net(x)
+            loc_t, loc_m, cls_t = net.targets(anchors, labels, cls_preds)
+            loss = lossfn(cls_preds, box_preds, cls_t, loc_t, loc_m)
+        loss.backward()
+        trainer.step(2)
+        v = float(loss.mean().asnumpy())
+        first = first if first is not None else v
+        last = v
+    assert last < first * 0.5, f"SSD did not converge: {first} -> {last}"
+    det = net.detections(cls_preds, box_preds, anchors).asnumpy()[0]
+    top = det[det[:, 1] > 0.5]
+    assert len(top) >= 1 and top[0][0] == 0
+    onp.testing.assert_allclose(top[0][2:], [.1, .1, .45, .45], atol=0.1)
