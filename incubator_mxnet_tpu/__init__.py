@@ -54,6 +54,9 @@ from . import models
 from . import profiler
 from . import runtime
 from . import amp
+from . import contrib
+from . import operator
+from . import subgraph
 from . import numpy as np  # mx.np NumPy-compatible namespace
 from . import numpy_extension as npx
 from . import callback

@@ -1,0 +1,3 @@
+"""``mx.contrib`` namespace (reference python/mxnet/contrib/)."""
+from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401

@@ -1,0 +1,6 @@
+"""ONNX interop (reference python/mxnet/contrib/onnx/) — self-contained
+protobuf wire codec, no ``onnx`` package dependency."""
+from .mx2onnx import export_model, export_bytes
+from .onnx2mx import import_model, import_bytes
+
+__all__ = ["export_model", "export_bytes", "import_model", "import_bytes"]

@@ -1,0 +1,128 @@
+"""Minimal protobuf wire-format codec for ONNX messages.
+
+The environment has no ``onnx`` package, so the exporter/importer
+(reference python/mxnet/contrib/onnx/) speak the protobuf wire format
+directly. Only what ONNX needs: varints, length-delimited fields, 32/64
+bit scalars, packed repeated numerics. Field numbers follow onnx.proto3
+(see each message builder in mx2onnx.py / parser in onnx2mx.py).
+"""
+from __future__ import annotations
+
+import struct
+
+__all__ = ["Writer", "parse_fields", "decode_varint"]
+
+_WT_VARINT = 0
+_WT_64BIT = 1
+_WT_LEN = 2
+_WT_32BIT = 5
+
+
+def _varint(value: int) -> bytes:
+    if value < 0:  # protobuf encodes negative int64 as 10-byte varint
+        value += 1 << 64
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+class Writer:
+    """Append-only message builder."""
+
+    def __init__(self):
+        self._parts: list[bytes] = []
+
+    def varint(self, field: int, value: int):
+        if value is None:
+            return self
+        self._parts.append(_varint((field << 3) | _WT_VARINT))
+        self._parts.append(_varint(int(value)))
+        return self
+
+    def string(self, field: int, value) -> "Writer":
+        if value is None:
+            return self
+        data = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+        self._parts.append(_varint((field << 3) | _WT_LEN))
+        self._parts.append(_varint(len(data)))
+        self._parts.append(data)
+        return self
+
+    bytes_ = string
+
+    def float32(self, field: int, value: float):
+        self._parts.append(_varint((field << 3) | _WT_32BIT))
+        self._parts.append(struct.pack("<f", value))
+        return self
+
+    def message(self, field: int, sub: "Writer"):
+        return self.string(field, sub.tobytes())
+
+    def packed_int64(self, field: int, values):
+        body = b"".join(_varint(int(v)) for v in values)
+        return self.string(field, body)
+
+    def packed_float(self, field: int, values):
+        return self.string(field, struct.pack(f"<{len(values)}f", *values))
+
+    def tobytes(self) -> bytes:
+        return b"".join(self._parts)
+
+
+def decode_varint(data: bytes, pos: int):
+    result = 0
+    shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            break
+        shift += 7
+    if result >= 1 << 63:  # negative int64
+        result -= 1 << 64
+    return result, pos
+
+
+def parse_fields(data: bytes):
+    """Yield (field_number, wire_type, value) over a serialized message.
+    LEN fields yield bytes; varints ints; 32/64-bit raw bytes."""
+    pos = 0
+    n = len(data)
+    while pos < n:
+        tag, pos = decode_varint(data, pos)
+        field, wt = tag >> 3, tag & 7
+        if wt == _WT_VARINT:
+            val, pos = decode_varint(data, pos)
+        elif wt == _WT_LEN:
+            ln, pos = decode_varint(data, pos)
+            val = data[pos:pos + ln]
+            pos += ln
+        elif wt == _WT_32BIT:
+            val = data[pos:pos + 4]
+            pos += 4
+        elif wt == _WT_64BIT:
+            val = data[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield field, wt, val
+
+
+def unpack_packed_int64(data: bytes):
+    out = []
+    pos = 0
+    while pos < len(data):
+        v, pos = decode_varint(data, pos)
+        out.append(v)
+    return out
+
+
+def unpack_packed_float(data: bytes):
+    return list(struct.unpack(f"<{len(data) // 4}f", data))

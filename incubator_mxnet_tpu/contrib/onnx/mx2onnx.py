@@ -1,0 +1,320 @@
+"""Symbol → ONNX exporter (reference python/mxnet/contrib/onnx/mx2onnx/).
+
+Walks the Symbol DAG (symbol/__init__.py _SymNode) in topological order
+and emits an ONNX ModelProto (opset 13) through the hand-rolled protobuf
+writer. Parameters become graph initializers (raw_data TensorProto).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ._protobuf import Writer
+
+__all__ = ["export_model", "export_bytes"]
+
+# onnx.proto3 TensorProto.DataType
+_DTYPE = {"float32": 1, "uint8": 2, "int8": 3, "int32": 6, "int64": 7,
+          "bool": 9, "float16": 10, "float64": 11, "bfloat16": 16}
+
+
+def _tensor(name, arr):
+    arr = onp.ascontiguousarray(arr)
+    w = Writer()
+    w.packed_int64(1, arr.shape)                    # dims
+    w.varint(2, _DTYPE[str(arr.dtype)])             # data_type
+    w.string(8, name)                               # name
+    w.bytes_(9, arr.tobytes())                      # raw_data
+    return w
+
+
+def _attr_int(name, v):
+    return Writer().string(1, name).varint(3, int(v)).varint(20, 2)
+
+
+def _attr_float(name, v):
+    return Writer().string(1, name).float32(2, float(v)).varint(20, 1)
+
+
+def _attr_ints(name, vs):
+    return Writer().string(1, name).packed_int64(8, vs).varint(20, 7)
+
+
+def _attr_str(name, v):
+    return Writer().string(1, name).string(4, v).varint(20, 3)
+
+
+def _node(op_type, inputs, outputs, name, attrs=()):
+    w = Writer()
+    for i in inputs:
+        w.string(1, i)
+    for o in outputs:
+        w.string(2, o)
+    w.string(3, name)
+    w.string(4, op_type)
+    for a in attrs:
+        w.message(5, a)
+    return w
+
+
+def _value_info(name, shape, dtype="float32"):
+    shp = Writer()
+    for d in shape:
+        shp.message(1, Writer().varint(1, int(d)))
+    tt = Writer().varint(1, _DTYPE[dtype]).message(2, shp)
+    tp = Writer().message(1, tt)
+    return Writer().string(1, name).message(2, tp)
+
+
+def _tuple(v, n=2):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+class _Exporter:
+    def __init__(self):
+        self.nodes: list[Writer] = []
+        self.extra_inits: list[Writer] = []
+        self._uid = 0
+
+    def uid(self, base):
+        self._uid += 1
+        return f"{base}_{self._uid}"
+
+    def shape_const(self, name, values):
+        self.extra_inits.append(
+            _tensor(name, onp.asarray(values, onp.int64)))
+        return name
+
+    # one handler per op: (node, in_names, out_name) -> emits node(s)
+    def emit(self, node, ins, out):
+        kw = node.kwargs
+        op = node.op_name
+        H = _HANDLERS.get(op)
+        if H is None:
+            raise NotImplementedError(
+                f"ONNX export: op {op!r} has no handler")
+        H(self, node, ins, out, kw)
+
+
+def _h_conv(ex, node, ins, out, kw):
+    attrs = [_attr_ints("kernel_shape", _tuple(kw.get("kernel"))),
+             _attr_ints("strides", _tuple(kw.get("stride", (1, 1)))),
+             _attr_ints("dilations", _tuple(kw.get("dilate", (1, 1)))),
+             _attr_int("group", kw.get("num_group", 1))]
+    pad = _tuple(kw.get("pad", (0, 0)))
+    attrs.append(_attr_ints("pads", pad + pad))
+    inputs = ins if not kw.get("no_bias", False) else ins[:2]
+    ex.nodes.append(_node("Conv", inputs, [out], node.name, attrs))
+
+
+def _h_fc(ex, node, ins, out, kw):
+    data = ins[0]
+    if kw.get("flatten", True):
+        flat = ex.uid(node.name + "_flat")
+        ex.nodes.append(_node("Flatten", [data], [flat],
+                              flat, [_attr_int("axis", 1)]))
+        data = flat
+    attrs = [_attr_float("alpha", 1.0), _attr_float("beta", 1.0),
+             _attr_int("transB", 1)]
+    inputs = [data, ins[1]] + (list(ins[2:3]) if not kw.get("no_bias", False)
+                               else [])
+    ex.nodes.append(_node("Gemm", inputs, [out], node.name, attrs))
+
+
+def _h_act(ex, node, ins, out, kw):
+    act = kw.get("act_type", "relu")
+    op = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+          "softrelu": "Softplus", "softsign": "Softsign"}[act]
+    ex.nodes.append(_node(op, ins[:1], [out], node.name))
+
+
+def _h_bn(ex, node, ins, out, kw):
+    attrs = [_attr_float("epsilon", kw.get("eps", 1e-5)),
+             _attr_float("momentum", kw.get("momentum", 0.9))]
+    # mx order: data gamma beta mean var == onnx X scale B mean var
+    ex.nodes.append(_node("BatchNormalization", ins[:5], [out],
+                          node.name, attrs))
+
+
+def _h_pool(ex, node, ins, out, kw):
+    ptype = kw.get("pool_type", "max")
+    if kw.get("global_pool", False):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[ptype]
+        ex.nodes.append(_node(op, ins[:1], [out], node.name))
+        return
+    op = {"max": "MaxPool", "avg": "AveragePool"}[ptype]
+    pad = _tuple(kw.get("pad", (0, 0)))
+    attrs = [_attr_ints("kernel_shape", _tuple(kw.get("kernel"))),
+             _attr_ints("strides", _tuple(kw.get("stride", (1, 1)))),
+             _attr_ints("pads", pad + pad)]
+    if op == "AveragePool":
+        attrs.append(_attr_int("count_include_pad", 1))
+    ex.nodes.append(_node(op, ins[:1], [out], node.name, attrs))
+
+
+def _h_softmax(ex, node, ins, out, kw):
+    ex.nodes.append(_node("Softmax", ins[:1], [out], node.name,
+                          [_attr_int("axis", kw.get("axis", -1))]))
+
+
+def _h_flatten(ex, node, ins, out, kw):
+    ex.nodes.append(_node("Flatten", ins[:1], [out], node.name,
+                          [_attr_int("axis", 1)]))
+
+
+def _h_elemwise(onnx_op):
+    def h(ex, node, ins, out, kw):
+        ex.nodes.append(_node(onnx_op, ins[:2], [out], node.name))
+    return h
+
+
+def _h_unary(onnx_op):
+    def h(ex, node, ins, out, kw):
+        ex.nodes.append(_node(onnx_op, ins[:1], [out], node.name))
+    return h
+
+
+def _h_concat(ex, node, ins, out, kw):
+    ex.nodes.append(_node("Concat", ins, [out], node.name,
+                          [_attr_int("axis", kw.get("dim", 1))]))
+
+
+def _h_reshape(ex, node, ins, out, kw):
+    shape_name = ex.uid(node.name + "_shape")
+    ex.shape_const(shape_name, kw.get("shape"))
+    ex.nodes.append(_node("Reshape", [ins[0], shape_name], [out], node.name))
+
+
+def _h_transpose(ex, node, ins, out, kw):
+    axes = kw.get("axes")
+    attrs = [_attr_ints("perm", axes)] if axes else []
+    ex.nodes.append(_node("Transpose", ins[:1], [out], node.name, attrs))
+
+
+def _h_dropout(ex, node, ins, out, kw):
+    ex.nodes.append(_node("Dropout", ins[:1], [out], node.name))
+
+
+def _h_leaky(ex, node, ins, out, kw):
+    ex.nodes.append(_node("LeakyRelu", ins[:1], [out], node.name,
+                          [_attr_float("alpha", kw.get("slope", 0.25))]))
+
+
+def _h_fullsoftmaxout(ex, node, ins, out, kw):
+    # SoftmaxOutput's inference semantics = Softmax over data
+    ex.nodes.append(_node("Softmax", ins[:1], [out], node.name,
+                          [_attr_int("axis", -1)]))
+
+
+def _h_clip(ex, node, ins, out, kw):
+    lo = ex.uid(node.name + "_min")
+    hi = ex.uid(node.name + "_max")
+    ex.extra_inits.append(_tensor(lo, onp.asarray(kw.get("a_min", 0.0),
+                                                  onp.float32)))
+    ex.extra_inits.append(_tensor(hi, onp.asarray(kw.get("a_max", 1.0),
+                                                  onp.float32)))
+    ex.nodes.append(_node("Clip", [ins[0], lo, hi], [out], node.name))
+
+
+_HANDLERS = {
+    "Convolution": _h_conv,
+    "FullyConnected": _h_fc,
+    "Activation": _h_act,
+    "BatchNorm": _h_bn,
+    "Pooling": _h_pool,
+    "softmax": _h_softmax,
+    "log_softmax": _h_unary("LogSoftmax"),
+    "SoftmaxOutput": _h_fullsoftmaxout,
+    "flatten": _h_flatten,
+    "concat": _h_concat,
+    "reshape": _h_reshape,
+    "transpose": _h_transpose,
+    "Dropout": _h_dropout,
+    "LeakyReLU": _h_leaky,
+    "clip": _h_clip,
+    "add": _h_elemwise("Add"),
+    "subtract": _h_elemwise("Sub"),
+    "multiply": _h_elemwise("Mul"),
+    "divide": _h_elemwise("Div"),
+    "maximum": _h_elemwise("Max"),
+    "minimum": _h_elemwise("Min"),
+    "matmul": _h_elemwise("MatMul"),
+    "dot": _h_elemwise("MatMul"),
+    "relu": _h_unary("Relu"),
+    "sigmoid": _h_unary("Sigmoid"),
+    "tanh": _h_unary("Tanh"),
+    "exp": _h_unary("Exp"),
+    "log": _h_unary("Log"),
+    "sqrt": _h_unary("Sqrt"),
+    "abs": _h_unary("Abs"),
+    "negative": _h_unary("Neg"),
+    "mean": _h_unary("ReduceMean"),
+    "elemwise_add": _h_elemwise("Add"),
+    "broadcast_add": _h_elemwise("Add"),
+    "broadcast_mul": _h_elemwise("Mul"),
+}
+
+
+def export_bytes(sym, params, input_shape, input_dtype="float32",
+                 opset=13):
+    """Serialize (symbol, params) to ONNX ModelProto bytes.
+
+    params: dict name → NDArray/ndarray for every non-data variable.
+    input_shape: shape of the single data input (dict for multi-input).
+    """
+    nodes = sym._topo_order()
+    params = {k: (v.asnumpy() if hasattr(v, "asnumpy") else onp.asarray(v))
+              for k, v in (params or {}).items()}
+
+    ex = _Exporter()
+    names: dict[int, str] = {}
+    inputs = []
+    inits = []
+    for n in nodes:
+        if n.op_name is None:  # variable
+            names[id(n)] = n.name
+            if n.name in params:
+                inits.append(_tensor(n.name, params[n.name]))
+            else:
+                shape = input_shape[n.name] if isinstance(input_shape, dict) \
+                    else input_shape
+                inputs.append(_value_info(n.name, shape, input_dtype))
+        else:
+            out_name = n.name if n.num_outputs == 1 else \
+                f"{n.name}_out{n.output_index}"
+            names[id(n)] = out_name
+            ins = [names[id(i)] for i in n.inputs]
+            ex.emit(n, ins, out_name)
+
+    outputs = [_value_info(names[id(n)], ()) for n in sym._nodes]
+
+    g = Writer()
+    for nd_ in ex.nodes:
+        g.message(1, nd_)
+    g.string(2, "incubator_mxnet_tpu")
+    for t in inits + ex.extra_inits:
+        g.message(5, t)
+    for vi in inputs:
+        g.message(11, vi)
+    for vo in outputs:
+        g.message(12, vo)
+
+    opset_w = Writer().string(1, "").varint(2, opset)
+    m = Writer()
+    m.varint(1, 8)                     # ir_version
+    m.string(2, "incubator_mxnet_tpu") # producer_name
+    m.string(3, "1.0")
+    m.message(7, g)
+    m.message(8, opset_w)
+    return m.tobytes()
+
+
+def export_model(sym, params, input_shape, onnx_file_path,
+                 input_dtype="float32", opset=13):
+    """Reference mx2onnx.export_model surface: writes the .onnx file and
+    returns its path."""
+    data = export_bytes(sym, params, input_shape, input_dtype, opset)
+    with open(onnx_file_path, "wb") as f:
+        f.write(data)
+    return onnx_file_path

@@ -1,0 +1,353 @@
+"""ONNX → Symbol importer (reference python/mxnet/contrib/onnx/onnx2mx/).
+
+Parses ModelProto wire bytes with the hand-rolled codec and rebuilds a
+Symbol graph plus arg/aux param dicts — ``import_model`` keeps the
+reference's (sym, arg_params, aux_params) return contract.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as onp
+
+from ._protobuf import parse_fields, unpack_packed_int64
+from ... import symbol as sym_mod
+from ... import ndarray as nd
+
+__all__ = ["import_model", "import_bytes"]
+
+_NP_DTYPE = {1: onp.float32, 2: onp.uint8, 3: onp.int8, 6: onp.int32,
+             7: onp.int64, 9: onp.bool_, 10: onp.float16, 11: onp.float64}
+
+
+def _parse_tensor(data: bytes):
+    dims, dtype, name, raw = [], 1, "", b""
+    float_data, int32_data, int64_data = [], [], []
+    for f, wt, v in parse_fields(data):
+        if f == 1:
+            dims += unpack_packed_int64(v) if wt == 2 else [v]
+        elif f == 2:
+            dtype = v
+        elif f == 8:
+            name = v.decode()
+        elif f == 9:
+            raw = v
+        elif f == 4:
+            float_data += list(struct.unpack(f"<{len(v) // 4}f", v)) \
+                if wt == 2 else [struct.unpack("<f", v)[0]]
+        elif f == 5:
+            int32_data += unpack_packed_int64(v) if wt == 2 else [v]
+        elif f == 7:
+            int64_data += unpack_packed_int64(v) if wt == 2 else [v]
+    np_dtype = _NP_DTYPE.get(dtype, onp.float32)
+    if raw:
+        arr = onp.frombuffer(raw, np_dtype).reshape(dims)
+    elif float_data:
+        arr = onp.asarray(float_data, np_dtype).reshape(dims)
+    elif int64_data:
+        arr = onp.asarray(int64_data, np_dtype).reshape(dims)
+    elif int32_data:
+        arr = onp.asarray(int32_data, np_dtype).reshape(dims)
+    else:
+        arr = onp.zeros(dims, np_dtype)
+    return name, arr
+
+
+def _parse_attr(data: bytes):
+    name, atype = "", 0
+    f_val, i_val, s_val, ints, floats = 0.0, 0, b"", [], []
+    t_val = None
+    for f, wt, v in parse_fields(data):
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            f_val = struct.unpack("<f", v)[0]
+        elif f == 3:
+            i_val = v
+        elif f == 4:
+            s_val = v
+        elif f == 5:
+            t_val = _parse_tensor(v)
+        elif f == 7:
+            floats += list(struct.unpack(f"<{len(v) // 4}f", v)) \
+                if wt == 2 else [struct.unpack("<f", v)[0]]
+        elif f == 8:
+            ints += unpack_packed_int64(v) if wt == 2 else [v]
+        elif f == 20:
+            atype = v
+    value = {1: f_val, 2: i_val, 3: s_val.decode() if s_val else "",
+             4: t_val, 6: floats, 7: ints}.get(atype)
+    if value is None:  # infer when type field missing
+        value = ints or floats or (s_val.decode() if s_val else i_val)
+    return name, value
+
+
+def _parse_node(data: bytes):
+    inputs, outputs, attrs = [], [], {}
+    name, op_type = "", ""
+    for f, wt, v in parse_fields(data):
+        if f == 1:
+            inputs.append(v.decode())
+        elif f == 2:
+            outputs.append(v.decode())
+        elif f == 3:
+            name = v.decode()
+        elif f == 4:
+            op_type = v.decode()
+        elif f == 5:
+            k, val = _parse_attr(v)
+            attrs[k] = val
+    return op_type, name, inputs, outputs, attrs
+
+
+def _value_info_name(data: bytes):
+    for f, _, v in parse_fields(data):
+        if f == 1:
+            return v.decode()
+    return ""
+
+
+def _pads_to_mx(pads):
+    if not pads:
+        return (0, 0)
+    half = len(pads) // 2
+    return tuple(pads[:half])
+
+
+class _Importer:
+    """Rebuilds Symbol nodes from ONNX ops."""
+
+    def __init__(self, params):
+        self.params = params
+        self.tensors: dict = {}     # onnx name → Symbol
+        self.consts: dict = {}      # onnx name → ndarray (shape inputs etc.)
+
+    def get(self, name):
+        if name in self.tensors:
+            return self.tensors[name]
+        if name in self.params:
+            v = sym_mod.var(name)
+            self.tensors[name] = v
+            return v
+        raise KeyError(f"ONNX input {name!r} not found")
+
+    def convert(self, op_type, name, inputs, outputs, attrs):
+        H = _IMPORT_HANDLERS.get(op_type)
+        if H is None:
+            raise NotImplementedError(
+                f"ONNX import: op {op_type!r} has no handler")
+        out = H(self, name, inputs, attrs)
+        if isinstance(out, tuple):
+            for o_name, o_sym in zip(outputs, out):
+                self.tensors[o_name] = o_sym
+        else:
+            self.tensors[outputs[0]] = out
+
+
+def _i_conv(im, name, ins, attrs):
+    kernel = tuple(attrs.get("kernel_shape", (1, 1)))
+    kw = dict(kernel=kernel,
+              stride=tuple(attrs.get("strides", (1,) * len(kernel))),
+              dilate=tuple(attrs.get("dilations", (1,) * len(kernel))),
+              pad=_pads_to_mx(attrs.get("pads")),
+              num_group=attrs.get("group", 1))
+    w = im.params[ins[1]]
+    kw["num_filter"] = w.shape[0]
+    args = [im.get(i) for i in ins]
+    kw["no_bias"] = len(ins) < 3
+    return sym_mod.Convolution(*args, name=name, **kw)
+
+
+def _i_gemm(im, name, ins, attrs):
+    w = im.params[ins[1]]
+    num_hidden = w.shape[0] if attrs.get("transB", 0) else w.shape[1]
+    args = [im.get(i) for i in ins]
+    return sym_mod.FullyConnected(*args, num_hidden=num_hidden,
+                                  no_bias=len(ins) < 3, flatten=False,
+                                  name=name)
+
+
+def _i_bn(im, name, ins, attrs):
+    args = [im.get(i) for i in ins]
+    # running mean/var are auxiliary states (reference FListAuxState)
+    for aux_sym in args[3:5]:
+        for n in aux_sym._nodes:
+            if n.op_name is None:
+                n.attrs["__aux__"] = "1"
+    return sym_mod.BatchNorm(*args,
+                             eps=attrs.get("epsilon", 1e-5),
+                             momentum=attrs.get("momentum", 0.9),
+                             name=name)
+
+
+def _i_pool(ptype, global_pool=False):
+    def h(im, name, ins, attrs):
+        kw = dict(pool_type=ptype, global_pool=global_pool)
+        if not global_pool:
+            kw.update(kernel=tuple(attrs.get("kernel_shape", (2, 2))),
+                      stride=tuple(attrs.get("strides", (1, 1))),
+                      pad=_pads_to_mx(attrs.get("pads")))
+        return sym_mod.Pooling(im.get(ins[0]), name=name, **kw)
+    return h
+
+
+def _i_act(mx_act):
+    def h(im, name, ins, attrs):
+        return sym_mod.Activation(im.get(ins[0]), act_type=mx_act, name=name)
+    return h
+
+
+def _i_elemwise(op_name):
+    def h(im, name, ins, attrs):
+        return getattr(sym_mod, op_name)(*[im.get(i) for i in ins])
+    return h
+
+
+def _i_unary(op_name):
+    def h(im, name, ins, attrs):
+        return getattr(sym_mod, op_name)(im.get(ins[0]))
+    return h
+
+
+def _i_softmax(im, name, ins, attrs):
+    return sym_mod.softmax(im.get(ins[0]), axis=attrs.get("axis", -1))
+
+
+def _i_flatten(im, name, ins, attrs):
+    return sym_mod.flatten(im.get(ins[0]))
+
+
+def _i_reshape(im, name, ins, attrs):
+    shape = im.consts.get(ins[1])
+    if shape is None:
+        shape = im.params.get(ins[1])
+    return sym_mod.reshape(im.get(ins[0]),
+                           shape=tuple(int(s) for s in shape))
+
+
+def _i_transpose(im, name, ins, attrs):
+    return sym_mod.transpose(im.get(ins[0]),
+                             axes=tuple(attrs.get("perm", ())) or None)
+
+
+def _i_concat(im, name, ins, attrs):
+    return sym_mod.concat(*[im.get(i) for i in ins],
+                          dim=attrs.get("axis", 1))
+
+
+def _i_dropout(im, name, ins, attrs):
+    return im.get(ins[0])  # inference graph: identity
+
+
+def _i_leaky(im, name, ins, attrs):
+    return sym_mod.LeakyReLU(im.get(ins[0]),
+                             slope=attrs.get("alpha", 0.01))
+
+
+def _i_clip(im, name, ins, attrs):
+    a_min = attrs.get("min", 0.0)
+    a_max = attrs.get("max", 1.0)
+    if len(ins) > 1:
+        c = im.consts.get(ins[1], im.params.get(ins[1]))
+        if c is not None:
+            a_min = float(c)
+    if len(ins) > 2:
+        c = im.consts.get(ins[2], im.params.get(ins[2]))
+        if c is not None:
+            a_max = float(c)
+    return sym_mod.clip(im.get(ins[0]), a_min=a_min, a_max=a_max)
+
+
+_IMPORT_HANDLERS = {
+    "Conv": _i_conv,
+    "Gemm": _i_gemm,
+    "BatchNormalization": _i_bn,
+    "MaxPool": _i_pool("max"),
+    "AveragePool": _i_pool("avg"),
+    "GlobalMaxPool": _i_pool("max", True),
+    "GlobalAveragePool": _i_pool("avg", True),
+    "Relu": _i_act("relu"),
+    "Sigmoid": _i_act("sigmoid"),
+    "Tanh": _i_act("tanh"),
+    "Softplus": _i_act("softrelu"),
+    "Softmax": _i_softmax,
+    "LogSoftmax": _i_unary("log_softmax"),
+    "Flatten": _i_flatten,
+    "Reshape": _i_reshape,
+    "Transpose": _i_transpose,
+    "Concat": _i_concat,
+    "Dropout": _i_dropout,
+    "LeakyRelu": _i_leaky,
+    "Clip": _i_clip,
+    "Add": _i_elemwise("add"),
+    "Sub": _i_elemwise("subtract"),
+    "Mul": _i_elemwise("multiply"),
+    "Div": _i_elemwise("divide"),
+    "Max": _i_elemwise("maximum"),
+    "Min": _i_elemwise("minimum"),
+    "MatMul": _i_elemwise("matmul"),
+    "Exp": _i_unary("exp"),
+    "Log": _i_unary("log"),
+    "Sqrt": _i_unary("sqrt"),
+    "Abs": _i_unary("abs"),
+    "Neg": _i_unary("negative"),
+}
+
+
+def import_bytes(data: bytes):
+    graph = None
+    for f, _, v in parse_fields(data):
+        if f == 7:
+            graph = v
+    if graph is None:
+        raise ValueError("no GraphProto in model")
+
+    raw_nodes, inits, g_inputs, g_outputs = [], {}, [], []
+    for f, _, v in parse_fields(graph):
+        if f == 1:
+            raw_nodes.append(_parse_node(v))
+        elif f == 5:
+            name, arr = _parse_tensor(v)
+            inits[name] = arr
+        elif f == 11:
+            g_inputs.append(_value_info_name(v))
+        elif f == 12:
+            g_outputs.append(_value_info_name(v))
+
+    im = _Importer(inits)
+    # shape-ish int64 initializers double as constants for Reshape etc.
+    im.consts = {k: v for k, v in inits.items() if v.dtype == onp.int64}
+    for iname in g_inputs:
+        if iname not in inits:
+            im.tensors[iname] = sym_mod.var(iname)
+    # Constant nodes become consts
+    for op_type, name, ins, outs, attrs in raw_nodes:
+        if op_type == "Constant":
+            t = attrs.get("value")
+            if t is not None:
+                im.consts[outs[0]] = t[1]
+            continue
+        im.convert(op_type, name, ins, outs, attrs)
+
+    out_syms = [im.tensors[o] for o in g_outputs]
+    sym = out_syms[0] if len(out_syms) == 1 else sym_mod.Group(out_syms)
+
+    used = set()
+    for n in sym._topo_order():
+        if n.op_name is None:
+            used.add(n.name)
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params, aux_params = {}, {}
+    for k, v in inits.items():
+        if k not in used or v.dtype == onp.int64:
+            continue
+        (aux_params if k in aux_names else arg_params)[k] = nd.array(v)
+    return sym, arg_params, aux_params
+
+
+def import_model(model_file):
+    """Reference onnx2mx.import_model surface: returns
+    (sym, arg_params, aux_params)."""
+    with open(model_file, "rb") as f:
+        data = f.read()
+    return import_bytes(data)

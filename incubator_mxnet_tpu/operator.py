@@ -1,0 +1,197 @@
+"""Custom operator escape hatch (reference python/mxnet/operator.py +
+src/operator/custom/custom-inl.h:52-232).
+
+The reference runs Python-callback ops on dedicated worker threads; the
+TPU-native design runs them through ``jax.pure_callback`` so a custom op
+is legal INSIDE a jitted/compiled graph (host round-trip, documented
+slow path) and still differentiable: forward/backward both dispatch to
+the user's ``CustomOp`` methods via a ``jax.custom_vjp`` pair.
+
+API surface kept: ``CustomOp`` (forward/backward/assign), ``CustomOpProp``
+(list_arguments/list_outputs/infer_shape/infer_type/create_operator),
+``register``, and ``nd.Custom(..., op_type=...)``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop", "custom"]
+
+_PROPS: dict = {}
+
+
+class CustomOp:
+    """Base class for user ops (reference operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    @staticmethod
+    def assign(dst, req, src):
+        """Write src into dst honoring the grad_req (write/add/null)."""
+        if req in ("null", 0):
+            return
+        if req in ("add", "add_to"):
+            dst[:] = dst.asnumpy() + (src.asnumpy() if hasattr(src, "asnumpy")
+                                      else onp.asarray(src))
+        else:
+            dst[:] = src
+
+
+class CustomOpProp:
+    """Metadata provider (reference operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp under ``op_type=reg_name``
+    (reference operator.py:register / MXCustomOpRegister)."""
+
+    def deco(prop_cls):
+        _PROPS[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_prop(name):
+    try:
+        return _PROPS[name]
+    except KeyError:
+        raise KeyError(f"custom op {name!r} is not registered") from None
+
+
+class _HostArray:
+    """Minimal NDArray-like handed to user CustomOp code: numpy storage
+    with the mutation surface (slicing assign, asnumpy) forward/backward
+    implementations use."""
+
+    def __init__(self, arr):
+        self._a = onp.asarray(arr)
+
+    def asnumpy(self):
+        return self._a
+
+    @property
+    def shape(self):
+        return self._a.shape
+
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+    def __getitem__(self, k):
+        return self._a[k]
+
+    def __setitem__(self, k, v):
+        self._a[k] = v.asnumpy() if hasattr(v, "asnumpy") else onp.asarray(v)
+
+
+def _build_callable(op_type, num_inputs, kwargs):
+    """Build the custom_vjp-wrapped jax function for one invocation
+    signature. The CustomOp instance is created lazily host-side."""
+    prop_cls = get_prop(op_type)
+    prop = prop_cls(**kwargs) if kwargs else prop_cls()
+    n_out = len(prop.list_outputs())
+
+    def make_op(shapes, dtypes):
+        return prop.create_operator(None, shapes, dtypes)
+
+    def _out_dtypes(in_dtypes):
+        _, outs, _ = prop.infer_type(list(in_dtypes))
+        return [onp.dtype(t) for t in outs]
+
+    def host_forward(*arrays):
+        shapes = [a.shape for a in arrays]
+        dtypes = [a.dtype for a in arrays]
+        _, out_shapes, _ = prop.infer_shape([list(s) for s in shapes])
+        out_dtypes = _out_dtypes(dtypes)
+        op = make_op(shapes, dtypes)
+        in_data = [_HostArray(a) for a in arrays]
+        out_data = [_HostArray(onp.zeros(s, t))
+                    for s, t in zip(out_shapes, out_dtypes)]
+        op.forward(True, ["write"] * n_out, in_data, out_data, [])
+        return tuple(o.asnumpy() for o in out_data)
+
+    def host_backward(*arrays):
+        # arrays = out_grads + inputs + outputs
+        grads = arrays[:n_out]
+        ins = arrays[n_out:n_out + num_inputs]
+        outs = arrays[n_out + num_inputs:]
+        op = make_op([a.shape for a in ins], [a.dtype for a in ins])
+        in_grad = [_HostArray(onp.zeros(a.shape, a.dtype)) for a in ins]
+        op.backward(["write"] * num_inputs,
+                    [_HostArray(g) for g in grads],
+                    [_HostArray(a) for a in ins],
+                    [_HostArray(a) for a in outs],
+                    in_grad, [])
+        return tuple(g.asnumpy() for g in in_grad)
+
+    @jax.custom_vjp
+    def fn(*inputs):
+        shapes = [jnp.shape(x) for x in inputs]
+        _, out_shapes, _ = prop.infer_shape([list(s) for s in shapes])
+        out_dtypes = _out_dtypes([onp.dtype(str(x.dtype)) for x in inputs])
+        result_shape = tuple(
+            jax.ShapeDtypeStruct(tuple(s), t)
+            for s, t in zip(out_shapes, out_dtypes))
+        out = jax.pure_callback(host_forward, result_shape, *inputs,
+                                vmap_method="sequential")
+        return out[0] if n_out == 1 else out
+
+    def fn_fwd(*inputs):
+        out = fn(*inputs)
+        outs = (out,) if n_out == 1 else out
+        return out, (inputs, outs)
+
+    def fn_bwd(res, g):
+        inputs, outs = res
+        gs = (g,) if n_out == 1 else g
+        result_shape = tuple(
+            jax.ShapeDtypeStruct(jnp.shape(x), x.dtype) for x in inputs)
+        grads = jax.pure_callback(host_backward, result_shape, *gs, *inputs,
+                                  *outs, vmap_method="sequential")
+        return tuple(grads)
+
+    fn.defvjp(fn_fwd, fn_bwd)
+    return fn, n_out
+
+
+@functools.lru_cache(maxsize=256)
+def _cached_callable(op_type, num_inputs, kwargs_items):
+    return _build_callable(op_type, num_inputs, dict(kwargs_items))
+
+
+def custom(*inputs, op_type, **kwargs):
+    """Raw jax-level custom op invocation (used by nd.Custom and the
+    symbol frontend)."""
+    fn, _ = _cached_callable(op_type, len(inputs),
+                             tuple(sorted(kwargs.items())))
+    return fn(*inputs)

@@ -24,3 +24,13 @@ from . import sequence_ops  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import sort_ops  # noqa: F401
 from . import contrib_ops  # noqa: F401
+from . import quantization_ops  # noqa: F401
+
+# Python-callback custom op (reference src/operator/custom/): op named
+# "Custom" with op_type kwarg, matching nd.Custom(..., op_type=...)
+from ..operator import custom as _custom_invoke
+
+
+@register("Custom")
+def Custom(*inputs, op_type=None, **kwargs):
+    return _custom_invoke(*inputs, op_type=op_type, **kwargs)

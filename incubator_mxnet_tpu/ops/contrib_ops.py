@@ -143,12 +143,15 @@ def multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
         if negative_mining_ratio > 0:
             probs = jax.nn.softmax(cls_pred, axis=0)    # (C+1, N)
             neg_score = 1.0 - probs[0]                  # confidence not-bg
-            neg_score = jnp.where(matched, -1.0, neg_score)
+            # only anchors clearly away from any gt are mining candidates
+            # (reference negative_mining_thresh gate)
+            candidate = (~matched) & (best_iou < negative_mining_thresh)
+            neg_score = jnp.where(candidate, neg_score, -1.0)
             num_pos = jnp.sum(matched)
             max_neg = (num_pos * negative_mining_ratio).astype(jnp.int32)
             order = jnp.argsort(-neg_score)
             rank = jnp.zeros((n,), jnp.int32).at[order].set(jnp.arange(n))
-            keep_neg = (~matched) & (rank < max_neg)
+            keep_neg = candidate & (rank < max_neg)
             cls_target = jnp.where(matched | keep_neg, cls_target,
                                    float(ignore_label))
 

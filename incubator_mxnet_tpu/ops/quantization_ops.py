@@ -1,0 +1,122 @@
+"""Quantization operators (int8), TPU-first.
+
+Re-design of the reference int8 stack (src/operator/quantization/:
+quantize_v2-inl.h, dequantize-inl.h, requantize-inl.h, quantized_conv.cc,
+quantized_fully_connected.cc). The reference routes int8 math to
+cuDNN/MKL-DNN; here the int8 matmul/conv goes to the MXU via
+lax.dot_general/conv with int8 inputs and int32 accumulation, and the
+(de)quantize steps are elementwise XLA ops that fuse around it.
+
+Convention kept from the reference: signed int8 symmetric range
+[-127, 127] ("int8" out_type), thresholds carried as (min, max) floats.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = ["quantize", "dequantize", "requantize", "quantized_dense",
+           "quantized_conv2d"]
+
+_INT8_RANGE = 127.0
+
+
+def _scale_from_range(min_range, max_range):
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return jnp.maximum(amax, 1e-12) / _INT8_RANGE
+
+
+@register("_contrib_quantize_v2", aliases=("quantize",),
+          differentiable=False)
+def quantize(data, min_range=None, max_range=None, out_type="int8"):
+    """f32 → int8 + (min, max) thresholds (reference quantize_v2-inl.h).
+    When no range is given it is computed from the data (the reference's
+    min_calib_range=None path)."""
+    if min_range is None:
+        min_range = jnp.min(data)
+    if max_range is None:
+        max_range = jnp.max(data)
+    min_range = jnp.asarray(min_range, jnp.float32)
+    max_range = jnp.asarray(max_range, jnp.float32)
+    scale = _scale_from_range(min_range, max_range)
+    q = jnp.clip(jnp.round(data / scale), -_INT8_RANGE, _INT8_RANGE)
+    return q.astype(jnp.int8), min_range, max_range
+
+
+@register("_contrib_dequantize", aliases=("dequantize",),
+          differentiable=False)
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """Quantized → f32. The quantized range follows the input dtype
+    (int8 → 127, int32 accumulators → 2³¹−1), matching the reference
+    DequantizeCompute's per-dtype ranges."""
+    qrange = _INT8_RANGE if data.dtype in (jnp.int8, jnp.uint8) \
+        else float(2 ** 31 - 1)
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    scale = jnp.maximum(amax, 1e-12) / qrange
+    return data.astype(jnp.float32) * scale
+
+
+@register("_contrib_requantize", aliases=("requantize",),
+          differentiable=False)
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accumulator → int8 with a new range (requantize-inl.h).
+    data's implied scale is (range/2^31); target range either calibrated
+    or taken from the data."""
+    in_scale = jnp.maximum(jnp.maximum(jnp.abs(min_range),
+                                       jnp.abs(max_range)), 1e-12) / \
+        float(2 ** 31 - 1)
+    real = data.astype(jnp.float32) * in_scale
+    if min_calib_range is None:
+        min_calib_range = jnp.min(real)
+    if max_calib_range is None:
+        max_calib_range = jnp.max(real)
+    min_c = jnp.asarray(min_calib_range, jnp.float32)
+    max_c = jnp.asarray(max_calib_range, jnp.float32)
+    out_scale = _scale_from_range(min_c, max_c)
+    q = jnp.clip(jnp.round(real / out_scale), -_INT8_RANGE, _INT8_RANGE)
+    return q.astype(jnp.int8), min_c, max_c
+
+
+@register("_contrib_quantized_fully_connected",
+          aliases=("quantized_dense",), differentiable=False)
+def quantized_dense(data, weight, bias, data_min, data_max, w_min, w_max,
+                    num_hidden=0):
+    """int8×int8→int32 dense on the MXU (quantized_fully_connected.cc).
+    Returns (int32 out, out_min, out_max) with the implied f32 range."""
+    acc = lax.dot_general(data.astype(jnp.int8), weight.astype(jnp.int8),
+                          (((data.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    d_scale = _scale_from_range(data_min, data_max)
+    w_scale = _scale_from_range(w_min, w_max)
+    out_scale = d_scale * w_scale
+    if bias is not None:
+        # bias arrives f32; fold at int32 accumulator scale
+        acc = acc + jnp.round(bias / out_scale).astype(jnp.int32)
+    out_max = out_scale * float(2 ** 31 - 1)
+    return acc, -out_max, out_max
+
+
+@register("_contrib_quantized_conv", aliases=("quantized_conv2d",),
+          differentiable=False)
+def quantized_conv2d(data, weight, bias, data_min, data_max, w_min, w_max,
+                     stride=(1, 1), pad=(0, 0), dilate=(1, 1)):
+    """int8 NCHW conv with int32 accumulation (quantized_conv.cc)."""
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=tuple(stride),
+        padding=tuple((p, p) for p in pad),
+        rhs_dilation=tuple(dilate),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.int32)
+    d_scale = _scale_from_range(data_min, data_max)
+    w_scale = _scale_from_range(w_min, w_max)
+    out_scale = d_scale * w_scale
+    if bias is not None:
+        acc = acc + jnp.round(bias / out_scale).astype(jnp.int32)[
+            None, :, None, None]
+    out_max = out_scale * float(2 ** 31 - 1)
+    return acc, -out_max, out_max

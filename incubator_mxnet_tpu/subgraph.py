@@ -1,0 +1,278 @@
+"""Subgraph backend API (reference src/operator/subgraph/
+subgraph_property.h:86-252, build_subgraph.cc, MXNET_SUBGRAPH_BACKEND).
+
+Extension point parity: a backend registers a ``SubgraphProperty`` whose
+selector claims ops; ``partition()`` greedily grows connected regions of
+claimed nodes and replaces each with a single fused node executing the
+sub-DAG through one ``jax.jit`` callable. The built-in ``"XLA"`` backend
+claims every op — the whole-graph → one-XLA-program compile that
+``simple_bind`` also performs, exposed through the same plugin surface
+the reference uses for MKLDNN/TensorRT backends.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+
+__all__ = ["SubgraphSelector", "SubgraphProperty", "register_backend",
+           "get_backend", "list_backends", "partition",
+           "default_backend_from_env"]
+
+_BACKENDS: dict = {}
+_lock = threading.Lock()
+
+
+class SubgraphSelector:
+    """Node-claiming policy (subgraph_property.h SubgraphSelector)."""
+
+    def is_op_supported(self, node) -> bool:  # node: symbol._SymNode
+        return False
+
+
+class SubgraphProperty:
+    """Backend description (subgraph_property.h SubgraphProperty)."""
+
+    name = "base"
+
+    def create_selector(self) -> SubgraphSelector:
+        return SubgraphSelector()
+
+    def min_subgraph_size(self) -> int:
+        return 2
+
+    # hook: backends may post-process the fused callable
+    def wrap_callable(self, fn):
+        return fn
+
+
+def register_backend(prop: "SubgraphProperty | type"):
+    """MXNET_REGISTER_SUBGRAPH_PROPERTY analog."""
+    if isinstance(prop, type):
+        prop = prop()
+    with _lock:
+        _BACKENDS[prop.name] = prop
+    return prop
+
+
+def get_backend(name: str) -> SubgraphProperty:
+    try:
+        return _BACKENDS[name]
+    except KeyError:
+        raise KeyError(
+            f"subgraph backend {name!r} not registered "
+            f"(have: {sorted(_BACKENDS)})") from None
+
+
+def list_backends():
+    return sorted(_BACKENDS)
+
+
+def default_backend_from_env():
+    """MXNET_SUBGRAPH_BACKEND env knob (reference
+    docs faq/perf.md:61 / build_subgraph.cc)."""
+    return os.environ.get("MXNET_SUBGRAPH_BACKEND", "")
+
+
+class _AllSelector(SubgraphSelector):
+    def is_op_supported(self, node):
+        return True
+
+
+class XLAProperty(SubgraphProperty):
+    """Swallow the maximal subgraph into one XLA program (SURVEY.md §2.1
+    subgraph row: the natural home of whole-graph compilation)."""
+
+    name = "XLA"
+
+    def create_selector(self):
+        return _AllSelector()
+
+    def min_subgraph_size(self):
+        return 1
+
+
+register_backend(XLAProperty)
+
+
+_FUSED_UID = [0]
+
+
+def partition(sym, backend_name):
+    """Partition a Symbol under a backend: contiguous regions of claimed
+    ops become fused nodes (reference build_subgraph.cc BuildSubgraph).
+
+    Returns a new Symbol whose fused regions execute as single jitted
+    callables through per-partition registered ops. Grouping is
+    cycle-safe: a claimed node only joins an input's group when that
+    group is not also reachable through an unclaimed path (otherwise the
+    fused node would depend on an external input that depends on it).
+    """
+    from . import symbol as sym_mod
+    from .ops.registry import register
+
+    prop = get_backend(backend_name)
+    selector = prop.create_selector()
+    order = sym._topo_order()
+
+    claimed = {id(n) for n in order
+               if n.op_name is not None and selector.is_op_supported(n)}
+
+    # group assignment in topo order with cycle check:
+    #   all_groups[v]    = groups reachable from v (any path)
+    #   via_unclaimed[v] = groups reachable only via ≥1 unclaimed node
+    group_of: dict = {}
+    members_of: dict = {}
+    all_groups: dict = {}
+    via_unclaimed: dict = {}
+    next_gid = [0]
+    for n in order:
+        ag, vu = set(), set()
+        for i in n.inputs:
+            ag |= all_groups.get(id(i), set())
+            if id(i) in claimed:
+                vu |= via_unclaimed.get(id(i), set())
+            else:
+                # path through an unclaimed node: everything reachable
+                # from it becomes forbidden for joining
+                vu |= all_groups.get(id(i), set())
+                vu |= via_unclaimed.get(id(i), set())
+        if id(n) in claimed:
+            joined = None
+            for i in n.inputs:
+                g = group_of.get(id(i))
+                if g is not None and g not in vu:
+                    joined = g
+                    break
+            if joined is None:
+                joined = next_gid[0]
+                next_gid[0] += 1
+                members_of[joined] = []
+            group_of[id(n)] = joined
+            members_of[joined].append(n)
+            ag = ag | {joined}
+        all_groups[id(n)] = ag
+        via_unclaimed[id(n)] = vu
+
+    groups = {g: v for g, v in members_of.items()
+              if len(v) >= prop.min_subgraph_size()}
+    if not groups:
+        return sym
+    node_group = {id(n): g for g, v in groups.items() for n in v}
+
+    # rebuild the graph, replacing each group with fused node(s): one
+    # registered op per consumed output, all sharing one memoized fused
+    # callable so the sub-DAG executes once per distinct input set
+    by_id: dict = {}
+    group_nodes: dict = {}
+
+    def convert(node):
+        if id(node) in by_id:
+            return by_id[id(node)]
+        gid = node_group.get(id(node))
+        if gid is None:
+            new_inputs = [convert(i) for i in node.inputs]
+            nn = sym_mod._SymNode(node.op_name, node.name, new_inputs,
+                                  node.kwargs, node.attrs,
+                                  node.num_outputs, node.output_index)
+            by_id[id(node)] = nn
+            return nn
+        if gid not in group_nodes:
+            members = groups[gid]
+            member_ids = {id(m) for m in members}
+            ext, seen = [], set()
+            for m in members:
+                for i in m.inputs:
+                    if id(i) not in member_ids and id(i) not in seen:
+                        seen.add(id(i))
+                        ext.append(i)
+            consumed_outside = set()
+            for n2 in order:
+                if id(n2) in member_ids:
+                    continue
+                for i in n2.inputs:
+                    if id(i) in member_ids:
+                        consumed_outside.add(id(i))
+            for h in sym._nodes:
+                if id(h) in member_ids:
+                    consumed_outside.add(id(h))
+            outs = [m for m in members if id(m) in consumed_outside]
+
+            fused_fn = prop.wrap_callable(
+                _make_fused_callable(members, ext, outs))
+            memo = {"args": None, "out": None}
+
+            def run_all(args):
+                prev = memo["args"]
+                if prev is not None and len(prev) == len(args) and \
+                        all(a is b for a, b in zip(prev, args)):
+                    return memo["out"]
+                out = fused_fn(*args)
+                if not isinstance(out, tuple):
+                    out = (out,)
+                memo["args"] = args
+                memo["out"] = out
+                return out
+
+            _FUSED_UID[0] += 1
+            uid = _FUSED_UID[0]
+            new_inputs = [convert(i) for i in ext]
+            attrs = {"__subgraph__": prop.name,
+                     "__n_ops__": str(len(members))}
+            picks = {}
+            for k, o in enumerate(outs):
+                op_name = f"_subgraph_{prop.name}_{uid}_out{k}"
+
+                def out_fn(*args, _k=k):
+                    return run_all(args)[_k]
+
+                register(op_name)(out_fn)
+                picks[id(o)] = sym_mod._SymNode(op_name, op_name,
+                                                new_inputs, {}, attrs)
+            group_nodes[gid] = picks
+        picks = group_nodes[gid]
+        by_id[id(node)] = picks[id(node)]
+        return picks[id(node)]
+
+    new_heads = [convert(h) for h in sym._nodes]
+    return sym_mod.Symbol(new_heads)
+
+
+def _make_fused_callable(members, ext_inputs, outs):
+    """One jit-compiled callable over the member sub-DAG."""
+    from .ops.registry import get_op
+
+    member_ids = {id(m) for m in members}
+    ext_pos = {id(e): i for i, e in enumerate(ext_inputs)}
+    out_ids = [id(o) for o in outs]
+    # snapshot the sub-DAG structure (node → op + input wiring)
+    plan = []
+    for m in members:
+        srcs = []
+        for i in m.inputs:
+            if id(i) in member_ids:
+                srcs.append(("m", id(i), i.output_index))
+            else:
+                srcs.append(("e", ext_pos[id(i)], 0))
+        plan.append((id(m), get_op(m.op_name), m.kwargs, srcs))
+
+    @jax.jit
+    def fused(*args):
+        vals: dict = {}
+        for mid, op, kwargs, srcs in plan:
+            ins = []
+            for kind, key, oidx in srcs:
+                if kind == "e":
+                    ins.append(args[key])
+                else:
+                    v = vals[key]
+                    ins.append(v[oidx] if isinstance(v, tuple) else v)
+            vals[mid] = op.fn(*ins, **kwargs)
+        result = []
+        for oid in out_ids:
+            v = vals[oid]
+            result.append(v if not isinstance(v, tuple) else v[0])
+        return result[0] if len(result) == 1 else tuple(result)
+
+    return fused

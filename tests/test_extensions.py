@@ -1,0 +1,317 @@
+"""ONNX interop, custom-op escape hatch, subgraph backend API
+(reference tests/python/unittest/{onnx,test_operator_custom,
+test_subgraph_op} coverage)."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd, sym
+from incubator_mxnet_tpu.contrib import onnx as mxonnx
+
+
+# ---------------- ONNX ---------------------------------------------------
+
+def _convnet_and_params():
+    data = sym.var("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                          name="conv1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                      name="pool1")
+    net = sym.flatten(net)
+    net = sym.FullyConnected(net, num_hidden=10, name="fc1")
+    net = sym.softmax(net, axis=-1)
+    rs = onp.random.RandomState(0)
+    shapes = {"conv1_weight": (8, 1, 3, 3), "conv1_bias": (8,),
+              "fc1_weight": (10, 8 * 8 * 8), "fc1_bias": (10,)}
+    params = {k: nd.array(rs.randn(*s).astype("float32") * 0.1)
+              for k, s in shapes.items()}
+    return net, params
+
+
+def test_onnx_roundtrip_convnet(tmp_path):
+    net, params = _convnet_and_params()
+    x = onp.random.RandomState(1).rand(2, 1, 16, 16).astype("float32")
+    ref = net.simple_bind(data=(2, 1, 16, 16)).forward(
+        data=nd.array(x), **params)[0].asnumpy()
+    path = str(tmp_path / "m.onnx")
+    mxonnx.export_model(net, params, (2, 1, 16, 16), path)
+    assert os.path.getsize(path) > 1000
+    sym2, arg2, aux2 = mxonnx.import_model(path)
+    assert sorted(arg2) == sorted(params)
+    got = sym2.simple_bind(data=(2, 1, 16, 16)).forward(
+        data=nd.array(x), **arg2)[0].asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_roundtrip_elemwise_and_bn(tmp_path):
+    data = sym.var("data")
+    net = sym.BatchNorm(data, name="bn")
+    net = net + data
+    net = sym.tanh(net)
+    rs = onp.random.RandomState(2)
+    params = {"bn_gamma": nd.array(rs.rand(4).astype("float32") + 0.5),
+              "bn_beta": nd.array(rs.randn(4).astype("float32") * 0.1),
+              "bn_moving_mean": nd.array(rs.randn(4).astype("float32") * 0.1),
+              "bn_moving_var": nd.array(rs.rand(4).astype("float32") + 0.5)}
+    x = rs.rand(2, 4, 5, 5).astype("float32")
+    aux_in = {k: params[k] for k in ("bn_moving_mean", "bn_moving_var")}
+    arg_in = {k: v for k, v in params.items() if k not in aux_in}
+    ex_ref = net.simple_bind(data=(2, 4, 5, 5))
+    for k, v in aux_in.items():
+        ex_ref.aux_dict[k]._set_data(v.data)
+    ref = ex_ref.forward(data=nd.array(x), **arg_in)[0].asnumpy()
+    path = str(tmp_path / "bn.onnx")
+    mxonnx.export_model(net, params, (2, 4, 5, 5), path)
+    sym2, arg2, aux2 = mxonnx.import_model(path)
+    assert "bn_moving_mean" in aux2 and "bn_moving_var" in aux2
+    ex = sym2.simple_bind(data=(2, 4, 5, 5))
+    for k, v in aux2.items():
+        ex.aux_dict[k]._set_data(v.data)
+    got = ex.forward(data=nd.array(x), **arg2)[0].asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_protobuf_primitives():
+    from incubator_mxnet_tpu.contrib.onnx._protobuf import (
+        Writer, decode_varint, parse_fields, unpack_packed_int64)
+    w = Writer()
+    w.varint(1, 300)
+    w.string(2, "hello")
+    w.packed_int64(3, [1, -2, 3])
+    fields = list(parse_fields(w.tobytes()))
+    assert fields[0][:2] == (1, 0) and fields[0][2] == 300
+    assert fields[1][2] == b"hello"
+    assert unpack_packed_int64(fields[2][2]) == [1, -2, 3]
+
+
+# ---------------- custom op ----------------------------------------------
+
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], 1.0 / (1.0 + onp.exp(-x)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        g = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], g * y * (1 - y))
+
+
+@mx.operator.register("test_sigmoid")
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Sigmoid()
+
+
+def test_custom_op_forward_backward():
+    x = nd.array(onp.random.RandomState(0).randn(4, 5).astype("float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="test_sigmoid")
+        s = y.sum()
+    s.backward()
+    ref = 1 / (1 + onp.exp(-x.asnumpy()))
+    onp.testing.assert_allclose(y.asnumpy(), ref, rtol=1e-6)
+    onp.testing.assert_allclose(x.grad.asnumpy(), ref * (1 - ref), rtol=1e-6)
+
+
+def test_custom_op_inside_jit():
+    """The host callback must survive jit compilation (pure_callback —
+    the reference's custom-op worker-thread escape, custom-inl.h)."""
+    x = jnp.asarray(onp.random.RandomState(1).randn(3, 3), jnp.float32)
+    jitted = jax.jit(lambda a: mx.operator.custom(a, op_type="test_sigmoid"))
+    got = jitted(x)
+    ref = 1 / (1 + onp.exp(-onp.asarray(x)))
+    onp.testing.assert_allclose(onp.asarray(got), ref, rtol=1e-6)
+
+
+def test_custom_op_grad_through_jit():
+    x = jnp.asarray(onp.random.RandomState(2).randn(3, 3), jnp.float32)
+    f = jax.jit(lambda a: mx.operator.custom(
+        a, op_type="test_sigmoid").sum())
+    g = jax.grad(f)(x)
+    ref = 1 / (1 + onp.exp(-onp.asarray(x)))
+    onp.testing.assert_allclose(onp.asarray(g), ref * (1 - ref), rtol=1e-5)
+
+
+# ---------------- subgraph ------------------------------------------------
+
+def _mlp():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    rs = onp.random.RandomState(1)
+    params = {"fc1_weight": nd.array(rs.randn(8, 6).astype("float32") * 0.1),
+              "fc1_bias": nd.zeros((8,)),
+              "fc2_weight": nd.array(rs.randn(4, 8).astype("float32") * 0.1),
+              "fc2_bias": nd.zeros((4,))}
+    return net, params
+
+
+def test_subgraph_xla_backend_fuses_everything():
+    net, params = _mlp()
+    p = mx.subgraph.partition(net, "XLA")
+    fused = [n for n in p._topo_order()
+             if n.op_name and n.op_name.startswith("_subgraph")]
+    assert fused and fused[0].attrs["__n_ops__"] == "3"
+    x = nd.array(onp.random.RandomState(3).rand(2, 6).astype("float32"))
+    ref = net.simple_bind(data=(2, 6)).forward(data=x, **params)[0].asnumpy()
+    shapes = {k: v.shape for k, v in params.items()}
+    got = p.simple_bind(data=(2, 6), **shapes).forward(
+        data=x, **params)[0].asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_subgraph_selective_backend():
+    """A backend claiming only activations fuses nothing (min size 2) or
+    just the claimed region; unclaimed ops stay as-is."""
+
+    class ReluOnly(mx.subgraph.SubgraphSelector):
+        def is_op_supported(self, node):
+            return node.op_name == "Activation"
+
+    class ReluProp(mx.subgraph.SubgraphProperty):
+        name = "relu_only_test"
+
+        def create_selector(self):
+            return ReluOnly()
+
+    mx.subgraph.register_backend(ReluProp)
+    net, params = _mlp()
+    p = mx.subgraph.partition(net, "relu_only_test")
+    # single relu < min_subgraph_size=2 → graph unchanged
+    fused = [n for n in p._topo_order()
+             if n.op_name and n.op_name.startswith("_subgraph")]
+    assert not fused
+
+
+def test_subgraph_env_knob(monkeypatch):
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "XLA")
+    assert mx.subgraph.default_backend_from_env() == "XLA"
+    assert "XLA" in mx.subgraph.list_backends()
+
+
+def test_subgraph_two_partitions_independent():
+    """Fused op registrations must be unique per partition (regression:
+    name collision made the 2nd graph run the 1st graph's callable)."""
+    a = sym.var("a")
+    s1 = (a + 1.0) * 2.0
+    b = sym.var("b")
+    s2 = (b - 5.0) / 2.0
+    p1 = mx.subgraph.partition(s1, "XLA")
+    p2 = mx.subgraph.partition(s2, "XLA")
+    r1 = p1.eval(a=nd.array(onp.array([1.0], onp.float32)))
+    r2 = p2.eval(b=nd.array(onp.array([1.0], onp.float32)))
+    assert float(r1.asnumpy()[0]) == 4.0
+    assert float(r2.asnumpy()[0]) == -2.0
+
+
+def test_subgraph_partial_backend_no_cycle():
+    """A backend that skips one mid-graph op must not fuse across it in
+    a way that creates a cyclic dependency (regression: RecursionError)."""
+
+    class NoExp(mx.subgraph.SubgraphSelector):
+        def is_op_supported(self, node):
+            return node.op_name != "exp"
+
+    class NoExpProp(mx.subgraph.SubgraphProperty):
+        name = "no_exp_test"
+
+        def create_selector(self):
+            return NoExp()
+
+    mx.subgraph.register_backend(NoExpProp)
+    a = sym.var("a")
+    x = a + 1.0               # claimed
+    e = sym.exp(x)            # unclaimed
+    out = (x * 2.0) + e       # claimed, consumes both x and exp(x)
+    p = mx.subgraph.partition(out, "no_exp_test")
+    val = float(p.eval(a=nd.array(onp.array([0.0], onp.float32))).asnumpy()[0])
+    ref = (0.0 + 1) * 2 + onp.exp(1.0)
+    assert abs(val - ref) < 1e-5
+
+
+def test_subgraph_multi_output_pick_indices():
+    """Consumers of different outputs of a fused multi-output region must
+    get their own output (regression: everyone got output 0)."""
+
+    class SplitOnly(mx.subgraph.SubgraphSelector):
+        def is_op_supported(self, node):
+            return node.op_name in ("split", "add", "multiply")
+
+    class SplitProp(mx.subgraph.SubgraphProperty):
+        name = "split_test"
+
+        def create_selector(self):
+            return SplitOnly()
+
+        def min_subgraph_size(self):
+            return 1
+
+    mx.subgraph.register_backend(SplitProp)
+    a = sym.var("a")
+    halves = sym.split(a, num_outputs=2, axis=0)
+    s0, s1 = halves[0], halves[1]
+    out = sym.Group([sym.exp(s0), sym.exp(s1 * 3.0)])
+    p = mx.subgraph.partition(out, "split_test")
+    arr = onp.array([1.0, 2.0], onp.float32)
+    r = p.eval(a=nd.array(arr))
+    got = [float(x.asnumpy()[0]) for x in r]
+    assert abs(got[0] - onp.exp(1.0)) < 1e-5
+    assert abs(got[1] - onp.exp(6.0)) < 1e-4
+
+
+def test_custom_op_infer_type_respected():
+    class ArgmaxOp(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0],
+                        in_data[0].asnumpy().argmax(-1).astype("int32"))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0],
+                        onp.zeros(in_data[0].shape, in_data[0].dtype))
+
+    @mx.operator.register("test_argmax_int")
+    class ArgmaxProp(mx.operator.CustomOpProp):
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0][:-1]], []
+
+        def infer_type(self, in_type):
+            return in_type, [onp.int32], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return ArgmaxOp()
+
+    x = nd.array(onp.random.RandomState(0).rand(3, 4).astype("float32"))
+    y = nd.Custom(x, op_type="test_argmax_int")
+    assert y.asnumpy().dtype == onp.int32
+    onp.testing.assert_array_equal(y.asnumpy(), x.asnumpy().argmax(-1))
+
+
+def test_quantize_net_on_hybridized():
+    """quantize_net must work on (and de-hybridize) a hybridized net
+    (regression: stale CachedOp made quantization a silent no-op)."""
+    from incubator_mxnet_tpu.contrib.quantization import quantize_net
+    from incubator_mxnet_tpu.gluon import nn
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    x = nd.random.uniform(shape=(8, 10))
+    fp32 = net(x).asnumpy()  # builds the cached op
+    qnet = quantize_net(net, calib_data=[x])
+    from incubator_mxnet_tpu.contrib.quantization import QuantizedDense
+    kinds = [type(c).__name__ for c in qnet._children.values()]
+    assert kinds == ["QuantizedDense", "QuantizedDense"], kinds
+    got = qnet(x).asnumpy()
+    # int8 result differs slightly but must track fp32 (not be identical,
+    # not be garbage)
+    rel = onp.abs(got - fp32).mean() / (onp.abs(fp32).mean() + 1e-9)
+    assert 0 < rel < 0.1, rel
