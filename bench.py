@@ -1,7 +1,7 @@
 """Headline benchmark: ResNet-50 training throughput on one TPU chip.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N, ...}
 
 Baseline: the reference's published ResNet-50 training number,
 363.69 img/s at batch=128 on 1x V100
@@ -11,48 +11,110 @@ The benchmark path is the framework's fused train step (fuse.py):
 forward + backward + SGD-momentum update + BatchNorm stat updates in a
 single donated-buffer XLA program, bf16 compute via AMP conversion —
 the TPU analog of hybridize(static_alloc=True) + multi-tensor SGD.
+
+Robustness (round-2 hardening, VERDICT.md Weak #1): the parent process
+never imports JAX, so a wedged TPU plugin cannot hang it.  The actual
+benchmark runs in a child subprocess under a timeout, retried on
+failure; if the accelerator never comes up, a CPU-fallback child runs a
+reduced benchmark so the driver always records a real number, with the
+platform named honestly in the metric.  Inside the child, eager setup
+(parameter init, AMP conversion) is staged on the CPU backend; only the
+compiled step touches the accelerator.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
+BASELINE = 363.69  # img/s, reference ResNet-50 train bs=128 on 1x V100
+# ResNet-50 @224x224: ~4.09 GFLOP/img forward; training ~3x forward.
+TRAIN_FLOPS_PER_IMG = 3 * 4.089e9
+PEAK_FLOPS = {  # per-chip bf16 peak, for the MFU estimate
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+}
 
-def main():
+
+def _child(platform: str) -> None:
     bs = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
-    baseline = 363.69  # img/s, reference ResNet-50 train bs=128 on V100
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        bs = int(os.environ.get("BENCH_CPU_BATCH", "32"))
+        steps = int(os.environ.get("BENCH_CPU_STEPS", "3"))
+        warmup = 1
 
     import jax
     import jax.numpy as jnp
     import numpy as onp
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            from jax._src import xla_bridge as xb
+            xb._backend_factories.pop("axon", None)
+        except Exception:
+            pass
+
+    # Bounded retry on accelerator init (UNAVAILABLE while the chip
+    # tunnel warms up).  A *hang* here is handled by the parent timeout.
+    tries = int(os.environ.get("BENCH_INIT_RETRIES", "3"))
+    accel = None
+    for attempt in range(tries):
+        try:
+            devs = jax.devices()
+            accel = devs[0]
+            break
+        except RuntimeError as e:
+            print(f"[bench] devices() attempt {attempt + 1}/{tries} failed: "
+                  f"{e}", file=sys.stderr, flush=True)
+            time.sleep(5 * (attempt + 1))
+    if accel is None:
+        raise RuntimeError("accelerator backend never initialized")
+    print(f"[bench] platform={accel.platform} device={accel}",
+          file=sys.stderr, flush=True)
+
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, gluon, amp
     from incubator_mxnet_tpu.fuse import make_fused_train_step
     from incubator_mxnet_tpu.gluon.model_zoo import vision
 
     mx.random.seed(0)
-    ctx = mx.tpu()
-    net = vision.resnet50_v1()
-    net.initialize(ctx=ctx)
-    net(nd.random.uniform(shape=(1, 3, 32, 32), ctx=ctx))  # resolve shapes
-    if dtype == "bfloat16":
-        amp.convert_block(net, "bfloat16")
+    cpu0 = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu0):  # eager setup never touches the chip
+        net = vision.resnet50_v1()
+        net.initialize(ctx=mx.cpu())
+        net(nd.random.uniform(shape=(1, 3, 32, 32)))  # resolve shapes
+        if dtype == "bfloat16":
+            amp.convert_block(net, "bfloat16")
+        step = make_fused_train_step(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+        x = jnp.asarray(onp.random.rand(bs, 3, 224, 224), jnp.float32)
+        if dtype == "bfloat16":
+            x = x.astype(jnp.bfloat16)
+        y = jnp.asarray(onp.random.randint(0, 1000, (bs,)), jnp.int32)
+    print("[bench] setup done (CPU); moving state to device",
+          file=sys.stderr, flush=True)
 
-    step = make_fused_train_step(
-        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
-        {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+    put = lambda t: jax.device_put(t, accel)  # noqa: E731
+    step.params = jax.tree_util.tree_map(put, step.params)
+    step.aux = jax.tree_util.tree_map(put, step.aux)
+    step.opt_state = jax.tree_util.tree_map(put, step.opt_state)
+    x, y = put(x), put(y)
 
-    x = jnp.asarray(onp.random.rand(bs, 3, 224, 224), jnp.float32)
-    if dtype == "bfloat16":
-        x = x.astype(jnp.bfloat16)
-    y = jnp.asarray(onp.random.randint(0, 1000, (bs,)), jnp.int32)
-
+    t_compile = time.perf_counter()
     loss = step(x, y)  # compile + first step
+    jax.block_until_ready(loss)
+    print(f"[bench] compiled + first step in "
+          f"{time.perf_counter() - t_compile:.1f}s", file=sys.stderr,
+          flush=True)
     for _ in range(max(warmup - 1, 0)):
         loss = step(x, y)
     jax.block_until_ready(loss)
@@ -64,12 +126,79 @@ def main():
     dt = time.perf_counter() - t0
 
     imgs_per_sec = bs * steps / dt
-    print(json.dumps({
-        "metric": f"resnet50_train_img_per_sec_bs{bs}_{dtype}",
+    plat = accel.platform
+    suffix = "" if plat not in ("cpu",) else "_cpu_fallback"
+    result = {
+        "metric": f"resnet50_train_img_per_sec_bs{bs}_{dtype}{suffix}",
         "value": round(imgs_per_sec, 2),
         "unit": "img/s",
-        "vs_baseline": round(imgs_per_sec / baseline, 3),
-    }))
+        "vs_baseline": round(imgs_per_sec / BASELINE, 3),
+        "platform": plat,
+        "loss": round(float(loss), 4),
+    }
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = PEAK_FLOPS.get(gen)
+    if plat != "cpu" and peak:
+        result["mfu_est"] = round(
+            imgs_per_sec * TRAIN_FLOPS_PER_IMG / peak, 4)
+    print(json.dumps(result), flush=True)
+
+
+def _run_child(platform: str, timeout: float):
+    """Run one benchmark attempt in a subprocess; return parsed JSON or None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", platform],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        print(f"[bench] child ({platform}) timed out after {timeout:.0f}s",
+              file=sys.stderr, flush=True)
+        return None
+    sys.stderr.write(proc.stderr[-2000:])
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            obj = json.loads(line)
+            if isinstance(obj, dict) and "value" in obj:
+                return obj
+        except json.JSONDecodeError:
+            continue
+    print(f"[bench] child ({platform}) rc={proc.returncode}, no JSON line",
+          file=sys.stderr, flush=True)
+    return None
+
+
+def main():
+    if len(sys.argv) > 2 and sys.argv[1] == "--child":
+        _child(sys.argv[2])
+        return
+
+    tpu_timeout = float(os.environ.get("BENCH_TIMEOUT", "1500"))
+    cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", "1500"))
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "2"))
+
+    result = None
+    if os.environ.get("BENCH_PLATFORM", "tpu") != "cpu":
+        for i in range(attempts):
+            result = _run_child("tpu", tpu_timeout)
+            if result is not None:
+                break
+            print(f"[bench] TPU attempt {i + 1}/{attempts} failed",
+                  file=sys.stderr, flush=True)
+    if result is None:
+        print("[bench] falling back to CPU benchmark", file=sys.stderr,
+              flush=True)
+        result = _run_child("cpu", cpu_timeout)
+    if result is None:
+        print(json.dumps({
+            "metric": "resnet50_train_img_per_sec",
+            "value": 0.0,
+            "unit": "img/s",
+            "vs_baseline": 0.0,
+            "error": "all benchmark attempts failed (see stderr)",
+        }))
+        sys.exit(1)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -217,8 +217,17 @@ def _h_clip(ex, node, ins, out, kw):
     ex.nodes.append(_node("Clip", [ins[0], lo, hi], [out], node.name))
 
 
+def _h_split(ex, node, ins, outs, kw):
+    if isinstance(outs, str):
+        outs = [outs]
+    ex.nodes.append(_node("Split", [ins[0]], outs, node.name,
+                          [_attr_int("axis", kw.get("axis", 1))]))
+
+
 _HANDLERS = {
     "Convolution": _h_conv,
+    "split": _h_split,
+    "SliceChannel": _h_split,
     "FullyConnected": _h_fc,
     "Activation": _h_act,
     "BatchNorm": _h_bn,
@@ -268,12 +277,14 @@ def export_bytes(sym, params, input_shape, input_dtype="float32",
               for k, v in (params or {}).items()}
 
     ex = _Exporter()
-    names: dict[int, str] = {}
+    # names are keyed by (producer key, output_index): input edges may be
+    # output-selecting clones of the producer, so id() is not stable
+    names: dict[tuple, str] = {}
     inputs = []
     inits = []
     for n in nodes:
         if n.op_name is None:  # variable
-            names[id(n)] = n.name
+            names[(n.key, 0)] = n.name
             if n.name in params:
                 inits.append(_tensor(n.name, params[n.name]))
             else:
@@ -281,13 +292,19 @@ def export_bytes(sym, params, input_shape, input_dtype="float32",
                     else input_shape
                 inputs.append(_value_info(n.name, shape, input_dtype))
         else:
-            out_name = n.name if n.num_outputs == 1 else \
-                f"{n.name}_out{n.output_index}"
-            names[id(n)] = out_name
-            ins = [names[id(i)] for i in n.inputs]
-            ex.emit(n, ins, out_name)
+            if n.num_outputs == 1:
+                out_names = [n.name]
+            else:
+                out_names = [f"{n.name}_out{i}"
+                             for i in range(n.num_outputs)]
+            for i, nm in enumerate(out_names):
+                names[(n.key, i)] = nm
+            ins = [names[(i.key, i.output_index)] for i in n.inputs]
+            ex.emit(n, ins,
+                    out_names[0] if len(out_names) == 1 else out_names)
 
-    outputs = [_value_info(names[id(n)], ()) for n in sym._nodes]
+    outputs = [_value_info(names[(h.key, h.output_index)], ())
+               for h in sym._head_entries()]
 
     g = Writer()
     for nd_ in ex.nodes:

@@ -426,15 +426,16 @@ def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224),
                                      shuffle=shuffle, **kwargs)
     from ..image import CreateAugmenter, ImageIter
     if set(kwargs) <= _native_kwargs:
-        # Python fallback honors the same options as the native pipeline:
-        # fold scale into mean/std ((px/s − m)/σ == (px − m·s)/(σ·s)) and
-        # map crop/mirror/resize onto the augmenter chain.
+        # Python fallback honors the same options as the native pipeline,
+        # with reference semantics (iter_normalize.h): (px − m)·s/σ.
+        # Fold scale into std ((px − m)·s/σ == (px − m)/(σ/s)) and map
+        # crop/mirror/resize onto the augmenter chain.
         s = kwargs.get("scale", 1.0) or 1.0
-        mean = [kwargs.get("mean_r", 0.0) * s, kwargs.get("mean_g", 0.0) * s,
-                kwargs.get("mean_b", 0.0) * s]
-        std = [max(kwargs.get("std_r", 1.0), 1e-12) * s,
-               max(kwargs.get("std_g", 1.0), 1e-12) * s,
-               max(kwargs.get("std_b", 1.0), 1e-12) * s]
+        mean = [kwargs.get("mean_r", 0.0), kwargs.get("mean_g", 0.0),
+                kwargs.get("mean_b", 0.0)]
+        std = [max(kwargs.get("std_r", 1.0), 1e-12) / s,
+               max(kwargs.get("std_g", 1.0), 1e-12) / s,
+               max(kwargs.get("std_b", 1.0), 1e-12) / s]
         aug = CreateAugmenter(data_shape,
                               resize=kwargs.get("resize", 0),
                               rand_crop=bool(kwargs.get("rand_crop", False)),

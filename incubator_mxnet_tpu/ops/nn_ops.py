@@ -34,10 +34,12 @@ def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
     (reference src/operator/nn/fully_connected.cc)."""
     if flatten and x.ndim > 2:
         x = x.reshape(x.shape[0], -1)
+    # no explicit preferred_element_type: the MXU accumulates bf16
+    # matmuls in fp32 internally, and an explicit f32 output breaks the
+    # transpose rule (fp32 cotangent vs bf16 primal under jax.grad)
     y = lax.dot_general(
         x, weight,
-        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())))
     y = y.astype(x.dtype)
     if bias is not None and not no_bias:
         y = y + bias
@@ -66,12 +68,12 @@ def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
         x.shape, weight.shape,
         ("NCHW", "OIHW", "NCHW") if nd == 2 else
         (("NCW", "OIW", "NCW") if nd == 1 else ("NCDHW", "OIDHW", "NCDHW")))
+    # no explicit preferred_element_type (see fully_connected note)
     y = lax.conv_general_dilated(
         x, weight, window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate, dimension_numbers=dn,
-        feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        feature_group_count=num_group)
     y = y.astype(x.dtype)
     if bias is not None and not no_bias:
         y = y + bias.reshape((1, -1) + (1,) * nd)
@@ -166,17 +168,25 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
     bshape = [1] * x.ndim
     bshape[axis % x.ndim] = x.shape[axis % x.ndim]
     bshape = tuple(bshape)
+    # mixed precision: stats + affine in fp32, output back in x.dtype
+    # (bf16 activations with fp32 gamma/beta must not upcast the output —
+    # the next conv would see mismatched dtypes)
+    xf = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
     if training and not use_global_stats:
-        mean = jnp.mean(x, axis=reduce_axes)
-        var = jnp.var(x, axis=reduce_axes)
-        new_mean = momentum * moving_mean + (1 - momentum) * mean
-        new_var = momentum * moving_var + (1 - momentum) * var
-        x_hat = (x - mean.reshape(bshape)) * lax.rsqrt(var.reshape(bshape) + eps)
+        mean = jnp.mean(xf, axis=reduce_axes)
+        var = jnp.var(xf, axis=reduce_axes)
+        new_mean = (momentum * moving_mean
+                    + (1 - momentum) * mean.astype(moving_mean.dtype))
+        new_var = (momentum * moving_var
+                   + (1 - momentum) * var.astype(moving_var.dtype))
+        x_hat = (xf - mean.reshape(bshape)) * lax.rsqrt(var.reshape(bshape)
+                                                        + eps)
         out = x_hat * gamma.reshape(bshape) + beta.reshape(bshape)
-        return out, new_mean, new_var
-    x_hat = (x - moving_mean.reshape(bshape)) * lax.rsqrt(
+        return out.astype(x.dtype), new_mean, new_var
+    x_hat = (xf - moving_mean.reshape(bshape)) * lax.rsqrt(
         moving_var.reshape(bshape) + eps)
-    return x_hat * gamma.reshape(bshape) + beta.reshape(bshape)
+    out = x_hat * gamma.reshape(bshape) + beta.reshape(bshape)
+    return out.astype(x.dtype)
 
 
 @register("LayerNorm", aliases=("layer_norm",))
@@ -188,36 +198,39 @@ def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
         from . import pallas_kernels as pk
         if pk.use_pallas():
             return pk.fused_layer_norm(x, gamma, beta, float(eps))
-    mean = jnp.mean(x, axis=axis, keepdims=True)
-    var = jnp.var(x, axis=axis, keepdims=True)
-    x_hat = (x - mean) * lax.rsqrt(var + eps)
+    xf = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
+    mean = jnp.mean(xf, axis=axis, keepdims=True)
+    var = jnp.var(xf, axis=axis, keepdims=True)
+    x_hat = (xf - mean) * lax.rsqrt(var + eps)
     shape = [1] * x.ndim
     shape[axis % x.ndim] = x.shape[axis % x.ndim]
-    return x_hat * gamma.reshape(shape) + beta.reshape(shape)
+    out = x_hat * gamma.reshape(shape) + beta.reshape(shape)
+    return out.astype(x.dtype)
 
 
 @register("GroupNorm", aliases=("group_norm",))
 def group_norm(x, gamma, beta, num_groups=1, eps=1e-5):
     n, c = x.shape[:2]
     g = num_groups
-    y = x.reshape((n, g, c // g) + x.shape[2:])
+    y = x.astype(jnp.float32).reshape((n, g, c // g) + x.shape[2:])
     axes = tuple(range(2, y.ndim))
     mean = jnp.mean(y, axis=axes, keepdims=True)
     var = jnp.var(y, axis=axes, keepdims=True)
     y = (y - mean) * lax.rsqrt(var + eps)
     y = y.reshape(x.shape)
     shape = (1, c) + (1,) * (x.ndim - 2)
-    return y * gamma.reshape(shape) + beta.reshape(shape)
+    return (y * gamma.reshape(shape) + beta.reshape(shape)).astype(x.dtype)
 
 
 @register("InstanceNorm", aliases=("instance_norm",))
 def instance_norm(x, gamma, beta, eps=1e-3):
     axes = tuple(range(2, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    y = (x - mean) * lax.rsqrt(var + eps)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
     shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
-    return y * gamma.reshape(shape) + beta.reshape(shape)
+    return (y * gamma.reshape(shape) + beta.reshape(shape)).astype(x.dtype)
 
 
 @register("L2Normalization", aliases=("l2_normalization",))

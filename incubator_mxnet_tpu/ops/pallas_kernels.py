@@ -90,9 +90,22 @@ def _softmax_bwd_kernel(y_ref, g_ref, o_ref):
     o_ref[:] = (y * (g - inner)).astype(o_ref.dtype)
 
 
+_VMEM_BUDGET = 8 * 1024 * 1024  # bytes; ~half the ~16 MB/core VMEM
+
+
+def _rowwise_block(rows_p, cols_p, n_buffers):
+    """Row-block size honoring the VMEM budget: wide rows shrink the
+    block so n_buffers f32 blocks of (block_r, cols_p) stay inside
+    VMEM (at _MAX_COLS=16384 a fixed 256-row block would need ~16 MB
+    per buffer and fail Mosaic compilation on real TPUs)."""
+    by_budget = _VMEM_BUDGET // (cols_p * 4 * n_buffers)
+    block_r = max(8, min(_BLOCK_ROWS, by_budget) // 8 * 8)
+    return min(block_r, _round_up(rows_p, 8))
+
+
 def _rowwise_call(kernel, out_dtype, n_inputs, x2d_list):
     rows_p, cols_p = x2d_list[0].shape
-    block_r = min(_BLOCK_ROWS, rows_p)
+    block_r = _rowwise_block(rows_p, cols_p, n_inputs + 1)
     spec = pl.BlockSpec((block_r, cols_p), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
     return pl.pallas_call(
@@ -208,7 +221,7 @@ def _ln_fwd(x, gamma, beta, eps):
     rows_p, cols_p = x2d_p.shape
     gamma_p = jnp.pad(gamma.astype(x.dtype), (0, cols_p - cols))
     beta_p = jnp.pad(beta.astype(x.dtype), (0, cols_p - cols))
-    block_r = min(_BLOCK_ROWS, rows_p)
+    block_r = _rowwise_block(rows_p, cols_p, 2)  # x block + y block
     grid = (pl.cdiv(rows_p, block_r),)
     row_spec = pl.BlockSpec((block_r, cols_p), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
@@ -244,7 +257,7 @@ def _fused_ln_bwd(eps, res, g):
     g2d_p, _, _ = _pad_rows_cols(g2d, 8, 128)
     rows_p, cols_p = x2d_p.shape
     gamma_p = jnp.pad(gamma.astype(jnp.float32), (0, cols_p - cols))
-    block_r = min(_BLOCK_ROWS, rows_p)
+    block_r = _rowwise_block(rows_p, cols_p, 3)  # x + g + dx blocks
     n_blocks = pl.cdiv(rows_p, block_r)
     row_spec = pl.BlockSpec((block_r, cols_p), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)

@@ -94,8 +94,12 @@ def quantized_dense(data, weight, bias, data_min, data_max, w_min, w_max,
     w_scale = _scale_from_range(w_min, w_max)
     out_scale = d_scale * w_scale
     if bias is not None:
-        # bias arrives f32; fold at int32 accumulator scale
-        acc = acc + jnp.round(bias / out_scale).astype(jnp.int32)
+        # bias arrives f32; fold at int32 accumulator scale, clipped so
+        # tiny calibration ranges can't wrap the int32 cast
+        # 2147483520 = largest float32 below 2**31 (2**31-1 is not
+        # representable and would round up to an out-of-range convert)
+        acc = acc + jnp.clip(jnp.round(bias / out_scale),
+                             -2147483520.0, 2147483520.0).astype(jnp.int32)
     out_max = out_scale * float(2 ** 31 - 1)
     return acc, -out_max, out_max
 
@@ -116,7 +120,8 @@ def quantized_conv2d(data, weight, bias, data_min, data_max, w_min, w_max,
     w_scale = _scale_from_range(w_min, w_max)
     out_scale = d_scale * w_scale
     if bias is not None:
-        acc = acc + jnp.round(bias / out_scale).astype(jnp.int32)[
+        acc = acc + jnp.clip(jnp.round(bias / out_scale),
+                             -2147483520.0, 2147483520.0).astype(jnp.int32)[
             None, :, None, None]
     out_max = out_scale * float(2 ** 31 - 1)
     return acc, -out_max, out_max

@@ -154,7 +154,13 @@ class MXRecordIO:
         while True:
             header = self.record.read(8)
             if len(header) < 8:
-                return None if not multipart else b"".join(parts)
+                if multipart:
+                    # EOF between continuation chunks: fail like the
+                    # native reader (RecordIOReader::Next 'truncated
+                    # header') instead of returning partial data
+                    raise IOError(
+                        f"truncated multipart record in {self.uri}")
+                return None
             magic, lrec = struct.unpack("<II", header)
             if magic != _MAGIC:
                 raise IOError(f"invalid record magic {magic:#x} in {self.uri}")

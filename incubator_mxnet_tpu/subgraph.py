@@ -116,7 +116,7 @@ def partition(sym, backend_name):
     selector = prop.create_selector()
     order = sym._topo_order()
 
-    claimed = {id(n) for n in order
+    claimed = {n.key for n in order
                if n.op_name is not None and selector.is_op_supported(n)}
 
     # group assignment in topo order with cycle check:
@@ -130,18 +130,18 @@ def partition(sym, backend_name):
     for n in order:
         ag, vu = set(), set()
         for i in n.inputs:
-            ag |= all_groups.get(id(i), set())
-            if id(i) in claimed:
-                vu |= via_unclaimed.get(id(i), set())
+            ag |= all_groups.get(i.key, set())
+            if i.key in claimed:
+                vu |= via_unclaimed.get(i.key, set())
             else:
                 # path through an unclaimed node: everything reachable
                 # from it becomes forbidden for joining
-                vu |= all_groups.get(id(i), set())
-                vu |= via_unclaimed.get(id(i), set())
-        if id(n) in claimed:
+                vu |= all_groups.get(i.key, set())
+                vu |= via_unclaimed.get(i.key, set())
+        if n.key in claimed:
             joined = None
             for i in n.inputs:
-                g = group_of.get(id(i))
+                g = group_of.get(i.key)
                 if g is not None and g not in vu:
                     joined = g
                     break
@@ -149,55 +149,65 @@ def partition(sym, backend_name):
                 joined = next_gid[0]
                 next_gid[0] += 1
                 members_of[joined] = []
-            group_of[id(n)] = joined
+            group_of[n.key] = joined
             members_of[joined].append(n)
             ag = ag | {joined}
-        all_groups[id(n)] = ag
-        via_unclaimed[id(n)] = vu
+        all_groups[n.key] = ag
+        via_unclaimed[n.key] = vu
 
     groups = {g: v for g, v in members_of.items()
               if len(v) >= prop.min_subgraph_size()}
     if not groups:
         return sym
-    node_group = {id(n): g for g, v in groups.items() for n in v}
+    node_group = {n.key: g for g, v in groups.items() for n in v}
 
     # rebuild the graph, replacing each group with fused node(s): one
-    # registered op per consumed output, all sharing one memoized fused
-    # callable so the sub-DAG executes once per distinct input set
-    by_id: dict = {}
+    # registered op per consumed (member, output_index) pair, all sharing
+    # one memoized fused callable so the sub-DAG executes once per
+    # distinct input set
+    by_edge: dict = {}   # (key, output_index) -> rebuilt node
+    canon_new: dict = {}  # key -> canonical rebuilt node (unfused path)
     group_nodes: dict = {}
 
     def convert(node):
-        if id(node) in by_id:
-            return by_id[id(node)]
-        gid = node_group.get(id(node))
+        edge = (node.key, node.output_index)
+        if edge in by_edge:
+            return by_edge[edge]
+        gid = node_group.get(node.key)
         if gid is None:
-            new_inputs = [convert(i) for i in node.inputs]
-            nn = sym_mod._SymNode(node.op_name, node.name, new_inputs,
-                                  node.kwargs, node.attrs,
-                                  node.num_outputs, node.output_index)
-            by_id[id(node)] = nn
+            canon = canon_new.get(node.key)
+            if canon is None:
+                new_inputs = [convert(i) for i in node.inputs]
+                canon = sym_mod._SymNode(node.op_name, node.name, new_inputs,
+                                         node.kwargs, node.attrs,
+                                         node.num_outputs, 0)
+                canon_new[node.key] = canon
+                by_edge[(node.key, 0)] = canon
+            nn = canon.clone_for_output(node.output_index)
+            by_edge[edge] = nn
             return nn
         if gid not in group_nodes:
             members = groups[gid]
-            member_ids = {id(m) for m in members}
+            member_keys = {m.key for m in members}
             ext, seen = [], set()
             for m in members:
                 for i in m.inputs:
-                    if id(i) not in member_ids and id(i) not in seen:
-                        seen.add(id(i))
+                    ie = (i.key, i.output_index)
+                    if i.key not in member_keys and ie not in seen:
+                        seen.add(ie)
                         ext.append(i)
-            consumed_outside = set()
+            consumed_outside = set()   # (member key, output_index)
             for n2 in order:
-                if id(n2) in member_ids:
+                if n2.key in member_keys:
                     continue
                 for i in n2.inputs:
-                    if id(i) in member_ids:
-                        consumed_outside.add(id(i))
-            for h in sym._nodes:
-                if id(h) in member_ids:
-                    consumed_outside.add(id(h))
-            outs = [m for m in members if id(m) in consumed_outside]
+                    if i.key in member_keys:
+                        consumed_outside.add((i.key, i.output_index))
+            for h in sym._head_entries():
+                if h.key in member_keys:
+                    consumed_outside.add((h.key, h.output_index))
+            pos = {n.key: i for i, n in enumerate(order)}
+            outs = sorted(consumed_outside, key=lambda e: (pos[e[0]], e[1]))
 
             fused_fn = prop.wrap_callable(
                 _make_fused_callable(members, ext, outs))
@@ -221,41 +231,45 @@ def partition(sym, backend_name):
             attrs = {"__subgraph__": prop.name,
                      "__n_ops__": str(len(members))}
             picks = {}
-            for k, o in enumerate(outs):
+            for k, oe in enumerate(outs):
                 op_name = f"_subgraph_{prop.name}_{uid}_out{k}"
 
                 def out_fn(*args, _k=k):
                     return run_all(args)[_k]
 
                 register(op_name)(out_fn)
-                picks[id(o)] = sym_mod._SymNode(op_name, op_name,
-                                                new_inputs, {}, attrs)
+                picks[oe] = sym_mod._SymNode(op_name, op_name,
+                                             new_inputs, {}, attrs)
             group_nodes[gid] = picks
         picks = group_nodes[gid]
-        by_id[id(node)] = picks[id(node)]
-        return picks[id(node)]
+        by_edge[edge] = picks[edge]
+        return picks[edge]
 
-    new_heads = [convert(h) for h in sym._nodes]
+    new_heads = [convert(h) for h in sym._head_entries()]
     return sym_mod.Symbol(new_heads)
 
 
 def _make_fused_callable(members, ext_inputs, outs):
-    """One jit-compiled callable over the member sub-DAG."""
+    """One jit-compiled callable over the member sub-DAG.
+
+    ``outs`` is a list of (member key, output_index) pairs — each fused
+    output selects the right element of a multi-output member's tuple
+    result (reference NodeEntry.index semantics).
+    """
     from .ops.registry import get_op
 
-    member_ids = {id(m) for m in members}
-    ext_pos = {id(e): i for i, e in enumerate(ext_inputs)}
-    out_ids = [id(o) for o in outs]
+    member_keys = {m.key for m in members}
+    ext_pos = {(e.key, e.output_index): i for i, e in enumerate(ext_inputs)}
     # snapshot the sub-DAG structure (node → op + input wiring)
     plan = []
     for m in members:
         srcs = []
         for i in m.inputs:
-            if id(i) in member_ids:
-                srcs.append(("m", id(i), i.output_index))
+            if i.key in member_keys:
+                srcs.append(("m", i.key, i.output_index))
             else:
-                srcs.append(("e", ext_pos[id(i)], 0))
-        plan.append((id(m), get_op(m.op_name), m.kwargs, srcs))
+                srcs.append(("e", ext_pos[(i.key, i.output_index)], 0))
+        plan.append((m.key, get_op(m.op_name), m.kwargs, srcs))
 
     @jax.jit
     def fused(*args):
@@ -270,9 +284,9 @@ def _make_fused_callable(members, ext_inputs, outs):
                     ins.append(v[oidx] if isinstance(v, tuple) else v)
             vals[mid] = op.fn(*ins, **kwargs)
         result = []
-        for oid in out_ids:
-            v = vals[oid]
-            result.append(v if not isinstance(v, tuple) else v[0])
+        for okey, oidx in outs:
+            v = vals[okey]
+            result.append(v[oidx] if isinstance(v, tuple) else v)
         return result[0] if len(result) == 1 else tuple(result)
 
     return fused

@@ -28,11 +28,20 @@ __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
 
 
 class _SymNode:
+    """A graph node, or a *clone* selecting one output of a node.
+
+    Multi-output ops (``split`` etc.) produce one node; consuming output
+    ``i`` is represented by a clone sharing the producer's identity via
+    ``base`` but carrying ``output_index = i`` (the reference models this
+    as NodeEntry{node, index} edges, nnvm/node.h).  Every traversal keys
+    on ``node.key`` so clones and their canonical node evaluate once.
+    """
+
     __slots__ = ("op_name", "name", "inputs", "kwargs", "attrs", "num_outputs",
-                 "output_index")
+                 "output_index", "base")
 
     def __init__(self, op_name, name, inputs, kwargs, attrs=None,
-                 num_outputs=1, output_index=0):
+                 num_outputs=1, output_index=0, base=None):
         self.op_name = op_name  # None for variables
         self.name = name
         self.inputs = inputs  # list[_SymNode]
@@ -40,6 +49,24 @@ class _SymNode:
         self.attrs = attrs or {}
         self.num_outputs = num_outputs
         self.output_index = output_index
+        self.base = base  # canonical producer when this is an output clone
+
+    @property
+    def key(self):
+        """Identity of the producing op (shared by all output clones)."""
+        return id(self.base) if self.base is not None else id(self)
+
+    def clone_for_output(self, idx):
+        """An edge selecting output ``idx``.  For a multi-output node the
+        result always has ``base`` set (even for idx 0), distinguishing
+        'the whole multi-output symbol' (canonical) from 'one selected
+        output' (clone)."""
+        if idx == self.output_index and (self.base is not None
+                                         or self.num_outputs == 1):
+            return self
+        return _SymNode(self.op_name, self.name, self.inputs, self.kwargs,
+                        self.attrs, self.num_outputs, idx,
+                        base=self.base if self.base is not None else self)
 
 
 # Layer ops whose trailing array inputs are learnable parameters that the
@@ -137,15 +164,55 @@ class Symbol:
     def __repr__(self):
         return f"<Symbol {self.name}>"
 
+    def _head_arity(self):
+        """Number of outputs this symbol exposes.  A clone (selected
+        output) exposes exactly one, matching the reference where
+        ``sym[i]`` yields a single-output symbol."""
+        if len(self._nodes) > 1:
+            return len(self._nodes)
+        n = self._nodes[0]
+        return 1 if n.base is not None else n.num_outputs
+
+    def _head_entries(self):
+        """Flat list of (node-or-clone) head edges, expanding a canonical
+        multi-output head into one entry per output (reference: a
+        multi-output symbol's outputs() lists every NodeEntry)."""
+        out = []
+        for n in self._nodes:
+            if n.base is None and n.num_outputs > 1:
+                out.extend(n.clone_for_output(i)
+                           for i in range(n.num_outputs))
+            else:
+                out.append(n)
+        return out
+
     def __getitem__(self, idx):
-        if isinstance(idx, int):
+        if not isinstance(idx, int):
+            raise TypeError("symbol indexing requires int")
+        if len(self._nodes) > 1:  # group: index over heads
             return Symbol(self._nodes[idx])
-        raise TypeError("symbol indexing requires int")
+        node = self._nodes[0]
+        arity = self._head_arity()
+        if idx < 0:
+            idx += arity
+        if not 0 <= idx < arity:
+            raise IndexError(
+                f"output index {idx} out of range for {node.name!r} "
+                f"({arity} outputs)")
+        if node.base is not None:  # already a selected single output
+            return self
+        return Symbol(node.clone_for_output(idx))
 
     def __len__(self):
-        return len(self._nodes)
+        return self._head_arity()
 
     def __iter__(self):
+        if len(self._nodes) == 1:
+            n = self._nodes[0]
+            if n.base is None and n.num_outputs > 1:
+                return (Symbol(n.clone_for_output(i))
+                        for i in range(n.num_outputs))
+            return iter((Symbol(n),))
         return (Symbol(n) for n in self._nodes)
 
     def attr(self, key):
@@ -156,13 +223,15 @@ class Symbol:
 
     # -- graph queries ----------------------------------------------------
     def _topo_order(self):
+        """Topological order, one representative per producing op
+        (output clones dedupe onto their canonical node via ``key``)."""
         seen = {}
         order = []
 
         def visit(node):
-            if id(node) in seen:
+            if node.key in seen:
                 return
-            seen[id(node)] = node
+            seen[node.key] = node
             for i in node.inputs:
                 visit(i)
             order.append(node)
@@ -179,7 +248,10 @@ class Symbol:
         return self.list_arguments()
 
     def list_outputs(self):
-        return [f"{n.name}_output" for n in self._nodes]
+        heads = self._head_entries()
+        return [f"{n.name}_output{n.output_index}"
+                if n.num_outputs > 1 else f"{n.name}_output"
+                for n in heads]
 
     def list_auxiliary_states(self):
         return [n.name for n in self._topo_order()
@@ -229,10 +301,10 @@ class Symbol:
             if node.op_name is None:
                 if node.name not in bindings:
                     raise ValueError(f"unbound variable {node.name}")
-                values[id(node)] = (bindings[node.name],)
+                values[node.key] = (bindings[node.name],)
             else:
                 op = _registry.get_op(node.op_name)
-                args = [values[id(i)][i.output_index] for i in node.inputs]
+                args = [values[i.key][i.output_index] for i in node.inputs]
                 kwargs = node.kwargs
                 if training and node.op_name in _TRAIN_FLAG_OPS:
                     out = op.fn(*args, training=True, **kwargs)
@@ -244,15 +316,15 @@ class Symbol:
                         if aux_updates is not None:
                             for var, new in zip(aux_in, out[1:]):
                                 aux_updates[var.name] = new
-                        values[id(node)] = (out[0],)
+                        values[node.key] = (out[0],)
                     else:
                         # e.g. BatchNorm(use_global_stats=True) returns a
                         # single array even in train mode
-                        values[id(node)] = (out,)
+                        values[node.key] = (out,)
                 else:
                     out = op.fn(*args, **kwargs)
-                    values[id(node)] = out if isinstance(out, tuple) else (out,)
-        return [values[id(n)][n.output_index] for n in self._nodes]
+                    values[node.key] = out if isinstance(out, tuple) else (out,)
+        return [values[n.key][n.output_index] for n in self._head_entries()]
 
     def _infer_args_from(self, known: dict):
         """Infer remaining argument/aux shapes from known input shapes.
@@ -276,14 +348,14 @@ class Symbol:
         for node in self._topo_order():
             if node.op_name is None:
                 s = var_shape(node)
-                shapes[id(node)] = (s,)
+                shapes[node.key] = (s,)
                 is_int = node.attrs.get("__dtype__") == "int32"
-                dtypes[id(node)] = (jnp.int32 if is_int else jnp.float32,)
+                dtypes[node.key] = (jnp.int32 if is_int else jnp.float32,)
                 continue
             # backward-infer any still-unknown variable inputs
             roles = _LAYER_VARS.get(node.op_name)
             first = node.inputs[0] if node.inputs else None
-            data_shape = (shapes[id(first)][first.output_index]
+            data_shape = (shapes[first.key][first.output_index]
                           if first is not None else None)
             if roles and data_shape is not None:
                 rule = _infer_layer_param_shapes(node.op_name, node.kwargs,
@@ -292,14 +364,14 @@ class Symbol:
                     if (inp.op_name is None and var_shape(inp) is None
                             and role in rule):
                         inferred[inp.name] = tuple(rule[role])
-                        shapes[id(inp)] = (tuple(rule[role]),)
+                        shapes[inp.key] = (tuple(rule[role]),)
                     if (inp.op_name is None and role in _LABEL_ROLES
                             and var_shape(inp) is None and data_shape):
                         inferred[inp.name] = (data_shape[0],)
-                        shapes[id(inp)] = ((data_shape[0],),)
+                        shapes[inp.key] = ((data_shape[0],),)
             missing = [i.name for i in node.inputs
                        if i.op_name is None
-                       and shapes[id(i)][i.output_index] is None]
+                       and shapes[i.key][i.output_index] is None]
             if missing:
                 raise ValueError(
                     f"cannot infer shapes for variables {missing} feeding "
@@ -308,15 +380,15 @@ class Symbol:
             specs = []
             for i in node.inputs:
                 specs.append(jax.ShapeDtypeStruct(
-                    shapes[id(i)][i.output_index],
-                    dtypes[id(i)][i.output_index]))
+                    shapes[i.key][i.output_index],
+                    dtypes[i.key][i.output_index]))
             op = _registry.get_op(node.op_name)
             out_abs = jax.eval_shape(
                 lambda *a, _op=op, _kw=node.kwargs: _op.fn(*a, **_kw), *specs)
             if not isinstance(out_abs, tuple):
                 out_abs = (out_abs,)
-            shapes[id(node)] = tuple(tuple(o.shape) for o in out_abs)
-            dtypes[id(node)] = tuple(o.dtype for o in out_abs)
+            shapes[node.key] = tuple(tuple(o.shape) for o in out_abs)
+            dtypes[node.key] = tuple(o.dtype for o in out_abs)
         return inferred
 
     def eval_with(self, bindings: dict):
@@ -376,18 +448,21 @@ class Symbol:
     # -- serialization (json graph, reference symbol.py tojson) -----------
     def tojson(self):
         order = self._topo_order()
-        index = {id(n): i for i, n in enumerate(order)}
+        index = {n.key: i for i, n in enumerate(order)}
         nodes = []
         for n in order:
             nodes.append({
                 "op": n.op_name or "null",
                 "name": n.name,
                 "attrs": {**{k: json.dumps(v) for k, v in n.kwargs.items()},
-                          **n.attrs},
-                "inputs": [[index[id(i)], i.output_index, 0]
+                          **n.attrs,
+                          **({"__num_outputs__": str(n.num_outputs)}
+                             if n.num_outputs > 1 else {})},
+                "inputs": [[index[i.key], i.output_index, 0]
                            for i in n.inputs],
             })
-        heads = [[index[id(n)], n.output_index, 0] for n in self._nodes]
+        heads = [[index[n.key], n.output_index, 0]
+                 for n in self._head_entries()]
         return json.dumps({"nodes": nodes, "heads": heads,
                            "attrs": {"mxtpu_version": "0.1"}}, indent=2)
 
@@ -434,9 +509,14 @@ def _apply(op_name, sym_inputs, kwargs, name=None):
     in_nodes = [s._nodes[0] if len(s._nodes) == 1 else s._nodes[0]
                 for s in sym_inputs]
     kwargs = {k: v for k, v in kwargs.items() if v is not None}
-    # determine output arity by abstract evaluation later; assume 1 for now
+    # static output arity: split-family ops declare it via num_outputs
+    # (the reference gets this from each op's FNumOutputs / num_outputs())
+    num_outputs = 1
+    k = kwargs.get("num_outputs")
+    if isinstance(k, int):
+        num_outputs = k
     node = _SymNode(op_name, name, in_nodes, kwargs,
-                    attrs=AttrScope.current_attrs())
+                    attrs=AttrScope.current_attrs(), num_outputs=num_outputs)
     return Symbol(node)
 
 
@@ -529,24 +609,30 @@ def load_json(json_str):
     data = json.loads(json_str)
     nodes_built = []
     for nd_spec in data["nodes"]:
-        inputs = [nodes_built[i][0] for i, oi, _ in nd_spec["inputs"]]
-        for (i, oi, _), inp in zip(nd_spec["inputs"], inputs):
-            inp.output_index = oi  # restore multi-output index
+        # each input edge selects one output of the producer: a clone per
+        # nonzero index (mutating the shared node would corrupt sibling
+        # consumers of a different output)
+        inputs = [nodes_built[i][0].clone_for_output(oi)
+                  for i, oi, _ in nd_spec["inputs"]]
         if nd_spec["op"] == "null":
             node = _SymNode(None, nd_spec["name"], [], {},
                             attrs=nd_spec.get("attrs", {}))
         else:
             kwargs = {}
-            for k, v in nd_spec.get("attrs", {}).items():
+            attrs = dict(nd_spec.get("attrs", {}))
+            n_out = int(attrs.pop("__num_outputs__", 1))
+            for k, v in attrs.items():
                 try:
                     kwargs[k] = json.loads(v)
                     if isinstance(kwargs[k], list):
                         kwargs[k] = tuple(kwargs[k])
                 except (json.JSONDecodeError, TypeError):
                     pass
-            node = _SymNode(nd_spec["op"], nd_spec["name"], inputs, kwargs)
+            node = _SymNode(nd_spec["op"], nd_spec["name"], inputs, kwargs,
+                            num_outputs=n_out)
         nodes_built.append((node, nd_spec))
-    heads = [nodes_built[i][0] for i, oi, _ in data["heads"]]
+    heads = [nodes_built[i][0].clone_for_output(oi)
+             for i, oi, _ in data["heads"]]
     return Symbol(heads)
 
 

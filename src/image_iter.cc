@@ -394,7 +394,10 @@ class ImageRecordIter {
     float stdv[3] = {p_.std_r > 0 ? p_.std_r : 1.f,
                      p_.std_g > 0 ? p_.std_g : 1.f,
                      p_.std_b > 0 ? p_.std_b : 1.f};
-    float inv_scale = p_.scale > 0 ? 1.f / p_.scale : 1.f;
+    // reference semantics (iter_normalize.h): (px - mean) * scale / std —
+    // scale is a multiplier applied AFTER mean subtraction, so with the
+    // canonical scale=1/255 the output lands in [0, 1] range.
+    float scale = p_.scale > 0 ? p_.scale : 1.f;
     size_t plane = static_cast<size_t>(p_.height) * p_.width;
     float* out = t.batch->data.data() +
                  static_cast<size_t>(t.slot) * p_.channels * plane;
@@ -406,10 +409,10 @@ class ImageRecordIter {
         if (p_.channels == 3) {
           for (int c = 0; c < 3; ++c)
             out[c * plane + y * p_.width + x] =
-                (px[c] * inv_scale - mean[c]) / stdv[c];
+                (px[c] - mean[c]) * scale / stdv[c];
         } else {
           float grey = 0.299f * px[0] + 0.587f * px[1] + 0.114f * px[2];
-          out[y * p_.width + x] = (grey * inv_scale - mean[0]) / stdv[0];
+          out[y * p_.width + x] = (grey - mean[0]) * scale / stdv[0];
         }
       }
     }

@@ -76,14 +76,16 @@ int MXTStorageReleaseAll(void);
 /* ---------------- ImageRecordIter pipeline ----------------------------- */
 /* Multi-threaded JPEG decode + augment + batch + prefetch, the
  * counterpart of src/io/iter_image_recordio_2.cc + iter_batchloader.h +
- * iter_prefetcher.h. Output is NCHW float32, (x/scale - mean)/std. */
+ * iter_prefetcher.h. Output is NCHW float32, (x - mean) * scale / std
+ * (reference iter_normalize.h semantics: scale multiplies after mean
+ * subtraction; canonical scale=1/255 lands pixels in [0,1]). */
 typedef struct {
   const char* path_imgrec;
   int batch_size;
   int channels, height, width;   /* data_shape */
   float mean_r, mean_g, mean_b;
   float std_r, std_g, std_b;
-  float scale;                   /* divide raw pixels first; 1 = none */
+  float scale;                   /* multiplier after mean subtract; 1 = none */
   int resize;                    /* shorter-side resize; 0 = direct resize */
   int rand_crop, rand_mirror, shuffle;
   int round_batch;               /* wrap tail batch from epoch start */

@@ -245,14 +245,15 @@ def test_image_record_iter(tmp_path):
 
 def test_image_record_iter_augment_normalize(tmp_path):
     path, colors = _write_jpeg_rec(tmp_path, n=4)
+    # reference semantics (iter_normalize.h): out = (px - mean) * scale / std
     it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
-                               batch_size=4, shuffle=False, scale=255.0,
-                               mean_r=0.5, mean_g=0.5, mean_b=0.5,
+                               batch_size=4, shuffle=False, scale=1 / 255.0,
+                               mean_r=127.5, mean_g=127.5, mean_b=127.5,
                                std_r=0.5, std_g=0.5, std_b=0.5)
     batch = next(iter(it))
     data = batch.data[0].asnumpy()
     r0 = colors[0][0]
-    expect = (r0 / 255.0 - 0.5) / 0.5
+    expect = (r0 - 127.5) / 255.0 / 0.5
     assert abs(data[0, 0].mean() - expect) < 0.05
 
 
@@ -400,8 +401,8 @@ def test_image_record_iter_python_fallback_parity(tmp_path, monkeypatch):
     native pipeline (no silent behavior drift when the lib is absent)."""
     path, colors = _write_jpeg_rec(tmp_path, n=4)
     kwargs = dict(path_imgrec=path, data_shape=(3, 16, 16), batch_size=4,
-                  shuffle=False, scale=255.0, mean_r=0.5, mean_g=0.5,
-                  mean_b=0.5, std_r=0.5, std_g=0.5, std_b=0.5)
+                  shuffle=False, scale=1 / 255.0, mean_r=127.5, mean_g=127.5,
+                  mean_b=127.5, std_r=0.5, std_g=0.5, std_b=0.5)
     nat = next(iter(mx.io.ImageRecordIter(**kwargs))).data[0].asnumpy()
     import incubator_mxnet_tpu.native as native_mod
     monkeypatch.setattr(native_mod, "lib", None)
