@@ -5,6 +5,8 @@ TPU story: bf16 is the native MXU compute type and needs no loss scaling
 default and the reference's fp16 + dynamic LossScaler machinery
 (loss_scaler.py) is kept for API parity / fp16 experiments.
 """
-from .amp import init, init_trainer, convert_block, scale_loss, unscale
+from .amp import (init, init_trainer, convert_block, convert_symbol,
+                  convert_model, scale_loss, unscale, CastPolicy,
+                  current_policy, policy_scope)
 from .loss_scaler import LossScaler
 from . import lists

@@ -1,23 +1,75 @@
-"""Op cast lists (reference contrib/amp/lists/symbol_fp16.py).
+"""Op cast lists (reference contrib/amp/lists/symbol_fp16.py and
+symbol_bf16.py).
 
-Three classes, mirroring the reference's allow/deny structure:
-* LP16_FUNCS — always run in low precision (MXU-bound matmul/conv)
-* FP32_FUNCS — numerically sensitive, keep fp32
-* WIDEST_TYPE_CASTS — follow the widest input type
+Three classes per target dtype, mirroring the reference's structure:
+
+* ``*_LP16`` — always run in the low-precision dtype (MXU-bound
+  matmul/conv: the FLOPs live here, and bf16/fp16 inputs double the MXU
+  throughput).
+* ``*_FP32`` — numerically sensitive, keep fp32 (exp/log-heavy math,
+  loss ops; for fp16 also the norm layers, whose variance computation
+  overflows fp16's 5-bit exponent).
+* ``*_WIDEST`` — follow the widest floating input type (elementwise
+  combiners where silently downcasting one side loses information).
+
+Ops in no list run in whatever dtype their inputs already have.  Note
+the bf16 lists are more aggressive than fp16: bf16 shares fp32's
+exponent range so the norm layers stay unlisted — their kernels in
+``ops/nn_ops.py`` already accumulate statistics in fp32 internally while
+keeping the normalize/affine math in the activation dtype.
+
+Consumed by ``amp.CastPolicy`` (eager/Gluon path, applied per-op inside
+``ops.registry.invoke``) and ``amp.convert_symbol`` (graph rewrite
+inserting explicit ``amp_cast``/``amp_multicast`` nodes).
 """
 
-LP16_FUNCS = [
+# ---- shared op families ---------------------------------------------------
+
+_MATMUL_OPS = [
     "FullyConnected", "Convolution", "Deconvolution", "dot", "batch_dot",
     "matmul", "linalg_gemm2", "RNN", "dot_product_attention",
 ]
 
-FP32_FUNCS = [
-    "softmax", "log_softmax", "SoftmaxOutput", "BatchNorm", "LayerNorm",
-    "GroupNorm", "InstanceNorm", "RMSNorm", "norm", "mean", "sum", "exp",
-    "log", "erfinv", "power", "ctc_loss", "logsumexp", "var", "std",
+_SENSITIVE_OPS = [
+    "softmax", "log_softmax", "SoftmaxOutput", "softmax_cross_entropy",
+    "norm", "mean", "sum", "exp", "log", "log2", "log10", "log1p",
+    "erfinv", "power", "ctc_loss", "logsumexp", "var", "std", "cumsum",
+    "SoftmaxActivation", "MakeLoss",
 ]
 
-WIDEST_TYPE_CASTS = [
-    "add", "subtract", "multiply", "divide", "maximum", "minimum", "where",
-    "concat", "stack",
+_NORM_OPS = [
+    "BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm", "RMSNorm",
+    "L2Normalization",
 ]
+
+_WIDEST_OPS = [
+    "add", "subtract", "multiply", "divide", "maximum", "minimum", "where",
+    "concat", "stack", "elemwise_add", "elemwise_sub", "elemwise_mul",
+    "elemwise_div", "broadcast_add", "broadcast_sub", "broadcast_mul",
+    "broadcast_div",
+]
+
+# ---- fp16 (reference lists/symbol_fp16.py) --------------------------------
+
+FP16_LP16 = list(_MATMUL_OPS)
+FP16_FP32 = list(_SENSITIVE_OPS) + list(_NORM_OPS)
+FP16_WIDEST = list(_WIDEST_OPS)
+
+# ---- bf16 (reference lists/symbol_bf16.py) --------------------------------
+
+BF16_LP16 = list(_MATMUL_OPS)
+BF16_FP32 = list(_SENSITIVE_OPS)
+BF16_WIDEST = list(_WIDEST_OPS)
+
+# Back-compat aliases (round-2 names; fp16 semantics)
+LP16_FUNCS = FP16_LP16
+FP32_FUNCS = FP16_FP32
+WIDEST_TYPE_CASTS = FP16_WIDEST
+
+
+def get_lists(target_dtype):
+    """(lp16, fp32, widest) op lists for a target low-precision dtype."""
+    name = str(target_dtype)
+    if "bfloat16" in name:
+        return BF16_LP16, BF16_FP32, BF16_WIDEST
+    return FP16_LP16, FP16_FP32, FP16_WIDEST

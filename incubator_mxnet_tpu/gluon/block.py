@@ -219,7 +219,13 @@ class Block:
     def __call__(self, *args, **kwargs):
         for hook in self._forward_pre_hooks:
             hook(self, args)
-        out = self.forward(*args, **kwargs)
+        policy = getattr(self, "_amp_policy", None)
+        if policy is not None:
+            from ..amp import amp as _amp
+            with _amp.policy_scope(policy):
+                out = self.forward(*args, **kwargs)
+        else:
+            out = self.forward(*args, **kwargs)
         for hook in self._forward_hooks:
             hook(self, args, out)
         return out
@@ -401,7 +407,16 @@ class HybridBlock(Block):
                 not isinstance(a.data, jax.core.Tracer) for a in args):
             for hook in self._forward_pre_hooks:
                 hook(self, args)
-            out = self._get_cached_op()(*args)
+            policy = getattr(self, "_amp_policy", None)
+            if policy is not None:
+                # the CachedOp trace replays forward() via invoke, so the
+                # policy must be active around it exactly as in the eager
+                # path (the casts bake into the compiled graph)
+                from ..amp import amp as _amp
+                with _amp.policy_scope(policy):
+                    out = self._get_cached_op()(*args)
+            else:
+                out = self._get_cached_op()(*args)
             for hook in self._forward_hooks:
                 hook(self, args, out)
             return out
@@ -440,9 +455,16 @@ class HybridBlock(Block):
                      with_updates=False):
             key = key if key is not None else jax.random.PRNGKey(0)
             mapping = {name2param[n]: NDArray(v) for n, v in pvals.items()}
+            policy = getattr(self, "_amp_policy", None)
+            if policy is not None:
+                from ..amp import amp as _amp
+                pol_ctx = _amp.policy_scope(policy)
+            else:
+                import contextlib as _cl
+                pol_ctx = _cl.nullcontext()
             with _TraceParams(mapping), _random.key_scope(key), \
                     autograd._scope(None, training), \
-                    _CollectStateUpdates() as su:
+                    _CollectStateUpdates() as su, pol_ctx:
                 outs = self.forward(*[NDArray(v) for v in input_vals])
             if isinstance(outs, (list, tuple)):
                 out = tuple(o.data for o in outs)

@@ -169,3 +169,29 @@ def smooth_l1(x, scalar=1.0):
 @register("lerp", num_inputs=3)
 def lerp(a, b, t):
     return a + (b - a) * t
+
+
+@register("amp_cast", num_inputs=1)
+def amp_cast(x, dtype="float32"):
+    """AMP cast: floating arrays cast to ``dtype``, everything else
+    passes through (reference src/operator/tensor/amp_cast.cc — int
+    labels and bool masks must survive graph-wide precision rewrites)."""
+    from ..base import dtype_from_any
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return x
+    return x.astype(dtype_from_any(dtype))
+
+
+@register("amp_multicast")
+def amp_multicast(*arrays, num_outputs=None):
+    """Cast all floating inputs to the widest floating dtype among them
+    (reference amp_cast.cc amp_multicast)."""
+    floats = [a.dtype for a in arrays
+              if jnp.issubdtype(a.dtype, jnp.floating)]
+    if not floats:
+        return arrays if len(arrays) > 1 else arrays[0]
+    widest = max(floats, key=lambda d: jnp.finfo(d).bits)
+    out = tuple(a.astype(widest)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a
+                for a in arrays)
+    return out if len(out) > 1 else out[0]

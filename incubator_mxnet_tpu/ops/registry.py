@@ -107,6 +107,15 @@ def list_ops():
     return sorted(set(_OPS))
 
 
+def _current_amp_policy():
+    """Bound once on first use: invoke() is the per-op hot path and must
+    not pay a module lookup per call when AMP is off."""
+    global _current_amp_policy
+    from ..amp.amp import current_policy
+    _current_amp_policy = current_policy
+    return current_policy()
+
+
 def invoke(op: "Op | str", *inputs, out=None, **kwargs):
     """Execute an op on NDArrays with autograd integration.
 
@@ -153,6 +162,13 @@ def invoke(op: "Op | str", *inputs, out=None, **kwargs):
     kw_names = tuple(kw_arrays)
     raw = [x.data if isinstance(x, NDArray) else x for x in all_in]
     n_pos = len(inputs)
+
+    # AMP: an active CastPolicy (amp.convert_block) casts floating inputs
+    # per the op lists — the eager-path analog of the reference's
+    # ReducePrecision graph pass (contrib/amp/amp.py convert_symbol).
+    _pol = _current_amp_policy()
+    if _pol is not None:
+        raw = _pol.cast_args(op.name, raw)
 
     recording = autograd.is_recording()
     need_grad = (

@@ -11,6 +11,7 @@ parallel layer.
 """
 from __future__ import annotations
 
+import builtins as _bi
 import json
 
 import jax
@@ -93,6 +94,10 @@ _LABEL_ROLES = {"label"}
 # ops that take a `training` static flag and, when training, return
 # (out, *aux_updates) — the executor applies the updates to aux state.
 _TRAIN_FLAG_OPS = {"BatchNorm"}
+# wrapper ops that forward one input unchanged in shape; backward shape
+# inference resolves variables through them (amp.convert_symbol inserts
+# amp_cast between params and their consuming layer ops)
+_TRANSPARENT_OPS = {"amp_cast", "amp_multicast"}
 
 
 def _infer_layer_param_shapes(op_name, kwargs, in_shape):
@@ -329,14 +334,16 @@ class Symbol:
     def _infer_args_from(self, known: dict):
         """Infer remaining argument/aux shapes from known input shapes.
 
-        Walks the DAG in topo order; variable inputs of layer ops with
-        unknown shapes get shapes from ``_infer_layer_param_shapes``
-        (backward inference, mirroring per-op FInferShape in the
-        reference); op output shapes come from jax.eval_shape (forward
-        inference).  Returns {var_name: shape} for every variable not in
-        ``known``.
+        Worklist over the DAG: variable inputs of layer ops with unknown
+        shapes get shapes from ``_infer_layer_param_shapes`` (backward
+        inference, mirroring per-op FInferShape in the reference); op
+        output shapes come from jax.eval_shape (forward inference).
+        Backward inference sees *through* transparent wrapper nodes
+        (amp_cast etc.), so AMP-converted graphs still bind without
+        explicit parameter shapes.  Returns {var_name: shape} for every
+        variable not in ``known``.
         """
-        shapes: dict[int, tuple] = {}   # id(node) -> tuple of output shapes
+        shapes: dict[int, tuple] = {}   # node key -> tuple of output shapes
         dtypes: dict[int, tuple] = {}
         inferred: dict[str, tuple] = {}
 
@@ -345,50 +352,82 @@ class Symbol:
                 return tuple(known[node.name])
             return inferred.get(node.name)
 
-        for node in self._topo_order():
-            if node.op_name is None:
-                s = var_shape(node)
-                shapes[node.key] = (s,)
-                is_int = node.attrs.get("__dtype__") == "int32"
-                dtypes[node.key] = (jnp.int32 if is_int else jnp.float32,)
-                continue
-            # backward-infer any still-unknown variable inputs
+        def resolve_var(entry):
+            """Follow an input edge through transparent ops to the
+            underlying variable, or None if it ends at an op."""
+            while entry.op_name in _TRANSPARENT_OPS:
+                idx = (entry.output_index
+                       if entry.op_name == "amp_multicast" else 0)
+                entry = entry.inputs[idx]
+            return entry if entry.op_name is None else None
+
+        def try_backward(node):
+            """Layer-op backward inference; returns True on new facts."""
             roles = _LAYER_VARS.get(node.op_name)
-            first = node.inputs[0] if node.inputs else None
-            data_shape = (shapes[first.key][first.output_index]
-                          if first is not None else None)
-            if roles and data_shape is not None:
-                rule = _infer_layer_param_shapes(node.op_name, node.kwargs,
-                                                 data_shape)
-                for inp, role in zip(node.inputs, roles):
-                    if (inp.op_name is None and var_shape(inp) is None
-                            and role in rule):
-                        inferred[inp.name] = tuple(rule[role])
-                        shapes[inp.key] = (tuple(rule[role]),)
-                    if (inp.op_name is None and role in _LABEL_ROLES
-                            and var_shape(inp) is None and data_shape):
-                        inferred[inp.name] = (data_shape[0],)
-                        shapes[inp.key] = ((data_shape[0],),)
-            missing = [i.name for i in node.inputs
-                       if i.op_name is None
-                       and shapes[i.key][i.output_index] is None]
-            if missing:
+            if not roles or not node.inputs:
+                return False
+            first = node.inputs[0]
+            data_shape = None
+            if first.key in shapes:
+                data_shape = shapes[first.key][first.output_index]
+            if data_shape is None:
+                return False
+            rule = _infer_layer_param_shapes(node.op_name, node.kwargs,
+                                             data_shape)
+            new = False
+            for inp, role in zip(node.inputs, roles):
+                v = resolve_var(inp)
+                if v is None or var_shape(v) is not None:
+                    continue
+                if role in rule:
+                    inferred[v.name] = tuple(rule[role])
+                    new = True
+                elif role in _LABEL_ROLES:
+                    inferred[v.name] = (data_shape[0],)
+                    new = True
+            return new
+
+        remaining = self._topo_order()
+        while remaining:
+            progress = False
+            deferred = []
+            for node in remaining:
+                if node.op_name is None:
+                    s = var_shape(node)
+                    if s is None:
+                        deferred.append(node)
+                        continue
+                    shapes[node.key] = (tuple(s),)
+                    is_int = node.attrs.get("__dtype__") == "int32"
+                    dtypes[node.key] = (jnp.int32 if is_int else jnp.float32,)
+                    progress = True
+                    continue
+                if try_backward(node):
+                    progress = True
+                # NB: _bi.any, not any — generated op wrappers below
+                # shadow several builtins in this module's globals
+                if _bi.any(i.key not in shapes for i in node.inputs):
+                    deferred.append(node)
+                    continue
+                specs = [jax.ShapeDtypeStruct(shapes[i.key][i.output_index],
+                                              dtypes[i.key][i.output_index])
+                         for i in node.inputs]
+                op = _registry.get_op(node.op_name)
+                out_abs = jax.eval_shape(
+                    lambda *a, _op=op, _kw=node.kwargs: _op.fn(*a, **_kw),
+                    *specs)
+                if not isinstance(out_abs, tuple):
+                    out_abs = (out_abs,)
+                shapes[node.key] = tuple(tuple(o.shape) for o in out_abs)
+                dtypes[node.key] = tuple(o.dtype for o in out_abs)
+                progress = True
+            if not progress:
+                missing = sorted({n.name for n in deferred
+                                  if n.op_name is None})
                 raise ValueError(
-                    f"cannot infer shapes for variables {missing} feeding "
-                    f"op {node.op_name!r} ({node.name}); bind with explicit "
-                    "shapes for them")
-            specs = []
-            for i in node.inputs:
-                specs.append(jax.ShapeDtypeStruct(
-                    shapes[i.key][i.output_index],
-                    dtypes[i.key][i.output_index]))
-            op = _registry.get_op(node.op_name)
-            out_abs = jax.eval_shape(
-                lambda *a, _op=op, _kw=node.kwargs: _op.fn(*a, **_kw), *specs)
-            if not isinstance(out_abs, tuple):
-                out_abs = (out_abs,)
-            shapes[node.key] = tuple(tuple(o.shape) for o in out_abs)
-            dtypes[node.key] = tuple(o.dtype for o in out_abs)
+                    f"cannot infer shapes for variables {missing}; bind "
+                    "with explicit shapes for them")
+            remaining = deferred
         return inferred
 
     def eval_with(self, bindings: dict):
@@ -605,34 +644,71 @@ def Group(symbols):
     return Symbol(nodes)
 
 
+def _parse_ref_attr(v):
+    """Parse a reference-format attr string (MXNet serializes every op
+    param as ``str(value)``: '64', '(7, 7)', 'True', 'relu', ...)."""
+    import ast
+    if not isinstance(v, str):
+        return v
+    try:
+        out = ast.literal_eval(v)
+        return tuple(out) if isinstance(out, list) else out
+    except (ValueError, SyntaxError):
+        return v  # plain string param (act_type='relu', pool_type='max')
+
+
 def load_json(json_str):
+    """Build a Symbol from graph JSON.
+
+    Accepts both this framework's format ({"nodes", "heads"}) and the
+    reference's nnvm graph JSON (python/mxnet/symbol serialization:
+    nodes with stringly "attrs"/"param", plus "arg_nodes",
+    "node_row_ptr", "heads") so reference-exported ``-symbol.json``
+    files load directly (reference model.py:238 load_checkpoint).
+    """
     data = json.loads(json_str)
+    is_reference = "arg_nodes" in data or "node_row_ptr" in data
     nodes_built = []
     for nd_spec in data["nodes"]:
         # each input edge selects one output of the producer: a clone per
         # nonzero index (mutating the shared node would corrupt sibling
         # consumers of a different output)
         inputs = [nodes_built[i][0].clone_for_output(oi)
-                  for i, oi, _ in nd_spec["inputs"]]
+                  for i, oi, *_ in nd_spec["inputs"]]
         if nd_spec["op"] == "null":
             node = _SymNode(None, nd_spec["name"], [], {},
-                            attrs=nd_spec.get("attrs", {}))
+                            attrs=dict(nd_spec.get("attrs", {})))
         else:
             kwargs = {}
-            attrs = dict(nd_spec.get("attrs", {}))
+            # reference graphs may use "param" (older) or "attrs"
+            attrs = dict(nd_spec.get("attrs", nd_spec.get("param", {})))
             n_out = int(attrs.pop("__num_outputs__", 1))
             for k, v in attrs.items():
+                if is_reference:
+                    kwargs[k] = _parse_ref_attr(v)
+                    continue
                 try:
                     kwargs[k] = json.loads(v)
                     if isinstance(kwargs[k], list):
                         kwargs[k] = tuple(kwargs[k])
                 except (json.JSONDecodeError, TypeError):
                     pass
+            if is_reference and nd_spec["op"] == "SliceChannel":
+                n_out = int(kwargs.get("num_outputs", 1))
             node = _SymNode(nd_spec["op"], nd_spec["name"], inputs, kwargs,
                             num_outputs=n_out)
         nodes_built.append((node, nd_spec))
+    if is_reference:
+        # mark aux-state variables (moving stats) so the executor treats
+        # them as aux: the reference records this implicitly via each
+        # op's FListAuxiliaryStates; here the naming contract identifies
+        # them (model.py aux: prefix uses the same names)
+        for node, _ in nodes_built:
+            if node.op_name is None and node.name.endswith(
+                    ("moving_mean", "moving_var")):
+                node.attrs["__aux__"] = "1"
     heads = [nodes_built[i][0].clone_for_output(oi)
-             for i, oi, _ in data["heads"]]
+             for i, oi, *_ in data["heads"]]
     return Symbol(heads)
 
 
@@ -685,6 +761,12 @@ def _make_sym_wrapper(op_name):
     return fn
 
 
+# CAUTION: this injects an attribute per registered op into the module
+# globals for API parity (sym.sum, sym.any, ...).  Op names like
+# any/all/sum/max/min/abs/round/slice SHADOW the Python builtins for all
+# code in this module — module code must use the _bi (builtins) alias
+# for those (a bare any() here once returned a truthy Symbol and
+# silently broke shape inference).
 _g = globals()
 for _op_name in _registry.list_ops():
     if _op_name not in _g:

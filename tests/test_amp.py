@@ -1,0 +1,259 @@
+"""AMP list-driven conversion tests (VERDICT r2 task #7).
+
+Covers the reference's convert_symbol/convert_model surface
+(contrib/amp/amp.py:389-477 + lists/) and the Gluon convert_block path:
+the op lists must actually steer per-op dtypes, and the fp32_ops /
+target_dtype_ops arguments must be honored rather than discarded.
+"""
+import numpy as onp
+import pytest
+
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import amp, nd, gluon, sym
+from incubator_mxnet_tpu.ops.registry import get_op, invoke
+
+
+# ---------------------------------------------------------------------------
+# CastPolicy unit behavior
+# ---------------------------------------------------------------------------
+
+def test_policy_classes_from_lists():
+    pol = amp.CastPolicy("bfloat16")
+    assert pol.op_class("Convolution") == "lp16"
+    assert pol.op_class("softmax") == "fp32"
+    assert pol.op_class("add") == "widest"
+    assert pol.op_class("relu") is None  # unlisted: untouched
+
+
+def test_policy_override_args_honored():
+    # fp32_ops overrides the default listing — the round-2 bug was that
+    # this argument was accepted and ignored
+    pol = amp.CastPolicy("bfloat16", fp32_ops=["Convolution"],
+                         target_dtype_ops=["FullyConnected"])
+    assert pol.op_class("Convolution") == "fp32"
+    assert pol.op_class("FullyConnected") == "lp16"
+    assert pol.op_class("softmax") is None  # replaced default list
+
+
+def test_policy_conflicting_lists_rejected():
+    with pytest.raises(ValueError):
+        amp.CastPolicy("bfloat16", target_dtype_ops=["dot"], fp32_ops=["dot"])
+
+
+def test_policy_cast_args_dtypes():
+    pol = amp.CastPolicy("bfloat16")
+    f32 = jnp.ones((4, 4), jnp.float32)
+    bf16 = jnp.ones((4, 4), jnp.bfloat16)
+    ints = jnp.ones((4,), jnp.int32)
+    out = pol.cast_args("dot", [f32, bf16, ints])
+    assert out[0].dtype == jnp.bfloat16
+    assert out[1].dtype == jnp.bfloat16
+    assert out[2].dtype == jnp.int32  # non-float passes through
+    out = pol.cast_args("softmax", [bf16])
+    assert out[0].dtype == jnp.float32
+    out = pol.cast_args("add", [f32, bf16])
+    assert out[0].dtype == jnp.float32 and out[1].dtype == jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# Eager/Gluon path: policy active during forward
+# ---------------------------------------------------------------------------
+
+def test_convert_block_policy_steers_op_dtypes():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, in_units=8))
+    net.add(gluon.nn.Dense(4, in_units=16))
+    net.initialize()
+    amp.convert_block(net, "bfloat16")
+    x = nd.random.uniform(shape=(2, 8))  # fp32 input
+    out = net(x)
+    # params cast + lp16 list => FullyConnected computes in bf16
+    assert out.dtype == jnp.bfloat16
+
+    # now force Dense to fp32 via the fp32_ops argument
+    net2 = gluon.nn.HybridSequential()
+    net2.add(gluon.nn.Dense(16, in_units=8))
+    net2.initialize()
+    amp.convert_block(net2, "bfloat16", fp32_ops=["FullyConnected"],
+                      target_dtype_ops=[])
+    out2 = net2(x)
+    assert out2.dtype == jnp.float32
+
+
+def test_convert_block_keeps_norm_params_fp32():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, in_channels=3))
+    net.add(gluon.nn.BatchNorm(in_channels=8))
+    net.initialize()
+    net(nd.random.uniform(shape=(1, 3, 8, 8)))
+    amp.convert_block(net, "bfloat16")
+    params = dict(net.collect_params().items())
+    conv_w = [v for k, v in params.items() if k.endswith("weight")][0]
+    gammas = [v for k, v in params.items() if k.endswith("gamma")]
+    assert conv_w.dtype == jnp.bfloat16
+    assert all(g.dtype == jnp.float32 for g in gammas)
+
+
+def test_policy_scope_restores():
+    pol = amp.CastPolicy("bfloat16")
+    assert amp.current_policy() is None
+    with amp.policy_scope(pol):
+        assert amp.current_policy() is pol
+    assert amp.current_policy() is None
+
+
+# ---------------------------------------------------------------------------
+# amp_cast / amp_multicast ops
+# ---------------------------------------------------------------------------
+
+def test_amp_cast_op():
+    op = get_op("amp_cast")
+    x = jnp.ones((3,), jnp.float32)
+    assert op.fn(x, dtype="bfloat16").dtype == jnp.bfloat16
+    ints = jnp.ones((3,), jnp.int32)
+    assert op.fn(ints, dtype="bfloat16").dtype == jnp.int32
+
+
+def test_amp_multicast_op():
+    op = get_op("amp_multicast")
+    a = jnp.ones((3,), jnp.bfloat16)
+    b = jnp.ones((3,), jnp.float32)
+    oa, ob = op.fn(a, b, num_outputs=2)
+    assert oa.dtype == jnp.float32 and ob.dtype == jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# Symbolic path: convert_symbol graph rewrite
+# ---------------------------------------------------------------------------
+
+def _count_ops(s, name):
+    return sum(1 for n in s._topo_order() if n.op_name == name)
+
+
+def test_convert_symbol_inserts_casts():
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    out = sym.softmax(fc, name="sm")
+    conv = amp.convert_symbol(out, "bfloat16")
+    # fc inputs (data, weight, bias) wrapped in amp_cast->bf16;
+    # softmax input wrapped in amp_cast->fp32
+    casts = [n for n in conv._topo_order() if n.op_name == "amp_cast"]
+    assert len(casts) == 4
+    tgt = {n.kwargs["dtype"] for n in casts}
+    assert tgt == {"bfloat16", "float32"}
+    # original symbol untouched
+    assert _count_ops(out, "amp_cast") == 0
+
+
+def test_convert_symbol_execution_dtypes():
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    conv = amp.convert_symbol(fc, "bfloat16")
+    w = jnp.ones((8, 4), jnp.float32)
+    b = jnp.zeros((8,), jnp.float32)
+    x = jnp.ones((2, 4), jnp.float32)
+    outs = conv._evaluate({"data": x, "fc1_weight": w, "fc1_bias": b})
+    assert outs[0].dtype == jnp.bfloat16
+    # numerics match the fp32 graph within bf16 tolerance
+    ref = fc._evaluate({"data": x, "fc1_weight": w, "fc1_bias": b})
+    onp.testing.assert_allclose(onp.asarray(outs[0], onp.float32),
+                                onp.asarray(ref[0]), rtol=2e-2)
+
+
+def test_convert_symbol_excluded_names():
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    conv = amp.convert_symbol(fc, "bfloat16", excluded_sym_names=["fc1"])
+    assert _count_ops(conv, "amp_cast") == 0
+
+
+def test_convert_symbol_widest_multicast():
+    a = sym.var("a")
+    b = sym.var("b")
+    s = a + b
+    conv = amp.convert_symbol(s, "bfloat16")
+    assert _count_ops(conv, "amp_multicast") == 1
+    out = conv._evaluate({"a": jnp.ones((3,), jnp.bfloat16),
+                          "b": jnp.ones((3,), jnp.float32)})
+    assert out[0].dtype == jnp.float32
+
+
+def test_convert_model_casts_optional_params():
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    arg_params = {"fc1_weight": nd.ones((8, 4)).data,
+                  "fc1_bias": nd.zeros((8,)).data}
+    new_sym, new_args, _ = amp.convert_model(
+        fc, arg_params, {}, "bfloat16", cast_optional_params=True)
+    assert new_args["fc1_weight"].dtype == jnp.bfloat16
+    # default: params stay fp32 (runtime amp_cast downcasts)
+    _, args2, _ = amp.convert_model(fc, arg_params, {}, "bfloat16")
+    assert args2["fc1_weight"].dtype == jnp.float32
+
+
+def test_converted_symbol_roundtrips_json():
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    conv = amp.convert_symbol(fc, "bfloat16")
+    j = conv.tojson()
+    re = sym.load_json(j)
+    assert _count_ops(re, "amp_cast") == _count_ops(conv, "amp_cast")
+
+
+# ---------------------------------------------------------------------------
+# Review-pass regressions (round-3 code review findings)
+# ---------------------------------------------------------------------------
+
+def test_converted_symbol_infers_param_shapes():
+    # amp_cast between a param variable and its layer op must not break
+    # backward shape inference in simple_bind
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    conv = amp.convert_symbol(fc, "bfloat16")
+    ex = conv.simple_bind(data=(2, 4))
+    out = ex.forward()
+    assert out[0].shape == (2, 8)
+
+
+def test_converted_symbol_keeps_aux_updates():
+    # fp16 lists put BatchNorm in fp32; the cast insertion must leave
+    # moving stats (aux) as direct variable inputs so training-mode
+    # aux updates still map back
+    data = sym.var("data")
+    bn = sym.BatchNorm(data, name="bn0")
+    conv = amp.convert_symbol(bn, "float16")
+    binds = {"data": jnp.ones((4, 3, 2, 2), jnp.float32) * 2.0,
+             "bn0_gamma": jnp.ones((3,)), "bn0_beta": jnp.zeros((3,)),
+             "bn0_moving_mean": jnp.zeros((3,)),
+             "bn0_moving_var": jnp.ones((3,))}
+    aux = {}
+    conv._evaluate(binds, training=True, aux_updates=aux)
+    assert set(aux) == {"bn0_moving_mean", "bn0_moving_var"}
+    assert float(aux["bn0_moving_mean"][0]) != 0.0
+
+
+def test_convert_symbol_dedups_casts():
+    # one variable feeding two lp16 ops gets ONE amp_cast node
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=4, name="fca")
+    fc2 = sym.FullyConnected(data, num_hidden=4, name="fcb")
+    g = sym.Group([fc1, fc2])
+    conv = amp.convert_symbol(g, "bfloat16")
+    casts = [n for n in conv._topo_order() if n.op_name == "amp_cast"]
+    data_casts = [n for n in casts if n.inputs[0].name == "data"]
+    assert len(data_casts) == 1
+    names = [n.name for n in conv._topo_order()]
+    assert len(names) == len(set(names)), "duplicate node names"
+
+
+def test_convert_model_excluded_params_stay_fp32():
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    arg_params = {"fc1_weight": nd.ones((8, 4)).data,
+                  "fc1_bias": nd.zeros((8,)).data}
+    _, args, _ = amp.convert_model(
+        fc, arg_params, {}, "bfloat16", excluded_sym_names=["fc1"],
+        cast_optional_params=True)
+    assert args["fc1_weight"].dtype == jnp.float32
