@@ -196,6 +196,10 @@ class TransformerLM:
         # layer param is sharded over 'pp' (stage placement)
         x, _ = lax.scan(lambda carry, lp: (self._layer(lp, carry, mesh), None),
                         x, params["layers"])
+        return self._head(params, x, mesh)
+
+    def _head(self, params, x, mesh):
+        cfg = self.cfg
         if cfg.use_moe:
             moe_out, aux = moe_forward(params["moe"], x)
             x = x + moe_out
@@ -206,21 +210,121 @@ class TransformerLM:
                 logits, NamedSharding(mesh, P("dp", "sp", None)))
         return logits
 
+    # -- pipelined forward (real pp schedule) -----------------------------
+    def apply_pipelined(self, params, tokens, mesh: Mesh, n_micro: int):
+        """tokens (B, T) → logits, via a microbatched circular pipeline.
+
+        The GSPMD collective-permute pipelining pattern (GSPMD paper §3.4;
+        scaling-book pipelining chapter): the layer stack is reshaped to
+        (npp, L/npp, ...) with the stage axis sharded over 'pp'; a
+        per-stage activation buffer advances one stage per step via
+        ``jnp.roll`` on the stage-sharded axis, which XLA lowers to a
+        collective-permute over the pp ring.  All stages compute every
+        step (vmapped over the stage axis → SPMD over 'pp'); bubble-step
+        garbage is never collected.  Because the schedule is plain
+        scan+roll, ``jax.grad`` differentiates it into the reverse
+        pipeline automatically — backward microbatches flow last→first
+        stage with the transposed permute.  Replaces the reference's
+        coarse group2ctx placement (graph_executor.cc:2048) with an
+        actual overlap schedule.
+        """
+        cfg = self.cfg
+        npp = mesh.shape["pp"]
+        B, T = tokens.shape
+        if B % n_micro:
+            raise ValueError(
+                f"n_micro ({n_micro}) must divide the batch size ({B})")
+        L = cfg.n_layers
+        if L % npp:
+            raise ValueError(
+                f"pp degree ({npp}) must divide n_layers ({L})")
+        mb = B // n_micro
+
+        x = params["embed"][tokens] + params["pos_embed"][:T][None]
+        micro = x.reshape(n_micro, mb, T, cfg.d_model)
+        micro = lax.with_sharding_constraint(
+            micro, NamedSharding(mesh, P(None, "dp", "sp", None)))
+
+        # (L, ...) → (npp, L/npp, ...), stage axis sharded over pp
+        layers = jax.tree_util.tree_map(
+            lambda a: lax.with_sharding_constraint(
+                a.reshape(npp, L // npp, *a.shape[1:]),
+                NamedSharding(mesh, P("pp", *([None] * a.ndim)))),
+            params["layers"])
+
+        def stage_apply(lp_stage, xb):
+            """Run this stage's L/npp layers (no per-op sharding
+            constraints here: specs can't follow the vmapped stage axis;
+            GSPMD propagates tp/sp sharding from the param shardings)."""
+            out, _ = lax.scan(
+                lambda c, lp: (self._layer(lp, c, None), None), xb, lp_stage)
+            return out
+
+        buf = jnp.zeros((npp, mb, T, cfg.d_model), micro.dtype)
+        outputs = jnp.zeros((n_micro, mb, T, cfg.d_model), micro.dtype)
+        buf = lax.with_sharding_constraint(
+            buf, NamedSharding(mesh, P("pp", "dp", "sp", None)))
+
+        def step(carry, t):
+            buf, outputs = carry
+            inject = micro[jnp.clip(t, 0, n_micro - 1)]
+            slot0 = jnp.where(t < n_micro, inject, buf[0])
+            buf = lax.dynamic_update_index_in_dim(buf, slot0, 0, axis=0)
+            new_buf = jax.vmap(stage_apply)(layers, buf)
+            emit = t - (npp - 1)
+            out_last = new_buf[npp - 1]
+            outputs = jnp.where(
+                (emit >= 0) & (emit < n_micro),
+                lax.dynamic_update_index_in_dim(
+                    outputs, out_last, jnp.clip(emit, 0, n_micro - 1), axis=0),
+                outputs)
+            # advance: stage i's output becomes stage i+1's input
+            # (roll on the pp-sharded axis → collective-permute on ICI)
+            buf = jnp.roll(new_buf, 1, axis=0)
+            return (buf, outputs), None
+
+        (buf, outputs), _ = lax.scan(step, (buf, outputs),
+                                     jnp.arange(n_micro + npp - 1))
+        x = outputs.reshape(B, T, cfg.d_model)
+        x = lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("dp", "sp", None)))
+        return self._head(params, x, mesh)
+
     # -- training ---------------------------------------------------------
-    def loss_fn(self, params, tokens, mesh=None):
-        logits = self.apply(params, tokens[:, :-1], mesh)
+    def loss_fn(self, params, tokens, mesh=None, n_micro=None):
+        if n_micro is not None and mesh is not None:
+            logits = self.apply_pipelined(params, tokens[:, :-1], mesh,
+                                          n_micro)
+        else:
+            logits = self.apply(params, tokens[:, :-1], mesh)
         targets = tokens[:, 1:]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
         return jnp.mean(nll)
 
-    def make_train_step(self, mesh: Mesh, lr=1e-3):
+    def make_train_step(self, mesh: Mesh, lr=1e-3, n_micro=None):
         """SGD train step jitted over the mesh; GSPMD inserts the dp-psum
-        for gradients and tp/sp/ep collectives for the sharded math."""
+        for gradients and tp/sp/ep collectives for the sharded math.
+
+        When the mesh has pp > 1, the forward (and its transposed
+        backward) run the microbatched circular pipeline
+        (``apply_pipelined``) instead of the scan-with-sharded-params
+        stage fetch; n_micro defaults to 2*pp (bubble fraction
+        (pp-1)/(2*pp+pp-1)) clamped to divide the batch at call time.
+        """
+        pp = dict(mesh.shape).get("pp", 1)
 
         def step(params, tokens):
+            nm = n_micro
+            if pp > 1 and nm is None:
+                # default 2*pp microbatches, clamped to a divisor of the
+                # (statically known) batch so the pipeline always traces
+                nm = min(2 * pp, tokens.shape[0])
+                while tokens.shape[0] % nm:
+                    nm -= 1
             loss, grads = jax.value_and_grad(
-                lambda p: self.loss_fn(p, tokens, mesh))(params)
+                lambda p: self.loss_fn(p, tokens, mesh,
+                                       nm if pp > 1 else None))(params)
             new_params = jax.tree_util.tree_map(
                 lambda p, g: (p.astype(jnp.float32)
                               - lr * g.astype(jnp.float32)).astype(p.dtype),

@@ -51,8 +51,12 @@ def moe_forward(params, x, capacity_factor=1.25, top_k=2):
     for slot in range(top_k):
         e_idx = gate_idx[:, slot]                           # (S,)
         onehot = jax.nn.one_hot(e_idx, E, dtype=jnp.int32)  # (S, E)
-        pos = jnp.cumsum(onehot, axis=0) * onehot - 1       # position per expert
-        pos_in_e = jnp.sum(pos, axis=-1)                    # (S,)
+        # rank of this token within its chosen expert's queue; the
+        # (cumsum-1) must be masked BY onehot so non-selected experts
+        # contribute 0, not -1 (a -1 per other expert shifted every
+        # position negative and one_hot silently dropped early tokens)
+        pos_in_e = jnp.sum((jnp.cumsum(onehot, axis=0) - 1) * onehot,
+                           axis=-1)                         # (S,)
         keep = pos_in_e < C
         cap_onehot = jax.nn.one_hot(jnp.where(keep, pos_in_e, C), C + 1,
                                     dtype=probs.dtype)[:, :C]

@@ -27,18 +27,20 @@ def _ulysses_sharded(q, k, v, axis_name, causal):
 
     def seq2head(x):
         # (B,H,Tl,D) → split heads into nsp groups, all-to-all so each
-        # rank gets H/nsp heads with the FULL sequence.
+        # rank gets H/nsp heads with the FULL sequence.  The received
+        # source-rank axis must land BEFORE T (chunk-major) so that
+        # merging (nsp, T) reconstructs the global sequence order —
+        # head2seq then splits S the same chunk-major way, making the
+        # two transforms exact inverses.
         x = x.reshape(B, nsp, H // nsp, T, D)
-        x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3,
-                           tiled=False)
-        return x.reshape(B, H // nsp, T * nsp, D)
+        x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                           tiled=False)           # (B, H/nsp, nsp, T, D)
+        return x.reshape(B, H // nsp, nsp * T, D)
 
     def head2seq(x):
-        x = x.reshape(B, 1, H // nsp, nsp, T, D).squeeze(1)
         x = x.reshape(B, H // nsp, nsp, T, D)
         x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
-                           tiled=False)
-        # now (B, nsp*(H//nsp) ... ) → reshape back to (B,H,T,D)
+                           tiled=False)           # (B, nsp, H/nsp, T, D)
         return x.reshape(B, H, T, D)
 
     qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)

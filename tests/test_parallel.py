@@ -1,0 +1,416 @@
+"""Multi-device unit tests for the parallel layer (VERDICT r2 task #2).
+
+Runs on the 8-virtual-CPU-device mesh (conftest.py sets
+--xla_force_host_platform_device_count=8).  Pattern follows the
+reference's self-checking distributed tests
+(tests/nightly/dist_sync_kvstore.py): compute on the sharded path,
+assert against the dense/single-device oracle.
+"""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from incubator_mxnet_tpu.parallel.mesh import make_mesh
+from incubator_mxnet_tpu.parallel.ring_attention import ring_attention
+from incubator_mxnet_tpu.parallel.ulysses import ulysses_attention
+from incubator_mxnet_tpu.parallel.pipeline import pipeline_forward
+from incubator_mxnet_tpu.parallel.moe import moe_forward, init_moe_params
+from incubator_mxnet_tpu.parallel.data_parallel import (
+    make_data_parallel_train_step)
+from incubator_mxnet_tpu.models.transformer import (TransformerConfig,
+                                                    TransformerLM)
+
+
+def setup_module():
+    assert jax.device_count() >= 8, (
+        f"parallel tests need >= 8 devices, have {jax.device_count()} "
+        "(conftest should force an 8-device CPU mesh)")
+
+
+def _dense_attention(q, k, v, causal):
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    logits = jnp.einsum("bhtd,bhsd->bhts", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        T, S = logits.shape[-2:]
+        mask = jnp.tril(jnp.ones((T, S), bool))
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhts,bhsd->bhtd", probs.astype(v.dtype), v)
+
+
+def _qkv(key, B=2, H=4, T=16, D=8, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (B, H, T, D), dtype) for k in ks)
+
+
+# ---------------------------------------------------------------------------
+# ring attention == dense attention
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(causal):
+    mesh = make_mesh(sp=8)
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    out = ring_attention(q, k, v, mesh, axis_name="sp", causal=causal)
+    ref = _dense_attention(q, k, v, causal)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_ring_attention_grads_match_dense():
+    mesh = make_mesh(sp=8)
+    q, k, v = _qkv(jax.random.PRNGKey(1))
+
+    def loss_ring(q, k, v):
+        return jnp.sum(jnp.square(
+            ring_attention(q, k, v, mesh, axis_name="sp", causal=True)))
+
+    def loss_dense(q, k, v):
+        return jnp.sum(jnp.square(_dense_attention(q, k, v, True)))
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        onp.testing.assert_allclose(onp.asarray(gr), onp.asarray(gd),
+                                    rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_on_dp_sp_mesh():
+    # batch AND sequence sharded simultaneously
+    mesh = make_mesh(dp=2, sp=4)
+    q, k, v = _qkv(jax.random.PRNGKey(2), B=4, T=12)
+    out = ring_attention(q, k, v, mesh, axis_name="sp", causal=True)
+    ref = _dense_attention(q, k, v, True)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses == dense attention
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_dense(causal):
+    mesh = make_mesh(sp=4, dp=2)
+    q, k, v = _qkv(jax.random.PRNGKey(3), B=2, H=4, T=16)
+    out = ulysses_attention(q, k, v, mesh, axis_name="sp", causal=causal)
+    ref = _dense_attention(q, k, v, causal)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_ring_agree():
+    mesh = make_mesh(sp=4, dp=2)
+    q, k, v = _qkv(jax.random.PRNGKey(4), B=2, H=8, T=8)
+    a = ulysses_attention(q, k, v, mesh, axis_name="sp", causal=True)
+    b = ring_attention(q, k, v, mesh, axis_name="sp", causal=True)
+    onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# pipeline_forward == sequential stage application
+# ---------------------------------------------------------------------------
+
+def _stage_fn(p, x):
+    return jax.nn.relu(x @ p["w"] + p["b"])
+
+
+def _make_stage_params(key, npp, d):
+    k1, k2 = jax.random.split(key)
+    return {"w": jax.random.normal(k1, (npp, d, d), jnp.float32) * 0.3,
+            "b": jax.random.normal(k2, (npp, d), jnp.float32) * 0.1}
+
+
+@pytest.mark.parametrize("n_micro", [4, 8])
+def test_pipeline_matches_sequential(n_micro):
+    npp, d, B = 8, 6, 16
+    mesh = make_mesh(pp=npp)
+    params = _make_stage_params(jax.random.PRNGKey(5), npp, d)
+    x = jax.random.normal(jax.random.PRNGKey(6), (B, d), jnp.float32)
+    out = pipeline_forward(params, x, _stage_fn, mesh, n_micro=n_micro)
+    ref = x
+    for i in range(npp):
+        ref = _stage_fn({"w": params["w"][i], "b": params["b"][i]}, ref)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_multi_layer_stages():
+    # 8 layers over a 4-stage pipeline: 2 layers per stage
+    npp, L, d, B = 4, 8, 4, 8
+    mesh = make_mesh(pp=npp, dp=2)
+    params = _make_stage_params(jax.random.PRNGKey(7), L, d)
+
+    def stage_fn(p, x):
+        # p leaves have leading axis L/npp (the local layer slice)
+        for i in range(L // npp):
+            x = _stage_fn({"w": p["w"][i], "b": p["b"][i]}, x)
+        return x
+
+    x = jax.random.normal(jax.random.PRNGKey(8), (B, d), jnp.float32)
+    out = pipeline_forward(params, x, stage_fn, mesh, n_micro=4)
+    ref = x
+    for i in range(L):
+        ref = _stage_fn({"w": params["w"][i], "b": params["b"][i]}, ref)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# MoE: determinism, capacity semantics, ep-sharded parity
+# ---------------------------------------------------------------------------
+
+def test_moe_deterministic():
+    params = init_moe_params(jax.random.PRNGKey(9), 16, 32, 4)
+    x = jax.random.normal(jax.random.PRNGKey(10), (2, 8, 16), jnp.float32)
+    o1, a1 = moe_forward(params, x)
+    o2, a2 = moe_forward(params, x)
+    onp.testing.assert_array_equal(onp.asarray(o1), onp.asarray(o2))
+    assert float(a1) == float(a2)
+    assert o1.shape == x.shape
+    assert float(a1) >= 0.0
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    # gate forced to expert 0: with capacity < n_tokens the overflow
+    # tokens get no expert contribution (combine weights are zero)
+    d, E = 8, 4
+    params = init_moe_params(jax.random.PRNGKey(11), d, 16, E)
+    params = dict(params)
+    gate = onp.zeros((d, E), onp.float32)
+    gate[:, 0] = 0.0
+    params["gate"] = jnp.asarray(gate)  # all logits equal -> top1 = expert 0
+    x = jnp.broadcast_to(jnp.ones((d,), jnp.float32), (1, 16, d))
+    out, _ = moe_forward(params, x, capacity_factor=0.25, top_k=1)
+    # capacity C = 0.25 * 16 * 1 / 4 = 1 slot: identical tokens, only the
+    # first fits; the rest must be exactly zero
+    outs = onp.asarray(out.reshape(16, d))
+    assert onp.abs(outs[0]).sum() > 0.0
+    assert onp.abs(outs[1:]).max() == 0.0
+
+
+def test_moe_full_capacity_keeps_all_tokens():
+    d, E = 8, 2
+    params = init_moe_params(jax.random.PRNGKey(12), d, 16, E)
+    x = jax.random.normal(jax.random.PRNGKey(13), (2, 4, d), jnp.float32)
+    out, _ = moe_forward(params, x, capacity_factor=4.0, top_k=2)
+    # with generous capacity every token gets routed: output nonzero
+    assert float(jnp.abs(out).sum()) > 0.0
+
+
+def test_moe_ep_sharded_matches_local():
+    mesh = make_mesh(ep=4, dp=2)
+    d = 16
+    params = init_moe_params(jax.random.PRNGKey(14), d, 32, 4)
+    x = jax.random.normal(jax.random.PRNGKey(15), (4, 8, d), jnp.float32)
+    ref, aux_ref = moe_forward(params, x)
+
+    sharded = {
+        "gate": jax.device_put(params["gate"], NamedSharding(mesh, P())),
+        "w_in": jax.device_put(params["w_in"],
+                               NamedSharding(mesh, P("ep", None, None))),
+        "w_out": jax.device_put(params["w_out"],
+                                NamedSharding(mesh, P("ep", None, None))),
+    }
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None, None)))
+    out, aux = jax.jit(moe_forward)(sharded, xs)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-5)
+    onp.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# data parallel: sharded grads == single-device grads
+# ---------------------------------------------------------------------------
+
+def test_data_parallel_step_matches_single_device():
+    d = 8
+    key = jax.random.PRNGKey(16)
+    w = jax.random.normal(key, (d, 1), jnp.float32)
+    params = {"w": w}
+    xs = jax.random.normal(jax.random.PRNGKey(17), (32, d), jnp.float32)
+    ys = jnp.sum(xs, axis=1, keepdims=True)
+    batch = {"x": xs, "y": ys}
+
+    def loss_fn(p, b):
+        pred = b["x"] @ p["w"]
+        return jnp.mean(jnp.square(pred - b["y"]))
+
+    lr = 0.1
+
+    def opt_update(grads, state, params):
+        return jax.tree_util.tree_map(lambda g: -lr * g, grads), state
+
+    mesh = make_mesh(dp=8)
+    step = make_data_parallel_train_step(loss_fn, mesh, opt_update)
+    p_sh, st_sh, b_sh = step.place(params, {}, batch)
+    new_params, _, loss = step(p_sh, st_sh, b_sh)
+
+    # single-device oracle
+    g = jax.grad(loss_fn)(params, batch)
+    ref_w = params["w"] - lr * g["w"]
+    ref_loss = loss_fn(params, batch)
+    onp.testing.assert_allclose(onp.asarray(new_params["w"]),
+                                onp.asarray(ref_w), rtol=1e-6, atol=1e-6)
+    onp.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# fused train step on a mesh == fused train step single-device
+# ---------------------------------------------------------------------------
+
+def test_fused_step_mesh_matches_single_device():
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon
+    from incubator_mxnet_tpu.fuse import make_fused_train_step
+
+    def build():
+        mx.random.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(16, in_units=8, activation="relu"))
+        net.add(gluon.nn.Dense(4, in_units=16))
+        net.initialize()
+        return net
+
+    onp.random.seed(0)
+    x = onp.random.rand(16, 8).astype(onp.float32)
+    y = onp.random.randint(0, 4, (16,)).astype(onp.int32)
+
+    losses = {}
+    for mode in ("single", "mesh"):
+        net = build()
+        kwargs = {}
+        if mode == "mesh":
+            kwargs = {"mesh": make_mesh(dp=8), "batch_spec": P("dp")}
+        step = make_fused_train_step(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9}, **kwargs)
+        ls = []
+        for _ in range(3):
+            ls.append(float(step(jnp.asarray(x), jnp.asarray(y))))
+        losses[mode] = ls
+    onp.testing.assert_allclose(losses["single"], losses["mesh"],
+                                rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# TransformerLM: train-step loss parity across mesh shapes
+# ---------------------------------------------------------------------------
+
+def _tiny_cfg(**kw):
+    return TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                             n_layers=2, d_ff=64, max_len=32,
+                             dtype="float32", **kw)
+
+
+@pytest.mark.parametrize("mesh_kw", [
+    {"dp": 8},
+    {"dp": 2, "tp": 4},
+    {"dp": 2, "sp": 2, "tp": 2},
+    {"dp": 4, "pp": 2},
+    {"dp": 2, "tp": 2, "pp": 2},
+])
+def test_transformer_train_step_parity_across_meshes(mesh_kw):
+    model = TransformerLM(_tiny_cfg())
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0, 64)
+
+    # single-device oracle
+    ref_loss = float(model.loss_fn(params, tokens))
+
+    mesh = make_mesh(**mesh_kw)
+    step, tok_sharding = model.make_train_step(mesh, lr=1e-2)
+    p_sh = model.shard_params(params, mesh)
+    t_sh = jax.device_put(tokens, tok_sharding)
+    new_params, loss = step(p_sh, t_sh)
+    onp.testing.assert_allclose(float(loss), ref_loss, rtol=2e-4, atol=2e-4)
+
+    # one more step must also agree with the single-device trajectory
+    def ref_step(params, tokens, lr=1e-2):
+        loss, grads = jax.value_and_grad(
+            lambda p: model.loss_fn(p, tokens))(params)
+        new = jax.tree_util.tree_map(
+            lambda p, g: (p.astype(jnp.float32)
+                          - lr * g.astype(jnp.float32)).astype(p.dtype),
+            params, grads)
+        return new, loss
+
+    ref_params, _ = ref_step(params, tokens)
+    _, loss2 = step(new_params, t_sh)
+    _, ref_loss2 = ref_step(ref_params, tokens)
+    onp.testing.assert_allclose(float(loss2), float(ref_loss2),
+                                rtol=2e-4, atol=2e-4)
+
+
+def test_transformer_ring_attention_mesh_matches_gspmd():
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 17), 0, 64)
+    params = TransformerLM(_tiny_cfg()).init(jax.random.PRNGKey(0))
+
+    losses = {}
+    for attn, mesh_kw in (("gspmd", {"dp": 2, "sp": 4}),
+                          ("ring", {"dp": 2, "sp": 4})):
+        model = TransformerLM(_tiny_cfg(attention=attn))
+        mesh = make_mesh(**mesh_kw)
+        step, tok_sharding = model.make_train_step(mesh, lr=1e-2)
+        p_sh = model.shard_params(params, mesh)
+        t_sh = jax.device_put(tokens, tok_sharding)
+        _, loss = step(p_sh, t_sh)
+        losses[attn] = float(loss)
+    onp.testing.assert_allclose(losses["ring"], losses["gspmd"],
+                                rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# real pipeline schedule in the flagship (VERDICT r2 task #3)
+# ---------------------------------------------------------------------------
+
+def test_transformer_pipelined_loss_matches_plain():
+    model = TransformerLM(_tiny_cfg())
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (8, 17), 0, 64)
+    ref = float(model.loss_fn(params, tokens))
+
+    mesh = make_mesh(dp=4, pp=2)
+    p_sh = model.shard_params(params, mesh)
+    t_sh = jax.device_put(tokens, NamedSharding(mesh, P("dp", None)))
+    pip = float(jax.jit(
+        lambda p, t: model.loss_fn(p, t, mesh, n_micro=4))(p_sh, t_sh))
+    onp.testing.assert_allclose(pip, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_transformer_pipelined_grads_match_plain():
+    model = TransformerLM(_tiny_cfg())
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (8, 17), 0, 64)
+    g_ref = jax.grad(lambda p: model.loss_fn(p, tokens))(params)
+
+    mesh = make_mesh(dp=4, pp=2)
+    p_sh = model.shard_params(params, mesh)
+    t_sh = jax.device_put(tokens, NamedSharding(mesh, P("dp", None)))
+    g_pip = jax.jit(jax.grad(
+        lambda p: model.loss_fn(p, t_sh, mesh, n_micro=4)))(p_sh)
+    flat_ref = jax.tree_util.tree_leaves(g_ref)
+    flat_pip = jax.tree_util.tree_leaves(g_pip)
+    for a, b in zip(flat_ref, flat_pip):
+        onp.testing.assert_allclose(onp.asarray(b), onp.asarray(a),
+                                    rtol=5e-4, atol=5e-5)
+
+
+def test_transformer_train_step_uses_pipeline_when_pp():
+    # make_train_step with pp>1 must route through apply_pipelined and
+    # still track the single-device trajectory
+    model = TransformerLM(_tiny_cfg())
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (8, 17), 0, 64)
+    mesh = make_mesh(dp=2, pp=2)
+    step, tok_sharding = model.make_train_step(mesh, lr=1e-2)
+    p_sh = model.shard_params(params, mesh)
+    t_sh = jax.device_put(tokens, tok_sharding)
+    _, loss = step(p_sh, t_sh)
+    ref = float(model.loss_fn(params, tokens))
+    onp.testing.assert_allclose(float(loss), ref, rtol=2e-4, atol=2e-4)
