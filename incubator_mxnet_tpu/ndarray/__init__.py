@@ -232,50 +232,72 @@ def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
 
 
 # ---------------------------------------------------------------------------
-# serialization (reference src/ndarray/ndarray.cc:1679-1924 TLV format;
-# redesigned as a simple tagged binary container, same capabilities:
-# list-of-arrays and dict-of-arrays round trip, used by .params files)
+# serialization — reference-compatible TLV wire format
+# (src/ndarray/ndarray.cc:1679-1924; codec in params_io.py).  Files
+# written here load in the reference runtime and vice versa, satisfying
+# the SURVEY.md §5.4 backwards-compat axis.  The round-1 private
+# "MXTPU001" container is still readable for old checkpoints.
 # ---------------------------------------------------------------------------
 
 _MAGIC = b"MXTPU001"
 
 
 def save(fname, data):
-    """Save a list or dict of NDArrays (reference nd.save)."""
+    """Save a list or dict of NDArrays in the reference ``.params``
+    format (reference nd.save, ndarray.cc:1926 kMXAPINDArrayListMagic) —
+    files written here load in the reference runtime."""
+    from . import params_io
+    from .sparse import RowSparseNDArray, CSRNDArray
     if isinstance(data, NDArray):
         data = [data]
-    if isinstance(data, dict):
-        items = [(k, v) for k, v in data.items()]
-    else:
-        items = [("", v) for v in data]
+    named = isinstance(data, dict)
+    items = list(data.items()) if named else [("", v) for v in data]
+    wire = []
+    for key, arr in items:
+        if isinstance(arr, RowSparseNDArray):
+            vals = _onp.asarray(arr._rs_values)
+            idx = _onp.asarray(arr._rs_indices, _onp.int64)
+            wire.append((key, (vals, arr._dense_shape, 1, [idx])))
+        elif isinstance(arr, CSRNDArray):
+            vals = _onp.asarray(arr._csr_data)
+            indptr = _onp.asarray(arr._csr_indptr, _onp.int64)
+            idx = _onp.asarray(arr._csr_indices, _onp.int64)
+            wire.append((key, (vals, arr._dense_shape, 2, [indptr, idx])))
+        else:
+            v = arr.data if isinstance(arr, NDArray) else _jnp.asarray(arr)
+            wire.append((key, _onp.asarray(v)))
     with open(fname, "wb") as f:
-        f.write(_MAGIC)
-        f.write(_struct.pack("<q", len(items)))
-        for key, arr in items:
-            kb = key.encode()
-            np_val = arr.asnumpy() if arr.data.dtype != _jnp.bfloat16 else \
-                _onp.asarray(arr.data.astype(_jnp.float32))
-            dtype_name = arr.data.dtype.name
-            db = np_val.tobytes() if dtype_name != "bfloat16" else np_val.astype("float32").tobytes()
-            shape = arr.shape
-            f.write(_struct.pack("<q", len(kb)))
-            f.write(kb)
-            dn = dtype_name.encode()
-            f.write(_struct.pack("<q", len(dn)))
-            f.write(dn)
-            f.write(_struct.pack("<q", len(shape)))
-            for s in shape:
-                f.write(_struct.pack("<q", s))
-            f.write(_struct.pack("<q", len(db)))
-            f.write(db)
+        f.write(params_io.save_bytes(wire, named=named))
 
 
 def load(fname):
-    """Load arrays saved by :func:`save` (reference nd.load)."""
+    """Load arrays saved by the reference runtime or by :func:`save`
+    (reference nd.load); also reads the round-1 MXTPU001 container."""
+    from . import params_io
+    from .sparse import RowSparseNDArray, CSRNDArray
+    with open(fname, "rb") as f:
+        raw = f.read()
+    if raw[:8] != _MAGIC:
+        arrays, names = params_io.load_bytes(raw)
+        wrapped = []
+        for values, stype, aux, shape in arrays:
+            if values is None:
+                wrapped.append(None)
+            elif stype == 1:
+                wrapped.append(RowSparseNDArray(
+                    _jnp.asarray(values), _onp.asarray(aux[0]), shape))
+            elif stype == 2:
+                wrapped.append(CSRNDArray(
+                    _jnp.asarray(values), _onp.asarray(aux[1]),
+                    _onp.asarray(aux[0]), shape))
+            else:
+                wrapped.append(NDArray(_jnp.asarray(values)))
+        if names:
+            return dict(zip(names, wrapped))
+        return wrapped
+    # ---- legacy MXTPU001 container -------------------------------------
     with open(fname, "rb") as f:
         magic = f.read(8)
-        if magic != _MAGIC:
-            raise ValueError(f"{fname}: not a {_MAGIC.decode()} file")
         n = _struct.unpack("<q", f.read(8))[0]
         out = {}
         keyed = True

@@ -1,0 +1,189 @@
+"""Reference-compatible ``.params`` serialization (wire format of
+``NDArray::Save/Load``, reference src/ndarray/ndarray.cc:1679-1924).
+
+Layout (all little-endian):
+
+  file      := uint64 0x112 (kMXAPINDArrayListMagic) | uint64 reserved=0
+             | uint64 n_arrays | ndarray*  | uint64 n_keys
+             | (uint64 len | utf8 bytes)*                 [dmlc::Stream]
+  ndarray   := uint32 magic | payload
+    magic 0xF993fac9 (V2) / 0xF993faca (V3, np-shape):
+      int32 stype | [sparse: tshape storage_shape] | tshape shape
+      | int32 dev_type | int32 dev_id | int32 type_flag
+      | [sparse: (int32 aux_type | tshape aux_shape) * nad]
+      | raw data | [sparse: raw aux data * nad]
+    magic 0xF993fac8 (V1): tshape shape | ctx | int32 type_flag | raw
+    other magic = ndim (legacy): uint32 dims[ndim] | ctx | int32 type_flag | raw
+  tshape    := int32 ndim | int64 dims[ndim]              [mxnet tuple.h:731]
+  ctx       := int32 dev_type | int32 dev_id              [mxnet base.h:145]
+
+Storage types (ndarray.h:61): 0 dense, 1 row_sparse (1 aux: indices),
+2 csr (2 aux: indptr, indices).  Type flags (mshadow base.h:329): 0 f32,
+1 f64, 2 f16, 3 u8, 4 i32, 5 i8, 6 i64, 7 bool, 12 bf16.
+
+Writing emits V2 dense/row_sparse/csr records, so checkpoints produced
+here load in the reference runtime and vice versa — the
+backwards-compatibility axis of SURVEY.md §5.4 (the reference's own
+model_backwards_compatibility_check relies on this format being stable).
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as onp
+import ml_dtypes
+
+LIST_MAGIC = 0x112
+V1_MAGIC = 0xF993FAC8
+V2_MAGIC = 0xF993FAC9
+V3_MAGIC = 0xF993FACA
+
+# mshadow type_flag <-> numpy dtype
+_FLAG2DT = {
+    0: onp.dtype("float32"), 1: onp.dtype("float64"),
+    2: onp.dtype("float16"), 3: onp.dtype("uint8"),
+    4: onp.dtype("int32"), 5: onp.dtype("int8"), 6: onp.dtype("int64"),
+    7: onp.dtype(bool), 12: onp.dtype(ml_dtypes.bfloat16),
+}
+_DT2FLAG = {v: k for k, v in _FLAG2DT.items()}
+
+_STYPE_NAUX = {0: 0, 1: 1, 2: 2}  # dense, row_sparse, csr
+
+
+class _Reader:
+    def __init__(self, buf):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, n):
+        if self.pos + n > len(self.buf):
+            raise ValueError("truncated .params stream")
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def u32(self):
+        return struct.unpack("<I", self.read(4))[0]
+
+    def i32(self):
+        return struct.unpack("<i", self.read(4))[0]
+
+    def u64(self):
+        return struct.unpack("<Q", self.read(8))[0]
+
+    def tshape(self):
+        ndim = self.i32()
+        if ndim < 0:  # unknown shape (np semantics)
+            return None
+        return tuple(struct.unpack(f"<{ndim}q", self.read(8 * ndim)))
+
+
+def _read_ndarray(r: _Reader):
+    """One NDArray record → (values, stype, aux_list, logical_shape).
+
+    For dense records values.shape == logical_shape; for sparse records
+    values holds the storage buffer and logical_shape the dense shape.
+    """
+    magic = r.u32()
+    if magic in (V2_MAGIC, V3_MAGIC):
+        stype = r.i32()
+        nad = _STYPE_NAUX.get(stype)
+        if nad is None:
+            raise ValueError(f"unknown storage type {stype}")
+        sshape = r.tshape() if nad else None
+        shape = r.tshape()
+        if shape is None or len(shape) == 0:
+            return None, 0, [], ()
+        r.i32(), r.i32()  # context: dev_type, dev_id (placement ignored)
+        flag = r.i32()
+        aux_meta = [(r.i32(), r.tshape()) for _ in range(nad)]
+        dshape = sshape if nad else shape
+        dt = _FLAG2DT[flag]
+        n = int(onp.prod(dshape)) if dshape else 1
+        data = onp.frombuffer(r.read(n * dt.itemsize), dt).reshape(dshape)
+        aux = []
+        for aflag, ashape in aux_meta:
+            adt = _FLAG2DT[aflag]
+            an = int(onp.prod(ashape)) if ashape else 1
+            aux.append(onp.frombuffer(r.read(an * adt.itemsize),
+                                      adt).reshape(ashape))
+        return data, stype, aux, shape
+    if magic == V1_MAGIC:
+        shape = r.tshape()
+    else:  # oldest format: magic IS ndim, uint32 dims
+        ndim = magic
+        shape = tuple(struct.unpack(f"<{ndim}I", r.read(4 * ndim)))
+    if not shape:
+        return None, 0, [], ()
+    r.i32(), r.i32()  # context
+    flag = r.i32()
+    dt = _FLAG2DT[flag]
+    n = int(onp.prod(shape))
+    data = onp.frombuffer(r.read(n * dt.itemsize), dt).reshape(shape)
+    return data, 0, [], shape
+
+
+def load_bytes(buf):
+    """Parse a reference .params byte string →
+    (list of (values, stype, aux, shape), list of names)."""
+    r = _Reader(buf)
+    header = r.u64()
+    if header != LIST_MAGIC:
+        raise ValueError(f"bad .params header {header:#x}")
+    r.u64()  # reserved
+    n = r.u64()
+    arrays = [_read_ndarray(r) for _ in range(n)]
+    nk = r.u64()
+    names = []
+    for _ in range(nk):
+        ln = r.u64()
+        names.append(r.read(ln).decode())
+    return arrays, names
+
+
+def _write_tshape(out, shape):
+    out.append(struct.pack("<i", len(shape)))
+    if shape:
+        out.append(struct.pack(f"<{len(shape)}q", *shape))
+
+
+def save_bytes(items, named=True):
+    """items: list of (name, numpy | (values, logical_shape, stype, aux)).
+
+    Returns the reference-format byte string.  ``named=False`` writes an
+    empty key table (the reference's unnamed-list save)."""
+    out = [struct.pack("<QQQ", LIST_MAGIC, 0, len(items))]
+    for _, val in items:
+        if isinstance(val, tuple):
+            values, shape, stype, aux = val
+            # sparse record: storage_shape first, then logical shape
+            out.append(struct.pack("<I", V2_MAGIC))
+            out.append(struct.pack("<i", stype))
+            _write_tshape(out, values.shape)   # storage shape
+            _write_tshape(out, shape)          # logical shape
+            out.append(struct.pack("<ii", 1, 0))
+            out.append(struct.pack("<i", _DT2FLAG[onp.dtype(values.dtype)]))
+            for a in aux:
+                out.append(struct.pack("<i", _DT2FLAG[onp.dtype(a.dtype)]))
+                _write_tshape(out, a.shape)
+            out.append(onp.ascontiguousarray(values).tobytes())
+            for a in aux:
+                out.append(onp.ascontiguousarray(a).tobytes())
+        else:
+            values = onp.asarray(val)
+            out.append(struct.pack("<I", V2_MAGIC))
+            out.append(struct.pack("<i", 0))
+            _write_tshape(out, values.shape)
+            out.append(struct.pack("<ii", 1, 0))
+            out.append(struct.pack("<i", _DT2FLAG[onp.dtype(values.dtype)]))
+            out.append(onp.ascontiguousarray(values).tobytes())
+    if not named:
+        out.append(struct.pack("<Q", 0))
+    else:
+        names = [name for name, _ in items]
+        out.append(struct.pack("<Q", len(names)))
+        for name in names:
+            nb = name.encode()
+            out.append(struct.pack("<Q", len(nb)))
+            out.append(nb)
+    return b"".join(out)

@@ -1,0 +1,115 @@
+"""Generate a reference-format MXNet checkpoint fixture.
+
+Writes refmlp-symbol.json + refmlp-0000.params byte-for-byte in the
+reference's on-disk formats, using ONLY the stdlib (no framework code)
+— the .params layout follows src/ndarray/ndarray.cc:1679-1924 /
+include/mxnet/tuple.h:731 / include/mxnet/base.h:145, and the symbol
+JSON follows the nnvm graph JSON the reference's model.save_checkpoint
+emits (python/mxnet/model.py:189).  Regenerate with:
+
+    python tests/fixtures/make_ref_fixture.py
+"""
+import json
+import os
+import struct
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PREFIX = os.path.join(HERE, "refmlp")
+
+
+def tshape(shape):
+    return struct.pack("<i", len(shape)) + \
+        struct.pack(f"<{len(shape)}q", *shape)
+
+
+def dense_record(arr):
+    out = struct.pack("<I", 0xF993FAC9)       # NDARRAY_V2_MAGIC
+    out += struct.pack("<i", 0)               # kDefaultStorage
+    out += tshape(arr.shape)
+    out += struct.pack("<ii", 1, 0)           # Context: kCPU, dev 0
+    flag = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+            "int32": 4, "int8": 5, "int64": 6}[arr.dtype.name]
+    out += struct.pack("<i", flag)
+    out += arr.tobytes()
+    return out
+
+
+def row_sparse_record(values, indices, dense_shape):
+    out = struct.pack("<I", 0xF993FAC9)
+    out += struct.pack("<i", 1)               # kRowSparseStorage
+    out += tshape(values.shape)               # storage shape
+    out += tshape(dense_shape)                # logical shape
+    out += struct.pack("<ii", 1, 0)
+    out += struct.pack("<i", 0)               # values f32
+    out += struct.pack("<i", 6)               # aux idx int64
+    out += tshape(indices.shape)
+    out += values.tobytes()
+    out += indices.tobytes()
+    return out
+
+
+def main():
+    rng = np.random.RandomState(42)
+    w1 = rng.randn(16, 8).astype(np.float32)
+    b1 = rng.randn(16).astype(np.float32)
+    w2 = rng.randn(4, 16).astype(np.float32)
+    b2 = rng.randn(4).astype(np.float32)
+    emb = rng.randn(6, 8).astype(np.float32)   # row_sparse-stored weight
+    emb_rows = np.array([0, 2, 5], dtype=np.int64)
+
+    items = [
+        ("arg:fc1_weight", dense_record(w1)),
+        ("arg:fc1_bias", dense_record(b1)),
+        ("arg:fc2_weight", dense_record(w2)),
+        ("arg:fc2_bias", dense_record(b2)),
+        ("arg:embed_weight",
+         row_sparse_record(emb[emb_rows], emb_rows, emb.shape)),
+    ]
+    buf = struct.pack("<QQ", 0x112, 0)        # kMXAPINDArrayListMagic
+    buf += struct.pack("<Q", len(items))
+    for _, rec in items:
+        buf += rec
+    buf += struct.pack("<Q", len(items))
+    for name, _ in items:
+        nb = name.encode()
+        buf += struct.pack("<Q", len(nb)) + nb
+    with open(PREFIX + "-0000.params", "wb") as f:
+        f.write(buf)
+    np.savez(PREFIX + "-expected.npz", fc1_weight=w1, fc1_bias=b1,
+             fc2_weight=w2, fc2_bias=b2, embed_weight_vals=emb[emb_rows],
+             embed_weight_rows=emb_rows)
+
+    # nnvm graph JSON exactly as the reference serializes an MLP
+    graph = {
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "null", "name": "fc1_weight", "attrs":
+                {"__dtype__": "0"}, "inputs": []},
+            {"op": "null", "name": "fc1_bias", "inputs": []},
+            {"op": "FullyConnected", "name": "fc1",
+             "attrs": {"num_hidden": "16"},
+             "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+            {"op": "Activation", "name": "relu1",
+             "attrs": {"act_type": "relu"}, "inputs": [[3, 0, 0]]},
+            {"op": "null", "name": "fc2_weight", "inputs": []},
+            {"op": "null", "name": "fc2_bias", "inputs": []},
+            {"op": "FullyConnected", "name": "fc2",
+             "attrs": {"num_hidden": "4", "no_bias": "False"},
+             "inputs": [[4, 0, 0], [5, 0, 0], [6, 0, 0]]},
+            {"op": "softmax", "name": "out", "attrs": {"axis": "-1"},
+             "inputs": [[7, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2, 5, 6],
+        "node_row_ptr": list(range(10)),
+        "heads": [[8, 0, 0]],
+        "attrs": {"mxnet_version": ["int", 10600]},
+    }
+    with open(PREFIX + "-symbol.json", "w") as f:
+        json.dump(graph, f, indent=2)
+    print("wrote", PREFIX + "-{symbol.json,0000.params,expected.npz}")
+
+
+if __name__ == "__main__":
+    main()
