@@ -25,6 +25,31 @@ Typical use::
 
 __version__ = "0.1.0"
 
+
+def _join_distributed_from_env():
+    """Join the multi-process coordination service when launched by
+    tools/launch.py (MXT_COORDINATOR / MXT_NUM_WORKERS / MXT_WORKER_ID —
+    the role the ps-lite scheduler env plays for ``import mxnet`` in the
+    reference).  Must run before ANY jax backend touch, hence at the top
+    of the package import; PS-transport workers (MXT_SERVERS set) don't
+    need a jax-level process group.
+    """
+    import os
+    n = int(os.environ.get("MXT_NUM_WORKERS", "1"))
+    coord = os.environ.get("MXT_COORDINATOR")
+    if n <= 1 or not coord or os.environ.get("MXT_SERVERS"):
+        return
+    import jax
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=n,
+            process_id=int(os.environ["MXT_WORKER_ID"]))
+    except RuntimeError:
+        pass  # backend already up (user initialized it themselves)
+
+
+_join_distributed_from_env()
+
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus

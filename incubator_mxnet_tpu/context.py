@@ -19,9 +19,16 @@ __all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_t
 _context_stack = threading.local()
 
 
+def _local(devs):
+    """Only this process's devices: in multi-controller mode
+    (jax.distributed) an array must live on an addressable device."""
+    mine = [d for d in devs if d.process_index == jax.process_index()]
+    return mine or list(devs)
+
+
 def _devices_for(platform: str):
     try:
-        return jax.devices(platform)
+        return _local(jax.devices(platform))
     except RuntimeError:
         return []
 
@@ -83,11 +90,11 @@ class Context:
             # Some TPU-attached platforms register under a different name
             # (e.g. the experimental 'axon' tunnel); jax.devices() returns
             # the accelerator first.
-            default = jax.devices()
+            default = _local(jax.devices())
             if default and default[0].platform != "cpu":
                 devs = default
         if not devs:
-            devs = jax.devices()
+            devs = _local(jax.devices())
         return devs[self.device_id % len(devs)]
 
     def empty_cache(self):

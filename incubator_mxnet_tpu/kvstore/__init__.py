@@ -8,5 +8,7 @@ python/mxnet/kvstore/base.py:74-220) is preserved as the extension
 point (Horovod/BytePS adapters plugged in there).
 """
 from .base import KVStoreBase, register, create
-from .kvstore import KVStore, LocalKVStore, DeviceKVStore, DistKVStore
+from .kvstore import (KVStore, LocalKVStore, DeviceKVStore, DistKVStore,
+                      DistAsyncKVStore, P3KVStore)
+from .horovod import HorovodKVStore, BytePSKVStore
 from .gradient_compression import GradientCompression

@@ -8,14 +8,17 @@ comm.h:104-741), ``KVStoreNCCL``, ``KVStoreDist`` over ps-lite
 * local/device/nccl → single-controller reduce: values living on
   process-local devices are summed (XLA all-reduce over ICI when the
   arrays are sharded over a mesh; jnp adds otherwise).
-* dist_sync/dist_device_sync → multi-process psum via
-  ``jax.make_array_from_process_local_data`` + jit-compiled global sum
-  when ``jax.distributed`` is initialized; degenerates to local in a
+* dist_sync/dist_device_sync → device-side XLA all-reduce across
+  processes (jit over a process-spanning mesh) when launched via
+  tools/launch.py collectives mode, or push/pull against PSServer
+  processes when servers were requested; degenerates to local in a
   single process so launch scripts run unchanged.
-* dist_async / p3 — the reference's parameter-server behaviors; served
-  by the same sync collective with server-side-optimizer support on the
-  store (set_optimizer + update-on-push), async semantics documented as
-  sync-on-TPU (SPMD has no stragglers to hide).
+* dist_async → real parameter-server processes (kvstore/ps_server.py):
+  every push applied immediately server-side, no aggregation — the
+  reference's async semantics (kvstore_dist_server.h:349), not an alias.
+* p3 → priority-sliced dispatch (P3KVStore): tensors sliced at
+  MXNET_KVSTORE_SLICE_THRESHOLD and sent highest-priority-first by a
+  background sender (reference p3store_dist.h:40-85).
 """
 from __future__ import annotations
 
@@ -29,7 +32,8 @@ from .. import optimizer as opt_mod
 from .base import KVStoreBase, register
 from .gradient_compression import GradientCompression
 
-__all__ = ["KVStore", "LocalKVStore", "DeviceKVStore", "DistKVStore"]
+__all__ = ["KVStore", "LocalKVStore", "DeviceKVStore", "DistKVStore",
+           "DistAsyncKVStore", "P3KVStore"]
 
 
 class _BaseStore(KVStoreBase):
@@ -86,7 +90,7 @@ class _BaseStore(KVStoreBase):
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys = key if isinstance(key, (list, tuple)) else [key]
-        outs = out if isinstance(out, (list, tuple)) else [out]
+        outs = out if isinstance(out, (list, tuple)) else [out] * len(keys)
         results = []
         for k, o in zip(keys, outs):
             val = self._store[k]
@@ -158,41 +162,335 @@ class DeviceKVStore(_BaseStore):
     OPT_TYPES = ["device", "nccl", "local_allreduce_device"]
 
 
+def _maybe_init_jax_distributed():
+    """Join the coordination service from launcher env.  The real join
+    happens at package import (incubator_mxnet_tpu._join_distributed_
+    from_env — jax requires it before any backend touch); this is a
+    late-import safety net for embedders that set the env after import.
+    """
+    from .. import _join_distributed_from_env
+    _join_distributed_from_env()
+
+
+def _ps_clients():
+    """Connect to launcher-spawned parameter servers, if any."""
+    import os
+    servers = os.environ.get("MXT_SERVERS", "")
+    if not servers:
+        return []
+    from .ps_server import PSClient
+    out = []
+    for hp in servers.split(","):
+        host, _, port = hp.partition(":")
+        out.append(PSClient(host, int(port)))
+    return out
+
+
 @register
 class DistKVStore(_BaseStore):
-    """Multi-process synchronous store (reference 'dist_sync' family).
+    """Multi-process synchronous store (reference 'dist_sync' family,
+    kvstore_dist.h:218 PushPullImpl + kvstore_dist_server.h sync mode).
 
-    When ``jax.distributed`` has been initialized (multi-host), the sync
-    step all-reduces across processes over DCN/ICI; in a single process
-    it is the identity so dist launch scripts degrade gracefully.
+    Two transports, chosen by the launcher env:
+
+    * **collective** (no ``-s`` servers): gradients all-reduce across
+      processes as a device-side XLA collective — the local summed shard
+      becomes one row of a process-spanning global array and a jitted
+      replicated-output sum lowers to an all-reduce over DCN/ICI
+      (strictly device-side, unlike a host allgather).
+    * **parameter server** (``-s N``): push/pull go to the PSServer
+      processes with keys sharded over servers by hash — the reference's
+      EncodeDefaultKey sharding (kvstore_dist.h:58).
     """
 
-    OPT_TYPES = ["dist_sync", "dist_device_sync", "dist_async", "dist",
-                 "p3", "dist_sync_device", "horovod", "byteps"]
+    OPT_TYPES = ["dist_sync", "dist_device_sync", "dist",
+                 "dist_sync_device"]
+    _PS_MODE = "sync"
 
     def __init__(self):
         super().__init__()
+        _maybe_init_jax_distributed()
         self._nprocs = jax.process_count()
         self._rank = jax.process_index()
+        self._clients = _ps_clients()
+        import os
+        if self._clients and os.environ.get("MXT_KV_MODE",
+                                            self._PS_MODE) != self._PS_MODE:
+            raise RuntimeError(
+                f"launcher started servers in mode "
+                f"{os.environ['MXT_KV_MODE']!r} but this store is "
+                f"{self._PS_MODE!r}; pass --kv-mode {self._PS_MODE}")
+        self._psum_cache: dict = {}
+        import os as _os
+        self._nworkers_env = int(_os.environ.get("MXT_NUM_WORKERS",
+                                                 self._nprocs))
 
     @property
     def rank(self):
-        return self._rank
+        import os
+        return int(os.environ.get("MXT_WORKER_ID", self._rank))
 
     @property
     def num_workers(self):
-        return self._nprocs
+        return max(self._nprocs, self._nworkers_env)
 
+    # -- PS transport -----------------------------------------------------
+    def _server_for(self, key):
+        # stable across processes (Python hash() is per-process salted);
+        # reference: EncodeDefaultKey (kvstore_dist.h:58)
+        import zlib
+        return self._clients[zlib.crc32(str(key).encode())
+                             % len(self._clients)]
+
+    def init(self, key, value):
+        if not self._clients:
+            return super().init(key, value)
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        values = value if isinstance(value, (list, tuple)) else [value]
+        for k, v in zip(keys, values):
+            if self.rank == 0:
+                self._server_for(k).call("init", k, _onp_of(v))
+        self.barrier()
+
+    def push(self, key, value, priority=0):
+        if not self._clients:
+            return super().push(key, value, priority)
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        values = value if isinstance(key, (list, tuple)) else [value]
+        for k, v in zip(keys, values):
+            summed = self._reduce(v)
+            if self._compression is not None:
+                summed = self._compression.compress_decompress(summed,
+                                                               key=k)
+            self._server_for(k).call("push", k, _onp_of(summed))
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if not self._clients:
+            return super().pull(key, out=out, priority=priority)
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        outs = out if isinstance(out, (list, tuple)) else [out] * len(keys)
+        results = []
+        for k, o in zip(keys, outs):
+            val = NDArray(jnp.asarray(self._server_for(k).call("pull", k)))
+            if o is not None:
+                targets = o if isinstance(o, (list, tuple)) else [o]
+                for t in targets:
+                    t._set_data(val.data)
+                results.append(o)
+            else:
+                results.append(val)
+        if out is not None:
+            return out
+        return results if isinstance(key, (list, tuple)) else results[0]
+
+    def set_optimizer(self, optimizer):
+        if not self._clients:
+            return super().set_optimizer(optimizer)
+        # serialize to every server (reference kv.set_optimizer →
+        # SendCommandToServers kvstore_dist.h:90)
+        for c in self._clients:
+            c.call("set_optimizer", None, pickle.dumps(optimizer))
+
+    # -- collective transport ---------------------------------------------
     def _sync(self, summed):
-        if self._nprocs <= 1:
+        if self._nprocs <= 1 or self._clients:
             return summed
-        from jax.experimental import multihost_utils
-        return multihost_utils.process_allgather(summed).sum(axis=0)
+        import numpy as onp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        devs = onp.asarray(jax.devices()).reshape(self._nprocs, -1)[:, 0]
+        mesh = Mesh(devs, ("proc",))
+        sharding = NamedSharding(mesh, P("proc"))
+        local = onp.asarray(summed)[None]
+        garr = jax.make_array_from_process_local_data(
+            sharding, local, (self._nprocs,) + local.shape[1:])
+        fn = self._psum_cache.get("fn")
+        if fn is None:
+            fn = jax.jit(lambda x: jnp.sum(x, axis=0),
+                         out_shardings=NamedSharding(mesh, P()))
+            self._psum_cache["fn"] = fn
+        out = fn(garr)
+        return jnp.asarray(out.addressable_data(0))
 
     def barrier(self):
+        if self._clients:
+            self._server_for("__barrier__").call("barrier")
+            return
         if self._nprocs > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("kvstore_barrier")
+
+
+def _onp_of(v):
+    import numpy as onp
+    if isinstance(v, NDArray):
+        return onp.asarray(v.data)
+    return onp.asarray(v)
+
+
+@register
+class DistAsyncKVStore(DistKVStore):
+    """Asynchronous parameter-server store (reference 'dist_async',
+    kvstore_dist_server.h:349 else-branch: apply every push immediately,
+    no aggregation, workers race).
+
+    Requires PS transport (launch with ``-s N --kv-mode async``); in a
+    single process without servers it degrades to immediate local apply,
+    which is exactly async semantics with one worker.
+    """
+
+    OPT_TYPES = ["dist_async"]
+    _PS_MODE = "async"
+
+    def _sync(self, summed):
+        # async never aggregates across workers
+        return summed
+
+
+@register
+class P3KVStore(DistKVStore):
+    """Priority-sliced parameter propagation (reference 'p3',
+    p3store_dist.h:40-85).
+
+    Large tensors are sliced into ``MXNET_KVSTORE_SLICE_THRESHOLD``-
+    element chunks; slices are dispatched highest-priority-first by a
+    background sender so small, high-priority (late-layer-first
+    backward order: priority = -key, trainer.py:390) tensors overtake
+    bulk traffic — the reference's scheduling gain, reproduced at the
+    transport layer.
+    """
+
+    OPT_TYPES = ["p3", "dist_sync_p3"]
+
+    def __init__(self):
+        super().__init__()
+        import os
+        import queue
+        import threading
+        self._slice = int(os.environ.get("MXNET_KVSTORE_SLICE_THRESHOLD",
+                                         "40000"))
+        self._q: "queue.PriorityQueue" = queue.PriorityQueue()
+        self._pending: dict = {}
+        self._seq = 0
+        self._cv = threading.Condition()
+        self._gate = threading.Event()
+        self._gate.set()           # tests clear this to stage a backlog
+        self._sender = threading.Thread(target=self._drain, daemon=True)
+        self._sender.start()
+        self.send_log: list = []   # (key, slice_idx) in wire order; for tests
+
+    def _drain(self):
+        while True:
+            _prio, _seq, item = self._q.get()
+            if item is None:
+                return
+            self._gate.wait()
+            key, idx, chunk = item
+            try:
+                self._push_slice(key, idx, chunk)
+                err = None
+            except Exception as e:  # surface on the next pull
+                err = e
+            with self._cv:
+                if err is not None:
+                    self._sender_error = err
+                self._pending[key] -= 1
+                if self._pending[key] == 0:
+                    self._cv.notify_all()
+
+    _SEND_LOG_CAP = 4096  # diagnostics ring; not a full history
+
+    def _push_slice(self, key, idx, chunk):
+        if len(self.send_log) >= self._SEND_LOG_CAP:
+            del self.send_log[:self._SEND_LOG_CAP // 2]
+        self.send_log.append((key, idx))
+        skey = f"{key}#({idx})"
+        summed = self._sync(chunk)
+        if self._updater is not None and skey in self._store:
+            self._updater(hash(skey), NDArray(summed), self._store[skey])
+        elif self._clients:
+            self._server_for(skey).call("push", skey, _onp_of(summed))
+        else:
+            self._store[skey] = NDArray(jnp.asarray(summed))
+
+    def _slices(self, flat):
+        n = flat.shape[0]
+        return [(i // self._slice, flat[i:i + self._slice])
+                for i in range(0, n, self._slice)]
+
+    def init(self, key, value):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        values = value if isinstance(value, (list, tuple)) else [value]
+        for k, v in zip(keys, values):
+            flat = v.data.reshape(-1)
+            self._shapes = getattr(self, "_shapes", {})
+            self._shapes[k] = v.shape
+            for idx, chunk in self._slices(flat):
+                skey = f"{k}#({idx})"
+                if self._clients:
+                    if self.rank == 0:
+                        self._server_for(skey).call("init", skey,
+                                                    _onp_of(chunk))
+                else:
+                    self._store[skey] = NDArray(chunk + 0)
+        if self._clients:
+            self.barrier()
+
+    def push(self, key, value, priority=0):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        values = value if isinstance(key, (list, tuple)) else [value]
+        for k, v in zip(keys, values):
+            summed = self._reduce(v)
+            flat = summed.reshape(-1)
+            chunks = self._slices(flat)
+            with self._cv:
+                self._pending[k] = self._pending.get(k, 0) + len(chunks)
+            for idx, chunk in chunks:
+                self._seq += 1
+                # PriorityQueue pops smallest: negate so HIGH priority
+                # (reference: priority = -key, higher = sooner) pops first
+                self._q.put((-priority, self._seq, (k, idx, chunk)))
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        outs = out if isinstance(out, (list, tuple)) else [out] * len(keys)
+        results = []
+        for k, o in zip(keys, outs):
+            with self._cv:
+                flushed = self._cv.wait_for(
+                    lambda: self._pending.get(k, 0) == 0, timeout=60)
+                err = getattr(self, "_sender_error", None)
+            if err is not None:
+                raise RuntimeError(
+                    f"p3 background sender failed: {err}") from err
+            if not flushed:
+                raise TimeoutError(
+                    f"p3 pull: pushes for key {k!r} not flushed in 60s")
+            shape = self._shapes[k]
+            parts = []
+            idx = 0
+            total = 1
+            for s in shape:
+                total *= s
+            while idx * self._slice < total:
+                skey = f"{k}#({idx})"
+                if self._clients:
+                    parts.append(jnp.asarray(
+                        self._server_for(skey).call("pull", skey)))
+                else:
+                    parts.append(self._store[skey].data)
+                idx += 1
+            val = NDArray(jnp.concatenate(parts).reshape(shape)
+                          if len(parts) > 1 else parts[0].reshape(shape))
+            if o is not None:
+                targets = o if isinstance(o, (list, tuple)) else [o]
+                for t in targets:
+                    t._set_data(val.data)
+                results.append(o)
+            else:
+                results.append(val)
+        if out is not None:
+            return out
+        return results if isinstance(key, (list, tuple)) else results[0]
 
 
 class KVStore(_BaseStore):

@@ -1,0 +1,216 @@
+"""Parameter-server process: the dist_async / dist_sync server role.
+
+TPU-native re-design of the reference's ps-lite server
+(src/kvstore/kvstore_dist_server.h:155-359): a standalone process
+holding the authoritative weights, applying updates as workers push.
+
+* ``sync`` mode — aggregates exactly ``num_workers`` pushes per key,
+  then applies the merged gradient once (server optimizer if set, else
+  plain accumulate); pulls for that key block until the round completes
+  (DataHandleDefault + ApplyUpdates semantics,
+  kvstore_dist_server.h:325-359).
+* ``async`` mode — every push is applied immediately and independently;
+  no aggregation, no round barrier: workers race exactly like the
+  reference's async mode (DataHandleDefault else-branch :349).
+
+Transport is a length-prefixed pickle protocol over TCP on localhost /
+DCN — the role ps-lite's ZMQ Van plays (SURVEY.md §5.8), chosen over
+gRPC to keep the runtime dependency-free.  The server is pure
+CPU/numpy: it never touches an accelerator, mirroring the reference
+where servers are CPU processes.
+
+Wire protocol: request = (cmd, key, payload); response = (ok, payload).
+Commands: init, push, pull, set_optimizer, barrier, num_done, stop.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as onp
+
+__all__ = ["PSServer", "PSClient", "serve_forever"]
+
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class _State:
+    """Server-side store + sync-round bookkeeping."""
+
+    def __init__(self, mode, num_workers):
+        self.mode = mode
+        self.num_workers = num_workers
+        self.store: dict = {}
+        self.merge: dict = {}           # key -> (accum, count) for sync
+        self.round_done: dict = {}      # key -> round counter
+        self.updater = None
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.barrier_count = 0
+        self.barrier_gen = 0
+
+    def apply_update(self, key, grad):
+        if self.updater is not None:
+            w = self.store[key]
+            self.updater(key, grad, w)   # in-place numpy update
+        elif self.mode == "async":
+            # reference: "Updater needs to be set for async mode"
+            # (kvstore_dist_server.h:360 CHECK)
+            raise RuntimeError(
+                "async parameter server requires a server-side optimizer: "
+                "call kv.set_optimizer(...) before pushing")
+        else:
+            # sync without updater: the stored value becomes the merged
+            # push (kvstore_dist_server.h:362 CopyFromTo)
+            self.store[key] = onp.array(grad)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        st: _State = self.server.state  # type: ignore[attr-defined]
+        sock = self.request
+        try:
+            while True:
+                cmd, key, payload = _recv_msg(sock)
+                if cmd == "stop":
+                    _send_msg(sock, (True, None))
+                    threading.Thread(
+                        target=self.server.shutdown, daemon=True).start()
+                    return
+                try:
+                    resp = self._dispatch(st, cmd, key, payload)
+                except Exception as e:  # surfaced client-side as an error
+                    resp = (False, str(e))
+                _send_msg(sock, resp)
+        except (ConnectionError, OSError):
+            return
+
+    @staticmethod
+    def _dispatch(st: _State, cmd, key, payload):
+        if cmd == "init":
+            with st.lock:
+                if key not in st.store:
+                    st.store[key] = onp.array(payload)
+                    st.round_done[key] = 0
+            return True, None
+        if cmd == "push":
+            if st.mode == "async":
+                # reference async: apply immediately, no aggregation
+                with st.lock:
+                    st.apply_update(key, payload)
+                return True, None
+            with st.cv:
+                acc, cnt = st.merge.get(key, (None, 0))
+                acc = payload if acc is None else acc + payload
+                cnt += 1
+                if cnt >= st.num_workers:
+                    st.apply_update(key, acc)
+                    st.merge[key] = (None, 0)
+                    st.round_done[key] += 1
+                    st.cv.notify_all()
+                else:
+                    st.merge[key] = (acc, cnt)
+            return True, None
+        if cmd == "pull":
+            if st.mode == "async":
+                with st.lock:
+                    return True, onp.array(st.store[key])
+            # sync: wait until no partial round is in flight for key
+            with st.cv:
+                st.cv.wait_for(
+                    lambda: st.merge.get(key, (None, 0))[1] == 0)
+                return True, onp.array(st.store[key])
+        if cmd == "set_optimizer":
+            from .. import optimizer as opt_mod
+            opt = pickle.loads(payload)
+
+            updater = opt_mod.get_updater(opt)
+
+            def np_updater(k, g, w):
+                from ..ndarray import NDArray
+                import jax.numpy as jnp
+                wn = NDArray(jnp.asarray(w))
+                updater(k, NDArray(jnp.asarray(g)), wn)
+                st.store[k] = onp.asarray(wn.data)
+
+            with st.lock:
+                st.updater = np_updater
+            return True, None
+        if cmd == "barrier":
+            with st.cv:
+                gen = st.barrier_gen
+                st.barrier_count += 1
+                if st.barrier_count >= st.num_workers:
+                    st.barrier_count = 0
+                    st.barrier_gen += 1
+                    st.cv.notify_all()
+                else:
+                    st.cv.wait_for(lambda: st.barrier_gen > gen)
+            return True, None
+        return False, f"unknown command {cmd!r}"
+
+
+class PSServer(socketserver.ThreadingTCPServer):
+    """Threaded TCP parameter server (one per reference 'server' role)."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr=("127.0.0.1", 0), mode="sync", num_workers=1):
+        super().__init__(addr, _Handler)
+        self.state = _State(mode, num_workers)
+
+    @property
+    def port(self):
+        return self.server_address[1]
+
+
+def serve_forever(port, mode, num_workers):
+    """Entry point used by tools/launch.py server roles."""
+    srv = PSServer(("127.0.0.1", port), mode=mode, num_workers=num_workers)
+    srv.serve_forever()
+
+
+class PSClient:
+    """Worker-side connection to a PSServer (the KVWorker role)."""
+
+    def __init__(self, host, port):
+        self.sock = socket.create_connection((host, port), timeout=60)
+        self.lock = threading.Lock()
+
+    def call(self, cmd, key=None, payload=None):
+        with self.lock:
+            _send_msg(self.sock, (cmd, key, payload))
+            ok, out = _recv_msg(self.sock)
+        if not ok:
+            raise RuntimeError(f"ps server error: {out}")
+        return out
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,121 @@
+"""Gradient compression on a real collective (VERDICT r2 task #10).
+
+Asserts (a) the cross-rank traffic is genuinely uint8 2-bit-packed
+codes, (b) the quantize→gather→dequantize algebra matches a hand
+computation, (c) error feedback makes compressed data-parallel SGD
+converge on a toy model over the 8-device mesh, (d) the measured wire
+bytes are 16× below fp32.
+"""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from incubator_mxnet_tpu.parallel.mesh import make_mesh
+from incubator_mxnet_tpu.kvstore.gradient_compression import (
+    GradientCompression, make_compressed_allreduce,
+    make_compressed_dp_train_step, _quantize_2bit, _dequantize_2bit)
+
+
+def setup_module():
+    assert jax.device_count() >= 8
+
+
+def test_pack_unpack_roundtrip():
+    x = jnp.asarray([0.7, -0.9, 0.1, -0.2, 0.5, 0.0, -0.5], jnp.float32)
+    packed = _quantize_2bit(x, 0.5)
+    assert packed.dtype == jnp.uint8
+    assert packed.shape[0] == 2           # ceil(7/4) bytes
+    back = _dequantize_2bit(packed, 7, 0.5, jnp.float32)
+    onp.testing.assert_array_equal(
+        onp.asarray(back), [0.5, -0.5, 0.0, 0.0, 0.5, 0.0, -0.5])
+
+
+def test_wire_dtype_is_uint8():
+    mesh = make_mesh(dp=8)
+    fn = make_compressed_allreduce(mesh, threshold=0.5)
+    grads = {"w": jnp.zeros((8, 4, 4), jnp.float32)}   # stacked per-rank
+    res = {"w": jnp.zeros((8, 4, 4), jnp.float32)}
+    jaxpr = str(jax.make_jaxpr(fn)(grads, res))
+    # the only collective result is the packed uint8 code buffer
+    gathers = [l for l in jaxpr.splitlines() if "= all_gather" in l]
+    assert gathers and all("u8[" in l for l in gathers), gathers
+
+
+def test_compressed_allreduce_matches_manual():
+    mesh = make_mesh(dp=8)
+    rng = onp.random.RandomState(0)
+    per_rank = rng.randn(8, 6).astype(onp.float32)
+    grads = {"w": jnp.asarray(per_rank)}
+    res = {"w": jnp.zeros((8, 6), jnp.float32)}
+    fn = make_compressed_allreduce(mesh, threshold=0.5)
+    mean, new_res = fn(grads, res)
+    # manual: quantize each rank to {-.5, 0, .5}, average
+    q = onp.where(per_rank >= 0.5, 0.5,
+                  onp.where(per_rank <= -0.5, -0.5, 0.0))
+    onp.testing.assert_allclose(onp.asarray(mean["w"]), q.mean(axis=0),
+                                rtol=1e-6)
+    onp.testing.assert_allclose(onp.asarray(new_res["w"]), per_rank - q,
+                                rtol=1e-6)
+
+
+def test_error_feedback_accumulates():
+    mesh = make_mesh(dp=8)
+    fn = make_compressed_allreduce(mesh, threshold=0.5)
+    # constant small gradient 0.2 < threshold: first step quantizes to 0,
+    # residual builds until it crosses the threshold and fires
+    grads = {"w": jnp.full((8, 4), 0.2, jnp.float32)}
+    res = {"w": jnp.zeros((8, 4), jnp.float32)}
+    mean1, res1 = fn(grads, res)
+    assert float(jnp.abs(mean1["w"]).max()) == 0.0          # all dropped
+    onp.testing.assert_allclose(onp.asarray(res1["w"]), 0.2, rtol=1e-6)
+    mean2, res2 = fn(grads, res1)
+    assert float(jnp.abs(mean2["w"]).max()) == 0.0          # 0.4 < 0.5
+    mean3, res3 = fn(grads, res2)                           # 0.6 fires
+    onp.testing.assert_allclose(onp.asarray(mean3["w"]), 0.5, rtol=1e-6)
+    onp.testing.assert_allclose(onp.asarray(res3["w"]), 0.1, rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_compressed_dp_training_converges():
+    mesh = make_mesh(dp=8)
+    rng = onp.random.RandomState(1)
+    d = 4
+    w_true = rng.randn(d).astype(onp.float32)
+    X = rng.randn(64, d).astype(onp.float32)
+    y = X @ w_true
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean(jnp.square(pred - batch["y"]))
+
+    step = make_compressed_dp_train_step(loss_fn, mesh, lr=0.5,
+                                         threshold=0.1)
+    params = {"w": jnp.zeros((d,), jnp.float32)}
+    residuals = {"w": jnp.zeros((8, d), jnp.float32)}
+    batch = {"x": jnp.asarray(X), "y": jnp.asarray(y)}
+    first = None
+    for i in range(500):
+        params, residuals, loss = step(params, residuals, batch)
+        if first is None:
+            first = float(loss)
+    final = float(loss)
+    assert final < 0.01 * first, (first, final)
+    onp.testing.assert_allclose(onp.asarray(params["w"]), w_true,
+                                rtol=0.2, atol=0.1)
+
+
+def test_wire_bytes_reduction():
+    n = 1024
+    packed = _quantize_2bit(jnp.zeros((n,), jnp.float32), 0.5)
+    fp32_bytes = n * 4
+    wire_bytes = packed.size * packed.dtype.itemsize
+    assert wire_bytes * 16 == fp32_bytes
+
+
+def test_legacy_roundtrip_api_still_works():
+    gc = GradientCompression(type="2bit", threshold=0.5)
+    g = jnp.asarray([1.0, 0.2, -0.7], jnp.float32)
+    q = gc.compress_decompress(g, key="k")
+    onp.testing.assert_array_equal(onp.asarray(q), [0.5, 0.0, -0.5])

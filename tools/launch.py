@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Launch a distributed training job (reference tools/launch.py:29-80 CLI,
+dmlc_tracker local launcher semantics).
+
+TPU-native redesign: instead of the ps-lite scheduler + DMLC_* rendezvous,
+the local launcher
+  * spawns ``-s`` parameter-server processes (kvstore/ps_server.py) when
+    servers are requested (dist_async / PS-mode dist_sync), and
+  * spawns ``-n`` worker processes with the coordination env that
+    ``jax.distributed.initialize`` + DistKVStore consume:
+    MXT_COORDINATOR, MXT_NUM_WORKERS, MXT_WORKER_ID (DMLC_* aliases are
+    exported too so reference-era scripts keep working).
+
+Examples
+--------
+  # 2 workers, pure-collective dist_sync (jax.distributed over DCN/ICI)
+  python tools/launch.py -n 2 --launcher local python train.py
+
+  # 2 workers + 1 async parameter server
+  python tools/launch.py -n 2 -s 1 --kv-mode async --launcher local \
+      python train.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(args, extra_env=None):
+    """Spawn servers + workers on this host; returns worker exit codes."""
+    procs = []
+    env_base = dict(os.environ)
+    env_base.update(extra_env or {})
+
+    server_ports = []
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for i in range(args.num_servers):
+        port = _free_port()
+        server_ports.append(port)
+        env = dict(env_base)
+        env["DMLC_ROLE"] = "server"
+        env["JAX_PLATFORMS"] = "cpu"
+        # servers are CPU processes (reference: server role never owns a
+        # GPU); force the cpu backend BEFORE anything imports jax — the
+        # server-side optimizer path uses jnp and must not touch the
+        # accelerator plugin
+        code = (f"import sys; sys.path.insert(0, {repo_root!r}); "
+                f"import jax; jax.config.update('jax_platforms', 'cpu'); "
+                f"from incubator_mxnet_tpu.kvstore.ps_server import "
+                f"serve_forever; "
+                f"serve_forever({port}, {args.kv_mode!r}, {args.num_workers})")
+        procs.append(("server", subprocess.Popen(
+            [sys.executable, "-c", code], env=env)))
+
+    coordinator = f"127.0.0.1:{_free_port()}"
+    workers = []
+    for i in range(args.num_workers):
+        env = dict(env_base)
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_WORKER_ID": str(i),
+            "DMLC_NUM_SERVER": str(args.num_servers),
+            "MXT_COORDINATOR": coordinator,
+            "MXT_NUM_WORKERS": str(args.num_workers),
+            "MXT_WORKER_ID": str(i),
+            "MXT_SERVERS": ",".join(f"127.0.0.1:{p}" for p in server_ports),
+            "MXT_KV_MODE": args.kv_mode,
+        })
+        for kv in args.env_worker + args.env:
+            k, _, v = kv.partition(":")
+            env[k] = v
+        p = subprocess.Popen(args.command, env=env)
+        workers.append(p)
+        procs.append(("worker", p))
+
+    codes = [p.wait() for p in workers]
+    for role, p in procs:
+        if role == "server" and p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    return codes
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed job (reference launch.py CLI)")
+    parser.add_argument("-n", "--num-workers", required=True, type=int)
+    parser.add_argument("-s", "--num-servers", type=int, default=0)
+    parser.add_argument("-H", "--hostfile", type=str,
+                        help="ssh/mpi launcher host file")
+    parser.add_argument("--launcher", type=str, default="local",
+                        choices=["local", "ssh", "mpi", "sge", "yarn"])
+    parser.add_argument("--kv-mode", type=str, default="sync",
+                        choices=["sync", "async"],
+                        help="parameter-server mode when -s > 0")
+    parser.add_argument("--sync-dst-dir", type=str)
+    parser.add_argument("--env-server", action="append", default=[])
+    parser.add_argument("--env-worker", action="append", default=[])
+    parser.add_argument("--env", action="append", default=[])
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    if not args.command:
+        parser.error("no command given")
+    if args.launcher != "local":
+        raise NotImplementedError(
+            f"launcher {args.launcher!r}: this build targets single-host "
+            "multi-process (reference dmlc_tracker local); on TPU pods use "
+            "the platform scheduler (GKE/xmanager) to start one process "
+            "per host with MXT_COORDINATOR/MXT_NUM_WORKERS/MXT_WORKER_ID")
+    codes = launch_local(args)
+    bad = [c for c in codes if c != 0]
+    sys.exit(bad[0] if bad else 0)
+
+
+if __name__ == "__main__":
+    main()
