@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Per-operator latency benchmark (reference benchmark/opperf/).
+
+Walks the op registry, generates inputs per op (curated specs for layer
+ops, shape heuristics for tensor ops), and times forward and backward
+with the honest-sync discipline from bench.py: every measurement chains
+through device values and ends with a host readback INSIDE the timed
+region (block_until_ready does not wait on this platform).
+
+Usage:
+  python benchmark/opperf.py [--output opperf.json] [--ops relu,dot,...]
+                             [--steps 50] [--warmup 5]
+
+Output JSON: {"platform", "n_ops", "results": {op: {fwd_ms, bwd_ms,
+inputs}}, "skipped": {op: reason}}.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+
+def _sync(val):
+    leaf = jax.tree_util.tree_leaves(val)[0]
+    onp.asarray(jax.device_get(jnp.ravel(leaf)[:1].astype(jnp.float32)))
+
+
+# ---------------------------------------------------------------------------
+# input specs
+# ---------------------------------------------------------------------------
+
+def default_specs(n=1024):
+    """Curated (args, kwargs) generators per op; keys are canonical op
+    names.  Mirrors the reference's opperf default input registry
+    (benchmark/opperf/rules/default_params.py)."""
+    f = jnp.float32
+    rng = onp.random.RandomState(0)
+
+    def arr(*shape, dtype=f):
+        return jnp.asarray(rng.rand(*shape), dtype)
+
+    B, C, H, W = 32, 64, 56, 56
+    specs = {
+        "FullyConnected": (lambda: ([arr(B, 512), arr(1024, 512),
+                                     arr(1024)], {"num_hidden": 1024})),
+        "Convolution": (lambda: ([arr(B, C, H, W), arr(128, C, 3, 3)],
+                                 {"kernel": (3, 3), "num_filter": 128,
+                                  "pad": (1, 1), "no_bias": True})),
+        "Deconvolution": (lambda: ([arr(B, C, 28, 28), arr(C, 64, 2, 2)],
+                                   {"kernel": (2, 2), "stride": (2, 2),
+                                    "num_filter": 64})),
+        "Pooling": (lambda: ([arr(B, C, H, W)],
+                             {"kernel": (2, 2), "stride": (2, 2),
+                              "pool_type": "max"})),
+        "BatchNorm": (lambda: ([arr(B, C, H, W), arr(C), arr(C), arr(C),
+                                arr(C)], {})),
+        "LayerNorm": (lambda: ([arr(B, 128, 768), arr(768), arr(768)], {})),
+        "RMSNorm": (lambda: ([arr(B, 128, 768), arr(768)], {})),
+        "GroupNorm": (lambda: ([arr(B, C, 28, 28), arr(C), arr(C)],
+                               {"num_groups": 8})),
+        "InstanceNorm": (lambda: ([arr(B, C, 28, 28), arr(C), arr(C)], {})),
+        "softmax": (lambda: ([arr(B, 1000)], {})),
+        "log_softmax": (lambda: ([arr(B, 1000)], {})),
+        "dot": (lambda: ([arr(n, n), arr(n, n)], {})),
+        "batch_dot": (lambda: ([arr(B, 128, 128), arr(B, 128, 128)], {})),
+        "Embedding": (lambda: ([jnp.asarray(rng.randint(0, 1000, (B, 64)),
+                                            jnp.int32), arr(1000, 512)],
+                               {"input_dim": 1000, "output_dim": 512})),
+        "dot_product_attention": (lambda: (
+            [arr(B, 8, 128, 64), arr(B, 8, 128, 64), arr(B, 8, 128, 64)],
+            {})),
+        "take": (lambda: ([arr(1000, 512),
+                           jnp.asarray(rng.randint(0, 1000, (B, 64)),
+                                       jnp.int32)], {})),
+        "concat": (lambda: ([arr(B, 512), arr(B, 512)], {"dim": 1})),
+        "topk": (lambda: ([arr(B, 1000)], {"k": 5})),
+        "sort": (lambda: ([arr(B, 1000)], {})),
+        "argsort": (lambda: ([arr(B, 1000)], {})),
+        "RNN": None,  # exercised via gluon rnn tests; stateful signature
+        "_contrib_interleaved_matmul_selfatt_qk": (
+            lambda: ([arr(128, B, 8 * 64 * 3)], {"heads": 8})),
+    }
+    # generic elementwise/reduction fallbacks
+    unary = ["relu", "sigmoid", "tanh", "exp", "log", "sqrt", "square",
+             "abs", "negative", "erf", "gelu", "softsign", "softrelu",
+             "mean", "sum", "max", "min", "norm", "argmax", "argmin",
+             "floor", "ceil", "round", "rsqrt", "cbrt", "sin", "cos",
+             "tan", "arcsin", "arccos", "arctan", "sinh", "cosh", "tanh",
+             "log1p", "expm1", "logical_not", "sign", "reciprocal",
+             "flatten", "transpose", "reverse", "cumsum", "clip",
+             "L2Normalization", "softmax_cross_entropy"]
+    binary = ["add", "subtract", "multiply", "divide", "maximum", "minimum",
+              "power", "mod", "hypot", "broadcast_add", "broadcast_sub",
+              "broadcast_mul", "broadcast_div", "elemwise_add",
+              "elemwise_sub", "elemwise_mul", "elemwise_div"]
+    for name in unary:
+        specs.setdefault(name, (lambda: ([arr(n, n)], {})))
+    for name in binary:
+        specs.setdefault(name, (lambda: ([arr(n, n), arr(n, n)], {})))
+    return specs
+
+
+def bench_op(op, args, kwargs, steps, warmup, grad):
+    """Time one op's forward (and backward) with host-readback sync."""
+    fwd = op.jitted(tuple(sorted(kwargs)))
+
+    out = fwd(*args, **kwargs)
+    _sync(out)
+    for _ in range(warmup):
+        out = fwd(*args, **kwargs)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fwd(*args, **kwargs)
+    _sync(out)
+    fwd_ms = (time.perf_counter() - t0) / steps * 1e3
+
+    bwd_ms = None
+    if grad and op.differentiable:
+        float_pos = [i for i, a in enumerate(args)
+                     if jnp.issubdtype(a.dtype, jnp.floating)]
+        if float_pos:
+            def loss(*a):
+                o = op.fn(*a, **kwargs)
+                leaves = jax.tree_util.tree_leaves(o)
+                return sum(jnp.sum(l.astype(jnp.float32)) for l in leaves
+                           if jnp.issubdtype(l.dtype, jnp.floating))
+
+            gfn = jax.jit(jax.grad(loss, argnums=tuple(float_pos)))
+            g = gfn(*args)
+            _sync(g)
+            for _ in range(warmup):
+                g = gfn(*args)
+            _sync(g)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                g = gfn(*args)
+            _sync(g)
+            bwd_ms = (time.perf_counter() - t0) / steps * 1e3
+    return fwd_ms, bwd_ms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--output", default="opperf_results.json")
+    ap.add_argument("--ops", default="",
+                    help="comma-separated subset (default: all with specs)")
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--size", type=int, default=1024)
+    ap.add_argument("--no-grad", action="store_true")
+    ap.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                    help="force a jax platform (a site plugin may override "
+                    "JAX_PLATFORMS; this uses jax.config directly)")
+    args = ap.parse_args()
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    elif args.platform == "tpu":
+        if jax.devices()[0].platform == "cpu":
+            print("--platform tpu: no accelerator available "
+                  "(jax.devices() is CPU-only)", file=sys.stderr)
+            sys.exit(2)
+
+    from incubator_mxnet_tpu.ops import registry
+
+    specs = default_specs(args.size)
+    wanted = [s for s in args.ops.split(",") if s] or sorted(specs)
+    results, skipped = {}, {}
+    platform = jax.devices()[0].platform
+    for name in wanted:
+        spec = specs.get(name)
+        if spec is None:
+            skipped[name] = "no input spec"
+            continue
+        try:
+            op = registry.get_op(name)
+        except KeyError:
+            skipped[name] = "not registered"
+            continue
+        try:
+            a, kw = spec()
+            fwd_ms, bwd_ms = bench_op(op, a, kw, args.steps, args.warmup,
+                                      not args.no_grad)
+            results[name] = {
+                "fwd_ms": round(fwd_ms, 4),
+                "bwd_ms": round(bwd_ms, 4) if bwd_ms is not None else None,
+                "inputs": [list(x.shape) for x in a],
+            }
+            print(f"{name:48s} fwd {fwd_ms:9.4f} ms"
+                  + (f"  bwd {bwd_ms:9.4f} ms" if bwd_ms else ""),
+                  flush=True)
+        except Exception as e:  # record, keep sweeping
+            skipped[name] = f"{type(e).__name__}: {e}"[:200]
+    out = {"platform": platform, "n_ops": len(results),
+           "steps": args.steps, "results": results, "skipped": skipped}
+    with open(args.output, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"\n{len(results)} ops benchmarked, {len(skipped)} skipped "
+          f"-> {args.output}")
+
+
+if __name__ == "__main__":
+    main()

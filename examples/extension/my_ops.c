@@ -1,0 +1,49 @@
+/* Example external-op library for the mxt ext-op C ABI
+ * (src/include/mxt/ext_op.h; reference example/extensions/lib_custom_op).
+ * Build: gcc -shared -fPIC -I../../src my_ops.c -o libmyops.so
+ * Ops: my_relu(x), my_scaled_add(a, b)  [out = a + 2*b]
+ */
+#include <string.h>
+#include "include/mxt/ext_op.h"
+
+int mxt_ext_abi_version(void) { return MXT_EXT_ABI_VERSION; }
+int mxt_ext_num_ops(void) { return 2; }
+
+const char* mxt_ext_op_name(int idx) {
+  return idx == 0 ? "my_relu" : "my_scaled_add";
+}
+
+int mxt_ext_op_num_inputs(int idx) { return idx == 0 ? 1 : 2; }
+
+static int64_t numel(const int64_t* shape, int ndim) {
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  return n;
+}
+
+int mxt_ext_op_infer_shape(int idx, int nin,
+                           const int64_t* const* in_shapes,
+                           const int* in_ndims,
+                           int64_t* out_shape, int* out_ndim) {
+  (void)idx; (void)nin;
+  *out_ndim = in_ndims[0];
+  memcpy(out_shape, in_shapes[0], in_ndims[0] * sizeof(int64_t));
+  return 0;
+}
+
+int mxt_ext_op_forward(int idx, int nin,
+                       const float* const* in_data,
+                       const int64_t* const* in_shapes,
+                       const int* in_ndims,
+                       float* out_data) {
+  (void)nin;
+  int64_t n = numel(in_shapes[0], in_ndims[0]);
+  if (idx == 0) {
+    for (int64_t i = 0; i < n; ++i)
+      out_data[i] = in_data[0][i] > 0.f ? in_data[0][i] : 0.f;
+  } else {
+    for (int64_t i = 0; i < n; ++i)
+      out_data[i] = in_data[0][i] + 2.f * in_data[1][i];
+  }
+  return 0;
+}

@@ -89,6 +89,8 @@ from . import monitor
 from . import visualization as viz
 from . import test_utils
 from . import util
+from . import library
+from . import deploy
 from .util import is_np_array, set_np, reset_np
 from .attribute import AttrScope
 from .name import NameManager

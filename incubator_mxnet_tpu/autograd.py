@@ -40,18 +40,24 @@ def _tls():
 
 
 class _TapeNode:
-    __slots__ = ("op", "vjp_fn", "nd_inputs", "outputs", "saved_out_data")
+    __slots__ = ("op", "vjp_fn", "nd_inputs", "input_slots", "outputs",
+                 "saved_out_data")
 
-    def __init__(self, op, vjp_fn, nd_inputs, outputs):
+    def __init__(self, op, vjp_fn, nd_inputs, input_slots, outputs):
         self.op = op
         self.vjp_fn = vjp_fn
         self.nd_inputs = nd_inputs
+        # position of each NDArray input within the op's FULL argument
+        # list: the vjp returns one cotangent per argument, and raw jax
+        # arrays (sparse index triplets etc.) may precede the NDArrays —
+        # a positional zip would hand an NDArray the wrong gradient
+        self.input_slots = input_slots
         self.outputs = outputs
 
 
 def _record(op, vjp_fn, all_inputs, nd_inputs, input_slots, outputs):
     outs = outputs if isinstance(outputs, (list, tuple)) else (outputs,)
-    node = _TapeNode(op, vjp_fn, nd_inputs, outs)
+    node = _TapeNode(op, vjp_fn, nd_inputs, input_slots, outs)
     for o in outs:
         o._tape_node = node
     _tls().tape.append(node)
@@ -188,7 +194,8 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
             continue
         seed = out_cots[0] if len(node.outputs) == 1 else tuple(out_cots)
         in_cots = node.vjp_fn(seed)
-        for x, g in zip(node.nd_inputs, in_cots):
+        for slot, x in zip(node.input_slots, node.nd_inputs):
+            g = in_cots[slot]
             if isinstance(g, jax.Array) and g.dtype != jax.dtypes.float0:
                 add_cot(x, g)
 

@@ -1,0 +1,141 @@
+"""Deploy/predict surface (reference include/mxnet/c_predict_api.h +
+src/c_api/c_predict_api.cc).
+
+Export side: ``export_model`` compiles a Block (or jittable fn) forward
+to StableHLO and writes three artifacts:
+
+* ``{prefix}.stablehlo.mlir``  — human-inspectable StableHLO text of the
+  compiled forward (the TPU-era analog of ``prefix-symbol.json``)
+* ``{prefix}.jaxport``         — jax.export serialized executable
+  (StableHLO + calling convention), reloadable without any model code
+* ``{prefix}.params``          — weights in the reference TLV format
+* ``{prefix}.meta.json``       — input names/shapes/dtypes
+
+Predict side: ``load_predictor`` rebuilds a callable from the artifacts
+alone — no Python model code, mirroring the reference's predict-only API
+that loads symbol+params without the training stack.  The C ABI in
+src/predict.cc drives exactly this loader through an embedded
+interpreter, the same layering as the reference where c_predict_api.cc
+is a thin C shim over the full libmxnet runtime.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["export_model", "load_predictor"]
+
+
+def _block_forward_fn(block):
+    params, apply_fn = block.functional()
+
+    def fwd(params, *inputs):
+        out = apply_fn(params, *inputs, training=False)
+        return out[0] if isinstance(out, tuple) else out
+
+    return params, fwd
+
+
+def export_model(model, example_inputs, prefix, params=None):
+    """Compile + serialize a model's forward for deployment.
+
+    model: a gluon Block (uses ``functional()``) or a pure
+    ``fn(params, *inputs)``; example_inputs: tuple of arrays fixing the
+    traced shapes (static-shape contract, like the reference predictor's
+    input-shape binding at MXPredCreate time).
+    """
+    from .ndarray import NDArray, save as nd_save
+
+    if hasattr(model, "functional"):
+        params, fwd = _block_forward_fn(model)
+    else:
+        fwd = model
+        if params is None:
+            raise ValueError("pure-function export needs params=")
+
+    example = tuple(
+        x.data if isinstance(x, NDArray) else jnp.asarray(x)
+        for x in example_inputs)
+
+    jitted = jax.jit(fwd)
+    lowered = jitted.lower(params, *example)
+    with open(prefix + ".stablehlo.mlir", "w") as f:
+        f.write(lowered.as_text())
+
+    exported = jax.export.export(jitted)(params, *example)
+    with open(prefix + ".jaxport", "wb") as f:
+        f.write(exported.serialize())
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    names, wire = [], {}
+    for path, leaf in flat:
+        name = jax.tree_util.keystr(path)
+        names.append(name)
+        wire[name] = NDArray(leaf)
+    nd_save(prefix + ".params", wire)
+
+    meta = {
+        "format": "mxtpu_predict_v1",
+        "param_names": names,
+        "inputs": [{"shape": list(x.shape), "dtype": jnp.dtype(x.dtype).name}
+                   for x in example],
+        "outputs": [{"shape": list(s.shape), "dtype": jnp.dtype(s.dtype).name}
+                    for s in jax.tree_util.tree_leaves(
+                        jax.eval_shape(fwd, params, *example))],
+    }
+    with open(prefix + ".meta.json", "w") as f:
+        json.dump(meta, f, indent=1)
+    return meta
+
+
+class Predictor:
+    """Loaded deploy artifact: ``pred(inputs) -> outputs`` (numpy).
+
+    Mirrors MXPredCreate/SetInput/Forward/GetOutput
+    (reference c_predict_api.h) as a single callable; the C ABI wraps
+    this object 1:1.
+    """
+
+    def __init__(self, prefix):
+        with open(prefix + ".meta.json") as f:
+            self.meta = json.load(f)
+        if self.meta.get("format") != "mxtpu_predict_v1":
+            raise ValueError(f"{prefix}: not a mxtpu predict artifact")
+        with open(prefix + ".jaxport", "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        from .ndarray import load as nd_load
+        loaded = nd_load(prefix + ".params")
+        # rebuild the params pytree from flattened keystr names
+        self._params = _unflatten_keystr(
+            {k: v.data for k, v in loaded.items()})
+        self._call = self._exported.call
+
+    def __call__(self, *inputs):
+        arrs = tuple(jnp.asarray(x) for x in inputs)
+        out = self._call(self._params, *arrs)
+        return jax.tree_util.tree_map(onp.asarray, out)
+
+
+def _unflatten_keystr(flat: dict):
+    """Invert jax.tree_util.keystr for dict-of-dict pytrees
+    (keys look like ``['a']['b']``)."""
+    import re
+    root: dict = {}
+    for keystr, val in flat.items():
+        parts = re.findall(r"\['([^']+)'\]", keystr)
+        if not parts:
+            parts = [keystr]
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return root
+
+
+def load_predictor(prefix):
+    return Predictor(prefix)

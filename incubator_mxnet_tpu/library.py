@@ -1,0 +1,138 @@
+"""Dynamic external-op libraries (reference python/mxnet/library.py
+``load`` → C++ ``MXLoadLib`` + include/mxnet/lib_api.h).
+
+``load("libfoo.so")`` dlopens a library implementing the C ABI in
+src/include/mxt/ext_op.h and registers every op it exports in the op
+registry.  Kernels run host-side via ``jax.pure_callback`` — inside jit
+the callback becomes a host transfer + C call + transfer back, the
+documented slow-path escape hatch (the reference's external ops are the
+same: opt-in custom kernels outside the compiled graph).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from .ops.registry import Op, _OPS, _lock
+
+__all__ = ["load", "loaded_libraries"]
+
+_LIBS: dict[str, ctypes.CDLL] = {}
+
+_MAX_NDIM = 8
+
+
+def loaded_libraries():
+    return dict(_LIBS)
+
+
+def _declare(lib: ctypes.CDLL):
+    i64pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))
+    lib.mxt_ext_abi_version.restype = ctypes.c_int
+    lib.mxt_ext_num_ops.restype = ctypes.c_int
+    lib.mxt_ext_op_name.restype = ctypes.c_char_p
+    lib.mxt_ext_op_name.argtypes = [ctypes.c_int]
+    lib.mxt_ext_op_num_inputs.restype = ctypes.c_int
+    lib.mxt_ext_op_num_inputs.argtypes = [ctypes.c_int]
+    lib.mxt_ext_op_infer_shape.restype = ctypes.c_int
+    lib.mxt_ext_op_infer_shape.argtypes = [
+        ctypes.c_int, ctypes.c_int, i64pp,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.mxt_ext_op_forward.restype = ctypes.c_int
+    lib.mxt_ext_op_forward.argtypes = [
+        ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)), i64pp,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_float)]
+    return lib
+
+
+def _shapes_to_c(shapes):
+    n = len(shapes)
+    ndims = (ctypes.c_int * n)(*[len(s) for s in shapes])
+    rows = []
+    for s in shapes:
+        rows.append((ctypes.c_int64 * max(len(s), 1))(*[int(d) for d in s]))
+    ptrs = (ctypes.POINTER(ctypes.c_int64) * n)(
+        *[ctypes.cast(r, ctypes.POINTER(ctypes.c_int64)) for r in rows])
+    return ptrs, ndims, rows  # rows kept alive by caller
+
+
+def _infer_shape(lib, idx, shapes):
+    ptrs, ndims, _keep = _shapes_to_c(shapes)
+    out_shape = (ctypes.c_int64 * _MAX_NDIM)()
+    out_ndim = ctypes.c_int(0)
+    rc = lib.mxt_ext_op_infer_shape(idx, len(shapes), ptrs, ndims,
+                                    out_shape, ctypes.byref(out_ndim))
+    if rc != 0:
+        raise RuntimeError(f"external op infer_shape failed (rc={rc})")
+    return tuple(int(out_shape[i]) for i in range(out_ndim.value))
+
+
+def _make_ext_fn(lib, idx, name):
+    def host_kernel(*arrays):
+        arrays = [onp.ascontiguousarray(onp.asarray(a), onp.float32)
+                  for a in arrays]
+        shapes = [a.shape for a in arrays]
+        out_shape = _infer_shape(lib, idx, shapes)
+        out = onp.empty(out_shape, onp.float32)
+        ptrs, ndims, _keep = _shapes_to_c(shapes)
+        data_ptrs = (ctypes.POINTER(ctypes.c_float) * len(arrays))(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for a in arrays])
+        rc = lib.mxt_ext_op_forward(
+            idx, len(arrays), data_ptrs, ptrs, ndims,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            raise RuntimeError(f"external op {name!r} forward failed "
+                               f"(rc={rc})")
+        return out
+
+    def fn(*arrays):
+        shapes = [tuple(a.shape) for a in arrays]
+        out_shape = _infer_shape(lib, idx, shapes)
+        result = jax.ShapeDtypeStruct(out_shape, jnp.float32)
+        return jax.pure_callback(
+            host_kernel, result,
+            *[jnp.asarray(a, jnp.float32) for a in arrays])
+
+    fn.__name__ = name
+    fn.__doc__ = (f"External op {name!r} (C ABI, src/include/mxt/ext_op.h; "
+                  "reference lib_api.h). Host-callback execution.")
+    return fn
+
+
+def load(path, verbose=True):
+    """Load an external-op library (reference mx.library.load →
+    MXLoadLib).  Returns the list of op names registered."""
+    path = os.path.abspath(path)
+    lib = _declare(ctypes.CDLL(path))
+    abi = lib.mxt_ext_abi_version()
+    if abi != 1:
+        raise RuntimeError(
+            f"{path}: external-op ABI version {abi} unsupported (want 1)")
+    names = []
+    n = lib.mxt_ext_num_ops()
+    for idx in range(n):
+        name = lib.mxt_ext_op_name(idx).decode()
+        nin = lib.mxt_ext_op_num_inputs(idx)
+        op = Op(name, _make_ext_fn(lib, idx, name), differentiable=False,
+                num_inputs=nin)
+        with _lock:
+            _OPS[name] = op
+        names.append(name)
+    _LIBS[path] = lib
+    # expose in the nd namespace like generated wrappers
+    from . import ndarray as nd_mod
+    for name in names:
+        if not hasattr(nd_mod, name):
+            setattr(nd_mod, name, nd_mod._make_wrapper(name))
+    if verbose:
+        print(f"[mxt.library] loaded {len(names)} external op(s) from "
+              f"{path}: {names}")
+    return names

@@ -44,7 +44,32 @@ for _name in _registry.list_ops():
         _g[_name] = _make_wrapper(_name)
 
 # pythonic aliases matching the reference nd namespace
-dot = _g["dot"]
+_dense_dot = _g["dot"]
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, out=None):
+    """Dot product with sparse storage dispatch (reference
+    src/operator/tensor/dot-inl.h FComputeEx: csr/row_sparse lhs hit the
+    sparse kernels in ops/sparse_ops.py instead of densifying).
+
+    The sparse branches go through ``invoke`` so autograd records the
+    op — gradients flow to the dense rhs exactly like the dense path
+    (the sparse lhs pattern is constant, matching reference semantics
+    where the csr structure is not differentiable).
+    """
+    from .sparse import CSRNDArray, RowSparseNDArray
+    if isinstance(lhs, CSRNDArray) and not transpose_b:
+        n_out = lhs._dense_shape[1] if transpose_a else lhs._dense_shape[0]
+        return _invoke("_sparse_csr_dot_dense",
+                       lhs._csr_data, lhs._csr_indices, lhs._csr_indptr,
+                       rhs, transpose_lhs=bool(transpose_a),
+                       n_rows=int(n_out), out=out)
+    if isinstance(lhs, RowSparseNDArray) and not (transpose_a or transpose_b):
+        return _invoke("_sparse_row_sparse_dot_dense",
+                       lhs._rs_values, lhs._rs_indices, rhs,
+                       n_rows=int(lhs._dense_shape[0]), out=out)
+    return _dense_dot(lhs, rhs, transpose_a=transpose_a,
+                      transpose_b=transpose_b, out=out)
 concatenate = _g["concat"]
 elemwise_add = _g["add"]
 waitall = None  # set below

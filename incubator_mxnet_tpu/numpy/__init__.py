@@ -11,6 +11,7 @@ generic ``_jnp_call`` vjp path).
 """
 from __future__ import annotations
 
+import builtins as _bi
 import functools
 
 import numpy as _onp
@@ -45,9 +46,16 @@ def _wrap_fn(jnp_fn):
     @functools.wraps(jnp_fn)
     def fn(*args, **kwargs):
         nd_inputs = [a for a in args if isinstance(a, _ND)]
+        # the vjp below covers ALL positional args; record the true
+        # argument slot of each NDArray so backward() maps cotangents
+        # correctly when scalars precede arrays (np.subtract(1.0, x))
+        nd_slots = [i for i, a in enumerate(args) if isinstance(a, _ND)]
         raw = [a.data if isinstance(a, _ND) else a for a in args]
 
-        recording = _autograd.is_recording() and any(
+        # NB: _bi.any — the delegated namespace below shadows several
+        # builtins (np.any/all/sum/...) in this module's globals, and a
+        # bare any() here recursed through its own wrapper
+        recording = _autograd.is_recording() and _bi.any(
             a._in_graph() for a in nd_inputs)
         call = lambda *xs: jnp_fn(*xs, **kwargs)
         if recording:
@@ -70,7 +78,7 @@ def _wrap_fn(jnp_fn):
                 return vjp(seed)
 
             _autograd._record(None, tape_vjp, args, nd_inputs,
-                              list(range(len(nd_inputs))), out_tuple)
+                              nd_slots, out_tuple)
         return outs
 
     return fn

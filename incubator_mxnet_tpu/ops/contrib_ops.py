@@ -425,3 +425,112 @@ def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
         return jnp.mean(grid, axis=(1, 3)).transpose(2, 0, 1)  # (C, ph, pw)
 
     return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# data-dependent selection (reference src/operator/contrib/boolean_mask.cc,
+# index_copy.cc) — dynamic output shapes, so these run eagerly (outside
+# jit) like the reference's FComputeEx CPU path; under trace they raise
+# a shape error, matching XLA's static-shape contract
+# ---------------------------------------------------------------------------
+
+@register("_contrib_boolean_mask", aliases=("boolean_mask",),
+          differentiable=False, jittable=False)
+def boolean_mask(data, index, axis=0):
+    """Rows of ``data`` where ``index`` is nonzero.  Output shape depends
+    on the mask VALUES (reference boolean_mask.cc) — eager-only."""
+    import numpy as _np
+    mask = _np.asarray(index) != 0
+    return jnp.asarray(_np.compress(mask, _np.asarray(data), axis=axis))
+
+
+@register("_contrib_index_copy", aliases=("index_copy",))
+def index_copy(old, index, new):
+    """Copy rows of ``new`` into ``old`` at ``index``
+    (reference contrib/index_copy.cc)."""
+    return old.at[jnp.asarray(index, jnp.int32)].set(new)
+
+
+@register("_contrib_AdaptiveAvgPooling2D", aliases=("adaptive_avg_pool2d",))
+def adaptive_avg_pooling2d(data, output_size=1):
+    """NCHW adaptive average pooling
+    (reference contrib/adaptive_avg_pooling.cc).  Implemented as a
+    dense interpolation matrix per spatial axis — two small matmuls,
+    which is the MXU-friendly form of the variable-window average."""
+    import numpy as _np
+    if isinstance(output_size, int):
+        oh = ow = int(output_size)
+    else:
+        oh, ow = (int(output_size[0]),
+                  int(output_size[1] if len(output_size) > 1
+                      else output_size[0]))
+    n, c, h, w = data.shape
+
+    def interp(in_size, out_size):
+        m = _np.zeros((out_size, in_size), _np.float32)
+        for o in range(out_size):
+            lo = (o * in_size) // out_size
+            hi = -(-((o + 1) * in_size) // out_size)  # ceil
+            m[o, lo:hi] = 1.0 / (hi - lo)
+        return jnp.asarray(m)
+
+    mh = interp(h, oh)
+    mw = interp(w, ow)
+    out = jnp.einsum("oh,nchw->ncow", mh, data.astype(jnp.float32))
+    out = jnp.einsum("pw,ncow->ncop", mw, out)
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# BERT-era fused attention ops (reference contrib/transformer.cc:650-760).
+# XLA fuses the reshape/transpose/batched-matmul chain itself; the ops
+# exist for API parity with gluon-nlp-style models.
+# ---------------------------------------------------------------------------
+
+@register("_contrib_div_sqrt_dim", aliases=("div_sqrt_dim",))
+def div_sqrt_dim(data):
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], jnp.float32)).astype(
+        data.dtype)
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk",
+          aliases=("interleaved_matmul_selfatt_qk",))
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
+    """(T, B, H*3*dh) interleaved qkv → (B*H, T, T) scaled QK^T scores
+    (reference transformer.cc:650)."""
+    T, B, E = queries_keys_values.shape
+    dh = E // (heads * 3)
+    tmp = queries_keys_values.reshape(T, B, heads, 3, dh)
+    q = tmp[:, :, :, 0, :].transpose(1, 2, 0, 3).reshape(B * heads, T, dh)
+    k = tmp[:, :, :, 1, :].transpose(1, 2, 0, 3).reshape(B * heads, T, dh)
+    q = q / jnp.sqrt(jnp.asarray(dh, jnp.float32)).astype(q.dtype)
+    return jnp.einsum("btd,bsd->bts", q, k)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt",
+          aliases=("interleaved_matmul_selfatt_valatt",))
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads=1):
+    """attention (B*H, T, T) x interleaved V → (T, B, H*dh)
+    (reference transformer.cc:693)."""
+    T, B, E = queries_keys_values.shape
+    dh = E // (heads * 3)
+    tmp = queries_keys_values.reshape(T, B, heads, 3, dh)
+    v = tmp[:, :, :, 2, :].transpose(1, 2, 0, 3).reshape(B * heads, T, dh)
+    out = jnp.einsum("bts,bsd->btd", attention, v)
+    return out.reshape(B, heads, T, dh).transpose(2, 0, 1, 3).reshape(
+        T, B, heads * dh)
+
+
+@register("_contrib_count_sketch", aliases=("count_sketch",))
+def count_sketch(data, h, s, out_dim=None):
+    """Count-sketch projection (reference contrib/count_sketch.cc):
+    out[..., h[i]] += s[i] * data[..., i], a signed feature-hashing
+    scatter-add — lowered to one segment-sum per output bucket."""
+    if out_dim is None:
+        raise ValueError("count_sketch requires out_dim")
+    idx = jnp.asarray(h, jnp.int32).reshape(-1)
+    sign = jnp.asarray(s, data.dtype).reshape(-1)
+    signed = data * sign
+    flat = signed.reshape(-1, data.shape[-1])
+    out = jax.ops.segment_sum(flat.T, idx, num_segments=int(out_dim)).T
+    return out.reshape(data.shape[:-1] + (int(out_dim),))

@@ -132,10 +132,17 @@ def pooling(x, kernel=None, pool_type="max", global_pool=False, stride=None,
     window = (1, 1) + kernel
     strides = (1, 1) + stride
     pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    import numpy as _np
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        # init must be a SCALAR (python/numpy), not a jax array constant:
+        # reduce_window with an array init breaks reverse-mode
+        # linearization; a typed numpy scalar keeps int8 pooling exact
+        init = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                else _np.dtype(x.dtype).type(jnp.iinfo(x.dtype).min))
         return lax.reduce_window(x, init, lax.max, window, strides, pads)
-    summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+    zero = (0.0 if jnp.issubdtype(x.dtype, jnp.floating)
+            else _np.dtype(x.dtype).type(0))
+    summed = lax.reduce_window(x, zero, lax.add, window, strides, pads)
     if pool_type == "sum":
         return summed
     if count_include_pad or all(p == 0 for p in pad):

@@ -125,3 +125,70 @@ def quantized_conv2d(data, weight, bias, data_min, data_max, w_min, w_max,
             None, :, None, None]
     out_max = out_scale * float(2 ** 31 - 1)
     return acc, -out_max, out_max
+
+
+@register("_contrib_quantized_pooling", aliases=("quantized_pooling",),
+          differentiable=False)
+def quantized_pooling(data, min_data, max_data, kernel=None, pool_type="max",
+                      stride=None, pad=None, global_pool=False):
+    """int8 pooling, ranges pass through unchanged
+    (reference quantization/quantized_pooling.cc: pooling is monotone so
+    the quantization scale is preserved)."""
+    from .nn_ops import pooling as _pooling
+    if data.dtype not in (jnp.int8, jnp.uint8):
+        raise ValueError("quantized_pooling expects int8/uint8 input")
+    if pool_type == "avg":
+        # average in int32 then round back: avoids int8 overflow
+        if global_pool:
+            # nn_ops.pooling's global branch already MEANS for non-max;
+            # sum explicitly so the division below happens exactly once
+            acc = jnp.sum(data.astype(jnp.int32), axis=(2, 3),
+                          keepdims=True)
+            denom = data.shape[2] * data.shape[3]
+        else:
+            acc = _pooling(data.astype(jnp.int32), kernel=kernel,
+                           pool_type="sum", stride=stride, pad=pad)
+            k = kernel if not isinstance(kernel, int) else (kernel, kernel)
+            denom = int(k[0]) * int(k[1])
+        out = jnp.clip(jnp.round(acc / denom), -128, 127).astype(data.dtype)
+    else:
+        out = _pooling(data, kernel=kernel, pool_type="max", stride=stride,
+                       pad=pad, global_pool=global_pool)
+    return out, min_data, max_data
+
+
+@register("_contrib_quantized_concat", aliases=("quantized_concat",),
+          differentiable=False)
+def quantized_concat(*args, dim=1):
+    """Concat int8 tensors with differing scales: requantize every input
+    to the widest range first (reference quantized_concat.cc)."""
+    n = len(args) // 3
+    datas, mins, maxs = args[:n], args[n:2 * n], args[2 * n:]
+    out_min = mins[0]
+    out_max = maxs[0]
+    for mn in mins[1:]:
+        out_min = jnp.minimum(out_min, mn)
+    for mx in maxs[1:]:
+        out_max = jnp.maximum(out_max, mx)
+    out_scale = jnp.maximum(jnp.abs(out_min), jnp.abs(out_max)) / 127.0
+    parts = []
+    for d, mn, mx in zip(datas, mins, maxs):
+        scale = jnp.maximum(jnp.abs(mn), jnp.abs(mx)) / 127.0
+        parts.append(jnp.clip(jnp.round(
+            d.astype(jnp.float32) * (scale / out_scale)),
+            -128, 127).astype(d.dtype))
+    return jnp.concatenate(parts, axis=dim), -out_scale * 127, out_scale * 127
+
+
+@register("_contrib_quantized_elemwise_add",
+          aliases=("quantized_elemwise_add",), differentiable=False)
+def quantized_elemwise_add(a, b, a_min, a_max, b_min, b_max):
+    """int8 + int8 with scale reconciliation in int32
+    (reference quantized_elemwise_add.cc)."""
+    a_scale = jnp.maximum(jnp.abs(a_min), jnp.abs(a_max)) / 127.0
+    b_scale = jnp.maximum(jnp.abs(b_min), jnp.abs(b_max)) / 127.0
+    out_scale = jnp.maximum(a_scale, b_scale)
+    acc = (a.astype(jnp.int32) * jnp.round(a_scale / out_scale * 64).astype(jnp.int32)
+           + b.astype(jnp.int32) * jnp.round(b_scale / out_scale * 64).astype(jnp.int32))
+    out_max = out_scale * 127.0 * 64 * 2
+    return acc, -out_max, out_max

@@ -48,12 +48,17 @@ class Op:
     num_inputs : informational; varargs ops pass -1
     """
 
-    def __init__(self, name, fn, differentiable=True, num_inputs=-1, aliases=()):
+    def __init__(self, name, fn, differentiable=True, num_inputs=-1,
+                 aliases=(), jittable=True):
         self.name = name
         self.fn = fn
         self.differentiable = differentiable
         self.num_inputs = num_inputs
         self.aliases = tuple(aliases)
+        # jittable=False: data-dependent output shape (boolean_mask et
+        # al.) — runs eagerly on concrete arrays, like the reference's
+        # imperative-only FComputeEx ops; tracing raises a shape error
+        self.jittable = jittable
         self._jit_cache: dict = {}
         try:
             sig = inspect.signature(fn)
@@ -66,6 +71,8 @@ class Op:
             self._sig = None
 
     def jitted(self, kwarg_names: tuple):
+        if not self.jittable:
+            return self.fn
         jfn = self._jit_cache.get(kwarg_names)
         if jfn is None:
             jfn = jax.jit(self.fn, static_argnames=kwarg_names)
@@ -81,12 +88,13 @@ class Op:
         return f"Op({self.name})"
 
 
-def register(name, differentiable=True, num_inputs=-1, aliases=()):
+def register(name, differentiable=True, num_inputs=-1, aliases=(),
+             jittable=True):
     """Decorator: register a pure JAX function as an operator."""
 
     def deco(fn):
         op = Op(name, fn, differentiable=differentiable,
-                num_inputs=num_inputs, aliases=aliases)
+                num_inputs=num_inputs, aliases=aliases, jittable=jittable)
         with _lock:
             _OPS[name] = op
             for a in aliases:

@@ -1,0 +1,87 @@
+"""Sparse compute kernels (reference src/operator/tensor/dot-inl.h,
+cast_storage-inl.h — the sparse FComputeEx paths).
+
+TPU re-design: TPU has no hardware scatter/gather parity with GPU sparse
+kernels, but XLA lowers ``segment_sum`` to an efficient one-hot/sorted
+reduction, so CSR x dense products are computed from the COO triplets
+WITHOUT materializing the dense matrix — static shapes (nnz is a static
+attribute of the container), jit-compatible, MXU-friendly on the dense
+operand side.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = ["csr_dot_dense", "csr_row_ids", "row_sparse_dot_dense",
+           "cast_storage_meta"]
+
+
+def csr_row_ids(indptr, nnz):
+    """Expand a CSR indptr to per-nonzero row ids (static nnz)."""
+    # row_ids[j] = number of indptr entries <= j, minus 1
+    positions = jnp.arange(nnz)
+    return (jnp.searchsorted(jnp.asarray(indptr)[1:], positions,
+                             side="right")).astype(jnp.int32)
+
+
+@register("_sparse_csr_dot_dense", num_inputs=4)
+def csr_dot_dense(data, indices, indptr, rhs, transpose_lhs=False,
+                  n_rows=None):
+    """CSR(lhs) @ dense(rhs) from the raw triplets
+    (reference dot-inl.h DotCsrDnsDns).
+
+    data (nnz,), indices (nnz,), indptr (n_rows+1,), rhs (n_cols, K) →
+    (n_rows, K).  transpose_lhs computes lhs^T @ rhs → (n_cols, K).
+    """
+    nnz = data.shape[0]
+    rows = csr_row_ids(indptr, nnz)
+    cols = jnp.asarray(indices, jnp.int32)
+    if transpose_lhs:
+        # out[c, :] = sum over nonzeros j with cols[j]==c of
+        # data[j] * rhs[rows[j], :]; the output row count is lhs's
+        # COLUMN count, which the triplets don't carry
+        if n_rows is None:
+            raise ValueError("transpose_lhs requires n_rows (= lhs cols)")
+        contrib = data[:, None] * rhs[rows]
+        return jax.ops.segment_sum(contrib, cols, num_segments=int(n_rows))
+    n_rows = int(n_rows) if n_rows is not None else int(indptr.shape[0] - 1)
+    contrib = data[:, None] * rhs[cols]
+    return jax.ops.segment_sum(contrib, rows, num_segments=n_rows)
+
+
+@register("_sparse_row_sparse_dot_dense", num_inputs=3)
+def row_sparse_dot_dense(values, row_idx, rhs, n_rows=None):
+    """row_sparse(lhs) @ dense(rhs): only stored rows multiply
+    (reference dot-inl.h DotRspDnsDns); result is dense (n_rows, K)."""
+    if n_rows is None:
+        # the dense row count is not derivable from the stored rows;
+        # defaulting to n_stored would silently clip scatter indices
+        raise ValueError("row_sparse_dot_dense requires n_rows "
+                         "(= dense lhs rows)")
+    out_rows = values @ rhs                       # (n_stored, K) — MXU
+    out = jnp.zeros((int(n_rows), rhs.shape[1]), out_rows.dtype)
+    return out.at[jnp.asarray(row_idx, jnp.int32)].set(out_rows)
+
+
+def cast_storage_meta(dense, stype):
+    """Dense → (values, aux...) triplets with jnp ops where possible
+    (reference cast_storage-inl.h).  Returns numpy-backed components —
+    the nnz pattern is data-dependent, so this runs eagerly like the
+    reference's CPU kernel."""
+    import numpy as onp
+    np_val = onp.asarray(dense)
+    if stype == "row_sparse":
+        nz = onp.nonzero(np_val.reshape(np_val.shape[0], -1).any(axis=1))[0]
+        return np_val[nz], (nz.astype(onp.int64),)
+    if stype == "csr":
+        if np_val.ndim != 2:
+            raise ValueError("csr requires 2-D")
+        rows, cols = onp.nonzero(np_val)
+        indptr = onp.zeros(np_val.shape[0] + 1, onp.int64)
+        onp.add.at(indptr, rows + 1, 1)
+        indptr = onp.cumsum(indptr)
+        return np_val[rows, cols], (cols.astype(onp.int64), indptr)
+    raise ValueError(f"unknown stype {stype}")

@@ -149,10 +149,11 @@ class SGD(Optimizer):
     mom = momentum*mom - lr*(grad + wd*w); w += mom.
     """
 
-    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=True,
                  **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.momentum = momentum
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -160,6 +161,9 @@ class SGD(Optimizer):
         return NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
 
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            return self._update_row_sparse(index, weight, grad, state)
         lr, wd, g = self._prep(index, weight, grad)
         w = weight.data
         g = g.astype(w.dtype) + wd * w
@@ -169,6 +173,31 @@ class SGD(Optimizer):
             weight._set_data(w + mom)
         else:
             weight._set_data(w - lr * g)
+
+    def _update_row_sparse(self, index, weight, grad, state):
+        """Lazy update: only rows present in the row_sparse gradient are
+        touched (reference optimizer/sgd.py lazy_update + sgd-inl.h
+        SGDUpdateRspRspImpl) — absent rows keep weight AND momentum
+        unchanged, which differs from a dense update when momentum or wd
+        is nonzero (documented reference semantics)."""
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        rows = jnp.asarray(grad._rs_indices, jnp.int32)
+        g_rows = grad._rs_values * self.rescale_grad
+        if self.clip_gradient is not None:
+            g_rows = jnp.clip(g_rows, -self.clip_gradient,
+                              self.clip_gradient)
+        g_rows = g_rows.astype(weight.data.dtype)
+        w = weight.data
+        w_rows = w[rows]
+        g_rows = g_rows + wd * w_rows
+        if state is not None:
+            mom_rows = self.momentum * state.data[rows] - lr * g_rows
+            state._set_data(state.data.at[rows].set(mom_rows))
+            weight._set_data(w.at[rows].add(mom_rows))
+        else:
+            weight._set_data(w.at[rows].add(-lr * g_rows))
 
 
 @register

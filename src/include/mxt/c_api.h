@@ -112,6 +112,22 @@ int MXTImageIterNumSamples(ImageIterHandle h, uint64_t* out);
 int MXTImdecode(const char* buf, uint64_t size, unsigned char* out,
                 int* h, int* w);
 
+/* ---------------- Predict (deploy) API --------------------------------
+ * Reference include/mxnet/c_predict_api.h: load an exported model
+ * (deploy.export_model artifacts: serialized StableHLO executable +
+ * .params weights + meta) and run forward from C — no model code.
+ * Implemented in predict.cc (libmxtpredict.so, links libpython). */
+typedef void* PredictorHandle;
+
+int MXTPredCreate(const char* artifact_prefix, PredictorHandle* out);
+int MXTPredSetInput(PredictorHandle h, uint32_t index, const float* data,
+                    uint64_t size);
+int MXTPredForward(PredictorHandle h);
+int MXTPredGetOutputSize(PredictorHandle h, uint32_t index, uint64_t* size);
+int MXTPredGetOutput(PredictorHandle h, uint32_t index, float* out,
+                     uint64_t size);
+int MXTPredFree(PredictorHandle h);
+
 #ifdef __cplusplus
 }
 #endif

@@ -1,0 +1,53 @@
+/* External-operator C ABI (reference include/mxnet/lib_api.h:903-936:
+ * CustomOp::setForward/setInferShape + MXLoadLib dynamic loading).
+ *
+ * A shared library implementing ops exports the four functions below;
+ * mx.library.load("libfoo.so") dlopens it, enumerates the ops, and
+ * registers each in the op registry.  Execution happens host-side
+ * through a JAX pure_callback, so external ops compose with jit /
+ * hybridize / the symbolic executor as an escape hatch — the same role
+ * the reference's external ops play (host fallback, lib_api.h), with
+ * shape inference consulted at trace time for XLA's static shapes.
+ *
+ * v1 contract: float32 tensors, up to MXT_EXT_MAX_NDIM dims, one output
+ * per op.  All functions return 0 on success, nonzero on failure.
+ */
+#ifndef MXT_EXT_OP_H_
+#define MXT_EXT_OP_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define MXT_EXT_ABI_VERSION 1
+#define MXT_EXT_MAX_NDIM 8
+
+/* ABI version handshake; loader refuses a mismatch. */
+int mxt_ext_abi_version(void);
+
+/* Number of ops in this library. */
+int mxt_ext_num_ops(void);
+
+/* Name and arity of op `idx`. */
+const char* mxt_ext_op_name(int idx);
+int mxt_ext_op_num_inputs(int idx);
+
+/* Output shape from input shapes (trace-time; static shapes). */
+int mxt_ext_op_infer_shape(int idx, int nin,
+                           const int64_t* const* in_shapes,
+                           const int* in_ndims,
+                           int64_t* out_shape, int* out_ndim);
+
+/* Forward kernel: contiguous float32 buffers. */
+int mxt_ext_op_forward(int idx, int nin,
+                       const float* const* in_data,
+                       const int64_t* const* in_shapes,
+                       const int* in_ndims,
+                       float* out_data);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* MXT_EXT_OP_H_ */

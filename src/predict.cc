@@ -1,0 +1,236 @@
+/* Deploy-only predict C ABI (reference include/mxnet/c_predict_api.h +
+ * src/c_api/c_predict_api.cc).
+ *
+ * Architecture parity with the reference: c_predict_api.cc is a thin C
+ * shim over the full libmxnet runtime; here the shim drives the same
+ * XLA/PJRT runtime the Python frontend uses, through an embedded
+ * interpreter running ONLY the artifact loader
+ * (incubator_mxnet_tpu/deploy.py load_predictor) — no user/model Python
+ * code is involved, the model is the serialized StableHLO executable +
+ * .params weights produced by deploy.export_model.
+ *
+ * Built separately from libmxtpu.so (needs -lpython3.x):
+ *   make -C src predict
+ * producing libmxtpredict.so and the smoke binary mxt_predict_smoke.
+ */
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "include/mxt/c_api.h"
+#include "error.h"
+
+namespace {
+
+struct Predictor {
+  PyObject* pred = nullptr;       // deploy.Predictor instance
+  PyObject* meta_inputs = nullptr;   // list of {"shape","dtype"}
+  PyObject* outputs = nullptr;    // last forward's outputs (tuple/array)
+  std::vector<std::string> input_bufs;
+};
+
+bool EnsurePython() {
+  if (Py_IsInitialized()) return true;
+  Py_InitializeEx(0);
+  return Py_IsInitialized();
+}
+
+/* Fetch the python error as a string and stash it in the mxt error slot. */
+int PyFail(const char* where) {
+  std::string msg = std::string(where) + ": python error";
+  if (PyErr_Occurred()) {
+    PyObject *type, *value, *tb;
+    PyErr_Fetch(&type, &value, &tb);
+    PyObject* s = value ? PyObject_Str(value) : nullptr;
+    if (s) {
+      msg = std::string(where) + ": " + PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+  }
+  mxt::SetLastError(msg);
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXTPredCreate(const char* artifact_prefix, PredictorHandle* out) {
+  if (!EnsurePython()) {
+    mxt::SetLastError("python runtime failed to initialize");
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* mod = PyImport_ImportModule("incubator_mxnet_tpu.deploy");
+  if (!mod) {
+    int rc = PyFail("MXTPredCreate(import deploy)");
+    PyGILState_Release(gil);
+    return rc;
+  }
+  PyObject* pred = PyObject_CallMethod(mod, "load_predictor", "s",
+                                       artifact_prefix);
+  Py_DECREF(mod);
+  if (!pred) {
+    int rc = PyFail("MXTPredCreate(load_predictor)");
+    PyGILState_Release(gil);
+    return rc;
+  }
+  PyObject* meta = PyObject_GetAttrString(pred, "meta");
+  PyObject* inputs = meta ? PyDict_GetItemString(meta, "inputs") : nullptr;
+  Py_XINCREF(inputs);
+  Py_XDECREF(meta);
+  auto* p = new Predictor();
+  p->pred = pred;
+  p->meta_inputs = inputs;
+  p->input_bufs.resize(inputs ? (size_t)PyList_Size(inputs) : 1);
+  *out = p;
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXTPredSetInput(PredictorHandle h, uint32_t index, const float* data,
+                    uint64_t size) {
+  auto* p = static_cast<Predictor*>(h);
+  if (index >= p->input_bufs.size()) {
+    mxt::SetLastError("MXTPredSetInput: input index out of range");
+    return -1;
+  }
+  p->input_bufs[index].assign(reinterpret_cast<const char*>(data),
+                              size * sizeof(float));
+  return 0;
+}
+
+int MXTPredForward(PredictorHandle h) {
+  auto* p = static_cast<Predictor*>(h);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (!np) {
+    int rc = PyFail("MXTPredForward(import numpy)");
+    PyGILState_Release(gil);
+    return rc;
+  }
+  Py_ssize_t n = (Py_ssize_t)p->input_bufs.size();
+  PyObject* args = PyTuple_New(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* spec = PyList_GetItem(p->meta_inputs, i);
+    PyObject* shape = PyDict_GetItemString(spec, "shape");
+    PyObject* dtype = PyDict_GetItemString(spec, "dtype");
+    PyObject* bytes = PyBytes_FromStringAndSize(
+        p->input_bufs[i].data(), (Py_ssize_t)p->input_bufs[i].size());
+    /* np.frombuffer(bytes, dtype).reshape(shape) */
+    PyObject* flat = PyObject_CallMethod(np, "frombuffer", "OO", bytes,
+                                         dtype);
+    Py_DECREF(bytes);
+    PyObject* arr = flat ? PyObject_CallMethod(flat, "reshape", "O", shape)
+                         : nullptr;
+    Py_XDECREF(flat);
+    if (!arr) {
+      Py_DECREF(args);
+      Py_DECREF(np);
+      int rc = PyFail("MXTPredForward(build input)");
+      PyGILState_Release(gil);
+      return rc;
+    }
+    PyTuple_SET_ITEM(args, i, arr);
+  }
+  Py_DECREF(np);
+  PyObject* out = PyObject_CallObject(p->pred, args);
+  Py_DECREF(args);
+  if (!out) {
+    int rc = PyFail("MXTPredForward(call)");
+    PyGILState_Release(gil);
+    return rc;
+  }
+  Py_XDECREF(p->outputs);
+  p->outputs = out;
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXTPredGetOutput(PredictorHandle h, uint32_t index, float* out,
+                     uint64_t size) {
+  auto* p = static_cast<Predictor*>(h);
+  if (!p->outputs) {
+    mxt::SetLastError("MXTPredGetOutput: call MXTPredForward first");
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* o = p->outputs;
+  bool unwrap = PyTuple_Check(o) || PyList_Check(o);
+  PyObject* item = unwrap ? PySequence_GetItem(o, (Py_ssize_t)index)
+                          : (Py_INCREF(o), o);
+  if (!item) {
+    int rc = PyFail("MXTPredGetOutput(index)");
+    PyGILState_Release(gil);
+    return rc;
+  }
+  /* item.astype('float32').tobytes() */
+  PyObject* f32 = PyObject_CallMethod(item, "astype", "s", "float32");
+  Py_DECREF(item);
+  PyObject* bytes = f32 ? PyObject_CallMethod(f32, "tobytes", nullptr)
+                        : nullptr;
+  Py_XDECREF(f32);
+  if (!bytes) {
+    int rc = PyFail("MXTPredGetOutput(tobytes)");
+    PyGILState_Release(gil);
+    return rc;
+  }
+  char* buf;
+  Py_ssize_t blen;
+  PyBytes_AsStringAndSize(bytes, &buf, &blen);
+  if ((uint64_t)blen > size * sizeof(float)) {
+    Py_DECREF(bytes);
+    mxt::SetLastError("MXTPredGetOutput: output buffer too small");
+    PyGILState_Release(gil);
+    return -1;
+  }
+  std::memcpy(out, buf, (size_t)blen);
+  Py_DECREF(bytes);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXTPredGetOutputSize(PredictorHandle h, uint32_t index, uint64_t* size) {
+  auto* p = static_cast<Predictor*>(h);
+  if (!p->outputs) {
+    mxt::SetLastError("MXTPredGetOutputSize: call MXTPredForward first");
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* o = p->outputs;
+  bool unwrap = PyTuple_Check(o) || PyList_Check(o);
+  PyObject* item = unwrap ? PySequence_GetItem(o, (Py_ssize_t)index)
+                          : (Py_INCREF(o), o);
+  PyObject* sz = item ? PyObject_GetAttrString(item, "size") : nullptr;
+  Py_XDECREF(item);
+  if (!sz) {
+    int rc = PyFail("MXTPredGetOutputSize");
+    PyGILState_Release(gil);
+    return rc;
+  }
+  *size = (uint64_t)PyLong_AsUnsignedLongLong(sz);
+  Py_DECREF(sz);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXTPredFree(PredictorHandle h) {
+  auto* p = static_cast<Predictor*>(h);
+  if (Py_IsInitialized()) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    Py_XDECREF(p->pred);
+    Py_XDECREF(p->meta_inputs);
+    Py_XDECREF(p->outputs);
+    PyGILState_Release(gil);
+  }
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

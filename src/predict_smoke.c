@@ -1,0 +1,59 @@
+/* C smoke test for the predict ABI: load an exported model and run one
+ * forward without any Python model code (reference
+ * tests/python/predict pattern, but from C).
+ * Usage: mxt_predict_smoke <artifact_prefix> <n_inputs_floats...>
+ * Reads input floats from <prefix>.smoke_in.bin, writes outputs to
+ * <prefix>.smoke_out.bin. Exit 0 on success. */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "include/mxt/c_api.h"
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <prefix> <input_nfloats>\n", argv[0]);
+    return 2;
+  }
+  const char* prefix = argv[1];
+  long nin = atol(argv[2]);
+
+  char path[1024];
+  snprintf(path, sizeof(path), "%s.smoke_in.bin", prefix);
+  FILE* f = fopen(path, "rb");
+  if (!f) { fprintf(stderr, "cannot open %s\n", path); return 2; }
+  float* in = (float*)malloc(nin * sizeof(float));
+  if (fread(in, sizeof(float), nin, f) != (size_t)nin) {
+    fprintf(stderr, "short read\n"); return 2;
+  }
+  fclose(f);
+
+  PredictorHandle h;
+  if (MXTPredCreate(prefix, &h) != 0) {
+    fprintf(stderr, "create failed: %s\n", MXTGetLastError());
+    return 1;
+  }
+  if (MXTPredSetInput(h, 0, in, (uint64_t)nin) != 0 ||
+      MXTPredForward(h) != 0) {
+    fprintf(stderr, "forward failed: %s\n", MXTGetLastError());
+    return 1;
+  }
+  uint64_t nout = 0;
+  if (MXTPredGetOutputSize(h, 0, &nout) != 0) {
+    fprintf(stderr, "size failed: %s\n", MXTGetLastError());
+    return 1;
+  }
+  float* out = (float*)malloc(nout * sizeof(float));
+  if (MXTPredGetOutput(h, 0, out, nout) != 0) {
+    fprintf(stderr, "get failed: %s\n", MXTGetLastError());
+    return 1;
+  }
+  snprintf(path, sizeof(path), "%s.smoke_out.bin", prefix);
+  f = fopen(path, "wb");
+  fwrite(out, sizeof(float), nout, f);
+  fclose(f);
+  printf("predict smoke OK: %llu floats\n", (unsigned long long)nout);
+  MXTPredFree(h);
+  free(in);
+  free(out);
+  return 0;
+}

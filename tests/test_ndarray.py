@@ -170,3 +170,21 @@ def test_one_hot_take_pick():
     assert nd.take(data, nd.array([1], dtype="int32"),
                    axis=1).asnumpy().ravel().tolist() == [2, 5]
     assert nd.pick(data, nd.array([0, 2]), axis=1).asnumpy().tolist() == [1, 6]
+
+
+def test_np_grad_with_leading_scalar():
+    """Cotangent slot routing when non-arrays precede NDArrays
+    (round-3 review regression: np.subtract(1.0, x) handed x the
+    scalar's gradient)."""
+    import numpy as onp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+    x = mx.np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.np.subtract(1.0, x)
+        loss = (y * y).sum()
+    loss.backward()
+    onp.testing.assert_allclose(
+        x.grad.asnumpy(), -2.0 * (1.0 - onp.array([1., 2., 3.])),
+        rtol=1e-6)
