@@ -1,0 +1,47 @@
+"""TensorBoard logging bridge (reference python/mxnet/contrib/tensorboard.py).
+
+``LogMetricsCallback`` plugs into the fit/epoch callback slots and
+writes EvalMetric values as TensorBoard scalars.  Uses tensorboardX (or
+tensorboard's SummaryWriter) when available.
+"""
+from __future__ import annotations
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Log metric values each callback invocation (reference
+    contrib/tensorboard.py:33).
+
+    Usage::
+
+        cb = LogMetricsCallback('logs/train')
+        model.fit(..., batch_end_callback=[cb])
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        try:
+            from tensorboardX import SummaryWriter
+        except ImportError:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+            except ImportError as e:
+                raise ImportError(
+                    "LogMetricsCallback needs tensorboardX or torch "
+                    "(pip install tensorboardX)") from e
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        """BatchEndParam-style callback (reference model.py callbacks)."""
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(name, value, self.step)
+
+    def close(self):
+        self.summary_writer.close()

@@ -31,12 +31,23 @@ import jax.numpy as jnp
 __all__ = ["export_model", "load_predictor"]
 
 
+def _tuples_to_lists(tree):
+    if isinstance(tree, tuple):
+        return [_tuples_to_lists(t) for t in tree]
+    if isinstance(tree, list):
+        return [_tuples_to_lists(t) for t in tree]
+    if isinstance(tree, dict):
+        return {k: _tuples_to_lists(v) for k, v in tree.items()}
+    return tree
+
+
 def _block_forward_fn(block):
     params, apply_fn = block.functional()
 
     def fwd(params, *inputs):
-        out = apply_fn(params, *inputs, training=False)
-        return out[0] if isinstance(out, tuple) else out
+        # keep multi-output forwards intact: the predictor exposes
+        # indexed outputs (MXTPredGetOutput), so no truncation here
+        return apply_fn(params, *inputs, training=False)
 
     return params, fwd
 
@@ -57,6 +68,10 @@ def export_model(model, example_inputs, prefix, params=None):
         fwd = model
         if params is None:
             raise ValueError("pure-function export needs params=")
+    # normalize containers so the traced pytree matches what
+    # _unflatten_keystr reconstructs at load time (tuples → lists;
+    # keystr cannot distinguish them)
+    params = _tuples_to_lists(params)
 
     example = tuple(
         x.data if isinstance(x, NDArray) else jnp.asarray(x)
@@ -122,19 +137,43 @@ class Predictor:
 
 
 def _unflatten_keystr(flat: dict):
-    """Invert jax.tree_util.keystr for dict-of-dict pytrees
-    (keys look like ``['a']['b']``)."""
+    """Invert jax.tree_util.keystr for pytrees of nested dicts, lists
+    and tuples (keys look like ``['a'][0]['b']``; tuples come back as
+    lists, which jax treats as the same pytree shape for calling)."""
     import re
-    root: dict = {}
+    token = re.compile(r"\['([^']+)'\]|\[(\d+)\]")
+    root: dict | list | None = None
+
+    def ensure(container, key, make):
+        if isinstance(key, int):
+            while len(container) <= key:
+                container.append(None)
+            if container[key] is None:
+                container[key] = make()
+            return container[key]
+        if key not in container:
+            container[key] = make()
+        return container[key]
+
     for keystr, val in flat.items():
-        parts = re.findall(r"\['([^']+)'\]", keystr)
+        parts = [(m.group(1) if m.group(1) is not None else int(m.group(2)))
+                 for m in token.finditer(keystr)]
         if not parts:
             parts = [keystr]
+        kinds = [list if isinstance(p, int) else dict for p in parts]
+        if root is None:
+            root = kinds[0]()
         node = root
-        for p in parts[:-1]:
-            node = node.setdefault(p, {})
-        node[parts[-1]] = val
-    return root
+        for i, p in enumerate(parts[:-1]):
+            node = ensure(node, p, kinds[i + 1])
+        last = parts[-1]
+        if isinstance(last, int):
+            while len(node) <= last:
+                node.append(None)
+            node[last] = val
+        else:
+            node[last] = val
+    return root if root is not None else {}
 
 
 def load_predictor(prefix):

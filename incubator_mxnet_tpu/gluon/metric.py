@@ -9,8 +9,10 @@ from ..base import registry
 from ..ndarray import NDArray
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
-           "F1", "MCC", "MAE", "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
-           "Perplexity", "PearsonCorrelation", "PCC", "Loss", "CustomMetric",
+           "F1", "Fbeta", "MCC", "MAE", "MSE", "RMSE", "CrossEntropy",
+           "NegativeLogLikelihood", "Perplexity", "PearsonCorrelation",
+           "PCC", "BinaryAccuracy", "MeanPairwiseDistance",
+           "MeanCosineSimilarity", "Torch", "Caffe", "Loss", "CustomMetric",
            "create", "np"]
 
 _reg = registry("metric")
@@ -313,7 +315,126 @@ class PearsonCorrelation(EvalMetric):
         return self.name, float(onp.corrcoef(lab, pred)[0, 1])
 
 
-PCC = PearsonCorrelation
+@_reg.register(name="pcc")
+class PCC(EvalMetric):
+    """Multiclass Pearson correlation on the confusion matrix
+    (reference metric.py:1651) — the K-class generalization of MCC."""
+
+    def __init__(self, name="pcc", **kwargs):
+        super().__init__(name, **kwargs)
+        self.reset()
+
+    def reset(self):
+        super().reset()
+        self._cm = onp.zeros((0, 0), onp.float64)
+
+    def _grow(self, k):
+        if k > self._cm.shape[0]:
+            cm = onp.zeros((k, k), onp.float64)
+            cm[:self._cm.shape[0], :self._cm.shape[1]] = self._cm
+            self._cm = cm
+
+    def update(self, labels, preds):
+        for label, pred in zip(_to_list(labels), _to_list(preds)):
+            label = _as_np(label).ravel().astype("int64")
+            pred = _as_np(pred)
+            if pred.ndim > 1:
+                pred = pred.argmax(-1).ravel()
+            pred = pred.astype("int64")
+            k = int(max(label.max(initial=0), pred.max(initial=0))) + 1
+            self._grow(k)
+            onp.add.at(self._cm, (label, pred), 1.0)
+            self.num_inst += label.size
+
+    def get(self):
+        c = self._cm
+        if self.num_inst == 0 or c.size == 0:
+            return self.name, float("nan")
+        n = c.sum()
+        t = c.sum(axis=1)   # true occurrences per class
+        p = c.sum(axis=0)   # predicted occurrences per class
+        cov_tp = onp.trace(c) * n - (t * p).sum()
+        cov_tt = n * n - (t * t).sum()
+        cov_pp = n * n - (p * p).sum()
+        denom = math.sqrt(max(cov_tt * cov_pp, 0.0))
+        return self.name, float(cov_tp / denom) if denom else float("nan")
+
+
+@_reg.register(name="fbeta")
+class Fbeta(F1):
+    """Fbeta score for binary classification (reference metric.py:815):
+    (1+beta^2) * P * R / (beta^2 * P + R)."""
+
+    def __init__(self, name="fbeta", beta=1.0, threshold=0.5, **kwargs):
+        self.beta = beta
+        super().__init__(name=name, threshold=threshold, **kwargs)
+
+    def get(self):
+        prec = self.tp / max(self.tp + self.fp, 1)
+        rec = self.tp / max(self.tp + self.fn, 1)
+        b2 = self.beta ** 2
+        denom = b2 * prec + rec
+        fbeta = (1 + b2) * prec * rec / denom if denom else 0.0
+        return self.name, fbeta
+
+
+@_reg.register(name="binary_accuracy")
+class BinaryAccuracy(EvalMetric):
+    """Binary/multilabel accuracy at a threshold (reference
+    metric.py:876)."""
+
+    def __init__(self, name="binary_accuracy", threshold=0.5, **kwargs):
+        super().__init__(name, **kwargs)
+        self.threshold = threshold
+
+    def update(self, labels, preds):
+        for label, pred in zip(_to_list(labels), _to_list(preds)):
+            label = _as_np(label).ravel()
+            pred = (_as_np(pred).ravel() > self.threshold)
+            self.sum_metric += float((pred == (label > 0.5)).sum())
+            self.num_inst += label.size
+
+
+@_reg.register(name="mpd")
+class MeanPairwiseDistance(EvalMetric):
+    """Mean p-norm distance between rows (reference metric.py:1197)."""
+
+    def __init__(self, name="mpd", p=2.0, **kwargs):
+        super().__init__(name, **kwargs)
+        self.p = p
+
+    def update(self, labels, preds):
+        for label, pred in zip(_to_list(labels), _to_list(preds)):
+            label = _as_np(label)
+            pred = _as_np(pred)
+            diff = onp.abs(pred.reshape(pred.shape[0], -1)
+                           - label.reshape(label.shape[0], -1)) ** self.p
+            dist = diff.sum(axis=1) ** (1.0 / self.p)
+            self.sum_metric += float(dist.sum())
+            self.num_inst += pred.shape[0]
+
+
+@_reg.register(name="cos_sim")
+class MeanCosineSimilarity(EvalMetric):
+    """Mean cosine similarity along the last axis (reference
+    metric.py:1263)."""
+
+    def __init__(self, name="cos_sim", eps=1e-12, **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(_to_list(labels), _to_list(preds)):
+            label = _as_np(label)
+            pred = _as_np(pred)
+            num = (label * pred).sum(axis=-1)
+            den = onp.maximum(
+                onp.linalg.norm(label, axis=-1)
+                * onp.linalg.norm(pred, axis=-1), self.eps)
+            sim = num / den
+            self.sum_metric += float(sim.sum())
+            self.num_inst += sim.size
+
 
 
 @_reg.register(name="loss")
@@ -355,3 +476,17 @@ def np(numpy_feval, name="custom", allow_extra_outputs=False):
     feval.__name__ = getattr(numpy_feval, "__name__", name)
     return CustomMetric(feval, name=feval.__name__,
                         allow_extra_outputs=allow_extra_outputs)
+@_reg.register(name="torch")
+class Torch(Loss):
+    """Legacy alias (reference metric.py Torch: Loss-style mean)."""
+
+    def __init__(self, name="torch", **kwargs):
+        super().__init__(name=name, **kwargs)
+
+
+@_reg.register(name="caffe")
+class Caffe(Loss):
+    """Legacy alias (reference metric.py Caffe)."""
+
+    def __init__(self, name="caffe", **kwargs):
+        super().__init__(name=name, **kwargs)

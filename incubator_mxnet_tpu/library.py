@@ -107,9 +107,11 @@ def _make_ext_fn(lib, idx, name):
     return fn
 
 
-def load(path, verbose=True):
+def load(path, verbose=True, allow_override=False):
     """Load an external-op library (reference mx.library.load →
-    MXLoadLib).  Returns the list of op names registered."""
+    MXLoadLib).  Returns the list of op names registered.  Refuses to
+    shadow a builtin op unless ``allow_override=True`` (a silent clobber
+    would reroute e.g. every relu through a host callback)."""
     path = os.path.abspath(path)
     lib = _declare(ctypes.CDLL(path))
     abi = lib.mxt_ext_abi_version()
@@ -120,6 +122,10 @@ def load(path, verbose=True):
     n = lib.mxt_ext_num_ops()
     for idx in range(n):
         name = lib.mxt_ext_op_name(idx).decode()
+        if name in _OPS and not allow_override:
+            raise ValueError(
+                f"{path}: op {name!r} already registered; pass "
+                "allow_override=True to replace the builtin")
         nin = lib.mxt_ext_op_num_inputs(idx)
         op = Op(name, _make_ext_fn(lib, idx, name), differentiable=False,
                 num_inputs=nin)

@@ -14,7 +14,10 @@ from . import ndarray as nd
 
 __all__ = ["default_context", "assert_almost_equal", "almost_equal",
            "check_numeric_gradient", "check_consistency", "rand_ndarray",
-           "rand_shape_nd", "same"]
+           "rand_shape_nd", "same", "with_seed", "assert_exception",
+           "rand_sparse_ndarray", "check_symbolic_forward",
+           "check_symbolic_backward", "compare_optimizer", "EnvManager",
+           "DummyIter"]
 
 _default_ctx = None
 
@@ -133,3 +136,168 @@ def list_gpus():
 
 def download(url, fname=None, dirname=None, overwrite=False, retries=5):
     raise RuntimeError("network egress is unavailable in this environment")
+
+
+# ---------------------------------------------------------------------------
+# round-3 additions: the remaining load-bearing helpers of the
+# reference's test_utils.py / tests/python/unittest/common.py surface
+# ---------------------------------------------------------------------------
+
+def with_seed(seed=None):
+    """Decorator: reproducible per-test RNG with the failure banner
+    (reference tests/python/unittest/common.py:with_seed).  Seeds both
+    numpy and the framework stream; on failure prints the seed so the
+    run can be replayed with MXNET_TEST_SEED."""
+    import functools
+    import os
+    import sys
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            env = os.environ.get("MXNET_TEST_SEED")
+            this_seed = (int(env) if env is not None
+                         else seed if seed is not None
+                         else int.from_bytes(os.urandom(4), "little"))
+            onp.random.seed(this_seed)
+            from . import random as _random
+            _random.seed(this_seed)
+            try:
+                return fn(*args, **kwargs)
+            except Exception:
+                print(f"*** test failed with seed {this_seed}: set "
+                      f"MXNET_TEST_SEED={this_seed} to reproduce ***",
+                      file=sys.stderr)
+                raise
+        return wrapper
+
+    return deco
+
+
+def assert_exception(fn, exception_type, *args, **kwargs):
+    """fn(*args) must raise exception_type (reference test_utils.py)."""
+    try:
+        fn(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError(
+        f"{fn} did not raise {exception_type.__name__}")
+
+
+def rand_sparse_ndarray(shape, stype, density=0.5, dtype="float32"):
+    """Random sparse array + its constituent buffers
+    (reference test_utils.py:388 rand_sparse_ndarray)."""
+    from .ndarray import sparse
+    arr = rand_ndarray(shape, stype=stype, density=density, dtype=dtype)
+    if stype == "row_sparse":
+        return arr, (onp.asarray(arr._rs_values), onp.asarray(arr._rs_indices))
+    if stype == "csr":
+        return arr, (onp.asarray(arr._csr_data), onp.asarray(arr._csr_indices),
+                     onp.asarray(arr._csr_indptr))
+    raise ValueError(f"not a sparse stype: {stype}")
+
+
+def check_symbolic_forward(sym, locations, expected, rtol=1e-4, atol=1e-5,
+                           ctx=None):
+    """Bind a symbol, run forward, compare each output
+    (reference test_utils.py check_symbolic_forward)."""
+    arg_names = sym.list_arguments()
+    if isinstance(locations, (list, tuple)):
+        locations = dict(zip(arg_names, locations))
+    ex = sym.simple_bind(
+        ctx=ctx, **{k: onp.asarray(v).shape for k, v in locations.items()})
+    for k, v in locations.items():
+        if k in ex.arg_dict:
+            ex.arg_dict[k][:] = onp.asarray(v)
+    outs = ex.forward()
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    for i, (o, e) in enumerate(zip(outs, expected)):
+        assert_almost_equal(o.asnumpy(), onp.asarray(e), rtol=rtol,
+                            atol=atol, names=(f"out[{i}]", f"expected[{i}]"))
+    return outs
+
+
+def check_symbolic_backward(sym, locations, out_grads, expected_grads,
+                            rtol=1e-4, atol=1e-5, ctx=None):
+    """Bind, forward, backward with given head gradients, compare arg
+    grads (reference test_utils.py check_symbolic_backward)."""
+    arg_names = sym.list_arguments()
+    if isinstance(locations, (list, tuple)):
+        locations = dict(zip(arg_names, locations))
+    if isinstance(expected_grads, (list, tuple)):
+        expected_grads = dict(zip(arg_names, expected_grads))
+    ex = sym.simple_bind(
+        ctx=ctx, **{k: onp.asarray(v).shape for k, v in locations.items()})
+    for k, v in locations.items():
+        if k in ex.arg_dict:
+            ex.arg_dict[k][:] = onp.asarray(v)
+    ex.forward(is_train=True)
+    ex.backward([nd.array(g) for g in (
+        out_grads if isinstance(out_grads, (list, tuple)) else [out_grads])])
+    for name, exp in expected_grads.items():
+        assert_almost_equal(ex.grad_dict[name].asnumpy(), onp.asarray(exp),
+                            rtol=rtol, atol=atol,
+                            names=(f"grad[{name}]", "expected"))
+    return ex
+
+
+def compare_optimizer(opt1, opt2, shapes=((4, 3),), dtype="float32",
+                      w_stype="default", g_stype="default", rtol=1e-4,
+                      atol=1e-5, nsteps=3):
+    """Run two optimizers over identical weight/grad streams and demand
+    identical trajectories (reference test_utils.py compare_optimizer)."""
+    for shape in shapes:
+        w_np = onp.random.uniform(-1, 1, size=shape).astype(dtype)
+        w1 = nd.array(w_np.copy())
+        w2 = nd.array(w_np.copy())
+        s1 = opt1.create_state(0, w1)
+        s2 = opt2.create_state(0, w2)
+        for _ in range(nsteps):
+            g_np = onp.random.uniform(-1, 1, size=shape).astype(dtype)
+            opt1.update(0, w1, nd.array(g_np.copy()), s1)
+            opt2.update(0, w2, nd.array(g_np.copy()), s2)
+            assert_almost_equal(w1.asnumpy(), w2.asnumpy(), rtol=rtol,
+                                atol=atol, names=("opt1_w", "opt2_w"))
+
+
+class EnvManager:
+    """Scoped environment variable (reference test_utils.py EnvManager)."""
+
+    def __init__(self, key, val):
+        self._key = key
+        self._val = val
+        self._prev = None
+
+    def __enter__(self):
+        import os
+        self._prev = os.environ.get(self._key)
+        os.environ[self._key] = self._val
+        return self
+
+    def __exit__(self, *exc):
+        import os
+        if self._prev is None:
+            os.environ.pop(self._key, None)
+        else:
+            os.environ[self._key] = self._prev
+
+
+class DummyIter:
+    """Endless repetition of one batch (reference test_utils.py DummyIter)."""
+
+    def __init__(self, real_iter):
+        self._iter = real_iter
+        self._batch = next(iter(real_iter))
+        self.batch_size = getattr(real_iter, "batch_size", None)
+        self.provide_data = getattr(real_iter, "provide_data", None)
+        self.provide_label = getattr(real_iter, "provide_label", None)
+
+    def __iter__(self):
+        while True:
+            yield self._batch
+
+    def next(self):
+        return self._batch
+
+    def reset(self):
+        pass

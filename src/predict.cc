@@ -35,7 +35,11 @@ struct Predictor {
 bool EnsurePython() {
   if (Py_IsInitialized()) return true;
   Py_InitializeEx(0);
-  return Py_IsInitialized();
+  if (!Py_IsInitialized()) return false;
+  /* release the GIL the init thread implicitly holds, so other threads'
+   * PyGILState_Ensure() calls don't deadlock */
+  PyEval_SaveThread();
+  return true;
 }
 
 /* Fetch the python error as a string and stash it in the mxt error slot. */

@@ -302,3 +302,40 @@ def test_summary_runs(capsys):
     net.initialize()
     net.summary(nd.ones((1, 3)))
     assert "Total params" in capsys.readouterr().out
+
+
+def test_metrics_tail():
+    """Round-3 metric additions (reference gluon/metric.py:815-1300)."""
+    import numpy as onp
+    from incubator_mxnet_tpu.gluon import metric as M
+
+    ba = M.BinaryAccuracy(threshold=0.6)
+    ba.update([onp.array([0., 1., 0.])], [onp.array([0.7, 1., 0.55])])
+    assert abs(ba.get()[1] - 2.0 / 3.0) < 1e-9  # reference docstring example
+
+    mpd = M.MeanPairwiseDistance()
+    mpd.update([onp.array([[1., 0.], [4., 2.]])],
+               [onp.array([[1., 2.], [3., 4.]])])
+    assert abs(mpd.get()[1] - (2 + onp.sqrt(5.0)) / 2) < 1e-9
+
+    cs = M.MeanCosineSimilarity()
+    cs.update([onp.array([[1., 0.], [0., 1.]])],
+              [onp.array([[1., 0.], [1., 0.]])])
+    assert abs(cs.get()[1] - 0.5) < 1e-9
+
+    fb = M.Fbeta(beta=1.0, threshold=0.5)
+    f1 = M.F1(threshold=0.5)
+    y = [onp.array([1, 0, 1, 1])]
+    p = [onp.array([0.9, 0.8, 0.2, 0.7])]
+    fb.update(y, p); f1.update(y, p)
+    assert abs(fb.get()[1] - f1.get()[1]) < 1e-12  # beta=1 == F1
+
+    # PCC on perfect 3-class predictions == 1.0
+    pcc = M.PCC()
+    labels = [onp.array([0, 1, 2, 1, 0])]
+    preds = [onp.eye(3)[labels[0]]]
+    pcc.update(labels, preds)
+    assert abs(pcc.get()[1] - 1.0) < 1e-9
+
+    assert M.create("pcc").name == "pcc"
+    assert isinstance(M.Torch(), M.Loss) and isinstance(M.Caffe(), M.Loss)

@@ -84,3 +84,20 @@ def test_c_predict_smoke(tmp_path):
     out = onp.fromfile(prefix + ".smoke_out.bin", onp.float32) \
         .reshape(ref.shape)
     onp.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_export_params_with_list_pytree(tmp_path):
+    import jax.numpy as jnp
+
+    def fwd(params, x):
+        h = x @ params["layers"][0]
+        return h @ params["layers"][1] + params["b"]
+
+    params = {"layers": [jnp.ones((4, 5)), jnp.full((5, 2), 2.0)],
+              "b": jnp.zeros((2,))}
+    x = onp.random.RandomState(1).rand(3, 4).astype(onp.float32)
+    prefix = str(tmp_path / "lst")
+    deploy.export_model(fwd, (x,), prefix, params=params)
+    pred = deploy.load_predictor(prefix)
+    ref = (x @ onp.ones((4, 5))) @ onp.full((5, 2), 2.0)
+    onp.testing.assert_allclose(pred(x), ref, rtol=1e-5)
