@@ -1,0 +1,3 @@
+"""SVRG optimization (reference contrib/svrg_optimization/)."""
+from .svrg_optimizer import SVRGOptimizer
+from .svrg_module import SVRGModule

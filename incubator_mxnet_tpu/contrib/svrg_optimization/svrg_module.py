@@ -1,0 +1,106 @@
+"""SVRGModule (reference contrib/svrg_optimization/svrg_module.py:30).
+
+Extends the Module training loop with the SVRG schedule: every
+``update_freq`` epochs, snapshot the weights and accumulate the full
+gradient mu over the dataset (reference update_full_grads:292); each
+batch then applies the variance-reduced gradient
+g_i(w) - g_i(w_snapshot) + mu through the base updater.
+"""
+from __future__ import annotations
+
+from ...module import Module
+from ...ndarray import NDArray
+from ... import ndarray as nd
+
+
+class SVRGModule(Module):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, **kwargs)
+        self.update_freq = update_freq
+        self._param_snapshot = {}
+        self._mu = {}
+        self._last_batch = None
+
+    def forward(self, data_batch, is_train=None):
+        self._last_batch = data_batch
+        return super().forward(data_batch, is_train=is_train)
+
+    def _grads(self):
+        return {name: self._exec_group.sum_grad(name)
+                for name in self._param_names}
+
+    def update_full_grads(self, train_data):
+        """Snapshot weights and accumulate the full-dataset gradient mu
+        (reference svrg_module.py:292)."""
+        arg_params, _ = self.get_params()
+        self._param_snapshot = {k: v.copy() for k, v in arg_params.items()}
+        sums = {k: nd.zeros(v.shape) for k, v in arg_params.items()}
+        nbatch = 0
+        train_data.reset()
+        for batch in train_data:
+            self.forward(batch, is_train=True)
+            self.backward()
+            for name, g in self._grads().items():
+                if g is not None:
+                    sums[name] = NDArray(sums[name].data + g.data)
+            nbatch += 1
+        train_data.reset()
+        self._mu = {k: NDArray(v.data / max(nbatch, 1))
+                    for k, v in sums.items()}
+
+    def update_svrg(self):
+        """One variance-reduced step for the last forwarded batch
+        (falls back to a plain update before the first snapshot)."""
+        if not self._param_snapshot:
+            return self.update()
+        assert self._last_batch is not None, "forward a batch first"
+        cur_grads = {k: (v.copy() if v is not None else None)
+                     for k, v in self._grads().items()}
+        # gradient of the SAME batch at the snapshot weights
+        current = {k: v.copy() for k, v in self.get_params()[0].items()}
+        self._exec_group.set_params(self._param_snapshot, allow_extra=True)
+        super().forward(self._last_batch, is_train=True)
+        self.backward()
+        snap_grads = {k: (v.copy() if v is not None else None)
+                      for k, v in self._grads().items()}
+        self._exec_group.set_params(current, allow_extra=True)
+        for i, name in enumerate(self._param_names):
+            g, gs = cur_grads[name], snap_grads[name]
+            if g is None:
+                continue
+            corrected = NDArray(g.data - gs.data + self._mu[name].data)
+            self._updater(i, corrected, self._arg_params[name])
+        self._exec_group.set_params(self._arg_params, allow_extra=True)
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            num_epoch=1, **kwargs):
+        """Training loop with the SVRG schedule (reference
+        svrg_module.py fit): refresh the snapshot every update_freq
+        epochs, variance-reduced updates in between."""
+        from ...gluon import metric as metric_mod
+        if not self.binded:
+            first = next(iter(train_data))
+            train_data.reset()
+            self.bind(data_shapes=[("data", first.data[0].shape)],
+                      label_shapes=[("softmax_label",
+                                     first.label[0].shape)],
+                      for_training=True)
+        if not self.params_initialized:
+            self.init_params()
+        if not self.optimizer_initialized:
+            self.init_optimizer()
+        metric = metric_mod.create(eval_metric) \
+            if isinstance(eval_metric, str) else eval_metric
+        for epoch in range(num_epoch):
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            train_data.reset()
+            metric.reset()
+            for batch in train_data:
+                self.forward(batch, is_train=True)
+                self.backward()
+                self.update_svrg()
+                self.update_metric(metric, batch.label)
+        return metric

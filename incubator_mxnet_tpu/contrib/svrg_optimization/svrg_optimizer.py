@@ -1,0 +1,41 @@
+"""SVRG optimizer wrapper (reference contrib/svrg_optimization/
+svrg_optimizer.py:66).
+
+Stochastic Variance Reduced Gradient: the effective gradient for a
+batch is  g_i(w) - g_i(w_snapshot) + mu  where mu is the full-dataset
+gradient at the snapshot weights.  This wrapper delegates the actual
+update to any registered optimizer after the variance correction; the
+special index convention (the reference routes snapshot-gradient slots
+through the same kvstore by key offset) is replaced here by explicit
+arrays handed in by SVRGModule.
+"""
+from __future__ import annotations
+
+from ... import optimizer as opt_mod
+from ...ndarray import NDArray
+
+
+class SVRGOptimizer(opt_mod.Optimizer):
+    """update(w) with variance-reduced gradient; wraps a base optimizer."""
+
+    def __init__(self, default_optimizer="sgd", **kwargs):
+        base_kwargs = dict(kwargs)
+        super().__init__(**{k: v for k, v in kwargs.items()
+                            if k in ("learning_rate", "rescale_grad", "wd",
+                                     "clip_gradient", "lr_scheduler")})
+        if isinstance(default_optimizer, str):
+            self.default_opt = opt_mod.create(default_optimizer, **base_kwargs)
+        else:
+            self.default_opt = default_optimizer
+
+    def create_state(self, index, weight):
+        return self.default_opt.create_state(index, weight)
+
+    def update_svrg(self, index, weight, grad, grad_snapshot, mu, state):
+        """The SVRG correction + delegated update."""
+        corrected = NDArray(grad.data - grad_snapshot.data + mu.data)
+        self.default_opt.update(index, weight, corrected, state)
+
+    def update(self, index, weight, grad, state):
+        # plain passthrough (used before the first snapshot exists)
+        self.default_opt.update(index, weight, grad, state)

@@ -1,0 +1,5 @@
+"""Text utilities (reference python/mxnet/contrib/text/)."""
+from . import vocab
+from . import embedding
+from . import utils
+from .vocab import Vocabulary

@@ -1,0 +1,135 @@
+"""Token embeddings (reference contrib/text/embedding.py).
+
+Pretrained-vector loading from the GloVe/fastText text format
+("token v1 v2 ... vn" per line).  The reference's downloadable registry
+(GloVe/FastText classes with URL tables) maps here onto
+``CustomEmbedding`` over local files — network egress is environment-
+dependent, the file format is identical.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+
+__all__ = ["TokenEmbedding", "CustomEmbedding", "CompositeEmbedding",
+           "register", "create", "get_pretrained_file_names"]
+
+_REG: dict = {}
+
+
+def register(cls):
+    _REG[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(name, **kwargs):
+    return _REG[name.lower()](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """The reference returns its download registry; this build is
+    offline — pretrained files are supplied locally via
+    CustomEmbedding(pretrained_file_path=...)."""
+    return {name: [] for name in _REG} if embedding_name is None else []
+
+
+class TokenEmbedding:
+    """Base: token → vector with <unk> fallback (reference
+    embedding.py:139 _TokenEmbedding)."""
+
+    def __init__(self, unknown_token="<unk>"):
+        self._unknown_token = unknown_token
+        self._idx_to_token = [unknown_token]
+        self._token_to_idx = {unknown_token: 0}
+        self._idx_to_vec = None
+
+    def _load_text_file(self, path, elem_delim=" ", encoding="utf8"):
+        toks, vecs = [], []
+        with open(path, encoding=encoding) as f:
+            for line in f:
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                toks.append(parts[0])
+                vecs.append(onp.asarray([float(x) for x in parts[1:]],
+                                        onp.float32))
+        dim = vecs[0].shape[0] if vecs else 0
+        self._idx_to_token = [self._unknown_token] + toks
+        self._token_to_idx = {t: i for i, t in
+                              enumerate(self._idx_to_token)}
+        mat = onp.zeros((len(self._idx_to_token), dim), onp.float32)
+        for i, v in enumerate(vecs):
+            mat[i + 1] = v
+        self._idx_to_vec = nd.array(mat)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def vec_len(self):
+        return int(self._idx_to_vec.shape[1]) if self._idx_to_vec is not None else 0
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        idx = []
+        for t in toks:
+            i = self._token_to_idx.get(t)
+            if i is None and lower_case_backup:
+                i = self._token_to_idx.get(t.lower())
+            idx.append(0 if i is None else i)
+        out = NDArray(self._idx_to_vec.data[onp.asarray(idx)])
+        return NDArray(out.data[0]) if single else out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        toks = [tokens] if isinstance(tokens, str) else list(tokens)
+        vecs = new_vectors.asnumpy().reshape(len(toks), -1)
+        mat = onp.array(self._idx_to_vec.asnumpy())  # writable copy
+        for t, v in zip(toks, vecs):
+            if t not in self._token_to_idx:
+                raise ValueError(f"token {t!r} unknown")
+            mat[self._token_to_idx[t]] = v
+        self._idx_to_vec = nd.array(mat)
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Embedding from a local pretrained text file (reference
+    embedding.py CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", **kwargs):
+        super().__init__(**kwargs)
+        self._load_text_file(pretrained_file_path, elem_delim, encoding)
+
+
+@register
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference
+    embedding.py CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        super().__init__(unknown_token=vocabulary.unknown_token)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        parts = []
+        for emb in token_embeddings:
+            parts.append(emb.get_vecs_by_tokens(
+                self._idx_to_token).asnumpy())
+        self._idx_to_vec = nd.array(onp.concatenate(parts, axis=1))

@@ -1,0 +1,90 @@
+"""SVRG optimization tests (reference contrib/svrg_optimization +
+tests/python/unittest/test_contrib_svrg_*)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym
+from incubator_mxnet_tpu.contrib.svrg_optimization import (SVRGModule,
+                                                           SVRGOptimizer)
+from incubator_mxnet_tpu.io import NDArrayIter
+
+
+def _linreg_module(update_freq=2):
+    data = sym.var("data")
+    out = sym.FullyConnected(data, num_hidden=1, no_bias=True, name="fc")
+    out = sym.LinearRegressionOutput(out, name="softmax")
+    return SVRGModule(out, data_names=("data",),
+                      label_names=("softmax_label",),
+                      update_freq=update_freq)
+
+
+def _toy_data(n=64, d=4, seed=0):
+    rng = onp.random.RandomState(seed)
+    x = rng.randn(n, d).astype(onp.float32)
+    w = rng.randn(d, 1).astype(onp.float32)
+    y = (x @ w).ravel()
+    return x, y, w
+
+
+def test_svrg_single_batch_equals_sgd():
+    # with the whole dataset in ONE batch, mu == g(w_snap) on that batch,
+    # so the variance-reduced gradient equals the plain gradient and the
+    # trajectories must match exactly
+    x, y, _ = _toy_data(n=16)
+    def run(module_cls):
+        if module_cls is SVRGModule:
+            mod = _linreg_module(update_freq=1)
+        else:
+            from incubator_mxnet_tpu.module import Module
+            data = sym.var("data")
+            out = sym.FullyConnected(data, num_hidden=1, no_bias=True,
+                                     name="fc")
+            out = sym.LinearRegressionOutput(out, name="softmax")
+            mod = Module(out, data_names=("data",),
+                         label_names=("softmax_label",))
+        it = NDArrayIter(x, y, batch_size=16)
+        first = next(iter(it)); it.reset()
+        mod.bind(data_shapes=[("data", first.data[0].shape)],
+                 label_shapes=[("softmax_label", first.label[0].shape)],
+                 for_training=True)
+        mx.random.seed(7)
+        mod.init_params()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params=(("learning_rate", 0.05),))
+        if module_cls is SVRGModule:
+            mod.update_full_grads(it)
+        for _ in range(3):
+            it.reset()
+            for batch in it:
+                mod.forward(batch, is_train=True)
+                mod.backward()
+                if module_cls is SVRGModule:
+                    mod.update_svrg()
+                else:
+                    mod.update()
+        return mod.get_params()[0]["fc_weight"].asnumpy()
+
+    w_svrg = run(SVRGModule)
+    from incubator_mxnet_tpu.module import Module
+    w_sgd = run(Module)
+    onp.testing.assert_allclose(w_svrg, w_sgd, rtol=1e-5, atol=1e-6)
+
+
+def test_svrg_fit_converges():
+    x, y, w_true = _toy_data(n=64)
+    mod = _linreg_module(update_freq=2)
+    it = NDArrayIter(x, y, batch_size=16, shuffle=False)
+    mod.fit(it, eval_metric="mse", num_epoch=30)
+    w = mod.get_params()[0]["fc_weight"].asnumpy().ravel()
+    onp.testing.assert_allclose(w, w_true.ravel(), rtol=0.05, atol=0.05)
+
+
+def test_svrg_optimizer_delegates():
+    opt = SVRGOptimizer("sgd", learning_rate=0.1)
+    w = nd.ones((3,))
+    g = nd.ones((3,))
+    gs = nd.zeros((3,))
+    mu = nd.zeros((3,))
+    opt.update_svrg(0, w, g, gs, mu, opt.create_state(0, w))
+    onp.testing.assert_allclose(w.asnumpy(), 0.9 * onp.ones(3), rtol=1e-6)

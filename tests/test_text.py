@@ -1,0 +1,49 @@
+"""contrib.text tests (reference tests/python/unittest/test_contrib_text)."""
+import collections
+
+import numpy as onp
+
+from incubator_mxnet_tpu.contrib import text
+
+
+def test_count_tokens():
+    c = text.utils.count_tokens_from_str("a b b c\nc c d", to_lower=False)
+    assert c == collections.Counter({"c": 3, "b": 2, "a": 1, "d": 1})
+
+
+def test_vocabulary_ordering_and_lookup():
+    counter = collections.Counter({"the": 10, "cat": 5, "sat": 5, "rare": 1})
+    v = text.Vocabulary(counter, min_freq=2, reserved_tokens=["<pad>"])
+    assert v.idx_to_token[0] == "<unk>"
+    assert v.idx_to_token[1] == "<pad>"
+    assert v.to_indices("the") == 2       # most frequent first
+    assert v.to_indices("rare") == 0      # below min_freq -> unk
+    assert v.to_tokens([2]) == ["the"]
+    assert len(v) == 5
+
+
+def test_custom_embedding_roundtrip(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("cat 1.0 2.0 3.0\ndog 4.0 5.0 6.0\n")
+    emb = text.embedding.CustomEmbedding(str(p))
+    assert emb.vec_len == 3 and len(emb) == 3
+    onp.testing.assert_array_equal(
+        emb.get_vecs_by_tokens("dog").asnumpy(), [4.0, 5.0, 6.0])
+    out = emb.get_vecs_by_tokens(["cat", "unknown!"])
+    onp.testing.assert_array_equal(out.asnumpy()[1], onp.zeros(3))
+    emb.update_token_vectors("cat", __import__(
+        "incubator_mxnet_tpu").nd.array([[9.0, 9.0, 9.0]]))
+    onp.testing.assert_array_equal(
+        emb.get_vecs_by_tokens("cat").asnumpy(), [9.0, 9.0, 9.0])
+
+
+def test_composite_embedding(tmp_path):
+    p1 = tmp_path / "a.txt"; p1.write_text("cat 1.0 2.0\ndog 3.0 4.0\n")
+    p2 = tmp_path / "b.txt"; p2.write_text("cat 7.0\n")
+    v = text.Vocabulary(collections.Counter({"cat": 2, "dog": 1}))
+    comp = text.embedding.CompositeEmbedding(
+        v, [text.embedding.CustomEmbedding(str(p1)),
+            text.embedding.CustomEmbedding(str(p2))])
+    vec = comp.get_vecs_by_tokens("cat").asnumpy()
+    onp.testing.assert_array_equal(vec, [1.0, 2.0, 7.0])
+    assert comp.get_vecs_by_tokens("dog").asnumpy()[2] == 0.0
