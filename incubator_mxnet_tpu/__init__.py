@@ -50,6 +50,32 @@ def _join_distributed_from_env():
 
 _join_distributed_from_env()
 
+
+def _install_fork_handlers():
+    """Fork safety for multiprocessing DataLoader workers (reference
+    src/initialize.h:39-86 LibraryInitializer fork handlers): a forked
+    child must not inherit the parent's engine lock state or reuse its
+    PRNG stream."""
+    import os
+
+    def _after_fork_child():
+        try:
+            from . import engine
+            engine.reset_engine()
+        except Exception:
+            pass
+        try:
+            from . import random as _random
+            _random.seed(int.from_bytes(os.urandom(4), "little"))
+        except Exception:
+            pass
+
+    if hasattr(os, "register_at_fork"):
+        os.register_at_fork(after_in_child=_after_fork_child)
+
+
+_install_fork_handlers()
+
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus

@@ -75,7 +75,9 @@ class SVRGModule(Module):
         self._exec_group.set_params(self._arg_params, allow_extra=True)
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
-            num_epoch=1, **kwargs):
+            optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
+            kvstore="local", num_epoch=1, initializer=None,
+            batch_end_callback=None, epoch_end_callback=None):
         """Training loop with the SVRG schedule (reference
         svrg_module.py fit): refresh the snapshot every update_freq
         epochs, variance-reduced updates in between."""
@@ -83,14 +85,17 @@ class SVRGModule(Module):
         if not self.binded:
             first = next(iter(train_data))
             train_data.reset()
-            self.bind(data_shapes=[("data", first.data[0].shape)],
-                      label_shapes=[("softmax_label",
-                                     first.label[0].shape)],
-                      for_training=True)
+            self.bind(
+                data_shapes=[(self._data_names[0], first.data[0].shape)],
+                label_shapes=[(self._label_names[0],
+                               first.label[0].shape)],
+                for_training=True)
         if not self.params_initialized:
-            self.init_params()
+            self.init_params(initializer) if initializer is not None \
+                else self.init_params()
         if not self.optimizer_initialized:
-            self.init_optimizer()
+            self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                optimizer_params=optimizer_params)
         metric = metric_mod.create(eval_metric) \
             if isinstance(eval_metric, str) else eval_metric
         for epoch in range(num_epoch):
@@ -101,6 +106,14 @@ class SVRGModule(Module):
             for batch in train_data:
                 self.forward(batch, is_train=True)
                 self.backward()
-                self.update_svrg()
+                # score BEFORE update_svrg: it re-forwards the batch at
+                # the snapshot weights, which would poison the metric
                 self.update_metric(metric, batch.label)
+                self.update_svrg()
+            if epoch_end_callback is not None:
+                epoch_end_callback(epoch, self._symbol, *self.get_params())
+        if eval_data is not None:
+            val = metric_mod.create(eval_metric) \
+                if isinstance(eval_metric, str) else eval_metric
+            self.score(eval_data, val)
         return metric

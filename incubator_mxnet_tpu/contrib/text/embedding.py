@@ -48,14 +48,25 @@ class TokenEmbedding:
     def _load_text_file(self, path, elem_delim=" ", encoding="utf8"):
         toks, vecs = [], []
         with open(path, encoding=encoding) as f:
-            for line in f:
+            for lineno, line in enumerate(f):
                 parts = line.rstrip().split(elem_delim)
+                if lineno == 0 and len(parts) == 2:
+                    try:  # fastText .vec header: "<count> <dim>"
+                        int(parts[0]), int(parts[1])
+                        continue
+                    except ValueError:
+                        pass
                 if len(parts) < 2:
                     continue
                 toks.append(parts[0])
                 vecs.append(onp.asarray([float(x) for x in parts[1:]],
                                         onp.float32))
         dim = vecs[0].shape[0] if vecs else 0
+        bad = [i for i, v in enumerate(vecs) if v.shape[0] != dim]
+        if bad:
+            raise ValueError(
+                f"{path}: line {bad[0] + 1} has {vecs[bad[0]].shape[0]} "
+                f"values, expected {dim} (inconsistent embedding rows)")
         self._idx_to_token = [self._unknown_token] + toks
         self._token_to_idx = {t: i for i, t in
                               enumerate(self._idx_to_token)}

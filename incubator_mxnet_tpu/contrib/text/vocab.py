@@ -22,12 +22,14 @@ class Vocabulary:
         self._reserved_tokens = reserved_tokens
         self._idx_to_token = [unknown_token] + reserved_tokens
         if counter is not None:
+            seen = set(self._idx_to_token)
             pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
             if most_freq_count is not None:
                 pairs = pairs[:most_freq_count]
             for tok, freq in pairs:
-                if freq >= min_freq and tok not in self._idx_to_token:
+                if freq >= min_freq and tok not in seen:
                     self._idx_to_token.append(tok)
+                    seen.add(tok)
         self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
 
     def __len__(self):

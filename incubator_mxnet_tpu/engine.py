@@ -424,3 +424,14 @@ def set_engine(engine: Engine):
     global _engine
     with _engine_lock:
         _engine = engine
+
+
+def reset_engine():
+    """Drop the singleton so the next use builds a fresh engine — called
+    from the after-fork handler (reference initialize.h fork handlers):
+    a forked child must not drive the parent's worker threads or hold
+    its queue locks."""
+    global _engine
+    # deliberately no lock: after fork the old lock may be held by a
+    # thread that no longer exists in the child
+    _engine = None

@@ -155,6 +155,29 @@ def _prod(xs):
     return out
 
 
+def _cross_device_copy(x, tgt_dev, src_dev):
+    """Differentiable device transfer (reference
+    src/operator/cross_device_copy.cc): forward moves the value to the
+    target device, backward moves the cotangent to the source device so
+    each group's math runs device-local.  Devices are static, so this
+    composes with jax.vjp."""
+    if src_dev == tgt_dev:
+        return x
+
+    @jax.custom_vjp
+    def cp(v):
+        return jax.device_put(v, tgt_dev)
+
+    def cp_fwd(v):
+        return jax.device_put(v, tgt_dev), None
+
+    def cp_bwd(_, g):
+        return (jax.device_put(g, src_dev) if src_dev is not None else g,)
+
+    cp.defvjp(cp_fwd, cp_bwd)
+    return cp(x)
+
+
 class Symbol:
     """An output (or group of outputs) of a symbolic graph."""
 
@@ -292,7 +315,8 @@ class Symbol:
                 [jnp.float32] * len(self._nodes), [])
 
     # -- evaluation -------------------------------------------------------
-    def _evaluate(self, bindings: dict, training=False, aux_updates=None):
+    def _evaluate(self, bindings: dict, training=False, aux_updates=None,
+                  group2ctx=None):
         """Evaluate the DAG with jax values bound to variable names.
 
         training=True passes the train flag to stateful-norm ops
@@ -300,16 +324,39 @@ class Symbol:
         collected into ``aux_updates`` as {aux_var_name: new_value} — the
         executor applies them after the step (the reference mutates aux
         NDArrays inside the op; here state is threaded functionally).
+
+        group2ctx maps ``ctx_group`` attr values (AttrScope(ctx_group=..))
+        to Contexts: each op executes on its group's device, with
+        jax.device_put inserting the cross-device copies the reference's
+        executor materializes as _CrossDeviceCopy nodes
+        (graph_executor.cc:2048, src/operator/cross_device_copy.cc) —
+        coarse model parallelism for legacy scripts; new code should use
+        the sharding layer instead.
         """
         values: dict[int, object] = {}
+        node_dev: dict[int, object] = {}   # static placement per node
         for node in self._topo_order():
             if node.op_name is None:
                 if node.name not in bindings:
                     raise ValueError(f"unbound variable {node.name}")
                 values[node.key] = (bindings[node.name],)
+                node_dev[node.key] = None
             else:
                 op = _registry.get_op(node.op_name)
                 args = [values[i.key][i.output_index] for i in node.inputs]
+                if group2ctx:
+                    grp = node.attrs.get("ctx_group")
+                    ctx = group2ctx.get(grp) if grp else None
+                    if ctx is not None:
+                        tgt = ctx.jax_device
+                    else:  # inherit the first input's placement
+                        tgt = node_dev.get(node.inputs[0].key) \
+                            if node.inputs else None
+                    node_dev[node.key] = tgt
+                    if tgt is not None:
+                        args = [_cross_device_copy(
+                                    a, tgt, node_dev.get(i.key))
+                                for a, i in zip(args, node.inputs)]
                 kwargs = node.kwargs
                 if training and node.op_name in _TRAIN_FLAG_OPS:
                     out = op.fn(*args, training=True, **kwargs)
@@ -461,19 +508,36 @@ class Symbol:
         if missing:
             raise ValueError(f"simple_bind needs shapes for {missing}")
         dev = ctx or current_context()
+        # place each variable on its consumer's ctx-group device so the
+        # per-forward _cross_device_copy of parameters is a no-op (the
+        # reference allocates args in their group's context,
+        # graph_executor.cc:2048)
+        var_ctx = {}
+        if group2ctx:
+            for node in self._topo_order():
+                if node.op_name is None:
+                    continue
+                grp = node.attrs.get("ctx_group")
+                gctx = group2ctx.get(grp) if grp else None
+                if gctx is None:
+                    continue
+                for i in node.inputs:
+                    if i.op_name is None:
+                        var_ctx.setdefault(i.name, gctx)
         arg_arrays = {}
         for name in arg_names:
             dtype = (type_dict or {}).get(name, "float32")
             arg_arrays[name] = NDArray(
                 jnp.zeros(tuple(all_shapes[name]), dtype_from_any(dtype)),
-                ctx=dev)
+                ctx=var_ctx.get(name, dev))
         aux_arrays = {}
         for name in aux_names:
             init = jnp.ones if name.endswith("_var") else jnp.zeros
             aux_arrays[name] = NDArray(
-                init(tuple(all_shapes[name]), jnp.float32), ctx=dev)
+                init(tuple(all_shapes[name]), jnp.float32),
+                ctx=var_ctx.get(name, dev))
         return Executor(self, arg_arrays, aux_dict=aux_arrays,
-                        grad_req=grad_req, ctx=ctx)
+                        grad_req=grad_req, ctx=ctx, group2ctx=group2ctx)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
@@ -482,7 +546,7 @@ class Symbol:
         if isinstance(args, (list, tuple)):
             args = dict(zip(arg_names, args))
         return Executor(self, args, args_grad=args_grad, grad_req=grad_req,
-                        ctx=ctx)
+                        ctx=ctx, group2ctx=group2ctx)
 
     # -- serialization (json graph, reference symbol.py tojson) -----------
     def tojson(self):

@@ -15,14 +15,25 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..context import Context
 from ..ndarray import NDArray
 
 __all__ = ["Executor"]
 
 
+def _ctx_of(arr):
+    """Context matching a committed array's device (placement-preserving
+    wrap for group2ctx executors)."""
+    if not getattr(arr, "committed", False):
+        return None
+    dev = next(iter(arr.devices()))
+    return Context("cpu" if dev.platform == "cpu" else "tpu", dev.id)
+
+
 class Executor:
     def __init__(self, symbol, arg_dict, args_grad=None, aux_dict=None,
-                 grad_req="write", ctx=None):
+                 grad_req="write", ctx=None, group2ctx=None):
+        self._group2ctx = dict(group2ctx) if group2ctx else None
         self._symbol = symbol
         self._arg_names = symbol.list_arguments()
         self._aux_names = symbol.list_auxiliary_states()
@@ -48,12 +59,19 @@ class Executor:
         self.outputs: list[NDArray] = []
         self._vjp_fn = None
 
+        g2c = self._group2ctx
+
         def fwd_infer(vals, aux):
             bindings = dict(zip(self._arg_names, vals))
             bindings.update(zip(self._aux_names, aux))
-            return tuple(symbol._evaluate(bindings))
+            return tuple(symbol._evaluate(bindings, group2ctx=g2c))
 
         def fwd_train(vals, aux):
+            # training runs without group placement: jax.vjp traces the
+            # graph into one computation where committed-device transfers
+            # cannot mix; the placed path is inference (below), matching
+            # the group2ctx deploy use-case — multi-device TRAINING goes
+            # through the sharding layer (parallel/), not ctx groups
             bindings = dict(zip(self._arg_names, vals))
             bindings.update(zip(self._aux_names, aux))
             updates: dict = {}
@@ -61,7 +79,10 @@ class Executor:
                                           aux_updates=updates))
             return outs, updates
 
-        self._jit_infer = jax.jit(fwd_infer)
+        # group-placed executors run eagerly: device_put-committed
+        # arrays can't mix inside one jit computation, and the legacy
+        # group2ctx path is op-by-op in the reference anyway
+        self._jit_infer = fwd_infer if g2c else jax.jit(fwd_infer)
         self._fwd_train = fwd_train
 
     def forward(self, is_train=False, **kwargs):
@@ -81,7 +102,10 @@ class Executor:
         else:
             outs = self._jit_infer(vals, aux)
             self._vjp_fn = None
-        self.outputs = [NDArray(o) for o in outs]
+        if self._group2ctx:
+            self.outputs = [NDArray(o, ctx=_ctx_of(o)) for o in outs]
+        else:
+            self.outputs = [NDArray(o) for o in outs]
         return self.outputs
 
     def backward(self, out_grads=None, is_train=True):

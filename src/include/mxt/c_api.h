@@ -124,6 +124,9 @@ int MXTPredSetInput(PredictorHandle h, uint32_t index, const float* data,
                     uint64_t size);
 int MXTPredForward(PredictorHandle h);
 int MXTPredGetOutputSize(PredictorHandle h, uint32_t index, uint64_t* size);
+/* shape query: *ndim carries the buffer capacity in, the rank out */
+int MXTPredGetOutputShape(PredictorHandle h, uint32_t index,
+                          uint64_t* shape, uint32_t* ndim);
 int MXTPredGetOutput(PredictorHandle h, uint32_t index, float* out,
                      uint64_t size);
 int MXTPredFree(PredictorHandle h);

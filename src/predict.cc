@@ -224,6 +224,40 @@ int MXTPredGetOutputSize(PredictorHandle h, uint32_t index, uint64_t* size) {
   return 0;
 }
 
+int MXTPredGetOutputShape(PredictorHandle h, uint32_t index,
+                          uint64_t* shape, uint32_t* ndim) {
+  auto* p = static_cast<Predictor*>(h);
+  if (!p->outputs) {
+    mxt::SetLastError("MXTPredGetOutputShape: call MXTPredForward first");
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* o = p->outputs;
+  bool unwrap = PyTuple_Check(o) || PyList_Check(o);
+  PyObject* item = unwrap ? PySequence_GetItem(o, (Py_ssize_t)index)
+                          : (Py_INCREF(o), o);
+  PyObject* shp = item ? PyObject_GetAttrString(item, "shape") : nullptr;
+  Py_XDECREF(item);
+  if (!shp) {
+    int rc = PyFail("MXTPredGetOutputShape");
+    PyGILState_Release(gil);
+    return rc;
+  }
+  Py_ssize_t n = PyTuple_Size(shp);
+  if (*ndim < (uint32_t)n) {
+    Py_DECREF(shp);
+    mxt::SetLastError("MXTPredGetOutputShape: shape buffer too small");
+    PyGILState_Release(gil);
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i)
+    shape[i] = (uint64_t)PyLong_AsLongLong(PyTuple_GetItem(shp, i));
+  *ndim = (uint32_t)n;
+  Py_DECREF(shp);
+  PyGILState_Release(gil);
+  return 0;
+}
+
 int MXTPredFree(PredictorHandle h) {
   auto* p = static_cast<Predictor*>(h);
   if (Py_IsInitialized()) {
@@ -236,5 +270,20 @@ int MXTPredFree(PredictorHandle h) {
   delete p;
   return 0;
 }
+
+/* Reference-named aliases (include/mxnet/c_predict_api.h) so deploy
+ * code written against the reference predict ABI links unchanged. */
+int MXPredCreate2(const char* prefix, PredictorHandle* out) {
+  return MXTPredCreate(prefix, out);
+}
+int MXPredSetInput2(PredictorHandle h, uint32_t i, const float* d,
+                    uint64_t n) {
+  return MXTPredSetInput(h, i, d, n);
+}
+int MXPredForward2(PredictorHandle h) { return MXTPredForward(h); }
+int MXPredGetOutput2(PredictorHandle h, uint32_t i, float* o, uint64_t n) {
+  return MXTPredGetOutput(h, i, o, n);
+}
+int MXPredFree2(PredictorHandle h) { return MXTPredFree(h); }
 
 }  // extern "C"

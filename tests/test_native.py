@@ -413,3 +413,73 @@ def test_image_record_iter_python_fallback_parity(tmp_path, monkeypatch):
     # same normalization applied (decode/resize differ slightly per path)
     onp.testing.assert_allclose(fb.mean(axis=(0, 2, 3)),
                                 nat.mean(axis=(0, 2, 3)), atol=0.05)
+
+
+def test_threadsafe_hybridized_inference():
+    """Concurrent inference through one hybridized block (reference
+    src/imperative/cached_op_threadsafe.cc +
+    tests/cpp/thread_safety/thread_safety_test.cc): N threads share a
+    compiled CachedOp; every result must match the single-thread
+    output."""
+    import threading
+    import numpy as onp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, in_units=16, activation="relu"),
+            gluon.nn.Dense(8, in_units=32))
+    net.initialize()
+    net.hybridize()
+    xs = [nd.random.uniform(shape=(4, 16)) for _ in range(8)]
+    refs = [net(x).asnumpy() for x in xs]
+
+    errors = []
+    results = [None] * 64
+
+    def worker(tid):
+        try:
+            for i in range(8):
+                idx = tid * 8 + i
+                out = net(xs[i]).asnumpy()
+                results[idx] = (i, out)
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append((tid, e))
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    for idx, (i, out) in enumerate(results):
+        onp.testing.assert_allclose(out, refs[i], rtol=1e-5, atol=1e-6,
+                                    err_msg=f"slot {idx}")
+
+
+def test_engine_fork_safety():
+    """A forked child gets a fresh engine (reference initialize.h fork
+    handlers): host-side scheduling in DataLoader-style workers must not
+    deadlock on the parent's worker threads/locks.  (JAX device compute
+    is not fork-safe by design — children do host work only.)"""
+    import multiprocessing as mp
+    from incubator_mxnet_tpu import nd
+
+    nd.ones((2, 2)).asnumpy()  # engine active in the parent
+
+    def child(q):
+        from incubator_mxnet_tpu import engine
+        eng = engine.get_engine()
+        out = []
+        v = eng.new_variable("t")
+        eng.push_sync(lambda: out.append(21), const_vars=[],
+                      mutable_vars=[v])
+        eng.wait_for_all()
+        q.put(out[0] * 2)
+
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    p = ctx.Process(target=child, args=(q,))
+    p.start()
+    p.join(60)
+    assert q.get(timeout=10) == 42

@@ -88,3 +88,23 @@ def test_svrg_optimizer_delegates():
     mu = nd.zeros((3,))
     opt.update_svrg(0, w, g, gs, mu, opt.create_state(0, w))
     onp.testing.assert_allclose(w.asnumpy(), 0.9 * onp.ones(3), rtol=1e-6)
+
+
+def test_svrg_fit_honors_optimizer_params_and_metric():
+    x, y, _ = _toy_data(n=32)
+    mod = _linreg_module(update_freq=1)
+    it = NDArrayIter(x, y, batch_size=16)
+    mx.random.seed(11)
+    m = mod.fit(it, eval_metric="mse", num_epoch=2, optimizer="sgd",
+                optimizer_params=(("learning_rate", 0.0),))
+    # lr=0 -> weights must not move; proves optimizer_params reach the
+    # optimizer instead of being swallowed (round-3 review regression)
+    w0 = mod.get_params()[0]["fc_weight"].asnumpy()
+    mod2 = _linreg_module(update_freq=1)
+    it.reset()
+    mx.random.seed(11)
+    mod2.fit(it, eval_metric="mse", num_epoch=2, optimizer="sgd",
+             optimizer_params=(("learning_rate", 0.0),))
+    onp.testing.assert_allclose(
+        w0, mod2.get_params()[0]["fc_weight"].asnumpy(), rtol=1e-6)
+    assert onp.isfinite(m.get()[1])

@@ -47,3 +47,20 @@ def test_composite_embedding(tmp_path):
     vec = comp.get_vecs_by_tokens("cat").asnumpy()
     onp.testing.assert_array_equal(vec, [1.0, 2.0, 7.0])
     assert comp.get_vecs_by_tokens("dog").asnumpy()[2] == 0.0
+
+
+def test_custom_embedding_skips_fasttext_header(tmp_path):
+    p = tmp_path / "ft.vec"
+    p.write_text("2 3\ncat 1.0 2.0 3.0\ndog 4.0 5.0 6.0\n")
+    emb = text.embedding.CustomEmbedding(str(p))
+    assert emb.vec_len == 3 and len(emb) == 3
+    onp.testing.assert_array_equal(
+        emb.get_vecs_by_tokens("cat").asnumpy(), [1.0, 2.0, 3.0])
+
+
+def test_custom_embedding_ragged_rows_error(tmp_path):
+    p = tmp_path / "bad.txt"
+    p.write_text("cat 1.0 2.0 3.0\ndog 4.0 5.0\n")
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="inconsistent"):
+        text.embedding.CustomEmbedding(str(p))
