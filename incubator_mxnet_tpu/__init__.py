@@ -116,6 +116,7 @@ from . import visualization as viz
 from . import test_utils
 from . import util
 from . import library
+from . import rtc
 from . import deploy
 from .util import is_np_array, set_np, reset_np
 from .attribute import AttrScope

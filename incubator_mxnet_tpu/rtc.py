@@ -1,0 +1,123 @@
+"""Runtime kernel compilation — the TPU analog of ``mx.rtc``
+(reference python/mxnet/rtc.py CudaModule over NVRTC,
+src/common/rtc.cc:49).
+
+On GPU the reference lets users hand libmxnet raw CUDA C, NVRTC-compiles
+it at runtime, and launches it on NDArrays.  The TPU-native equivalent
+of "user-supplied kernel source" is a **Pallas kernel**: the user writes
+a ``pl.BlockSpec``-style kernel function in Python, and ``PallasModule``
+wraps it into a launchable accepting NDArrays, with grid/block geometry
+mapped onto the Pallas grid.  Mosaic plays NVRTC's role (runtime
+compilation to the accelerator ISA) and the kernel composes with jit
+like any other op.
+
+Usage::
+
+    import incubator_mxnet_tpu as mx
+
+    def saxpy(x_ref, y_ref, o_ref, *, alpha):
+        o_ref[...] = x_ref[...] * alpha + y_ref[...]
+
+    mod = mx.rtc.PallasModule(saxpy, num_inputs=2, static_args=("alpha",))
+    kern = mod.get_kernel("saxpy", alpha=3.0)
+    out = kern.launch([x, y], mx.tpu(0))      # NDArrays in, NDArray out
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray import NDArray
+
+__all__ = ["PallasModule", "CudaModule"]
+
+
+class _Kernel:
+    def __init__(self, fn, name, num_inputs, static_kwargs, out_like,
+                 grid, interpret):
+        self._fn = fn
+        self.name = name
+        self._num_inputs = num_inputs
+        self._static = static_kwargs
+        self._out_like = out_like
+        self._grid = grid
+        self._interpret = interpret
+
+    def launch(self, args, ctx=None, grid_dims=None, block_dims=None,
+               shared_mem=0):
+        """Run the kernel on NDArrays (reference rtc.py:185 launch).
+
+        grid_dims maps to the Pallas grid; block geometry is implied by
+        the kernel's BlockSpecs (the TPU has no free-form thread blocks —
+        Mosaic tiles to the hardware lanes itself), so block_dims and
+        shared_mem are accepted for signature parity and ignored.
+        """
+        from jax.experimental import pallas as pl
+
+        arrays = [a.data if isinstance(a, NDArray) else jnp.asarray(a)
+                  for a in args[:self._num_inputs]]
+        out_like = self._out_like
+        out_shape = jax.ShapeDtypeStruct(
+            arrays[0].shape if out_like is None else out_like.shape,
+            arrays[0].dtype if out_like is None else out_like.dtype)
+        kern = (functools.partial(self._fn, **self._static)
+                if self._static else self._fn)
+        grid = grid_dims or self._grid
+        kwargs = {"out_shape": out_shape, "interpret": self._interpret}
+        if grid:
+            kwargs["grid"] = tuple(grid)
+        call = pl.pallas_call(kern, **kwargs)
+        out = call(*arrays)
+        if len(args) > self._num_inputs:
+            # reference semantics: extra args are outputs written in place
+            target = args[self._num_inputs]
+            target._set_data(out)
+            return target
+        return NDArray(out)
+
+
+class PallasModule:
+    """A module of user kernels (reference rtc.py:41 CudaModule).
+
+    ``source`` is a Pallas kernel function (or dict of name → function)
+    instead of CUDA C text; ``options``/``exports`` are accepted for
+    signature parity.
+    """
+
+    def __init__(self, source, options=(), exports=(), num_inputs=1,
+                 static_args=(), out_like=None, grid=None):
+        if callable(source):
+            self._kernels = {source.__name__: source}
+        elif isinstance(source, dict):
+            self._kernels = dict(source)
+        else:
+            raise TypeError(
+                "PallasModule wants a kernel function or {name: fn}; raw "
+                "CUDA C has no TPU compiler — write the kernel in Pallas "
+                "(see /opt/skills/guides/pallas_guide.md)")
+        self._num_inputs = num_inputs
+        self._static_names = tuple(static_args)
+        self._out_like = out_like
+        self._grid = grid
+
+    def get_kernel(self, name, signature=None, **static_kwargs):
+        """Bind static parameters → launchable kernel (reference
+        rtc.py:111 get_kernel; the C-signature string is accepted and
+        ignored — Pallas kernels carry their types in the refs)."""
+        if name not in self._kernels:
+            raise ValueError(f"no kernel {name!r} in module "
+                             f"(have {sorted(self._kernels)})")
+        unknown = set(static_kwargs) - set(self._static_names)
+        if unknown:
+            raise ValueError(f"unknown static args {sorted(unknown)}")
+        interpret = jax.devices()[0].platform == "cpu"
+        return _Kernel(self._kernels[name], name, self._num_inputs,
+                       static_kwargs, self._out_like, self._grid, interpret)
+
+
+class CudaModule(PallasModule):
+    """Name-compatible shim: constructing it with CUDA C source raises
+    with the migration hint; with a Pallas kernel it behaves like
+    PallasModule (reference scripts keep their structure)."""

@@ -1,0 +1,52 @@
+"""mx.rtc — runtime Pallas kernels (reference python/mxnet/rtc.py
+CudaModule/NVRTC; on TPU the user kernel is Pallas and Mosaic is the
+runtime compiler).  Runs in interpret mode on the CPU harness."""
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def test_pallas_module_saxpy():
+    def saxpy(x_ref, y_ref, o_ref, *, alpha):
+        o_ref[...] = x_ref[...] * alpha + y_ref[...]
+
+    mod = mx.rtc.PallasModule(saxpy, num_inputs=2, static_args=("alpha",))
+    kern = mod.get_kernel("saxpy", alpha=3.0)
+    x = nd.ones((8, 128))
+    y = nd.ones((8, 128))
+    out = kern.launch([x, y], mx.tpu(0))
+    onp.testing.assert_allclose(out.asnumpy(), 4.0 * onp.ones((8, 128)),
+                                rtol=1e-6)
+
+
+def test_pallas_module_inplace_output_arg():
+    def double(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    mod = mx.rtc.PallasModule(double, num_inputs=1)
+    kern = mod.get_kernel("double")
+    x = nd.ones((4, 128))
+    out = nd.zeros((4, 128))
+    ret = kern.launch([x, out], mx.tpu(0))
+    assert ret is out
+    onp.testing.assert_allclose(out.asnumpy(), 2.0 * onp.ones((4, 128)))
+
+
+def test_cuda_source_rejected_with_hint():
+    import pytest
+    with pytest.raises(TypeError, match="Pallas"):
+        mx.rtc.CudaModule("__global__ void axpy(float*x){}")
+
+
+def test_unknown_kernel_and_static_args():
+    import pytest
+
+    def k(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    mod = mx.rtc.PallasModule(k)
+    with pytest.raises(ValueError, match="no kernel"):
+        mod.get_kernel("nope")
+    with pytest.raises(ValueError, match="unknown static"):
+        mod.get_kernel("k", beta=1.0)
