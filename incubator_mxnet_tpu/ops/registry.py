@@ -205,3 +205,39 @@ def invoke(op: "Op | str", *inputs, out=None, **kwargs):
                        if isinstance(x, NDArray)]
         autograd._record(op, vjp_fn, all_in, nd_inputs, input_slots, outputs)
     return outputs
+
+
+def describe_op(op: "Op | str"):
+    """Declarative parameter reflection (reference §5.6:
+    dmlc::Parameter/DMLC_DECLARE_FIELD auto-exposes every op's params,
+    defaults and docs to all frontends).  Here the op's Python signature
+    IS the declaration; this returns it as structured metadata:
+    {"name", "doc", "inputs": [...], "params": {name: {"default", "kind"}}}.
+    """
+    import inspect as _ins
+    if isinstance(op, str):
+        op = get_op(op)
+    info = {"name": op.name, "doc": (op.fn.__doc__ or "").strip(),
+            "differentiable": op.differentiable, "aliases": list(op.aliases),
+            "inputs": [], "params": {}}
+    if op._sig is None:
+        info["inputs"] = ["*args"]
+        return info
+    for pname, p in op._sig.parameters.items():
+        if p.kind is _ins.Parameter.VAR_KEYWORD:
+            continue
+        if p.default is _ins.Parameter.empty:
+            info["inputs"].append(pname)
+        else:
+            info["params"][pname] = {
+                "default": p.default,
+                "kind": type(p.default).__name__
+                if p.default is not None else "optional",
+            }
+    return info
+
+
+def list_op_docs():
+    """{op_name: describe_op(...)} over the whole registry (the analog of
+    the reference's generated op-doc tables)."""
+    return {name: describe_op(name) for name in list_ops()}

@@ -79,3 +79,16 @@ def test_check_consistency_and_numeric_gradient_still_work():
     check_numeric_gradient(
         lambda x: (x * x).sum(),
         [nd.array(onp.random.rand(4).astype(onp.float32))])
+
+
+def test_describe_op_reflection():
+    """§5.6: declarative op-parameter reflection (dmlc::Parameter
+    analog) must expose inputs, params, and defaults per op."""
+    from incubator_mxnet_tpu.ops.registry import describe_op, list_op_docs
+    d = describe_op("Convolution")
+    assert "x" in d["inputs"] and "weight" in d["inputs"]
+    assert d["params"]["num_group"]["default"] == 1
+    assert "stride" in d["params"]
+    docs = list_op_docs()
+    assert len(docs) > 300
+    assert docs["softmax"]["differentiable"]
