@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""LeNet on (synthetic) MNIST — the reference example/image-classification
+starter, on the TPU-native stack.
+
+  python examples/train_mnist.py [--epochs 2] [--batch-size 64] [--smoke]
+
+Uses the Gluon API end-to-end: HybridBlock -> hybridize (whole-graph XLA
+compile) -> Trainer(kvstore 'device').
+"""
+import argparse
+import time
+
+import numpy as onp
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny synthetic run (CI)")
+    args = ap.parse_args()
+    if args.smoke:
+        import os
+        os.environ.setdefault("XLA_FLAGS",
+                              "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, autograd, gluon
+    from incubator_mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(32, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Conv2D(64, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2), nn.Flatten(),
+            nn.Dense(128, activation="relu"), nn.Dense(10))
+    net.initialize(ctx=mx.tpu())
+    net.hybridize()
+
+    n = 256 if args.smoke else 8192
+    rng = onp.random.RandomState(0)
+    images = rng.rand(n, 1, 28, 28).astype(onp.float32)
+    labels = rng.randint(0, 10, (n,)).astype(onp.float32)
+
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr}, kvstore="device")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = gluon.metric.Accuracy()
+    epochs = 1 if args.smoke else args.epochs
+    bs = args.batch_size
+    for epoch in range(epochs):
+        metric.reset()
+        t0 = time.time()
+        for i in range(0, n - bs + 1, bs):
+            x = nd.array(images[i:i + bs])
+            y = nd.array(labels[i:i + bs])
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(bs)
+            metric.update([y], [out])
+        name, acc = metric.get()
+        print(f"epoch {epoch}: {name}={acc:.3f} "
+              f"({n / (time.time() - t0):.0f} samples/s)")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

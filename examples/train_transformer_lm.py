@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Train the flagship TransformerLM over a device mesh
+(dp/tp/sp/pp/ep — SURVEY.md §7 stage 10; no reference equivalent).
+
+  python examples/train_transformer_lm.py --dp 2 --tp 2 --pp 2 [--smoke]
+
+On real hardware the mesh spans TPU chips over ICI; under --smoke it
+runs on 8 virtual CPU devices.
+"""
+import argparse
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dp", type=int, default=2)
+    ap.add_argument("--tp", type=int, default=2)
+    ap.add_argument("--pp", type=int, default=2)
+    ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--attention", default="gspmd",
+                    choices=["gspmd", "ring", "flash"])
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        import os
+        os.environ.setdefault("XLA_FLAGS",
+                              "--xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.parallel.mesh import make_mesh
+    from incubator_mxnet_tpu.models.transformer import (TransformerConfig,
+                                                        TransformerLM)
+
+    if args.smoke:
+        cfg = TransformerConfig(vocab_size=256, d_model=64, n_heads=4,
+                                n_layers=2, d_ff=128, max_len=64,
+                                dtype="float32", attention=args.attention)
+        B, T, steps = 8, 33, 3
+    else:
+        cfg = TransformerConfig(attention=args.attention)
+        B, T, steps = 32, 1025, args.steps
+
+    mesh = make_mesh(dp=args.dp, tp=args.tp, pp=args.pp, sp=args.sp)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    params = model.shard_params(params, mesh)
+    step, tok_sharding = model.make_train_step(mesh, lr=1e-3)
+    key = jax.random.PRNGKey(1)
+    for i in range(steps):
+        key, sub = jax.random.split(key)
+        tokens = jax.device_put(
+            jax.random.randint(sub, (B, T), 0, cfg.vocab_size),
+            tok_sharding)
+        params, loss = step(params, tokens)
+        print(f"step {i}: loss {float(loss):.4f}", flush=True)
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -78,7 +78,9 @@ _install_fork_handlers()
 
 from . import base
 from .base import MXNetError
-from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from .context import (Context, cpu, gpu, tpu, current_context, num_gpus,
+                      num_tpus, gpu_memory_info, tpu_memory_info,
+                      memory_summary)
 from . import engine
 from . import ndarray
 from . import ndarray as nd

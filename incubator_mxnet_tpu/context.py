@@ -14,7 +14,9 @@ import threading
 
 import jax
 
-__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_tpus"]
+__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus",
+           "num_tpus", "gpu_memory_info", "tpu_memory_info",
+           "memory_summary"]
 
 _context_stack = threading.local()
 
@@ -151,3 +153,39 @@ def num_tpus() -> int:
     if not devs:
         devs = [d for d in jax.devices() if d.platform not in ("cpu",)]
     return len(devs)
+
+
+def gpu_memory_info(device_id: int = 0):
+    """(free, total) bytes on an accelerator (reference
+    python/mxnet/context.py:279 gpu_memory_info over cudaMemGetInfo).
+
+    TPU mapping: PJRT ``device.memory_stats()`` — the HBM-pool statistics
+    the reference's GPUPooledStorageManager tracked (SURVEY.md §2.1
+    storage row).  Falls back to (0, 0) on backends that expose no
+    stats (the virtual-CPU test harness).
+    """
+    devs = [d for d in _local(jax.devices()) if d.platform != "cpu"] \
+        or _local(jax.devices())
+    dev = devs[device_id % len(devs)]
+    stats = dev.memory_stats() or {}
+    total = stats.get("bytes_limit", 0)
+    used = stats.get("bytes_in_use", 0)
+    return (total - used, total)
+
+
+def tpu_memory_info(device_id: int = 0):
+    return gpu_memory_info(device_id)
+
+
+def memory_summary(device_id: int = 0):
+    """Human-readable device-memory report (the storage-profiler hook of
+    reference storage_profiler.cc, surfaced Python-side)."""
+    devs = _local(jax.devices())
+    dev = devs[device_id % len(devs)]
+    stats = dev.memory_stats() or {}
+    lines = [f"device {dev}"]
+    for k in sorted(stats):
+        lines.append(f"  {k}: {stats[k]}")
+    if not stats:
+        lines.append("  (backend exposes no memory statistics)")
+    return "\n".join(lines)

@@ -460,3 +460,82 @@ def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224),
             return next(inner)
 
     return PrefetchingIter(_Adapter())
+
+
+class LibSVMIter(DataIter):
+    """Sparse batches from LibSVM text files (reference
+    src/io/iter_libsvm.cc).
+
+    Each line is ``label idx:val idx:val ...`` (indices 0-based like the
+    reference's default).  Batches come out as CSRNDArray data (+ dense
+    label, or CSR label from ``label_libsvm``), which feeds the sparse
+    dot kernels / sparse FullyConnected path.
+    """
+
+    def __init__(self, data_libsvm, data_shape, batch_size,
+                 label_libsvm=None, label_shape=None, round_batch=True,
+                 **kwargs):
+        super().__init__(batch_size)
+        self._data_shape = tuple(data_shape)
+        self._num_col = int(self._data_shape[-1])
+        self._round_batch = round_batch
+        self._rows, self._labels = self._parse(data_libsvm)
+        if label_libsvm is not None:
+            lab_rows, _ = self._parse(label_libsvm)
+            ncol = int((label_shape or (1,))[-1])
+            self._labels = [self._row_to_dense(r, ncol) for r in lab_rows]
+        self._cursor = 0
+
+    @staticmethod
+    def _parse(path):
+        rows, labels = [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                rows.append([(int(k), float(v)) for k, v in
+                             (p.split(":") for p in parts[1:])])
+        return rows, labels
+
+    def _row_to_dense(self, row, ncol):
+        out = onp.zeros(ncol, onp.float32)
+        for k, v in row:
+            out[k] = v
+        return out
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._num_col))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        from ..ndarray.sparse import CSRNDArray
+        n = len(self._rows)
+        if self._cursor >= n:
+            raise StopIteration
+        idxs = list(range(self._cursor, min(self._cursor + self.batch_size,
+                                            n)))
+        pad = self.batch_size - len(idxs)
+        if pad and self._round_batch:
+            idxs += list(range(pad))   # wrap around (reference round_batch)
+        self._cursor += self.batch_size
+        data, indices, indptr = [], [], [0]
+        for i in idxs:
+            for k, v in self._rows[i]:
+                indices.append(k)
+                data.append(v)
+            indptr.append(len(indices))
+        csr = CSRNDArray(onp.asarray(data, onp.float32),
+                         onp.asarray(indices, onp.int64),
+                         onp.asarray(indptr, onp.int64),
+                         (len(idxs), self._num_col))
+        lab = onp.asarray([self._labels[i] for i in idxs], onp.float32)
+        return DataBatch(data=[csr], label=[NDArray(lab)], pad=pad)

@@ -1,0 +1,43 @@
+"""The examples/ scripts must stay runnable (smoke mode)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert "done" in proc.stdout
+    return proc.stdout
+
+
+def test_train_mnist_smoke():
+    _run("train_mnist.py", "--smoke")
+
+
+def test_train_transformer_lm_smoke():
+    out = _run("train_transformer_lm.py", "--smoke", "--dp", "2",
+               "--tp", "2", "--pp", "2")
+    assert "loss" in out
+
+
+def test_train_dist_kvstore_via_launcher():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", sys.executable,
+         os.path.join(REPO, "examples", "train_dist_kvstore.py")],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert proc.stdout.count("done") == 2
