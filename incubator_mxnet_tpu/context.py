@@ -166,7 +166,10 @@ def gpu_memory_info(device_id: int = 0):
     """
     devs = [d for d in _local(jax.devices()) if d.platform != "cpu"] \
         or _local(jax.devices())
-    dev = devs[device_id % len(devs)]
+    if not 0 <= device_id < len(devs):
+        raise ValueError(
+            f"device_id {device_id} out of range (have {len(devs)})")
+    dev = devs[device_id]
     stats = dev.memory_stats() or {}
     total = stats.get("bytes_limit", 0)
     used = stats.get("bytes_in_use", 0)
@@ -181,7 +184,10 @@ def memory_summary(device_id: int = 0):
     """Human-readable device-memory report (the storage-profiler hook of
     reference storage_profiler.cc, surfaced Python-side)."""
     devs = _local(jax.devices())
-    dev = devs[device_id % len(devs)]
+    if not 0 <= device_id < len(devs):
+        raise ValueError(
+            f"device_id {device_id} out of range (have {len(devs)})")
+    dev = devs[device_id]
     stats = dev.memory_stats() or {}
     lines = [f"device {dev}"]
     for k in sorted(stats):

@@ -14,7 +14,8 @@ from ..ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
-           "NativeImageRecordIter"]
+           "NativeImageRecordIter",
+           "LibSVMIter"]
 
 
 class DataDesc:
@@ -480,6 +481,7 @@ class LibSVMIter(DataIter):
         self._num_col = int(self._data_shape[-1])
         self._round_batch = round_batch
         self._rows, self._labels = self._parse(data_libsvm)
+        self._label_shape = tuple(label_shape) if label_shape else ()
         if label_libsvm is not None:
             lab_rows, _ = self._parse(label_libsvm)
             ncol = int((label_shape or (1,))[-1])
@@ -511,7 +513,8 @@ class LibSVMIter(DataIter):
 
     @property
     def provide_label(self):
-        return [DataDesc("softmax_label", (self.batch_size,))]
+        return [DataDesc("softmax_label",
+                         (self.batch_size,) + self._label_shape)]
 
     def reset(self):
         self._cursor = 0
@@ -525,7 +528,11 @@ class LibSVMIter(DataIter):
                                             n)))
         pad = self.batch_size - len(idxs)
         if pad and self._round_batch:
-            idxs += list(range(pad))   # wrap around (reference round_batch)
+            # wrap cyclically (reference round_batch: pads from the
+            # dataset start, repeating if the pad exceeds the file)
+            idxs += [i % n for i in range(pad)]
+        else:
+            pad = 0   # no wrap: the final batch is simply shorter
         self._cursor += self.batch_size
         data, indices, indptr = [], [], [0]
         for i in idxs:

@@ -265,3 +265,38 @@ def test_libsvm_iter(tmp_path):
     w = nd.array(onp.ones((5, 2), onp.float32))
     out = nd.dot(b1.data[0], w)
     onp.testing.assert_allclose(out.asnumpy()[0], [3.5, 3.5])
+
+
+def test_libsvm_iter_edge_cases(tmp_path):
+    p = tmp_path / "t.libsvm"
+    p.write_text("1 0:1.0\n0 1:1.0\n1 2:1.0\n")
+    from incubator_mxnet_tpu.io import LibSVMIter
+    # pad larger than the dataset wraps cyclically instead of crashing
+    it = LibSVMIter(str(p), data_shape=(3,), batch_size=7)
+    b = it.next()
+    assert b.pad == 4
+    assert b.data[0].asnumpy().shape == (7, 3)
+    onp.testing.assert_array_equal(b.data[0].asnumpy()[3],
+                                   b.data[0].asnumpy()[0])
+    # round_batch=False: short final batch, pad stays 0
+    it2 = LibSVMIter(str(p), data_shape=(3,), batch_size=2,
+                     round_batch=False)
+    it2.next()
+    b2 = it2.next()
+    assert b2.pad == 0 and b2.data[0].asnumpy().shape == (1, 3)
+    # multi-column labels advertised correctly
+    lp = tmp_path / "l.libsvm"
+    lp.write_text("0 0:1.0 3:2.0\n0 1:1.0\n0 2:5.0\n")
+    it3 = LibSVMIter(str(p), data_shape=(3,), batch_size=2,
+                     label_libsvm=str(lp), label_shape=(4,))
+    assert it3.provide_label[0].shape == (2, 4)
+    assert it3.next().label[0].shape == (2, 4)
+
+
+def test_memory_info_bounds():
+    import pytest as _pytest
+    import incubator_mxnet_tpu as mx
+    free, total = mx.gpu_memory_info(0)
+    assert free >= 0 and total >= 0
+    with _pytest.raises(ValueError, match="out of range"):
+        mx.gpu_memory_info(99)
