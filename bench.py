@@ -211,7 +211,10 @@ def main():
         _child(sys.argv[2])
         return
 
-    tpu_timeout = float(os.environ.get("BENCH_TIMEOUT", "1500"))
+    # healthy-chip sweep needs ~5 min; a wedged tunnel hangs forever,
+    # so keep the per-attempt ceiling tight enough that the CPU
+    # fallback still lands inside the driver's bench window
+    tpu_timeout = float(os.environ.get("BENCH_TIMEOUT", "600"))
     cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", "1500"))
     attempts = int(os.environ.get("BENCH_ATTEMPTS", "2"))
 
