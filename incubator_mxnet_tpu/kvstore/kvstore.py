@@ -78,7 +78,10 @@ class _BaseStore(KVStoreBase):
         for k, v in zip(keys, values):
             summed = self._reduce(v)
             if self._compression is not None:
-                summed = self._compression.compress_decompress(summed)
+                # key the error-feedback residual by PARAMETER, not by
+                # shape: same-shaped params must not share residuals
+                summed = self._compression.compress_decompress(summed,
+                                                               key=k)
             summed = self._sync(summed)
             if self._updater is not None:
                 # server-side optimizer (reference kvstore_dist_server.h:349)

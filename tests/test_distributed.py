@@ -239,3 +239,24 @@ def test_p3_priority_order_on_wire():
         onp.testing.assert_allclose(out_b.asnumpy(), onp.ones(32))
     finally:
         del os.environ["MXNET_KVSTORE_SLICE_THRESHOLD"]
+
+
+def test_gradient_compression_residuals_per_key():
+    """Error-feedback residuals must be keyed per parameter: two
+    same-shaped keys must not cross-contaminate (round-3 review fix)."""
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("a", nd.zeros((4,)))
+    kv.init("b", nd.zeros((4,)))
+    # push 0.3 to 'a' twice: residual builds 0.3 -> fires 0.5 on push 2
+    kv.push("a", nd.ones((4,)) * 0.3)
+    out = nd.zeros((4,))
+    kv.pull("a", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 0.0)
+    # a push to same-shaped 'b' must NOT inherit a's 0.3 residual
+    kv.push("b", nd.ones((4,)) * 0.3)
+    kv.pull("b", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 0.0)
+    kv.push("a", nd.ones((4,)) * 0.3)   # a's residual 0.3+0.3 fires
+    kv.pull("a", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 0.5)
