@@ -18,7 +18,10 @@ from .ndarray import NDArray
 
 __all__ = ["imread", "imdecode", "imencode", "imresize", "resize_short",
            "center_crop", "random_crop", "fixed_crop", "color_normalize",
-           "CreateAugmenter", "Augmenter", "ImageIter"]
+           "CreateAugmenter", "Augmenter", "ImageIter",
+           "DetAugmenter", "DetHorizontalFlipAug", "DetRandomCropAug",
+           "DetBorderAug", "DetColorNormalizeAug", "CreateDetAugmenter",
+           "ImageDetIter"]
 
 
 def _decode_bytes(buf: bytes, flag=1):
@@ -264,5 +267,205 @@ class ImageIter:
         data = nd.stack(*imgs, axis=0)
         label = nd.array(onp.asarray(labels, "float32"))
         return DataBatch(data=[data], label=[label])
+
+    next = __next__
+
+
+# ---------------------------------------------------------------------------
+# detection pipeline (reference python/mxnet/image/detection.py):
+# bbox-aware augmenters + ImageDetIter.  Labels are rows of
+# [class, xmin, ymin, xmax, ymax] with coordinates normalized to [0, 1]
+# (the reference's object format after its header is stripped).
+# ---------------------------------------------------------------------------
+
+class DetAugmenter:
+    """Augmenter transforming (image, label) together."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image and boxes with probability p (reference
+    detection.py DetHorizontalFlipAug)."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if onp.random.rand() < self.p:
+            src = src[:, ::-1]
+            label = label.copy()
+            xmin = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - xmin
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop keeping enough box overlap (reference
+    DetRandomCropAug: min_object_covered / area-range sampling,
+    simplified to bounded retries)."""
+
+    def __init__(self, min_object_covered=0.5, min_crop_size=0.5,
+                 max_attempts=25):
+        self.min_object_covered = min_object_covered
+        self.min_crop_size = min_crop_size
+        self.max_attempts = max_attempts
+
+    def __call__(self, src, label):
+        h, w = src.shape[:2]
+        for _ in range(self.max_attempts):
+            cw = onp.random.uniform(self.min_crop_size, 1.0)
+            ch = onp.random.uniform(self.min_crop_size, 1.0)
+            cx = onp.random.uniform(0, 1.0 - cw)
+            cy = onp.random.uniform(0, 1.0 - ch)
+            new = self._project(label, cx, cy, cw, ch)
+            if new is not None:
+                x0, y0 = int(cx * w), int(cy * h)
+                x1, y1 = int((cx + cw) * w), int((cy + ch) * h)
+                return src[y0:y1, x0:x1], new
+        return src, label
+
+    def _project(self, label, cx, cy, cw, ch):
+        """Boxes re-expressed in crop coordinates; None if coverage of
+        any kept object falls below min_object_covered."""
+        out = []
+        for row in label:
+            cls, xmin, ymin, xmax, ymax = row[:5]
+            ix0, iy0 = max(xmin, cx), max(ymin, cy)
+            ix1, iy1 = min(xmax, cx + cw), min(ymax, cy + ch)
+            inter = max(0.0, ix1 - ix0) * max(0.0, iy1 - iy0)
+            area = (xmax - xmin) * (ymax - ymin)
+            if area <= 0 or inter / area < 1e-6:
+                continue                      # object fully outside: drop
+            if inter / area < self.min_object_covered:
+                return None                   # partially cut: reject crop
+            out.append([cls,
+                        max(0.0, (xmin - cx) / cw),
+                        max(0.0, (ymin - cy) / ch),
+                        min(1.0, (xmax - cx) / cw),
+                        min(1.0, (ymax - cy) / ch)])
+        if not out:
+            return None
+        return onp.asarray(out, onp.float32)
+
+
+class DetBorderAug(DetAugmenter):
+    """Pad to square with a fill value, boxes re-normalized (reference
+    DetRandomPadAug, deterministic variant)."""
+
+    def __init__(self, fill=127):
+        self.fill = fill
+
+    def __call__(self, src, label):
+        h, w = src.shape[:2]
+        s = max(h, w)
+        out = onp.full((s, s) + src.shape[2:], self.fill, src.dtype)
+        y0, x0 = (s - h) // 2, (s - w) // 2
+        out[y0:y0 + h, x0:x0 + w] = src
+        label = label.copy()
+        label[:, [1, 3]] = (label[:, [1, 3]] * w + x0) / s
+        label[:, [2, 4]] = (label[:, [2, 4]] * h + y0) / s
+        return out, label
+
+
+class DetColorNormalizeAug(DetAugmenter):
+    """Color normalization; labels pass through (reference detection.py
+    wraps the classification augmenter the same way)."""
+
+    def __init__(self, mean, std=None):
+        self.mean = onp.asarray(mean, onp.float32)
+        self.std = onp.asarray(std, onp.float32) if std is not None else None
+
+    def __call__(self, src, label):
+        out = onp.asarray(src, onp.float32) - self.mean
+        if self.std is not None:
+            out = out / self.std
+        return out, label
+
+
+def CreateDetAugmenter(data_shape, rand_crop=0, rand_mirror=False,
+                       rand_pad=0, mean=None, std=None):
+    """Standard detection augmentation chain (reference
+    detection.py CreateDetAugmenter)."""
+    augs: list = []
+    if rand_pad:
+        augs.append(DetBorderAug())
+    if rand_crop:
+        augs.append(DetRandomCropAug())
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug())
+    if mean is not None:
+        augs.append(DetColorNormalizeAug(mean, std))
+    return augs
+
+
+class ImageDetIter:
+    """Detection batches with padded multi-object labels (reference
+    image/detection.py ImageDetIter).
+
+    imglist: list of (HWC uint8/float array, label rows (N, 5)).  Emits
+    data (B, C, H, W) float32 and label (B, max_objs, 5) padded with -1
+    rows — the contract MultiBoxTarget consumes (ops/contrib_ops.py).
+    """
+
+    def __init__(self, batch_size, data_shape, imglist, augmenters=None,
+                 shuffle=False, label_shape=None):
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self._items = list(imglist)
+        self._augs = augmenters or []
+        self._shuffle = shuffle
+        self._order = list(range(len(self._items)))
+        self._cursor = 0
+        # fixed label arity across batches (reference label_shape): a
+        # per-batch max would change shapes batch-to-batch and force XLA
+        # recompiles in every consumer
+        if label_shape is not None:
+            self._max_objs = int(label_shape[0])
+        else:
+            self._max_objs = max(
+                (onp.asarray(l).reshape(-1, 5).shape[0]
+                 for _, l in self._items), default=1)
+        self.reset()
+
+    def reset(self):
+        self._cursor = 0
+        if self._shuffle:
+            onp.random.shuffle(self._order)
+
+    def __iter__(self):
+        # no implicit reset: DataIter semantics (reset() starts an epoch)
+        return self
+
+    def __next__(self):
+        from .io import DataBatch
+        from .ndarray import NDArray
+        n = len(self._order)
+        if self._cursor >= n:
+            raise StopIteration
+        idxs = [self._order[(self._cursor + i) % n]
+                for i in range(self.batch_size)]
+        pad = max(0, self._cursor + self.batch_size - n)
+        self._cursor += self.batch_size
+        c, h, w = self.data_shape
+        datas, labels = [], []
+        for i in idxs:
+            img, lab = self._items[i]
+            img = onp.asarray(img)
+            lab = onp.asarray(lab, onp.float32).reshape(-1, 5)
+            for aug in self._augs:
+                img, lab = aug(img, lab)
+            img = imresize(img, w, h).asnumpy()
+            datas.append(img.astype(onp.float32).transpose(2, 0, 1))
+            labels.append(lab)
+        lab_out = onp.full((self.batch_size, self._max_objs, 5), -1.0,
+                           onp.float32)
+        for bi, l in enumerate(labels):
+            k = min(len(l), self._max_objs)
+            lab_out[bi, :k] = l[:k]
+        return DataBatch(data=[NDArray(onp.stack(datas))],
+                         label=[NDArray(lab_out)], pad=pad)
 
     next = __next__

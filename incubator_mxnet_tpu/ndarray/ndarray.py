@@ -230,6 +230,20 @@ class NDArray:
             return onp.asarray(a.astype(jnp.float32))
         return onp.asarray(a)
 
+    def __array__(self, dtype=None, copy=None):
+        """numpy conversion protocol: without this, np.asarray(ndarray)
+        falls back to the SEQUENCE protocol and crawls __getitem__
+        row-by-row — O(n) device round trips that look like a hang.
+
+        A host copy is always materialized from the device buffer, so
+        copy=False cannot be honored (numpy 2 protocol: raise)."""
+        if copy is False:
+            raise ValueError(
+                "NDArray->numpy always copies (device buffer); "
+                "np.asarray(..., copy=False) cannot be satisfied")
+        out = self.asnumpy()
+        return out.astype(dtype) if dtype is not None else out
+
     def asscalar(self):
         if self.size != 1:
             raise ValueError("the array is not scalar")

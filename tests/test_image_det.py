@@ -1,0 +1,72 @@
+"""Detection data pipeline (reference python/mxnet/image/detection.py):
+bbox-aware augmenters + ImageDetIter feeding MultiBoxTarget."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import image, nd
+
+
+def _sample(n=5, size=32):
+    rng = onp.random.RandomState(0)
+    items = []
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3), dtype=onp.uint8)
+        lab = onp.asarray([[i % 3, 0.2, 0.3, 0.6, 0.7]], onp.float32)
+        items.append((img, lab))
+    return items
+
+
+def test_det_flip_moves_boxes():
+    aug = image.DetHorizontalFlipAug(p=1.0)
+    img = onp.zeros((8, 8, 3), onp.uint8)
+    lab = onp.asarray([[0, 0.1, 0.2, 0.4, 0.9]], onp.float32)
+    img2, lab2 = aug(img, lab)
+    onp.testing.assert_allclose(lab2[0], [0, 0.6, 0.2, 0.9, 0.9],
+                                rtol=1e-6)
+    # flipping twice restores
+    _, lab3 = aug(img2, lab2)
+    onp.testing.assert_allclose(lab3, lab, rtol=1e-6)
+
+
+def test_det_border_pad_square():
+    aug = image.DetBorderAug(fill=0)
+    img = onp.ones((4, 8, 3), onp.uint8)
+    lab = onp.asarray([[1, 0.0, 0.0, 1.0, 1.0]], onp.float32)
+    out, lab2 = aug(img, lab)
+    assert out.shape[:2] == (8, 8)
+    # the full-image box now spans the padded center band vertically
+    onp.testing.assert_allclose(lab2[0], [1, 0.0, 0.25, 1.0, 0.75],
+                                rtol=1e-6)
+
+
+def test_det_random_crop_keeps_objects():
+    onp.random.seed(0)
+    aug = image.DetRandomCropAug(min_object_covered=1.0,
+                                 min_crop_size=0.7)
+    img = onp.zeros((32, 32, 3), onp.uint8)
+    lab = onp.asarray([[0, 0.4, 0.4, 0.6, 0.6]], onp.float32)
+    for _ in range(10):
+        img2, lab2 = aug(img, lab)
+        assert len(lab2) == 1
+        assert (lab2[:, 1:] >= -1e-6).all() and (lab2[:, 1:] <= 1 + 1e-6).all()
+
+
+def test_image_det_iter_batches_and_multibox_target():
+    items = _sample(5)
+    it = image.ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                            imglist=items,
+                            augmenters=image.CreateDetAugmenter(
+                                (3, 16, 16), rand_mirror=True))
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 1                 # 5 items, bs 2 -> wrap 1
+    b = batches[0]
+    assert b.data[0].shape == (2, 3, 16, 16)
+    assert b.label[0].shape[0] == 2 and b.label[0].shape[2] == 5
+    # labels feed MultiBoxTarget directly
+    anchors = nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 4, 4)),
+                                       sizes=(0.5,), ratios=(1.0,))
+    out = nd.contrib.MultiBoxTarget(anchors, b.label[0],
+                                    nd.zeros((2, 3, anchors.shape[1])))
+    assert out[0].shape[0] == 2
