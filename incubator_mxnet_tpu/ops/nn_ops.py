@@ -516,3 +516,122 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False):
         logits = jnp.where(mask.astype(bool), logits, -jnp.inf)
     probs = jnn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhts,bhsd->bhtd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# spatial-transformer family (reference src/operator/bilinear_sampler.cc,
+# grid_generator.cc, spatial_transformer.cc) and UpSampling
+# ---------------------------------------------------------------------------
+
+def _bilinear_taps(data, xs, ys):
+    """Gather the 4 bilinear taps of NCHW data at pixel coords (xs, ys)
+    (flattened per batch); out-of-range taps contribute zero (reference
+    BilinearSampler border semantics).  Returns taps + fractional
+    weights."""
+    n, c, h, w = data.shape
+    x0 = jnp.floor(xs)
+    y0 = jnp.floor(ys)
+
+    def tap(yi, xi):
+        inside = ((xi >= 0) & (xi <= w - 1)
+                  & (yi >= 0) & (yi <= h - 1))        # (N, P)
+        xi_c = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yi_c = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        flat = data.reshape(n, c, h * w)
+        idx = (yi_c * w + xi_c)[:, None, :]           # (N, 1, P)
+        vals = jnp.take_along_axis(flat, jnp.broadcast_to(
+            idx, (n, c, idx.shape[-1])), axis=2)      # (N, C, P)
+        return vals * inside[:, None, :]
+
+    return (tap(y0, x0), tap(y0, x0 + 1), tap(y0 + 1, x0),
+            tap(y0 + 1, x0 + 1), xs - x0, ys - y0)
+
+
+@register("BilinearSampler", aliases=("bilinear_sampler",))
+def bilinear_sampler(data, grid):
+    """data (N,C,H,W), grid (N,2,Ho,Wo) with x=grid[:,0], y=grid[:,1] in
+    [-1,1] → (N,C,Ho,Wo) (reference src/operator/bilinear_sampler.cc)."""
+    n, c, h, w = data.shape
+    ho, wo = grid.shape[2], grid.shape[3]
+    gx = grid[:, 0].reshape(n, -1).astype(jnp.float32)
+    gy = grid[:, 1].reshape(n, -1).astype(jnp.float32)
+    xs = (gx + 1.0) * (w - 1) / 2.0
+    ys = (gy + 1.0) * (h - 1) / 2.0
+    v00, v01, v10, v11, fx, fy = _bilinear_taps(
+        data.astype(jnp.float32), xs, ys)
+    fx = fx[:, None, :]
+    fy = fy[:, None, :]
+    out = (v00 * (1 - fx) * (1 - fy) + v01 * fx * (1 - fy)
+           + v10 * (1 - fx) * fy + v11 * fx * fy)
+    return out.reshape(n, c, ho, wo).astype(data.dtype)
+
+
+@register("GridGenerator", aliases=("grid_generator",))
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Affine (N,6) → sampling grid (N,2,H,W); warp passes flow through
+    (reference src/operator/grid_generator.cc)."""
+    h, w = int(target_shape[0]), int(target_shape[1])
+    if transform_type == "warp":
+        # data is (N,2,H,W) optical flow added to the identity grid,
+        # normalized to [-1,1]
+        n, _, h, w = data.shape
+        xs = jnp.arange(w, dtype=jnp.float32)[None, :]
+        ys = jnp.arange(h, dtype=jnp.float32)[:, None]
+        gx = (data[:, 0] + xs) * 2.0 / max(w - 1, 1) - 1.0
+        gy = (data[:, 1] + ys) * 2.0 / max(h - 1, 1) - 1.0
+        return jnp.stack([gx, gy], axis=1)
+    n = data.shape[0]
+    theta = data.reshape(n, 2, 3).astype(jnp.float32)
+    ys, xs = jnp.meshgrid(jnp.linspace(-1, 1, h), jnp.linspace(-1, 1, w),
+                          indexing="ij")
+    ones = jnp.ones_like(xs)
+    base = jnp.stack([xs, ys, ones], axis=0).reshape(3, -1)  # (3, H*W)
+    out = jnp.einsum("nij,jp->nip", theta, base)             # (N,2,H*W)
+    return out.reshape(n, 2, h, w)
+
+
+@register("SpatialTransformer", aliases=("spatial_transformer",))
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear"):
+    """STN: affine params → grid → bilinear sample (reference
+    src/operator/spatial_transformer.cc)."""
+    grid = grid_generator.fn(loc, "affine", target_shape)
+    return bilinear_sampler.fn(data, grid)
+
+
+@register("UpSampling", aliases=("upsampling",))
+def upsampling(*args, scale=2, sample_type="nearest", num_filter=0,
+               num_args=1):
+    """Nearest/bilinear upsampling (reference src/operator/upsampling.cc);
+    multiple inputs are upsampled to the first one's scaled size and
+    concatenated on channels."""
+    outs = []
+    data0 = args[0]
+    th, tw = data0.shape[2] * scale, data0.shape[3] * scale
+    for d in args[:max(1, num_args)]:
+        if sample_type == "nearest":
+            r_h, r_w = th // d.shape[2], tw // d.shape[3]
+            out = jnp.repeat(jnp.repeat(d, r_h, axis=2), r_w, axis=3)
+        else:
+            out = jax.image.resize(
+                d.astype(jnp.float32),
+                (d.shape[0], d.shape[1], th, tw), method="bilinear"
+            ).astype(d.dtype)
+        outs.append(out)
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+
+
+@register("log_sigmoid")
+def log_sigmoid(x):
+    return jnn.log_sigmoid(x)
+
+
+@register("masked_softmax")
+def masked_softmax(data, mask, axis=-1, temperature=1.0):
+    """softmax over positions where mask is True (reference
+    src/operator/nn/softmax.cc masked_softmax)."""
+    logits = data / temperature
+    neg = jnp.finfo(jnp.float32).min
+    logits = jnp.where(mask.astype(bool), logits.astype(jnp.float32), neg)
+    out = jnn.softmax(logits, axis=axis)
+    return (out * mask.astype(out.dtype)).astype(data.dtype)

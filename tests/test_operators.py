@@ -328,3 +328,49 @@ def test_smooth_l1():
                        0.5 * x.asnumpy() ** 2,
                        onp.abs(x.asnumpy()) - 0.5)
     assert_almost_equal(out, expect, rtol=1e-5)
+
+
+def test_spatial_transformer_family():
+    """STN ops (reference bilinear_sampler.cc / grid_generator.cc /
+    spatial_transformer.cc / upsampling.cc)."""
+    import numpy as onp
+    from incubator_mxnet_tpu import nd
+
+    rng = onp.random.RandomState(0)
+    data = nd.array(rng.rand(2, 3, 5, 5).astype(onp.float32))
+    ident = nd.array(onp.tile(onp.array([1, 0, 0, 0, 1, 0], onp.float32),
+                              (2, 1)))
+    out = nd.SpatialTransformer(data, ident, target_shape=(5, 5))
+    onp.testing.assert_allclose(out.asnumpy(), data.asnumpy(),
+                                rtol=1e-4, atol=1e-5)
+    # horizontal-flip affine: x' = -x
+    flip = nd.array(onp.tile(onp.array([-1, 0, 0, 0, 1, 0], onp.float32),
+                             (2, 1)))
+    out2 = nd.SpatialTransformer(data, flip, target_shape=(5, 5))
+    onp.testing.assert_allclose(out2.asnumpy(),
+                                data.asnumpy()[:, :, :, ::-1],
+                                rtol=1e-4, atol=1e-5)
+    # grid_generator warp mode: zero flow == identity sampling
+    zero_flow = nd.zeros((2, 2, 5, 5))
+    grid = nd.GridGenerator(zero_flow, transform_type="warp")
+    out3 = nd.BilinearSampler(data, grid)
+    onp.testing.assert_allclose(out3.asnumpy(), data.asnumpy(),
+                                rtol=1e-4, atol=1e-5)
+    # gradients flow through the sampler
+    import jax, jax.numpy as jnp
+    from incubator_mxnet_tpu.ops.registry import get_op
+    bs = get_op("BilinearSampler")
+    g = jax.grad(lambda d: jnp.sum(bs.fn(d, grid.data)))(data.data)
+    assert float(jnp.abs(g).sum()) > 0
+
+
+def test_upsampling_bilinear_and_masked_softmax():
+    import numpy as onp
+    from incubator_mxnet_tpu import nd
+
+    x = nd.array(onp.arange(8, dtype=onp.float32).reshape(1, 2, 2, 2))
+    up = nd.UpSampling(x, scale=2, sample_type="bilinear")
+    assert up.shape == (1, 2, 4, 4)
+    m = nd.masked_softmax(nd.ones((1, 3)),
+                          nd.array(onp.array([[1, 0, 1]], onp.float32)))
+    onp.testing.assert_allclose(m.asnumpy(), [[0.5, 0.0, 0.5]], rtol=1e-5)
