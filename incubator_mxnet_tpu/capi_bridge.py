@@ -1,0 +1,233 @@
+"""Python side of the MX* C API (src/c_api.cc).
+
+Architecture parity with the reference's C API boundary: the reference's
+``src/c_api/c_api.cc`` (~400 ``MX*`` functions over
+include/mxnet/c_api.h) is a thin C shim translating C types into calls
+on the C++ runtime.  Here the runtime *is* the XLA/PJRT stack driven by
+this package, so the C shim (src/c_api.cc, embedded CPython like
+src/predict.cc) translates C types into calls on the functions below.
+Every function in this module takes/returns only C-marshallable values
+(ints, bytes, str, tuples/lists thereof, or opaque object handles the C
+side holds strong references to).
+
+Keep this module import-light: the C ABI is used from deploy contexts
+where startup latency matters.
+"""
+from __future__ import annotations
+
+import ast
+
+import numpy as onp
+
+__all__ = ["DTYPE_CODES", "DTYPE_NAMES"]
+
+# Reference dtype enum (include/mxnet/base.h via mshadow type flags:
+# kFloat32=0 ... kInt64=6, kBool=7; bfloat16 carries the reference's
+# mshadow::kBfloat16=12) extended with the remaining fixed-width ints.
+DTYPE_NAMES = {
+    0: "float32", 1: "float64", 2: "float16", 3: "uint8", 4: "int32",
+    5: "int8", 6: "int64", 7: "bool", 8: "int16", 9: "uint16",
+    10: "uint32", 11: "uint64", 12: "bfloat16",
+}
+DTYPE_CODES = {v: k for k, v in DTYPE_NAMES.items()}
+
+
+def _mx():
+    import incubator_mxnet_tpu as mx
+    return mx
+
+
+def _nd():
+    from incubator_mxnet_tpu import nd
+    return nd
+
+
+def version() -> int:
+    return 20000  # 2.0.0, MXNET_VERSION style (major*10000+minor*100+patch)
+
+
+def seed(s: int) -> None:
+    _mx().random.seed(int(s))
+
+
+def waitall() -> None:
+    _nd().waitall()
+
+
+# ---------------------------------------------------------------------------
+# NDArray
+# ---------------------------------------------------------------------------
+
+def _ctx(dev_type: int, dev_id: int):
+    from incubator_mxnet_tpu.context import Context
+    return Context(Context.devtype2str[int(dev_type)], int(dev_id))
+
+
+def create(shape, dtype_code: int, dev_type: int, dev_id: int):
+    nd = _nd()
+    return nd.zeros(tuple(int(d) for d in shape),
+                    dtype=DTYPE_NAMES[int(dtype_code)],
+                    ctx=_ctx(dev_type, dev_id))
+
+
+def set_bytes(arr, data: bytes) -> None:
+    """SyncCopyFromCPU: in-place host->array copy (full buffer)."""
+    import jax.numpy as jnp
+    np_dtype = onp.dtype(jnp.dtype(arr.dtype))  # ml_dtypes covers bf16
+    host = onp.frombuffer(data, dtype=np_dtype)
+    arr[:] = host.reshape(arr.shape)
+
+
+def set_floats(arr, data: bytes) -> None:
+    """SyncCopyFromCPU float32 variant (the reference predict-style path:
+    host buffer is float32, cast to the array dtype on device)."""
+    host = onp.frombuffer(data, dtype=onp.float32).reshape(arr.shape)
+    arr[:] = host
+
+
+def get_bytes(arr) -> bytes:
+    a = arr.asnumpy()
+    return a.tobytes()
+
+
+def get_floats(arr) -> bytes:
+    return arr.asnumpy().astype(onp.float32).tobytes()
+
+
+def get_shape(arr):
+    return tuple(int(d) for d in arr.shape)
+
+
+def get_dtype(arr) -> int:
+    from incubator_mxnet_tpu.base import dtype_name
+    return DTYPE_CODES[dtype_name(arr.dtype)]
+
+
+def get_context(arr):
+    ctx = arr.ctx
+    return int(ctx.device_typeid), int(ctx.device_id)
+
+
+def slice_(arr, begin: int, end: int):
+    return arr.slice([int(begin)], [int(end)])
+
+
+def at(arr, idx: int):
+    return arr[int(idx)]
+
+
+def reshape(arr, dims):
+    return arr.reshape(tuple(int(d) for d in dims))
+
+
+def wait_to_read(arr) -> None:
+    arr.wait_to_read()
+
+
+def save(fname: str, names, arrs) -> None:
+    nd = _nd()
+    if names:
+        nd.save(fname, dict(zip(names, arrs)))
+    else:
+        nd.save(fname, list(arrs))
+
+
+def load(fname: str):
+    nd = _nd()
+    data = nd.load(fname)
+    if isinstance(data, dict):
+        names = list(data.keys())
+        return names, [data[n] for n in names]
+    return [], list(data)
+
+
+# ---------------------------------------------------------------------------
+# Operator invocation (MXImperativeInvoke)
+# ---------------------------------------------------------------------------
+
+def list_ops():
+    from incubator_mxnet_tpu.ops import registry
+    return registry.list_ops()
+
+
+def _parse_val(s: str):
+    """Reference op params arrive as strings (dmlc::Parameter style);
+    accept python/mxnet literal syntax: ints, floats, bools, tuples."""
+    s = s.strip()
+    low = s.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    if low in ("none", "null"):
+        return None
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s
+
+
+def invoke(op_name: str, inputs, keys, vals):
+    from incubator_mxnet_tpu.ops import registry
+    kwargs = {k: _parse_val(v) for k, v in zip(keys, vals)}
+    out = registry.invoke(op_name, *inputs, **kwargs)
+    if isinstance(out, (list, tuple)):
+        return list(out)
+    return [out]
+
+
+# ---------------------------------------------------------------------------
+# KVStore
+# ---------------------------------------------------------------------------
+
+def kv_create(type_str: str):
+    import incubator_mxnet_tpu as mx
+    return mx.kv.create(type_str)
+
+
+def kv_init(kv, key: str, arr) -> None:
+    kv.init(key, arr)
+
+
+def kv_push(kv, key: str, arr, priority: int) -> None:
+    kv.push(key, arr, priority=int(priority))
+
+
+def kv_pull(kv, key: str, out, priority: int) -> None:
+    kv.pull(key, out=out, priority=int(priority))
+
+
+def kv_type(kv) -> str:
+    return kv.type
+
+
+def kv_rank(kv) -> int:
+    return int(kv.rank)
+
+
+def kv_size(kv) -> int:
+    return int(kv.num_workers)
+
+
+# ---------------------------------------------------------------------------
+# Symbol
+# ---------------------------------------------------------------------------
+
+def sym_from_json(json_str: str):
+    from incubator_mxnet_tpu import symbol as sym
+    return sym.load_json(json_str)
+
+
+def sym_from_file(fname: str):
+    from incubator_mxnet_tpu import symbol as sym
+    return sym.load(fname)
+
+
+def sym_to_json(s) -> str:
+    return s.tojson()
+
+
+def sym_outputs(s):
+    return list(s.list_outputs())
+
+
+def sym_arguments(s):
+    return list(s.list_arguments())

@@ -1,0 +1,510 @@
+/* Core MX* C API over the TPU runtime (header: include/mxt/mx_api.h).
+ *
+ * Layering parity with the reference: src/c_api/c_api.cc there is a C
+ * shim translating handles/strings into calls on the C++ runtime; here
+ * the runtime is the XLA/PJRT stack driven by the Python package, so
+ * this shim embeds CPython (like predict.cc) and drives
+ * incubator_mxnet_tpu/capi_bridge.py.  No user/model Python code is
+ * involved — the bridge is part of the runtime.
+ *
+ * Handle model: NDArrayHandle/SymbolHandle/KVStoreHandle are strong
+ * PyObject* references owned by the caller (release via *Free).
+ * Returned arrays live in thread-local RetStore (reference
+ * MXAPIThreadLocalEntry) valid until the next MX* call on the thread.
+ *
+ * Build: make -C src capi   -> ../incubator_mxnet_tpu/native/libmxtapi.so
+ */
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "include/mxt/mx_api.h"
+#include "error.h"
+#include "py_embed.h"
+
+extern "C" const char* MXTGetLastError(void);
+
+namespace {
+
+using mxt::PyFail;
+using Gil = mxt::GilScope;
+
+struct RetStore {
+  std::vector<int64_t> shape;
+  std::vector<std::string> strings;
+  std::vector<const char*> cstrs;
+  std::vector<void*> handles;
+  std::string str;
+};
+thread_local RetStore ret;
+
+PyObject* Bridge() {
+  static PyObject* mod = nullptr;  // borrowed forever once imported
+  if (!mod) mod = PyImport_ImportModule("incubator_mxnet_tpu.capi_bridge");
+  return mod;
+}
+
+/* Call bridge.<fn>(*args) with a vector of NEW references (consumed). */
+PyObject* CallBridge(const char* fn, std::vector<PyObject*> args) {
+  PyObject* mod = Bridge();
+  if (!mod) {
+    for (auto* a : args) Py_XDECREF(a);
+    return nullptr;
+  }
+  for (auto* a : args)
+    if (!a) {
+      for (auto* b : args) Py_XDECREF(b);
+      return nullptr;
+    }
+  PyObject* tup = PyTuple_New((Py_ssize_t)args.size());
+  for (size_t i = 0; i < args.size(); ++i)
+    PyTuple_SET_ITEM(tup, (Py_ssize_t)i, args[i]);  // steals
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  PyObject* out = f ? PyObject_CallObject(f, tup) : nullptr;
+  Py_XDECREF(f);
+  Py_DECREF(tup);
+  return out;
+}
+
+PyObject* IntTuple(const int64_t* vals, uint32_t n) {
+  PyObject* t = PyTuple_New(n);
+  for (uint32_t i = 0; i < n; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromLongLong(vals[i]));
+  return t;
+}
+
+PyObject* StrList(const char** vals, uint32_t n) {
+  PyObject* l = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, PyUnicode_FromString(vals[i]));
+  return l;
+}
+
+PyObject* HandleList(void** handles, uint32_t n) {
+  PyObject* l = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    PyObject* o = static_cast<PyObject*>(handles[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(l, i, o);
+  }
+  return l;
+}
+
+/* Copy a python list of str into thread-local ret storage. */
+int StoreStrList(PyObject* list, uint32_t* out_size, const char*** out,
+                 const char* where) {
+  ret.strings.clear();
+  ret.cstrs.clear();
+  Py_ssize_t n = PySequence_Size(list);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* item = PySequence_GetItem(list, i);
+    const char* s = item ? PyUnicode_AsUTF8(item) : nullptr;
+    if (!s) {
+      Py_XDECREF(item);
+      return PyFail(where);
+    }
+    ret.strings.emplace_back(s);
+    Py_DECREF(item);
+  }
+  for (auto& s : ret.strings) ret.cstrs.push_back(s.c_str());
+  *out_size = (uint32_t)n;
+  *out = ret.cstrs.data();
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXGetLastError(void) { return MXTGetLastError(); }
+
+int MXGetVersion(int* out) {
+  Gil gil;
+  if (!gil.ok()) {
+    mxt::SetLastError("python runtime failed to initialize");
+    return -1;
+  }
+  PyObject* r = CallBridge("version", {});
+  if (!r) return PyFail("MXGetVersion");
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRandomSeed(int seed) {
+  Gil gil;
+  PyObject* r = CallBridge("seed", {PyLong_FromLong(seed)});
+  if (!r) return PyFail("MXRandomSeed");
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ------------------------- NDArray ------------------------------------ */
+
+int MXNDArrayCreate(const int64_t* shape, uint32_t ndim, int dtype,
+                    int dev_type, int dev_id, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* r = CallBridge(
+      "create", {IntTuple(shape, ndim), PyLong_FromLong(dtype),
+                 PyLong_FromLong(dev_type), PyLong_FromLong(dev_id)});
+  if (!r) return PyFail("MXNDArrayCreate");
+  *out = r;  // strong ref transferred to caller
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle h) {
+  if (!h || !Py_IsInitialized()) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(h));
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void* data,
+                             uint64_t nbytes) {
+  Gil gil;
+  PyObject* o = static_cast<PyObject*>(h);
+  Py_INCREF(o);
+  PyObject* r = CallBridge(
+      "set_bytes",
+      {o, PyBytes_FromStringAndSize(static_cast<const char*>(data),
+                                    (Py_ssize_t)nbytes)});
+  if (!r) return PyFail("MXNDArraySyncCopyFromCPU");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle h, void* data, uint64_t nbytes) {
+  Gil gil;
+  PyObject* o = static_cast<PyObject*>(h);
+  Py_INCREF(o);
+  PyObject* r = CallBridge("get_bytes", {o});
+  if (!r) return PyFail("MXNDArraySyncCopyToCPU");
+  char* buf;
+  Py_ssize_t blen;
+  if (PyBytes_AsStringAndSize(r, &buf, &blen) != 0) {
+    Py_DECREF(r);
+    return PyFail("MXNDArraySyncCopyToCPU(bytes)");
+  }
+  if ((uint64_t)blen != nbytes) {
+    Py_DECREF(r);
+    mxt::SetLastError("MXNDArraySyncCopyToCPU: buffer size mismatch (got " +
+                      std::to_string(nbytes) + " bytes, array holds " +
+                      std::to_string(blen) + ")");
+    return -1;
+  }
+  std::memcpy(data, buf, (size_t)blen);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle h, uint32_t* out_dim,
+                      const int64_t** out_pdata) {
+  Gil gil;
+  PyObject* o = static_cast<PyObject*>(h);
+  Py_INCREF(o);
+  PyObject* r = CallBridge("get_shape", {o});
+  if (!r) return PyFail("MXNDArrayGetShape");
+  Py_ssize_t n = PyTuple_Size(r);
+  ret.shape.resize((size_t)n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    ret.shape[(size_t)i] = PyLong_AsLongLong(PyTuple_GetItem(r, i));
+  Py_DECREF(r);
+  *out_dim = (uint32_t)n;
+  *out_pdata = ret.shape.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle h, int* out) {
+  Gil gil;
+  PyObject* o = static_cast<PyObject*>(h);
+  Py_INCREF(o);
+  PyObject* r = CallBridge("get_dtype", {o});
+  if (!r) return PyFail("MXNDArrayGetDType");
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetContext(NDArrayHandle h, int* out_dev_type, int* out_dev_id) {
+  Gil gil;
+  PyObject* o = static_cast<PyObject*>(h);
+  Py_INCREF(o);
+  PyObject* r = CallBridge("get_context", {o});
+  if (!r) return PyFail("MXNDArrayGetContext");
+  *out_dev_type = (int)PyLong_AsLong(PyTuple_GetItem(r, 0));
+  *out_dev_id = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySlice(NDArrayHandle h, int64_t begin, int64_t end,
+                   NDArrayHandle* out) {
+  Gil gil;
+  PyObject* o = static_cast<PyObject*>(h);
+  Py_INCREF(o);
+  PyObject* r = CallBridge("slice_", {o, PyLong_FromLongLong(begin),
+                                      PyLong_FromLongLong(end)});
+  if (!r) return PyFail("MXNDArraySlice");
+  *out = r;
+  return 0;
+}
+
+int MXNDArrayAt(NDArrayHandle h, int64_t idx, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* o = static_cast<PyObject*>(h);
+  Py_INCREF(o);
+  PyObject* r = CallBridge("at", {o, PyLong_FromLongLong(idx)});
+  if (!r) return PyFail("MXNDArrayAt");
+  *out = r;
+  return 0;
+}
+
+int MXNDArrayReshape(NDArrayHandle h, int ndim, const int64_t* dims,
+                     NDArrayHandle* out) {
+  Gil gil;
+  PyObject* o = static_cast<PyObject*>(h);
+  Py_INCREF(o);
+  PyObject* r = CallBridge("reshape", {o, IntTuple(dims, (uint32_t)ndim)});
+  if (!r) return PyFail("MXNDArrayReshape");
+  *out = r;
+  return 0;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle h) {
+  Gil gil;
+  PyObject* o = static_cast<PyObject*>(h);
+  Py_INCREF(o);
+  PyObject* r = CallBridge("wait_to_read", {o});
+  if (!r) return PyFail("MXNDArrayWaitToRead");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitAll(void) {
+  Gil gil;
+  PyObject* r = CallBridge("waitall", {});
+  if (!r) return PyFail("MXNDArrayWaitAll");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySave(const char* fname, uint32_t num, NDArrayHandle* args,
+                  const char** keys) {
+  Gil gil;
+  PyObject* names = keys ? StrList(keys, num) : (Py_INCREF(Py_None), Py_None);
+  PyObject* r = CallBridge("save", {PyUnicode_FromString(fname), names,
+                                    HandleList(args, num)});
+  if (!r) return PyFail("MXNDArraySave");
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayLoad(const char* fname, uint32_t* out_size,
+                  NDArrayHandle** out_arr, uint32_t* out_name_size,
+                  const char*** out_names) {
+  Gil gil;
+  PyObject* r = CallBridge("load", {PyUnicode_FromString(fname)});
+  if (!r) return PyFail("MXNDArrayLoad");
+  PyObject* names = PyTuple_GetItem(r, 0);
+  PyObject* arrs = PyTuple_GetItem(r, 1);
+  if (StoreStrList(names, out_name_size, out_names, "MXNDArrayLoad") != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
+  ret.handles.clear();
+  Py_ssize_t n = PyList_Size(arrs);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* a = PyList_GetItem(arrs, i);
+    Py_INCREF(a);  // strong ref handed to caller
+    ret.handles.push_back(a);
+  }
+  Py_DECREF(r);
+  *out_size = (uint32_t)n;
+  *out_arr = ret.handles.data();
+  return 0;
+}
+
+/* ------------------------- Operators ----------------------------------- */
+
+int MXListAllOpNames(uint32_t* out_size, const char*** out_array) {
+  Gil gil;
+  PyObject* r = CallBridge("list_ops", {});
+  if (!r) return PyFail("MXListAllOpNames");
+  int rc = StoreStrList(r, out_size, out_array, "MXListAllOpNames");
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXImperativeInvokeByName(const char* op_name, int num_inputs,
+                             NDArrayHandle* inputs, int* num_outputs,
+                             NDArrayHandle** outputs, int num_params,
+                             const char** param_keys,
+                             const char** param_vals) {
+  Gil gil;
+  PyObject* r = CallBridge(
+      "invoke", {PyUnicode_FromString(op_name),
+                 HandleList(inputs, (uint32_t)num_inputs),
+                 StrList(param_keys, (uint32_t)num_params),
+                 StrList(param_vals, (uint32_t)num_params)});
+  if (!r) return PyFail("MXImperativeInvokeByName");
+  ret.handles.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* a = PyList_GetItem(r, i);
+    Py_INCREF(a);
+    ret.handles.push_back(a);
+  }
+  Py_DECREF(r);
+  *num_outputs = (int)n;
+  *outputs = ret.handles.data();
+  return 0;
+}
+
+/* ------------------------- KVStore ------------------------------------- */
+
+int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
+  Gil gil;
+  PyObject* r = CallBridge("kv_create", {PyUnicode_FromString(type)});
+  if (!r) return PyFail("MXKVStoreCreate");
+  *out = r;
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle h) { return MXNDArrayFree(h); }
+
+static int KvPerKey(const char* fn, KVStoreHandle h, uint32_t num,
+                    const char** keys, NDArrayHandle* vals, int priority,
+                    bool with_priority, const char* where) {
+  Gil gil;
+  for (uint32_t i = 0; i < num; ++i) {
+    PyObject* kv = static_cast<PyObject*>(h);
+    PyObject* arr = static_cast<PyObject*>(vals[i]);
+    Py_INCREF(kv);
+    Py_INCREF(arr);
+    std::vector<PyObject*> args = {kv, PyUnicode_FromString(keys[i]), arr};
+    if (with_priority) args.push_back(PyLong_FromLong(priority));
+    PyObject* r = CallBridge(fn, std::move(args));
+    if (!r) return PyFail(where);
+    Py_DECREF(r);
+  }
+  return 0;
+}
+
+int MXKVStoreInitEx(KVStoreHandle h, uint32_t num, const char** keys,
+                    NDArrayHandle* vals) {
+  return KvPerKey("kv_init", h, num, keys, vals, 0, false, "MXKVStoreInitEx");
+}
+
+int MXKVStorePushEx(KVStoreHandle h, uint32_t num, const char** keys,
+                    NDArrayHandle* vals, int priority) {
+  return KvPerKey("kv_push", h, num, keys, vals, priority, true,
+                  "MXKVStorePushEx");
+}
+
+int MXKVStorePullEx(KVStoreHandle h, uint32_t num, const char** keys,
+                    NDArrayHandle* outs, int priority) {
+  return KvPerKey("kv_pull", h, num, keys, outs, priority, true,
+                  "MXKVStorePullEx");
+}
+
+int MXKVStoreGetType(KVStoreHandle h, const char** out) {
+  Gil gil;
+  PyObject* o = static_cast<PyObject*>(h);
+  Py_INCREF(o);
+  PyObject* r = CallBridge("kv_type", {o});
+  if (!r) return PyFail("MXKVStoreGetType");
+  const char* s = PyUnicode_AsUTF8(r);
+  if (!s) {
+    Py_DECREF(r);
+    return PyFail("MXKVStoreGetType(str)");
+  }
+  ret.str = s;
+  Py_DECREF(r);
+  *out = ret.str.c_str();
+  return 0;
+}
+
+static int KvInt(const char* fn, KVStoreHandle h, int* out,
+                 const char* where) {
+  Gil gil;
+  PyObject* o = static_cast<PyObject*>(h);
+  Py_INCREF(o);
+  PyObject* r = CallBridge(fn, {o});
+  if (!r) return PyFail(where);
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetRank(KVStoreHandle h, int* out) {
+  return KvInt("kv_rank", h, out, "MXKVStoreGetRank");
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle h, int* out) {
+  return KvInt("kv_size", h, out, "MXKVStoreGetGroupSize");
+}
+
+/* ------------------------- Symbol -------------------------------------- */
+
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
+  Gil gil;
+  PyObject* r = CallBridge("sym_from_json", {PyUnicode_FromString(json)});
+  if (!r) return PyFail("MXSymbolCreateFromJSON");
+  *out = r;
+  return 0;
+}
+
+int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out) {
+  Gil gil;
+  PyObject* r = CallBridge("sym_from_file", {PyUnicode_FromString(fname)});
+  if (!r) return PyFail("MXSymbolCreateFromFile");
+  *out = r;
+  return 0;
+}
+
+int MXSymbolSaveToJSON(SymbolHandle h, const char** out_json) {
+  Gil gil;
+  PyObject* o = static_cast<PyObject*>(h);
+  Py_INCREF(o);
+  PyObject* r = CallBridge("sym_to_json", {o});
+  if (!r) return PyFail("MXSymbolSaveToJSON");
+  const char* s = PyUnicode_AsUTF8(r);
+  if (!s) {
+    Py_DECREF(r);
+    return PyFail("MXSymbolSaveToJSON(str)");
+  }
+  ret.str = s;
+  Py_DECREF(r);
+  *out_json = ret.str.c_str();
+  return 0;
+}
+
+static int SymStrList(const char* fn, SymbolHandle h, uint32_t* out_size,
+                      const char*** out, const char* where) {
+  Gil gil;
+  PyObject* o = static_cast<PyObject*>(h);
+  Py_INCREF(o);
+  PyObject* r = CallBridge(fn, {o});
+  if (!r) return PyFail(where);
+  int rc = StoreStrList(r, out_size, out, where);
+  Py_DECREF(r);
+  return rc;
+}
+
+int MXSymbolListOutputs(SymbolHandle h, uint32_t* out_size,
+                        const char*** out) {
+  return SymStrList("sym_outputs", h, out_size, out, "MXSymbolListOutputs");
+}
+
+int MXSymbolListArguments(SymbolHandle h, uint32_t* out_size,
+                          const char*** out) {
+  return SymStrList("sym_arguments", h, out_size, out,
+                    "MXSymbolListArguments");
+}
+
+int MXSymbolFree(SymbolHandle h) { return MXNDArrayFree(h); }
+
+}  // extern "C"

@@ -1,0 +1,163 @@
+/* Smoke test for the core MX* C API (mx_api.h): pure C client, no
+ * Python — exercises NDArray lifecycle, imperative invoke, .params
+ * save/load round-trip, KVStore push/pull and Symbol JSON round-trip
+ * against libmxtapi.so.  Run by tests/test_c_api.py.
+ *
+ * Usage: mxt_c_api_smoke <tmpdir>
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "include/mxt/mx_api.h"
+
+#define CHECK(cond)                                                        \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      fprintf(stderr, "FAIL %s:%d: %s | %s\n", __FILE__, __LINE__, #cond,  \
+              MXGetLastError());                                           \
+      return 1;                                                            \
+    }                                                                      \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <tmpdir>\n", argv[0]);
+    return 2;
+  }
+  int version = 0;
+  CHECK(MXGetVersion(&version) == 0 && version >= 20000);
+  CHECK(MXRandomSeed(0) == 0);
+
+  /* NDArray create + copy round-trip */
+  int64_t shape[2] = {2, 3};
+  NDArrayHandle a = NULL;
+  CHECK(MXNDArrayCreate(shape, 2, 0 /*float32*/, 1 /*cpu*/, 0, &a) == 0);
+  float host[6] = {0, 1, 2, 3, 4, 5};
+  CHECK(MXNDArraySyncCopyFromCPU(a, host, sizeof(host)) == 0);
+  CHECK(MXNDArrayWaitToRead(a) == 0);
+
+  uint32_t ndim = 0;
+  const int64_t* rshape = NULL;
+  CHECK(MXNDArrayGetShape(a, &ndim, &rshape) == 0);
+  CHECK(ndim == 2 && rshape[0] == 2 && rshape[1] == 3);
+  int dtype = -1, dev_type = -1, dev_id = -1;
+  CHECK(MXNDArrayGetDType(a, &dtype) == 0 && dtype == 0);
+  CHECK(MXNDArrayGetContext(a, &dev_type, &dev_id) == 0 && dev_type == 1);
+
+  /* invoke: broadcast_add(a, a) then reshape via string param */
+  NDArrayHandle inputs[2] = {a, a};
+  int num_out = 0;
+  NDArrayHandle* outs = NULL;
+  CHECK(MXImperativeInvokeByName("broadcast_add", 2, inputs, &num_out, &outs,
+                                 0, NULL, NULL) == 0);
+  CHECK(num_out == 1);
+  NDArrayHandle sum = outs[0];
+  float back[6] = {0};
+  CHECK(MXNDArraySyncCopyToCPU(sum, back, sizeof(back)) == 0);
+  int i;
+  for (i = 0; i < 6; ++i) CHECK(back[i] == 2 * host[i]);
+
+  const char* pkeys[1] = {"shape"};
+  const char* pvals[1] = {"(3, 2)"};
+  NDArrayHandle rin[1] = {sum};
+  CHECK(MXImperativeInvokeByName("reshape", 1, rin, &num_out, &outs, 1,
+                                 pkeys, pvals) == 0);
+  CHECK(MXNDArrayGetShape(outs[0], &ndim, &rshape) == 0);
+  CHECK(ndim == 2 && rshape[0] == 3 && rshape[1] == 2);
+  CHECK(MXNDArrayFree(outs[0]) == 0);
+
+  /* slice / at / reshape handle paths */
+  NDArrayHandle row = NULL, elem = NULL, rsh = NULL;
+  CHECK(MXNDArraySlice(a, 0, 1, &row) == 0);
+  CHECK(MXNDArrayGetShape(row, &ndim, &rshape) == 0 && rshape[0] == 1);
+  CHECK(MXNDArrayAt(a, 1, &elem) == 0);
+  CHECK(MXNDArrayGetShape(elem, &ndim, &rshape) == 0 && ndim == 1);
+  int64_t dims[2] = {3, 2};
+  CHECK(MXNDArrayReshape(a, 2, dims, &rsh) == 0);
+  MXNDArrayFree(row);
+  MXNDArrayFree(elem);
+  MXNDArrayFree(rsh);
+
+  /* save / load (.params reference wire format) */
+  char fname[1024];
+  snprintf(fname, sizeof(fname), "%s/smoke.params", argv[1]);
+  const char* keys[2] = {"alpha", "beta"};
+  NDArrayHandle pair[2] = {a, sum};
+  CHECK(MXNDArraySave(fname, 2, pair, keys) == 0);
+  uint32_t nload = 0, nnames = 0;
+  NDArrayHandle* loaded = NULL;
+  const char** names = NULL;
+  CHECK(MXNDArrayLoad(fname, &nload, &loaded, &nnames, &names) == 0);
+  CHECK(nload == 2 && nnames == 2);
+  CHECK(strcmp(names[0], "alpha") == 0 && strcmp(names[1], "beta") == 0);
+  float back2[6] = {0};
+  CHECK(MXNDArraySyncCopyToCPU(loaded[0], back2, sizeof(back2)) == 0);
+  for (i = 0; i < 6; ++i) CHECK(back2[i] == host[i]);
+  MXNDArrayFree(loaded[0]);
+  MXNDArrayFree(loaded[1]);
+
+  /* op listing */
+  uint32_t nops = 0;
+  const char** op_names = NULL;
+  CHECK(MXListAllOpNames(&nops, &op_names) == 0);
+  CHECK(nops > 300);
+
+  /* KVStore local: init / push / pull */
+  KVStoreHandle kv = NULL;
+  CHECK(MXKVStoreCreate("local", &kv) == 0);
+  const char* kv_keys[1] = {"w"};
+  NDArrayHandle kv_vals[1] = {a};
+  CHECK(MXKVStoreInitEx(kv, 1, kv_keys, kv_vals) == 0);
+  CHECK(MXKVStorePushEx(kv, 1, kv_keys, kv_vals, 0) == 0);
+  NDArrayHandle pulled = NULL;
+  CHECK(MXNDArrayCreate(shape, 2, 0, 1, 0, &pulled) == 0);
+  NDArrayHandle kv_outs[1];
+  kv_outs[0] = pulled;
+  CHECK(MXKVStorePullEx(kv, 1, kv_keys, kv_outs, 0) == 0);
+  float back3[6] = {0};
+  CHECK(MXNDArraySyncCopyToCPU(pulled, back3, sizeof(back3)) == 0);
+  for (i = 0; i < 6; ++i) CHECK(back3[i] == host[i]);
+  const char* kv_type = NULL;
+  int rank = -1, size = -1;
+  CHECK(MXKVStoreGetType(kv, &kv_type) == 0 && strcmp(kv_type, "local") == 0);
+  CHECK(MXKVStoreGetRank(kv, &rank) == 0 && rank == 0);
+  CHECK(MXKVStoreGetGroupSize(kv, &size) == 0 && size == 1);
+  MXNDArrayFree(pulled);
+  CHECK(MXKVStoreFree(kv) == 0);
+
+  /* Symbol JSON round-trip (file written by the pytest driver) */
+  snprintf(fname, sizeof(fname), "%s/net-symbol.json", argv[1]);
+  FILE* f = fopen(fname, "rb");
+  if (f) {
+    fclose(f);
+    SymbolHandle sym = NULL;
+    CHECK(MXSymbolCreateFromFile(fname, &sym) == 0);
+    uint32_t nout = 0, narg = 0;
+    const char** outputs = NULL;
+    CHECK(MXSymbolListOutputs(sym, &nout, &outputs) == 0 && nout >= 1);
+    const char** args = NULL;
+    CHECK(MXSymbolListArguments(sym, &narg, &args) == 0 && narg >= 1);
+    const char* json = NULL;
+    CHECK(MXSymbolSaveToJSON(sym, &json) == 0);
+    CHECK(strstr(json, "nodes") != NULL);
+    SymbolHandle sym2 = NULL;
+    CHECK(MXSymbolCreateFromJSON(json, &sym2) == 0);
+    MXSymbolFree(sym2);
+    MXSymbolFree(sym);
+  }
+
+  /* error path: bogus op must fail with a message, not crash */
+  CHECK(MXImperativeInvokeByName("definitely_not_an_op", 1, inputs, &num_out,
+                                 &outs, 0, NULL, NULL) == -1);
+  CHECK(strlen(MXGetLastError()) > 0);
+
+  CHECK(MXNDArraySyncCopyToCPU(a, back, sizeof(back) - 4) == -1);
+
+  MXNDArrayFree(sum);
+  MXNDArrayFree(a);
+  CHECK(MXNDArrayWaitAll() == 0);
+  printf("c_api smoke ok (version %d, %u ops)\n", version, nops);
+  return 0;
+}

@@ -1,0 +1,108 @@
+/* Core MX* C API (reference include/mxnet/c_api.h, 3,641 ln).
+ *
+ * The reference exposes ~400 MX* functions as the ABI every language
+ * frontend binds against.  This header regenerates the load-bearing
+ * core of that surface — NDArray lifecycle/copy/query, imperative op
+ * invocation, save/load, KVStore, Symbol — over the TPU runtime
+ * (implemented in src/c_api.cc as an embedded-interpreter shim driving
+ * incubator_mxnet_tpu/capi_bridge.py, the same layering as the
+ * reference's C shim over its C++ runtime).  The deploy-only predict
+ * surface lives in c_api.h / predict.cc (reference c_predict_api.h).
+ *
+ * Conventions (reference src/c_api/c_api_error.cc):
+ *   - every function returns 0 on success, -1 on failure;
+ *   - the failure message is retrievable via MXGetLastError();
+ *   - returned arrays (shapes, name lists, handle lists) live in
+ *     thread-local storage owned by the library and stay valid until
+ *     the next MX* call on the same thread (reference
+ *     MXAPIThreadLocalEntry semantics);
+ *   - NDArray/Symbol/KVStore handles are strong references: release
+ *     each with the matching *Free call.
+ */
+#ifndef MXT_MX_API_H_
+#define MXT_MX_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+typedef void* KVStoreHandle;
+
+const char* MXGetLastError(void);
+int MXGetVersion(int* out);
+int MXRandomSeed(int seed);
+
+/* ------------------------- NDArray ------------------------------------ */
+/* dtype codes follow the reference enum: 0=float32 1=float64 2=float16
+ * 3=uint8 4=int32 5=int8 6=int64 7=bool 8=int16 9=uint16 10=uint32
+ * 11=uint64 12=bfloat16.  dev_type: 1=cpu 2=gpu 6=tpu (context.py). */
+int MXNDArrayCreate(const int64_t* shape, uint32_t ndim, int dtype,
+                    int dev_type, int dev_id, NDArrayHandle* out);
+int MXNDArrayFree(NDArrayHandle h);
+/* Full-buffer host<->device copies; nbytes must equal size*itemsize. */
+int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void* data,
+                             uint64_t nbytes);
+int MXNDArraySyncCopyToCPU(NDArrayHandle h, void* data, uint64_t nbytes);
+int MXNDArrayGetShape(NDArrayHandle h, uint32_t* out_dim,
+                      const int64_t** out_pdata);
+int MXNDArrayGetDType(NDArrayHandle h, int* out);
+int MXNDArrayGetContext(NDArrayHandle h, int* out_dev_type, int* out_dev_id);
+int MXNDArraySlice(NDArrayHandle h, int64_t begin, int64_t end,
+                   NDArrayHandle* out);
+int MXNDArrayAt(NDArrayHandle h, int64_t idx, NDArrayHandle* out);
+int MXNDArrayReshape(NDArrayHandle h, int ndim, const int64_t* dims,
+                     NDArrayHandle* out);
+int MXNDArrayWaitToRead(NDArrayHandle h);
+int MXNDArrayWaitAll(void);
+/* Reference-format .params serialization (src/ndarray/ndarray.cc:1679).
+ * keys may be NULL for an unnamed list. */
+int MXNDArraySave(const char* fname, uint32_t num, NDArrayHandle* args,
+                  const char** keys);
+int MXNDArrayLoad(const char* fname, uint32_t* out_size,
+                  NDArrayHandle** out_arr, uint32_t* out_name_size,
+                  const char*** out_names);
+
+/* ------------------------- Operators ----------------------------------- */
+int MXListAllOpNames(uint32_t* out_size, const char*** out_array);
+/* Imperative invoke by registry name (reference MXImperativeInvokeEx,
+ * src/c_api/c_api_ndarray.cc:153; op params arrive as strings exactly
+ * like dmlc::Parameter setters). *num_outputs/*outputs are outputs
+ * only — auto-allocated, returned via thread-local storage. */
+int MXImperativeInvokeByName(const char* op_name, int num_inputs,
+                             NDArrayHandle* inputs, int* num_outputs,
+                             NDArrayHandle** outputs, int num_params,
+                             const char** param_keys,
+                             const char** param_vals);
+
+/* ------------------------- KVStore ------------------------------------- */
+int MXKVStoreCreate(const char* type, KVStoreHandle* out);
+int MXKVStoreFree(KVStoreHandle h);
+int MXKVStoreInitEx(KVStoreHandle h, uint32_t num, const char** keys,
+                    NDArrayHandle* vals);
+int MXKVStorePushEx(KVStoreHandle h, uint32_t num, const char** keys,
+                    NDArrayHandle* vals, int priority);
+int MXKVStorePullEx(KVStoreHandle h, uint32_t num, const char** keys,
+                    NDArrayHandle* outs, int priority);
+int MXKVStoreGetType(KVStoreHandle h, const char** out);
+int MXKVStoreGetRank(KVStoreHandle h, int* out);
+int MXKVStoreGetGroupSize(KVStoreHandle h, int* out);
+
+/* ------------------------- Symbol -------------------------------------- */
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out);
+int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out);
+int MXSymbolSaveToJSON(SymbolHandle h, const char** out_json);
+int MXSymbolListOutputs(SymbolHandle h, uint32_t* out_size,
+                        const char*** out);
+int MXSymbolListArguments(SymbolHandle h, uint32_t* out_size,
+                          const char*** out);
+int MXSymbolFree(SymbolHandle h);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* MXT_MX_API_H_ */

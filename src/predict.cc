@@ -22,8 +22,12 @@
 
 #include "include/mxt/c_api.h"
 #include "error.h"
+#include "py_embed.h"
 
 namespace {
+
+using mxt::EnsurePython;
+using mxt::PyFail;
 
 struct Predictor {
   PyObject* pred = nullptr;       // deploy.Predictor instance
@@ -31,35 +35,6 @@ struct Predictor {
   PyObject* outputs = nullptr;    // last forward's outputs (tuple/array)
   std::vector<std::string> input_bufs;
 };
-
-bool EnsurePython() {
-  if (Py_IsInitialized()) return true;
-  Py_InitializeEx(0);
-  if (!Py_IsInitialized()) return false;
-  /* release the GIL the init thread implicitly holds, so other threads'
-   * PyGILState_Ensure() calls don't deadlock */
-  PyEval_SaveThread();
-  return true;
-}
-
-/* Fetch the python error as a string and stash it in the mxt error slot. */
-int PyFail(const char* where) {
-  std::string msg = std::string(where) + ": python error";
-  if (PyErr_Occurred()) {
-    PyObject *type, *value, *tb;
-    PyErr_Fetch(&type, &value, &tb);
-    PyObject* s = value ? PyObject_Str(value) : nullptr;
-    if (s) {
-      msg = std::string(where) + ": " + PyUnicode_AsUTF8(s);
-      Py_DECREF(s);
-    }
-    Py_XDECREF(type);
-    Py_XDECREF(value);
-    Py_XDECREF(tb);
-  }
-  mxt::SetLastError(msg);
-  return -1;
-}
 
 }  // namespace
 

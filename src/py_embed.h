@@ -1,0 +1,69 @@
+// Shared CPython-embedding helpers for the C ABI shims (predict.cc,
+// c_api.cc).  Both shims follow the same layering: a C surface whose
+// implementation drives the XLA/PJRT runtime through the Python
+// package, so both need interpreter bootstrap + python-error capture.
+#ifndef MXT_PY_EMBED_H_
+#define MXT_PY_EMBED_H_
+
+#include <Python.h>
+
+#include <string>
+
+#include "error.h"
+
+namespace mxt {
+
+// Bring up the interpreter once per process (no-op when the shim is
+// loaded INTO a Python process, e.g. via ctypes).  Releases the GIL the
+// init thread implicitly holds so other threads' PyGILState_Ensure()
+// calls don't deadlock.
+inline bool EnsurePython() {
+  if (Py_IsInitialized()) return true;
+  Py_InitializeEx(0);
+  if (!Py_IsInitialized()) return false;
+  PyEval_SaveThread();
+  return true;
+}
+
+// Fetch the pending python exception as text into the thread-local
+// error slot; returns -1 for direct use as the C ABI failure rc.
+inline int PyFail(const char* where) {
+  std::string msg = std::string(where) + ": python error";
+  if (PyErr_Occurred()) {
+    PyObject *type, *value, *tb;
+    PyErr_Fetch(&type, &value, &tb);
+    PyObject* s = value ? PyObject_Str(value) : nullptr;
+    if (s) {
+      // AsUTF8 can itself fail (unencodable exception text) — keep the
+      // generic message rather than appending a null pointer
+      const char* txt = PyUnicode_AsUTF8(s);
+      if (txt) msg = std::string(where) + ": " + txt;
+      Py_DECREF(s);
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+  }
+  SetLastError(msg);
+  return -1;
+}
+
+// RAII: interpreter + GIL for the scope of one C ABI call.
+class GilScope {
+ public:
+  GilScope() : ok_(EnsurePython()) {
+    if (ok_) state_ = PyGILState_Ensure();
+  }
+  ~GilScope() {
+    if (ok_) PyGILState_Release(state_);
+  }
+  bool ok() const { return ok_; }
+
+ private:
+  bool ok_;
+  PyGILState_STATE state_{};
+};
+
+}  // namespace mxt
+
+#endif  // MXT_PY_EMBED_H_
