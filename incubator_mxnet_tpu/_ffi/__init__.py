@@ -1,0 +1,209 @@
+"""Packed-function FFI — Python side (native side: src/ffi.cc).
+
+Role parity with the reference's new FFI (python/mxnet/_ffi/ over
+src/runtime/packed_func.h + registry.h): ONE calling convention for
+every crossing of the C boundary.  Functions registered from C++
+(native built-ins) and from Python (callbacks) live in the same global
+name table; either side calls either side without per-function ctypes
+signatures.
+
+    from incubator_mxnet_tpu import _ffi
+    ver = _ffi.get_global_func("mxt.runtime.version")()
+
+    @_ffi.register_func("frontend.scale")
+    def scale(x, k):
+        return x * k
+    # now callable from C++ via MXTFuncCallByName("frontend.scale", ...)
+"""
+from __future__ import annotations
+
+import ctypes
+
+from ..native import lib as _lib
+
+__all__ = ["available", "get_global_func", "list_global_func_names",
+           "register_func", "Function"]
+
+TYPE_INT, TYPE_FLOAT, TYPE_STR, TYPE_HANDLE, TYPE_NULL = range(5)
+
+
+class MXTValue(ctypes.Union):
+    """Mirror of MXTValue (src/include/mxt/ffi.h)."""
+
+    _fields_ = [("v_int", ctypes.c_int64), ("v_float", ctypes.c_double),
+                ("v_handle", ctypes.c_void_p), ("v_str", ctypes.c_char_p)]
+
+
+PACKED_CFUNC = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.POINTER(MXTValue), ctypes.POINTER(ctypes.c_int),
+    ctypes.c_int, ctypes.POINTER(MXTValue), ctypes.POINTER(ctypes.c_int),
+    ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p))
+
+_libc = ctypes.CDLL(None, use_errno=True)
+_libc.strdup.restype = ctypes.c_void_p
+_libc.strdup.argtypes = [ctypes.c_char_p]
+
+# registered ctypes callbacks must outlive their registration
+_registered: dict[str, object] = {}
+_declared = False
+
+
+def _declare():
+    global _declared
+    if _declared or _lib is None:
+        return
+    vp = ctypes.c_void_p
+    _lib.MXTFuncRegister.argtypes = [ctypes.c_char_p, PACKED_CFUNC, vp,
+                                     ctypes.c_int]
+    _lib.MXTFuncGet.argtypes = [ctypes.c_char_p, ctypes.POINTER(vp)]
+    _lib.MXTFuncListNames.argtypes = [
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+    _lib.MXTFuncCall.argtypes = [vp, ctypes.POINTER(MXTValue),
+                                 ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+                                 ctypes.POINTER(MXTValue),
+                                 ctypes.POINTER(ctypes.c_int)]
+    _lib.MXTFuncRetStr.argtypes = [ctypes.c_char_p,
+                                   ctypes.POINTER(MXTValue),
+                                   ctypes.POINTER(ctypes.c_int)]
+    _declared = True
+
+
+def available() -> bool:
+    return _lib is not None
+
+
+def _check(rc):
+    if rc != 0:
+        raise RuntimeError("FFI error: "
+                           + _lib.MXTGetLastError().decode("utf-8",
+                                                           "replace"))
+
+
+def _marshal(pyargs):
+    """Python values -> (MXTValue[], int[], keepalive list)."""
+    n = len(pyargs)
+    vals = (MXTValue * max(n, 1))()
+    codes = (ctypes.c_int * max(n, 1))()
+    keep = []
+    for i, a in enumerate(pyargs):
+        if a is None:
+            codes[i] = TYPE_NULL
+        elif isinstance(a, bool) or isinstance(a, int):
+            vals[i].v_int = int(a)
+            codes[i] = TYPE_INT
+        elif isinstance(a, float):
+            vals[i].v_float = a
+            codes[i] = TYPE_FLOAT
+        elif isinstance(a, str):
+            b = a.encode()
+            keep.append(b)  # the union holds a borrowed pointer
+            vals[i].v_str = b
+            codes[i] = TYPE_STR
+        elif isinstance(a, ctypes.c_void_p):
+            vals[i].v_handle = a.value
+            codes[i] = TYPE_HANDLE
+        else:
+            raise TypeError(f"FFI cannot marshal {type(a).__name__}; "
+                            "pass int/float/str/None")
+    return vals, codes, keep
+
+
+def _unmarshal(val: MXTValue, code: int):
+    if code == TYPE_INT:
+        return val.v_int
+    if code == TYPE_FLOAT:
+        return val.v_float
+    if code == TYPE_STR:
+        return val.v_str.decode() if val.v_str is not None else ""
+    if code == TYPE_HANDLE:
+        return val.v_handle
+    return None
+
+
+class Function:
+    """A handle to a packed function in the global table."""
+
+    def __init__(self, handle, name):
+        self._handle = handle
+        self.name = name
+
+    def __call__(self, *args):
+        vals, codes, keep = _marshal(args)
+        ret = MXTValue()
+        ret_code = ctypes.c_int(TYPE_NULL)
+        _check(_lib.MXTFuncCall(self._handle, vals, codes, len(args),
+                                ctypes.byref(ret), ctypes.byref(ret_code)))
+        del keep
+        return _unmarshal(ret, ret_code.value)
+
+    def __repr__(self):
+        return f"<ffi.Function {self.name}>"
+
+
+def get_global_func(name: str) -> Function:
+    if _lib is None:
+        raise RuntimeError("native runtime library unavailable — the FFI "
+                           "needs libmxtpu.so (see native/__init__.py)")
+    _declare()
+    h = ctypes.c_void_p()
+    _check(_lib.MXTFuncGet(name.encode(), ctypes.byref(h)))
+    return Function(h, name)
+
+
+def list_global_func_names():
+    if _lib is None:
+        return []
+    _declare()
+    n = ctypes.c_uint32()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    _check(_lib.MXTFuncListNames(ctypes.byref(n), ctypes.byref(names)))
+    return [names[i].decode() for i in range(n.value)]
+
+
+def register_func(name, f=None, override=False):
+    """Register a Python callable under a global FFI name.
+
+    Usable directly (``register_func("n", fn)``) or as a decorator
+    (reference python/mxnet/_ffi style)::
+
+        @_ffi.register_func("frontend.scale")
+        def scale(x, k): return x * k
+    """
+    if f is None:
+        return lambda fn: register_func(name, fn, override=override)
+    if _lib is None:
+        raise RuntimeError("native runtime library unavailable — the FFI "
+                           "needs libmxtpu.so (see native/__init__.py)")
+    _declare()
+
+    def packed(args, codes, num, ret, ret_code, _resource, err_msg):
+        try:
+            pyargs = [_unmarshal(args[i], codes[i]) for i in range(num)]
+            out = f(*pyargs)
+            if out is None:
+                ret_code[0] = TYPE_NULL
+            elif isinstance(out, bool) or isinstance(out, int):
+                ret[0].v_int = int(out)
+                ret_code[0] = TYPE_INT
+            elif isinstance(out, float):
+                ret[0].v_float = out
+                ret_code[0] = TYPE_FLOAT
+            elif isinstance(out, str):
+                # native-side thread-local storage owns the copy
+                _check(_lib.MXTFuncRetStr(out.encode(), ret, ret_code))
+            else:
+                raise TypeError(
+                    f"FFI cannot marshal return {type(out).__name__}")
+            return 0
+        except Exception as e:  # noqa: BLE001 — becomes the C error
+            err_msg[0] = ctypes.cast(
+                _libc.strdup(f"{type(e).__name__}: {e}".encode()),
+                ctypes.c_char_p)
+            return -1
+
+    cb = PACKED_CFUNC(packed)
+    _check(_lib.MXTFuncRegister(name.encode(), cb, None,
+                                1 if override else 0))
+    _registered[name] = cb  # keep the ctypes thunk alive
+    return f

@@ -1,0 +1,182 @@
+/* Packed-function FFI registry (header: include/mxt/ffi.h).
+ *
+ * Reference counterpart: the TVM-style FFI under src/runtime/ +
+ * src/api/ (PackedFunc calling convention, global Registry).  The
+ * registry is process-global and language-neutral: native built-ins are
+ * registered below at static-init time, frontends register callbacks at
+ * runtime through the same MXTFuncRegister entry point, and any side
+ * can call any function with one marshalling path.
+ */
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "include/mxt/ffi.h"
+#include "error.h"
+
+namespace {
+
+struct Entry {
+  MXTPackedCFunc fn;
+  void* resource;
+};
+
+std::mutex& RegMutex() {
+  static std::mutex mu;
+  return mu;
+}
+
+std::map<std::string, Entry>& Registry() {
+  static std::map<std::string, Entry> reg;
+  return reg;
+}
+
+struct RetStore {
+  std::string str;                    // string return slot
+  std::vector<std::string> names;     // ListNames storage
+  std::vector<const char*> name_ptrs;
+};
+thread_local RetStore ffi_ret;
+
+}  // namespace
+
+extern "C" {
+
+int MXTFuncRegister(const char* name, MXTPackedCFunc fn, void* resource,
+                    int override_existing) {
+  MXT_API_BEGIN();
+  std::lock_guard<std::mutex> lock(RegMutex());
+  auto& reg = Registry();
+  if (!override_existing && reg.count(name))
+    throw std::runtime_error(std::string("MXTFuncRegister: '") + name +
+                             "' already registered (pass override=1)");
+  reg[name] = Entry{fn, resource};
+  MXT_API_END();
+}
+
+int MXTFuncGet(const char* name, MXTFuncHandle* out) {
+  MXT_API_BEGIN();
+  std::lock_guard<std::mutex> lock(RegMutex());
+  auto it = Registry().find(name);
+  if (it == Registry().end())
+    throw std::runtime_error(std::string("MXTFuncGet: no function '") +
+                             name + "' registered");
+  *out = &it->second;  // map nodes are pointer-stable
+  MXT_API_END();
+}
+
+int MXTFuncListNames(uint32_t* out_size, const char*** out_names) {
+  MXT_API_BEGIN();
+  std::lock_guard<std::mutex> lock(RegMutex());
+  ffi_ret.names.clear();
+  ffi_ret.name_ptrs.clear();
+  for (auto& kv : Registry()) ffi_ret.names.push_back(kv.first);
+  for (auto& s : ffi_ret.names) ffi_ret.name_ptrs.push_back(s.c_str());
+  *out_size = (uint32_t)ffi_ret.name_ptrs.size();
+  *out_names = ffi_ret.name_ptrs.data();
+  MXT_API_END();
+}
+
+int MXTFuncCall(MXTFuncHandle h, const MXTValue* args, const int* type_codes,
+                int num_args, MXTValue* ret, int* ret_tcode) {
+  auto* e = static_cast<Entry*>(h);
+  ret->v_handle = nullptr;
+  *ret_tcode = kMXTNull;
+  char* err = nullptr;
+  int rc = e->fn(args, type_codes, num_args, ret, ret_tcode, e->resource,
+                 &err);
+  if (rc != 0) {
+    mxt::SetLastError(err ? err : "packed function failed");
+    std::free(err);
+    return -1;
+  }
+  return 0;
+}
+
+int MXTFuncCallByName(const char* name, const MXTValue* args,
+                      const int* type_codes, int num_args, MXTValue* ret,
+                      int* ret_tcode) {
+  MXTFuncHandle h = nullptr;
+  if (MXTFuncGet(name, &h) != 0) return -1;
+  return MXTFuncCall(h, args, type_codes, num_args, ret, ret_tcode);
+}
+
+int MXTFuncRetStr(const char* s, MXTValue* ret, int* ret_tcode) {
+  MXT_API_BEGIN();
+  ffi_ret.str = s ? s : "";
+  ret->v_str = ffi_ret.str.c_str();
+  *ret_tcode = kMXTStr;
+  MXT_API_END();
+}
+
+}  // extern "C"
+
+/* ------------------- native built-ins ---------------------------------
+ * The counterparts of the reference's MXNET_REGISTER_API sites: C++
+ * functionality published through the packed convention.  Kept small —
+ * the compute fast path is XLA, so the FFI's job is uniform access to
+ * the native runtime + frontend callbacks, not per-op dispatch. */
+
+extern "C" int MXTStorageStats(uint64_t* bytes_allocated,
+                               uint64_t* bytes_pooled);
+
+namespace {
+
+int FfiError(char** err_msg, const std::string& msg) {
+  *err_msg = static_cast<char*>(std::malloc(msg.size() + 1));
+  std::memcpy(*err_msg, msg.c_str(), msg.size() + 1);
+  return -1;
+}
+
+int VersionFunc(const MXTValue*, const int*, int, MXTValue* ret,
+                int* ret_tcode, void*, char**) {
+  ret->v_int = 20000;
+  *ret_tcode = kMXTInt;
+  return 0;
+}
+
+/* echo(x) -> x: marshalling identity, used by FFI round-trip tests. */
+int EchoFunc(const MXTValue* args, const int* tcodes, int num, MXTValue* ret,
+             int* ret_tcode, void*, char** err_msg) {
+  if (num != 1) return FfiError(err_msg, "mxt.echo expects exactly 1 arg");
+  if (tcodes[0] == kMXTStr) return MXTFuncRetStr(args[0].v_str, ret,
+                                                 ret_tcode);
+  *ret = args[0];
+  *ret_tcode = tcodes[0];
+  return 0;
+}
+
+/* strcat(a, b) -> a+b: exercises string ownership across the boundary. */
+int StrcatFunc(const MXTValue* args, const int* tcodes, int num,
+               MXTValue* ret, int* ret_tcode, void*, char** err_msg) {
+  if (num != 2 || tcodes[0] != kMXTStr || tcodes[1] != kMXTStr)
+    return FfiError(err_msg, "mxt.strcat expects (str, str)");
+  std::string joined = std::string(args[0].v_str) + args[1].v_str;
+  return MXTFuncRetStr(joined.c_str(), ret, ret_tcode);
+}
+
+int StorageAllocatedFunc(const MXTValue*, const int*, int, MXTValue* ret,
+                         int* ret_tcode, void*, char** err_msg) {
+  uint64_t allocated = 0, pooled = 0;
+  if (MXTStorageStats(&allocated, &pooled) != 0)
+    return FfiError(err_msg, "storage stats unavailable");
+  ret->v_int = (int64_t)allocated;
+  *ret_tcode = kMXTInt;
+  return 0;
+}
+
+struct BuiltinRegistrar {
+  BuiltinRegistrar() {
+    MXTFuncRegister("mxt.runtime.version", VersionFunc, nullptr, 1);
+    MXTFuncRegister("mxt.echo", EchoFunc, nullptr, 1);
+    MXTFuncRegister("mxt.strcat", StrcatFunc, nullptr, 1);
+    MXTFuncRegister("mxt.storage.allocated", StorageAllocatedFunc, nullptr,
+                    1);
+  }
+};
+BuiltinRegistrar builtin_registrar;
+
+}  // namespace

@@ -1,0 +1,70 @@
+/* Packed-function FFI — the framework's single calling convention for
+ * crossing the C boundary (role of the reference's TVM-style new FFI:
+ * include/mxnet/runtime/packed_func.h, registry.h and the
+ * MXNET_REGISTER_API fast paths in src/api/).
+ *
+ * A packed function takes N tagged values and returns one tagged value.
+ * Both native code and frontends can REGISTER functions into one global
+ * name table and CALL functions out of it, so the same convention works
+ * C++→Python, Python→C++ and C++→C++ without per-function ctypes
+ * signatures.  Conventions follow the rest of the ABI: rc 0/-1 +
+ * MXTGetLastError(); returned strings/name-lists live in thread-local
+ * storage valid until the next FFI call on the thread.
+ */
+#ifndef MXT_FFI_H_
+#define MXT_FFI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Tagged value (reference packed_func.h TVMValue analog). */
+typedef union {
+  int64_t v_int;
+  double v_float;
+  void* v_handle;
+  const char* v_str;
+} MXTValue;
+
+/* type codes for MXTValue */
+enum {
+  kMXTInt = 0,
+  kMXTFloat = 1,
+  kMXTStr = 2,
+  kMXTHandle = 3,
+  kMXTNull = 4,
+};
+
+typedef void* MXTFuncHandle;
+
+/* Packed calling convention: read num_args tagged args, write one
+ * tagged result (defaults to null). resource is the registration-time
+ * closure pointer. Return 0, or -1 with the message in *err_msg
+ * (strdup'd; the caller frees). */
+typedef int (*MXTPackedCFunc)(const MXTValue* args, const int* type_codes,
+                              int num_args, MXTValue* ret, int* ret_tcode,
+                              void* resource, char** err_msg);
+
+/* Register under a global name. override=0 makes re-registration an
+ * error (reference registry.h Register(..., can_override)). */
+int MXTFuncRegister(const char* name, MXTPackedCFunc fn, void* resource,
+                    int override);
+int MXTFuncGet(const char* name, MXTFuncHandle* out);
+int MXTFuncListNames(uint32_t* out_size, const char*** out_names);
+int MXTFuncCall(MXTFuncHandle h, const MXTValue* args, const int* type_codes,
+                int num_args, MXTValue* ret, int* ret_tcode);
+/* Convenience: look up + call in one hop (C++ callers of
+ * frontend-registered functions use this). */
+int MXTFuncCallByName(const char* name, const MXTValue* args,
+                      const int* type_codes, int num_args, MXTValue* ret,
+                      int* ret_tcode);
+/* Copy s into thread-local return storage and point *ret at it — the
+ * only safe way for a packed func to return a string it owns. */
+int MXTFuncRetStr(const char* s, MXTValue* ret, int* ret_tcode);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* MXT_FFI_H_ */

@@ -1,0 +1,111 @@
+"""Packed-function FFI tests (src/ffi.cc + incubator_mxnet_tpu/_ffi).
+
+Reference: the TVM-style new FFI (src/runtime/packed_func.h,
+registry.h; python/mxnet/_ffi/) — one calling convention both ways
+across the C boundary.
+"""
+import ctypes
+
+import pytest
+
+from incubator_mxnet_tpu import _ffi
+
+pytestmark = pytest.mark.skipif(not _ffi.available(),
+                                reason="native runtime library unavailable")
+
+
+def test_native_builtins():
+    ver = _ffi.get_global_func("mxt.runtime.version")
+    assert ver() == 20000
+    names = _ffi.list_global_func_names()
+    assert {"mxt.runtime.version", "mxt.echo", "mxt.strcat",
+            "mxt.storage.allocated"} <= set(names)
+    assert isinstance(_ffi.get_global_func("mxt.storage.allocated")(), int)
+
+
+def test_marshalling_roundtrip():
+    echo = _ffi.get_global_func("mxt.echo")
+    assert echo(42) == 42
+    assert echo(-7) == -7
+    assert echo(2.5) == 2.5
+    assert echo("hello") == "hello"
+    assert echo(None) is None
+    assert echo(True) == 1  # bools travel as ints, like the reference
+
+
+def test_string_ownership_across_boundary():
+    strcat = _ffi.get_global_func("mxt.strcat")
+    a = strcat("foo", "bar")
+    b = strcat("baz", "qux")  # overwrites the thread-local return slot
+    assert b == "bazqux"
+    assert a == "foobar"      # a was decoded before the second call
+
+
+def test_unknown_function_errors():
+    with pytest.raises(RuntimeError, match="no function"):
+        _ffi.get_global_func("mxt.definitely_missing")
+
+
+def test_native_error_propagates():
+    strcat = _ffi.get_global_func("mxt.strcat")
+    with pytest.raises(RuntimeError, match="expects"):
+        strcat("only-one")
+
+
+def test_register_python_func_and_call_via_table():
+    @_ffi.register_func("test.pyscale", override=True)
+    def pyscale(x, k):
+        return x * k
+
+    f = _ffi.get_global_func("test.pyscale")
+    assert f(6, 7) == 42
+    assert f(1.5, 2.0) == 3.0
+
+
+def test_python_func_callable_from_native_side():
+    """C++ code calls frontend-registered functions via
+    MXTFuncCallByName — drive that exact entry point."""
+    from incubator_mxnet_tpu.native import lib
+
+    @_ffi.register_func("test.greet", override=True)
+    def greet(name):
+        return "hello " + name
+
+    _ffi._declare()
+    vals = (_ffi.MXTValue * 1)()
+    codes = (ctypes.c_int * 1)(_ffi.TYPE_STR)
+    arg = b"tpu"
+    vals[0].v_str = arg
+    ret = _ffi.MXTValue()
+    ret_code = ctypes.c_int(_ffi.TYPE_NULL)
+    lib.MXTFuncCallByName.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(_ffi.MXTValue),
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.POINTER(_ffi.MXTValue), ctypes.POINTER(ctypes.c_int)]
+    rc = lib.MXTFuncCallByName(b"test.greet", vals, codes, 1,
+                               ctypes.byref(ret), ctypes.byref(ret_code))
+    assert rc == 0
+    assert ret_code.value == _ffi.TYPE_STR
+    assert ret.v_str == b"hello tpu"
+
+
+def test_python_exception_becomes_ffi_error():
+    @_ffi.register_func("test.boom", override=True)
+    def boom():
+        raise ValueError("kaput")
+
+    f = _ffi.get_global_func("test.boom")
+    with pytest.raises(RuntimeError, match="kaput"):
+        f()
+
+
+def test_double_registration_guard():
+    @_ffi.register_func("test.once", override=True)
+    def once():
+        return 1
+
+    with pytest.raises(RuntimeError, match="already registered"):
+        _ffi.register_func("test.once", lambda: 2)
+    # override replaces
+    _ffi.register_func("test.once", lambda: 3, override=True)
+    assert _ffi.get_global_func("test.once")() == 3
