@@ -26,6 +26,9 @@ from . import sort_ops  # noqa: F401
 from . import contrib_ops  # noqa: F401
 from . import quantization_ops  # noqa: F401
 from . import sparse_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import image_ops  # noqa: F401
+from . import init_ops  # noqa: F401
 
 # Python-callback custom op (reference src/operator/custom/): op named
 # "Custom" with op_type kwarg, matching nd.Custom(..., op_type=...)

@@ -1,0 +1,236 @@
+"""Image operators (reference src/operator/image/: crop-inl.h,
+resize-inl.h, image_random-inl.h) plus the contrib image/box tail
+(bilinear_resize, box_encode/decode — src/operator/contrib/).
+
+Reference layout contract: ``image.*`` ops take HWC (or NHWC batches),
+``to_tensor`` converts to the CHW float tensors the conv stack eats.
+Resizes lower to ``jax.image.resize`` (XLA gather/dot lowering);
+random-* ops take an explicit PRNG key first, like every op in
+random_ops.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _is_batch(x):
+    return x.ndim == 4
+
+
+@register("image_crop", aliases=("_image_crop",))
+def image_crop(x, x_start=0, y_start=0, width=1, height=1):
+    """Fixed-window crop of HWC / NHWC images (image/crop-inl.h)."""
+    if _is_batch(x):
+        return x[:, y_start:y_start + height, x_start:x_start + width, :]
+    return x[y_start:y_start + height, x_start:x_start + width, :]
+
+
+@register("image_resize", aliases=("_image_resize",))
+def image_resize(x, size=None, keep_ratio=False, interp=1):
+    """Resize HWC / NHWC (image/resize-inl.h).  size: int or (w, h).
+    interp: 0 nearest, 1 bilinear (OpenCV codes the reference uses)."""
+    if size is None:
+        raise ValueError("image_resize requires size")
+    if isinstance(size, int):
+        if keep_ratio:
+            # short edge -> size, long edge scaled (resize-inl.h
+            # ResizeParam.keep_ratio)
+            src_h, src_w = (x.shape[1], x.shape[2]) if _is_batch(x) else \
+                (x.shape[0], x.shape[1])
+            if src_h < src_w:
+                size = (max(1, round(src_w * size / src_h)), size)
+            else:
+                size = (size, max(1, round(src_h * size / src_w)))
+        else:
+            size = (size, size)
+    w, h = int(size[0]), int(size[1])
+    method = "nearest" if interp == 0 else "linear"
+    if _is_batch(x):
+        new_shape = (x.shape[0], h, w, x.shape[3])
+    else:
+        new_shape = (h, w, x.shape[2])
+    return jax.image.resize(x.astype(jnp.float32), new_shape,
+                            method=method).astype(x.dtype)
+
+
+@register("image_to_tensor", aliases=("_image_to_tensor", "to_tensor"))
+def image_to_tensor(x):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (image_random-inl.h
+    ToTensor); batches NHWC → NCHW."""
+    y = x.astype(jnp.float32) / 255.0
+    if _is_batch(x):
+        return y.transpose(0, 3, 1, 2)
+    return y.transpose(2, 0, 1)
+
+
+@register("image_normalize", aliases=("_image_normalize",))
+def image_normalize(x, mean=0.0, std=1.0):
+    """(x - mean) / std on CHW / NCHW tensors, per-channel
+    (image_random-inl.h Normalize)."""
+    mean_t = jnp.asarray(mean, jnp.float32).reshape(-1, 1, 1)
+    std_t = jnp.asarray(std, jnp.float32).reshape(-1, 1, 1)
+    return ((x - mean_t) / std_t).astype(x.dtype)
+
+
+@register("image_random_crop", aliases=("_image_random_crop",))
+def image_random_crop(key, x, width=1, height=1):
+    """Random-position crop to (height, width) — static output shape,
+    traced offset (image_random-inl.h RandomCrop)."""
+    kh, kw = jax.random.split(key)
+    if _is_batch(x):
+        hmax, wmax = x.shape[1] - height, x.shape[2] - width
+    else:
+        hmax, wmax = x.shape[0] - height, x.shape[1] - width
+    y0 = jax.random.randint(kh, (), 0, hmax + 1)
+    x0 = jax.random.randint(kw, (), 0, wmax + 1)
+    axis = 1 if _is_batch(x) else 0
+    y = jax.lax.dynamic_slice_in_dim(x, y0, height, axis=axis)
+    return jax.lax.dynamic_slice_in_dim(y, x0, width, axis=axis + 1)
+
+
+@register("BilinearResize2D", aliases=("_contrib_BilinearResize2D",
+                                       "bilinear_resize_2d"))
+def bilinear_resize_2d(data, height=None, width=None, scale_height=None,
+                       scale_width=None, mode="size"):
+    """NCHW bilinear resize (contrib/bilinear_resize-inl.h)."""
+    n, c, h, w = data.shape
+    if height is None:
+        height = int(h * (scale_height or 1.0))
+    if width is None:
+        width = int(w * (scale_width or 1.0))
+    out = jax.image.resize(data.astype(jnp.float32),
+                           (n, c, int(height), int(width)), method="linear")
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Box codecs (reference src/operator/contrib/bounding_box.cc box_encode /
+# box_decode — the SSD target pipeline's anchor transforms)
+# ---------------------------------------------------------------------------
+
+@register("box_encode", aliases=("_contrib_box_encode",))
+def box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+               stds=(0.1, 0.1, 0.2, 0.2)):
+    """Encode matched ground-truth boxes against anchors into regression
+    targets + masks (bounding_box.cc _contrib_box_encode).
+
+    samples (B, N): 1 = positive match, else ignore; matches (B, N):
+    index into refs; anchors/refs (B, N/M, 4) corner format.
+    """
+    a_w = anchors[..., 2] - anchors[..., 0]
+    a_h = anchors[..., 3] - anchors[..., 1]
+    a_x = anchors[..., 0] + 0.5 * a_w
+    a_y = anchors[..., 1] + 0.5 * a_h
+    ref = jnp.take_along_axis(
+        refs, matches[..., None].astype(jnp.int32).clip(0), axis=1)
+    r_w = ref[..., 2] - ref[..., 0]
+    r_h = ref[..., 3] - ref[..., 1]
+    r_x = ref[..., 0] + 0.5 * r_w
+    r_y = ref[..., 1] + 0.5 * r_h
+    valid = (samples > 0.5)[..., None]
+    t = jnp.stack([(r_x - a_x) / jnp.maximum(a_w, 1e-12),
+                   (r_y - a_y) / jnp.maximum(a_h, 1e-12),
+                   jnp.log(jnp.maximum(r_w, 1e-12)
+                           / jnp.maximum(a_w, 1e-12)),
+                   jnp.log(jnp.maximum(r_h, 1e-12)
+                           / jnp.maximum(a_h, 1e-12))], axis=-1)
+    t = (t - jnp.asarray(means, t.dtype)) / jnp.asarray(stds, t.dtype)
+    masks = jnp.where(valid, jnp.ones_like(t), jnp.zeros_like(t))
+    return jnp.where(valid, t, jnp.zeros_like(t)), masks
+
+
+@register("box_decode", aliases=("_contrib_box_decode",))
+def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+               clip=-1.0, format="corner"):
+    """Decode regression deltas against anchors back to corner boxes
+    (bounding_box.cc _contrib_box_decode)."""
+    if format == "corner":
+        a_w = anchors[..., 2] - anchors[..., 0]
+        a_h = anchors[..., 3] - anchors[..., 1]
+        a_x = anchors[..., 0] + 0.5 * a_w
+        a_y = anchors[..., 1] + 0.5 * a_h
+    else:  # center
+        a_x, a_y = anchors[..., 0], anchors[..., 1]
+        a_w, a_h = anchors[..., 2], anchors[..., 3]
+    dx = data[..., 0] * std0
+    dy = data[..., 1] * std1
+    dw = data[..., 2] * std2
+    dh = data[..., 3] * std3
+    if clip is not None and clip > 0:
+        dw = jnp.minimum(dw, clip)
+        dh = jnp.minimum(dh, clip)
+    cx = dx * a_w + a_x
+    cy = dy * a_h + a_y
+    w = jnp.exp(dw) * a_w
+    h = jnp.exp(dh) * a_h
+    return jnp.stack([cx - 0.5 * w, cy - 0.5 * h, cx + 0.5 * w,
+                      cy + 0.5 * h], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Misc contrib tail
+# ---------------------------------------------------------------------------
+
+@register("allclose", aliases=("_contrib_allclose",), differentiable=False)
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    """Scalar 0/1 closeness test (contrib/allclose_op.cc)."""
+    return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                        equal_nan=equal_nan).astype(jnp.float32)
+
+
+@register("arange_like", aliases=("_contrib_arange_like",),
+          differentiable=False)
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    """arange shaped like data (or its given axis)
+    (contrib/arange_like — BERT position ids without host sync)."""
+    if axis is None:
+        n = data.size
+        vals = start + step * (jnp.arange(n) // repeat)
+        return vals.reshape(data.shape).astype(data.dtype)
+    n = data.shape[axis]
+    vals = start + step * (jnp.arange(n) // repeat)
+    return vals.astype(data.dtype)
+
+
+@register("quadratic", aliases=("_contrib_quadratic",))
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """a*x^2 + b*x + c (contrib/quadratic_op — the reference's extension
+    tutorial op; kept for example parity)."""
+    return a * jnp.square(data) + b * data + c
+
+
+@register("interleaved_matmul_encdec_qk",
+          aliases=("_contrib_interleaved_matmul_encdec_qk",))
+def interleaved_matmul_encdec_qk(queries, keys_values, heads=1):
+    """Encoder-decoder attention scores: queries (Tq, B, H*dh) x
+    interleaved kv (Tk, B, H*2*dh) → (B*H, Tq, Tk)
+    (reference transformer.cc encdec_qk)."""
+    Tq, B, E = queries.shape
+    dh = E // heads
+    Tk = keys_values.shape[0]
+    q = queries.reshape(Tq, B, heads, dh).transpose(1, 2, 0, 3) \
+        .reshape(B * heads, Tq, dh)
+    kv = keys_values.reshape(Tk, B, heads, 2, dh)
+    k = kv[:, :, :, 0, :].transpose(1, 2, 0, 3).reshape(B * heads, Tk, dh)
+    q = q / jnp.sqrt(jnp.asarray(dh, jnp.float32)).astype(q.dtype)
+    return jnp.einsum("btd,bsd->bts", q, k)
+
+
+@register("interleaved_matmul_encdec_valatt",
+          aliases=("_contrib_interleaved_matmul_encdec_valatt",))
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
+    """attention (B*H, Tq, Tk) x interleaved kv values → (Tq, B, H*dh)
+    (reference transformer.cc encdec_valatt)."""
+    Tk, B, E2 = keys_values.shape
+    dh = E2 // (heads * 2)
+    Tq = attention.shape[1]
+    kv = keys_values.reshape(Tk, B, heads, 2, dh)
+    v = kv[:, :, :, 1, :].transpose(1, 2, 0, 3).reshape(B * heads, Tk, dh)
+    out = jnp.einsum("bts,bsd->btd", attention, v)
+    return out.reshape(B, heads, Tq, dh).transpose(2, 0, 1, 3).reshape(
+        Tq, B, heads * dh)

@@ -81,11 +81,6 @@ def masked_fill(data, mask, value=0.0):
     return jnp.where(mask.astype(bool), jnp.asarray(value, data.dtype), data)
 
 
-@register("index_copy", num_inputs=3)
-def index_copy(base, index, updates):
-    return base.at[index.astype(jnp.int32)].set(updates)
-
-
 @register("index_array", num_inputs=1, differentiable=False)
 def index_array(x, axes=None):
     shape = x.shape

@@ -1,0 +1,59 @@
+"""Tensor-creation ops with no array inputs (reference
+src/operator/tensor/init_op.cc: _arange, _linspace, _eye, _full;
+histogram.cc).  Zero-input registry ops: everything is a static kwarg,
+so each distinct call signature compiles once and is cached.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import dtype_from_any
+
+__all__ = []
+
+
+@register("arange", aliases=("_arange",), differentiable=False,
+          num_inputs=0)
+def arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    if stop is None:
+        start, stop = 0.0, start
+    vals = jnp.arange(start, stop, step, dtype=dtype_from_any(dtype))
+    if repeat != 1:
+        vals = jnp.repeat(vals, repeat)
+    return vals
+
+
+@register("linspace", aliases=("_linspace",), differentiable=False,
+          num_inputs=0)
+def linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32"):
+    return jnp.linspace(start, stop, int(num), endpoint=endpoint,
+                        dtype=dtype_from_any(dtype))
+
+
+@register("logspace", differentiable=False, num_inputs=0)
+def logspace(start=0.0, stop=1.0, num=50, endpoint=True, base=10.0,
+             dtype="float32"):
+    return jnp.logspace(start, stop, int(num), endpoint=endpoint, base=base,
+                        dtype=dtype_from_any(dtype))
+
+
+@register("eye", aliases=("_eye",), differentiable=False, num_inputs=0)
+def eye(N=1, M=0, k=0, dtype="float32"):
+    return jnp.eye(int(N), int(M) if M else int(N), k=int(k),
+                   dtype=dtype_from_any(dtype))
+
+
+@register("_full", differentiable=False, num_inputs=0)
+def full_op(shape=(), value=0.0, dtype="float32"):
+    """Filled tensor (init_op.cc _full); the richer ``nd.full(shape, val,
+    ctx, dtype)`` frontend wrapper predates this op and keeps its name."""
+    return jnp.full(tuple(shape), value, dtype=dtype_from_any(dtype))
+
+
+@register("histogram", aliases=("_histogram",), differentiable=False)
+def histogram(data, bins=10, range=None):
+    """Counts + bin edges (reference tensor/histogram.cc; fixed bin count
+    keeps the output shape static for jit)."""
+    cnt, edges = jnp.histogram(data, bins=int(bins), range=range)
+    return cnt, edges

@@ -119,12 +119,22 @@ def linalg_svd(a):
 
 @register("linalg_maketrian", num_inputs=1)
 def linalg_maketrian(a, offset=0, lower=True):
-    n = a.shape[-1]
-    # inverse of extracting a triangle into packed form: approximate parity
-    k = int((((8 * n + 1) ** 0.5) - 1) / 2)
-    out = jnp.zeros(a.shape[:-1] + (k, k), a.dtype)
-    idx = jnp.tril_indices(k) if lower else jnp.triu_indices(k)
-    return out.at[..., idx[0], idx[1]].set(a)
+    """Unpack (..., m*(m+1)/2) into an (..., n, n) triangle with
+    n = m + |offset| (la_op.cc maketrian) — inverse of
+    linalg_extracttrian for matching offset/lower."""
+    plen = a.shape[-1]
+    m = int((((8 * plen + 1) ** 0.5) - 1) / 2)
+    n = m + abs(offset)
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    if offset > 0:
+        r, c = jnp.triu_indices(m)
+        c = c + offset
+    elif offset < 0:
+        r, c = jnp.tril_indices(m)
+        r = r - offset
+    else:
+        r, c = jnp.tril_indices(m) if lower else jnp.triu_indices(m)
+    return out.at[..., r, c].set(a)
 
 
 @register("khatri_rao")
@@ -140,3 +150,63 @@ def moments(x, axes=None, keepdims=False):
     mean = jnp.mean(x, axis=tuple(axes) if axes else None, keepdims=keepdims)
     var = jnp.var(x, axis=tuple(axes) if axes else None, keepdims=keepdims)
     return mean, var
+
+
+@register("linalg_extracttrian", num_inputs=1)
+def linalg_extracttrian(a, offset=0, lower=True):
+    """Pack a triangle of (..., n, n) into (..., m*(m+1)/2) with
+    m = n - |offset| (reference la_op.cc extracttrian): offset > 0 reads
+    the triangle starting at that super-diagonal, offset < 0 the one at
+    that sub-diagonal; ``lower`` picks the side only when offset == 0.
+    Inverse of linalg_maketrian for matching offset."""
+    n = a.shape[-1]
+    m = n - abs(offset)
+    if offset > 0:
+        r, c = jnp.triu_indices(m)
+        c = c + offset
+    elif offset < 0:
+        r, c = jnp.tril_indices(m)
+        r = r - offset
+    else:
+        r, c = jnp.tril_indices(m) if lower else jnp.triu_indices(m)
+    return a[..., r, c]
+
+
+@register("linalg_trmm", num_inputs=2)
+def linalg_trmm(a, b, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular matrix multiply (la_op.cc trmm): out = alpha*op(tri(A))@B
+    (or B@op(tri(A)) when rightside)."""
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    return alpha * (jnp.matmul(b, tri) if rightside else jnp.matmul(tri, b))
+
+
+@register("linalg_potri", num_inputs=1)
+def linalg_potri(a, lower=True):
+    """Inverse from a Cholesky factor (la_op.cc potri): given L with
+    A = L L^T, return A^{-1} = L^{-T} L^{-1}."""
+    from jax.scipy.linalg import solve_triangular
+    n = a.shape[-1]
+    eye = jnp.broadcast_to(jnp.eye(n, dtype=a.dtype), a.shape)
+    linv = solve_triangular(a, eye, lower=lower)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv) if lower else \
+        jnp.matmul(linv, jnp.swapaxes(linv, -1, -2))
+
+
+@register("linalg_syevd", num_inputs=1)
+def linalg_syevd(a):
+    """Symmetric eigendecomposition (la_op.cc syevd): returns (U, L) with
+    A = U^T diag(L) U — rows of U are eigenvectors, matching the
+    reference's row convention."""
+    w, v = jnp.linalg.eigh(a)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("linalg_gelqf", num_inputs=1)
+def linalg_gelqf(a):
+    """LQ factorization (la_op.cc gelqf): A = L Q with Q orthonormal rows.
+    Computed via QR of A^T."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    return jnp.swapaxes(q, -1, -2), jnp.swapaxes(r, -1, -2)

@@ -635,3 +635,52 @@ def masked_softmax(data, mask, axis=-1, temperature=1.0):
     logits = jnp.where(mask.astype(bool), logits.astype(jnp.float32), neg)
     out = jnn.softmax(logits, axis=axis)
     return (out * mask.astype(out.dtype)).astype(data.dtype)
+
+
+@register("LeakyReLU", num_inputs=-1)
+def LeakyReLU(data, gamma=None, act_type="leaky", slope=0.25,
+              lower_bound=0.125, upper_bound=0.334, training=False):
+    """Parametric activation family (reference src/operator/leaky_relu.cc
+    LeakyReLU: act_type in leaky/elu/gelu/selu/prelu/rrelu)."""
+    from jax import nn as jnn
+    if act_type == "leaky":
+        return jnn.leaky_relu(data, negative_slope=slope)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * jnp.expm1(data))
+    if act_type == "gelu":
+        return jnn.gelu(data, approximate=False)
+    if act_type == "selu":
+        return jnn.selu(data)
+    if act_type == "prelu":
+        if gamma is None:
+            raise ValueError("LeakyReLU(act_type='prelu') needs gamma")
+        shape = [1] * data.ndim
+        if data.ndim > 1:
+            shape[1] = gamma.size
+        g = gamma.reshape(shape)
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "rrelu":
+        # eval mode: the reference uses the mean slope; train-mode random
+        # slopes need an explicit key — use leaky with the mean
+        mean_slope = (lower_bound + upper_bound) / 2.0
+        return jnn.leaky_relu(data, negative_slope=mean_slope)
+    raise ValueError(f"unknown act_type {act_type!r}")
+
+
+@register("SyncBatchNorm", aliases=("_contrib_SyncBatchNorm",))
+def sync_batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                    momentum=0.9, fix_gamma=False, use_global_stats=False,
+                    ndev=1, key=None, output_mean_var=False, training=False):
+    """Cross-device BatchNorm (reference contrib/sync_batch_norm.cc).
+
+    TPU-first: inside pjit/shard_map with the batch axis sharded, the
+    jnp.mean reductions in batch_norm lower to XLA all-reduces over the
+    mesh automatically, so plain BatchNorm IS sync-BN under GSPMD — this
+    op exists for API parity and single-process use (where it equals
+    BatchNorm; the reference's ndev/key coordination fields are accepted
+    and unused).
+    """
+    return batch_norm.fn(x, gamma, beta, moving_mean, moving_var, eps=eps,
+                         momentum=momentum, fix_gamma=fix_gamma,
+                         use_global_stats=use_global_stats,
+                         output_mean_var=output_mean_var, training=training)

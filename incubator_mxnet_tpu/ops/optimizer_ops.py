@@ -1,0 +1,352 @@
+"""Optimizer update operators (reference src/operator/optimizer_op.cc).
+
+The reference registers every optimizer step as an NNVM op mutating
+weight/state NDArrays in place (sgd_update optimizer_op.cc:501,
+adam_update :649, lamb phases :917/:961, the variadic multi_* family
+:313/:346, mp_* master-weight variants :582-:599, contrib
+group_adagrad src/operator/contrib/optimizer_op.cc:53 and adamw
+src/operator/contrib/adamw.cc:34-79).  TPU-first redesign: each update
+is a PURE function returning the new weight and new state tensors —
+XLA fuses the whole update into one kernel and the caller (optimizer
+layer, fused train step, or user code via ``nd.sgd_update``) rebinds
+buffers with donation instead of in-place mutation.  Formulas match
+``optimizer/optimizer.py`` by construction; these ops are the
+registry-visible counterpart used by the legacy ``mx.nd.*_update``
+API surface and opperf.
+
+Multi-tensor (`multi_*`) ops take the reference's interleaved varargs
+layout (w0, g0, w1, g1, ...; :313) so call sites port unchanged.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _prep(grad, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+# ---------------------------------------------------------------------------
+# SGD family
+# ---------------------------------------------------------------------------
+
+@register("sgd_update", num_inputs=2)
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return (weight * (1 - lr * wd) - lr * g).astype(weight.dtype)
+
+
+@register("sgd_mom_update", num_inputs=3)
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    return ((weight + new_mom).astype(weight.dtype),
+            new_mom.astype(mom.dtype))
+
+
+@register("nag_mom_update", num_inputs=3)
+def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mom = momentum * mom + g
+    new_weight = weight - lr * (g + momentum * new_mom)
+    return new_weight.astype(weight.dtype), new_mom.astype(mom.dtype)
+
+
+def _mp(update_fn, weight, weight32, *args, **kwargs):
+    """Master-weight wrapper: math in fp32, weight re-cast to its dtype
+    (reference mp_sgd_update optimizer_op.cc:582: weight32 carries the
+    fp32 truth, the low-precision weight is a cast copy)."""
+    out = update_fn(weight32, *args, **kwargs)
+    if isinstance(out, tuple):
+        new_w32, *state = out
+        return (new_w32.astype(weight.dtype), *state, new_w32)
+    return out.astype(weight.dtype), out
+
+
+@register("mp_sgd_update", num_inputs=3)
+def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    return _mp(lambda w32: sgd_update.fn(w32, grad.astype(jnp.float32), lr,
+                                         wd, rescale_grad, clip_gradient),
+               weight, weight32)
+
+
+@register("mp_sgd_mom_update", num_inputs=4)
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    return _mp(lambda w32: sgd_mom_update.fn(
+        w32, grad.astype(jnp.float32), mom, lr, momentum, wd, rescale_grad,
+        clip_gradient), weight, weight32)
+
+
+@register("mp_nag_mom_update", num_inputs=4)
+def mp_nag_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0):
+    return _mp(lambda w32: nag_mom_update.fn(
+        w32, grad.astype(jnp.float32), mom, lr, momentum, wd, rescale_grad,
+        clip_gradient), weight, weight32)
+
+
+# ---------------------------------------------------------------------------
+# Sign-based (reference optimizer_op.cc:49-75)
+# ---------------------------------------------------------------------------
+
+@register("signsgd_update", num_inputs=2)
+def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return (weight * (1 - lr * wd) - lr * jnp.sign(g)).astype(weight.dtype)
+
+
+@register("signum_update", num_inputs=3)
+def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * (g + wd * weight)
+    new_weight = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return new_weight.astype(weight.dtype), new_mom.astype(mom.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Adam family (optimizer_op.cc:649; contrib/adamw.cc:34-79)
+# ---------------------------------------------------------------------------
+
+@register("adam_update", num_inputs=4)
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_weight = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return (new_weight.astype(weight.dtype), new_mean.astype(mean.dtype),
+            new_var.astype(var.dtype))
+
+
+@register("adamw_update", num_inputs=4,
+          aliases=("_adamw_update", "_contrib_adamw_update"))
+def adamw_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, eta=1.0, rescale_grad=1.0,
+                 clip_gradient=-1.0):
+    """Decoupled weight decay (contrib/adamw.cc:79): wd applies to the
+    weight directly, not through the moments."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_weight = weight - eta * (lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+                                 + wd * weight)
+    return (new_weight.astype(weight.dtype), new_mean.astype(mean.dtype),
+            new_var.astype(var.dtype))
+
+
+@register("mp_adamw_update", num_inputs=5, aliases=("_mp_adamw_update",))
+def mp_adamw_update(weight, grad, mean, var, weight32, lr, beta1=0.9,
+                    beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    new_w32, new_mean, new_var = adamw_update.fn(
+        weight32, grad.astype(jnp.float32), mean, var, lr, beta1, beta2,
+        epsilon, wd, eta, rescale_grad, clip_gradient)
+    return new_w32.astype(weight.dtype), new_mean, new_var, new_w32
+
+
+# ---------------------------------------------------------------------------
+# RMSProp (optimizer_op.cc:754-804)
+# ---------------------------------------------------------------------------
+
+@register("rmsprop_update", num_inputs=3)
+def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_weight = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_weight = jnp.clip(new_weight, -clip_weights, clip_weights)
+    return new_weight.astype(weight.dtype), new_n.astype(n.dtype)
+
+
+@register("rmspropalex_update", num_inputs=5)
+def rmspropalex_update(weight, grad, n, g_state, delta, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    """Graves' non-centered variant (optimizer_op.cc:804)."""
+    grad_p = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(grad_p)
+    new_g = gamma1 * g_state + (1 - gamma1) * grad_p
+    new_delta = gamma2 * delta - lr * grad_p / jnp.sqrt(
+        new_n - jnp.square(new_g) + epsilon)
+    new_weight = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        new_weight = jnp.clip(new_weight, -clip_weights, clip_weights)
+    return (new_weight.astype(weight.dtype), new_n.astype(n.dtype),
+            new_g.astype(g_state.dtype), new_delta.astype(delta.dtype))
+
+
+# ---------------------------------------------------------------------------
+# FTRL (optimizer_op.cc:845)
+# ---------------------------------------------------------------------------
+
+@register("ftrl_update", num_inputs=4)
+def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_weight = jnp.where(
+        jnp.abs(new_z) > lamda1,
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd),
+        jnp.zeros_like(weight))
+    return (new_weight.astype(weight.dtype), new_z.astype(z.dtype),
+            new_n.astype(n.dtype))
+
+
+# ---------------------------------------------------------------------------
+# LAMB phases (optimizer_op.cc:917-1042)
+# ---------------------------------------------------------------------------
+
+@register("lamb_update_phase1", num_inputs=4)
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    m, v = new_mean, new_var
+    if bias_correction:
+        m = m / (1 - beta1 ** t)
+        v = v / (1 - beta2 ** t)
+    update = m / (jnp.sqrt(v) + epsilon) + wd * weight
+    return (update.astype(weight.dtype), new_mean.astype(mean.dtype),
+            new_var.astype(var.dtype))
+
+
+@register("lamb_update_phase2", num_inputs=4)
+def lamb_update_phase2(weight, g, r1, r2, lr, lower_bound=-1.0,
+                       upper_bound=-1.0):
+    if lower_bound is not None and lower_bound > 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    ratio = jnp.where(jnp.logical_and(r1 > 0, r2 > 0), r1 / r2,
+                      jnp.ones_like(r1))
+    return (weight - lr * ratio * g).astype(weight.dtype)
+
+
+@register("mp_lamb_update_phase1", num_inputs=5)
+def mp_lamb_update_phase1(weight, grad, mean, var, weight32, beta1=0.9,
+                          beta2=0.999, epsilon=1e-6, t=1,
+                          bias_correction=True, wd=0.0, rescale_grad=1.0,
+                          clip_gradient=-1.0):
+    return lamb_update_phase1.fn(weight32, grad.astype(jnp.float32), mean,
+                                 var, beta1, beta2, epsilon, t,
+                                 bias_correction, wd, rescale_grad,
+                                 clip_gradient)
+
+
+@register("mp_lamb_update_phase2", num_inputs=5)
+def mp_lamb_update_phase2(weight, g, r1, r2, weight32, lr, lower_bound=-1.0,
+                          upper_bound=-1.0):
+    new_w32 = lamb_update_phase2.fn(weight32, g, r1, r2, lr, lower_bound,
+                                    upper_bound)
+    return new_w32.astype(weight.dtype), new_w32
+
+
+# ---------------------------------------------------------------------------
+# Group AdaGrad (contrib/optimizer_op.cc:53)
+# ---------------------------------------------------------------------------
+
+@register("group_adagrad_update", num_inputs=3,
+          aliases=("_contrib_group_adagrad_update",))
+def group_adagrad_update(weight, grad, history, lr, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5):
+    """Row-wise AdaGrad: one accumulator per output row (embedding use)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    grp = jnp.mean(jnp.square(g), axis=tuple(range(1, g.ndim)))
+    new_hist = history + grp
+    denom = jnp.sqrt(new_hist + epsilon).reshape(
+        (-1,) + (1,) * (g.ndim - 1))
+    new_weight = weight - lr * g / denom
+    return new_weight.astype(weight.dtype), new_hist.astype(history.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Multi-tensor variadic family (optimizer_op.cc:313-346).  Inputs arrive
+# interleaved exactly like the reference (w0,g0,w1,g1,... / +mom /
+# +weight32); lrs/wds are per-tensor tuples.
+# ---------------------------------------------------------------------------
+
+def _per_tensor(val, i):
+    if isinstance(val, (tuple, list)):
+        return val[i]
+    return val
+
+
+@register("multi_sgd_update")
+def multi_sgd_update(*tensors, lrs, wds, rescale_grad=1.0,
+                     clip_gradient=-1.0, num_weights=None):
+    n = num_weights if num_weights is not None else len(tensors) // 2
+    outs = []
+    for i in range(n):
+        w, g = tensors[2 * i], tensors[2 * i + 1]
+        outs.append(sgd_update.fn(w, g, _per_tensor(lrs, i),
+                                  _per_tensor(wds, i), rescale_grad,
+                                  clip_gradient))
+    return tuple(outs)
+
+
+@register("multi_sgd_mom_update")
+def multi_sgd_mom_update(*tensors, lrs, wds, momentum=0.0, rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=None):
+    n = num_weights if num_weights is not None else len(tensors) // 3
+    new_ws, new_ms = [], []
+    for i in range(n):
+        w, g, m = tensors[3 * i], tensors[3 * i + 1], tensors[3 * i + 2]
+        nw, nm = sgd_mom_update.fn(w, g, m, _per_tensor(lrs, i), momentum,
+                                   _per_tensor(wds, i), rescale_grad,
+                                   clip_gradient)
+        new_ws.append(nw)
+        new_ms.append(nm)
+    return tuple(new_ws) + tuple(new_ms)
+
+
+@register("multi_mp_sgd_update")
+def multi_mp_sgd_update(*tensors, lrs, wds, rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=None):
+    n = num_weights if num_weights is not None else len(tensors) // 3
+    new_ws, new_w32s = [], []
+    for i in range(n):
+        w, g, w32 = tensors[3 * i], tensors[3 * i + 1], tensors[3 * i + 2]
+        nw, nw32 = mp_sgd_update.fn(w, g, w32, _per_tensor(lrs, i),
+                                    _per_tensor(wds, i), rescale_grad,
+                                    clip_gradient)
+        new_ws.append(nw)
+        new_w32s.append(nw32)
+    return tuple(new_ws) + tuple(new_w32s)
+
+
+@register("multi_mp_sgd_mom_update")
+def multi_mp_sgd_mom_update(*tensors, lrs, wds, momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0,
+                            num_weights=None):
+    n = num_weights if num_weights is not None else len(tensors) // 4
+    new_ws, new_ms, new_w32s = [], [], []
+    for i in range(n):
+        w, g, m, w32 = tensors[4 * i:4 * i + 4]
+        nw, nm, nw32 = mp_sgd_mom_update.fn(
+            w, g, m, w32, _per_tensor(lrs, i), momentum, _per_tensor(wds, i),
+            rescale_grad, clip_gradient)
+        new_ws.append(nw)
+        new_ms.append(nm)
+        new_w32s.append(nw32)
+    return tuple(new_ws) + tuple(new_ms) + tuple(new_w32s)

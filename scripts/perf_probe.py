@@ -109,15 +109,25 @@ def probe_fused():
     from incubator_mxnet_tpu.gluon.model_zoo import vision
 
     mx.random.seed(0)
-    net = vision.resnet50_v1()
-    net.initialize(ctx=mx.cpu())
-    net(nd.random.uniform(shape=(1, 3, 32, 32)))
-    amp.convert_block(net, "bfloat16")
-    step = make_fused_train_step(
-        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
-        {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
-    x = jnp.asarray(onp.random.rand(bs, 3, 224, 224), jnp.bfloat16)
-    y = jnp.asarray(onp.random.randint(0, 1000, (bs,)), jnp.int32)
+    # stage ALL eager setup on the CPU backend (bench.py discipline):
+    # per-op eager dispatch over the axon tunnel costs seconds per op
+    accel = jax.devices()[0]
+    cpu0 = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu0):
+        net = vision.resnet50_v1()
+        net.initialize(ctx=mx.cpu())
+        net(nd.random.uniform(shape=(1, 3, 32, 32)))
+        amp.convert_block(net, "bfloat16")
+        step = make_fused_train_step(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+        x = jnp.asarray(onp.random.rand(bs, 3, 224, 224), jnp.bfloat16)
+        y = jnp.asarray(onp.random.randint(0, 1000, (bs,)), jnp.int32)
+    put = lambda t: jax.device_put(t, accel)  # noqa: E731
+    step.params = jax.tree_util.tree_map(put, step.params)
+    step.aux = jax.tree_util.tree_map(put, step.aux)
+    step.opt_state = jax.tree_util.tree_map(put, step.opt_state)
+    x, y = put(x), put(y)
 
     t0 = time.perf_counter()
     loss = step(x, y)
@@ -159,11 +169,67 @@ def probe_matmul():
               f"({100 * tf * 1e12 / PEAK:.1f}% of peak)", flush=True)
 
 
+def probe_conv1():
+    """Isolate single-conv efficiency: one conv shape, chained, like the
+    matmul probe — separates conv-kernel quality from tower effects."""
+    from jax import lax
+    bs = int(os.environ.get("PROBE_BS", "128"))
+    cases = [  # (cin, cout, k, stride, h, layout)
+        (512, 512, 3, 1, 28, "NCHW"),
+        (512, 512, 3, 1, 28, "NHWC"),
+        (256, 256, 3, 1, 56, "NHWC"),
+        (2048, 2048, 3, 1, 7, "NHWC"),
+        (64, 64, 3, 1, 112, "NHWC"),
+        (3, 64, 7, 2, 224, "NHWC"),
+    ]
+    for ci, co, k, s, h, layout in cases:
+        key = jax.random.PRNGKey(0)
+        if layout == "NCHW":
+            x = jax.random.normal(key, (bs, ci, h, h), jnp.bfloat16)
+            w = jax.random.normal(key, (co, ci, k, k), jnp.bfloat16) * 0.02
+            dn_str = ("NCHW", "OIHW", "NCHW")
+        else:
+            x = jax.random.normal(key, (bs, h, h, ci), jnp.bfloat16)
+            w = jax.random.normal(key, (k, k, ci, co), jnp.bfloat16) * 0.02
+            dn_str = ("NHWC", "HWIO", "NHWC")
+        reps = 8 if ci == co and s == 1 else 1
+
+        @jax.jit
+        def f(x, w, _dn_str=dn_str, _reps=reps, _k=k, _s=s):
+            y = x
+            for _ in range(_reps):
+                dn = lax.conv_dimension_numbers(y.shape, w.shape, _dn_str)
+                y = lax.conv_general_dilated(
+                    y, w, (_s, _s), [(_k // 2, _k // 2)] * 2,
+                    dimension_numbers=dn)
+                y = y * (1.0 / _k)
+            return y
+
+        # warm up, then time 10 dispatches and sync once at the end (the
+        # final host readback waits for the whole queued sequence)
+        for _ in range(2):
+            y = f(x, w)
+        sync(y)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            y = f(x, w)
+        sync(y)
+        dt = (time.perf_counter() - t0) / 10
+        ho = h // s
+        fl = reps * 2 * ci * co * k * k * ho * ho * bs
+        tf = fl / dt / 1e12
+        print(f"{layout} {ci:4d}->{co:4d} k{k} s{s} {h:3d}px x{reps}: "
+              f"{dt * 1e3:7.2f} ms  ~{tf:6.1f} TFLOP/s "
+              f"({100 * tf * 1e12 / PEAK:.1f}% of peak)", flush=True)
+
+
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "fused"
     print(f"devices: {jax.devices()}", flush=True)
     if mode == "matmul":
         probe_matmul()
+    elif mode == "conv1":
+        probe_conv1()
     elif mode == "layout":
         probe_layout()
     else:

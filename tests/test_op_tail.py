@@ -1,0 +1,282 @@
+"""Op-coverage tail: image ops, init ops, linalg completions, contrib
+misc, LeakyReLU family, SyncBatchNorm (ops/image_ops.py, init_ops.py,
+linalg_ops.py additions — reference src/operator/image/,
+tensor/init_op.cc, tensor/la_op.cc, contrib/).
+"""
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import nd
+
+
+def _np(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# image ops
+# ---------------------------------------------------------------------------
+
+def test_image_crop_hwc_and_batch():
+    img = nd.array(onp.arange(5 * 6 * 3).reshape(5, 6, 3).astype("f"))
+    out = nd.image_crop(img, x_start=1, y_start=2, width=3, height=2)
+    assert out.shape == (2, 3, 3)
+    onp.testing.assert_array_equal(_np(out), _np(img)[2:4, 1:4, :])
+    batch = nd.array(onp.random.rand(2, 5, 6, 3).astype("f"))
+    outb = nd.image_crop(batch, x_start=0, y_start=0, width=4, height=5)
+    assert outb.shape == (2, 5, 4, 3)
+
+
+def test_image_resize_shapes_and_nearest():
+    img = nd.array(onp.random.rand(8, 6, 3).astype("f"))
+    out = nd.image_resize(img, size=(12, 16), interp=1)
+    assert out.shape == (16, 12, 3)
+    # nearest on a 2x upscale replicates each source pixel into 2x2
+    small = nd.array(onp.arange(4).reshape(2, 2, 1).astype("f"))
+    up = nd.image_resize(small, size=4, interp=0)
+    onp.testing.assert_array_equal(
+        _np(up)[..., 0], onp.repeat(onp.repeat(
+            onp.arange(4.0).reshape(2, 2), 2, 0), 2, 1))
+
+
+def test_image_to_tensor_and_normalize():
+    img = nd.array((onp.random.rand(4, 5, 3) * 255).astype(onp.uint8))
+    t = nd.image_to_tensor(img)
+    assert t.shape == (3, 4, 5)
+    assert float(t.max().asnumpy()) <= 1.0
+    norm = nd.image_normalize(t, mean=(0.5, 0.5, 0.5), std=(0.2, 0.2, 0.2))
+    onp.testing.assert_allclose(_np(norm), (_np(t) - 0.5) / 0.2, rtol=1e-5)
+
+
+def test_image_random_crop_bounds():
+    import jax
+    img = nd.array(onp.arange(10 * 12 * 3).reshape(10, 12, 3).astype("f"))
+    out = nd.image_random_crop(nd.array(
+        onp.asarray(jax.random.PRNGKey(0), onp.uint32)), img, width=5,
+        height=4)
+    assert out.shape == (4, 5, 3)
+    # content must be a contiguous window of the source
+    src = _np(img)
+    got = _np(out)
+    found = any(
+        onp.array_equal(got, src[y:y + 4, x:x + 5])
+        for y in range(7) for x in range(8))
+    assert found
+
+
+def test_bilinear_resize_2d():
+    x = nd.array(onp.random.rand(2, 3, 4, 4).astype("f"))
+    out = nd.BilinearResize2D(x, height=8, width=6)
+    assert out.shape == (2, 3, 8, 6)
+    out2 = nd.BilinearResize2D(x, scale_height=2.0, scale_width=2.0,
+                               mode="scale")
+    assert out2.shape == (2, 3, 8, 8)
+
+
+# ---------------------------------------------------------------------------
+# box codecs
+# ---------------------------------------------------------------------------
+
+def test_box_encode_decode_roundtrip():
+    anchors = onp.array([[[0.1, 0.1, 0.4, 0.5], [0.3, 0.2, 0.8, 0.9]]],
+                        onp.float32)
+    refs = onp.array([[[0.15, 0.12, 0.45, 0.55], [0.25, 0.2, 0.75, 0.8]]],
+                     onp.float32)
+    samples = onp.ones((1, 2), onp.float32)
+    matches = onp.array([[0, 1]], onp.float32)
+    t, masks = nd.box_encode(nd.array(samples), nd.array(matches),
+                             nd.array(anchors), nd.array(refs))
+    assert _np(masks).min() == 1.0
+    dec = nd.box_decode(t, nd.array(anchors))
+    onp.testing.assert_allclose(_np(dec), refs, rtol=1e-4, atol=1e-5)
+
+
+def test_box_encode_negative_samples_masked():
+    anchors = onp.random.rand(1, 3, 4).astype("f")
+    refs = onp.random.rand(1, 2, 4).astype("f")
+    samples = onp.array([[1, -1, 0]], onp.float32)
+    matches = onp.array([[0, 0, 1]], onp.float32)
+    t, masks = nd.box_encode(nd.array(samples), nd.array(matches),
+                             nd.array(anchors), nd.array(refs))
+    assert _np(masks)[0, 1].sum() == 0 and _np(masks)[0, 2].sum() == 0
+    assert _np(t)[0, 1].sum() == 0
+
+
+# ---------------------------------------------------------------------------
+# contrib misc
+# ---------------------------------------------------------------------------
+
+def test_allclose_and_quadratic():
+    a = nd.array(onp.ones((3, 3), onp.float32))
+    b = a + 1e-9
+    assert float(_np(nd.allclose(a, b))) == 1.0
+    assert float(_np(nd.allclose(a, a + 1.0))) == 0.0
+    x = nd.array(onp.array([1.0, 2.0], onp.float32))
+    onp.testing.assert_allclose(_np(nd.quadratic(x, a=2.0, b=3.0, c=1.0)),
+                                [6.0, 15.0])
+
+
+def test_arange_like():
+    x = nd.zeros(shape=(2, 5))
+    full = nd.arange_like(x)
+    assert full.shape == (2, 5)
+    onp.testing.assert_array_equal(_np(full).ravel(), onp.arange(10))
+    ax = nd.arange_like(x, axis=1, start=3.0, step=2.0)
+    onp.testing.assert_array_equal(_np(ax), [3, 5, 7, 9, 11])
+
+
+def test_interleaved_matmul_encdec_matches_selfatt():
+    """encdec with kv built from the same sequence == selfatt scores."""
+    T, B, H, dh = 4, 2, 2, 8
+    rng = onp.random.RandomState(0)
+    qkv = rng.randn(T, B, H * 3 * dh).astype(onp.float32)
+    qkv_r = qkv.reshape(T, B, H, 3, dh)
+    q = qkv_r[:, :, :, 0, :].reshape(T, B, H * dh)
+    kv = onp.stack([qkv_r[:, :, :, 1, :], qkv_r[:, :, :, 2, :]],
+                   axis=3).reshape(T, B, H * 2 * dh)
+    ref = nd.interleaved_matmul_selfatt_qk(nd.array(qkv), heads=H)
+    got = nd.interleaved_matmul_encdec_qk(nd.array(q), nd.array(kv), heads=H)
+    onp.testing.assert_allclose(_np(got), _np(ref), rtol=1e-4, atol=1e-5)
+    att = onp.abs(rng.randn(B * H, T, T)).astype(onp.float32)
+    ref_v = nd.interleaved_matmul_selfatt_valatt(nd.array(qkv),
+                                                 nd.array(att), heads=H)
+    got_v = nd.interleaved_matmul_encdec_valatt(nd.array(kv), nd.array(att),
+                                                heads=H)
+    onp.testing.assert_allclose(_np(got_v), _np(ref_v), rtol=1e-4,
+                                atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# LeakyReLU family + SyncBatchNorm
+# ---------------------------------------------------------------------------
+
+def test_leaky_relu_family():
+    x = nd.array(onp.array([-2.0, -0.5, 0.5, 2.0], onp.float32))
+    leaky = nd.LeakyReLU(x, act_type="leaky", slope=0.1)
+    onp.testing.assert_allclose(_np(leaky), [-0.2, -0.05, 0.5, 2.0],
+                                rtol=1e-6)
+    elu = nd.LeakyReLU(x, act_type="elu", slope=1.0)
+    onp.testing.assert_allclose(_np(elu)[0], onp.expm1(-2.0), rtol=1e-5)
+    gelu = nd.LeakyReLU(x, act_type="gelu")
+    assert abs(float(_np(gelu)[2]) - 0.345731) < 1e-3
+    x2 = nd.array(onp.array([[-1.0, 1.0], [2.0, -2.0]], onp.float32))
+    prelu = nd.LeakyReLU(x2, nd.array(onp.array([0.1, 0.5], onp.float32)),
+                         act_type="prelu")
+    onp.testing.assert_allclose(_np(prelu), [[-0.1, 1.0], [2.0, -1.0]],
+                                rtol=1e-6)
+
+
+def test_sync_batch_norm_equals_batch_norm():
+    rng = onp.random.RandomState(1)
+    x = nd.array(rng.rand(4, 3, 5, 5).astype("f"))
+    gamma = nd.array(onp.ones(3, onp.float32))
+    beta = nd.array(onp.zeros(3, onp.float32))
+    mm = nd.array(onp.zeros(3, onp.float32))
+    mv = nd.array(onp.ones(3, onp.float32))
+    a = nd.SyncBatchNorm(x, gamma, beta, mm, mv, eps=1e-5, training=False)
+    b = nd.BatchNorm(x, gamma, beta, mm, mv, eps=1e-5, training=False)
+    onp.testing.assert_allclose(_np(a), _np(b), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# init ops
+# ---------------------------------------------------------------------------
+
+def test_init_ops():
+    onp.testing.assert_array_equal(_np(nd.arange(5)), onp.arange(5.0))
+    onp.testing.assert_array_equal(_np(nd.arange(2, 8, 2)),
+                                   [2.0, 4.0, 6.0])
+    onp.testing.assert_array_equal(_np(nd.arange(3, repeat=2)),
+                                   [0, 0, 1, 1, 2, 2])
+    onp.testing.assert_allclose(_np(nd.linspace(0, 1, 5)),
+                                onp.linspace(0, 1, 5))
+    onp.testing.assert_allclose(_np(nd.logspace(0, 2, 3)), [1, 10, 100],
+                                rtol=1e-5)
+    onp.testing.assert_array_equal(_np(nd.eye(3)), onp.eye(3))
+    onp.testing.assert_array_equal(_np(nd.eye(2, 4, k=1)),
+                                   onp.eye(2, 4, k=1))
+    from incubator_mxnet_tpu.ops import registry
+    out = registry.invoke("_full", shape=(2, 3), value=7.5)
+    onp.testing.assert_array_equal(_np(out), onp.full((2, 3), 7.5))
+
+
+def test_histogram():
+    data = nd.array(onp.array([0.1, 0.2, 0.6, 0.9], onp.float32))
+    cnt, edges = nd.histogram(data, bins=2, range=(0.0, 1.0))
+    onp.testing.assert_array_equal(_np(cnt), [2, 2])
+    onp.testing.assert_allclose(_np(edges), [0.0, 0.5, 1.0])
+
+
+# ---------------------------------------------------------------------------
+# linalg completions
+# ---------------------------------------------------------------------------
+
+def test_linalg_trmm_and_potri():
+    rng = onp.random.RandomState(2)
+    a = onp.tril(rng.rand(4, 4).astype(onp.float64) + onp.eye(4))
+    b = rng.rand(4, 3).astype(onp.float64)
+    out = nd.linalg_trmm(nd.array(a), nd.array(b), alpha=2.0)
+    onp.testing.assert_allclose(_np(out), 2.0 * a @ b, rtol=1e-5)
+    spd = a @ a.T
+    potri = nd.linalg_potri(nd.array(a))
+    onp.testing.assert_allclose(_np(potri), onp.linalg.inv(spd), rtol=1e-3,
+                                atol=1e-4)
+
+
+def test_linalg_syevd_reconstructs():
+    rng = onp.random.RandomState(3)
+    m = rng.rand(5, 5).astype(onp.float64)
+    a = (m + m.T) / 2
+    u, lam = nd.linalg_syevd(nd.array(a))
+    u_np, l_np = _np(u), _np(lam)
+    onp.testing.assert_allclose(u_np.T @ onp.diag(l_np) @ u_np, a,
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_linalg_gelqf_reconstructs():
+    rng = onp.random.RandomState(4)
+    a = rng.rand(3, 5).astype(onp.float64)
+    q, l = nd.linalg_gelqf(nd.array(a))  # reference order: A = L Q
+    l_np, q_np = _np(l), _np(q)
+    onp.testing.assert_allclose(l_np @ q_np, a, rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(q_np @ q_np.T, onp.eye(3), rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_linalg_extracttrian_roundtrip():
+    rng = onp.random.RandomState(5)
+    a = rng.rand(4, 4).astype(onp.float32)
+    packed = nd.linalg_extracttrian(nd.array(a))
+    assert packed.shape == (10,)
+    rebuilt = nd.linalg_maketrian(packed)
+    onp.testing.assert_allclose(_np(rebuilt), onp.tril(a), rtol=1e-6)
+
+
+def test_linalg_extracttrian_offset():
+    """offset>0 reads the super-diagonal triangle (la_op.cc semantics):
+    length (n-offset)(n-offset+1)/2, and maketrian inverts it."""
+    a = onp.array([[1.0, 2.0], [3.0, 4.0]], onp.float32)
+    p = nd.linalg_extracttrian(nd.array(a), offset=1)
+    onp.testing.assert_array_equal(_np(p), [2.0])
+    m = nd.linalg_maketrian(p, offset=1)
+    onp.testing.assert_array_equal(_np(m), [[0.0, 2.0], [0.0, 0.0]])
+    p2 = nd.linalg_extracttrian(nd.array(a), offset=-1)
+    onp.testing.assert_array_equal(_np(p2), [3.0])
+    m2 = nd.linalg_maketrian(p2, offset=-1)
+    onp.testing.assert_array_equal(_np(m2), [[0.0, 0.0], [3.0, 0.0]])
+    b = onp.arange(16.0).reshape(4, 4).astype(onp.float32)
+    p3 = nd.linalg_extracttrian(nd.array(b), offset=2)
+    assert p3.shape == (3,)
+    onp.testing.assert_array_equal(_np(p3), [b[0, 2], b[0, 3], b[1, 3]])
+    onp.testing.assert_array_equal(
+        _np(nd.linalg_extracttrian(nd.linalg_maketrian(p3, offset=2),
+                                   offset=2)), _np(p3))
+
+
+def test_image_resize_keep_ratio():
+    img = nd.array(onp.random.rand(300, 400, 3).astype("f"))
+    out = nd.image_resize(img, size=200, keep_ratio=True)
+    assert out.shape == (200, 267, 3)  # short edge 300 -> 200
+    tall = nd.array(onp.random.rand(400, 100, 3).astype("f"))
+    out2 = nd.image_resize(tall, size=50, keep_ratio=True)
+    assert out2.shape == (200, 50, 3)

@@ -1,0 +1,193 @@
+"""Optimizer-update op family (ops/optimizer_ops.py).
+
+Reference: src/operator/optimizer_op.cc — every optimizer step as a
+registry op.  Pure-function redesign: ops return (new_weight, *new_state)
+instead of mutating; tests check formula parity against the optimizer
+classes and against straight numpy math.
+"""
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import nd
+
+
+def _np(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+@pytest.fixture
+def wg():
+    rng = onp.random.RandomState(0)
+    w = rng.rand(5, 4).astype(onp.float32)
+    g = rng.randn(5, 4).astype(onp.float32)
+    return nd.array(w), nd.array(g), w, g
+
+
+def test_sgd_update(wg):
+    aw, ag, w, g = wg
+    out = nd.sgd_update(aw, ag, lr=0.1, wd=0.01, rescale_grad=0.5)
+    expect = w * (1 - 0.1 * 0.01) - 0.1 * (0.5 * g)
+    onp.testing.assert_allclose(_np(out), expect, rtol=1e-6)
+
+
+def test_sgd_update_clip(wg):
+    aw, ag, w, g = wg
+    out = nd.sgd_update(aw, ag, lr=1.0, clip_gradient=0.1)
+    expect = w - onp.clip(g, -0.1, 0.1)
+    onp.testing.assert_allclose(_np(out), expect, rtol=1e-6)
+
+
+def test_sgd_mom_matches_trainer_formula(wg):
+    """Two steps of the op == two steps of the SGD optimizer class."""
+    from incubator_mxnet_tpu import optimizer as opt
+    aw, ag, w, g = wg
+    mom = nd.zeros_like(aw)
+    weight = aw
+    for _ in range(2):
+        weight, mom = nd.sgd_mom_update(weight, ag, mom, lr=0.1,
+                                        momentum=0.9, wd=0.01)
+    sgd = opt.SGD(learning_rate=0.1, momentum=0.9, wd=0.01)
+    state = sgd.create_state(0, aw)
+    ref_w = aw
+    for _ in range(2):
+        ref_w = ref_w.copy()
+        sgd.update(0, ref_w, ag, state)
+    onp.testing.assert_allclose(_np(weight), _np(ref_w), rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_mp_sgd_update_keeps_fp32_master(wg):
+    aw, ag, w, g = wg
+    w16 = aw.astype("bfloat16")
+    w32 = aw.copy()
+    new_w, new_w32 = nd.mp_sgd_update(w16, ag.astype("bfloat16"), w32,
+                                      lr=0.01)
+    assert str(new_w.dtype) == "bfloat16"  # stays low precision
+    assert _np(new_w32).dtype == onp.float32
+    # master carries the precise update; low-precision weight is its cast
+    onp.testing.assert_allclose(
+        _np(new_w).astype(onp.float32), _np(new_w32), rtol=1e-2, atol=1e-2)
+
+
+def test_adam_update_formula(wg):
+    aw, ag, w, g = wg
+    mean = nd.zeros_like(aw)
+    var = nd.zeros_like(aw)
+    new_w, new_m, new_v = nd.adam_update(aw, ag, mean, var, lr=0.002,
+                                         beta1=0.9, beta2=0.999,
+                                         epsilon=1e-8)
+    m = 0.1 * g
+    v = 0.001 * g * g
+    expect = w - 0.002 * m / (onp.sqrt(v) + 1e-8)
+    onp.testing.assert_allclose(_np(new_w), expect, rtol=1e-5)
+    onp.testing.assert_allclose(_np(new_m), m, rtol=1e-6)
+    onp.testing.assert_allclose(_np(new_v), v, rtol=1e-5, atol=1e-9)
+
+
+def test_adamw_decoupled_decay(wg):
+    """wd must not flow through the moments (contrib/adamw.cc)."""
+    aw, ag, w, g = wg
+    zeros = nd.zeros_like(aw)
+    _, m_wd, _ = nd.adamw_update(aw, ag, zeros, zeros, lr=0.01, wd=0.5)
+    _, m_nowd, _ = nd.adamw_update(aw, ag, zeros, zeros, lr=0.01, wd=0.0)
+    onp.testing.assert_allclose(_np(m_wd), _np(m_nowd), rtol=1e-7)
+
+
+def test_nag_differs_from_sgd_mom(wg):
+    aw, ag, w, g = wg
+    mom = nd.zeros_like(aw)
+    w_nag, _ = nd.nag_mom_update(aw, ag, mom, lr=0.1, momentum=0.9)
+    w_sgd, _ = nd.sgd_mom_update(aw, ag, mom, lr=0.1, momentum=0.9)
+    assert not onp.allclose(_np(w_nag), _np(w_sgd))
+
+
+def test_ftrl_sparsifies(wg):
+    aw, ag, w, g = wg
+    z = nd.zeros_like(aw)
+    n = nd.zeros_like(aw)
+    new_w, new_z, new_n = nd.ftrl_update(aw, ag, z, n, lr=0.1, lamda1=10.0)
+    # huge l1 zeroes every weight whose |z| <= lamda1
+    assert (onp.abs(_np(new_w)) < 1e-6).mean() > 0.5
+    onp.testing.assert_allclose(_np(new_n), g * g, rtol=1e-6)
+
+
+def test_rmsprop_update(wg):
+    aw, ag, w, g = wg
+    n = nd.zeros_like(aw)
+    new_w, new_n = nd.rmsprop_update(aw, ag, n, lr=0.01, gamma1=0.9)
+    exp_n = 0.1 * g * g
+    onp.testing.assert_allclose(_np(new_n), exp_n, rtol=1e-5)
+    onp.testing.assert_allclose(
+        _np(new_w), w - 0.01 * g / onp.sqrt(exp_n + 1e-8), rtol=1e-5)
+
+
+def test_rmspropalex_update_shapes(wg):
+    aw, ag, w, g = wg
+    zeros = nd.zeros_like(aw)
+    outs = nd.rmspropalex_update(aw, ag, zeros, zeros, zeros, lr=0.01)
+    assert len(outs) == 4
+    assert all(_np(o).shape == w.shape for o in outs)
+
+
+def test_signum_and_signsgd(wg):
+    aw, ag, w, g = wg
+    out = nd.signsgd_update(aw, ag, lr=0.1)
+    onp.testing.assert_allclose(_np(out), w - 0.1 * onp.sign(g), rtol=1e-6)
+    new_w, new_m = nd.signum_update(aw, ag, nd.zeros_like(aw), lr=0.1,
+                                    momentum=0.9)
+    onp.testing.assert_allclose(_np(new_m), -0.1 * g, rtol=1e-5)
+
+
+def test_lamb_phases_compose(wg):
+    aw, ag, w, g = wg
+    zeros = nd.zeros_like(aw)
+    upd, m, v = nd.lamb_update_phase1(aw, ag, zeros, zeros, t=1, wd=0.01)
+    r1 = nd.norm(aw)
+    r2 = nd.norm(upd)
+    new_w = nd.lamb_update_phase2(aw, upd, r1, r2, lr=0.01)
+    assert _np(new_w).shape == w.shape
+    # trust ratio scales the step: direction matches -upd
+    delta = _np(new_w) - w
+    assert onp.dot(delta.ravel(), _np(upd).ravel()) < 0
+
+
+def test_group_adagrad_rowwise(wg):
+    aw, ag, w, g = wg
+    hist = nd.zeros(shape=(5,))
+    new_w, new_h = nd.group_adagrad_update(aw, ag, hist, lr=0.1)
+    onp.testing.assert_allclose(_np(new_h), (g * g).mean(axis=1), rtol=1e-5)
+
+
+def test_multi_sgd_matches_single(wg):
+    aw, ag, w, g = wg
+    w2 = nd.array(w.T.copy())
+    g2 = nd.array(g.T.copy() * 2)
+    outs = nd.multi_sgd_update(aw, ag, w2, g2, lrs=(0.1, 0.2),
+                               wds=(0.0, 0.01), num_weights=2)
+    s0 = nd.sgd_update(aw, ag, lr=0.1, wd=0.0)
+    s1 = nd.sgd_update(w2, g2, lr=0.2, wd=0.01)
+    onp.testing.assert_allclose(_np(outs[0]), _np(s0), rtol=1e-6)
+    onp.testing.assert_allclose(_np(outs[1]), _np(s1), rtol=1e-6)
+
+
+def test_multi_sgd_mom_matches_single(wg):
+    aw, ag, w, g = wg
+    m = nd.zeros_like(aw)
+    w2, g2, m2 = aw * 2, ag * 3, nd.zeros_like(aw)
+    outs = nd.multi_sgd_mom_update(aw, ag, m, w2, g2, m2, lrs=(0.1, 0.1),
+                                   wds=(0.0, 0.0), momentum=0.9,
+                                   num_weights=2)
+    sw, sm = nd.sgd_mom_update(aw, ag, m, lr=0.1, momentum=0.9)
+    onp.testing.assert_allclose(_np(outs[0]), _np(sw), rtol=1e-6)
+    onp.testing.assert_allclose(_np(outs[2]), _np(sm), rtol=1e-6)
+
+
+def test_multi_mp_sgd_mom_update(wg):
+    aw, ag, w, g = wg
+    w16 = aw.astype("bfloat16")
+    g16 = ag.astype("bfloat16")
+    outs = nd.multi_mp_sgd_mom_update(
+        w16, g16, nd.zeros_like(aw), aw.copy(), lrs=(0.1,), wds=(0.0,),
+        momentum=0.9, num_weights=1)
+    assert len(outs) == 3
+    assert _np(outs[2]).dtype == onp.float32
