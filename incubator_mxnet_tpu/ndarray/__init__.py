@@ -117,12 +117,11 @@ class _Contrib:
                        ctx=data.ctx)
 
     @staticmethod
-    def arange_like(data, start=0.0, step=1.0, axis=None):
-        n = data.size if axis is None else data.shape[axis]
-        out = _jnp.arange(n, dtype=_jnp.float32) * step + start
-        if axis is None:
-            out = out.reshape(data.shape)
-        return NDArray(out, ctx=data.ctx)
+    def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+        # delegate to the registered op so eager/symbolic/contrib paths
+        # share one behavior (ops/image_ops.py arange_like)
+        return _invoke("arange_like", data, start=start, step=step,
+                       repeat=repeat, axis=axis)
 
 
 contrib = _Contrib()

@@ -534,3 +534,48 @@ def count_sketch(data, h, s, out_dim=None):
     flat = signed.reshape(-1, data.shape[-1])
     out = jax.ops.segment_sum(flat.T, idx, num_segments=int(out_dim)).T
     return out.reshape(data.shape[:-1] + (int(out_dim),))
+
+
+@register("hawkesll", aliases=("_contrib_hawkesll",))
+def hawkesll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Log-likelihood of a marked self-exciting Hawkes process, one scan
+    over the event sequence (reference contrib/hawkes_ll-inl.h
+    hawkesll_forward + the remaining-compensator kernel).
+
+    mu (N, K), alpha (K,), beta (K,), state (N, K), lags (N, T),
+    marks int32 (N, T), valid_length (N,), max_time (N,)
+    → (loglike (N,), new_state (N, K)).
+    """
+    from jax import lax
+    marks = marks.astype(jnp.int32)
+    T = lags.shape[1]
+
+    def one_sample(mu_i, state_i, lags_i, marks_i, vl_i, mt_i):
+        def step(carry, inp):
+            ll, t, st, last = carry
+            j, lag, ci = inp
+            valid = j < vl_i
+            t_new = t + lag
+            d = t_new - last[ci]
+            ed = jnp.exp(-beta[ci] * d)
+            lda = mu_i[ci] + alpha[ci] * beta[ci] * st[ci] * ed
+            comp = mu_i[ci] * d + alpha[ci] * st[ci] * (1 - ed)
+            ll = jnp.where(valid, ll + jnp.log(lda) - comp, ll)
+            st = jnp.where(valid, st.at[ci].set(1 + st[ci] * ed), st)
+            last = jnp.where(valid, last.at[ci].set(t_new), last)
+            t = jnp.where(valid, t_new, t)
+            return (ll, t, st, last), None
+
+        init = (jnp.zeros((), mu.dtype), jnp.zeros((), mu.dtype), state_i,
+                jnp.zeros_like(state_i))
+        (ll, _, st, last), _ = lax.scan(
+            step, init, (jnp.arange(T), lags_i, marks_i))
+        # remaining compensator to the censoring time (hawkes_ll-inl.h
+        # hawkesll_forward_compensator)
+        d = mt_i - last
+        ed = jnp.exp(-beta * d)
+        ll = ll - jnp.sum(mu_i * d + alpha * st * (1 - ed))
+        return ll, ed * st
+
+    return jax.vmap(one_sample)(mu, state, lags, marks,
+                                valid_length, max_time)

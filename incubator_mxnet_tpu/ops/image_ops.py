@@ -49,13 +49,19 @@ def image_resize(x, size=None, keep_ratio=False, interp=1):
         else:
             size = (size, size)
     w, h = int(size[0]), int(size[1])
-    method = "nearest" if interp == 0 else "linear"
+    # OpenCV interp codes (image/resize-inl.h): 0 nearest, 1 bilinear,
+    # 2 bicubic, 3 area (≈ antialiased linear for downscale), 4 lanczos
+    method, antialias = {0: ("nearest", False), 1: ("linear", False),
+                         2: ("cubic", False), 3: ("linear", True),
+                         4: ("lanczos3", False)}.get(interp,
+                                                     ("linear", False))
     if _is_batch(x):
         new_shape = (x.shape[0], h, w, x.shape[3])
     else:
         new_shape = (h, w, x.shape[2])
     return jax.image.resize(x.astype(jnp.float32), new_shape,
-                            method=method).astype(x.dtype)
+                            method=method,
+                            antialias=antialias).astype(x.dtype)
 
 
 @register("image_to_tensor", aliases=("_image_to_tensor", "to_tensor"))
@@ -95,16 +101,64 @@ def image_random_crop(key, x, width=1, height=1):
 
 @register("BilinearResize2D", aliases=("_contrib_BilinearResize2D",
                                        "bilinear_resize_2d"))
-def bilinear_resize_2d(data, height=None, width=None, scale_height=None,
-                       scale_width=None, mode="size"):
-    """NCHW bilinear resize (contrib/bilinear_resize-inl.h)."""
+def bilinear_resize_2d(data, like=None, height=1, width=1,
+                       scale_height=None, scale_width=None, mode="size"):
+    """NCHW bilinear resize (contrib/bilinear_resize-inl.h).
+
+    Implements the reference mode table (size / scale / odd_scale /
+    like / to_even_down|up / to_odd_down|up) and the reference's
+    align-corners sampling grid (src coordinate = dst * (in-1)/(out-1)),
+    which differs from jax.image.resize's half-pixel convention — a
+    ported segmentation head must see the same interpolation its
+    reference-trained weights expect.
+    """
     n, c, h, w = data.shape
-    if height is None:
-        height = int(h * (scale_height or 1.0))
-    if width is None:
-        width = int(w * (scale_width or 1.0))
-    out = jax.image.resize(data.astype(jnp.float32),
-                           (n, c, int(height), int(width)), method="linear")
+
+    def _scaled(dim, scale):
+        return int(round(dim * scale)) if scale else dim
+
+    if mode == "size":
+        out_h, out_w = int(height), int(width)
+    elif mode == "scale":
+        out_h, out_w = _scaled(h, scale_height), _scaled(w, scale_width)
+    elif mode == "odd_scale":
+        sh, sw = _scaled(h, scale_height), _scaled(w, scale_width)
+        out_h = sh if sh % 2 else sh + 1
+        out_w = sw if sw % 2 else sw + 1
+    elif mode == "like":
+        if like is None:
+            raise ValueError("mode='like' needs the second (like) input")
+        out_h, out_w = like.shape[-2], like.shape[-1]
+    elif mode in ("to_even_down", "to_even_up", "to_odd_down", "to_odd_up"):
+        def _round(dim):
+            odd = "odd" in mode
+            down = mode.endswith("down")
+            if (dim % 2 == 1) == odd:
+                return dim
+            return dim - 1 if down else dim + 1
+        out_h, out_w = _round(h), _round(w)
+    else:
+        raise ValueError(f"unknown BilinearResize2D mode {mode!r}")
+
+    # align-corners bilinear gather (bilinear_resize-inl.h scale factor
+    # (in-1)/(out-1); degenerate out==1 samples index 0)
+    def coords(out_dim, in_dim):
+        if out_dim == 1:
+            return jnp.zeros((1,), jnp.float32)
+        return jnp.arange(out_dim, dtype=jnp.float32) \
+            * ((in_dim - 1) / (out_dim - 1))
+
+    ys, xs = coords(out_h, h), coords(out_w, w)
+    y0 = jnp.floor(ys).astype(jnp.int32).clip(0, h - 1)
+    x0 = jnp.floor(xs).astype(jnp.int32).clip(0, w - 1)
+    y1 = (y0 + 1).clip(0, h - 1)
+    x1 = (x0 + 1).clip(0, w - 1)
+    wy = (ys - y0).astype(jnp.float32)
+    wx = (xs - x0).astype(jnp.float32)
+    d = data.astype(jnp.float32)
+    top = d[:, :, y0][:, :, :, x0] * (1 - wx) + d[:, :, y0][:, :, :, x1] * wx
+    bot = d[:, :, y1][:, :, :, x0] * (1 - wx) + d[:, :, y1][:, :, :, x1] * wx
+    out = top * (1 - wy)[None, None, :, None] + bot * wy[None, None, :, None]
     return out.astype(data.dtype)
 
 

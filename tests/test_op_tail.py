@@ -71,6 +71,23 @@ def test_bilinear_resize_2d():
     out2 = nd.BilinearResize2D(x, scale_height=2.0, scale_width=2.0,
                                mode="scale")
     assert out2.shape == (2, 3, 8, 8)
+    # mode table (contrib/bilinear_resize-inl.h)
+    out3 = nd.BilinearResize2D(x, scale_height=2.0, scale_width=2.0,
+                               mode="odd_scale")
+    assert out3.shape == (2, 3, 9, 9)
+    x5 = nd.array(onp.random.rand(1, 1, 5, 4).astype("f"))
+    assert nd.BilinearResize2D(x5, mode="to_even_down").shape == (1, 1, 4, 4)
+    assert nd.BilinearResize2D(x5, mode="to_odd_up").shape == (1, 1, 5, 5)
+
+
+def test_bilinear_resize_2d_align_corners():
+    """The reference samples with scale (in-1)/(out-1): corners map to
+    corners exactly and a 2x2 -> 3x3 upscale is the exact midpoint grid."""
+    src = onp.array([[0.0, 1.0], [2.0, 3.0]], onp.float32)
+    x = nd.array(src.reshape(1, 1, 2, 2))
+    out = _np(nd.BilinearResize2D(x, height=3, width=3))[0, 0]
+    expect = onp.array([[0.0, 0.5, 1.0], [1.0, 1.5, 2.0], [2.0, 2.5, 3.0]])
+    onp.testing.assert_allclose(out, expect, rtol=1e-6)
 
 
 # ---------------------------------------------------------------------------
@@ -280,3 +297,73 @@ def test_image_resize_keep_ratio():
     tall = nd.array(onp.random.rand(400, 100, 3).astype("f"))
     out2 = nd.image_resize(tall, size=50, keep_ratio=True)
     assert out2.shape == (200, 50, 3)
+
+
+# ---------------------------------------------------------------------------
+# hawkesll
+# ---------------------------------------------------------------------------
+
+def _hawkes_ll_numpy(mu, alpha, beta, state, lags, marks, vl, max_time):
+    """Straight transcription of hawkes_ll-inl.h for the oracle."""
+    N, K = mu.shape
+    out_ll = onp.zeros(N)
+    out_state = state.copy().astype(onp.float64)
+    for i in range(N):
+        ll, t = 0.0, 0.0
+        last = onp.zeros(K)
+        for j in range(int(vl[i])):
+            ci = int(marks[i, j])
+            t += lags[i, j]
+            d = t - last[ci]
+            ed = onp.exp(-beta[ci] * d)
+            lda = mu[i, ci] + alpha[ci] * beta[ci] * out_state[i, ci] * ed
+            comp = mu[i, ci] * d + alpha[ci] * out_state[i, ci] * (1 - ed)
+            ll += onp.log(lda) - comp
+            out_state[i, ci] = 1 + out_state[i, ci] * ed
+            last[ci] = t
+        d = max_time[i] - last
+        ed = onp.exp(-beta * d)
+        ll -= onp.sum(mu[i] * d + alpha * out_state[i] * (1 - ed))
+        out_state[i] *= ed
+        out_ll[i] = ll
+    return out_ll, out_state
+
+
+def test_hawkesll_matches_reference_math():
+    rng = onp.random.RandomState(7)
+    N, T, K = 3, 6, 2
+    mu = rng.rand(N, K).astype(onp.float32) + 0.5
+    alpha = rng.rand(K).astype(onp.float32) * 0.5
+    beta = rng.rand(K).astype(onp.float32) + 0.5
+    state = rng.rand(N, K).astype(onp.float32)
+    lags = rng.rand(N, T).astype(onp.float32)
+    marks = rng.randint(0, K, (N, T)).astype(onp.int32)
+    vl = onp.array([6, 4, 0], onp.float32)  # incl. an empty sequence
+    max_time = lags.sum(axis=1) + 1.0
+    ll, st = nd.hawkesll(nd.array(mu), nd.array(alpha), nd.array(beta),
+                         nd.array(state), nd.array(lags), nd.array(marks),
+                         nd.array(vl), nd.array(max_time))
+    ref_ll, ref_st = _hawkes_ll_numpy(mu, alpha, beta, state, lags, marks,
+                                      vl, max_time)
+    onp.testing.assert_allclose(_np(ll), ref_ll, rtol=1e-4)
+    onp.testing.assert_allclose(_np(st), ref_st, rtol=1e-4)
+
+
+def test_hawkesll_differentiable():
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops import registry
+    op = registry.get_op("hawkesll")
+    rng = onp.random.RandomState(8)
+    N, T, K = 2, 4, 2
+    args = (jnp.asarray(rng.rand(N, K) + 0.5, jnp.float32),
+            jnp.asarray(rng.rand(K) * 0.5, jnp.float32),
+            jnp.asarray(rng.rand(K) + 0.5, jnp.float32),
+            jnp.asarray(rng.rand(N, K), jnp.float32),
+            jnp.asarray(rng.rand(N, T), jnp.float32),
+            jnp.asarray(rng.randint(0, K, (N, T)), jnp.int32),
+            jnp.full((N,), T, jnp.float32),
+            jnp.full((N,), 10.0, jnp.float32))
+    grad = jax.grad(lambda mu: op.fn(mu, *args[1:])[0].sum())(args[0])
+    assert onp.isfinite(onp.asarray(grad)).all()
+    assert onp.abs(onp.asarray(grad)).sum() > 0
