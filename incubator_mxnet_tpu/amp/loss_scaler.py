@@ -1,8 +1,6 @@
 """Dynamic loss scaler (reference contrib/amp/loss_scaler.py)."""
 from __future__ import annotations
 
-import numpy as onp
-
 
 class LossScaler:
     """Doubles the scale every ``scale_window`` overflow-free steps and
@@ -16,14 +14,21 @@ class LossScaler:
         self._unskipped = 0
 
     def has_overflow(self, params):
-        """True if any gradient is non-finite."""
-        for p in params:
-            if p.grad_req != "null" and p._data is not None and \
-                    p._data.grad is not None:
-                g = p.grad().asnumpy()
-                if not onp.isfinite(g).all():
-                    return True
-        return False
+        """True if any gradient is non-finite.
+
+        Device-side: one fused multi_all_finite reduction over every
+        gradient and a single scalar readback (reference
+        optimizer_op.cc multi_all_finite), instead of pulling each
+        gradient to the host.
+        """
+        from ..ops.registry import invoke
+        grads = [p.grad() for p in params
+                 if p.grad_req != "null" and p._data is not None
+                 and p._data.grad is not None]
+        if not grads:
+            return False
+        flag = invoke("multi_all_finite", *grads, num_arrays=len(grads))
+        return float(flag.asnumpy()[0]) == 0.0
 
     def update_scale(self, overflow: bool):
         if overflow:

@@ -70,6 +70,7 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False, out=None):
                        n_rows=int(lhs._dense_shape[0]), out=out)
     return _dense_dot(lhs, rhs, transpose_a=transpose_a,
                       transpose_b=transpose_b, out=out)
+from .sparse import cast_storage  # noqa: E402  (reference nd.cast_storage)
 concatenate = _g["concat"]
 elemwise_add = _g["add"]
 waitall = None  # set below

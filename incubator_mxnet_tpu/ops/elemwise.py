@@ -195,3 +195,30 @@ def amp_multicast(*arrays, num_outputs=None):
                 if jnp.issubdtype(a.dtype, jnp.floating) else a
                 for a in arrays)
     return out if len(out) > 1 else out[0]
+
+
+@register("all_finite", num_inputs=1, differentiable=False)
+def all_finite(data, init_output=True):
+    """(1,) float flag: 1.0 iff every element is finite (reference
+    optimizer_op.cc all_finite — the AMP dynamic-loss-scaler probe)."""
+    return jnp.isfinite(data).all().astype(jnp.float32).reshape(1)
+
+
+@register("multi_all_finite", differentiable=False)
+def multi_all_finite(*arrays, num_arrays=None, init_output=True):
+    """all_finite over many tensors fused into ONE scalar on device —
+    one host readback checks a whole gradient set (optimizer_op.cc
+    multi_all_finite)."""
+    arrays = arrays[:num_arrays] if num_arrays is not None else arrays
+    flag = jnp.ones((), jnp.bool_)
+    for a in arrays:
+        flag = jnp.logical_and(flag, jnp.isfinite(a).all())
+    return flag.astype(jnp.float32).reshape(1)
+
+
+@register("reset_arrays", differentiable=False)
+def reset_arrays(*arrays, num_arrays=None):
+    """Zero a set of tensors (contrib reset_arrays.cc); pure form
+    returns the zeroed tensors for rebinding."""
+    arrays = arrays[:num_arrays] if num_arrays is not None else arrays
+    return tuple(jnp.zeros_like(a) for a in arrays)

@@ -102,29 +102,31 @@ def image_random_crop(key, x, width=1, height=1):
 @register("BilinearResize2D", aliases=("_contrib_BilinearResize2D",
                                        "bilinear_resize_2d"))
 def bilinear_resize_2d(data, like=None, height=1, width=1,
-                       scale_height=None, scale_width=None, mode="size"):
+                       scale_height=None, scale_width=None, mode="size",
+                       align_corners=True):
     """NCHW bilinear resize (contrib/bilinear_resize-inl.h).
 
-    Implements the reference mode table (size / scale / odd_scale /
-    like / to_even_down|up / to_odd_down|up) and the reference's
-    align-corners sampling grid (src coordinate = dst * (in-1)/(out-1)),
-    which differs from jax.image.resize's half-pixel convention — a
-    ported segmentation head must see the same interpolation its
-    reference-trained weights expect.
+    Mode table and shape math follow BilinearSampleOpInferShape
+    (bilinear_resize-inl.h:240-300) exactly — truncating int casts for
+    scales, the odd-input special case in odd_scale, parity fixups of
+    the input dims for to_even/to_odd.  Sampling follows
+    area_pixel_compute_scale (:108-130): align_corners=True uses
+    scale (in-1)/(out-1) with corners mapping to corners; False uses
+    the half-pixel convention src = (dst+0.5)*in/out - 0.5.
     """
     n, c, h, w = data.shape
 
-    def _scaled(dim, scale):
-        return int(round(dim * scale)) if scale else dim
-
     if mode == "size":
-        out_h, out_w = int(height), int(width)
-    elif mode == "scale":
-        out_h, out_w = _scaled(h, scale_height), _scaled(w, scale_width)
+        # "simple": scale overrides the explicit size when provided
+        out_h = int(scale_height * h) if scale_height is not None \
+            else int(height)
+        out_w = int(scale_width * w) if scale_width is not None \
+            else int(width)
     elif mode == "odd_scale":
-        sh, sw = _scaled(h, scale_height), _scaled(w, scale_width)
-        out_h = sh if sh % 2 else sh + 1
-        out_w = sw if sw % 2 else sw + 1
+        out_h = int(h * scale_height) if h % 2 == 0 \
+            else int((h - 1) * scale_height) + 1
+        out_w = int(w * scale_width) if w % 2 == 0 \
+            else int((w - 1) * scale_width) + 1
     elif mode == "like":
         if like is None:
             raise ValueError("mode='like' needs the second (like) input")
@@ -140,13 +142,15 @@ def bilinear_resize_2d(data, like=None, height=1, width=1,
     else:
         raise ValueError(f"unknown BilinearResize2D mode {mode!r}")
 
-    # align-corners bilinear gather (bilinear_resize-inl.h scale factor
-    # (in-1)/(out-1); degenerate out==1 samples index 0)
     def coords(out_dim, in_dim):
         if out_dim == 1:
             return jnp.zeros((1,), jnp.float32)
-        return jnp.arange(out_dim, dtype=jnp.float32) \
-            * ((in_dim - 1) / (out_dim - 1))
+        if align_corners:
+            return jnp.arange(out_dim, dtype=jnp.float32) \
+                * ((in_dim - 1) / (out_dim - 1))
+        src = (jnp.arange(out_dim, dtype=jnp.float32) + 0.5) \
+            * (in_dim / out_dim) - 0.5
+        return jnp.maximum(src, 0.0)
 
     ys, xs = coords(out_h, h), coords(out_w, w)
     y0 = jnp.floor(ys).astype(jnp.int32).clip(0, h - 1)

@@ -41,3 +41,18 @@ def test_train_dist_kvstore_via_launcher():
         capture_output=True, text=True, timeout=420, env=env)
     assert proc.returncode == 0, proc.stderr[-800:]
     assert proc.stdout.count("done") == 2
+
+
+def test_benchmark_score_smoke():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"  # the harness env may pin axon
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples",
+                                      "benchmark_score.py"),
+         "--models", "squeezenet1_1", "--batch-sizes", "2",
+         "--image-shape", "3,64,64", "--dtype", "float32",
+         "--steps", "2", "--warmup", "1"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert "img/s" in proc.stdout and "FAILED" not in proc.stdout

@@ -68,16 +68,27 @@ def test_bilinear_resize_2d():
     x = nd.array(onp.random.rand(2, 3, 4, 4).astype("f"))
     out = nd.BilinearResize2D(x, height=8, width=6)
     assert out.shape == (2, 3, 8, 6)
-    out2 = nd.BilinearResize2D(x, scale_height=2.0, scale_width=2.0,
-                               mode="scale")
+    # mode="size" honors scales when given (bilinear_resize-inl.h:255,
+    # truncating cast)
+    out2 = nd.BilinearResize2D(x, scale_height=2.0, scale_width=2.0)
     assert out2.shape == (2, 3, 8, 8)
-    # mode table (contrib/bilinear_resize-inl.h)
+    out2b = nd.BilinearResize2D(x, scale_height=1.6, scale_width=1.9)
+    assert out2b.shape == (2, 3, 6, 7)  # int(6.4), int(7.6)
+    # odd_scale: even input dims use int(dim*scale) — may stay even
+    # (:267-273); odd input dims use int((dim-1)*scale)+1
     out3 = nd.BilinearResize2D(x, scale_height=2.0, scale_width=2.0,
                                mode="odd_scale")
-    assert out3.shape == (2, 3, 9, 9)
+    assert out3.shape == (2, 3, 8, 8)
     x5 = nd.array(onp.random.rand(1, 1, 5, 4).astype("f"))
+    out4 = nd.BilinearResize2D(x5, scale_height=2.0, scale_width=2.0,
+                               mode="odd_scale")
+    assert out4.shape == (1, 1, 9, 8)  # odd 5 -> (5-1)*2+1, even 4 -> 8
     assert nd.BilinearResize2D(x5, mode="to_even_down").shape == (1, 1, 4, 4)
     assert nd.BilinearResize2D(x5, mode="to_odd_up").shape == (1, 1, 5, 5)
+    # align_corners=False (half-pixel) is requestable and differs
+    a = nd.BilinearResize2D(x, height=8, width=8)
+    b = nd.BilinearResize2D(x, height=8, width=8, align_corners=False)
+    assert not onp.allclose(_np(a), _np(b))
 
 
 def test_bilinear_resize_2d_align_corners():
@@ -367,3 +378,57 @@ def test_hawkesll_differentiable():
     grad = jax.grad(lambda mu: op.fn(mu, *args[1:])[0].sum())(args[0])
     assert onp.isfinite(onp.asarray(grad)).all()
     assert onp.abs(onp.asarray(grad)).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# all_finite family + cast_storage frontend
+# ---------------------------------------------------------------------------
+
+def test_all_finite_ops():
+    good = nd.array(onp.ones((3, 3), onp.float32))
+    bad = nd.array(onp.array([1.0, onp.inf], onp.float32))
+    nan = nd.array(onp.array([1.0, onp.nan], onp.float32))
+    assert float(_np(nd.all_finite(good))[0]) == 1.0
+    assert float(_np(nd.all_finite(bad))[0]) == 0.0
+    assert float(_np(nd.multi_all_finite(good, good, num_arrays=2))[0]) == 1.0
+    assert float(_np(nd.multi_all_finite(good, nan, num_arrays=2))[0]) == 0.0
+
+
+def test_reset_arrays():
+    a = nd.array(onp.ones((2, 2), onp.float32))
+    b = nd.array(onp.full((3,), 5.0, onp.float32))
+    za, zb = nd.reset_arrays(a, b, num_arrays=2)
+    assert _np(za).sum() == 0 and _np(zb).sum() == 0
+    assert za.shape == a.shape and zb.shape == b.shape
+
+
+def test_loss_scaler_device_side_overflow():
+    """LossScaler.has_overflow runs one fused device-side check
+    (multi_all_finite) — drive it through real Parameters."""
+    from incubator_mxnet_tpu import autograd, gluon
+    from incubator_mxnet_tpu.amp.loss_scaler import LossScaler
+    net = gluon.nn.Dense(3, in_units=4)
+    net.initialize()
+    x = nd.array(onp.ones((2, 4), onp.float32))
+    with autograd.record():
+        y = net(x)
+        loss = (y * nd.array(onp.full((2, 3), onp.inf, onp.float32))).sum()
+    loss.backward()
+    scaler = LossScaler()
+    params = list(net.collect_params().values())
+    assert scaler.has_overflow(params) is True
+    with autograd.record():
+        loss2 = net(x).sum()
+    loss2.backward()
+    assert scaler.has_overflow(params) is False
+
+
+def test_nd_cast_storage_frontend():
+    dense = nd.array(onp.array([[1.0, 0.0], [0.0, 0.0], [0.0, 2.0]],
+                               onp.float32))
+    rsp = nd.cast_storage(dense, "row_sparse")
+    assert rsp.stype == "row_sparse"
+    back = nd.cast_storage(rsp, "default") if hasattr(rsp, "stype") else rsp
+    onp.testing.assert_array_equal(_np(back.todense()
+                                       if hasattr(back, "todense")
+                                       else back), _np(dense))
