@@ -197,20 +197,39 @@ def amp_multicast(*arrays, num_outputs=None):
     return out if len(out) > 1 else out[0]
 
 
-@register("all_finite", num_inputs=1, differentiable=False)
-def all_finite(data, init_output=True):
+@register("all_finite", differentiable=False)
+def all_finite(data, prev=None, init_output=True):
     """(1,) float flag: 1.0 iff every element is finite (reference
-    optimizer_op.cc all_finite — the AMP dynamic-loss-scaler probe)."""
-    return jnp.isfinite(data).all().astype(jnp.float32).reshape(1)
+    optimizer_op.cc all_finite — the AMP dynamic-loss-scaler probe).
+
+    The reference ANDs into its output buffer when init_output=false so
+    callers can accumulate overflow status across gradient chunks; the
+    pure form takes the prior flag as the ``prev`` input instead of
+    mutating it.
+    """
+    flag = jnp.isfinite(data).all()
+    if not init_output:
+        if prev is None:
+            raise ValueError("all_finite(init_output=False) needs the "
+                             "prior flag as the `prev` input (pure-op "
+                             "form of the reference's accumulate-AND)")
+        flag = jnp.logical_and(flag, prev.reshape(()) > 0)
+    return flag.astype(jnp.float32).reshape(1)
 
 
 @register("multi_all_finite", differentiable=False)
-def multi_all_finite(*arrays, num_arrays=None, init_output=True):
+def multi_all_finite(*arrays, num_arrays=None, init_output=True, prev=None):
     """all_finite over many tensors fused into ONE scalar on device —
     one host readback checks a whole gradient set (optimizer_op.cc
-    multi_all_finite)."""
+    multi_all_finite).  See all_finite for the ``prev`` accumulation
+    contract."""
     arrays = arrays[:num_arrays] if num_arrays is not None else arrays
     flag = jnp.ones((), jnp.bool_)
+    if not init_output:
+        if prev is None:
+            raise ValueError("multi_all_finite(init_output=False) needs "
+                             "the prior flag as the `prev` kwarg")
+        flag = prev.reshape(()) > 0
     for a in arrays:
         flag = jnp.logical_and(flag, jnp.isfinite(a).all())
     return flag.astype(jnp.float32).reshape(1)

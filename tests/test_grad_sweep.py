@@ -1,0 +1,139 @@
+"""Finite-difference gradient sweep across the op registry.
+
+The reference validates every operator's FGradient against central
+differences (test_utils.py:987 check_numeric_gradient, used throughout
+tests/python/unittest/test_operator.py).  Here the backward comes from
+jax.vjp through the invoke path, so this sweep validates the whole
+autograd integration per op family — wrappers, static-kwarg routing,
+multi-input cotangents — not just jnp formulas.
+"""
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import check_numeric_gradient
+
+R = onp.random.RandomState(42)
+
+
+def arr(*shape, positive=False, lo=-1.0, hi=1.0):
+    data = R.uniform(lo, hi, shape).astype(onp.float32)
+    if positive:
+        data = onp.abs(data) + 0.5
+    return nd.array(data)
+
+
+# (name, fn(*inputs)->scalar, inputs builder)
+CASES = [
+    # elemwise unary
+    ("tanh", lambda x: nd.sum(nd.tanh(x)), lambda: [arr(3, 4)]),
+    ("sigmoid", lambda x: nd.sum(nd.sigmoid(x)), lambda: [arr(3, 4)]),
+    ("exp", lambda x: nd.sum(nd.exp(x)), lambda: [arr(3, 4)]),
+    ("log", lambda x: nd.sum(nd.log(x)), lambda: [arr(3, 4, positive=True)]),
+    ("sqrt", lambda x: nd.sum(nd.sqrt(x)),
+     lambda: [arr(3, 4, positive=True)]),
+    ("rsqrt", lambda x: nd.sum(nd.rsqrt(x)),
+     lambda: [arr(3, 4, positive=True)]),
+    ("square", lambda x: nd.sum(nd.square(x)), lambda: [arr(3, 4)]),
+    ("erf", lambda x: nd.sum(nd.erf(x)), lambda: [arr(3, 4)]),
+    ("gelu", lambda x: nd.sum(nd.LeakyReLU(x, act_type="gelu")),
+     lambda: [arr(3, 4)]),
+    ("elu", lambda x: nd.sum(nd.LeakyReLU(x, act_type="elu", slope=0.7)),
+     lambda: [arr(3, 4)]),
+    ("softsign", lambda x: nd.sum(nd.softsign(x)), lambda: [arr(3, 4)]),
+    # binary + broadcast
+    ("broadcast_mul",
+     lambda a, b: nd.sum(nd.broadcast_mul(a, b)),
+     lambda: [arr(3, 4), arr(1, 4)]),
+    ("broadcast_div",
+     lambda a, b: nd.sum(nd.broadcast_div(a, b)),
+     lambda: [arr(3, 4), arr(1, 4, positive=True)]),
+    ("broadcast_power",
+     lambda a, b: nd.sum(nd.broadcast_power(a, b)),
+     lambda: [arr(3, 4, positive=True), arr(1, 4)]),
+    ("hypot", lambda a, b: nd.sum(nd.hypot(a, b)),
+     lambda: [arr(3, 4, positive=True), arr(3, 4, positive=True)]),
+    # reductions
+    ("mean", lambda x: nd.mean(x), lambda: [arr(4, 5)]),
+    ("nansum", lambda x: nd.nansum(x), lambda: [arr(4, 5)]),
+    ("norm", lambda x: nd.norm(x), lambda: [arr(4, 5, positive=True)]),
+    ("max", lambda x: nd.max(x), lambda: [arr(4, 5)]),
+    ("logsumexp", lambda x: nd.sum(nd.logsumexp(x, axis=1)),
+     lambda: [arr(4, 5)]) if hasattr(nd, "logsumexp") else None,
+    ("softmax", lambda x: nd.sum(nd.square(nd.softmax(x, axis=-1))),
+     lambda: [arr(3, 5)]),
+    ("log_softmax", lambda x: nd.sum(nd.log_softmax(x, axis=-1) * 0.3),
+     lambda: [arr(3, 5)]),
+    # shape / index
+    ("transpose", lambda x: nd.sum(nd.square(nd.transpose(x, axes=(1, 0)))),
+     lambda: [arr(3, 4)]),
+    ("slice", lambda x: nd.sum(nd.square(
+        nd.slice(x, begin=(1, 0), end=(3, 2)))), lambda: [arr(4, 3)]),
+    ("tile", lambda x: nd.sum(nd.square(nd.tile(x, reps=(2, 2)))),
+     lambda: [arr(2, 3)]),
+    ("take", lambda x: nd.sum(nd.square(
+        nd.take(x, nd.array(onp.array([0, 2], onp.int32))))),
+     lambda: [arr(4, 3)]),
+    # nn
+    ("FullyConnected",
+     lambda x, w, b: nd.sum(nd.square(
+         nd.FullyConnected(x, w, b, num_hidden=4))),
+     lambda: [arr(2, 3), arr(4, 3), arr(4)]),
+    ("Convolution",
+     lambda x, w, b: nd.mean(nd.square(nd.Convolution(
+         x, w, b, kernel=(3, 3), num_filter=2, pad=(1, 1)))),
+     lambda: [arr(1, 2, 5, 5), arr(2, 2, 3, 3), arr(2)]),
+    ("Pooling_avg",
+     lambda x: nd.sum(nd.square(nd.Pooling(
+         x, kernel=(2, 2), stride=(2, 2), pool_type="avg"))),
+     lambda: [arr(1, 2, 4, 4)]),
+    ("LayerNorm",
+     lambda x, g, b: nd.sum(nd.square(nd.LayerNorm(x, g, b))),
+     lambda: [arr(3, 6), arr(6, positive=True), arr(6)]),
+    ("Embedding",
+     lambda w: nd.sum(nd.square(nd.Embedding(
+         nd.array(onp.array([0, 2, 1], onp.int32)), w, input_dim=4,
+         output_dim=3))),
+     lambda: [arr(4, 3)]),
+    # linalg
+    ("dot", lambda a, b: nd.sum(nd.square(nd.dot(a, b))),
+     lambda: [arr(3, 4), arr(4, 2)]),
+    ("batch_dot", lambda a, b: nd.sum(nd.square(nd.batch_dot(a, b))),
+     lambda: [arr(2, 3, 4), arr(2, 4, 2)]),
+    ("linalg_gemm2",
+     lambda a, b: nd.sum(nd.square(nd.linalg_gemm2(a, b, alpha=1.5))),
+     lambda: [arr(3, 4), arr(4, 2)]),
+    ("linalg_trmm", lambda a, b: nd.sum(nd.square(nd.linalg_trmm(a, b))),
+     lambda: [arr(3, 3), arr(3, 2)]),
+    ("linalg_sumlogdiag",
+     lambda a: nd.sum(nd.linalg_sumlogdiag(a)),
+     lambda: [nd.array(onp.eye(3, dtype=onp.float32) * 2.0
+                       + 0.1 * R.rand(3, 3).astype(onp.float32))]),
+    # new image / attention ops
+    ("BilinearResize2D",
+     lambda x: nd.sum(nd.square(nd.BilinearResize2D(x, height=5, width=7))),
+     lambda: [arr(1, 2, 3, 4)]),
+    ("image_normalize",
+     lambda x: nd.mean(nd.square(nd.image_normalize(
+         x, mean=(0.4, 0.5, 0.6), std=(0.2, 0.25, 0.3)))),
+     lambda: [arr(3, 4, 4)]),
+    ("interleaved_selfatt",
+     lambda qkv: nd.sum(nd.square(nd.interleaved_matmul_selfatt_qk(
+         qkv, heads=2))),
+     lambda: [arr(3, 2, 12)]),
+    ("quadratic",
+     lambda x: nd.sum(nd.quadratic(x, a=1.5, b=-2.0, c=0.3)),
+     lambda: [arr(3, 4)]),
+    ("sequence_mask",
+     lambda x: nd.sum(nd.square(nd.SequenceMask(
+         x, nd.array(onp.array([2.0, 3.0], onp.float32)),
+         use_sequence_length=True))),
+     lambda: [arr(4, 2, 3)]),
+]
+CASES = [c for c in CASES if c is not None]
+
+
+@pytest.mark.parametrize("name,fn,builder", CASES,
+                         ids=[c[0] for c in CASES])
+def test_numeric_gradient(name, fn, builder):
+    check_numeric_gradient(fn, builder())

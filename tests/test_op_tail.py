@@ -392,6 +392,15 @@ def test_all_finite_ops():
     assert float(_np(nd.all_finite(bad))[0]) == 0.0
     assert float(_np(nd.multi_all_finite(good, good, num_arrays=2))[0]) == 1.0
     assert float(_np(nd.multi_all_finite(good, nan, num_arrays=2))[0]) == 0.0
+    # accumulate-AND across chunks (reference init_output=false)
+    flag0 = nd.all_finite(nan)
+    acc = nd.all_finite(good, prev=flag0, init_output=False)
+    assert float(_np(acc)[0]) == 0.0  # earlier overflow is NOT lost
+    acc2 = nd.multi_all_finite(good, good, num_arrays=2, prev=flag0,
+                               init_output=False)
+    assert float(_np(acc2)[0]) == 0.0
+    with pytest.raises((ValueError, Exception)):
+        nd.all_finite(good, init_output=False)
 
 
 def test_reset_arrays():
@@ -428,7 +437,6 @@ def test_nd_cast_storage_frontend():
                                onp.float32))
     rsp = nd.cast_storage(dense, "row_sparse")
     assert rsp.stype == "row_sparse"
-    back = nd.cast_storage(rsp, "default") if hasattr(rsp, "stype") else rsp
-    onp.testing.assert_array_equal(_np(back.todense()
-                                       if hasattr(back, "todense")
-                                       else back), _np(dense))
+    back = nd.cast_storage(rsp, "default")
+    assert not hasattr(back, "todense") or back.stype == "default"
+    onp.testing.assert_array_equal(_np(back), _np(dense))
