@@ -195,7 +195,11 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
         seed = out_cots[0] if len(node.outputs) == 1 else tuple(out_cots)
         in_cots = node.vjp_fn(seed)
         for slot, x in zip(node.input_slots, node.nd_inputs):
-            g = in_cots[slot]
+            # compound (slot, index) addresses an NDArray inside a
+            # sequence argument (np.concatenate([a, b]) — the vjp's
+            # cotangent at that slot is itself a sequence)
+            g = in_cots[slot[0]][slot[1]] if isinstance(slot, tuple) \
+                else in_cots[slot]
             if isinstance(g, jax.Array) and g.dtype != jax.dtypes.float0:
                 add_cot(x, g)
 

@@ -45,19 +45,39 @@ def _wrap_fn(jnp_fn):
 
     @functools.wraps(jnp_fn)
     def fn(*args, **kwargs):
-        nd_inputs = [a for a in args if isinstance(a, _ND)]
         # the vjp below covers ALL positional args; record the true
         # argument slot of each NDArray so backward() maps cotangents
-        # correctly when scalars precede arrays (np.subtract(1.0, x))
-        nd_slots = [i for i, a in enumerate(args) if isinstance(a, _ND)]
-        raw = [a.data if isinstance(a, _ND) else a for a in args]
+        # correctly when scalars precede arrays (np.subtract(1.0, x)).
+        # Sequence args (np.concatenate([a, b])) unwrap one level deep
+        # with compound (slot, index) addresses.
+        nd_inputs, nd_slots, raw = [], [], []
+        for i, a in enumerate(args):
+            if isinstance(a, _ND):
+                nd_inputs.append(a)
+                nd_slots.append(i)
+                raw.append(a.data)
+            elif isinstance(a, (list, tuple)) and _bi.any(
+                    isinstance(e, _ND) for e in a):
+                for j, e in enumerate(a):
+                    if isinstance(e, _ND):
+                        nd_inputs.append(e)
+                        nd_slots.append((i, j))
+                raw.append(type(a)(
+                    e.data if isinstance(e, _ND) else e for e in a))
+            else:
+                raw.append(a)
 
         # NB: _bi.any — the delegated namespace below shadows several
         # builtins (np.any/all/sum/...) in this module's globals, and a
         # bare any() here recursed through its own wrapper
         recording = _autograd.is_recording() and _bi.any(
             a._in_graph() for a in nd_inputs)
-        call = lambda *xs: jnp_fn(*xs, **kwargs)
+        def call(*xs):
+            res = jnp_fn(*xs, **kwargs)
+            # normalize list outputs (jnp.split et al.) to tuples so the
+            # vjp's primal structure matches the tuple cotangent seed
+            # backward() builds (jax.vjp requires exact pytree match)
+            return tuple(res) if isinstance(res, list) else res
         if recording:
             try:
                 out, vjp = jax.vjp(call, *raw)
@@ -105,7 +125,7 @@ _DELEGATED = [
     "nansum", "nanvar", "negative", "not_equal", "outer", "percentile",
     "polyval", "positive", "power", "prod", "ptp", "quantile", "rad2deg",
     "radians", "ravel", "reciprocal", "remainder", "repeat", "reshape",
-    "roll", "rot90", "round", "searchsorted", "sign", "sin", "sinh",
+    "rint", "broadcast_to", "roll", "rot90", "round", "searchsorted", "sign", "sin", "sinh",
     "sort", "split", "sqrt", "square", "squeeze", "stack", "std",
     "subtract", "sum", "swapaxes", "take", "take_along_axis", "tan", "tanh",
     "tensordot", "tile", "trace", "transpose", "tril", "triu",

@@ -198,7 +198,7 @@ def amp_multicast(*arrays, num_outputs=None):
 
 
 @register("all_finite", differentiable=False)
-def all_finite(data, prev=None, init_output=True):
+def all_finite(data, init_output=True, *, prev=None):
     """(1,) float flag: 1.0 iff every element is finite (reference
     optimizer_op.cc all_finite — the AMP dynamic-loss-scaler probe).
 
@@ -218,7 +218,8 @@ def all_finite(data, prev=None, init_output=True):
 
 
 @register("multi_all_finite", differentiable=False)
-def multi_all_finite(*arrays, num_arrays=None, init_output=True, prev=None):
+def multi_all_finite(*arrays, num_arrays=None, init_output=True,
+                     prev=None):
     """all_finite over many tensors fused into ONE scalar on device —
     one host readback checks a whole gradient set (optimizer_op.cc
     multi_all_finite).  See all_finite for the ``prev`` accumulation

@@ -223,6 +223,88 @@ def probe_conv1():
               f"({100 * tf * 1e12 / PEAK:.1f}% of peak)", flush=True)
 
 
+def probe_ablate():
+    """Decompose the fused-step time into three measurements — full
+    train step, train step with eval-mode BN (no batch-stat
+    reductions), forward only — to locate the 15%-MFU gap between the
+    conv tower (~24% of peak) and the full train step."""
+    bs = int(os.environ.get("PROBE_BS", "128"))
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon, amp
+    from incubator_mxnet_tpu.fuse import make_fused_train_step
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    accel = jax.devices()[0]
+    cpu0 = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu0):
+        net = vision.resnet50_v1()
+        net.initialize(ctx=mx.cpu())
+        net(nd.random.uniform(shape=(1, 3, 32, 32)))
+        amp.convert_block(net, "bfloat16")
+        step = make_fused_train_step(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+        _, apply_fn = net.functional()
+        x = jnp.asarray(onp.random.rand(bs, 3, 224, 224), jnp.bfloat16)
+        y = jnp.asarray(onp.random.randint(0, 1000, (bs,)), jnp.int32)
+    put = lambda t: jax.device_put(t, accel)  # noqa: E731
+    params = jax.tree_util.tree_map(put, step.params)
+    aux = jax.tree_util.tree_map(put, step.aux)
+    opt_state = jax.tree_util.tree_map(put, step.opt_state)
+    x, y = put(x), put(y)
+    flops_train = 3 * 4.089e9 * bs
+    flops_fwd = 4.089e9 * bs
+
+    def timed(name, fn, carry, flops, steps=10):
+        dt = timeit(fn, carry, steps=steps, warmup=3)
+        print(f"{name:24s} {dt * 1e3:8.2f} ms  "
+              f"{100 * flops / dt / PEAK:5.1f}% MFU-equiv", flush=True)
+        return dt
+
+    # (a) full train step (params chained through carry)
+    def full(p, a, o, x, y):
+        key = jax.random.PRNGKey(0)
+        p2, a2, o2, loss = step._step_fn(p, a, o, x, y, key)
+        return p2, a2, o2, x, y
+    timed("full train step", full, (params, aux, opt_state, x, y),
+          flops_train)
+
+    # (b) fwd+bwd+sgd WITHOUT BatchNorm batch stats (use_global_stats
+    #     analog: training=False apply → moving stats, no reductions)
+    def loss_eval(p, x, y):
+        out = apply_fn(p, x, training=False)
+        if isinstance(out, tuple):
+            out = out[0]
+        lp = jax.nn.log_softmax(out.astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(lp, y[:, None], 1))
+
+    @jax.jit
+    def train_nobn(p, x, y):
+        loss, g = jax.value_and_grad(loss_eval)(p, x, y)
+        p2 = jax.tree_util.tree_map(
+            lambda w, gg: (w - 0.1 * gg.astype(w.dtype)), p, g)
+        return p2, x, y
+    pa = {**params, **aux}
+    timed("train, eval-mode BN", train_nobn, (pa, x, y), flops_train)
+
+    # (c) forward only, eval-mode BN
+    @jax.jit
+    def fwd_loop(p, x):
+        out = apply_fn(p, x, training=False)
+        if isinstance(out, tuple):
+            out = out[0]
+        # chain: feed a scalar of the output back into x so steps serialize
+        return x + out.mean().astype(x.dtype) * 0, p
+
+    def fwd_carry(x, p):
+        x2, _ = fwd_loop(p, x)
+        return x2, p
+    timed("fwd only (eval BN)", fwd_carry, (x, pa), flops_fwd)
+
+
+
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "fused"
     print(f"devices: {jax.devices()}", flush=True)
@@ -230,6 +312,8 @@ if __name__ == "__main__":
         probe_matmul()
     elif mode == "conv1":
         probe_conv1()
+    elif mode == "ablate":
+        probe_ablate()
     elif mode == "layout":
         probe_layout()
     else:

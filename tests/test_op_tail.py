@@ -399,7 +399,7 @@ def test_all_finite_ops():
     acc2 = nd.multi_all_finite(good, good, num_arrays=2, prev=flag0,
                                init_output=False)
     assert float(_np(acc2)[0]) == 0.0
-    with pytest.raises((ValueError, Exception)):
+    with pytest.raises(ValueError, match="prev"):
         nd.all_finite(good, init_output=False)
 
 
