@@ -1,0 +1,126 @@
+"""Gluon contrib layers (reference python/mxnet/gluon/contrib/nn/
+basic_layers.py): Concurrent/HybridConcurrent branching containers,
+Identity, SparseEmbedding, SyncBatchNorm, PixelShuffle{1,2,3}D.
+"""
+from __future__ import annotations
+
+import math
+
+from ...block import HybridBlock
+from ...nn import (Sequential, HybridSequential, Identity, Embedding,
+                   BatchNorm)
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
+
+
+class Concurrent(Sequential):
+    """Feed the SAME input to every child and concat the outputs along
+    ``axis`` (reference basic_layers.py Concurrent — the Inception-style
+    branch container)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def forward(self, x):
+        from ....ndarray import concat
+        return concat(*[block(x) for block in self._children.values()],
+                      dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (reference HybridConcurrent)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def forward(self, x):
+        from ....ndarray import concat
+        return concat(*[block(x) for block in self._children.values()],
+                      dim=self.axis)
+
+
+class SparseEmbedding(Embedding):
+    """Embedding whose gradient is row-sparse (reference
+    SparseEmbedding).  The row_sparse optimizer path (sgd lazy_update,
+    ops/sparse_ops.py) consumes such gradients; under XLA the gather
+    backward is already a scatter-add touching only the looked-up rows,
+    so this is Embedding with the sparse-grad contract documented."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32", **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype, **kwargs)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (reference contrib SyncBatchNorm over
+    src/operator/contrib/sync_batch_norm.cc).
+
+    TPU-first: under pjit/shard_map with the batch axis sharded, the
+    batch-stat reductions inside BatchNorm lower to mesh all-reduces
+    automatically (GSPMD), so plain BatchNorm IS sync-BN there; this
+    class keeps the reference signature (num_devices accepted, unused
+    in-process).
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, **kwargs):
+        super().__init__(momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices
+
+
+class _PixelShuffle(HybridBlock):
+    _ndim = 2
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        try:
+            self._factors = (int(factor),) * self._ndim
+        except TypeError:
+            self._factors = tuple(int(f) for f in factor)
+            if len(self._factors) != self._ndim:
+                raise ValueError(f"wrong length {len(self._factors)}")
+        self._prod = math.prod(self._factors)
+
+    def forward(self, x):
+        # route through the registered reshape/transpose ops so the
+        # autograd tape records every step (a raw jnp rearrangement here
+        # would silently drop gradients through the layer)
+        from ....ndarray import reshape, transpose
+        fs = self._factors
+        nd_sp = self._ndim
+        N = x.shape[0]
+        C = x.shape[1] // self._prod
+        spatial = tuple(x.shape[2:])
+        # (N, f1*..*fk*C, *S) -> (N, C, f1..fk, *S): channel-major C
+        # first, then factors (reference reshape(0, -4, -1, f1*f2, 0, 0))
+        y = reshape(x, shape=(N, C) + fs + spatial)
+        # interleave: (N, C, S1, f1, S2, f2, ...)
+        perm = [0, 1]
+        for i in range(nd_sp):
+            perm += [2 + nd_sp + i, 2 + i]
+        y = transpose(y, axes=tuple(perm))
+        out_spatial = tuple(s * f for s, f in zip(spatial, fs))
+        return reshape(y, shape=(N, C) + out_spatial)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._factors})"
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, f*C, W) -> (N, C, f*W) (reference PixelShuffle1D)."""
+    _ndim = 1
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, f1*f2*C, H, W) -> (N, C, f1*H, f2*W) (reference
+    PixelShuffle2D — sub-pixel upsampling, arXiv:1609.05158)."""
+    _ndim = 2
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, f1*f2*f3*C, D, H, W) -> (N, C, f1*D, f2*H, f3*W)."""
+    _ndim = 3

@@ -2,7 +2,7 @@
 from .basic_layers import (
     Sequential, HybridSequential, Dense, Dropout, Flatten, Lambda,
     HybridLambda, Embedding, Activation, LeakyReLU, PReLU, ELU, SELU, GELU,
-    Swish, SiLU, BatchNorm, LayerNorm, GroupNorm, InstanceNorm, Identity,
+    Swish, SiLU, BatchNorm, BatchNormReLU, LayerNorm, GroupNorm, InstanceNorm, Identity,
 )
 from .conv_layers import (
     Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,

@@ -281,6 +281,17 @@ class BatchNorm(HybridBlock):
                       fix_gamma=not self._scale, training=False)
 
 
+class BatchNormReLU(BatchNorm):
+    """Fused BatchNorm + ReLU (reference nn/activations.py
+    BatchNormReLU over src/operator/nn/batch_norm_relu): under XLA the
+    relu fuses into the BN epilogue automatically, so this is BatchNorm
+    followed by relu in one compiled program."""
+
+    def forward(self, x):
+        out = super().forward(x)
+        return invoke("relu", out)
+
+
 class LayerNorm(HybridBlock):
     def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
                  beta_initializer="zeros", gamma_initializer="ones",

@@ -2,4 +2,5 @@
 from .rnn_layer import RNN, LSTM, GRU
 from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
                        SequentialRNNCell, BidirectionalCell, DropoutCell,
-                       ResidualCell, ZoneoutCell)
+                       ResidualCell, ZoneoutCell, ModifierCell,
+                       HybridRecurrentCell, HybridSequentialRNNCell)

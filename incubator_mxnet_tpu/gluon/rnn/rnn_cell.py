@@ -175,6 +175,22 @@ class SequentialRNNCell(RecurrentCell):
         return inputs, next_states
 
 
+class ModifierCell(RecurrentCell):
+    """Base for cells that wrap a base_cell and modify its behavior
+    (reference rnn/rnn_cell.py ModifierCell — parent of Residual/
+    Zoneout): delegates state handling to the wrapped cell."""
+
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+
 class DropoutCell(RecurrentCell):
     def __init__(self, rate, **kwargs):
         super().__init__(**kwargs)
@@ -193,33 +209,19 @@ class DropoutCell(RecurrentCell):
         return inputs, states
 
 
-class ResidualCell(RecurrentCell):
-    def __init__(self, base_cell, **kwargs):
-        super().__init__(**kwargs)
-        self.base_cell = base_cell
-
-    def state_info(self, batch_size=0):
-        return self.base_cell.state_info(batch_size)
-
-    def begin_state(self, batch_size=0, **kwargs):
-        return self.base_cell.begin_state(batch_size, **kwargs)
-
+class ResidualCell(ModifierCell):
     def forward(self, inputs, states):
         out, states = self.base_cell(inputs, states)
         return out + inputs, states
 
 
-class ZoneoutCell(RecurrentCell):
+class ZoneoutCell(ModifierCell):
     def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
                  **kwargs):
-        super().__init__(**kwargs)
-        self.base_cell = base_cell
+        super().__init__(base_cell, **kwargs)
         self._zo = zoneout_outputs
         self._zs = zoneout_states
         self._prev_output = None
-
-    def state_info(self, batch_size=0):
-        return self.base_cell.state_info(batch_size)
 
     def begin_state(self, batch_size=0, **kwargs):
         self._prev_output = None
@@ -277,3 +279,11 @@ class BidirectionalCell(RecurrentCell):
 
     def forward(self, inputs, states):
         raise NotImplementedError("BidirectionalCell supports unroll() only")
+
+
+# Hybrid aliases: every cell here is already a HybridBlock (whole-graph
+# jit via hybridize), so the reference's separate Hybrid* hierarchy
+# (rnn/rnn_cell.py HybridRecurrentCell/HybridSequentialRNNCell)
+# collapses to aliases.
+HybridRecurrentCell = RecurrentCell
+HybridSequentialRNNCell = SequentialRNNCell

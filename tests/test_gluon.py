@@ -339,3 +339,81 @@ def test_metrics_tail():
 
     assert M.create("pcc").name == "pcc"
     assert isinstance(M.Torch(), M.Loss) and isinstance(M.Caffe(), M.Loss)
+
+
+def test_batch_norm_relu_layer():
+    """BatchNormReLU == BatchNorm then relu (reference nn BatchNormReLU)."""
+    import numpy as onp
+    from incubator_mxnet_tpu import autograd
+    bnr = nn.BatchNormReLU(in_channels=3)
+    bn = nn.BatchNorm(in_channels=3)
+    bnr.initialize()
+    bn.initialize()
+    x = nd.random.uniform(-2, 2, shape=(2, 3, 4, 4))
+    out = bnr(x)
+    ref = nd.relu(bn(x))
+    onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-5)
+    assert float(out.min().asnumpy()) >= 0.0
+    # training mode updates moving stats like plain BN
+    with autograd.record():
+        y = bnr(x)
+    y.backward()
+    assert float(nd.sum(nd.abs(bnr.running_mean.data())).asnumpy()) > 0
+
+
+def test_modifier_cell_hierarchy():
+    """ModifierCell base + hybrid aliases (reference rnn_cell.py)."""
+    from incubator_mxnet_tpu.gluon import rnn
+    assert issubclass(rnn.ResidualCell, rnn.ModifierCell)
+    assert issubclass(rnn.ZoneoutCell, rnn.ModifierCell)
+    assert rnn.HybridRecurrentCell is rnn.RecurrentCell
+    assert rnn.HybridSequentialRNNCell is rnn.SequentialRNNCell
+    base = rnn.LSTMCell(8, input_size=4)
+    res = rnn.ResidualCell(base)
+    assert res.state_info(2) == base.state_info(2)
+
+
+def test_contrib_nn_layers():
+    """gluon.contrib.nn (reference contrib/nn/basic_layers.py):
+    Concurrent branches, PixelShuffle value parity, SyncBatchNorm."""
+    import numpy as onp
+    from incubator_mxnet_tpu.gluon.contrib import nn as gcn
+    # Concurrent: same input to every branch, concat on axis
+    cc = gcn.HybridConcurrent(axis=1)
+    cc.add(nn.Dense(3, in_units=4), nn.Dense(5, in_units=4))
+    cc.initialize()
+    x = nd.random.uniform(shape=(2, 4))
+    out = cc(x)
+    assert out.shape == (2, 8)
+    onp.testing.assert_allclose(out.asnumpy()[:, :3],
+                                cc[0](x).asnumpy(), rtol=1e-6)
+    # PixelShuffle2D value parity vs a direct numpy rearrangement
+    f1, f2, C, H, W = 2, 3, 2, 3, 5
+    src = onp.arange(1 * f1 * f2 * C * H * W, dtype=onp.float32) \
+        .reshape(1, f1 * f2 * C, H, W)
+    got = gcn.PixelShuffle2D((f1, f2))(nd.array(src)).asnumpy()
+    want = src.reshape(1, C, f1, f2, H, W).transpose(0, 1, 4, 2, 5, 3) \
+        .reshape(1, C, H * f1, W * f2)
+    onp.testing.assert_array_equal(got, want)
+    # gradients flow through the shuffle (tape-recorded rearrangement)
+    from incubator_mxnet_tpu import autograd
+    xs = nd.array(src)
+    xs.attach_grad()
+    with autograd.record():
+        y = gcn.PixelShuffle2D((f1, f2))(xs)
+        loss = nd.sum(y * y)
+    loss.backward()
+    onp.testing.assert_allclose(xs.grad.asnumpy(), 2 * src, rtol=1e-6)
+    # SyncBatchNorm layer behaves like BatchNorm in-process
+    sbn = gcn.SyncBatchNorm(in_channels=3, num_devices=8)
+    bn = nn.BatchNorm(in_channels=3)
+    sbn.initialize()
+    bn.initialize()
+    xi = nd.random.uniform(shape=(2, 3, 4, 4))
+    onp.testing.assert_allclose(sbn(xi).asnumpy(), bn(xi).asnumpy(),
+                                rtol=1e-5)
+    # SparseEmbedding is an Embedding with the sparse-grad contract
+    emb = gcn.SparseEmbedding(10, 4)
+    emb.initialize()
+    idx = nd.array(onp.array([1, 3], onp.int32))
+    assert emb(idx).shape == (2, 4)
