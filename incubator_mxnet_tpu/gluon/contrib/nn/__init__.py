@@ -15,7 +15,14 @@ __all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
            "PixelShuffle3D"]
 
 
-class Concurrent(Sequential):
+class _ConcurrentMixin:
+    def _concat_branches(self, x):
+        from ....ndarray import concat
+        return concat(*[block(x) for block in self._children.values()],
+                      dim=self.axis)
+
+
+class Concurrent(_ConcurrentMixin, Sequential):
     """Feed the SAME input to every child and concat the outputs along
     ``axis`` (reference basic_layers.py Concurrent — the Inception-style
     branch container)."""
@@ -25,12 +32,10 @@ class Concurrent(Sequential):
         self.axis = axis
 
     def forward(self, x):
-        from ....ndarray import concat
-        return concat(*[block(x) for block in self._children.values()],
-                      dim=self.axis)
+        return self._concat_branches(x)
 
 
-class HybridConcurrent(HybridSequential):
+class HybridConcurrent(_ConcurrentMixin, HybridSequential):
     """Hybridizable Concurrent (reference HybridConcurrent)."""
 
     def __init__(self, axis=-1, **kwargs):
@@ -38,9 +43,7 @@ class HybridConcurrent(HybridSequential):
         self.axis = axis
 
     def forward(self, x):
-        from ....ndarray import concat
-        return concat(*[block(x) for block in self._children.values()],
-                      dim=self.axis)
+        return self._concat_branches(x)
 
 
 class SparseEmbedding(Embedding):
@@ -49,9 +52,6 @@ class SparseEmbedding(Embedding):
     ops/sparse_ops.py) consumes such gradients; under XLA the gather
     backward is already a scatter-add touching only the looked-up rows,
     so this is Embedding with the sparse-grad contract documented."""
-
-    def __init__(self, input_dim, output_dim, dtype="float32", **kwargs):
-        super().__init__(input_dim, output_dim, dtype=dtype, **kwargs)
 
 
 class SyncBatchNorm(BatchNorm):

@@ -11,7 +11,7 @@ from . import random as _random
 
 __all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
            "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
-           "register", "create"]
+           "InitDesc", "Load", "FusedRNN", "Mixed", "register", "create"]
 
 _reg = registry("initializer")
 register = _reg.register
@@ -209,3 +209,101 @@ class Mixed:
                 init(name, arr)
                 return
         raise ValueError(f"parameter {name} did not match any pattern")
+
+
+class InitDesc(str):
+    """Initialization-pattern descriptor (reference initializer.py:36):
+    a parameter name carrying its symbol attrs and the global fallback
+    initializer — passed to initializers on the symbolic init path."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Load:
+    """Initialize parameters from a saved ``.params`` file or dict
+    (reference initializer.py:318); ``arg:``/``aux:`` prefixes are
+    dropped, unmatched names fall back to ``default_init``."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            from .ndarray import load as _nd_load
+            param = _nd_load(param)
+        if not isinstance(param, dict):
+            raise TypeError("Load needs a .params path or a name->NDArray "
+                            "dict")
+        self.param = {}
+        for name, arr in param.items():
+            key = name[4:] if name.startswith(("arg:", "aux:")) else name
+            self.param[key] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr=None):
+        if arr is None:
+            name, arr = "", name
+        name = getattr(name, "name", name) or str(name)
+        if name in self.param:
+            src = self.param[name]
+            if tuple(arr.shape) != tuple(src.shape):
+                raise ValueError(
+                    f"Parameter {name} cannot be initialized from "
+                    f"loading: shape mismatch, target {tuple(arr.shape)} "
+                    f"vs loaded {tuple(src.shape)}")
+            arr._set_data(jnp.asarray(src.data if hasattr(src, "data")
+                                      else src).astype(arr.data.dtype))
+            if self.verbose:
+                import logging
+                logging.info("Initialized %s by loading", name)
+        else:
+            if self.default_init is None:
+                raise ValueError(
+                    f"Cannot initialize {name}: not found in loaded "
+                    "params and no default initializer provided")
+            self.default_init(name, arr)
+
+
+@register
+class FusedRNN(Initializer):
+    """Initializer for fused-RNN parameters (reference
+    initializer.py:719).
+
+    The reference unpacks cuDNN's single packed weight blob and applies
+    ``init`` per unfused matrix.  This framework's fused RNN
+    (gluon/rnn/rnn_layer.py) keeps per-layer i2h/h2h weights as separate
+    parameters (lax.scan consumes them directly — no cuDNN blob), so
+    this initializer applies ``init`` to each weight and the LSTM
+    forget-gate bias treatment to each bias, which is the same
+    post-unpack behavior without the packing round-trip.
+    """
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        super().__init__(num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._mode = mode
+        self._forget_bias = forget_bias
+
+    def __call__(self, name, arr=None):
+        if arr is None:
+            name, arr = "", name
+        name = getattr(name, "name", name) or ""
+        if name.endswith("bias") and self._mode == "lstm":
+            import numpy as onp
+            b = onp.zeros(arr.shape, "float32")
+            n = arr.shape[0] // 4
+            b[n:2 * n] = self._forget_bias
+            arr._set_data(jnp.asarray(b).astype(arr.data.dtype))
+        elif self._init is not None:
+            self._init(name, arr)
+        else:
+            self._init_weight(name, arr)
+
+    def _init_weight(self, name, arr):
+        raise ValueError("FusedRNN needs an inner init (or a global "
+                         "initializer) for weight parameters")

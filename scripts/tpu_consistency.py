@@ -1,0 +1,76 @@
+"""CPU-vs-TPU cross-backend oracle battery.
+
+The reference's flagship correctness tool is check_consistency
+(test_utils.py:1428): run the same op on every backend and cross-check.
+This script runs a battery of representative ops on the CPU backend and
+the real TPU and asserts parity — the CPU-vs-GPU oracle recast for TPU.
+
+Run directly (prints one line per case), or via
+tests/test_tpu_consistency.py which subprocess-guards against a wedged
+axon tunnel (the first device op can hang forever there).
+"""
+import sys
+
+import numpy as onp
+
+
+def main():
+    import jax
+    accel = jax.devices()[0]
+    if accel.platform == "cpu":
+        print("NO_ACCELERATOR")
+        return 0
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.test_utils import check_consistency
+
+    R = onp.random.RandomState(0)
+    ctxs = [mx.cpu(), mx.tpu()]
+
+    cases = [
+        ("matmul_f32", lambda a, b: nd.dot(a, b),
+         [R.rand(16, 32).astype("f"), R.rand(32, 8).astype("f")], 1e-4),
+        ("conv", lambda x, w: nd.Convolution(
+            x, w, kernel=(3, 3), num_filter=8, pad=(1, 1), no_bias=True),
+         [R.rand(2, 4, 8, 8).astype("f"), R.rand(8, 4, 3, 3).astype("f")],
+         1e-3),
+        ("batchnorm_eval", lambda x, g, b, m, v: nd.BatchNorm(
+            x, g, b, m, v, training=False),
+         [R.rand(2, 3, 4, 4).astype("f"), onp.ones(3, "f"),
+          onp.zeros(3, "f"), R.rand(3).astype("f"),
+          (R.rand(3) + 0.5).astype("f")], 1e-3),
+        ("softmax", lambda x: nd.softmax(x, axis=-1),
+         [R.randn(4, 10).astype("f")], 1e-4),
+        ("logsumexp_red", lambda x: nd.sum(nd.exp(x - nd.max(x))),
+         [R.randn(3, 7).astype("f")], 1e-4),
+        ("layer_norm", lambda x, g, b: nd.LayerNorm(x, g, b),
+         [R.rand(4, 16).astype("f"), onp.ones(16, "f"),
+          onp.zeros(16, "f")], 1e-3),
+        ("take", lambda x: nd.take(x, nd.array(
+            onp.array([0, 3, 1], onp.int32))),
+         [R.rand(5, 4).astype("f")], 1e-6),
+        ("selfatt_qk", lambda qkv: nd.interleaved_matmul_selfatt_qk(
+            qkv, heads=2),
+         [R.randn(6, 2, 24).astype("f")], 1e-3),
+        ("pooling", lambda x: nd.Pooling(x, kernel=(2, 2), stride=(2, 2),
+                                         pool_type="max"),
+         [R.rand(2, 3, 8, 8).astype("f")], 1e-6),
+        ("topk", lambda x: nd.topk(x, k=3, ret_typ="value"),
+         [R.rand(4, 10).astype("f")], 1e-6),
+    ]
+    failures = 0
+    for name, fn, inputs, tol in cases:
+        try:
+            check_consistency(fn, inputs, ctx_list=ctxs, rtol=tol, atol=tol)
+            print(f"OK {name}", flush=True)
+        except Exception as e:  # noqa: BLE001 — one op failing (parity
+            # OR lowering error) must not abort the rest of the battery
+            failures += 1
+            print(f"FAIL {name}: {type(e).__name__}: {str(e)[:200]}",
+                  flush=True)
+    print(f"DONE {len(cases) - failures}/{len(cases)}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

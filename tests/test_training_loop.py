@@ -93,3 +93,42 @@ def test_prefetching_iter_matches_plain():
     assert len(got) == len(plain)
     for a, b in zip(plain, got):
         onp.testing.assert_array_equal(a, b)
+
+
+def test_initializer_load_and_fused_rnn(tmp_path):
+    """Load + FusedRNN + InitDesc initializers (reference
+    initializer.py:36,318,719)."""
+    import numpy as onp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, initializer
+    # Load: round-trip through a saved .params file
+    w = nd.array(onp.full((3, 2), 5.0, onp.float32))
+    nd.save(str(tmp_path / "w.params"), {"arg:dense_weight": w})
+    init = initializer.Load(str(tmp_path / "w.params"),
+                            default_init=initializer.Zero())
+    target = nd.zeros(shape=(3, 2))
+    target.attach_grad()
+    init("dense_weight", target)
+    onp.testing.assert_array_equal(target.asnumpy(), w.asnumpy())
+    other = nd.ones(shape=(4,))
+    init("unknown_bias", other)  # falls back to Zero
+    assert float(other.asnumpy().sum()) == 0.0
+    # shape mismatch is an error, not silent truncation
+    import pytest
+    with pytest.raises(ValueError, match="shape mismatch"):
+        init("dense_weight", nd.zeros(shape=(2, 2)))
+    # FusedRNN: weights via inner init, lstm bias gets forget_bias
+    fr = initializer.FusedRNN(initializer.One(), num_hidden=4,
+                              num_layers=1, mode="lstm", forget_bias=2.0)
+    wgt = nd.zeros(shape=(16, 8))
+    fr("lstm_i2h_weight", wgt)
+    assert float(wgt.asnumpy().mean()) == 1.0
+    bias = nd.zeros(shape=(16,))
+    fr("lstm_i2h_bias", bias)
+    b = bias.asnumpy()
+    onp.testing.assert_array_equal(b[4:8], onp.full(4, 2.0))
+    assert b[:4].sum() == 0 and b[8:].sum() == 0
+    # InitDesc carries attrs + global_init and remains a str
+    d = initializer.InitDesc("conv_weight", attrs={"lr_mult": "2"},
+                             global_init=initializer.Zero())
+    assert d == "conv_weight" and d.attrs["lr_mult"] == "2"
