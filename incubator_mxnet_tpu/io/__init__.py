@@ -14,7 +14,7 @@ from ..ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
-           "NativeImageRecordIter",
+           "NativeImageRecordIter", "MXDataIter",
            "LibSVMIter"]
 
 
@@ -546,3 +546,10 @@ class LibSVMIter(DataIter):
                          (len(idxs), self._num_col))
         lab = onp.asarray([self._labels[i] for i in idxs], onp.float32)
         return DataBatch(data=[csr], label=[NDArray(lab)], pad=pad)
+
+
+# Reference io.py:799: MXDataIter is the Python wrapper over any C++
+# iterator handle. This framework's C++ iterator family is the
+# image-record pipeline (src/image_iter.cc), so MXDataIter names that
+# wrapper.
+MXDataIter = NativeImageRecordIter
