@@ -305,8 +305,89 @@ def probe_ablate():
 
 
 
+def probe_stem():
+    """ResNet stem experiment: 7x7/s2 conv on (N,3,224,224) vs the
+    space-to-depth equivalent (4x4/s1 conv on (N,12,112,112) with a
+    transformed kernel — the MLPerf TPU ResNet trick).  The C=3 input
+    packs poorly onto the 128-lane MXU; s2d raises the contraction
+    density 4x.  Prints a numeric-equivalence check, then timings."""
+    from jax import lax
+    bs = int(os.environ.get("PROBE_BS", "128"))
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (bs, 3, 224, 224), jnp.bfloat16)
+    w = jax.random.normal(key, (64, 3, 7, 7), jnp.bfloat16) * 0.05
+
+    def stem_plain(x, w):
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+        return lax.conv_general_dilated(x, w, (2, 2), [(3, 3), (3, 3)],
+                                        dimension_numbers=dn)
+
+    def s2d(x):
+        # (N, C, H, W) -> (N, 4C, H/2, W/2), block-major (dy, dx)
+        n, c, h, wd = x.shape
+        y = x.reshape(n, c, h // 2, 2, wd // 2, 2)
+        return y.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * 4, h // 2,
+                                                     wd // 2)
+
+    def make_w2(w):
+        # embed the 7x7 kernel (pad 3) into the s2d domain: output pixel
+        # (i, j) of the plain stem reads input rows 2i-3..2i+3 — in s2d
+        # coordinates, rows i-2..i+1 of each parity plane. A 4x4 kernel
+        # over 4 parity planes with offset -2 covers exactly that span.
+        # Built in host numpy: 49 eager scatter dispatches over the
+        # tunnel would wedge for minutes (docs/performance.md).
+        o, c, _, _ = w.shape
+        w_host = onp.asarray(jax.device_get(w).astype(jnp.float32))
+        w8 = onp.zeros((o, c, 2, 2, 4, 4), onp.float32)
+        for ky in range(7):
+            for kx in range(7):
+                # plain: input row r = 2i + ky - 3; decompose r = 2q + p:
+                # parity p = (ky - 3) % 2, q-offset tap
+                # t = (ky - 3 - p) // 2 + 2 in [0, 4)
+                py, ty = (ky - 3) % 2, ((ky - 3) - ((ky - 3) % 2)) // 2 + 2
+                px, tx = (kx - 3) % 2, ((kx - 3) - ((kx - 3) % 2)) // 2 + 2
+                w8[:, :, py, px, ty, tx] = w_host[:, :, ky, kx]
+        return jnp.asarray(w8.reshape(o, c * 4, 4, 4), w.dtype)
+
+    def stem_s2d(x, w2):
+        xs = s2d(x)
+        dn = lax.conv_dimension_numbers(xs.shape, w2.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+        # q-offset -2..1 relative to output pixel i -> pad (2, 1)
+        return lax.conv_general_dilated(xs, w2, (1, 1), [(2, 1), (2, 1)],
+                                        dimension_numbers=dn)
+
+    w2 = make_w2(w)
+    diff = jax.jit(lambda a, b, c: jnp.max(jnp.abs(
+        stem_plain(a, b) - stem_s2d(a, c))))
+    err = float(diff(x[:2].astype(jnp.float32), w.astype(jnp.float32),
+                     w2.astype(jnp.float32)))
+    print(f"s2d equivalence max|diff| = {err:.2e} (fp32)", flush=True)
+    if err > 1e-3:
+        print("NOT equivalent — do not use", flush=True)
+        return
+
+    flops = 2 * 3 * 64 * 49 * 112 * 112 * bs
+    for name, fn, args in (("stem 7x7/s2 plain", stem_plain, (x, w)),
+                           ("stem s2d 4x4/s1", stem_s2d, (x, w2))):
+        # serialize steps by feeding a (numerically negligible) function
+        # of the output back into the carried input
+        jfn = jax.jit(lambda a, b, _f=fn: (
+            a + (_f(a, b).ravel()[0] * 1e-20).astype(a.dtype), b))
+        dt = timeit(lambda a, b: jfn(a, b), args, steps=10, warmup=3)
+        print(f"{name:20s} {dt * 1e3:7.2f} ms  "
+              f"~{flops / dt / 1e12:5.1f} TFLOP/s "
+              f"({100 * flops / dt / PEAK:.1f}% of peak)", flush=True)
+
+
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "fused"
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # sitecustomize re-pins the axon platform programmatically;
+        # honor an explicit CPU request (probes must be CPU-testable
+        # while the tunnel is wedged)
+        jax.config.update("jax_platforms", "cpu")
     print(f"devices: {jax.devices()}", flush=True)
     if mode == "matmul":
         probe_matmul()
@@ -314,6 +395,8 @@ if __name__ == "__main__":
         probe_conv1()
     elif mode == "ablate":
         probe_ablate()
+    elif mode == "stem":
+        probe_stem()
     elif mode == "layout":
         probe_layout()
     else:
