@@ -368,9 +368,21 @@ def probe_stem():
         print("NOT equivalent — do not use", flush=True)
         return
 
+    # pre-transform the input once: the MLPerf trick folds s2d into the
+    # data pipeline, so the conv is timed on (N,12,112,112) directly;
+    # the conv+transform variant is also timed for the in-graph case
+    xs = jax.jit(s2d)(x)
+
+    def stem_s2d_pre(xs, w2):
+        dn = lax.conv_dimension_numbers(xs.shape, w2.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+        return lax.conv_general_dilated(xs, w2, (1, 1), [(2, 1), (2, 1)],
+                                        dimension_numbers=dn)
+
     flops = 2 * 3 * 64 * 49 * 112 * 112 * bs
     for name, fn, args in (("stem 7x7/s2 plain", stem_plain, (x, w)),
-                           ("stem s2d 4x4/s1", stem_s2d, (x, w2))):
+                           ("s2d conv+transform", stem_s2d, (x, w2)),
+                           ("s2d conv (pre-s2d)", stem_s2d_pre, (xs, w2))):
         # serialize steps by feeding a (numerically negligible) function
         # of the output back into the carried input
         jfn = jax.jit(lambda a, b, _f=fn: (

@@ -1,0 +1,125 @@
+"""Model-family tests: BERT and LSTM-LM (BASELINE.json configs 3 and 5;
+reference counterparts: gluon-nlp BERT-base pretraining and
+example/rnn's LSTM LM).  SSD has its own suite in test_contrib_det.py;
+TransformerLM sharding is covered in test_parallel.py.
+"""
+import numpy as onp
+
+from incubator_mxnet_tpu import nd, autograd, gluon
+
+
+def _tiny_bert(**kw):
+    from incubator_mxnet_tpu.models.bert import BERTModel
+    cfg = dict(vocab_size=50, num_layers=2, units=16, hidden_size=32,
+               num_heads=2, max_length=24, dropout=0.0)
+    cfg.update(kw)
+    net = BERTModel(**cfg)
+    net.initialize()
+    return net
+
+
+def test_bert_forward_shapes():
+    net = _tiny_bert()
+    B, T = 3, 10
+    tokens = nd.array(onp.random.RandomState(0).randint(0, 50, (B, T))
+                      .astype(onp.int32))
+    types = nd.zeros(shape=(B, T), dtype="int32")
+    out = net(tokens, types)
+    seq, pooled, nsp = (out if len(out) == 3 else (out[0], out[1], None))
+    assert seq.shape == (B, T, 50)      # MLM logits over vocab
+    assert pooled.shape[0] == B
+
+
+def test_bert_valid_length_masks_attention():
+    """Padding tokens beyond valid_length must not change the prefix
+    outputs (attention-mask semantics)."""
+    net = _tiny_bert()
+    rng = onp.random.RandomState(1)
+    B, T, VL = 2, 12, 5
+    base = rng.randint(1, 50, (B, T)).astype(onp.int32)
+    pad_a = base.copy()
+    pad_b = base.copy()
+    pad_b[:, VL:] = 7  # different padding content
+    vl = nd.array(onp.full((B,), VL, onp.float32))
+    out_a = net(nd.array(pad_a), None, vl)
+    out_b = net(nd.array(pad_b), None, vl)
+    seq_a = out_a[0].asnumpy() if isinstance(out_a, tuple) else out_a.asnumpy()
+    seq_b = out_b[0].asnumpy() if isinstance(out_b, tuple) else out_b.asnumpy()
+    onp.testing.assert_allclose(seq_a[:, :VL], seq_b[:, :VL], rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_bert_mlm_overfits_tiny_batch():
+    """Masked-LM objective memorizes a fixed batch (config-3 smoke)."""
+    net = _tiny_bert()
+    rng = onp.random.RandomState(2)
+    B, T = 4, 8
+    tokens = rng.randint(1, 50, (B, T)).astype(onp.int32)
+    labels = tokens.copy()
+    masked = tokens.copy()
+    masked[:, ::2] = 0  # mask half the positions
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = nd.array(masked)
+    y = nd.array(labels.reshape(-1))
+    first = None
+    for _ in range(40):
+        with autograd.record():
+            out = net(x)
+            seq = out[0] if isinstance(out, tuple) else out
+            loss = loss_fn(seq.reshape(B * T, -1), y).mean()
+        loss.backward()
+        trainer.step(B)
+        if first is None:
+            first = float(loss.asnumpy())
+    final = float(loss.asnumpy())
+    assert final < first * 0.5, (first, final)
+
+
+def test_bert_amp_bf16_conversion():
+    """AMP bf16 conversion runs on BERT and keeps LN/softmax healthy."""
+    from incubator_mxnet_tpu import amp
+    net = _tiny_bert()
+    tokens = nd.array(onp.random.RandomState(3).randint(0, 50, (2, 6))
+                      .astype(onp.int32))
+    ref = net(tokens)
+    ref_seq = ref[0] if isinstance(ref, tuple) else ref
+    amp.convert_block(net, "bfloat16")
+    out = net(tokens)
+    out_seq = out[0] if isinstance(out, tuple) else out
+    assert out_seq.shape == ref_seq.shape
+    assert onp.isfinite(out_seq.asnumpy()).all()
+    # bf16 has ~3 decimal digits; just require correlation with fp32
+    a, b = ref_seq.asnumpy().ravel(), out_seq.asnumpy().ravel()
+    corr = onp.corrcoef(a, b)[0, 1]
+    assert corr > 0.98, corr
+
+
+def test_lstm_lm_overfits():
+    from incubator_mxnet_tpu.models.lstm_lm import LSTMLanguageModel
+    rng = onp.random.RandomState(4)
+    net = LSTMLanguageModel(vocab_size=30, embed_size=16, hidden_size=32,
+                            dropout=0.0)
+    net.initialize()
+    B, T = 4, 6
+    seq = rng.randint(0, 30, (B, T + 1)).astype(onp.int32)
+    # the model is time-major (LSTM layout=TNC): inputs (T, B), and the
+    # flattened logits follow T*B order
+    x = nd.array(seq[:, :-1].T.copy())
+    y = nd.array(seq[:, 1:].T.reshape(-1))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    first = None
+    for _ in range(150):
+        with autograd.record():
+            out = net(x)
+            logits = out[0] if isinstance(out, tuple) else out
+            loss = loss_fn(logits.reshape(B * T, -1), y).mean()
+        loss.backward()
+        trainer.step(B)
+        if first is None:
+            first = float(loss.asnumpy())
+    final = float(loss.asnumpy())
+    assert final < first * 0.4, (first, final)
