@@ -106,6 +106,65 @@ def default_specs(n=1024):
         specs.setdefault(name, (lambda: ([arr(n, n)], {})))
     for name in binary:
         specs.setdefault(name, (lambda: ([arr(n, n), arr(n, n)], {})))
+
+    # optimizer-update family (ops/optimizer_ops.py): weight-sized
+    # tensors, pure update returns new (weight, *state)
+    P = (4096, 1024)
+    specs.update({
+        "sgd_update": (lambda: ([arr(*P), arr(*P)], {"lr": 0.1})),
+        "sgd_mom_update": (lambda: ([arr(*P), arr(*P), arr(*P)],
+                                    {"lr": 0.1, "momentum": 0.9})),
+        "nag_mom_update": (lambda: ([arr(*P), arr(*P), arr(*P)],
+                                    {"lr": 0.1, "momentum": 0.9})),
+        "adam_update": (lambda: ([arr(*P), arr(*P), arr(*P), arr(*P)],
+                                 {"lr": 0.001})),
+        "adamw_update": (lambda: ([arr(*P), arr(*P), arr(*P), arr(*P)],
+                                  {"lr": 0.001, "wd": 0.01})),
+        "ftrl_update": (lambda: ([arr(*P), arr(*P), arr(*P), arr(*P)],
+                                 {"lr": 0.1})),
+        "rmsprop_update": (lambda: ([arr(*P), arr(*P), arr(*P)],
+                                    {"lr": 0.01})),
+        "signum_update": (lambda: ([arr(*P), arr(*P), arr(*P)],
+                                   {"lr": 0.01, "momentum": 0.9})),
+        "lamb_update_phase1": (lambda: ([arr(*P), arr(*P), arr(*P),
+                                         arr(*P)], {"t": 1})),
+        "group_adagrad_update": (lambda: ([arr(*P), arr(*P),
+                                           arr(P[0])], {"lr": 0.1})),
+        "multi_all_finite": (lambda: ([arr(*P), arr(*P)],
+                                      {"num_arrays": 2})),
+        # image family
+        "image_resize": (lambda: ([arr(B, 256, 256, 3)],
+                                  {"size": (224, 224)})),
+        "image_to_tensor": (lambda: ([arr(B, 224, 224, 3)], {})),
+        "image_normalize": (lambda: ([arr(B, 3, 224, 224)],
+                                     {"mean": (0.485, 0.456, 0.406),
+                                      "std": (0.229, 0.224, 0.225)})),
+        "BilinearResize2D": (lambda: ([arr(B, C, 28, 28)],
+                                      {"height": 56, "width": 56})),
+        "box_decode": (lambda: ([arr(B, 8732, 4), arr(1, 8732, 4)], {})),
+        # linalg tail (square SPD-ish inputs for the factorizations)
+        "linalg_trmm": (lambda: ([jnp.asarray(
+            onp.tril(rng.rand(512, 512)) + onp.eye(512), f),
+            arr(512, 512)], {})),
+        "linalg_potri": (lambda: ([jnp.asarray(
+            onp.tril(rng.rand(256, 256)) + 2 * onp.eye(256), f)], {})),
+        "linalg_syevd": (lambda: ([jnp.asarray(
+            (lambda m: (m + m.T) / 2)(rng.rand(256, 256)), f)], {})),
+        "linalg_gelqf": (lambda: ([arr(256, 512)], {})),
+        "interleaved_matmul_encdec_qk": (
+            lambda: ([arr(128, B, 8 * 64), arr(128, B, 8 * 2 * 64)],
+                     {"heads": 8})),
+        "hawkesll": (lambda: ([arr(B, 8), arr(8), arr(8), arr(B, 8),
+                               arr(B, 100),
+                               jnp.asarray(rng.randint(0, 8, (B, 100)),
+                                           jnp.int32),
+                               jnp.full((B,), 100.0, f),
+                               jnp.full((B,), 60.0, f)], {})),
+        "arange": (lambda: ([], {"start": 0.0, "stop": float(n * n)})),
+        "eye": (lambda: ([], {"N": n})),
+        "histogram": (lambda: ([arr(n, n)],
+                               {"bins": 64, "range": (0.0, 1.0)})),
+    })
     return specs
 
 

@@ -350,13 +350,15 @@ def probe_stem():
                 w8[:, :, py, px, ty, tx] = w_host[:, :, ky, kx]
         return jnp.asarray(w8.reshape(o, c * 4, 4, 4), w.dtype)
 
-    def stem_s2d(x, w2):
-        xs = s2d(x)
+    def stem_s2d_pre(xs, w2):
         dn = lax.conv_dimension_numbers(xs.shape, w2.shape,
                                         ("NCHW", "OIHW", "NCHW"))
         # q-offset -2..1 relative to output pixel i -> pad (2, 1)
         return lax.conv_general_dilated(xs, w2, (1, 1), [(2, 1), (2, 1)],
                                         dimension_numbers=dn)
+
+    def stem_s2d(x, w2):
+        return stem_s2d_pre(s2d(x), w2)
 
     w2 = make_w2(w)
     diff = jax.jit(lambda a, b, c: jnp.max(jnp.abs(
@@ -372,12 +374,6 @@ def probe_stem():
     # data pipeline, so the conv is timed on (N,12,112,112) directly;
     # the conv+transform variant is also timed for the in-graph case
     xs = jax.jit(s2d)(x)
-
-    def stem_s2d_pre(xs, w2):
-        dn = lax.conv_dimension_numbers(xs.shape, w2.shape,
-                                        ("NCHW", "OIHW", "NCHW"))
-        return lax.conv_general_dilated(xs, w2, (1, 1), [(2, 1), (2, 1)],
-                                        dimension_numbers=dn)
 
     flops = 2 * 3 * 64 * 49 * 112 * 112 * bs
     for name, fn, args in (("stem 7x7/s2 plain", stem_plain, (x, w)),
