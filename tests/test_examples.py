@@ -56,3 +56,13 @@ def test_benchmark_score_smoke():
         capture_output=True, text=True, timeout=420, env=env)
     assert proc.returncode == 0, proc.stderr[-800:]
     assert "img/s" in proc.stdout and "FAILED" not in proc.stdout
+
+
+def test_train_ssd_smoke():
+    out = _run("train_ssd.py", "--smoke")
+    assert "loss" in out and "detections" in out
+
+
+def test_train_bert_smoke():
+    out = _run("train_bert.py", "--smoke", "--amp")
+    assert "loss" in out

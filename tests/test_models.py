@@ -18,6 +18,20 @@ def _tiny_bert(**kw):
     return net
 
 
+def _overfit(step_fn, steps, ratio):
+    """Run the train loop until the loss dips below first*ratio (early
+    exit) or steps run out; returns (first, final)."""
+    first = final = None
+    for _ in range(steps):
+        v = step_fn()
+        if first is None:
+            first = v
+        elif v < first * ratio:
+            final = v
+            break
+    return first, final if final is not None else v
+
+
 def test_bert_forward_shapes():
     net = _tiny_bert()
     B, T = 3, 10
@@ -60,20 +74,15 @@ def test_bert_mlm_overfits_tiny_batch():
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     x = nd.array(masked)
     y = nd.array(labels.reshape(-1))
-    first = None
-    final = None
-    for _ in range(40):
+    def step():
         with autograd.record():
             seq = net(x)[0]
             loss = loss_fn(seq.reshape(B * T, -1), y).mean()
         loss.backward()
         trainer.step(B)
-        if first is None:
-            first = float(loss.asnumpy())
-        elif final is None and float(loss.asnumpy()) < first * 0.5:
-            final = float(loss.asnumpy())  # early exit: signal reached
-            break
-    final = final if final is not None else float(loss.asnumpy())
+        return float(loss.asnumpy())
+
+    first, final = _overfit(step, 40, 0.5)
     assert final < first * 0.5, (first, final)
 
 
@@ -109,19 +118,14 @@ def test_lstm_lm_overfits():
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": 1e-2})
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
-    first = None
-    final = None
-    for _ in range(150):
+    def step():
         with autograd.record():
             out = net(x)
             logits = out[0] if isinstance(out, tuple) else out
             loss = loss_fn(logits.reshape(B * T, -1), y).mean()
         loss.backward()
         trainer.step(B)
-        if first is None:
-            first = float(loss.asnumpy())
-        elif final is None and float(loss.asnumpy()) < first * 0.4:
-            final = float(loss.asnumpy())  # early exit: signal reached
-            break
-    final = final if final is not None else float(loss.asnumpy())
+        return float(loss.asnumpy())
+
+    first, final = _overfit(step, 150, 0.4)
     assert final < first * 0.4, (first, final)
