@@ -8,7 +8,7 @@ MultiBoxTarget, NMS'd outputs from MultiBoxDetection.
 
 Usage:
     python examples/train_ssd.py --smoke          # tiny CI run
-    python examples/train_ssd.py --epochs 10 --batch-size 32
+    python examples/train_ssd.py --steps 500 --batch-size 32
 """
 import argparse
 

@@ -1,0 +1,198 @@
+/* Pure-C++ self-test of the native runtime (the role of the
+ * reference's tests/cpp gtest suite: threaded_engine_test.cc,
+ * storage_test.cc, recordio tests — SURVEY §4).  The Python suite
+ * exercises the same surfaces through ctypes; this binary proves the
+ * C++ ABI stands alone: engine ordering/exclusion/exceptions under
+ * native threads, storage pool recycling, recordio wire round-trip,
+ * and the packed-func FFI — no interpreter involved.
+ *
+ * Build + run: make -C src selftest && ./tools/bin/mxt_selftest <tmpdir>
+ */
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "include/mxt/c_api.h"
+#include "include/mxt/ffi.h"
+
+static int failures = 0;
+
+#define CHECK(cond)                                                     \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      std::fprintf(stderr, "FAIL %s:%d: %s | %s\n", __FILE__, __LINE__, \
+                   #cond, MXTGetLastError());                           \
+      ++failures;                                                       \
+    }                                                                   \
+  } while (0)
+
+struct Ctx {
+  std::atomic<int>* counter;
+  std::vector<int>* order;
+  int id;
+  bool fail;
+};
+
+static void OpFn(void* vctx, const char* upstream_err, char** err_msg) {
+  auto* c = static_cast<Ctx*>(vctx);
+  if (upstream_err) return;  // skipped due to upstream exception
+  if (c->fail) {
+    *err_msg = strdup("injected failure");
+    return;
+  }
+  if (c->order) c->order->push_back(c->id);
+  if (c->counter) c->counter->fetch_add(1);
+}
+
+static void TestEngine() {
+  EngineHandle e = nullptr;
+  CHECK(MXTEngineCreate(4, &e) == 0);
+  if (!e) return;  // environment failure: report, don't deref null
+  VarHandle v = nullptr;
+  CHECK(MXTEngineNewVar(e, &v) == 0);
+  if (!v) return;
+
+  // writers on one var are exclusive and ordered
+  std::vector<int> order;
+  std::vector<Ctx> ctxs;
+  ctxs.reserve(32);
+  for (int i = 0; i < 32; ++i) ctxs.push_back(Ctx{nullptr, &order, i, false});
+  for (int i = 0; i < 32; ++i)
+    CHECK(MXTEnginePush(e, OpFn, &ctxs[i], nullptr, 0, &v, 1, 0) == 0);
+  CHECK(MXTEngineWaitForVar(e, v) == 0);
+  CHECK(order.size() == 32);
+  for (int i = 0; i < 32; ++i) CHECK(order[(size_t)i] == i);
+
+  // concurrent readers all run (no ordering requirement)
+  std::atomic<int> reads{0};
+  std::vector<Ctx> rctxs;
+  rctxs.reserve(16);
+  for (int i = 0; i < 16; ++i)
+    rctxs.push_back(Ctx{&reads, nullptr, i, false});
+  for (int i = 0; i < 16; ++i)
+    CHECK(MXTEnginePush(e, OpFn, &rctxs[i], &v, 1, nullptr, 0, 0) == 0);
+  CHECK(MXTEngineWaitAll(e) == 0);
+  CHECK(reads.load() == 16);
+
+  // version counter bumps per write
+  uint64_t ver0 = 0, ver1 = 0;
+  CHECK(MXTEngineVarVersion(e, v, &ver0) == 0);
+  Ctx w{nullptr, nullptr, 0, false};
+  CHECK(MXTEnginePush(e, OpFn, &w, nullptr, 0, &v, 1, 0) == 0);
+  CHECK(MXTEngineWaitForVar(e, v) == 0);
+  CHECK(MXTEngineVarVersion(e, v, &ver1) == 0);
+  CHECK(ver1 == ver0 + 1);
+
+  // exceptions stick to the var, skip dependents, rethrow at wait
+  VarHandle bad = nullptr;
+  CHECK(MXTEngineNewVar(e, &bad) == 0);
+  if (!bad) return;
+  Ctx boom{nullptr, nullptr, 0, true};
+  std::atomic<int> after{0};
+  Ctx dep{&after, nullptr, 0, false};
+  CHECK(MXTEnginePush(e, OpFn, &boom, nullptr, 0, &bad, 1, 0) == 0);
+  CHECK(MXTEnginePush(e, OpFn, &dep, &bad, 1, nullptr, 0, 0) == 0);
+  CHECK(MXTEngineWaitForVar(e, bad) != 0);  // error surfaces
+  CHECK(std::strstr(MXTGetLastError(), "injected failure") != nullptr);
+  CHECK(after.load() == 0);  // dependent did not run user work
+
+  CHECK(MXTEngineDeleteVar(e, v) == 0);
+  CHECK(MXTEngineDeleteVar(e, bad) == 0);
+  CHECK(MXTEngineFree(e) == 0);
+  std::puts("engine ok");
+}
+
+static void TestStorage() {
+  uint64_t alloc0 = 0, pooled0 = 0;
+  CHECK(MXTStorageStats(&alloc0, &pooled0) == 0);
+  void* p1 = nullptr;
+  CHECK(MXTStorageAlloc(1 << 20, &p1) == 0 && p1 != nullptr);
+  std::memset(p1, 0xAB, 1 << 20);
+  CHECK(MXTStorageFree(p1, 1 << 20) == 0);
+  void* p2 = nullptr;
+  CHECK(MXTStorageAlloc(1 << 20, &p2) == 0);
+  CHECK(p2 == p1);  // size-bucketed pool recycles the block
+  CHECK(MXTStorageFree(p2, 1 << 20) == 0);
+  CHECK(MXTStorageReleaseAll() == 0);
+  std::puts("storage ok");
+}
+
+static void TestRecordIO(const std::string& dir) {
+  std::string uri = dir + "/selftest.rec";
+  RecordIOHandle w = nullptr;
+  CHECK(MXTRecordIOWriterCreate(uri.c_str(), &w) == 0);
+  if (!w) return;  // unwritable dir: keep the failure report alive
+  const char* recs[3] = {"alpha", "bravo-bravo", ""};
+  for (int i = 0; i < 3; ++i)
+    CHECK(MXTRecordIOWriterWrite(w, recs[i], std::strlen(recs[i])) == 0);
+  CHECK(MXTRecordIOWriterFree(w) == 0);
+
+  RecordIOHandle r = nullptr;
+  CHECK(MXTRecordIOReaderCreate(uri.c_str(), &r) == 0);
+  if (!r) return;
+  for (int i = 0; i < 3; ++i) {
+    const char* buf = nullptr;
+    uint64_t size = 0;
+    CHECK(MXTRecordIOReaderNext(r, &buf, &size) == 0);
+    CHECK(size == std::strlen(recs[i]));
+    CHECK(size == 0 || std::memcmp(buf, recs[i], size) == 0);
+  }
+  const char* buf = nullptr;
+  uint64_t size = 1;
+  CHECK(MXTRecordIOReaderNext(r, &buf, &size) == 0);
+  CHECK(buf == nullptr && size == 0);  // EOF contract
+  CHECK(MXTRecordIOReaderFree(r) == 0);
+  std::puts("recordio ok");
+}
+
+static int Doubler(const MXTValue* args, const int* tcodes, int n,
+                   MXTValue* ret, int* ret_tcode, void*, char** err) {
+  if (n != 1 || tcodes[0] != kMXTInt) {
+    *err = strdup("doubler wants one int");
+    return -1;
+  }
+  ret->v_int = 2 * args[0].v_int;
+  *ret_tcode = kMXTInt;
+  return 0;
+}
+
+static void TestFFI() {
+  CHECK(MXTFuncRegister("selftest.double", Doubler, nullptr, 0) == 0);
+  MXTValue arg;
+  arg.v_int = 21;
+  int tcode = kMXTInt;
+  MXTValue ret;
+  int ret_tcode = kMXTNull;
+  CHECK(MXTFuncCallByName("selftest.double", &arg, &tcode, 1, &ret,
+                          &ret_tcode) == 0);
+  CHECK(ret_tcode == kMXTInt && ret.v_int == 42);
+  // built-ins visible from C++ too
+  MXTValue r2;
+  int t2 = kMXTNull;
+  CHECK(MXTFuncCallByName("mxt.runtime.version", nullptr, nullptr, 0, &r2,
+                          &t2) == 0);
+  CHECK(t2 == kMXTInt && r2.v_int >= 20000);
+  // errors carry messages
+  CHECK(MXTFuncCallByName("selftest.double", nullptr, nullptr, 0, &ret,
+                          &ret_tcode) != 0);
+  CHECK(std::strstr(MXTGetLastError(), "doubler wants one int") != nullptr);
+  std::puts("ffi ok");
+}
+
+int main(int argc, char** argv) {
+  std::string dir = argc > 1 ? argv[1] : "/tmp";
+  TestEngine();
+  TestStorage();
+  TestRecordIO(dir);
+  TestFFI();
+  if (failures) {
+    std::fprintf(stderr, "%d failures\n", failures);
+    return 1;
+  }
+  std::puts("native selftest ok");
+  return 0;
+}

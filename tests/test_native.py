@@ -483,3 +483,21 @@ def test_engine_fork_safety():
     p.start()
     p.join(60)
     assert q.get(timeout=10) == 42
+
+
+def test_cpp_selftest_binary(tmp_path):
+    """Pure-C++ runtime self-test (reference tests/cpp role): engine
+    ordering/exclusion/exceptions under native threads, storage pool
+    recycling, recordio wire, packed-func FFI — no interpreter in the
+    loop."""
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bin_path = os.path.join(repo, "tools", "bin", "mxt_selftest")
+    proc = subprocess.run(["make", "-C", os.path.join(repo, "src"),
+                           "selftest"], capture_output=True, text=True)
+    if proc.returncode != 0 or not os.path.exists(bin_path):
+        pytest.skip(f"selftest build unavailable: {proc.stderr[-300:]}")
+    run = subprocess.run([bin_path, str(tmp_path)], capture_output=True,
+                         text=True, timeout=120)
+    assert run.returncode == 0, (run.stdout, run.stderr[-500:])
+    assert "native selftest ok" in run.stdout
