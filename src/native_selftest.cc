@@ -107,17 +107,24 @@ static void TestEngine() {
 }
 
 static void TestStorage() {
+  CHECK(MXTStorageReleaseAll() == 0);  // known-empty starting point
   uint64_t alloc0 = 0, pooled0 = 0;
   CHECK(MXTStorageStats(&alloc0, &pooled0) == 0);
   void* p1 = nullptr;
   CHECK(MXTStorageAlloc(1 << 20, &p1) == 0 && p1 != nullptr);
   std::memset(p1, 0xAB, 1 << 20);
   CHECK(MXTStorageFree(p1, 1 << 20) == 0);
+  uint64_t alloc1 = 0, pooled1 = 0;
+  CHECK(MXTStorageStats(&alloc1, &pooled1) == 0);
+  CHECK(pooled1 >= pooled0 + (1 << 20));  // freed block parked in pool
   void* p2 = nullptr;
   CHECK(MXTStorageAlloc(1 << 20, &p2) == 0);
   CHECK(p2 == p1);  // size-bucketed pool recycles the block
   CHECK(MXTStorageFree(p2, 1 << 20) == 0);
   CHECK(MXTStorageReleaseAll() == 0);
+  uint64_t alloc2 = 0, pooled2 = 0;
+  CHECK(MXTStorageStats(&alloc2, &pooled2) == 0);
+  CHECK(pooled2 == 0);  // release drains the pool
   std::puts("storage ok");
 }
 
