@@ -8,7 +8,8 @@ from .block import HybridBlock
 __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "KLDivLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
-           "LogisticLoss", "TripletLoss", "CosineEmbeddingLoss", "CTCLoss"]
+           "LogisticLoss", "TripletLoss", "CosineEmbeddingLoss", "CTCLoss",
+           "PoissonNLLLoss", "SDMLLoss"]
 
 
 def _apply_weighting(loss, weight=None, sample_weight=None):
@@ -242,3 +243,61 @@ class CTCLoss(Loss):
                                     ctx=pred.ctx)
         loss = invoke("ctc_loss", pred, label, pred_lengths, label_lengths)
         return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    """Poisson negative log likelihood (reference loss.py:800):
+    from_logits → exp(pred) - target*pred; else pred - target*log(pred+eps);
+    compute_full adds the Stirling approximation for target > 1."""
+
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight=weight, batch_axis=batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def forward(self, pred, target, sample_weight=None, epsilon=1e-08):
+        import math as _math
+        target = _reshape_like(pred, target)
+        if self._from_logits:
+            loss = invoke("exp", pred) - target * pred
+        else:
+            loss = pred - target * invoke("log", pred + epsilon)
+        if self._compute_full:
+            # guard the masked-out region: 0*log(0) would NaN the whole
+            # mean even though the mask zeroes it (the reference formula
+            # has this hazard; evaluate Stirling on clamped targets)
+            safe_t = invoke("maximum", target, invoke("ones_like", target))
+            stirling = (safe_t * invoke("log", safe_t) - safe_t
+                        + 0.5 * invoke("log", 2 * _math.pi * safe_t))
+            loss = loss + stirling * (target > 1)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return invoke("mean", loss)
+
+
+class SDMLLoss(Loss):
+    """Batchwise Smoothed Deep Metric Learning loss (reference
+    loss.py:935): aligned batches x1/x2, softmax over negative pairwise
+    euclidean distances against a label-smoothed identity target via KL
+    divergence (Pereyra et al., arXiv:1701.06548)."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight=weight, batch_axis=batch_axis, **kwargs)
+        self.kl_loss = KLDivLoss(from_logits=True)
+        self.smoothing_parameter = smoothing_parameter
+
+    def forward(self, x1, x2):
+        batch_size, dim = x1.shape
+        # distances/labels via recorded ops so gradients flow
+        x1e = invoke("broadcast_to", invoke("expand_dims", x1, axis=1),
+                     shape=(batch_size, batch_size, dim))
+        x2e = invoke("broadcast_to", invoke("expand_dims", x2, axis=0),
+                     shape=(batch_size, batch_size, dim))
+        distances = invoke("sum", invoke("square", x1e - x2e), axis=2)
+        gold = invoke("eye", N=batch_size)
+        labels = (gold * (1 - self.smoothing_parameter)
+                  + (1 - gold) * self.smoothing_parameter
+                  / (batch_size - 1))
+        log_probs = invoke("log_softmax", -distances, axis=1)
+        return self.kl_loss(log_probs, labels) * batch_size

@@ -417,3 +417,40 @@ def test_contrib_nn_layers():
     emb.initialize()
     idx = nd.array(onp.array([1, 3], onp.int32))
     assert emb(idx).shape == (2, 4)
+
+
+def test_poisson_nll_and_sdml_losses():
+    """PoissonNLLLoss + SDMLLoss (reference loss.py:800,935) +
+    FilterSampler (data/sampler.py)."""
+    import numpy as onp
+    from incubator_mxnet_tpu import autograd
+    rng = onp.random.RandomState(0)
+    # Poisson: from_logits formula exp(pred) - target*pred
+    pred = nd.array(rng.randn(4, 3).astype("f"))
+    target = nd.array(rng.poisson(2.0, (4, 3)).astype("f"))
+    loss = gloss.PoissonNLLLoss(from_logits=True)(pred, target)
+    expect = (onp.exp(pred.asnumpy()) - target.asnumpy() * pred.asnumpy()).mean()
+    onp.testing.assert_allclose(float(loss.asnumpy()), expect, rtol=1e-5)
+    # non-logits + compute_full adds Stirling only for target > 1
+    loss2 = gloss.PoissonNLLLoss(from_logits=False, compute_full=True)(
+        nd.abs(pred) + 0.5, target)
+    assert onp.isfinite(float(loss2.asnumpy()))
+    # SDML: aligned batches -> the loss decreases as x2 approaches x1
+    x1 = nd.array(rng.rand(4, 8).astype("f"))
+    far = nd.array(rng.rand(4, 8).astype("f"))
+    sdml = gloss.SDMLLoss(smoothing_parameter=0.1)
+    l_far = float(sdml(x1, far).mean().asnumpy())
+    l_near = float(sdml(x1, x1 * 1.02).mean().asnumpy())
+    assert l_near < l_far
+    # and is differentiable
+    x2 = nd.array(rng.rand(4, 8).astype("f"))
+    x2.attach_grad()
+    with autograd.record():
+        out = sdml(x1, x2).mean()
+    out.backward()
+    assert float(nd.sum(nd.abs(x2.grad)).asnumpy()) > 0
+    # FilterSampler keeps matching indices only
+    from incubator_mxnet_tpu.gluon.data import FilterSampler, ArrayDataset
+    ds = ArrayDataset(nd.array(onp.arange(10).astype("f")))
+    samp = FilterSampler(lambda v: float(v.asnumpy()) % 2 == 0, ds)
+    assert list(samp) == [0, 2, 4, 6, 8] and len(samp) == 5

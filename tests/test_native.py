@@ -497,8 +497,11 @@ def test_cpp_selftest_binary(tmp_path):
         proc = subprocess.run(["make", "-C", os.path.join(repo, "src"),
                                "selftest"], capture_output=True, text=True,
                               timeout=300)
-    except (OSError, subprocess.SubprocessError):
+    except FileNotFoundError:
         pytest.skip("no native toolchain (make) available")
+    except subprocess.TimeoutExpired:
+        raise AssertionError("native selftest build hung (>300s) with a "
+                             "working toolchain")
     if proc.returncode != 0:
         # toolchain present: a compile error in checked-in sources is a
         # FAILURE, not a skip (it would otherwise ship silently)
