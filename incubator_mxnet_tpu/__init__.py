@@ -23,7 +23,7 @@ Typical use::
     y.backward()
 """
 
-__version__ = "0.1.0"
+from .libinfo import __version__  # single source of truth
 
 
 def _join_distributed_from_env():
@@ -78,6 +78,9 @@ _install_fork_handlers()
 
 from . import base
 from .base import MXNetError
+from . import error
+from . import libinfo
+from . import log
 from .context import (Context, cpu, gpu, tpu, current_context, num_gpus,
                       num_tpus, gpu_memory_info, tpu_memory_info,
                       memory_summary)

@@ -1,0 +1,61 @@
+"""Exception hierarchy (reference python/mxnet/error.py).
+
+MXNetError is the base carried across the C ABI (rc -1 +
+MXTGetLastError).  Subclasses dual-inherit the matching python builtin
+(reference error.py does the same) so both ``except mx.error.ValueError``
+and plain ``except ValueError`` catch them.  The native ``check_call``
+and FFI error paths dispatch messages prefixed "Kind: ..." onto the
+registered class via :func:`get_error_class`.
+"""
+import builtins as _bi
+
+from .base import MXNetError
+
+__all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
+           "TypeError", "AttributeError", "NotImplementedError",
+           "register_error", "get_error_class"]
+
+_ERROR_REGISTRY = {}
+
+
+def register_error(cls=None, name=None):
+    """Register an error class by name (reference error.py:register)."""
+    def deco(c):
+        _ERROR_REGISTRY[name or c.__name__] = c
+        return c
+    return deco(cls) if cls is not None else deco
+
+
+def get_error_class(kind, default=MXNetError):
+    """Resolve a registered error kind ("ValueError", ...) to its class."""
+    return _ERROR_REGISTRY.get(kind, default)
+
+
+@register_error
+class InternalError(MXNetError):
+    """An internal invariant was violated."""
+
+
+@register_error
+class IndexError(MXNetError, _bi.IndexError):
+    """Index out of range (also catchable as builtin IndexError)."""
+
+
+@register_error
+class ValueError(MXNetError, _bi.ValueError):
+    """Invalid argument value (also catchable as builtin ValueError)."""
+
+
+@register_error
+class TypeError(MXNetError, _bi.TypeError):
+    """Invalid argument type (also catchable as builtin TypeError)."""
+
+
+@register_error
+class AttributeError(MXNetError, _bi.AttributeError):
+    """Attribute not found (also catchable as builtin AttributeError)."""
+
+
+@register_error
+class NotImplementedError(MXNetError, _bi.NotImplementedError):
+    """Feature not implemented."""

@@ -1,0 +1,35 @@
+"""Library/build information (reference python/mxnet/libinfo.py).
+
+The reference locates libmxnet.so for ctypes; here the native runtime
+is libmxtpu.so (+ the optional libmxtapi.so C API), built from src/.
+"""
+from __future__ import annotations
+
+import os
+
+__version__ = "0.1.0"
+
+
+def find_lib_path():
+    """Paths of the native runtime libraries that exist on disk
+    (reference libinfo.py:25).  Canonical location comes from the
+    native loader (one source of truth)."""
+    from . import native as _native
+    runtime = _native._LIB_PATH
+    candidates = [runtime,
+                  os.path.join(os.path.dirname(runtime), "libmxtapi.so")]
+    found = [p for p in candidates if os.path.exists(p)]
+    if not found:
+        raise RuntimeError(
+            "native runtime library not found; build it with `make -C src` "
+            f"(searched {candidates})")
+    return found
+
+
+def find_include_path():
+    """Path of the C ABI headers (reference libinfo.py find_include_path)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    inc = os.path.join(repo, "src", "include")
+    if not os.path.isdir(inc):
+        raise RuntimeError(f"include path not found at {inc}")
+    return inc

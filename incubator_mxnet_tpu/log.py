@@ -1,0 +1,36 @@
+"""Logging helpers (reference python/mxnet/log.py): a `get_logger`
+with the reference's level/format conventions."""
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger", "getLogger"]
+
+_FORMAT = "%(asctime)s [%(levelname)s] %(name)s %(message)s"
+
+
+def get_logger(name=None, filename=None, filemode=None, level=None):
+    """Create/fetch a logger configured the reference way (log.py:43):
+    optional file sink, WARNING default level, shared format."""
+    logger = logging.getLogger(name)
+    if name is None:
+        # reference log.py only configures NAMED loggers; mutating the
+        # root logger would hijack the host application's logging setup
+        return logger
+    if getattr(logger, "_mxt_configured", False):
+        if level is not None:
+            logger.setLevel(level)
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    logger.addHandler(handler)
+    logger.setLevel(level if level is not None else logging.WARNING)
+    logger._mxt_configured = True
+    return logger
+
+
+getLogger = get_logger

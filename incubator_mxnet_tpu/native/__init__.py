@@ -155,7 +155,15 @@ def available() -> bool:
 
 
 def check_call(rc: int) -> None:
-    """Raise the native error as a Python exception (c_api_error analog)."""
+    """Raise the native error as a Python exception (c_api_error analog).
+
+    Messages prefixed "Kind: ..." map onto the registered error class
+    (error.py registry), so ``except mx.error.ValueError`` works on
+    native failures; everything else raises the MXNetError base.
+    """
     if rc != 0:
+        from ..error import get_error_class, MXNetError
         msg = lib.MXTGetLastError().decode("utf-8", "replace")
-        raise RuntimeError(f"native runtime error: {msg}")
+        kind, sep, _rest = msg.partition(": ")
+        cls = get_error_class(kind) if sep else MXNetError
+        raise cls(f"native runtime error: {msg}")

@@ -132,3 +132,43 @@ def test_initializer_load_and_fused_rnn(tmp_path):
     d = initializer.InitDesc("conv_weight", attrs={"lr_mult": "2"},
                              global_init=initializer.Zero())
     assert d == "conv_weight" and d.attrs["lr_mult"] == "2"
+
+
+def test_libinfo_error_log_modules():
+    """Top-level module tail: libinfo/error/log (reference
+    python/mxnet/{libinfo,error,log}.py)."""
+    import logging
+    import incubator_mxnet_tpu as mx
+    libs = mx.libinfo.find_lib_path()
+    assert libs and all(p.endswith(".so") for p in libs)
+    inc = mx.libinfo.find_include_path()
+    import os
+    assert os.path.exists(os.path.join(inc, "mxt", "c_api.h"))
+    # error hierarchy roots at MXNetError
+    assert issubclass(mx.error.InternalError, mx.MXNetError)
+    try:
+        raise mx.error.ValueError("bad value")
+    except mx.MXNetError as e:
+        assert "bad value" in str(e)
+    # log helper configures once, honors level updates, leaves root alone
+    lg = mx.log.get_logger("mxt-test", level=logging.INFO)
+    assert lg.level == logging.INFO
+    lg2 = mx.log.get_logger("mxt-test")
+    assert lg2 is lg and len(lg.handlers) == 1
+    root_handlers = list(logging.getLogger().handlers)
+    mx.log.get_logger()  # name=None must NOT mutate the root logger
+    assert logging.getLogger().handlers == root_handlers
+    # one version source of truth
+    assert mx.__version__ == mx.libinfo.__version__
+    # error classes dual-inherit builtins and native errors dispatch
+    try:
+        raise mx.error.TypeError("t")
+    except TypeError:
+        pass
+    import ctypes
+    from incubator_mxnet_tpu.native import lib, check_call
+    rc = lib.MXTRecordIOReaderCreate(b"/definitely/missing.rec",
+                                     ctypes.byref(ctypes.c_void_p()))
+    import pytest as _pytest
+    with _pytest.raises(mx.MXNetError):
+        check_call(rc)
