@@ -122,3 +122,28 @@ def test_quantize_net_accuracy(mode):
     rel = onp.abs(q_out - fp32_out).mean() / (onp.abs(fp32_out).mean() + 1e-9)
     assert q_acc >= fp32_acc - 0.05
     assert rel < 0.15, rel
+
+
+def test_int8_dot_reaches_xla():
+    """The quantized dense path must keep int8 operands into the
+    dot_general (int8xint8->int32 on hardware), not silently upcast
+    before the contraction — asserted on the traced jaxpr."""
+    import jax
+
+    def run(xq, wq, xmin, xmax, wmin, wmax):
+        acc, omin, omax = qops.quantized_dense.fn(xq, wq, None, xmin, xmax,
+                                                  wmin, wmax)
+        return acc
+
+    rng = onp.random.RandomState(0)
+    xq = jnp.asarray(rng.randint(-127, 127, (4, 16)), jnp.int8)
+    wq = jnp.asarray(rng.randint(-127, 127, (8, 16)), jnp.int8)
+    scal = lambda v: jnp.asarray(v, jnp.float32)  # noqa: E731
+    jaxpr = jax.make_jaxpr(run)(xq, wq, scal(-1), scal(1), scal(-1),
+                                scal(1))
+    dots = [e for e in jaxpr.jaxpr.eqns if e.primitive.name == "dot_general"]
+    assert dots, "quantized_dense lowered without any dot_general"
+    for eq in dots:
+        in_dtypes = [v.aval.dtype for v in eq.invars]
+        assert all(str(d) == "int8" for d in in_dtypes), in_dtypes
+        assert str(eq.outvars[0].aval.dtype) == "int32"
