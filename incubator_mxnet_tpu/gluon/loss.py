@@ -101,6 +101,14 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._from_logits = from_logits
 
     def forward(self, pred, label, sample_weight=None):
+        if (self._sparse_label and not self._from_logits and pred.ndim == 2
+                and self._axis in (-1, 1)):
+            # fused path: one Pallas pass, softmax never materialized
+            # (ops/nn_ops.py softmax_xent; XLA fallback built in)
+            loss = invoke("softmax_xent", pred, label)
+            loss = invoke("reshape", loss, shape=(-1, 1))
+            loss = _apply_weighting(loss, self._weight, sample_weight)
+            return self._mean(loss)
         if not self._from_logits:
             pred = invoke("log_softmax", pred, axis=self._axis)
         if self._sparse_label:

@@ -684,3 +684,23 @@ def sync_batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-3,
                          momentum=momentum, fix_gamma=fix_gamma,
                          use_global_stats=use_global_stats,
                          output_mean_var=output_mean_var, training=training)
+
+
+@register("softmax_xent", num_inputs=2)
+def softmax_xent(logits, labels):
+    """Fused softmax cross-entropy over the trailing axis: per-row
+    logsumexp(logits) - logits[label] in one Pallas pass on TPU, the
+    XLA formulation elsewhere (gated here like the other pallas-backed
+    ops; the kernel itself always runs in tests via interpret mode).
+    The softmax probabilities never hit HBM — the memory bottleneck of
+    big-vocab LM training (reference loss_binary_op.cc recast
+    blockwise).  Output dtype follows logits like the log_softmax+pick
+    formulation."""
+    from . import pallas_kernels as pk
+    lbl = labels.astype(jnp.int32)
+    if pk.use_pallas():
+        out = pk.fused_softmax_xent(logits, lbl)
+    else:
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        out = -jnp.take_along_axis(lp, lbl[:, None], axis=-1)[:, 0]
+    return out.astype(logits.dtype)

@@ -30,7 +30,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["fused_softmax", "fused_layer_norm", "flash_attention",
-           "use_pallas", "interpret_mode"]
+           "use_pallas", "interpret_mode", "fused_softmax_xent"]
 
 _NEG_INF = -1e30
 
@@ -465,3 +465,112 @@ def flash_attention(q, k, v, sm_scale=None, causal=False):
     """
     scale = float(sm_scale) if sm_scale is not None else q.shape[-1] ** -0.5
     return _flash_core(q, k, v, scale, bool(causal))
+
+
+# ======================================================================
+# fused softmax cross-entropy (big-vocab LM loss)
+# ======================================================================
+
+def _xent_fwd_kernel(x_ref, lbl_ref, loss_ref, *, n_cols):
+    x = x_ref[...].astype(jnp.float32)
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    valid = col < n_cols
+    x = jnp.where(valid, x, _NEG_INF)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    lse = jnp.log(jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True)) + m
+    lbl = lbl_ref[...].astype(jnp.int32)  # (block_r, 1)
+    picked = jnp.sum(jnp.where(col == lbl, x, 0.0), axis=-1, keepdims=True)
+    loss_ref[...] = (lse - picked).astype(loss_ref.dtype)
+
+
+def _xent_bwd_kernel(x_ref, lbl_ref, g_ref, dx_ref, *, n_cols):
+    x = x_ref[...].astype(jnp.float32)
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    valid = col < n_cols
+    x = jnp.where(valid, x, _NEG_INF)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    p = e / jnp.sum(e, axis=-1, keepdims=True)
+    lbl = lbl_ref[...].astype(jnp.int32)
+    onehot = (col == lbl).astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)  # (block_r, 1)
+    dx = (p - onehot) * g
+    dx_ref[...] = jnp.where(valid, dx, 0.0).astype(dx_ref.dtype)
+
+
+def _xent_call(kernel, out_shape, x2d, lbl2d, *extra):
+    rows_p, cols_p = x2d.shape
+    block_r = _rowwise_block(rows_p, cols_p, 3)
+    xspec = pl.BlockSpec((block_r, cols_p), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    sspec = pl.BlockSpec((block_r, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    out_spec = sspec if out_shape[1] == 1 else xspec
+    in_specs = [xspec, sspec] + [sspec] * len(extra)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(out_shape, jnp.float32),
+        grid=(pl.cdiv(rows_p, block_r),),
+        in_specs=in_specs,
+        out_specs=out_spec,
+        interpret=interpret_mode(),
+    )(x2d, lbl2d, *extra)
+
+
+@jax.custom_vjp
+def fused_softmax_xent(logits, labels):
+    """Per-row cross-entropy loss = logsumexp(logits) - logits[label],
+    one Pallas pass — the softmax probabilities are never materialized
+    in HBM, which is the memory bottleneck of big-vocab LM training
+    (reference softmax_cross_entropy, src/operator/loss_binary_op.cc,
+    recast blockwise).
+
+    logits (N, C), labels int (N,) → loss (N,) float32.
+    """
+    loss, _ = _xent_fwd(logits, labels)
+    return loss
+
+
+def _xent_fwd(logits, labels):
+    n, c = logits.shape
+    if c > _MAX_COLS:
+        lse = jax.scipy.special.logsumexp(
+            logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(
+            logits.astype(jnp.float32), labels[:, None].astype(jnp.int32),
+            axis=-1)[:, 0]
+        return lse - picked, (logits, labels)
+    x2d, rows, cols = _pad_rows_cols(logits, 8, 128)
+    lbl2d, _, _ = _pad_rows_cols(labels.reshape(-1, 1).astype(jnp.int32),
+                                 8, 1)
+    loss = _xent_call(
+        functools.partial(_xent_fwd_kernel, n_cols=cols),
+        (x2d.shape[0], 1), x2d, lbl2d)
+    return loss[:rows, 0], (logits, labels)
+
+
+def _xent_vjp_fwd(logits, labels):
+    return _xent_fwd(logits, labels)
+
+
+def _xent_vjp_bwd(res, g):
+    logits, labels = res
+    n, c = logits.shape
+    if c > _MAX_COLS:
+        p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        onehot = jax.nn.one_hot(labels.astype(jnp.int32), c,
+                                dtype=jnp.float32)
+        dx = (p - onehot) * g[:, None].astype(jnp.float32)
+        return dx.astype(logits.dtype), None
+    x2d, rows, cols = _pad_rows_cols(logits, 8, 128)
+    lbl2d, _, _ = _pad_rows_cols(labels.reshape(-1, 1).astype(jnp.int32),
+                                 8, 1)
+    g2d, _, _ = _pad_rows_cols(
+        g.reshape(-1, 1).astype(jnp.float32), 8, 1)
+    dx = _xent_call(
+        functools.partial(_xent_bwd_kernel, n_cols=cols),
+        x2d.shape, x2d, lbl2d, g2d)
+    return dx[:rows, :cols].astype(logits.dtype), None
+
+
+fused_softmax_xent.defvjp(_xent_vjp_fwd, _xent_vjp_bwd)

@@ -185,3 +185,53 @@ def test_transformer_flash_attention_matches_gspmd():
     out_f = m_f.apply(params, tokens)
     onp.testing.assert_allclose(onp.asarray(out_g), onp.asarray(out_f),
                                 rtol=1e-4, atol=1e-4)
+
+
+def test_fused_softmax_xent_matches_reference():
+    """fused_softmax_xent == -log_softmax[label] fwd+bwd, incl. padded
+    widths, and the SoftmaxCrossEntropyLoss fast path stays equal to
+    the log_softmax+pick formulation."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops.pallas_kernels import fused_softmax_xent
+    rng = onp.random.RandomState(0)
+    for n, c in ((4, 7), (10, 300), (16, 1024)):
+        x = jnp.asarray(rng.randn(n, c), jnp.float32)
+        lbl = jnp.asarray(rng.randint(0, c, (n,)), jnp.int32)
+        loss = fused_softmax_xent(x, lbl)
+        ref = -jax.nn.log_softmax(x)[jnp.arange(n), lbl]
+        onp.testing.assert_allclose(onp.asarray(loss), onp.asarray(ref),
+                                    rtol=1e-5, atol=1e-6)
+        g = jax.grad(lambda x: fused_softmax_xent(x, lbl).sum())(x)
+        gref = jax.grad(
+            lambda x: (-jax.nn.log_softmax(x)[jnp.arange(n), lbl]).sum())(x)
+        onp.testing.assert_allclose(onp.asarray(g), onp.asarray(gref),
+                                    rtol=1e-4, atol=1e-6)
+
+
+def test_softmax_ce_loss_fast_path_parity():
+    from incubator_mxnet_tpu import nd, autograd, gluon
+    rng = onp.random.RandomState(1)
+    pred = nd.array(rng.randn(6, 50).astype("f"))
+    label = nd.array(rng.randint(0, 50, (6,)).astype("f"))
+    fast = gluon.loss.SoftmaxCrossEntropyLoss()
+    slow = gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+    # 3-D input exercises the generic path; 2-D the fused path
+    out_fast = fast(pred, label)
+    pred3 = nd.array(rng.randn(2, 3, 50).astype("f"))
+    label3 = nd.array(rng.randint(0, 50, (2, 3)).astype("f"))
+    out_gen = slow(pred3, label3)
+    assert out_gen.shape == (2,)
+    # fused == generic on the same 2-D input
+    import jax.numpy as jnp
+    ref = -jnp.take_along_axis(
+        jax.nn.log_softmax(pred.data), label.data.astype(jnp.int32)[:, None],
+        axis=1)[:, 0]
+    onp.testing.assert_allclose(out_fast.asnumpy(), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-6)
+    # gradient flows through the fused path
+    pred.attach_grad()
+    with autograd.record():
+        loss = fast(pred, label).mean()
+    loss.backward()
+    assert float(nd.sum(nd.abs(pred.grad)).asnumpy()) > 0
