@@ -263,7 +263,12 @@ def l2_normalization(x, eps=1e-10, mode="instance"):
 
 @register("RMSNorm", aliases=("rms_norm",))
 def rms_norm(x, gamma, axis=-1, eps=1e-6):
-    """TPU-era addition (not in the reference): used by the transformer stack."""
+    """TPU-era addition (not in the reference): used by the transformer
+    stack.  Trailing-axis case runs the fused Pallas kernel on TPU
+    (pallas_kernels.fused_rms_norm), like LayerNorm/softmax."""
+    from . import pallas_kernels as pk
+    if axis in (-1, x.ndim - 1) and pk.use_pallas():
+        return pk.fused_rms_norm(x, gamma, eps)
     ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axis, keepdims=True)
     y = (x.astype(jnp.float32) * lax.rsqrt(ms + eps)).astype(x.dtype)
     return y * gamma

@@ -30,7 +30,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["fused_softmax", "fused_layer_norm", "flash_attention",
-           "use_pallas", "interpret_mode", "fused_softmax_xent"]
+           "use_pallas", "interpret_mode", "fused_softmax_xent",
+           "fused_rms_norm"]
 
 _NEG_INF = -1e30
 
@@ -478,7 +479,8 @@ def _xent_fwd_kernel(x_ref, lbl_ref, loss_ref, *, n_cols):
     x = jnp.where(valid, x, _NEG_INF)
     m = jnp.max(x, axis=-1, keepdims=True)
     lse = jnp.log(jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True)) + m
-    lbl = lbl_ref[...].astype(jnp.int32)  # (block_r, 1)
+    # clip-mode label semantics (generic path uses pick(mode="clip"))
+    lbl = jnp.clip(lbl_ref[...].astype(jnp.int32), 0, n_cols - 1)
     picked = jnp.sum(jnp.where(col == lbl, x, 0.0), axis=-1, keepdims=True)
     loss_ref[...] = (lse - picked).astype(loss_ref.dtype)
 
@@ -491,7 +493,7 @@ def _xent_bwd_kernel(x_ref, lbl_ref, g_ref, dx_ref, *, n_cols):
     m = jnp.max(x, axis=-1, keepdims=True)
     e = jnp.exp(x - m)
     p = e / jnp.sum(e, axis=-1, keepdims=True)
-    lbl = lbl_ref[...].astype(jnp.int32)
+    lbl = jnp.clip(lbl_ref[...].astype(jnp.int32), 0, n_cols - 1)
     onehot = (col == lbl).astype(jnp.float32)
     g = g_ref[...].astype(jnp.float32)  # (block_r, 1)
     dx = (p - onehot) * g
@@ -536,9 +538,9 @@ def _xent_fwd(logits, labels):
     if c > _MAX_COLS:
         lse = jax.scipy.special.logsumexp(
             logits.astype(jnp.float32), axis=-1)
+        lbl = jnp.clip(labels.astype(jnp.int32), 0, c - 1)
         picked = jnp.take_along_axis(
-            logits.astype(jnp.float32), labels[:, None].astype(jnp.int32),
-            axis=-1)[:, 0]
+            logits.astype(jnp.float32), lbl[:, None], axis=-1)[:, 0]
         return lse - picked, (logits, labels)
     x2d, rows, cols = _pad_rows_cols(logits, 8, 128)
     lbl2d, _, _ = _pad_rows_cols(labels.reshape(-1, 1).astype(jnp.int32),
@@ -558,8 +560,9 @@ def _xent_vjp_bwd(res, g):
     n, c = logits.shape
     if c > _MAX_COLS:
         p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        onehot = jax.nn.one_hot(labels.astype(jnp.int32), c,
-                                dtype=jnp.float32)
+        onehot = jax.nn.one_hot(
+            jnp.clip(labels.astype(jnp.int32), 0, c - 1), c,
+            dtype=jnp.float32)
         dx = (p - onehot) * g[:, None].astype(jnp.float32)
         return dx.astype(logits.dtype), None
     x2d, rows, cols = _pad_rows_cols(logits, 8, 128)
@@ -574,3 +577,123 @@ def _xent_vjp_bwd(res, g):
 
 
 fused_softmax_xent.defvjp(_xent_vjp_fwd, _xent_vjp_bwd)
+
+
+# ======================================================================
+# fused RMSNorm (transformer stack's norm; no reference counterpart —
+# TPU-era addition like the RMSNorm op itself)
+# ======================================================================
+
+def _rms_fwd_kernel(x_ref, gamma_ref, o_ref, rrms_ref, *, n_cols, eps):
+    x = x_ref[:].astype(jnp.float32)
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    valid = col < n_cols
+    xv = jnp.where(valid, x, 0.0)
+    ms = jnp.sum(xv * xv, axis=-1, keepdims=True) / n_cols
+    rrms = jax.lax.rsqrt(ms + eps)
+    g = gamma_ref[:].astype(jnp.float32)
+    o_ref[:] = (xv * rrms * g).astype(o_ref.dtype)
+    rrms_ref[:] = rrms.astype(jnp.float32)
+
+
+def _rms_bwd_kernel(x_ref, g_ref, gamma_ref, rrms_ref, dx_ref, dgamma_ref,
+                    *, n_cols):
+    x = x_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    gamma = gamma_ref[:].astype(jnp.float32)
+    rrms = rrms_ref[:]
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    valid = col < n_cols
+    xv = jnp.where(valid, x, 0.0)
+    gv = jnp.where(valid, g, 0.0)
+    ggam = gv * gamma
+    # dx = rrms*(gγ − x·(rrms²/n)·sum(gγ·x))
+    s = jnp.sum(ggam * xv, axis=-1, keepdims=True)
+    dx = rrms * (ggam - xv * (rrms * rrms) * s / n_cols)
+    dx_ref[:] = jnp.where(valid, dx, 0.0).astype(dx_ref.dtype)
+    dgamma_ref[:] = jnp.sum(gv * xv * rrms, axis=0, keepdims=True)
+
+
+def fused_rms_norm(x, gamma, eps=1e-6):
+    """RMSNorm over the trailing axis in one Pallas pass (fp32 stats,
+    output in x.dtype) — the transformer stack's norm.  Rows wider than
+    _MAX_COLS fall back to the XLA formulation like the sibling
+    kernels (one row must fit VMEM)."""
+    if x.shape[-1] > _MAX_COLS:
+        ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                      keepdims=True)
+        y = (x.astype(jnp.float32) * jax.lax.rsqrt(ms + eps))
+        return (y * gamma.astype(jnp.float32)).astype(x.dtype)
+    return _fused_rms_core(x, gamma, eps)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fused_rms_core(x, gamma, eps):
+    y, _ = _rms_fwd(x, gamma, eps)
+    return y
+
+
+def _rms_fwd(x, gamma, eps):
+    lead = x.shape[:-1]
+    cols = x.shape[-1]
+    x2d = x.reshape(-1, cols)
+    x2d_p, rows, _ = _pad_rows_cols(x2d, 8, 128)
+    rows_p, cols_p = x2d_p.shape
+    gamma_p = jnp.pad(gamma.astype(x.dtype), (0, cols_p - cols))
+    block_r = _rowwise_block(rows_p, cols_p, 2)
+    grid = (pl.cdiv(rows_p, block_r),)
+    row_spec = pl.BlockSpec((block_r, cols_p), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((1, cols_p), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    stat_spec = pl.BlockSpec((block_r, 1), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    y, rrms = pl.pallas_call(
+        functools.partial(_rms_fwd_kernel, n_cols=cols, eps=eps),
+        out_shape=(jax.ShapeDtypeStruct((rows_p, cols_p), x.dtype),
+                   jax.ShapeDtypeStruct((rows_p, 1), jnp.float32)),
+        grid=grid,
+        in_specs=[row_spec, vec_spec],
+        out_specs=(row_spec, stat_spec),
+        interpret=interpret_mode(),
+    )(x2d_p, gamma_p.reshape(1, -1))
+    return y[:rows, :cols].reshape(*lead, cols), (x, gamma, rrms, rows)
+
+
+def _rms_vjp_fwd(x, gamma, eps):
+    return _rms_fwd(x, gamma, eps)
+
+
+def _rms_vjp_bwd(eps, res, g):
+    x, gamma, rrms, rows = res
+    lead = x.shape[:-1]
+    cols = x.shape[-1]
+    x2d_p, _, _ = _pad_rows_cols(x.reshape(-1, cols), 8, 128)
+    g2d_p, _, _ = _pad_rows_cols(
+        g.reshape(-1, cols).astype(x.dtype), 8, 128)
+    rows_p, cols_p = x2d_p.shape
+    gamma_p = jnp.pad(gamma.astype(x.dtype), (0, cols_p - cols))
+    block_r = _rowwise_block(rows_p, cols_p, 3)
+    n_blocks = pl.cdiv(rows_p, block_r)
+    row_spec = pl.BlockSpec((block_r, cols_p), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((1, cols_p), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    stat_spec = pl.BlockSpec((block_r, 1), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    part_spec = pl.BlockSpec((1, cols_p), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    dx, dgamma_parts = pl.pallas_call(
+        functools.partial(_rms_bwd_kernel, n_cols=cols),
+        out_shape=(jax.ShapeDtypeStruct((rows_p, cols_p), x.dtype),
+                   jax.ShapeDtypeStruct((n_blocks, cols_p), jnp.float32)),
+        grid=(n_blocks,),
+        in_specs=[row_spec, row_spec, vec_spec, stat_spec],
+        out_specs=(row_spec, part_spec),
+        interpret=interpret_mode(),
+    )(x2d_p, g2d_p, gamma_p.reshape(1, -1), rrms)
+    dgamma = dgamma_parts.sum(axis=0)[:cols].astype(gamma.dtype)
+    return dx[:rows, :cols].reshape(*lead, cols), dgamma
+
+
+_fused_rms_core.defvjp(_rms_vjp_fwd, _rms_vjp_bwd)

@@ -209,6 +209,25 @@ def test_fused_softmax_xent_matches_reference():
                                     rtol=1e-4, atol=1e-6)
 
 
+def test_fused_softmax_xent_label_clip_semantics():
+    """Out-of-range labels clamp like the generic pick(mode='clip')
+    path — an ignore-marker label of -1 or an off-by-one vocab must not
+    poison the loss with the padding value."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops.pallas_kernels import fused_softmax_xent
+    rng = onp.random.RandomState(3)
+    x = jnp.asarray(rng.randn(4, 10), jnp.float32)
+    lbl = jnp.asarray([0, -1, 10, 9], jnp.int32)
+    loss = onp.asarray(fused_softmax_xent(x, lbl))
+    clipped = jnp.clip(lbl, 0, 9)
+    ref = onp.asarray(-jax.nn.log_softmax(x)[jnp.arange(4), clipped])
+    onp.testing.assert_allclose(loss, ref, rtol=1e-5, atol=1e-6)
+    assert (onp.abs(loss) < 1e3).all()  # no padding leak
+    g = jax.grad(lambda x: fused_softmax_xent(x, lbl).sum())(x)
+    assert onp.isfinite(onp.asarray(g)).all()
+
+
 def test_softmax_ce_loss_fast_path_parity():
     from incubator_mxnet_tpu import nd, autograd, gluon
     rng = onp.random.RandomState(1)
@@ -235,3 +254,31 @@ def test_softmax_ce_loss_fast_path_parity():
         loss = fast(pred, label).mean()
     loss.backward()
     assert float(nd.sum(nd.abs(pred.grad)).asnumpy()) > 0
+
+
+def test_fused_rms_norm_matches_reference():
+    """fused_rms_norm == plain RMSNorm formula, fwd + both gradients,
+    incl. padded widths and a 3-D batch."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops.pallas_kernels import fused_rms_norm
+    rng = onp.random.RandomState(2)
+    for shape in ((4, 7), (10, 300), (2, 3, 129)):
+        x = jnp.asarray(rng.randn(*shape), jnp.float32)
+        gamma = jnp.asarray(rng.rand(shape[-1]) + 0.5, jnp.float32)
+
+        def ref(x, gamma):
+            ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+            return x * jax.lax.rsqrt(ms + 1e-6) * gamma
+
+        got = fused_rms_norm(x, gamma, 1e-6)
+        onp.testing.assert_allclose(onp.asarray(got), onp.asarray(
+            ref(x, gamma)), rtol=1e-5, atol=1e-6)
+        gx, gg = jax.grad(lambda x, g: fused_rms_norm(x, g, 1e-6).sum(),
+                          argnums=(0, 1))(x, gamma)
+        rx, rg = jax.grad(lambda x, g: ref(x, g).sum(),
+                          argnums=(0, 1))(x, gamma)
+        onp.testing.assert_allclose(onp.asarray(gx), onp.asarray(rx),
+                                    rtol=1e-4, atol=1e-5)
+        onp.testing.assert_allclose(onp.asarray(gg), onp.asarray(rg),
+                                    rtol=1e-4, atol=1e-5)
