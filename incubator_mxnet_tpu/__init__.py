@@ -81,6 +81,7 @@ from .base import MXNetError
 from . import error
 from . import libinfo
 from . import log
+from . import checkpoint
 from .context import (Context, cpu, gpu, tpu, current_context, num_gpus,
                       num_tpus, gpu_memory_info, tpu_memory_info,
                       memory_summary)

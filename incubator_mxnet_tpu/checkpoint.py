@@ -1,0 +1,210 @@
+"""Sharded asynchronous checkpointing (SURVEY §5.4: keep the reference
+``.params`` formats for interop — ndarray/params_io.py — and ADD a
+sharded async checkpoint for large-scale training; the reference's
+preemption story is checkpoint-restart, event_handler.py:336).
+
+TPU-first design: ``save()`` dispatches an async on-device COPY of
+each leaf and returns — the copy is a fresh buffer, so the training
+loop's donated param buffers (fuse.py donates by default) cannot
+invalidate the snapshot — then a background thread pulls the copies to
+host and writes them.  Sharded arrays are written one file per unique
+addressable shard (replica 0 only), keyed by process index, with an
+index of global shape/dtype/shard slices, so on a multi-host mesh each
+process writes only the HBM it owns (no gather through one host).
+Single-process checkpoints are staged under a ``.tmp`` name and
+atomically renamed; multi-process writes land per-file with the
+per-process index written last as the completion marker (cross-process
+commit barriers belong to the launcher).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import threading
+
+import numpy as onp
+
+__all__ = ["AsyncCheckpointManager"]
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _safe(name: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", name)
+
+
+def _leaf_array(v):
+    # unwrap the framework NDArray only — numpy scalars/arrays also have
+    # a .data attribute (a memoryview), which must NOT be taken
+    if hasattr(v, "asnumpy") and hasattr(v, "data"):
+        v = v.data
+    import jax
+    if isinstance(v, jax.Array):
+        # async on-device copy: a NEW buffer, immune to later donation
+        # of the original by the train step (fuse.py donate_argnums)
+        import jax.numpy as jnp
+        return jnp.copy(v)
+    return v
+
+
+class AsyncCheckpointManager:
+    """Async, shard-aware checkpoint directory manager.
+
+    Usage::
+
+        ckpt = AsyncCheckpointManager(dir, keep=3)
+        ckpt.save(step, {"w": w, "m": m})   # returns immediately
+        ...
+        ckpt.wait()                          # barrier (e.g. before exit)
+        params = ckpt.restore()              # latest, name -> numpy
+    """
+
+    def __init__(self, directory, keep=5):
+        self.directory = str(directory)
+        self.keep = int(keep)
+        os.makedirs(self.directory, exist_ok=True)
+        self._thread = None
+        self._error = None
+
+    # ------------------------------------------------------------- save
+    def save(self, step, tree, wait=False):
+        """Snapshot ``tree`` (dict name -> NDArray/jax.Array/numpy) at
+        ``step``.  References are captured synchronously (jax.Arrays are
+        immutable, so later parameter updates cannot corrupt the
+        snapshot); device→host transfer + IO happen on the writer
+        thread."""
+        self.wait()  # one in-flight checkpoint at a time, oldest first
+        flat = {str(k): _leaf_array(v) for k, v in tree.items()}
+        self._thread = threading.Thread(
+            target=self._write, args=(int(step), flat), daemon=True)
+        self._thread.start()
+        if wait:
+            self.wait()
+
+    def _write(self, step, flat):
+        import jax
+        proc = jax.process_index()
+        single = jax.process_count() == 1
+        final = os.path.join(self.directory, f"step_{step:08d}")
+        tmp = final + ".tmp" if single else final
+        try:
+            if single and os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp, exist_ok=True)
+            index = {}
+            for name, arr in flat.items():
+                fname = _safe(name)
+                shards = getattr(arr, "addressable_shards", None)
+                sharded = shards is not None and (
+                    len(shards) > 1
+                    or not getattr(arr, "is_fully_addressable", True))
+                if sharded:
+                    entries = []
+                    for k, sh in enumerate(shards):
+                        if getattr(sh, "replica_id", 0) != 0:
+                            continue  # one copy per unique slice
+                        fn = f"{fname}.p{proc}_s{k}.npy"
+                        onp.save(os.path.join(tmp, fn),
+                                 onp.asarray(sh.data))
+                        entries.append({
+                            "file": fn,
+                            "index": [[sl.start or 0,
+                                       sl.stop if sl.stop is not None
+                                       else dim]
+                                      for sl, dim in zip(sh.index,
+                                                         arr.shape)],
+                        })
+                    index[name] = {"shape": list(arr.shape),
+                                   "dtype": str(onp.dtype(arr.dtype)),
+                                   "shards": entries}
+                else:
+                    fn = f"{fname}.npy" if single else f"{fname}.p{proc}.npy"
+                    if single or proc == 0:  # replicated: one copy
+                        onp.save(os.path.join(tmp, fn), onp.asarray(arr))
+                        index[name] = {
+                            "shape": list(getattr(arr, "shape", ())),
+                            "dtype": str(onp.dtype(arr.dtype)),
+                            "file": fn}
+            # the per-process index is written LAST: its presence marks
+            # this process's contribution complete
+            idx_name = "index.json" if single else f"index.{proc}.json"
+            with open(os.path.join(tmp, idx_name), "w") as f:
+                json.dump({"step": step, "params": index}, f)
+            if single:
+                if os.path.exists(final):
+                    shutil.rmtree(final)
+                os.rename(tmp, final)  # atomic publish
+            self._prune()
+        except BaseException as e:  # surfaced at the next wait()/save()
+            self._error = e
+            if single:
+                shutil.rmtree(tmp, ignore_errors=True)
+
+    def _prune(self):
+        steps = sorted(self.all_steps())
+        for s in steps[:-self.keep] if self.keep > 0 else []:
+            shutil.rmtree(os.path.join(self.directory, f"step_{s:08d}"),
+                          ignore_errors=True)
+
+    # ------------------------------------------------------- inspection
+    def wait(self):
+        """Block until the in-flight checkpoint (if any) is durable;
+        re-raises a writer-thread failure."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("async checkpoint write failed") from err
+
+    def all_steps(self):
+        out = []
+        for entry in os.listdir(self.directory):
+            m = _STEP_RE.match(entry)
+            d = os.path.join(self.directory, entry)
+            if m and (os.path.exists(os.path.join(d, "index.json"))
+                      or os.path.exists(os.path.join(d, "index.0.json"))):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self):
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # ---------------------------------------------------------- restore
+    def restore(self, step=None):
+        """Reassemble a checkpoint into {name: numpy array} (global
+        arrays; re-shard with jax.device_put(..., sharding) to resume
+        on a mesh)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        d = os.path.join(self.directory, f"step_{int(step):08d}")
+        merged = {}
+        if os.path.exists(os.path.join(d, "index.json")):
+            with open(os.path.join(d, "index.json")) as f:
+                merged = json.load(f)["params"]
+        else:  # multi-process: merge every per-process index
+            for entry in sorted(os.listdir(d)):
+                if entry.startswith("index.") and entry.endswith(".json"):
+                    with open(os.path.join(d, entry)) as f:
+                        for name, meta in json.load(f)["params"].items():
+                            if name in merged and "shards" in meta:
+                                merged[name]["shards"] += meta["shards"]
+                            else:
+                                merged[name] = meta
+        out = {}
+        for name, meta in merged.items():
+            if "shards" in meta:
+                full = onp.zeros(meta["shape"], onp.dtype(meta["dtype"]))
+                for entry in meta["shards"]:
+                    block = onp.load(os.path.join(d, entry["file"]))
+                    sl = tuple(slice(a, b) for a, b in entry["index"])
+                    full[sl] = block
+                out[name] = full
+            else:
+                out[name] = onp.load(os.path.join(d, meta["file"]))
+        return out

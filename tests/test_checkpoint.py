@@ -1,0 +1,129 @@
+"""Sharded async checkpointing (checkpoint.py — SURVEY §5.4's
+"add sharded async checkpoint" beyond the reference's synchronous
+save/load)."""
+import os
+import threading
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from incubator_mxnet_tpu.checkpoint import AsyncCheckpointManager
+
+
+def test_async_save_restore_roundtrip(tmp_path):
+    ckpt = AsyncCheckpointManager(tmp_path, keep=5)
+    tree = {"w": jnp.arange(12.0).reshape(3, 4),
+            "b": jnp.ones((4,), jnp.float32),
+            "step_count": onp.int64(7)}
+    ckpt.save(3, tree)  # returns immediately; writer thread finishes it
+    ckpt.wait()
+    assert ckpt.latest_step() == 3
+    back = ckpt.restore()
+    onp.testing.assert_array_equal(back["w"], onp.arange(12.0).reshape(3, 4))
+    onp.testing.assert_array_equal(back["b"], onp.ones(4))
+    assert int(back["step_count"]) == 7
+
+
+def test_sharded_arrays_one_file_per_shard(tmp_path):
+    from incubator_mxnet_tpu.parallel import make_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    assert jax.device_count() >= 8
+    mesh = make_mesh(dp=8)
+    x = jnp.arange(64.0).reshape(8, 8)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    assert len(xs.addressable_shards) == 8
+    ckpt = AsyncCheckpointManager(tmp_path)
+    ckpt.save(1, {"sharded": xs, "plain": jnp.ones((2,))}, wait=True)
+    d = os.path.join(str(tmp_path), "step_00000001")
+    shard_files = [f for f in os.listdir(d) if "_s" in f and
+                   f.endswith(".npy")]
+    assert len(shard_files) == 8  # one file per unique addressable shard
+    back = ckpt.restore(1)
+    onp.testing.assert_array_equal(back["sharded"], onp.asarray(x))
+    # and the restored global array can be re-sharded to resume
+    res = jax.device_put(jnp.asarray(back["sharded"]),
+                         NamedSharding(mesh, P("dp", None)))
+    onp.testing.assert_array_equal(onp.asarray(res), onp.asarray(x))
+
+
+def test_replicated_array_saved_once(tmp_path):
+    """A fully-replicated sharded array writes ONE copy, not one per
+    device (replica_id filter)."""
+    from incubator_mxnet_tpu.parallel import make_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = make_mesh(dp=8)
+    x = jnp.arange(16.0).reshape(4, 4)
+    xr = jax.device_put(x, NamedSharding(mesh, P(None, None)))  # replicated
+    assert len(xr.addressable_shards) == 8
+    ckpt = AsyncCheckpointManager(tmp_path)
+    ckpt.save(1, {"rep": xr}, wait=True)
+    d = os.path.join(str(tmp_path), "step_00000001")
+    data_files = [f for f in os.listdir(d) if f.endswith(".npy")]
+    assert len(data_files) == 1, data_files
+    onp.testing.assert_array_equal(ckpt.restore(1)["rep"], onp.asarray(x))
+
+
+def test_donation_cannot_corrupt_snapshot(tmp_path):
+    """save() copies on device, so a train step that donates the very
+    param buffers (fuse.py default) cannot invalidate the snapshot."""
+    ckpt = AsyncCheckpointManager(tmp_path)
+    w = jnp.arange(8.0)
+
+    @jax.jit
+    def donating_step(w):
+        return w * 2.0
+
+    donating_step_d = jax.jit(lambda w: w * 2.0, donate_argnums=(0,))
+    ckpt.save(5, {"w": w})
+    w2 = donating_step_d(w)  # donates/deletes the original buffer
+    ckpt.wait()
+    onp.testing.assert_array_equal(ckpt.restore(5)["w"], onp.arange(8.0))
+    onp.testing.assert_array_equal(onp.asarray(w2), onp.arange(8.0) * 2)
+
+
+def test_retention_prunes_oldest(tmp_path):
+    ckpt = AsyncCheckpointManager(tmp_path, keep=2)
+    for s in (1, 2, 3, 4):
+        ckpt.save(s, {"v": jnp.full((2,), float(s))}, wait=True)
+    assert ckpt.all_steps() == [3, 4]
+    onp.testing.assert_array_equal(ckpt.restore()["v"], [4.0, 4.0])
+    onp.testing.assert_array_equal(ckpt.restore(3)["v"], [3.0, 3.0])
+
+
+def test_snapshot_immune_to_later_updates(tmp_path):
+    """The step-N snapshot must hold values as of save() even though
+    training keeps producing new arrays (immutability contract)."""
+    ckpt = AsyncCheckpointManager(tmp_path)
+    w = jnp.zeros((4,))
+    ckpt.save(0, {"w": w})
+    for _ in range(50):
+        w = w + 1.0  # new arrays; old snapshot must stay zeros
+    ckpt.wait()
+    onp.testing.assert_array_equal(ckpt.restore(0)["w"], onp.zeros(4))
+
+
+def test_torn_checkpoint_never_published(tmp_path):
+    """A failed write leaves no step directory and raises at wait()."""
+    ckpt = AsyncCheckpointManager(tmp_path)
+
+    class Boom:
+        shape = (2,)
+        dtype = onp.float32
+
+        def __array__(self, dtype=None, copy=None):
+            raise IOError("disk gone")
+
+    ckpt.save(9, {"bad": Boom()})
+    with pytest.raises(RuntimeError, match="checkpoint write failed"):
+        ckpt.wait()
+    assert ckpt.all_steps() == []
+    assert not os.path.exists(os.path.join(str(tmp_path), "step_00000009"))
+
+
+def test_restore_missing_is_explicit(tmp_path):
+    ckpt = AsyncCheckpointManager(tmp_path)
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore()
