@@ -46,7 +46,9 @@ def _leaf_array(v):
         # of the original by the train step (fuse.py donate_argnums)
         import jax.numpy as jnp
         return jnp.copy(v)
-    return v
+    # host leaves are copied too: the snapshot must not see in-place
+    # mutations made after save() returns; plain scalars become 0-d
+    return onp.array(v)
 
 
 class AsyncCheckpointManager:
@@ -122,11 +124,13 @@ class AsyncCheckpointManager:
                 else:
                     fn = f"{fname}.npy" if single else f"{fname}.p{proc}.npy"
                     if single or proc == 0:  # replicated: one copy
-                        onp.save(os.path.join(tmp, fn), onp.asarray(arr))
-                        index[name] = {
-                            "shape": list(getattr(arr, "shape", ())),
-                            "dtype": str(onp.dtype(arr.dtype)),
-                            "file": fn}
+                        host = onp.asarray(arr)
+                        onp.save(os.path.join(tmp, fn), host)
+                        index[name] = {"shape": list(host.shape),
+                                       "dtype": str(host.dtype
+                                                    if host.dtype.kind != "V"
+                                                    else onp.dtype(arr.dtype)),
+                                       "file": fn}
             # the per-process index is written LAST: its presence marks
             # this process's contribution complete
             idx_name = "index.json" if single else f"index.{proc}.json"
@@ -136,11 +140,17 @@ class AsyncCheckpointManager:
                 if os.path.exists(final):
                     shutil.rmtree(final)
                 os.rename(tmp, final)  # atomic publish
-            self._prune()
         except BaseException as e:  # surfaced at the next wait()/save()
             self._error = e
             if single:
                 shutil.rmtree(tmp, ignore_errors=True)
+            return
+        try:
+            # pruning failures must not mark the (already durable)
+            # checkpoint as failed
+            self._prune()
+        except OSError:
+            pass
 
     def _prune(self):
         steps = sorted(self.all_steps())
@@ -198,13 +208,30 @@ class AsyncCheckpointManager:
                                 merged[name] = meta
         out = {}
         for name, meta in merged.items():
+            dtype = onp.dtype(meta["dtype"])  # ml_dtypes names resolve
+
+            def _typed(block):
+                # numpy serializes exotic dtypes (bf16/fp8) as raw void
+                # of the same itemsize; view restores the logical dtype
+                if block.dtype != dtype and block.dtype.kind == "V":
+                    return block.view(dtype)
+                return block
+
             if "shards" in meta:
-                full = onp.zeros(meta["shape"], onp.dtype(meta["dtype"]))
+                full = onp.zeros(meta["shape"], dtype)
+                covered = 0
                 for entry in meta["shards"]:
-                    block = onp.load(os.path.join(d, entry["file"]))
+                    block = _typed(onp.load(os.path.join(d, entry["file"])))
                     sl = tuple(slice(a, b) for a, b in entry["index"])
                     full[sl] = block
+                    covered += int(block.size)
+                if covered < int(onp.prod(meta["shape"])):
+                    raise RuntimeError(
+                        f"checkpoint step {step} is incomplete for "
+                        f"{name!r}: {covered} of "
+                        f"{int(onp.prod(meta['shape']))} elements present "
+                        "(a writer process likely died mid-save)")
                 out[name] = full
             else:
-                out[name] = onp.load(os.path.join(d, meta["file"]))
+                out[name] = _typed(onp.load(os.path.join(d, meta["file"])))
         return out

@@ -181,3 +181,36 @@ class EarlyStoppingHandler(EpochEnd):
             return False
         self.wait += 1
         return self.wait > self.patience
+
+
+class AsyncCheckpointHandler(BatchEnd, TrainEnd):
+    """Checkpointing that never stalls the train loop: snapshots the
+    net's parameters through checkpoint.AsyncCheckpointManager every
+    ``batch_period`` batches (device-side copy now, IO on a writer
+    thread — SURVEY §5.4's sharded-async addition; CheckpointHandler
+    above keeps the reference's synchronous .params behavior)."""
+
+    def __init__(self, model_dir, batch_period=100, max_checkpoints=5):
+        from ....checkpoint import AsyncCheckpointManager
+        self.manager = AsyncCheckpointManager(model_dir,
+                                              keep=max_checkpoints)
+        self.batch_period = batch_period
+        self._batches = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self._batches += 1
+        if self._batches % self.batch_period == 0:
+            params = {name: p.data()
+                      for name, p in estimator.net.collect_params().items()
+                      if p._data is not None}
+            self.manager.save(self._batches, params)
+
+    def train_end(self, estimator, *args, **kwargs):
+        self.manager.wait()  # durable before exit
+
+    def restore_into(self, net, step=None):
+        """Load a snapshot back into a Block's parameters."""
+        snap = self.manager.restore(step)
+        for name, p in net.collect_params().items():
+            if name in snap:
+                p.set_data(snap[name])  # public API: coerces dtype

@@ -105,25 +105,112 @@ def test_snapshot_immune_to_later_updates(tmp_path):
     onp.testing.assert_array_equal(ckpt.restore(0)["w"], onp.zeros(4))
 
 
-def test_torn_checkpoint_never_published(tmp_path):
-    """A failed write leaves no step directory and raises at wait()."""
+def test_torn_checkpoint_never_published(tmp_path, monkeypatch):
+    """A failed write (IO error on the writer thread) leaves no step
+    directory, cleans its staging dir, and raises at wait()."""
+    from incubator_mxnet_tpu import checkpoint as ckpt_mod
     ckpt = AsyncCheckpointManager(tmp_path)
 
-    class Boom:
-        shape = (2,)
-        dtype = onp.float32
+    def boom(*a, **k):
+        raise IOError("disk gone")
 
-        def __array__(self, dtype=None, copy=None):
-            raise IOError("disk gone")
-
-    ckpt.save(9, {"bad": Boom()})
+    monkeypatch.setattr(ckpt_mod.onp, "save", boom)
+    ckpt.save(9, {"bad": jnp.ones((2,))})
     with pytest.raises(RuntimeError, match="checkpoint write failed"):
         ckpt.wait()
     assert ckpt.all_steps() == []
     assert not os.path.exists(os.path.join(str(tmp_path), "step_00000009"))
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           "step_00000009.tmp"))
 
 
 def test_restore_missing_is_explicit(tmp_path):
     ckpt = AsyncCheckpointManager(tmp_path)
     with pytest.raises(FileNotFoundError):
         ckpt.restore()
+
+
+def test_async_checkpoint_handler_in_estimator(tmp_path):
+    """AsyncCheckpointHandler snapshots during estimator.fit without
+    blocking and restores into a fresh net."""
+    from incubator_mxnet_tpu import nd, gluon
+    from incubator_mxnet_tpu.gluon.contrib.estimator import (
+        Estimator, AsyncCheckpointHandler)
+    from incubator_mxnet_tpu.gluon import nn, loss as gloss
+    net = nn.Dense(3, in_units=5)
+    net.initialize()
+    est = Estimator(net, gloss.L2Loss(),
+                    trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 0.05}))
+    X = nd.random.uniform(shape=(32, 5))
+    Y = nd.random.uniform(shape=(32, 3))
+    from incubator_mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    loader = DataLoader(ArrayDataset(X, Y), batch_size=8)
+    handler = AsyncCheckpointHandler(str(tmp_path), batch_period=2)
+    est.fit(loader, epochs=2, event_handlers=[handler])
+    steps = handler.manager.all_steps()
+    assert steps, "no async snapshots were taken"
+    net2 = nn.Dense(3, in_units=5)
+    net2.initialize()
+    net2(nd.zeros((1, 5)))
+    handler.restore_into(net2, steps[-1])
+    x = nd.random.uniform(shape=(2, 5))
+    onp.testing.assert_allclose(net2(x).asnumpy(), net(x).asnumpy(),
+                                rtol=1e-5)
+
+
+def test_bfloat16_roundtrip(tmp_path):
+    """bf16 params — the TPU common case — survive save/restore for
+    both sharded and unsharded leaves (numpy writes exotic dtypes as
+    raw void; restore views them back)."""
+    from incubator_mxnet_tpu.parallel import make_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = make_mesh(dp=8)
+    x = jnp.arange(64.0, dtype=jnp.bfloat16).reshape(8, 8)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    ckpt = AsyncCheckpointManager(tmp_path)
+    ckpt.save(1, {"sharded": xs, "plain": jnp.full((3,), 2.5,
+                                                   jnp.bfloat16)},
+              wait=True)
+    back = ckpt.restore(1)
+    assert str(back["sharded"].dtype) == "bfloat16"
+    onp.testing.assert_array_equal(
+        back["sharded"].astype(onp.float32),
+        onp.arange(64.0, dtype=onp.float32).reshape(8, 8))
+    assert str(back["plain"].dtype) == "bfloat16"
+    onp.testing.assert_array_equal(back["plain"].astype(onp.float32),
+                                   onp.full((3,), 2.5))
+
+
+def test_incomplete_multiprocess_checkpoint_is_loud(tmp_path):
+    """Missing shards (a writer process died) raise instead of
+    zero-filling the resumed model."""
+    import json
+    from incubator_mxnet_tpu.parallel import make_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = make_mesh(dp=8)
+    xs = jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                        NamedSharding(mesh, P("dp", None)))
+    ckpt = AsyncCheckpointManager(tmp_path)
+    ckpt.save(1, {"w": xs}, wait=True)
+    d = os.path.join(str(tmp_path), "step_00000001")
+    with open(os.path.join(d, "index.json")) as f:
+        idx = json.load(f)
+    idx["params"]["w"]["shards"] = idx["params"]["w"]["shards"][:4]
+    with open(os.path.join(d, "index.json"), "w") as f:
+        json.dump(idx, f)
+    with pytest.raises(RuntimeError, match="incomplete"):
+        ckpt.restore(1)
+
+
+def test_host_numpy_leaf_snapshot_isolated(tmp_path):
+    """In-place mutation of a host numpy leaf after save() must not
+    leak into the snapshot; plain python scalars are accepted."""
+    ckpt = AsyncCheckpointManager(tmp_path)
+    ema = onp.ones(4, onp.float32)
+    ckpt.save(2, {"ema": ema, "epoch": 3})
+    ema *= 100.0
+    ckpt.wait()
+    back = ckpt.restore(2)
+    onp.testing.assert_array_equal(back["ema"], onp.ones(4))
+    assert int(back["epoch"]) == 3
