@@ -191,13 +191,15 @@ def _child(platform: str) -> None:
     print(json.dumps(best), flush=True)
 
 
-def _run_child(platform: str, timeout: float):
+def _run_child(platform: str, timeout: float, extra_env=None):
     """Run one benchmark attempt in a subprocess; return parsed JSON or None."""
+    env = dict(os.environ)
+    env.update(extra_env or {})
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child", platform],
             capture_output=True, text=True, timeout=timeout,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env)
     except subprocess.TimeoutExpired:
         print(f"[bench] child ({platform}) timed out after {timeout:.0f}s",
               file=sys.stderr, flush=True)
@@ -235,6 +237,19 @@ def main():
                 break
             print(f"[bench] TPU attempt {i + 1}/{attempts} failed",
                   file=sys.stderr, flush=True)
+        if result is None and os.environ.get("BENCH_PALLAS_FALLBACK",
+                                             "1") != "0":
+            # last-resort degraded mode BEFORE giving up the chip: if
+            # every same-config attempt failed (e.g. a Pallas kernel
+            # fails Mosaic compilation on this hardware), one try with
+            # the pallas paths disabled — slower but honest, and better
+            # than the CPU fallback
+            print("[bench] retrying with pallas kernels disabled",
+                  file=sys.stderr, flush=True)
+            result = _run_child("tpu", tpu_timeout,
+                                {"MXNET_USE_PALLAS": "0"})
+            if result is not None:
+                result["note"] = "pallas kernels disabled (fallback)"
     if result is None:
         print("[bench] falling back to CPU benchmark", file=sys.stderr,
               flush=True)

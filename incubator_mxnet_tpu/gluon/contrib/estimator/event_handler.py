@@ -209,8 +209,22 @@ class AsyncCheckpointHandler(BatchEnd, TrainEnd):
         self.manager.wait()  # durable before exit
 
     def restore_into(self, net, step=None):
-        """Load a snapshot back into a Block's parameters."""
+        """Load a snapshot back into a Block's parameters.
+
+        Name mismatches are loud (load_parameters convention,
+        block.py): zero matches raise, partial matches raise listing
+        the missing names."""
         snap = self.manager.restore(step)
-        for name, p in net.collect_params().items():
-            if name in snap:
-                p.set_data(snap[name])  # public API: coerces dtype
+        params = net.collect_params()
+        matched = [n for n in params if n in snap]
+        if not matched:
+            raise KeyError(
+                f"no parameter names match the snapshot (net has "
+                f"{sorted(params)[:5]}..., snapshot has "
+                f"{sorted(snap)[:5]}...)")
+        missing = [n for n in params if n not in snap]
+        if missing:
+            raise KeyError(
+                f"snapshot is missing parameters: {missing[:10]}")
+        for name in matched:
+            params[name].set_data(snap[name])  # public API: coerces dtype
