@@ -122,3 +122,244 @@ class RandomBrightness(Block):
     def forward(self, x):
         alpha = 1.0 + onp.random.uniform(-self._b, self._b)
         return (x.astype("float32") * alpha).clip(0, 255).astype(str(x.dtype))
+
+
+_GRAY = onp.array([0.299, 0.587, 0.114], "float32")
+
+_YIQ = onp.array([[0.299, 0.587, 0.114],
+                  [0.596, -0.274, -0.321],
+                  [0.211, -0.523, 0.311]], "float32")
+_YIQ_INV = onp.linalg.inv(_YIQ).astype("float32")
+
+
+class RandomContrast(Block):
+    """alpha-blend with the LUMINANCE mean (reference
+    ContrastJitterAug: gray = 0.299R+0.587G+0.114B, blend with its
+    mean)."""
+
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        alpha = 1.0 + onp.random.uniform(-self._c, self._c)
+        f = x.astype("float32")
+        lum_mean = float(nd.dot(f, nd.array(_GRAY, ctx=x.ctx))
+                         .mean().asnumpy())
+        return (f * alpha + lum_mean * (1 - alpha)) \
+            .clip(0, 255).astype(str(x.dtype))
+
+
+class RandomSaturation(Block):
+    """alpha-blend with the per-pixel grayscale (reference
+    RandomSaturation)."""
+
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        alpha = 1.0 + onp.random.uniform(-self._s, self._s)
+        f = x.astype("float32")
+        gray = nd.dot(f, nd.array(_GRAY, ctx=x.ctx)).expand_dims(-1)
+        return (f * alpha + gray * (1 - alpha)) \
+            .clip(0, 255).astype(str(x.dtype))
+
+
+class RandomHue(Block):
+    """Rotate hue via the YIQ linear approximation (reference RandomHue's
+    cv-free formulation)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        alpha = onp.random.uniform(-self._h, self._h) * onp.pi
+        u, w = onp.cos(alpha), onp.sin(alpha)
+        t_hue = onp.array([[1.0, 0.0, 0.0],
+                           [0.0, u, -w],
+                           [0.0, w, u]], "float32")
+        t_rgb = _YIQ_INV @ t_hue @ _YIQ
+        f = x.astype("float32")
+        out = nd.dot(f, nd.array(t_rgb.T.astype("float32"), ctx=x.ctx))
+        return out.clip(0, 255).astype(str(x.dtype))
+
+
+class RandomColorJitter(Block):
+    """Brightness/contrast/saturation/hue in random order (reference
+    RandomColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        for i in onp.random.permutation(len(self._ts)):
+            x = self._ts[int(i)].forward(x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (reference RandomLighting)."""
+
+    _eigval = onp.array([55.46, 4.794, 1.148], "float32")
+    _eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], "float32")
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._a = alpha
+
+    def forward(self, x):
+        alpha = onp.random.normal(0, self._a, 3).astype("float32")
+        rgb = (self._eigvec * alpha) @ self._eigval
+        return (x.astype("float32") + nd.array(rgb, ctx=x.ctx)) \
+            .clip(0, 255).astype(str(x.dtype))
+
+
+class RandomGray(Block):
+    """Random grayscale conversion with probability p (reference
+    RandomGray)."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if onp.random.rand() < self._p:
+            f = x.astype("float32")
+            gray = nd.dot(f, nd.array(_GRAY, ctx=x.ctx)).expand_dims(-1)
+            return nd.concat(gray, gray, gray, dim=-1) \
+                .clip(0, 255).astype(str(x.dtype))
+        return x
+
+
+class RandomCrop(Block):
+    """Random-position crop with optional padding (reference
+    RandomCrop): delegates to image.random_crop, which upscales when
+    the (padded) source is smaller than the target so the output shape
+    is always exactly ``size``.  HWC images only (the reference's
+    contract; batches go through CenterCrop/batch-aware ops)."""
+
+    def __init__(self, size, pad=None, pad_value=0, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._pad = pad
+        self._pad_value = pad_value
+        self._interp = interpolation
+
+    def forward(self, x):
+        if x.ndim != 3:
+            raise ValueError("RandomCrop expects an HWC image; use "
+                             "CenterCrop for batched input")
+        if self._pad:
+            p = self._pad
+            x = NDArray(onp.pad(onp.asarray(x.asnumpy()),
+                                ((p, p), (p, p), (0, 0)),
+                                constant_values=self._pad_value), ctx=x.ctx)
+        from ....image import random_crop as _random_crop
+        out, _ = _random_crop(x, self._size, interp=self._interp)
+        return out
+
+
+class CropResize(Block):
+    """Fixed crop then resize (reference CropResize)."""
+
+    def __init__(self, x0, y0, width, height, size=None, interpolation=1):
+        super().__init__()
+        self._box = (x0, y0, width, height)
+        self._size = size
+        self._interp = interpolation
+
+    def forward(self, x):
+        x0, y0, w, h = self._box
+        crop = x[..., y0:y0 + h, x0:x0 + w, :]
+        if self._size is not None:
+            return Resize(self._size, interpolation=self._interp) \
+                .forward(crop)
+        return crop
+
+
+class Rotate(Block):
+    """Rotate by a fixed angle in DEGREES, zero-filled corners
+    (reference transforms.Rotate) — bilinear gather via
+    map_coordinates.  The reference's zoom_in/zoom_out modes are not
+    implemented; passing them raises instead of silently producing
+    un-zoomed output."""
+
+    def __init__(self, rotation_degrees=None, zoom_in=False, zoom_out=False,
+                 rotation=None):
+        super().__init__()
+        if zoom_in or zoom_out:
+            raise NotImplementedError(
+                "Rotate zoom_in/zoom_out are not implemented; rotate "
+                "then Resize/CenterCrop explicitly")
+        deg = rotation_degrees if rotation_degrees is not None else rotation
+        self._theta = float(onp.deg2rad(deg if deg is not None else 0.0))
+
+    def _rotate(self, x, theta):
+        from jax.scipy.ndimage import map_coordinates
+        import jax.numpy as jnp
+        f = x.data.astype("float32")
+        H, W = f.shape[0], f.shape[1]
+        cy, cx = (H - 1) / 2.0, (W - 1) / 2.0
+        yy, xx = jnp.meshgrid(jnp.arange(H) - cy, jnp.arange(W) - cx,
+                              indexing="ij")
+        src_y = cy + yy * onp.cos(theta) - xx * onp.sin(theta)
+        src_x = cx + yy * onp.sin(theta) + xx * onp.cos(theta)
+        out = jnp.stack([
+            map_coordinates(f[..., c], [src_y, src_x], order=1, cval=0.0)
+            for c in range(f.shape[-1])], axis=-1)
+        return NDArray(out.astype(x.data.dtype), ctx=x.ctx)
+
+    def forward(self, x):
+        return self._rotate(x, self._theta)
+
+
+class RandomRotation(Rotate):
+    """Rotate by a uniform random angle from [-a, a] degrees (reference
+    RandomRotation)."""
+
+    def __init__(self, angle_limits=(-10, 10), zoom_in=False,
+                 zoom_out=False, rotate_with_proba=1.0):
+        super().__init__(rotation_degrees=0.0, zoom_in=zoom_in,
+                         zoom_out=zoom_out)
+        self._limits = angle_limits
+        self._proba = rotate_with_proba
+
+    def forward(self, x):
+        if onp.random.rand() >= self._proba:
+            return x
+        deg = onp.random.uniform(*self._limits)
+        return self._rotate(x, float(onp.deg2rad(deg)))
+
+
+class RandomApply(Block):
+    """Apply a transform with probability p (reference RandomApply)."""
+
+    def __init__(self, transforms, p=0.5):
+        super().__init__()
+        self._t = transforms
+        self._p = p
+
+    def forward(self, x):
+        if onp.random.rand() < self._p:
+            return self._t(x)
+        return x
+
+
+# every transform here routes through ops/NDArray methods, so the
+# hybrid variants collapse to aliases (reference keeps separate
+# HybridBlock hierarchies)
+HybridCompose = Compose
+HybridRandomApply = RandomApply

@@ -454,3 +454,59 @@ def test_poisson_nll_and_sdml_losses():
     ds = ArrayDataset(nd.array(onp.arange(10).astype("f")))
     samp = FilterSampler(lambda v: float(v.asnumpy()) % 2 == 0, ds)
     assert list(samp) == [0, 2, 4, 6, 8] and len(samp) == 5
+
+
+def test_transforms_tail():
+    """Color jitter / crop / rotate transform family (reference
+    gluon/data/vision/transforms.py)."""
+    import numpy as onp
+    from incubator_mxnet_tpu.gluon.data.vision import transforms as T
+    onp.random.seed(0)
+    img = nd.array((onp.random.rand(20, 24, 3) * 255).astype(onp.uint8))
+    # shape-preserving color ops stay uint8 in [0, 255]
+    for t in (T.RandomContrast(0.5), T.RandomSaturation(0.5),
+              T.RandomHue(0.3), T.RandomLighting(0.1),
+              T.RandomColorJitter(0.3, 0.3, 0.3, 0.1), T.RandomGray(1.0)):
+        out = t(img)
+        assert out.shape == img.shape, type(t).__name__
+        a = out.asnumpy()
+        assert a.dtype == onp.uint8 and a.min() >= 0 and a.max() <= 255
+    # RandomGray(p=1): all three channels equal
+    g = T.RandomGray(1.0)(img).asnumpy()
+    onp.testing.assert_array_equal(g[..., 0], g[..., 1])
+    # crops
+    assert T.RandomCrop(8)(img).shape == (8, 8, 3)
+    assert T.RandomCrop(8, pad=4)(img).shape == (8, 8, 3)
+    # smaller-than-target sources upscale to exactly the target size
+    assert T.RandomCrop(32)(img).shape == (32, 32, 3)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="HWC"):
+        T.RandomCrop(8)(nd.zeros(shape=(2, 20, 24, 3)))
+    with _pytest.raises(NotImplementedError):
+        T.Rotate(30.0, zoom_out=True)
+    cr = T.CropResize(2, 3, 10, 12)(img)
+    assert cr.shape == (12, 10, 3)
+    cr2 = T.CropResize(2, 3, 10, 12, size=6)(img)
+    assert cr2.shape == (6, 6, 3)
+    # rotation: 0 degrees is identity; 90-degree content check on floats
+    sq = nd.array(onp.random.rand(9, 9, 1).astype("f"))
+    onp.testing.assert_allclose(T.Rotate(0.0)(sq).asnumpy(), sq.asnumpy(),
+                                atol=1e-5)
+    r90 = T.Rotate(90.0)(sq).asnumpy()[..., 0]
+    onp.testing.assert_allclose(r90, onp.rot90(sq.asnumpy()[..., 0], -1),
+                                atol=1e-4)
+    rr = T.RandomRotation((-30, 30))(sq)
+    assert rr.shape == sq.shape
+    # RandomApply honors p
+    marker = []
+    class Tag:
+        def __call__(self, x):
+            marker.append(1)
+            return x
+    T.RandomApply(Tag(), p=0.0)(img)
+    assert not marker
+    T.RandomApply(Tag(), p=1.0)(img)
+    assert marker
+    # hybrid aliases
+    assert T.HybridCompose is T.Compose
+    assert T.HybridRandomApply is T.RandomApply
