@@ -1,6 +1,6 @@
-from .estimator import Estimator
-from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,
-                            BatchBegin, BatchEnd, StoppingHandler,
+from .estimator import Estimator, BatchProcessor
+from .event_handler import (EventHandler, TrainBegin, TrainEnd, EpochBegin,
+                            EpochEnd, BatchBegin, BatchEnd, StoppingHandler,
                             MetricHandler, ValidationHandler, LoggingHandler,
                             CheckpointHandler, EarlyStoppingHandler,
-                            AsyncCheckpointHandler)
+                            AsyncCheckpointHandler, GradientUpdateHandler)

@@ -7,12 +7,38 @@ from ... import metric as metric_mod
 from ...trainer import Trainer
 from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
                             TrainBegin, TrainEnd, MetricHandler,
-                            LoggingHandler)
+                            LoggingHandler, GradientUpdateHandler)
+
+
+class BatchProcessor:
+    """Encapsulates the per-batch forward/backward (reference
+    batch_processor.py): override fit_batch/evaluate_batch to customize
+    how a batch flows through the net (multi-input models, teacher
+    forcing, ...)."""
+
+    def fit_batch(self, estimator, batch, batch_axis=0):
+        data, label = batch[0], batch[1]
+        data = data.as_in_context(estimator.context)
+        label = label if not hasattr(label, "as_in_context") \
+            else label.as_in_context(estimator.context)
+        with autograd.record():
+            pred = estimator.net(data)
+            loss = estimator.loss(pred, label)
+        loss.backward()
+        return data, label, pred, loss
+
+    def evaluate_batch(self, estimator, batch, batch_axis=0):
+        data, label = batch[0], batch[1]
+        data = data.as_in_context(estimator.context)
+        pred = estimator.net(data)
+        return data, label, pred
 
 
 class Estimator:
     def __init__(self, net, loss, train_metrics=None, val_metrics=None,
-                 trainer=None, context=None, evaluation_loss=None):
+                 trainer=None, context=None, evaluation_loss=None,
+                 batch_processor=None):
+        self.batch_processor = batch_processor or BatchProcessor()
         self.net = net
         self.loss = loss
         self.train_metrics = train_metrics or [metric_mod.Accuracy()]
@@ -30,9 +56,8 @@ class Estimator:
         for m in self.val_metrics:
             m.reset()
         for batch in val_data:
-            data, label = batch[0], batch[1]
-            data = data.as_in_context(self.context)
-            pred = self.net(data)
+            _, label, pred = self.batch_processor.evaluate_batch(
+                self, batch, batch_axis)
             for m in self.val_metrics:
                 m.update([label], [pred])
         return {m.get()[0]: m.get()[1] for m in self.val_metrics}
@@ -40,6 +65,10 @@ class Estimator:
     def fit(self, train_data, val_data=None, epochs=1, event_handlers=None,
             batch_axis=0):
         handlers = list(event_handlers or [])
+        if not any(isinstance(h, GradientUpdateHandler) for h in handlers):
+            # the optimizer step is itself a handler (reference
+            # estimator.py): prepend so it runs before metric/log hooks
+            handlers.insert(0, GradientUpdateHandler())
         if not any(isinstance(h, MetricHandler) for h in handlers):
             handlers.append(MetricHandler(self.train_metrics))
         if not any(isinstance(h, LoggingHandler) for h in handlers):
@@ -64,16 +93,9 @@ class Estimator:
                 for h in handlers:
                     if isinstance(h, BatchBegin):
                         h.batch_begin(estimator_ref, batch=batch)
-                data, label = batch[0], batch[1]
-                data = data.as_in_context(self.context)
-                label = label if not hasattr(label, "as_in_context") \
-                    else label.as_in_context(self.context)
-                with autograd.record():
-                    pred = self.net(data)
-                    loss = self.loss(pred, label)
-                loss.backward()
-                bs = data.shape[batch_axis]
-                self.trainer.step(bs)
+                data, label, pred, loss = self.batch_processor.fit_batch(
+                    self, batch, batch_axis)
+                self._last_batch_size = data.shape[batch_axis]
                 self.train_loss_metric.update(None, [loss])
                 for m in self.train_metrics:
                     m.update([label], [pred])

@@ -9,6 +9,12 @@ import os
 import time
 
 
+class EventHandler:
+    """Base marker for estimator event handlers (reference
+    event_handler.py EventHandler); the mixin classes below define the
+    hook points."""
+
+
 class TrainBegin:
     def train_begin(self, estimator, *args, **kwargs):
         pass
@@ -37,6 +43,19 @@ class BatchBegin:
 class BatchEnd:
     def batch_end(self, estimator, *args, **kwargs):
         return False
+
+
+class GradientUpdateHandler(BatchEnd):
+    """Applies the optimizer step at batch end (reference
+    event_handler.py GradientUpdateHandler) — pulled out of the fit
+    loop so update cadence is overridable (e.g. gradient accumulation:
+    subclass and step every N batches)."""
+
+    def __init__(self, priority=-2000):
+        self.priority = priority
+
+    def batch_end(self, estimator, *args, **kwargs):
+        estimator.trainer.step(estimator._last_batch_size)
 
 
 class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):

@@ -1,0 +1,243 @@
+"""Gluon contrib recurrent cells (reference
+python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py + rnn/conv_rnn_cell
+LSTMPCell, VariationalDropoutCell): convolutional RNN/LSTM/GRU cells in
+1/2/3 spatial dims, projected LSTM, and variational (per-sequence mask)
+dropout.
+
+TPU design note: the conv cells' gates are `Convolution` ops on NC*
+layouts, so under `hybridize`/scan the whole recurrence lowers to XLA
+convs on the MXU exactly like the dense cells lower to matmuls.
+"""
+from __future__ import annotations
+
+from .... import initializer as init_mod
+from ....ops.registry import invoke
+from ...parameter import Parameter
+from ...rnn.rnn_cell import RecurrentCell, ModifierCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell",
+           "LSTMPCell", "VariationalDropoutCell"]
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _ConvCellBase(RecurrentCell):
+    """Shared plumbing: i2h/h2h convs with same-padding so the hidden
+    state keeps the input's spatial shape (reference _BaseConvRNNCell)."""
+
+    _gates = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=(3, 3),
+                 h2h_kernel=(3, 3), activation="tanh", ndim=2, **kwargs):
+        super().__init__(**kwargs)
+        self._ndim = ndim
+        self._input_shape = tuple(input_shape)  # (C, *spatial)
+        self._hc = hidden_channels
+        self._act = activation
+        ik = _tup(i2h_kernel, ndim)
+        hk = _tup(h2h_kernel, ndim)
+        for k in hk:
+            if k % 2 == 0:
+                raise ValueError("h2h_kernel must be odd for same-padding "
+                                 f"(got {hk})")
+        self._ik, self._hk = ik, hk
+        self._ipad = tuple(k // 2 for k in ik)
+        self._hpad = tuple(k // 2 for k in hk)
+        G = self._gates
+        cin = self._input_shape[0]
+        self.i2h_weight = Parameter(
+            "i2h_weight", shape=(G * hidden_channels, cin) + ik,
+            init=init_mod.Xavier())
+        self.h2h_weight = Parameter(
+            "h2h_weight", shape=(G * hidden_channels, hidden_channels) + hk,
+            init=init_mod.Xavier())
+        self.i2h_bias = Parameter("i2h_bias", shape=(G * hidden_channels,),
+                                  init=init_mod.Zero())
+        self.h2h_bias = Parameter("h2h_bias", shape=(G * hidden_channels,),
+                                  init=init_mod.Zero())
+
+    def state_info(self, batch_size=0):
+        spatial = self._input_shape[1:]
+        shape = (batch_size, self._hc) + spatial
+        n = {1: [shape], 2: [shape, shape]}[self._num_states]
+        return [{"shape": s, "__layout__": "NC" + "DHW"[-self._ndim:]}
+                for s in n]
+
+    _num_states = 1
+
+    def _convs(self, inputs, h):
+        G = self._gates
+        i2h = invoke("Convolution", inputs, self.i2h_weight.data(),
+                     self.i2h_bias.data(), kernel=self._ik,
+                     num_filter=G * self._hc, pad=self._ipad)
+        h2h = invoke("Convolution", h, self.h2h_weight.data(),
+                     self.h2h_bias.data(), kernel=self._hk,
+                     num_filter=G * self._hc, pad=self._hpad)
+        return i2h, h2h
+
+
+class _ConvRNNCell(_ConvCellBase):
+    _gates = 1
+    _num_states = 1
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._convs(inputs, states[0])
+        out = invoke("Activation", i2h + h2h, act_type=self._act)
+        return out, [out]
+
+
+class _ConvLSTMCell(_ConvCellBase):
+    _gates = 4
+    _num_states = 2
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._convs(inputs, states[0])
+        gates = i2h + h2h
+        i, f, g, o = invoke("split", gates, num_outputs=4, axis=1)
+        c = invoke("sigmoid", f) * states[1] + \
+            invoke("sigmoid", i) * invoke("Activation", g,
+                                          act_type=self._act)
+        h = invoke("sigmoid", o) * invoke("Activation", c,
+                                          act_type=self._act)
+        return h, [h, c]
+
+
+class _ConvGRUCell(_ConvCellBase):
+    _gates = 3
+    _num_states = 1
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._convs(inputs, states[0])
+        i_r, i_z, i_n = invoke("split", i2h, num_outputs=3, axis=1)
+        h_r, h_z, h_n = invoke("split", h2h, num_outputs=3, axis=1)
+        r = invoke("sigmoid", i_r + h_r)
+        z = invoke("sigmoid", i_z + h_z)
+        n = invoke("Activation", i_n + r * h_n, act_type=self._act)
+        h = (1 - z) * n + z * states[0]
+        return h, [h]
+
+
+def _make(ndim, base, name, doc):
+    class Cell(base):
+        def __init__(self, input_shape, hidden_channels, i2h_kernel=3,
+                     h2h_kernel=3, activation="tanh", **kwargs):
+            super().__init__(input_shape, hidden_channels, i2h_kernel,
+                             h2h_kernel, activation, ndim=ndim, **kwargs)
+
+    Cell.__name__ = Cell.__qualname__ = name
+    Cell.__doc__ = doc
+    return Cell
+
+
+Conv1DRNNCell = _make(1, _ConvRNNCell, "Conv1DRNNCell",
+                      "1-D convolutional RNN cell (NCW states).")
+Conv2DRNNCell = _make(2, _ConvRNNCell, "Conv2DRNNCell",
+                      "2-D convolutional RNN cell (NCHW states).")
+Conv3DRNNCell = _make(3, _ConvRNNCell, "Conv3DRNNCell",
+                      "3-D convolutional RNN cell (NCDHW states).")
+Conv1DLSTMCell = _make(1, _ConvLSTMCell, "Conv1DLSTMCell",
+                       "1-D ConvLSTM cell (Shi et al. 2015).")
+Conv2DLSTMCell = _make(2, _ConvLSTMCell, "Conv2DLSTMCell",
+                       "2-D ConvLSTM cell (Shi et al. 2015).")
+Conv3DLSTMCell = _make(3, _ConvLSTMCell, "Conv3DLSTMCell",
+                       "3-D ConvLSTM cell (Shi et al. 2015).")
+Conv1DGRUCell = _make(1, _ConvGRUCell, "Conv1DGRUCell",
+                      "1-D convolutional GRU cell.")
+Conv2DGRUCell = _make(2, _ConvGRUCell, "Conv2DGRUCell",
+                      "2-D convolutional GRU cell.")
+Conv3DGRUCell = _make(3, _ConvGRUCell, "Conv3DGRUCell",
+                      "3-D convolutional GRU cell.")
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a projection layer on the hidden state (reference
+    contrib LSTMPCell; Sak et al. 2014): c stays hidden_size wide, h is
+    projected to projection_size before recurrence and output."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._proj = projection_size
+        H, P = hidden_size, projection_size
+        self.i2h_weight = Parameter("i2h_weight", shape=(4 * H, input_size),
+                                    init=init_mod.Xavier(),
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight", shape=(4 * H, P),
+                                    init=init_mod.Xavier())
+        self.h2r_weight = Parameter("h2r_weight", shape=(P, H),
+                                    init=init_mod.Xavier())
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * H,),
+                                  init=init_mod.Zero())
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * H,),
+                                  init=init_mod.Zero())
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._proj), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        if self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (4 * self._hidden_size,
+                                     inputs.shape[-1])
+            self.i2h_weight._finish_deferred_init()
+        H = self._hidden_size
+        gates = invoke("FullyConnected", inputs, self.i2h_weight.data(),
+                       self.i2h_bias.data(), num_hidden=4 * H,
+                       flatten=False) + \
+            invoke("FullyConnected", states[0], self.h2h_weight.data(),
+                   self.h2h_bias.data(), num_hidden=4 * H, flatten=False)
+        i, f, g, o = invoke("split", gates, num_outputs=4, axis=-1)
+        c = invoke("sigmoid", f) * states[1] + \
+            invoke("sigmoid", i) * invoke("tanh", g)
+        h_full = invoke("sigmoid", o) * invoke("tanh", c)
+        r = invoke("FullyConnected", h_full, self.h2r_weight.data(), None,
+                   num_hidden=self._proj, no_bias=True, flatten=False)
+        return r, [r, c]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Applies the SAME dropout mask at every time step (reference
+    contrib VariationalDropoutCell; Gal & Ghahramani 2016) to inputs,
+    states, and outputs of the wrapped cell."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0, **kwargs):
+        super().__init__(base_cell, **kwargs)
+        self._di, self._ds, self._do = drop_inputs, drop_states, drop_outputs
+        self.reset_masks()
+
+    def reset_masks(self):
+        self._masks = {}
+
+    def begin_state(self, batch_size=0, **kwargs):
+        self.reset_masks()  # new sequence → new masks
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+    def _mask(self, key, rate, like):
+        from .... import autograd, random as _random, ndarray as nd_mod
+        if not rate or not autograd.is_training():
+            return None
+        if key not in self._masks:
+            keep = 1.0 - rate
+            bern = nd_mod.random.bernoulli(keep, like.shape, ctx=like.ctx)
+            self._masks[key] = bern / keep
+        return self._masks[key]
+
+    def forward(self, inputs, states):
+        m = self._mask("i", self._di, inputs)
+        if m is not None:
+            inputs = inputs * m
+        ms = self._mask("s", self._ds, states[0])
+        if ms is not None:
+            states = [states[0] * ms] + list(states[1:])
+        out, new_states = self.base_cell(inputs, states)
+        mo = self._mask("o", self._do, out)
+        if mo is not None:
+            out = out * mo
+        return out, new_states

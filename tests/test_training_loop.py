@@ -172,3 +172,57 @@ def test_libinfo_error_log_modules():
     import pytest as _pytest
     with _pytest.raises(mx.MXNetError):
         check_call(rc)
+
+
+def test_batch_processor_and_gradient_update_handler():
+    """BatchProcessor customizes the per-batch flow; GradientUpdateHandler
+    owns the optimizer step (reference estimator/batch_processor.py,
+    event_handler.py GradientUpdateHandler) — gradient accumulation by
+    subclassing steps every N batches."""
+    import numpy as onp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, gluon
+    from incubator_mxnet_tpu.gluon import nn, loss as gloss
+    from incubator_mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    from incubator_mxnet_tpu.gluon.contrib.estimator import (
+        Estimator, BatchProcessor, GradientUpdateHandler)
+
+    X = nd.random.uniform(shape=(32, 6))
+    Y = nd.random.uniform(shape=(32, 2))
+    loader = DataLoader(ArrayDataset(X, Y), batch_size=8)
+
+    # custom processor: scales the loss (observable through train_loss)
+    class HalfLoss(BatchProcessor):
+        def fit_batch(self, estimator, batch, batch_axis=0):
+            d, l, p, loss = super().fit_batch(estimator, batch, batch_axis)
+            return d, l, p, loss * 0.5
+
+    net = nn.Dense(2, in_units=6)
+    net.initialize()
+    est = Estimator(net, gloss.L2Loss(),
+                    trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 0.1}),
+                    batch_processor=HalfLoss())
+    est.fit(loader, epochs=1)
+
+    # accumulation handler: steps every 2 batches only
+    class Accum(GradientUpdateHandler):
+        def __init__(self):
+            super().__init__()
+            self.calls = 0
+            self.steps = 0
+
+        def batch_end(self, estimator, *args, **kwargs):
+            self.calls += 1
+            if self.calls % 2 == 0:
+                estimator.trainer.step(estimator._last_batch_size * 2)
+                self.steps += 1
+
+    net2 = nn.Dense(2, in_units=6)
+    net2.initialize()
+    accum = Accum()
+    est2 = Estimator(net2, gloss.L2Loss(),
+                     trainer=gluon.Trainer(net2.collect_params(), "sgd",
+                                           {"learning_rate": 0.1}))
+    est2.fit(loader, epochs=1, event_handlers=[accum])
+    assert accum.calls == 4 and accum.steps == 2
