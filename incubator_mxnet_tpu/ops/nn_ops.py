@@ -707,5 +707,8 @@ def softmax_xent(logits, labels):
         out = pk.fused_softmax_xent(logits, lbl)
     else:
         lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        out = -jnp.take_along_axis(lp, lbl[:, None], axis=-1)[:, 0]
+        # pick(mode='clip') semantics, same as the Pallas kernel: padding
+        # labels like -1 clamp to a valid row instead of wrapping
+        safe = jnp.clip(lbl, 0, logits.shape[-1] - 1)
+        out = -jnp.take_along_axis(lp, safe[:, None], axis=-1)[:, 0]
     return out.astype(logits.dtype)

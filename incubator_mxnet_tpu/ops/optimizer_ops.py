@@ -28,7 +28,10 @@ __all__ = []
 
 def _prep(grad, rescale_grad, clip_gradient):
     g = grad * rescale_grad
-    if clip_gradient is not None and clip_gradient > 0:
+    # reference clips whenever clip_gradient >= 0 (optimizer_op-inl.h
+    # clip::Map guard) — clip_gradient=0.0 legitimately zeroes gradients;
+    # -1 (the default) means "off"
+    if clip_gradient is not None and clip_gradient >= 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
     return g
 
@@ -168,7 +171,9 @@ def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
     g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
     new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
-    new_weight = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    # reference denominator is sqrt(n) + eps (optimizer_op-inl.h:2025),
+    # NOT sqrt(n + eps) — the Alex variant below keeps eps inside
+    new_weight = weight - lr * g / (jnp.sqrt(new_n) + epsilon)
     if clip_weights is not None and clip_weights > 0:
         new_weight = jnp.clip(new_weight, -clip_weights, clip_weights)
     return new_weight.astype(weight.dtype), new_n.astype(n.dtype)

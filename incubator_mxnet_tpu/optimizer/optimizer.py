@@ -614,7 +614,8 @@ class RMSProp(Optimizer):
             (n,) = state
             new_n = (1 - self.gamma1) * g * g + self.gamma1 * n.data
             n._set_data(new_n)
-            new_w = w - lr * g / jnp.sqrt(new_n + self.epsilon)
+            # sqrt(n) + eps, matching rmsprop_update (optimizer_op-inl.h:2025)
+            new_w = w - lr * g / (jnp.sqrt(new_n) + self.epsilon)
         if self.clip_weights:
             new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
         weight._set_data(new_w)

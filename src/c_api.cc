@@ -32,6 +32,16 @@ namespace {
 using mxt::PyFail;
 using Gil = mxt::GilScope;
 
+// Every MX* entry point must verify the interpreter actually came up
+// before touching CPython (ADVICE r3: a failed Py_InitializeEx
+// otherwise crashes instead of returning -1 with MXGetLastError set).
+#define MXT_GIL_OR_FAIL                                         \
+  Gil gil;                                                      \
+  if (!gil.ok()) {                                              \
+    mxt::SetLastError("python runtime failed to initialize");   \
+    return -1;                                                  \
+  }
+
 struct RetStore {
   std::vector<int64_t> shape;
   std::vector<std::string> strings;
@@ -122,11 +132,7 @@ extern "C" {
 const char* MXGetLastError(void) { return MXTGetLastError(); }
 
 int MXGetVersion(int* out) {
-  Gil gil;
-  if (!gil.ok()) {
-    mxt::SetLastError("python runtime failed to initialize");
-    return -1;
-  }
+  MXT_GIL_OR_FAIL
   PyObject* r = CallBridge("version", {});
   if (!r) return PyFail("MXGetVersion");
   *out = (int)PyLong_AsLong(r);
@@ -135,7 +141,7 @@ int MXGetVersion(int* out) {
 }
 
 int MXRandomSeed(int seed) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* r = CallBridge("seed", {PyLong_FromLong(seed)});
   if (!r) return PyFail("MXRandomSeed");
   Py_DECREF(r);
@@ -146,7 +152,7 @@ int MXRandomSeed(int seed) {
 
 int MXNDArrayCreate(const int64_t* shape, uint32_t ndim, int dtype,
                     int dev_type, int dev_id, NDArrayHandle* out) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* r = CallBridge(
       "create", {IntTuple(shape, ndim), PyLong_FromLong(dtype),
                  PyLong_FromLong(dev_type), PyLong_FromLong(dev_id)});
@@ -157,14 +163,14 @@ int MXNDArrayCreate(const int64_t* shape, uint32_t ndim, int dtype,
 
 int MXNDArrayFree(NDArrayHandle h) {
   if (!h || !Py_IsInitialized()) return 0;
-  Gil gil;
+  MXT_GIL_OR_FAIL
   Py_DECREF(static_cast<PyObject*>(h));
   return 0;
 }
 
 int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void* data,
                              uint64_t nbytes) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* o = static_cast<PyObject*>(h);
   Py_INCREF(o);
   PyObject* r = CallBridge(
@@ -177,7 +183,7 @@ int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void* data,
 }
 
 int MXNDArraySyncCopyToCPU(NDArrayHandle h, void* data, uint64_t nbytes) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* o = static_cast<PyObject*>(h);
   Py_INCREF(o);
   PyObject* r = CallBridge("get_bytes", {o});
@@ -202,7 +208,7 @@ int MXNDArraySyncCopyToCPU(NDArrayHandle h, void* data, uint64_t nbytes) {
 
 int MXNDArrayGetShape(NDArrayHandle h, uint32_t* out_dim,
                       const int64_t** out_pdata) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* o = static_cast<PyObject*>(h);
   Py_INCREF(o);
   PyObject* r = CallBridge("get_shape", {o});
@@ -218,7 +224,7 @@ int MXNDArrayGetShape(NDArrayHandle h, uint32_t* out_dim,
 }
 
 int MXNDArrayGetDType(NDArrayHandle h, int* out) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* o = static_cast<PyObject*>(h);
   Py_INCREF(o);
   PyObject* r = CallBridge("get_dtype", {o});
@@ -229,7 +235,7 @@ int MXNDArrayGetDType(NDArrayHandle h, int* out) {
 }
 
 int MXNDArrayGetContext(NDArrayHandle h, int* out_dev_type, int* out_dev_id) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* o = static_cast<PyObject*>(h);
   Py_INCREF(o);
   PyObject* r = CallBridge("get_context", {o});
@@ -242,7 +248,7 @@ int MXNDArrayGetContext(NDArrayHandle h, int* out_dev_type, int* out_dev_id) {
 
 int MXNDArraySlice(NDArrayHandle h, int64_t begin, int64_t end,
                    NDArrayHandle* out) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* o = static_cast<PyObject*>(h);
   Py_INCREF(o);
   PyObject* r = CallBridge("slice_", {o, PyLong_FromLongLong(begin),
@@ -253,7 +259,7 @@ int MXNDArraySlice(NDArrayHandle h, int64_t begin, int64_t end,
 }
 
 int MXNDArrayAt(NDArrayHandle h, int64_t idx, NDArrayHandle* out) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* o = static_cast<PyObject*>(h);
   Py_INCREF(o);
   PyObject* r = CallBridge("at", {o, PyLong_FromLongLong(idx)});
@@ -264,7 +270,7 @@ int MXNDArrayAt(NDArrayHandle h, int64_t idx, NDArrayHandle* out) {
 
 int MXNDArrayReshape(NDArrayHandle h, int ndim, const int64_t* dims,
                      NDArrayHandle* out) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* o = static_cast<PyObject*>(h);
   Py_INCREF(o);
   PyObject* r = CallBridge("reshape", {o, IntTuple(dims, (uint32_t)ndim)});
@@ -274,7 +280,7 @@ int MXNDArrayReshape(NDArrayHandle h, int ndim, const int64_t* dims,
 }
 
 int MXNDArrayWaitToRead(NDArrayHandle h) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* o = static_cast<PyObject*>(h);
   Py_INCREF(o);
   PyObject* r = CallBridge("wait_to_read", {o});
@@ -284,7 +290,7 @@ int MXNDArrayWaitToRead(NDArrayHandle h) {
 }
 
 int MXNDArrayWaitAll(void) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* r = CallBridge("waitall", {});
   if (!r) return PyFail("MXNDArrayWaitAll");
   Py_DECREF(r);
@@ -293,7 +299,7 @@ int MXNDArrayWaitAll(void) {
 
 int MXNDArraySave(const char* fname, uint32_t num, NDArrayHandle* args,
                   const char** keys) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* names = keys ? StrList(keys, num) : (Py_INCREF(Py_None), Py_None);
   PyObject* r = CallBridge("save", {PyUnicode_FromString(fname), names,
                                     HandleList(args, num)});
@@ -305,7 +311,7 @@ int MXNDArraySave(const char* fname, uint32_t num, NDArrayHandle* args,
 int MXNDArrayLoad(const char* fname, uint32_t* out_size,
                   NDArrayHandle** out_arr, uint32_t* out_name_size,
                   const char*** out_names) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* r = CallBridge("load", {PyUnicode_FromString(fname)});
   if (!r) return PyFail("MXNDArrayLoad");
   PyObject* names = PyTuple_GetItem(r, 0);
@@ -330,7 +336,7 @@ int MXNDArrayLoad(const char* fname, uint32_t* out_size,
 /* ------------------------- Operators ----------------------------------- */
 
 int MXListAllOpNames(uint32_t* out_size, const char*** out_array) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* r = CallBridge("list_ops", {});
   if (!r) return PyFail("MXListAllOpNames");
   int rc = StoreStrList(r, out_size, out_array, "MXListAllOpNames");
@@ -343,7 +349,7 @@ int MXImperativeInvokeByName(const char* op_name, int num_inputs,
                              NDArrayHandle** outputs, int num_params,
                              const char** param_keys,
                              const char** param_vals) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* r = CallBridge(
       "invoke", {PyUnicode_FromString(op_name),
                  HandleList(inputs, (uint32_t)num_inputs),
@@ -366,7 +372,7 @@ int MXImperativeInvokeByName(const char* op_name, int num_inputs,
 /* ------------------------- KVStore ------------------------------------- */
 
 int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* r = CallBridge("kv_create", {PyUnicode_FromString(type)});
   if (!r) return PyFail("MXKVStoreCreate");
   *out = r;
@@ -378,7 +384,7 @@ int MXKVStoreFree(KVStoreHandle h) { return MXNDArrayFree(h); }
 static int KvPerKey(const char* fn, KVStoreHandle h, uint32_t num,
                     const char** keys, NDArrayHandle* vals, int priority,
                     bool with_priority, const char* where) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   for (uint32_t i = 0; i < num; ++i) {
     PyObject* kv = static_cast<PyObject*>(h);
     PyObject* arr = static_cast<PyObject*>(vals[i]);
@@ -411,7 +417,7 @@ int MXKVStorePullEx(KVStoreHandle h, uint32_t num, const char** keys,
 }
 
 int MXKVStoreGetType(KVStoreHandle h, const char** out) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* o = static_cast<PyObject*>(h);
   Py_INCREF(o);
   PyObject* r = CallBridge("kv_type", {o});
@@ -429,7 +435,7 @@ int MXKVStoreGetType(KVStoreHandle h, const char** out) {
 
 static int KvInt(const char* fn, KVStoreHandle h, int* out,
                  const char* where) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* o = static_cast<PyObject*>(h);
   Py_INCREF(o);
   PyObject* r = CallBridge(fn, {o});
@@ -450,7 +456,7 @@ int MXKVStoreGetGroupSize(KVStoreHandle h, int* out) {
 /* ------------------------- Symbol -------------------------------------- */
 
 int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* r = CallBridge("sym_from_json", {PyUnicode_FromString(json)});
   if (!r) return PyFail("MXSymbolCreateFromJSON");
   *out = r;
@@ -458,7 +464,7 @@ int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
 }
 
 int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* r = CallBridge("sym_from_file", {PyUnicode_FromString(fname)});
   if (!r) return PyFail("MXSymbolCreateFromFile");
   *out = r;
@@ -466,7 +472,7 @@ int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out) {
 }
 
 int MXSymbolSaveToJSON(SymbolHandle h, const char** out_json) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* o = static_cast<PyObject*>(h);
   Py_INCREF(o);
   PyObject* r = CallBridge("sym_to_json", {o});
@@ -484,7 +490,7 @@ int MXSymbolSaveToJSON(SymbolHandle h, const char** out_json) {
 
 static int SymStrList(const char* fn, SymbolHandle h, uint32_t* out_size,
                       const char*** out, const char* where) {
-  Gil gil;
+  MXT_GIL_OR_FAIL
   PyObject* o = static_cast<PyObject*>(h);
   Py_INCREF(o);
   PyObject* r = CallBridge(fn, {o});

@@ -118,7 +118,7 @@ def test_rmsprop_update(wg):
     exp_n = 0.1 * g * g
     onp.testing.assert_allclose(_np(new_n), exp_n, rtol=1e-5)
     onp.testing.assert_allclose(
-        _np(new_w), w - 0.01 * g / onp.sqrt(exp_n + 1e-8), rtol=1e-5)
+        _np(new_w), w - 0.01 * g / (onp.sqrt(exp_n) + 1e-8), rtol=1e-5)
 
 
 def test_rmspropalex_update_shapes(wg):
