@@ -47,8 +47,10 @@ def _child(platform: str) -> None:
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     if platform == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
-        sweep = [int(os.environ.get("BENCH_CPU_BATCH", "32"))]
-        steps = int(os.environ.get("BENCH_CPU_STEPS", "3"))
+        # sized so compile (~100s) + 3 steps fit the 300s CPU reserve:
+        # measured 84s/step at bs=32 on this host, ~21s at bs=8
+        sweep = [int(os.environ.get("BENCH_CPU_BATCH", "8"))]
+        steps = int(os.environ.get("BENCH_CPU_STEPS", "2"))
         warmup = 1
 
     # persistent compilation cache: the fused-step compile costs ~30s on
@@ -217,43 +219,81 @@ def _run_child(platform: str, timeout: float, extra_env=None):
     return None
 
 
+def _probe_tpu(timeout: float) -> bool:
+    """Cheap liveness check: can a child see the accelerator and run one
+    tiny op?  A wedged tunnel hangs at the first device touch, so this
+    answers in ~20s healthy / `timeout`s wedged — far cheaper than
+    discovering the wedge inside a full benchmark attempt."""
+    code = ("import jax, jax.numpy as jnp;"
+            "d = jax.devices()[0];"
+            "x = jax.device_put(jnp.ones((128, 128), jnp.bfloat16), d);"
+            "(x @ x).block_until_ready();"
+            "print('PROBE_OK', d.platform)")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"[bench] TPU probe timed out after {timeout:.0f}s",
+              file=sys.stderr, flush=True)
+        return False
+    # require a non-CPU platform: JAX silently falling back to the host
+    # backend also prints PROBE_OK, and running the full TPU sweep on
+    # CPU would burn the whole budget
+    ok = any(ln.startswith("PROBE_OK") and not ln.endswith(" cpu")
+             for ln in proc.stdout.splitlines())
+    print(f"[bench] TPU probe: {'alive' if ok else 'failed'} "
+          f"({proc.stdout.strip()[:200]})", file=sys.stderr, flush=True)
+    return ok
+
+
 def main():
     if len(sys.argv) > 2 and sys.argv[1] == "--child":
         _child(sys.argv[2])
         return
 
-    # healthy-chip sweep needs ~5 min; a wedged tunnel hangs forever,
-    # so keep the per-attempt ceiling tight enough that the CPU
-    # fallback still lands inside the driver's bench window
-    tpu_timeout = float(os.environ.get("BENCH_TIMEOUT", "600"))
-    cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", "1500"))
-    attempts = int(os.environ.get("BENCH_ATTEMPTS", "2"))
+    # Round-4 policy (VERDICT r3 Weak #1): ONE total deadline, not
+    # per-attempt timeouts.  Every phase is sized to the time actually
+    # remaining, and the CPU fallback owns the last BENCH_CPU_RESERVE
+    # seconds unconditionally — bench.py must emit a JSON line before
+    # the driver's window closes, never rc=124 with nothing parsed.
+    t_start = time.monotonic()
+    deadline = float(os.environ.get("BENCH_DEADLINE", "900"))
+    cpu_reserve = float(os.environ.get("BENCH_CPU_RESERVE", "300"))
+    remaining = lambda: deadline - (time.monotonic() - t_start)  # noqa: E731
 
     result = None
     if os.environ.get("BENCH_PLATFORM", "tpu") != "cpu":
-        for i in range(attempts):
-            result = _run_child("tpu", tpu_timeout)
-            if result is not None:
-                break
-            print(f"[bench] TPU attempt {i + 1}/{attempts} failed",
-                  file=sys.stderr, flush=True)
-        if result is None and os.environ.get("BENCH_PALLAS_FALLBACK",
-                                             "1") != "0":
-            # last-resort degraded mode BEFORE giving up the chip: if
-            # every same-config attempt failed (e.g. a Pallas kernel
-            # fails Mosaic compilation on this hardware), one try with
-            # the pallas paths disabled — slower but honest, and better
-            # than the CPU fallback
-            print("[bench] retrying with pallas kernels disabled",
-                  file=sys.stderr, flush=True)
-            result = _run_child("tpu", tpu_timeout,
-                                {"MXNET_USE_PALLAS": "0"})
-            if result is not None:
-                result["note"] = "pallas kernels disabled (fallback)"
+        probe_t = min(float(os.environ.get("BENCH_PROBE_TIMEOUT", "120")),
+                      max(remaining() - cpu_reserve, 0))
+        if probe_t > 30 and _probe_tpu(probe_t):
+            # main attempt gets everything except the CPU reserve
+            budget = remaining() - cpu_reserve
+            if budget > 120:
+                result = _run_child("tpu", budget)
+            if result is None and os.environ.get(
+                    "BENCH_PALLAS_FALLBACK", "1") != "0":
+                # degraded mode before giving up the chip (e.g. a Pallas
+                # kernel failing Mosaic compile on this hardware) — only
+                # if real time remains beyond the CPU reserve
+                budget = remaining() - cpu_reserve
+                if budget > 120:
+                    print("[bench] retrying with pallas kernels disabled",
+                          file=sys.stderr, flush=True)
+                    degraded = {"MXNET_USE_PALLAS": "0"}
+                    if "BENCH_SWEEP" not in os.environ:
+                        degraded["BENCH_SWEEP"] = "128"  # one bs: save time
+                    result = _run_child("tpu", budget, degraded)
+                    if result is not None:
+                        result["note"] = "pallas kernels disabled (fallback)"
+        else:
+            print("[bench] accelerator not reachable — skipping TPU "
+                  "attempts", file=sys.stderr, flush=True)
     if result is None:
-        print("[bench] falling back to CPU benchmark", file=sys.stderr,
-              flush=True)
-        result = _run_child("cpu", cpu_timeout)
+        budget = max(remaining() - 15, 60)  # 15s margin to print JSON
+        print(f"[bench] falling back to CPU benchmark "
+              f"({budget:.0f}s budget)", file=sys.stderr, flush=True)
+        result = _run_child("cpu", budget)
     if result is None:
         print(json.dumps({
             "metric": "resnet50_train_img_per_sec",

@@ -39,6 +39,20 @@ def _join_distributed_from_env():
     coord = os.environ.get("MXT_COORDINATOR")
     if n <= 1 or not coord or os.environ.get("MXT_SERVERS"):
         return
+    if os.environ.get("MXT_WORKER_ID_FROM_MPI") and \
+            "MXT_WORKER_ID" not in os.environ:
+        # mpi launcher (tools/launch.py launch_mpi): rank-dependent vars
+        # can't ride mpirun -x, so derive the id from the MPI/PMI env
+        for var in ("OMPI_COMM_WORLD_RANK", "PMIX_RANK", "PMI_RANK",
+                    "SLURM_PROCID"):
+            if var in os.environ:
+                os.environ["MXT_WORKER_ID"] = os.environ[var]
+                break
+        else:
+            raise RuntimeError(
+                "MXT_WORKER_ID_FROM_MPI is set but no MPI rank variable "
+                "(OMPI_COMM_WORLD_RANK/PMIX_RANK/PMI_RANK/SLURM_PROCID) "
+                "is present")
     import jax
     try:
         jax.distributed.initialize(

@@ -151,16 +151,31 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             return
-        if isinstance(optimizer, str):
-            optimizer = _opt.create(optimizer, **dict(optimizer_params))
-        optimizer.param_idx2name = {i: n
-                                    for i, n in enumerate(self._param_names)}
-        self._optimizer = optimizer
-        self._updater = _opt.get_updater(optimizer)
         kv = kvstore
         if isinstance(kv, str):
             from ..kvstore import create as kv_create
             kv = kv_create(kv) if kv else None
+        if isinstance(optimizer, str):
+            params = dict(optimizer_params)
+            # reference module.py:506-518: a string optimizer gets
+            # rescale_grad = 1/(batch_size * num_workers) injected unless
+            # the caller set it — layer grads are batch sums, so without
+            # this the effective lr scales with batch size
+            if "rescale_grad" not in params and self._data_shapes:
+                batch = self._data_shapes[0][1][0]
+                # num_workers enters only for dist-SYNC stores (reference
+                # guard `'dist' in type and '_sync' in type`): sync sums
+                # pushes across workers, async applies each push alone
+                nworkers = 1
+                if kv is not None and "dist" in getattr(kv, "type", "") \
+                        and "sync" in kv.type:
+                    nworkers = kv.num_workers
+                params["rescale_grad"] = 1.0 / (batch * nworkers)
+            optimizer = _opt.create(optimizer, **params)
+        optimizer.param_idx2name = {i: n
+                                    for i, n in enumerate(self._param_names)}
+        self._optimizer = optimizer
+        self._updater = _opt.get_updater(optimizer)
         self._kvstore = kv
         if kv is not None and getattr(kv, "is_capable", None) and \
                 kv.is_capable("optimizer"):

@@ -52,13 +52,20 @@ def nanprod(x, axis=None, keepdims=False):
 
 @register("argmax", num_inputs=1, differentiable=False)
 def argmax(x, axis=None, keepdims=False):
-    out = jnp.argmax(x, axis=axis, keepdims=keepdims).astype(jnp.float32)
-    return out
+    # reference returns float32 indices; under x64 (the large-tensor
+    # mode, tests/test_large_tensor.py) widen to float64 — float32 only
+    # represents integers exactly up to 2**24
+    import jax
+    ftype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    return jnp.argmax(x, axis=axis, keepdims=keepdims).astype(ftype)
 
 
 @register("argmin", num_inputs=1, differentiable=False)
 def argmin(x, axis=None, keepdims=False):
-    return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(jnp.float32)
+    # same index-exactness widening as argmax above
+    import jax
+    ftype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(ftype)
 
 
 @register("norm", num_inputs=1)

@@ -1,0 +1,42 @@
+"""tools/bandwidth.py — collective-bandwidth probe (reference
+tools/bandwidth/measure.py role) on the 8-device CPU mesh."""
+import os
+import sys
+
+import jax
+import numpy as onp
+from jax.sharding import Mesh
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import bandwidth  # noqa: E402
+
+
+def _mesh():
+    return Mesh(onp.array(jax.devices()), ("x",))
+
+
+def test_psum_collective_correct_and_timed():
+    import jax.numpy as jnp
+    mesh = _mesh()
+    n = mesh.shape["x"]
+    jitted = bandwidth._mk_collective("psum", mesh)
+    x = jnp.arange(8 * n, dtype=jnp.float32)
+    out = jitted(x)
+    # psum over the mesh axis: every shard becomes the sum of all shards
+    shards = onp.asarray(x).reshape(n, -1)
+    expect = onp.tile(shards.sum(0), n)
+    onp.testing.assert_allclose(onp.asarray(out), expect, rtol=1e-6)
+    dt = bandwidth._time_collective(jitted, x, iters=2, warmup=1)
+    assert dt > 0
+
+
+def test_sweep_rows_and_algo_factors():
+    args = bandwidth.parse_args(
+        ["--min-mb", "0.05", "--max-mb", "0.05", "--iters", "2",
+         "--warmup", "1", "--collectives", "psum,all_gather"])
+    rows = bandwidth.run_sweep(args, _mesh())
+    assert {r["collective"] for r in rows} == {"psum", "all_gather"}
+    assert all(r["algo_gb_s"] > 0 for r in rows)
+    n = 8
+    assert bandwidth.ALGO_FACTOR["psum"](n) == 2 * (n - 1) / n
+    assert bandwidth.ALGO_FACTOR["all_gather"](n) == (n - 1) / n

@@ -260,3 +260,131 @@ def test_gradient_compression_residuals_per_key():
     kv.push("a", nd.ones((4,)) * 0.3)   # a's residual 0.3+0.3 fires
     kv.pull("a", out=out)
     onp.testing.assert_allclose(out.asnumpy(), 0.5)
+
+
+# ---------------------------------------------------------------------------
+# ssh / mpi launchers (VERDICT r3 Next #6, reference tools/launch.py:72-74
+# dispatching to dmlc_tracker ssh.py / mpi.py).  No sshd/mpirun exists in
+# this image, so the transport is injected: a shim that executes the
+# remote shell command locally.  Everything else — hostfile parsing,
+# worker-id assignment, coordination env marshaling through the remote
+# command line, server placement on the head host — is the real path.
+# ---------------------------------------------------------------------------
+
+def _write_exec(path, text):
+    with open(path, "w") as f:
+        f.write(text)
+    os.chmod(path, 0o755)
+
+
+def test_launch_ssh_loopback(tmp_path):
+    ssh = tmp_path / "fake_ssh"
+    # argv: <host> <remote command> — run it locally, as sshd would
+    _write_exec(ssh, '#!/bin/bash\nshift\nexec bash -c "$1"\n')
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("127.0.0.1:2\n")
+
+    script = os.path.join(REPO, "tests", "_dist_ssh_worker_tmp.py")
+    with open(script, "w") as f:
+        f.write(_WORKER_SCRIPT)
+    env = dict(os.environ)
+    env["MXT_REPO"] = REPO
+    env["MXT_TEST_KVTYPE"] = "dist_sync"
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+             "-n", "2", "-s", "1", "--kv-mode", "sync",
+             "--launcher", "ssh", "-H", str(hostfile),
+             "--ssh-cmd", str(ssh),
+             sys.executable, script],
+            env=env, capture_output=True, text=True, timeout=240)
+    finally:
+        os.unlink(script)
+    assert proc.returncode == 0, (
+        f"ssh launcher failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}")
+
+
+def test_launch_mpi_fake_mpirun(tmp_path):
+    """launch_mpi builds the mpirun command; ranks derive MXT_WORKER_ID
+    from OMPI_COMM_WORLD_RANK (set per-rank by the fake mpirun here,
+    by the real one in production)."""
+    mpirun = tmp_path / "fake_mpirun"
+    _write_exec(mpirun, """#!/usr/bin/env python
+import os, subprocess, sys
+args = sys.argv[1:]
+np, envs, cmd = 0, {}, []
+i = 0
+while i < len(args):
+    if args[i] == "-np":
+        np = int(args[i + 1]); i += 2
+    elif args[i] == "--hostfile":
+        i += 2
+    elif args[i] == "-x":
+        k, _, v = args[i + 1].partition("="); envs[k] = v; i += 2
+    else:
+        cmd = args[i:]; break
+procs = []
+for r in range(np):
+    env = dict(os.environ); env.update(envs)
+    env["OMPI_COMM_WORLD_RANK"] = str(r)
+    procs.append(subprocess.Popen(cmd, env=env))
+sys.exit(max(p.wait() for p in procs))
+""")
+    out_dir = tmp_path / "ranks"
+    out_dir.mkdir()
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(f"""
+        import os
+        # the launcher must have marshaled these through mpirun -x
+        assert os.environ["MXT_NUM_WORKERS"] == "2"
+        assert os.environ["MXT_WORKER_ID_FROM_MPI"] == "1"
+        assert os.environ["MXT_COORDINATOR"]
+        rank = os.environ["OMPI_COMM_WORLD_RANK"]
+        open(os.path.join({str(out_dir)!r}, rank), "w").write("ok")
+    """))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "mpi",
+         "--mpirun-cmd", str(mpirun),
+         sys.executable, str(worker)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert sorted(p.name for p in out_dir.iterdir()) == ["0", "1"]
+
+
+def test_hostfile_parsing_and_assignment(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import launch
+    hf = tmp_path / "hosts"
+    hf.write_text("# comment\nhostA slots=2\nhostB:1\nhostC\n")
+    hosts = launch.read_hostfile(str(hf))
+    assert hosts == [("hostA", 2), ("hostB", 1), ("hostC", 1)]
+    # slots first, then round-robin oversubscription
+    assert launch._assign_hosts(hosts, 6) == [
+        "hostA", "hostA", "hostB", "hostC", "hostA", "hostB"]
+
+
+def test_mpi_rank_derivation(monkeypatch):
+    import jax
+    import incubator_mxnet_tpu as mx_pkg
+    calls = {}
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda **kw: calls.update(kw))
+    monkeypatch.setenv("MXT_NUM_WORKERS", "4")
+    monkeypatch.setenv("MXT_COORDINATOR", "10.0.0.1:9009")
+    monkeypatch.setenv("MXT_WORKER_ID_FROM_MPI", "1")
+    monkeypatch.delenv("MXT_WORKER_ID", raising=False)
+    monkeypatch.delenv("MXT_SERVERS", raising=False)
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
+    mx_pkg._join_distributed_from_env()
+    assert calls == {"coordinator_address": "10.0.0.1:9009",
+                     "num_processes": 4, "process_id": 3}
+    # no rank variable at all -> loud failure, not a silent id=0 join
+    monkeypatch.delenv("OMPI_COMM_WORLD_RANK")
+    monkeypatch.delenv("MXT_WORKER_ID", raising=False)
+    with pytest.raises(RuntimeError, match="no MPI rank"):
+        mx_pkg._join_distributed_from_env()

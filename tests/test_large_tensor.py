@@ -1,0 +1,76 @@
+"""Large-tensor (>2**31 elements) support — the reference's
+tests/nightly/test_large_array.py / test_large_vector.py role.
+
+Like the reference these are NIGHTLY tests (a >=2.1 GB allocation per
+case), gated by MXNET_TEST_LARGE_TENSOR=1; the default suite skips them.
+Run:  MXNET_TEST_LARGE_TENSOR=1 python -m pytest tests/test_large_tensor.py
+
+Design note: the reference gates int64 tensor sizes behind a BUILD flag
+(USE_INT64_TENSOR_SIZE); the XLA analog is a RUNTIME flag —
+``jax_enable_x64`` — without which gather/scatter indices are silently
+truncated to int32 and element access past 2**31 wraps around.  The
+fixture below enables it for these tests; production large-tensor users
+set JAX_ENABLE_X64=1 (documented in docs/env_vars.md).
+"""
+import os
+
+import numpy as onp
+import pytest
+
+import jax
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+LARGE = int(2**31) + 16  # one past the int32 boundary
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("MXNET_TEST_LARGE_TENSOR") != "1",
+    reason="nightly: >2**31-element allocations (set "
+           "MXNET_TEST_LARGE_TENSOR=1)")
+
+
+@pytest.fixture(autouse=True)
+def _x64_indices():
+    jax.config.update("jax_enable_x64", True)
+    yield
+    jax.config.update("jax_enable_x64", False)
+
+
+def test_create_index_past_int31():
+    x = nd.zeros((LARGE,), dtype="int8")
+    assert x.shape == (LARGE,)
+    assert x.size == LARGE
+    # writes + reads on both sides of the 2**31 boundary
+    x[2**31 - 1] = 3
+    x[2**31 + 1] = 5
+    assert int(x[2**31 - 1].asscalar()) == 3
+    assert int(x[2**31 + 1].asscalar()) == 5
+    assert int(x[0].asscalar()) == 0
+
+
+def test_reduce_and_argmax_past_int31():
+    x = nd.zeros((LARGE,), dtype="int8")
+    x[LARGE - 2] = 7
+    # the argmax index must come back untruncated (float64 under x64;
+    # float32 would round 2**31+14 away)
+    assert int(x.sum().asscalar()) == 7
+    assert int(x.argmax().asscalar()) == LARGE - 2
+
+
+def test_slice_across_boundary():
+    idx = onp.arange(LARGE - 8, LARGE, dtype=onp.int64)
+    vals = (idx % 97).astype(onp.float32)
+    big = nd.zeros((LARGE,), dtype="float32")
+    big[LARGE - 8:LARGE] = nd.array(vals)
+    out = big[LARGE - 8:LARGE].asnumpy()
+    onp.testing.assert_allclose(out, vals)
+    assert float(big[LARGE - 9].asscalar()) == 0.0
+
+
+def test_2d_large_rows():
+    # one row beyond 2**31/2**16 so the total crosses the boundary
+    rows = LARGE // (2**16) + 1
+    x = nd.ones((rows, 2**16), dtype="int8")
+    assert x.size > 2**31
+    assert int(x[rows - 1].sum().asscalar()) == 2**16

@@ -75,7 +75,10 @@ def test_svrg_fit_converges():
     x, y, w_true = _toy_data(n=64)
     mod = _linreg_module(update_freq=2)
     it = NDArrayIter(x, y, batch_size=16, shuffle=False)
-    mod.fit(it, eval_metric="mse", num_epoch=30)
+    # lr set for the reference's rescale_grad=1/batch convention
+    # (module.py:506-518): per-sample-mean gradients need a larger step
+    mod.fit(it, eval_metric="mse", num_epoch=30,
+            optimizer_params=(("learning_rate", 0.4),))
     w = mod.get_params()[0]["fc_weight"].asnumpy().ravel()
     onp.testing.assert_allclose(w, w_true.ravel(), rtol=0.05, atol=0.05)
 

@@ -38,6 +38,19 @@ def _free_port():
     return port
 
 
+def _server_code(port, kv_mode, num_workers):
+    """Bootstrap string for one PS server process.  Servers are CPU
+    processes (reference: server role never owns a GPU); the cpu
+    backend is forced BEFORE anything imports jax — the server-side
+    optimizer path uses jnp and must not touch the accelerator plugin."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return (f"import sys; sys.path.insert(0, {repo_root!r}); "
+            f"import jax; jax.config.update('jax_platforms', 'cpu'); "
+            f"from incubator_mxnet_tpu.kvstore.ps_server import "
+            f"serve_forever; "
+            f"serve_forever({port}, {kv_mode!r}, {num_workers})")
+
+
 def launch_local(args, extra_env=None):
     """Spawn servers + workers on this host; returns worker exit codes."""
     procs = []
@@ -45,43 +58,22 @@ def launch_local(args, extra_env=None):
     env_base.update(extra_env or {})
 
     server_ports = []
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     for i in range(args.num_servers):
         port = _free_port()
         server_ports.append(port)
         env = dict(env_base)
         env["DMLC_ROLE"] = "server"
         env["JAX_PLATFORMS"] = "cpu"
-        # servers are CPU processes (reference: server role never owns a
-        # GPU); force the cpu backend BEFORE anything imports jax — the
-        # server-side optimizer path uses jnp and must not touch the
-        # accelerator plugin
-        code = (f"import sys; sys.path.insert(0, {repo_root!r}); "
-                f"import jax; jax.config.update('jax_platforms', 'cpu'); "
-                f"from incubator_mxnet_tpu.kvstore.ps_server import "
-                f"serve_forever; "
-                f"serve_forever({port}, {args.kv_mode!r}, {args.num_workers})")
+        code = _server_code(port, args.kv_mode, args.num_workers)
         procs.append(("server", subprocess.Popen(
             [sys.executable, "-c", code], env=env)))
 
     coordinator = f"127.0.0.1:{_free_port()}"
+    server_addrs = [f"127.0.0.1:{p}" for p in server_ports]
     workers = []
     for i in range(args.num_workers):
         env = dict(env_base)
-        env.update({
-            "DMLC_ROLE": "worker",
-            "DMLC_NUM_WORKER": str(args.num_workers),
-            "DMLC_WORKER_ID": str(i),
-            "DMLC_NUM_SERVER": str(args.num_servers),
-            "MXT_COORDINATOR": coordinator,
-            "MXT_NUM_WORKERS": str(args.num_workers),
-            "MXT_WORKER_ID": str(i),
-            "MXT_SERVERS": ",".join(f"127.0.0.1:{p}" for p in server_ports),
-            "MXT_KV_MODE": args.kv_mode,
-        })
-        for kv in args.env_worker + args.env:
-            k, _, v = kv.partition(":")
-            env[k] = v
+        env.update(_worker_env(args, i, coordinator, server_addrs))
         p = subprocess.Popen(args.command, env=env)
         workers.append(p)
         procs.append(("worker", p))
@@ -91,6 +83,162 @@ def launch_local(args, extra_env=None):
         if role == "server" and p.poll() is None:
             p.send_signal(signal.SIGTERM)
     return codes
+
+
+def read_hostfile(path):
+    """Reference dmlc hostfile format: one ``host`` (optionally
+    ``host:slots`` or ``host slots=N``) per line; # comments."""
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            slots = 1
+            if " slots=" in line:
+                host, _, s = line.partition(" slots=")
+                slots = int(s)
+            elif ":" in line:
+                host, _, s = line.partition(":")
+                slots = int(s)
+            else:
+                host = line
+            hosts.append((host.strip(), slots))
+    if not hosts:
+        raise ValueError(f"hostfile {path} is empty")
+    return hosts
+
+
+def _worker_env(args, i, coordinator, server_addrs):
+    env = {
+        "DMLC_ROLE": "worker",
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_WORKER_ID": str(i),
+        "DMLC_NUM_SERVER": str(args.num_servers),
+        "MXT_COORDINATOR": coordinator,
+        "MXT_NUM_WORKERS": str(args.num_workers),
+        "MXT_WORKER_ID": str(i),
+        "MXT_SERVERS": ",".join(server_addrs),
+        "MXT_KV_MODE": args.kv_mode,
+    }
+    for kv in args.env_worker + args.env:
+        k, _, v = kv.partition(":")
+        env[k] = v
+    return env
+
+
+def _assign_hosts(hosts, n):
+    """Round-robin n workers over (host, slots) respecting slots first."""
+    flat = [h for h, slots in hosts for _ in range(slots)]
+    if len(flat) < n:  # oversubscribe round-robin like dmlc ssh tracker
+        flat = flat + [hosts[i % len(hosts)][0]
+                       for i in range(n - len(flat))]
+    return flat[:n]
+
+
+def _sh_quote(s):
+    import shlex
+    return shlex.quote(s)
+
+
+def launch_ssh(args, extra_env=None):
+    """Reference dmlc_tracker/ssh.py semantics, TPU-native rendezvous:
+    one ssh per worker carrying the coordination env inline (``env K=V
+    ... cd DIR && exec CMD``); jax.distributed.initialize on each host
+    joins the coordinator on the first host.  PS servers (if any) run on
+    the first host.  ``--ssh-cmd`` injects the transport — tests use a
+    shim that runs the remote shell locally; production uses real ssh
+    with agent/keys (StrictHostKeyChecking left to the user's config).
+    """
+    hosts = read_hostfile(args.hostfile)
+    assignment = _assign_hosts(hosts, args.num_workers)
+    head = assignment[0]
+    # Ports are probed on the LAUNCHER (a heuristic: free here says
+    # nothing certain about the head host).  A remote bind failure is
+    # loud — serve_forever raises, ssh exits nonzero, and workers error
+    # out connecting — and --port pins the coordinator deterministically
+    # for schedulers that pre-allocate ports.
+    port = args.port or _free_port()
+    coordinator = f"{head}:{port}"
+    ssh_cmd = args.ssh_cmd.split()
+    workdir = args.sync_dst_dir or os.getcwd()
+
+    procs = []
+    server_addrs = []
+    for i in range(args.num_servers):
+        sport = _free_port()
+        server_addrs.append(f"{head}:{sport}")
+        code = _server_code(sport, args.kv_mode, args.num_workers)
+        # Lifecycle: the server runs in the remote shell's background
+        # while `cat` holds the ssh channel open; when the launcher
+        # closes the server's stdin pipe (or dies), cat sees EOF and the
+        # shell kills the server — SIGTERM on the local ssh client alone
+        # would leak the remote process.
+        server_sh = (f"env DMLC_ROLE=server JAX_PLATFORMS=cpu "
+                     f"{_sh_quote(sys.executable)} -c {_sh_quote(code)} "
+                     f"& SRV=$!; cat > /dev/null; kill $SRV 2>/dev/null")
+        remote = f"cd {_sh_quote(workdir)} && {{ {server_sh}; }}"
+        procs.append(("server", subprocess.Popen(
+            ssh_cmd + [head, remote], stdin=subprocess.PIPE)))
+
+    workers = []
+    for i, host in enumerate(assignment):
+        env = _worker_env(args, i, coordinator, server_addrs)
+        env_str = " ".join(f"{k}={_sh_quote(v)}" for k, v in env.items())
+        cmd = " ".join(_sh_quote(c) for c in args.command)
+        remote = f"cd {_sh_quote(workdir)} && env {env_str} {cmd}"
+        p = subprocess.Popen(ssh_cmd + [host, remote])
+        workers.append(p)
+        procs.append(("worker", p))
+
+    codes = [p.wait() for p in workers]
+    for role, p in procs:
+        if role == "server":
+            if p.stdin:
+                p.stdin.close()     # EOF -> remote shell kills the server
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.send_signal(signal.SIGTERM)
+    return codes
+
+
+def launch_mpi(args, extra_env=None):
+    """Reference dmlc_tracker/mpi.py role: delegate process placement to
+    mpirun.  Rank-dependent vars can't ride ``-x`` (same value
+    everywhere), so MXT_WORKER_ID is derived per-rank from the MPI env
+    (OMPI_COMM_WORLD_RANK / PMI_RANK / SLURM_PROCID) at package import —
+    the launcher exports MXT_WORKER_ID_FROM_MPI=1 to request that."""
+    if args.num_servers:
+        raise NotImplementedError(
+            "--launcher mpi runs collective mode only (mpirun places "
+            "workers; there is no MPMD server placement here) — use "
+            "--launcher ssh or local for parameter-server mode")
+    hosts = read_hostfile(args.hostfile) if args.hostfile else None
+    head = hosts[0][0] if hosts else "127.0.0.1"
+    port = args.port or _free_port()
+    env = {
+        "MXT_COORDINATOR": f"{head}:{port}",
+        "MXT_NUM_WORKERS": str(args.num_workers),
+        "MXT_WORKER_ID_FROM_MPI": "1",
+        "MXT_KV_MODE": args.kv_mode,
+        "DMLC_ROLE": "worker",
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": str(args.num_servers),
+    }
+    for kv in args.env_worker + args.env:
+        k, _, v = kv.partition(":")
+        env[k] = v
+    cmd = args.mpirun_cmd.split() + ["-np", str(args.num_workers)]
+    if args.hostfile:
+        cmd += ["--hostfile", args.hostfile]
+    for k, v in env.items():
+        cmd += ["-x", f"{k}={v}"]
+    cmd += args.command
+    os_env = dict(os.environ)
+    os_env.update(env)
+    os_env.update(extra_env or {})
+    return [subprocess.call(cmd, env=os_env)]
 
 
 def main():
@@ -105,7 +253,13 @@ def main():
     parser.add_argument("--kv-mode", type=str, default="sync",
                         choices=["sync", "async"],
                         help="parameter-server mode when -s > 0")
-    parser.add_argument("--sync-dst-dir", type=str)
+    parser.add_argument("--sync-dst-dir", type=str,
+                        help="remote working dir for ssh launcher")
+    parser.add_argument("--port", type=int, default=0,
+                        help="coordinator port (0 = pick a free one)")
+    parser.add_argument("--ssh-cmd", type=str, default="ssh",
+                        help="ssh transport (tests inject a local shim)")
+    parser.add_argument("--mpirun-cmd", type=str, default="mpirun")
     parser.add_argument("--env-server", action="append", default=[])
     parser.add_argument("--env-worker", action="append", default=[])
     parser.add_argument("--env", action="append", default=[])
@@ -113,13 +267,20 @@ def main():
     args = parser.parse_args()
     if not args.command:
         parser.error("no command given")
-    if args.launcher != "local":
+    if args.launcher == "local":
+        codes = launch_local(args)
+    elif args.launcher == "ssh":
+        if not args.hostfile:
+            parser.error("--launcher ssh requires -H hostfile")
+        codes = launch_ssh(args)
+    elif args.launcher == "mpi":
+        codes = launch_mpi(args)
+    else:
         raise NotImplementedError(
-            f"launcher {args.launcher!r}: this build targets single-host "
-            "multi-process (reference dmlc_tracker local); on TPU pods use "
-            "the platform scheduler (GKE/xmanager) to start one process "
-            "per host with MXT_COORDINATOR/MXT_NUM_WORKERS/MXT_WORKER_ID")
-    codes = launch_local(args)
+            f"launcher {args.launcher!r}: sge/yarn cluster managers are "
+            "not targeted by this build; on TPU pods use the platform "
+            "scheduler (GKE/xmanager) to start one process per host with "
+            "MXT_COORDINATOR/MXT_NUM_WORKERS/MXT_WORKER_ID")
     bad = [c for c in codes if c != 0]
     sys.exit(bad[0] if bad else 0)
 
