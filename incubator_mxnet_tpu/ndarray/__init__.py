@@ -291,7 +291,8 @@ def save(fname, data):
         else:
             v = arr.data if isinstance(arr, NDArray) else _jnp.asarray(arr)
             wire.append((key, _onp.asarray(v)))
-    with open(fname, "wb") as f:
+    from ..filesystem import open_uri
+    with open_uri(fname, "wb") as f:
         f.write(params_io.save_bytes(wire, named=named))
 
 
@@ -300,7 +301,8 @@ def load(fname):
     (reference nd.load); also reads the round-1 MXTPU001 container."""
     from . import params_io
     from .sparse import RowSparseNDArray, CSRNDArray
-    with open(fname, "rb") as f:
+    from ..filesystem import open_uri
+    with open_uri(fname, "rb") as f:
         raw = f.read()
     if raw[:8] != _MAGIC:
         arrays, names = params_io.load_bytes(raw)

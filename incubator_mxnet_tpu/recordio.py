@@ -40,7 +40,10 @@ class MXRecordIO:
             self.writable = False
         else:
             raise ValueError(f"invalid flag {self.flag}")
-        if native.available():
+        # any URI (incl. file://) routes through the filesystem registry;
+        # bare paths keep the native fast path
+        remote = "://" in self.uri
+        if native.available() and not remote:
             import ctypes
             h = ctypes.c_void_p()
             create = (native.lib.MXTRecordIOWriterCreate if self.writable
@@ -52,6 +55,12 @@ class MXRecordIO:
             self._nh_free = (native.lib.MXTRecordIOWriterFree if self.writable
                              else native.lib.MXTRecordIOReaderFree)
             self.record = True  # truthy marker: stream is open
+        elif remote:
+            # s3:// / hdfs:// stream through the filesystem registry
+            # (dmlc-core SeekStream role; reference s3_integration.md)
+            from .filesystem import open_uri
+            self.record = open_uri(self.uri,
+                                   "wb" if self.writable else "rb")
         else:
             self.record = open(self.uri, "wb" if self.writable else "rb")
         self.pid = os.getpid()

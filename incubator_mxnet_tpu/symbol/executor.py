@@ -67,16 +67,18 @@ class Executor:
             return tuple(symbol._evaluate(bindings, group2ctx=g2c))
 
         def fwd_train(vals, aux):
-            # training runs without group placement: jax.vjp traces the
-            # graph into one computation where committed-device transfers
-            # cannot mix; the placed path is inference (below), matching
-            # the group2ctx deploy use-case — multi-device TRAINING goes
-            # through the sharding layer (parallel/), not ctx groups
+            # group-placed TRAINING (reference trains through group2ctx,
+            # tests/python/unittest/test_model_parallel.py): the eager
+            # evaluation inserts differentiable _cross_device_copy at
+            # group boundaries; jax.vjp runs the primal on the placed
+            # devices and its transpose copies cotangents back, so every
+            # layer's backward math is device-local like the forward
             bindings = dict(zip(self._arg_names, vals))
             bindings.update(zip(self._aux_names, aux))
             updates: dict = {}
             outs = tuple(symbol._evaluate(bindings, training=True,
-                                          aux_updates=updates))
+                                          aux_updates=updates,
+                                          group2ctx=g2c))
             return outs, updates
 
         # group-placed executors run eagerly: device_put-committed
@@ -118,6 +120,14 @@ class Executor:
         else:
             out_grads = [g.data if isinstance(g, NDArray) else g
                          for g in out_grads]
+        if self._group2ctx:
+            # head cotangents enter on each output's group device — the
+            # caller's buffers may live anywhere (reference inserts the
+            # copy node at the head too, graph_executor.cc:2048)
+            out_grads = [
+                jax.device_put(g, next(iter(o.data.devices())))
+                if getattr(o.data, "committed", True) else g
+                for g, o in zip(out_grads, self.outputs)]
         grads, _aux_grads = self._vjp_fn(tuple(out_grads))
         for name, g in zip(self._arg_names, grads):
             req = self._grad_req.get(name, "null")

@@ -156,19 +156,31 @@ def with_seed(seed=None):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             env = os.environ.get("MXNET_TEST_SEED")
-            this_seed = (int(env) if env is not None
-                         else seed if seed is not None
-                         else int.from_bytes(os.urandom(4), "little"))
-            onp.random.seed(this_seed)
-            from . import random as _random
-            _random.seed(this_seed)
-            try:
-                return fn(*args, **kwargs)
-            except Exception:
-                print(f"*** test failed with seed {this_seed}: set "
-                      f"MXNET_TEST_SEED={this_seed} to reproduce ***",
-                      file=sys.stderr)
-                raise
+            # MXNET_TEST_COUNT repeats the body with fresh seeds — the
+            # hook tools/flakiness_checker.py drives (reference
+            # common.py with_seed/ flakiness_checker contract)
+            count = max(int(os.environ.get("MXNET_TEST_COUNT", "1")), 1)
+            if count > 1 and seed is not None and env is None:
+                print(f"*** MXNET_TEST_COUNT={count}: decorator-pinned "
+                      f"seed {seed} is replaced by fresh per-trial seeds "
+                      "***", file=sys.stderr)
+            ret = None
+            for trial in range(count):
+                this_seed = (int(env) if env is not None
+                             else seed if seed is not None and count == 1
+                             else int.from_bytes(os.urandom(4), "little"))
+                onp.random.seed(this_seed)
+                from . import random as _random
+                _random.seed(this_seed)
+                try:
+                    ret = fn(*args, **kwargs)
+                except Exception:
+                    print(f"*** test failed at trial {trial + 1}/{count} "
+                          f"with seed {this_seed}: set "
+                          f"MXNET_TEST_SEED={this_seed} to reproduce ***",
+                          file=sys.stderr)
+                    raise
+            return ret
         return wrapper
 
     return deco

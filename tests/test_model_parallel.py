@@ -85,3 +85,56 @@ def test_group2ctx_allocates_params_on_group_device():
     w2 = ex.arg_dict["fc2_weight"].data
     assert {d.id for d in w1.devices()} == {2}
     assert {d.id for d in w2.devices()} == {3}
+
+
+def test_group2ctx_training_parity_and_placement():
+    """VERDICT r3 Next #10: full TRAINING through group2ctx placements
+    (reference test_model_parallel.py semantics) — N SGD steps on the
+    2-device placed executor must match the unplaced executor exactly,
+    with every parameter and its gradient staying on its group device
+    throughout."""
+    from incubator_mxnet_tpu import nd as _nd
+
+    g2c = {"dev1": mx.Context("cpu", 0), "dev2": mx.Context("cpu", 1)}
+    net = _two_group_net()
+    ex = net.simple_bind(data=(4, 6), group2ctx=g2c)
+    ex_ref = net.simple_bind(data=(4, 6))
+    rng = onp.random.RandomState(7)
+    for k in ex.arg_dict:
+        v = rng.randn(*ex.arg_dict[k].shape).astype(onp.float32)
+        ex.arg_dict[k][:] = v
+        ex_ref.arg_dict[k][:] = v
+
+    group_of = {"fc1": 0, "act1": 0, "fc2": 1}
+
+    def dev_id(arr):
+        return next(iter(arr.data.devices())).id
+
+    lr = 0.05
+    for step in range(5):
+        x = rng.randn(4, 6).astype(onp.float32)
+        og = rng.randn(4, 4).astype(onp.float32)
+        for e in (ex, ex_ref):
+            e.forward(is_train=True, data=x)
+            e.backward([_nd.array(og)])
+            # device-local SGD: update each param where it lives (the
+            # reference updates per-device through kvstore type=local)
+            for name, grad in e.grad_dict.items():
+                if name == "data" or grad is None:
+                    continue
+                w = e.arg_dict[name]
+                w[:] = w.data - lr * grad.data
+        for name, grad in ex.grad_dict.items():
+            if name == "data" or grad is None:
+                continue
+            layer = name.split("_")[0]
+            want = group_of[layer]
+            assert dev_id(grad) == want, \
+                f"step {step}: grad {name} on cpu:{dev_id(grad)}"
+            assert dev_id(ex.arg_dict[name]) == want, \
+                f"step {step}: param {name} on cpu:{dev_id(ex.arg_dict[name])}"
+
+    for k in ex.arg_dict:
+        onp.testing.assert_allclose(
+            ex.arg_dict[k].asnumpy(), ex_ref.arg_dict[k].asnumpy(),
+            rtol=1e-5, atol=1e-6, err_msg=f"param {k} diverged")

@@ -92,3 +92,40 @@ def test_describe_op_reflection():
     docs = list_op_docs()
     assert len(docs) > 300
     assert docs["softmax"]["differentiable"]
+
+
+def test_with_seed_repeats_via_test_count(monkeypatch, capsys):
+    """MXNET_TEST_COUNT repeats the body with fresh seeds (the
+    tools/flakiness_checker.py contract)."""
+    from incubator_mxnet_tpu.test_utils import with_seed
+    seen = []
+
+    @with_seed()
+    def body():
+        seen.append(onp.random.randint(0, 2**30))
+
+    monkeypatch.setenv("MXNET_TEST_COUNT", "5")
+    body()
+    assert len(seen) == 5
+    assert len(set(seen)) > 1, "trials must get fresh seeds"
+
+    # pinned seed replays identically even with count
+    seen.clear()
+    monkeypatch.setenv("MXNET_TEST_SEED", "1234")
+    monkeypatch.setenv("MXNET_TEST_COUNT", "3")
+    body()
+    assert len(set(seen)) == 1
+
+
+def test_flakiness_checker_cli(tmp_path):
+    import subprocess, sys, os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # a stable test passes; run a tiny trial count through the real CLI
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "flakiness_checker.py"),
+         "tests/test_test_utils.py::test_with_seed_repeats_via_test_count"
+         .replace("/", os.sep),
+         "-n", "4", "-b", "2"],
+        capture_output=True, text=True, cwd=repo, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "stable" in proc.stdout

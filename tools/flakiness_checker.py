@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Flakiness checker — rerun one test many times with fresh seeds
+(reference tools/flakiness_checker.py CLI).
+
+Two repetition strategies:
+  * tests decorated with ``test_utils.with_seed`` repeat IN-PROCESS via
+    MXNET_TEST_COUNT (cheap: one interpreter, N seeded trials);
+  * any other pytest node is re-invoked ``--batches`` times in
+    subprocesses, each with a fresh MXNET_TEST_SEED (slower but fully
+    general).
+
+Usage:
+  python tools/flakiness_checker.py test_operators.test_softmax
+  python tools/flakiness_checker.py tests/test_gluon.py::test_dense -n 500
+  python tools/flakiness_checker.py <nodeid> --seed 42   # replay one seed
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_NUM_TRIALS = 500
+
+
+def find_test_path(spec):
+    """Accept either a pytest nodeid (tests/test_x.py::test_y) or the
+    reference's ``module.test_name`` / ``dir/module.test_name`` form."""
+    if "::" in spec or spec.endswith(".py"):
+        return spec
+    mod, _, name = spec.rpartition(".")
+    fname = os.path.basename(mod) + ".py"
+    for root, _dirs, files in os.walk(os.path.join(REPO, "tests")):
+        if fname in files:
+            path = os.path.join(root, fname)
+            return f"{path}::{name}" if name else path
+    raise FileNotFoundError(f"no test file {fname} under tests/")
+
+
+def run_trials(nodeid, num_trials, batches, seed, verbosity):
+    per_batch = max(num_trials // batches, 1)
+    failures = 0
+    for b in range(batches):
+        env = dict(os.environ)
+        env["MXNET_TEST_COUNT"] = str(per_batch)
+        if seed is not None:
+            env["MXNET_TEST_SEED"] = str(seed)
+        else:
+            env.pop("MXNET_TEST_SEED", None)
+            env["PYTHONHASHSEED"] = str(random.randrange(2**31))
+        cmd = [sys.executable, "-m", "pytest", nodeid,
+               f"--verbosity={verbosity}", "-x"]
+        proc = subprocess.run(cmd, cwd=REPO, env=env,
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            failures += 1
+            # surface the reproduction banner from with_seed
+            for line in proc.stdout.splitlines() + proc.stderr.splitlines():
+                if "MXNET_TEST_SEED" in line or "FAILED" in line:
+                    print(line, flush=True)
+        print(f"batch {b + 1}/{batches} ({per_batch} trials): "
+              f"{'FAIL' if proc.returncode else 'ok'}", flush=True)
+    return failures
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="check a test for flakiness (reference "
+                    "tools/flakiness_checker.py)")
+    p.add_argument("test", help="pytest nodeid or module.test_name")
+    p.add_argument("-n", "--num-trials", type=int,
+                   default=DEFAULT_NUM_TRIALS)
+    p.add_argument("-b", "--batches", type=int, default=10,
+                   help="subprocess batches (fresh interpreter per batch)")
+    p.add_argument("-s", "--seed", type=int, default=None,
+                   help="pin MXNET_TEST_SEED to replay one failure")
+    p.add_argument("-v", "--verbosity", type=int, default=1)
+    args = p.parse_args(argv)
+
+    nodeid = find_test_path(args.test)
+    print(f"checking {nodeid}: {args.num_trials} trials in "
+          f"{args.batches} batches", flush=True)
+    failures = run_trials(nodeid, args.num_trials, args.batches,
+                          args.seed, args.verbosity)
+    if failures:
+        print(f"FLAKY: {failures}/{args.batches} batches failed")
+        return 1
+    print("stable: every batch passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
