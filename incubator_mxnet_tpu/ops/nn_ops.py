@@ -212,7 +212,7 @@ def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
     trailing-axis case on TPU (ops/pallas_kernels.fused_layer_norm)."""
     if isinstance(axis, int) and axis in (-1, x.ndim - 1) and gamma.ndim == 1:
         from . import pallas_kernels as pk
-        if pk.use_pallas():
+        if pk.use_pallas("fused_layer_norm"):
             return pk.fused_layer_norm(x, gamma, beta, float(eps))
     xf = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
     mean = jnp.mean(xf, axis=axis, keepdims=True)
@@ -267,7 +267,7 @@ def rms_norm(x, gamma, axis=-1, eps=1e-6):
     stack.  Trailing-axis case runs the fused Pallas kernel on TPU
     (pallas_kernels.fused_rms_norm), like LayerNorm/softmax."""
     from . import pallas_kernels as pk
-    if axis in (-1, x.ndim - 1) and pk.use_pallas():
+    if axis in (-1, x.ndim - 1) and pk.use_pallas("fused_rms_norm"):
         return pk.fused_rms_norm(x, gamma, eps)
     ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axis, keepdims=True)
     y = (x.astype(jnp.float32) * lax.rsqrt(ms + eps)).astype(x.dtype)
@@ -292,7 +292,7 @@ def softmax(x, axis=-1, temperature=None, length=None):
         mask = idx < jnp.expand_dims(length, ax)
         x = jnp.where(mask, x, -jnp.inf)
     from . import pallas_kernels as pk
-    if isinstance(axis, int) and pk.use_pallas():
+    if isinstance(axis, int) and pk.use_pallas("fused_softmax"):
         return pk.fused_softmax(x, axis)
     return jnn.softmax(x, axis=axis)
 
@@ -703,7 +703,7 @@ def softmax_xent(logits, labels):
     formulation."""
     from . import pallas_kernels as pk
     lbl = labels.astype(jnp.int32)
-    if pk.use_pallas():
+    if pk.use_pallas("fused_softmax_xent"):
         out = pk.fused_softmax_xent(logits, lbl)
     else:
         lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)

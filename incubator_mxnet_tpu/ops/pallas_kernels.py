@@ -44,14 +44,60 @@ def interpret_mode() -> bool:
         return True
 
 
-@functools.cache
-def use_pallas() -> bool:
+_MANIFEST_CACHE: list = []  # [parsed-or-None], lazily filled
+
+
+def manifest_path() -> str:
+    return os.environ.get(
+        "MXNET_PALLAS_MANIFEST",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "pallas_manifest.json"))
+
+
+def _manifest():
+    """Known-good kernel manifest written by scripts/pallas_smoke.py on
+    real hardware (VERDICT r3 Next #2; reference analog: NVRTC fused-op
+    verification, fused_op.cu:174-186).  Only a manifest recorded on the
+    CURRENT backend platform applies."""
+    if not _MANIFEST_CACHE:
+        parsed = None
+        try:
+            import json
+            with open(manifest_path()) as f:
+                parsed = json.load(f)
+        except (OSError, ValueError):
+            parsed = None
+        _MANIFEST_CACHE.append(parsed)
+    m = _MANIFEST_CACHE[0]
+    if m and m.get("platform") == jax.default_backend():
+        return m
+    return None
+
+
+def reload_manifest():
+    _MANIFEST_CACHE.clear()
+
+
+def kernel_known_good(name: str) -> bool:
+    """False only when a manifest for this platform explicitly marks the
+    kernel failed; no manifest (or an unknown name) stays permissive —
+    the smoke harness always writes every kernel, so unknown names only
+    occur mid-development."""
+    m = _manifest()
+    if m is None:
+        return True
+    return bool(m.get("kernels", {}).get(name, {}).get("ok", True))
+
+
+def use_pallas(kernel: str | None = None) -> bool:
     flag = os.environ.get("MXNET_USE_PALLAS", "auto").lower()
     if flag in ("0", "false", "off"):
         return False
     if flag in ("1", "true", "on"):
-        return True
-    return jax.default_backend() == "tpu"
+        return kernel is None or kernel_known_good(kernel)
+    if jax.default_backend() != "tpu":
+        return False
+    return kernel is None or kernel_known_good(kernel)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -463,9 +509,26 @@ def flash_attention(q, k, v, sm_scale=None, causal=False):
     Shapes (B, H, T, D). New capability relative to the reference (which
     caps sequence length by device memory, SURVEY.md §5.7); pairs with
     parallel/ring_attention.py for the sequence-parallel path.
+
+    If the smoke manifest marks this kernel bad on the current hardware,
+    falls back to the O(T²) XLA formulation instead of risking a Mosaic
+    failure mid-run.
     """
     scale = float(sm_scale) if sm_scale is not None else q.shape[-1] ** -0.5
+    if not interpret_mode() and not kernel_known_good("flash_attention"):
+        return _xla_attention(q, k, v, scale, bool(causal))
     return _flash_core(q, k, v, scale, bool(causal))
+
+
+def _xla_attention(q, k, v, scale, causal):
+    logits = jnp.einsum("bhtd,bhsd->bhts", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        T, S = logits.shape[-2:]
+        mask = jnp.tril(jnp.ones((T, S), bool))
+        logits = jnp.where(mask, logits, _NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhts,bhsd->bhtd", p.astype(q.dtype), v)
 
 
 # ======================================================================

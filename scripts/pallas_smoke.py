@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""On-chip Pallas kernel smoke: prove every kernel compiles (Mosaic) and
+matches its XLA reference fwd+bwd, then write the known-good manifest
+that ``ops.pallas_kernels.use_pallas`` consults (VERDICT r3 Next #2;
+reference analog: NVRTC fused-op verification, fused_op.cu:174-186).
+
+Each kernel runs in its OWN subprocess under a timeout, so one Mosaic
+crash/hang cannot take down the harness or a bench window.  The
+manifest records the platform; a cpu-recorded manifest never gates a
+tpu run and vice versa.
+
+Usage:
+  python scripts/pallas_smoke.py                 # write default manifest
+  python scripts/pallas_smoke.py --timeout 45 --out path.json
+Exit code 0 as long as the manifest was written (failures are DATA).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+KERNELS = ["fused_softmax", "fused_layer_norm", "fused_rms_norm",
+           "fused_softmax_xent", "flash_attention"]
+
+_CHILD_BODY = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+import numpy as onp
+import jax, jax.numpy as jnp
+if {platform!r}:
+    jax.config.update("jax_platforms", {platform!r})
+
+name = {name!r}
+os.environ["MXNET_USE_PALLAS"] = "1"
+# a stale manifest must NOT gate the verification itself: a kernel
+# previously marked bad would silently fall back to XLA and be compared
+# against itself, flipping back to ok — point at a nonexistent file
+os.environ["MXNET_PALLAS_MANIFEST"] = "/nonexistent/pallas-manifest"
+from incubator_mxnet_tpu.ops import pallas_kernels as pk
+pk.reload_manifest()
+
+def run(use_kernel):
+    rng = onp.random.RandomState(0)   # identical data both runs
+    os.environ["MXNET_USE_PALLAS"] = "1" if use_kernel else "0"
+    if name == "fused_softmax":
+        x = jnp.asarray(rng.randn(64, 257), jnp.float32)
+        f = (pk.fused_softmax if use_kernel
+             else lambda v: jax.nn.softmax(v, axis=-1))
+        y, vjp = jax.vjp(f, x)
+        (dx,) = vjp(jnp.ones_like(y))
+        return y, dx
+    if name == "fused_layer_norm":
+        x = jnp.asarray(rng.randn(48, 130), jnp.float32)
+        g = jnp.asarray(rng.rand(130) + 0.5, jnp.float32)
+        b = jnp.asarray(rng.randn(130), jnp.float32)
+        def ref(x, g, b):
+            m = jnp.mean(x, -1, keepdims=True)
+            v = jnp.var(x, -1, keepdims=True)
+            return (x - m) * jax.lax.rsqrt(v + 1e-5) * g + b
+        f = (lambda *a: pk.fused_layer_norm(*a, 1e-5)) if use_kernel else ref
+        y, vjp = jax.vjp(f, x, g, b)
+        return (y,) + vjp(jnp.ones_like(y))
+    if name == "fused_rms_norm":
+        x = jnp.asarray(rng.randn(48, 130), jnp.float32)
+        g = jnp.asarray(rng.rand(130) + 0.5, jnp.float32)
+        def ref(x, g):
+            ms = jnp.mean(jnp.square(x), -1, keepdims=True)
+            return x * jax.lax.rsqrt(ms + 1e-6) * g
+        f = (lambda *a: pk.fused_rms_norm(*a, 1e-6)) if use_kernel else ref
+        y, vjp = jax.vjp(f, x, g)
+        return (y,) + vjp(jnp.ones_like(y))
+    if name == "fused_softmax_xent":
+        x = jnp.asarray(rng.randn(64, 1000), jnp.float32)
+        lbl = jnp.asarray(rng.randint(0, 1000, 64), jnp.int32)
+        def ref(x):
+            lp = jax.nn.log_softmax(x, axis=-1)
+            return -jnp.take_along_axis(lp, lbl[:, None], -1)[:, 0]
+        f = (lambda v: pk.fused_softmax_xent(v, lbl)) if use_kernel else ref
+        y, vjp = jax.vjp(f, x)
+        (dx,) = vjp(jnp.ones_like(y))
+        return y, dx
+    if name == "flash_attention":
+        q = jnp.asarray(rng.randn(2, 2, 128, 64), jnp.float32) * 0.3
+        k = jnp.asarray(rng.randn(2, 2, 128, 64), jnp.float32) * 0.3
+        v = jnp.asarray(rng.randn(2, 2, 128, 64), jnp.float32)
+        f = ((lambda q, k, v: pk.flash_attention(q, k, v, causal=True))
+             if use_kernel else
+             (lambda q, k, v: pk._xla_attention(q, k, v, 64 ** -0.5, True)))
+        y, vjp = jax.vjp(f, q, k, v)
+        return (y,) + vjp(jnp.ones_like(y))
+    raise SystemExit(f"unknown kernel {{name}}")
+
+got = run(True)
+want = run(False)
+err = max(float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                - b.astype(jnp.float32))))
+          for a, b in zip(got, want))
+print("SMOKE_RESULT", name, err, flush=True)
+assert err < 2e-2, f"{{name}} max err {{err}}"
+print("SMOKE_OK", name, flush=True)
+"""
+
+
+def smoke_one(name, timeout, platform=None):
+    code = _CHILD_BODY.format(repo=REPO, name=name, platform=platform or "")
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"timeout after {timeout}s"}
+    dt = time.monotonic() - t0
+    ok = "SMOKE_OK" in proc.stdout
+    rec = {"ok": ok, "seconds": round(dt, 1)}
+    for line in proc.stdout.splitlines():
+        if line.startswith("SMOKE_RESULT"):
+            rec["max_err"] = float(line.split()[2])
+    if not ok:
+        tail = (proc.stderr or proc.stdout)[-400:]
+        rec["error"] = tail.strip().splitlines()[-1] if tail.strip() else \
+            f"rc={proc.returncode}"
+    return rec
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--timeout", type=float, default=60.0,
+                   help="per-kernel subprocess ceiling (seconds)")
+    p.add_argument("--out", type=str, default=None)
+    p.add_argument("--kernels", type=str, default=",".join(KERNELS))
+    p.add_argument("--platform", type=str, default=None,
+                   help="force the jax platform in children (e.g. cpu); "
+                        "default: the machine's accelerator")
+    args = p.parse_args(argv)
+
+    # the platform is discovered in a child too — the parent must never
+    # touch a possibly-wedged accelerator
+    platform, device = "unknown", "unknown"
+    force = (f"jax.config.update('jax_platforms', {args.platform!r}); "
+             if args.platform else "")
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             f"import sys; sys.path.insert(0, {REPO!r}); import jax; "
+             f"{force}"
+             "print('PLATFORM', jax.default_backend()); "
+             "print('DEVICE', jax.devices()[0])"],
+            capture_output=True, text=True, timeout=args.timeout)
+        for line in probe.stdout.splitlines():
+            if line.startswith("PLATFORM"):
+                platform = line.split(None, 1)[1]
+            if line.startswith("DEVICE"):
+                device = line.split(None, 1)[1]
+    except subprocess.TimeoutExpired:
+        print("platform probe timed out (wedged accelerator?) — "
+              "recording kernels anyway", flush=True)
+    print(f"platform={platform} device={device}", flush=True)
+
+    from incubator_mxnet_tpu.ops.pallas_kernels import manifest_path
+    out = args.out or manifest_path()
+
+    # write INCREMENTALLY after every kernel: if the parent's budget
+    # expires mid-harness (e.g. one wedged Mosaic compile), the kernels
+    # already verified keep their records
+    kernels = {}
+
+    def flush():
+        manifest = {"format": "pallas_smoke_v1", "platform": platform,
+                    "device": device, "kernels": kernels}
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1)
+        os.replace(tmp, out)
+
+    for name in args.kernels.split(","):
+        rec = smoke_one(name, args.timeout, args.platform)
+        kernels[name] = rec
+        flush()
+        state = "ok" if rec["ok"] else f"FAILED ({rec.get('error')})"
+        print(f"  {name:20s} {state}", flush=True)
+    print(f"wrote {out}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

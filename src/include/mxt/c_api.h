@@ -130,6 +130,11 @@ int MXTPredGetOutputShape(PredictorHandle h, uint32_t index,
 int MXTPredGetOutput(PredictorHandle h, uint32_t index, float* out,
                      uint64_t size);
 int MXTPredFree(PredictorHandle h);
+/* N handles over one loaded model for N caller threads (reference
+ * c_predict_api.h MXPredCreateMultiThread); free each handle. */
+int MXTPredCreateMultiThread(const char* artifact_prefix,
+                             uint32_t num_threads,
+                             PredictorHandle* out_handles);
 
 #ifdef __cplusplus
 }

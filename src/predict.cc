@@ -246,10 +246,55 @@ int MXTPredFree(PredictorHandle h) {
   return 0;
 }
 
+/* Multi-threaded serving (reference c_predict_api.h
+ * MXPredCreateMultiThread + cached_op_threadsafe.cc role): N handles
+ * over ONE loaded model, one handle per caller thread.  The model
+ * object (weights + compiled executable) is shared by reference count;
+ * per-handle state (input staging, last outputs) is private, so
+ * concurrent SetInput/Forward/GetOutput on different handles never
+ * race.
+ *
+ * Concurrency model: each entry point holds the GIL only for argument
+ * marshaling; the XLA executable run and the device-to-host copies
+ * inside Predictor.__call__ release the GIL (PJRT binding behavior), so
+ * N threads overlap the actual compute — the TPU analog of the
+ * reference's thread-safe CachedOp running kernels on parallel GPU
+ * streams while NNVM graph prep is mutex-guarded.  Throughput is
+ * asserted by tests/test_predict.py::test_multithread_concurrency. */
+int MXTPredCreateMultiThread(const char* artifact_prefix,
+                             uint32_t num_threads,
+                             PredictorHandle* out_handles) {
+  if (num_threads == 0) {
+    mxt::SetLastError("MXTPredCreateMultiThread: num_threads must be > 0");
+    return -1;
+  }
+  PredictorHandle first = nullptr;
+  int rc = MXTPredCreate(artifact_prefix, &first);
+  if (rc != 0) return rc;
+  auto* p0 = static_cast<Predictor*>(first);
+  out_handles[0] = first;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  for (uint32_t i = 1; i < num_threads; ++i) {
+    auto* p = new Predictor();
+    Py_INCREF(p0->pred);
+    Py_XINCREF(p0->meta_inputs);
+    p->pred = p0->pred;
+    p->meta_inputs = p0->meta_inputs;
+    p->input_bufs.resize(p0->input_bufs.size());
+    out_handles[i] = p;
+  }
+  PyGILState_Release(gil);
+  return 0;
+}
+
 /* Reference-named aliases (include/mxnet/c_predict_api.h) so deploy
  * code written against the reference predict ABI links unchanged. */
 int MXPredCreate2(const char* prefix, PredictorHandle* out) {
   return MXTPredCreate(prefix, out);
+}
+int MXPredCreateMultiThread2(const char* prefix, uint32_t n,
+                             PredictorHandle* out) {
+  return MXTPredCreateMultiThread(prefix, n, out);
 }
 int MXPredSetInput2(PredictorHandle h, uint32_t i, const float* d,
                     uint64_t n) {

@@ -1,9 +1,13 @@
 """Pallas kernel correctness vs jnp references (interpret mode on CPU —
 identical kernel code paths as on TPU, per ops/pallas_kernels.py)."""
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as onp
 import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 from incubator_mxnet_tpu.ops import pallas_kernels as pk
 
@@ -153,7 +157,7 @@ def test_nn_ops_dispatch_to_pallas(monkeypatch):
     """ops.softmax / ops.layer_norm route through the Pallas kernels when
     MXNET_USE_PALLAS=1 and produce reference results."""
     from incubator_mxnet_tpu.ops import nn_ops
-    pk.use_pallas.cache_clear()
+    pk.reload_manifest()
     monkeypatch.setenv("MXNET_USE_PALLAS", "1")
     try:
         x = _rand(4, 50, seed=20)
@@ -169,7 +173,7 @@ def test_nn_ops_dispatch_to_pallas(monkeypatch):
             onp.asarray(nn_ops.layer_norm(x, g, b, axis=-1, eps=1e-5)),
             onp.asarray(want), rtol=1e-4, atol=1e-5)
     finally:
-        pk.use_pallas.cache_clear()
+        pk.reload_manifest()
 
 
 def test_transformer_flash_attention_matches_gspmd():
@@ -282,3 +286,83 @@ def test_fused_rms_norm_matches_reference():
                                     rtol=1e-4, atol=1e-5)
         onp.testing.assert_allclose(onp.asarray(gg), onp.asarray(rg),
                                     rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# known-good manifest (VERDICT r3 Next #2): scripts/pallas_smoke.py
+# writes it on real hardware; use_pallas() consults it per kernel
+# ---------------------------------------------------------------------------
+
+def test_manifest_gates_kernels(tmp_path, monkeypatch):
+    import json
+    from incubator_mxnet_tpu.ops import pallas_kernels as pk
+    man = tmp_path / "manifest.json"
+    man.write_text(json.dumps({
+        "format": "pallas_smoke_v1", "platform": "cpu",
+        "kernels": {"fused_softmax": {"ok": True},
+                    "flash_attention": {"ok": False}}}))
+    monkeypatch.setenv("MXNET_PALLAS_MANIFEST", str(man))
+    monkeypatch.setenv("MXNET_USE_PALLAS", "1")
+    pk.reload_manifest()
+    try:
+        # current backend is cpu, so the cpu manifest applies
+        assert pk.use_pallas("fused_softmax")
+        assert not pk.use_pallas("flash_attention")
+        # unknown kernels stay permissive
+        assert pk.use_pallas("fused_rms_norm")
+        # bare use_pallas keeps flag semantics
+        assert pk.use_pallas()
+        # a manifest for ANOTHER platform never gates this one
+        man.write_text(json.dumps({
+            "platform": "tpu",
+            "kernels": {"fused_softmax": {"ok": False}}}))
+        pk.reload_manifest()
+        assert pk.use_pallas("fused_softmax")
+    finally:
+        pk.reload_manifest()
+
+
+def test_flash_attention_falls_back_when_marked_bad(tmp_path, monkeypatch):
+    import json
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops import pallas_kernels as pk
+    rng = onp.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 2, 16, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 2, 16, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 2, 16, 8), jnp.float32)
+    ref = onp.asarray(pk._xla_attention(q, k, v, 8 ** -0.5, True))
+    man = tmp_path / "manifest.json"
+    man.write_text(json.dumps({
+        "platform": "cpu",
+        "kernels": {"flash_attention": {"ok": False}}}))
+    monkeypatch.setenv("MXNET_PALLAS_MANIFEST", str(man))
+    pk.reload_manifest()
+    try:
+        # interpret mode is on (cpu backend), so the kernel path still
+        # runs interpreted; the fallback branch is for real hardware —
+        # drive it directly by patching interpret_mode
+        monkeypatch.setattr(pk, "interpret_mode", lambda: False)
+        out = onp.asarray(pk.flash_attention(q, k, v, causal=True))
+        onp.testing.assert_allclose(out, ref, rtol=1e-6)
+    finally:
+        pk.reload_manifest()
+
+
+def test_smoke_harness_writes_manifest(tmp_path):
+    """End-to-end: the harness runs one kernel in a subprocess and the
+    written manifest is consumable by the gating logic."""
+    import json
+    import subprocess
+    import sys as _sys
+    out = tmp_path / "m.json"
+    proc = subprocess.run(
+        [_sys.executable,
+         os.path.join(REPO, "scripts", "pallas_smoke.py"),
+         "--kernels", "fused_softmax", "--platform", "cpu",
+         "--timeout", "120", "--out", str(out)],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-400:]
+    man = json.loads(out.read_text())
+    assert man["platform"] == "cpu"
+    assert man["kernels"]["fused_softmax"]["ok"] is True
+    assert man["kernels"]["fused_softmax"]["max_err"] < 2e-2
