@@ -260,8 +260,18 @@ def _ensure_pallas_manifest(remaining, cpu_reserve):
         if os.path.exists(path):
             import json
             with open(path) as f:
-                if json.load(f).get("platform") not in ("cpu", "unknown"):
-                    return  # accelerator manifest already recorded
+                man = json.load(f)
+            if man.get("platform") not in ("cpu", "unknown"):
+                # an accelerator manifest exists; keep it UNLESS some
+                # kernel failed only by timeout (transient: slow runtime
+                # init) — those deserve a retry, real Mosaic errors don't
+                timeouts = [k for k, r in man.get("kernels", {}).items()
+                            if not r.get("ok")
+                            and "timeout" in str(r.get("error", ""))]
+                if not timeouts:
+                    return
+                print(f"[bench] re-running pallas smoke: timed-out "
+                      f"kernels {timeouts}", file=sys.stderr, flush=True)
         budget = min(float(os.environ.get("PALLAS_SMOKE_TIMEOUT", "150")),
                      remaining() - cpu_reserve - 120)
         if budget < 60:
@@ -273,11 +283,15 @@ def _ensure_pallas_manifest(remaining, cpu_reserve):
         # a parent timeout keeps the kernels already verified
         per_kernel = max((budget - 10) / 6, 15)
         try:
-            subprocess.run(
+            proc = subprocess.run(
                 [sys.executable, os.path.join(here, "scripts",
                                               "pallas_smoke.py"),
                  "--timeout", str(per_kernel)],
-                timeout=budget, capture_output=True)
+                timeout=budget, capture_output=True, text=True)
+            # the per-kernel verdict lines are the only diagnostics a
+            # failed Mosaic compile leaves behind — keep them
+            sys.stderr.write(proc.stdout[-1500:])
+            sys.stderr.flush()
         except subprocess.TimeoutExpired:
             print("[bench] pallas smoke hit its budget; partial manifest "
                   "kept", file=sys.stderr, flush=True)

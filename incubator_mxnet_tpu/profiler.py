@@ -55,15 +55,84 @@ def start(profile_process="worker"):
             _state["xprof_active"] = True
         except Exception:  # already tracing or unsupported platform
             _state["xprof_active"] = False
+    if _config.get("profile_memory"):
+        _start_memory_sampler()
 
 
 def stop(profile_process="worker"):
     _state["running"] = False
+    _stop_memory_sampler()
     if _state.get("xprof_active"):
         try:
             jax.profiler.stop_trace()
         finally:
             _state["xprof_active"] = False
+
+
+# -- device/host memory counters (reference storage_profiler.cc +
+#    profiler.h counter events; §2.1 "storage manager profiler hooks") --
+
+def _memory_snapshot():
+    """One sample: PJRT HBM stats per device + the native host pool."""
+    samples = {}
+    for dev, st in device_memory_profile().items():
+        if st.get("bytes_in_use") is not None:
+            samples[f"hbm:{dev}"] = {"bytes_in_use": st["bytes_in_use"]}
+    try:
+        from . import native
+        if native.available():
+            import ctypes
+            allocated = ctypes.c_uint64()
+            pooled = ctypes.c_uint64()
+            native.check_call(native.lib.MXTStorageStats(
+                ctypes.byref(allocated), ctypes.byref(pooled)))
+            samples["host_pool"] = {"bytes_allocated": allocated.value,
+                                    "bytes_pooled": pooled.value}
+    except Exception:
+        pass
+    return samples
+
+
+def _sampler_loop(stop_evt, interval_s):
+    while not stop_evt.wait(interval_s):
+        if not _state["running"]:
+            continue  # pause() suppresses memory samples like events
+        ts = time.perf_counter_ns() // 1000
+        for name, args in _memory_snapshot().items():
+            with _events_lock:
+                _events.append({"name": name, "cat": "memory", "ph": "C",
+                                "ts": ts, "pid": os.getpid(), "args": args})
+
+
+def _start_memory_sampler():
+    if _state.get("mem_thread") is not None:
+        return
+    interval = float(os.environ.get("MXNET_PROFILER_MEM_INTERVAL_MS",
+                                    "50")) / 1000.0
+    evt = threading.Event()
+    t = threading.Thread(target=_sampler_loop, args=(evt, interval),
+                         daemon=True)
+    _state["mem_stop"] = evt
+    _state["mem_thread"] = t
+    t.start()
+
+
+def _stop_memory_sampler():
+    t = _state.pop("mem_thread", None)
+    evt = _state.pop("mem_stop", None)
+    if evt is not None:
+        evt.set()
+    if t is None:
+        return  # sampler never ran (profile_memory off) — emit nothing,
+                # and never touch the backend from a bare stop()
+    t.join(timeout=2)
+    # one final sample so even a zero-duration profile window records
+    # the memory state
+    ts = time.perf_counter_ns() // 1000
+    for name, args in _memory_snapshot().items():
+        with _events_lock:
+            _events.append({"name": name, "cat": "memory", "ph": "C",
+                            "ts": ts, "pid": os.getpid(), "args": args})
 
 
 def pause(profile_process="worker"):

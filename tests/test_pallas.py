@@ -302,19 +302,33 @@ def test_manifest_gates_kernels(tmp_path, monkeypatch):
         "kernels": {"fused_softmax": {"ok": True},
                     "flash_attention": {"ok": False}}}))
     monkeypatch.setenv("MXNET_PALLAS_MANIFEST", str(man))
-    monkeypatch.setenv("MXNET_USE_PALLAS", "1")
+    # the manifest gates only AUTO mode on the accelerator backend;
+    # simulate a tpu backend with a cpu-recorded... rather, rewrite the
+    # manifest as tpu so platforms match
+    man.write_text(json.dumps({
+        "format": "pallas_smoke_v1", "platform": "tpu",
+        "kernels": {"fused_softmax": {"ok": True},
+                    "flash_attention": {"ok": False}}}))
+    monkeypatch.delenv("MXNET_USE_PALLAS", raising=False)
+    monkeypatch.setattr(pk.jax, "default_backend", lambda: "tpu")
     pk.reload_manifest()
     try:
-        # current backend is cpu, so the cpu manifest applies
         assert pk.use_pallas("fused_softmax")
         assert not pk.use_pallas("flash_attention")
         # unknown kernels stay permissive
         assert pk.use_pallas("fused_rms_norm")
-        # bare use_pallas keeps flag semantics
+        # bare use_pallas: auto + tpu backend -> on
         assert pk.use_pallas()
+        # explicit force-on IGNORES the manifest (override contract)
+        monkeypatch.setenv("MXNET_USE_PALLAS", "1")
+        assert pk.use_pallas("flash_attention")
+        # explicit off wins over everything
+        monkeypatch.setenv("MXNET_USE_PALLAS", "0")
+        assert not pk.use_pallas("fused_softmax")
         # a manifest for ANOTHER platform never gates this one
+        monkeypatch.delenv("MXNET_USE_PALLAS")
         man.write_text(json.dumps({
-            "platform": "tpu",
+            "platform": "cpu",
             "kernels": {"fused_softmax": {"ok": False}}}))
         pk.reload_manifest()
         assert pk.use_pallas("fused_softmax")

@@ -147,3 +147,28 @@ def test_int8_dot_reaches_xla():
         in_dtypes = [v.aval.dtype for v in eq.invars]
         assert all(str(d) == "int8" for d in in_dtypes), in_dtypes
         assert str(eq.outvars[0].aval.dtype) == "int32"
+
+
+def test_quantize_resnet_example_end_to_end():
+    """VERDICT r3 Next #5: the full calibrate -> int8-convert -> infer
+    flow at model-zoo scale, via the shipped example (reduced size for
+    CI).  Asserts top-1 agreement with the float model for both calib
+    modes and that both throughput numbers were measured."""
+    import json
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "examples",
+                                      "quantize_resnet50.py"),
+         "--cpu", "--model", "resnet18_v1", "--batch", "4",
+         "--image-size", "64", "--eval-batches", "2",
+         "--calib-batches", "1"],
+        capture_output=True, text=True, timeout=540)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    lines = [json.loads(l) for l in proc.stdout.strip().splitlines()]
+    assert {r["calib_mode"] for r in lines} == {"naive", "entropy"}
+    for r in lines:
+        assert r["top1_agreement_vs_float"] >= 0.85, r
+        assert r["int8_img_per_sec"] > 0 and r["float_img_per_sec"] > 0
