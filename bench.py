@@ -97,11 +97,13 @@ def _child(platform: str) -> None:
     from incubator_mxnet_tpu.fuse import make_fused_train_step
     from incubator_mxnet_tpu.gluon.model_zoo import vision
 
+    stem = os.environ.get("BENCH_STEM", "conv7")
+
     def measure(bs):
         mx.random.seed(0)
         cpu0 = jax.local_devices(backend="cpu")[0]
         with jax.default_device(cpu0):  # eager setup off the chip
-            net = vision.resnet50_v1()
+            net = vision.resnet50_v1(stem=stem)
             net.initialize(ctx=mx.cpu())
             net(nd.random.uniform(shape=(1, 3, 32, 32)))  # resolve shapes
             if dtype == "bfloat16":
@@ -147,8 +149,10 @@ def _child(platform: str) -> None:
         imgs_per_sec = bs * steps / dt
         plat = accel.platform
         suffix = "" if plat not in ("cpu",) else "_cpu_fallback"
+        stem_tag = "" if stem == "conv7" else f"_{stem}stem"
         result = {
-            "metric": f"resnet50_train_img_per_sec_bs{bs}_{dtype}{suffix}",
+            "metric":
+                f"resnet50_train_img_per_sec_bs{bs}_{dtype}{stem_tag}{suffix}",
             "value": round(imgs_per_sec, 2),
             "unit": "img/s",
             "vs_baseline": round(imgs_per_sec / BASELINE, 3),

@@ -137,19 +137,59 @@ class BottleneckV2(HybridBlock):
         return x + residual
 
 
+class S2DStem(HybridBlock):
+    """Space-to-depth ResNet stem (the MLPerf TPU trick): s2d(2) then a
+    4x4/s1 conv over 12 channels replaces the 7x7/s2 conv over 3.
+
+    Same function class and FLOPs as the classic stem (the 7x7 kernel
+    embeds exactly into the s2d domain — equivalence verified to 1.2e-6
+    by scripts/perf_probe.py stem), but the contraction reads 12*16=192
+    taps instead of 3*49=147 over a C=3 input that packs the 128-lane
+    MXU at 2.3% density — the top conv-lowering lever identified in
+    docs/performance.md.  Select with resnet50_v1(stem="s2d") or
+    BENCH_STEM=s2d.
+    """
+
+    def __init__(self, channels, **kwargs):
+        super().__init__(**kwargs)
+        self.conv = nn.Conv2D(channels, 4, 1, 2, use_bias=False,
+                              in_channels=12)
+
+    def forward(self, x):
+        from .... import nd
+        if x.shape[-1] % 2 or x.shape[-2] % 2:
+            raise ValueError(
+                f"stem='s2d' needs even spatial dims (got "
+                f"{x.shape[-2:]}); use the default conv7 stem for odd "
+                "crop sizes")
+        y = nd.space_to_depth(x, block_size=2)
+        y = self.conv(y)
+        # pad 2 yields 113x113 for the canonical (2,1) asymmetric pad;
+        # drop the last row/col (receptive-field shift the trained
+        # weights absorb)
+        return y[:, :, :-1, :-1]
+
+
+def _add_stem(features, channels, thumbnail, stem):
+    if thumbnail:
+        features.add(_conv3x3(channels, 1, 0))
+        return
+    if stem == "s2d":
+        features.add(S2DStem(channels))
+    else:
+        features.add(nn.Conv2D(channels, 7, 2, 3, use_bias=False))
+    features.add(nn.BatchNorm())
+    features.add(nn.Activation("relu"))
+    features.add(nn.MaxPool2D(3, 2, 1))
+
+
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
+                 stem="conv7", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         self.features = nn.HybridSequential()
-        if thumbnail:
-            self.features.add(_conv3x3(channels[0], 1, 0))
-        else:
-            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(3, 2, 1))
+        _add_stem(self.features, channels[0], thumbnail, stem)
         for i, num_layer in enumerate(layers):
             stride = 1 if i == 0 else 2
             self.features.add(self._make_layer(
@@ -173,17 +213,11 @@ class ResNetV1(HybridBlock):
 
 class ResNetV2(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
+                 stem="conv7", **kwargs):
         super().__init__(**kwargs)
         self.features = nn.HybridSequential()
         self.features.add(nn.BatchNorm(scale=False, center=False))
-        if thumbnail:
-            self.features.add(_conv3x3(channels[0], 1, 0))
-        else:
-            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(3, 2, 1))
+        _add_stem(self.features, channels[0], thumbnail, stem)
         in_channels = channels[0]
         for i, num_layer in enumerate(layers):
             stride = 1 if i == 0 else 2

@@ -1,0 +1,26 @@
+"""benchmark/score.py — the reference benchmark_score.py role (source
+of the BASELINE inference tables), driven end-to-end at CI scale."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_score_sweep_reports_models(tmp_path):
+    out = tmp_path / "score.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmark", "score.py"),
+         "--cpu", "--models", "resnet18_v1,squeezenet1_0",
+         "--batches", "2", "--image-size", "64",
+         "--steps", "2", "--warmup", "1", "--json", str(out)],
+        capture_output=True, text=True, timeout=540)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
+            if l.startswith("{")]
+    assert {r["model"] for r in rows} == {"resnet18_v1", "squeezenet1_0"}
+    assert all(r["img_per_sec"] > 0 for r in rows)
+    artifact = json.loads(out.read_text())
+    assert artifact["platform"] == "cpu"
+    assert len(artifact["results"]) == 2

@@ -5,6 +5,7 @@ TransformerLM sharding is covered in test_parallel.py.
 """
 import numpy as onp
 
+import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import nd, autograd, gluon
 
 
@@ -129,3 +130,35 @@ def test_lstm_lm_overfits():
 
     first, final = _overfit(step, 150, 0.4)
     assert final < first * 0.4, (first, final)
+
+
+def test_resnet_s2d_stem_variant():
+    """resnet50_v1(stem='s2d') — the MLPerf space-to-depth stem
+    (BENCH_STEM=s2d path): same output contract as the classic stem,
+    stem conv reads the s2d-packed 12-channel input, and the fused
+    train step runs end to end."""
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    from incubator_mxnet_tpu.fuse import make_fused_train_step
+
+    mx.random.seed(0)
+    net = vision.resnet50_v1(stem="s2d")
+    net.initialize(ctx=mx.cpu())
+    x = nd.random.uniform(shape=(2, 3, 64, 64))
+    out = net(x)
+    assert out.shape == (2, 1000)
+    # the stem conv consumes the 12-channel s2d layout
+    stem = net.features._children["0"]
+    assert stem.conv.weight.shape == (64, 12, 4, 4)
+    # spatial contract matches the classic stem stage by stage
+    plain = vision.resnet50_v1()
+    plain.initialize(ctx=mx.cpu())
+    assert plain(x).shape == out.shape
+
+    step = make_fused_train_step(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9})
+    y = nd.random.randint(0, 1000, shape=(2,))
+    l0 = float(step(x.data, y.data))
+    l1 = float(step(x.data, y.data))
+    assert onp.isfinite(l0) and onp.isfinite(l1)

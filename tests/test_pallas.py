@@ -296,19 +296,14 @@ def test_fused_rms_norm_matches_reference():
 def test_manifest_gates_kernels(tmp_path, monkeypatch):
     import json
     from incubator_mxnet_tpu.ops import pallas_kernels as pk
+    # the manifest gates only AUTO mode on the accelerator backend, so
+    # write a tpu-platform manifest and fake the backend as tpu
     man = tmp_path / "manifest.json"
-    man.write_text(json.dumps({
-        "format": "pallas_smoke_v1", "platform": "cpu",
-        "kernels": {"fused_softmax": {"ok": True},
-                    "flash_attention": {"ok": False}}}))
-    monkeypatch.setenv("MXNET_PALLAS_MANIFEST", str(man))
-    # the manifest gates only AUTO mode on the accelerator backend;
-    # simulate a tpu backend with a cpu-recorded... rather, rewrite the
-    # manifest as tpu so platforms match
     man.write_text(json.dumps({
         "format": "pallas_smoke_v1", "platform": "tpu",
         "kernels": {"fused_softmax": {"ok": True},
                     "flash_attention": {"ok": False}}}))
+    monkeypatch.setenv("MXNET_PALLAS_MANIFEST", str(man))
     monkeypatch.delenv("MXNET_USE_PALLAS", raising=False)
     monkeypatch.setattr(pk.jax, "default_backend", lambda: "tpu")
     pk.reload_manifest()
