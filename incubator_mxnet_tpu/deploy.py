@@ -105,7 +105,72 @@ def export_model(model, example_inputs, prefix, params=None):
     }
     with open(prefix + ".meta.json", "w") as f:
         json.dump(meta, f, indent=1)
+    _write_pjrt_sidecar(prefix, params, meta)
     return meta
+
+
+def _write_pjrt_sidecar(prefix, params, meta):
+    """Artifacts for the PURE-C++ PJRT predictor (src/pjrt_predict.cc):
+    no Python at serving time, so everything the C runtime needs is
+    spelled out flat —
+    * ``{prefix}.pjrt.json``: the mlir main's argument list in calling
+      order (param leaves in tree-flatten order, then user inputs) with
+      dtype/shape, and byte offsets into
+    * ``{prefix}.pjrt_params.bin``: concatenated little-endian raw
+      param bytes, and
+    * ``{prefix}.compile_options.pb``: a serialized CompileOptionsProto
+      for PJRT_Client_Compile (generated here because C has no proto
+      library).
+    """
+    import numpy as onp
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    args, offset = [], 0
+    with open(prefix + ".pjrt_params.bin", "wb") as f:
+        for path, leaf in flat:
+            arr = onp.asarray(leaf)
+            raw = arr.tobytes()
+            args.append({"kind": "param",
+                         "name": jax.tree_util.keystr(path),
+                         "dtype": jnp.dtype(arr.dtype).name,
+                         "shape": list(arr.shape),
+                         "offset": offset, "nbytes": len(raw)})
+            f.write(raw)
+            offset += len(raw)
+    for spec in meta["inputs"]:
+        args.append({"kind": "input", "dtype": spec["dtype"],
+                     "shape": spec["shape"]})
+    with open(prefix + ".pjrt.json", "w") as f:
+        json.dump({"format": "mxtpu_pjrt_v1", "args": args,
+                   "outputs": meta["outputs"]}, f, indent=1)
+    # line-oriented twin of pjrt.json for the C runtime (no JSON parser
+    # in C): "arg {param|input} dtype offset nbytes ndim d0 d1 ..." /
+    # "out dtype ndim d0 d1 ..."
+    with open(prefix + ".pjrt.txt", "w") as f:
+        for a in args:
+            dims = " ".join(str(d) for d in a["shape"])
+            off = a.get("offset", -1)
+            nb = a.get("nbytes", -1)
+            f.write(f"arg {a['kind']} {a['dtype']} {off} {nb} "
+                    f"{len(a['shape'])} {dims}".rstrip() + "\n")
+        for o in meta["outputs"]:
+            dims = " ".join(str(d) for d in o["shape"])
+            f.write(f"out {o['dtype']} {len(o['shape'])} {dims}".rstrip()
+                    + "\n")
+    try:
+        from jax._src.lib import _jax as _xc
+        blob = _xc.CompileOptions().SerializeAsString()  # before open():
+        # a failed serialization must not leave a truncated file behind
+    except Exception as e:
+        import warnings
+        if os.path.exists(prefix + ".compile_options.pb"):
+            os.remove(prefix + ".compile_options.pb")  # no stale lies
+        warnings.warn(
+            f"could not serialize CompileOptions ({e}); the PJRT-direct "
+            "C predictor will refuse this artifact (python Predictor "
+            "unaffected)")
+        return
+    with open(prefix + ".compile_options.pb", "wb") as f:
+        f.write(blob)
 
 
 class Predictor:

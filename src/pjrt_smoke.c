@@ -1,0 +1,62 @@
+/* Pure-C driver for the PJRT-direct predictor: no Python in this
+ * process at all.
+ *
+ * usage: mxt_pjrt_smoke <plugin.so> <options "k=v,..."> <prefix>
+ *   reads  {prefix}.smoke_in.bin   (float32, input 0)
+ *   writes {prefix}.smoke_out.bin  (float32, output 0)
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+extern int MXTPjrtPredCreate(const char*, const char*, const char*, void**);
+extern int MXTPjrtPredSetInput(void*, uint32_t, const float*, uint64_t);
+extern int MXTPjrtPredForward(void*);
+extern int MXTPjrtPredGetOutputSize(void*, uint32_t, uint64_t*);
+extern int MXTPjrtPredGetOutput(void*, uint32_t, float*, uint64_t);
+extern int MXTPjrtPredFree(void*);
+extern const char* MXTPjrtLastError(void);
+
+#define CHECK(x)                                                  \
+  if ((x) != 0) {                                                 \
+    fprintf(stderr, "FAILED %s: %s\n", #x, MXTPjrtLastError());   \
+    return 1;                                                     \
+  }
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s plugin.so options prefix\n", argv[0]);
+    return 2;
+  }
+  void* h = NULL;
+  CHECK(MXTPjrtPredCreate(argv[1], argv[2], argv[3], &h));
+
+  char path[1024];
+  snprintf(path, sizeof(path), "%s.smoke_in.bin", argv[3]);
+  FILE* f = fopen(path, "rb");
+  if (!f) { fprintf(stderr, "no %s\n", path); return 1; }
+  fseek(f, 0, SEEK_END);
+  long nbytes = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  float* in = (float*)malloc(nbytes);
+  if (fread(in, 1, nbytes, f) != (size_t)nbytes) return 1;
+  fclose(f);
+
+  CHECK(MXTPjrtPredSetInput(h, 0, in, (uint64_t)(nbytes / 4)));
+  CHECK(MXTPjrtPredForward(h));
+
+  uint64_t out_n = 0;
+  CHECK(MXTPjrtPredGetOutputSize(h, 0, &out_n));
+  float* out = (float*)malloc(out_n * 4);
+  CHECK(MXTPjrtPredGetOutput(h, 0, out, out_n));
+
+  snprintf(path, sizeof(path), "%s.smoke_out.bin", argv[3]);
+  f = fopen(path, "wb");
+  fwrite(out, 4, out_n, f);
+  fclose(f);
+  printf("PJRT_SMOKE_OK %llu\n", (unsigned long long)out_n);
+  MXTPjrtPredFree(h);
+  free(in);
+  free(out);
+  return 0;
+}
