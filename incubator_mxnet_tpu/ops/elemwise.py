@@ -242,3 +242,13 @@ def reset_arrays(*arrays, num_arrays=None):
     returns the zeroed tensors for rebinding."""
     arrays = arrays[:num_arrays] if num_arrays is not None else arrays
     return tuple(jnp.zeros_like(a) for a in arrays)
+
+
+@register("add_n", aliases=("ElementWiseSum", "elemwise_sum"))
+def add_n(*arrays, num_args=None):
+    """Sum of N tensors in one pass (reference elemwise_sum.cc add_n)."""
+    arrays = arrays[:num_args] if num_args is not None else arrays
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = out + a
+    return out

@@ -87,3 +87,51 @@ def index_array(x, axes=None):
     axes = axes or tuple(range(len(shape)))
     grids = jnp.meshgrid(*[jnp.arange(shape[a]) for a in axes], indexing="ij")
     return jnp.stack(grids, axis=-1).astype(jnp.int64 if False else jnp.int32)
+
+
+@register("batch_take", num_inputs=2, differentiable=False)
+def batch_take(a, indices):
+    """Row-wise pick: out[i] = a[i, indices[i]] (reference
+    indexing_op.cc batch_take; flattens leading dims like the
+    reference)."""
+    a2 = a.reshape(-1, a.shape[-1])
+    idx = indices.reshape(-1).astype(jnp.int32)
+    idx = jnp.clip(idx, 0, a2.shape[1] - 1)
+    return jnp.take_along_axis(a2, idx[:, None], axis=1)[:, 0] \
+        .reshape(indices.shape)
+
+
+@register("argmax_channel", num_inputs=1, differentiable=False)
+def argmax_channel(x):
+    """argmax over axis 1 returned as float (reference
+    broadcast_reduce_op_index.cc argmax_channel)."""
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+@register("ravel_multi_index", num_inputs=1, differentiable=False,
+          aliases=("_ravel_multi_index",))
+def ravel_multi_index(data, shape=None):
+    """(ndim, n) coordinates -> flat indices (reference ravel.cc)."""
+    coords = tuple(data[i].astype(jnp.int32)
+                   for i in range(data.shape[0]))
+    strides = []
+    acc = 1
+    for d in reversed(shape):
+        strides.append(acc)
+        acc *= d
+    strides = list(reversed(strides))
+    out = sum(c * s for c, s in zip(coords, strides))
+    return out.astype(jnp.float32) if data.dtype == jnp.float32 else out
+
+
+@register("unravel_index", num_inputs=1, differentiable=False,
+          aliases=("_unravel_index",))
+def unravel_index(data, shape=None):
+    """flat indices -> (ndim, n) coordinates (reference ravel.cc)."""
+    idx = data.astype(jnp.int32)
+    coords = []
+    for d in reversed(shape):
+        coords.append(idx % d)
+        idx = idx // d
+    out = jnp.stack(list(reversed(coords)))
+    return out.astype(jnp.float32) if data.dtype == jnp.float32 else out

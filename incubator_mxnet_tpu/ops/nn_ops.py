@@ -712,3 +712,96 @@ def softmax_xent(logits, labels):
         safe = jnp.clip(lbl, 0, logits.shape[-1] - 1)
         out = -jnp.take_along_axis(lp, safe[:, None], axis=-1)[:, 0]
     return out.astype(logits.dtype)
+
+
+# ---------------------------------------------------------------------------
+# im2col / col2im (reference src/operator/nn/im2col.h surfaced as ops)
+# ---------------------------------------------------------------------------
+
+def _im2col_impl(x, kernel, stride, dilate, pad):
+    nd_sp = x.ndim - 2
+    kernel = _pair(kernel, nd_sp)
+    stride = _pair(stride or 1, nd_sp)
+    dilate = _pair(dilate or 1, nd_sp)
+    pad = _pair(pad or 0, nd_sp)
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=tuple(kernel), window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=("NCHW", "OIHW", "NCHW") if nd_sp == 2 else
+        ("NCW", "OIW", "NCW"))
+    # (N, C*K, *out_spatial) -> (N, C*K, L), reference layout
+    return patches.reshape(patches.shape[0], patches.shape[1], -1)
+
+
+@register("im2col", num_inputs=1)
+def im2col(x, kernel=None, stride=None, dilate=None, pad=None):
+    """Unfold conv patches to columns: (N,C,*sp) -> (N, C*prod(k), L)
+    (reference im2col.h; channel-major patch layout)."""
+    return _im2col_impl(x, kernel, stride, dilate, pad)
+
+
+@register("col2im", num_inputs=1)
+def col2im(col, output_size=None, kernel=None, stride=None, dilate=None,
+           pad=None):
+    """Fold columns back with overlap-add — exactly im2col's adjoint,
+    realized through its transpose (reference col2im in im2col.h)."""
+    import numpy as _onp
+    n = col.shape[0]
+    kernel = _pair(kernel, len(output_size))
+    c = col.shape[1] // int(_onp.prod(kernel))
+    shape = (n, c) + tuple(output_size)
+    zero = jnp.zeros(shape, col.dtype)
+    _, vjp = jax.vjp(
+        lambda x: _im2col_impl(x, kernel, stride, dilate, pad), zero)
+    (out,) = vjp(col)
+    return out
+
+
+@register("softmax_cross_entropy", num_inputs=2)
+def softmax_cross_entropy(data, label):
+    """Total cross-entropy of softmax(data) vs integer labels, summed
+    over the batch into a scalar; differentiable in data like the
+    reference (loss_binary_op.cc:30 + SoftmaxCrossEntropyGrad)."""
+    lp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    lbl = jnp.clip(label.astype(jnp.int32), 0, data.shape[-1] - 1)
+    picked = jnp.take_along_axis(lp, lbl[:, None], axis=-1)[:, 0]
+    return -jnp.sum(picked).reshape((1,))
+
+
+@register("IdentityAttachKLSparseReg", num_inputs=1)
+def identity_attach_kl_sparse_reg(x, sparseness_target=0.1, penalty=0.001,
+                                  momentum=0.9):
+    """Identity forward; backward adds the KL-sparseness penalty
+    gradient  penalty * (-t/rho + (1-t)/(1-rho))  where rho is the mean
+    activation (reference identity_attach_KL_sparse_reg-inl.h:109).
+    Functional form uses the batch mean (the reference's moving average
+    is an aux state; ``momentum`` is accepted for signature parity)."""
+
+    @jax.custom_vjp
+    def _identity(v):
+        return v
+
+    def _fwd(v):
+        return v, jnp.mean(v, axis=0)
+
+    def _bwd(rho, g):
+        rho = jnp.clip(rho, 1e-6, 1 - 1e-6)
+        reg = penalty * (-sparseness_target / rho
+                         + (1 - sparseness_target) / (1 - rho))
+        return (g + reg,)
+
+    _identity.defvjp(_fwd, _bwd)
+    return _identity(x)
+
+
+@register("BatchNorm_v1", num_inputs=5)
+def batch_norm_v1(x, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                  momentum=0.9, fix_gamma=True, use_global_stats=False,
+                  output_mean_var=False, training=False):
+    """Legacy BatchNorm_v1 (reference batch_norm_v1.cc) — axis-1 only,
+    served by the modern implementation."""
+    return batch_norm.fn(x, gamma, beta, moving_mean, moving_var, eps=eps,
+                         momentum=momentum, fix_gamma=fix_gamma,
+                         use_global_stats=use_global_stats,
+                         output_mean_var=output_mean_var,
+                         training=training)

@@ -355,3 +355,122 @@ def multi_mp_sgd_mom_update(*tensors, lrs, wds, momentum=0.0,
         new_ms.append(nm)
         new_w32s.append(nw32)
     return tuple(new_ws) + tuple(new_ms) + tuple(new_w32s)
+
+
+# ---------------------------------------------------------------------------
+# FTML (optimizer_op-inl.h:1159 FTMLKernel)
+# ---------------------------------------------------------------------------
+
+@register("ftml_update", num_inputs=5)
+def ftml_update(weight, grad, d, v, z, lr, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                clip_grad=-1.0):
+    # reference FTMLKernel clips the wd-INCLUSIVE gradient as one
+    # quantity (optimizer_op-inl.h:1167-1169)
+    g = grad * rescale_grad + wd * weight
+    if clip_grad is not None and clip_grad >= 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (
+        jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    new_z = beta1 * z + (1 - beta1) * g - (d_t - beta1 * d) * weight
+    new_weight = -new_z / d_t
+    return (new_weight.astype(weight.dtype), d_t.astype(d.dtype),
+            new_v.astype(v.dtype), new_z.astype(z.dtype))
+
+
+# ---------------------------------------------------------------------------
+# LARS support ops (contrib/multi_sum_sq.cc, contrib/multi_lars.cc) —
+# the layer-wise adaptive-rate machinery LBSGD consumes
+# ---------------------------------------------------------------------------
+
+@register("multi_sum_sq", differentiable=False)
+def multi_sum_sq(*arrays, num_arrays=None):
+    """Per-array sum of squares, returned as one 1-D float32 array."""
+    n = num_arrays if num_arrays is not None else len(arrays)
+    return jnp.stack([jnp.sum(jnp.square(a.astype(jnp.float32)))
+                      for a in arrays[:n]])
+
+
+@register("multi_lars", num_inputs=4, differentiable=False)
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+               eps=1e-8, rescale_grad=1.0):
+    """LARS rate scaling (multi_lars-inl.h:61 MultiLARSKernel):
+    lr_i *= eta*|w|/(|g|*rescale + wd*|w| + eps) when both norms > 0."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    ratio = eta * w_norm / (g_norm + wds * w_norm + eps)
+    return jnp.where((w_norm > 0) & (g_norm > 0), lrs * ratio, lrs)
+
+
+# ---------------------------------------------------------------------------
+# preloaded_multi_* (contrib/preloaded_multi_sgd.cc): the multi_sgd
+# family with lrs/wds as TENSOR inputs (trailing), so the whole update
+# including hyperparameters stays on device
+# ---------------------------------------------------------------------------
+
+@register("preloaded_multi_sgd_update")
+def preloaded_multi_sgd_update(*tensors, rescale_grad=1.0,
+                               clip_gradient=-1.0, num_weights=None):
+    lrs, wds = tensors[-2], tensors[-1]
+    wg = tensors[:-2]
+    n = num_weights if num_weights is not None else len(wg) // 2
+    outs = []
+    for i in range(n):
+        outs.append(sgd_update.fn(wg[2 * i], wg[2 * i + 1], lrs[i],
+                                  wds[i], rescale_grad, clip_gradient))
+    return tuple(outs)
+
+
+@register("preloaded_multi_sgd_mom_update")
+def preloaded_multi_sgd_mom_update(*tensors, momentum=0.0,
+                                   rescale_grad=1.0, clip_gradient=-1.0,
+                                   num_weights=None):
+    lrs, wds = tensors[-2], tensors[-1]
+    wgm = tensors[:-2]
+    n = num_weights if num_weights is not None else len(wgm) // 3
+    new_ws, new_ms = [], []
+    for i in range(n):
+        nw, nm = sgd_mom_update.fn(wgm[3 * i], wgm[3 * i + 1],
+                                   wgm[3 * i + 2], lrs[i], momentum,
+                                   wds[i], rescale_grad, clip_gradient)
+        new_ws.append(nw)
+        new_ms.append(nm)
+    return tuple(new_ws) + tuple(new_ms)
+
+
+@register("preloaded_multi_mp_sgd_update")
+def preloaded_multi_mp_sgd_update(*tensors, rescale_grad=1.0,
+                                  clip_gradient=-1.0, num_weights=None):
+    lrs, wds = tensors[-2], tensors[-1]
+    wgw = tensors[:-2]
+    n = num_weights if num_weights is not None else len(wgw) // 3
+    new_ws, new_w32s = [], []
+    for i in range(n):
+        w, g, w32 = wgw[3 * i], wgw[3 * i + 1], wgw[3 * i + 2]
+        nw, nw32 = mp_sgd_update.fn(w, g, w32, lrs[i], wds[i],
+                                    rescale_grad, clip_gradient)
+        new_ws.append(nw)
+        new_w32s.append(nw32)
+    return tuple(new_ws) + tuple(new_w32s)
+
+
+@register("preloaded_multi_mp_sgd_mom_update")
+def preloaded_multi_mp_sgd_mom_update(*tensors, momentum=0.0,
+                                      rescale_grad=1.0,
+                                      clip_gradient=-1.0,
+                                      num_weights=None):
+    lrs, wds = tensors[-2], tensors[-1]
+    wgmw = tensors[:-2]
+    n = num_weights if num_weights is not None else len(wgmw) // 4
+    new_ws, new_ms, new_w32s = [], [], []
+    for i in range(n):
+        w, g, m, w32 = (wgmw[4 * i], wgmw[4 * i + 1], wgmw[4 * i + 2],
+                        wgmw[4 * i + 3])
+        nw, nm, nw32 = mp_sgd_mom_update.fn(w, g, m, w32, lrs[i],
+                                            momentum, wds[i],
+                                            rescale_grad, clip_gradient)
+        new_ws.append(nw)
+        new_ms.append(nm)
+        new_w32s.append(nw32)
+    return tuple(new_ws) + tuple(new_ms) + tuple(new_w32s)

@@ -440,3 +440,143 @@ def test_nd_cast_storage_frontend():
     back = nd.cast_storage(rsp, "default")
     assert not hasattr(back, "todense") or back.stype == "default"
     onp.testing.assert_array_equal(_np(back), _np(dense))
+
+
+# ---------------------------------------------------------------------------
+# round-4 op-gap closure (registry diff vs reference NNVM registrations)
+# ---------------------------------------------------------------------------
+
+def test_add_n_and_aliases():
+    xs = [nd.array(onp.full((3,), float(i))) for i in range(4)]
+    onp.testing.assert_allclose(nd.add_n(*xs).asnumpy(), 0 + 1 + 2 + 3)
+    onp.testing.assert_allclose(nd.ElementWiseSum(*xs).asnumpy(), 6.0)
+
+
+def test_batch_take_and_argmax_channel():
+    a = nd.array(onp.arange(12, dtype=onp.float32).reshape(4, 3))
+    idx = nd.array(onp.array([0, 2, 1, 0], onp.float32))
+    onp.testing.assert_allclose(nd.batch_take(a, idx).asnumpy(),
+                                [0, 5, 7, 9])
+    onp.testing.assert_allclose(nd.argmax_channel(a).asnumpy(),
+                                [2, 2, 2, 2])
+
+
+def test_ravel_unravel_roundtrip():
+    shape = (3, 4, 5)
+    rng = onp.random.RandomState(0)
+    coords = onp.stack([rng.randint(0, s, 10) for s in shape]) \
+        .astype(onp.int32)
+    flat = nd.ravel_multi_index(nd.array(coords), shape=shape)
+    onp.testing.assert_array_equal(
+        flat.asnumpy().astype(onp.int64),
+        onp.ravel_multi_index(coords, shape))
+    back = nd.unravel_index(flat, shape=shape)
+    onp.testing.assert_array_equal(back.asnumpy().astype(onp.int32), coords)
+
+
+def test_im2col_matches_conv_and_col2im_adjoint():
+    import jax.numpy as jnp
+    rng = onp.random.RandomState(0)
+    x = rng.randn(2, 3, 8, 8).astype(onp.float32)
+    w = rng.randn(5, 3, 3, 3).astype(onp.float32)
+    cols = nd.im2col(nd.array(x), kernel=(3, 3), stride=(2, 2),
+                     pad=(1, 1)).asnumpy()
+    # conv == weight-matrix times columns (the definition of im2col)
+    ref = onp.asarray(nd.Convolution(
+        nd.array(x), nd.array(w), kernel=(3, 3), stride=(2, 2),
+        pad=(1, 1), num_filter=5, no_bias=True).asnumpy())
+    got = (w.reshape(5, -1) @ cols).reshape(2, 5, 4, 4)
+    onp.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    # col2im is im2col's adjoint: <im2col(x), y> == <x, col2im(y)>
+    y = rng.randn(*cols.shape).astype(onp.float32)
+    lhs = float((cols * y).sum())
+    xi = nd.col2im(nd.array(y), output_size=(8, 8), kernel=(3, 3),
+                   stride=(2, 2), pad=(1, 1)).asnumpy()
+    rhs = float((x * xi).sum())
+    assert abs(lhs - rhs) < 1e-2 * max(abs(lhs), 1.0)
+
+
+def test_softmax_cross_entropy_scalar():
+    logits = onp.array([[1.0, 2.0, 3.0], [0.0, 0.0, 0.0]], onp.float32)
+    label = onp.array([2, 0], onp.float32)
+    out = nd.softmax_cross_entropy(nd.array(logits), nd.array(label))
+    p = onp.exp(logits) / onp.exp(logits).sum(1, keepdims=True)
+    want = -(onp.log(p[0, 2]) + onp.log(p[1, 0]))
+    onp.testing.assert_allclose(out.asnumpy(), [want], rtol=1e-5)
+
+
+def test_identity_attach_kl_sparse_reg():
+    from incubator_mxnet_tpu import autograd
+    rng = onp.random.RandomState(0)
+    act = rng.rand(16, 4).astype(onp.float32) * 0.5 + 0.25
+    x = nd.array(act)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.IdentityAttachKLSparseReg(x, sparseness_target=0.1,
+                                         penalty=0.01)
+        s = y.sum()
+    s.backward()
+    onp.testing.assert_allclose(y.asnumpy(), act)  # identity forward
+    rho = onp.clip(act.mean(0), 1e-6, 1 - 1e-6)
+    want = 1.0 + 0.01 * (-0.1 / rho + 0.9 / (1 - rho))
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                onp.broadcast_to(want, act.shape),
+                                rtol=1e-4)
+
+
+def test_ftml_update_moves_toward_negative_gradient():
+    w = nd.ones((4,))
+    g = nd.ones((4,)) * 0.5
+    d = nd.zeros((4,))
+    v = nd.zeros((4,))
+    z = nd.zeros((4,))
+    nw, ndd, nv, nz = nd.ftml_update(w, g, d, v, z, lr=0.1, t=1)
+    assert (nw.asnumpy() < 1.0).all()
+    onp.testing.assert_allclose(nv.asnumpy(), 0.001 * 0.25, rtol=1e-5)
+
+
+def test_multi_sum_sq_and_lars():
+    a = nd.array(onp.array([3.0, 4.0], onp.float32))
+    b = nd.array(onp.array([1.0], onp.float32))
+    ss = nd.multi_sum_sq(a, b)
+    onp.testing.assert_allclose(ss.asnumpy(), [25.0, 1.0])
+    lrs = nd.array(onp.array([0.1, 0.1], onp.float32))
+    wds = nd.array(onp.array([0.0, 0.0], onp.float32))
+    wss = nd.array(onp.array([25.0, 0.0], onp.float32))
+    gss = nd.array(onp.array([1.0, 1.0], onp.float32))
+    out = nd.multi_lars(lrs, wss, gss, wds, eta=1.0, eps=0.0)
+    # |w|=5, |g|=1 -> lr*5; zero-norm weight keeps its lr
+    onp.testing.assert_allclose(out.asnumpy(), [0.5, 0.1], rtol=1e-5)
+
+
+def test_preloaded_multi_sgd():
+    w0, g0 = nd.ones((3,)), nd.ones((3,))
+    w1, g1 = nd.ones((2,)) * 2, nd.ones((2,))
+    lrs = nd.array(onp.array([0.1, 0.5], onp.float32))
+    wds = nd.zeros((2,))
+    nw0, nw1 = nd.preloaded_multi_sgd_update(w0, g0, w1, g1, lrs, wds,
+                                             num_weights=2)
+    onp.testing.assert_allclose(nw0.asnumpy(), 0.9, rtol=1e-6)
+    onp.testing.assert_allclose(nw1.asnumpy(), 1.5, rtol=1e-6)
+
+
+def test_batch_norm_v1_alias():
+    x = nd.random.uniform(shape=(2, 3, 4, 4))
+    g, b = nd.ones((3,)), nd.zeros((3,))
+    mm, mv = nd.zeros((3,)), nd.ones((3,))
+    out = nd.BatchNorm_v1(x, g, b, mm, mv)
+    ref = nd.BatchNorm(x, g, b, mm, mv, fix_gamma=True, eps=1e-3)
+    onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-5)
+
+
+def test_softmax_cross_entropy_backprops():
+    from incubator_mxnet_tpu import autograd
+    logits = nd.array(onp.array([[1.0, 2.0, 3.0]], onp.float32))
+    label = nd.array(onp.array([2], onp.float32))
+    logits.attach_grad()
+    with autograd.record():
+        loss = nd.softmax_cross_entropy(logits, label)
+    loss.backward()
+    p = onp.exp([[1, 2, 3]]) / onp.exp([[1, 2, 3]]).sum()
+    want = p - onp.array([[0, 0, 1.0]])
+    onp.testing.assert_allclose(logits.grad.asnumpy(), want, rtol=1e-4)
