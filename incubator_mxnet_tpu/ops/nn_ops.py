@@ -7,6 +7,7 @@ FLOPs; layout is kept NCHW to match the reference's default data layout,
 with XLA free to relayout internally for the systolic array.
 """
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -14,6 +15,12 @@ from jax import lax
 from jax import nn as jnn
 
 from .registry import register
+
+# BatchNorm batch-stat algorithm, fixed at import: compiled traces are
+# cached (registry Op._jit_cache), so a runtime-mutable knob would be
+# silently ignored by already-traced callers.  Tests monkeypatch the
+# module attribute instead.
+_BN_STATS_MODE = os.environ.get("MXNET_BN_STATS", "onepass")
 
 
 def _pair(v, n):
@@ -182,9 +189,25 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
     # doubles its VMEM footprint for no accuracy win (VERDICT r2 Weak #2).
     if training and not use_global_stats:
         mean = jnp.mean(x, axis=reduce_axes, dtype=jnp.float32)
-        var = jnp.mean(
-            jnp.square(x.astype(jnp.float32) - mean.reshape(bshape)),
-            axis=reduce_axes)
+        if _BN_STATS_MODE == "twopass":
+            # numerically safest: E[(x-mu)^2].  The broadcast-subtract
+            # materializes an fp32 copy of the activation in the vjp —
+            # measured as the dominant HBM traffic of the bf16 train
+            # step on v5e, so one-pass is the default.
+            var = jnp.mean(
+                jnp.square(x.astype(jnp.float32) - mean.reshape(bshape)),
+                axis=reduce_axes)
+        else:
+            # one-pass E[x^2] - mu^2 (same form as flax BatchNorm): no
+            # fp32 activation-sized tensor exists fwd or bwd.  For bf16
+            # x the square is rounded to bf16 before the f32-accumulated
+            # sum (~2^-9 relative per element, averaged out over the
+            # batch*spatial reduction); cancellation needs |mu| >> sigma,
+            # which post-conv activations don't exhibit.  fp32 and bf16
+            # parity with two-pass is covered in tests.
+            meansq = jnp.mean(jnp.square(x), axis=reduce_axes,
+                              dtype=jnp.float32)
+            var = jnp.maximum(meansq - jnp.square(mean), 0.0)
         new_mean = (momentum * moving_mean
                     + (1 - momentum) * mean.astype(moving_mean.dtype))
         new_var = (momentum * moving_var

@@ -389,6 +389,121 @@ def probe_stem():
               f"({100 * flops / dt / PEAK:.1f}% of peak)", flush=True)
 
 
+def probe_raw():
+    """Attainable-ceiling reference: a hand-written NHWC bf16 ResNet-50
+    train step in raw jnp/lax — no framework, BN stats one-pass in f32,
+    SGD-momentum epilogue.  If this also lands at ~15% MFU the gap is
+    the platform/XLA; if it is much faster, the gap is in our graph."""
+    from jax import lax
+    bs = int(os.environ.get("PROBE_BS", "128"))
+    remat = os.environ.get("PROBE_REMAT", "0") == "1"
+    bn_batch_stats = os.environ.get("PROBE_BN", "batch") == "batch"
+
+    key = jax.random.PRNGKey(0)
+    stages = [(256, 64, 3), (512, 128, 4), (1024, 256, 6), (2048, 512, 3)]
+
+    def conv(x, w, s=1):
+        k = w.shape[0]
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NHWC", "HWIO", "NHWC"))
+        return lax.conv_general_dilated(x, w, (s, s),
+                                        [(k // 2, k // 2)] * 2,
+                                        dimension_numbers=dn)
+
+    def bn(x, p, training):
+        g, b = p
+        if training and bn_batch_stats:
+            mean = jnp.mean(x, (0, 1, 2), dtype=jnp.float32)
+            meansq = jnp.mean(jnp.square(x), (0, 1, 2), dtype=jnp.float32)
+            var = jnp.maximum(meansq - jnp.square(mean), 0.0)
+        else:
+            mean = jnp.zeros(x.shape[-1], jnp.float32)
+            var = jnp.ones(x.shape[-1], jnp.float32)
+        scale = (g * lax.rsqrt(var + 1e-5)).astype(x.dtype)
+        bias = (b - mean * g * lax.rsqrt(var + 1e-5)).astype(x.dtype)
+        return x * scale + bias
+
+    def init():
+        params = {}
+        k = [key]
+
+        def mk(name, shape, scale=0.05):
+            k[0], sub = jax.random.split(k[0])
+            params[name] = jax.random.normal(sub, shape, jnp.bfloat16) * scale
+
+        def mkbn(name, c):
+            params[name] = (jnp.ones(c, jnp.float32),
+                            jnp.zeros(c, jnp.float32))
+        mk("stem", (7, 7, 3, 64)); mkbn("stem_bn", 64)
+        cin = 64
+        for si, (co, cm, n) in enumerate(stages):
+            for bi in range(n):
+                p = f"s{si}b{bi}"
+                mk(p + "c1", (1, 1, cin, cm))
+                mk(p + "c2", (3, 3, cm, cm))
+                mk(p + "c3", (1, 1, cm, co))
+                mkbn(p + "bn1", cm); mkbn(p + "bn2", cm); mkbn(p + "bn3", co)
+                if bi == 0:
+                    mk(p + "sc", (1, 1, cin, co)); mkbn(p + "scbn", co)
+                cin = co
+        mk("fc", (2048, 1000), 0.01)
+        return params
+
+    def block(x, params, p, stride, proj, training):
+        y = bn(conv(x, params[p + "c1"]), params[p + "bn1"], training)
+        y = jnp.maximum(y, 0)
+        y = bn(conv(y, params[p + "c2"], stride), params[p + "bn2"], training)
+        y = jnp.maximum(y, 0)
+        y = bn(conv(y, params[p + "c3"]), params[p + "bn3"], training)
+        if proj:
+            x = bn(conv(x, params[p + "sc"], stride), params[p + "scbn"],
+                   training)
+        return jnp.maximum(x + y, 0)
+
+    def forward(params, x, training=True):
+        y = conv(x, params["stem"], 2)
+        y = jnp.maximum(bn(y, params["stem_bn"], training), 0)
+        y = lax.reduce_window(y, -jnp.inf, lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+        for si, (co, cm, n) in enumerate(stages):
+            for bi in range(n):
+                fn = (lambda yy, _si=si, _bi=bi, _n=n: block(
+                    yy, params, f"s{_si}b{_bi}",
+                    (2 if _bi == 0 and _si > 0 else 1), _bi == 0, training))
+                if remat:
+                    fn = jax.checkpoint(fn)
+                y = fn(y)
+        y = jnp.mean(y, (1, 2))
+        return y.astype(jnp.bfloat16) @ params["fc"]
+
+    def loss_fn(params, x, lbl):
+        logits = forward(params, x).astype(jnp.float32)
+        lp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(lp, lbl[:, None], 1))
+
+    params = init()
+    mom = jax.tree_util.tree_map(jnp.zeros_like, params)
+    x = jax.random.normal(key, (bs, 224, 224, 3), jnp.bfloat16)
+    lbl = jax.random.randint(key, (bs,), 0, 1000)
+
+    @jax.jit
+    def step(params, mom, x, lbl):
+        loss, g = jax.value_and_grad(loss_fn)(params, x, lbl)
+        mom = jax.tree_util.tree_map(
+            lambda m, gg: 0.9 * m + gg.astype(m.dtype), mom, g)
+        params = jax.tree_util.tree_map(
+            lambda p, m: p - (0.1 * m).astype(p.dtype), params, mom)
+        return params, mom, x, lbl
+
+    flops = 3 * 4.089e9 * bs
+    dt = timeit(lambda p, m, a, b: step(p, m, a, b), (params, mom, x, lbl),
+                steps=10, warmup=3)
+    tag = (f"raw NHWC train bs={bs} remat={int(remat)} "
+           f"bn={'batch' if bn_batch_stats else 'eval'}")
+    print(f"{tag}: {dt * 1e3:7.2f} ms  {bs / dt:7.1f} img/s  "
+          f"{100 * flops / dt / PEAK:5.1f}% MFU", flush=True)
+
+
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "fused"
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
@@ -407,5 +522,7 @@ if __name__ == "__main__":
         probe_stem()
     elif mode == "layout":
         probe_layout()
+    elif mode == "raw":
+        probe_raw()
     else:
         probe_fused()

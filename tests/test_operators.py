@@ -131,6 +131,40 @@ def test_batchnorm_inference_and_training():
     assert_almost_equal(out, x.asnumpy() / onp.sqrt(1 + 1e-5), rtol=1e-4)
 
 
+def test_batchnorm_onepass_matches_twopass():
+    """Training-mode batch stats: the one-pass E[x^2]-mu^2 form (the
+    TPU default — no fp32 activation materialized) must match the
+    two-pass E[(x-mu)^2] form, fwd and grad, in fp32 AND in bf16 (the
+    production training dtype, where the square rounds to bf16)."""
+    from incubator_mxnet_tpu.ops import nn_ops
+    import jax, jax.numpy as jnp
+    x32 = onp.random.randn(8, 5, 6, 6).astype("float32") * 3 + 1.5
+    g = onp.random.rand(5).astype("float32") + 0.5
+    b = onp.random.randn(5).astype("float32")
+
+    def run(mode, dtype):
+        saved = nn_ops._BN_STATS_MODE
+        nn_ops._BN_STATS_MODE = mode
+        try:
+            def f(x, g, b):
+                out = nn_ops.batch_norm.fn(
+                    jnp.asarray(x, dtype), jnp.asarray(g), jnp.asarray(b),
+                    jnp.zeros(5), jnp.ones(5), training=True)
+                return out[0] if isinstance(out, tuple) else out
+            y, vjp = jax.vjp(f, x32, g, b)
+            grads = vjp(jnp.ones_like(y))
+            return [onp.asarray(t, "float32") for t in (y,) + grads]
+        finally:
+            nn_ops._BN_STATS_MODE = saved
+
+    for dtype, rtol, atol in (("float32", 1e-4, 1e-4),
+                              ("bfloat16", 2e-2, 2e-2)):
+        one = run("onepass", dtype)
+        two = run("twopass", dtype)
+        for a, c in zip(one, two):
+            assert_almost_equal(a, c, rtol=rtol, atol=atol)
+
+
 def test_layer_norm_matches_numpy():
     x = onp.random.rand(2, 5).astype("float32")
     g = onp.ones(5, "float32")
