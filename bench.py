@@ -110,7 +110,8 @@ def _child(platform: str) -> None:
                 amp.convert_block(net, "bfloat16")
             step = make_fused_train_step(
                 net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
-                {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+                {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+                remat=os.environ.get("BENCH_REMAT") or None)
             x = jnp.asarray(onp.random.rand(bs, 3, 224, 224), jnp.float32)
             if dtype == "bfloat16":
                 x = x.astype(jnp.bfloat16)

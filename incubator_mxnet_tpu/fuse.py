@@ -98,7 +98,7 @@ class FusedTrainStep:
     """
 
     def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
-                 mesh=None, batch_spec=None, donate=True):
+                 mesh=None, batch_spec=None, donate=True, remat=None):
         self.block = block
         self.loss_block = loss_fn
         opt_params = dict(optimizer_params or {})
@@ -126,7 +126,11 @@ class FusedTrainStep:
             raise ValueError(
                 f"fused step supports sgd/nag/adam/adamw; got {optimizer!r} "
                 f"(use the eager Trainer for others)")
+        if remat not in (None, "dots", "nothing"):
+            raise ValueError(
+                f"remat must be None, 'dots' or 'nothing'; got {remat!r}")
         self._key = jax.random.PRNGKey(0)
+        self._remat = remat
         self._step_fn = self._build(mesh, batch_spec, donate)
         self._last = None
 
@@ -143,6 +147,17 @@ class FusedTrainStep:
                 out = out[0]
             loss = loss_block(NDArray(out), NDArray(y))
             return jnp.mean(loss.data), updates
+
+        if self._remat:
+            # rematerialization (SURVEY §"HBM bandwidth"): trade recompute
+            # for activation traffic.  'dots' keeps matmul outputs and
+            # recomputes the elementwise/norm tail in the backward pass;
+            # 'nothing' recomputes the whole forward.
+            policies = {
+                "dots": jax.checkpoint_policies.checkpoint_dots,
+                "nothing": jax.checkpoint_policies.nothing_saveable,
+            }
+            loss_of = jax.checkpoint(loss_of, policy=policies[self._remat])
 
         def step(params, aux, opt_state, x, y, key):
             (loss, updates), grads = jax.value_and_grad(

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Serial queue of every measurement that needs the real TPU chip.
+# Resumable: each job writes its artifact under artifacts/r4/ and is
+# skipped when that file already exists (delete to re-run).  One job at
+# a time — the chip is single-claim.  A wedged tunnel costs one job's
+# timeout, not the queue.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p artifacts/r4
+run() { # name timeout_s cmd...
+  local name="$1" t="$2"; shift 2
+  local out="artifacts/r4/$name.txt"
+  if [ -s "$out" ] && ! grep -q "QUEUE_FAILED" "$out"; then
+    echo "== $name: already done, skipping"; return 0
+  fi
+  echo "== $name (timeout ${t}s)"
+  if timeout "$t" "$@" > "$out.tmp" 2>&1; then
+    mv "$out.tmp" "$out"; echo "   ok"
+  else
+    echo "QUEUE_FAILED rc=$?" >> "$out.tmp"; mv "$out.tmp" "$out"
+    echo "   FAILED (see $out)"
+  fi
+}
+
+# cheap liveness gate so a wedged tunnel exits fast
+if ! timeout 90 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()[0]; assert d.platform != 'cpu'
+x = jax.device_put(jnp.ones((256,256), jnp.bfloat16), d)
+float((x@x).sum())" >/dev/null 2>&1; then
+  echo "chip not reachable — aborting queue"; exit 1
+fi
+echo "chip alive; running queue"
+
+run ablate    900  python scripts/perf_probe.py ablate
+run raw128    900  env PROBE_BS=128 python scripts/perf_probe.py raw
+run raw256r   900  env PROBE_BS=256 PROBE_REMAT=1 python scripts/perf_probe.py raw
+run bench     1100 env BENCH_DEADLINE=1000 BENCH_SWEEP=128,256,512 python bench.py
+run consist   1500 python scripts/tpu_consistency.py --deadline 1400
+run opperf    1800 python benchmark/opperf.py --platform tpu --output artifacts/r4/opperf_tpu.json
+run int8      900  python examples/quantize_resnet50.py
+echo "queue complete"

@@ -2,74 +2,281 @@
 
 The reference's flagship correctness tool is check_consistency
 (test_utils.py:1428): run the same op on every backend and cross-check.
-This script runs a battery of representative ops on the CPU backend and
-the real TPU and asserts parity — the CPU-vs-GPU oracle recast for TPU.
+This script does that for the real TPU at registry scale: every op
+benchmark/opperf.py has an input spec for is run on the CPU backend and
+the chip — forward in fp32 AND bf16, gradient in fp32 — and
+cross-checked (VERDICT r3 Next #3).
 
-Run directly (prints one line per case), or via
-tests/test_tpu_consistency.py which subprocess-guards against a wedged
-axon tunnel (the first device op can hang forever there).
+Robustness (the tunnel can wedge at any device op): ops run in CHUNKED
+SUBPROCESSES under timeouts, results append to the artifact after every
+chunk, and already-recorded ops are skipped on re-run — the battery is
+resumable and a hang costs one chunk.
+
+Usage:
+  python scripts/tpu_consistency.py [--out artifacts/r4/consistency.json]
+      [--deadline 1200] [--chunk 8] [--ops name1,name2]
+Exit 0 iff every attempted op passed.
 """
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
 import sys
+import time
 
-import numpy as onp
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# ops whose outputs are legitimately backend-dependent
+SKIP = {
+    "arange", "eye",              # no tensor inputs; trivial + shape-only
+    "RNN",                        # stateful signature, exercised in gluon
+}
+# reductions/factorizations where fp32 associativity differs across
+# backends more than the default tolerance
+LOOSE = {"linalg_syevd", "linalg_potri", "linalg_gelqf", "hawkesll",
+         "softmax_cross_entropy", "norm"}
+
+FP32_TOL = 2e-3
+LOOSE_TOL = 2e-2
+BF16_TOL = 4e-2
 
 
-def main():
+def op_list():
+    """Curated opperf specs plus a generic fallback for every other
+    registry op (dedup by canonical name).  Generic cases that the CPU
+    oracle itself cannot run are recorded as 'skip', not 'fail' — the
+    battery measures CPU↔TPU parity, not spec completeness."""
+    from benchmark.opperf import default_specs
+    from incubator_mxnet_tpu.ops import registry
+    specs = default_specs(n=256)
+
+    import numpy as onp
+    rng = onp.random.RandomState(7)
+
+    def generic(nin):
+        def gen():
+            import jax.numpy as jnp
+            return ([jnp.asarray(rng.rand(8, 8) + 0.5, jnp.float32)
+                     for _ in range(nin)], {})
+        return gen
+
+    # domain-constrained inputs the generic fallback can't guess
+    import jax.numpy as _jnp
+    specs["arccosh"] = lambda: (
+        [_jnp.asarray(rng.rand(8, 8) + 1.1, _jnp.float32)], {})
+    specs["arctanh"] = lambda: (
+        [_jnp.asarray(rng.rand(8, 8) * 1.6 - 0.8, _jnp.float32)], {})
+    specs["erfinv"] = lambda: (
+        [_jnp.asarray(rng.rand(8, 8) * 1.6 - 0.8, _jnp.float32)], {})
+    _m = rng.rand(8, 8)
+    specs["linalg_potrf"] = lambda: (
+        [_jnp.asarray(_m @ _m.T + 8 * onp.eye(8), _jnp.float32)], {})
+
+    seen_canonical = set()
+    for name in registry.list_ops():
+        op = registry.get_op(name)
+        if op.name in seen_canonical:
+            continue
+        seen_canonical.add(op.name)
+        if op.name in specs or op.name in SKIP:
+            continue
+        if any(tok in op.name.lower() for tok in
+               ("random", "sample", "shuffle", "dropout", "rand")):
+            continue  # stochastic: parity is a seeding contract, not
+            # bitwise (docs/migration.md RNG note)
+        info = registry.describe_op(op)
+        nin = len([i for i in info["inputs"] if i != "*args"])
+        if not (1 <= nin <= 3):
+            continue
+        specs[op.name] = generic(nin)
+    return specs, [k for k, v in sorted(specs.items())
+                   if v is not None and k not in SKIP]
+
+
+def _child(names):
     import jax
+    if os.environ.get("CONSIST_FORCE_CPU") == "1":
+        # harness self-test without a chip: the sitecustomize pins the
+        # axon platform programmatically, so the env var alone is not
+        # enough (docs/performance.md)
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as onp
+    import jax.numpy as jnp
+
+    cpu0 = jax.local_devices(backend="cpu")[0]
     accel = jax.devices()[0]
-    if accel.platform == "cpu":
-        print("NO_ACCELERATOR")
-        return 0
-    import incubator_mxnet_tpu as mx
-    from incubator_mxnet_tpu import nd
-    from incubator_mxnet_tpu.test_utils import check_consistency
+    if accel.platform == "cpu" and os.environ.get(
+            "CONSIST_SELF_TEST") != "1":
+        print("NO_ACCELERATOR", flush=True)
+        return
+    from incubator_mxnet_tpu.ops import registry
+    specs, _ = op_list()
 
-    R = onp.random.RandomState(0)
-    ctxs = [mx.cpu(), mx.tpu()]
+    def to_np(t):
+        return onp.asarray(jax.device_get(t))
 
-    cases = [
-        ("matmul_f32", lambda a, b: nd.dot(a, b),
-         [R.rand(16, 32).astype("f"), R.rand(32, 8).astype("f")], 1e-4),
-        ("conv", lambda x, w: nd.Convolution(
-            x, w, kernel=(3, 3), num_filter=8, pad=(1, 1), no_bias=True),
-         [R.rand(2, 4, 8, 8).astype("f"), R.rand(8, 4, 3, 3).astype("f")],
-         1e-3),
-        ("batchnorm_eval", lambda x, g, b, m, v: nd.BatchNorm(
-            x, g, b, m, v, training=False),
-         [R.rand(2, 3, 4, 4).astype("f"), onp.ones(3, "f"),
-          onp.zeros(3, "f"), R.rand(3).astype("f"),
-          (R.rand(3) + 0.5).astype("f")], 1e-3),
-        ("softmax", lambda x: nd.softmax(x, axis=-1),
-         [R.randn(4, 10).astype("f")], 1e-4),
-        ("logsumexp_red", lambda x: nd.sum(nd.exp(x - nd.max(x))),
-         [R.randn(3, 7).astype("f")], 1e-4),
-        ("layer_norm", lambda x, g, b: nd.LayerNorm(x, g, b),
-         [R.rand(4, 16).astype("f"), onp.ones(16, "f"),
-          onp.zeros(16, "f")], 1e-3),
-        ("take", lambda x: nd.take(x, nd.array(
-            onp.array([0, 3, 1], onp.int32))),
-         [R.rand(5, 4).astype("f")], 1e-6),
-        ("selfatt_qk", lambda qkv: nd.interleaved_matmul_selfatt_qk(
-            qkv, heads=2),
-         [R.randn(6, 2, 24).astype("f")], 1e-3),
-        ("pooling", lambda x: nd.Pooling(x, kernel=(2, 2), stride=(2, 2),
-                                         pool_type="max"),
-         [R.rand(2, 3, 8, 8).astype("f")], 1e-6),
-        ("topk", lambda x: nd.topk(x, k=3, ret_typ="value"),
-         [R.rand(4, 10).astype("f")], 1e-6),
-    ]
-    failures = 0
-    for name, fn, inputs, tol in cases:
+    def run_on(dev, op, args_np, kwargs, dtype):
+        args = []
+        for a in args_np:
+            t = jnp.asarray(a)
+            if dtype == "bfloat16" and jnp.issubdtype(t.dtype, jnp.floating):
+                t = t.astype(jnp.bfloat16)
+            args.append(jax.device_put(t, dev))
+        fwd = jax.jit(lambda *a: op.fn(*a, **kwargs))
+        out = fwd(*args)
+        outs = [to_np(t).astype("float32")
+                for t in jax.tree_util.tree_leaves(out)]
+        grads = []
+        if dtype == "float32" and op.differentiable:
+            fpos = tuple(i for i, a in enumerate(args)
+                         if jnp.issubdtype(a.dtype, jnp.floating))
+            if fpos:
+                def loss(*a):
+                    o = op.fn(*a, **kwargs)
+                    return sum(jnp.sum(l.astype(jnp.float32))
+                               for l in jax.tree_util.tree_leaves(o)
+                               if jnp.issubdtype(l.dtype, jnp.floating))
+                g = jax.jit(jax.grad(loss, argnums=fpos))(*args)
+                grads = [to_np(t).astype("float32")
+                         for t in jax.tree_util.tree_leaves(g)]
+        return outs, grads
+
+    for name in names:
+        t0 = time.monotonic()
         try:
-            check_consistency(fn, inputs, ctx_list=ctxs, rtol=tol, atol=tol)
-            print(f"OK {name}", flush=True)
-        except Exception as e:  # noqa: BLE001 — one op failing (parity
-            # OR lowering error) must not abort the rest of the battery
-            failures += 1
-            print(f"FAIL {name}: {type(e).__name__}: {str(e)[:200]}",
-                  flush=True)
-    print(f"DONE {len(cases) - failures}/{len(cases)}")
-    return 1 if failures else 0
+            op = registry.get_op(name)
+            gen = specs[name]
+            args, kwargs = gen()
+            args_np = [to_np(a) for a in args]
+            tol = LOOSE_TOL if name in LOOSE else FP32_TOL
+            worst = 0.0
+            for dtype, dtol in (("float32", tol), ("bfloat16", BF16_TOL)):
+                try:
+                    ref_o, ref_g = run_on(cpu0, op, args_np, kwargs, dtype)
+                except Exception as e:  # noqa: BLE001 — the CPU oracle
+                    # can't run this (generic) spec: a spec gap, not a
+                    # TPU parity failure
+                    msg = f"{type(e).__name__}"[:80]
+                    print(f"RESULT {name} skip cpu-oracle {msg}",
+                          flush=True)
+                    break
+                got_o, got_g = run_on(accel, op, args_np, kwargs, dtype)
+                for r, g in zip(ref_o + ref_g, got_o + got_g):
+                    finite = onp.isfinite(r) & onp.isfinite(g)
+                    denom = onp.maximum(onp.abs(r), 1.0)
+                    diff = onp.where(finite, onp.abs(r - g) / denom, 0.0)
+                    err = float(onp.max(diff)) if r.size else 0.0
+                    worst = max(worst, err)
+                    # equal_nan: agreeing on the invalid domain IS
+                    # consistency; disagreeing (one finite, one not)
+                    # fails via the isfinite mask below
+                    if not onp.allclose(r, g, rtol=dtol, atol=dtol,
+                                        equal_nan=True):
+                        raise AssertionError(
+                            f"{dtype} mismatch rel-err {err:.3e} > {dtol}")
+                    if not bool(onp.all(onp.isfinite(r) ==
+                                        onp.isfinite(g))):
+                        raise AssertionError(
+                            f"{dtype} finiteness mismatch")
+            else:
+                print(f"RESULT {name} ok {worst:.3e} "
+                      f"{time.monotonic() - t0:.1f}s", flush=True)
+        except Exception as e:  # noqa: BLE001 — record and continue
+            msg = f"{type(e).__name__}: {e}"[:160].replace("\n", " ")
+            print(f"RESULT {name} FAIL {msg}", flush=True)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="artifacts/r4/consistency.json")
+    p.add_argument("--deadline", type=float, default=1200.0)
+    p.add_argument("--chunk", type=int, default=8)
+    p.add_argument("--ops", default=None)
+    p.add_argument("--child", default=None, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.child is not None:
+        _child(args.child.split(","))
+        return 0
+
+    t_start = time.monotonic()
+    remaining = lambda: args.deadline - (time.monotonic() - t_start)  # noqa
+
+    _, names = op_list()
+    if args.ops:
+        names = args.ops.split(",")
+
+    results = {}
+    if os.path.exists(args.out):
+        with open(args.out) as f:
+            results = json.load(f).get("ops", {})
+        if not args.ops:
+            # resume: FAILed ops get a retry; passes and skips are kept.
+            # An explicit --ops list always re-runs what it names.
+            names = [n for n in names
+                     if results.get(n, {}).get("status")
+                     not in ("ok", "skip")]
+    print(f"{len(names)} ops to run", flush=True)
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+
+    def flush():
+        ok = sum(1 for r in results.values() if r["status"] == "ok")
+        skip = sum(1 for r in results.values() if r["status"] == "skip")
+        doc = {"format": "tpu_consistency_v1", "passed": ok,
+               "skipped": skip, "failed": len(results) - ok - skip,
+               "total": len(results), "ops": results}
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, args.out)
+
+    i = 0
+    while i < len(names) and remaining() > 90:
+        chunk = names[i:i + args.chunk]
+        i += args.chunk
+        # generous first-compile allowance, then ~20s/op
+        budget = min(120 + 25 * len(chunk), remaining() - 10)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--child", ",".join(chunk)],
+                capture_output=True, text=True, timeout=budget)
+            out = proc.stdout
+        except subprocess.TimeoutExpired as e:
+            out = (e.stdout or b"").decode() if isinstance(
+                e.stdout, bytes) else (e.stdout or "")
+            print(f"chunk timed out after {budget:.0f}s", flush=True)
+        if "NO_ACCELERATOR" in out:
+            print("no accelerator visible — aborting", flush=True)
+            return 2
+        seen = set()
+        for line in out.splitlines():
+            if not line.startswith("RESULT "):
+                continue
+            _, name, status, *rest = line.split(" ", 3)
+            seen.add(name)
+            results[name] = {
+                "status": status if status in ("ok", "skip") else "fail",
+                "detail": " ".join(rest)}
+            print(line, flush=True)
+        for name in chunk:
+            if name not in seen and name not in results:
+                results[name] = {"status": "fail",
+                                 "detail": "no result (hang/timeout)"}
+                print(f"RESULT {name} FAIL no result (hang/timeout)",
+                      flush=True)
+        flush()
+
+    ok = sum(1 for r in results.values() if r["status"] == "ok")
+    skip = sum(1 for r in results.values() if r["status"] == "skip")
+    fail = len(results) - ok - skip
+    print(f"DONE {ok} ok / {skip} skip / {fail} fail "
+          f"({len(names) - min(i, len(names))} not attempted)", flush=True)
+    return 0 if fail == 0 and i >= len(names) else 1
 
 
 if __name__ == "__main__":

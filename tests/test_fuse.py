@@ -103,6 +103,25 @@ def test_fused_step_loss_decreases():
     assert last < first * 0.7, (first, last)
 
 
+@pytest.mark.parametrize("remat", ["dots", "nothing"])
+def test_fused_step_remat_matches_plain(remat):
+    """Rematerialization must not change the computed update — only the
+    schedule.  Same seed, same data: identical loss trajectory."""
+    import incubator_mxnet_tpu as mx
+
+    def run(r):
+        mx.random.seed(0)
+        net = _net()
+        step = make_fused_train_step(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9}, remat=r)
+        x, y = _data(bs=8)
+        return [float(step(x, y)) for _ in range(3)]
+
+    plain, rem = run(None), run(remat)
+    assert plain == pytest.approx(rem, rel=1e-5), (plain, rem)
+
+
 def test_fused_step_rejects_unknown_optimizer():
     net = _net()
     with pytest.raises(ValueError, match="fused step supports"):
