@@ -217,6 +217,9 @@ def main():
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--size", type=int, default=1024)
     ap.add_argument("--no-grad", action="store_true")
+    ap.add_argument("--resume", action="store_true",
+                    help="keep results already in --output and only "
+                    "measure the rest (wedged-tunnel recovery)")
     ap.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                     help="force a jax platform (a site plugin may override "
                     "JAX_PLATFORMS; this uses jax.config directly)")
@@ -235,6 +238,14 @@ def main():
     wanted = [s for s in args.ops.split(",") if s] or sorted(specs)
     results, skipped = {}, {}
     platform = jax.devices()[0].platform
+    if args.resume and os.path.exists(args.output):
+        with open(args.output) as f:
+            prev = json.load(f)
+        if prev.get("platform") == platform:
+            results = prev.get("results", {})
+            wanted = [n for n in wanted if n not in results]
+            print(f"resuming: {len(results)} ops kept, "
+                  f"{len(wanted)} to measure", flush=True)
     for name in wanted:
         spec = specs.get(name)
         if spec is None:
@@ -259,10 +270,15 @@ def main():
                   flush=True)
         except Exception as e:  # record, keep sweeping
             skipped[name] = f"{type(e).__name__}: {e}"[:200]
-    out = {"platform": platform, "n_ops": len(results),
-           "steps": args.steps, "results": results, "skipped": skipped}
-    with open(args.output, "w") as f:
-        json.dump(out, f, indent=1)
+        # flush INCREMENTALLY: on an accelerator a wedged tunnel can
+        # hang any op mid-sweep, and the ops already measured must
+        # survive the parent's kill (same policy as pallas_smoke)
+        out = {"platform": platform, "n_ops": len(results),
+               "steps": args.steps, "results": results, "skipped": skipped}
+        tmp = args.output + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f, indent=1)
+        os.replace(tmp, args.output)
     print(f"\n{len(results)} ops benchmarked, {len(skipped)} skipped "
           f"-> {args.output}")
 

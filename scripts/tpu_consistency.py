@@ -33,11 +33,13 @@ sys.path.insert(0, REPO)
 SKIP = {
     "arange", "eye",              # no tensor inputs; trivial + shape-only
     "RNN",                        # stateful signature, exercised in gluon
+    "linalg_syevd", "linalg_gelqf",  # unique only up to column/row sign;
+    # element-wise cross-backend compare is meaningless.  Correctness is
+    # covered by reconstruction tests (tests/test_op_tail.py linalg).
 }
 # reductions/factorizations where fp32 associativity differs across
 # backends more than the default tolerance
-LOOSE = {"linalg_syevd", "linalg_potri", "linalg_gelqf", "hawkesll",
-         "softmax_cross_entropy", "norm"}
+LOOSE = {"linalg_potri", "hawkesll", "softmax_cross_entropy", "norm"}
 
 FP32_TOL = 2e-3
 LOOSE_TOL = 2e-2
@@ -103,6 +105,10 @@ def _child(names):
         # axon platform programmatically, so the env var alone is not
         # enough (docs/performance.md)
         jax.config.update("jax_platforms", "cpu")
+    # (no platform pinning in the accelerator path: under the axon
+    # plugin the host oracle stays reachable via backend="cpu" — the
+    # same split bench.py's TPU child uses, proven on hardware; the
+    # plugin's platform naming rejects explicit "axon,cpu" pin strings)
     import numpy as onp
     import jax.numpy as jnp
 
@@ -153,15 +159,22 @@ def _child(names):
             args_np = [to_np(a) for a in args]
             tol = LOOSE_TOL if name in LOOSE else FP32_TOL
             worst = 0.0
+            passed_dtypes = []
             for dtype, dtol in (("float32", tol), ("bfloat16", BF16_TOL)):
                 try:
                     ref_o, ref_g = run_on(cpu0, op, args_np, kwargs, dtype)
                 except Exception as e:  # noqa: BLE001 — the CPU oracle
-                    # can't run this (generic) spec: a spec gap, not a
-                    # TPU parity failure
+                    # can't run this leg: a spec/kernel gap, not a TPU
+                    # parity failure.  A completed fp32 verdict is kept
+                    # (LAPACK-backed ops often have no bf16 CPU kernel).
                     msg = f"{type(e).__name__}"[:80]
-                    print(f"RESULT {name} skip cpu-oracle {msg}",
-                          flush=True)
+                    if passed_dtypes:
+                        print(f"RESULT {name} ok {worst:.3e} "
+                              f"{'+'.join(passed_dtypes)}-only "
+                              f"(cpu-oracle {msg} on {dtype})", flush=True)
+                    else:
+                        print(f"RESULT {name} skip cpu-oracle {msg}",
+                              flush=True)
                     break
                 got_o, got_g = run_on(accel, op, args_np, kwargs, dtype)
                 for r, g in zip(ref_o + ref_g, got_o + got_g):
@@ -181,6 +194,7 @@ def _child(names):
                                         onp.isfinite(g))):
                         raise AssertionError(
                             f"{dtype} finiteness mismatch")
+                passed_dtypes.append(dtype)
             else:
                 print(f"RESULT {name} ok {worst:.3e} "
                       f"{time.monotonic() - t0:.1f}s", flush=True)
@@ -240,13 +254,16 @@ def main(argv=None):
         i += args.chunk
         # generous first-compile allowance, then ~20s/op
         budget = min(120 + 25 * len(chunk), remaining() - 10)
+        timed_out, stderr_tail = False, ""
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
                  "--child", ",".join(chunk)],
                 capture_output=True, text=True, timeout=budget)
             out = proc.stdout
+            stderr_tail = (proc.stderr or "")[-300:].replace("\n", " | ")
         except subprocess.TimeoutExpired as e:
+            timed_out = True
             out = (e.stdout or b"").decode() if isinstance(
                 e.stdout, bytes) else (e.stdout or "")
             print(f"chunk timed out after {budget:.0f}s", flush=True)
@@ -263,12 +280,15 @@ def main(argv=None):
                 "status": status if status in ("ok", "skip") else "fail",
                 "detail": " ".join(rest)}
             print(line, flush=True)
+        # crash vs hang: a chunk that FINISHED without emitting results
+        # is a harness crash (import error, registry break) and must
+        # read as one — a silent skip would let the battery rot green
+        missing_why = ("no result (hang/timeout)" if timed_out else
+                       f"child crashed: {stderr_tail or 'no stderr'}")
         for name in chunk:
             if name not in seen and name not in results:
-                results[name] = {"status": "fail",
-                                 "detail": "no result (hang/timeout)"}
-                print(f"RESULT {name} FAIL no result (hang/timeout)",
-                      flush=True)
+                results[name] = {"status": "fail", "detail": missing_why}
+                print(f"RESULT {name} FAIL {missing_why}", flush=True)
         flush()
 
     ok = sum(1 for r in results.values() if r["status"] == "ok")
