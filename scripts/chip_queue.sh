@@ -36,6 +36,7 @@ run ablate    900  python scripts/perf_probe.py ablate
 run raw128    900  env PROBE_BS=128 python scripts/perf_probe.py raw
 run raw256r   900  env PROBE_BS=256 PROBE_REMAT=1 python scripts/perf_probe.py raw
 run bench     1100 env BENCH_DEADLINE=1000 BENCH_SWEEP=128,256,512 python bench.py
+run benchrem  900  env BENCH_DEADLINE=800 BENCH_SWEEP=256,512 BENCH_REMAT=dots python bench.py
 run consist   1500 python scripts/tpu_consistency.py --deadline 1400
 run opperf    1800 python benchmark/opperf.py --platform tpu --resume --output artifacts/r4/opperf_tpu.json
 run int8      900  python examples/quantize_resnet50.py
