@@ -39,6 +39,19 @@ PEAK_FLOPS = {  # per-chip bf16 peak, for the MFU estimate
 }
 
 
+def ensure_compile_cache() -> None:
+    """Point JAX at the repo-shared persistent compilation cache (call
+    BEFORE importing jax).  The fused-step compile costs ~30s on a
+    healthy tunnel; sharing one cache across bench.py and the
+    scripts/perf_probe.py modes makes retries and cross-tool re-runs
+    immune to most of the compile window."""
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
+
 def _child(platform: str) -> None:
     sweep = [int(b) for b in
              os.environ.get("BENCH_SWEEP", "128,256").split(",")]
@@ -53,14 +66,7 @@ def _child(platform: str) -> None:
         steps = int(os.environ.get("BENCH_CPU_STEPS", "2"))
         warmup = 1
 
-    # persistent compilation cache: the fused-step compile costs ~30s on
-    # a healthy tunnel; caching it makes retries and re-runs immune to
-    # most of the compile window
-    os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     ".jax_cache"))
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+    ensure_compile_cache()
 
     import jax
     import jax.numpy as jnp

@@ -34,6 +34,7 @@ echo "chip alive; running queue"
 
 run ablate    900  python scripts/perf_probe.py ablate
 run raw128    900  env PROBE_BS=128 python scripts/perf_probe.py raw
+run raw128n   900  env PROBE_BS=128 PROBE_LAYOUT=NCHW python scripts/perf_probe.py raw
 run raw256r   900  env PROBE_BS=256 PROBE_REMAT=1 python scripts/perf_probe.py raw
 run bench     1100 env BENCH_DEADLINE=1000 BENCH_SWEEP=128,256,512 python bench.py
 run benchrem  900  env BENCH_DEADLINE=800 BENCH_SWEEP=256,512 BENCH_REMAT=dots python bench.py

@@ -13,6 +13,11 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import ensure_compile_cache  # noqa: E402 — must precede jax
+
+ensure_compile_cache()
+
 import jax
 import jax.numpy as jnp
 import numpy as onp
@@ -226,8 +231,9 @@ def probe_conv1():
 def probe_ablate():
     """Decompose the fused-step time into three measurements — full
     train step, train step with eval-mode BN (no batch-stat
-    reductions), forward only — to locate the 15%-MFU gap between the
-    conv tower (~24% of peak) and the full train step."""
+    reductions), forward only — to attribute the 15%-MFU full-step gap
+    (the chained conv kernels themselves reach 84-91% of peak; see
+    docs/performance.md round-4 findings)."""
     bs = int(os.environ.get("PROBE_BS", "128"))
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     import incubator_mxnet_tpu as mx
@@ -257,19 +263,34 @@ def probe_ablate():
     flops_train = 3 * 4.089e9 * bs
     flops_fwd = 4.089e9 * bs
 
+    failures = []
+
     def timed(name, fn, carry, flops, steps=10):
-        dt = timeit(fn, carry, steps=steps, warmup=3)
+        # one measurement failing (transient UNAVAILABLE on the tunnel)
+        # must not lose the others — each is independently valuable.
+        # Failures are still FAILURES: the process exits non-zero so
+        # chip_queue marks the artifact QUEUE_FAILED and retries.
+        try:
+            dt = timeit(fn, carry, steps=steps, warmup=3)
+        except Exception as e:  # noqa: BLE001
+            print(f"{name:24s} FAILED: {type(e).__name__}: "
+                  f"{str(e)[:120]}", flush=True)
+            failures.append(name)
+            return None
         print(f"{name:24s} {dt * 1e3:8.2f} ms  "
               f"{100 * flops / dt / PEAK:5.1f}% MFU-equiv", flush=True)
         return dt
 
-    # (a) full train step (params chained through carry)
+    # (a) full train step (params chained through carry).  The step fn
+    #     DONATES params/aux/opt_state (fuse.py donate_argnums), so it
+    #     gets its own copies — the originals must survive for (b)/(c).
     def full(p, a, o, x, y):
         key = jax.random.PRNGKey(0)
         p2, a2, o2, loss = step._step_fn(p, a, o, x, y, key)
         return p2, a2, o2, x, y
-    timed("full train step", full, (params, aux, opt_state, x, y),
-          flops_train)
+    copy = lambda tree: jax.tree_util.tree_map(jnp.array, tree)  # noqa
+    timed("full train step", full,
+          (copy(params), copy(aux), copy(opt_state), x, y), flops_train)
 
     # (b) fwd+bwd+sgd WITHOUT BatchNorm batch stats (use_global_stats
     #     analog: training=False apply → moving stats, no reductions)
@@ -302,6 +323,9 @@ def probe_ablate():
         x2, _ = fwd_loop(p, x)
         return x2, p
     timed("fwd only (eval BN)", fwd_carry, (x, pa), flops_fwd)
+    if failures:
+        sys.exit(f"ablate: {len(failures)} measurement(s) failed: "
+                 f"{failures}")
 
 
 
@@ -390,22 +414,30 @@ def probe_stem():
 
 
 def probe_raw():
-    """Attainable-ceiling reference: a hand-written NHWC bf16 ResNet-50
-    train step in raw jnp/lax — no framework, BN stats one-pass in f32,
-    SGD-momentum epilogue.  If this also lands at ~15% MFU the gap is
-    the platform/XLA; if it is much faster, the gap is in our graph."""
+    """Attainable-ceiling reference: a hand-written bf16 ResNet-50
+    train step in raw jnp/lax (PROBE_LAYOUT=NHWC|NCHW) — no framework,
+    BN stats one-pass in f32, SGD-momentum epilogue.  If this also
+    lands at ~15% MFU the gap is the platform/XLA; if it is much
+    faster, the gap is in our graph."""
     from jax import lax
     bs = int(os.environ.get("PROBE_BS", "128"))
     remat = os.environ.get("PROBE_REMAT", "0") == "1"
     bn_batch_stats = os.environ.get("PROBE_BN", "batch") == "batch"
+    layout = os.environ.get("PROBE_LAYOUT", "NHWC").upper()
+    if layout not in ("NHWC", "NCHW"):
+        sys.exit(f"PROBE_LAYOUT must be NHWC or NCHW, got {layout!r}")
+    nhwc = layout == "NHWC"
+    CH = -1 if nhwc else 1                     # channel axis
+    RED = (0, 1, 2) if nhwc else (0, 2, 3)     # BN reduce axes
 
     key = jax.random.PRNGKey(0)
     stages = [(256, 64, 3), (512, 128, 4), (1024, 256, 6), (2048, 512, 3)]
 
     def conv(x, w, s=1):
-        k = w.shape[0]
-        dn = lax.conv_dimension_numbers(x.shape, w.shape,
-                                        ("NHWC", "HWIO", "NHWC"))
+        k = w.shape[0 if nhwc else 2]
+        dn = lax.conv_dimension_numbers(
+            x.shape, w.shape,
+            ("NHWC", "HWIO", "NHWC") if nhwc else ("NCHW", "OIHW", "NCHW"))
         return lax.conv_general_dilated(x, w, (s, s),
                                         [(k // 2, k // 2)] * 2,
                                         dimension_numbers=dn)
@@ -413,40 +445,45 @@ def probe_raw():
     def bn(x, p, training):
         g, b = p
         if training and bn_batch_stats:
-            mean = jnp.mean(x, (0, 1, 2), dtype=jnp.float32)
-            meansq = jnp.mean(jnp.square(x), (0, 1, 2), dtype=jnp.float32)
+            mean = jnp.mean(x, RED, dtype=jnp.float32)
+            meansq = jnp.mean(jnp.square(x), RED, dtype=jnp.float32)
             var = jnp.maximum(meansq - jnp.square(mean), 0.0)
         else:
-            mean = jnp.zeros(x.shape[-1], jnp.float32)
-            var = jnp.ones(x.shape[-1], jnp.float32)
+            mean = jnp.zeros(x.shape[CH], jnp.float32)
+            var = jnp.ones(x.shape[CH], jnp.float32)
         scale = (g * lax.rsqrt(var + 1e-5)).astype(x.dtype)
         bias = (b - mean * g * lax.rsqrt(var + 1e-5)).astype(x.dtype)
-        return x * scale + bias
+        bcast = [1] * x.ndim
+        bcast[CH] = x.shape[CH]
+        return x * scale.reshape(bcast) + bias.reshape(bcast)
 
     def init():
         params = {}
         k = [key]
 
-        def mk(name, shape, scale=0.05):
+        def mk(name, k_, ci, co, scale=0.05):
             k[0], sub = jax.random.split(k[0])
+            shape = (k_, k_, ci, co) if nhwc else (co, ci, k_, k_)
             params[name] = jax.random.normal(sub, shape, jnp.bfloat16) * scale
 
         def mkbn(name, c):
             params[name] = (jnp.ones(c, jnp.float32),
                             jnp.zeros(c, jnp.float32))
-        mk("stem", (7, 7, 3, 64)); mkbn("stem_bn", 64)
+        mk("stem", 7, 3, 64); mkbn("stem_bn", 64)
         cin = 64
         for si, (co, cm, n) in enumerate(stages):
             for bi in range(n):
                 p = f"s{si}b{bi}"
-                mk(p + "c1", (1, 1, cin, cm))
-                mk(p + "c2", (3, 3, cm, cm))
-                mk(p + "c3", (1, 1, cm, co))
+                mk(p + "c1", 1, cin, cm)
+                mk(p + "c2", 3, cm, cm)
+                mk(p + "c3", 1, cm, co)
                 mkbn(p + "bn1", cm); mkbn(p + "bn2", cm); mkbn(p + "bn3", co)
                 if bi == 0:
-                    mk(p + "sc", (1, 1, cin, co)); mkbn(p + "scbn", co)
+                    mk(p + "sc", 1, cin, co); mkbn(p + "scbn", co)
                 cin = co
-        mk("fc", (2048, 1000), 0.01)
+        k[0], sub = jax.random.split(k[0])
+        params["fc"] = jax.random.normal(sub, (2048, 1000),
+                                         jnp.bfloat16) * 0.01
         return params
 
     def block(x, params, p, stride, proj, training):
@@ -463,8 +500,9 @@ def probe_raw():
     def forward(params, x, training=True):
         y = conv(x, params["stem"], 2)
         y = jnp.maximum(bn(y, params["stem_bn"], training), 0)
-        y = lax.reduce_window(y, -jnp.inf, lax.max, (1, 3, 3, 1),
-                              (1, 2, 2, 1), "SAME")
+        pool_w = (1, 3, 3, 1) if nhwc else (1, 1, 3, 3)
+        pool_s = (1, 2, 2, 1) if nhwc else (1, 1, 2, 2)
+        y = lax.reduce_window(y, -jnp.inf, lax.max, pool_w, pool_s, "SAME")
         for si, (co, cm, n) in enumerate(stages):
             for bi in range(n):
                 fn = (lambda yy, _si=si, _bi=bi, _n=n: block(
@@ -473,7 +511,7 @@ def probe_raw():
                 if remat:
                     fn = jax.checkpoint(fn)
                 y = fn(y)
-        y = jnp.mean(y, (1, 2))
+        y = jnp.mean(y, (1, 2) if nhwc else (2, 3))
         return y.astype(jnp.bfloat16) @ params["fc"]
 
     def loss_fn(params, x, lbl):
@@ -483,7 +521,8 @@ def probe_raw():
 
     params = init()
     mom = jax.tree_util.tree_map(jnp.zeros_like, params)
-    x = jax.random.normal(key, (bs, 224, 224, 3), jnp.bfloat16)
+    xshape = (bs, 224, 224, 3) if nhwc else (bs, 3, 224, 224)
+    x = jax.random.normal(key, xshape, jnp.bfloat16)
     lbl = jax.random.randint(key, (bs,), 0, 1000)
 
     @jax.jit
@@ -498,7 +537,7 @@ def probe_raw():
     flops = 3 * 4.089e9 * bs
     dt = timeit(lambda p, m, a, b: step(p, m, a, b), (params, mom, x, lbl),
                 steps=10, warmup=3)
-    tag = (f"raw NHWC train bs={bs} remat={int(remat)} "
+    tag = (f"raw {layout} train bs={bs} remat={int(remat)} "
            f"bn={'batch' if bn_batch_stats else 'eval'}")
     print(f"{tag}: {dt * 1e3:7.2f} ms  {bs / dt:7.1f} img/s  "
           f"{100 * flops / dt / PEAK:5.1f}% MFU", flush=True)
