@@ -41,9 +41,10 @@ def _tls():
 
 class _TapeNode:
     __slots__ = ("op", "vjp_fn", "nd_inputs", "input_slots", "outputs",
-                 "saved_out_data")
+                 "saved_out_data", "fn", "all_inputs")
 
-    def __init__(self, op, vjp_fn, nd_inputs, input_slots, outputs):
+    def __init__(self, op, vjp_fn, nd_inputs, input_slots, outputs,
+                 fn=None, all_inputs=None):
         self.op = op
         self.vjp_fn = vjp_fn
         self.nd_inputs = nd_inputs
@@ -53,11 +54,21 @@ class _TapeNode:
         # a positional zip would hand an NDArray the wrong gradient
         self.input_slots = input_slots
         self.outputs = outputs
+        # primal closure + the op's full argument list: kept so that a
+        # create_graph backward can RE-LINEARIZE this node through the
+        # recording path (the stored vjp_fn runs outside the tape, so
+        # its cotangents are not differentiable).  None for nodes that
+        # cannot re-linearize (custom Function, CachedOp) — those end
+        # the higher-order chain.
+        self.fn = fn
+        self.all_inputs = all_inputs
 
 
-def _record(op, vjp_fn, all_inputs, nd_inputs, input_slots, outputs):
+def _record(op, vjp_fn, all_inputs, nd_inputs, input_slots, outputs,
+            fn=None):
     outs = outputs if isinstance(outputs, (list, tuple)) else (outputs,)
-    node = _TapeNode(op, vjp_fn, nd_inputs, input_slots, outs)
+    node = _TapeNode(op, vjp_fn, nd_inputs, input_slots, outs,
+                     fn=fn, all_inputs=list(all_inputs))
     for o in outs:
         o._tape_node = node
     _tls().tape.append(node)
@@ -139,7 +150,20 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
     closure turns output cotangents into input cotangents.  Gradients
     land in ``x.grad`` for every array that had ``attach_grad`` called
     (grad_req 'write' overwrites, 'add' accumulates across backward calls).
+
+    With ``create_graph=True`` the backward computations are themselves
+    recorded (each node is re-linearized through the op layer), so the
+    produced gradients can be differentiated again — the reference's
+    higher-order-gradient contract (test_higher_order_grad.py).
     """
+    _backward_impl(heads, head_grads, retain_graph, train_mode,
+                   create_graph)
+
+
+def _backward_impl(heads, head_grads, retain_graph, train_mode,
+                   create_graph, want=None):
+    """Shared core of backward()/grad().  Returns the cotangent for each
+    array in ``want`` (graph-carrying NDArrays under create_graph)."""
     from .ndarray import NDArray
 
     if isinstance(heads, NDArray):
@@ -152,13 +176,21 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
     tape = _tls().tape
     if not tape:
         # heads may be leaves with no recorded ops: grad = head_grad
+        head_map = {}
         for h, hg in zip(heads, head_grads):
+            g = hg.data if hg is not None else jnp.ones_like(h.data)
+            head_map[id(h)] = g
             if h._grad_req != "null" and h._grad is not None:
-                g = hg.data if hg is not None else jnp.ones_like(h.data)
                 _accumulate_leaf(h, g)
-        return
+        if want is not None:
+            return [NDArray(head_map.get(id(v),
+                                         jnp.zeros(v.shape, v.dtype)))
+                    for v in want]
+        return None
 
-    # cotangent accumulator keyed by NDArray identity
+    # cotangent accumulator keyed by NDArray identity.  Plain path:
+    # raw jax arrays.  create_graph path: NDArrays, summed through the
+    # recorded op layer so the accumulation is differentiable too.
     cot: dict[int, object] = {}
     alive: dict[int, NDArray] = {}
 
@@ -172,45 +204,125 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
             cot[key] = g
             alive[key] = arr
 
+    def as_cot(raw):
+        return NDArray(raw) if create_graph else raw
+
     for h, hg in zip(heads, head_grads):
         g = hg.data if hg is not None else jnp.ones_like(h.data)
-        add_cot(h, g)
+        add_cot(h, as_cot(g))
 
     needed = _mark_needed(tape, heads)
 
-    for node in reversed(tape):
-        if node not in needed:
-            continue
-        out_cots = []
-        any_cot = False
-        for o in node.outputs:
-            g = cot.get(id(o))
-            if g is None:
-                g = jnp.zeros(o.shape, o.dtype)
+    with _scope(True if create_graph else None, train_mode):
+        for node in reversed(tape):
+            if node not in needed:
+                continue
+            out_cots = []
+            any_cot = False
+            for o in node.outputs:
+                g = cot.get(id(o))
+                if g is None:
+                    g = as_cot(jnp.zeros(o.shape, o.dtype))
+                else:
+                    any_cot = True
+                out_cots.append(g)
+            if not any_cot:
+                continue
+            relinearizable = (
+                node.fn is not None
+                and not any(isinstance(s, tuple)
+                            for s in node.input_slots)
+                and all(isinstance(x, (NDArray, jax.Array))
+                        for x in node.all_inputs))
+            if create_graph and relinearizable:
+                in_cots = _relinearize(node, out_cots)
             else:
-                any_cot = True
-            out_cots.append(g)
-        if not any_cot:
-            continue
-        seed = out_cots[0] if len(node.outputs) == 1 else tuple(out_cots)
-        in_cots = node.vjp_fn(seed)
-        for slot, x in zip(node.input_slots, node.nd_inputs):
-            # compound (slot, index) addresses an NDArray inside a
-            # sequence argument (np.concatenate([a, b]) — the vjp's
-            # cotangent at that slot is itself a sequence)
-            g = in_cots[slot[0]][slot[1]] if isinstance(slot, tuple) \
-                else in_cots[slot]
-            if isinstance(g, jax.Array) and g.dtype != jax.dtypes.float0:
-                add_cot(x, g)
+                if create_graph:
+                    import warnings
+                    name = getattr(node.op, "name", None) or "custom node"
+                    warnings.warn(
+                        f"create_graph: {name} cannot be re-linearized "
+                        "(custom Function / CachedOp / sequence-arg op); "
+                        "the gradient graph is truncated at this node and "
+                        "higher-order derivatives through it are wrong",
+                        stacklevel=2)
+                seed = (out_cots[0].data if create_graph else out_cots[0]) \
+                    if len(node.outputs) == 1 else tuple(
+                        c.data if create_graph else c for c in out_cots)
+                raw_cots = node.vjp_fn(seed)
+                in_cots = [as_cot(g) if isinstance(g, jax.Array)
+                           and g.dtype != jax.dtypes.float0 else g
+                           for g in raw_cots]
+            for slot, x in zip(node.input_slots, node.nd_inputs):
+                # compound (slot, index) addresses an NDArray inside a
+                # sequence argument (np.concatenate([a, b]) — the vjp's
+                # cotangent at that slot is itself a sequence)
+                g = in_cots[slot[0]][slot[1]] if isinstance(slot, tuple) \
+                    else in_cots[slot]
+                if isinstance(g, NDArray) or (isinstance(g, jax.Array)
+                                              and g.dtype
+                                              != jax.dtypes.float0):
+                    add_cot(x, g)
 
     for key, arr in alive.items():
         if arr._grad_req not in (None, "null") and arr._grad is not None:
-            _accumulate_leaf(arr, cot[key])
+            g = cot[key]
+            _accumulate_leaf(arr, g.data if isinstance(g, NDArray) else g)
+
+    result = None
+    if want is not None:
+        result = []
+        for v in want:
+            g = cot.get(id(v))
+            if g is None:
+                g = NDArray(jnp.zeros(v.shape, v.dtype))
+            elif not isinstance(g, NDArray):
+                g = NDArray(g)
+            result.append(g)
 
     if not retain_graph:
         _tls().tape = []
         for key, arr in alive.items():
             arr._tape_node = None
+    return result
+
+
+def _relinearize(node, out_cots):
+    """Apply a tape node's vjp THROUGH the op layer so the cotangents
+    get tape nodes of their own (create_graph).  The primal closure is
+    re-linearized at the original inputs; differentiating the result
+    reaches both the original inputs and the incoming cotangents."""
+    from .ops import registry
+
+    n_primal = len(node.all_inputs)
+    multi = len(node.outputs) > 1
+    primal_fn = node.fn
+    # only float-kind inputs have differentiable cotangents; integer
+    # inputs (gather indices etc.) get float0 from jax.vjp, which must
+    # not become a recorded output (jnp can't even build a float0 zeros
+    # seed for the next-order walk)
+    keep = [jnp.issubdtype(getattr(x, "dtype", jnp.float32), jnp.floating)
+            for x in node.all_inputs]
+    if not any(keep):
+        return [None] * n_primal
+
+    def bwd_fn(*arrs):
+        primals, seeds = arrs[:n_primal], arrs[n_primal:]
+        _, vjp = jax.vjp(primal_fn, *primals)
+        res = [r for r, k in zip(vjp(tuple(seeds) if multi else seeds[0]),
+                                 keep) if k]
+        # singleton unwrap: this node's own recorded vjp must see the
+        # same output structure backward() will seed it with (a leaf
+        # when there is one output)
+        return res[0] if len(res) == 1 else tuple(res)
+
+    name = getattr(node.op, "name", None) or "fn"
+    bwd_op = registry.Op(f"_backward_{name}", bwd_fn, differentiable=True)
+    out = registry.invoke(bwd_op, *(list(node.all_inputs) + list(out_cots)))
+    outs = out if isinstance(out, (list, tuple)) else (out,)
+    # re-expand to one slot per primal arg (None where non-float)
+    it = iter(outs)
+    return [next(it) if k else None for k in keep]
 
 
 def _mark_needed(tape, heads):
@@ -235,7 +347,11 @@ def _accumulate_leaf(arr, g):
 
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
-    """Return gradients of heads w.r.t. variables (reference autograd.py:271)."""
+    """Return gradients of heads w.r.t. variables (reference autograd.py:271).
+
+    With ``create_graph=True`` the returned arrays carry tape nodes, so
+    they can be fed back into backward()/grad() for higher-order
+    derivatives."""
     from .ndarray import NDArray
 
     if isinstance(variables, NDArray):
@@ -248,10 +364,13 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
         v._grad = _zeros_like_nd(v)
         v._grad_req = "write"
     try:
-        backward(heads, head_grads,
-                 retain_graph=bool(retain_graph or create_graph),
-                 train_mode=train_mode, create_graph=create_graph)
-        grads = [v.grad.copy() for v in variables]
+        grads = _backward_impl(
+            heads, head_grads,
+            retain_graph=bool(retain_graph or create_graph),
+            train_mode=train_mode, create_graph=create_graph,
+            want=variables)
+        if not create_graph:
+            grads = [g.copy() for g in grads]
     finally:
         for v, (g, req) in zip(variables, saved):
             v._grad, v._grad_req = g, req

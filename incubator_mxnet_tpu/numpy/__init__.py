@@ -98,7 +98,7 @@ def _wrap_fn(jnp_fn):
                 return vjp(seed)
 
             _autograd._record(None, tape_vjp, args, nd_inputs,
-                              nd_slots, out_tuple)
+                              nd_slots, out_tuple, fn=call)
         return outs
 
     return fn

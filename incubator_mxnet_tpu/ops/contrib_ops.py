@@ -579,3 +579,77 @@ def hawkesll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
 
     return jax.vmap(one_sample)(mu, state, lags, marks,
                                 valid_length, max_time)
+
+
+# ---------------------------------------------------------------------------
+# Khatri-Rao product (reference src/operator/contrib/krprod.cc,
+# tests/python/unittest/test_contrib_krprod.py)
+# ---------------------------------------------------------------------------
+
+@register("khatri_rao", num_inputs=-1)
+def khatri_rao(*matrices):
+    """Column-wise Kronecker product: inputs (r_i, k) -> (prod r_i, k).
+
+    Reference semantics (krprod.cc khatri_rao): kr(A, B)[:, j] =
+    kron(A[:, j], B[:, j]); variadic left-fold over the inputs.
+    """
+    if not matrices:
+        raise ValueError("khatri_rao needs at least one input")
+    out = matrices[0]
+    for m in matrices[1:]:
+        k = out.shape[1]
+        if m.shape[1] != k:
+            raise ValueError(
+                f"khatri_rao: column counts differ ({k} vs {m.shape[1]})")
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, k)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Straight-through estimators (reference src/operator/contrib/stes_op.cc,
+# tests/python/unittest/test_contrib_stes_op.py): quantization-aware
+# training primitives whose backward is the identity.
+# ---------------------------------------------------------------------------
+
+@jax.custom_vjp
+def _round_ste(x):
+    return jnp.round(x)
+
+
+def _round_ste_fwd(x):
+    return jnp.round(x), None
+
+
+def _round_ste_bwd(_, g):
+    return (g,)
+
+
+_round_ste.defvjp(_round_ste_fwd, _round_ste_bwd)
+
+
+@jax.custom_vjp
+def _sign_ste(x):
+    return jnp.sign(x)
+
+
+def _sign_ste_fwd(x):
+    return jnp.sign(x), None
+
+
+def _sign_ste_bwd(_, g):
+    return (g,)
+
+
+_sign_ste.defvjp(_sign_ste_fwd, _sign_ste_bwd)
+
+
+@register("_contrib_round_ste", aliases=("round_ste",))
+def round_ste(data):
+    """round with identity gradient (reference stes_op.cc ROUND_STE)."""
+    return _round_ste(data)
+
+
+@register("_contrib_sign_ste", aliases=("sign_ste",))
+def sign_ste(data):
+    """sign with identity gradient (reference stes_op.cc SIGN_STE)."""
+    return _sign_ste(data)

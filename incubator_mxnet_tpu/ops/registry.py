@@ -203,7 +203,8 @@ def invoke(op: "Op | str", *inputs, out=None, **kwargs):
         nd_inputs = [x for x in all_in if isinstance(x, NDArray)]
         input_slots = [i for i, x in enumerate(all_in)
                        if isinstance(x, NDArray)]
-        autograd._record(op, vjp_fn, all_in, nd_inputs, input_slots, outputs)
+        autograd._record(op, vjp_fn, all_in, nd_inputs, input_slots,
+                         outputs, fn=fn)
     return outputs
 
 

@@ -107,7 +107,6 @@ def probe_layout():
 
 def probe_fused():
     bs = int(os.environ.get("PROBE_BS", "128"))
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, gluon, amp
     from incubator_mxnet_tpu.fuse import make_fused_train_step
@@ -235,7 +234,6 @@ def probe_ablate():
     (the chained conv kernels themselves reach 84-91% of peak; see
     docs/performance.md round-4 findings)."""
     bs = int(os.environ.get("PROBE_BS", "128"))
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, gluon, amp
     from incubator_mxnet_tpu.fuse import make_fused_train_step
