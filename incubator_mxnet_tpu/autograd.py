@@ -155,9 +155,11 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
     recorded (each node is re-linearized through the op layer), so the
     produced gradients can be differentiated again — the reference's
     higher-order-gradient contract (test_higher_order_grad.py).
+    ``x.grad`` is then rebound to the graph-carrying cotangent and the
+    tape is retained, so ``autograd.grad([x.grad], [x])`` works.
     """
-    _backward_impl(heads, head_grads, retain_graph, train_mode,
-                   create_graph)
+    _backward_impl(heads, head_grads, retain_graph or create_graph,
+                   train_mode, create_graph)
 
 
 def _backward_impl(heads, head_grads, retain_graph, train_mode,
@@ -231,9 +233,7 @@ def _backward_impl(heads, head_grads, retain_graph, train_mode,
             relinearizable = (
                 node.fn is not None
                 and not any(isinstance(s, tuple)
-                            for s in node.input_slots)
-                and all(isinstance(x, (NDArray, jax.Array))
-                        for x in node.all_inputs))
+                            for s in node.input_slots))
             if create_graph and relinearizable:
                 in_cots = _relinearize(node, out_cots)
             else:
@@ -246,19 +246,23 @@ def _backward_impl(heads, head_grads, retain_graph, train_mode,
                         "the gradient graph is truncated at this node and "
                         "higher-order derivatives through it are wrong",
                         stacklevel=2)
-                seed = (out_cots[0].data if create_graph else out_cots[0]) \
-                    if len(node.outputs) == 1 else tuple(
-                        c.data if create_graph else c for c in out_cots)
+
+                def raw_of(c):
+                    return c.data if isinstance(c, NDArray) else c
+                seed = raw_of(out_cots[0]) if len(node.outputs) == 1 \
+                    else tuple(raw_of(c) for c in out_cots)
                 raw_cots = node.vjp_fn(seed)
-                in_cots = [as_cot(g) if isinstance(g, jax.Array)
-                           and g.dtype != jax.dtypes.float0 else g
-                           for g in raw_cots]
+                in_cots = list(raw_cots)
             for slot, x in zip(node.input_slots, node.nd_inputs):
                 # compound (slot, index) addresses an NDArray inside a
                 # sequence argument (np.concatenate([a, b]) — the vjp's
                 # cotangent at that slot is itself a sequence)
                 g = in_cots[slot[0]][slot[1]] if isinstance(slot, tuple) \
                     else in_cots[slot]
+                if isinstance(g, jax.Array) \
+                        and g.dtype != jax.dtypes.float0:
+                    g = as_cot(g)  # uniform: cot dict holds NDArrays
+                    # under create_graph, raw arrays otherwise
                 if isinstance(g, NDArray) or (isinstance(g, jax.Array)
                                               and g.dtype
                                               != jax.dtypes.float0):
@@ -267,7 +271,16 @@ def _backward_impl(heads, head_grads, retain_graph, train_mode,
     for key, arr in alive.items():
         if arr._grad_req not in (None, "null") and arr._grad is not None:
             g = cot[key]
-            _accumulate_leaf(arr, g.data if isinstance(g, NDArray) else g)
+            if create_graph and isinstance(g, NDArray):
+                # rebind to the graph-carrying cotangent so x.grad can
+                # be differentiated again; 'add' chains the old buffer
+                # in as a leaf of a recorded addition
+                with _scope(True, train_mode):
+                    arr._grad = (arr._grad + g) if arr._grad_req == "add" \
+                        else g
+            else:
+                _accumulate_leaf(arr,
+                                 g.data if isinstance(g, NDArray) else g)
 
     result = None
     if want is not None:
@@ -294,21 +307,40 @@ def _relinearize(node, out_cots):
     reaches both the original inputs and the incoming cotangents."""
     from .ops import registry
 
+    from .ndarray import NDArray
+
     n_primal = len(node.all_inputs)
     multi = len(node.outputs) > 1
     primal_fn = node.fn
-    # only float-kind inputs have differentiable cotangents; integer
-    # inputs (gather indices etc.) get float0 from jax.vjp, which must
-    # not become a recorded output (jnp can't even build a float0 zeros
-    # seed for the next-order walk)
-    keep = [jnp.issubdtype(getattr(x, "dtype", jnp.float32), jnp.floating)
-            for x in node.all_inputs]
+    # partition the primal args: arrays re-enter the recorded call;
+    # static non-array args (python scalars — mxnp.power(x, 3)) are
+    # closed over.  Among the arrays only float-kind ones have
+    # differentiable cotangents; integer inputs (gather indices) get
+    # float0 from jax.vjp, which must not become a recorded output
+    # (jnp can't even build a float0 zeros seed for the next-order walk)
+    is_arr = [isinstance(x, (NDArray, jax.Array, onp.ndarray))
+              for x in node.all_inputs]
+    arr_pos = [i for i, a in enumerate(is_arr) if a]
+    statics = {i: x for i, (a, x)
+               in enumerate(zip(is_arr, node.all_inputs)) if not a}
+    keep = [jnp.issubdtype(node.all_inputs[i].dtype, jnp.floating)
+            for i in arr_pos]
     if not any(keep):
         return [None] * n_primal
+    n_arr = len(arr_pos)
 
     def bwd_fn(*arrs):
-        primals, seeds = arrs[:n_primal], arrs[n_primal:]
-        _, vjp = jax.vjp(primal_fn, *primals)
+        arrays, seeds = arrs[:n_arr], arrs[n_arr:]
+
+        def g(*array_args):
+            merged = [None] * n_primal
+            for i, v in statics.items():
+                merged[i] = v
+            for i, v in zip(arr_pos, array_args):
+                merged[i] = v
+            return primal_fn(*merged)
+
+        _, vjp = jax.vjp(g, *arrays)
         res = [r for r, k in zip(vjp(tuple(seeds) if multi else seeds[0]),
                                  keep) if k]
         # singleton unwrap: this node's own recorded vjp must see the
@@ -318,11 +350,16 @@ def _relinearize(node, out_cots):
 
     name = getattr(node.op, "name", None) or "fn"
     bwd_op = registry.Op(f"_backward_{name}", bwd_fn, differentiable=True)
-    out = registry.invoke(bwd_op, *(list(node.all_inputs) + list(out_cots)))
+    arr_args = [node.all_inputs[i] for i in arr_pos]
+    out = registry.invoke(bwd_op, *(arr_args + list(out_cots)))
     outs = out if isinstance(out, (list, tuple)) else (out,)
-    # re-expand to one slot per primal arg (None where non-float)
+    # re-expand to one slot per primal arg (None where static/non-float)
+    result = [None] * n_primal
     it = iter(outs)
-    return [next(it) if k else None for k in keep]
+    for i, k in zip(arr_pos, keep):
+        if k:
+            result[i] = next(it)
+    return result
 
 
 def _mark_needed(tape, heads):

@@ -244,6 +244,32 @@ class NDArray:
         out = self.asnumpy()
         return out.astype(dtype) if dtype is not None else out
 
+    def __array_function__(self, func, types, args, kwargs):
+        """NumPy dispatch protocol (reference mx.np
+        numpy_dispatch_protocol.py / test_numpy_interoperability.py):
+        ``onp.mean(nd_array)`` etc. route to the framework's numpy
+        namespace — staying on device and returning NDArray — with a
+        host-numpy fallback for functions the namespace lacks."""
+        from .. import numpy as mxnp
+        f = getattr(mxnp, func.__name__, None)
+        if callable(f):
+            try:
+                return f(*args, **kwargs)
+            except TypeError:
+                pass  # signature mismatch (out=, where=...): host path
+
+        def host(v):
+            # DEEP conversion — an NDArray left inside a nested sequence
+            # or kwarg re-dispatches right back here (RecursionError)
+            if isinstance(v, NDArray):
+                return v.asnumpy()
+            if isinstance(v, (list, tuple)):
+                return type(v)(host(e) for e in v)
+            if isinstance(v, dict):
+                return {k: host(e) for k, e in v.items()}
+            return v
+        return func(*host(args), **host(kwargs))
+
     def asscalar(self):
         if self.size != 1:
             raise ValueError("the array is not scalar")

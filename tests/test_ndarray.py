@@ -188,3 +188,19 @@ def test_np_grad_with_leading_scalar():
     onp.testing.assert_allclose(
         x.grad.asnumpy(), -2.0 * (1.0 - onp.array([1., 2., 3.])),
         rtol=1e-6)
+
+
+def test_array_function_protocol():
+    """onp.mean/concatenate/stack on NDArray dispatch to the framework
+    numpy namespace and stay NDArray (reference
+    test_numpy_interoperability.py / numpy_dispatch_protocol.py)."""
+    import numpy as onp
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    m = onp.mean(a)
+    assert isinstance(m, nd.NDArray) and float(m.asnumpy()) == 2.0
+    c = onp.concatenate([a, b])
+    assert isinstance(c, nd.NDArray)
+    assert c.asnumpy().tolist() == [1, 2, 3, 4, 5, 6]
+    s = onp.stack([a, b])
+    assert isinstance(s, nd.NDArray) and s.shape == (2, 3)

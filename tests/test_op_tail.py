@@ -681,3 +681,40 @@ def test_dlpack_torch_interop():
     assert_almost_equal(a, t.numpy())
     back = nd.from_dlpack(torch.arange(6, dtype=torch.float32))
     assert back.asnumpy().tolist() == [0, 1, 2, 3, 4, 5]
+
+
+def test_higher_order_static_scalar_and_backward_create():
+    """Review-fix regressions: (a) mx.np ops with python-scalar args
+    relinearize (statics close over), (b) backward(create_graph=True)
+    rebinds x.grad to a graph-carrying cotangent."""
+    from incubator_mxnet_tpu import autograd
+    import incubator_mxnet_tpu.numpy as mxnp
+    v = onp.array([1.5, -2.0], "f")
+    x = nd.array(v)
+    x.attach_grad()
+    with autograd.record():
+        y = mxnp.power(x, 3)
+        (g1,) = autograd.grad([y], [x], head_grads=[nd.ones((2,))],
+                              create_graph=True)
+    g1.backward(nd.ones((2,)))
+    assert_almost_equal(x.grad, 6 * v, rtol=1e-5)
+
+    x = nd.array(v)
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        autograd.backward([y], create_graph=True)
+        (g2,) = autograd.grad([x.grad], [x], head_grads=[nd.ones((2,))])
+    assert_almost_equal(g2, 6 * v, rtol=1e-5)
+
+
+def test_array_function_nested_and_kwarg_fallback():
+    """Host fallback deep-converts NDArrays in nested sequences and
+    kwargs (was RecursionError)."""
+    import numpy as onp2
+    a, b = nd.array([1.0, 2.0]), nd.array([3.0, 4.0])
+    out = onp2.block([[a, b]])
+    got = out.asnumpy() if hasattr(out, "asnumpy") else out
+    assert onp2.asarray(got).tolist() == [[1, 2, 3, 4]]
+    w = onp2.average(a, weights=b)
+    assert float(onp2.asarray(w)) == pytest.approx(1.5714285)
