@@ -1,3 +1,6 @@
 """Gluon contrib (reference python/mxnet/gluon/contrib/)."""
+from . import cnn
+from . import data
 from . import estimator
 from . import nn
+from . import rnn

@@ -1,0 +1,109 @@
+"""Gluon contrib CNN layers (reference
+python/mxnet/gluon/contrib/cnn/conv_layers.py): deformable convolution
+blocks bundling the learned offset branch with the sampled conv.
+"""
+from __future__ import annotations
+
+from .... import initializer as init_mod
+from ....ops.registry import invoke
+from ...block import HybridBlock
+from ...nn import Conv2D
+from ...parameter import Parameter
+
+from ...nn.conv_layers import _tuple
+
+__all__ = ["DeformableConvolution", "ModulatedDeformableConvolution"]
+
+
+def _pair(v):
+    return _tuple(v, 2)
+
+
+class DeformableConvolution(HybridBlock):
+    """2-D deformable convolution v1 (Dai 2017; reference
+    conv_layers.py:29).  The offset field is produced by an internal
+    zero-initialized Conv2D — so training starts as a plain conv — and
+    consumed by the ``DeformableConvolution`` op."""
+
+    _op_name = "DeformableConvolution"
+    _mask = False
+
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, use_bias=True, in_channels=0,
+                 activation=None, weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        k = _pair(kernel_size)
+        self._channels = channels
+        self._groups = groups
+        self._activation = activation
+        self._use_bias = use_bias
+        self._kwargs = dict(kernel=k, stride=_pair(strides),
+                            pad=_pair(padding), dilate=_pair(dilation),
+                            num_filter=channels, num_group=groups,
+                            num_deformable_group=num_deformable_group,
+                            no_bias=not use_bias)
+        planes = k[0] * k[1] * num_deformable_group
+        planes *= 3 if self._mask else 2
+        self.offset = Conv2D(
+            planes, kernel_size=k, strides=strides, padding=padding,
+            dilation=dilation, use_bias=offset_use_bias,
+            in_channels=in_channels,
+            weight_initializer=offset_weight_initializer or
+            init_mod.Zero(),
+            bias_initializer=offset_bias_initializer)
+        wshape = (channels, (in_channels // groups) if in_channels else 0) \
+            + k
+        self.weight = Parameter("weight", shape=wshape,
+                                init=weight_initializer or init_mod.Xavier(),
+                                allow_deferred_init=True)
+        self.bias = Parameter("bias", shape=(channels,),
+                              init=bias_initializer or init_mod.Zero(),
+                              allow_deferred_init=True) if use_bias else None
+
+    def _ensure_init(self, x):
+        if self.weight._data is None:
+            self.weight.shape = (self._channels,
+                                 x.shape[1] // self._groups) \
+                + self._kwargs["kernel"]
+            self.weight._finish_deferred_init()
+        if self._use_bias and self.bias._data is None:
+            self.bias._finish_deferred_init()
+
+    def forward(self, x):
+        self._ensure_init(x)
+        off = self.offset(x)
+        k = self._kwargs["kernel"]
+        ndg = self._kwargs["num_deformable_group"]
+        args = [x]
+        if self._mask:
+            from ....ndarray import sigmoid, slice_axis
+            n_off = 2 * k[0] * k[1] * ndg
+            # reference conv_layers.py:383: mask = sigmoid(raw) * 2, so
+            # a zero-initialized offset branch starts at mask 1.0 — the
+            # layer begins as an exact plain convolution
+            args += [slice_axis(off, axis=1, begin=0, end=n_off),
+                     sigmoid(slice_axis(off, axis=1, begin=n_off,
+                                        end=None)) * 2]
+        else:
+            args += [off]
+        args += [self.weight.data()]
+        if self._use_bias:
+            args.append(self.bias.data())
+        out = invoke(self._op_name, *args, **self._kwargs)
+        if self._activation:
+            out = invoke("Activation", out, act_type=self._activation)
+        return out
+
+
+class ModulatedDeformableConvolution(DeformableConvolution):
+    """Deformable conv v2 (Zhu 2018; reference conv_layers.py
+    ModulatedDeformableConvolution): the offset branch also emits a
+    sigmoid-squashed per-tap modulation mask."""
+
+    _op_name = "ModulatedDeformableConvolution"
+    _mask = True

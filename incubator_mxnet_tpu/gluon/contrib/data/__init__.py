@@ -1,0 +1,86 @@
+"""Gluon contrib data utilities (reference
+python/mxnet/gluon/contrib/data/): IntervalSampler and the WikiText
+language-modelling datasets.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as onp
+
+from ...data.dataset import Dataset
+from ...data.sampler import Sampler
+
+__all__ = ["IntervalSampler", "WikiText2", "WikiText103"]
+
+
+class IntervalSampler(Sampler):
+    """Samples [i, i+interval, ...] for each phase i (reference
+    contrib/data/sampler.py IntervalSampler)."""
+
+    def __init__(self, length, interval, rollover=True):
+        if interval > length:
+            raise ValueError(
+                f"Interval {interval} must be <= length {length}")
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        for i in range(self._interval if self._rollover else 1):
+            yield from range(i, self._length, self._interval)
+
+    def __len__(self):
+        return self._length
+
+
+class _WikiText(Dataset):
+    """Token-id sequence dataset over a local WikiText dump (reference
+    contrib/data/text.py _WikiText).  This environment has no network
+    egress, so the archive must already exist under ``root`` (the
+    reference auto-downloads); vocabulary is built from the train split
+    on first use."""
+
+    _filename: str
+
+    def __init__(self, root, segment="train", seq_len=35):
+        path = os.path.join(os.path.expanduser(root),
+                            self._filename.format(segment))
+        if not os.path.exists(path):
+            raise OSError(
+                f"{path} not found. Download is unavailable (no network "
+                "egress); place the extracted WikiText .tokens files "
+                f"under {root!r}.")
+        with open(path, encoding="utf-8") as f:
+            tokens = f.read().replace("\n", " <eos> ").split()
+        vocab_src = path if segment == "train" else os.path.join(
+            os.path.expanduser(root), self._filename.format("train"))
+        if os.path.exists(vocab_src) and vocab_src != path:
+            with open(vocab_src, encoding="utf-8") as f:
+                vtokens = f.read().replace("\n", " <eos> ").split()
+        else:
+            vtokens = tokens
+        self.vocab = {"<unk>": 0}
+        for t in vtokens:
+            self.vocab.setdefault(t, len(self.vocab))
+        ids = onp.asarray([self.vocab.get(t, 0) for t in tokens],
+                          onp.int32)
+        n = (len(ids) - 1) // seq_len
+        self._data = ids[:n * seq_len].reshape(n, seq_len)
+        self._label = ids[1:n * seq_len + 1].reshape(n, seq_len)
+
+    def __getitem__(self, idx):
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._data)
+
+
+class WikiText2(_WikiText):
+    """WikiText-2 (reference contrib/data/text.py WikiText2)."""
+    _filename = "wiki.{}.tokens"
+
+
+class WikiText103(_WikiText):
+    """WikiText-103 (reference contrib/data/text.py WikiText103)."""
+    _filename = "wiki.{}.tokens"

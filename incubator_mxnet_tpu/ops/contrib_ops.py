@@ -653,3 +653,117 @@ def round_ste(data):
 def sign_ste(data):
     """sign with identity gradient (reference stes_op.cc SIGN_STE)."""
     return _sign_ste(data)
+
+
+# ---------------------------------------------------------------------------
+# Deformable convolution v1/v2 (reference
+# src/operator/contrib/deformable_convolution.cc, Dai 2017 /
+# modulated_deformable_convolution.cc, Zhu 2018).  TPU lowering: the
+# deformable im2col (deformable_im2col.h) becomes a batched bilinear
+# gather — 4 clamped takes with interpolation weights — followed by the
+# same grouped-patch x weight contraction a dense conv performs on the
+# MXU.  Zero-padding semantics outside the input match the reference.
+# ---------------------------------------------------------------------------
+
+def _deform_patches(x, offset, kernel, stride, dilate, pad, ndg,
+                    mask=None):
+    """x (C,H,W), offset (2*KK*ndg, Ho, Wo) -> patches (C, KK, Ho, Wo)."""
+    kh, kw = kernel
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    C, H, W = x.shape
+    kk = kh * kw
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    # base sampling grid: p0 + pk, one (KK, Ho, Wo) plane per axis
+    oy = jnp.arange(Ho) * sh - ph
+    ox = jnp.arange(Wo) * sw - pw
+    ky = (jnp.arange(kh) * dh).repeat(kw)
+    kx = jnp.tile(jnp.arange(kw) * dw, kh)
+    base_y = ky[:, None, None] + oy[None, :, None]    # (KK, Ho, 1)
+    base_x = kx[:, None, None] + ox[None, None, :]    # (KK, 1, Wo)
+
+    off = offset.reshape(ndg, kk, 2, Ho, Wo)
+    ys = base_y + off[:, :, 0]                        # (ndg, KK, Ho, Wo)
+    xs = base_x + off[:, :, 1]
+
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = (ys - y0).astype(x.dtype)
+    wx = (xs - x0).astype(x.dtype)
+
+    xg = x.reshape(ndg, C // ndg, H, W)
+
+    # gather returns (ndg, C/ndg, KK, Ho, Wo) via advanced indexing:
+    # xg[g][:, yc[g], xc[g]] -> (C/ndg, KK, Ho, Wo)
+    def sample(yi, xi):
+        valid = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        v = jax.vmap(lambda g, yg, xg_: g[:, yg, xg_])(xg, yc, xc)
+        return jnp.where(valid[:, None], v, 0).astype(x.dtype)
+
+    p = (sample(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
+         + sample(y0 + 1, x0) * (wy * (1 - wx))[:, None]
+         + sample(y0, x0 + 1) * ((1 - wy) * wx)[:, None]
+         + sample(y0 + 1, x0 + 1) * (wy * wx)[:, None])
+    if mask is not None:
+        p = p * mask.reshape(ndg, 1, kk, Ho, Wo).astype(x.dtype)
+    return p.reshape(C, kk, Ho, Wo)
+
+
+def _deform_conv_impl(data, offset, weight, bias, kernel, stride, dilate,
+                      pad, num_filter, num_group, num_deformable_group,
+                      no_bias, mask=None):
+    from .nn_ops import _pair
+    kernel = _pair(kernel, 2)
+    stride = _pair(stride or 1, 2)
+    dilate = _pair(dilate or 1, 2)
+    pad = _pair(pad or 0, 2)
+    ndg = num_deformable_group
+
+    def one(x, off, m):
+        return _deform_patches(x, off, kernel, stride, dilate, pad, ndg,
+                               mask=m)
+    patches = jax.vmap(one, in_axes=(0, 0, 0 if mask is not None
+                                     else None))(data, offset, mask)
+    # patches (N, C, KK, Ho, Wo); weight (O, C/g, kh, kw)
+    n, C, kk, Ho, Wo = patches.shape
+    g = num_group
+    w = weight.reshape(g, num_filter // g, C // g, kk)
+    pg = patches.reshape(n, g, C // g, kk, Ho, Wo)
+    out = jnp.einsum("gock,ngckhw->ngohw", w.astype(data.dtype), pg)
+    out = out.reshape(n, num_filter, Ho, Wo).astype(data.dtype)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+@register("_contrib_DeformableConvolution",
+          aliases=("deformable_convolution", "DeformableConvolution"))
+def deformable_convolution(data, offset, weight, bias=None, kernel=None,
+                           stride=None, dilate=None, pad=None,
+                           num_filter=1, num_group=1,
+                           num_deformable_group=1, no_bias=False,
+                           layout="NCHW"):
+    """Deformable conv v1 (reference deformable_convolution.cc)."""
+    return _deform_conv_impl(data, offset, weight, bias, kernel, stride,
+                             dilate, pad, num_filter, num_group,
+                             num_deformable_group, no_bias)
+
+
+@register("_contrib_ModulatedDeformableConvolution",
+          aliases=("modulated_deformable_convolution",
+                   "ModulatedDeformableConvolution"))
+def modulated_deformable_convolution(data, offset, mask, weight, bias=None,
+                                     kernel=None, stride=None, dilate=None,
+                                     pad=None, num_filter=1, num_group=1,
+                                     num_deformable_group=1, no_bias=False,
+                                     layout="NCHW"):
+    """Deformable conv v2 with per-tap modulation mask (reference
+    modulated_deformable_convolution.cc)."""
+    return _deform_conv_impl(data, offset, weight, bias, kernel, stride,
+                             dilate, pad, num_filter, num_group,
+                             num_deformable_group, no_bias, mask=mask)

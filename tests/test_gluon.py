@@ -510,3 +510,75 @@ def test_transforms_tail():
     # hybrid aliases
     assert T.HybridCompose is T.Compose
     assert T.HybridRandomApply is T.RandomApply
+
+
+def test_deformable_convolution_layers():
+    """contrib.cnn deformable conv v1/v2 (reference
+    gluon/contrib/cnn/conv_layers.py + deformable_convolution.cc): with
+    zero-initialized offsets BOTH start as the plain conv (the v2 mask
+    is sigmoid(0)*2 = 1, conv_layers.py:383); both train end-to-end."""
+    from incubator_mxnet_tpu.gluon.contrib.cnn import (
+        DeformableConvolution, ModulatedDeformableConvolution)
+    x = nd.random.uniform(shape=(2, 4, 8, 8))
+    for cls, scale in ((DeformableConvolution, 1.0),
+                       (ModulatedDeformableConvolution, 1.0)):
+        net = cls(8, kernel_size=3, padding=1)
+        net.initialize(ctx=mx.cpu())
+        y = net(x)
+        assert y.shape == (2, 8, 8, 8)
+        ref = nd.Convolution(x, net.weight.data(), kernel=(3, 3),
+                             pad=(1, 1), num_filter=8, no_bias=True) * scale \
+            + net.bias.data().reshape((1, -1, 1, 1))
+        assert_almost_equal(y, ref.asnumpy(), rtol=1e-5, atol=1e-5)
+        from incubator_mxnet_tpu import gluon
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        with autograd.record():
+            loss = nd.sum(net(x))
+        loss.backward()
+        tr.step(1)
+        assert float(onp.abs(
+            net.offset.weight.grad().asnumpy()).max()) > 0
+
+
+def test_deformable_convolution_shifts_sampling():
+    """An integer (+1,+1) offset samples the shifted input (interior
+    pixels; borders differ by zero-pad sampling)."""
+    R = onp.random.RandomState(0)
+    x = R.rand(1, 2, 8, 8).astype("f")
+    w = R.randn(3, 2, 3, 3).astype("f") * 0.1
+    off = onp.ones((1, 18, 8, 8), "f")
+    d = nd.DeformableConvolution(nd.array(x), nd.array(off), nd.array(w),
+                                 kernel=(3, 3), pad=(1, 1), num_filter=3,
+                                 no_bias=True)
+    xs = onp.zeros_like(x)
+    xs[:, :, :-1, :-1] = x[:, :, 1:, 1:]
+    ref = nd.Convolution(nd.array(xs), nd.array(w), kernel=(3, 3),
+                         pad=(1, 1), num_filter=3, no_bias=True)
+    assert_almost_equal(d.asnumpy()[:, :, 1:-2, 1:-2],
+                        ref.asnumpy()[:, :, 1:-2, 1:-2], rtol=1e-5,
+                        atol=1e-5)
+
+
+def test_interval_sampler():
+    from incubator_mxnet_tpu.gluon.contrib.data import IntervalSampler
+    assert list(IntervalSampler(13, interval=3)) == \
+        [0, 3, 6, 9, 12, 1, 4, 7, 10, 2, 5, 8, 11]
+    assert list(IntervalSampler(13, interval=3, rollover=False)) == \
+        [0, 3, 6, 9, 12]
+
+
+def test_wikitext_local_file(tmp_path):
+    from incubator_mxnet_tpu.gluon.contrib.data import WikiText2
+    text = "the quick brown fox\njumps over the lazy dog\n" * 5
+    (tmp_path / "wiki.train.tokens").write_text(text)
+    ds = WikiText2(str(tmp_path), segment="train", seq_len=5)
+    assert len(ds) > 0
+    d, l = ds[0]
+    assert d.shape == (5,) and l.shape == (5,)
+    # label is data shifted by exactly one token
+    flat_d = ds._data.ravel()
+    flat_l = ds._label.ravel()
+    assert (flat_l[:-1] == flat_d[1:]).all()
+    with pytest.raises(OSError, match="not found"):
+        WikiText2(str(tmp_path), segment="test")
