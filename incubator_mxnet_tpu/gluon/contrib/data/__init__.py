@@ -55,11 +55,17 @@ class _WikiText(Dataset):
             tokens = f.read().replace("\n", " <eos> ").split()
         vocab_src = path if segment == "train" else os.path.join(
             os.path.expanduser(root), self._filename.format("train"))
-        if os.path.exists(vocab_src) and vocab_src != path:
+        if vocab_src == path:
+            vtokens = tokens
+        elif os.path.exists(vocab_src):
             with open(vocab_src, encoding="utf-8") as f:
                 vtokens = f.read().replace("\n", " <eos> ").split()
         else:
-            vtokens = tokens
+            # a test/valid-only vocab would silently mismatch any model
+            # trained with the train-split vocab — refuse instead
+            raise OSError(
+                f"{vocab_src} not found: the vocabulary is built from the "
+                f"train split; place wiki.train.tokens next to {path}")
         self.vocab = {"<unk>": 0}
         for t in vtokens:
             self.vocab.setdefault(t, len(self.vocab))

@@ -43,6 +43,32 @@ for _name in _registry.list_ops():
     if _name not in _g:
         _g[_name] = _make_wrapper(_name)
 
+# Per-element-parameter samplers: the reference exposes these as
+# `mx.nd.sample_normal(mu, sigma, shape=n)` with no explicit RNG state
+# (src/operator/random/sample_op.cc); here the wrapper draws the key
+# from the global stream so the registry op itself stays pure.
+def _make_sample_wrapper(op_name):
+    op = _registry.get_op(op_name)
+
+    def fn(*params, shape=(), dtype=None, out=None, **kw):
+        from .. import random as _rng
+        key = _rng.next_key()
+        if dtype is not None:
+            kw["dtype"] = dtype
+        return _invoke(op, *params, key, out=out, shape=shape, **kw)
+
+    fn.__name__ = op_name
+    fn.__doc__ = op.fn.__doc__
+    return fn
+
+
+for _name in ("sample_uniform", "sample_normal", "sample_gamma",
+              "sample_exponential", "sample_poisson",
+              "sample_negative_binomial",
+              "sample_generalized_negative_binomial"):
+    # the reference-internal alias (`_sample_*`) must key-inject too
+    _g[_name] = _g["_" + _name] = _make_sample_wrapper(_name)
+
 # pythonic aliases matching the reference nd namespace
 _dense_dot = _g["dot"]
 

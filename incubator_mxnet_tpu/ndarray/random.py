@@ -6,14 +6,16 @@ graphs stay pure (see random.py module docstring for the contract).
 """
 from __future__ import annotations
 
+import jax.numpy as jnp
+
 from .. import random as _random
 from ..context import current_context
 from ..ops.registry import get_op
 from .ndarray import NDArray
 
 __all__ = ["uniform", "normal", "randn", "randint", "gamma", "exponential",
-           "poisson", "negative_binomial", "multinomial", "shuffle",
-           "bernoulli", "seed"]
+           "poisson", "negative_binomial", "generalized_negative_binomial",
+           "multinomial", "shuffle", "bernoulli", "seed"]
 
 seed = _random.seed
 
@@ -30,11 +32,37 @@ def _sample(op_name, shape, dtype, ctx, out, **params):
     return nd
 
 
+def _tensor_params(*vals):
+    """Reference _random_helper dispatch (python/mxnet/ndarray/random.py:28):
+    NDArray distribution params route to the per-element `sample_*` op,
+    scalars to the plain `random_*` sampler.  Mixing the two is an error
+    there and here."""
+    kinds = [isinstance(v, NDArray) for v in vals]
+    if all(kinds):
+        return True
+    if any(kinds):
+        raise ValueError(
+            "distribution params must be all scalars or all NDArrays")
+    return False
+
+
+def _sample_per_elem(op_name, params, shape, out, **kw):
+    from . import __dict__ as _nd_ns  # the key-injecting nd wrappers
+    fn = _nd_ns[op_name]
+    return fn(*params, shape=shape, out=out, **kw)
+
+
 def uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    if _tensor_params(low, high):
+        return _sample_per_elem("sample_uniform", (low, high), shape,
+                                out, dtype=dtype)
     return _sample("random_uniform", shape, dtype, ctx, out, low=low, high=high)
 
 
 def normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    if _tensor_params(loc, scale):
+        return _sample_per_elem("sample_normal", (loc, scale), shape,
+                                out, dtype=dtype)
     return _sample("random_normal", shape, dtype, ctx, out, loc=loc, scale=scale)
 
 
@@ -47,19 +75,44 @@ def randint(low, high=None, shape=(), dtype="int32", ctx=None, out=None):
 
 
 def gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    if _tensor_params(alpha, beta):
+        return _sample_per_elem("sample_gamma", (alpha, beta), shape,
+                                out, dtype=dtype)
     return _sample("random_gamma", shape, dtype, ctx, out, alpha=alpha, beta=beta)
 
 
 def exponential(scale=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    if _tensor_params(scale):
+        return _sample_per_elem("sample_exponential", (1.0 / scale,), shape,
+                                out, dtype=dtype)
     return _sample("random_exponential", shape, dtype, ctx, out, lam=1.0 / scale)
 
 
 def poisson(lam=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    if _tensor_params(lam):
+        return _sample_per_elem("sample_poisson", (lam,), shape, out,
+                                dtype=dtype)
     return _sample("random_poisson", shape, dtype, ctx, out, lam=lam)
 
 
 def negative_binomial(k=1, p=1.0, shape=(), dtype="float32", ctx=None, out=None):
+    if _tensor_params(k, p):
+        return _sample_per_elem("sample_negative_binomial", (k, p), shape,
+                                out, dtype=dtype)
     return _sample("random_negative_binomial", shape, dtype, ctx, out, k=k, p=p)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(),
+                                  dtype="float32", ctx=None, out=None):
+    """Reference python/mxnet/ndarray/random.py generalized_negative_binomial."""
+    if _tensor_params(mu, alpha):
+        return _sample_per_elem("sample_generalized_negative_binomial",
+                                (mu, alpha), shape, out, dtype=dtype)
+    mu_nd = NDArray(jnp.full((), float(mu), jnp.float32))
+    a_nd = NDArray(jnp.full((), float(alpha), jnp.float32))
+    res = _sample_per_elem("sample_generalized_negative_binomial",
+                           (mu_nd, a_nd), shape, out, dtype=dtype)
+    return res
 
 
 def bernoulli(p=0.5, shape=(), dtype="float32", ctx=None, out=None):

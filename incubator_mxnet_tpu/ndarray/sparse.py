@@ -20,7 +20,18 @@ from ..context import current_context
 from .ndarray import NDArray
 
 __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
-           "cast_storage", "zeros"]
+           "cast_storage", "zeros", "retain"]
+
+
+def retain(data, indices):
+    """Module-level sparse row retain (reference mx.nd.sparse.retain,
+    src/operator/tensor/sparse_retain-inl.h): keep only the rows named
+    by ``indices``; other rows become zero/unstored."""
+    if isinstance(data, RowSparseNDArray):
+        return data.retain(indices)
+    raise TypeError("sparse.retain expects a RowSparseNDArray; got "
+                    f"{type(data).__name__} (dense arrays: use "
+                    "nd.sparse_retain)")
 
 
 class BaseSparseNDArray(NDArray):

@@ -655,6 +655,97 @@ def sign_ste(data):
     return _sign_ste(data)
 
 
+@register("_contrib_mrcnn_mask_target", num_inputs=4,
+          aliases=("mrcnn_mask_target",), differentiable=False)
+def mrcnn_mask_target(rois, gt_masks, matches, cls_targets,
+                      num_rois=None, num_classes=81, mask_size=(14, 14),
+                      sample_ratio=2, aligned=False):
+    """Mask-RCNN training targets (reference
+    src/operator/contrib/mrcnn_mask_target-inl.h): ROI-align the matched
+    ground-truth mask of every sampled RoI to ``mask_size`` and expand it
+    over the class axis; the companion output is the one-hot class weight
+    mask that selects which class channel contributes to the mask loss.
+
+    rois (B,N,4 corner format) · gt_masks (B,M,1,H,W or B,M,H,W) ·
+    matches (B,N) · cls_targets (B,N) →
+    mask_targets, mask_cls  both (B, N, num_classes, h, w).
+    """
+    mh, mw = mask_size
+    sr = max(int(sample_ratio), 1)
+    if num_rois is not None and int(num_rois) != rois.shape[1]:
+        raise ValueError(
+            f"num_rois={num_rois} does not match rois.shape[1]="
+            f"{rois.shape[1]} (reference mrcnn_mask_target-inl.h:81 "
+            "shape check)")
+    if gt_masks.ndim == 5:
+        gt_masks = gt_masks[:, :, 0]
+    B, M, H, W = gt_masks.shape
+    matched = jnp.take_along_axis(
+        gt_masks, jnp.asarray(matches, jnp.int32)[:, :, None, None],
+        axis=1)                                          # (B, N, H, W)
+    half = 0.5 if aligned else 0.0
+
+    def crop(mask, roi):
+        x0, y0, x1, y1 = roi[0], roi[1], roi[2], roi[3]
+        rw = jnp.maximum(x1 - x0, 1.0)
+        rh = jnp.maximum(y1 - y0, 1.0)
+        bin_h, bin_w = rh / mh, rw / mw
+        iy = jnp.arange(mh, dtype=jnp.float32)
+        ix = jnp.arange(mw, dtype=jnp.float32)
+        sy = (jnp.arange(sr, dtype=jnp.float32) + 0.5) / sr
+        ys = (y0 - half + (iy[:, None] + sy[None, :]) * bin_h).reshape(-1)
+        xs = (x0 - half + (ix[:, None] + sy[None, :]) * bin_w).reshape(-1)
+        yc = jnp.clip(ys, 0.0, H - 1.0)
+        xc = jnp.clip(xs, 0.0, W - 1.0)
+        yi0 = jnp.floor(yc).astype(jnp.int32)
+        xi0 = jnp.floor(xc).astype(jnp.int32)
+        yi1 = jnp.minimum(yi0 + 1, H - 1)
+        xi1 = jnp.minimum(xi0 + 1, W - 1)
+        wy = (yc - yi0)[:, None]
+        wx = (xc - xi0)[None, :]
+        v = (mask[yi0][:, xi0] * (1 - wy) * (1 - wx)
+             + mask[yi0][:, xi1] * (1 - wy) * wx
+             + mask[yi1][:, xi0] * wy * (1 - wx)
+             + mask[yi1][:, xi1] * wy * wx)          # (mh·sr, mw·sr)
+        return jnp.mean(v.reshape(mh, sr, mw, sr), axis=(1, 3))
+
+    per_roi = jax.vmap(crop)                 # over N
+    cropped = jax.vmap(per_roi)(matched.astype(jnp.float32),
+                                rois.astype(jnp.float32))   # (B,N,h,w)
+    cls = jnp.asarray(cls_targets, jnp.int32)
+    onehot = jax.nn.one_hot(cls, num_classes, dtype=cropped.dtype)
+    # valid only for positive classes (background rois get zero weight)
+    onehot = onehot * (cls > 0)[..., None]
+    mask_targets = cropped[:, :, None] * onehot[..., None, None]
+    mask_cls = jnp.broadcast_to(onehot[..., None, None],
+                                onehot.shape + (mh, mw))
+    return mask_targets, mask_cls
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _grad_mult(x, scalar):
+    return x
+
+
+def _grad_mult_fwd(x, scalar):
+    return x, None
+
+
+def _grad_mult_bwd(scalar, _, g):
+    return (g * scalar,)
+
+
+_grad_mult.defvjp(_grad_mult_fwd, _grad_mult_bwd)
+
+
+@register("_contrib_gradientmultiplier", aliases=("gradientmultiplier",))
+def gradientmultiplier(data, scalar=1.0):
+    """Identity forward, gradient scaled by ``scalar`` on the way back
+    (reference contrib/gradient_multiplier_op.cc:73 — the gradient-
+    reversal trick of Ganin & Lempitsky when scalar < 0)."""
+    return _grad_mult(data, float(scalar))
+
+
 # ---------------------------------------------------------------------------
 # Deformable convolution v1/v2 (reference
 # src/operator/contrib/deformable_convolution.cc, Dai 2017 /

@@ -285,6 +285,30 @@ def group_adagrad_update(weight, grad, history, lr, rescale_grad=1.0,
     return new_weight.astype(weight.dtype), new_hist.astype(history.dtype)
 
 
+@register("sparse_adagrad_update", num_inputs=4,
+          aliases=("_sparse_adagrad_update",))
+def sparse_adagrad_update(weight, grad_values, grad_indices, history, lr,
+                          epsilon=1e-7, rescale_grad=1.0,
+                          clip_gradient=-1.0):
+    """Lazy (row-wise) AdaGrad (reference optimizer_op.cc:886
+    `_sparse_adagrad_update`): only the rows present in the row_sparse
+    gradient touch the weight/history.
+
+    TPU lowering: the sparse rows arrive as (values, indices) and the
+    update is a scatter over the dense state — XLA turns the `.at[idx]`
+    ops into in-place dynamic-update-slices under donation, so untouched
+    rows cost no bandwidth beyond the gather/scatter of the stored rows.
+    Weight decay is unsupported, matching the reference.
+    """
+    idx = jnp.asarray(grad_indices, jnp.int32)
+    g = _prep(grad_values, rescale_grad, clip_gradient)
+    hist_rows = history[idx] + jnp.square(g)
+    w_rows = weight[idx] - lr * g / jnp.sqrt(hist_rows + epsilon)
+    new_history = history.at[idx].set(hist_rows.astype(history.dtype))
+    new_weight = weight.at[idx].set(w_rows.astype(weight.dtype))
+    return new_weight, new_history
+
+
 # ---------------------------------------------------------------------------
 # Multi-tensor variadic family (optimizer_op.cc:313-346).  Inputs arrive
 # interleaved exactly like the reference (w0,g0,w1,g1,... / +mom /
@@ -355,6 +379,98 @@ def multi_mp_sgd_mom_update(*tensors, lrs, wds, momentum=0.0,
         new_ms.append(nm)
         new_w32s.append(nw32)
     return tuple(new_ws) + tuple(new_ms) + tuple(new_w32s)
+
+
+@register("multi_adamw_update", aliases=("_multi_adamw_update",))
+def multi_adamw_update(*tensors, lrs, wds, etas=1.0, beta1=0.9, beta2=0.999,
+                       epsilon=1e-8, rescale_grad=1.0, clip_gradient=-1.0,
+                       num_weights=None):
+    """Aggregated AdamW (reference contrib/adamw.cc `_multi_adamw_update`):
+    interleaved (w, g, mean, var) x n, per-tensor lrs/wds/etas."""
+    n = num_weights if num_weights is not None else len(tensors) // 4
+    new_ws, new_ms, new_vs = [], [], []
+    for i in range(n):
+        w, g, m, v = tensors[4 * i:4 * i + 4]
+        nw, nm, nv = adamw_update.fn(
+            w, g, m, v, _per_tensor(lrs, i), beta1, beta2, epsilon,
+            _per_tensor(wds, i), _per_tensor(etas, i), rescale_grad,
+            clip_gradient)
+        new_ws.append(nw)
+        new_ms.append(nm)
+        new_vs.append(nv)
+    return tuple(new_ws) + tuple(new_ms) + tuple(new_vs)
+
+
+@register("multi_mp_adamw_update", aliases=("_multi_mp_adamw_update",))
+def multi_mp_adamw_update(*tensors, lrs, wds, etas=1.0, beta1=0.9,
+                          beta2=0.999, epsilon=1e-8, rescale_grad=1.0,
+                          clip_gradient=-1.0, num_weights=None):
+    n = num_weights if num_weights is not None else len(tensors) // 5
+    new_ws, new_ms, new_vs, new_w32s = [], [], [], []
+    for i in range(n):
+        w, g, m, v, w32 = tensors[5 * i:5 * i + 5]
+        nw, nm, nv, nw32 = mp_adamw_update.fn(
+            w, g, m, v, w32, _per_tensor(lrs, i), beta1, beta2, epsilon,
+            _per_tensor(wds, i), _per_tensor(etas, i), rescale_grad,
+            clip_gradient)
+        new_ws.append(nw)
+        new_ms.append(nm)
+        new_vs.append(nv)
+        new_w32s.append(nw32)
+    return tuple(new_ws) + tuple(new_ms) + tuple(new_vs) + tuple(new_w32s)
+
+
+@register("multi_lamb_update", aliases=("_multi_lamb_update",))
+def multi_lamb_update(*tensors, learning_rates, wds, step_count, beta1=0.9,
+                      beta2=0.999, epsilon=1e-6, bias_correction=True,
+                      rescale_grad=1.0, clip_gradient=-1.0,
+                      lower_bound=-1.0, upper_bound=-1.0, num_tensors=None):
+    """Aggregated LAMB (reference contrib/multi_lamb.cc): interleaved
+    (w, g, mean, var) x n with per-tensor step counts; each tensor runs
+    phase1 (adam direction + wd) then phase2 (trust-ratio scaling)."""
+    n = num_tensors if num_tensors is not None else len(tensors) // 4
+    new_ws, new_ms, new_vs = [], [], []
+    for i in range(n):
+        w, g, m, v = tensors[4 * i:4 * i + 4]
+        upd, nm, nv = lamb_update_phase1.fn(
+            w, g, m, v, beta1, beta2, epsilon, _per_tensor(step_count, i),
+            bias_correction, _per_tensor(wds, i), rescale_grad,
+            clip_gradient)
+        r1 = jnp.sqrt(jnp.sum(jnp.square(w.astype(jnp.float32))))
+        r2 = jnp.sqrt(jnp.sum(jnp.square(upd.astype(jnp.float32))))
+        nw = lamb_update_phase2.fn(w, upd, r1, r2,
+                                   _per_tensor(learning_rates, i),
+                                   lower_bound, upper_bound)
+        new_ws.append(nw)
+        new_ms.append(nm)
+        new_vs.append(nv)
+    return tuple(new_ws) + tuple(new_ms) + tuple(new_vs)
+
+
+@register("multi_mp_lamb_update", aliases=("_multi_mp_lamb_update",))
+def multi_mp_lamb_update(*tensors, learning_rates, wds, step_count,
+                         beta1=0.9, beta2=0.999, epsilon=1e-6,
+                         bias_correction=True, rescale_grad=1.0,
+                         clip_gradient=-1.0, lower_bound=-1.0,
+                         upper_bound=-1.0, num_tensors=None):
+    n = num_tensors if num_tensors is not None else len(tensors) // 5
+    new_ws, new_ms, new_vs, new_w32s = [], [], [], []
+    for i in range(n):
+        w, g, m, v, w32 = tensors[5 * i:5 * i + 5]
+        upd, nm, nv = lamb_update_phase1.fn(
+            w32, g.astype(jnp.float32), m, v, beta1, beta2, epsilon,
+            _per_tensor(step_count, i), bias_correction,
+            _per_tensor(wds, i), rescale_grad, clip_gradient)
+        r1 = jnp.sqrt(jnp.sum(jnp.square(w32)))
+        r2 = jnp.sqrt(jnp.sum(jnp.square(upd)))
+        nw32 = lamb_update_phase2.fn(w32, upd, r1, r2,
+                                     _per_tensor(learning_rates, i),
+                                     lower_bound, upper_bound)
+        new_ws.append(nw32.astype(w.dtype))
+        new_ms.append(nm)
+        new_vs.append(nv)
+        new_w32s.append(nw32)
+    return tuple(new_ws) + tuple(new_ms) + tuple(new_vs) + tuple(new_w32s)
 
 
 # ---------------------------------------------------------------------------

@@ -66,6 +66,110 @@ def random_bernoulli(key, p=0.5, shape=(), dtype="float32"):
     return jax.random.bernoulli(key, p, shape).astype(dt)
 
 
+def _per_elem_shape(pshape, shape):
+    s = (shape,) if isinstance(shape, int) else tuple(shape or ())
+    return tuple(pshape) + s, s
+
+
+def _bcast(param, out_shape):
+    """Right-pad the param shape with 1s so it broadcasts over the
+    per-element sample tail."""
+    return param.reshape(tuple(param.shape)
+                         + (1,) * (len(out_shape) - param.ndim))
+
+
+def _param_dtype(param, dtype):
+    """Sample dtype: explicit ``dtype`` wins, else the param dtype
+    (reference sample_op.cc DType defaulting)."""
+    if dtype is None or dtype == "None":
+        dt = param.dtype
+        return dt if jnp.issubdtype(dt, jnp.floating) else jnp.float32
+    return dtype_from_any(dtype)
+
+
+@register("sample_uniform", num_inputs=3, differentiable=False,
+          aliases=("_sample_uniform",))
+def sample_uniform(low, high, key, shape=(), dtype=None):
+    """Per-element-parameter sampling: output[i, ...] ~ U(low[i], high[i])
+    with ``shape`` draws per parameter element (reference
+    src/operator/random/sample_op.cc `_sample_uniform`)."""
+    dt = _param_dtype(low, dtype)
+    out_shape, _ = _per_elem_shape(low.shape, shape)
+    u = jax.random.uniform(key, out_shape, dtype=dt)
+    return (_bcast(low, out_shape).astype(dt)
+            + _bcast(high - low, out_shape).astype(dt) * u)
+
+
+@register("sample_normal", num_inputs=3, differentiable=False,
+          aliases=("_sample_normal",))
+def sample_normal(mu, sigma, key, shape=(), dtype=None):
+    dt = _param_dtype(mu, dtype)
+    out_shape, _ = _per_elem_shape(mu.shape, shape)
+    z = jax.random.normal(key, out_shape, dtype=dt)
+    return (_bcast(mu, out_shape).astype(dt)
+            + _bcast(sigma, out_shape).astype(dt) * z)
+
+
+@register("sample_gamma", num_inputs=3, differentiable=False,
+          aliases=("_sample_gamma",))
+def sample_gamma(alpha, beta, key, shape=(), dtype=None):
+    dt = _param_dtype(alpha, dtype)
+    out_shape, _ = _per_elem_shape(alpha.shape, shape)
+    g = jax.random.gamma(key, _bcast(alpha, out_shape).astype(dt),
+                         out_shape, dtype=dt)
+    return g * _bcast(beta, out_shape).astype(dt)
+
+
+@register("sample_exponential", num_inputs=2, differentiable=False,
+          aliases=("_sample_exponential",))
+def sample_exponential(lam, key, shape=(), dtype=None):
+    dt = _param_dtype(lam, dtype)
+    out_shape, _ = _per_elem_shape(lam.shape, shape)
+    e = jax.random.exponential(key, out_shape, dtype=dt)
+    return e / _bcast(lam, out_shape).astype(dt)
+
+
+@register("sample_poisson", num_inputs=2, differentiable=False,
+          aliases=("_sample_poisson",))
+def sample_poisson(lam, key, shape=(), dtype="float32"):
+    out_shape, _ = _per_elem_shape(lam.shape, shape)
+    p = jax.random.poisson(key, _bcast(lam, out_shape), out_shape)
+    return p.astype(dtype_from_any(dtype))
+
+
+@register("sample_negative_binomial", num_inputs=3, differentiable=False,
+          aliases=("_sample_negative_binomial",))
+def sample_negative_binomial(k, p, key, shape=(), dtype="float32"):
+    """NB(k, p) via the gamma-Poisson mixture, per parameter element."""
+    out_shape, _ = _per_elem_shape(k.shape, shape)
+    k1, k2 = jax.random.split(key)
+    kf = _bcast(k.astype(jnp.float32), out_shape)
+    pf = _bcast(p.astype(jnp.float32), out_shape)
+    lam = jax.random.gamma(k1, kf, out_shape) * ((1 - pf) / pf)
+    return jax.random.poisson(k2, lam, out_shape).astype(
+        dtype_from_any(dtype))
+
+
+@register("sample_generalized_negative_binomial", num_inputs=3,
+          differentiable=False,
+          aliases=("_sample_generalized_negative_binomial",))
+def sample_generalized_negative_binomial(mu, alpha, key, shape=(),
+                                         dtype="float32"):
+    """GNB(mu, alpha): mean/dispersion parameterization — r = 1/alpha,
+    lam ~ Gamma(r, scale=mu*alpha), then Poisson(lam) (reference
+    sample_op.h GeneralizedNegativeBinomialSampler)."""
+    out_shape, _ = _per_elem_shape(mu.shape, shape)
+    k1, k2 = jax.random.split(key)
+    muf = _bcast(mu.astype(jnp.float32), out_shape)
+    af = _bcast(alpha.astype(jnp.float32), out_shape)
+    r = 1.0 / jnp.maximum(af, 1e-12)
+    lam = jax.random.gamma(k1, r, out_shape) * (muf * af)
+    # alpha -> 0 degenerates to Poisson(mu)
+    lam = jnp.where(af < 1e-10, muf, lam)
+    return jax.random.poisson(k2, lam, out_shape).astype(
+        dtype_from_any(dtype))
+
+
 @register("sample_multinomial", num_inputs=2, differentiable=False)
 def sample_multinomial(data, key, shape=(), get_prob=False):
     """Categorical sampling over last-axis probabilities (reference

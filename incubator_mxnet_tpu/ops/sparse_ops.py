@@ -66,6 +66,27 @@ def row_sparse_dot_dense(values, row_idx, rhs, n_rows=None):
     return out.at[jnp.asarray(row_idx, jnp.int32)].set(out_rows)
 
 
+@register("sparse_retain", num_inputs=2, aliases=("_sparse_retain",))
+def sparse_retain(data, indices):
+    """Keep only the rows named by ``indices``, zeroing the rest
+    (reference src/operator/tensor/sparse_retain-inl.h).  On the dense
+    backing array this is a mask-select: rows not retained become zero,
+    matching the dense view of the reference's row_sparse result."""
+    idx = jnp.asarray(indices, jnp.int32)
+    keep = jnp.zeros((data.shape[0],), jnp.bool_).at[idx].set(True)
+    return jnp.where(keep.reshape((-1,) + (1,) * (data.ndim - 1)),
+                     data, jnp.zeros_like(data))
+
+
+@register("square_sum", aliases=("_square_sum",))
+def square_sum(data, axis=None, keepdims=False):
+    """sum(data**2) — the fused op the reference uses for row_sparse
+    norms (src/operator/tensor/square_sum-inl.h); XLA fuses the square
+    into the reduction so no intermediate materializes."""
+    ax = tuple(axis) if isinstance(axis, (tuple, list)) else axis
+    return jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims)
+
+
 def cast_storage_meta(dense, stype):
     """Dense → (values, aux...) triplets with jnp ops where possible
     (reference cast_storage-inl.h).  Returns numpy-backed components —

@@ -1,0 +1,222 @@
+"""Round-4 op-gap closure #2: per-element `sample_*` distributions,
+sparse_retain / square_sum / sparse_adagrad_update, gradientmultiplier,
+multi-tensor AdamW/LAMB, mrcnn_mask_target (reference
+src/operator/random/sample_op.cc, tensor/sparse_retain-inl.h,
+tensor/square_sum-inl.h, optimizer_op.cc:886, contrib/
+gradient_multiplier_op.cc, contrib/adamw.cc, contrib/multi_lamb.cc,
+contrib/mrcnn_mask_target-inl.h).
+"""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _np(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# per-element samplers
+# ---------------------------------------------------------------------------
+
+def test_sample_normal_per_element_stats():
+    mx.random.seed(0)
+    mu = nd.array([[0.0, 10.0], [100.0, -5.0]])
+    sigma = nd.array([[1.0, 0.1], [2.0, 0.5]])
+    s = nd.sample_normal(mu, sigma, shape=8000)
+    assert s.shape == (2, 2, 8000)
+    assert_almost_equal(_np(s).mean(-1), _np(mu), atol=0.15)
+    assert_almost_equal(_np(s).std(-1), _np(sigma), rtol=0.1)
+
+
+def test_sample_uniform_gamma_exponential():
+    mx.random.seed(1)
+    u = nd.sample_uniform(nd.array([0.0, 5.0]), nd.array([1.0, 6.0]),
+                          shape=6000)
+    assert_almost_equal(_np(u).mean(-1), [0.5, 5.5], atol=0.05)
+    assert float(_np(u)[1].min()) >= 5.0
+    g = nd.sample_gamma(nd.array([2.0, 9.0]), nd.array([1.0, 0.5]),
+                        shape=6000)
+    assert_almost_equal(_np(g).mean(-1), [2.0, 4.5], rtol=0.1)
+    e = nd.sample_exponential(nd.array([2.0, 0.5]), shape=6000)
+    assert_almost_equal(_np(e).mean(-1), [0.5, 2.0], rtol=0.1)
+
+
+def test_sample_counts_match_means():
+    mx.random.seed(2)
+    p = nd.sample_poisson(nd.array([3.0, 30.0]), shape=6000)
+    assert_almost_equal(_np(p).mean(-1), [3.0, 30.0], rtol=0.1)
+    nb = nd.sample_negative_binomial(nd.array([5.0, 2.0]),
+                                     nd.array([0.5, 0.2]), shape=6000)
+    # NB mean = k(1-p)/p
+    assert_almost_equal(_np(nb).mean(-1), [5.0, 8.0], rtol=0.15)
+    gnb = nd.sample_generalized_negative_binomial(
+        nd.array([4.0, 10.0]), nd.array([0.25, 0.1]), shape=6000)
+    assert_almost_equal(_np(gnb).mean(-1), [4.0, 10.0], rtol=0.15)
+    # GNB variance = mu + alpha*mu^2
+    assert_almost_equal(_np(gnb).var(-1), [8.0, 20.0], rtol=0.25)
+
+
+def test_random_namespace_tensor_dispatch():
+    """mx.nd.random.* routes NDArray params to the sample_* ops
+    (reference python/mxnet/ndarray/random.py:28 _random_helper)."""
+    mx.random.seed(3)
+    r = mx.nd.random.normal(nd.array([0.0, 50.0]), nd.array([1.0, 1.0]),
+                            shape=2000)
+    assert r.shape == (2, 2000)
+    assert_almost_equal(_np(r).mean(-1), [0.0, 50.0], atol=0.2)
+    with pytest.raises(ValueError):
+        mx.nd.random.normal(nd.array([0.0]), 1.0, shape=10)
+    s = mx.nd.random.generalized_negative_binomial(4.0, 0.25, shape=(3, 5))
+    assert s.shape == (3, 5)
+
+
+# ---------------------------------------------------------------------------
+# sparse tail
+# ---------------------------------------------------------------------------
+
+def test_sparse_retain_op_and_module():
+    d = nd.array(onp.arange(12.0).reshape(4, 3))
+    r = nd.sparse_retain(d, nd.array([0, 2]))
+    expect = _np(d).copy()
+    expect[[1, 3]] = 0
+    onp.testing.assert_array_equal(_np(r), expect)
+    rs = mx.nd.sparse.row_sparse_array(
+        (onp.ones((2, 3), "f"), [0, 2]), shape=(5, 3))
+    kept = mx.nd.sparse.retain(rs, nd.array([2]))
+    assert kept.stype == "row_sparse"
+    assert float(_np(kept).sum()) == 3.0
+    onp.testing.assert_array_equal(_np(kept.indices), [2])
+
+
+def test_square_sum_matches_dense():
+    d = nd.array(onp.random.RandomState(0).randn(5, 4).astype("f"))
+    assert_almost_equal(_np(nd.square_sum(d, axis=1)),
+                        (_np(d) ** 2).sum(1), rtol=1e-5)
+    assert_almost_equal(float(_np(nd.square_sum(d))),
+                        float((_np(d) ** 2).sum()), rtol=1e-5)
+
+
+def test_sparse_adagrad_update_rows_only():
+    w = nd.array(onp.ones((4, 3), "f"))
+    h = nd.array(onp.zeros((4, 3), "f"))
+    gv = nd.array(onp.full((2, 3), 2.0, "f"))
+    gi = nd.array(onp.array([1, 3], "i"))
+    nw, nh = nd.sparse_adagrad_update(w, gv, gi, h, lr=0.1, epsilon=1e-7)
+    # untouched rows unchanged
+    onp.testing.assert_array_equal(_np(nw)[[0, 2]], onp.ones((2, 3), "f"))
+    onp.testing.assert_array_equal(_np(nh)[[0, 2]], onp.zeros((2, 3), "f"))
+    # touched rows follow adagrad: h=4, w -= 0.1*2/sqrt(4) = 0.1
+    assert_almost_equal(_np(nw)[[1, 3]], onp.full((2, 3), 0.9), rtol=1e-6)
+    assert_almost_equal(_np(nh)[[1, 3]], onp.full((2, 3), 4.0), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# gradientmultiplier
+# ---------------------------------------------------------------------------
+
+def test_gradientmultiplier_identity_fwd_scaled_bwd():
+    x = nd.array([1.0, -2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.gradientmultiplier(x, scalar=-0.5)
+        z = (y * y).sum()
+    z.backward()
+    onp.testing.assert_array_equal(_np(x.grad), -0.5 * 2 * _np(x))
+    onp.testing.assert_array_equal(
+        _np(nd.gradientmultiplier(x, scalar=7.0)), _np(x))
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor AdamW / LAMB
+# ---------------------------------------------------------------------------
+
+def _interleave(*groups):
+    out = []
+    for tensors in zip(*groups):
+        out.extend(tensors)
+    return out
+
+
+def test_multi_adamw_matches_single():
+    rng = onp.random.RandomState(1)
+    ws = [nd.array(rng.randn(4).astype("f")) for _ in range(3)]
+    gs = [nd.array(rng.randn(4).astype("f")) for _ in range(3)]
+    ms = [nd.zeros((4,)) for _ in range(3)]
+    vs = [nd.zeros((4,)) for _ in range(3)]
+    lrs, wds, etas = (0.01, 0.02, 0.03), (0.0, 0.1, 0.0), (1.0, 1.0, 0.5)
+    outs = nd.multi_adamw_update(*_interleave(ws, gs, ms, vs),
+                                 lrs=lrs, wds=wds, etas=etas)
+    for i in range(3):
+        sw, sm, sv = nd.adamw_update(ws[i], gs[i], ms[i], vs[i],
+                                     lr=lrs[i], wd=wds[i], eta=etas[i])
+        assert_almost_equal(_np(outs[i]), _np(sw), rtol=1e-6)
+        assert_almost_equal(_np(outs[3 + i]), _np(sm), rtol=1e-6)
+        assert_almost_equal(_np(outs[6 + i]), _np(sv), rtol=1e-6)
+
+
+def test_multi_lamb_trust_ratio_applied():
+    rng = onp.random.RandomState(2)
+    ws = [nd.array(rng.rand(6).astype("f") + 1.0) for _ in range(2)]
+    gs = [nd.array(rng.randn(6).astype("f")) for _ in range(2)]
+    ms = [nd.zeros((6,)) for _ in range(2)]
+    vs = [nd.zeros((6,)) for _ in range(2)]
+    outs = nd.multi_lamb_update(*_interleave(ws, gs, ms, vs),
+                                learning_rates=(0.01, 0.01), wds=(0.0, 0.0),
+                                step_count=(1, 1))
+    for i in range(2):
+        upd, _, _ = nd.lamb_update_phase1(ws[i], gs[i], ms[i], vs[i], t=1)
+        r1 = float(onp.sqrt((_np(ws[i]) ** 2).sum()))
+        r2 = float(onp.sqrt((_np(upd) ** 2).sum()))
+        expect = _np(ws[i]) - 0.01 * (r1 / r2) * _np(upd)
+        assert_almost_equal(_np(outs[i]), expect, rtol=1e-5)
+
+
+def test_multi_mp_variants_keep_fp32_master():
+    w16 = nd.array(onp.ones(4, "f")).astype("float16")
+    g16 = nd.array(onp.full(4, 0.5, "f")).astype("float16")
+    m = nd.zeros((4,))
+    v = nd.zeros((4,))
+    w32 = nd.array(onp.ones(4, "f"))
+    outs = nd.multi_mp_adamw_update(w16, g16, m, v, w32,
+                                    lrs=(0.1,), wds=(0.0,), etas=(1.0,))
+    assert str(outs[0].dtype) == "float16"
+    assert str(outs[3].dtype) == "float32"
+    outs = nd.multi_mp_lamb_update(w16, g16, m, v, w32,
+                                   learning_rates=(0.1,), wds=(0.0,),
+                                   step_count=(1,))
+    assert str(outs[0].dtype) == "float16"
+    assert str(outs[3].dtype) == "float32"
+
+
+# ---------------------------------------------------------------------------
+# mrcnn_mask_target
+# ---------------------------------------------------------------------------
+
+def test_mrcnn_mask_target_shapes_and_weights():
+    B, N, M, H, W = 2, 3, 4, 28, 28
+    rois = nd.array(onp.tile(
+        onp.array([[0, 0, 14, 14], [7, 7, 21, 21], [0, 0, 27, 27]],
+                  "f"), (B, 1, 1)))
+    gt = onp.zeros((B, M, H, W), "f")
+    gt[:, 0, 8:20, 8:20] = 1.0
+    matches = nd.array(onp.zeros((B, N), "i"))
+    cls_t = nd.array(onp.tile(onp.array([1, 0, 3], "i"), (B, 1)))
+    mt, mc = nd.mrcnn_mask_target(rois, nd.array(gt), matches, cls_t,
+                                  num_classes=5, mask_size=(14, 14))
+    assert mt.shape == (B, N, 5, 14, 14)
+    assert mc.shape == (B, N, 5, 14, 14)
+    mt_np, mc_np = _np(mt), _np(mc)
+    # roi 1 has background class -> zero weights and zero targets
+    assert mc_np[:, 1].sum() == 0 and mt_np[:, 1].sum() == 0
+    # roi 0 (class 1): weight channel 1 all ones, other channels zero
+    assert (mc_np[0, 0, 1] == 1).all()
+    assert mc_np[0, 0, [0, 2, 3, 4]].sum() == 0
+    # full-image roi (class 3) averages the mask's fill fraction
+    frac = gt[0, 0].mean()
+    assert abs(mt_np[0, 2, 3].mean() - frac) < 0.05
+    # targets only on the labeled class channel
+    assert mt_np[0, 0, [0, 2, 3, 4]].sum() == 0

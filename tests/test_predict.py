@@ -362,4 +362,8 @@ def test_pjrt_predictor_on_accelerator(tmp_path):
         pytest.skip(f"plugin refused: {proc.stderr[-300:]}")
     out = onp.fromfile(prefix + ".smoke_out.bin", onp.float32)
     ref = onp.tanh(x @ params["w"]).ravel()
-    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    # TPU MXU matmuls run bf16 by default (~2^-8 relative on the dot
+    # inputs), so against the host fp32 oracle only bf16-level agreement
+    # is expected; this test proves the serve plumbing, the numerics
+    # oracle is scripts/tpu_consistency.py
+    onp.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
