@@ -30,8 +30,13 @@ import sys
 import time
 
 BASELINE = 363.69  # img/s, reference ResNet-50 train bs=128 on 1x V100
-# ResNet-50 @224x224: ~4.09 GFLOP/img forward; training ~3x forward.
-TRAIN_FLOPS_PER_IMG = 3 * 4.089e9
+# ResNet-50 @224x224 forward = 4.089 GMACs (the widely quoted "4.1
+# GFLOPs" counts one fused multiply-add as ONE flop).  TPU peak counts
+# a multiply-add as TWO flops, so MFU must use 2x the MAC count or it
+# understates utilization by exactly 2x (round-4 audit: the analytic
+# per-conv sum in scripts/perf_probe.py `stages` mode independently
+# gives 7.75 GFLOP/img fwd).  Training ~ 3x forward.
+TRAIN_FLOPS_PER_IMG = 3 * 2 * 4.089e9
 PEAK_FLOPS = {  # per-chip bf16 peak, for the MFU estimate
     "v5e": 197e12,
     "v5p": 459e12,

@@ -52,10 +52,13 @@ def _make_sample_wrapper(op_name):
 
     def fn(*params, shape=(), dtype=None, out=None, **kw):
         from .. import random as _rng
-        key = _rng.next_key()
         if dtype is not None:
             kw["dtype"] = dtype
-        return _invoke(op, *params, key, out=out, shape=shape, **kw)
+        # key goes by keyword: distribution params may legally arrive as
+        # keywords too (reference API), and a positional key would then
+        # collide with the first parameter slot
+        return _invoke(op, *params, out=out, shape=shape,
+                       key=_rng.next_key(), **kw)
 
     fn.__name__ = op_name
     fn.__doc__ = op.fn.__doc__

@@ -303,7 +303,10 @@ def sparse_adagrad_update(weight, grad_values, grad_indices, history, lr,
     idx = jnp.asarray(grad_indices, jnp.int32)
     g = _prep(grad_values, rescale_grad, clip_gradient)
     hist_rows = history[idx] + jnp.square(g)
-    w_rows = weight[idx] - lr * g / jnp.sqrt(hist_rows + epsilon)
+    # reference kernel (optimizer_op-inl.h:2474): eps OUTSIDE the sqrt
+    # (the reference op's own describe() string says sqrt(h+eps), but
+    # the kernel is the behavior ported code depends on)
+    w_rows = weight[idx] - lr * g / (jnp.sqrt(hist_rows) + epsilon)
     new_history = history.at[idx].set(hist_rows.astype(history.dtype))
     new_weight = weight.at[idx].set(w_rows.astype(weight.dtype))
     return new_weight, new_history

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Follow-up chip jobs staged after the round-4 window-2 findings
+# (run after chip_queue.sh; same resumable artifact convention).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p artifacts/r4
+run() { # name timeout_s cmd...
+  local name="$1" t="$2"; shift 2
+  local out="artifacts/r4/$name.txt"
+  if [ -s "$out" ] && ! grep -q "QUEUE_FAILED" "$out"; then
+    echo "== $name: already done, skipping"; return 0
+  fi
+  echo "== $name (timeout ${t}s)"
+  if timeout "$t" "$@" > "$out.tmp" 2>&1; then
+    mv "$out.tmp" "$out"; echo "   ok"
+  else
+    echo "QUEUE_FAILED rc=$?" >> "$out.tmp"; mv "$out.tmp" "$out"
+    echo "   FAILED (see $out)"
+  fi
+}
+
+if ! timeout 90 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()[0]; assert d.platform != 'cpu'
+x = jax.device_put(jnp.ones((256,256), jnp.bfloat16), d)
+float((x@x).sum())" >/dev/null 2>&1; then
+  echo "chip not reachable — aborting queue"; exit 1
+fi
+echo "chip alive; running queue 2"
+
+# per-stage traffic localization (which stage owns the ~24 GB)
+run stages128 1200 env PROBE_BS=128 python scripts/perf_probe.py stages
+# eval-BN raw at bs=256: bounds the BN-stat cost at the headline batch
+run raw256nb  600  env PROBE_BS=256 PROBE_BN=eval python scripts/perf_probe.py raw
+echo "queue 2 complete"

@@ -33,11 +33,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8, "s32": 4,
-               "u32": 4, "s8": 1, "u8": 1, "i1": 1, "s64": 8, "u64": 8,
-               "pred": 1}
+# StableHLO MLIR dtype spellings (iN is signless int, uiN unsigned)
+DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8, "i32": 4,
+               "ui32": 4, "i8": 1, "ui8": 1, "i1": 1, "i64": 8,
+               "ui64": 8, "i16": 2, "ui16": 2}
 
-TENSOR_RE = re.compile(r"tensor<([0-9x]+)x(f32|bf16|f16|f64|s32|u32|s8|u8|i1|s64|u64)>")
+TENSOR_RE = re.compile(
+    r"tensor<([0-9x]+)x(f32|bf16|f16|f64|ui32|ui8|ui64|ui16|i32|i8|i1|i64|i16)>")
 
 
 def census(hlo_text, min_mb=1.0):

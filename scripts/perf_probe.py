@@ -22,7 +22,12 @@ import jax
 import jax.numpy as jnp
 import numpy as onp
 
-PEAK = 197e12  # v5e bf16
+PEAK = 197e12  # v5e bf16 (multiply-add = 2 flops)
+# ResNet-50 fwd = 4.089 GMACs = 8.178e9 true flops/img; train ~ 3x fwd.
+# The MAC/flop convention split understated every MFU before the
+# round-4 audit by exactly 2x (see bench.py TRAIN_FLOPS_PER_IMG).
+R50_FWD_FLOPS = 2 * 4.089e9
+R50_TRAIN_FLOPS = 3 * R50_FWD_FLOPS
 
 
 def sync(tree):
@@ -147,7 +152,7 @@ def probe_fused():
     lv = float(loss)
     dt = (time.perf_counter() - t0) / steps
     ips = bs / dt
-    mfu = 100 * ips * 3 * 4.089e9 / PEAK
+    mfu = 100 * ips * R50_TRAIN_FLOPS / PEAK
     print(f"fused bs={bs}: {dt * 1e3:.2f} ms/step  {ips:.0f} img/s  "
           f"MFU {mfu:.1f}%  loss {lv:.3f}", flush=True)
 
@@ -258,8 +263,8 @@ def probe_ablate():
     aux = jax.tree_util.tree_map(put, step.aux)
     opt_state = jax.tree_util.tree_map(put, step.opt_state)
     x, y = put(x), put(y)
-    flops_train = 3 * 4.089e9 * bs
-    flops_fwd = 4.089e9 * bs
+    flops_train = R50_TRAIN_FLOPS * bs
+    flops_fwd = R50_FWD_FLOPS * bs
 
     failures = []
 
@@ -411,12 +416,16 @@ def probe_stem():
               f"({100 * flops / dt / PEAK:.1f}% of peak)", flush=True)
 
 
-def probe_raw():
+def probe_raw(max_stages=None):
     """Attainable-ceiling reference: a hand-written bf16 ResNet-50
     train step in raw jnp/lax (PROBE_LAYOUT=NHWC|NCHW) — no framework,
     BN stats one-pass in f32, SGD-momentum epilogue.  If this also
     lands at ~15% MFU the gap is the platform/XLA; if it is much
-    faster, the gap is in our graph."""
+    faster, the gap is in our graph.
+
+    max_stages (stages mode): truncate after that many residual stages
+    (0 = stem+pool only) with a global-pool head, so successive deltas
+    localize the step time per stage."""
     from jax import lax
     bs = int(os.environ.get("PROBE_BS", "128"))
     remat = os.environ.get("PROBE_REMAT", "0") == "1"
@@ -430,6 +439,9 @@ def probe_raw():
 
     key = jax.random.PRNGKey(0)
     stages = [(256, 64, 3), (512, 128, 4), (1024, 256, 6), (2048, 512, 3)]
+    if max_stages is not None:
+        stages = stages[:max_stages]
+    head_c = stages[-1][0] if stages else 64
 
     def conv(x, w, s=1):
         k = w.shape[0 if nhwc else 2]
@@ -480,7 +492,7 @@ def probe_raw():
                     mk(p + "sc", 1, cin, co); mkbn(p + "scbn", co)
                 cin = co
         k[0], sub = jax.random.split(k[0])
-        params["fc"] = jax.random.normal(sub, (2048, 1000),
+        params["fc"] = jax.random.normal(sub, (head_c, 1000),
                                          jnp.bfloat16) * 0.01
         return params
 
@@ -532,13 +544,39 @@ def probe_raw():
             lambda p, m: p - (0.1 * m).astype(p.dtype), params, mom)
         return params, mom, x, lbl
 
-    flops = 3 * 4.089e9 * bs
+    # analytic conv+fc FLOPs of THIS (possibly truncated) prefix so the
+    # stages mode reports honest per-prefix MFU
+    def prefix_flops():
+        fl = 0.0
+
+        def cf(k_, ci, co, hw):
+            return 2.0 * k_ * k_ * ci * co * hw * hw
+        fl += cf(7, 3, 64, 112)
+        cin, hw = 64, 56
+        for si, (co, cm, n) in enumerate(stages):
+            for bi in range(n):
+                stride = 2 if bi == 0 and si > 0 else 1
+                # c1 runs PRE-stride (the stride lives in c2), so its
+                # output is at the block's input resolution
+                fl += cf(1, cin, cm, hw)
+                hw_out = hw // stride
+                fl += cf(3, cm, cm, hw_out) + cf(1, cm, co, hw_out)
+                if bi == 0:
+                    fl += cf(1, cin, co, hw_out)
+                cin, hw = co, hw_out
+        fl += 2.0 * head_c * 1000
+        return 3 * fl * bs     # train ~ 3x forward
+
+    flops = prefix_flops()
     dt = timeit(lambda p, m, a, b: step(p, m, a, b), (params, mom, x, lbl),
                 steps=10, warmup=3)
     tag = (f"raw {layout} train bs={bs} remat={int(remat)} "
-           f"bn={'batch' if bn_batch_stats else 'eval'}")
+           f"bn={'batch' if bn_batch_stats else 'eval'}"
+           + (f" stages<={len(stages)}" if max_stages is not None else ""))
     print(f"{tag}: {dt * 1e3:7.2f} ms  {bs / dt:7.1f} img/s  "
-          f"{100 * flops / dt / PEAK:5.1f}% MFU", flush=True)
+          f"{100 * flops / dt / PEAK:5.1f}% MFU  "
+          f"({flops / 1e9:.0f} GFLOP)", flush=True)
+    return dt
 
 
 if __name__ == "__main__":
@@ -561,5 +599,12 @@ if __name__ == "__main__":
         probe_layout()
     elif mode == "raw":
         probe_raw()
+    elif mode == "stages":
+        # prefix sweep: deltas between consecutive rows localize the
+        # train-step time (fwd+bwd+opt) per ResNet stage
+        times = [probe_raw(max_stages=k) for k in range(5)]
+        for k in range(1, 5):
+            d = (times[k] - times[k - 1]) * 1e3
+            print(f"  stage{k} delta: {d:7.2f} ms", flush=True)
     else:
         probe_fused()
