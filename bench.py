@@ -35,7 +35,7 @@ BASELINE = 363.69  # img/s, reference ResNet-50 train bs=128 on 1x V100
 # a multiply-add as TWO flops, so MFU must use 2x the MAC count or it
 # understates utilization by exactly 2x (round-4 audit: the analytic
 # per-conv sum in scripts/perf_probe.py `stages` mode independently
-# gives 7.75 GFLOP/img fwd).  Training ~ 3x forward.
+# gives 8.178 GFLOP/img fwd = 2 x 4.089 exactly).  Training ~ 3x forward.
 TRAIN_FLOPS_PER_IMG = 3 * 2 * 4.089e9
 PEAK_FLOPS = {  # per-chip bf16 peak, for the MFU estimate
     "v5e": 197e12,

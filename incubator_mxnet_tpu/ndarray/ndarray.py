@@ -397,15 +397,11 @@ class NDArray:
         if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
             shape = tuple(shape[0])
         shape = kwargs.get("shape", shape)
-        # resolve -1 and 0 (reference reshape special codes 0 = copy dim)
-        shape = list(shape)
-        for i, s in enumerate(shape):
-            if s == 0 and i < self.ndim:
-                shape[i] = self.shape[i]
-        if -1 in shape:
-            known = int(onp.prod([s for s in shape if s != -1])) or 1
-            shape[shape.index(-1)] = self.size // known
-        shape = tuple(int(s) for s in shape)
+        # reference Reshape special codes (matrix_op-inl.h:95): 0 copy,
+        # -1 infer, -2 copy-rest, -3 merge, -4 split, reverse=right-to-left
+        from ..ops.shape_ops import infer_reshape
+        shape = infer_reshape(self.shape, shape,
+                              reverse=bool(kwargs.get("reverse", False)))
         if self._grad_live():
             return self._op("reshape", shape=shape)
         if not self._is_view and not _is_tracer(self._chunk.array):

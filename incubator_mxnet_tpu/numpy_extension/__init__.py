@@ -38,6 +38,55 @@ gelu = _op("gelu")
 leaky_relu = _op("leaky_relu")
 
 
+batch_dot = _op("batch_dot")
+scatter_nd = _op("scatter_nd")
+index_add = _op("index_add_nd")
+index_update = _op("index_update_nd")
+
+
+def reshape(a, newshape, reverse=False, order="C"):
+    """npx.reshape with the numpy-extension special codes (reference
+    src/operator/numpy/np_matrix_op.cc:199 `_npx_reshape`: -1 infer,
+    -2 copy dim, -3 skip size-1 dim, -4 copy rest, -5 merge two,
+    -6 split)."""
+    from ..ops.shape_ops import npx_reshape_shape
+    if order != "C":
+        raise NotImplementedError("npx.reshape supports order='C' only")
+    if isinstance(newshape, int):
+        newshape = (newshape,)
+    resolved = npx_reshape_shape(a.shape, newshape, reverse=reverse)
+    return invoke("reshape", a, shape=resolved)
+
+
+def constraint_check(data, msg="Constraint violated"):
+    """Eager all-true assertion (reference `_npx_constraint_check`,
+    src/operator/numpy_extension/npx_constraint_check.cc): raises
+    ``ValueError(msg)`` if any element is falsy, else returns a scalar
+    True array.  Data-dependent by construction, so it runs host-side
+    like the reference's kernel-side CHECK."""
+    import numpy as onp
+    arr = data.asnumpy() if hasattr(data, "asnumpy") else onp.asarray(data)
+    if not bool(arr.all()):
+        raise ValueError(msg)
+    from .. import ndarray as nd
+    return nd.array(onp.asarray(True))
+
+
+def nonzero(a):
+    """Indices of nonzero elements as an (N, ndim) int32 array
+    (reference `_npx_nonzero`, src/operator/numpy/np_nonzero_op.cc,
+    emits int64; JAX runs x64-disabled so int32 is the index dtype
+    here).  Output shape is data-dependent, so this is an eager host op
+    there and here."""
+    import numpy as onp
+    arr = a.asnumpy() if hasattr(a, "asnumpy") else onp.asarray(a)
+    # reference emits int64; JAX runs x64-disabled so int32 is the
+    # widest index dtype here (shapes stay < 2^31 on one chip)
+    idx = onp.transpose(onp.nonzero(arr)).astype(onp.int32)
+    from .. import ndarray as nd
+    return nd.array(idx, dtype="int32")
+
+
 def reshape_like(lhs, rhs):
     return invoke("reshape_like", lhs, rhs)
 

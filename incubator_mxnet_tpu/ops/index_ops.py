@@ -43,10 +43,22 @@ def scatter_nd(data, indices, shape=None):
     return jnp.zeros(shape, data.dtype).at[idx].set(data)
 
 
-@register("index_add_nd", num_inputs=3, aliases=("_scatter_set_nd",))
+@register("index_add_nd", num_inputs=3,
+          aliases=("index_add", "_npx_index_add"))
 def index_add_nd(base, indices, updates):
+    """Coordinate-row scatter-add (reference _npx_index_add,
+    src/operator/contrib/index_add.cc): indices is (K, N) — K leading
+    coordinates for N update sites."""
     idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
     return base.at[idx].add(updates)
+
+
+@register("index_update_nd", num_inputs=3,
+          aliases=("index_update", "_npx_index_update", "_scatter_set_nd"))
+def index_update_nd(base, indices, updates):
+    """Coordinate-row scatter-assign (reference _npx_index_update)."""
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return base.at[idx].set(updates)
 
 
 @register("pick", num_inputs=2)

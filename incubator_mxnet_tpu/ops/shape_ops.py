@@ -10,6 +10,172 @@ def reshape(x, shape=None):
     return jnp.reshape(x, shape)
 
 
+def _resolve_split(d0, d1, d2):
+    """Resolve a split of source dim d0 into (d1, d2) where one of the
+    two may be -1 (shared by the classic -4 and npx -6 reshape codes)."""
+    if d1 == -1 and d2 == -1:
+        raise ValueError("split dims cannot both be -1")
+    if d1 == -1:
+        d1 = d0 // d2
+    if d2 == -1:
+        d2 = d0 // d1
+    if d1 * d2 != d0:
+        raise ValueError(f"split dims {d1}, {d2} do not divide dim {d0}")
+    return d1, d2
+
+
+def infer_reshape(src_shape, target, reverse=False):
+    """Resolve the classic MXNet Reshape special codes against a source
+    shape (reference src/operator/tensor/matrix_op-inl.h:95
+    InferReshapeShape): 0 copy dim, -1 infer one, -2 copy all remaining,
+    -3 merge two consecutive, -4 split one dim into the next two target
+    entries (one may be -1).  ``reverse=True`` applies the codes
+    right-to-left, exactly like the reference (list reversal around the
+    same forward pass).
+
+    One deliberate divergence: the reference stores the *parameter*
+    index of -1 and later writes tmp[that index], which mis-targets when
+    -2/-3/-4 expansions shift positions; here the inferred slot is
+    tracked by its position in the OUTPUT, which is what the docs
+    describe and what every shipped call site expects.
+    """
+    dvec = list(src_shape)
+    pvec = [int(t) for t in target]
+    if reverse:
+        dvec.reverse()
+        pvec.reverse()
+    tmp, src_idx, inf_idx = [], 0, -1
+    i = 0
+    while i < len(pvec):
+        p = pvec[i]
+        if p == 0:
+            if src_idx >= len(dvec):
+                raise ValueError(f"reshape code 0 at {i}: no source dim")
+            tmp.append(dvec[src_idx])
+            src_idx += 1
+        elif p == -1:
+            if inf_idx >= 0:
+                raise ValueError("one and only one dim can be inferred")
+            inf_idx = len(tmp)
+            tmp.append(1)
+            src_idx += 1
+        elif p == -2:
+            tmp.extend(dvec[src_idx:])
+            src_idx = len(dvec)
+        elif p == -3:
+            if src_idx + 1 >= len(dvec):
+                raise ValueError("reshape code -3: needs two source dims")
+            tmp.append(dvec[src_idx] * dvec[src_idx + 1])
+            src_idx += 2
+        elif p == -4:
+            if i + 2 >= len(pvec) or src_idx >= len(dvec):
+                raise ValueError("reshape code -4: needs two target dims")
+            d0 = dvec[src_idx]
+            src_idx += 1
+            d1, d2 = _resolve_split(d0, pvec[i + 1], pvec[i + 2])
+            i += 2
+            tmp.extend([d1, d2])
+        elif p > 0:
+            tmp.append(p)
+            src_idx += 1
+        else:
+            raise ValueError(f"invalid reshape code {p}")
+        i += 1
+    if inf_idx >= 0:
+        total = 1
+        for s in src_shape:
+            total *= s
+        known = 1
+        for s in tmp:
+            known *= s
+        # zero-size arrays: any 0 in the target absorbs the inference
+        # (the flatten-an-empty-batch idiom reshape(0, -1) must not die)
+        tmp[inf_idx] = total // known if known else 0
+    if reverse:
+        tmp.reverse()
+    return tuple(tmp)
+
+
+def npx_reshape_shape(src_shape, newshape, reverse=False):
+    """Resolve the `npx.reshape` special codes (reference
+    src/operator/numpy/np_matrix_op.cc:199 NumpyXReshapeInferShape):
+    -1 infer one, -2 copy dim, -3 skip a size-1 source dim, -4 copy all
+    remaining, -5 merge two consecutive, -6 split one dim into the next
+    two target entries (one may be -1)."""
+    dvec = list(src_shape)
+    pvec = [int(t) for t in newshape]
+    if reverse:
+        dvec.reverse()
+        pvec.reverse()
+    out, src_idx, inf_idx = [], 0, -1
+    known_prod = 1
+    i = 0
+    while i < len(pvec):
+        p = pvec[i]
+        if p == -1:
+            if inf_idx >= 0:
+                raise ValueError("one and only one dim can be inferred")
+            inf_idx = len(out)
+            out.append(-1)
+            src_idx += 1
+        elif p == -2:
+            if src_idx >= len(dvec):
+                raise ValueError("npx reshape -2: no source dim to copy")
+            known_prod *= dvec[src_idx]
+            out.append(dvec[src_idx])
+            src_idx += 1
+        elif p == -3:
+            if src_idx >= len(dvec) or dvec[src_idx] != 1:
+                raise ValueError(
+                    "-3 can only skip a source dim of size 1")
+            src_idx += 1
+        elif p == -4:
+            while src_idx < len(dvec):
+                known_prod *= dvec[src_idx]
+                out.append(dvec[src_idx])
+                src_idx += 1
+        elif p == -5:
+            if src_idx + 1 >= len(dvec):
+                raise ValueError("npx reshape -5: needs two source dims")
+            d = dvec[src_idx] * dvec[src_idx + 1]
+            known_prod *= d
+            out.append(d)
+            src_idx += 2
+        elif p == -6:
+            if i + 2 >= len(pvec) or src_idx >= len(dvec):
+                raise ValueError("npx reshape -6: needs two target dims")
+            d0 = dvec[src_idx]
+            src_idx += 1
+            d1, d2 = _resolve_split(d0, pvec[i + 1], pvec[i + 2])
+            i += 2
+            known_prod *= d0
+            out.extend([d1, d2])
+        elif p >= 0:
+            known_prod *= p
+            out.append(p)
+            src_idx += 1
+        else:
+            raise ValueError(f"invalid npx reshape code {p}")
+        i += 1
+    if inf_idx >= 0:
+        total = 1
+        for s in src_shape:
+            total *= s
+        out[inf_idx] = total // max(known_prod, 1)
+    if reverse:
+        out.reverse()
+    total = 1
+    for s in src_shape:
+        total *= s
+    got = 1
+    for s in out:
+        got *= s
+    if got != total:
+        raise ValueError(
+            f"cannot reshape {tuple(src_shape)} into {tuple(newshape)}")
+    return tuple(out)
+
+
 @register("transpose", num_inputs=1)
 def transpose(x, axes=None):
     return jnp.transpose(x, axes if axes else None)

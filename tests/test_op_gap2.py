@@ -220,3 +220,62 @@ def test_mrcnn_mask_target_shapes_and_weights():
     assert abs(mt_np[0, 2, 3].mean() - frac) < 0.05
     # targets only on the labeled class channel
     assert mt_np[0, 0, [0, 2, 3, 4]].sum() == 0
+
+
+# ---------------------------------------------------------------------------
+# reshape special codes + npx tail
+# ---------------------------------------------------------------------------
+
+def test_reshape_classic_special_codes():
+    """Reference matrix_op-inl.h:95 InferReshapeShape semantics."""
+    x = nd.array(onp.arange(24.0).reshape(2, 3, 4))
+    assert x.reshape(0, -3).shape == (2, 12)
+    assert x.reshape(0, 0, -4, 2, 2).shape == (2, 3, 2, 2)
+    assert x.reshape(-2).shape == (2, 3, 4)
+    assert x.reshape(-3, 0).shape == (6, 4)
+    assert x.reshape(0, -1).shape == (2, 12)
+    # reverse applies codes right-to-left
+    z = nd.array(onp.zeros((10, 5, 4), "f"))
+    assert z.reshape(-1, 0, reverse=True).shape == (50, 4)
+    with pytest.raises(ValueError):
+        x.reshape(-1, -1)
+    with pytest.raises(ValueError):
+        x.reshape(0, -4, 5, 5)  # 5*5 != 3 split
+
+
+def test_npx_reshape_codes():
+    """Reference np_matrix_op.cc:199 NumpyXReshapeInferShape."""
+    import incubator_mxnet_tpu as mx
+    npx = mx.npx
+    a = nd.array(onp.arange(24.0).reshape(1, 2, 3, 4))
+    assert npx.reshape(a, (-3, -2, -2, -2)).shape == (2, 3, 4)
+    assert npx.reshape(a, (-3, -2, -5)).shape == (2, 12)
+    assert npx.reshape(a, (-2, -2, -2, -6, 2, 2)).shape == (1, 2, 3, 2, 2)
+    assert npx.reshape(a, (-1, 4)).shape == (6, 4)
+    assert npx.reshape(a, (-4,)).shape == (1, 2, 3, 4)
+    with pytest.raises(ValueError):
+        npx.reshape(a, (-3, -3, -2, -2))  # second dim is 2, not 1
+    with pytest.raises(ValueError):
+        npx.reshape(a, (5, -1))
+    # reshape result stays numerically identical
+    out = npx.reshape(a, (-3, -2, -5))
+    onp.testing.assert_array_equal(_np(out), _np(a).reshape(2, 12))
+
+
+def test_npx_index_add_update_nonzero_constraint():
+    import incubator_mxnet_tpu as mx
+    npx = mx.npx
+    b = nd.zeros((3, 3))
+    ind = nd.array(onp.array([[0, 2], [1, 1]], "i"))
+    val = nd.array(onp.array([5.0, 7.0], "f"))
+    added = npx.index_add(b, ind, val)
+    assert float(_np(added)[0, 1]) == 5.0 and float(_np(added)[2, 1]) == 7.0
+    setv = npx.index_update(b, ind, val)
+    assert float(_np(setv)[0, 1]) == 5.0
+    c = nd.array(onp.array([[1.0, 0.0], [0.0, 3.0]]))
+    assert _np(npx.nonzero(c)).tolist() == [[0, 0], [1, 1]]
+    with pytest.raises(ValueError, match="bad"):
+        npx.constraint_check(nd.array([1.0, 0.0]), "bad")
+    assert bool(_np(npx.constraint_check(nd.array([1.0, 1.0]))))
+    assert npx.batch_dot(nd.array(onp.ones((2, 3, 4), "f")),
+                         nd.array(onp.ones((2, 4, 5), "f"))).shape == (2, 3, 5)
