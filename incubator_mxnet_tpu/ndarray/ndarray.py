@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from ..base import dtype_from_any, integer_types, numeric_types
 from ..context import Context, current_context
 from .. import engine as _engine_mod
+from .. import profiler as _profiler
 
 __all__ = ["NDArray", "_wrap_outputs", "_to_jax"]
 
@@ -84,6 +85,15 @@ class _Chunk:
         self.array = array
         self.ctx = ctx
         self.var = _engine_mod.get_engine().new_variable("ndarray")
+        if _profiler._alloc_tracking and not _is_tracer(array):
+            # storage-profiler hook (reference storage_profiler.cc):
+            # tag this chunk's bytes with the active profiler scope
+            try:
+                _profiler.record_alloc(
+                    array.size * array.dtype.itemsize, array.shape,
+                    array.dtype, ctx)
+            except Exception:
+                pass
 
     def write(self, new_array):
         self.array = new_array

@@ -81,19 +81,42 @@ def infer_reshape(src_shape, target, reverse=False):
         else:
             raise ValueError(f"invalid reshape code {p}")
         i += 1
-    if inf_idx >= 0:
-        total = 1
-        for s in src_shape:
-            total *= s
-        known = 1
-        for s in tmp:
-            known *= s
-        # zero-size arrays: any 0 in the target absorbs the inference
-        # (the flatten-an-empty-batch idiom reshape(0, -1) must not die)
-        tmp[inf_idx] = total // known if known else 0
+    tmp = _finish_infer(src_shape, target, tmp, inf_idx)
     if reverse:
         tmp.reverse()
     return tuple(tmp)
+
+
+def _finish_infer(src_shape, target, out, inf_idx):
+    """Resolve a pending -1 against the source size and validate the
+    total.  Zero-size arrays infer over the NON-zero dims (numpy can't:
+    flatten-an-empty-batch reshape(0, -1) on (0, 5) must give (0, 5),
+    not die or collapse to (0, 0))."""
+    def prod_nonzero(dims):
+        p = 1
+        for s in dims:
+            if s != 0:
+                p *= s
+        return p
+
+    nz_total = prod_nonzero(src_shape)
+    if inf_idx >= 0:
+        nz_known = prod_nonzero(out)   # the -1 slot holds placeholder 1
+        if nz_total % nz_known:
+            raise ValueError(
+                f"cannot infer dim: {tuple(src_shape)} -> {tuple(target)}")
+        out[inf_idx] = nz_total // nz_known
+    total = 1
+    for s in src_shape:
+        total *= s
+    got = 1
+    for s in out:
+        got *= s
+    if got != total:
+        raise ValueError(
+            f"cannot reshape {tuple(src_shape)} into {tuple(target)} "
+            f"(resolved {tuple(out)}: {got} != {total} elements)")
+    return out
 
 
 def npx_reshape_shape(src_shape, newshape, reverse=False):
@@ -108,7 +131,6 @@ def npx_reshape_shape(src_shape, newshape, reverse=False):
         dvec.reverse()
         pvec.reverse()
     out, src_idx, inf_idx = [], 0, -1
-    known_prod = 1
     i = 0
     while i < len(pvec):
         p = pvec[i]
@@ -116,12 +138,11 @@ def npx_reshape_shape(src_shape, newshape, reverse=False):
             if inf_idx >= 0:
                 raise ValueError("one and only one dim can be inferred")
             inf_idx = len(out)
-            out.append(-1)
+            out.append(1)
             src_idx += 1
         elif p == -2:
             if src_idx >= len(dvec):
                 raise ValueError("npx reshape -2: no source dim to copy")
-            known_prod *= dvec[src_idx]
             out.append(dvec[src_idx])
             src_idx += 1
         elif p == -3:
@@ -131,15 +152,12 @@ def npx_reshape_shape(src_shape, newshape, reverse=False):
             src_idx += 1
         elif p == -4:
             while src_idx < len(dvec):
-                known_prod *= dvec[src_idx]
                 out.append(dvec[src_idx])
                 src_idx += 1
         elif p == -5:
             if src_idx + 1 >= len(dvec):
                 raise ValueError("npx reshape -5: needs two source dims")
-            d = dvec[src_idx] * dvec[src_idx + 1]
-            known_prod *= d
-            out.append(d)
+            out.append(dvec[src_idx] * dvec[src_idx + 1])
             src_idx += 2
         elif p == -6:
             if i + 2 >= len(pvec) or src_idx >= len(dvec):
@@ -148,31 +166,16 @@ def npx_reshape_shape(src_shape, newshape, reverse=False):
             src_idx += 1
             d1, d2 = _resolve_split(d0, pvec[i + 1], pvec[i + 2])
             i += 2
-            known_prod *= d0
             out.extend([d1, d2])
         elif p >= 0:
-            known_prod *= p
             out.append(p)
             src_idx += 1
         else:
             raise ValueError(f"invalid npx reshape code {p}")
         i += 1
-    if inf_idx >= 0:
-        total = 1
-        for s in src_shape:
-            total *= s
-        out[inf_idx] = total // max(known_prod, 1)
+    out = _finish_infer(src_shape, newshape, out, inf_idx)
     if reverse:
         out.reverse()
-    total = 1
-    for s in src_shape:
-        total *= s
-    got = 1
-    for s in out:
-        got *= s
-    if got != total:
-        raise ValueError(
-            f"cannot reshape {tuple(src_shape)} into {tuple(newshape)}")
     return tuple(out)
 
 

@@ -17,7 +17,8 @@ import time
 import jax
 
 __all__ = ["set_config", "set_state", "start", "stop", "dump", "dumps",
-           "pause", "resume", "Task", "Frame", "Counter", "Marker", "scope"]
+           "pause", "resume", "Task", "Frame", "Counter", "Marker", "scope",
+           "dump_memory_allocations"]
 
 _config = {
     "filename": "profile.json",
@@ -57,16 +58,73 @@ def start(profile_process="worker"):
             _state["xprof_active"] = False
     if _config.get("profile_memory"):
         _start_memory_sampler()
+        global _alloc_tracking
+        _alloc_tracking = True
+        _state["alloc_session"] = True
+        with _events_lock:
+            _alloc_records.clear()   # each session starts fresh
 
 
 def stop(profile_process="worker"):
+    global _alloc_tracking
     _state["running"] = False
+    _alloc_tracking = False
+    _state["alloc_session"] = False
     _stop_memory_sampler()
     if _state.get("xprof_active"):
         try:
             jax.profiler.stop_trace()
         finally:
             _state["xprof_active"] = False
+
+
+# -- per-allocation attribution (reference storage_profiler.cc
+#    GpuMemoryProfiler: allocations tagged with the active profiler
+#    scope and dumped as CSV) --
+
+_scope_stack = threading.local()
+_alloc_tracking = False          # checked inline by _Chunk.__init__
+_alloc_records: list[tuple] = []
+_ALLOC_CAP = 200_000             # hard cap: profiling must not OOM the host
+
+
+def _current_scope_name():
+    stack = getattr(_scope_stack, "names", None)
+    return ":".join(stack) if stack else "<unk>"
+
+
+def record_alloc(nbytes, shape, dtype, device):
+    """Called from NDArray chunk creation while allocation tracking is
+    on (reference storage_profiler.cc:OnAlloc)."""
+    if len(_alloc_records) >= _ALLOC_CAP:
+        return
+    with _events_lock:
+        _alloc_records.append((_current_scope_name(), int(nbytes),
+                               tuple(shape), str(dtype), str(device)))
+
+
+def dump_memory_allocations(path=None, reset=False):
+    """CSV of recorded allocations, one row per chunk, grouped totals at
+    the end (the reference's gpu_memory_profile.csv role).  Returns the
+    CSV text; writes it to ``path`` when given."""
+    with _events_lock:
+        records = list(_alloc_records)
+        if reset:
+            _alloc_records.clear()
+    lines = ["Attribute name,Requested size,Shape,Dtype,Device"]
+    totals: dict[str, int] = {}
+    for name, nbytes, shape, dtype, dev in records:
+        lines.append(f"\"{name}\",{nbytes},\"{shape}\",{dtype},{dev}")
+        totals[name] = totals.get(name, 0) + nbytes
+    lines.append("")
+    lines.append("Scope,Total bytes")
+    for name, tot in sorted(totals.items(), key=lambda kv: -kv[1]):
+        lines.append(f"\"{name}\",{tot}")
+    text = "\n".join(lines) + "\n"
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
 
 
 # -- device/host memory counters (reference storage_profiler.cc +
@@ -136,11 +194,15 @@ def _stop_memory_sampler():
 
 
 def pause(profile_process="worker"):
+    global _alloc_tracking
     _state["running"] = False
+    _alloc_tracking = False   # allocations are suppressed while paused
 
 
 def resume(profile_process="worker"):
+    global _alloc_tracking
     _state["running"] = True
+    _alloc_tracking = bool(_state.get("alloc_session"))
 
 
 def is_running():
@@ -169,6 +231,10 @@ class scope:
 
     def __enter__(self):
         self._t0 = time.perf_counter_ns()
+        stack = getattr(_scope_stack, "names", None)
+        if stack is None:
+            stack = _scope_stack.names = []
+        stack.append(self.name)
         try:
             self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
             self._jax_ctx.__enter__()
@@ -177,6 +243,9 @@ class scope:
         return self
 
     def __exit__(self, *exc):
+        stack = getattr(_scope_stack, "names", None)
+        if stack:
+            stack.pop()
         if self._jax_ctx is not None:
             self._jax_ctx.__exit__(*exc)
         if _state["running"]:

@@ -78,3 +78,27 @@ def test_device_memory_profile_shape():
     assert isinstance(prof, dict)  # may be empty on hosts without stats
     for dev, st in prof.items():
         assert "bytes_in_use" in st
+
+
+def test_memory_allocation_attribution():
+    """Per-allocation scope tagging (reference storage_profiler.cc
+    GpuMemoryProfiler CSV role)."""
+    from incubator_mxnet_tpu import nd, profiler
+    profiler.set_config(profile_memory=True)
+    profiler.start()
+    try:
+        with profiler.scope("alloc_test_init"):
+            a = nd.ones((64, 64))
+        with profiler.scope("alloc_test_fwd"):
+            with profiler.scope("inner"):
+                (a * 2 + 1).wait_to_read()
+    finally:
+        profiler.stop()
+    csv = profiler.dump_memory_allocations(reset=True)
+    assert '"alloc_test_init",16384' in csv
+    assert "alloc_test_fwd:inner" in csv  # nested scope join
+    assert "Scope,Total bytes" in csv
+    # tracking is off after stop(): no new rows
+    b = nd.ones((8, 8))
+    b.wait_to_read()
+    assert "(8, 8)" not in profiler.dump_memory_allocations(reset=True)
