@@ -6,10 +6,13 @@ from .resnet import (ResNetV1, ResNetV2, resnet18_v1, resnet34_v1,
 from .alexnet import AlexNet, alexnet
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19, vgg11_bn, vgg13_bn, \
     vgg16_bn, vgg19_bn, get_vgg
-from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
-from .densenet import DenseNet, densenet121, densenet161, densenet169, \
+from .squeezenet import SqueezeNet, get_squeezenet, squeezenet1_0, \
+    squeezenet1_1
+from .densenet import DenseNet, get_densenet, densenet121, \
+    densenet161, densenet169, \
     densenet201
-from .mobilenet import MobileNet, MobileNetV2, mobilenet1_0, mobilenet0_75, \
+from .mobilenet import MobileNet, MobileNetV2, get_mobilenet, \
+    get_mobilenet_v2, mobilenet1_0, mobilenet0_75, \
     mobilenet0_5, mobilenet0_25, mobilenet_v2_1_0, mobilenet_v2_0_75, \
     mobilenet_v2_0_5, mobilenet_v2_0_25
 from .inception import Inception3, inception_v3

@@ -3,7 +3,8 @@ from ... import nn
 from ...block import HybridBlock
 from ....ops.registry import invoke
 
-__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+__all__ = ["DenseNet", "get_densenet",
+           "densenet121", "densenet161", "densenet169",
            "densenet201"]
 
 
@@ -71,9 +72,16 @@ densenet_spec = {
 }
 
 
-def _get(num_layers, **kwargs):
+def get_densenet(num_layers, pretrained=False, ctx=None, **kwargs):
+    """Factory by depth (reference model_zoo/vision/densenet.py
+    get_densenet)."""
+    if pretrained:
+        raise RuntimeError("no pretrained weights in zero-egress environment")
     num_init, growth, config = densenet_spec[num_layers]
     return DenseNet(num_init, growth, config, **kwargs)
+
+
+_get = get_densenet
 
 
 def densenet121(**kwargs):

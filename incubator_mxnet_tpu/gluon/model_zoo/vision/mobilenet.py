@@ -2,7 +2,8 @@
 from ... import nn
 from ...block import HybridBlock
 
-__all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",
+__all__ = ["MobileNet", "MobileNetV2", "get_mobilenet",
+           "get_mobilenet_v2", "mobilenet1_0", "mobilenet0_75",
            "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0",
            "mobilenet_v2_0_75", "mobilenet_v2_0_5", "mobilenet_v2_0_25"]
 
@@ -85,6 +86,20 @@ class MobileNetV2(HybridBlock):
 
     def forward(self, x):
         return self.output(self.features(x))
+
+
+def get_mobilenet(multiplier, pretrained=False, ctx=None, **kwargs):
+    """Factory by width multiplier (reference mobilenet.py get_mobilenet)."""
+    if pretrained:
+        raise RuntimeError("no pretrained weights in zero-egress environment")
+    return MobileNet(multiplier, **kwargs)
+
+
+def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, **kwargs):
+    """Factory by width multiplier (reference mobilenet.py get_mobilenet_v2)."""
+    if pretrained:
+        raise RuntimeError("no pretrained weights in zero-egress environment")
+    return MobileNetV2(multiplier, **kwargs)
 
 
 def mobilenet1_0(**kwargs):

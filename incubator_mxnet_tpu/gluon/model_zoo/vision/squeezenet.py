@@ -3,7 +3,8 @@ from ... import nn
 from ...block import HybridBlock
 from ....ops.registry import invoke
 
-__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+__all__ = ["SqueezeNet", "get_squeezenet", "squeezenet1_0",
+           "squeezenet1_1"]
 
 
 class _Fire(HybridBlock):
@@ -59,6 +60,13 @@ class SqueezeNet(HybridBlock):
 
     def forward(self, x):
         return self.output(self.features(x))
+
+
+def get_squeezenet(version, pretrained=False, ctx=None, **kwargs):
+    """Factory by version string (reference squeezenet.py get_squeezenet)."""
+    if pretrained:
+        raise RuntimeError("no pretrained weights in zero-egress environment")
+    return SqueezeNet(version, **kwargs)
 
 
 def squeezenet1_0(**kwargs):

@@ -858,3 +858,184 @@ def modulated_deformable_convolution(data, offset, mask, weight, bias=None,
     return _deform_conv_impl(data, offset, weight, bias, kernel, stride,
                              dilate, pad, num_filter, num_group,
                              num_deformable_group, no_bias, mask=mask)
+
+
+# ---------------------------------------------------------------------------
+# Rotated ROI align (reference src/operator/contrib/rroi_align.cc) and
+# contrib tail: BatchNormWithReLU, SparseEmbedding, DGL graph ops
+# ---------------------------------------------------------------------------
+
+@register("_contrib_RROIAlign", aliases=("rroi_align",),
+          differentiable=False, num_inputs=2)
+def rroi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+               sampling_ratio=-1):
+    """Rotated ROI align (reference rroi_align.cc): rois are
+    (batch_idx, cx, cy, w, h, theta_degrees); the bin sample grid is
+    rotated by theta about the roi center before bilinear lookup.
+    Average-pooled over a sampling_ratio x sampling_ratio grid per bin
+    (fixed grid: a data-dependent ceil() grid would break static
+    shapes; the reference's sampling_ratio>0 path is the one kept)."""
+    ph, pw = pooled_size
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    _, c, h, w = data.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        cw = roi[1] * spatial_scale
+        ch = roi[2] * spatial_scale
+        rw = jnp.maximum(roi[3] * spatial_scale, 1.0)
+        rh = jnp.maximum(roi[4] * spatial_scale, 1.0)
+        theta = roi[5] * jnp.pi / 180.0
+        cos_t, sin_t = jnp.cos(theta), jnp.sin(theta)
+        bin_h, bin_w = rh / ph, rw / pw
+        iy = jnp.arange(ph, dtype=jnp.float32)
+        ix = jnp.arange(pw, dtype=jnp.float32)
+        sy = (jnp.arange(sr, dtype=jnp.float32) + 0.5) / sr
+        # grid points relative to the roi center, then rotated
+        yy = (-rh / 2.0 + (iy[:, None] + sy[None, :]) * bin_h).reshape(-1)
+        xx = (-rw / 2.0 + (ix[:, None] + sy[None, :]) * bin_w).reshape(-1)
+        gy = yy[:, None]
+        gx = xx[None, :]
+        x = gx * cos_t + gy * sin_t + cw           # (ph·sr, pw·sr)
+        y = gy * cos_t - gx * sin_t + ch
+        oob = (y < -1.0) | (y > h) | (x < -1.0) | (x > w)
+        xc = jnp.clip(x, 0.0, w - 1.0)
+        yc = jnp.clip(y, 0.0, h - 1.0)
+        x0 = jnp.floor(xc).astype(jnp.int32)
+        y0 = jnp.floor(yc).astype(jnp.int32)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        wx = xc - x0
+        wy = yc - y0
+        img = data[b]                               # (C, H, W)
+        v = (img[:, y0, x0] * (1 - wy) * (1 - wx)
+             + img[:, y0, x1] * (1 - wy) * wx
+             + img[:, y1, x0] * wy * (1 - wx)
+             + img[:, y1, x1] * wy * wx)            # (C, ph·sr, pw·sr)
+        v = jnp.where(oob[None], 0.0, v)
+        return jnp.mean(v.reshape(c, ph, sr, pw, sr), axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))
+
+
+@register("_contrib_BatchNormWithReLU", aliases=("batch_norm_with_relu",),
+          num_inputs=5)
+def batch_norm_with_relu(x, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                         momentum=0.9, fix_gamma=True,
+                         use_global_stats=False, axis=1, training=False):
+    """Fused BN+ReLU at op level (reference contrib/batch_norm_relu.cc;
+    the gluon layer BatchNormReLU already exists) — XLA fuses the relu
+    into the BN epilogue, so this is API parity, not a new kernel."""
+    from .nn_ops import batch_norm
+    out = batch_norm(x, gamma, beta, moving_mean, moving_var, eps=eps,
+                     momentum=momentum, fix_gamma=fix_gamma,
+                     use_global_stats=use_global_stats, axis=axis,
+                     training=training)
+    if isinstance(out, tuple):
+        return (jnp.maximum(out[0], 0),) + out[1:]
+    return jnp.maximum(out, 0)
+
+
+@register("_contrib_SparseEmbedding", aliases=("sparse_embedding",),
+          num_inputs=2)
+def sparse_embedding(data, weight, input_dim=None, output_dim=None):
+    """Embedding whose reference version emits a row_sparse gradient
+    (src/operator/tensor/indexing_op.cc SparseEmbedding).  TPU design:
+    the gather is identical to Embedding; the gradient is a dense
+    scatter-add, which XLA lowers to the same row-update pattern the
+    row_sparse grad encoded (SURVEY.md §7 'Sparse storage' dense
+    fallback).  Use sparse_adagrad_update / AdaGrad(lazy) to keep the
+    row-wise optimizer semantics."""
+    # same gather (incl. OOB clip) as the fp Embedding op
+    return jnp.take(weight, jnp.asarray(data, jnp.int32), axis=0,
+                    mode="clip")
+
+
+@register("_contrib_edge_id", aliases=("edge_id",), num_inputs=5,
+          differentiable=False, jittable=False)
+def edge_id(data, indptr, indices, u, v):
+    """DGL edge-id lookup on a CSR adjacency (reference
+    src/operator/contrib/dgl_graph.cc:1280 EdgeIDForwardCsrImpl):
+    out[k] = data[pos] where pos is the CSR slot of edge (u[k], v[k]),
+    or -1 when absent.  Host-side eager (row degree is data-dependent),
+    like the reference's CPU kernel."""
+    import numpy as onp
+    data = onp.asarray(data)
+    indptr = onp.asarray(indptr)
+    indices = onp.asarray(indices)
+    u = onp.asarray(u).astype(onp.int64)
+    v = onp.asarray(v).astype(onp.int64)
+    out = onp.full(u.shape, -1.0, onp.asarray(data).dtype)
+    for k in range(u.size):
+        lo, hi = indptr[u[k]], indptr[u[k] + 1]
+        row = indices[lo:hi]
+        hits = onp.nonzero(row == v[k])[0]
+        if hits.size:
+            out[k] = data[lo + hits[0]]
+    return out
+
+
+@register("_contrib_getnnz", aliases=("getnnz",), num_inputs=2,
+          differentiable=False, jittable=False)
+def getnnz(indptr, indices, axis=None, n_cols=None):
+    """Stored-value counts of a CSR matrix (reference
+    src/operator/contrib/nnz.cc): axis=None -> total nnz, axis=0 ->
+    per-column counts (needs n_cols), axis=1 -> per-row counts."""
+    import numpy as onp
+    indptr = onp.asarray(indptr)
+    indices = onp.asarray(indices)
+    if axis is None:
+        return onp.int64(indptr[-1])
+    if axis == 1:
+        return (indptr[1:] - indptr[:-1]).astype(onp.int64)
+    if axis == 0:
+        if n_cols is None:
+            # the CSR triplets don't carry the column count; guessing
+            # from indices.max() under-counts trailing empty columns
+            raise ValueError("getnnz(axis=0) requires n_cols")
+        out = onp.zeros(int(n_cols), onp.int64)
+        onp.add.at(out, indices.astype(onp.int64), 1)
+        return out
+    raise ValueError(f"axis must be None, 0 or 1; got {axis}")
+
+
+@register("_contrib_dgl_adjacency", aliases=("dgl_adjacency",),
+          num_inputs=2, differentiable=False, jittable=False)
+def dgl_adjacency(indptr, indices):
+    """CSR graph -> adjacency CSR whose data is all-ones float32
+    (reference dgl_graph.cc DGLAdjacency: converts edge-id CSR to a
+    connectivity matrix)."""
+    import numpy as onp
+    return onp.ones(onp.asarray(indices).shape, onp.float32)
+
+
+@register("_contrib_dgl_subgraph", aliases=("dgl_subgraph",),
+          differentiable=False, jittable=False)
+def dgl_subgraph(data, indptr, indices, vids, return_mapping=False):
+    """Vertex-induced subgraph of a CSR graph (reference dgl_graph.cc
+    DGLSubgraph): keep only edges whose endpoints are both in ``vids``;
+    vertices are renumbered by their position in vids.  Returns the
+    subgraph CSR triplets (+ the edge-id mapping when asked).  Eager
+    host op — output nnz is data-dependent."""
+    import numpy as onp
+    data = onp.asarray(data)
+    indptr = onp.asarray(indptr)
+    indices = onp.asarray(indices)
+    vids = onp.asarray(vids).astype(onp.int64)
+    remap = {int(v): i for i, v in enumerate(vids)}
+    new_data, new_indices, new_indptr, mapping = [], [], [0], []
+    for new_u, u in enumerate(vids):
+        lo, hi = int(indptr[u]), int(indptr[u + 1])
+        for pos in range(lo, hi):
+            nv = remap.get(int(indices[pos]))
+            if nv is not None:
+                new_indices.append(nv)
+                new_data.append(len(new_data) + 1)  # re-numbered edge id
+                mapping.append(data[pos])
+        new_indptr.append(len(new_indices))
+    out = (onp.asarray(new_data, onp.float32),
+           onp.asarray(new_indptr, onp.int64),
+           onp.asarray(new_indices, onp.int64))
+    if return_mapping:
+        return out + (onp.asarray(mapping, onp.float32),)
+    return out

@@ -192,3 +192,140 @@ def quantized_elemwise_add(a, b, a_min, a_max, b_min, b_max):
            + b.astype(jnp.int32) * jnp.round(b_scale / out_scale * 64).astype(jnp.int32))
     out_max = out_scale * 127.0 * 64 * 2
     return acc, -out_max, out_max
+
+
+@register("_contrib_quantized_act", aliases=("quantized_act",),
+          differentiable=False)
+def quantized_act(data, min_data, max_data, act_type="relu"):
+    """int8 activation (reference quantized_activation.cc): relu clips
+    the negative codes; the float range clips at 0 accordingly."""
+    if act_type != "relu":
+        raise ValueError("quantized_act supports act_type='relu' only "
+                         "(reference quantized_activation.cc)")
+    out = jnp.maximum(data, 0).astype(data.dtype)
+    # the range passes through unchanged (reference quantized_activation
+    # min/max passthrough): the codes' scale is amax-symmetric, so
+    # narrowing the range here would silently rescale every value
+    return out, min_data, max_data
+
+
+@register("_contrib_quantized_flatten", aliases=("quantized_flatten",),
+          differentiable=False)
+def quantized_flatten(data, min_data, max_data):
+    """Shape-only: codes pass through (reference quantized_flatten.cc)."""
+    return data.reshape(data.shape[0], -1), min_data, max_data
+
+
+@register("_contrib_quantized_elemwise_mul",
+          aliases=("quantized_elemwise_mul",), differentiable=False)
+def quantized_elemwise_mul(a, b, a_min, a_max, b_min, b_max):
+    """int8 * int8 -> int32 with multiplied scales (reference
+    quantized_elemwise_mul.cc)."""
+    acc = a.astype(jnp.int32) * b.astype(jnp.int32)
+    a_amax = jnp.maximum(jnp.abs(a_min), jnp.abs(a_max))
+    b_amax = jnp.maximum(jnp.abs(b_min), jnp.abs(b_max))
+    # int32 codes span +-127*127; float range is the product of amaxes
+    out_max = a_amax * b_amax
+    return acc, -out_max, out_max
+
+
+@register("_contrib_quantized_embedding", aliases=("quantized_embedding",),
+          differentiable=False)
+def quantized_embedding(data, weight, min_weight, max_weight,
+                        input_dim=None, output_dim=None):
+    """int8 embedding gather (reference quantized_indexing_op.cc):
+    row lookup keeps the codes and the weight's float range."""
+    idx = jnp.asarray(data, jnp.int32)
+    # same OOB semantics as the fp Embedding op (index_ops.py: clip)
+    return jnp.take(weight, idx, axis=0, mode="clip"), \
+        min_weight, max_weight
+
+
+@register("_contrib_quantized_batch_norm", aliases=("quantized_batch_norm",),
+          differentiable=False)
+def quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                         min_data, max_data, eps=1e-3):
+    """int8 BatchNorm (reference quantized_batch_norm.cc): folds the
+    affine normalization into a rescale of the int8 codes — dequantize,
+    normalize with the MOVING stats (inference-only op), requantize to
+    the output range computed from the folded affine."""
+    amax = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data))
+    scale_in = amax / 127.0
+    rstd = lax.rsqrt(moving_var.astype(jnp.float32) + eps)
+    w = gamma.astype(jnp.float32) * rstd
+    b = (beta.astype(jnp.float32)
+         - moving_mean.astype(jnp.float32) * w)
+    # per-channel float output of code c in channel k:
+    #   y = (c * scale_in) * w[k] + b[k]
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    y = (data.astype(jnp.float32) * scale_in) * w.reshape(bshape) \
+        + b.reshape(bshape)
+    out_amax = jnp.max(jnp.abs(y))
+    q = jnp.clip(jnp.round(y / jnp.maximum(out_amax, 1e-12) * 127.0),
+                 -127, 127).astype(jnp.int8)
+    return q, -out_amax, out_amax
+
+
+@register("_contrib_calibrate_entropy", aliases=("calibrate_entropy",),
+          num_inputs=2, differentiable=False, jittable=False)
+def calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
+    """KL-optimal threshold from a symmetric activation histogram
+    (reference src/operator/quantization/calibrate.cc
+    `_contrib_calibrate_entropy`).  Host-side eager op, like the
+    reference's CPU-only kernel.  Returns (threshold, divergence).
+    """
+    import numpy as onp
+    hist = onp.asarray(hist, onp.float64).ravel()
+    edges = onp.asarray(hist_edges, onp.float64).ravel()
+    num_bins = hist.size
+    if edges.size != num_bins + 1:
+        raise ValueError("hist_edges must have len(hist)+1 entries")
+    if num_bins % 2 == 0:
+        raise ValueError("calibrate_entropy needs an odd, zero-centered "
+                         "bin count (reference calibrate.cc layout)")
+    zero = num_bins // 2
+    half_q = num_quantized_bins // 2
+    best_div, best_t = onp.inf, float(edges[-1])
+    for i in range(half_q, zero + 1):
+        start, stop = zero - i, zero + i + 1
+        t = float(edges[stop])
+        raw = hist[start:stop]          # unfolded slice -> q
+        p = raw.copy()                  # p folds the clipped tail mass in
+        p[0] += hist[:start + 1].sum() - hist[start]
+        p[-1] += hist[stop - 1:].sum() - hist[stop - 1]
+        if p.sum() == 0:
+            continue
+        # q quantizes the UNFOLDED slice (reference calibrate.cc builds
+        # q from sliced_nd_hist, not from p) — the tail mass present in
+        # p but missing from q is what penalizes small thresholds
+        n = p.size
+        factor = n / num_quantized_bins
+        idx = onp.minimum((onp.arange(n) / factor).astype(onp.int64),
+                          num_quantized_bins - 1)
+        q_small = onp.zeros(num_quantized_bins)
+        onp.add.at(q_small, idx, raw)
+        counts = onp.zeros(num_quantized_bins)
+        onp.add.at(counts, idx, (raw > 0).astype(onp.float64))
+        nzmask = counts[idx] > 0
+        q = onp.zeros(n)
+        q[nzmask] = (q_small[idx] / onp.maximum(counts[idx], 1.0))[nzmask]
+
+        def _smooth(d, eps=1e-4):
+            zeros = d == 0
+            nz = (~zeros).sum()
+            if nz == 0:
+                return None
+            eps1 = eps * zeros.sum() / nz
+            if eps1 >= 1.0:
+                return None
+            return d + eps * zeros - eps1 * (~zeros)
+
+        ps, qs = _smooth(p), _smooth(q)
+        if ps is None or qs is None:
+            continue
+        ps, qs = ps / ps.sum(), qs / qs.sum()
+        div = float(onp.sum(ps * onp.log(ps / qs)))
+        if div < best_div:
+            best_div, best_t = div, t
+    return (onp.float32(best_t),
+            onp.float32(best_div if onp.isfinite(best_div) else 0.0))

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Watch for the axon tunnel to recover, then drain the chip queues.
+# Probes every PROBE_INTERVAL seconds; on a live chip runs chip_queue.sh
+# (resumable — retries consist/opperf/int8 failures) then chip_queue2.sh
+# (stage localization).  Exits when both queues complete cleanly.
+set -u
+cd "$(dirname "$0")/.."
+interval="${PROBE_INTERVAL:-600}"
+while true; do
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()[0]; assert d.platform != 'cpu'
+x = jax.device_put(jnp.ones((256,256), jnp.bfloat16), d)
+float((x@x).sum())" >/dev/null 2>&1; then
+    echo "[watch] $(date -u +%H:%M:%S) chip ALIVE — draining queues"
+    bash scripts/chip_queue.sh
+    bash scripts/chip_queue2.sh
+    if ! grep -l "QUEUE_FAILED" artifacts/r4/*.txt >/dev/null 2>&1; then
+      echo "[watch] all queue artifacts clean — done"; exit 0
+    fi
+    echo "[watch] some jobs still failed; will retry next probe"
+  else
+    echo "[watch] $(date -u +%H:%M:%S) chip wedged; sleeping ${interval}s"
+  fi
+  sleep "$interval"
+done

@@ -1,0 +1,141 @@
+"""Contrib/quantization op-tail round 2 (reference rroi_align.cc,
+batch_norm_relu, indexing_op.cc SparseEmbedding, dgl_graph.cc,
+quantized_activation/flatten/elemwise_mul/embedding/batch_norm.cc,
+calibrate.cc)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def _np(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def test_rroi_align_axis_aligned_matches_quadrant_means():
+    data = nd.array(onp.arange(64, dtype="f").reshape(1, 1, 8, 8))
+    rois = nd.array(onp.array([[0, 3.5, 3.5, 8, 8, 0.0]], "f"))
+    out = nd.rroi_align(data, rois, pooled_size=(2, 2), sampling_ratio=2)
+    img = _np(data)[0, 0]
+    expect = onp.array([[img[:4, :4].mean(), img[:4, 4:].mean()],
+                        [img[4:, :4].mean(), img[4:, 4:].mean()]])
+    onp.testing.assert_allclose(_np(out)[0, 0], expect, atol=0.75)
+
+
+def test_rroi_align_rotation_180_flips():
+    data = nd.array(onp.arange(64, dtype="f").reshape(1, 1, 8, 8))
+    r0 = nd.array(onp.array([[0, 3.5, 3.5, 6, 4, 0.0]], "f"))
+    r180 = nd.array(onp.array([[0, 3.5, 3.5, 6, 4, 180.0]], "f"))
+    a = _np(nd.rroi_align(data, r0, pooled_size=(2, 2)))[0, 0]
+    b = _np(nd.rroi_align(data, r180, pooled_size=(2, 2)))[0, 0]
+    onp.testing.assert_allclose(b, a[::-1, ::-1], atol=1e-3)
+
+
+def test_batch_norm_with_relu_clips():
+    x = nd.array(onp.random.RandomState(0).randn(2, 3, 4, 4).astype("f"))
+    ones = nd.array(onp.ones(3, "f"))
+    zeros = nd.array(onp.zeros(3, "f"))
+    y = nd.batch_norm_with_relu(x, ones, zeros, zeros, ones)
+    assert float(_np(y).min()) >= 0
+    onp.testing.assert_allclose(_np(y), onp.maximum(_np(x), 0), rtol=2e-3,
+                                atol=2e-3)
+
+
+def test_sparse_embedding_gather_and_grad():
+    from incubator_mxnet_tpu import autograd
+    w = nd.array(onp.random.RandomState(1).randn(10, 4).astype("f"))
+    w.attach_grad()
+    idx = nd.array(onp.array([1, 9, 1], "i"))
+    with autograd.record():
+        e = nd.sparse_embedding(idx, w)
+        loss = e.sum()
+    loss.backward()
+    g = _np(w.grad)
+    assert g[1].sum() == pytest.approx(8.0)   # row 1 hit twice
+    assert g[9].sum() == pytest.approx(4.0)
+    assert g[0].sum() == 0
+
+
+def test_dgl_graph_ops():
+    # 0->1 (edge id 0), 0->2 (1), 2->1 (2)
+    indptr = nd.array(onp.array([0, 2, 2, 3], "i"))
+    indices = nd.array(onp.array([1, 2, 1], "i"))
+    edata = nd.array(onp.array([0.0, 1.0, 2.0], "f"))
+    eid = nd.edge_id(edata, indptr, indices,
+                     nd.array(onp.array([0, 0, 1, 2], "i")),
+                     nd.array(onp.array([2, 1, 0, 1], "i")))
+    onp.testing.assert_array_equal(_np(eid), [1, 0, -1, 2])
+    assert int(_np(nd.getnnz(indptr, indices))) == 3
+    onp.testing.assert_array_equal(_np(nd.getnnz(indptr, indices, axis=1)),
+                                   [2, 0, 1])
+    onp.testing.assert_array_equal(
+        _np(nd.getnnz(indptr, indices, axis=0, n_cols=3)), [0, 2, 1])
+    assert (_np(nd.dgl_adjacency(indptr, indices)) == 1).all()
+    sub = nd.dgl_subgraph(edata, indptr, indices,
+                          nd.array(onp.array([0, 1], "i")),
+                          return_mapping=True)
+    onp.testing.assert_array_equal(_np(sub[1]), [0, 1, 1])  # indptr
+    onp.testing.assert_array_equal(_np(sub[2]), [1])        # 0->1 kept
+    onp.testing.assert_array_equal(_np(sub[3]), [0.0])      # original id
+
+
+def test_quantized_tail_ops():
+    d = nd.array(onp.random.RandomState(2).randn(2, 4, 3, 3).astype("f"))
+    qd, lo, hi = nd.quantize(d)
+    qa, alo, ahi = nd.quantized_act(qd, lo, hi)
+    assert float(_np(qa).min()) >= 0
+    # the range passes through unchanged: the codes' amax-symmetric
+    # scale must not be silently rescaled by the relu
+    assert float(_np(alo)) == float(_np(lo))
+    deq_relu = _np(qa).astype("f") * max(abs(float(_np(alo))),
+                                         abs(float(_np(ahi)))) / 127.0
+    onp.testing.assert_allclose(deq_relu, onp.maximum(_np(d), 0), atol=0.05)
+    qf, flo, fhi = nd.quantized_flatten(qd, lo, hi)
+    assert qf.shape == (2, 36)
+    m, mlo, mhi = nd.quantized_elemwise_mul(qd, qd, lo, hi, lo, hi)
+    assert str(m.dtype) == "int32"
+    # dequantized product approximates the float product
+    approx = _np(m) * (float(_np(mhi)) / (127.0 * 127.0))
+    onp.testing.assert_allclose(approx, _np(d) ** 2, atol=0.05)
+    w = nd.array(onp.random.RandomState(3).randn(10, 5).astype("f"))
+    qw, wlo, whi = nd.quantize(w)
+    e, *_ = nd.quantized_embedding(nd.array(onp.array([1, 3], "i")),
+                                   qw, wlo, whi)
+    onp.testing.assert_array_equal(_np(e), _np(qw)[[1, 3]])
+    ones = nd.array(onp.ones(4, "f"))
+    zeros = nd.array(onp.zeros(4, "f"))
+    qb, blo, bhi = nd.quantized_batch_norm(qd, ones, zeros, zeros, ones,
+                                           lo, hi)
+    assert str(qb.dtype) == "int8"
+    # identity BN (mean 0, var 1, eps small): dequantized out ~ input
+    deq = _np(qb).astype("f") * float(_np(bhi)) / 127.0
+    onp.testing.assert_allclose(deq, _np(d), atol=0.1)
+
+
+def test_calibrate_entropy_clips_gaussian_keeps_uniform():
+    from incubator_mxnet_tpu.ops.quantization_ops import calibrate_entropy
+    rng = onp.random.RandomState(0)
+    hist, edges = onp.histogram(rng.randn(200000), bins=1001, range=(-8, 8))
+    t, div = calibrate_entropy.fn(hist, edges)
+    assert 2.5 < float(t) < 6.0
+    hist2, edges2 = onp.histogram(rng.uniform(-4, 4, 200000), bins=1001,
+                                  range=(-8, 8))
+    t2, _ = calibrate_entropy.fn(hist2, edges2)
+    assert 3.5 < float(t2) < 4.6
+    # registry path returns NDArrays
+    tn, dn = nd.calibrate_entropy(nd.array(hist.astype("f")),
+                                  nd.array(edges.astype("f")))
+    assert abs(float(_np(tn)) - float(t)) < 0.1
+
+
+def test_zoo_get_factories():
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    for fn, args in [(vision.get_densenet, (121,)),
+                     (vision.get_mobilenet, (0.25,)),
+                     (vision.get_mobilenet_v2, (0.25,)),
+                     (vision.get_squeezenet, ("1.1",))]:
+        net = fn(*args)
+        assert net is not None
+        with pytest.raises(RuntimeError):
+            fn(*args, pretrained=True)
