@@ -73,13 +73,22 @@ def optimal_threshold_kl(arr, num_bins=8001, num_quantized_bins=255):
 
 class CalibrationCollector:
     """Accumulates per-layer activation stats over calibration batches
-    (reference _LayerOutputMinMaxCollector / _LayerHistogramCollector)."""
+    (reference _LayerOutputMinMaxCollector / _LayerHistogramCollector).
 
-    def __init__(self, mode="naive"):
+    Entropy mode accumulates a fixed symmetric HISTOGRAM per layer (the
+    reference's _LayerHistogramCollector approach) instead of retaining
+    raw samples — calibration memory is O(num_bins) per layer however
+    many batches run.  The first batch fixes the histogram range at
+    2x that batch's amax (later outliers land in the edge bins, same as
+    the reference's include_layer rebinning compromise)."""
+
+    def __init__(self, mode="naive", num_bins=8001):
         assert mode in ("naive", "entropy")
         self.mode = mode
+        self.num_bins = num_bins if num_bins % 2 == 1 else num_bins + 1
         self.minmax: dict = {}
-        self.samples: dict = {}
+        self.hists: dict = {}
+        self.edges: dict = {}
 
     def collect(self, name, arr):
         a = onp.asarray(arr.asnumpy() if isinstance(arr, NDArray) else arr)
@@ -90,12 +99,22 @@ class CalibrationCollector:
         else:
             self.minmax[name] = (lo, hi)
         if self.mode == "entropy":
-            self.samples.setdefault(name, []).append(a.ravel())
+            if name not in self.hists:
+                amax = max(abs(lo), abs(hi), 1e-8) * 2.0
+                self.edges[name] = onp.linspace(-amax, amax,
+                                                self.num_bins + 1)
+                self.hists[name] = onp.zeros(self.num_bins, onp.float64)
+            edges = self.edges[name]
+            clipped = onp.clip(a.ravel(), edges[0], edges[-1])
+            h, _ = onp.histogram(clipped, bins=edges)
+            self.hists[name] += h
 
     def thresholds(self, name):
         lo, hi = self.minmax[name]
-        if self.mode == "entropy" and name in self.samples:
-            t = optimal_threshold_kl(onp.concatenate(self.samples[name]))
+        if self.mode == "entropy" and name in self.hists:
+            from ..ops.quantization_ops import calibrate_entropy
+            t, _ = calibrate_entropy.fn(self.hists[name], self.edges[name])
+            t = float(t)
             return (-t, t)
         return (lo, hi)
 

@@ -29,6 +29,8 @@ from . import sparse_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import image_ops  # noqa: F401
 from . import init_ops  # noqa: F401
+from . import ref_aliases  # noqa: F401  (must import LAST: aliases
+#                            resolve against every registered op above)
 
 # Python-callback custom op (reference src/operator/custom/): op named
 # "Custom" with op_type kwarg, matching nd.Custom(..., op_type=...)

@@ -96,3 +96,28 @@ def cond(pred, then_func, else_func, inputs=()):
         lambda xs: _to_raw(else_func(*_wrap(xs))),
         raw)
     return _wrap(out)
+
+
+# registry entries so the reference-internal names `_foreach`,
+# `_while_loop`, `_cond` (src/operator/control_flow.cc:63,525,825)
+# resolve; the callables go through as static kwargs, the loop itself
+# lowers to lax.scan/while_loop/cond inside the caller's trace.
+from .registry import register as _register  # noqa: E402
+
+
+@_register("_foreach", aliases=("foreach_op",), differentiable=False,
+           jittable=False)
+def _foreach_op(data, body=None, init_states=()):
+    return foreach(body, data, init_states)
+
+
+@_register("_while_loop", aliases=("while_loop_op",), differentiable=False,
+           jittable=False)
+def _while_loop_op(*loop_vars, cond=None, func=None, max_iterations=None):
+    return while_loop(cond, func, loop_vars, max_iterations=max_iterations)
+
+
+@_register("_cond", aliases=("cond_op",), differentiable=False,
+           jittable=False)
+def _cond_op(pred, *inputs, then_func=None, else_func=None):
+    return cond(pred, then_func, else_func, inputs)

@@ -292,3 +292,35 @@ def interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
     out = jnp.einsum("bts,bsd->btd", attention, v)
     return out.reshape(B, heads, Tq, dh).transpose(2, 0, 1, 3).reshape(
         Tq, B, heads * dh)
+
+
+@register("_image_random_resized_crop", aliases=("image_random_resized_crop",),
+          differentiable=False, jittable=False)
+def image_random_resized_crop(x, size=(224, 224), scale=(0.08, 1.0),
+                              ratio=(3.0 / 4.0, 4.0 / 3.0), seed=None):
+    """Random area/aspect crop then resize (reference
+    src/operator/image/crop.cc `_image_random_resized_crop` backing
+    gluon transforms.RandomResizedCrop).  Host-side eager: the crop
+    window is data-independent but its SIZE is random, which cannot be
+    a static XLA shape — same reasoning as the reference's CPU-side
+    implementation.  x is HWC (or NHWC); output spatial dims = size."""
+    import numpy as onp
+    from .image_ops import image_resize  # self-import safe at call time
+    rng = onp.random.RandomState(seed)
+    arr = onp.asarray(x)
+    H, W = arr.shape[-3], arr.shape[-2]
+    area = float(H * W)
+    size = (size, size) if isinstance(size, int) else tuple(size)
+    for _ in range(10):
+        target = rng.uniform(*scale) * area
+        ar = rng.uniform(*ratio)
+        w = int(round((target * ar) ** 0.5))
+        h = int(round((target / ar) ** 0.5))
+        if w <= W and h <= H:
+            x0 = rng.randint(0, W - w + 1)
+            y0 = rng.randint(0, H - h + 1)
+            crop = arr[..., y0:y0 + h, x0:x0 + w, :]
+            break
+    else:
+        crop = arr
+    return image_resize.fn(jnp.asarray(crop), size=size)

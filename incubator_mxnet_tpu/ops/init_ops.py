@@ -57,3 +57,19 @@ def histogram(data, bins=10, range=None):
     keeps the output shape static for jit)."""
     cnt, edges = jnp.histogram(data, bins=int(bins), range=range)
     return cnt, edges
+
+
+@register("_ones", aliases=("ones_op",), differentiable=False,
+          num_inputs=0)
+def ones_op(shape=(), dtype="float32"):
+    """Registry-level ones (reference init_op.cc `_ones`; nd.ones wraps
+    this same fill)."""
+    return jnp.ones(shape, dtype_from_any(dtype))
+
+
+@register("_zeros", aliases=("zeros_op", "_zeros_without_dtype"),
+          differentiable=False, num_inputs=0)
+def zeros_op(shape=(), dtype="float32"):
+    """Registry-level zeros (reference init_op.cc `_zeros` and the
+    dtype-defaulting `_zeros_without_dtype`)."""
+    return jnp.zeros(shape, dtype_from_any(dtype))

@@ -106,3 +106,14 @@ def cast_storage_meta(dense, stype):
         indptr = onp.cumsum(indptr)
         return np_val[rows, cols], (cols.astype(onp.int64), indptr)
     raise ValueError(f"unknown stype {stype}")
+
+
+@register("cast_storage", differentiable=False, jittable=False)
+def cast_storage_op(data, stype="default"):
+    """Registry-level cast_storage (reference tensor/cast_storage-inl.h).
+    Values are identical across storage types in this design (sparse
+    containers are dense-backed with index metadata — module docstring);
+    container-producing casts live in ndarray.sparse.cast_storage."""
+    if stype not in ("default", "row_sparse", "csr"):
+        raise ValueError(f"unknown stype {stype!r}")
+    return data
