@@ -75,12 +75,15 @@ class CalibrationCollector:
     """Accumulates per-layer activation stats over calibration batches
     (reference _LayerOutputMinMaxCollector / _LayerHistogramCollector).
 
-    Entropy mode accumulates a fixed symmetric HISTOGRAM per layer (the
+    Entropy mode accumulates a symmetric HISTOGRAM per layer (the
     reference's _LayerHistogramCollector approach) instead of retaining
     raw samples — calibration memory is O(num_bins) per layer however
-    many batches run.  The first batch fixes the histogram range at
-    2x that batch's amax (later outliers land in the edge bins, same as
-    the reference's include_layer rebinning compromise)."""
+    many batches run.  The range starts at 2x the first batch's amax
+    and GROWS when a later batch exceeds it: prior counts are rebinned
+    into the widened histogram by bin center (the reference's
+    include_layer rebinning compromise), so a degenerate first batch
+    (e.g. all-zero padding) cannot freeze the range and clip every
+    subsequent real activation into the edge bins."""
 
     def __init__(self, mode="naive", num_bins=8001):
         assert mode in ("naive", "entropy")
@@ -99,11 +102,19 @@ class CalibrationCollector:
         else:
             self.minmax[name] = (lo, hi)
         if self.mode == "entropy":
+            amax = max(abs(lo), abs(hi), 1e-8) * 2.0
             if name not in self.hists:
-                amax = max(abs(lo), abs(hi), 1e-8) * 2.0
                 self.edges[name] = onp.linspace(-amax, amax,
                                                 self.num_bins + 1)
                 self.hists[name] = onp.zeros(self.num_bins, onp.float64)
+            elif amax > self.edges[name][-1]:
+                # widen and rebin accumulated counts by old-bin center
+                old_edges, old_hist = self.edges[name], self.hists[name]
+                new_edges = onp.linspace(-amax, amax, self.num_bins + 1)
+                centers = (old_edges[:-1] + old_edges[1:]) / 2.0
+                self.hists[name], _ = onp.histogram(
+                    centers, bins=new_edges, weights=old_hist)
+                self.edges[name] = new_edges
             edges = self.edges[name]
             clipped = onp.clip(a.ravel(), edges[0], edges[-1])
             h, _ = onp.histogram(clipped, bins=edges)

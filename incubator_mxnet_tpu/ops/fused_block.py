@@ -1,0 +1,352 @@
+"""Fused 1x1-conv (matmul) + BatchNorm Pallas kernels for bottleneck nets.
+
+The round-4 on-chip roofline (docs/performance.md) showed the bf16
+ResNet-50 train step is HBM-bandwidth-bound on BN-structured activation
+traffic: XLA cannot fuse the batch-stat reductions *into* the producing
+conv, so every BatchNorm costs an extra activation-sized read (stats)
+plus a materialized normalized copy feeding the next conv.  The MXU-side
+convs themselves run at 84-91% of peak — the FLOPs are fine, the bytes
+are not.
+
+These kernels remove that traffic for the 1x1 convolutions (2/3 of the
+convs in a bottleneck ResNet), which are plain matmuls over the
+flattened spatial grid:
+
+  ``fused_matmul_bn(x, w)``               -> y = x @ w, plus per-column
+      sum(y) and sum(y^2) accumulated in the matmul epilogue — the BN
+      batch stats of y cost ZERO extra HBM reads.
+  ``fused_matmul_bn(x, w, scale, bias)``  -> y = relu(x*scale+bias) @ w:
+      the previous BatchNorm's normalize+ReLU is applied in-register as
+      the matmul prologue, so the normalized activation is NEVER
+      materialized in HBM.
+
+The custom VJP keeps the same property on the backward pass: the two
+matmuls (dx, dw) recompute the prologue in-register and carry the
+BN/ReLU backward reductions (dscale, dbias) as epilogues of the dx
+matmul, instead of XLA's separate reduction passes.
+
+Reference analog: the CUDNN/NNVM fused conv+BN+ReLU segments the
+reference builds via its pointwise-fusion pass (src/operator/fusion/
+fused_op.cu, src/executor/pointwise_fusion_pass.cc) — re-designed here
+as TPU Pallas kernels with stats epilogues instead of NVRTC codegen.
+
+Numerics: matmuls run on the MXU in the input dtype (bf16 for the
+benchmark path) with fp32 accumulation; the prologue normalize runs in
+fp32; stats accumulate in fp32 from the *rounded* output y (matching
+ops.nn_ops.batch_norm's one-pass E[x^2]-mu^2 convention).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_kernels import _round_up, interpret_mode, use_pallas
+
+__all__ = ["fused_matmul_bn", "bn_consts", "xla_matmul_bn"]
+
+
+def _pick_bm(np_cols: int) -> int:
+    # small-N matmuls (e.g. 256->64 c1 convs) amortize better with
+    # taller M tiles; wide outputs keep VMEM in budget with BM=256
+    return 512 if np_cols <= 256 else 256
+
+
+# ---------------------------------------------------------------------------
+# forward: y = [relu(x*scale+bias)] @ w, s1 = sum(y), s2 = sum(y^2)
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(x_ref, w_ref, sc_ref, bi_ref, y_ref, s1_ref, s2_ref, *,
+                m_real, bm, prologue):
+    i = pl.program_id(1)
+    xf = x_ref[...].astype(jnp.float32)
+    if prologue:
+        xf = jnp.maximum(xf * sc_ref[...] + bi_ref[...], 0.0)
+    rows = i * bm + jax.lax.broadcasted_iota(jnp.int32, xf.shape, 0)
+    xf = jnp.where(rows < m_real, xf, 0.0)  # padded rows contribute zero
+    y = jax.lax.dot_general(xf.astype(x_ref.dtype), w_ref[...],
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    yb = y.astype(y_ref.dtype)
+    y_ref[...] = yb
+
+    @pl.when(i == 0)
+    def _init():
+        s1_ref[...] = jnp.zeros_like(s1_ref)
+        s2_ref[...] = jnp.zeros_like(s2_ref)
+
+    yf = yb.astype(jnp.float32)
+    s1_ref[...] += jnp.sum(yf, axis=0, keepdims=True)
+    s2_ref[...] += jnp.sum(jnp.square(yf), axis=0, keepdims=True)
+
+
+def _fwd_impl(x, w, scale, bias, prologue):
+    m, k = x.shape
+    n = w.shape[1]
+    kp, np_ = _round_up(k, 128), _round_up(n, 128)
+    bm = _pick_bm(np_)
+    bn = min(512, np_)
+    mp = _round_up(m, bm)
+    xp = jnp.pad(x, ((0, mp - m), (0, kp - k)))
+    wp = jnp.pad(w, ((0, kp - k), (0, np_ - n)))
+    scp = jnp.pad(scale.astype(jnp.float32), (0, kp - k)).reshape(1, kp)
+    bip = jnp.pad(bias.astype(jnp.float32), (0, kp - k)).reshape(1, kp)
+    grid = (np_ // bn, mp // bm)
+    y, s1, s2 = pl.pallas_call(
+        functools.partial(_fwd_kernel, m_real=m, bm=bm, prologue=prologue),
+        out_shape=[jax.ShapeDtypeStruct((mp, np_), x.dtype),
+                   jax.ShapeDtypeStruct((1, np_), jnp.float32),
+                   jax.ShapeDtypeStruct((1, np_), jnp.float32)],
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, kp), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kp, bn), lambda j, i: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, kp), lambda j, i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, kp), lambda j, i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bn), lambda j, i: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bn), lambda j, i: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bn), lambda j, i: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        interpret=interpret_mode(),
+    )(xp, wp, scp, bip)
+    return y[:m, :n], s1[0, :n], s2[0, :n]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dx_kernel(dy_ref, y_ref, ds1_ref, ds2_ref, w_ref, x_ref, sc_ref,
+                   bi_ref, dx_ref, dsc_ref, dbi_ref, *, m_real, bm, prologue):
+    i = pl.program_id(1)
+    dyt = (dy_ref[...].astype(jnp.float32) + ds1_ref[...]
+           + 2.0 * y_ref[...].astype(jnp.float32) * ds2_ref[...])
+    rows = i * bm + jax.lax.broadcasted_iota(jnp.int32, dyt.shape, 0)
+    dyt = jnp.where(rows < m_real, dyt, 0.0)  # ds1 broadcast hits pad rows
+    dxn = jax.lax.dot_general(dyt.astype(dy_ref.dtype), w_ref[...],
+                              (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+
+    @pl.when(i == 0)
+    def _init():
+        dsc_ref[...] = jnp.zeros_like(dsc_ref)
+        dbi_ref[...] = jnp.zeros_like(dbi_ref)
+
+    if prologue:
+        xf = x_ref[...].astype(jnp.float32)
+        z = xf * sc_ref[...] + bi_ref[...]
+        dz = jnp.where(z > 0.0, dxn, 0.0)
+        dx_ref[...] = (dz * sc_ref[...]).astype(dx_ref.dtype)
+        dsc_ref[...] += jnp.sum(dz * xf, axis=0, keepdims=True)
+        dbi_ref[...] += jnp.sum(dz, axis=0, keepdims=True)
+    else:
+        dx_ref[...] = dxn.astype(dx_ref.dtype)
+
+
+def _bwd_dw_kernel(x_ref, dy_ref, y_ref, ds1_ref, ds2_ref, sc_ref, bi_ref,
+                   dw_ref, *, m_real, bm, prologue):
+    i = pl.program_id(2)
+    xf = x_ref[...].astype(jnp.float32)
+    if prologue:
+        xf = jnp.maximum(xf * sc_ref[...] + bi_ref[...], 0.0)
+    rows = i * bm + jax.lax.broadcasted_iota(jnp.int32, xf.shape, 0)
+    xf = jnp.where(rows < m_real, xf, 0.0)
+    dyt = (dy_ref[...].astype(jnp.float32) + ds1_ref[...]
+           + 2.0 * y_ref[...].astype(jnp.float32) * ds2_ref[...])
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+
+    dw_ref[...] += jax.lax.dot_general(
+        xf.astype(x_ref.dtype), dyt.astype(dy_ref.dtype),
+        (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+
+def _bwd_impl(x, w, scale, bias, y, dy, ds1, ds2, prologue):
+    m, k = x.shape
+    n = w.shape[1]
+    kp, np_ = _round_up(k, 128), _round_up(n, 128)
+    scp = jnp.pad(scale.astype(jnp.float32), (0, kp - k)).reshape(1, kp)
+    bip = jnp.pad(bias.astype(jnp.float32), (0, kp - k)).reshape(1, kp)
+    ds1p = jnp.pad(ds1.astype(jnp.float32), (0, np_ - n)).reshape(1, np_)
+    ds2p = jnp.pad(ds2.astype(jnp.float32), (0, np_ - n)).reshape(1, np_)
+
+    # --- dx (+ dscale, dbias epilogue) ---
+    bm = 256
+    bk = min(512, kp)
+    mp = _round_up(m, bm)
+    pad_mn = lambda a: jnp.pad(a, ((0, mp - m), (0, np_ - n)))
+    dyp, yp = pad_mn(dy), pad_mn(y)
+    xp = jnp.pad(x, ((0, mp - m), (0, kp - k)))
+    wp = jnp.pad(w, ((0, kp - k), (0, np_ - n)))
+    dx, dsc, dbi = pl.pallas_call(
+        functools.partial(_bwd_dx_kernel, m_real=m, bm=bm,
+                          prologue=prologue),
+        out_shape=[jax.ShapeDtypeStruct((mp, kp), x.dtype),
+                   jax.ShapeDtypeStruct((1, kp), jnp.float32),
+                   jax.ShapeDtypeStruct((1, kp), jnp.float32)],
+        grid=(kp // bk, mp // bm),
+        in_specs=[
+            pl.BlockSpec((bm, np_), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, np_), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, np_), lambda j, i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, np_), lambda j, i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk, np_), lambda j, i: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, bk), lambda j, i: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk), lambda j, i: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk), lambda j, i: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bk), lambda j, i: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk), lambda j, i: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk), lambda j, i: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        interpret=interpret_mode(),
+    )(dyp, yp, ds1p, ds2p, wp, xp, scp, bip)
+
+    # --- dw ---
+    bm2 = 256
+    bk2 = min(512, kp)
+    bn2 = min(512, np_)
+    mp2 = _round_up(m, bm2)
+    xp2 = jnp.pad(x, ((0, mp2 - m), (0, kp - k)))
+    dyp2 = jnp.pad(dy, ((0, mp2 - m), (0, np_ - n)))
+    yp2 = jnp.pad(y, ((0, mp2 - m), (0, np_ - n)))
+    # dw accumulates across M blocks in fp32 (a bf16 running sum loses
+    # mantissa every iteration); cast to the weight dtype at the end
+    dw = pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, m_real=m, bm=bm2,
+                          prologue=prologue),
+        out_shape=jax.ShapeDtypeStruct((kp, np_), jnp.float32),
+        grid=(kp // bk2, np_ // bn2, mp2 // bm2),
+        in_specs=[
+            pl.BlockSpec((bm2, bk2), lambda kj, nj, i: (i, kj),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm2, bn2), lambda kj, nj, i: (i, nj),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm2, bn2), lambda kj, nj, i: (i, nj),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bn2), lambda kj, nj, i: (0, nj),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bn2), lambda kj, nj, i: (0, nj),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk2), lambda kj, nj, i: (0, kj),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk2), lambda kj, nj, i: (0, kj),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bk2, bn2), lambda kj, nj, i: (kj, nj),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret_mode(),
+    )(xp2, dyp2, yp2, ds1p, ds2p, scp, bip)
+
+    dx = dx[:m, :k]
+    dw = dw[:k, :n].astype(w.dtype)
+    if prologue:
+        return dx, dw, dsc[0, :k], dbi[0, :k]
+    return dx, dw, jnp.zeros_like(scale), jnp.zeros_like(bias)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing + XLA reference/fallback
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fmm(x, w, scale, bias, prologue):
+    y, s1, s2 = _fwd_impl(x, w, scale, bias, prologue)
+    return y, s1, s2
+
+
+def _fmm_fwd(x, w, scale, bias, prologue):
+    y, s1, s2 = _fwd_impl(x, w, scale, bias, prologue)
+    return (y, s1, s2), (x, w, scale, bias, y)
+
+
+def _fmm_bwd(prologue, res, cts):
+    x, w, scale, bias, y = res
+    dy, ds1, ds2 = cts
+    dx, dw, dsc, dbi = _bwd_impl(x, w, scale, bias, y, dy, ds1, ds2,
+                                 prologue)
+    return dx, dw, dsc, dbi
+
+
+_fmm.defvjp(_fmm_fwd, _fmm_bwd)
+
+
+def xla_matmul_bn(x, w, scale=None, bias=None):
+    """Pure-XLA composition with the same contract (fallback + oracle)."""
+    if scale is not None:
+        xn = jnp.maximum(x.astype(jnp.float32) * scale.astype(jnp.float32)
+                         + bias.astype(jnp.float32), 0.0).astype(x.dtype)
+    else:
+        xn = x
+    y = jax.lax.dot_general(xn, w, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    y = y.astype(x.dtype)
+    yf = y.astype(jnp.float32)
+    return (y, jnp.sum(yf, axis=0), jnp.sum(jnp.square(yf), axis=0))
+
+
+def fused_matmul_bn(x, w, scale=None, bias=None):
+    """y = [relu(x*scale + bias)] @ w with BN batch stats in the epilogue.
+
+    Args:
+      x: (M, K) activations (bf16 or f32); rows = flattened N*H*W.
+      w: (K, N) weights — a 1x1 conv kernel reshaped.
+      scale, bias: optional per-K fp32 normalize constants; when given,
+        relu(x*scale+bias) is applied in-register (never materialized).
+
+    Returns ``(y, s1, s2)`` with ``s1 = sum_M(y)``, ``s2 = sum_M(y^2)``
+    in fp32: ``mean = s1/M``, ``var = s2/M - mean^2`` (one-pass BN).
+    """
+    prologue = scale is not None
+    if scale is None:
+        scale = jnp.ones((x.shape[1],), jnp.float32)
+        bias = jnp.zeros((x.shape[1],), jnp.float32)
+    if not (use_pallas("fused_matmul_bn") or interpret_mode()):
+        return xla_matmul_bn(x, w, scale if prologue else None,
+                             bias if prologue else None)
+    return _fmm(x, w, scale, bias, prologue)
+
+
+def bn_consts(s1, s2, m, gamma, beta, eps=1e-5, dtype=jnp.bfloat16):
+    """Fold kernel stats into per-channel normalize constants.
+
+    Returns ``(scale, bias, mean, var)`` with scale/bias in fp32 (fed to
+    the next fused kernel's prologue) — y_norm = y*scale + bias.
+    Differentiable: gradients flow back into s1/s2 cotangents, which the
+    kernel VJP folds into its matmul prologues.
+    """
+    del dtype
+    mf = jnp.float32(m)
+    mean = s1 / mf
+    var = jnp.maximum(s2 / mf - jnp.square(mean), 0.0)
+    rstd = jax.lax.rsqrt(var + eps)
+    g32 = gamma.astype(jnp.float32)
+    scale = g32 * rstd
+    bias = beta.astype(jnp.float32) - mean * scale
+    return scale, bias, mean, var

@@ -305,7 +305,6 @@ def image_random_resized_crop(x, size=(224, 224), scale=(0.08, 1.0),
     a static XLA shape — same reasoning as the reference's CPU-side
     implementation.  x is HWC (or NHWC); output spatial dims = size."""
     import numpy as onp
-    from .image_ops import image_resize  # self-import safe at call time
     rng = onp.random.RandomState(seed)
     arr = onp.asarray(x)
     H, W = arr.shape[-3], arr.shape[-2]

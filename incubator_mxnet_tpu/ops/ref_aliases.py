@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .registry import _OPS, _lock, get_op, register
+from .registry import _OPS, _lock, register
 
 # internal name -> existing registry name
 _ALIAS_MAP = {
@@ -34,9 +34,6 @@ _ALIAS_MAP = {
     "_logical_xor": "logical_xor",
     "_mod": "mod",
     "_hypot": "hypot",
-    "_ones": "ones",
-    "_zeros": "zeros",
-    "_zeros_without_dtype": "zeros",
     "_shuffle": "shuffle",
     "_split_v2": "split_v2",
     "_sample_multinomial": "sample_multinomial",
@@ -67,7 +64,11 @@ _ALIAS_MAP = {
 def _install():
     with _lock:
         for alias, target in _ALIAS_MAP.items():
-            if alias not in _OPS and target in _OPS:
+            if target not in _OPS:  # a typo'd target must not skip silently
+                raise KeyError(
+                    f"ref_aliases: alias {alias!r} targets unregistered "
+                    f"op {target!r}")
+            if alias not in _OPS:
                 _OPS[alias] = _OPS[target]
 
 

@@ -1,0 +1,40 @@
+#!/bin/bash
+# Round-4 session-3 chip jobs: fused-bottleneck Pallas A/B + XLA flag
+# sweep.  Same resumable artifact convention as chip_queue.sh.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p artifacts/r4
+run() { # name timeout_s cmd...
+  local name="$1" t="$2"; shift 2
+  local out="artifacts/r4/$name.txt"
+  if [ -s "$out" ] && ! grep -q "QUEUE_FAILED" "$out"; then
+    echo "== $name: already done, skipping"; return 0
+  fi
+  echo "== $name (timeout ${t}s)"
+  if timeout "$t" "$@" > "$out.tmp" 2>&1; then
+    mv "$out.tmp" "$out"; echo "   ok"
+  else
+    echo "QUEUE_FAILED rc=$?" >> "$out.tmp"; mv "$out.tmp" "$out"
+    echo "   FAILED (see $out)"
+  fi
+}
+
+if ! timeout 90 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()[0]; assert d.platform != 'cpu'
+x = jax.device_put(jnp.ones((256,256), jnp.bfloat16), d)
+float((x@x).sum())" >/dev/null 2>&1; then
+  echo "chip not reachable — aborting queue"; exit 1
+fi
+echo "chip alive; running queue 3"
+
+# prove the new fused_matmul_bn kernel under Mosaic + refresh manifest
+run smoke3    600  python scripts/pallas_smoke.py
+# fused-bottleneck step: on-chip loss/grad cross-check, then timing A/B
+run fusedver  900  env PROBE_FUSED=1 PROBE_VERIFY=1 PROBE_BS=128 \
+                       python scripts/perf_probe.py raw
+run fused256  900  env PROBE_FUSED=1 PROBE_BS=256 \
+                       python scripts/perf_probe.py raw
+# XLA knob sweep on the un-fused step (independent lever)
+run flags     2400 python scripts/flag_sweep.py
+echo "queue 3 complete"

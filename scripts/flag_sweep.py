@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""XLA TPU flag sweep over the raw ResNet-50 train step.
+
+The step is HBM-bandwidth-bound (docs/performance.md roofline); some
+XLA knobs trade VMEM headroom for deeper fusion.  Each config runs in
+its own subprocess (unknown flags on this libtpu version fail that row
+only).  Prints a ms/step table; the best row is a candidate for
+bench.py's default env.
+
+Usage: python scripts/flag_sweep.py   [env PROBE_BS, SWEEP_TIMEOUT]
+"""
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIGS = [
+    ("baseline", ""),
+    ("vmem48m", "--xla_tpu_scoped_vmem_limit_kib=49152"),
+    ("vmem64m", "--xla_tpu_scoped_vmem_limit_kib=65536"),
+    ("vmem96m", "--xla_tpu_scoped_vmem_limit_kib=98304"),
+    ("no_dot_sr", "--xla_tpu_enable_dot_strength_reduction=false"),
+]
+
+
+def main():
+    timeout = float(os.environ.get("SWEEP_TIMEOUT", "420"))
+    results = []
+    for name, flags in CONFIGS:
+        env = dict(os.environ)
+        base = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = (base + " " + flags).strip()
+        env.setdefault("PROBE_BS", "256")
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO, "scripts/perf_probe.py"),
+                 "raw"],
+                capture_output=True, text=True, timeout=timeout, env=env,
+                cwd=REPO)
+            m = re.search(r":\s*([0-9.]+) ms\s+([0-9.]+) img/s", proc.stdout)
+            if m:
+                results.append((name, float(m.group(1)), float(m.group(2))))
+                print(f"{name:12s} {m.group(1):>9s} ms  {m.group(2):>8s} "
+                      f"img/s  ({time.monotonic() - t0:.0f}s)", flush=True)
+            else:
+                tail = (proc.stderr or proc.stdout).strip().splitlines()
+                print(f"{name:12s} FAILED: {tail[-1] if tail else 'no output'}",
+                      flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"{name:12s} TIMEOUT after {timeout:.0f}s", flush=True)
+    if results:
+        best = min(results, key=lambda r: r[1])
+        print(f"best: {best[0]} at {best[1]:.2f} ms/step", flush=True)
+
+
+if __name__ == "__main__":
+    main()

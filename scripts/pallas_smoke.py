@@ -27,7 +27,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 KERNELS = ["fused_softmax", "fused_layer_norm", "fused_rms_norm",
-           "fused_softmax_xent", "flash_attention"]
+           "fused_softmax_xent", "flash_attention", "fused_matmul_bn"]
 
 _CHILD_BODY = r"""
 import os, sys
@@ -86,6 +86,27 @@ def run(use_kernel):
         y, vjp = jax.vjp(f, x)
         (dx,) = vjp(jnp.ones_like(y))
         return y, dx
+    if name == "fused_matmul_bn":
+        from incubator_mxnet_tpu.ops import fused_block as fb
+        x = jnp.asarray(rng.randn(200, 96), jnp.bfloat16) * 0.5
+        w = jnp.asarray(rng.randn(96, 72), jnp.bfloat16) * 0.1
+        sc = jnp.asarray(rng.rand(96) + 0.5, jnp.float32)
+        bi = jnp.asarray(rng.randn(96) * 0.2, jnp.float32)
+        dy = jnp.asarray(rng.randn(200, 72), jnp.bfloat16) * 0.1
+        ds1 = jnp.asarray(rng.randn(72), jnp.float32) * 0.01
+        ds2 = jnp.asarray(rng.randn(72), jnp.float32) * 0.001
+        def run_one(f):
+            outs = []
+            for prologue in (False, True):
+                y, vjp = jax.vjp(
+                    lambda x, w, s, b: f(x, w, s, b, prologue), x, w, sc, bi)
+                outs.extend(y)
+                outs.extend(vjp((dy, ds1, ds2)))
+            return tuple(outs)
+        if use_kernel:
+            return run_one(fb._fmm)
+        return run_one(lambda x, w, s, b, p: fb.xla_matmul_bn(
+            x, w, s if p else None, b if p else None))
     if name == "flash_attention":
         q = jnp.asarray(rng.randn(2, 2, 128, 64), jnp.float32) * 0.3
         k = jnp.asarray(rng.randn(2, 2, 128, 64), jnp.float32) * 0.3

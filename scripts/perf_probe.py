@@ -430,10 +430,22 @@ def probe_raw(max_stages=None):
     bs = int(os.environ.get("PROBE_BS", "128"))
     remat = os.environ.get("PROBE_REMAT", "0") == "1"
     bn_batch_stats = os.environ.get("PROBE_BN", "batch") == "batch"
+    fused_blk = os.environ.get("PROBE_FUSED", "0") == "1"
     layout = os.environ.get("PROBE_LAYOUT", "NHWC").upper()
     if layout not in ("NHWC", "NCHW"):
         sys.exit(f"PROBE_LAYOUT must be NHWC or NCHW, got {layout!r}")
     nhwc = layout == "NHWC"
+    if fused_blk:
+        if not nhwc:
+            sys.exit("PROBE_FUSED=1 needs PROBE_LAYOUT=NHWC (the fused "
+                     "matmul kernels read channel-minor [M, C] views)")
+        if not bn_batch_stats:
+            sys.exit("PROBE_FUSED=1 needs PROBE_BN=batch: the fused "
+                     "kernels exist to absorb batch-stat traffic; "
+                     "eval-BN has no stats pass to fuse")
+        # the A/B must exercise the kernels even before a manifest exists
+        os.environ.setdefault("MXNET_USE_PALLAS", "1")
+        from incubator_mxnet_tpu.ops import fused_block as fb
     CH = -1 if nhwc else 1                     # channel axis
     RED = (0, 1, 2) if nhwc else (0, 2, 3)     # BN reduce axes
 
@@ -507,33 +519,107 @@ def probe_raw(max_stages=None):
                    training)
         return jnp.maximum(x + y, 0)
 
-    def forward(params, x, training=True):
-        y = conv(x, params["stem"], 2)
-        y = jnp.maximum(bn(y, params["stem_bn"], training), 0)
-        pool_w = (1, 3, 3, 1) if nhwc else (1, 1, 3, 3)
-        pool_s = (1, 2, 2, 1) if nhwc else (1, 1, 2, 2)
-        y = lax.reduce_window(y, -jnp.inf, lax.max, pool_w, pool_s, "SAME")
-        for si, (co, cm, n) in enumerate(stages):
-            for bi in range(n):
-                fn = (lambda yy, _si=si, _bi=bi, _n=n: block(
-                    yy, params, f"s{_si}b{_bi}",
-                    (2 if _bi == 0 and _si > 0 else 1), _bi == 0, training))
-                if remat:
-                    fn = jax.checkpoint(fn)
-                y = fn(y)
-        y = jnp.mean(y, (1, 2) if nhwc else (2, 3))
-        return y.astype(jnp.bfloat16) @ params["fc"]
+    def block_fused(x, params, p, stride, proj, training):
+        """Bottleneck with Pallas fused matmul+BN kernels on c1/c3/sc:
+        1x1 convs emit their BN batch stats from the matmul epilogue and
+        the c3 kernel applies bn2+relu in its prologue — no stats read
+        passes, no materialized normalized copy of y2 (ops/fused_block)."""
+        n, h, w_, _ = x.shape
+        eps = 1e-5
+        flat = lambda t: t.reshape(-1, t.shape[-1])
+        sq = lambda w4: w4.reshape(w4.shape[2], w4.shape[3])  # 1x1 HWIO
+        mrows = n * h * w_
 
-    def loss_fn(params, x, lbl):
-        logits = forward(params, x).astype(jnp.float32)
-        lp = jax.nn.log_softmax(logits)
-        return -jnp.mean(jnp.take_along_axis(lp, lbl[:, None], 1))
+        y1, a1, b1 = fb.fused_matmul_bn(flat(x), sq(params[p + "c1"]))
+        g1, be1 = params[p + "bn1"]
+        sc1, of1, _, _ = fb.bn_consts(a1, b1, mrows, g1, be1, eps)
+        cm = y1.shape[-1]
+        y1n = jnp.maximum(y1.astype(jnp.float32) * sc1 + of1, 0.0)
+        y1n = y1n.astype(x.dtype).reshape(n, h, w_, cm)
+
+        y2 = conv(y1n, params[p + "c2"], stride)  # 3x3: XLA conv
+        g2, be2 = params[p + "bn2"]
+        mean2 = jnp.mean(y2, (0, 1, 2), dtype=jnp.float32)
+        meansq2 = jnp.mean(jnp.square(y2), (0, 1, 2), dtype=jnp.float32)
+        var2 = jnp.maximum(meansq2 - jnp.square(mean2), 0.0)
+        rstd2 = lax.rsqrt(var2 + eps)
+        sc2 = g2 * rstd2
+        of2 = be2 - mean2 * sc2
+
+        y3, a3, b3 = fb.fused_matmul_bn(flat(y2), sq(params[p + "c3"]),
+                                        sc2, of2)
+        g3, be3 = params[p + "bn3"]
+        sc3, of3, _, _ = fb.bn_consts(a3, b3, y3.shape[0], g3, be3, eps)
+
+        if proj:
+            xs = x[:, ::stride, ::stride, :] if stride > 1 else x
+            ysc, asc, bsc = fb.fused_matmul_bn(flat(xs), sq(params[p + "sc"]))
+            gsc, besc = params[p + "scbn"]
+            scc, ofc, _, _ = fb.bn_consts(asc, bsc, ysc.shape[0], gsc, besc,
+                                          eps)
+            short = ysc.astype(jnp.float32) * scc + ofc
+        else:
+            short = flat(x).astype(jnp.float32)
+        out = jnp.maximum(y3.astype(jnp.float32) * sc3 + of3 + short, 0.0)
+        co = y3.shape[-1]
+        return out.astype(x.dtype).reshape(n, h // stride, w_ // stride, co)
+
+    def make_loss(blk):
+        def forward(params, x, training=True):
+            y = conv(x, params["stem"], 2)
+            y = jnp.maximum(bn(y, params["stem_bn"], training), 0)
+            pool_w = (1, 3, 3, 1) if nhwc else (1, 1, 3, 3)
+            pool_s = (1, 2, 2, 1) if nhwc else (1, 1, 2, 2)
+            y = lax.reduce_window(y, -jnp.inf, lax.max, pool_w, pool_s,
+                                  "SAME")
+            for si, (co, cm, n) in enumerate(stages):
+                for bi in range(n):
+                    fn = (lambda yy, _si=si, _bi=bi, _n=n: blk(
+                        yy, params, f"s{_si}b{_bi}",
+                        (2 if _bi == 0 and _si > 0 else 1), _bi == 0,
+                        training))
+                    if remat:
+                        fn = jax.checkpoint(fn)
+                    y = fn(y)
+            y = jnp.mean(y, (1, 2) if nhwc else (2, 3))
+            return y.astype(jnp.bfloat16) @ params["fc"]
+
+        def loss_fn(params, x, lbl):
+            logits = forward(params, x).astype(jnp.float32)
+            lp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(lp, lbl[:, None], 1))
+        return loss_fn
+
+    loss_fn = make_loss(block_fused if fused_blk else block)
 
     params = init()
     mom = jax.tree_util.tree_map(jnp.zeros_like, params)
     xshape = (bs, 224, 224, 3) if nhwc else (bs, 3, 224, 224)
     x = jax.random.normal(key, xshape, jnp.bfloat16)
     lbl = jax.random.randint(key, (bs,), 0, 1000)
+
+    if fused_blk and os.environ.get("PROBE_VERIFY", "0") == "1":
+        # Hardware cross-check: fused-kernel step vs pure-XLA step on
+        # the SAME params/batch — catches a Mosaic miscompile in one
+        # cheap extra compile instead of a silently-wrong benchmark.
+        lv_f, g_f = jax.jit(jax.value_and_grad(make_loss(block_fused)))(
+            params, x, lbl)
+        lv_x, g_x = jax.jit(jax.value_and_grad(make_loss(block)))(
+            params, x, lbl)
+        rel = jax.tree_util.tree_map(
+            lambda a, b: float(
+                jnp.max(jnp.abs(a.astype(jnp.float32)
+                                - b.astype(jnp.float32)))
+                / (jnp.max(jnp.abs(b.astype(jnp.float32))) + 1e-6)),
+            g_f, g_x)
+        flat, _ = jax.tree_util.tree_flatten_with_path(rel)
+        flat.sort(key=lambda kv: -kv[1])
+        for path, v in flat[:5]:
+            print(f"  grad reldiff {jax.tree_util.keystr(path)}: {v:.3e}",
+                  flush=True)
+        worst = flat[0][1]
+        print(f"verify: loss fused={float(lv_f):.5f} xla={float(lv_x):.5f} "
+              f"worst-grad-reldiff={worst:.3e}", flush=True)
 
     @jax.jit
     def step(params, mom, x, lbl):
@@ -572,6 +658,7 @@ def probe_raw(max_stages=None):
                 steps=10, warmup=3)
     tag = (f"raw {layout} train bs={bs} remat={int(remat)} "
            f"bn={'batch' if bn_batch_stats else 'eval'}"
+           + (" fusedblk" if fused_blk else "")
            + (f" stages<={len(stages)}" if max_stages is not None else ""))
     print(f"{tag}: {dt * 1e3:7.2f} ms  {bs / dt:7.1f} img/s  "
           f"{100 * flops / dt / PEAK:5.1f}% MFU  "

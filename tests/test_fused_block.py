@@ -1,0 +1,126 @@
+"""Fused matmul+BN Pallas kernel parity (ops/fused_block.py).
+
+Oracle: the pure-XLA composition ``xla_matmul_bn`` (identical contract),
+checked through fwd outputs, stats, and full VJP — including the
+stats-cotangent path (ds1/ds2 feed the producing matmul via the BN
+constants of the *next* layer, exactly how the bottleneck chain uses
+it).  Kernels run in interpret mode on CPU (same numerics as Mosaic up
+to dot rounding); the on-chip proof lives in scripts/pallas_smoke.py.
+"""
+import os
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+import pytest
+
+os.environ.setdefault("MXNET_USE_PALLAS", "1")
+
+from incubator_mxnet_tpu.ops import fused_block as fb
+
+
+def _mk(m, k, n, dtype, seed=0):
+    rng = onp.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(m, k), dtype) * 0.5
+    w = jnp.asarray(rng.randn(k, n), dtype) * (k ** -0.5)
+    scale = jnp.asarray(rng.rand(k) + 0.5, jnp.float32)
+    bias = jnp.asarray(rng.randn(k) * 0.2, jnp.float32)
+    return x, w, scale, bias
+
+
+def _tol(dtype):
+    return 2e-2 if dtype == jnp.bfloat16 else 1e-5
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("m,k,n", [(256, 128, 128),   # exact tiles
+                                   (200, 96, 72),     # all dims padded
+                                   (1024, 256, 64),   # tall-skinny c1 shape
+                                   (512, 64, 256)])   # c3 shape
+@pytest.mark.parametrize("prologue", [False, True])
+def test_fwd_parity(dtype, m, k, n, prologue):
+    x, w, scale, bias = _mk(m, k, n, dtype)
+    args = (scale, bias) if prologue else (None, None)
+    y, s1, s2 = fb._fmm(x, w, scale if prologue else jnp.ones((k,), jnp.float32),
+                        bias if prologue else jnp.zeros((k,), jnp.float32),
+                        prologue)
+    yr, s1r, s2r = fb.xla_matmul_bn(x, w, *args)
+    tol = _tol(dtype)
+    onp.testing.assert_allclose(onp.asarray(y, onp.float32),
+                                onp.asarray(yr, onp.float32),
+                                rtol=tol, atol=tol)
+    # stats are sums over M: scale tolerance by M
+    onp.testing.assert_allclose(onp.asarray(s1), onp.asarray(s1r),
+                                rtol=tol, atol=tol * m)
+    onp.testing.assert_allclose(onp.asarray(s2), onp.asarray(s2r),
+                                rtol=tol, atol=tol * m)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("m,k,n", [(256, 128, 128), (200, 96, 72)])
+@pytest.mark.parametrize("prologue", [False, True])
+def test_vjp_parity(dtype, m, k, n, prologue):
+    x, w, scale, bias = _mk(m, k, n, dtype, seed=1)
+    rng = onp.random.RandomState(2)
+    dy = jnp.asarray(rng.randn(m, n), dtype) * 0.1
+    ds1 = jnp.asarray(rng.randn(n), jnp.float32) * 0.01
+    ds2 = jnp.asarray(rng.randn(n), jnp.float32) * 0.001
+
+    def run(fused):
+        def f(x, w, scale, bias):
+            if fused:
+                return fb._fmm(x, w, scale, bias, prologue)
+            return fb.xla_matmul_bn(x, w, scale if prologue else None,
+                                    bias if prologue else None)
+        out, vjp = jax.vjp(f, x, w, scale, bias)
+        return out, vjp((dy, ds1, ds2))
+
+    (y, s1, s2), (dx, dw, dsc, dbi) = run(True)
+    (yr, _, _), (dxr, dwr, dscr, dbir) = run(False)
+    tol = _tol(dtype)
+    onp.testing.assert_allclose(onp.asarray(dx, onp.float32),
+                                onp.asarray(dxr, onp.float32),
+                                rtol=5 * tol, atol=5 * tol)
+    # dw accumulates over M rows: absolute tolerance scales with M
+    onp.testing.assert_allclose(onp.asarray(dw, onp.float32),
+                                onp.asarray(dwr, onp.float32),
+                                rtol=5 * tol, atol=tol * m ** 0.5)
+    if prologue:
+        onp.testing.assert_allclose(onp.asarray(dsc), onp.asarray(dscr),
+                                    rtol=5 * tol, atol=tol * m ** 0.5)
+        onp.testing.assert_allclose(onp.asarray(dbi), onp.asarray(dbir),
+                                    rtol=5 * tol, atol=tol * m ** 0.5)
+
+
+def test_bn_consts_chain_grad():
+    """End-to-end mini-chain: fmm -> bn_consts -> prologue fmm -> loss.
+
+    Verifies the ds1/ds2 cotangent path through bn_consts matches the
+    XLA composition — the exact dataflow of a fused bottleneck block.
+    """
+    m, k, n1, n2 = 128, 64, 96, 80
+    x, w1, _, _ = _mk(m, k, n1, jnp.float32, seed=3)
+    _, w2, _, _ = _mk(m, n1, n2, jnp.float32, seed=4)
+    gamma = jnp.asarray(onp.random.RandomState(5).rand(n1) + 0.5, jnp.float32)
+    beta = jnp.asarray(onp.random.RandomState(6).randn(n1), jnp.float32)
+
+    def chain(fused):
+        fn = fb._fmm if fused else (
+            lambda x, w, s, b, p: fb.xla_matmul_bn(
+                x, w, s if p else None, b if p else None))
+
+        def f(x, w1, w2, gamma, beta):
+            y1, s1, s2 = fn(x, w1, jnp.ones((k,), jnp.float32),
+                            jnp.zeros((k,), jnp.float32), False)
+            sc, bi, _, _ = fb.bn_consts(s1, s2, m, gamma, beta)
+            y2, t1, t2 = fn(y1, w2, sc, bi, True)
+            return jnp.sum(jnp.square(y2)) + jnp.sum(t1) + jnp.sum(t2)
+        return jax.value_and_grad(f, argnums=(0, 1, 2, 3, 4))(
+            x, w1, w2, gamma, beta)
+
+    v, g = chain(True)
+    vr, gr = chain(False)
+    onp.testing.assert_allclose(float(v), float(vr), rtol=1e-4)
+    for a, b in zip(g, gr):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=2e-3, atol=2e-3)

@@ -94,6 +94,23 @@ def test_calibration_collector_modes():
     assert lo == -hi and 0 < hi < 10
 
 
+def test_calibration_entropy_range_grows_past_degenerate_first_batch():
+    """A near-zero first batch must not freeze the histogram range: the
+    collector widens and rebins when later batches exceed it, so the
+    threshold reflects the real activation scale."""
+    ce = CalibrationCollector("entropy")
+    ce.collect("l1", onp.full(64, 1e-7))          # degenerate first batch
+    rs = onp.random.RandomState(7)
+    for _ in range(4):
+        ce.collect("l1", rs.randn(10000))          # real scale ~N(0,1)
+    lo, hi = ce.thresholds("l1")
+    assert lo == -hi and 0.5 < hi < 10             # not ~2e-7
+    # histogram range covers the real data, not the first batch
+    assert ce.edges["l1"][-1] > 1.0
+    # total mass preserved through the rebinning (64 + 4*10000 samples)
+    assert abs(ce.hists["l1"].sum() - (64 + 40000)) < 1e-6
+
+
 @pytest.mark.parametrize("mode", ["naive", "entropy"])
 def test_quantize_net_accuracy(mode):
     mx.random.seed(0)
