@@ -109,21 +109,26 @@ def _child(platform: str) -> None:
     from incubator_mxnet_tpu.gluon.model_zoo import vision
 
     stem = os.environ.get("BENCH_STEM", "conv7")
+    layout = os.environ.get("BENCH_LAYOUT", "NCHW").upper()
+    fused = os.environ.get("BENCH_FUSED", "0") == "1"
+    nhwc = layout == "NHWC"
 
     def measure(bs):
         mx.random.seed(0)
         cpu0 = jax.local_devices(backend="cpu")[0]
         with jax.default_device(cpu0):  # eager setup off the chip
-            net = vision.resnet50_v1(stem=stem)
+            net = vision.resnet50_v1(stem=stem, layout=layout, fused=fused)
             net.initialize(ctx=mx.cpu())
-            net(nd.random.uniform(shape=(1, 3, 32, 32)))  # resolve shapes
+            shape0 = (1, 32, 32, 3) if nhwc else (1, 3, 32, 32)
+            net(nd.random.uniform(shape=shape0))  # resolve shapes
             if dtype == "bfloat16":
                 amp.convert_block(net, "bfloat16")
             step = make_fused_train_step(
                 net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
                 {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
                 remat=os.environ.get("BENCH_REMAT") or None)
-            x = jnp.asarray(onp.random.rand(bs, 3, 224, 224), jnp.float32)
+            xshape = (bs, 224, 224, 3) if nhwc else (bs, 3, 224, 224)
+            x = jnp.asarray(onp.random.rand(*xshape), jnp.float32)
             if dtype == "bfloat16":
                 x = x.astype(jnp.bfloat16)
             y = jnp.asarray(onp.random.randint(0, 1000, (bs,)), jnp.int32)
@@ -162,6 +167,10 @@ def _child(platform: str) -> None:
         plat = accel.platform
         suffix = "" if plat not in ("cpu",) else "_cpu_fallback"
         stem_tag = "" if stem == "conv7" else f"_{stem}stem"
+        if fused:
+            stem_tag += "_fusedblk"
+        elif nhwc:
+            stem_tag += "_nhwc"
         result = {
             "metric":
                 f"resnet50_train_img_per_sec_bs{bs}_{dtype}{stem_tag}{suffix}",

@@ -53,7 +53,9 @@ def main(argv=None):
     from incubator_mxnet_tpu.contrib.quantization import quantize_net
     from incubator_mxnet_tpu.gluon.model_zoo import vision
 
+    print("[int8] probing device...", file=sys.stderr, flush=True)
     platform = jax.devices()[0].platform
+    print(f"[int8] platform={platform}", file=sys.stderr, flush=True)
     rng = onp.random.RandomState(0)
     shape = (args.batch, 3, args.image_size, args.image_size)
     eval_x = [nd.array(rng.rand(*shape).astype(onp.float32))
@@ -65,6 +67,10 @@ def main(argv=None):
         net = getattr(vision, args.model)()
         net.initialize(ctx=mx.cpu())
         net(nd.zeros((1, 3, args.image_size, args.image_size)))
+        # whole-graph jit: eager per-op dispatch through the TPU tunnel
+        # costs one compile per distinct op/shape — hybridize collapses
+        # the model to a single compiled program per input shape
+        net.hybridize()
         return net
 
     def top1(net):
@@ -80,14 +86,24 @@ def main(argv=None):
         return args.batch * len(eval_x) / dt
 
     float_net = build()
+    print("[int8] float model built; evaluating...", file=sys.stderr,
+          flush=True)
     ref_pred = top1(float_net)
     float_ips = imgs_per_sec(float_net)
+    print(f"[int8] float baseline {float_ips:.1f} img/s", file=sys.stderr,
+          flush=True)
 
     for mode in args.modes.split(","):
+        print(f"[int8] calibrating mode={mode}...", file=sys.stderr,
+              flush=True)
         qnet = quantize_net(build(), calib_data=calib_x, calib_mode=mode,
                             exclude_layers=tuple(
                                 args.exclude_layers.split(",")),
                             num_calib_batches=args.calib_batches)
+        if hasattr(qnet, "hybridize"):
+            qnet.hybridize()
+        print(f"[int8] mode={mode} quantized; evaluating...",
+              file=sys.stderr, flush=True)
         q_pred = top1(qnet)
         agree = float(onp.mean([(a == b).mean()
                                 for a, b in zip(ref_pred, q_pred)]))

@@ -3,11 +3,22 @@
 The flagship benchmark model (BASELINE config 2: ResNet-50).  Identical
 architecture to the reference zoo: V1 = post-activation (He et al. 2015),
 V2 = pre-activation (He et al. 2016), thumbnail variant for CIFAR.
+
+TPU extensions (reference-compatible additions, not divergences):
+- ``layout="NHWC"``: channel-minor data layout end to end (the
+  reference's Conv2D layout knob, its cuDNN fp16 fast path; here the
+  layout the Pallas fused-block kernels read).
+- ``fused=True`` (+ NHWC): BottleneckV1 training forward runs the
+  fused matmul+BN Pallas path (ops/fused_block.py) — 1x1 convs emit BN
+  batch stats from the matmul epilogue and apply the previous BN's
+  normalize+ReLU in the prologue, eliminating the BN-structured HBM
+  traffic the round-4 roofline identified.
 """
 from __future__ import annotations
 
 from ... import nn
-from ...block import HybridBlock
+from ...block import HybridBlock, register_state_update
+from ....ops.registry import invoke
 
 __all__ = ["ResNetV1", "ResNetV2", "get_resnet",
            "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
@@ -15,27 +26,50 @@ __all__ = ["ResNetV1", "ResNetV2", "get_resnet",
            "resnet101_v2", "resnet152_v2"]
 
 
-def _conv3x3(channels, stride, in_channels):
+def _bn_axis(layout):
+    return -1 if layout == "NHWC" else 1
+
+
+def _check_fused(fused, layout, cls):
+    """fused=True must never silently degrade to the plain path: a
+    benchmark tagged 'fusedblk' (bench.py metric suffix) has to mean the
+    fused kernels actually ran."""
+    if not fused:
+        return
+    if cls != "BottleneckV1":
+        raise ValueError(
+            f"fused=True is implemented for BottleneckV1 only "
+            f"(ResNet-50/101/152 v1); {cls} has no fused path")
+    if layout != "NHWC":
+        raise ValueError(
+            "fused=True requires layout='NHWC' (the fused matmul+BN "
+            "kernels read channel-minor [M, C] views)")
+
+
+def _conv3x3(channels, stride, in_channels, layout="NCHW"):
     return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                     use_bias=False, in_channels=in_channels)
+                     use_bias=False, in_channels=in_channels, layout=layout)
 
 
 class BasicBlockV1(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+                 layout="NCHW", fused=False, **kwargs):
         super().__init__(**kwargs)
+        _check_fused(fused, layout, type(self).__name__)
+        ax = _bn_axis(layout)
         self.body = nn.HybridSequential()
-        self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
+        self.body.add(_conv3x3(channels, stride, in_channels, layout))
+        self.body.add(nn.BatchNorm(axis=ax))
         self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
+        self.body.add(_conv3x3(channels, 1, channels, layout))
+        self.body.add(nn.BatchNorm(axis=ax))
         if downsample:
             self.downsample = nn.HybridSequential()
             self.downsample.add(nn.Conv2D(channels, kernel_size=1,
                                           strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
+                                          in_channels=in_channels,
+                                          layout=layout))
+            self.downsample.add(nn.BatchNorm(axis=ax))
         else:
             self.downsample = None
         self.relu = nn.Activation("relu")
@@ -50,30 +84,100 @@ class BasicBlockV1(HybridBlock):
 
 class BottleneckV1(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+                 layout="NCHW", fused=False, **kwargs):
         super().__init__(**kwargs)
+        _check_fused(fused, layout, "BottleneckV1")
+        ax = _bn_axis(layout)
+        self._stride = stride
+        self._fused = bool(fused)
         self.body = nn.HybridSequential()
         self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride,
-                                use_bias=False))
-        self.body.add(nn.BatchNorm())
+                                use_bias=False, layout=layout))
+        self.body.add(nn.BatchNorm(axis=ax))
         self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
+        self.body.add(_conv3x3(channels // 4, 1, channels // 4, layout))
+        self.body.add(nn.BatchNorm(axis=ax))
         self.body.add(nn.Activation("relu"))
         self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1,
-                                use_bias=False))
-        self.body.add(nn.BatchNorm())
+                                use_bias=False, layout=layout))
+        self.body.add(nn.BatchNorm(axis=ax))
         if downsample:
             self.downsample = nn.HybridSequential()
             self.downsample.add(nn.Conv2D(channels, kernel_size=1,
                                           strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
+                                          in_channels=in_channels,
+                                          layout=layout))
+            self.downsample.add(nn.BatchNorm(axis=ax))
         else:
             self.downsample = None
         self.relu = nn.Activation("relu")
 
+    def _finish_deferred(self, x):
+        """Resolve deferred parameter shapes without running the body
+        (the fused path bypasses the child layers' forwards)."""
+        ci = x.shape[-1]
+        cm = self.body[0]._channels
+        co = self.body[6]._channels
+        for conv, cin in ((self.body[0], ci), (self.body[3], cm),
+                          (self.body[6], cm)):
+            if conv.weight._data is None:
+                conv.weight.shape = ((conv._channels,) + conv._kernel
+                                     + (cin // conv._groups,))
+                conv.weight._finish_deferred_init()
+        for bn, c in ((self.body[1], cm), (self.body[4], cm),
+                      (self.body[7], co)):
+            for p in (bn.gamma, bn.beta, bn.running_mean, bn.running_var):
+                if p._data is None:
+                    p.shape = (c,)
+                    p._finish_deferred_init()
+        if self.downsample is not None:
+            dconv, dbn = self.downsample[0], self.downsample[1]
+            if dconv.weight._data is None:
+                dconv.weight.shape = ((dconv._channels,) + dconv._kernel
+                                      + (ci // dconv._groups,))
+                dconv.weight._finish_deferred_init()
+            for p in (dbn.gamma, dbn.beta, dbn.running_mean,
+                      dbn.running_var):
+                if p._data is None:
+                    p.shape = (co,)
+                    p._finish_deferred_init()
+
+    def _forward_fused(self, x):
+        from ....ops import fused_block  # noqa: F401 — registers the ops
+        self._finish_deferred(x)
+        bn1, bn2, bn3 = self.body[1], self.body[4], self.body[7]
+
+        def bn_args(bn):
+            return (bn.gamma.data(), bn.beta.data(),
+                    bn.running_mean.data(), bn.running_var.data())
+
+        args = [x]
+        for conv, bn in ((self.body[0], bn1), (self.body[3], bn2),
+                         (self.body[6], bn3)):
+            args.append(conv.weight.data())
+            args.extend(bn_args(bn))
+        kwargs = dict(stride=self._stride, eps=bn1._epsilon,
+                      momentum=bn1._momentum)
+        if self.downsample is not None:
+            dconv, dbn = self.downsample[0], self.downsample[1]
+            args.append(dconv.weight.data())
+            args.extend(bn_args(dbn))
+            outs = invoke("_fused_bottleneck_v1_proj", *args, **kwargs)
+            bns = (bn1, bn2, bn3, dbn)
+        else:
+            outs = invoke("_fused_bottleneck_v1", *args, **kwargs)
+            bns = (bn1, bn2, bn3)
+        out = outs[0]
+        for i, bn in enumerate(bns):
+            register_state_update(bn.running_mean, outs[1 + 2 * i])
+            register_state_update(bn.running_var, outs[2 + 2 * i])
+        return out
+
     def forward(self, x):
+        if self._fused:
+            from .... import autograd
+            if autograd.is_training():
+                return self._forward_fused(x)
         residual = x
         x_out = self.body(x)
         if self.downsample is not None:
@@ -83,16 +187,19 @@ class BottleneckV1(HybridBlock):
 
 class BasicBlockV2(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+                 layout="NCHW", fused=False, **kwargs):
         super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
+        _check_fused(fused, layout, "BasicBlockV2")
+        ax = _bn_axis(layout)
+        self.bn1 = nn.BatchNorm(axis=ax)
+        self.conv1 = _conv3x3(channels, stride, in_channels, layout)
+        self.bn2 = nn.BatchNorm(axis=ax)
+        self.conv2 = _conv3x3(channels, 1, channels, layout)
         self.relu = nn.Activation("relu")
         if downsample:
             self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
+                                        in_channels=in_channels,
+                                        layout=layout)
         else:
             self.downsample = None
 
@@ -109,18 +216,22 @@ class BasicBlockV2(HybridBlock):
 
 class BottleneckV2(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+                 layout="NCHW", fused=False, **kwargs):
         super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, 1, 1, use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, 1, 1, use_bias=False)
+        _check_fused(fused, layout, "BottleneckV2")
+        ax = _bn_axis(layout)
+        self.bn1 = nn.BatchNorm(axis=ax)
+        self.conv1 = nn.Conv2D(channels // 4, 1, 1, use_bias=False,
+                               layout=layout)
+        self.bn2 = nn.BatchNorm(axis=ax)
+        self.conv2 = _conv3x3(channels // 4, stride, channels // 4, layout)
+        self.bn3 = nn.BatchNorm(axis=ax)
+        self.conv3 = nn.Conv2D(channels, 1, 1, use_bias=False, layout=layout)
         self.relu = nn.Activation("relu")
         if downsample:
             self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
+                                        in_channels=in_channels,
+                                        layout=layout)
         else:
             self.downsample = None
 
@@ -150,8 +261,11 @@ class S2DStem(HybridBlock):
     BENCH_STEM=s2d.
     """
 
-    def __init__(self, channels, **kwargs):
+    def __init__(self, channels, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
+        if layout != "NCHW":
+            raise ValueError("stem='s2d' is NCHW-only (space_to_depth op "
+                             "layout); use the conv7 stem with NHWC")
         self.conv = nn.Conv2D(channels, 4, 1, 2, use_bias=False,
                               in_channels=12)
 
@@ -170,40 +284,44 @@ class S2DStem(HybridBlock):
         return y[:, :, :-1, :-1]
 
 
-def _add_stem(features, channels, thumbnail, stem):
+def _add_stem(features, channels, thumbnail, stem, layout="NCHW"):
     if thumbnail:
-        features.add(_conv3x3(channels, 1, 0))
+        features.add(_conv3x3(channels, 1, 0, layout))
         return
     if stem == "s2d":
-        features.add(S2DStem(channels))
+        features.add(S2DStem(channels, layout=layout))
     else:
-        features.add(nn.Conv2D(channels, 7, 2, 3, use_bias=False))
-    features.add(nn.BatchNorm())
+        features.add(nn.Conv2D(channels, 7, 2, 3, use_bias=False,
+                               layout=layout))
+    features.add(nn.BatchNorm(axis=_bn_axis(layout)))
     features.add(nn.Activation("relu"))
-    features.add(nn.MaxPool2D(3, 2, 1))
+    features.add(nn.MaxPool2D(3, 2, 1, layout=layout))
 
 
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 stem="conv7", **kwargs):
+                 stem="conv7", layout="NCHW", fused=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
+        self._layout = layout
         self.features = nn.HybridSequential()
-        _add_stem(self.features, channels[0], thumbnail, stem)
+        _add_stem(self.features, channels[0], thumbnail, stem, layout)
         for i, num_layer in enumerate(layers):
             stride = 1 if i == 0 else 2
             self.features.add(self._make_layer(
                 block, num_layer, channels[i + 1], stride,
-                in_channels=channels[i]))
-        self.features.add(nn.GlobalAvgPool2D())
+                in_channels=channels[i], layout=layout, fused=fused))
+        self.features.add(nn.GlobalAvgPool2D(layout=layout))
         self.output = nn.Dense(classes)
 
-    def _make_layer(self, block, layers, channels, stride, in_channels=0):
+    def _make_layer(self, block, layers, channels, stride, in_channels=0,
+                    layout="NCHW", fused=False):
         layer = nn.HybridSequential()
         layer.add(block(channels, stride, channels != in_channels,
-                        in_channels=in_channels))
+                        in_channels=in_channels, layout=layout, fused=fused))
         for _ in range(layers - 1):
-            layer.add(block(channels, 1, False, in_channels=channels))
+            layer.add(block(channels, 1, False, in_channels=channels,
+                            layout=layout, fused=fused))
         return layer
 
     def forward(self, x):
@@ -213,21 +331,23 @@ class ResNetV1(HybridBlock):
 
 class ResNetV2(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 stem="conv7", **kwargs):
+                 stem="conv7", layout="NCHW", fused=False, **kwargs):
         super().__init__(**kwargs)
+        self._layout = layout
         self.features = nn.HybridSequential()
-        self.features.add(nn.BatchNorm(scale=False, center=False))
-        _add_stem(self.features, channels[0], thumbnail, stem)
+        self.features.add(nn.BatchNorm(axis=_bn_axis(layout), scale=False,
+                                       center=False))
+        _add_stem(self.features, channels[0], thumbnail, stem, layout)
         in_channels = channels[0]
         for i, num_layer in enumerate(layers):
             stride = 1 if i == 0 else 2
             self.features.add(self._make_layer(
                 block, num_layer, channels[i + 1], stride,
-                in_channels=in_channels))
+                in_channels=in_channels, layout=layout, fused=fused))
             in_channels = channels[i + 1]
-        self.features.add(nn.BatchNorm())
+        self.features.add(nn.BatchNorm(axis=_bn_axis(layout)))
         self.features.add(nn.Activation("relu"))
-        self.features.add(nn.GlobalAvgPool2D())
+        self.features.add(nn.GlobalAvgPool2D(layout=layout))
         self.features.add(nn.Flatten())
         self.output = nn.Dense(classes)
 

@@ -270,7 +270,7 @@ class BatchNorm(HybridBlock):
             out, new_mean, new_var = invoke(
                 "BatchNorm", x, self.gamma.data(), self.beta.data(),
                 self.running_mean.data(), self.running_var.data(),
-                eps=self._epsilon, momentum=self._momentum,
+                eps=self._epsilon, momentum=self._momentum, axis=self._axis,
                 fix_gamma=not self._scale, training=True)
             register_state_update(self.running_mean, new_mean)
             register_state_update(self.running_var, new_var)
@@ -278,7 +278,8 @@ class BatchNorm(HybridBlock):
         return invoke("BatchNorm", x, self.gamma.data(), self.beta.data(),
                       self.running_mean.data(), self.running_var.data(),
                       eps=self._epsilon, momentum=self._momentum,
-                      fix_gamma=not self._scale, training=False)
+                      axis=self._axis, fix_gamma=not self._scale,
+                      training=False)
 
 
 class BatchNormReLU(BatchNorm):

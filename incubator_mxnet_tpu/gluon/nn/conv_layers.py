@@ -21,8 +21,14 @@ class _Conv(HybridBlock):
     def __init__(self, channels, kernel_size, strides, padding, dilation,
                  groups, in_channels, activation, use_bias,
                  weight_initializer, bias_initializer, ndim,
-                 transpose=False, output_padding=0, **kwargs):
+                 transpose=False, output_padding=0, layout=None, **kwargs):
         super().__init__(**kwargs)
+        self._layout = layout
+        self._channel_minor = bool(layout) and layout.endswith("C")
+        if self._channel_minor and transpose:
+            raise ValueError("channel-minor layout is not supported for "
+                             "transposed convolution (reference limits the "
+                             "layout knob to Convolution too)")
         self._channels = channels
         self._in_channels = in_channels
         self._kernel = _tuple(kernel_size, ndim)
@@ -37,6 +43,9 @@ class _Conv(HybridBlock):
         self._output_padding = _tuple(output_padding, ndim)
         if transpose:
             wshape = (in_channels, channels // groups) + self._kernel
+        elif self._channel_minor:  # O, *K, I (reference NHWC kernel layout)
+            wshape = (channels,) + self._kernel \
+                + ((in_channels // groups) if in_channels else 0,)
         else:
             wshape = (channels, (in_channels // groups) if in_channels else 0) \
                 + self._kernel
@@ -51,11 +60,14 @@ class _Conv(HybridBlock):
             self.bias = None
 
     def _ensure_init(self, x):
-        c_in = x.shape[1]
+        c_in = x.shape[-1] if self._channel_minor else x.shape[1]
         if self.weight._data is None:
             if self._transpose:
                 self.weight.shape = (c_in, self._channels // self._groups) \
                     + self._kernel
+            elif self._channel_minor:
+                self.weight.shape = (self._channels,) + self._kernel \
+                    + (c_in // self._groups,)
             else:
                 self.weight.shape = (self._channels, c_in // self._groups) \
                     + self._kernel
@@ -78,7 +90,8 @@ class _Conv(HybridBlock):
             out = invoke("Convolution", *args, kernel=self._kernel,
                          stride=self._strides, pad=self._padding,
                          dilate=self._dilation, num_filter=self._channels,
-                         num_group=self._groups, no_bias=not self._use_bias)
+                         num_group=self._groups, no_bias=not self._use_bias,
+                         layout=self._layout)
         if self._activation:
             out = invoke("Activation", out, act_type=self._activation)
         return out
@@ -91,7 +104,8 @@ class Conv1D(_Conv):
                  bias_initializer="zeros", **kwargs):
         super().__init__(channels, kernel_size, strides, padding, dilation,
                          groups, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer, 1, **kwargs)
+                         weight_initializer, bias_initializer, 1,
+                         layout=layout, **kwargs)
 
 
 class Conv2D(_Conv):
@@ -101,7 +115,8 @@ class Conv2D(_Conv):
                  bias_initializer="zeros", **kwargs):
         super().__init__(channels, kernel_size, strides, padding, dilation,
                          groups, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer, 2, **kwargs)
+                         weight_initializer, bias_initializer, 2,
+                         layout=layout, **kwargs)
 
 
 class Conv3D(_Conv):
@@ -111,7 +126,8 @@ class Conv3D(_Conv):
                  weight_initializer=None, bias_initializer="zeros", **kwargs):
         super().__init__(channels, kernel_size, strides, padding, dilation,
                          groups, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer, 3, **kwargs)
+                         weight_initializer, bias_initializer, 3,
+                         layout=layout, **kwargs)
 
 
 class Conv1DTranspose(_Conv):
@@ -153,8 +169,9 @@ class Conv3DTranspose(_Conv):
 
 class _Pool(HybridBlock):
     def __init__(self, pool_size, strides, padding, global_pool, pool_type,
-                 ndim, count_include_pad=True, **kwargs):
+                 ndim, count_include_pad=True, layout=None, **kwargs):
         super().__init__(**kwargs)
+        self._layout = layout
         self._kernel = _tuple(pool_size, ndim)
         self._strides = _tuple(strides if strides is not None else pool_size, ndim)
         self._padding = _tuple(padding, ndim)
@@ -166,21 +183,24 @@ class _Pool(HybridBlock):
         return invoke("Pooling", x, kernel=self._kernel,
                       pool_type=self._pool_type, global_pool=self._global,
                       stride=self._strides, pad=self._padding,
-                      count_include_pad=self._count_include_pad)
+                      count_include_pad=self._count_include_pad,
+                      layout=self._layout)
 
 
 def _make_pool(name, pool_type, ndim, global_pool):
     if global_pool:
         class P(_Pool):
             def __init__(self, layout=None, **kwargs):
-                super().__init__(1, 1, 0, True, pool_type, ndim, **kwargs)
+                super().__init__(1, 1, 0, True, pool_type, ndim,
+                                 layout=layout, **kwargs)
     else:
         class P(_Pool):
             def __init__(self, pool_size=2, strides=None, padding=0,
                          layout=None, ceil_mode=False, count_include_pad=True,
                          **kwargs):
                 super().__init__(pool_size, strides, padding, False, pool_type,
-                                 ndim, count_include_pad, **kwargs)
+                                 ndim, count_include_pad, layout=layout,
+                                 **kwargs)
     P.__name__ = P.__qualname__ = name
     return P
 

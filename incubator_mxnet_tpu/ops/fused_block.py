@@ -228,27 +228,22 @@ def _bwd_impl(x, w, scale, bias, y, dy, ds1, ds2, prologue):
         interpret=interpret_mode(),
     )(dyp, yp, ds1p, ds2p, wp, xp, scp, bip)
 
-    # --- dw ---
-    bm2 = 256
+    # --- dw --- (same M tiling as dx: the padded dy/y/x are reused)
     bk2 = min(512, kp)
     bn2 = min(512, np_)
-    mp2 = _round_up(m, bm2)
-    xp2 = jnp.pad(x, ((0, mp2 - m), (0, kp - k)))
-    dyp2 = jnp.pad(dy, ((0, mp2 - m), (0, np_ - n)))
-    yp2 = jnp.pad(y, ((0, mp2 - m), (0, np_ - n)))
     # dw accumulates across M blocks in fp32 (a bf16 running sum loses
     # mantissa every iteration); cast to the weight dtype at the end
     dw = pl.pallas_call(
-        functools.partial(_bwd_dw_kernel, m_real=m, bm=bm2,
+        functools.partial(_bwd_dw_kernel, m_real=m, bm=bm,
                           prologue=prologue),
         out_shape=jax.ShapeDtypeStruct((kp, np_), jnp.float32),
-        grid=(kp // bk2, np_ // bn2, mp2 // bm2),
+        grid=(kp // bk2, np_ // bn2, mp // bm),
         in_specs=[
-            pl.BlockSpec((bm2, bk2), lambda kj, nj, i: (i, kj),
+            pl.BlockSpec((bm, bk2), lambda kj, nj, i: (i, kj),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((bm2, bn2), lambda kj, nj, i: (i, nj),
+            pl.BlockSpec((bm, bn2), lambda kj, nj, i: (i, nj),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((bm2, bn2), lambda kj, nj, i: (i, nj),
+            pl.BlockSpec((bm, bn2), lambda kj, nj, i: (i, nj),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bn2), lambda kj, nj, i: (0, nj),
                          memory_space=pltpu.VMEM),
@@ -262,7 +257,7 @@ def _bwd_impl(x, w, scale, bias, y, dy, ds1, ds2, prologue):
         out_specs=pl.BlockSpec((bk2, bn2), lambda kj, nj, i: (kj, nj),
                                memory_space=pltpu.VMEM),
         interpret=interpret_mode(),
-    )(xp2, dyp2, yp2, ds1p, ds2p, scp, bip)
+    )(xp, dyp, yp, ds1p, ds2p, scp, bip)
 
     dx = dx[:m, :k]
     dw = dw[:k, :n].astype(w.dtype)
@@ -327,13 +322,111 @@ def fused_matmul_bn(x, w, scale=None, bias=None):
     if scale is None:
         scale = jnp.ones((x.shape[1],), jnp.float32)
         bias = jnp.zeros((x.shape[1],), jnp.float32)
-    if not (use_pallas("fused_matmul_bn") or interpret_mode()):
+    if not use_pallas("fused_matmul_bn"):
+        # same contract as every other kernel gate (e.g. layer_norm):
+        # off-TPU auto mode falls back to the XLA composition; tests
+        # that want interpret-mode Pallas force MXNET_USE_PALLAS=1
         return xla_matmul_bn(x, w, scale if prologue else None,
                              bias if prologue else None)
     return _fmm(x, w, scale, bias, prologue)
 
 
-def bn_consts(s1, s2, m, gamma, beta, eps=1e-5, dtype=jnp.bfloat16):
+def _bottleneck_core(x, w1, g1, b1, w2, g2, b2, w3, g3, b3,
+                     wsc, gsc, bsc, stride, eps):
+    """Bottleneck-V1 body with fused 1x1 matmul+BN kernels (NHWC).
+
+    Weights are zoo NHWC kernels (O, kh, kw, I); the 1x1 convs become
+    fused_matmul_bn calls (stats in the epilogue; bn2's normalize+relu
+    in c3's prologue), the 3x3 stays an XLA conv.  Returns the block
+    output plus every BN's batch mean/var so the gluon layer can update
+    moving stats (reference BatchNork aux-state mutation contract).
+    """
+    n, h, w_, _ = x.shape
+    s = int(stride)
+    xs = x[:, ::s, ::s, :] if s > 1 else x
+    flat = lambda t: t.reshape(-1, t.shape[-1])
+    mm = lambda w4: w4.reshape(w4.shape[0], -1).T  # (O,1,1,I) -> (I,O)
+
+    hs, ws = xs.shape[1], xs.shape[2]  # ::s slice is ceil(h/s), not h//s
+    y1, a1, c1 = fused_matmul_bn(flat(xs), mm(w1))
+    m1 = y1.shape[0]
+    sc1, of1, mean1, var1 = bn_consts(a1, c1, m1, g1, b1, eps)
+    cm = y1.shape[-1]
+    y1n = jnp.maximum(y1.astype(jnp.float32) * sc1 + of1, 0.0)
+    y1n = y1n.astype(x.dtype).reshape(n, hs, ws, cm)
+
+    dn = jax.lax.conv_dimension_numbers(y1n.shape, w2.shape,
+                                        ("NHWC", "OHWI", "NHWC"))
+    y2 = jax.lax.conv_general_dilated(
+        y1n, w2, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn
+    ).astype(x.dtype)
+    mean2 = jnp.mean(y2, (0, 1, 2), dtype=jnp.float32)
+    meansq2 = jnp.mean(jnp.square(y2), (0, 1, 2), dtype=jnp.float32)
+    var2 = jnp.maximum(meansq2 - jnp.square(mean2), 0.0)
+    rstd2 = jax.lax.rsqrt(var2 + eps)
+    sc2 = g2.astype(jnp.float32) * rstd2
+    of2 = b2.astype(jnp.float32) - mean2 * sc2
+
+    y3, a3, c3 = fused_matmul_bn(flat(y2), mm(w3), sc2, of2)
+    sc3, of3, mean3, var3 = bn_consts(a3, c3, y3.shape[0], g3, b3, eps)
+
+    if wsc is not None:
+        ysc, asc, csc = fused_matmul_bn(flat(xs), mm(wsc))
+        sccs, ofcs, meansc, varsc = bn_consts(asc, csc, ysc.shape[0],
+                                              gsc, bsc, eps)
+        short = ysc.astype(jnp.float32) * sccs + ofcs
+    else:
+        short = flat(xs).astype(jnp.float32)
+    out = jnp.maximum(y3.astype(jnp.float32) * sc3 + of3 + short, 0.0)
+    out = out.astype(x.dtype).reshape(n, hs, ws, y3.shape[-1])
+    stats = (mean1, var1, mean2, var2, mean3, var3)
+    if wsc is not None:
+        stats = stats + (meansc, varsc)
+    return (out,) + stats
+
+
+def _blend(momentum, old, new):
+    return momentum * old + (1.0 - momentum) * new.astype(old.dtype)
+
+
+def fused_bottleneck_v1(x, w1, g1, b1, rm1, rv1, w2, g2, b2, rm2, rv2,
+                        w3, g3, b3, rm3, rv3, stride=1, eps=1e-5,
+                        momentum=0.9):
+    """Identity-shortcut fused bottleneck (see _bottleneck_core).
+
+    Follows the BatchNorm op contract (ops/nn_ops.py batch_norm): batch
+    stats are folded into updated moving mean/var returned alongside the
+    output; the gluon layer routes them through register_state_update.
+    """
+    out, m1, v1, m2, v2, m3, v3 = _bottleneck_core(
+        x, w1, g1, b1, w2, g2, b2, w3, g3, b3, None, None, None,
+        stride, eps)
+    b = functools.partial(_blend, momentum)
+    return (out, b(rm1, m1), b(rv1, v1), b(rm2, m2), b(rv2, v2),
+            b(rm3, m3), b(rv3, v3))
+
+
+def fused_bottleneck_v1_proj(x, w1, g1, b1, rm1, rv1, w2, g2, b2, rm2, rv2,
+                             w3, g3, b3, rm3, rv3, wsc, gsc, bsc, rmsc, rvsc,
+                             stride=1, eps=1e-5, momentum=0.9):
+    """Projection-shortcut fused bottleneck (see _bottleneck_core)."""
+    out, m1, v1, m2, v2, m3, v3, msc, vsc = _bottleneck_core(
+        x, w1, g1, b1, w2, g2, b2, w3, g3, b3, wsc, gsc, bsc, stride, eps)
+    b = functools.partial(_blend, momentum)
+    return (out, b(rm1, m1), b(rv1, v1), b(rm2, m2), b(rv2, v2),
+            b(rm3, m3), b(rv3, v3), b(rmsc, msc), b(rvsc, vsc))
+
+
+def _register_ops():
+    from .registry import register
+    register("_fused_bottleneck_v1")(fused_bottleneck_v1)
+    register("_fused_bottleneck_v1_proj")(fused_bottleneck_v1_proj)
+
+
+_register_ops()
+
+
+def bn_consts(s1, s2, m, gamma, beta, eps=1e-5):
     """Fold kernel stats into per-channel normalize constants.
 
     Returns ``(scale, bias, mean, var)`` with scale/bias in fp32 (fed to
@@ -341,7 +434,6 @@ def bn_consts(s1, s2, m, gamma, beta, eps=1e-5, dtype=jnp.bfloat16):
     Differentiable: gradients flow back into s1/s2 cotangents, which the
     kernel VJP folds into its matmul prologues.
     """
-    del dtype
     mf = jnp.float32(m)
     mean = s1 / mf
     var = jnp.maximum(s2 / mf - jnp.square(mean), 0.0)

@@ -61,20 +61,27 @@ def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
 def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
                 pad=None, num_filter=None, num_group=1, no_bias=False,
                 layout=None):
-    """N-D convolution, NCHW layout, weight (O, I/group, *K).
+    """N-D convolution, weight (O, I/group, *K) in the default NCHW
+    layout or (O, *K, I/group) for layout="NHWC" (reference layout
+    parameter semantics, src/operator/nn/convolution.cc — the
+    reference's NHWC path is its cuDNN fp16 fast path; here it is the
+    channel-minor layout the Pallas fused-block kernels read).
 
-    Reference: src/operator/nn/convolution.cc.  Lowers to a single
-    conv_general_dilated — XLA's conv already does implicit im2col +
-    MXU-tiled matmul, subsuming the reference's cuDNN algo selection.
+    Lowers to a single conv_general_dilated — XLA's conv already does
+    implicit im2col + MXU-tiled matmul, subsuming the reference's cuDNN
+    algo selection.
     """
     nd = x.ndim - 2
     stride = _pair(stride or 1, nd)
     dilate = _pair(dilate or 1, nd)
     pad = _pair(pad or 0, nd)
-    dn = lax.conv_dimension_numbers(
-        x.shape, weight.shape,
-        ("NCHW", "OIHW", "NCHW") if nd == 2 else
-        (("NCW", "OIW", "NCW") if nd == 1 else ("NCDHW", "OIDHW", "NCDHW")))
+    if layout is not None and layout.endswith("C") and nd >= 1:
+        spatial = "DHW"[3 - nd:]
+        dn_str = (f"N{spatial}C", f"O{spatial}I", f"N{spatial}C")
+    else:
+        spatial = "DHW"[3 - nd:]
+        dn_str = (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}")
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, dn_str)
     # no explicit preferred_element_type (see fully_connected note)
     y = lax.conv_general_dilated(
         x, weight, window_strides=stride,
@@ -83,7 +90,10 @@ def convolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
         feature_group_count=num_group)
     y = y.astype(x.dtype)
     if bias is not None and not no_bias:
-        y = y + bias.reshape((1, -1) + (1,) * nd)
+        bshape = ((1,) * (nd + 1) + (-1,)
+                  if layout is not None and layout.endswith("C")
+                  else (1, -1) + (1,) * nd)
+        y = y + bias.reshape(bshape)
     return y
 
 
@@ -123,11 +133,15 @@ def deconvolution(x, weight, bias=None, kernel=None, stride=None, dilate=None,
 
 @register("Pooling", aliases=("pooling",))
 def pooling(x, kernel=None, pool_type="max", global_pool=False, stride=None,
-            pad=None, count_include_pad=True, pooling_convention="valid"):
-    """Max/avg/sum/lp pooling via reduce_window (reference nn/pooling.cc)."""
+            pad=None, count_include_pad=True, pooling_convention="valid",
+            layout=None):
+    """Max/avg/sum/lp pooling via reduce_window (reference nn/pooling.cc;
+    layout="NHWC" puts channels minor, matching the conv layout knob)."""
     nd = x.ndim - 2
+    nhwc = layout is not None and layout.endswith("C")
     if global_pool:
-        axes = tuple(range(2, x.ndim))
+        axes = tuple(range(1, x.ndim - 1)) if nhwc \
+            else tuple(range(2, x.ndim))
         if pool_type == "max":
             out = jnp.max(x, axis=axes, keepdims=True)
         else:
@@ -136,9 +150,14 @@ def pooling(x, kernel=None, pool_type="max", global_pool=False, stride=None,
     kernel = _pair(kernel, nd)
     stride = _pair(stride or kernel, nd)
     pad = _pair(pad or 0, nd)
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if nhwc:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = ((0, 0),) + tuple((p, p) for p in pad) + ((0, 0),)
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
     import numpy as _np
     if pool_type == "max":
         # init must be a SCALAR (python/numpy), not a jax array constant:

@@ -40,5 +40,5 @@ run bench     1100 env BENCH_DEADLINE=1000 BENCH_SWEEP=128,256,512 python bench.
 run benchrem  900  env BENCH_DEADLINE=800 BENCH_SWEEP=256,512 BENCH_REMAT=dots python bench.py
 run consist   1500 python scripts/tpu_consistency.py --deadline 1400
 run opperf    1800 python benchmark/opperf.py --platform tpu --resume --output artifacts/r4/opperf_tpu.json
-run int8      900  python examples/quantize_resnet50.py
+run int8      1500 python examples/quantize_resnet50.py
 echo "queue complete"

@@ -35,6 +35,17 @@ run fusedver  900  env PROBE_FUSED=1 PROBE_VERIFY=1 PROBE_BS=128 \
                        python scripts/perf_probe.py raw
 run fused256  900  env PROBE_FUSED=1 PROBE_BS=256 \
                        python scripts/perf_probe.py raw
+# framework-level A/B: NHWC layout alone, then NHWC + fused blocks
+run benchnhwc 900  env BENCH_DEADLINE=800 BENCH_SWEEP=256 BENCH_LAYOUT=NHWC \
+                       python bench.py
+run benchfus  1100 env BENCH_DEADLINE=1000 BENCH_SWEEP=128,256 \
+                       BENCH_LAYOUT=NHWC BENCH_FUSED=1 python bench.py
 # XLA knob sweep on the un-fused step (independent lever)
 run flags     2400 python scripts/flag_sweep.py
+# zoo INFERENCE sweep on chip — BASELINE.md's headline tables are
+# inference img/s (perf.md:165-210); fp32 + the fp16-table analog (bf16)
+run score32   1500 python benchmark/score.py --batches 32 \
+                       --json artifacts/r4/score_fp32.json
+run scorebf   1500 python benchmark/score.py --batches 32,128 \
+                       --dtype bfloat16 --json artifacts/r4/score_bf16.json
 echo "queue 3 complete"

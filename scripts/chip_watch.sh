@@ -15,6 +15,7 @@ float((x@x).sum())" >/dev/null 2>&1; then
     echo "[watch] $(date -u +%H:%M:%S) chip ALIVE — draining queues"
     bash scripts/chip_queue.sh
     bash scripts/chip_queue2.sh
+    bash scripts/chip_queue3.sh
     if ! grep -l "QUEUE_FAILED" artifacts/r4/*.txt >/dev/null 2>&1; then
       echo "[watch] all queue artifacts clean — done"; exit 0
     fi

@@ -307,6 +307,56 @@ def test_launch_ssh_loopback(tmp_path):
         f"stderr:\n{proc.stderr}")
 
 
+def test_launch_sge_fake_qsub(tmp_path):
+    """launch_sge submits a qsub array job; the shim runs the generated
+    job script locally once per task with SGE_TASK_ID=1..N (what gridengine
+    would do across the cluster) and blocks like ``-sync y``.  Worker ids
+    derive from SGE_TASK_ID inside the job script — the real path."""
+    qsub = tmp_path / "fake_qsub"
+    _write_exec(qsub, """#!/usr/bin/env python
+import subprocess, sys
+args = sys.argv[1:]
+spec, script = None, None
+i = 0
+while i < len(args):
+    if args[i] == "-t":
+        spec = args[i + 1]; i += 2
+    elif args[i] == "-sync":
+        i += 2
+    else:
+        script = args[i]; i += 1
+lo, hi = spec.split("-")
+procs = [subprocess.Popen(["bash", script],
+                          env={**__import__("os").environ,
+                               "SGE_TASK_ID": str(t)})
+         for t in range(int(lo), int(hi) + 1)]
+sys.exit(max(p.wait() for p in procs))
+""")
+    script = os.path.join(REPO, "tests", "_dist_sge_worker_tmp.py")
+    with open(script, "w") as f:
+        f.write(_WORKER_SCRIPT)
+    env = dict(os.environ)
+    env["MXT_REPO"] = REPO
+    env["MXT_TEST_KVTYPE"] = "dist_sync"
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+             "-n", "2", "--launcher", "sge",
+             "--qsub-cmd", str(qsub), "--sge-head", "127.0.0.1",
+             "--env", "MXT_REPO:" + REPO,
+             "--env", "MXT_TEST_KVTYPE:dist_sync",
+             "--env", "JAX_PLATFORMS:cpu",
+             sys.executable, script],
+            env=env, capture_output=True, text=True, timeout=240)
+    finally:
+        os.unlink(script)
+    assert proc.returncode == 0, (
+        f"sge launcher failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}")
+
+
 def test_launch_mpi_fake_mpirun(tmp_path):
     """launch_mpi builds the mpirun command; ranks derive MXT_WORKER_ID
     from OMPI_COMM_WORLD_RANK (set per-rank by the fake mpirun here,

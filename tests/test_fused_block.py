@@ -7,16 +7,21 @@ constants of the *next* layer, exactly how the bottleneck chain uses
 it).  Kernels run in interpret mode on CPU (same numerics as Mosaic up
 to dot rounding); the on-chip proof lives in scripts/pallas_smoke.py.
 """
-import os
-
 import numpy as onp
 import jax
 import jax.numpy as jnp
 import pytest
 
-os.environ.setdefault("MXNET_USE_PALLAS", "1")
-
 from incubator_mxnet_tpu.ops import fused_block as fb
+
+
+@pytest.fixture(autouse=True)
+def _force_pallas(monkeypatch):
+    """Interpret-mode kernels need the explicit override — scoped per
+    test so the flag cannot leak into other files' manifest-gating
+    tests (a module-level setenv broke
+    test_flash_attention_falls_back_when_marked_bad in the full suite)."""
+    monkeypatch.setenv("MXNET_USE_PALLAS", "1")
 
 
 def _mk(m, k, n, dtype, seed=0):
@@ -124,3 +129,77 @@ def test_bn_consts_chain_grad():
     for a, b in zip(g, gr):
         onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
                                     rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# gluon zoo integration (layout="NHWC", fused=True)
+# ---------------------------------------------------------------------------
+
+def _transpose_params_nchw_to_nhwc(src, dst):
+    """Copy src (NCHW zoo net) params into dst (NHWC zoo net), moving
+    conv kernels OIHW -> OHWI."""
+    sp, dp = src.collect_params(), dst.collect_params()
+    from incubator_mxnet_tpu import nd
+    for name, p in sp.items():
+        q = dp[name]
+        if p.shape and len(p.shape) == 4 and name.endswith("weight") \
+                and q.shape != p.shape:
+            q.set_data(nd.transpose(p.data(), (0, 2, 3, 1)))
+        else:
+            q.set_data(p.data())
+
+
+def test_zoo_nhwc_layout_matches_nchw():
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, autograd
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    a = vision.resnet18_v1(classes=10)
+    b = vision.resnet18_v1(classes=10, layout="NHWC")
+    x = nd.random.uniform(shape=(2, 3, 32, 32))
+    a.initialize(ctx=mx.cpu())
+    b.initialize(ctx=mx.cpu())
+    a(x)
+    b(nd.transpose(x, (0, 2, 3, 1)))  # resolve deferred shapes
+    _transpose_params_nchw_to_nhwc(a, b)
+    ya = a(x).asnumpy()
+    yb = b(nd.transpose(x, (0, 2, 3, 1))).asnumpy()
+    onp.testing.assert_allclose(ya, yb, rtol=1e-4, atol=1e-4)
+
+
+def test_zoo_fused_bottleneck_matches_unfused():
+    """fused=True BottleneckV1 training forward/backward == the layer
+    composition, and moving stats update identically."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, autograd
+    from incubator_mxnet_tpu.gluon.model_zoo.vision.resnet import \
+        BottleneckV1
+    for stride, down in ((1, False), (2, True)):
+        blk_f = BottleneckV1(32, stride, down, in_channels=32 if down else 32,
+                             layout="NHWC", fused=True)
+        blk_u = BottleneckV1(32, stride, down, in_channels=32 if down else 32,
+                             layout="NHWC", fused=False)
+        x = nd.random.uniform(shape=(2, 8, 8, 32))
+        blk_f.initialize(ctx=mx.cpu())
+        blk_u.initialize(ctx=mx.cpu())
+        blk_f(x)  # resolve shapes via the (eval-mode) layer path
+        blk_u(x)
+        for name, p in blk_u.collect_params().items():
+            blk_f.collect_params()[name].set_data(p.data())
+
+        def run(blk):
+            with autograd.record():
+                y = blk(x)
+                loss = (y * y).mean()
+            loss.backward()
+            g = blk.body[0].weight.grad().asnumpy()
+            return (y.asnumpy(), g,
+                    blk.body[1].running_mean.data().asnumpy(),
+                    blk.body[1].running_var.data().asnumpy())
+
+        yf, gf, rmf, rvf = run(blk_f)
+        yu, gu, rmu, rvu = run(blk_u)
+        onp.testing.assert_allclose(yf, yu, rtol=2e-3, atol=2e-3)
+        onp.testing.assert_allclose(gf, gu, rtol=2e-2, atol=2e-3)
+        # the fused path must update moving stats like the BN layers do
+        onp.testing.assert_allclose(rmf, rmu, rtol=1e-3, atol=1e-4)
+        onp.testing.assert_allclose(rvf, rvu, rtol=1e-3, atol=1e-4)

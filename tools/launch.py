@@ -241,6 +241,63 @@ def launch_mpi(args, extra_env=None):
     return [subprocess.call(cmd, env=os_env)]
 
 
+def launch_sge(args, extra_env=None):
+    """Reference dmlc_tracker/sge.py role: workers ride a qsub array
+    job (``-t 1-N``, ``-sync y`` so the launcher blocks on completion);
+    each task derives MXT_WORKER_ID from $SGE_TASK_ID.  The coordinator
+    address points at the submitting host (the reference runs its
+    tracker on the submit node the same way) and any parameter servers
+    run here as local processes.  ``--qsub-cmd`` injects the transport —
+    tests use a shim that executes the array tasks locally."""
+    import tempfile
+
+    port = args.port or _free_port()
+    head = args.sge_head or socket.gethostname()
+    coordinator = f"{head}:{port}"
+
+    procs = []
+    server_addrs = []
+    for i in range(args.num_servers):
+        sport = _free_port()
+        server_addrs.append(f"{head}:{sport}")
+        env = dict(os.environ)
+        env.update(extra_env or {})
+        env["DMLC_ROLE"] = "server"
+        env["JAX_PLATFORMS"] = "cpu"
+        code = _server_code(sport, args.kv_mode, args.num_workers)
+        procs.append(subprocess.Popen([sys.executable, "-c", code], env=env))
+
+    # template env from the shared helper; the per-task worker id is
+    # substituted by the array task itself from $SGE_TASK_ID
+    env = _worker_env(args, 0, coordinator, server_addrs)
+    env.pop("MXT_WORKER_ID"), env.pop("DMLC_WORKER_ID")
+    env.update(extra_env or {})
+    lines = ["#!/bin/bash", f"#$ -t 1-{args.num_workers}", "#$ -cwd",
+             'export MXT_WORKER_ID=$((SGE_TASK_ID-1))',
+             'export DMLC_WORKER_ID=$MXT_WORKER_ID']
+    for k, v in env.items():
+        lines.append(f"export {k}={_sh_quote(v)}")
+    lines.append("exec " + " ".join(_sh_quote(c) for c in args.command))
+    with tempfile.NamedTemporaryFile("w", suffix=".sh", delete=False) as f:
+        f.write("\n".join(lines) + "\n")
+        script = f.name
+    os.chmod(script, 0o755)
+    try:
+        rc = subprocess.call(args.qsub_cmd.split()
+                             + ["-sync", "y", "-t",
+                                f"1-{args.num_workers}", script])
+    finally:
+        os.unlink(script)
+        for p in procs:            # PS lifetime = the job's lifetime
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return [rc]
+
+
 def main():
     parser = argparse.ArgumentParser(
         description="Launch a distributed job (reference launch.py CLI)")
@@ -260,6 +317,11 @@ def main():
     parser.add_argument("--ssh-cmd", type=str, default="ssh",
                         help="ssh transport (tests inject a local shim)")
     parser.add_argument("--mpirun-cmd", type=str, default="mpirun")
+    parser.add_argument("--qsub-cmd", type=str, default="qsub",
+                        help="sge submit command (tests inject a shim)")
+    parser.add_argument("--sge-head", type=str, default=None,
+                        help="coordinator host workers dial back to "
+                             "(default: this host's name)")
     parser.add_argument("--env-server", action="append", default=[])
     parser.add_argument("--env-worker", action="append", default=[])
     parser.add_argument("--env", action="append", default=[])
@@ -275,12 +337,16 @@ def main():
         codes = launch_ssh(args)
     elif args.launcher == "mpi":
         codes = launch_mpi(args)
+    elif args.launcher == "sge":
+        codes = launch_sge(args)
     else:
         raise NotImplementedError(
-            f"launcher {args.launcher!r}: sge/yarn cluster managers are "
-            "not targeted by this build; on TPU pods use the platform "
-            "scheduler (GKE/xmanager) to start one process per host with "
-            "MXT_COORDINATOR/MXT_NUM_WORKERS/MXT_WORKER_ID")
+            "launcher 'yarn': the Hadoop/YARN application master is not "
+            "targeted by this build (reference dmlc_tracker/yarn.py ships "
+            "a Java AM); on TPU pods use the platform scheduler "
+            "(GKE/xmanager) to start one process per host with "
+            "MXT_COORDINATOR/MXT_NUM_WORKERS/MXT_WORKER_ID, or submit "
+            "through --launcher sge/ssh/mpi")
     bad = [c for c in codes if c != 0]
     sys.exit(bad[0] if bad else 0)
 
