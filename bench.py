@@ -112,6 +112,16 @@ def _child(platform: str) -> None:
     layout = os.environ.get("BENCH_LAYOUT", "NCHW").upper()
     fused = os.environ.get("BENCH_FUSED", "0") == "1"
     nhwc = layout == "NHWC"
+    if fused:
+        # the '_fusedblk' metric tag must mean the kernels actually ran:
+        # force the explicit pallas override so a missing/stale manifest
+        # fails loudly instead of silently timing the XLA fallback
+        if os.environ.get("MXNET_USE_PALLAS", "").lower() in (
+                "0", "false", "off"):
+            raise RuntimeError(
+                "BENCH_FUSED=1 with MXNET_USE_PALLAS=0 would publish a "
+                "'fusedblk' metric measured on the XLA fallback")
+        os.environ.setdefault("MXNET_USE_PALLAS", "1")
 
     def measure(bs):
         mx.random.seed(0)
@@ -325,6 +335,23 @@ def _ensure_pallas_manifest(remaining, cpu_reserve):
               flush=True)
 
 
+def _fused_known_good():
+    """Manifest says the fused matmul+BN kernel passed Mosaic on real
+    TPU.  Raw JSON read — the parent process must never import jax
+    (wedged-accelerator discipline)."""
+    path = os.environ.get("MXNET_PALLAS_MANIFEST", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "incubator_mxnet_tpu", "ops", "pallas_manifest.json"))
+    try:
+        with open(path) as f:
+            m = json.load(f)
+        return bool(m.get("platform") == "tpu"
+                    and m.get("kernels", {}).get("fused_matmul_bn",
+                                                 {}).get("ok"))
+    except (OSError, ValueError):
+        return False
+
+
 def main():
     if len(sys.argv) > 2 and sys.argv[1] == "--child":
         _child(sys.argv[2])
@@ -350,6 +377,28 @@ def main():
             budget = remaining() - cpu_reserve
             if budget > 120:
                 result = _run_child("tpu", budget)
+            if (result is not None and "BENCH_FUSED" not in os.environ
+                    and os.environ.get("BENCH_TRY_FUSED", "1") != "0"
+                    and _fused_known_good()):
+                # second attempt with the fused-bottleneck config when
+                # time remains: publish whichever is faster, keeping the
+                # loser's numbers in the JSON for the record
+                budget = remaining() - cpu_reserve
+                if budget > 180:
+                    print("[bench] trying fused-bottleneck config",
+                          file=sys.stderr, flush=True)
+                    extra = {"BENCH_LAYOUT": "NHWC", "BENCH_FUSED": "1"}
+                    if "BENCH_SWEEP" not in os.environ:
+                        extra["BENCH_SWEEP"] = "256"
+                    alt = _run_child("tpu", budget, extra)
+                    summary = lambda r: {  # noqa: E731
+                        k: r[k] for k in ("metric", "value", "step_ms")
+                        if k in r}
+                    if alt is not None and alt["value"] > result["value"]:
+                        alt["unfused_attempt"] = summary(result)
+                        result = alt
+                    elif alt is not None:
+                        result["fused_attempt"] = summary(alt)
             if result is None and os.environ.get(
                     "BENCH_PALLAS_FALLBACK", "1") != "0":
                 # degraded mode before giving up the chip (e.g. a Pallas

@@ -39,7 +39,8 @@ run fused256  900  env PROBE_FUSED=1 PROBE_BS=256 \
 run benchnhwc 900  env BENCH_DEADLINE=800 BENCH_SWEEP=256 BENCH_LAYOUT=NHWC \
                        python bench.py
 run benchfus  1100 env BENCH_DEADLINE=1000 BENCH_SWEEP=128,256 \
-                       BENCH_LAYOUT=NHWC BENCH_FUSED=1 python bench.py
+                       BENCH_LAYOUT=NHWC BENCH_FUSED=1 MXNET_USE_PALLAS=1 \
+                       python bench.py
 # XLA knob sweep on the un-fused step (independent lever)
 run flags     2400 python scripts/flag_sweep.py
 # zoo INFERENCE sweep on chip — BASELINE.md's headline tables are

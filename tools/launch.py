@@ -253,13 +253,12 @@ def launch_sge(args, extra_env=None):
 
     port = args.port or _free_port()
     head = args.sge_head or socket.gethostname()
-    coordinator = f"{head}:{port}"
 
     procs = []
     server_addrs = []
     for i in range(args.num_servers):
         sport = _free_port()
-        server_addrs.append(f"{head}:{sport}")
+        server_addrs.append(f"{head}:{sport}")  # PS genuinely run here
         env = dict(os.environ)
         env.update(extra_env or {})
         env["DMLC_ROLE"] = "server"
@@ -267,14 +266,31 @@ def launch_sge(args, extra_env=None):
         code = _server_code(sport, args.kv_mode, args.num_workers)
         procs.append(subprocess.Popen([sys.executable, "-c", code], env=env))
 
-    # template env from the shared helper; the per-task worker id is
-    # substituted by the array task itself from $SGE_TASK_ID
-    env = _worker_env(args, 0, coordinator, server_addrs)
+    # The jax.distributed coordinator is HOSTED BY WORKER 0 on whatever
+    # exec node SGE places task 1 — unknowable at submit time.  Task 1
+    # publishes its host through the shared working directory (#$ -cwd;
+    # SGE clusters share it over NFS — the same assumption the reference
+    # dmlc_tracker/sge.py makes) and the other tasks poll for it.
+    coord_file = f".mxt_sge_coord.{os.getpid()}.{port}"
+    # template env from the shared helper; worker id and coordinator
+    # host are substituted by the array task itself
+    env = _worker_env(args, 0, coordinator="__SGE__", server_addrs=server_addrs)
     env.pop("MXT_WORKER_ID"), env.pop("DMLC_WORKER_ID")
+    env.pop("MXT_COORDINATOR")
     env.update(extra_env or {})
     lines = ["#!/bin/bash", f"#$ -t 1-{args.num_workers}", "#$ -cwd",
              'export MXT_WORKER_ID=$((SGE_TASK_ID-1))',
-             'export DMLC_WORKER_ID=$MXT_WORKER_ID']
+             'export DMLC_WORKER_ID=$MXT_WORKER_ID',
+             f'if [ "$SGE_TASK_ID" = "1" ]; then',
+             f'  echo "$(hostname):{port}" > {coord_file}.tmp'
+             f' && mv {coord_file}.tmp {coord_file}',
+             'else',
+             f'  for i in $(seq 1 120); do'
+             f' [ -f {coord_file} ] && break; sleep 1; done',
+             f'  [ -f {coord_file} ] || {{ echo "coordinator file never'
+             f' appeared" >&2; exit 1; }}',
+             'fi',
+             f'export MXT_COORDINATOR="$(cat {coord_file})"']
     for k, v in env.items():
         lines.append(f"export {k}={_sh_quote(v)}")
     lines.append("exec " + " ".join(_sh_quote(c) for c in args.command))
@@ -288,6 +304,11 @@ def launch_sge(args, extra_env=None):
                                 f"1-{args.num_workers}", script])
     finally:
         os.unlink(script)
+        for leftover in (coord_file, coord_file + ".tmp"):
+            try:
+                os.unlink(leftover)
+            except OSError:
+                pass
         for p in procs:            # PS lifetime = the job's lifetime
             p.terminate()
         for p in procs:
