@@ -83,12 +83,14 @@ def _fwd_kernel(x_ref, w_ref, sc_ref, bi_ref, y_ref, s1_ref, s2_ref, *,
     s2_ref[...] += jnp.sum(jnp.square(yf), axis=0, keepdims=True)
 
 
-def _fwd_impl(x, w, scale, bias, prologue):
+def _fwd_impl(x, w, scale, bias, prologue, bm=None, bn=None):
     m, k = x.shape
     n = w.shape[1]
     kp, np_ = _round_up(k, 128), _round_up(n, 128)
-    bm = _pick_bm(np_)
-    bn = min(512, np_)
+    bm = bm or _pick_bm(np_)
+    bn = bn or min(512, np_)
+    if np_ % bn:  # grid = np_ // bn would silently drop output columns
+        raise ValueError(f"bn={bn} must divide the padded width {np_}")
     mp = _round_up(m, bm)
     xp = jnp.pad(x, ((0, mp - m), (0, kp - k)))
     wp = jnp.pad(w, ((0, kp - k), (0, np_ - n)))

@@ -30,6 +30,8 @@ echo "chip alive; running queue 3"
 
 # prove the new fused_matmul_bn kernel under Mosaic + refresh manifest
 run smoke3    600  python scripts/pallas_smoke.py
+# kernel-level microbench + block-size tune (fast signal first)
+run fmm       900  env PROBE_BS=256 python scripts/perf_probe.py fmm
 # fused-bottleneck step: on-chip loss/grad cross-check, then timing A/B
 run fusedver  900  env PROBE_FUSED=1 PROBE_VERIFY=1 PROBE_BS=128 \
                        python scripts/perf_probe.py raw

@@ -666,6 +666,73 @@ def probe_raw(max_stages=None):
     return dt
 
 
+def probe_fmm():
+    """Fused matmul+BN kernel microbenchmark vs the XLA composition, per
+    characteristic ResNet-50 shape, plus a (BM, BN) block-size sweep —
+    run on chip to tune ops/fused_block._pick_bm.  PROBE_BS scales M."""
+    import functools
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops import fused_block as fb
+
+    bs = int(os.environ.get("PROBE_BS", "256"))
+    # (label, HW, K, N, prologue) — stage2/stage4 c1 and c3 shapes
+    shapes = [
+        ("s1.c1 56px 256->64", 56 * 56, 256, 64, False),
+        ("s1.c3 56px  64->256", 56 * 56, 64, 256, True),
+        ("s3.c1 14px 1024->256", 14 * 14, 1024, 256, False),
+        ("s3.c3 14px  256->1024", 14 * 14, 256, 1024, True),
+        ("s4.c3  7px  512->2048", 7 * 7, 512, 2048, True),
+    ]
+    key = jax.random.PRNGKey(0)
+    for label, hw, k, n, prologue in shapes:
+        m = bs * hw
+        kx, kw = jax.random.split(key)
+        x = jax.random.normal(kx, (m, k), jnp.bfloat16) * 0.5
+        w = jax.random.normal(kw, (k, n), jnp.bfloat16) * (k ** -0.5)
+        sc = jnp.ones((k,), jnp.float32)
+        bi = jnp.zeros((k,), jnp.float32)
+        flops = 2.0 * m * k * n
+
+        def time_fn(f):
+            g = jax.jit(f)
+            outs = g(x, w)           # compile
+            sync(outs)
+            t0 = time.perf_counter()
+            for _ in range(10):
+                outs = g(x, w)
+            sync(outs)
+            return (time.perf_counter() - t0) / 10
+
+        dt_x = time_fn(lambda x, w: fb.xla_matmul_bn(
+            x, w, sc if prologue else None, bi if prologue else None))
+        best = None
+        for bm in (128, 256, 512):
+            for bn in (128, 256, 512):
+                if fb._round_up(n, 128) % bn:
+                    continue
+                try:
+                    dt = time_fn(functools.partial(
+                        lambda x, w, _bm, _bn: fb._fwd_impl(
+                            x, w, sc, bi, prologue, bm=_bm, bn=_bn),
+                        _bm=bm, _bn=bn))
+                except Exception as e:
+                    print(f"  {label} bm={bm} bn={bn}: FAIL "
+                          f"{type(e).__name__}", flush=True)
+                    continue
+                if best is None or dt < best[0]:
+                    best = (dt, bm, bn)
+        if best is None:
+            print(f"{label}: all block configs failed (xla "
+                  f"{dt_x * 1e3:.3f} ms)", flush=True)
+            continue
+        dt_f, bm, bn = best
+        print(f"{label}: xla {dt_x * 1e3:7.3f} ms ({flops / dt_x / 1e12:5.1f}"
+              f" TF/s)  fused {dt_f * 1e3:7.3f} ms ({flops / dt_f / 1e12:5.1f}"
+              f" TF/s) best bm={bm} bn={bn}  "
+              f"{'WIN' if dt_f < dt_x else 'LOSS'} {dt_x / dt_f:5.2f}x",
+              flush=True)
+
+
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "fused"
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
@@ -686,6 +753,8 @@ if __name__ == "__main__":
         probe_layout()
     elif mode == "raw":
         probe_raw()
+    elif mode == "fmm":
+        probe_fmm()
     elif mode == "stages":
         # prefix sweep: deltas between consecutive rows localize the
         # train-step time (fwd+bwd+opt) per ResNet stage
