@@ -694,17 +694,20 @@ def probe_fmm():
         flops = 2.0 * m * k * n
 
         def time_fn(f):
-            g = jax.jit(f)
-            outs = g(x, w)           # compile
-            sync(outs)
-            t0 = time.perf_counter()
-            for _ in range(10):
-                outs = g(x, w)
-            sync(outs)
-            return (time.perf_counter() - t0) / 10
+            # carry-chained per the module timing discipline: step n+1's
+            # x depends on step n's s1, so the final sync transitively
+            # waits for every step (a 1-element donated update — no
+            # extra activation traffic)
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(x, w):
+                _y, s1, _s2 = f(x, w)
+                return x.at[0, 0].add((s1[0] * 1e-30).astype(x.dtype)), w
+            # fresh buffer per config: step donates its x, and the next
+            # config must not inherit a consumed input
+            return timeit(step, (jnp.array(x), w), steps=10, warmup=2)
 
-        dt_x = time_fn(lambda x, w: fb.xla_matmul_bn(
-            x, w, sc if prologue else None, bi if prologue else None))
+        dt_x = time_fn(lambda xx, ww: fb.xla_matmul_bn(
+            xx, ww, sc if prologue else None, bi if prologue else None))
         best = None
         for bm in (128, 256, 512):
             for bn in (128, 256, 512):
@@ -712,8 +715,8 @@ def probe_fmm():
                     continue
                 try:
                     dt = time_fn(functools.partial(
-                        lambda x, w, _bm, _bn: fb._fwd_impl(
-                            x, w, sc, bi, prologue, bm=_bm, bn=_bn),
+                        lambda xx, ww, _bm, _bn: fb._fwd_impl(
+                            xx, ww, sc, bi, prologue, bm=_bm, bn=_bn),
                         _bm=bm, _bn=bn))
                 except Exception as e:
                     print(f"  {label} bm={bm} bn={bn}: FAIL "

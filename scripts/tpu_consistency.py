@@ -76,6 +76,33 @@ def op_list():
     _m = rng.rand(8, 8)
     specs["linalg_potrf"] = lambda: (
         [_jnp.asarray(_m @ _m.T + 8 * onp.eye(8), _jnp.float32)], {})
+    # index/kwarg-constrained ops the generic 8x8-floats fallback skips
+    specs["gather_nd"] = lambda: (
+        [_jnp.asarray(rng.rand(6, 7), _jnp.float32),
+         _jnp.asarray(rng.randint(0, 1000, (2, 5)) % onp.array([[6], [7]]),
+                      _jnp.int32)], {})
+    # scatter sites must be UNIQUE: with duplicates, .set() ordering is
+    # backend-unspecified and .add() rounding is order-dependent — either
+    # would make the cross-backend compare a flake
+    specs["index_add_nd"] = lambda: (
+        [_jnp.asarray(rng.rand(6, 7), _jnp.float32),
+         _jnp.asarray(rng.permutation(6)[:5].reshape(1, 5), _jnp.int32),
+         _jnp.asarray(rng.rand(5, 7), _jnp.float32)], {})
+    specs["index_update_nd"] = lambda: (
+        [_jnp.asarray(rng.rand(6, 7), _jnp.float32),
+         _jnp.asarray(rng.permutation(6)[:5].reshape(1, 5), _jnp.int32),
+         _jnp.asarray(rng.rand(5, 7), _jnp.float32)], {})
+    specs["im2col"] = lambda: (
+        [_jnp.asarray(rng.rand(2, 3, 10, 10), _jnp.float32)],
+        {"kernel": (3, 3), "stride": (1, 1), "pad": (1, 1)})
+    specs["image_crop"] = lambda: (
+        [_jnp.asarray(rng.rand(10, 12, 3), _jnp.float32)],
+        {"x_start": 2, "y_start": 1, "width": 6, "height": 5})
+    specs["_contrib_RROIAlign"] = lambda: (
+        [_jnp.asarray(rng.rand(2, 3, 16, 16), _jnp.float32),
+         _jnp.asarray([[0, 8.0, 8.0, 6.0, 4.0, 30.0],
+                       [1, 5.0, 7.0, 4.0, 4.0, -15.0]], _jnp.float32)],
+        {"pooled_size": (3, 3), "spatial_scale": 1.0})
 
     seen_canonical = set()
     for name in registry.list_ops():
