@@ -7,6 +7,7 @@ from ..ndarray import NDArray
 from .. import ndarray as nd
 
 __all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "convert_conv_params_layout",
            "download", "shape_is_known"]
 
 
@@ -71,3 +72,54 @@ def shape_is_known(shape):
     if shape is None:
         return False
     return all(s > 0 for s in shape)
+
+
+def convert_conv_params_layout(src_net, dst_net):
+    """Copy parameters from ``src_net`` into ``dst_net`` across a conv
+    data-layout change (NCHW <-> NHWC zoo nets): conv kernels are
+    transposed OIHW <-> OHWI when the two layers' layouts differ.
+
+    Which parameters are conv kernels is decided from the LAYERS (their
+    channel-minor flag), never from shapes — an (O,3,3,3) kernel is
+    shape-identical in both layouts and a shape heuristic would silently
+    copy it untransposed.  Both nets must have resolved shapes (run one
+    forward each).  Use this to move a reference-era NCHW checkpoint
+    onto the NHWC fast path (``resnet50_v1(layout="NHWC", fused=True)``).
+    """
+    from .nn.conv_layers import _Conv
+
+    def conv_weight_layouts(net):
+        out = {}
+
+        def walk(b):
+            if isinstance(b, _Conv) and not b._transpose:
+                out[id(b.weight)] = b._channel_minor
+            for c in getattr(b, "_children", {}).values():
+                walk(c)
+        walk(net)
+        return out
+
+    src_cm = conv_weight_layouts(src_net)
+    dst_cm = conv_weight_layouts(dst_net)
+    sp = src_net.collect_params()
+    dp = dst_net.collect_params()
+    missing = [k for k in sp if k not in dp]
+    extra = [k for k in dp if k not in sp]
+    if missing or extra:
+        raise ValueError(
+            f"parameter sets differ: missing in dst {missing[:5]}, "
+            f"only in dst {extra[:5]}")
+    for name, p in sp.items():
+        q = dp[name]
+        s_minor = src_cm.get(id(p))
+        d_minor = dst_cm.get(id(q))
+        if s_minor is not None and d_minor is not None \
+                and s_minor != d_minor:
+            perm = (0, 2, 3, 1) if d_minor else (0, 3, 1, 2)
+            q.set_data(nd.transpose(p.data(), perm))
+        elif p.shape != q.shape:
+            raise ValueError(
+                f"{name}: shape {p.shape} does not match destination "
+                f"{q.shape} and is not a layout-differing conv kernel")
+        else:
+            q.set_data(p.data())

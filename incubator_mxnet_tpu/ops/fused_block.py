@@ -354,8 +354,13 @@ def _bottleneck_core(x, w1, g1, b1, w2, g2, b2, w3, g3, b3,
     m1 = y1.shape[0]
     sc1, of1, mean1, var1 = bn_consts(a1, c1, m1, g1, b1, eps)
     cm = y1.shape[-1]
-    y1n = jnp.maximum(y1.astype(jnp.float32) * sc1 + of1, 0.0)
-    y1n = y1n.astype(x.dtype).reshape(n, hs, ws, cm)
+    # normalize/residual glue stays in x.dtype (the batch_norm op's
+    # mixed-precision discipline): per-channel constants are fp32, but
+    # an fp32 activation-sized intermediate must never exist — the
+    # round-4 on-chip finding was that such copies materialize as real
+    # HBM traffic when they survive into the program
+    y1n = jnp.maximum(y1 * sc1.astype(x.dtype) + of1.astype(x.dtype), 0)
+    y1n = y1n.reshape(n, hs, ws, cm)
 
     dn = jax.lax.conv_dimension_numbers(y1n.shape, w2.shape,
                                         ("NHWC", "OHWI", "NHWC"))
@@ -376,11 +381,12 @@ def _bottleneck_core(x, w1, g1, b1, w2, g2, b2, w3, g3, b3,
         ysc, asc, csc = fused_matmul_bn(flat(xs), mm(wsc))
         sccs, ofcs, meansc, varsc = bn_consts(asc, csc, ysc.shape[0],
                                               gsc, bsc, eps)
-        short = ysc.astype(jnp.float32) * sccs + ofcs
+        short = ysc * sccs.astype(x.dtype) + ofcs.astype(x.dtype)
     else:
-        short = flat(xs).astype(jnp.float32)
-    out = jnp.maximum(y3.astype(jnp.float32) * sc3 + of3 + short, 0.0)
-    out = out.astype(x.dtype).reshape(n, hs, ws, y3.shape[-1])
+        short = flat(xs)
+    out = jnp.maximum(
+        y3 * sc3.astype(x.dtype) + of3.astype(x.dtype) + short, 0)
+    out = out.reshape(n, hs, ws, y3.shape[-1])
     stats = (mean1, var1, mean2, var2, mean3, var3)
     if wsc is not None:
         stats = stats + (meansc, varsc)

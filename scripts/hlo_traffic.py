@@ -61,6 +61,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--bs", type=int, default=128)
     ap.add_argument("--stem", default="conv7")
+    ap.add_argument("--layout", default="NCHW")
+    ap.add_argument("--fused", action="store_true")
     ap.add_argument("--remat", default=None)
     ap.add_argument("--top", type=int, default=25)
     ap.add_argument("--min-mb", type=float, default=1.0)
@@ -69,6 +71,9 @@ def main():
     args = ap.parse_args()
 
     import jax
+    # the axon sitecustomize pins the platform programmatically — the
+    # env var alone does not keep a wedged tunnel from hanging the trace
+    jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as onp
 
@@ -77,17 +82,26 @@ def main():
     from incubator_mxnet_tpu.fuse import make_fused_train_step
     from incubator_mxnet_tpu.gluon.model_zoo import vision
 
+    if args.fused:
+        if os.environ.get("MXNET_USE_PALLAS", "").lower() in (
+                "0", "false", "off"):
+            sys.exit("--fused with MXNET_USE_PALLAS=0 would census the "
+                     "XLA fallback under a fusedblk=True label")
+        os.environ["MXNET_USE_PALLAS"] = "1"
     mx.random.seed(0)
-    net = vision.resnet50_v1(stem=args.stem)
+    net = vision.resnet50_v1(stem=args.stem, layout=args.layout,
+                             fused=args.fused)
     net.initialize(ctx=mx.cpu())
-    net(nd.random.uniform(shape=(1, 3, 32, 32)))
+    nhwc = args.layout == "NHWC"
+    net(nd.random.uniform(shape=(1, 32, 32, 3) if nhwc else (1, 3, 32, 32)))
     amp.convert_block(net, "bfloat16")
     step = make_fused_train_step(
         net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
         {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
         remat=args.remat)
 
-    x = jax.ShapeDtypeStruct((args.bs, 3, 224, 224), jnp.bfloat16)
+    x = jax.ShapeDtypeStruct((args.bs, 224, 224, 3) if nhwc
+                             else (args.bs, 3, 224, 224), jnp.bfloat16)
     y = jax.ShapeDtypeStruct((args.bs,), jnp.int32)
     key = jax.ShapeDtypeStruct((2,), jnp.uint32)
     spec = lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype)  # noqa: E731
@@ -102,7 +116,8 @@ def main():
 
     counts = census(text, args.min_mb)
     rows = sorted(counts.items(), key=lambda kv: -kv[0][2] * kv[1])
-    print(f"# fused step bs={args.bs} stem={args.stem} remat={args.remat}")
+    print(f"# fused step bs={args.bs} stem={args.stem} remat={args.remat} "
+          f"layout={args.layout} fusedblk={args.fused}")
     print(f"# {len(text.splitlines())} HLO lines; tensor types >= "
           f"{args.min_mb} MB, sorted by MB x occurrences")
     print(f"{'shape':>28} {'dtype':>5} {'MB':>8} {'count':>5} {'MBxN':>9}")

@@ -534,8 +534,9 @@ def probe_raw(max_stages=None):
         g1, be1 = params[p + "bn1"]
         sc1, of1, _, _ = fb.bn_consts(a1, b1, mrows, g1, be1, eps)
         cm = y1.shape[-1]
-        y1n = jnp.maximum(y1.astype(jnp.float32) * sc1 + of1, 0.0)
-        y1n = y1n.astype(x.dtype).reshape(n, h, w_, cm)
+        # glue in x.dtype: no fp32 activation-sized intermediates
+        y1n = jnp.maximum(y1 * sc1.astype(x.dtype) + of1.astype(x.dtype), 0)
+        y1n = y1n.reshape(n, h, w_, cm)
 
         y2 = conv(y1n, params[p + "c2"], stride)  # 3x3: XLA conv
         g2, be2 = params[p + "bn2"]
@@ -557,12 +558,13 @@ def probe_raw(max_stages=None):
             gsc, besc = params[p + "scbn"]
             scc, ofc, _, _ = fb.bn_consts(asc, bsc, ysc.shape[0], gsc, besc,
                                           eps)
-            short = ysc.astype(jnp.float32) * scc + ofc
+            short = ysc * scc.astype(x.dtype) + ofc.astype(x.dtype)
         else:
-            short = flat(x).astype(jnp.float32)
-        out = jnp.maximum(y3.astype(jnp.float32) * sc3 + of3 + short, 0.0)
+            short = flat(x)
+        out = jnp.maximum(
+            y3 * sc3.astype(x.dtype) + of3.astype(x.dtype) + short, 0)
         co = y3.shape[-1]
-        return out.astype(x.dtype).reshape(n, h // stride, w_ // stride, co)
+        return out.reshape(n, h // stride, w_ // stride, co)
 
     def make_loss(blk):
         def forward(params, x, training=True):

@@ -135,32 +135,26 @@ def test_bn_consts_chain_grad():
 # gluon zoo integration (layout="NHWC", fused=True)
 # ---------------------------------------------------------------------------
 
-def _transpose_params_nchw_to_nhwc(src, dst):
-    """Copy src (NCHW zoo net) params into dst (NHWC zoo net), moving
-    conv kernels OIHW -> OHWI."""
-    sp, dp = src.collect_params(), dst.collect_params()
-    from incubator_mxnet_tpu import nd
-    for name, p in sp.items():
-        q = dp[name]
-        if p.shape and len(p.shape) == 4 and name.endswith("weight") \
-                and q.shape != p.shape:
-            q.set_data(nd.transpose(p.data(), (0, 2, 3, 1)))
-        else:
-            q.set_data(p.data())
 
 
-def test_zoo_nhwc_layout_matches_nchw():
+
+@pytest.mark.parametrize("thumbnail", [False, True])
+def test_zoo_nhwc_layout_matches_nchw(thumbnail):
+    """thumbnail=True covers the (O,3,3,3) stem kernel whose OIHW and
+    OHWI shapes coincide — a shape heuristic would copy it untransposed
+    (review finding); the converter must use layer metadata."""
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, autograd
     from incubator_mxnet_tpu.gluon.model_zoo import vision
-    a = vision.resnet18_v1(classes=10)
-    b = vision.resnet18_v1(classes=10, layout="NHWC")
+    a = vision.resnet18_v1(classes=10, thumbnail=thumbnail)
+    b = vision.resnet18_v1(classes=10, layout="NHWC", thumbnail=thumbnail)
     x = nd.random.uniform(shape=(2, 3, 32, 32))
     a.initialize(ctx=mx.cpu())
     b.initialize(ctx=mx.cpu())
     a(x)
     b(nd.transpose(x, (0, 2, 3, 1)))  # resolve deferred shapes
-    _transpose_params_nchw_to_nhwc(a, b)
+    from incubator_mxnet_tpu.gluon.utils import convert_conv_params_layout
+    convert_conv_params_layout(a, b)
     ya = a(x).asnumpy()
     yb = b(nd.transpose(x, (0, 2, 3, 1))).asnumpy()
     onp.testing.assert_allclose(ya, yb, rtol=1e-4, atol=1e-4)
