@@ -1,0 +1,37 @@
+#!/bin/bash
+# Highest-value-density chip jobs, run FIRST on any recovered window:
+#   smoke3 — prove fused_matmul_bn under Mosaic and refresh the kernel
+#            manifest: after this, bench.py (including the DRIVER's
+#            end-of-round run) auto-tries the fused config on its own.
+#   fmm    — per-shape kernel-vs-XLA microbench + block-size tune.
+# Same resumable artifact convention as chip_queue.sh.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p artifacts/r4
+run() { # name timeout_s cmd...
+  local name="$1" t="$2"; shift 2
+  local out="artifacts/r4/$name.txt"
+  if [ -s "$out" ] && ! grep -q "QUEUE_FAILED" "$out"; then
+    echo "== $name: already done, skipping"; return 0
+  fi
+  echo "== $name (timeout ${t}s)"
+  if timeout "$t" "$@" > "$out.tmp" 2>&1; then
+    mv "$out.tmp" "$out"; echo "   ok"
+  else
+    echo "QUEUE_FAILED rc=$?" >> "$out.tmp"; mv "$out.tmp" "$out"
+    echo "   FAILED (see $out)"
+  fi
+}
+
+if ! timeout 90 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()[0]; assert d.platform != 'cpu'
+x = jax.device_put(jnp.ones((256,256), jnp.bfloat16), d)
+float((x@x).sum())" >/dev/null 2>&1; then
+  echo "chip not reachable — aborting queue"; exit 1
+fi
+echo "chip alive; running queue 0"
+
+run smoke3    600  python scripts/pallas_smoke.py
+run fmm       900  env PROBE_BS=256 python scripts/perf_probe.py fmm
+echo "queue 0 complete"

@@ -28,10 +28,7 @@ float((x@x).sum())" >/dev/null 2>&1; then
 fi
 echo "chip alive; running queue 3"
 
-# prove the new fused_matmul_bn kernel under Mosaic + refresh manifest
-run smoke3    600  python scripts/pallas_smoke.py
-# kernel-level microbench + block-size tune (fast signal first)
-run fmm       900  env PROBE_BS=256 python scripts/perf_probe.py fmm
+# (smoke3 + fmm moved to chip_queue0.sh — they run first on any window)
 # fused-bottleneck step: on-chip loss/grad cross-check, then timing A/B
 run fusedver  900  env PROBE_FUSED=1 PROBE_VERIFY=1 PROBE_BS=128 \
                        python scripts/perf_probe.py raw

@@ -13,6 +13,8 @@ d = jax.devices()[0]; assert d.platform != 'cpu'
 x = jax.device_put(jnp.ones((256,256), jnp.bfloat16), d)
 float((x@x).sum())" >/dev/null 2>&1; then
     echo "[watch] $(date -u +%H:%M:%S) chip ALIVE — draining queues"
+    bash scripts/chip_queue0.sh   # manifest + kernel tune: 25 min that
+                                  # lets the driver's own bench go fused
     bash scripts/chip_queue.sh
     bash scripts/chip_queue2.sh
     bash scripts/chip_queue3.sh
