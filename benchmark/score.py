@@ -42,6 +42,9 @@ def parse_args(argv=None):
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--json", default=None, help="also write results here")
+    p.add_argument("--fuse-bn", action="store_true",
+                   help="fold BatchNorm into convs before timing "
+                        "(gluon.contrib.fuse_conv_bn inference transform)")
     return p.parse_args(argv)
 
 
@@ -71,6 +74,9 @@ def main(argv=None):
             net = builder()
             net.initialize(ctx=mx.cpu())
             net(nd.zeros((1, 3, size, size)))   # shape resolution
+            if args.fuse_bn:
+                from incubator_mxnet_tpu.gluon.contrib import fuse_conv_bn
+                fuse_conv_bn(net)
             if args.dtype == "bfloat16":
                 amp.convert_block(net, "bfloat16")
             net.hybridize(static_alloc=True)
@@ -90,6 +96,7 @@ def main(argv=None):
             float(out.data.ravel()[0])          # host-readback sync
             dt = time.perf_counter() - t0
             rec = {"model": name, "batch": bs, "dtype": args.dtype,
+                   "fuse_bn": bool(args.fuse_bn),
                    "image_size": size,
                    "img_per_sec": round(bs * args.steps / dt, 2),
                    "ms_per_batch": round(1000 * dt / args.steps, 2),

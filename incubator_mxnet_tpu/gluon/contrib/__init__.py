@@ -4,3 +4,4 @@ from . import data
 from . import estimator
 from . import nn
 from . import rnn
+from .fuse_bn import fuse_conv_bn

@@ -4,26 +4,9 @@
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p artifacts/r4
-run() { # name timeout_s cmd...
-  local name="$1" t="$2"; shift 2
-  local out="artifacts/r4/$name.txt"
-  if [ -s "$out" ] && ! grep -q "QUEUE_FAILED" "$out"; then
-    echo "== $name: already done, skipping"; return 0
-  fi
-  echo "== $name (timeout ${t}s)"
-  if timeout "$t" "$@" > "$out.tmp" 2>&1; then
-    mv "$out.tmp" "$out"; echo "   ok"
-  else
-    echo "QUEUE_FAILED rc=$?" >> "$out.tmp"; mv "$out.tmp" "$out"
-    echo "   FAILED (see $out)"
-  fi
-}
+. "$(dirname "$0")/chip_queue_lib.sh"
 
-if ! timeout 90 python -c "
-import jax, jax.numpy as jnp
-d = jax.devices()[0]; assert d.platform != 'cpu'
-x = jax.device_put(jnp.ones((256,256), jnp.bfloat16), d)
-float((x@x).sum())" >/dev/null 2>&1; then
+if ! chip_alive; then
   echo "chip not reachable — aborting queue"; exit 1
 fi
 echo "chip alive; running queue 3"
@@ -48,4 +31,7 @@ run score32   1500 python benchmark/score.py --batches 32 \
                        --json artifacts/r4/score_fp32.json
 run scorebf   1500 python benchmark/score.py --batches 32,128 \
                        --dtype bfloat16 --json artifacts/r4/score_bf16.json
+# conv+BN folding (gluon.contrib.fuse_conv_bn): the deploy-mode numbers
+run scorefb   1200 python benchmark/score.py --batches 32 --fuse-bn \
+                       --json artifacts/r4/score_fp32_fusebn.json
 echo "queue 3 complete"

@@ -1,0 +1,27 @@
+# Shared helpers for the chip job queues (sourced, not executed).
+# run NAME TIMEOUT CMD... — resumable: the job is skipped when its
+# artifact exists without a QUEUE_FAILED marker; failures keep partial
+# output + the marker so a re-run retries exactly the failed jobs.
+run() {
+  local name="$1" t="$2"; shift 2
+  local out="artifacts/r4/$name.txt"
+  if [ -s "$out" ] && ! grep -q "QUEUE_FAILED" "$out"; then
+    echo "== $name: already done, skipping"; return 0
+  fi
+  echo "== $name (timeout ${t}s)"
+  if timeout "$t" "$@" > "$out.tmp" 2>&1; then
+    mv "$out.tmp" "$out"; echo "   ok"
+  else
+    echo "QUEUE_FAILED rc=$?" >> "$out.tmp"; mv "$out.tmp" "$out"
+    echo "   FAILED (see $out)"
+  fi
+}
+
+# chip_alive — cheap liveness gate so a wedged tunnel exits fast
+chip_alive() {
+  timeout 90 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()[0]; assert d.platform != 'cpu'
+x = jax.device_put(jnp.ones((256,256), jnp.bfloat16), d)
+float((x@x).sum())" >/dev/null 2>&1
+}

@@ -227,3 +227,43 @@ def test_fused_model_under_dp_mesh():
     loss2 = float(step(x, y))
     assert onp.isfinite(loss1) and onp.isfinite(loss2)
     assert loss2 < loss1 + 1e-3  # training on a constant batch descends
+
+
+def test_fuse_conv_bn_inference_parity():
+    """gluon.contrib.fuse_conv_bn folds every Conv->BN pair (incl. the
+    pre-activation V2 ordering and biasless convs) with exact eval
+    parity, and leaves BatchNormReLU (has a relu inside) alone."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon.contrib import fuse_conv_bn
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    # v2 folds fewer by design: pre-activation bn1 consumes the block
+    # INPUT (no producing conv); only conv_i -> bn_{i+1} pairs fold
+    for factory, min_pairs in ((vision.resnet18_v1, 20),
+                               (vision.resnet18_v2, 9)):
+        net = factory(classes=10)
+        net.initialize(ctx=mx.cpu())
+        x = nd.random.uniform(shape=(2, 3, 32, 32))
+        y0 = net(x).asnumpy()
+        n = fuse_conv_bn(net)
+        y1 = net(x).asnumpy()
+        assert n >= min_pairs, n
+        onp.testing.assert_allclose(y0, y1, rtol=1e-4, atol=1e-5)
+        # folded net still hybridizes and runs
+        net.hybridize()
+        y2 = net(x).asnumpy()
+        onp.testing.assert_allclose(y1, y2, rtol=1e-4, atol=1e-5)
+
+    # exclusions: BatchNormReLU (relu inside) and conv with built-in
+    # activation (activation runs after the conv) must NOT fold
+    from incubator_mxnet_tpu.gluon import nn
+    seq = nn.HybridSequential()
+    seq.add(nn.Conv2D(4, 3, padding=1, in_channels=3),
+            nn.BatchNormReLU(),
+            nn.Conv2D(4, 3, padding=1, activation="relu", in_channels=4),
+            nn.BatchNorm())
+    seq.initialize(ctx=mx.cpu())
+    x = nd.random.uniform(shape=(2, 3, 8, 8))
+    y0 = seq(x).asnumpy()
+    assert fuse_conv_bn(seq) == 0
+    onp.testing.assert_allclose(y0, seq(x).asnumpy())
