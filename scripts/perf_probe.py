@@ -711,10 +711,14 @@ def probe_fmm():
         dt_x = time_fn(lambda xx, ww: fb.xla_matmul_bn(
             xx, ww, sc if prologue else None, bi if prologue else None))
         best = None
+        np_full = fb._round_up(n, 128)
+        # widest bn = x streamed once (w block kp x bn must fit VMEM);
+        # try it alongside the narrow tiles
+        bn_cands = sorted({b for b in (128, 256, 512, np_full)
+                           if np_full % b == 0
+                           and fb._round_up(k, 128) * b * 2 <= 8 * 2**20})
         for bm in (128, 256, 512):
-            for bn in (128, 256, 512):
-                if fb._round_up(n, 128) % bn:
-                    continue
+            for bn in bn_cands:
                 try:
                     dt = time_fn(functools.partial(
                         lambda xx, ww, _bm, _bn: fb._fwd_impl(
