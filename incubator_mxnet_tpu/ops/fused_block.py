@@ -55,6 +55,20 @@ def _pick_bm(np_cols: int) -> int:
     return 512 if np_cols <= 256 else 256
 
 
+def _pick_bn(kp: int, np_: int, bm: int) -> int:
+    """Widest output block within a ~8 MB VMEM budget for the residents
+    that scale with bn — the weight tile (kp*bn*2B) AND the output/
+    accumulator tiles (bm*bn*(4+2)B): every N-block sweep re-reads the
+    x tile, so a wider bn directly cuts activation re-reads.  Floor 512
+    (= the previous fixed default) even when the budget is tighter."""
+    per_col = kp * 2 + bm * 6
+    cap = max(512, (8 * 2 ** 20 // per_col) // 128 * 128)
+    bn = min(np_, cap)
+    while np_ % bn:
+        bn -= 128
+    return bn
+
+
 # ---------------------------------------------------------------------------
 # forward: y = [relu(x*scale+bias)] @ w, s1 = sum(y), s2 = sum(y^2)
 # ---------------------------------------------------------------------------
@@ -88,7 +102,7 @@ def _fwd_impl(x, w, scale, bias, prologue, bm=None, bn=None):
     n = w.shape[1]
     kp, np_ = _round_up(k, 128), _round_up(n, 128)
     bm = bm or _pick_bm(np_)
-    bn = bn or min(512, np_)
+    bn = bn or _pick_bn(kp, np_, bm)
     if np_ % bn:  # grid = np_ // bn would silently drop output columns
         raise ValueError(f"bn={bn} must divide the padded width {np_}")
     mp = _round_up(m, bm)

@@ -671,7 +671,9 @@ def probe_raw(max_stages=None):
 def probe_fmm():
     """Fused matmul+BN kernel microbenchmark vs the XLA composition, per
     characteristic ResNet-50 shape, plus a (BM, BN) block-size sweep —
-    run on chip to tune ops/fused_block._pick_bm.  PROBE_BS scales M."""
+    run on chip to tune ops/fused_block._pick_bm/_pick_bn (the sweep
+    always includes the production heuristic's pick).  PROBE_BS
+    scales M."""
     import functools
     import jax.numpy as jnp
     from incubator_mxnet_tpu.ops import fused_block as fb
@@ -712,12 +714,14 @@ def probe_fmm():
             xx, ww, sc if prologue else None, bi if prologue else None))
         best = None
         np_full = fb._round_up(n, 128)
-        # widest bn = x streamed once (w block kp x bn must fit VMEM);
-        # try it alongside the narrow tiles
-        bn_cands = sorted({b for b in (128, 256, 512, np_full)
-                           if np_full % b == 0
-                           and fb._round_up(k, 128) * b * 2 <= 8 * 2**20})
+        kp = fb._round_up(k, 128)
         for bm in (128, 256, 512):
+            # narrow tiles, the whole width, and whatever production's
+            # heuristic picks for this (kp, np_, bm) — no VMEM
+            # pre-filter: a config that cannot compile reports FAIL
+            bn_cands = sorted({b for b in (128, 256, 512, np_full,
+                                           fb._pick_bn(kp, np_full, bm))
+                               if np_full % b == 0})
             for bn in bn_cands:
                 try:
                     dt = time_fn(functools.partial(
