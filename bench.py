@@ -289,6 +289,7 @@ def _ensure_pallas_manifest(remaining, cpu_reserve):
     benchmark attempt (VERDICT r3 Next #2)."""
     here = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, here)
+    rerun = None  # None = no prior accelerator manifest: run everything
     try:
         from incubator_mxnet_tpu.ops.pallas_kernels import manifest_path
         path = manifest_path()
@@ -299,29 +300,40 @@ def _ensure_pallas_manifest(remaining, cpu_reserve):
             if man.get("platform") not in ("cpu", "unknown"):
                 # an accelerator manifest exists; keep it UNLESS some
                 # kernel failed only by timeout (transient: slow runtime
-                # init) — those deserve a retry, real Mosaic errors don't
-                timeouts = [k for k, r in man.get("kernels", {}).items()
+                # init) — those deserve a retry, real Mosaic errors
+                # don't — or a kernel added since the manifest was
+                # recorded has no verdict at all (a stale manifest must
+                # not silently disable the auto-fused bench attempt)
+                from scripts.pallas_smoke import KERNELS
+                recorded = man.get("kernels", {})
+                timeouts = [k for k, r in recorded.items()
                             if not r.get("ok")
                             and "timeout" in str(r.get("error", ""))]
-                if not timeouts:
+                unrecorded = [k for k in KERNELS if k not in recorded]
+                rerun = timeouts + unrecorded
+                if not rerun:
                     return
                 print(f"[bench] re-running pallas smoke: timed-out "
-                      f"kernels {timeouts}", file=sys.stderr, flush=True)
+                      f"{timeouts}, unrecorded {unrecorded}",
+                      file=sys.stderr, flush=True)
         budget = min(float(os.environ.get("PALLAS_SMOKE_TIMEOUT", "150")),
                      remaining() - cpu_reserve - 120)
         if budget < 60:
             return
         print(f"[bench] running pallas smoke ({budget:.0f}s budget)",
               file=sys.stderr, flush=True)
-        # per-kernel ceiling sized so probe + 5 kernels fit the parent
-        # budget; the harness writes the manifest incrementally, so even
-        # a parent timeout keeps the kernels already verified
-        per_kernel = max((budget - 10) / 6, 15)
+        # only the kernels that need a verdict re-run (the harness
+        # merges prior same-platform records); per-kernel ceiling sized
+        # so probe + those kernels fit the parent budget
+        from scripts.pallas_smoke import KERNELS
+        todo = rerun or list(KERNELS)
+        per_kernel = max((budget - 10) / (len(todo) + 1), 15)
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.join(here, "scripts",
                                               "pallas_smoke.py"),
-                 "--timeout", str(per_kernel)],
+                 "--timeout", str(per_kernel),
+                 "--kernels", ",".join(todo)],
                 timeout=budget, capture_output=True, text=True)
             # the per-kernel verdict lines are the only diagnostics a
             # failed Mosaic compile leaves behind — keep them

@@ -55,6 +55,16 @@ def _pick_bm(np_cols: int) -> int:
     return 512 if np_cols <= 256 else 256
 
 
+def _div_block(dim: int, cap: int) -> int:
+    """Largest 128-multiple block <= cap that divides dim (dim is a
+    128-multiple): a non-divisor block with grid = dim // block would
+    silently drop the tail columns."""
+    b = min(dim, cap)
+    while dim % b:
+        b -= 128
+    return b
+
+
 def _pick_bn(kp: int, np_: int, bm: int) -> int:
     """Widest output block within a ~8 MB VMEM budget for the residents
     that scale with bn — the weight tile (kp*bn*2B) AND the output/
@@ -202,7 +212,7 @@ def _bwd_impl(x, w, scale, bias, y, dy, ds1, ds2, prologue):
 
     # --- dx (+ dscale, dbias epilogue) ---
     bm = 256
-    bk = min(512, kp)
+    bk = _div_block(kp, 512)
     mp = _round_up(m, bm)
     pad_mn = lambda a: jnp.pad(a, ((0, mp - m), (0, np_ - n)))
     dyp, yp = pad_mn(dy), pad_mn(y)
@@ -245,8 +255,8 @@ def _bwd_impl(x, w, scale, bias, y, dy, ds1, ds2, prologue):
     )(dyp, yp, ds1p, ds2p, wp, xp, scp, bip)
 
     # --- dw --- (same M tiling as dx: the padded dy/y/x are reused)
-    bk2 = min(512, kp)
-    bn2 = min(512, np_)
+    bk2 = _div_block(kp, 512)
+    bn2 = _div_block(np_, 512)
     # dw accumulates across M blocks in fp32 (a bf16 running sum loses
     # mantissa every iteration); cast to the weight dtype at the end
     dw = pl.pallas_call(

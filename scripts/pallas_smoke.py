@@ -190,8 +190,17 @@ def main(argv=None):
 
     # write INCREMENTALLY after every kernel: if the parent's budget
     # expires mid-harness (e.g. one wedged Mosaic compile), the kernels
-    # already verified keep their records
+    # already verified keep their records.  Seed from an existing
+    # same-platform manifest so a PARTIAL re-run (e.g. only a newly
+    # added kernel) cannot erase earlier verdicts.
     kernels = {}
+    try:
+        with open(out) as f:
+            prior = json.load(f)
+        if prior.get("platform") == platform:
+            kernels.update(prior.get("kernels", {}))
+    except (OSError, ValueError):
+        pass
 
     def flush():
         manifest = {"format": "pallas_smoke_v1", "platform": platform,

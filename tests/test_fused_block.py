@@ -267,3 +267,34 @@ def test_fuse_conv_bn_inference_parity():
     y0 = seq(x).asnumpy()
     assert fuse_conv_bn(seq) == 0
     onp.testing.assert_allclose(y0, seq(x).asnumpy())
+
+
+@pytest.mark.parametrize("prologue", [False, True])
+def test_nonmultiple_width_fwd_bwd(prologue):
+    """n=600 (padded 640) exercises block sizes that do not divide the
+    padded width: _div_block must shrink the bwd tiles instead of
+    silently dropping columns past 512 (review finding)."""
+    m, k, n = 192, 200, 600
+    x, w, scale, bias = _mk(m, k, n, jnp.float32, seed=9)
+    dy = jnp.asarray(onp.random.RandomState(10).randn(m, n), jnp.float32)
+    ds1 = jnp.zeros((n,), jnp.float32)
+    ds2 = jnp.zeros((n,), jnp.float32)
+
+    def run(fused):
+        f = (lambda *a: fb._fmm(*a, prologue)) if fused else (
+            lambda *a: fb.xla_matmul_bn(
+                a[0], a[1], a[2] if prologue else None,
+                a[3] if prologue else None))
+        out, vjp = jax.vjp(f, x, w, scale, bias)
+        return out, vjp((dy, ds1, ds2))
+
+    (y, s1, s2), (dx, dw, dsc, dbi) = run(True)
+    (yr, s1r, s2r), (dxr, dwr, dscr, dbir) = run(False)
+    onp.testing.assert_allclose(onp.asarray(y), onp.asarray(yr),
+                                rtol=1e-4, atol=1e-4)
+    # the columns past 512 are the regression: they must carry real
+    # gradients, not uninitialized pallas output
+    onp.testing.assert_allclose(onp.asarray(dw), onp.asarray(dwr),
+                                rtol=1e-3, atol=1e-3)
+    onp.testing.assert_allclose(onp.asarray(dx), onp.asarray(dxr),
+                                rtol=1e-3, atol=1e-3)
