@@ -291,6 +291,7 @@ def _ensure_pallas_manifest(remaining, cpu_reserve):
     sys.path.insert(0, here)
     rerun = None  # None = no prior accelerator manifest: run everything
     try:
+        from scripts.pallas_smoke import KERNELS
         from incubator_mxnet_tpu.ops.pallas_kernels import manifest_path
         path = manifest_path()
         if os.path.exists(path):
@@ -304,10 +305,9 @@ def _ensure_pallas_manifest(remaining, cpu_reserve):
                 # don't — or a kernel added since the manifest was
                 # recorded has no verdict at all (a stale manifest must
                 # not silently disable the auto-fused bench attempt)
-                from scripts.pallas_smoke import KERNELS
                 recorded = man.get("kernels", {})
                 timeouts = [k for k, r in recorded.items()
-                            if not r.get("ok")
+                            if k in KERNELS and not r.get("ok")
                             and "timeout" in str(r.get("error", ""))]
                 unrecorded = [k for k in KERNELS if k not in recorded]
                 rerun = timeouts + unrecorded
@@ -325,7 +325,6 @@ def _ensure_pallas_manifest(remaining, cpu_reserve):
         # only the kernels that need a verdict re-run (the harness
         # merges prior same-platform records); per-kernel ceiling sized
         # so probe + those kernels fit the parent budget
-        from scripts.pallas_smoke import KERNELS
         todo = rerun or list(KERNELS)
         per_kernel = max((budget - 10) / (len(todo) + 1), 15)
         try:

@@ -35,6 +35,10 @@ def main(argv=None):
     p.add_argument("--eval-batches", type=int, default=4)
     p.add_argument("--calib-batches", type=int, default=2)
     p.add_argument("--modes", default="naive,entropy")
+    p.add_argument("--fuse-bn", action="store_true",
+                   help="fold BatchNorm into convs before calibration "
+                        "(fewer layers to calibrate; the standard "
+                        "deploy-quantization flow)")
     p.add_argument("--exclude-layers", default="output",
                    help="comma-separated layer names kept float "
                         "(default: the classifier head, matching the "
@@ -67,6 +71,9 @@ def main(argv=None):
         net = getattr(vision, args.model)()
         net.initialize(ctx=mx.cpu())
         net(nd.zeros((1, 3, args.image_size, args.image_size)))
+        if args.fuse_bn:
+            from incubator_mxnet_tpu.gluon.contrib import fuse_conv_bn
+            fuse_conv_bn(net)
         # whole-graph jit: eager per-op dispatch through the TPU tunnel
         # costs one compile per distinct op/shape — hybridize collapses
         # the model to a single compiled program per input shape

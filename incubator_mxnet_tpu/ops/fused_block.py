@@ -73,10 +73,7 @@ def _pick_bn(kp: int, np_: int, bm: int) -> int:
     (= the previous fixed default) even when the budget is tighter."""
     per_col = kp * 2 + bm * 6
     cap = max(512, (8 * 2 ** 20 // per_col) // 128 * 128)
-    bn = min(np_, cap)
-    while np_ % bn:
-        bn -= 128
-    return bn
+    return _div_block(np_, cap)
 
 
 # ---------------------------------------------------------------------------

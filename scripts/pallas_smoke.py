@@ -199,6 +199,15 @@ def main(argv=None):
             prior = json.load(f)
         if prior.get("platform") == platform:
             kernels.update(prior.get("kernels", {}))
+        elif platform == "unknown" and prior.get("platform") not in (
+                "cpu", "unknown", None):
+            # probe failed (wedged chip) but a real-platform manifest
+            # exists: inherit its platform + records — a partial re-run
+            # must never downgrade a tpu manifest to 'unknown' and wipe
+            # the verdicts the fused-bench gate depends on
+            platform = prior["platform"]
+            device = prior.get("device", device)
+            kernels.update(prior.get("kernels", {}))
     except (OSError, ValueError):
         pass
 
