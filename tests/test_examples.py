@@ -66,3 +66,8 @@ def test_train_ssd_smoke():
 def test_train_bert_smoke():
     out = _run("train_bert.py", "--smoke", "--amp")
     assert "loss" in out
+
+
+def test_train_resnet_fused_smoke():
+    _run("train_resnet_fused.py", "--cpu", "--batch", "2",
+         "--image-size", "32", "--steps", "4")

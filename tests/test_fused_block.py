@@ -162,7 +162,14 @@ def test_zoo_nhwc_layout_matches_nchw(thumbnail):
 
 def test_zoo_fused_bottleneck_matches_unfused():
     """fused=True BottleneckV1 training forward/backward == the layer
-    composition, and moving stats update identically."""
+    composition, and moving stats update identically.
+
+    Block-level parity is the right oracle: FULL-model grad equality is
+    not testable at f32 — the 50-layer tiny-batch-BN gradient is
+    chaotic at rounding scale (a 1e-6 input perturbation moves plain-
+    path grads by ~0.37 relative; measured, see ROUND4.md session-3
+    notes), so fused-vs-plain full-model diffs just re-measure that
+    chaos."""
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd, autograd
     from incubator_mxnet_tpu.gluon.model_zoo.vision.resnet import \
