@@ -1,23 +1,55 @@
 #!/usr/bin/env python
-"""LeNet on (synthetic) MNIST — the reference example/image-classification
+"""LeNet on MNIST-style digits — the reference example/image-classification
 starter, on the TPU-native stack.
 
   python examples/train_mnist.py [--epochs 2] [--batch-size 64] [--smoke]
+  python examples/train_mnist.py --dataset digits   # REAL data, asserts
+                                                    # the accuracy target
 
 Uses the Gluon API end-to-end: HybridBlock -> hybridize (whole-graph XLA
-compile) -> Trainer(kvstore 'device').
+compile) -> DataLoader -> Trainer(kvstore 'device').
+
+``--dataset digits`` is the accuracy-parity config (VERDICT r4 Next #4;
+reference analog: tests/python/train/test_conv.py, which trains MNIST
+to an asserted 0.98 top-1): this environment has no network egress, so
+the real-data point uses the offline-available scikit-learn handwritten
+digits (1797 genuine 8x8 samples of the same task family), split
+80/20, trained through the full stack and asserted to >=0.97 held-out
+top-1 — a convergence proof on real data, not a synthetic loss curve.
 """
 import argparse
+import os
+import sys
 import time
 
 import numpy as onp
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_digits_data():
+    """Real handwritten digits, deterministic 80/20 split, normalized."""
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    x = (d.images / 16.0).astype(onp.float32)[:, None, :, :]  # NCHW
+    y = d.target.astype(onp.float32)
+    rng = onp.random.RandomState(42)
+    idx = rng.permutation(len(x))
+    n_test = len(x) // 5
+    test, train = idx[:n_test], idx[n_test:]
+    return (x[train], y[train]), (x[test], y[test])
+
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--epochs", type=int, default=None,
+                    help="default: 2 synthetic, 40 digits")
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--dataset", choices=["synthetic", "digits"],
+                    default="synthetic")
+    ap.add_argument("--target-acc", type=float, default=0.97,
+                    help="asserted held-out top-1 for --dataset digits")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny synthetic run (CI)")
     args = ap.parse_args()
@@ -41,23 +73,31 @@ def main():
     net.initialize(ctx=mx.tpu())
     net.hybridize()
 
-    n = 256 if args.smoke else 8192
-    rng = onp.random.RandomState(0)
-    images = rng.rand(n, 1, 28, 28).astype(onp.float32)
-    labels = rng.randint(0, 10, (n,)).astype(onp.float32)
+    if args.dataset == "digits":
+        (images, labels), (timages, tlabels) = load_digits_data()
+        n = len(images)
+        epochs = args.epochs if args.epochs is not None else 40
+    else:
+        n = 256 if args.smoke else 8192
+        rng = onp.random.RandomState(0)
+        images = rng.rand(n, 1, 28, 28).astype(onp.float32)
+        labels = rng.randint(0, 10, (n,)).astype(onp.float32)
+        timages = tlabels = None
+        epochs = 1 if args.smoke else (
+            args.epochs if args.epochs is not None else 2)
 
+    bs = args.batch_size
+    dataset = gluon.data.ArrayDataset(images, labels)
+    loader = gluon.data.DataLoader(dataset, batch_size=bs, shuffle=True,
+                                   last_batch="discard")
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": args.lr}, kvstore="device")
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     metric = gluon.metric.Accuracy()
-    epochs = 1 if args.smoke else args.epochs
-    bs = args.batch_size
     for epoch in range(epochs):
         metric.reset()
         t0 = time.time()
-        for i in range(0, n - bs + 1, bs):
-            x = nd.array(images[i:i + bs])
-            y = nd.array(labels[i:i + bs])
+        for x, y in loader:
             with autograd.record():
                 out = net(x)
                 loss = loss_fn(out, y)
@@ -67,6 +107,20 @@ def main():
         name, acc = metric.get()
         print(f"epoch {epoch}: {name}={acc:.3f} "
               f"({n / (time.time() - t0):.0f} samples/s)")
+
+    if timages is not None:
+        metric.reset()
+        for i in range(0, len(timages), bs):
+            x = nd.array(timages[i:i + bs])
+            y = nd.array(tlabels[i:i + bs])
+            metric.update([y], [net(x)])
+        _, test_acc = metric.get()
+        import jax
+        print(f"RESULT digits_test_top1 {test_acc:.4f} "
+              f"(target {args.target_acc}) "
+              f"platform={jax.devices()[0].platform}")
+        assert test_acc >= args.target_acc, (
+            f"held-out top-1 {test_acc:.4f} < target {args.target_acc}")
     print("done")
 
 

@@ -21,6 +21,8 @@ of every model-zoo family here, including the pre-activation V2 resnets
 """
 from __future__ import annotations
 
+import warnings
+
 import jax.numpy as jnp
 
 from ... import nd
@@ -80,11 +82,25 @@ def fuse_conv_bn(net):
                 if type(nxt) is _bl.BatchNorm and \
                         nxt.running_mean._data is not None and \
                         child.weight._data is not None:
+                    pairs.append(f"{name}->{nxt_name}")
                     _fold_pair(child, nxt)
                     setattr(block, nxt_name, _bl.Identity())
                     folded += 1
         for _, child in block._children.items():
             walk(child)
 
+    pairs = []
     walk(net)
+    if pairs:
+        # pairing is by declaration-order adjacency, not dataflow
+        # (correct for every zoo family); ONE summary warning makes a
+        # misapplication on a custom Block visible without drowning the
+        # common path in per-pair noise (ADVICE r4 + review) — verify
+        # with a probe tensor if unsure
+        warnings.warn(
+            f"fuse_conv_bn folded {folded} conv->BN pair(s) by "
+            f"declaration-order adjacency: {', '.join(pairs[:8])}"
+            + (", ..." if len(pairs) > 8 else "")
+            + " — verify dataflow adjacency on custom (non-zoo) blocks",
+            stacklevel=2)
     return folded

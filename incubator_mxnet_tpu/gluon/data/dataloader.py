@@ -1,15 +1,26 @@
 """DataLoader with background prefetch (reference gluon/data/dataloader.py).
 
 The reference forks worker *processes* and ships NDArrays through shared
-memory (dataloader.py:28-133, cpu_shared_storage_manager.h).  On TPU the
-device does the heavy math and batches flow host→HBM, so the re-design
-uses a *thread* pool (no pickling; JAX arrays are process-local) plus
-async double-buffering: the next batch is assembled and ``device_put``
-while the current step runs — the prefetcher role of the reference's
-``PrefetcherIter`` (src/io/iter_prefetcher.h).
+memory (dataloader.py:28-133, cpu_shared_storage_manager.h).  Both
+strategies exist here:
+
+* ``thread_pool=True`` (default): a thread pool with async
+  double-buffering — no pickling, JAX arrays stay process-local; right
+  whenever decode/augment releases the GIL (numpy, the native
+  RecordIO iterator) — the prefetcher role of the reference's
+  ``PrefetcherIter`` (src/io/iter_prefetcher.h).
+* ``thread_pool=False`` with ``num_workers>0``: forked worker
+  PROCESSES assembling batches into POSIX shared memory
+  (``multiprocessing.shared_memory``), the TPU-native analog of the
+  reference's shared-mem NDArray pickling + cpu_shared_storage_manager
+  — right for GIL-bound Python augmentation.  Workers are numpy-only
+  (they never touch JAX, so forking under an initialized backend is
+  safe); the parent maps each segment zero-copy and uploads straight
+  to the device.
 """
 from __future__ import annotations
 
+import traceback
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as onp
@@ -31,6 +42,95 @@ def default_batchify_fn(data):
     return nd.array(arr)
 
 
+# ---------------------------------------------------------------------------
+# multiprocess workers: numpy-only children + shared-memory transport
+# ---------------------------------------------------------------------------
+
+def _np_batchify(data):
+    """Worker-side batchify: stack into NUMPY (children never touch JAX)."""
+    if isinstance(data[0], tuple):
+        return tuple(_np_batchify([s[i] for s in data])
+                     for i in range(len(data[0])))
+    first = data[0]
+    if isinstance(first, NDArray):
+        raise TypeError(
+            "multiprocess DataLoader workers are numpy-only (JAX arrays "
+            "are process-local); return numpy from the dataset/transform "
+            "or use thread_pool=True")
+    arr = onp.stack([onp.asarray(d) for d in data], axis=0)
+    if arr.dtype == onp.float64:
+        arr = arr.astype(onp.float32)
+    return arr
+
+
+def _tree_to_shm(tree):
+    """Copy a tree of numpy arrays into shared memory; return the spec."""
+    from multiprocessing import resource_tracker, shared_memory
+    if isinstance(tree, tuple):
+        return ("tuple", [_tree_to_shm(t) for t in tree])
+    shm = shared_memory.SharedMemory(create=True, size=max(tree.nbytes, 1))
+    onp.ndarray(tree.shape, tree.dtype, buffer=shm.buf)[...] = tree
+    name = shm.name
+    shm.close()
+    # ownership transfers to the parent (it unlinks after upload); drop
+    # the creating process's resource-tracker registration so worker
+    # shutdown does not try to destroy segments it no longer owns
+    try:
+        resource_tracker.unregister("/" + name, "shared_memory")
+    except Exception:
+        pass
+    return ("array", name, tree.shape, str(tree.dtype))
+
+
+def _tree_from_shm(spec, to_nd=True):
+    """Rebuild the batch from shared memory, upload, unlink the segments."""
+    from multiprocessing import shared_memory
+    kind = spec[0]
+    if kind == "tuple":
+        return tuple(_tree_from_shm(s, to_nd) for s in spec[1])
+    _, name, shape, dtype = spec
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        view = onp.ndarray(shape, onp.dtype(dtype), buffer=shm.buf)
+        out = nd.array(view) if to_nd else view.copy()
+    finally:
+        shm.close()
+        try:
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+    return out
+
+
+def _unlink_spec(spec):
+    """Release the shared memory behind an undelivered batch spec."""
+    from multiprocessing import shared_memory
+    if spec[0] == "tuple":
+        for s in spec[1]:
+            _unlink_spec(s)
+        return
+    try:
+        shm = shared_memory.SharedMemory(name=spec[1])
+        shm.close()
+        shm.unlink()
+    except FileNotFoundError:
+        pass
+
+
+def _worker_loop(dataset, batchify_fn, key_queue, result_queue):
+    """Forked child: pull (seq, indices), push (seq, shm spec | error)."""
+    while True:
+        item = key_queue.get()
+        if item is None:
+            return
+        seq, indices = item
+        try:
+            batch = batchify_fn([dataset[i] for i in indices])
+            result_queue.put((seq, "ok", _tree_to_shm(batch)))
+        except Exception:  # noqa: BLE001 — ship the traceback to the parent
+            result_queue.put((seq, "error", traceback.format_exc()))
+
+
 class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
@@ -48,6 +148,8 @@ class DataLoader:
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = num_workers
+        self._thread_pool = thread_pool
+        self._timeout = timeout
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * max(num_workers, 1))
 
@@ -59,6 +161,9 @@ class DataLoader:
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)
+            return
+        if not self._thread_pool:
+            yield from self._iter_multiprocess()
             return
         with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
             futures = []
@@ -75,6 +180,90 @@ class DataLoader:
                 except StopIteration:
                     pass
                 yield batch
+
+    def _iter_multiprocess(self):
+        """Forked numpy-only workers + shared-memory batch transport
+        (reference dataloader.py:28-133 / cpu_shared_storage_manager.h
+        analog).  Batches are yielded strictly in sampler order."""
+        import multiprocessing as mp
+        ctx = mp.get_context("fork" if "fork" in mp.get_all_start_methods()
+                             else "spawn")
+        batchify = (self._batchify_fn if self._batchify_fn
+                    is not default_batchify_fn else _np_batchify)
+        key_queue = ctx.Queue()
+        result_queue = ctx.Queue()
+        workers = [ctx.Process(
+            target=_worker_loop,
+            args=(self._dataset, batchify, key_queue, result_queue),
+            daemon=True) for _ in range(self._num_workers)]
+        done = {}
+        for w in workers:
+            w.start()
+        try:
+            it = enumerate(iter(self._batch_sampler))
+            sent = 0
+            for _ in range(self._prefetch):
+                try:
+                    key_queue.put(next(it))
+                    sent += 1
+                except StopIteration:
+                    break
+            next_seq = 0
+            # every submitted batch yields exactly once, in order —
+            # `sent` only grows, so this drains the tail the prefetch
+            # ramp left in `done`
+            import queue as _q
+            while next_seq < sent:
+                while next_seq not in done:
+                    try:
+                        seq, status, payload = result_queue.get(
+                            timeout=self._timeout)
+                    except _q.Empty:
+                        # distinguish "slow batch" from "worker died
+                        # without reporting" (OOM-kill, segfault)
+                        dead = [w.pid for w in workers if not w.is_alive()]
+                        raise RuntimeError(
+                            f"DataLoader timed out after {self._timeout}s "
+                            f"waiting for batch {next_seq}"
+                            + (f"; worker pid(s) {dead} died without "
+                               "reporting" if dead else
+                               " (workers alive — raise `timeout` for "
+                               "slow augmentation)")) from None
+                    if status == "error":
+                        raise RuntimeError(
+                            f"DataLoader worker failed on batch {seq}:\n"
+                            f"{payload}")
+                    done[seq] = payload
+                    try:
+                        key_queue.put(next(it))
+                        sent += 1
+                    except StopIteration:
+                        pass
+                yield _tree_from_shm(done.pop(next_seq))
+                next_seq += 1
+        finally:
+            for _ in workers:
+                key_queue.put(None)
+            for w in workers:
+                w.join(timeout=5)
+                if w.is_alive():
+                    w.terminate()
+            # early abandonment leaves undelivered batches in shared
+            # memory — release them (workers are stopped, so the drain
+            # is complete)
+            import queue as _queue
+            try:
+                while True:
+                    _, status, payload = result_queue.get_nowait()
+                    if status == "ok":
+                        _unlink_spec(payload)
+            except (_queue.Empty, OSError):
+                pass
+            for payload in done.values():
+                _unlink_spec(payload)
+            for q in (key_queue, result_queue):
+                q.close()
+                q.cancel_join_thread()
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -173,10 +173,23 @@ class BottleneckV1(HybridBlock):
             register_state_update(bn.running_var, outs[2 + 2 * i])
         return out
 
+    def _fused_bns_uniform(self):
+        """The fused registry op takes ONE eps/momentum and always uses
+        batch stats; a BN mutated after construction (use_global_stats,
+        or a differing eps/momentum) must route through the layer path
+        instead of being silently mis-normalized (ADVICE r4)."""
+        bns = [self.body[1], self.body[4], self.body[7]]
+        if self.downsample is not None:
+            bns.append(self.downsample[1])
+        ref = bns[0]
+        return all(not getattr(bn, "_use_global_stats", False)
+                   and bn._epsilon == ref._epsilon
+                   and bn._momentum == ref._momentum for bn in bns)
+
     def forward(self, x):
         if self._fused:
             from .... import autograd
-            if autograd.is_training():
+            if autograd.is_training() and self._fused_bns_uniform():
                 return self._forward_fused(x)
         residual = x
         x_out = self.body(x)

@@ -115,7 +115,13 @@ def convert_conv_params_layout(src_net, dst_net):
         d_minor = dst_cm.get(id(q))
         if s_minor is not None and d_minor is not None \
                 and s_minor != d_minor:
-            perm = (0, 2, 3, 1) if d_minor else (0, 3, 1, 2)
+            # rank-derived permutation (ADVICE r4): works for Conv1D
+            # (OWI), Conv2D (OHWI) and Conv3D (ODHWI) kernels alike
+            ndim = len(p.shape)
+            if d_minor:        # O, spatial..., I  <-  O, I, spatial...
+                perm = (0,) + tuple(range(2, ndim)) + (1,)
+            else:              # O, I, spatial...  <-  O, spatial..., I
+                perm = (0, ndim - 1) + tuple(range(1, ndim - 1))
             q.set_data(nd.transpose(p.data(), perm))
         elif p.shape != q.shape:
             raise ValueError(

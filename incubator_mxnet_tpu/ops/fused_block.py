@@ -375,25 +375,17 @@ def _bottleneck_core(x, w1, g1, b1, w2, g2, b2, w3, g3, b3,
     m1 = y1.shape[0]
     sc1, of1, mean1, var1 = bn_consts(a1, c1, m1, g1, b1, eps)
     cm = y1.shape[-1]
-    # normalize/residual glue stays in x.dtype (the batch_norm op's
-    # mixed-precision discipline): per-channel constants are fp32, but
-    # an fp32 activation-sized intermediate must never exist — the
-    # round-4 on-chip finding was that such copies materialize as real
-    # HBM traffic when they survive into the program
-    y1n = jnp.maximum(y1 * sc1.astype(x.dtype) + of1.astype(x.dtype), 0)
-    y1n = y1n.reshape(n, hs, ws, cm)
 
-    dn = jax.lax.conv_dimension_numbers(y1n.shape, w2.shape,
-                                        ("NHWC", "OHWI", "NHWC"))
-    y2 = jax.lax.conv_general_dilated(
-        y1n, w2, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn
-    ).astype(x.dtype)
-    mean2 = jnp.mean(y2, (0, 1, 2), dtype=jnp.float32)
-    meansq2 = jnp.mean(jnp.square(y2), (0, 1, 2), dtype=jnp.float32)
-    var2 = jnp.maximum(meansq2 - jnp.square(mean2), 0.0)
-    rstd2 = jax.lax.rsqrt(var2 + eps)
-    sc2 = g2.astype(jnp.float32) * rstd2
-    of2 = b2.astype(jnp.float32) - mean2 * sc2
+    # 3x3 stage conv: bn1's normalize+ReLU runs in the conv prologue
+    # (the normalized y1 copy never exists in HBM) and bn2's batch
+    # stats come from the conv epilogue — the round-5 extension of the
+    # 1x1 pattern to the remaining stage-conv traffic.  Falls back to
+    # the XLA composition (normalize+conv+stats, identical contract)
+    # off-manifest or at over-VMEM widths.
+    from .fused_conv import fused_conv3_bn
+    y2, a2, c2 = fused_conv3_bn(y1.reshape(n, hs, ws, cm),
+                                jnp.transpose(w2, (1, 2, 3, 0)), sc1, of1)
+    sc2, of2, mean2, var2 = bn_consts(a2, c2, m1, g2, b2, eps)
 
     y3, a3, c3 = fused_matmul_bn(flat(y2), mm(w3), sc2, of2)
     sc3, of3, mean3, var3 = bn_consts(a3, c3, y3.shape[0], g3, b3, eps)

@@ -1,0 +1,418 @@
+"""Fused 3x3-conv + BatchNorm Pallas kernels (the stage convs).
+
+`fused_block.py` removed the BN-structured HBM traffic around the 1x1
+convolutions of a bottleneck ResNet; this module does the same for the
+remaining 3x3 stage convs (stride 1, pad 1, NHWC), which the round-4
+roofline (docs/performance.md) identified as the last structural
+activation traffic:
+
+  * the previous BatchNorm's normalize+ReLU runs as the conv's PROLOGUE
+    in-register — the normalized activation (`y1n` in the old
+    `_bottleneck_core`) is never materialized in HBM;
+  * the conv emits per-channel sum(y) and sum(y^2) from its EPILOGUE —
+    the BN batch stats of the conv output cost zero extra HBM reads.
+
+Kernel shape: a 3x3/s1/p1 conv over NHWC is nine shifted matmuls.  The
+flattened (N*H*W, C) activation is blocked into groups of whole images
+(block = b*H*W rows, so every spatial shift stays inside the block);
+each tap (dh, dw) contributes dot(shift(x, dh*W+dw), W[dh,dw]) with an
+iota-derived validity mask zeroing out-of-image neighbors.  No halo
+exchange, no padded-copy of the input in HBM.  The custom VJP keeps the
+property backward: dx is the nine-tap transposed conv of the
+stats-adjusted cotangent (dy + ds1 + 2*y*ds2) with the ReLU/normalize
+backward and dscale/dbias reductions fused as epilogues; dw accumulates
+the nine (C, C_out) tap gradients across image blocks in fp32.
+
+Reference analog: the conv+BN+ReLU segments the reference fuses via
+cuDNN/NNVM (src/operator/fusion/fused_op.cu:24,
+src/executor/pointwise_fusion_pass.cc) — re-designed as TPU Pallas
+kernels with stats epilogues instead of NVRTC codegen.
+
+Numerics match `fused_block.py`: MXU matmuls in the input dtype (bf16
+on the bench path) with fp32 accumulation, prologue normalize in fp32,
+stats accumulated in fp32 from the *rounded* output (the one-pass
+E[x^2]-mu^2 convention of ops.nn_ops.batch_norm).
+
+VMEM policy: channel width and block height anti-correlate in ResNet
+(56px@64ch ... 7px@512ch), so whole-image blocks fit comfortably up to
+256 channels; configurations whose working set exceeds the budget
+(512-channel stage-4, where activation traffic is tiny anyway) fall
+back to the XLA composition, as does any stride/kernel/geometry this
+kernel does not cover.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_kernels import (_round_up, interpret_mode, kernel_known_good,
+                             use_pallas)
+
+__all__ = ["fused_conv3_bn", "xla_conv3_bn"]
+
+# VMEM working-set ceiling for the fused conv kernels (bytes).  The dw
+# kernel is the worst case: 9*kp*np*4 (fp32 tap-gradient accumulator)
+# + activation/cotangent tiles.
+_VMEM_BUDGET = int(os.environ.get("MXNET_FUSED_CONV3_VMEM", 10 * 2 ** 20))
+
+_TAPS = [(dh, dw) for dh in (-1, 0, 1) for dw in (-1, 0, 1)]
+
+
+def _shift_rows(a, off):
+    """Shift rows of a 2-D block by `off` (static), zero-filling — the
+    flattened-NHWC analog of a spatial (dh, dw) displacement."""
+    if off == 0:
+        return a
+    z = jnp.zeros((abs(off), a.shape[1]), a.dtype)
+    if off > 0:
+        return jnp.concatenate([a[off:], z], axis=0)
+    return jnp.concatenate([z, a[:off]], axis=0)
+
+
+def _local_hw(bm, w_img, h_img):
+    """Per-row image-local (h, w) coordinates for a whole-image block."""
+    r = jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+    return (r // w_img) % h_img, r % w_img
+
+
+# ---------------------------------------------------------------------------
+# forward: y = conv3x3([relu(x*scale+bias)]), s1 = sum(y), s2 = sum(y^2)
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(x_ref, w_ref, sc_ref, bi_ref, y_ref, s1_ref, s2_ref, *,
+                m_real, bm, kp, h_img, w_img, prologue):
+    i = pl.program_id(0)
+    xf = x_ref[...].astype(jnp.float32)
+    if prologue:
+        xf = jnp.maximum(xf * sc_ref[...] + bi_ref[...], 0.0)
+    xc = xf.astype(x_ref.dtype)  # MXU runs in the input dtype
+    hl, wl = _local_hw(bm, w_img, h_img)
+    acc = jnp.zeros((bm, y_ref.shape[1]), jnp.float32)
+    for t, (dh, dw) in enumerate(_TAPS):
+        shifted = _shift_rows(xc, dh * w_img + dw)
+        valid = ((hl + dh >= 0) & (hl + dh < h_img)
+                 & (wl + dw >= 0) & (wl + dw < w_img))
+        shifted = jnp.where(valid, shifted, 0)
+        acc += jax.lax.dot_general(
+            shifted, w_ref[t * kp:(t + 1) * kp, :],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    yb = acc.astype(y_ref.dtype)
+    y_ref[...] = yb
+
+    @pl.when(i == 0)
+    def _init():
+        s1_ref[...] = jnp.zeros_like(s1_ref)
+        s2_ref[...] = jnp.zeros_like(s2_ref)
+
+    # pad rows produce values (their shifted taps read real rows) but
+    # must not enter the batch stats
+    rows = i * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+    yf = jnp.where(rows < m_real, yb.astype(jnp.float32), 0.0)
+    s1_ref[...] += jnp.sum(yf, axis=0, keepdims=True)
+    s2_ref[...] += jnp.sum(jnp.square(yf), axis=0, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _dyt(dy_ref, y_ref, ds1_ref, ds2_ref, rows, m_real):
+    """Stats-adjusted cotangent dy + ds1 + 2*y*ds2, zeroed on pad rows
+    (the ds1/ds2 broadcasts would otherwise hit them)."""
+    d = (dy_ref[...].astype(jnp.float32) + ds1_ref[...]
+         + 2.0 * y_ref[...].astype(jnp.float32) * ds2_ref[...])
+    return jnp.where(rows < m_real, d, 0.0)
+
+
+def _bwd_dx_kernel(dy_ref, y_ref, ds1_ref, ds2_ref, w_ref, x_ref, sc_ref,
+                   bi_ref, dx_ref, dsc_ref, dbi_ref, *,
+                   m_real, bm, kp, h_img, w_img, prologue):
+    i = pl.program_id(0)
+    rows = i * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+    dyt = _dyt(dy_ref, y_ref, ds1_ref, ds2_ref, rows, m_real)
+    dc = dyt.astype(dy_ref.dtype)
+    hl, wl = _local_hw(bm, w_img, h_img)
+    dxn = jnp.zeros((bm, kp), jnp.float32)
+    for t, (dh, dw) in enumerate(_TAPS):
+        # x-position r received tap (dh,dw) from output position r-off;
+        # validity is the forward condition evaluated at that output
+        shifted = _shift_rows(dc, -(dh * w_img + dw))
+        valid = ((hl - dh >= 0) & (hl - dh < h_img)
+                 & (wl - dw >= 0) & (wl - dw < w_img))
+        shifted = jnp.where(valid, shifted, 0)
+        dxn += jax.lax.dot_general(
+            shifted, w_ref[t * kp:(t + 1) * kp, :],
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    dxn = jnp.where(rows < m_real, dxn, 0.0)
+
+    @pl.when(i == 0)
+    def _init():
+        dsc_ref[...] = jnp.zeros_like(dsc_ref)
+        dbi_ref[...] = jnp.zeros_like(dbi_ref)
+
+    if prologue:
+        xf = x_ref[...].astype(jnp.float32)
+        z = xf * sc_ref[...] + bi_ref[...]
+        dz = jnp.where(z > 0.0, dxn, 0.0)
+        dx_ref[...] = (dz * sc_ref[...]).astype(dx_ref.dtype)
+        dsc_ref[...] += jnp.sum(dz * xf, axis=0, keepdims=True)
+        dbi_ref[...] += jnp.sum(dz, axis=0, keepdims=True)
+    else:
+        dx_ref[...] = dxn.astype(dx_ref.dtype)
+
+
+def _bwd_dw_kernel(x_ref, dy_ref, y_ref, ds1_ref, ds2_ref, sc_ref, bi_ref,
+                   dw_ref, *, m_real, bm, kp, h_img, w_img, prologue):
+    i = pl.program_id(0)
+    rows = i * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+    dyt = _dyt(dy_ref, y_ref, ds1_ref, ds2_ref, rows, m_real)
+    dc = dyt.astype(dy_ref.dtype)
+    xf = x_ref[...].astype(jnp.float32)
+    if prologue:
+        xf = jnp.maximum(xf * sc_ref[...] + bi_ref[...], 0.0)
+    xc = xf.astype(x_ref.dtype)
+    hl, wl = _local_hw(bm, w_img, h_img)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+
+    for t, (dh, dw) in enumerate(_TAPS):
+        shifted = _shift_rows(xc, dh * w_img + dw)
+        valid = ((hl + dh >= 0) & (hl + dh < h_img)
+                 & (wl + dw >= 0) & (wl + dw < w_img))
+        shifted = jnp.where(valid, shifted, 0)
+        dw_ref[t * kp:(t + 1) * kp, :] += jax.lax.dot_general(
+            shifted, dc, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# geometry / wrappers
+# ---------------------------------------------------------------------------
+
+class _Geom:
+    """Blocking plan for a (N, H, W, C)->C_out fused conv, or None when
+    the kernel cannot cover the configuration (wrapper falls back)."""
+
+    def __init__(self, x4, cout):
+        n, h, w, c = x4.shape
+        self.n, self.h, self.w, self.c, self.cout = n, h, w, c, cout
+        self.hw = h * w
+        self.m = n * self.hw
+        self.kp = _round_up(c, 128)
+        self.np = _round_up(cout, 128)
+        row_mult = 16 if x4.dtype == jnp.bfloat16 else 8
+        b = 1
+        while (b * self.hw) % row_mult and b <= row_mult:
+            b += 1
+        # small images: grow blocks toward a decent MXU M tile
+        while b * self.hw < 256 and b * 2 * self.hw <= 4096:
+            b *= 2
+        self.bm = b * self.hw
+        self.mp = _round_up(self.m, self.bm)
+        self.grid = self.mp // self.bm
+
+    def fits(self):
+        if (self.bm * self.hw) == 0 or (self.bm % 8):
+            return False
+        # dw kernel is the VMEM worst case: fp32 tap accumulator + x/dy/y
+        # tiles + one fp32 cotangent temp
+        dw_bytes = (9 * self.kp * self.np * 4
+                    + self.bm * (self.kp + 2 * self.np) * 2
+                    + self.bm * self.np * 4)
+        return dw_bytes <= _VMEM_BUDGET
+
+    def pad_x(self, x4):
+        x2 = x4.reshape(self.m, self.c)
+        return jnp.pad(x2, ((0, self.mp - self.m), (0, self.kp - self.c)))
+
+    def pad_w(self, w):  # (3, 3, C, C_out) HWIO -> (9*kp, np)
+        wt = w.reshape(9, self.c, self.cout)
+        wt = jnp.pad(wt, ((0, 0), (0, self.kp - self.c),
+                          (0, self.np - self.cout)))
+        return wt.reshape(9 * self.kp, self.np)
+
+    def pad_vec(self, v, width):
+        return jnp.pad(v.astype(jnp.float32),
+                       (0, width - v.shape[0])).reshape(1, width)
+
+
+def _fwd_impl(x4, w, scale, bias, prologue):
+    g = _Geom(x4, w.shape[-1])
+    kern = functools.partial(_fwd_kernel, m_real=g.m, bm=g.bm, kp=g.kp,
+                             h_img=g.h, w_img=g.w, prologue=prologue)
+    y, s1, s2 = pl.pallas_call(
+        kern,
+        out_shape=[jax.ShapeDtypeStruct((g.mp, g.np), x4.dtype),
+                   jax.ShapeDtypeStruct((1, g.np), jnp.float32),
+                   jax.ShapeDtypeStruct((1, g.np), jnp.float32)],
+        grid=(g.grid,),
+        in_specs=[
+            pl.BlockSpec((g.bm, g.kp), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((9 * g.kp, g.np), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, g.kp), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, g.kp), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((g.bm, g.np), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, g.np), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, g.np), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        interpret=interpret_mode(),
+    )(g.pad_x(x4), g.pad_w(w), g.pad_vec(scale, g.kp),
+      g.pad_vec(bias, g.kp))
+    y = y[:g.m, :g.cout].reshape(g.n, g.h, g.w, g.cout)
+    return y, s1[0, :g.cout], s2[0, :g.cout]
+
+
+def _bwd_impl(x4, w, scale, bias, y4, dy4, ds1, ds2, prologue):
+    g = _Geom(x4, w.shape[-1])
+    xp = g.pad_x(x4)
+    wp = g.pad_w(w)
+    scp = g.pad_vec(scale, g.kp)
+    bip = g.pad_vec(bias, g.kp)
+    pad_y = lambda t: jnp.pad(t.reshape(g.m, g.cout),
+                              ((0, g.mp - g.m), (0, g.np - g.cout)))
+    dyp, yp = pad_y(dy4), pad_y(y4)
+    ds1p = g.pad_vec(ds1, g.np)
+    ds2p = g.pad_vec(ds2, g.np)
+    row_spec = lambda cols: pl.BlockSpec((g.bm, cols), lambda i: (i, 0),
+                                         memory_space=pltpu.VMEM)
+    vec_spec = lambda cols: pl.BlockSpec((1, cols), lambda i: (0, 0),
+                                         memory_space=pltpu.VMEM)
+
+    dx, dsc, dbi = pl.pallas_call(
+        functools.partial(_bwd_dx_kernel, m_real=g.m, bm=g.bm, kp=g.kp,
+                          h_img=g.h, w_img=g.w, prologue=prologue),
+        out_shape=[jax.ShapeDtypeStruct((g.mp, g.kp), x4.dtype),
+                   jax.ShapeDtypeStruct((1, g.kp), jnp.float32),
+                   jax.ShapeDtypeStruct((1, g.kp), jnp.float32)],
+        grid=(g.grid,),
+        in_specs=[row_spec(g.np), row_spec(g.np), vec_spec(g.np),
+                  vec_spec(g.np),
+                  pl.BlockSpec((9 * g.kp, g.np), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+                  row_spec(g.kp), vec_spec(g.kp), vec_spec(g.kp)],
+        out_specs=[row_spec(g.kp), vec_spec(g.kp), vec_spec(g.kp)],
+        interpret=interpret_mode(),
+    )(dyp, yp, ds1p, ds2p, wp, xp, scp, bip)
+
+    dw = pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, m_real=g.m, bm=g.bm, kp=g.kp,
+                          h_img=g.h, w_img=g.w, prologue=prologue),
+        out_shape=jax.ShapeDtypeStruct((9 * g.kp, g.np), jnp.float32),
+        grid=(g.grid,),
+        in_specs=[row_spec(g.kp), row_spec(g.np), row_spec(g.np),
+                  vec_spec(g.np), vec_spec(g.np), vec_spec(g.kp),
+                  vec_spec(g.kp)],
+        out_specs=pl.BlockSpec((9 * g.kp, g.np), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret_mode(),
+    )(xp, dyp, yp, ds1p, ds2p, scp, bip)
+
+    dx = dx[:g.m, :g.c].reshape(x4.shape)
+    dw = dw.reshape(9, g.kp, g.np)[:, :g.c, :g.cout].reshape(
+        3, 3, g.c, g.cout).astype(w.dtype)
+    if prologue:
+        return dx, dw, dsc[0, :g.c], dbi[0, :g.c]
+    return dx, dw, jnp.zeros_like(scale), jnp.zeros_like(bias)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing + XLA reference/fallback
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fc3(x, w, scale, bias, prologue):
+    y, s1, s2 = _fwd_impl(x, w, scale, bias, prologue)
+    return y, s1, s2
+
+
+def _fc3_fwd(x, w, scale, bias, prologue):
+    y, s1, s2 = _fwd_impl(x, w, scale, bias, prologue)
+    return (y, s1, s2), (x, w, scale, bias, y)
+
+
+def _fc3_bwd(prologue, res, cts):
+    x, w, scale, bias, y = res
+    dy, ds1, ds2 = cts
+    return _bwd_impl(x, w, scale, bias, y, dy, ds1, ds2, prologue)
+
+
+_fc3.defvjp(_fc3_fwd, _fc3_bwd)
+
+
+def xla_conv3_bn(x, w, scale=None, bias=None):
+    """Pure-XLA composition with the same contract (fallback + oracle).
+
+    x: (N, H, W, C) NHWC; w: (3, 3, C, C_out) HWIO.
+    """
+    if scale is not None:
+        xn = jnp.maximum(x.astype(jnp.float32) * scale.astype(jnp.float32)
+                         + bias.astype(jnp.float32), 0.0).astype(x.dtype)
+    else:
+        xn = x
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NHWC", "HWIO", "NHWC"))
+    y = jax.lax.conv_general_dilated(
+        xn, w, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=dn).astype(x.dtype)
+    yf = y.astype(jnp.float32)
+    return (y, jnp.sum(yf, axis=(0, 1, 2)),
+            jnp.sum(jnp.square(yf), axis=(0, 1, 2)))
+
+
+def _conv3_kernel_on():
+    """Kernel dispatch gate.  Unlike the generic use_pallas contract,
+    an explicit MXNET_USE_PALLAS=1 still honors a negative manifest
+    verdict here: the bench forces '1' for the fused-bottleneck config,
+    and a Mosaic-broken conv kernel must downgrade to the XLA
+    composition (the 1x1 kernels keep running) rather than sink the
+    whole attempt.  MXNET_FUSED_CONV3 ∈ {auto,0,1} overrides."""
+    flag = os.environ.get("MXNET_FUSED_CONV3", "auto").lower()
+    if flag in ("0", "false", "off"):
+        return False
+    if flag in ("1", "true", "on"):
+        return True
+    return use_pallas("fused_conv3_bn") and kernel_known_good(
+        "fused_conv3_bn")
+
+
+def fused_conv3_bn(x, w, scale=None, bias=None):
+    """3x3/s1/p1 NHWC conv with BN stats epilogue and optional
+    normalize+ReLU prologue.
+
+    Args:
+      x: (N, H, W, C) activations (bf16 or f32).
+      w: (3, 3, C, C_out) HWIO conv kernel.
+      scale, bias: optional per-C fp32 normalize constants; when given,
+        relu(x*scale+bias) is applied in-register (never materialized).
+
+    Returns ``(y, s1, s2)``: y (N, H, W, C_out) plus fp32 per-channel
+    ``s1 = sum(y)``, ``s2 = sum(y^2)`` over N*H*W (one-pass BN stats:
+    mean = s1/M, var = s2/M - mean^2).
+    """
+    prologue = scale is not None
+    if w.ndim != 4 or w.shape[0] != 3 or w.shape[1] != 3:
+        raise ValueError(f"fused_conv3_bn needs a 3x3 HWIO kernel, "
+                         f"got {w.shape}")
+    if scale is None:
+        scale = jnp.ones((x.shape[-1],), jnp.float32)
+        bias = jnp.zeros((x.shape[-1],), jnp.float32)
+    if not (_conv3_kernel_on() and _Geom(x, w.shape[-1]).fits()):
+        return xla_conv3_bn(x, w, scale if prologue else None,
+                            bias if prologue else None)
+    return _fc3(x, w, scale, bias, prologue)

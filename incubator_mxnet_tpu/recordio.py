@@ -16,7 +16,8 @@ import struct
 import numpy as onp
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IndexedRecordIO",
-           "IRHeader", "pack", "unpack", "pack_img", "unpack_img"]
+           "IRHeader", "pack", "unpack", "pack_img", "unpack_img",
+           "pack_raw", "unpack_raw"]
 
 _MAGIC = 0xced7230a
 _MAGIC_BYTES = struct.pack("<I", _MAGIC)
@@ -276,3 +277,30 @@ def unpack_img(s, iscolor=1):
     from . import image
     header, buf = unpack(s)
     return header, image.imdecode_np(buf, iscolor)
+
+
+def pack_raw(header, img):
+    """Pack a pre-decoded HWC uint8 image ("MXTR" passthrough format).
+
+    The native iterator (src/image_iter.cc ProcessSample) detects the
+    magic and skips JPEG decode — for pre-decoded datasets and IO
+    benchmarks where decode throughput would measure the host CPU
+    rather than the pipeline.
+    """
+    img = onp.ascontiguousarray(img, dtype=onp.uint8)
+    if img.ndim != 3 or img.shape[2] != 3:
+        raise ValueError(f"pack_raw needs HWC RGB uint8, got {img.shape}")
+    h, w = img.shape[:2]
+    payload = b"MXTR" + struct.pack("<ii", h, w) + img.tobytes()
+    return pack(header, payload)
+
+
+def unpack_raw(s):
+    """Inverse of pack_raw (pure-Python side)."""
+    header, buf = unpack(s)
+    if buf[:4] != b"MXTR":
+        raise ValueError("not a raw MXTR record")
+    h, w = struct.unpack("<ii", buf[4:12])
+    img = onp.frombuffer(buf, onp.uint8, count=3 * h * w,
+                         offset=12).reshape(h, w, 3)
+    return header, img

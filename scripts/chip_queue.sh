@@ -1,27 +1,34 @@
 #!/bin/bash
-# Serial queue of every measurement that needs the real TPU chip.
-# Resumable: each job writes its artifact under artifacts/r4/ and is
-# skipped when that file already exists (delete to re-run).  One job at
-# a time — the chip is single-claim.  A wedged tunnel costs one job's
-# timeout, not the queue.
+# Serial queue of the round-5 must-land measurements (VERDICT r4 Next
+# #2/#3): the full consistency battery (wedge-aware harness, resumes
+# from the r4 record), the opperf per-op TPU latency table, and the
+# int8 end-to-end device run.  Resumable: each job writes its artifact
+# under $ART_DIR and is skipped when clean (delete to re-run).  One job
+# at a time — the chip is single-claim.
 set -u
 cd "$(dirname "$0")/.."
-mkdir -p artifacts/r4
 . "$(dirname "$0")/chip_queue_lib.sh"
+mkdir -p "$ART_DIR"
 
-# cheap liveness gate so a wedged tunnel exits fast
 if ! chip_alive; then
   echo "chip not reachable — aborting queue"; exit 1
 fi
-echo "chip alive; running queue"
+echo "chip alive; running queue 1"
 
-run ablate    900  python scripts/perf_probe.py ablate
-run raw128    900  env PROBE_BS=128 python scripts/perf_probe.py raw
-run raw128n   900  env PROBE_BS=128 PROBE_LAYOUT=NCHW python scripts/perf_probe.py raw
-run raw256r   900  env PROBE_BS=256 PROBE_REMAT=1 python scripts/perf_probe.py raw
-run bench     1100 env BENCH_DEADLINE=1000 BENCH_SWEEP=128,256,512 python bench.py
-run benchrem  900  env BENCH_DEADLINE=800 BENCH_SWEEP=256,512 BENCH_REMAT=dots python bench.py
-run consist   1500 python scripts/tpu_consistency.py --deadline 1400
-run opperf    1800 python benchmark/opperf.py --platform tpu --resume --output artifacts/r4/opperf_tpu.json
+# seed the battery's resume state from round 4 (124 ok carried over;
+# fails/unknowns are retried by the harness)
+if [ ! -s "$ART_DIR/consistency.json" ] && \
+   [ -s artifacts/r4/consistency.json ]; then
+  cp artifacts/r4/consistency.json "$ART_DIR/consistency.json"
+fi
+
+run consist   1500 python scripts/tpu_consistency.py --deadline 1400 \
+                       --out "$ART_DIR/consistency.json"
+run opperf    1800 python benchmark/opperf.py --platform tpu --resume \
+                       --output "$ART_DIR/opperf_tpu.json"
 run int8      1500 python examples/quantize_resnet50.py
-echo "queue complete"
+# the battery usually needs >1 chunk-window: give it a second slot in
+# the same window if the first hit its deadline mid-run
+run consist2  1500 python scripts/tpu_consistency.py --deadline 1400 \
+                       --out "$ART_DIR/consistency.json"
+echo "queue 1 complete"

@@ -1,20 +1,22 @@
 #!/bin/bash
 # Highest-value-density chip jobs, run FIRST on any recovered window:
-#   smoke3 — prove fused_matmul_bn under Mosaic and refresh the kernel
-#            manifest: after this, bench.py (including the DRIVER's
-#            end-of-round run) auto-tries the fused config on its own.
+#   smoke3 — prove every Pallas kernel under Mosaic (incl. the fused
+#            matmul+BN and conv-fused kernels) and refresh the manifest:
+#            after this, bench.py (including the DRIVER's end-of-round
+#            run) auto-tries the fused config on its own.
 #   fmm    — per-shape kernel-vs-XLA microbench + block-size tune.
-# Same resumable artifact convention as chip_queue.sh.
+# Same resumable artifact convention as chip_queue.sh (ART_DIR).
 set -u
 cd "$(dirname "$0")/.."
-mkdir -p artifacts/r4
 . "$(dirname "$0")/chip_queue_lib.sh"
+mkdir -p "$ART_DIR"
 
 if ! chip_alive; then
   echo "chip not reachable — aborting queue"; exit 1
 fi
 echo "chip alive; running queue 0"
 
-run smoke3    600  python scripts/pallas_smoke.py
+run smoke3    900  python scripts/pallas_smoke.py
 run fmm       900  env PROBE_BS=256 python scripts/perf_probe.py fmm
+run fc3       900  env PROBE_BS=256 python scripts/perf_probe.py fc3
 echo "queue 0 complete"

@@ -1,18 +1,34 @@
 #!/bin/bash
-# Follow-up chip jobs staged after the round-4 window-2 findings
-# (run after chip_queue.sh; same resumable artifact convention).
+# Fused-path proof jobs (VERDICT r4 Next #1): on-chip fused-vs-XLA
+# loss/grad cross-check, the fused timing A/Bs, and traffic
+# localization.  Same resumable artifact convention (ART_DIR).
 set -u
 cd "$(dirname "$0")/.."
-mkdir -p artifacts/r4
 . "$(dirname "$0")/chip_queue_lib.sh"
+mkdir -p "$ART_DIR"
 
 if ! chip_alive; then
   echo "chip not reachable — aborting queue"; exit 1
 fi
 echo "chip alive; running queue 2"
 
-# per-stage traffic localization (which stage owns the ~24 GB)
+# fused-bottleneck step: on-chip loss/grad cross-check, then timing A/B
+run fusedver  900  env PROBE_FUSED=1 PROBE_VERIFY=1 PROBE_BS=128 \
+                       python scripts/perf_probe.py raw
+run fused256  900  env PROBE_FUSED=1 PROBE_BS=256 \
+                       python scripts/perf_probe.py raw
+# framework-level A/B: NHWC layout alone, then NHWC + fused blocks
+run benchnhwc 900  env BENCH_DEADLINE=800 BENCH_SWEEP=256 BENCH_LAYOUT=NHWC \
+                       python bench.py
+run benchfus  1100 env BENCH_DEADLINE=1000 BENCH_SWEEP=128,256 \
+                       BENCH_LAYOUT=NHWC BENCH_FUSED=1 MXNET_USE_PALLAS=1 \
+                       python bench.py
+# per-stage traffic localization (which stage owns the HBM bytes)
 run stages128 1200 env PROBE_BS=128 python scripts/perf_probe.py stages
-# eval-BN raw at bs=256: bounds the BN-stat cost at the headline batch
-run raw256nb  600  env PROBE_BS=256 PROBE_BN=eval python scripts/perf_probe.py raw
+# IO-fed bench (VERDICT r4 Next #5): native RecordIO pipeline + device
+# double-buffering; raw = pipeline/transfer overlap, jpeg = full decode
+run benchio   900  env BENCH_DEADLINE=800 BENCH_SWEEP=256 BENCH_IO=raw \
+                       python bench.py
+run benchiojpg 700 env BENCH_DEADLINE=600 BENCH_SWEEP=256 BENCH_IO=jpeg \
+                       BENCH_STEPS=10 python bench.py
 echo "queue 2 complete"

@@ -1,37 +1,31 @@
 #!/bin/bash
-# Round-4 session-3 chip jobs: fused-bottleneck Pallas A/B + XLA flag
-# sweep.  Same resumable artifact convention as chip_queue.sh.
+# Secondary chip jobs: XLA flag sweep, zoo inference score tables,
+# eval-BN bound, and the round-5 additions (accuracy parity on chip,
+# IO-fed bench) once their scripts land.  Resumable (ART_DIR).
 set -u
 cd "$(dirname "$0")/.."
-mkdir -p artifacts/r4
 . "$(dirname "$0")/chip_queue_lib.sh"
+mkdir -p "$ART_DIR"
 
 if ! chip_alive; then
   echo "chip not reachable — aborting queue"; exit 1
 fi
 echo "chip alive; running queue 3"
 
-# (smoke3 + fmm moved to chip_queue0.sh — they run first on any window)
-# fused-bottleneck step: on-chip loss/grad cross-check, then timing A/B
-run fusedver  900  env PROBE_FUSED=1 PROBE_VERIFY=1 PROBE_BS=128 \
-                       python scripts/perf_probe.py raw
-run fused256  900  env PROBE_FUSED=1 PROBE_BS=256 \
-                       python scripts/perf_probe.py raw
-# framework-level A/B: NHWC layout alone, then NHWC + fused blocks
-run benchnhwc 900  env BENCH_DEADLINE=800 BENCH_SWEEP=256 BENCH_LAYOUT=NHWC \
-                       python bench.py
-run benchfus  1100 env BENCH_DEADLINE=1000 BENCH_SWEEP=128,256 \
-                       BENCH_LAYOUT=NHWC BENCH_FUSED=1 MXNET_USE_PALLAS=1 \
-                       python bench.py
 # XLA knob sweep on the un-fused step (independent lever)
 run flags     2400 python scripts/flag_sweep.py
 # zoo INFERENCE sweep on chip — BASELINE.md's headline tables are
 # inference img/s (perf.md:165-210); fp32 + the fp16-table analog (bf16)
 run score32   1500 python benchmark/score.py --batches 32 \
-                       --json artifacts/r4/score_fp32.json
+                       --json "$ART_DIR/score_fp32.json"
 run scorebf   1500 python benchmark/score.py --batches 32,128 \
-                       --dtype bfloat16 --json artifacts/r4/score_bf16.json
+                       --dtype bfloat16 --json "$ART_DIR/score_bf16.json"
 # conv+BN folding (gluon.contrib.fuse_conv_bn): the deploy-mode numbers
 run scorefb   1200 python benchmark/score.py --batches 32 --fuse-bn \
-                       --json artifacts/r4/score_fp32_fusebn.json
+                       --json "$ART_DIR/score_fp32_fusebn.json"
+# eval-BN raw at bs=256: bounds the BN-stat cost at the headline batch
+run raw256nb  600  env PROBE_BS=256 PROBE_BN=eval python scripts/perf_probe.py raw
+# accuracy parity ON CHIP (VERDICT r4 Next #4 "repeat on TPU"): real
+# digits through the full stack; asserts >=0.97 held-out top-1
+run accuracy  900  python examples/train_mnist.py --dataset digits
 echo "queue 3 complete"

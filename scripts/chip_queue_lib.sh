@@ -1,10 +1,13 @@
 # Shared helpers for the chip job queues (sourced, not executed).
+# ART_DIR selects the round's artifact directory (default artifacts/r5).
 # run NAME TIMEOUT CMD... — resumable: the job is skipped when its
 # artifact exists without a QUEUE_FAILED marker; failures keep partial
 # output + the marker so a re-run retries exactly the failed jobs.
+ART_DIR="${ART_DIR:-artifacts/r5}"
+
 run() {
   local name="$1" t="$2"; shift 2
-  local out="artifacts/r4/$name.txt"
+  local out="$ART_DIR/$name.txt"
   if [ -s "$out" ] && ! grep -q "QUEUE_FAILED" "$out"; then
     echo "== $name: already done, skipping"; return 0
   fi
@@ -24,4 +27,18 @@ import jax, jax.numpy as jnp
 d = jax.devices()[0]; assert d.platform != 'cpu'
 x = jax.device_put(jnp.ones((256,256), jnp.bfloat16), d)
 float((x@x).sum())" >/dev/null 2>&1
+}
+
+# commit_artifacts MSG — snapshot current chip artifacts into git so
+# results survive even if the session/driver window closes mid-queue.
+# One `git add` per path: a single missing pathspec (BENCH_latest_tpu
+# only exists after the first successful TPU bench) would otherwise
+# abort the whole add and stage nothing.
+commit_artifacts() {
+  for p in "$ART_DIR" BENCH_latest_tpu.json \
+           incubator_mxnet_tpu/ops/pallas_manifest.json; do
+    [ -e "$p" ] && git add -A "$p" 2>/dev/null
+  done
+  git diff --cached --quiet 2>/dev/null || \
+    git commit -q -m "${1:-chip window: artifact snapshot}" || true
 }

@@ -27,7 +27,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 KERNELS = ["fused_softmax", "fused_layer_norm", "fused_rms_norm",
-           "fused_softmax_xent", "flash_attention", "fused_matmul_bn"]
+           "fused_softmax_xent", "flash_attention", "fused_matmul_bn",
+           "fused_conv3_bn"]
 
 _CHILD_BODY = r"""
 import os, sys
@@ -106,6 +107,29 @@ def run(use_kernel):
         if use_kernel:
             return run_one(fb._fmm)
         return run_one(lambda x, w, s, b, p: fb.xla_matmul_bn(
+            x, w, s if p else None, b if p else None))
+    if name == "fused_conv3_bn":
+        from incubator_mxnet_tpu.ops import fused_conv as fcv
+        # bf16 (the bench dtype): hw=36 with sublane 16 forces b=4 image
+        # blocks and batch padding — the full masking machinery
+        x = jnp.asarray(rng.randn(2, 6, 6, 24), jnp.bfloat16) * 0.5
+        w = jnp.asarray(rng.randn(3, 3, 24, 16), jnp.bfloat16) * 0.07
+        sc = jnp.asarray(rng.rand(24) + 0.5, jnp.float32)
+        bi = jnp.asarray(rng.randn(24) * 0.2, jnp.float32)
+        dy = jnp.asarray(rng.randn(2, 6, 6, 16), jnp.bfloat16) * 0.1
+        ds1 = jnp.asarray(rng.randn(16), jnp.float32) * 0.01
+        ds2 = jnp.asarray(rng.randn(16), jnp.float32) * 0.001
+        def run_one(f):
+            outs = []
+            for prologue in (False, True):
+                y, vjp = jax.vjp(
+                    lambda x, w, s, b: f(x, w, s, b, prologue), x, w, sc, bi)
+                outs.extend(y)
+                outs.extend(vjp((dy, ds1, ds2)))
+            return tuple(outs)
+        if use_kernel:
+            return run_one(fcv._fc3)
+        return run_one(lambda x, w, s, b, p: fcv.xla_conv3_bn(
             x, w, s if p else None, b if p else None))
     if name == "flash_attention":
         q = jnp.asarray(rng.randn(2, 2, 128, 64), jnp.float32) * 0.3

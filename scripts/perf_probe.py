@@ -746,6 +746,58 @@ def probe_fmm():
               flush=True)
 
 
+def probe_fc3():
+    """Fused 3x3-conv+BN kernel A/B vs the XLA composition per ResNet
+    stage-conv shape (PROBE_BS scales the batch) — run on chip to
+    decide whether the conv kernel pays at each width
+    (ops/fused_conv.py; the 512ch stage is expected to report its VMEM
+    fallback)."""
+    import functools
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops import fused_conv as fcv
+
+    bs = int(os.environ.get("PROBE_BS", "256"))
+    shapes = [("s1 56px  64ch", 56, 64), ("s2 28px 128ch", 28, 128),
+              ("s3 14px 256ch", 14, 256), ("s4  7px 512ch", 7, 512)]
+    key = jax.random.PRNGKey(0)
+    for label, px, c in shapes:
+        kx, kw = jax.random.split(jax.random.fold_in(key, c))
+        x = jax.random.normal(kx, (bs, px, px, c), jnp.bfloat16) * 0.5
+        w = jax.random.normal(kw, (3, 3, c, c), jnp.bfloat16) \
+            * ((9 * c) ** -0.5)
+        sc = jnp.ones((c,), jnp.float32)
+        bi = jnp.zeros((c,), jnp.float32)
+        flops = 2.0 * bs * px * px * 9 * c * c
+
+        def time_fn(f):
+            # carry-chained like probe_fmm: the final sync transitively
+            # waits for every step
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(x, w):
+                _y, s1, _s2 = f(x, w)
+                return (x.at[0, 0, 0, 0].add(
+                    (s1[0] * 1e-30).astype(x.dtype)), w)
+            return timeit(step, (jnp.array(x), w), steps=10, warmup=2)
+
+        dt_x = time_fn(lambda xx, ww: fcv.xla_conv3_bn(xx, ww, sc, bi))
+        if not fcv._Geom(x, c).fits():
+            print(f"{label}: xla {dt_x * 1e3:7.3f} ms "
+                  f"({flops / dt_x / 1e12:5.1f} TF/s)  kernel: VMEM "
+                  "fallback (by design)", flush=True)
+            continue
+        try:
+            dt_f = time_fn(lambda xx, ww: fcv._fc3(xx, ww, sc, bi, True))
+        except Exception as e:
+            print(f"{label}: xla {dt_x * 1e3:7.3f} ms  kernel FAIL "
+                  f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+            continue
+        print(f"{label}: xla {dt_x * 1e3:7.3f} ms ({flops / dt_x / 1e12:5.1f}"
+              f" TF/s)  fused {dt_f * 1e3:7.3f} ms "
+              f"({flops / dt_f / 1e12:5.1f} TF/s)  "
+              f"{'WIN' if dt_f < dt_x else 'LOSS'} {dt_x / dt_f:5.2f}x",
+              flush=True)
+
+
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "fused"
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
@@ -754,6 +806,9 @@ if __name__ == "__main__":
         # while the tunnel is wedged)
         jax.config.update("jax_platforms", "cpu")
     print(f"devices: {jax.devices()}", flush=True)
+    print("MFU convention: multiply-add = 2 flops "
+          f"(peak {PEAK / 1e12:.0f} TF/s bf16); every %-of-peak below "
+          "uses it", flush=True)
     if mode == "matmul":
         probe_matmul()
     elif mode == "conv1":
@@ -768,6 +823,8 @@ if __name__ == "__main__":
         probe_raw()
     elif mode == "fmm":
         probe_fmm()
+    elif mode == "fc3":
+        probe_fc3()
     elif mode == "stages":
         # prefix sweep: deltas between consecutive rows localize the
         # train-step time (fwd+bwd+opt) per ResNet stage

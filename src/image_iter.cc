@@ -350,11 +350,28 @@ class ImageRecordIter {
         std::memcpy(&lbl[i], payload.data() + 24 + 4ull * i, 4);
       img_off += 4ull * flag;
     }
-    // decode
+    // decode: JPEG, or the raw-uint8 passthrough format ("MXTR" magic +
+    // int32 h,w + HWC bytes — written by recordio.pack_raw) used by
+    // pre-decoded pipelines and the IO-overlap benchmark, where JPEG
+    // decode throughput would measure the host CPU, not the pipeline
     std::vector<unsigned char> decoded;
     int h = 0, w = 0;
-    DecodeJpeg(payload.data() + img_off, payload.size() - img_off, &decoded,
-               &h, &w);
+    const unsigned char* img = payload.data() + img_off;
+    size_t img_len = payload.size() - img_off;
+    if (img_len >= 12 && img[0] == 'M' && img[1] == 'X' && img[2] == 'T' &&
+        img[3] == 'R') {
+      int32_t rh32, rw32;
+      std::memcpy(&rh32, img + 4, 4);
+      std::memcpy(&rw32, img + 8, 4);
+      h = rh32;
+      w = rw32;
+      if (h <= 0 || w <= 0 ||
+          img_len < 12 + 3ull * static_cast<size_t>(h) * w)
+        throw std::runtime_error("raw record geometry mismatch");
+      decoded.assign(img + 12, img + 12 + 3ull * h * w);
+    } else {
+      DecodeJpeg(img, img_len, &decoded, &h, &w);
+    }
     // resize: shorter side to p_.resize (keeping aspect) or direct
     std::vector<unsigned char> sized;
     int rh, rw;

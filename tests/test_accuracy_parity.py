@@ -1,0 +1,47 @@
+"""Accuracy-parity convergence test (VERDICT r4 Next #4).
+
+Reference analog: tests/python/train/test_conv.py trains LeNet-MNIST to
+an asserted 0.98 top-1.  Offline (zero-egress) real-data analog here:
+scikit-learn's 1797 genuine handwritten digits, trained through the
+full stack (HybridBlock -> hybridize -> DataLoader -> Trainer(kvstore
+'device')) to an asserted >=0.97 held-out top-1.
+
+Nightly-gated (~2.5 min CPU) like the reference's train suite; the
+committed artifact from a full run is artifacts/r5/accuracy_digits_*.txt.
+A fast 8-epoch sanity leg always runs: real data must reach >=0.80 —
+random guessing is 0.10, so this still proves genuine convergence.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _train_digits(epochs, target):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "train_mnist.py"),
+         "--dataset", "digits", "--epochs", str(epochs),
+         "--target-acc", str(target)],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert proc.returncode == 0, (proc.stdout[-500:], proc.stderr[-500:])
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("RESULT digits_test_top1")][0]
+    return float(line.split()[2])
+
+
+def test_digits_quick_convergence():
+    acc = _train_digits(epochs=8, target=0.80)
+    assert acc >= 0.80, acc
+
+
+@pytest.mark.skipif(os.environ.get("MXNET_TEST_NIGHTLY") != "1",
+                    reason="nightly: full 40-epoch accuracy-parity run")
+def test_digits_accuracy_parity_nightly():
+    acc = _train_digits(epochs=40, target=0.97)
+    assert acc >= 0.97, acc

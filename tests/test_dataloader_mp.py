@@ -1,0 +1,105 @@
+"""Multiprocess shared-memory DataLoader (VERDICT r4 Next #6; reference
+python/mxnet/gluon/data/dataloader.py:28-133 +
+src/storage/cpu_shared_storage_manager.h).
+
+Workers are forked numpy-only children; batches travel through POSIX
+shared memory and are yielded in sampler order.  The thread-pool path
+stays the default (GIL-releasing decode); the process path is for
+GIL-bound Python augmentation.
+"""
+import os
+import time
+
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import gluon
+
+
+def _mk_dataset(n=64, shape=(3, 8, 8)):
+    rng = onp.random.RandomState(0)
+    x = rng.rand(n, *shape).astype(onp.float32)
+    y = rng.randint(0, 10, (n,)).astype(onp.int32)
+    return gluon.data.ArrayDataset(x, y), x, y
+
+
+@pytest.mark.parametrize("num_workers", [1, 3])
+def test_mp_loader_matches_serial(num_workers):
+    ds, x, y = _mk_dataset()
+    serial = gluon.data.DataLoader(ds, batch_size=10, shuffle=False)
+    mp = gluon.data.DataLoader(ds, batch_size=10, shuffle=False,
+                               num_workers=num_workers, thread_pool=False)
+    got = list(mp)
+    want = list(serial)
+    assert len(got) == len(want) == 7  # 64/10 -> 6 full + 1 tail (keep)
+    for (gx, gy), (wx, wy) in zip(got, want):
+        onp.testing.assert_allclose(gx.asnumpy(), wx.asnumpy())
+        onp.testing.assert_array_equal(gy.asnumpy(), wy.asnumpy())
+
+
+def test_mp_loader_with_transform_and_shuffle():
+    ds, x, y = _mk_dataset(48)
+    ds_t = ds.transform(lambda img, lbl: (img * 2.0, lbl))
+    loader = gluon.data.DataLoader(ds_t, batch_size=16, shuffle=True,
+                                   num_workers=2, thread_pool=False)
+    seen = []
+    for bx, by in loader:
+        assert bx.shape == (16, 3, 8, 8)
+        seen.extend(by.asnumpy().tolist())
+    # shuffled cover of the whole dataset, each label once
+    assert sorted(seen) == sorted(y.tolist())
+
+
+def test_mp_loader_worker_error_propagates():
+    class Bad(gluon.data.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, idx):
+            if idx == 5:
+                raise ValueError("boom at 5")
+            return onp.zeros((2,), onp.float32)
+
+    loader = gluon.data.DataLoader(Bad(), batch_size=4, num_workers=2,
+                                   thread_pool=False)
+    with pytest.raises(RuntimeError, match="boom at 5"):
+        list(loader)
+
+
+def test_mp_loader_early_abandon_cleans_up():
+    ds, _, _ = _mk_dataset(64)
+    loader = gluon.data.DataLoader(ds, batch_size=8, num_workers=2,
+                                   thread_pool=False)
+    it = iter(loader)
+    next(it)
+    it.close()  # GeneratorExit path: workers stop, in-flight shm unlinked
+
+
+@pytest.mark.skipif((os.cpu_count() or 1) < 4,
+                    reason="needs >=4 cores for a meaningful race")
+def test_mp_beats_threads_on_gil_bound_transform():
+    """The reason the process path exists: pure-Python augmentation
+    serializes a thread pool on the GIL but scales across workers."""
+    def heavy(img, lbl):  # pure-Python loop: holds the GIL
+        s = 0.0
+        for i in range(4000):
+            s += (i % 7) * 1e-9
+        return img + s, lbl
+
+    ds, _, _ = _mk_dataset(256, shape=(4, 4))
+    ds_t = ds.transform(heavy)
+
+    def run(**kw):
+        t0 = time.perf_counter()
+        n = sum(1 for _ in gluon.data.DataLoader(
+            ds_t, batch_size=32, **kw))
+        assert n == 8
+        return time.perf_counter() - t0
+
+    run(num_workers=4, thread_pool=False)  # fork/import warm-up
+    t_threads = min(run(num_workers=4), run(num_workers=4))
+    t_procs = min(run(num_workers=4, thread_pool=False),
+                  run(num_workers=4, thread_pool=False))
+    # loose bound: procs must at least not lose; on a real multicore
+    # box they win ~Nx
+    assert t_procs < t_threads * 1.1, (t_procs, t_threads)

@@ -307,6 +307,54 @@ def test_launch_ssh_loopback(tmp_path):
         f"stderr:\n{proc.stderr}")
 
 
+def test_launch_yarn_fake_yarn(tmp_path):
+    """launch_yarn submits a distributed-shell app; the shim runs the
+    bootstrap script once per container locally (what the AM would do
+    across the cluster) and blocks like the real client.  Worker ids
+    and the coordinator address come from the launcher's rendezvous
+    service on the submit node — the real path, placement-independent."""
+    yarn = tmp_path / "fake_yarn"
+    _write_exec(yarn, """#!/usr/bin/env python
+import subprocess, sys
+args = sys.argv[1:]
+script, n = None, 0
+i = 0
+while i < len(args):
+    if args[i] == "-shell_script":
+        script = args[i + 1]; i += 2
+    elif args[i] == "-num_containers":
+        n = int(args[i + 1]); i += 2
+    else:
+        i += 1
+procs = [subprocess.Popen(["bash", script]) for _ in range(n)]
+sys.exit(max(p.wait() for p in procs))
+""")
+    script = os.path.join(REPO, "tests", "_dist_yarn_worker_tmp.py")
+    with open(script, "w") as f:
+        f.write(_WORKER_SCRIPT)
+    env = dict(os.environ)
+    env["MXT_REPO"] = REPO
+    env["MXT_TEST_KVTYPE"] = "dist_sync"
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+             "-n", "2", "--launcher", "yarn",
+             "--yarn-cmd", str(yarn), "--yarn-jar", "/dev/null",
+             "--yarn-head", "127.0.0.1",
+             "--env", "MXT_REPO:" + REPO,
+             "--env", "MXT_TEST_KVTYPE:dist_sync",
+             "--env", "JAX_PLATFORMS:cpu",
+             sys.executable, script],
+            env=env, capture_output=True, text=True, timeout=240)
+    finally:
+        os.unlink(script)
+    assert proc.returncode == 0, (
+        f"yarn launcher failed\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}")
+
+
 def test_launch_sge_fake_qsub(tmp_path):
     """launch_sge submits a qsub array job; the shim runs the generated
     job script locally once per task with SGE_TASK_ID=1..N (what gridengine

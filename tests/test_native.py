@@ -243,6 +243,34 @@ def test_image_record_iter(tmp_path):
     assert len(again) == 3
 
 
+def test_image_record_iter_raw_passthrough(tmp_path):
+    """pack_raw "MXTR" records skip JPEG decode in the native pipeline
+    (pre-decoded datasets / the IO-overlap bench) — exact passthrough at
+    matching geometry, auto-detected per record alongside JPEG."""
+    rng = onp.random.RandomState(3)
+    path = str(tmp_path / "raw.rec")
+    w = recordio.MXRecordIO(path, "w")
+    imgs = []
+    for i in range(8):
+        img = rng.randint(0, 255, (16, 16, 3), dtype=onp.uint8)
+        w.write(recordio.pack_raw(recordio.IRHeader(0, float(i), i, 0),
+                                  img))
+        imgs.append(img)
+    w.close()
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                               batch_size=4, shuffle=False,
+                               preprocess_threads=2)
+    batch = next(iter(it))
+    data = batch.data[0].asnumpy()
+    for s in range(4):
+        ref = imgs[s].transpose(2, 0, 1).astype(onp.float32)
+        onp.testing.assert_array_equal(data[s], ref)
+    # python-side inverse
+    hdr, img = recordio.unpack_raw(
+        recordio.pack_raw(recordio.IRHeader(0, 5.0, 7, 0), imgs[0]))
+    assert hdr.label == 5.0 and (img == imgs[0]).all()
+
+
 def test_image_record_iter_augment_normalize(tmp_path):
     path, colors = _write_jpeg_rec(tmp_path, n=4)
     # reference semantics (iter_normalize.h): out = (px - mean) * scale / std

@@ -49,9 +49,14 @@ def test_cpu_tpu_consistency_battery():
         # the axon plugin only registers when its tunnel answers at
         # import; a wedged tunnel surfaces as an unknown backend
         pytest.skip("accelerator plugin failed to register (tunnel down)")
-    # hang → skip (tunnel wedged); crash → FAIL (the parent labels a
-    # finished-but-silent child "child crashed", which must stay red)
-    if out.count("no result (hang/timeout)") == len(SUBSET.split(",")):
+    # wedge → skip; crash → FAIL (the parent labels a finished-but-
+    # silent child "child crashed", which must stay red).  The round-5
+    # harness distinguishes them itself: a chunk timeout triggers a
+    # liveness re-probe, and a dead chip aborts the battery with the
+    # ops marked UNKNOWN (retried on resume) instead of fake FAILs.
+    if "chip wedged — aborting battery" in out:
+        pytest.skip("chip wedged mid-battery (liveness re-probe failed)")
+    if out.count("no result (hang/timeout") == len(SUBSET.split(",")):
         pytest.skip("chip never answered inside the chunk budget "
                     "(wedged tunnel)")
     assert proc.returncode == 0, (out[-1500:], proc.stderr[-500:])

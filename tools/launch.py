@@ -319,6 +319,132 @@ def launch_sge(args, extra_env=None):
     return [rc]
 
 
+def _rendezvous_server():
+    """Tracker-analog service on the submit node (the reference runs its
+    dmlc tracker there the same way): atomically assigns worker ids and
+    publishes worker 0's coordinator address — container placement under
+    YARN is unknowable at submit time and there is no shared cwd to
+    rendezvous through (unlike SGE)."""
+    import socketserver
+    import threading
+
+    state = {"coord": None, "next_id": 0}
+    lock = threading.Lock()
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            line = self.rfile.readline().decode("utf-8", "replace").strip()
+            if line == "ID":
+                with lock:
+                    wid = state["next_id"]
+                    state["next_id"] += 1
+                self.wfile.write(f"{wid}\n".encode())
+            elif line.startswith("PUT "):
+                with lock:
+                    state["coord"] = line[4:].strip()
+                self.wfile.write(b"OK\n")
+            elif line == "GET":
+                with lock:
+                    coord = state["coord"] or ""
+                self.wfile.write((coord + "\n").encode())
+
+    srv = socketserver.ThreadingTCPServer(("0.0.0.0", 0), Handler)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]
+
+
+def launch_yarn(args, extra_env=None):
+    """Reference dmlc_tracker/yarn.py role, minimally: submit the
+    workers as a YARN distributed-shell application (the reference
+    ships a Java ApplicationMaster; this build rides Hadoop's stock
+    distributedshell AM instead — ``--yarn-jar`` points at it, e.g.
+    $HADOOP_HOME/share/hadoop/yarn/hadoop-yarn-applications-
+    distributedshell-*.jar).  The tracker analog (worker-id assignment
+    + coordinator discovery) and any parameter servers run on the
+    submit node, exactly where the reference runs its tracker; each
+    container executes a self-contained bootstrap that dials back.
+    ``--yarn-cmd`` injects the transport — tests use a shim that runs
+    the containers locally."""
+    import tempfile
+
+    if not args.yarn_jar:
+        raise SystemExit("--launcher yarn requires --yarn-jar (the "
+                         "hadoop distributedshell jar)")
+    port = args.port or _free_port()
+    head = args.yarn_head or socket.gethostname()
+
+    procs = []
+    server_addrs = []
+    for _ in range(args.num_servers):
+        sport = _free_port()
+        server_addrs.append(f"{head}:{sport}")  # PS run on the submit node
+        env = dict(os.environ)
+        env.update(extra_env or {})
+        env["DMLC_ROLE"] = "server"
+        env["JAX_PLATFORMS"] = "cpu"
+        code = _server_code(sport, args.kv_mode, args.num_workers)
+        procs.append(subprocess.Popen([sys.executable, "-c", code], env=env))
+
+    srv, rport = _rendezvous_server()
+    env = _worker_env(args, 0, coordinator="__YARN__",
+                      server_addrs=server_addrs)
+    env.pop("MXT_WORKER_ID"), env.pop("DMLC_WORKER_ID")
+    env.pop("MXT_COORDINATOR")
+    env.update(extra_env or {})
+
+    rdv = (f"import socket;s=socket.create_connection(({head!r},{rport}),"
+           "timeout=30);f=s.makefile()")
+    lines = [
+        "#!/bin/bash",
+        f"wid=$(python3 -c \"{rdv};s.sendall(b'ID\\n');"
+        "print(f.readline().strip())\")",
+        'export MXT_WORKER_ID=$wid',
+        'export DMLC_WORKER_ID=$wid',
+        'if [ "$wid" = "0" ]; then',
+        f"  python3 -c \"{rdv};"
+        f"s.sendall(('PUT '+socket.gethostname()+':{port}\\n')"
+        ".encode());f.readline()\"",
+        f'  export MXT_COORDINATOR="$(hostname):{port}"',
+        'else',
+        '  for i in $(seq 1 120); do',
+        f"    c=$(python3 -c \"{rdv};s.sendall(b'GET\\n');"
+        "print(f.readline().strip())\")",
+        '    [ -n "$c" ] && break; sleep 1',
+        '  done',
+        '  [ -n "$c" ] || { echo "coordinator never appeared" >&2;'
+        ' exit 1; }',
+        '  export MXT_COORDINATOR="$c"',
+        'fi',
+    ]
+    for k, v in env.items():
+        lines.append(f"export {k}={_sh_quote(v)}")
+    lines.append("exec " + " ".join(_sh_quote(c) for c in args.command))
+    with tempfile.NamedTemporaryFile("w", suffix=".sh", delete=False) as f:
+        f.write("\n".join(lines) + "\n")
+        script = f.name
+    os.chmod(script, 0o755)
+    try:
+        # the distributedshell client blocks until the app completes
+        rc = subprocess.call(
+            args.yarn_cmd.split()
+            + ["jar", args.yarn_jar, "-jar", args.yarn_jar,
+               "-shell_script", script,
+               "-num_containers", str(args.num_workers)])
+    finally:
+        os.unlink(script)
+        srv.shutdown()
+        srv.server_close()
+        for p in procs:            # PS lifetime = the job's lifetime
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return [rc]
+
+
 def main():
     parser = argparse.ArgumentParser(
         description="Launch a distributed job (reference launch.py CLI)")
@@ -343,6 +469,13 @@ def main():
     parser.add_argument("--sge-head", type=str, default=None,
                         help="coordinator host workers dial back to "
                              "(default: this host's name)")
+    parser.add_argument("--yarn-cmd", type=str, default="yarn",
+                        help="yarn CLI (tests inject a shim)")
+    parser.add_argument("--yarn-jar", type=str, default=None,
+                        help="hadoop distributedshell jar path")
+    parser.add_argument("--yarn-head", type=str, default=None,
+                        help="submit-node host workers dial back to "
+                             "(default: this host's name)")
     parser.add_argument("--env-server", action="append", default=[])
     parser.add_argument("--env-worker", action="append", default=[])
     parser.add_argument("--env", action="append", default=[])
@@ -361,13 +494,7 @@ def main():
     elif args.launcher == "sge":
         codes = launch_sge(args)
     else:
-        raise NotImplementedError(
-            "launcher 'yarn': the Hadoop/YARN application master is not "
-            "targeted by this build (reference dmlc_tracker/yarn.py ships "
-            "a Java AM); on TPU pods use the platform scheduler "
-            "(GKE/xmanager) to start one process per host with "
-            "MXT_COORDINATOR/MXT_NUM_WORKERS/MXT_WORKER_ID, or submit "
-            "through --launcher sge/ssh/mpi")
+        codes = launch_yarn(args)
     bad = [c for c in codes if c != 0]
     sys.exit(bad[0] if bad else 0)
 
