@@ -534,18 +534,28 @@ def probe_raw(max_stages=None):
         g1, be1 = params[p + "bn1"]
         sc1, of1, _, _ = fb.bn_consts(a1, b1, mrows, g1, be1, eps)
         cm = y1.shape[-1]
-        # glue in x.dtype: no fp32 activation-sized intermediates
-        y1n = jnp.maximum(y1 * sc1.astype(x.dtype) + of1.astype(x.dtype), 0)
-        y1n = y1n.reshape(n, h, w_, cm)
-
-        y2 = conv(y1n, params[p + "c2"], stride)  # 3x3: XLA conv
         g2, be2 = params[p + "bn2"]
-        mean2 = jnp.mean(y2, (0, 1, 2), dtype=jnp.float32)
-        meansq2 = jnp.mean(jnp.square(y2), (0, 1, 2), dtype=jnp.float32)
-        var2 = jnp.maximum(meansq2 - jnp.square(mean2), 0.0)
-        rstd2 = lax.rsqrt(var2 + eps)
-        sc2 = g2 * rstd2
-        of2 = be2 - mean2 * sc2
+        if stride == 1:
+            # round-5: the 3x3 goes through the conv-fused kernel too —
+            # bn1+relu in the conv prologue (y1n never materialized),
+            # bn2 stats from the conv epilogue (ops/fused_conv)
+            from incubator_mxnet_tpu.ops.fused_conv import fused_conv3_bn
+            y2, a2, b2 = fused_conv3_bn(y1.reshape(n, h, w_, cm),
+                                        params[p + "c2"], sc1, of1)
+            sc2, of2, _, _ = fb.bn_consts(a2, b2, mrows, g2, be2, eps)
+        else:
+            # stride-2 3x3 (this probe's stage transitions): XLA conv
+            # with the materialized normalized copy — kernel is s1-only
+            y1n = jnp.maximum(y1 * sc1.astype(x.dtype)
+                              + of1.astype(x.dtype), 0)
+            y1n = y1n.reshape(n, h, w_, cm)
+            y2 = conv(y1n, params[p + "c2"], stride)
+            mean2 = jnp.mean(y2, (0, 1, 2), dtype=jnp.float32)
+            meansq2 = jnp.mean(jnp.square(y2), (0, 1, 2), dtype=jnp.float32)
+            var2 = jnp.maximum(meansq2 - jnp.square(mean2), 0.0)
+            rstd2 = lax.rsqrt(var2 + eps)
+            sc2 = g2 * rstd2
+            of2 = be2 - mean2 * sc2
 
         y3, a3, b3 = fb.fused_matmul_bn(flat(y2), sq(params[p + "c3"]),
                                         sc2, of2)
