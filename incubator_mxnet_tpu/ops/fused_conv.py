@@ -35,10 +35,12 @@ E[x^2]-mu^2 convention of ops.nn_ops.batch_norm).
 
 VMEM policy: channel width and block height anti-correlate in ResNet
 (56px@64ch ... 7px@512ch), so whole-image blocks fit comfortably up to
-256 channels; configurations whose working set exceeds the budget
-(512-channel stage-4, where activation traffic is tiny anyway) fall
-back to the XLA composition, as does any stride/kernel/geometry this
-kernel does not cover.
+256 channels with a single output block; wider outputs (512-channel
+stage-4) split the output-channel dimension into N blocks sized by a
+working-set estimate, with dx accumulated in fp32 across N blocks and
+its ReLU/normalize backward applied at the last one.  Geometry the
+plan cannot cover at any width — and any stride/kernel shape this
+kernel does not implement — falls back to the XLA composition.
 """
 from __future__ import annotations
 
@@ -80,26 +82,56 @@ def _local_hw(bm, w_img, h_img):
     return (r // w_img) % h_img, r % w_img
 
 
+def _shifted_taps(data, hl, wl, h_img, w_img, sgn):
+    """The nine masked tap views of a block: tap t displaced by
+    sgn*(dh, dw) with out-of-image neighbors zeroed.  sgn=+1 is the
+    forward/weight-grad orientation; sgn=-1 the transposed (dx) one.
+    Shared by every kernel so the shift/mask convention cannot drift."""
+    for t, (dh, dw) in enumerate(_TAPS):
+        shifted = _shift_rows(data, sgn * (dh * w_img + dw))
+        valid = ((hl + sgn * dh >= 0) & (hl + sgn * dh < h_img)
+                 & (wl + sgn * dw >= 0) & (wl + sgn * dw < w_img))
+        yield t, jnp.where(valid, shifted, 0)
+
+
+def _dx_partial(dc, w_ref, bm, kp, hl, wl, h_img, w_img):
+    """Nine-tap transposed conv of a cotangent block: sum_t
+    shifted(dc) @ W_t^T, fp32."""
+    dxn = jnp.zeros((bm, kp), jnp.float32)
+    for t, s in _shifted_taps(dc, hl, wl, h_img, w_img, -1):
+        dxn += jax.lax.dot_general(
+            s, w_ref[t * kp:(t + 1) * kp, :],
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    return dxn
+
+
+def _prologue_bwd(dxn, x_ref, sc_ref, bi_ref):
+    """ReLU/normalize backward: returns (dx block, dscale and dbias
+    row contributions)."""
+    xf = x_ref[...].astype(jnp.float32)
+    z = xf * sc_ref[...] + bi_ref[...]
+    dz = jnp.where(z > 0.0, dxn, 0.0)
+    return (dz * sc_ref[...],
+            jnp.sum(dz * xf, axis=0, keepdims=True),
+            jnp.sum(dz, axis=0, keepdims=True))
+
+
 # ---------------------------------------------------------------------------
 # forward: y = conv3x3([relu(x*scale+bias)]), s1 = sum(y), s2 = sum(y^2)
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(x_ref, w_ref, sc_ref, bi_ref, y_ref, s1_ref, s2_ref, *,
                 m_real, bm, kp, h_img, w_img, prologue):
-    i = pl.program_id(0)
+    i = pl.program_id(1)  # M block (grid = (n_blocks, m_blocks))
     xf = x_ref[...].astype(jnp.float32)
     if prologue:
         xf = jnp.maximum(xf * sc_ref[...] + bi_ref[...], 0.0)
     xc = xf.astype(x_ref.dtype)  # MXU runs in the input dtype
     hl, wl = _local_hw(bm, w_img, h_img)
     acc = jnp.zeros((bm, y_ref.shape[1]), jnp.float32)
-    for t, (dh, dw) in enumerate(_TAPS):
-        shifted = _shift_rows(xc, dh * w_img + dw)
-        valid = ((hl + dh >= 0) & (hl + dh < h_img)
-                 & (wl + dw >= 0) & (wl + dw < w_img))
-        shifted = jnp.where(valid, shifted, 0)
+    for t, s in _shifted_taps(xc, hl, wl, h_img, w_img, 1):
         acc += jax.lax.dot_general(
-            shifted, w_ref[t * kp:(t + 1) * kp, :],
+            s, w_ref[t * kp:(t + 1) * kp, :],
             (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
     yb = acc.astype(y_ref.dtype)
     y_ref[...] = yb
@@ -129,6 +161,43 @@ def _dyt(dy_ref, y_ref, ds1_ref, ds2_ref, rows, m_real):
     return jnp.where(rows < m_real, d, 0.0)
 
 
+def _bwd_dx_kernel_nb(dy_ref, y_ref, ds1_ref, ds2_ref, w_ref, x_ref, sc_ref,
+                      bi_ref, dx_ref, dsc_ref, dbi_ref, *,
+                      m_real, bm, kp, h_img, w_img, prologue, n_last):
+    """Multi-N-block dx: grid = (m_blocks, n_blocks), n inner.  The
+    tap-transposed partial products accumulate into an fp32 dx block
+    across N blocks; the ReLU/normalize backward (which needs the TOTAL
+    dxn before masking) and the dscale/dbias reductions run once at the
+    final N block."""
+    i, j = pl.program_id(0), pl.program_id(1)
+    rows = i * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+    dyt = _dyt(dy_ref, y_ref, ds1_ref, ds2_ref, rows, m_real)
+    dc = dyt.astype(dy_ref.dtype)
+    hl, wl = _local_hw(bm, w_img, h_img)
+    partial = _dx_partial(dc, w_ref, bm, kp, hl, wl, h_img, w_img)
+    partial = jnp.where(rows < m_real, partial, 0.0)
+
+    @pl.when(j == 0)
+    def _init_dx():
+        dx_ref[...] = jnp.zeros_like(dx_ref)
+
+    dx_ref[...] += partial
+
+    @pl.when((i == 0) & (j == 0))
+    def _init_scale():
+        dsc_ref[...] = jnp.zeros_like(dsc_ref)
+        dbi_ref[...] = jnp.zeros_like(dbi_ref)
+
+    if prologue:
+        @pl.when(j == n_last)
+        def _finish():
+            dx, dsc, dbi = _prologue_bwd(dx_ref[...], x_ref, sc_ref,
+                                         bi_ref)
+            dx_ref[...] = dx
+            dsc_ref[...] += dsc
+            dbi_ref[...] += dbi
+
+
 def _bwd_dx_kernel(dy_ref, y_ref, ds1_ref, ds2_ref, w_ref, x_ref, sc_ref,
                    bi_ref, dx_ref, dsc_ref, dbi_ref, *,
                    m_real, bm, kp, h_img, w_img, prologue):
@@ -137,17 +206,9 @@ def _bwd_dx_kernel(dy_ref, y_ref, ds1_ref, ds2_ref, w_ref, x_ref, sc_ref,
     dyt = _dyt(dy_ref, y_ref, ds1_ref, ds2_ref, rows, m_real)
     dc = dyt.astype(dy_ref.dtype)
     hl, wl = _local_hw(bm, w_img, h_img)
-    dxn = jnp.zeros((bm, kp), jnp.float32)
-    for t, (dh, dw) in enumerate(_TAPS):
-        # x-position r received tap (dh,dw) from output position r-off;
-        # validity is the forward condition evaluated at that output
-        shifted = _shift_rows(dc, -(dh * w_img + dw))
-        valid = ((hl - dh >= 0) & (hl - dh < h_img)
-                 & (wl - dw >= 0) & (wl - dw < w_img))
-        shifted = jnp.where(valid, shifted, 0)
-        dxn += jax.lax.dot_general(
-            shifted, w_ref[t * kp:(t + 1) * kp, :],
-            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    # x-position r received tap (dh,dw) from output position r-off;
+    # validity is the forward condition evaluated at that output
+    dxn = _dx_partial(dc, w_ref, bm, kp, hl, wl, h_img, w_img)
     dxn = jnp.where(rows < m_real, dxn, 0.0)
 
     @pl.when(i == 0)
@@ -156,19 +217,17 @@ def _bwd_dx_kernel(dy_ref, y_ref, ds1_ref, ds2_ref, w_ref, x_ref, sc_ref,
         dbi_ref[...] = jnp.zeros_like(dbi_ref)
 
     if prologue:
-        xf = x_ref[...].astype(jnp.float32)
-        z = xf * sc_ref[...] + bi_ref[...]
-        dz = jnp.where(z > 0.0, dxn, 0.0)
-        dx_ref[...] = (dz * sc_ref[...]).astype(dx_ref.dtype)
-        dsc_ref[...] += jnp.sum(dz * xf, axis=0, keepdims=True)
-        dbi_ref[...] += jnp.sum(dz, axis=0, keepdims=True)
+        dx, dsc, dbi = _prologue_bwd(dxn, x_ref, sc_ref, bi_ref)
+        dx_ref[...] = dx.astype(dx_ref.dtype)
+        dsc_ref[...] += dsc
+        dbi_ref[...] += dbi
     else:
         dx_ref[...] = dxn.astype(dx_ref.dtype)
 
 
 def _bwd_dw_kernel(x_ref, dy_ref, y_ref, ds1_ref, ds2_ref, sc_ref, bi_ref,
                    dw_ref, *, m_real, bm, kp, h_img, w_img, prologue):
-    i = pl.program_id(0)
+    i = pl.program_id(1)  # M block (grid = (n_blocks, m_blocks))
     rows = i * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
     dyt = _dyt(dy_ref, y_ref, ds1_ref, ds2_ref, rows, m_real)
     dc = dyt.astype(dy_ref.dtype)
@@ -182,13 +241,9 @@ def _bwd_dw_kernel(x_ref, dy_ref, y_ref, ds1_ref, ds2_ref, sc_ref, bi_ref,
     def _init():
         dw_ref[...] = jnp.zeros_like(dw_ref)
 
-    for t, (dh, dw) in enumerate(_TAPS):
-        shifted = _shift_rows(xc, dh * w_img + dw)
-        valid = ((hl + dh >= 0) & (hl + dh < h_img)
-                 & (wl + dw >= 0) & (wl + dw < w_img))
-        shifted = jnp.where(valid, shifted, 0)
+    for t, s in _shifted_taps(xc, hl, wl, h_img, w_img, 1):
         dw_ref[t * kp:(t + 1) * kp, :] += jax.lax.dot_general(
-            shifted, dc, (((0,), (0,)), ((), ())),
+            s, dc, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
 
@@ -198,7 +253,13 @@ def _bwd_dw_kernel(x_ref, dy_ref, y_ref, ds1_ref, ds2_ref, sc_ref, bi_ref,
 
 class _Geom:
     """Blocking plan for a (N, H, W, C)->C_out fused conv, or None when
-    the kernel cannot cover the configuration (wrapper falls back)."""
+    the kernel cannot cover the configuration (wrapper falls back).
+
+    The M dimension is blocked into whole images (bm = b*H*W rows).
+    The output-channel dimension is blocked too (bn), chosen as the
+    widest divisor of the padded width whose worst-case kernel working
+    set fits the VMEM budget — wide stages (512-channel stage-4) run
+    with several N blocks instead of falling back to XLA."""
 
     def __init__(self, x4, cout):
         n, h, w, c = x4.shape
@@ -217,16 +278,34 @@ class _Geom:
         self.bm = b * self.hw
         self.mp = _round_up(self.m, self.bm)
         self.grid = self.mp // self.bm
+        self.bn = self._pick_bn()
+
+    def _bytes(self, bn):
+        """Worst working set across the three kernels at width bn."""
+        bm, kp = self.bm, self.kp
+        fwd = bm * kp * 6 + 9 * kp * bn * 2 + bm * bn * 6
+        # nb-dx keeps THREE live (bm, kp) fp32 buffers at once: the
+        # accumulating dx block, the current partial, and xf in the
+        # finish epilogue (review finding) — plus the cotangent tiles
+        dx = (bm * bn * 8 + 9 * kp * bn * 2 + bm * kp * 2
+              + 3 * bm * kp * 4)
+        dw = bm * kp * 6 + bm * bn * 8 + 9 * kp * bn * 4
+        return max(fwd, dx, dw)
+
+    def _pick_bn(self):
+        bn = self.np
+        while bn >= 128:
+            if self.np % bn == 0 and self._bytes(bn) <= _VMEM_BUDGET:
+                return bn
+            bn -= 128
+        return None
+
+    @property
+    def n_blocks(self):
+        return self.np // self.bn
 
     def fits(self):
-        if (self.bm * self.hw) == 0 or (self.bm % 8):
-            return False
-        # dw kernel is the VMEM worst case: fp32 tap accumulator + x/dy/y
-        # tiles + one fp32 cotangent temp
-        dw_bytes = (9 * self.kp * self.np * 4
-                    + self.bm * (self.kp + 2 * self.np) * 2
-                    + self.bm * self.np * 4)
-        return dw_bytes <= _VMEM_BUDGET
+        return self.m > 0 and self.bm % 8 == 0 and self.bn is not None
 
     def pad_x(self, x4):
         x2 = x4.reshape(self.m, self.c)
@@ -252,23 +331,23 @@ def _fwd_impl(x4, w, scale, bias, prologue):
         out_shape=[jax.ShapeDtypeStruct((g.mp, g.np), x4.dtype),
                    jax.ShapeDtypeStruct((1, g.np), jnp.float32),
                    jax.ShapeDtypeStruct((1, g.np), jnp.float32)],
-        grid=(g.grid,),
+        grid=(g.n_blocks, g.grid),
         in_specs=[
-            pl.BlockSpec((g.bm, g.kp), lambda i: (i, 0),
+            pl.BlockSpec((g.bm, g.kp), lambda j, i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((9 * g.kp, g.np), lambda i: (0, 0),
+            pl.BlockSpec((9 * g.kp, g.bn), lambda j, i: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, g.kp), lambda i: (0, 0),
+            pl.BlockSpec((1, g.kp), lambda j, i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, g.kp), lambda i: (0, 0),
+            pl.BlockSpec((1, g.kp), lambda j, i: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((g.bm, g.np), lambda i: (i, 0),
+            pl.BlockSpec((g.bm, g.bn), lambda j, i: (i, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, g.np), lambda i: (0, 0),
+            pl.BlockSpec((1, g.bn), lambda j, i: (0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, g.np), lambda i: (0, 0),
+            pl.BlockSpec((1, g.bn), lambda j, i: (0, j),
                          memory_space=pltpu.VMEM),
         ],
         interpret=interpret_mode(),
@@ -289,41 +368,81 @@ def _bwd_impl(x4, w, scale, bias, y4, dy4, ds1, ds2, prologue):
     dyp, yp = pad_y(dy4), pad_y(y4)
     ds1p = g.pad_vec(ds1, g.np)
     ds2p = g.pad_vec(ds2, g.np)
-    row_spec = lambda cols: pl.BlockSpec((g.bm, cols), lambda i: (i, 0),
-                                         memory_space=pltpu.VMEM)
-    vec_spec = lambda cols: pl.BlockSpec((1, cols), lambda i: (0, 0),
-                                         memory_space=pltpu.VMEM)
+    if g.n_blocks == 1:
+        # single N block: the proven one-pass dx kernel (dx written in
+        # the input dtype, prologue applied inline)
+        row_spec = lambda cols: pl.BlockSpec(
+            (g.bm, cols), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        vec_spec = lambda cols: pl.BlockSpec(
+            (1, cols), lambda i: (0, 0), memory_space=pltpu.VMEM)
+        dx, dsc, dbi = pl.pallas_call(
+            functools.partial(_bwd_dx_kernel, m_real=g.m, bm=g.bm,
+                              kp=g.kp, h_img=g.h, w_img=g.w,
+                              prologue=prologue),
+            out_shape=[jax.ShapeDtypeStruct((g.mp, g.kp), x4.dtype),
+                       jax.ShapeDtypeStruct((1, g.kp), jnp.float32),
+                       jax.ShapeDtypeStruct((1, g.kp), jnp.float32)],
+            grid=(g.grid,),
+            in_specs=[row_spec(g.np), row_spec(g.np), vec_spec(g.np),
+                      vec_spec(g.np),
+                      pl.BlockSpec((9 * g.kp, g.np), lambda i: (0, 0),
+                                   memory_space=pltpu.VMEM),
+                      row_spec(g.kp), vec_spec(g.kp), vec_spec(g.kp)],
+            out_specs=[row_spec(g.kp), vec_spec(g.kp), vec_spec(g.kp)],
+            interpret=interpret_mode(),
+        )(dyp, yp, ds1p, ds2p, wp, xp, scp, bip)
+    else:
+        # wide outputs: accumulate fp32 dx partials across N blocks,
+        # prologue backward at the last block (grid n inner)
+        mrow = lambda cols: pl.BlockSpec(
+            (g.bm, cols), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
+        nrow = lambda cols: pl.BlockSpec(
+            (g.bm, cols), lambda i, j: (i, j), memory_space=pltpu.VMEM)
+        nvec = lambda cols: pl.BlockSpec(
+            (1, cols), lambda i, j: (0, j), memory_space=pltpu.VMEM)
+        cvec = lambda cols: pl.BlockSpec(
+            (1, cols), lambda i, j: (0, 0), memory_space=pltpu.VMEM)
+        dx, dsc, dbi = pl.pallas_call(
+            functools.partial(_bwd_dx_kernel_nb, m_real=g.m, bm=g.bm,
+                              kp=g.kp, h_img=g.h, w_img=g.w,
+                              prologue=prologue,
+                              n_last=g.n_blocks - 1),
+            out_shape=[jax.ShapeDtypeStruct((g.mp, g.kp), jnp.float32),
+                       jax.ShapeDtypeStruct((1, g.kp), jnp.float32),
+                       jax.ShapeDtypeStruct((1, g.kp), jnp.float32)],
+            grid=(g.grid, g.n_blocks),
+            in_specs=[nrow(g.bn), nrow(g.bn), nvec(g.bn), nvec(g.bn),
+                      pl.BlockSpec((9 * g.kp, g.bn), lambda i, j: (0, j),
+                                   memory_space=pltpu.VMEM),
+                      mrow(g.kp), cvec(g.kp), cvec(g.kp)],
+            out_specs=[mrow(g.kp), cvec(g.kp), cvec(g.kp)],
+            interpret=interpret_mode(),
+        )(dyp, yp, ds1p, ds2p, wp, xp, scp, bip)
 
-    dx, dsc, dbi = pl.pallas_call(
-        functools.partial(_bwd_dx_kernel, m_real=g.m, bm=g.bm, kp=g.kp,
-                          h_img=g.h, w_img=g.w, prologue=prologue),
-        out_shape=[jax.ShapeDtypeStruct((g.mp, g.kp), x4.dtype),
-                   jax.ShapeDtypeStruct((1, g.kp), jnp.float32),
-                   jax.ShapeDtypeStruct((1, g.kp), jnp.float32)],
-        grid=(g.grid,),
-        in_specs=[row_spec(g.np), row_spec(g.np), vec_spec(g.np),
-                  vec_spec(g.np),
-                  pl.BlockSpec((9 * g.kp, g.np), lambda i: (0, 0),
-                               memory_space=pltpu.VMEM),
-                  row_spec(g.kp), vec_spec(g.kp), vec_spec(g.kp)],
-        out_specs=[row_spec(g.kp), vec_spec(g.kp), vec_spec(g.kp)],
-        interpret=interpret_mode(),
-    )(dyp, yp, ds1p, ds2p, wp, xp, scp, bip)
-
+    dw_spec = lambda cols, im: pl.BlockSpec(  # noqa: E731
+        (g.bm, cols), im, memory_space=pltpu.VMEM)
     dw = pl.pallas_call(
         functools.partial(_bwd_dw_kernel, m_real=g.m, bm=g.bm, kp=g.kp,
                           h_img=g.h, w_img=g.w, prologue=prologue),
         out_shape=jax.ShapeDtypeStruct((9 * g.kp, g.np), jnp.float32),
-        grid=(g.grid,),
-        in_specs=[row_spec(g.kp), row_spec(g.np), row_spec(g.np),
-                  vec_spec(g.np), vec_spec(g.np), vec_spec(g.kp),
-                  vec_spec(g.kp)],
-        out_specs=pl.BlockSpec((9 * g.kp, g.np), lambda i: (0, 0),
+        grid=(g.n_blocks, g.grid),
+        in_specs=[dw_spec(g.kp, lambda j, i: (i, 0)),
+                  dw_spec(g.bn, lambda j, i: (i, j)),
+                  dw_spec(g.bn, lambda j, i: (i, j)),
+                  pl.BlockSpec((1, g.bn), lambda j, i: (0, j),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((1, g.bn), lambda j, i: (0, j),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((1, g.kp), lambda j, i: (0, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((1, g.kp), lambda j, i: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((9 * g.kp, g.bn), lambda j, i: (0, j),
                                memory_space=pltpu.VMEM),
         interpret=interpret_mode(),
     )(xp, dyp, yp, ds1p, ds2p, scp, bip)
 
-    dx = dx[:g.m, :g.c].reshape(x4.shape)
+    dx = dx[:g.m, :g.c].astype(x4.dtype).reshape(x4.shape)
     dw = dw.reshape(9, g.kp, g.np)[:, :g.c, :g.cout].reshape(
         3, 3, g.c, g.cout).astype(w.dtype)
     if prologue:
@@ -412,7 +531,15 @@ def fused_conv3_bn(x, w, scale=None, bias=None):
     if scale is None:
         scale = jnp.ones((x.shape[-1],), jnp.float32)
         bias = jnp.zeros((x.shape[-1],), jnp.float32)
-    if not (_conv3_kernel_on() and _Geom(x, w.shape[-1]).fits()):
+    # per-width tuning knob: after the on-chip fc3 A/B
+    # (scripts/perf_probe.py fc3), restrict the kernel to the input
+    # widths where it wins, e.g. MXNET_FUSED_CONV3_WIDTHS=64,128 —
+    # losing widths ride the XLA composition with no code change
+    widths = os.environ.get("MXNET_FUSED_CONV3_WIDTHS")
+    width_ok = (widths is None
+                or x.shape[-1] in {int(v) for v in widths.split(",") if v})
+    if not (width_ok and _conv3_kernel_on()
+            and _Geom(x, w.shape[-1]).fits()):
         return xla_conv3_bn(x, w, scale if prologue else None,
                             bias if prologue else None)
     return _fc3(x, w, scale, bias, prologue)

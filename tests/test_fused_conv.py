@@ -130,6 +130,64 @@ def test_chain_grad_through_bn_consts():
                                     rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("prologue", [False, True])
+def test_multi_nblock_parity(dtype, prologue, monkeypatch):
+    """Wide outputs split into several N blocks (the 512-channel
+    stage-4 path): constrain the VMEM budget so cout=260 (padded 384)
+    runs with bn=128 (3 N blocks) and check full fwd+VJP parity,
+    including the fp32 dx accumulation and the last-block prologue
+    backward.  n=8 makes the M grid multi-block too (review finding:
+    every cross-i interaction — per-i dx re-init, dsc/dbi and stats
+    accumulation across M under the 2-D grids — must actually
+    execute)."""
+    import incubator_mxnet_tpu.ops.fused_conv as fcm
+    n, h, w, c, cout = 16, 6, 6, 16, 260
+    x, k, scale, bias = _mk(n, h, w, c, cout, dtype, seed=5)
+    g_full = fcm._Geom(x, cout)
+    assert g_full.bn == g_full.np  # sanity: unconstrained = one block
+    monkeypatch.setattr(fcm, "_VMEM_BUDGET",
+                        g_full._bytes(128) + 1)
+    g = fcm._Geom(x, cout)
+    assert g.bn == 128 and g.n_blocks == 3 and g.fits()
+    assert g.grid >= 2  # multi M block as well
+
+    rng = onp.random.RandomState(6)
+    dy = jnp.asarray(rng.randn(n, h, w, cout), dtype) * 0.1
+    ds1 = jnp.asarray(rng.randn(cout), jnp.float32) * 0.01
+    ds2 = jnp.asarray(rng.randn(cout), jnp.float32) * 0.001
+
+    def run(fused):
+        def f(x, k, scale, bias):
+            if fused:
+                return fc._fc3(x, k, scale, bias, prologue)
+            return fc.xla_conv3_bn(x, k, scale if prologue else None,
+                                   bias if prologue else None)
+        out, vjp = jax.vjp(f, x, k, scale, bias)
+        return out, vjp((dy, ds1, ds2))
+
+    (y, s1, s2), (dx, dk, dsc, dbi) = run(True)
+    (yr, s1r, s2r), (dxr, dkr, dscr, dbir) = run(False)
+    tol = _tol(dtype)
+    m = n * h * w
+    onp.testing.assert_allclose(onp.asarray(y, onp.float32),
+                                onp.asarray(yr, onp.float32),
+                                rtol=tol, atol=tol)
+    onp.testing.assert_allclose(onp.asarray(s1), onp.asarray(s1r),
+                                rtol=tol, atol=tol * m)
+    onp.testing.assert_allclose(onp.asarray(dx, onp.float32),
+                                onp.asarray(dxr, onp.float32),
+                                rtol=5 * tol, atol=5 * tol)
+    onp.testing.assert_allclose(onp.asarray(dk, onp.float32),
+                                onp.asarray(dkr, onp.float32),
+                                rtol=5 * tol, atol=tol * m ** 0.5)
+    if prologue:
+        onp.testing.assert_allclose(onp.asarray(dsc), onp.asarray(dscr),
+                                    rtol=5 * tol, atol=tol * m ** 0.5)
+        onp.testing.assert_allclose(onp.asarray(dbi), onp.asarray(dbir),
+                                    rtol=5 * tol, atol=tol * m ** 0.5)
+
+
 def test_dispatch_falls_back_on_unsupported():
     """Non-3x3 kernels raise; over-budget geometry silently uses the
     XLA composition (identical results either way)."""
