@@ -235,7 +235,23 @@ def main():
     from incubator_mxnet_tpu.ops import registry
 
     specs = default_specs(args.size)
-    wanted = [s for s in args.ops.split(",") if s] or sorted(specs)
+    # chip windows are scarce: measure the hot NN/linear-algebra ops
+    # first so a run cut short by a tunnel wedge still yields the
+    # latencies that matter (the resume flag picks up the tail later)
+    priority = [
+        "Convolution", "FullyConnected", "BatchNorm", "dot", "batch_dot",
+        "Pooling", "Activation", "relu", "softmax", "log_softmax",
+        "SoftmaxOutput", "softmax_cross_entropy", "LayerNorm", "Dropout",
+        "elemwise_add", "elemwise_mul", "broadcast_add", "broadcast_mul",
+        "sum", "mean", "max", "transpose", "Reshape", "concat", "take",
+        "Embedding", "slice", "sigmoid", "tanh", "exp", "log", "sqrt",
+        "where", "gather_nd", "topk", "argmax", "norm", "Deconvolution",
+        "RNN", "add_n", "clip", "expand_dims", "one_hot",
+    ]
+    wanted = [s for s in args.ops.split(",") if s]
+    if not wanted:
+        rest = sorted(s for s in specs if s not in set(priority))
+        wanted = [p for p in priority if p in specs] + rest
     results, skipped = {}, {}
     platform = jax.devices()[0].platform
     if args.resume and os.path.exists(args.output):
