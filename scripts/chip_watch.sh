@@ -13,6 +13,10 @@ export ART_DIR="${ART_DIR:-artifacts/r5}"
 mkdir -p "$ART_DIR"
 . scripts/chip_queue_lib.sh
 interval="${PROBE_INTERVAL:-600}"
+# the chip is single-claim: this watcher must NOT outlive the builder
+# session into the driver's end-of-round bench window.  Default: stop
+# probing 9.5h after launch (WATCH_UNTIL overrides, epoch seconds).
+deadline="${WATCH_UNTIL:-$(( $(date +%s) + 34200 ))}"
 
 bench_latest() {  # $1 = artifact tag
   timeout 1000 env BENCH_DEADLINE=900 BENCH_CPU_RESERVE=120 \
@@ -20,6 +24,10 @@ bench_latest() {  # $1 = artifact tag
 }
 
 while true; do
+  if [ "$(date +%s)" -ge "$deadline" ]; then
+    echo "[watch] $(date -u +%H:%M:%S) deadline reached — exiting so the"\
+         "driver's bench window owns the chip"; exit 0
+  fi
   if chip_alive; then
     echo "[watch] $(date -u +%H:%M:%S) chip ALIVE — bench first, then queues"
     bench_latest first
