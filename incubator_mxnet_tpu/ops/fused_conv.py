@@ -58,8 +58,9 @@ from .pallas_kernels import (_round_up, interpret_mode, kernel_known_good,
 __all__ = ["fused_conv3_bn", "xla_conv3_bn"]
 
 # VMEM working-set ceiling for the fused conv kernels (bytes).  The dw
-# kernel is the worst case: 9*kp*np*4 (fp32 tap-gradient accumulator)
-# + activation/cotangent tiles.
+# kernel is the worst case: the stacked in-register tap gradients PLUS
+# the accumulating output ref (2 * 9*kp*bn*4 fp32) + activation/
+# cotangent tiles — see _Geom._bytes for the exact model.
 _VMEM_BUDGET = int(os.environ.get("MXNET_FUSED_CONV3_VMEM", 10 * 2 ** 20))
 
 _TAPS = [(dh, dw) for dh in (-1, 0, 1) for dw in (-1, 0, 1)]
@@ -241,10 +242,13 @@ def _bwd_dw_kernel(x_ref, dy_ref, y_ref, ds1_ref, ds2_ref, sc_ref, bi_ref,
     def _init():
         dw_ref[...] = jnp.zeros_like(dw_ref)
 
-    for t, s in _shifted_taps(xc, hl, wl, h_img, w_img, 1):
-        dw_ref[t * kp:(t + 1) * kp, :] += jax.lax.dot_general(
-            s, dc, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+    # one full-ref accumulate instead of nine slice-stores: stacked
+    # in-register tap gradients use only store patterns the round-4
+    # kernels already proved under Mosaic
+    taps = [jax.lax.dot_general(s, dc, (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            for _t, s in _shifted_taps(xc, hl, wl, h_img, w_img, 1)]
+    dw_ref[...] += jnp.concatenate(taps, axis=0)
 
 
 # ---------------------------------------------------------------------------
@@ -289,7 +293,9 @@ class _Geom:
         # finish epilogue (review finding) — plus the cotangent tiles
         dx = (bm * bn * 8 + 9 * kp * bn * 2 + bm * kp * 2
               + 3 * bm * kp * 4)
-        dw = bm * kp * 6 + bm * bn * 8 + 9 * kp * bn * 4
+        # dw: the stacked in-register tap gradients live alongside the
+        # accumulating output ref -> 2x the (9*kp, bn) fp32 term
+        dw = bm * kp * 6 + bm * bn * 8 + 2 * 9 * kp * bn * 4
         return max(fwd, dx, dw)
 
     def _pick_bn(self):
