@@ -67,10 +67,25 @@ _TAPS = [(dh, dw) for dh in (-1, 0, 1) for dw in (-1, 0, 1)]
 
 
 def _shift_rows(a, off):
-    """Shift rows of a 2-D block by `off` (static), zero-filling — the
-    flattened-NHWC analog of a spatial (dh, dw) displacement."""
+    """Shift rows of a 2-D block by `off` (static) — the flattened-NHWC
+    analog of a spatial (dh, dw) displacement.
+
+    Contract: every caller masks all out-of-image positions (the
+    `_shifted_taps` validity masks), which provably covers every
+    wrapped/zero-filled row — so the zero-fill (concat) and wrap-around
+    (roll) implementations are interchangeable.  `concat` is the
+    default; `MXNET_FUSED_CONV3_SHIFT=roll` switches to pltpu.roll as
+    an on-chip escape hatch should Mosaic reject the unaligned
+    sublane-dim concatenation.  When flipping the switch on hardware,
+    rerun `scripts/pallas_smoke.py --kernels fused_conv3_bn` with it
+    set: the smoke validates the roll path against the XLA oracle
+    before any bench trusts it."""
     if off == 0:
         return a
+    if os.environ.get("MXNET_FUSED_CONV3_SHIFT", "concat") == "roll":
+        if interpret_mode():
+            return jnp.roll(a, -off, axis=0)
+        return pltpu.roll(a, -off, 0)
     z = jnp.zeros((abs(off), a.shape[1]), a.dtype)
     if off > 0:
         return jnp.concatenate([a[off:], z], axis=0)

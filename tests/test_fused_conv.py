@@ -188,6 +188,32 @@ def test_multi_nblock_parity(dtype, prologue, monkeypatch):
                                     rtol=5 * tol, atol=tol * m ** 0.5)
 
 
+@pytest.mark.parametrize("prologue", [False, True])
+def test_roll_shift_impl_parity(prologue, monkeypatch):
+    """The wrap-around (roll) shift implementation must be numerically
+    identical to the zero-fill default — the masks cover every wrapped
+    row (the _shift_rows contract the on-chip escape hatch relies on)."""
+    n, h, w, c, cout = 3, 6, 6, 16, 24
+    x, k, scale, bias = _mk(n, h, w, c, cout, jnp.float32, seed=7)
+    rng = onp.random.RandomState(8)
+    dy = jnp.asarray(rng.randn(n, h, w, cout), jnp.float32) * 0.1
+    ds1 = jnp.asarray(rng.randn(cout), jnp.float32) * 0.01
+    ds2 = jnp.asarray(rng.randn(cout), jnp.float32) * 0.001
+
+    def run():
+        out, vjp = jax.vjp(
+            lambda *a: fc._fc3(*a, prologue), x, k, scale, bias)
+        return out, vjp((dy, ds1, ds2))
+
+    monkeypatch.setenv("MXNET_FUSED_CONV3_SHIFT", "concat")
+    (y1, s11, s21), g1 = run()
+    monkeypatch.setenv("MXNET_FUSED_CONV3_SHIFT", "roll")
+    (y2, s12, s22), g2 = run()
+    for a, b in [(y1, y2), (s11, s12), (s21, s22)] + list(zip(g1, g2)):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=1e-6, atol=1e-6)
+
+
 def test_dispatch_falls_back_on_unsupported():
     """Non-3x3 kernels raise; over-budget geometry silently uses the
     XLA composition (identical results either way)."""
