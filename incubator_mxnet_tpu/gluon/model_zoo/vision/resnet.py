@@ -8,11 +8,14 @@ TPU extensions (reference-compatible additions, not divergences):
 - ``layout="NHWC"``: channel-minor data layout end to end (the
   reference's Conv2D layout knob, its cuDNN fp16 fast path; here the
   layout the Pallas fused-block kernels read).
-- ``fused=True`` (+ NHWC): BottleneckV1 training forward runs the
-  fused matmul+BN Pallas path (ops/fused_block.py) — 1x1 convs emit BN
-  batch stats from the matmul epilogue and apply the previous BN's
-  normalize+ReLU in the prologue, eliminating the BN-structured HBM
-  traffic the round-4 roofline identified.
+- ``fused=True`` (+ NHWC): bottleneck training forwards run the fused
+  Pallas path (ops/fused_block.py + ops/fused_conv.py) — convs emit BN
+  batch stats from their epilogues and apply the previous BN's
+  normalize+ReLU in their prologues, eliminating the BN-structured HBM
+  traffic the round-4 roofline identified.  BottleneckV1 (resnet
+  50/101/152 v1) and the pre-activation BottleneckV2 (v2 family, whose
+  bn->relu->conv ordering maps directly onto the prologue) are both
+  covered; stride-2 v2 3x3s keep an XLA conv (the kernel is s1-only).
 """
 from __future__ import annotations
 
@@ -36,10 +39,10 @@ def _check_fused(fused, layout, cls):
     fused kernels actually ran."""
     if not fused:
         return
-    if cls != "BottleneckV1":
+    if cls not in ("BottleneckV1", "BottleneckV2"):
         raise ValueError(
-            f"fused=True is implemented for BottleneckV1 only "
-            f"(ResNet-50/101/152 v1); {cls} has no fused path")
+            f"fused=True is implemented for the bottleneck blocks only "
+            f"(ResNet-50/101/152 v1 and v2); {cls} has no fused path")
     if layout != "NHWC":
         raise ValueError(
             "fused=True requires layout='NHWC' (the fused matmul+BN "
@@ -80,6 +83,41 @@ class BasicBlockV1(HybridBlock):
         if self.downsample is not None:
             residual = self.downsample(residual)
         return self.relu(x_out + residual)
+
+
+def _bn_args(bn):
+    return (bn.gamma.data(), bn.beta.data(),
+            bn.running_mean.data(), bn.running_var.data())
+
+
+def _bns_uniform(bns):
+    """The fused registry ops take ONE eps/momentum and always use
+    batch stats; a BN mutated after construction (use_global_stats, or
+    a differing eps/momentum) must route the block through the layer
+    path instead of being silently mis-normalized (ADVICE r4)."""
+    ref = bns[0]
+    return all(not getattr(bn, "_use_global_stats", False)
+               and bn._epsilon == ref._epsilon
+               and bn._momentum == ref._momentum for bn in bns)
+
+
+def _invoke_fused_bottleneck(x, op, pairs, extra_args, state_bns, stride):
+    """Assemble (x, [w_i, bn_i params]..., extra) for a fused-bottleneck
+    registry op, invoke it, and route the returned moving stats through
+    register_state_update (the BatchNorm contract).  Shared by the V1
+    and V2 blocks so the arg marshaling cannot drift."""
+    from ....ops import fused_block  # noqa: F401 — registers the ops
+    args = [x]
+    for conv, bn in pairs:
+        args.append(conv.weight.data())
+        args.extend(_bn_args(bn))
+    args.extend(extra_args)
+    outs = invoke(op, *args, stride=stride, eps=pairs[0][1]._epsilon,
+                  momentum=pairs[0][1]._momentum)
+    for i, bn in enumerate(state_bns):
+        register_state_update(bn.running_mean, outs[1 + 2 * i])
+        register_state_update(bn.running_var, outs[2 + 2 * i])
+    return outs[0]
 
 
 class BottleneckV1(HybridBlock):
@@ -143,48 +181,25 @@ class BottleneckV1(HybridBlock):
                     p._finish_deferred_init()
 
     def _forward_fused(self, x):
-        from ....ops import fused_block  # noqa: F401 — registers the ops
         self._finish_deferred(x)
         bn1, bn2, bn3 = self.body[1], self.body[4], self.body[7]
-
-        def bn_args(bn):
-            return (bn.gamma.data(), bn.beta.data(),
-                    bn.running_mean.data(), bn.running_var.data())
-
-        args = [x]
-        for conv, bn in ((self.body[0], bn1), (self.body[3], bn2),
-                         (self.body[6], bn3)):
-            args.append(conv.weight.data())
-            args.extend(bn_args(bn))
-        kwargs = dict(stride=self._stride, eps=bn1._epsilon,
-                      momentum=bn1._momentum)
+        pairs = ((self.body[0], bn1), (self.body[3], bn2),
+                 (self.body[6], bn3))
         if self.downsample is not None:
             dconv, dbn = self.downsample[0], self.downsample[1]
-            args.append(dconv.weight.data())
-            args.extend(bn_args(dbn))
-            outs = invoke("_fused_bottleneck_v1_proj", *args, **kwargs)
-            bns = (bn1, bn2, bn3, dbn)
-        else:
-            outs = invoke("_fused_bottleneck_v1", *args, **kwargs)
-            bns = (bn1, bn2, bn3)
-        out = outs[0]
-        for i, bn in enumerate(bns):
-            register_state_update(bn.running_mean, outs[1 + 2 * i])
-            register_state_update(bn.running_var, outs[2 + 2 * i])
-        return out
+            return _invoke_fused_bottleneck(
+                x, "_fused_bottleneck_v1_proj", pairs,
+                (dconv.weight.data(),) + _bn_args(dbn),
+                (bn1, bn2, bn3, dbn), self._stride)
+        return _invoke_fused_bottleneck(
+            x, "_fused_bottleneck_v1", pairs, (), (bn1, bn2, bn3),
+            self._stride)
 
     def _fused_bns_uniform(self):
-        """The fused registry op takes ONE eps/momentum and always uses
-        batch stats; a BN mutated after construction (use_global_stats,
-        or a differing eps/momentum) must route through the layer path
-        instead of being silently mis-normalized (ADVICE r4)."""
         bns = [self.body[1], self.body[4], self.body[7]]
         if self.downsample is not None:
             bns.append(self.downsample[1])
-        ref = bns[0]
-        return all(not getattr(bn, "_use_global_stats", False)
-                   and bn._epsilon == ref._epsilon
-                   and bn._momentum == ref._momentum for bn in bns)
+        return _bns_uniform(bns)
 
     def forward(self, x):
         if self._fused:
@@ -233,6 +248,8 @@ class BottleneckV2(HybridBlock):
         super().__init__(**kwargs)
         _check_fused(fused, layout, "BottleneckV2")
         ax = _bn_axis(layout)
+        self._stride = stride
+        self._fused = bool(fused)
         self.bn1 = nn.BatchNorm(axis=ax)
         self.conv1 = nn.Conv2D(channels // 4, 1, 1, use_bias=False,
                                layout=layout)
@@ -248,7 +265,53 @@ class BottleneckV2(HybridBlock):
         else:
             self.downsample = None
 
+    def _finish_deferred(self, x):
+        """Resolve deferred parameter shapes without running the child
+        layers (the fused path bypasses their forwards)."""
+        ci = x.shape[-1]
+        cm = self.conv1._channels
+        co = self.conv3._channels
+        for conv, cin in ((self.conv1, ci), (self.conv2, cm),
+                          (self.conv3, cm)):
+            if conv.weight._data is None:
+                conv.weight.shape = ((conv._channels,) + conv._kernel
+                                     + (cin // conv._groups,))
+                conv.weight._finish_deferred_init()
+        # pre-activation: bn1 spans the block INPUT channels
+        for bn, c in ((self.bn1, ci), (self.bn2, cm), (self.bn3, cm)):
+            for p in (bn.gamma, bn.beta, bn.running_mean, bn.running_var):
+                if p._data is None:
+                    p.shape = (c,)
+                    p._finish_deferred_init()
+        if self.downsample is not None and \
+                self.downsample.weight._data is None:
+            d = self.downsample
+            d.weight.shape = ((d._channels,) + d._kernel
+                              + (ci // d._groups,))
+            d.weight._finish_deferred_init()
+
+    def _fused_bns_uniform(self):
+        return _bns_uniform((self.bn1, self.bn2, self.bn3))
+
+    def _forward_fused(self, x):
+        self._finish_deferred(x)
+        pairs = ((self.conv1, self.bn1), (self.conv2, self.bn2),
+                 (self.conv3, self.bn3))
+        state_bns = (self.bn1, self.bn2, self.bn3)  # v2: no shortcut BN
+        if self.downsample is not None:
+            return _invoke_fused_bottleneck(
+                x, "_fused_bottleneck_v2_proj", pairs,
+                (self.downsample.weight.data(),), state_bns,
+                self._stride)
+        return _invoke_fused_bottleneck(
+            x, "_fused_bottleneck_v2", pairs, (), state_bns,
+            self._stride)
+
     def forward(self, x):
+        if self._fused:
+            from .... import autograd
+            if autograd.is_training() and self._fused_bns_uniform():
+                return self._forward_fused(x)
         residual = x
         x = self.relu(self.bn1(x))
         if self.downsample is not None:

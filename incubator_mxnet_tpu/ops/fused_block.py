@@ -438,10 +438,107 @@ def fused_bottleneck_v1_proj(x, w1, g1, b1, rm1, rv1, w2, g2, b2, rm2, rv2,
             b(rm3, m3), b(rv3, v3), b(rmsc, msc), b(rvsc, vsc))
 
 
+def _bn_fold(x2, gamma, beta, eps):
+    """One-pass batch stats of a flat activation + folded normalize
+    constants (for BN inputs no kernel epilogue produced — e.g. the
+    pre-activation bn1 over a block's raw input; XLA fuses the reduce
+    with the producing elementwise add, one read).  Delegates the fold
+    itself to bn_consts so the numerics cannot drift from the
+    epilogue-fed BNs."""
+    s1 = jnp.sum(x2, 0, dtype=jnp.float32)
+    s2 = jnp.sum(jnp.square(x2.astype(jnp.float32)), 0)
+    return bn_consts(s1, s2, x2.shape[0], gamma, beta, eps)
+
+
+def _bottleneck_v2_core(x, w1, g1, b1, w2, g2, b2, w3, g3, b3, wsc,
+                        stride, eps):
+    """Pre-activation BottleneckV2 body with fused kernels (NHWC).
+
+    The v2 ordering (bn->relu->conv, reference resnet.py BottleneckV2)
+    maps directly onto the prologue pattern: every conv consumes its
+    preceding BN's normalize+ReLU in-register, and the two inner BNs
+    read their batch stats from the producing kernel's epilogue.  Only
+    bn1 (over the block's raw input) needs an explicit stats pass.
+    Stride sits on the 3x3 in v2: stride-2 blocks keep an XLA conv for
+    it (the conv kernel is s1-only); everything else stays fused.
+    """
+    n, h, w_, _ = x.shape
+    s = int(stride)
+    flat = lambda t: t.reshape(-1, t.shape[-1])
+    mm = lambda w4: w4.reshape(w4.shape[0], -1).T  # (O,1,1,I) -> (I,O)
+    xf = flat(x)
+    sc1, of1, mean1, var1 = _bn_fold(xf, g1, b1, eps)
+
+    y1, a2, c2 = fused_matmul_bn(xf, mm(w1), sc1, of1)
+    sc2, of2, mean2, var2 = bn_consts(a2, c2, y1.shape[0], g2, b2, eps)
+    cm = y1.shape[-1]
+
+    if s == 1:
+        from .fused_conv import fused_conv3_bn
+        y2, a3, c3 = fused_conv3_bn(y1.reshape(n, h, w_, cm),
+                                    jnp.transpose(w2, (1, 2, 3, 0)),
+                                    sc2, of2)
+        hs, ws = h, w_
+        y2f = flat(y2)
+        sc3, of3, mean3, var3 = bn_consts(a3, c3, y2f.shape[0], g3, b3,
+                                          eps)
+    else:
+        y1n = jnp.maximum(y1 * sc2.astype(x.dtype) + of2.astype(x.dtype),
+                          0)
+        y1n = y1n.reshape(n, h, w_, cm)
+        dn = jax.lax.conv_dimension_numbers(y1n.shape, w2.shape,
+                                            ("NHWC", "OHWI", "NHWC"))
+        y2 = jax.lax.conv_general_dilated(
+            y1n, w2, (s, s), [(1, 1), (1, 1)],
+            dimension_numbers=dn).astype(x.dtype)
+        hs, ws = y2.shape[1], y2.shape[2]
+        y2f = flat(y2)
+        sc3, of3, mean3, var3 = _bn_fold(y2f, g3, b3, eps)
+
+    # conv3 has no BN after it in v2 — its stats epilogue is unused
+    y3, _, _ = fused_matmul_bn(y2f, mm(w3), sc3, of3)
+
+    if wsc is not None:
+        # v2 downsample consumes relu(bn1(x)) — same prologue, never a
+        # materialized normalized copy; stride rides the 1x1 as a slice
+        xs = x[:, ::s, ::s, :] if s > 1 else x
+        rsd, _, _ = fused_matmul_bn(flat(xs), mm(wsc), sc1, of1)
+    else:
+        rsd = xf
+    out = (y3 + rsd).reshape(n, hs, ws, y3.shape[-1])
+    return out, mean1, var1, mean2, var2, mean3, var3
+
+
+def fused_bottleneck_v2(x, w1, g1, b1, rm1, rv1, w2, g2, b2, rm2, rv2,
+                        w3, g3, b3, rm3, rv3, stride=1, eps=1e-5,
+                        momentum=0.9):
+    """Identity-shortcut fused pre-activation bottleneck (see
+    _bottleneck_v2_core); moving stats follow the BatchNorm contract."""
+    out, m1, v1, m2, v2, m3, v3 = _bottleneck_v2_core(
+        x, w1, g1, b1, w2, g2, b2, w3, g3, b3, None, stride, eps)
+    b = functools.partial(_blend, momentum)
+    return (out, b(rm1, m1), b(rv1, v1), b(rm2, m2), b(rv2, v2),
+            b(rm3, m3), b(rv3, v3))
+
+
+def fused_bottleneck_v2_proj(x, w1, g1, b1, rm1, rv1, w2, g2, b2, rm2, rv2,
+                             w3, g3, b3, rm3, rv3, wsc, stride=1, eps=1e-5,
+                             momentum=0.9):
+    """Projection-shortcut fused pre-activation bottleneck (v2's
+    downsample is a bare conv — no shortcut BN)."""
+    out, m1, v1, m2, v2, m3, v3 = _bottleneck_v2_core(
+        x, w1, g1, b1, w2, g2, b2, w3, g3, b3, wsc, stride, eps)
+    b = functools.partial(_blend, momentum)
+    return (out, b(rm1, m1), b(rv1, v1), b(rm2, m2), b(rv2, v2),
+            b(rm3, m3), b(rv3, v3))
+
+
 def _register_ops():
     from .registry import register
     register("_fused_bottleneck_v1")(fused_bottleneck_v1)
     register("_fused_bottleneck_v1_proj")(fused_bottleneck_v1_proj)
+    register("_fused_bottleneck_v2")(fused_bottleneck_v2)
+    register("_fused_bottleneck_v2_proj")(fused_bottleneck_v2_proj)
 
 
 _register_ops()
