@@ -208,6 +208,80 @@ def bench_op(op, args, kwargs, steps, warmup, grad):
     return fwd_ms, bwd_ms
 
 
+def bench_bulk_chain(steps, warmup, chain_len=50, size=64):
+    """Per-op dispatch vs bulked dispatch on an elementwise chain.
+
+    The bulking headline microbenchmark: a chain of ``chain_len`` small
+    elementwise ops, run once with per-op jit dispatch and once with
+    ``MXNET_EXEC_ENABLE_BULKING`` semantics (deferred segments compiled
+    as one XLA program each, ops/bulking.py).  Outputs are compared at
+    ULP granularity — fused segments may FMA-contract across op
+    boundaries (same float semantics as hybridize), so a few ULPs of
+    drift is expected and anything beyond that is a real divergence —
+    and the profiler counters prove ops/segment and the trace-cache hit
+    rate.
+    """
+    from incubator_mxnet_tpu import nd, profiler
+    from incubator_mxnet_tpu.ops import bulking
+
+    rng = onp.random.RandomState(0)
+    x0 = nd.array(rng.rand(size, size).astype("float32"))
+    n_rounds = max(1, chain_len // 5)
+
+    def chain():
+        x = x0
+        for _ in range(n_rounds):  # 5 ops per round
+            x = x * 1.0001
+            x = x + 0.0001
+            x = nd.relu(x)
+            x = x - 0.00005
+            x = nd.minimum(x, 10.0)
+        return x.asnumpy()
+
+    def run(bulk):
+        with bulking.bulk_scope(bulk):
+            return chain()
+
+    ref, got = run(False), run(True)
+    identical = bool(onp.array_equal(ref, got))
+    max_abs = 0.0 if identical else float(onp.max(onp.abs(ref - got)))
+    max_ulp = 0.0 if identical else float(onp.max(
+        onp.abs(ref - got) / onp.spacing(onp.maximum(onp.abs(ref), 1e-30))))
+
+    def time_mode(bulk):
+        for _ in range(warmup):
+            run(bulk)
+        profiler.reset_bulk_stats()  # counters cover only the timed steps
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            run(bulk)
+        return (time.perf_counter() - t0) / steps * 1e3
+
+    per_op_ms = time_mode(False)
+    off_stats = profiler.bulk_stats(reset=True)
+    bulked_ms = time_mode(True)
+    on_stats = profiler.bulk_stats(reset=True)
+    return {
+        "chain_len": n_rounds * 5,
+        "size": size,
+        "steps": steps,
+        "identical": identical,
+        "max_abs_diff": max_abs,
+        "max_ulp_diff": max_ulp,
+        "per_op_ms": round(per_op_ms, 4),
+        "bulked_ms": round(bulked_ms, 4),
+        "speedup": round(per_op_ms / bulked_ms, 3) if bulked_ms else None,
+        "per_op_dispatches_per_run": off_stats["eager_dispatches"] // max(
+            1, steps),
+        "bulked_launches_per_run": on_stats["segments_flushed"] // max(
+            1, steps),
+        "ops_per_segment_mean": round(on_stats["ops_per_segment_mean"], 2),
+        "ops_per_segment_hist": {str(k): v for k, v in sorted(
+            on_stats["ops_per_segment"].items())},
+        "trace_cache_hit_rate": round(on_stats["trace_cache_hit_rate"], 4),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--output", default="opperf_results.json")
@@ -217,6 +291,17 @@ def main():
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--size", type=int, default=1024)
     ap.add_argument("--no-grad", action="store_true")
+    ap.add_argument("--bulk-chain", action="store_true",
+                    help="run the op-bulking chain microbenchmark "
+                    "(per-op vs bulked dispatch) instead of the op sweep")
+    ap.add_argument("--chain-len", type=int, default=50)
+    ap.add_argument("--chain-size", type=int, default=64,
+                    help="square side of the chain tensor; bulking "
+                    "targets the small-op dispatch-bound regime, large "
+                    "tensors hide dispatch behind async compute")
+    ap.add_argument("--check", action="store_true",
+                    help="with --bulk-chain: exit nonzero if bulked and "
+                    "per-op outputs diverge or no bulking happened")
     ap.add_argument("--resume", action="store_true",
                     help="keep results already in --output and only "
                     "measure the rest (wedged-tunnel recovery)")
@@ -233,6 +318,33 @@ def main():
             sys.exit(2)
 
     from incubator_mxnet_tpu.ops import registry
+
+    if args.bulk_chain:
+        chain_size = args.chain_size
+        res = bench_bulk_chain(args.steps, args.warmup,
+                               chain_len=args.chain_len, size=chain_size)
+        platform = jax.devices()[0].platform
+        out = {"platform": platform, "bulk_chain": res}
+        with open(args.output, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"bulk chain ({res['chain_len']} ops, {chain_size}x"
+              f"{chain_size}): per-op {res['per_op_ms']:.3f} ms "
+              f"({res['per_op_dispatches_per_run']} dispatches)  "
+              f"bulked {res['bulked_ms']:.3f} ms "
+              f"({res['bulked_launches_per_run']} launches, "
+              f"{res['ops_per_segment_mean']} ops/segment, "
+              f"cache hit rate {res['trace_cache_hit_rate']:.0%})  "
+              f"max diff {res['max_ulp_diff']:.1f} ulp")
+        # a fused segment may FMA-contract across op boundaries (same
+        # float semantics as hybridize): a few ULPs is expected, more is
+        # a real numeric divergence
+        if args.check and not (res["max_ulp_diff"] <= 32.0
+                               and res["bulked_launches_per_run"] >= 1
+                               and res["ops_per_segment_mean"] > 1):
+            print("bulk chain smoke FAILED: outputs diverged beyond ULP "
+                  "noise or no bulking happened", file=sys.stderr)
+            sys.exit(1)
+        return
 
     specs = default_specs(args.size)
     # chip windows are scarce: measure the hot NN/linear-algebra ops

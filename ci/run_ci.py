@@ -5,7 +5,10 @@ runtime_functions.sh) recast as one dependency-free driver.
 Stages (each isolated, failures collected, nonzero exit if any fail):
   build      native libs (libmxtpu, capi, predict) + C++ selftest
   sanity     compileall + import smoke
-  unit       pytest suite (shardable: --shard i/n for parallel CI hosts)
+  unit       tier-1 pytest suite (shardable: --shard i/n for parallel hosts)
+  slow       the slow-marked tests the tier-1 '-m not slow' sweep excludes
+  bulking    opperf op-bulking smoke: bulked vs per-op dispatch outputs
+             compared, fails on numeric divergence beyond ULP noise
   multichip  __graft_entry__.dryrun_multichip on a virtual 8-device mesh
   bench      bench.py CPU fallback emits a well-formed JSON line
 
@@ -63,8 +66,11 @@ def stage_sanity(args):
 
 
 def stage_unit(args):
+    # mirror the tier-1 verify command (ROADMAP.md): skip slow-marked
+    # tests, survive collection errors, no state-carrying plugins
     cmd = [sys.executable, "-m", "pytest", "tests/", "-q",
-           "--durations=10"]
+           "-m", "not slow", "--continue-on-collection-errors",
+           "-p", "no:cacheprovider", "--durations=10"]
     if args.shard:
         i, n = (int(v) for v in args.shard.split("/"))
         if not 1 <= i <= n:
@@ -79,6 +85,40 @@ def stage_unit(args):
     proc = sh(cmd, timeout=3600)
     tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
     return proc.returncode == 0, tail
+
+
+def stage_slow(args):
+    """Slow-marked tests: the unit stage mirrors the tier-1 command
+    ('-m not slow'), so this stage keeps the excluded tests covered."""
+    proc = sh([sys.executable, "-m", "pytest", "tests/", "-q", "-m", "slow",
+               "--continue-on-collection-errors", "-p", "no:cacheprovider"],
+              timeout=1800)
+    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+    if proc.returncode == 5:  # nothing collected / all deselected
+        return True, "no slow-marked tests"
+    return proc.returncode == 0, tail
+
+
+def stage_bulking(args):
+    """Op-bulking smoke: the tier-1 unit stage runs first (stage order),
+    then the fast-mode opperf chain compares bulked vs per-op dispatch
+    and fails on numeric divergence beyond FMA-contraction ULP noise."""
+    out = os.path.join(REPO, ".ci_bulk_smoke.json")
+    try:
+        proc = sh([sys.executable, "benchmark/opperf.py", "--bulk-chain",
+                   "--steps", "5", "--warmup", "1", "--check",
+                   "--output", out], timeout=600)
+        if proc.returncode != 0:
+            return False, (proc.stderr or proc.stdout).strip()[-300:]
+        with open(out) as f:
+            res = json.load(f)["bulk_chain"]
+    finally:
+        if os.path.exists(out):
+            os.remove(out)
+    return True, (f"{res['bulked_launches_per_run']} launches for "
+                  f"{res['chain_len']} ops, "
+                  f"{res['ops_per_segment_mean']} ops/segment, "
+                  f"max {res['max_ulp_diff']:.1f} ulp")
 
 
 def stage_multichip(args):
@@ -99,7 +139,8 @@ def stage_bench(args):
 
 
 STAGES = {"build": stage_build, "sanity": stage_sanity,
-          "unit": stage_unit, "multichip": stage_multichip,
+          "unit": stage_unit, "slow": stage_slow,
+          "bulking": stage_bulking, "multichip": stage_multichip,
           "bench": stage_bench}
 
 

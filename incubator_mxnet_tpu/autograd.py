@@ -89,7 +89,17 @@ def is_training() -> bool:
 def set_recording(is_rec: bool) -> bool:
     t = _tls()
     prev, t.recording = t.recording, is_rec
+    if is_rec and not prev:
+        _flush_bulked_segment()
     return prev
+
+
+def _flush_bulked_segment():
+    """Entry into recording is a bulking sync point: deferred eager
+    segments must not straddle the autograd boundary — the tape records
+    concrete ops, so the pre-record segment flushes first."""
+    from .ops import bulking
+    bulking.flush_current()
 
 
 def set_training(train: bool) -> bool:
@@ -104,6 +114,8 @@ def _scope(rec, train):
     prev_rec, prev_train = t.recording, t.training
     if rec is not None:
         t.recording = rec
+        if rec and not prev_rec:
+            _flush_bulked_segment()
     if train is not None:
         t.training = train
     try:

@@ -332,8 +332,12 @@ class CachedOp:
         raw_params = [p._check_and_get().data for p in params]
         raw_inputs = [x.data for x in inputs]
         training = autograd.is_training()
+        # param shapes/dtypes are part of the signature: a re-initialized
+        # or reshaped/recast parameter must rebuild, not silently reuse a
+        # stale executable entry
         sig = (tuple((tuple(a.shape), str(a.dtype)) for a in raw_inputs),
-               training, len(raw_params))
+               training,
+               tuple((tuple(a.shape), str(a.dtype)) for a in raw_params))
         entry = self._cache.get(sig)
         if entry is None:
             entry = self._build(sig, params, training)

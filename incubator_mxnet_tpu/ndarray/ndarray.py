@@ -35,6 +35,7 @@ from ..base import dtype_from_any, integer_types, numeric_types
 from ..context import Context, current_context
 from .. import engine as _engine_mod
 from .. import profiler as _profiler
+from ..ops import bulking as _bulking
 
 __all__ = ["NDArray", "_wrap_outputs", "_to_jax"]
 
@@ -131,8 +132,18 @@ class NDArray:
     # ------------------------------------------------------------------
     @property
     def data(self):
-        """Current value as a jax.Array (views re-slice lazily)."""
+        """Current value as a jax.Array (views re-slice lazily).
+
+        This is a bulking sync point: a chunk holding a PendingArray
+        (deferred segment output, ops/bulking.py) flushes its segment
+        here and the concrete value is swapped in — no version bump,
+        materialization is not a write."""
         a = self._chunk.array
+        if type(a) is _bulking.PendingArray:
+            v = _bulking.resolve(a)
+            if self._chunk.array is a:
+                self._chunk.array = v
+            a = v
         if self._index is not None:
             a = a[self._index]
         if self._vshape is not None:
@@ -148,6 +159,8 @@ class NDArray:
         if self._index is None and self._vshape is None:
             self._chunk.write(new)
         elif self._index is not None:
+            if type(self._chunk.array) is _bulking.PendingArray:
+                self.data  # sync point: materialize before scatter-back
             base = self._chunk.array
             target_shape = base[self._index].shape
             self._chunk.write(base.at[self._index].set(
@@ -168,15 +181,23 @@ class NDArray:
     # ------------------------------------------------------------------
     @property
     def shape(self):
+        # pending (bulked) values carry their abstract shape: metadata
+        # inspection must not force a segment flush
+        a = self._chunk.array
+        if type(a) is _bulking.PendingArray and self._index is None:
+            return tuple(self._vshape) if self._vshape is not None \
+                else tuple(a.shape)
         return tuple(self.data.shape)
 
     @property
     def dtype(self):
-        return onp.dtype(self.data.dtype.name) if self.data.dtype.name != "bfloat16" else self.data.dtype
+        a = self._chunk.array
+        dt = a.dtype if type(a) is _bulking.PendingArray else self.data.dtype
+        return onp.dtype(dt.name) if dt.name != "bfloat16" else dt
 
     @property
     def ndim(self):
-        return self.data.ndim
+        return len(self.shape)
 
     @property
     def size(self):
@@ -377,6 +398,8 @@ class NDArray:
             key = key.data
         if isinstance(value, NDArray):
             value = value.data
+        if type(self._chunk.array) is _bulking.PendingArray:
+            self.data  # sync point: materialize before the in-place write
         base = self._chunk.array
         if self._is_view:
             # write through the composed view

@@ -12,7 +12,10 @@ from .registry import (
     get_op,
     list_ops,
     invoke,
+    clear_caches,
+    cache_stats,
 )
+from . import bulking  # noqa: F401  (lazy eager segments / op bulking)
 from . import elemwise  # noqa: F401  (registration side effects)
 from . import reduce_ops  # noqa: F401
 from . import shape_ops  # noqa: F401
@@ -37,6 +40,6 @@ from . import ref_aliases  # noqa: F401  (must import LAST: aliases
 from ..operator import custom as _custom_invoke
 
 
-@register("Custom")
-def Custom(*inputs, op_type=None, **kwargs):
+@register("Custom", bulkable=False)  # user callbacks may be impure:
+def Custom(*inputs, op_type=None, **kwargs):  # never defer them
     return _custom_invoke(*inputs, op_type=op_type, **kwargs)

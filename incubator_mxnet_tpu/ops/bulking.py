@@ -1,0 +1,395 @@
+"""Imperative op bulking: lazy eager segments compiled as one XLA program.
+
+TPU-native re-design of the reference engine's bulk execution
+(``graph_executor.cc:1422 InitOpSegs``; ``MXNET_EXEC_BULK_EXEC_TRAIN`` /
+``MXNET_EXEC_BULK_EXEC_INFERENCE``): instead of pushing hundreds of tiny
+ops to the engine one at a time, runs of ops are batched into *segments*
+and executed as one engine job.  Here the segment is a deferred trace:
+
+* With ``MXNET_EXEC_ENABLE_BULKING=1`` (or inside ``bulk_scope(True)``),
+  ``registry.invoke`` on a jittable op does not execute — it appends a
+  node to the calling thread's open segment and returns a
+  :class:`PendingArray` placeholder carrying the abstract value
+  (shape/dtype via ``jax.eval_shape``).
+* The segment flushes as a **single jit-compiled program** at sync
+  points — ``NDArray.data`` access (``asnumpy``/``item``/``__bool__``/
+  ``wait_to_read``...), a non-jittable op consuming a pending input,
+  entry into autograd recording, or the ``MXNET_EXEC_BULK_MAX_OPS`` cap
+  (reference default bulk segment length: 15).
+* Flushed programs are cached in a trace cache keyed by the op-name
+  sequence, the dataflow structure, static kwargs, and external input
+  shapes/dtypes — a steady-state eager loop hits one compiled executable
+  per segment with zero retracing.
+
+Correctness notes: deferred nodes capture the *immutable* ``jax.Array``
+values of their inputs at append time, so later in-place mutation of an
+input NDArray (which swaps a new array into its chunk) cannot change an
+already-recorded node.  Because the segment compiles as one fused XLA
+program, float results may differ from per-op dispatch by a few ULPs
+(FMA contraction across op boundaries) — the same semantics hybridize
+already has; integer/bool results are bit-exact.  Pending placeholders may be resolved from any
+thread (engine worker closures read NDArrays produced on the main
+thread); segment state is lock-protected and a flush failure is sticky —
+every placeholder of the failed segment rethrows at its sync point, the
+same contract as the engine's async-error propagation.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as _onp
+
+from ..base import get_env
+from .. import profiler as _profiler
+
+__all__ = ["enabled", "set_enabled", "bulk_scope", "max_bulk_ops",
+           "PendingArray", "defer", "resolve", "flush_current",
+           "clear_trace_cache", "trace_cache_stats", "NOT_DEFERRED"]
+
+#: sentinel: these arguments cannot be deferred, invoke() takes the eager path
+NOT_DEFERRED = object()
+
+_tls = threading.local()
+
+_trace_cache: dict = {}
+_trace_lock = threading.Lock()
+
+
+_env_enabled: "bool | None" = None
+
+
+def enabled() -> bool:
+    """Bulking gate: thread-local ``bulk_scope`` override, else the
+    ``MXNET_EXEC_ENABLE_BULKING`` env var (reference knob; default off).
+
+    The env var is read ONCE at first use — enabled() sits on the
+    per-op eager hot path, which must not pay environ lookups when
+    bulking is off.  Use ``bulk_scope`` (or ``set_enabled``) to toggle
+    at runtime."""
+    ov = getattr(_tls, "override", None)
+    if ov is not None:
+        return ov
+    global _env_enabled
+    if _env_enabled is None:
+        _env_enabled = get_env("MXNET_EXEC_ENABLE_BULKING", False, bool)
+    return _env_enabled
+
+
+def set_enabled(enable: "bool | None"):
+    """Set the process-wide bulking default (None re-reads the env var
+    at next use).  Returns the previous value."""
+    global _env_enabled
+    prev, _env_enabled = _env_enabled, enable
+    return prev
+
+
+def max_bulk_ops() -> int:
+    """Segment length cap (reference MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN
+    semantics, default 15 like the reference bulk segments)."""
+    n = get_env("MXNET_EXEC_BULK_MAX_OPS", 15, int)
+    return n if n > 0 else 1
+
+
+class bulk_scope:
+    """Thread-local bulking override for tests/benchmarks.
+
+    ``with bulk_scope(True): ...`` forces bulking on regardless of the
+    env var; the open segment is flushed on exit so laziness never
+    escapes the scope.
+    """
+
+    def __init__(self, enable: bool):
+        self._enable = bool(enable)
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "override", None)
+        _tls.override = self._enable
+        return self
+
+    def __exit__(self, *exc):
+        _tls.override = self._prev
+        flush_current()
+        return False
+
+
+class PendingArray:
+    """Placeholder for one output of a deferred segment node.
+
+    Lives in an NDArray chunk until a sync point flushes the owning
+    segment; exposes shape/dtype so shape inspection does not force a
+    flush (the reference analog: NDArray metadata is known when the op
+    is pushed, only the buffer contents are async).
+    """
+
+    __slots__ = ("segment", "shape", "dtype", "_slot", "_value", "_exc")
+
+    def __init__(self, segment, shape, dtype, slot):
+        self.segment = segment
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self._slot = slot          # (node_index, output_index)
+        self._value = None
+        self._exc = None
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        n = 1
+        for d in self.shape:
+            n *= int(d)
+        return n
+
+    def __repr__(self):
+        state = "resolved" if self._value is not None else (
+            "failed" if self._exc is not None else "pending")
+        return f"PendingArray({state}, shape={self.shape}, dtype={self.dtype})"
+
+
+class _Node:
+    __slots__ = ("op", "args", "kwargs", "kwargs_t", "kw_names", "n_pos",
+                 "outs")
+
+    def __init__(self, op, args, kwargs, kwargs_t, kw_names, n_pos, outs):
+        self.op = op
+        self.args = args           # jax.Array / onp.ndarray (external) or
+        #                            PendingArray of this segment (internal)
+        self.kwargs = kwargs
+        self.kwargs_t = kwargs_t   # hashable form, part of the trace key
+        self.kw_names = kw_names
+        self.n_pos = n_pos
+        self.outs = outs
+
+
+class _Segment:
+    __slots__ = ("nodes", "lock", "flushed", "exc", "cap")
+
+    def __init__(self):
+        self.nodes: list[_Node] = []
+        self.lock = threading.Lock()
+        self.flushed = False
+        self.exc = None
+        # env read once per segment, not per op (the append hot path)
+        self.cap = max_bulk_ops()
+
+
+def _ndarray_cls():
+    """Bound on first use (bulking is a leaf module; NDArray imports it)."""
+    global _ndarray_cls
+    from ..ndarray.ndarray import NDArray
+    _ndarray_cls = lambda: NDArray  # noqa: E731
+    return NDArray
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def defer(op, all_in, n_pos, kw_names, kwargs):
+    """Append ``op`` to the calling thread's open segment.
+
+    Returns the PendingArray output(s) mirroring the op's output
+    structure, or :data:`NOT_DEFERRED` when the arguments cannot be
+    deferred (non-array args, tracers from an enclosing jit trace, or an
+    op the abstract evaluator rejects) — the caller then takes the
+    normal eager path.
+    """
+    NDArray = _ndarray_cls()
+    cur = getattr(_tls, "segment", None)
+    args = []
+    for x in all_in:
+        if isinstance(x, NDArray):
+            a = x._chunk.array
+            if (type(a) is PendingArray and not x._is_view
+                    and a._value is None and a._exc is None
+                    and a.segment is cur and not cur.flushed):
+                args.append(a)
+                continue
+            x = x.data  # resolves foreign/settled pendings, applies views
+        if _is_tracer(x) or not isinstance(x, (jax.Array, _onp.ndarray)):
+            return NOT_DEFERRED
+        args.append(x)
+
+    # abstract evaluation — cached per (avals, statics) so steady-state
+    # loops never re-trace even abstractly; dtype OBJECTS key the cache
+    # (hashable, value-equal — str(dtype) is measurably slow per op)
+    akey = (tuple((a.shape, a.dtype) for a in args),
+            kwargs_t := tuple(sorted(kwargs.items())), kw_names, n_pos)
+    out_avals = op._aval_cache.get(akey)
+    if out_avals is None:
+        specs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args]
+
+        def f(*arrs):
+            return op.fn(*arrs[:n_pos],
+                         **dict(zip(kw_names, arrs[n_pos:])), **kwargs)
+
+        try:
+            out_avals = jax.eval_shape(f, *specs)
+        except Exception:
+            return NOT_DEFERRED
+        flat = (tuple(out_avals) if isinstance(out_avals, (tuple, list))
+                else (out_avals,))
+        if not all(hasattr(av, "shape") and hasattr(av, "dtype")
+                   for av in flat):
+            return NOT_DEFERRED  # exotic output pytree: run eagerly
+        op._aval_cache[akey] = out_avals
+
+    multi = isinstance(out_avals, (tuple, list))
+    avals = tuple(out_avals) if multi else (out_avals,)
+    while True:
+        seg = getattr(_tls, "segment", None)
+        if seg is None or seg.flushed:
+            seg = _tls.segment = _Segment()
+        with seg.lock:
+            if seg.flushed:  # flushed under us by another thread's sync
+                continue
+            idx = len(seg.nodes)
+            outs = tuple(PendingArray(seg, av.shape, av.dtype, (idx, j))
+                         for j, av in enumerate(avals))
+            seg.nodes.append(_Node(op, args, dict(kwargs), kwargs_t,
+                                   kw_names, n_pos, outs))
+            if len(seg.nodes) >= seg.cap:
+                _flush_locked(seg)
+        return tuple(outs) if multi else outs[0]
+
+
+def resolve(p: PendingArray):
+    """Concrete value of a placeholder, flushing its segment if needed.
+
+    This is the sync point: flush errors (sticky on the segment) rethrow
+    here, mirroring ``wait_for_var`` exception propagation."""
+    v = p._value
+    if v is not None:
+        return v
+    if p._exc is not None:
+        raise p._exc
+    with p.segment.lock:
+        _flush_locked(p.segment)
+    if p._exc is not None:
+        raise p._exc
+    v = p._value
+    if v is None:  # defensive: a flush must settle every placeholder
+        raise p.segment.exc or RuntimeError(
+            "bulked segment flushed without settling this placeholder")
+    return v
+
+
+def flush_current():
+    """Flush the calling thread's open segment (autograd-entry hook,
+    bulk_scope exit)."""
+    seg = getattr(_tls, "segment", None)
+    if seg is not None:
+        _tls.segment = None
+        with seg.lock:
+            _flush_locked(seg)
+
+
+def _flush_locked(seg: _Segment):
+    """Compile-and-run the segment as one XLA program (caller holds
+    ``seg.lock``)."""
+    if seg.flushed:
+        return
+    seg.flushed = True
+    nodes = seg.nodes
+    if not nodes:
+        return
+
+    try:
+        ext, ext_ids = [], {}
+        node_keys = []
+        plan = []
+        for node in nodes:
+            srcs = []
+            for a in node.args:
+                if type(a) is PendingArray:
+                    if a._value is not None:
+                        a = a._value       # settled: plain external input
+                    elif a.segment is seg:
+                        srcs.append(("n",) + a._slot)
+                        continue
+                    else:                  # foreign unflushed (defensive):
+                        a = resolve(a)     # may rethrow that segment's exc
+                i = ext_ids.get(id(a))
+                if i is None:
+                    i = ext_ids[id(a)] = len(ext)
+                    ext.append(a)
+                srcs.append(("e", i))
+            srcs = tuple(srcs)
+            # the Op object itself is the key component (not its id():
+            # a recycled id after re-registration + GC could silently hit
+            # a stale program); the cache entry also pins the op alive
+            node_keys.append((node.op, srcs, node.kwargs_t,
+                              node.kw_names, node.n_pos, len(node.outs)))
+            plan.append((node.op.fn, srcs, node.kwargs, node.kw_names,
+                         node.n_pos))
+
+        key = (tuple(node_keys),
+               tuple((a.shape, a.dtype) for a in ext))
+        with _trace_lock:
+            prog = _trace_cache.get(key)
+            hit = prog is not None
+            if not hit:
+                prog = jax.jit(_make_program(plan))
+                _trace_cache[key] = prog
+
+        flat = prog(*ext)
+    except Exception as e:  # sticky, like the engine's var exceptions —
+        seg.exc = e         # whether raised compiling, resolving a
+        for node in nodes:  # failed input segment, or executing
+            for p in node.outs:
+                p._exc = e
+        raise
+    finally:
+        seg.nodes = []  # drop input refs either way
+
+    i = 0
+    for node in nodes:
+        for p in node.outs:
+            p._value = flat[i]
+            i += 1
+    _profiler.record_bulk_flush(len(nodes), hit)
+
+
+def _make_program(plan):
+    """Replay closure over a normalized node plan; jitted once per trace
+    key and reused for every segment with the same structure.
+
+    Float semantics: the segment compiles as ONE fused XLA program, so
+    XLA may contract across op boundaries (a ``mul``→``add`` pair
+    becomes an FMA with a single rounding) — exactly the same float
+    semantics a hybridized block already has versus eager per-op
+    dispatch.  Integer/bool ops are bit-exact; float results may differ
+    from per-op dispatch by a few ULPs.
+    """
+
+    def program(*ext_args):
+        vals = []
+        flat_out = []
+        for fn, srcs, kw, kw_names, n_pos in plan:
+            args = [ext_args[s[1]] if s[0] == "e" else vals[s[1]][s[2]]
+                    for s in srcs]
+            o = fn(*args[:n_pos],
+                   **dict(zip(kw_names, args[n_pos:])), **kw)
+            outs = tuple(o) if isinstance(o, (tuple, list)) else (o,)
+            vals.append(outs)
+            flat_out.extend(outs)
+        return tuple(flat_out)
+
+    return program
+
+
+def clear_trace_cache():
+    """Drop every cached segment program (registry.clear_caches hook)."""
+    with _trace_lock:
+        n = len(_trace_cache)
+        _trace_cache.clear()
+    return n
+
+
+def trace_cache_stats():
+    with _trace_lock:
+        return {"entries": len(_trace_cache)}

@@ -23,7 +23,11 @@ import threading
 import jax
 import numpy as _onp
 
-__all__ = ["Op", "register", "get_op", "list_ops", "invoke"]
+from .. import profiler as _profiler
+from . import bulking as _bulking
+
+__all__ = ["Op", "register", "get_op", "list_ops", "invoke",
+           "clear_caches", "cache_stats"]
 
 _OPS: dict[str, "Op"] = {}
 _lock = threading.Lock()
@@ -49,7 +53,7 @@ class Op:
     """
 
     def __init__(self, name, fn, differentiable=True, num_inputs=-1,
-                 aliases=(), jittable=True):
+                 aliases=(), jittable=True, bulkable=None):
         self.name = name
         self.fn = fn
         self.differentiable = differentiable
@@ -59,7 +63,12 @@ class Op:
         # al.) — runs eagerly on concrete arrays, like the reference's
         # imperative-only FComputeEx ops; tracing raises a shape error
         self.jittable = jittable
+        # bulkable=False opts a jittable op out of deferred segments
+        # (ops/bulking.py) — needed for ops whose fn runs impure Python
+        # (Custom callbacks) where deferring would reorder side effects
+        self.bulkable = jittable if bulkable is None else bulkable
         self._jit_cache: dict = {}
+        self._aval_cache: dict = {}
         try:
             sig = inspect.signature(fn)
             self._has_varargs = any(
@@ -89,12 +98,13 @@ class Op:
 
 
 def register(name, differentiable=True, num_inputs=-1, aliases=(),
-             jittable=True):
+             jittable=True, bulkable=None):
     """Decorator: register a pure JAX function as an operator."""
 
     def deco(fn):
         op = Op(name, fn, differentiable=differentiable,
-                num_inputs=num_inputs, aliases=aliases, jittable=jittable)
+                num_inputs=num_inputs, aliases=aliases, jittable=jittable,
+                bulkable=bulkable)
         with _lock:
             _OPS[name] = op
             for a in aliases:
@@ -168,17 +178,27 @@ def invoke(op: "Op | str", *inputs, out=None, **kwargs):
     kwargs = {k: _hashable(v) for k, v in kwargs.items() if k not in kw_arrays}
     all_in = list(inputs) + list(kw_arrays.values())
     kw_names = tuple(kw_arrays)
-    raw = [x.data if isinstance(x, NDArray) else x for x in all_in]
     n_pos = len(inputs)
 
     # AMP: an active CastPolicy (amp.convert_block) casts floating inputs
     # per the op lists — the eager-path analog of the reference's
     # ReducePrecision graph pass (contrib/amp/amp.py convert_symbol).
     _pol = _current_amp_policy()
+    recording = autograd.is_recording()
+
+    # Op bulking (ops/bulking.py): outside recording/AMP/out=, a jittable
+    # op joins the thread's deferred segment instead of dispatching — the
+    # segment compiles as ONE XLA program at the next sync point
+    # (reference engine bulk segments, graph_executor.cc InitOpSegs).
+    if (op.bulkable and out is None and _pol is None and not recording
+            and _bulking.enabled()):
+        res = _bulking.defer(op, all_in, n_pos, kw_names, kwargs)
+        if res is not _bulking.NOT_DEFERRED:
+            return _wrap_outputs(res, inputs if inputs else all_in)
+
+    raw = [x.data if isinstance(x, NDArray) else x for x in all_in]
     if _pol is not None:
         raw = _pol.cast_args(op.name, raw)
-
-    recording = autograd.is_recording()
     need_grad = (
         recording
         and op.differentiable
@@ -197,6 +217,7 @@ def invoke(op: "Op | str", *inputs, out=None, **kwargs):
         out_data = jfn(*raw[:n_pos], **dict(zip(kw_names, raw[n_pos:])),
                        **kwargs)
         vjp_fn = None
+    _profiler.record_eager_dispatch()  # both branches are per-op dispatches
 
     outputs = _wrap_outputs(out_data, inputs if inputs else all_in, out=out)
     if need_grad:
@@ -206,6 +227,39 @@ def invoke(op: "Op | str", *inputs, out=None, **kwargs):
         autograd._record(op, vjp_fn, all_in, nd_inputs, input_slots,
                          outputs, fn=fn)
     return outputs
+
+
+def clear_caches():
+    """Drop every ``Op._jit_cache`` / abstract-eval cache and the
+    bulking segment trace cache.
+
+    Gives tests (tests/conftest.py) and long-lived servers a way to
+    release compiled executables and guarantee no jit-cache state leaks
+    across test modules.  Returns the number of entries dropped."""
+    n = 0
+    with _lock:
+        ops = set(_OPS.values())
+    for op in ops:
+        n += len(op._jit_cache) + len(op._aval_cache)
+        op._jit_cache.clear()
+        op._aval_cache.clear()
+    n += _bulking.clear_trace_cache()
+    return n
+
+
+def cache_stats():
+    """Introspection over the compiled-executable caches: per-op jit
+    entries, abstract-eval entries, and bulking trace-cache size."""
+    with _lock:
+        ops = set(_OPS.values())
+    per_op = {op.name: len(op._jit_cache) for op in ops if op._jit_cache}
+    return {
+        "op_jit_entries": sum(per_op.values()),
+        "op_aval_entries": sum(len(op._aval_cache) for op in ops),
+        "ops_with_jit_cache": len(per_op),
+        "bulk_trace_entries": _bulking.trace_cache_stats()["entries"],
+        "per_op_jit_entries": per_op,
+    }
 
 
 def describe_op(op: "Op | str"):

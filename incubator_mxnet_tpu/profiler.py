@@ -18,7 +18,8 @@ import jax
 
 __all__ = ["set_config", "set_state", "start", "stop", "dump", "dumps",
            "pause", "resume", "Task", "Frame", "Counter", "Marker", "scope",
-           "dump_memory_allocations"]
+           "dump_memory_allocations", "bulk_stats", "reset_bulk_stats",
+           "record_bulk_flush", "record_eager_dispatch"]
 
 _config = {
     "filename": "profile.json",
@@ -76,6 +77,72 @@ def stop(profile_process="worker"):
             jax.profiler.stop_trace()
         finally:
             _state["xprof_active"] = False
+
+
+# -- imperative op-bulking counters (ops/bulking.py): segments flushed,
+#    ops-per-segment histogram, trace-cache hit rate, and the per-op
+#    eager dispatch count for comparison — the observability half of the
+#    reference's bulk-exec engine segments (graph_executor.cc InitOpSegs) --
+
+_bulk_lock = threading.Lock()
+
+
+def _fresh_bulk_stats():
+    return {"segments_flushed": 0, "ops_bulked": 0,
+            "trace_cache_hits": 0, "trace_cache_misses": 0,
+            "eager_dispatches": 0, "ops_per_segment": {}}
+
+
+_bulk = _fresh_bulk_stats()
+
+
+def record_bulk_flush(n_ops, cache_hit):
+    """One segment flushed as a single compiled program of ``n_ops`` ops."""
+    with _bulk_lock:
+        _bulk["segments_flushed"] += 1
+        _bulk["ops_bulked"] += n_ops
+        _bulk["trace_cache_hits" if cache_hit else "trace_cache_misses"] += 1
+        h = _bulk["ops_per_segment"]
+        h[n_ops] = h.get(n_ops, 0) + 1
+    if _state["running"]:
+        with _events_lock:
+            _events.append({"name": "bulk_segment", "cat": "bulking",
+                            "ph": "C", "ts": time.perf_counter_ns() // 1000,
+                            "pid": os.getpid(),
+                            "args": {"ops": n_ops,
+                                     "cache_hit": int(cache_hit)}})
+
+
+def record_eager_dispatch():
+    """One per-op jitted dispatch on the eager path (bulking off or op
+    not bulkable) — the denominator for launches-vs-ops comparisons."""
+    _bulk["eager_dispatches"] += 1  # GIL-atomic enough for a counter
+
+
+def bulk_stats(reset=False):
+    """Snapshot of the bulking counters plus derived rates.
+
+    ``segments_flushed`` is the number of compiled-program launches the
+    bulked path made; ``ops_bulked / segments_flushed`` is the mean
+    segment length (reference target: > 5 ops per engine segment)."""
+    global _bulk
+    with _bulk_lock:
+        out = {k: (dict(v) if isinstance(v, dict) else v)
+               for k, v in _bulk.items()}
+        if reset:
+            # rebind (not clear-in-place): record_eager_dispatch increments
+            # without the lock and must never see a half-reset dict
+            _bulk = _fresh_bulk_stats()
+    segs = out["segments_flushed"]
+    lookups = out["trace_cache_hits"] + out["trace_cache_misses"]
+    out["ops_per_segment_mean"] = (out["ops_bulked"] / segs) if segs else 0.0
+    out["trace_cache_hit_rate"] = (
+        out["trace_cache_hits"] / lookups) if lookups else 0.0
+    return out
+
+
+def reset_bulk_stats():
+    bulk_stats(reset=True)
 
 
 # -- per-allocation attribution (reference storage_profiler.cc

@@ -21,6 +21,12 @@ import numpy as _onp
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 "
+        "'-m \"not slow\"' sweep (ci/run_ci.py runs them in the slow stage)")
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything():
     """Reproducible RNG per test (reference @with_seed fixture,
@@ -29,3 +35,12 @@ def _seed_everything():
     _onp.random.seed(0)
     mx.random.seed(0)
     yield
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_op_caches():
+    """Per-op jit caches, abstract-eval caches, and the bulking trace
+    cache must not leak compiled state (or memory) across test modules."""
+    yield
+    from incubator_mxnet_tpu.ops import registry
+    registry.clear_caches()

@@ -1,0 +1,413 @@
+"""Imperative op bulking (ops/bulking.py): lazy eager segments compiled
+as one XLA program — the TPU analog of the reference engine's bulk
+segments (graph_executor.cc InitOpSegs, MXNET_EXEC_BULK_EXEC_* knobs).
+
+Parity tests run the same computation with bulking off and on instead of
+duplicating the operator/ndarray suites: float outputs must agree to ULP
+noise (fused segments may FMA-contract across op boundaries, like
+hybridize), integer outputs bit-exactly.
+"""
+import threading
+
+import numpy as onp
+import pytest
+
+import jax
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd, profiler
+from incubator_mxnet_tpu import engine as engine_mod
+from incubator_mxnet_tpu.ops import bulking, registry
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def run_both(fn):
+    """Run fn() with bulking off then on; return both results."""
+    with bulking.bulk_scope(False):
+        ref = fn()
+    with bulking.bulk_scope(True):
+        got = fn()
+    return ref, got
+
+
+def assert_mode_parity(fn, exact=False):
+    ref, got = run_both(fn)
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    gots = got if isinstance(got, (list, tuple)) else [got]
+    assert len(refs) == len(gots)
+    for r, g in zip(refs, gots):
+        r, g = onp.asarray(r), onp.asarray(g)
+        assert r.shape == g.shape and r.dtype == g.dtype
+        if exact or not onp.issubdtype(r.dtype, onp.floating):
+            assert onp.array_equal(r, g), (r, g)
+        else:
+            assert_almost_equal(r, g, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# mechanics: defer, flush points, cap, cache
+# ---------------------------------------------------------------------------
+
+def test_defer_returns_pending_and_flushes_on_asnumpy():
+    x = nd.array(onp.arange(12, dtype="float32").reshape(3, 4))
+    with bulking.bulk_scope(True):
+        y = x + 1.0
+        assert type(y._chunk.array) is bulking.PendingArray
+        # metadata inspection must not force a flush
+        assert y.shape == (3, 4)
+        assert y.dtype == onp.float32
+        assert y.ndim == 2 and y.size == 12
+        assert type(y._chunk.array) is bulking.PendingArray
+        got = y.asnumpy()  # sync point
+        assert isinstance(y._chunk.array, jax.Array)
+    assert onp.array_equal(got, onp.arange(12, dtype="float32").reshape(3, 4) + 1)
+
+
+def test_single_op_segment_is_bit_identical():
+    # one-op segments have no cross-op fusion: results must be exact
+    x = nd.array(onp.random.RandomState(3).rand(16, 16).astype("float32"))
+    def one():
+        with bulking.bulk_scope(False):
+            pass
+        return nd.sigmoid(x).asnumpy()
+    ref, got = run_both(one)
+    assert onp.array_equal(ref, got)
+
+
+def test_sync_points_item_bool_float_wait():
+    x = nd.array([2.0])
+    with bulking.bulk_scope(True):
+        assert float(x * 3.0) == 6.0
+        assert bool((x - 1.0) > 0.5)
+        assert (x + 1.0).item() == 3.0
+        y = x * 10.0
+        y.wait_to_read()
+        assert isinstance(y._chunk.array, jax.Array)
+
+
+def test_segment_cap_flush(monkeypatch):
+    monkeypatch.setenv("MXNET_EXEC_BULK_MAX_OPS", "3")
+    profiler.reset_bulk_stats()
+    x = nd.ones((4,))
+    with bulking.bulk_scope(True):
+        w = x
+        for _ in range(7):
+            w = w + 1.0
+        out = w.asnumpy()
+    assert onp.array_equal(out, onp.full((4,), 8.0, "float32"))
+    s = profiler.bulk_stats(reset=True)
+    assert s["segments_flushed"] == 3
+    assert s["ops_per_segment"] == {3: 2, 1: 1}
+    assert s["ops_bulked"] == 7
+
+
+def test_trace_cache_steady_state_and_clear():
+    registry.clear_caches()
+    x = nd.array(onp.random.RandomState(0).rand(8, 8).astype("float32"))
+
+    def chain():
+        with bulking.bulk_scope(True):
+            return (nd.relu(x * 2.0) + 1.0).asnumpy()
+
+    profiler.reset_bulk_stats()
+    a, b = chain(), chain()
+    assert onp.array_equal(a, b)
+    s = profiler.bulk_stats(reset=True)
+    assert s["segments_flushed"] == 2
+    assert s["trace_cache_misses"] == 1 and s["trace_cache_hits"] == 1
+    assert registry.cache_stats()["bulk_trace_entries"] >= 1
+    registry.clear_caches()
+    assert registry.cache_stats()["bulk_trace_entries"] == 0
+    # after a clear the next flush recompiles and still computes correctly
+    assert onp.array_equal(chain(), a)
+
+
+def test_counters_prove_bulking(monkeypatch):
+    # acceptance: a 50-op chain shows fewer launches than ops and
+    # ops/segment > 5 via the profiler counters
+    x = nd.ones((8, 8))
+    profiler.reset_bulk_stats()
+    with bulking.bulk_scope(True):
+        w = x
+        for _ in range(50):
+            w = w + 1.0
+        w.wait_to_read()
+    s = profiler.bulk_stats(reset=True)
+    assert s["ops_bulked"] == 50
+    assert s["segments_flushed"] < 50
+    assert s["ops_per_segment_mean"] > 5
+
+
+def test_bulking_off_is_todays_path():
+    profiler.reset_bulk_stats()
+    with bulking.bulk_scope(False):
+        x = nd.ones((4,))
+        y = x + 1.0
+        assert isinstance(y._chunk.array, jax.Array)
+    s = profiler.bulk_stats(reset=True)
+    assert s["segments_flushed"] == 0 and s["ops_bulked"] == 0
+    assert s["eager_dispatches"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# correctness: mutation, views, non-jittable ops, errors
+# ---------------------------------------------------------------------------
+
+def test_inplace_mutation_after_defer_does_not_change_node():
+    with bulking.bulk_scope(True):
+        a = nd.ones((4,))
+        b = a + 1.0          # captures a's current (immutable) value
+        a += 10.0            # swaps a new array into a's chunk
+        assert b.asnumpy().tolist() == [2.0] * 4
+        assert a.asnumpy().tolist() == [11.0] * 4
+
+
+def test_setitem_and_views_on_pending():
+    def fn():
+        x = nd.array(onp.arange(16, dtype="float32").reshape(4, 4))
+        y = x * 2.0
+        y[1] = -1.0            # in-place write on a pending value
+        v = y[2:4]             # basic-index view
+        z = v + 1.0
+        y2 = (x + 1.0).reshape((2, 8))   # reshape view of a pending
+        return y.asnumpy(), z.asnumpy(), y2.asnumpy()
+    assert_mode_parity(fn)
+
+
+def test_non_jittable_op_consumes_pending():
+    def fn():
+        x = nd.array([1.0, -2.0, 3.0, -4.0])
+        y = x * 2.0                       # deferred under bulking
+        m = nd.boolean_mask(y, y > 0.0)   # jittable=False: sync point
+        return m.asnumpy()
+    assert_mode_parity(fn)
+
+
+def test_operator_suite_parity():
+    # representative battery over the test_operators.py surface, run in
+    # both modes (elementwise, reductions, linalg, nn, shape, indexing)
+    rng = onp.random.RandomState(7)
+    a_np = rng.rand(8, 8).astype("float32")
+    b_np = rng.rand(8, 8).astype("float32")
+
+    def fn():
+        a, b = nd.array(a_np), nd.array(b_np)
+        outs = []
+        outs.append((a + b) * (a - b) / (b + 1.0))
+        outs.append(nd.relu(a - 0.5) + nd.sigmoid(b) * nd.tanh(a))
+        outs.append(nd.exp(a * 0.1).log() + nd.sqrt(b))
+        outs.append(nd.dot(a, b).sum(axis=1))
+        outs.append(nd.softmax(a, axis=-1).mean(axis=0))
+        outs.append(a.transpose().reshape((4, 16)).max(axis=0))
+        outs.append(nd.concat(a, b, dim=1).sum())
+        outs.append((a > b).sum())           # comparison chain
+        outs.append(a.argmax(axis=1))        # integer output
+        outs.append(nd.one_hot(a.argmax(axis=1), 8).sum(axis=0))
+        outs.append(nd.where(a > b, a, b).min())
+        return [o.asnumpy() for o in outs]
+    assert_mode_parity(fn)
+
+
+def test_ndarray_suite_parity():
+    # representative battery over the test_ndarray.py surface
+    def fn():
+        a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+        b = nd.array([10.0, 20.0])
+        outs = []
+        outs.append(a + b)
+        outs.append(a - 1)
+        outs.append(2 * a)
+        outs.append(a / b)
+        outs.append(a ** 2)
+        outs.append(-a)
+        c = a.copy()
+        c += 1.0
+        outs.append(c)
+        d = (a * 3.0)
+        d[0, 1] = 99.0
+        outs.append(d)
+        e = a.astype("float64").astype("float32")
+        outs.append(e.flatten())
+        outs.append((a < b).astype("int32"))
+        return [o.asnumpy() for o in outs]
+    assert_mode_parity(fn)
+
+
+def test_random_ops_parity():
+    # keyed sampling is deterministic: same seed, both modes
+    def fn():
+        mx.random.seed(42)
+        u = nd.random.uniform(shape=(4, 4))
+        n = nd.random.normal(shape=(4, 4))
+        return (u + n).asnumpy()
+    assert_mode_parity(fn)
+
+
+def test_flush_error_is_sticky_and_rethrows():
+    calls = {"boom": False}
+
+    @registry.register("_test_bulking_boom")
+    def _boom(x):
+        if calls["boom"]:
+            raise RuntimeError("bulk boom")
+        return x + 1.0
+
+    try:
+        with bulking.bulk_scope(True):
+            x = nd.ones((2,))
+            y = registry.invoke("_test_bulking_boom", x)
+            z = y * 2.0
+            assert type(y._chunk.array) is bulking.PendingArray
+            calls["boom"] = True  # the deferred trace now raises at flush
+            with pytest.raises(RuntimeError, match="bulk boom"):
+                y.asnumpy()
+            # every placeholder of the failed segment rethrows (sticky,
+            # like engine var exceptions at wait_for_var)
+            with pytest.raises(RuntimeError, match="bulk boom"):
+                z.asnumpy()
+            # a NEW op consuming a failed placeholder rethrows too
+            # instead of propagating a half-settled segment
+            with pytest.raises(RuntimeError, match="bulk boom"):
+                (z * 3.0).asnumpy()
+    finally:
+        registry._OPS.pop("_test_bulking_boom", None)
+        registry.clear_caches()
+
+
+# ---------------------------------------------------------------------------
+# autograd boundary
+# ---------------------------------------------------------------------------
+
+def test_autograd_entry_flushes_segment():
+    with bulking.bulk_scope(True):
+        x = nd.ones((3,))
+        y = x + 1.0
+        assert type(y._chunk.array) is bulking.PendingArray
+        with autograd.record():
+            pass
+        assert y._chunk.array._value is not None
+
+
+def test_autograd_parity_with_prelude():
+    # deferred pre-record computation feeding a recorded loss: gradients
+    # must match the unbulked path
+    def fn():
+        p = nd.array([1.0, 2.0, 3.0])
+        p.attach_grad()
+        pre = p * 2.0 + 1.0   # deferred under bulking, constant on tape
+        with autograd.record():
+            loss = (pre * p).sum()
+        loss.backward()
+        return p.grad.asnumpy()
+    assert_mode_parity(fn)
+
+
+def test_recording_ops_are_never_deferred():
+    with bulking.bulk_scope(True):
+        x = nd.ones((3,))
+        x.attach_grad()
+        with autograd.record():
+            y = x * 4.0
+            assert isinstance(y._chunk.array, jax.Array)
+        y.backward()
+    assert onp.array_equal(x.grad.asnumpy(), onp.full((3,), 4.0, "float32"))
+
+
+# ---------------------------------------------------------------------------
+# engine semantics under bulking (satellite: stress test)
+# ---------------------------------------------------------------------------
+
+def test_engine_push_with_bulked_ops_ordering_and_sticky_exception():
+    eng = engine_mod.get_engine()
+    with bulking.bulk_scope(True):
+        x = nd.ones((16,))
+        y = x * 2.0                       # deferred
+        var = y._chunk.var
+        v0 = var.version
+        results = []
+
+        # engine reads force cross-thread segment resolution; they must
+        # all observe the pre-write value
+        readers = [eng.push(lambda: results.append(float(y.asnumpy().sum())),
+                            const_vars=(var,), name="bulk-read")
+                   for _ in range(8)]
+
+        def write():
+            y._set_data(y.data * 0 + 7.0)
+
+        writer = eng.push(write, mutable_vars=(var,), name="bulk-write")
+        for op in readers:
+            op.done.wait()
+        writer.done.wait()
+    assert results == [32.0] * 8
+    # write ordering observable through the version counter: the chunk
+    # write bumps it, and the engine bumps it again on write release
+    assert var.version > v0
+    assert float(y.asnumpy().sum()) == 7.0 * 16
+
+    # sticky exception: a failing engine op on the bulked array's var
+    # rethrows at wait_for_var (threaded_engine.cc:422 contract)
+    def fail():
+        raise ValueError("engine boom")
+
+    fop = eng.push(fail, mutable_vars=(var,), name="bulk-fail")
+    fop.done.wait()
+    with pytest.raises(ValueError, match="engine boom"):
+        eng.wait_for_var(var)
+
+
+def test_engine_bulking_stress_interleaved():
+    # many rounds of: bulked chain -> concurrent engine reads + one
+    # serialized write per round; version ordering must be monotonic and
+    # values consistent per round
+    eng = engine_mod.get_engine()
+    versions = []
+    with bulking.bulk_scope(True):
+        acc = nd.ones((32,))
+        for round_i in range(5):
+            w = acc
+            for _ in range(6):
+                w = w + 1.0              # deferred chain
+            var = w._chunk.var
+            seen = []
+            readers = [eng.push(
+                lambda w=w, seen=seen: seen.append(float(w.asnumpy()[0])),
+                const_vars=(var,)) for _ in range(4)]
+            done = threading.Event()
+
+            def write(w=w, done=done):
+                w._set_data(w.data + 0.5)
+                done.set()
+
+            eng.push(write, mutable_vars=(var,))
+            for op in readers:
+                op.done.wait()
+            done.wait()
+            assert len(set(seen)) == 1   # all readers saw one version
+            versions.append(var.version)
+            acc = w
+        final = acc.asnumpy()
+    assert final[0] == pytest.approx(1.0 + 5 * 6 + 5 * 0.5)
+    assert all(v >= 1 for v in versions)
+
+
+# ---------------------------------------------------------------------------
+# satellite: CachedOp signature includes param shapes/dtypes
+# ---------------------------------------------------------------------------
+
+def test_cachedop_signature_keys_on_param_shape_dtype():
+    from incubator_mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize()
+    net.hybridize()
+    x = nd.ones((2, 8))
+    net(x)
+    co = net._cached_op
+    assert co is not None and len(co._cache) == 1
+    # a recast parameter must NOT silently reuse the stale executable
+    # entry (the old signature ignored param shapes/dtypes)
+    net.weight.cast("float16")
+    net.bias.cast("float16")
+    net(x)
+    assert len(co._cache) == 2
