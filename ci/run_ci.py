@@ -11,6 +11,10 @@ Stages (each isolated, failures collected, nonzero exit if any fail):
              compared, fails on numeric divergence beyond ULP noise
   multichip  __graft_entry__.dryrun_multichip on a virtual 8-device mesh
   bench      bench.py CPU fallback emits a well-formed JSON line
+  chaos      kvstore + checkpoint test subset re-run under a fixed
+             MXNET_FAULT_SPEC (deterministic transient faults on the
+             PS transport, delays on checkpoint writes) so every PR
+             exercises the retry/dedup/integrity paths
 
 Usage:
   python ci/run_ci.py                  # everything
@@ -121,6 +125,30 @@ def stage_bulking(args):
                   f"max {res['max_ulp_diff']:.1f} ulp")
 
 
+# Fixed chaos spec (docs/fault_tolerance.md): seeded so every run
+# replays the same fault schedule — a chaos failure bisects like any
+# other deterministic test failure.
+CHAOS_SPEC = ("kvstore.send:error:p=0.05:seed=7,"
+              "kvstore.recv:error:p=0.05:seed=11,"
+              "checkpoint.write:delay:ms=20")
+
+
+def stage_chaos(args):
+    """Fault-tolerance sweep: the kvstore + checkpoint subset must pass
+    with deterministic transient faults injected on the PS transport
+    and checkpoint writes (client retries + push dedup + CRC paths)."""
+    # yarn/sge shim tests exercise scheduler CLIs, not fault paths
+    proc = sh([sys.executable, "-m", "pytest", "-q",
+               "tests/test_fault.py", "tests/test_distributed.py",
+               "tests/test_checkpoint.py",
+               "-m", "not slow", "-k", "not yarn and not sge",
+               "--continue-on-collection-errors",
+               "-p", "no:cacheprovider"],
+              timeout=1800, env={"MXNET_FAULT_SPEC": CHAOS_SPEC})
+    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+    return proc.returncode == 0, f"spec={CHAOS_SPEC!r}: {tail}"
+
+
 def stage_multichip(args):
     code = "import __graft_entry__ as g; g.dryrun_multichip(8)"
     proc = sh([sys.executable, "-c", code], timeout=1200)
@@ -140,8 +168,8 @@ def stage_bench(args):
 
 STAGES = {"build": stage_build, "sanity": stage_sanity,
           "unit": stage_unit, "slow": stage_slow,
-          "bulking": stage_bulking, "multichip": stage_multichip,
-          "bench": stage_bench}
+          "bulking": stage_bulking, "chaos": stage_chaos,
+          "multichip": stage_multichip, "bench": stage_bench}
 
 
 def main(argv=None):

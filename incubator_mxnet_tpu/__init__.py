@@ -93,6 +93,7 @@ _install_fork_handlers()
 from . import base
 from .base import MXNetError
 from . import error
+from . import fault
 from . import libinfo
 from . import log
 from . import checkpoint

@@ -19,16 +19,30 @@ commit barriers belong to the launcher).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import re
 import shutil
 import threading
+import zlib
 
 import numpy as onp
+
+from . import fault
+from .error import CheckpointCorruptError
 
 __all__ = ["AsyncCheckpointManager"]
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+_TMP_RE = re.compile(r"^step_(\d+)\.tmp$")
+
+_log = logging.getLogger("incubator_mxnet_tpu.checkpoint")
+
+
+def _crc_of(host) -> int:
+    """CRC32 of an array's payload bytes — the integrity identity each
+    shard records in the index and re-proves at load."""
+    return zlib.crc32(onp.ascontiguousarray(host).tobytes()) & 0xFFFFFFFF
 
 
 def _safe(name: str) -> str:
@@ -69,6 +83,34 @@ class AsyncCheckpointManager:
         os.makedirs(self.directory, exist_ok=True)
         self._thread = None
         self._error = None
+        self._cleanup_stale_tmp()
+
+    _TMP_STALE_S = 15 * 60
+
+    def _cleanup_stale_tmp(self):
+        """Remove ``step_N.tmp`` staging dirs left by a crashed save.
+
+        A live writer touches its staging dir continuously, so only
+        dirs whose newest mtime is older than ``_TMP_STALE_S`` are
+        removed — another manager's in-flight save into the same
+        directory must not be torn out from under it."""
+        import time
+        now = time.time()
+        for entry in os.listdir(self.directory):
+            if not _TMP_RE.match(entry):
+                continue
+            p = os.path.join(self.directory, entry)
+            try:
+                newest = max([os.path.getmtime(p)]
+                             + [os.path.getmtime(os.path.join(p, f))
+                                for f in os.listdir(p)])
+            except OSError:
+                continue   # racing with its writer or already gone
+            if now - newest > self._TMP_STALE_S:
+                _log.warning("checkpoint: removing stale staging dir %s "
+                             "(crashed save, idle %.0fs)", entry,
+                             now - newest)
+                shutil.rmtree(p, ignore_errors=True)
 
     # ------------------------------------------------------------- save
     def save(self, step, tree, wait=False):
@@ -108,10 +150,12 @@ class AsyncCheckpointManager:
                         if getattr(sh, "replica_id", 0) != 0:
                             continue  # one copy per unique slice
                         fn = f"{fname}.p{proc}_s{k}.npy"
-                        onp.save(os.path.join(tmp, fn),
-                                 onp.asarray(sh.data))
+                        host = onp.asarray(sh.data)
+                        fault.inject("checkpoint.write", detail=fn)
+                        onp.save(os.path.join(tmp, fn), host)
                         entries.append({
                             "file": fn,
+                            "crc32": _crc_of(host),
                             "index": [[sl.start or 0,
                                        sl.stop if sl.stop is not None
                                        else dim]
@@ -125,12 +169,14 @@ class AsyncCheckpointManager:
                     fn = f"{fname}.npy" if single else f"{fname}.p{proc}.npy"
                     if single or proc == 0:  # replicated: one copy
                         host = onp.asarray(arr)
+                        fault.inject("checkpoint.write", detail=fn)
                         onp.save(os.path.join(tmp, fn), host)
                         index[name] = {"shape": list(host.shape),
                                        "dtype": str(host.dtype
                                                     if host.dtype.kind != "V"
                                                     else onp.dtype(arr.dtype)),
-                                       "file": fn}
+                                       "file": fn,
+                                       "crc32": _crc_of(host)}
             # the per-process index is written LAST: its presence marks
             # this process's contribution complete
             idx_name = "index.json" if single else f"index.{proc}.json"
@@ -187,12 +233,51 @@ class AsyncCheckpointManager:
     def restore(self, step=None):
         """Reassemble a checkpoint into {name: numpy array} (global
         arrays; re-shard with jax.device_put(..., sharding) to resume
-        on a mesh)."""
-        if step is None:
-            step = self.latest_step()
-        if step is None:
+        on a mesh).
+
+        Every shard listed with a ``crc32`` is re-verified against its
+        loaded bytes; a mismatch, truncated file, or missing shard
+        raises :class:`~incubator_mxnet_tpu.error.CheckpointCorruptError`
+        — a damaged checkpoint never loads silently.  With ``step=None``
+        the NEWEST complete-and-valid checkpoint wins: corrupt steps
+        are logged and skipped (crash-restart must not die on the very
+        damage it is recovering from); an explicit ``step`` is strict."""
+        if step is not None:
+            return self._restore_step(step)
+        steps = self.all_steps()
+        if not steps:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        last_err = None
+        for s in reversed(steps):
+            try:
+                return self._restore_step(s)
+            except CheckpointCorruptError as e:
+                _log.warning("checkpoint step %d is damaged (%s); "
+                             "falling back to the previous one", s, e)
+                last_err = e
+        raise CheckpointCorruptError(
+            f"no valid checkpoint in {self.directory}: all of steps "
+            f"{steps} failed verification") from last_err
+
+    def _restore_step(self, step):
         d = os.path.join(self.directory, f"step_{int(step):08d}")
+        if not os.path.isdir(d):
+            # absence is not corruption: resume logic starts fresh on
+            # FileNotFoundError but must crash loudly on real damage
+            raise FileNotFoundError(
+                f"no checkpoint for step {step} in {self.directory}")
+        try:
+            return self._load_dir(d, step)
+        except CheckpointCorruptError:
+            raise
+        except (OSError, ValueError, EOFError, KeyError) as e:
+            # onp.load on a truncated .npy raises ValueError/EOFError;
+            # a torn index raises KeyError/JSONDecodeError (⊂ ValueError)
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} failed to load: "
+                f"{type(e).__name__}: {e}") from e
+
+    def _load_dir(self, d, step):
         merged = {}
         if os.path.exists(os.path.join(d, "index.json")):
             with open(os.path.join(d, "index.json")) as f:
@@ -217,21 +302,33 @@ class AsyncCheckpointManager:
                     return block.view(dtype)
                 return block
 
+            def _verified(entry, what):
+                block = onp.load(os.path.join(d, entry["file"]))
+                want = entry.get("crc32")
+                # pre-CRC checkpoints stay loadable (no integrity info)
+                if want is not None and _crc_of(block) != want:
+                    raise CheckpointCorruptError(
+                        f"checkpoint step {step}: CRC mismatch for {what} "
+                        f"({entry['file']}): recorded {want:#010x}, file "
+                        f"has {_crc_of(block):#010x} (bit rot or a torn "
+                        "write)")
+                return _typed(block)
+
             if "shards" in meta:
                 full = onp.zeros(meta["shape"], dtype)
                 covered = 0
                 for entry in meta["shards"]:
-                    block = _typed(onp.load(os.path.join(d, entry["file"])))
+                    block = _verified(entry, f"shard of {name!r}")
                     sl = tuple(slice(a, b) for a, b in entry["index"])
                     full[sl] = block
                     covered += int(block.size)
                 if covered < int(onp.prod(meta["shape"])):
-                    raise RuntimeError(
+                    raise CheckpointCorruptError(
                         f"checkpoint step {step} is incomplete for "
                         f"{name!r}: {covered} of "
                         f"{int(onp.prod(meta['shape']))} elements present "
                         "(a writer process likely died mid-save)")
                 out[name] = full
             else:
-                out[name] = _typed(onp.load(os.path.join(d, meta["file"])))
+                out[name] = _verified(meta, repr(name))
         return out

@@ -29,6 +29,7 @@ import threading
 import traceback
 from collections import deque
 
+from . import fault
 from .base import get_env
 
 __all__ = ["Var", "Engine", "NaiveEngine", "ThreadedEngine", "get_engine", "set_engine"]
@@ -110,6 +111,7 @@ class NaiveEngine(Engine):
     """Synchronous engine: run on push (reference naive_engine.cc:51)."""
 
     def push(self, fn, const_vars=(), mutable_vars=(), name="op"):
+        fault.inject("engine.push", detail=name)
         op = _OpBlock(fn, tuple(const_vars), tuple(mutable_vars), name)
         try:
             fn()
@@ -153,6 +155,7 @@ class ThreadedEngine(Engine):
 
     # -- dependency bookkeeping ------------------------------------------
     def push(self, fn, const_vars=(), mutable_vars=(), name="op"):
+        fault.inject("engine.push", detail=name)
         const_vars = tuple(const_vars)
         mutable_vars = tuple(mutable_vars)
         dup = set(const_vars) & set(mutable_vars)
@@ -352,6 +355,7 @@ class NativeEngine(Engine):
         return arr
 
     def push(self, fn, const_vars=(), mutable_vars=(), name="op", priority=0):
+        fault.inject("engine.push", detail=name)
         const_vars = tuple(const_vars)
         mutable_vars = tuple(mutable_vars)
         dup = set(id(v) for v in const_vars) & set(id(v) for v in mutable_vars)

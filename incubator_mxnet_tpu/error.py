@@ -13,6 +13,7 @@ from .base import MXNetError
 
 __all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
            "TypeError", "AttributeError", "NotImplementedError",
+           "PSTimeoutError", "PSConnectionError", "CheckpointCorruptError",
            "register_error", "get_error_class"]
 
 _ERROR_REGISTRY = {}
@@ -59,3 +60,26 @@ class AttributeError(MXNetError, _bi.AttributeError):
 @register_error
 class NotImplementedError(MXNetError, _bi.NotImplementedError):
     """Feature not implemented."""
+
+
+@register_error
+class PSTimeoutError(MXNetError, _bi.TimeoutError):
+    """A parameter-server operation did not complete within its budget
+    (bounded sync-pull/barrier wait, or client retries exhausted).  The
+    message names the stalled command/key/round so a hung job is
+    diagnosable from the traceback alone.  Also catchable as builtin
+    ``TimeoutError``."""
+
+
+@register_error
+class PSConnectionError(MXNetError, _bi.ConnectionError):
+    """The parameter-server transport failed and could not be
+    re-established (reconnect attempts exhausted).  Also catchable as
+    builtin ``ConnectionError``."""
+
+
+@register_error
+class CheckpointCorruptError(MXNetError):
+    """A checkpoint shard failed integrity verification (CRC mismatch,
+    truncated file, or missing shards) — the checkpoint must not load
+    silently."""

@@ -1,0 +1,257 @@
+"""Deterministic fault injection + shared retry machinery.
+
+The reference framework's resilience story lives in ps-lite (van
+resends, server retry queues) and in checkpoint-restart
+(event_handler.py); neither is testable without a way to *make* faults
+happen on demand.  This module is that harness: a registry of named
+injection points threaded through the distributed and persistence
+subsystems, configured entirely from the environment so CI can run the
+same test suite with and without chaos.
+
+Injection points (each named where the fault physically occurs):
+
+* ``kvstore.send``      — worker→server request about to hit the wire
+* ``kvstore.recv``      — worker waiting on the server response
+* ``engine.push``       — a closure being scheduled on the engine
+* ``checkpoint.write``  — a shard file about to be written
+* ``io.next_batch``     — the data pipeline handing out a batch
+
+Spec grammar (``MXNET_FAULT_SPEC``)::
+
+    spec    := entry (',' entry)*
+    entry   := point ':' kind (':' key '=' value)*
+    kind    := 'error' | 'delay'
+    keys    := p      fire probability          (default 1.0)
+               seed   per-point RNG seed        (default 0)
+               ms     delay duration, ms        (delay only, default 100)
+               n      max total fires           (default unlimited)
+               after  calls to skip first       (default 0)
+               class  'transient' | 'permanent' (error only, default
+                      transient)
+
+Example::
+
+    MXNET_FAULT_SPEC='kvstore.send:error:p=0.05:seed=7,checkpoint.write:delay:ms=200'
+
+Every point draws from its **own** ``random.Random(seed)`` so whether
+call *k* at one point fires never depends on traffic at another point —
+a chaos run is replayable from the spec alone.
+
+Error taxonomy: :class:`TransientFault` derives from
+``ConnectionError`` (the canonical retryable transport failure — the
+PSClient reconnect path and :func:`retry` treat it like a real broken
+socket); :class:`PermanentFault` derives from ``RuntimeError`` only and
+must surface to the caller.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from .base import get_env
+
+__all__ = [
+    "FaultInjected", "TransientFault", "PermanentFault",
+    "parse_spec", "configure", "reset", "inject", "active_points",
+    "stats", "retry",
+]
+
+POINTS = ("kvstore.send", "kvstore.recv", "engine.push",
+          "checkpoint.write", "io.next_batch")
+
+
+class FaultInjected(Exception):
+    """Marker base for injected faults (``isinstance`` lets handlers
+    distinguish harness faults from organic ones in assertions)."""
+
+
+class TransientFault(FaultInjected, ConnectionError):
+    """Injected fault the caller is expected to retry away."""
+
+
+class PermanentFault(FaultInjected, RuntimeError):
+    """Injected fault that must surface: retry layers re-raise it."""
+
+
+class _Point:
+    __slots__ = ("name", "kind", "p", "seed", "ms", "limit", "after",
+                 "permanent", "calls", "fired", "_rng", "_lock")
+
+    def __init__(self, name, kind, p=1.0, seed=0, ms=100.0, limit=None,
+                 after=0, permanent=False):
+        self.name = name
+        self.kind = kind
+        self.p = float(p)
+        self.seed = int(seed)
+        self.ms = float(ms)
+        self.limit = limit
+        self.after = int(after)
+        self.permanent = permanent
+        self.calls = 0
+        self.fired = 0
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+
+    def should_fire(self):
+        with self._lock:
+            self.calls += 1
+            if self.calls <= self.after:
+                return False
+            if self.limit is not None and self.fired >= self.limit:
+                return False
+            if self.p < 1.0 and self._rng.random() >= self.p:
+                return False
+            self.fired += 1
+            return True
+
+
+def parse_spec(spec: str) -> dict:
+    """Parse a ``MXNET_FAULT_SPEC`` string into {point: _Point}."""
+    points = {}
+    for raw in filter(None, (e.strip() for e in spec.split(","))):
+        parts = raw.split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                f"fault spec entry {raw!r}: want 'point:kind[:k=v...]'")
+        name, kind = parts[0], parts[1]
+        if name not in POINTS:
+            raise ValueError(
+                f"fault spec names unknown point {name!r} (known: "
+                f"{', '.join(POINTS)})")
+        if kind not in ("error", "delay"):
+            raise ValueError(
+                f"fault spec entry {raw!r}: kind must be 'error' or "
+                f"'delay', got {kind!r}")
+        kw = {}
+        for opt in parts[2:]:
+            k, sep, v = opt.partition("=")
+            if not sep:
+                raise ValueError(
+                    f"fault spec option {opt!r} in {raw!r}: want 'k=v'")
+            if k == "p":
+                kw["p"] = float(v)
+            elif k == "seed":
+                kw["seed"] = int(v)
+            elif k == "ms":
+                kw["ms"] = float(v)
+            elif k == "n":
+                kw["limit"] = int(v)
+            elif k == "after":
+                kw["after"] = int(v)
+            elif k == "class":
+                if v not in ("transient", "permanent"):
+                    raise ValueError(
+                        f"fault class must be transient|permanent, got {v!r}")
+                kw["permanent"] = v == "permanent"
+            else:
+                raise ValueError(
+                    f"unknown fault spec option {k!r} in {raw!r}")
+        points[name] = _Point(name, kind, **kw)
+    return points
+
+
+_lock = threading.Lock()
+_points: dict | None = None   # None = env not consulted yet
+
+
+def _active() -> dict:
+    global _points
+    if _points is None:
+        with _lock:
+            if _points is None:
+                spec = get_env("MXNET_FAULT_SPEC", "")
+                _points = parse_spec(spec) if spec else {}
+    return _points
+
+
+def configure(spec: str | None):
+    """Install a spec programmatically (tests); overrides the env."""
+    global _points
+    with _lock:
+        _points = parse_spec(spec) if spec else {}
+
+
+def reset():
+    """Forget any configuration; next :func:`inject` re-reads the env."""
+    global _points
+    with _lock:
+        _points = None
+
+
+def active_points() -> dict:
+    """The live {point: _Point} table (parsing the env on first use)."""
+    return dict(_active())
+
+
+def stats() -> dict:
+    """Per-point {name: (calls, fired)} counters for assertions."""
+    return {p.name: (p.calls, p.fired) for p in _active().values()}
+
+
+def inject(point: str, detail: str = ""):
+    """Fire the named injection point, if configured.
+
+    Near-zero cost when no spec is active — the hot paths (engine push,
+    batch iteration) call this unconditionally.
+    """
+    table = _active()
+    if not table:
+        return
+    pt = table.get(point)
+    if pt is None or not pt.should_fire():
+        return
+    if pt.kind == "delay":
+        time.sleep(pt.ms / 1000.0)
+        return
+    where = f"{point}" + (f" [{detail}]" if detail else "")
+    if pt.permanent:
+        raise PermanentFault(
+            f"injected permanent fault at {where} (fire #{pt.fired})")
+    raise TransientFault(
+        f"injected transient fault at {where} (fire #{pt.fired})")
+
+
+# ---------------------------------------------------------------------------
+# shared retry helper
+# ---------------------------------------------------------------------------
+
+def retry(fn, max_attempts=None, backoff=0.05, max_backoff=2.0,
+          jitter=0.5, retryable=(ConnectionError, TimeoutError),
+          rng=None, on_retry=None):
+    """Run ``fn()`` with exponential backoff on retryable failures.
+
+    ``backoff * 2**k`` seconds between attempts (capped at
+    ``max_backoff``), each scaled by a uniform ``[1-jitter, 1+jitter]``
+    factor so a fleet of workers does not thunder-herd a recovering
+    server.  :class:`PermanentFault` is never retried regardless of the
+    ``retryable`` classes (it subclasses RuntimeError, but an explicit
+    ``retryable=(RuntimeError,)`` must not swallow it either).  The
+    last failure is re-raised once attempts are exhausted.
+
+    ``on_retry(attempt, exc, sleep_s)`` runs before each sleep — the
+    PSClient uses it to drop and re-establish its connection so a
+    desynced stream is never reused.
+    """
+    attempts = int(max_attempts if max_attempts is not None
+                   else get_env("MXNET_KVSTORE_RETRIES", 5, int))
+    if attempts < 1:
+        raise ValueError(f"max_attempts must be >= 1, got {attempts}")
+    rng = rng or random
+    last = None
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except PermanentFault:
+            raise
+        except retryable as e:
+            last = e
+            if attempt == attempts:
+                break
+            sleep_s = min(backoff * (2 ** (attempt - 1)), max_backoff)
+            if jitter:
+                sleep_s *= 1.0 + jitter * (2.0 * rng.random() - 1.0)
+            if on_retry is not None:
+                on_retry(attempt, e, sleep_s)
+            time.sleep(sleep_s)
+    raise last

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import numpy as onp
 
+from .. import fault
 from .. import ndarray as nd
 from ..ndarray import NDArray
 
@@ -56,6 +57,9 @@ class DataIter:
         raise StopIteration
 
     def __next__(self):
+        # one injection point covers every iterator: chaos runs can
+        # stall (delay) or break (error) the input pipeline here
+        fault.inject("io.next_batch", detail=type(self).__name__)
         return self.next()
 
     @property

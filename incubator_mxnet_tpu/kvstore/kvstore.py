@@ -27,6 +27,8 @@ import pickle
 import jax
 import jax.numpy as jnp
 
+from ..base import get_env
+from ..error import PSTimeoutError
 from ..ndarray import NDArray
 from .. import optimizer as opt_mod
 from .base import KVStoreBase, register
@@ -322,6 +324,14 @@ class DistKVStore(_BaseStore):
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("kvstore_barrier")
 
+    def check_health(self):
+        """Probe every parameter server (``heartbeat``).  Returns a list
+        of per-server vitals dicts; a dead server raises
+        :class:`~incubator_mxnet_tpu.error.PSTimeoutError` naming it
+        (reference role: ps-lite Postoffice heartbeat/Van monitoring).
+        Collective transport has no servers: returns []."""
+        return [c.heartbeat() for c in self._clients]
+
 
 def _onp_of(v):
     import numpy as onp
@@ -457,17 +467,19 @@ class P3KVStore(DistKVStore):
         keys = key if isinstance(key, (list, tuple)) else [key]
         outs = out if isinstance(out, (list, tuple)) else [out] * len(keys)
         results = []
+        timeout = get_env("MXNET_KVSTORE_TIMEOUT", 60.0, float)
         for k, o in zip(keys, outs):
             with self._cv:
                 flushed = self._cv.wait_for(
-                    lambda: self._pending.get(k, 0) == 0, timeout=60)
+                    lambda: self._pending.get(k, 0) == 0, timeout=timeout)
                 err = getattr(self, "_sender_error", None)
             if err is not None:
                 raise RuntimeError(
                     f"p3 background sender failed: {err}") from err
             if not flushed:
-                raise TimeoutError(
-                    f"p3 pull: pushes for key {k!r} not flushed in 60s")
+                raise PSTimeoutError(
+                    f"p3 pull: {self._pending.get(k, 0)} pushed slice(s) "
+                    f"for key {k!r} not flushed in {timeout:.0f}s")
             shape = self._shapes[k]
             parts = []
             idx = 0

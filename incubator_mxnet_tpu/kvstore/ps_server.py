@@ -19,20 +19,57 @@ gRPC to keep the runtime dependency-free.  The server is pure
 CPU/numpy: it never touches an accelerator, mirroring the reference
 where servers are CPU processes.
 
+Fault tolerance (docs/fault_tolerance.md):
+
+* every push carries a client session id + per-key sequence number, and
+  the server remembers the last sequence applied per (session, key) —
+  a retried push (response lost on the wire) is acknowledged without
+  re-merging, so sync aggregation stays exactly-once (the role of
+  ps-lite's per-customer timestamps);
+* sync ``pull`` and ``barrier`` waits are bounded by
+  ``MXNET_KVSTORE_TIMEOUT`` and surface a typed
+  :class:`~incubator_mxnet_tpu.error.PSTimeoutError` naming the stalled
+  key/round instead of hanging forever on a dead worker;
+* :class:`PSClient` owns reconnect: any transport failure mid-call
+  closes the socket (a half-read length-prefixed stream can never be
+  resynchronized) and retries the whole request against a fresh
+  connection with exponential backoff + jitter
+  (``MXNET_KVSTORE_RETRIES`` attempts);
+* ``heartbeat`` answers with server vitals for liveness probing;
+* a server restart can adopt the previous :class:`_State` (checkpointed
+  weights + dedup table), so recovery does not double-apply in-flight
+  retries.
+
 Wire protocol: request = (cmd, key, payload); response = (ok, payload).
-Commands: init, push, pull, set_optimizer, barrier, num_done, stop.
+Push payloads may be wrapped as ``{"__ps__": 1, "data": .., "sess": ..,
+"seq": ..}`` for dedup; bare arrays are accepted (no dedup).
+Commands: init, push, pull, set_optimizer, barrier, heartbeat, stop.
+Error responses carry ``"Kind: message"`` and are re-raised client-side
+as the registered error class (error.get_error_class).
 """
 from __future__ import annotations
 
+import logging
 import pickle
 import socket
 import socketserver
 import struct
 import threading
+import uuid
 
 import numpy as onp
 
+from .. import fault
+from ..base import get_env
+from ..error import PSTimeoutError, get_error_class
+
 __all__ = ["PSServer", "PSClient", "serve_forever"]
+
+_log = logging.getLogger("incubator_mxnet_tpu.kvstore.ps")
+
+
+def _timeout_s():
+    return get_env("MXNET_KVSTORE_TIMEOUT", 60.0, float)
 
 
 def _send_msg(sock, obj):
@@ -40,12 +77,18 @@ def _send_msg(sock, obj):
     sock.sendall(struct.pack("<Q", len(data)) + data)
 
 
+class _CleanClose(ConnectionError):
+    """Peer closed at a message boundary — an orderly disconnect."""
+
+
 def _recv_msg(sock):
     hdr = b""
     while len(hdr) < 8:
         chunk = sock.recv(8 - len(hdr))
         if not chunk:
-            raise ConnectionError("peer closed")
+            if not hdr:
+                raise _CleanClose("peer closed")
+            raise ConnectionError("peer closed mid-frame")
         hdr += chunk
     (n,) = struct.unpack("<Q", hdr)
     buf = bytearray()
@@ -66,11 +109,14 @@ class _State:
         self.store: dict = {}
         self.merge: dict = {}           # key -> (accum, count) for sync
         self.round_done: dict = {}      # key -> round counter
+        self.seen: dict = {}            # (session, key) -> last seq applied
+        self.barrier_seen: dict = {}    # session -> (seq, gen entered)
         self.updater = None
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
         self.barrier_count = 0
         self.barrier_gen = 0
+        self.wait_timeout = _timeout_s()
 
     def apply_update(self, key, grad):
         if self.updater is not None:
@@ -92,9 +138,11 @@ class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         st: _State = self.server.state  # type: ignore[attr-defined]
         sock = self.request
+        last = None
         try:
             while True:
                 cmd, key, payload = _recv_msg(sock)
+                last = (cmd, key)
                 if cmd == "stop":
                     _send_msg(sock, (True, None))
                     threading.Thread(
@@ -103,26 +151,60 @@ class _Handler(socketserver.BaseRequestHandler):
                 try:
                     resp = self._dispatch(st, cmd, key, payload)
                 except Exception as e:  # surfaced client-side as an error
-                    resp = (False, str(e))
+                    resp = (False, f"{type(e).__name__}: {e}")
                 _send_msg(sock, resp)
-        except (ConnectionError, OSError):
+        except _CleanClose:
+            return   # orderly disconnect between requests
+        except (ConnectionError, OSError) as e:
+            # The client vanished mid-call.  Server state is already
+            # consistent: an applied push whose ack was lost is recorded
+            # in st.seen, so the client's retry (on a new connection)
+            # will be acknowledged without re-merging.  Log — a silent
+            # return here is how half-counted rounds went undiagnosed.
+            if last is not None:
+                _log.warning(
+                    "ps handler: client %s dropped after %s %r (%s); "
+                    "state kept, retries will dedup", self.client_address,
+                    last[0], last[1], e)
             return
 
     @staticmethod
     def _dispatch(st: _State, cmd, key, payload):
         if cmd == "init":
             with st.lock:
-                if key not in st.store:
+                if key in st.store:
+                    have = st.store[key]
+                    want = onp.asarray(payload)
+                    if (tuple(have.shape) != tuple(want.shape)
+                            or have.dtype != want.dtype):
+                        raise ValueError(
+                            f"init of existing key {key!r} with "
+                            f"shape={tuple(want.shape)} dtype={want.dtype} "
+                            f"conflicts with stored shape="
+                            f"{tuple(have.shape)} dtype={have.dtype}")
+                else:
                     st.store[key] = onp.array(payload)
                     st.round_done[key] = 0
             return True, None
         if cmd == "push":
+            sess = seq = None
+            if isinstance(payload, dict) and payload.get("__ps__") == 1:
+                sess, seq = payload["sess"], payload["seq"]
+                payload = payload["data"]
             if st.mode == "async":
-                # reference async: apply immediately, no aggregation
                 with st.lock:
+                    if sess is not None:
+                        if seq <= st.seen.get((sess, key), -1):
+                            return True, None   # duplicate of applied push
+                        st.seen[(sess, key)] = seq
+                    # reference async: apply immediately, no aggregation
                     st.apply_update(key, payload)
                 return True, None
             with st.cv:
+                if sess is not None:
+                    if seq <= st.seen.get((sess, key), -1):
+                        return True, None       # retried push: already merged
+                    st.seen[(sess, key)] = seq
                 acc, cnt = st.merge.get(key, (None, 0))
                 acc = payload if acc is None else acc + payload
                 cnt += 1
@@ -135,13 +217,36 @@ class _Handler(socketserver.BaseRequestHandler):
                     st.merge[key] = (acc, cnt)
             return True, None
         if cmd == "pull":
+            after_seq = None
+            if isinstance(payload, dict) and payload.get("__ps__") == 1:
+                after_seq = payload.get("after_seq")
             if st.mode == "async":
                 with st.lock:
                     return True, onp.array(st.store[key])
-            # sync: wait until no partial round is in flight for key
+            # sync, bounded wait — a dead worker must surface, not hang
+            # the fleet.  A puller that has pushed waits for the round
+            # its own push joined (round_done >= seq+1): waiting for
+            # "no partial round" would deadlock when a fast peer opens
+            # the NEXT round before this pull is served (reference
+            # semantics: ApplyUpdates wakes the round's own pulls).
             with st.cv:
-                st.cv.wait_for(
-                    lambda: st.merge.get(key, (None, 0))[1] == 0)
+                if after_seq is not None:
+                    target = int(after_seq) + 1
+                    done = st.cv.wait_for(
+                        lambda: st.round_done.get(key, 0) >= target,
+                        timeout=st.wait_timeout)
+                else:  # bare puller (never pushed): any quiescent point
+                    done = st.cv.wait_for(
+                        lambda: st.merge.get(key, (None, 0))[1] == 0,
+                        timeout=st.wait_timeout)
+                if not done:
+                    cnt = st.merge.get(key, (None, 0))[1]
+                    raise PSTimeoutError(
+                        f"sync pull of key {key!r} stalled in round "
+                        f"{st.round_done.get(key, 0)}: {cnt} of "
+                        f"{st.num_workers} pushes after "
+                        f"{st.wait_timeout:.0f}s (a worker likely died "
+                        "mid-round)")
                 return True, onp.array(st.store[key])
         if cmd == "set_optimizer":
             from .. import optimizer as opt_mod
@@ -160,7 +265,31 @@ class _Handler(socketserver.BaseRequestHandler):
                 st.updater = np_updater
             return True, None
         if cmd == "barrier":
+            sess = seq = None
+            if isinstance(payload, dict) and payload.get("__ps__") == 1:
+                sess, seq = payload["sess"], payload["seq"]
             with st.cv:
+                if sess is not None:
+                    prev = st.barrier_seen.get(sess)
+                    if prev is not None and seq <= prev[0]:
+                        # retry of an arrival already counted (the ack
+                        # was lost): re-counting would release the
+                        # barrier before every worker arrived — wait on
+                        # the generation the original arrival joined
+                        gen0 = prev[1]
+                        if st.barrier_gen > gen0:
+                            return True, None
+                        done = st.cv.wait_for(
+                            lambda: st.barrier_gen > gen0,
+                            timeout=st.wait_timeout)
+                        if not done:
+                            raise PSTimeoutError(
+                                f"barrier generation {gen0} stalled: "
+                                f"{st.barrier_count} of {st.num_workers} "
+                                f"workers arrived after "
+                                f"{st.wait_timeout:.0f}s")
+                        return True, None
+                    st.barrier_seen[sess] = (seq, st.barrier_gen)
                 gen = st.barrier_gen
                 st.barrier_count += 1
                 if st.barrier_count >= st.num_workers:
@@ -168,24 +297,73 @@ class _Handler(socketserver.BaseRequestHandler):
                     st.barrier_gen += 1
                     st.cv.notify_all()
                 else:
-                    st.cv.wait_for(lambda: st.barrier_gen > gen)
+                    done = st.cv.wait_for(lambda: st.barrier_gen > gen,
+                                          timeout=st.wait_timeout)
+                    if not done:
+                        cnt, st.barrier_count = st.barrier_count, \
+                            st.barrier_count - 1   # leave the barrier
+                        raise PSTimeoutError(
+                            f"barrier generation {gen} stalled: {cnt} of "
+                            f"{st.num_workers} workers arrived after "
+                            f"{st.wait_timeout:.0f}s")
             return True, None
+        if cmd == "heartbeat":
+            with st.lock:
+                return True, {"mode": st.mode,
+                              "num_workers": st.num_workers,
+                              "num_keys": len(st.store),
+                              "barrier_gen": st.barrier_gen}
         return False, f"unknown command {cmd!r}"
 
 
 class PSServer(socketserver.ThreadingTCPServer):
-    """Threaded TCP parameter server (one per reference 'server' role)."""
+    """Threaded TCP parameter server (one per reference 'server' role).
+
+    ``state=`` lets a restarted server adopt a previous instance's
+    :class:`_State` (weights AND the push-dedup table), so recovery
+    after a crash-restart does not double-apply retried pushes.
+    """
 
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, addr=("127.0.0.1", 0), mode="sync", num_workers=1):
+    def __init__(self, addr=("127.0.0.1", 0), mode="sync", num_workers=1,
+                 state=None):
         super().__init__(addr, _Handler)
-        self.state = _State(mode, num_workers)
+        self.state = state if state is not None else _State(mode, num_workers)
+        self._conns: set = set()
+
+    def get_request(self):
+        sock, addr = super().get_request()
+        # prune sockets the handler already closed (fileno -1) so the
+        # live-connection set does not grow with reconnect churn
+        self._conns = {s for s in self._conns if s.fileno() != -1}
+        self._conns.add(sock)
+        return sock, addr
 
     @property
     def port(self):
         return self.server_address[1]
+
+    def kill(self):
+        """Simulate a server crash: stop accepting AND sever every live
+        connection (handler threads would otherwise keep serving their
+        open sockets past ``server_close``).  Restart by constructing a
+        new :class:`PSServer` with ``state=old.state`` — weights and the
+        push-dedup table survive, exactly the recovered-from-checkpoint
+        server role."""
+        self.shutdown()
+        self.server_close()
+        for s in list(self._conns):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._conns.clear()
 
 
 def serve_forever(port, mode, num_workers):
@@ -195,22 +373,130 @@ def serve_forever(port, mode, num_workers):
 
 
 class PSClient:
-    """Worker-side connection to a PSServer (the KVWorker role)."""
+    """Worker-side connection to a PSServer (the KVWorker role).
 
-    def __init__(self, host, port):
-        self.sock = socket.create_connection((host, port), timeout=60)
+    Requests are retried on transport failure: the socket is CLOSED and
+    re-established first (a partial read leaves length-prefix framing
+    desynced — every later decode on the same stream would be garbage),
+    then the whole request is re-sent.  Pushes carry (session, seq) so
+    the server deduplicates a retry whose original was applied but whose
+    ack was lost.  Retry exhaustion surfaces
+    :class:`~incubator_mxnet_tpu.error.PSTimeoutError` naming the
+    command and key.
+    """
+
+    def __init__(self, host, port, timeout=None, max_retries=None):
+        self.host, self.port = host, port
+        # per-attempt socket budget sits ABOVE the server's bounded
+        # sync-wait so the server's typed timeout arrives as a response,
+        # not as a client-side socket timeout
+        self.timeout = (timeout if timeout is not None
+                        else _timeout_s() + 15.0)
+        self.max_retries = (max_retries if max_retries is not None
+                            else get_env("MXNET_KVSTORE_RETRIES", 5, int))
+        self.session = uuid.uuid4().hex
+        self._seq: dict = {}       # key -> last sequence number issued
+        self._barrier_seq = -1
         self.lock = threading.Lock()
+        self.sock = None
+        self._connect()
+
+    def _connect(self):
+        self.sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout)
+        self.sock.settimeout(self.timeout)
+
+    def _reconnect(self, attempt, exc, sleep_s):
+        _log.warning(
+            "ps client %s: %s to %s:%s failed (%s); reconnecting in "
+            "%.2fs (attempt %d/%d)", self.session[:8], "call", self.host,
+            self.port, exc, sleep_s, attempt, self.max_retries)
+        self.close()
+
+    def _roundtrip(self, req):
+        if self.sock is None:
+            self._connect()
+        fault.inject("kvstore.send", detail=str(req[0]))
+        _send_msg(self.sock, req)
+        fault.inject("kvstore.recv", detail=str(req[0]))
+        return _recv_msg(self.sock)
 
     def call(self, cmd, key=None, payload=None):
+        # seq issuance happens under the SAME lock as the roundtrip:
+        # clients are shared across threads (P3's background sender +
+        # the main thread), and a torn increment would hand two live
+        # pushes the same seq — the server would dedup a real gradient
         with self.lock:
-            _send_msg(self.sock, (cmd, key, payload))
-            ok, out = _recv_msg(self.sock)
+            if cmd == "push":
+                # same seq across retries of this call: the dedup identity
+                seq = self._seq[key] = self._seq.get(key, -1) + 1
+                payload = {"__ps__": 1, "data": payload,
+                           "sess": self.session, "seq": seq}
+            elif cmd == "pull" and key in self._seq:
+                # tell the server which round our own pushes reached so
+                # the sync wait targets that round, not global quiescence
+                payload = {"__ps__": 1, "sess": self.session,
+                           "after_seq": self._seq[key]}
+            elif cmd == "barrier":
+                # barriers carry a seq too: a retried arrival must not
+                # count twice or the barrier releases early
+                self._barrier_seq += 1
+                payload = {"__ps__": 1, "sess": self.session,
+                           "seq": self._barrier_seq}
+            req = (cmd, key, payload)
+            if cmd == "stop":
+                # best-effort: a lost ack means the server is already down
+                try:
+                    self._roundtrip(req)
+                except (ConnectionError, TimeoutError, OSError):
+                    pass
+                finally:
+                    self.close()
+                return None
+            try:
+                ok, out = fault.retry(
+                    lambda: self._roundtrip(req),
+                    max_attempts=self.max_retries,
+                    retryable=(ConnectionError, TimeoutError, OSError),
+                    on_retry=self._reconnect)
+            except (ConnectionError, TimeoutError, OSError) as e:
+                self.close()
+                raise PSTimeoutError(
+                    f"ps {cmd} for key {key!r} failed after "
+                    f"{self.max_retries} attempts to {self.host}:"
+                    f"{self.port}: {e}") from e
+        if not ok:
+            kind, sep, msg = str(out).partition(": ")
+            if sep:
+                raise get_error_class(kind)(f"ps server error: {msg}")
+            raise RuntimeError(f"ps server error: {out}")
+        return out
+
+    def heartbeat(self, timeout=5.0):
+        """Liveness probe: server vitals, or raises PSTimeoutError.
+
+        One shot on a dedicated connection with a SHORT budget — a
+        health probe that rides the full retry pipeline (minutes
+        against a hung server) answers slower than the failure it is
+        meant to diagnose."""
+        try:
+            with socket.create_connection((self.host, self.port),
+                                          timeout=timeout) as s:
+                s.settimeout(timeout)
+                _send_msg(s, ("heartbeat", None, None))
+                ok, out = _recv_msg(s)
+        except (ConnectionError, TimeoutError, OSError) as e:
+            raise PSTimeoutError(
+                f"ps heartbeat to {self.host}:{self.port} failed "
+                f"within {timeout:.0f}s: {e}") from e
         if not ok:
             raise RuntimeError(f"ps server error: {out}")
         return out
 
     def close(self):
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None

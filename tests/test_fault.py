@@ -1,0 +1,519 @@
+"""Fault-injection harness + fault-tolerance paths (ISSUE 2).
+
+Covers: MXNET_FAULT_SPEC parsing and per-point deterministic RNG, the
+shared retry helper, PSClient reconnect/retry with push dedup (a
+retried push whose original was applied but whose ack was lost must
+merge exactly once), server kill → typed PSTimeoutError, server
+kill+restart with state handover mid-training, bounded sync waits,
+checkpoint CRC verification with fallback to the newest valid step,
+stale staging-dir cleanup, and the engine/io injection points.
+"""
+import os
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import fault
+from incubator_mxnet_tpu.checkpoint import AsyncCheckpointManager
+from incubator_mxnet_tpu.error import (CheckpointCorruptError,
+                                       PSTimeoutError)
+from incubator_mxnet_tpu.kvstore.ps_server import PSServer, PSClient
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fault.configure(None)
+    yield
+    fault.reset()
+
+
+def _start_server(mode, num_workers, port=0, state=None):
+    srv = PSServer(("127.0.0.1", port), mode=mode, num_workers=num_workers,
+                   state=state)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+# ---------------------------------------------------------------------------
+# spec parsing + deterministic RNG
+# ---------------------------------------------------------------------------
+
+def test_spec_parsing_full_grammar():
+    pts = fault.parse_spec(
+        "kvstore.send:error:p=0.05:seed=7,"
+        "checkpoint.write:delay:ms=200,"
+        "engine.push:error:class=permanent:n=2:after=3")
+    assert set(pts) == {"kvstore.send", "checkpoint.write", "engine.push"}
+    assert pts["kvstore.send"].p == 0.05 and pts["kvstore.send"].seed == 7
+    assert pts["checkpoint.write"].kind == "delay"
+    assert pts["checkpoint.write"].ms == 200.0
+    assert pts["engine.push"].permanent
+    assert pts["engine.push"].limit == 2 and pts["engine.push"].after == 3
+
+
+@pytest.mark.parametrize("bad", [
+    "nonsense",                      # no kind
+    "no.such.point:error",           # unknown point
+    "kvstore.send:explode",          # unknown kind
+    "kvstore.send:error:p",          # option without '='
+    "kvstore.send:error:zap=1",      # unknown option
+    "kvstore.send:error:class=soft",  # unknown error class
+])
+def test_spec_parsing_rejects_garbage(bad):
+    with pytest.raises(ValueError):
+        fault.parse_spec(bad)
+
+
+def test_per_point_rng_is_deterministic_and_independent():
+    def fire_pattern():
+        pts = fault.parse_spec("kvstore.send:error:p=0.4:seed=11")
+        return [pts["kvstore.send"].should_fire() for _ in range(50)]
+
+    a, b = fire_pattern(), fire_pattern()
+    assert a == b, "same seed must replay the same fire pattern"
+    assert any(a) and not all(a)
+    # a second point's draws don't perturb the first point's pattern
+    pts = fault.parse_spec(
+        "kvstore.send:error:p=0.4:seed=11,io.next_batch:error:p=0.5:seed=2")
+    mixed = []
+    for _ in range(50):
+        pts["io.next_batch"].should_fire()
+        mixed.append(pts["kvstore.send"].should_fire())
+    assert mixed == a
+
+
+def test_inject_counts_limits_and_delay():
+    fault.configure("engine.push:error:n=2,checkpoint.write:delay:ms=40")
+    with pytest.raises(fault.TransientFault):
+        fault.inject("engine.push")
+    with pytest.raises(fault.TransientFault):
+        fault.inject("engine.push")
+    fault.inject("engine.push")        # n=2 exhausted: no-op now
+    t0 = time.monotonic()
+    fault.inject("checkpoint.write")
+    assert time.monotonic() - t0 >= 0.035
+    calls, fired = fault.stats()["engine.push"]
+    assert (calls, fired) == (3, 2)
+
+
+def test_inject_is_noop_without_spec():
+    fault.configure(None)
+    for p in fault.POINTS:
+        fault.inject(p)
+    assert fault.stats() == {}
+
+
+# ---------------------------------------------------------------------------
+# retry helper
+# ---------------------------------------------------------------------------
+
+def test_retry_recovers_then_succeeds():
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert fault.retry(flaky, max_attempts=5, backoff=0.001) == "ok"
+    assert len(attempts) == 3
+
+
+def test_retry_exhaustion_reraises_last():
+    def always():
+        raise TimeoutError("still down")
+
+    with pytest.raises(TimeoutError, match="still down"):
+        fault.retry(always, max_attempts=3, backoff=0.001)
+
+
+def test_retry_never_swallows_permanent_fault():
+    def perm():
+        raise fault.PermanentFault("wedged")
+
+    # even an explicit retryable=RuntimeError must not retry it
+    with pytest.raises(fault.PermanentFault):
+        fault.retry(perm, max_attempts=5, backoff=0.001,
+                    retryable=(RuntimeError,))
+
+
+def test_retry_on_retry_hook_sees_each_failure():
+    seen = []
+
+    def failing():
+        raise ConnectionError("x")
+
+    with pytest.raises(ConnectionError):
+        fault.retry(failing, max_attempts=3, backoff=0.001,
+                    on_retry=lambda a, e, s: seen.append((a, str(e))))
+    assert [a for a, _ in seen] == [1, 2]   # no hook after the last try
+
+
+# ---------------------------------------------------------------------------
+# PSClient retry + dedup (exactly-once sync aggregation)
+# ---------------------------------------------------------------------------
+
+def test_lost_ack_push_is_not_double_merged():
+    """The core dedup contract: server merges the push, the ack is lost
+    (injected recv fault), the client reconnects and retries the SAME
+    (session, seq) — aggregation must count it exactly once."""
+    srv = _start_server("sync", num_workers=2)
+    c1 = PSClient("127.0.0.1", srv.port)
+    c2 = PSClient("127.0.0.1", srv.port)
+    c1.call("init", "w", onp.zeros(3, onp.float32))
+    fault.configure("kvstore.recv:error:n=1")   # first response lost
+    _, fired = fault.stats().get("kvstore.recv", (0, 0))
+    assert fired == 0
+    c1.call("push", "w", onp.ones(3, onp.float32))
+    _, fired = fault.stats().get("kvstore.recv", (0, 0))
+    assert fired == 1, "the ack-loss fault must actually have fired"
+    fault.configure(None)
+    c2.call("push", "w", 2 * onp.ones(3, onp.float32))
+    # without dedup the retried push double-counts: 1+1+2 = 4
+    onp.testing.assert_array_equal(c1.call("pull", "w"), 3 * onp.ones(3))
+    c1.call("stop")
+
+
+def test_send_faults_are_transparent_to_training():
+    srv = _start_server("sync", num_workers=1)
+    c = PSClient("127.0.0.1", srv.port)
+    c.call("init", "w", onp.zeros(4, onp.float32))
+    fault.configure("kvstore.send:error:p=0.4:seed=9")
+    for _ in range(8):
+        c.call("push", "w", onp.ones(4, onp.float32))
+    out = c.call("pull", "w")
+    fault.configure(None)
+    onp.testing.assert_array_equal(out, onp.ones(4))
+    c.call("stop")
+
+
+def test_permanent_fault_surfaces_immediately():
+    srv = _start_server("sync", num_workers=1)
+    c = PSClient("127.0.0.1", srv.port)
+    c.call("init", "w", onp.zeros(2, onp.float32))
+    fault.configure("kvstore.send:error:class=permanent:n=1")
+    with pytest.raises(fault.PermanentFault):
+        c.call("push", "w", onp.ones(2, onp.float32))
+    fault.configure(None)
+    c.call("stop")
+
+
+def test_dead_server_surfaces_typed_timeout_after_retries():
+    srv = _start_server("sync", num_workers=1)
+    c = PSClient("127.0.0.1", srv.port, timeout=2.0, max_retries=2)
+    c.call("init", "w", onp.zeros(2, onp.float32))
+    srv.kill()
+    t0 = time.monotonic()
+    with pytest.raises(PSTimeoutError, match="push.*'w'"):
+        c.call("push", "w", onp.ones(2, onp.float32))
+    assert time.monotonic() - t0 < 30
+    # the error names the command, key and attempt budget
+    with pytest.raises(PSTimeoutError, match="pull.*2 attempts"):
+        c.call("pull", "w")
+
+
+def test_heartbeat_probes_liveness():
+    srv = _start_server("async", num_workers=3)
+    c = PSClient("127.0.0.1", srv.port, timeout=2.0, max_retries=2)
+    hb = c.heartbeat()
+    assert hb["mode"] == "async" and hb["num_workers"] == 3
+    srv.kill()
+    with pytest.raises(PSTimeoutError):
+        c.heartbeat()
+
+
+def test_reinit_with_conflicting_shape_or_dtype_rejected():
+    srv = _start_server("sync", num_workers=1)
+    c = PSClient("127.0.0.1", srv.port)
+    c.call("init", "w", onp.zeros((2, 3), onp.float32))
+    c.call("init", "w", onp.zeros((2, 3), onp.float32))  # idempotent: fine
+    with pytest.raises(ValueError, match="shape"):
+        c.call("init", "w", onp.zeros((3, 2), onp.float32))
+    with pytest.raises(ValueError, match="dtype"):
+        c.call("init", "w", onp.zeros((2, 3), onp.float64))
+    c.call("stop")
+
+
+def test_bounded_sync_pull_names_stalled_key_and_round():
+    srv = _start_server("sync", num_workers=2)
+    srv.state.wait_timeout = 1.0       # stall fast for the test
+    c1 = PSClient("127.0.0.1", srv.port)
+    c1.call("init", "w", onp.zeros(2, onp.float32))
+    c1.call("push", "w", onp.ones(2, onp.float32))   # 1 of 2: round open
+    c2 = PSClient("127.0.0.1", srv.port)
+    with pytest.raises(PSTimeoutError, match=r"'w'.*round 0.*1 of 2"):
+        c2.call("pull", "w")
+    c1.call("stop")
+
+
+def test_barrier_retry_does_not_double_count():
+    """A retried barrier arrival (ack lost) must not count twice —
+    double-counting would release the barrier with a worker missing."""
+    srv = _start_server("sync", num_workers=2)
+    c1 = PSClient("127.0.0.1", srv.port)
+    c2 = PSClient("127.0.0.1", srv.port)
+    fault.configure("kvstore.recv:error:n=1")   # c1's barrier ack lost
+    done = []
+
+    def arriver():
+        c1.call("barrier")
+        done.append(1)
+
+    t = threading.Thread(target=arriver)
+    t.start()
+    time.sleep(0.5)                 # retry has landed and deduped
+    fault.configure(None)
+    assert not done, "retried barrier double-counted: released early"
+    c2.call("barrier")
+    t.join(timeout=15)
+    assert done
+    c1.call("stop")
+
+
+def test_bounded_barrier_names_generation():
+    srv = _start_server("sync", num_workers=2)
+    srv.state.wait_timeout = 1.0
+    c = PSClient("127.0.0.1", srv.port)
+    with pytest.raises(PSTimeoutError, match="barrier generation 0"):
+        c.call("barrier")
+    c.call("stop")
+
+
+# ---------------------------------------------------------------------------
+# server kill + restart mid-training (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_server_restart_mid_training_correct_final_weights():
+    """Sync-mode 2-worker push/pull loop; the server is killed and
+    restarted (adopting the old state — the recovered-server role)
+    mid-run while lost-ack faults force push retries.  Final weights
+    must equal the fault-free run: every push counted exactly once."""
+    rounds, nw = 6, 2
+    srv = _start_server("sync", num_workers=nw)
+    srv.state.wait_timeout = 20.0    # a genuine wedge fails fast
+    port = srv.port
+    # deep retry budget: recv faults compound with the restart gap
+    clients = [PSClient("127.0.0.1", port, max_retries=8)
+               for _ in range(nw)]
+    clients[0].call("init", "w", onp.zeros(4, onp.float32))
+
+    # ~1 in 3 responses lost: each worker's pushes keep hitting lost
+    # acks, so retries overlap the restart as well
+    fault.configure("kvstore.recv:error:p=0.3:seed=13")
+
+    pulls = [[] for _ in range(nw)]
+    errs = []
+
+    def worker(r):
+        try:
+            for rnd in range(rounds):
+                g = onp.full((4,), float(r + 1), onp.float32)
+                clients[r].call("push", "w", g)
+                pulls[r].append(onp.array(clients[r].call("pull", "w")))
+        except Exception as e:  # pragma: no cover - surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(nw)]
+    for t in ts:
+        t.start()
+    time.sleep(0.4)
+    srv.kill()                       # crash mid-training
+    time.sleep(0.2)                  # clients are now retrying
+    srv2 = _start_server("sync", num_workers=nw, port=port,
+                         state=srv.state)
+    for t in ts:
+        t.join(timeout=120)
+        assert not t.is_alive()
+    fault.configure(None)
+    assert not errs, errs
+    # sync, no updater: each round stores the merged push 1+2 = 3
+    final = onp.array(clients[0].call("pull", "w"))
+    onp.testing.assert_array_equal(final, onp.full((4,), 3.0))
+    # every worker observed only whole-round aggregates, never a
+    # half-counted or double-counted merge
+    for r in range(nw):
+        for seen in pulls[r]:
+            onp.testing.assert_array_equal(seen, onp.full((4,), 3.0))
+    clients[0].call("stop")
+
+
+def test_chaos_seeded_sync_run_converges_identically():
+    """The CI chaos contract: a seeded transient-error spec on
+    kvstore.send must not change the result of a sync 2-worker loop."""
+    def run(spec):
+        fault.configure(spec)
+        try:
+            srv = _start_server("sync", num_workers=2)
+            cs = [PSClient("127.0.0.1", srv.port) for _ in range(2)]
+            cs[0].call("init", "w", onp.zeros(3, onp.float32))
+            for rnd in range(5):
+                for r in (0, 1):
+                    cs[r].call("push", "w",
+                               onp.full((3,), float(rnd + r), onp.float32))
+            out = onp.array(cs[0].call("pull", "w"))
+            cs[0].call("stop")
+            return out
+        finally:
+            fault.configure(None)
+
+    clean = run(None)
+    chaotic = run("kvstore.send:error:p=0.3:seed=7")
+    onp.testing.assert_array_equal(clean, chaotic)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity
+# ---------------------------------------------------------------------------
+
+def test_crc_recorded_per_entry(tmp_path):
+    import json
+    ckpt = AsyncCheckpointManager(tmp_path)
+    ckpt.save(1, {"w": jnp.arange(6.0)}, wait=True)
+    with open(os.path.join(str(tmp_path), "step_00000001",
+                           "index.json")) as f:
+        idx = json.load(f)
+    assert isinstance(idx["params"]["w"]["crc32"], int)
+
+
+def test_corrupted_shard_never_loads_silently(tmp_path):
+    ckpt = AsyncCheckpointManager(tmp_path)
+    ckpt.save(1, {"w": jnp.arange(8.0)}, wait=True)
+    d = os.path.join(str(tmp_path), "step_00000001")
+    fn = os.path.join(d, "w.npy")
+    raw = bytearray(open(fn, "rb").read())
+    raw[-2] ^= 0xFF                          # flip a payload bit
+    open(fn, "wb").write(bytes(raw))
+    with pytest.raises(CheckpointCorruptError, match="CRC mismatch"):
+        ckpt.restore(1)
+
+
+def test_restore_falls_back_to_newest_valid_checkpoint(tmp_path):
+    ckpt = AsyncCheckpointManager(tmp_path)
+    ckpt.save(1, {"w": jnp.full((4,), 1.0)}, wait=True)
+    ckpt.save(2, {"w": jnp.full((4,), 2.0)}, wait=True)
+    ckpt.save(3, {"w": jnp.full((4,), 3.0)}, wait=True)
+    # truncate step 3's shard (crashed write), bit-rot step 2's
+    d3 = os.path.join(str(tmp_path), "step_00000003", "w.npy")
+    open(d3, "wb").write(open(d3, "rb").read()[:40])
+    d2 = os.path.join(str(tmp_path), "step_00000002", "w.npy")
+    raw = bytearray(open(d2, "rb").read())
+    raw[-1] ^= 0x01
+    open(d2, "wb").write(bytes(raw))
+    back = ckpt.restore()                    # newest VALID = step 1
+    onp.testing.assert_array_equal(back["w"], onp.full((4,), 1.0))
+    # explicit step stays strict
+    with pytest.raises(CheckpointCorruptError):
+        ckpt.restore(3)
+
+
+def test_all_checkpoints_corrupt_is_loud(tmp_path):
+    ckpt = AsyncCheckpointManager(tmp_path)
+    ckpt.save(1, {"w": jnp.ones((2,))}, wait=True)
+    fn = os.path.join(str(tmp_path), "step_00000001", "w.npy")
+    open(fn, "wb").write(b"not an npy")
+    with pytest.raises(CheckpointCorruptError, match="no valid checkpoint"):
+        ckpt.restore()
+
+
+def test_stale_tmp_staging_dir_cleaned_at_init(tmp_path):
+    stale = os.path.join(str(tmp_path), "step_00000007.tmp")
+    os.makedirs(stale)
+    shard = os.path.join(stale, "w.npy")
+    open(shard, "wb").write(b"partial")
+    # a FRESH .tmp may belong to another manager's live save: kept
+    fresh = os.path.join(str(tmp_path), "step_00000008.tmp")
+    os.makedirs(fresh)
+    long_ago = time.time() - 3600
+    os.utime(stale, (long_ago, long_ago))
+    os.utime(shard, (long_ago, long_ago))
+    ckpt = AsyncCheckpointManager(tmp_path)
+    assert not os.path.exists(stale), "idle staging dir must be removed"
+    assert os.path.exists(fresh), "a live save's staging dir must survive"
+    assert ckpt.all_steps() == []
+
+
+def test_restore_missing_step_is_filenotfound(tmp_path):
+    """Absence is not corruption: a never-saved step raises
+    FileNotFoundError (resume-from-scratch logic keys on it)."""
+    ckpt = AsyncCheckpointManager(tmp_path)
+    ckpt.save(1, {"w": jnp.ones((2,))}, wait=True)
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore(42)
+
+
+def test_checkpoint_write_fault_cleans_staging(tmp_path):
+    ckpt = AsyncCheckpointManager(tmp_path)
+    fault.configure("checkpoint.write:error:class=permanent:n=1")
+    ckpt.save(4, {"w": jnp.ones((2,))})
+    with pytest.raises(RuntimeError, match="checkpoint write failed"):
+        ckpt.wait()
+    fault.configure(None)
+    assert ckpt.all_steps() == []
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           "step_00000004.tmp"))
+    # and the manager recovers: the next save succeeds
+    ckpt.save(5, {"w": jnp.ones((2,))}, wait=True)
+    assert ckpt.all_steps() == [5]
+
+
+def test_checkpoint_write_delay_fault_still_durable(tmp_path):
+    ckpt = AsyncCheckpointManager(tmp_path)
+    fault.configure("checkpoint.write:delay:ms=50")
+    ckpt.save(1, {"w": jnp.arange(4.0)}, wait=True)
+    fault.configure(None)
+    onp.testing.assert_array_equal(ckpt.restore(1)["w"], onp.arange(4.0))
+
+
+def test_pre_crc_checkpoints_still_load(tmp_path):
+    """Back-compat: an index without crc32 (older writer) loads."""
+    import json
+    ckpt = AsyncCheckpointManager(tmp_path)
+    ckpt.save(1, {"w": jnp.arange(4.0)}, wait=True)
+    idx_p = os.path.join(str(tmp_path), "step_00000001", "index.json")
+    with open(idx_p) as f:
+        idx = json.load(f)
+    del idx["params"]["w"]["crc32"]
+    with open(idx_p, "w") as f:
+        json.dump(idx, f)
+    onp.testing.assert_array_equal(ckpt.restore(1)["w"], onp.arange(4.0))
+
+
+# ---------------------------------------------------------------------------
+# engine + io injection points
+# ---------------------------------------------------------------------------
+
+def test_engine_push_injection():
+    from incubator_mxnet_tpu.engine import NaiveEngine
+    eng = NaiveEngine()
+    fault.configure("engine.push:error:n=1")
+    with pytest.raises(fault.TransientFault):
+        eng.push(lambda: None)
+    fault.configure(None)
+    eng.push_sync(lambda: None)        # recovered
+
+
+def test_io_next_batch_injection():
+    from incubator_mxnet_tpu.io import NDArrayIter
+    it = NDArrayIter(onp.ones((8, 2), onp.float32), batch_size=4)
+    fault.configure("io.next_batch:error:n=1")
+    with pytest.raises(fault.TransientFault):
+        next(it)
+    fault.configure(None)
+    batch = next(it)
+    assert batch.data[0].shape == (4, 2)
+
+
+def test_io_next_batch_delay_point(monkeypatch):
+    from incubator_mxnet_tpu.io import NDArrayIter
+    it = NDArrayIter(onp.ones((4, 2), onp.float32), batch_size=4)
+    fault.configure("io.next_batch:delay:ms=40")
+    t0 = time.monotonic()
+    next(it)
+    assert time.monotonic() - t0 >= 0.035
+    fault.configure(None)
