@@ -1,0 +1,351 @@
+#!/usr/bin/env python
+"""Serving benchmark: dynamic batching vs sequential unbatched predict.
+
+Measures what the serving subsystem exists to deliver — throughput on
+concurrent single requests — and emits a BENCH-style JSON record so the
+serving perf trajectory is tracked like `BENCH_r0*.json`:
+
+  baseline   sequential `load_predictor` calls at batch 1 (what a
+             naive request-per-call server does per request)
+  batched    closed-loop load: N concurrent clients (default 64) each
+             issuing single requests back-to-back for --rounds rounds
+             through the warmed InferenceServer repository (requests
+             coalesce into padded buckets); best of --trials volleys
+             is reported, same total request count as the baseline
+
+Modes:
+  (default)      batcher-level measurement, full N=64
+  --check        exit 1 unless batched >= 3x baseline (the ISSUE 3
+                 acceptance floor), outputs bitwise equal, and the
+                 compile count did not move after warmup
+  --smoke        CI stage: ephemeral HTTP server end-to-end — warmup,
+                 concurrent requests over the wire, /metrics scrape,
+                 compile-count stability (no perf floor: wire + JSON
+                 overhead and CI noise are not what we gate on)
+  --model-zoo M  run against a real model_zoo artifact (exported via
+                 scripts/export_model_zoo.py) instead of the toy MLP
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as onp   # noqa: E402
+
+
+def _toy_artifact(prefix):
+    """Dispatch-overhead-dominated MLP: the regime a request-per-call
+    server wastes, which batching reclaims."""
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import deploy
+
+    def fwd(params, x):
+        y = x
+        for w in params["layers"]:
+            y = jnp.tanh(y @ w)
+        return y
+
+    rng = onp.random.RandomState(0)
+    params = {"layers": [rng.randn(128, 128).astype(onp.float32) * 0.1
+                         for _ in range(6)]}
+    x = rng.randn(1, 128).astype(onp.float32)
+    deploy.export_model(fwd, (x,), prefix, params=params)
+    return prefix
+
+
+def _zoo_artifact(prefix, model):
+    from scripts.export_model_zoo import main as export_main
+    export_main(["--model", model, "--out", prefix,
+                 "--image-size", "32", "--classes", "10"])
+    return prefix
+
+
+def _instances(meta, n, seed=1):
+    rng = onp.random.RandomState(seed)
+    shapes = [tuple(s["shape"][1:]) for s in meta["inputs"]]
+    dtypes = [s["dtype"] for s in meta["inputs"]]
+    return [tuple(rng.randn(*sh).astype(dt)
+                  for sh, dt in zip(shapes, dtypes)) for _ in range(n)]
+
+
+def _p99(latencies_ms):
+    data = sorted(latencies_ms)
+    return data[min(len(data) - 1, int(0.99 * len(data)))]
+
+
+def bench(args):
+    from incubator_mxnet_tpu import deploy
+    from incubator_mxnet_tpu.serving import InferenceServer
+
+    prefix = os.path.join(args.workdir, "serving_bench_model")
+    if args.model_zoo:
+        _zoo_artifact(prefix, args.model_zoo)
+    else:
+        _toy_artifact(prefix)
+
+    pred = deploy.load_predictor(prefix)
+    instances = _instances(pred.meta, args.requests)
+    total = args.requests * args.rounds
+    pred(*[x[None] for x in instances[0]])   # warm batch-1 off-clock
+
+    # throughput-mode flush window (docs/serving.md tuning guide): give
+    # bursts time to fill buckets instead of fragmenting into partial
+    # flushes; a latency-sensitive deployment would lower this
+    os.environ.setdefault("MXNET_SERVING_MAX_LATENCY_MS", "15")
+    srv = InferenceServer()
+    srv.repository.load("bench", prefix)           # load + warm buckets
+    compile_before = srv.repository.compile_counts()["bench"]
+    results = [None] * args.requests
+
+    def baseline_pass():
+        lat = []
+        t0 = time.monotonic()
+        for k in range(total):
+            t1 = time.monotonic()
+            pred(*[x[None] for x in instances[k % args.requests]])
+            lat.append((time.monotonic() - t1) * 1000.0)
+        dt = time.monotonic() - t0
+        return {"rps": total / dt, "p99_ms": _p99(lat), "total_s": dt}
+
+    def batched_volley():
+        # args.requests single requests stay concurrently in flight,
+        # multiplexed over a few client threads via predict_async —
+        # the shape an async HTTP front end gives the batcher.  (One
+        # OS thread per request measures CPython thread wakeups, not
+        # the serving stack.)
+        nclients = min(args.clients, args.requests)
+        # split every index across clients (remainder spread over the
+        # first few): dropping leftovers would overstate rps (total is
+        # divided by wall clock) and leave result rows unverified
+        bounds = [args.requests * c // nclients
+                  for c in range(nclients + 1)]
+        lat2 = []
+        lat_lock = threading.Lock()
+        barrier = threading.Barrier(nclients + 1)
+
+        def client(c):
+            barrier.wait()
+            mine = []
+            for _ in range(args.rounds):
+                t1 = time.monotonic()
+                ids = range(bounds[c], bounds[c + 1])
+                handles = [
+                    (i, srv.repository.predict_async(
+                        "bench", instances[i])) for i in ids]
+                for i, h in handles:
+                    results[i], _timing = h.result()
+                dt_ms = (time.monotonic() - t1) * 1000.0
+                mine.extend([dt_ms] * len(ids))  # whole-wave latency
+            with lat_lock:
+                lat2.extend(mine)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(nclients)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.monotonic()
+        for t in threads:
+            t.join()
+        dt = time.monotonic() - t0
+        return {"rps": total / dt, "p99_ms": _p99(lat2), "total_s": dt}
+
+    # interleave baseline/batched trials and take the best of each:
+    # shared-box throughput wobbles run to run, so measuring the two
+    # sides in the same window (and at their respective bests) is what
+    # makes the speedup ratio reproducible
+    baseline, batched = None, None
+    for _ in range(args.trials):
+        b0 = baseline_pass()
+        if baseline is None or b0["rps"] > baseline["rps"]:
+            baseline = b0
+        b1 = batched_volley()
+        if batched is None or b1["rps"] > batched["rps"]:
+            batched = b1
+    compile_after = srv.repository.compile_counts()["bench"]
+    snap = srv.metrics.snapshot()
+    srv.shutdown()
+
+    import jax
+    bitwise_ok = True
+    for i in range(0, args.requests, max(1, args.requests // 8)):
+        ref = pred(*[x[None] for x in instances[i]])
+        for a, b in zip(jax.tree_util.tree_leaves(results[i]),
+                        jax.tree_util.tree_leaves(ref)):
+            if not (onp.asarray(a) == onp.asarray(b)[0]).all():
+                bitwise_ok = False
+    rec = {
+        "metric": ("serving_throughput_rps_zoo" if args.model_zoo
+                   else "serving_throughput_rps"),
+        "value": round(batched["rps"], 2),
+        "unit": "req/s",
+        "p99_ms": round(batched["p99_ms"], 3),
+        "concurrency": args.requests,
+        "requests": total,
+        "flush_ms": float(os.environ["MXNET_SERVING_MAX_LATENCY_MS"]),
+        "baseline_rps": round(baseline["rps"], 2),
+        "baseline_p99_ms": round(baseline["p99_ms"], 3),
+        "speedup_vs_unbatched": round(batched["rps"] / baseline["rps"],
+                                      2),
+        "batches": snap.get("bench.batches"),
+        "mean_batch": round(
+            snap["bench.batch_size"]["sum"]
+            / max(1, snap["bench.batch_size"]["count"]), 2),
+        "compile_total": compile_after,
+        "compile_stable": compile_after == compile_before,
+        "bitwise_equal_unbatched": bool(bitwise_ok),
+        "platform": os.environ.get("JAX_PLATFORMS", "tpu"),
+    }
+    return rec
+
+
+def smoke(args):
+    """CI serving stage: ephemeral HTTP server end-to-end."""
+    import urllib.request
+    from incubator_mxnet_tpu import deploy
+    from incubator_mxnet_tpu.serving import InferenceServer
+
+    prefix = os.path.join(args.workdir, "serving_smoke_model")
+    if args.model_zoo:
+        _zoo_artifact(prefix, args.model_zoo)
+    else:
+        _toy_artifact(prefix)
+    pred = deploy.load_predictor(prefix)
+    n = min(args.requests, 16)
+    instances = _instances(pred.meta, n, seed=2)
+    refs = [pred(*[x[None] for x in inst]) for inst in instances]
+
+    srv = InferenceServer()
+    srv.repository.load("smoke", prefix)
+    port = srv.start()
+
+    def scrape_compiles():
+        raw = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30).read()
+        for line in raw.decode().splitlines():
+            if line.startswith('mxnet_serving_compile_total'
+                               '{model="smoke"}'):
+                return int(float(line.rsplit(" ", 1)[1]))
+        raise AssertionError("compile_total not in /metrics")
+
+    compiles_warm = scrape_compiles()
+    codes, results = [None] * n, [None] * n
+
+    def call(i):
+        body = json.dumps(
+            {"inputs": [x.tolist() for x in instances[i]]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/smoke:predict",
+            data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            codes[i] = resp.status
+            results[i] = json.loads(resp.read())["outputs"]
+
+    threads = [threading.Thread(target=call, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    compiles_after = scrape_compiles()
+    health = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=30).read())
+    srv.shutdown()
+
+    import jax
+    ok_bitwise, ok_close = True, True
+    for i in range(n):
+        for out_leaf, ref_leaf in zip(
+                results[i], jax.tree_util.tree_leaves(refs[i])):
+            ref = onp.asarray(ref_leaf)[0]
+            got = onp.asarray(out_leaf, dtype=ref.dtype)
+            ok_bitwise &= bool((got == ref).all())
+            ok_close &= bool(onp.allclose(got, ref, rtol=1e-5,
+                                          atol=1e-6))
+    rec = {
+        "metric": "serving_http_smoke",
+        "value": float(sum(c == 200 for c in codes)),
+        "unit": "ok_responses",
+        "requests": n,
+        "compile_total": compiles_after,
+        "compile_stable": compiles_after == compiles_warm,
+        "bitwise_equal_unbatched": bool(ok_bitwise),
+        "allclose_unbatched": bool(ok_close),
+        "health": health["status"],
+        "platform": os.environ.get("JAX_PLATFORMS", "tpu"),
+    }
+    failures = []
+    if any(c != 200 for c in codes):
+        failures.append(f"non-200 responses: {codes}")
+    if not rec["compile_stable"]:
+        failures.append(
+            f"compile count moved {compiles_warm}->{compiles_after}")
+    # conv models (the zoo path) reassociate across batch sizes at ULP
+    # level, so the wire gate is allclose; the MLP path must stay
+    # bitwise (tests/test_serving.py holds the strict contract)
+    if not ok_close:
+        failures.append("HTTP outputs diverged from unbatched baseline")
+    if not args.model_zoo and not ok_bitwise:
+        failures.append("toy-MLP outputs not bitwise equal unbatched")
+    if health["status"] != "ok":
+        failures.append(f"healthz: {health}")
+    return rec, failures
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--requests", type=int, default=64,
+                   help="concurrent clients (batched volley width)")
+    p.add_argument("--rounds", type=int, default=4,
+                   help="request waves per client per volley")
+    p.add_argument("--clients", type=int, default=8,
+                   help="client threads multiplexing the in-flight "
+                        "requests (async submit)")
+    p.add_argument("--trials", type=int, default=3,
+                   help="volleys; best throughput reported")
+    p.add_argument("--output", default=None)
+    p.add_argument("--check", action="store_true",
+                   help="enforce the 3x + compile-stable + bitwise floor")
+    p.add_argument("--smoke", action="store_true",
+                   help="HTTP end-to-end smoke (CI serving stage)")
+    p.add_argument("--model-zoo", default=None, metavar="MODEL",
+                   help="bench a model_zoo artifact (e.g. resnet18_v1)")
+    p.add_argument("--workdir", default="/tmp")
+    args = p.parse_args(argv)
+
+    failures = []
+    if args.smoke:
+        rec, failures = smoke(args)
+    else:
+        rec = bench(args)
+        if args.check:
+            if rec["speedup_vs_unbatched"] < 3.0:
+                failures.append(
+                    f"speedup {rec['speedup_vs_unbatched']}x < 3x floor")
+            if not rec["compile_stable"]:
+                failures.append("compile count grew after warmup")
+            if not rec["bitwise_equal_unbatched"]:
+                failures.append("batched outputs != unbatched outputs")
+    line = json.dumps(rec)
+    print(line, flush=True)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(line + "\n")
+    if failures:
+        print(f"[serving_bench] FAIL: {failures}", file=sys.stderr,
+              flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

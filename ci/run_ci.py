@@ -15,6 +15,11 @@ Stages (each isolated, failures collected, nonzero exit if any fail):
              MXNET_FAULT_SPEC (deterministic transient faults on the
              PS transport, delays on checkpoint writes) so every PR
              exercises the retry/dedup/integrity paths
+  serving    inference-server smoke: export a real model_zoo resnet,
+             start the dynamic-batching HTTP server on an ephemeral
+             port, warm it, fire concurrent requests, scrape /metrics,
+             assert the compile count did not move and responses match
+             the unbatched baseline bitwise
 
 Usage:
   python ci/run_ci.py                  # everything
@@ -149,6 +154,28 @@ def stage_chaos(args):
     return proc.returncode == 0, f"spec={CHAOS_SPEC!r}: {tail}"
 
 
+def stage_serving(args):
+    """Serving smoke (docs/serving.md): HTTP end-to-end against a real
+    gluon model_zoo artifact — warmup, concurrent requests, /metrics
+    scrape, compile-count stability, bitwise parity with unbatched."""
+    out = os.path.join(REPO, ".ci_serving_smoke.json")
+    try:
+        proc = sh([sys.executable, "benchmark/serving_bench.py",
+                   "--smoke", "--model-zoo", "resnet18_v1",
+                   "--requests", "8", "--output", out], timeout=900)
+        if proc.returncode != 0:
+            return False, (proc.stderr or proc.stdout).strip()[-300:]
+        with open(out) as f:
+            rec = json.load(f)
+    finally:
+        if os.path.exists(out):
+            os.remove(out)
+    return True, (f"{int(rec['value'])}/{rec['requests']} ok, "
+                  f"{rec['compile_total']} executables "
+                  f"(stable={rec['compile_stable']}), "
+                  f"bitwise={rec['bitwise_equal_unbatched']}")
+
+
 def stage_multichip(args):
     code = "import __graft_entry__ as g; g.dryrun_multichip(8)"
     proc = sh([sys.executable, "-c", code], timeout=1200)
@@ -169,6 +196,7 @@ def stage_bench(args):
 STAGES = {"build": stage_build, "sanity": stage_sanity,
           "unit": stage_unit, "slow": stage_slow,
           "bulking": stage_bulking, "chaos": stage_chaos,
+          "serving": stage_serving,
           "multichip": stage_multichip, "bench": stage_bench}
 
 

@@ -139,6 +139,7 @@ from . import util
 from . import library
 from . import rtc
 from . import deploy
+from . import serving
 from .util import is_np_array, set_np, reset_np
 from .attribute import AttrScope
 from .name import NameManager

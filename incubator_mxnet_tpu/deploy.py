@@ -17,6 +17,15 @@ that loads symbol+params without the training stack.  The C ABI in
 src/predict.cc drives exactly this loader through an embedded
 interpreter, the same layering as the reference where c_predict_api.cc
 is a thin C shim over the full libmxnet runtime.
+
+Serving side: ``export_model`` additionally attempts a **batch-
+polymorphic** export (``{prefix}.batch.jaxport``, symbolic leading
+dim), so a loaded :class:`Predictor` accepts any batch size — the
+substrate the dynamic batcher (serving/batcher.py) pads its buckets
+against.  On TPU every distinct input shape is a fresh XLA compile, so
+the predictor also exposes :meth:`Predictor.warmup` (pre-compile a set
+of bucket sizes) and :attr:`Predictor.compile_count` (executable-cache
+probe: must flatline once traffic only replays warmed shapes).
 """
 from __future__ import annotations
 
@@ -26,6 +35,7 @@ import os
 import numpy as onp
 
 import jax
+import jax.export  # noqa: F401  (jax.export is a lazily-bound submodule)
 import jax.numpy as jnp
 
 __all__ = ["export_model", "load_predictor"]
@@ -103,10 +113,43 @@ def export_model(model, example_inputs, prefix, params=None):
                     for s in jax.tree_util.tree_leaves(
                         jax.eval_shape(fwd, params, *example))],
     }
+    meta["batch_export"] = _write_batch_export(jitted, params, example,
+                                               prefix)
     with open(prefix + ".meta.json", "w") as f:
         json.dump(meta, f, indent=1)
     _write_pjrt_sidecar(prefix, params, meta)
     return meta
+
+
+def _write_batch_export(jitted, params, example, prefix):
+    """Shape-polymorphic twin of the static export: the leading axis of
+    every input becomes one shared symbolic dim ``b``, so the serving
+    batcher can execute any padding-bucket size from the same artifact
+    (each concrete size still compiles once — see Predictor.warmup).
+    Models that constrain the batch dim (e.g. a reshape folding it into
+    a static size) can't be exported this way; the predictor then falls
+    back to chunked static-batch execution."""
+    path = prefix + ".batch.jaxport"
+    try:
+        if not all(x.ndim >= 1 for x in example):
+            raise ValueError("all inputs need a leading batch axis")
+        b, = jax.export.symbolic_shape("b")
+        specs = [jax.ShapeDtypeStruct((b,) + tuple(x.shape[1:]), x.dtype)
+                 for x in example]
+        exported = jax.export.export(jitted)(params, *specs)
+        blob = exported.serialize()   # serialize before open(): a failed
+        with open(path, "wb") as f:   # export must not truncate the file
+            f.write(blob)
+        return True
+    except Exception as e:
+        import warnings
+        if os.path.exists(path):
+            os.remove(path)  # no stale polymorphic artifact
+        warnings.warn(
+            f"batch-polymorphic export unavailable ({e}); the predictor "
+            "will serve non-exported batch sizes by chunking to the "
+            "traced batch size")
+        return False
 
 
 def _write_pjrt_sidecar(prefix, params, meta):
@@ -157,7 +200,10 @@ def _write_pjrt_sidecar(prefix, params, meta):
             f.write(f"out {o['dtype']} {len(o['shape'])} {dims}".rstrip()
                     + "\n")
     try:
-        from jax._src.lib import _jax as _xc
+        try:
+            from jaxlib import xla_client as _xc
+        except ImportError:  # newer jaxlib moved it under jax._src.lib
+            from jax._src.lib import _jax as _xc
         blob = _xc.CompileOptions().SerializeAsString()  # before open():
         # a failed serialization must not leave a truncated file behind
     except Exception as e:
@@ -193,12 +239,112 @@ class Predictor:
         # rebuild the params pytree from flattened keystr names
         self._params = _unflatten_keystr(
             {k: v.data for k, v in loaded.items()})
-        self._call = self._exported.call
+        # jit both entry points: jit's executable cache keyed on concrete
+        # input shapes is (a) the warm-path dispatch and (b) the compile
+        # counter the serving metrics watch (_cache_size per function)
+        self._call = jax.jit(self._exported.call)
+        self._batch_call = None
+        bpath = prefix + ".batch.jaxport"
+        if self.meta.get("batch_export", os.path.exists(bpath)):
+            try:
+                with open(bpath, "rb") as f:
+                    self._batch_exported = jax.export.deserialize(f.read())
+                self._batch_call = jax.jit(self._batch_exported.call)
+            except (OSError, ValueError) as e:
+                # an artifact set copied without the polymorphic twin
+                # (older tooling, partial copy) must still serve — the
+                # static export fully supports the chunk/pad fallback
+                import warnings
+                warnings.warn(
+                    f"batch-polymorphic artifact {bpath} unusable "
+                    f"({e}); serving non-exported batch sizes by "
+                    "chunking to the traced batch size")
+        self._static_shapes = [tuple(s["shape"])
+                               for s in self.meta["inputs"]]
+        self._static_dtypes = [s["dtype"] for s in self.meta["inputs"]]
 
     def __call__(self, *inputs):
         arrs = tuple(jnp.asarray(x) for x in inputs)
-        out = self._call(self._params, *arrs)
+        if [tuple(a.shape) for a in arrs] == self._static_shapes:
+            out = self._call(self._params, *arrs)
+        else:
+            out = self._flex_call(arrs)
         return jax.tree_util.tree_map(onp.asarray, out)
+
+    # -- batched serving surface -------------------------------------
+
+    def _flex_call(self, arrs):
+        """Execute at a batch size other than the traced one: the
+        polymorphic export when available, else chunk/pad to the traced
+        batch size (correct but pays traced-batch compute per chunk)."""
+        n = self._check_batched(arrs)
+        if self._batch_call is not None:
+            return self._batch_call(self._params, *arrs)
+        b0 = self._static_shapes[0][0]
+        chunks = []
+        for lo in range(0, n, b0):
+            part = tuple(a[lo:lo + b0] for a in arrs)
+            take = int(part[0].shape[0])
+            if take < b0:
+                part = tuple(jnp.concatenate(
+                    [p, jnp.zeros((b0 - take,) + tuple(p.shape[1:]),
+                                  p.dtype)]) for p in part)
+            out = self._call(self._params, *part)
+            chunks.append(jax.tree_util.tree_map(
+                lambda o, k=take: o[:k], out))
+        return jax.tree_util.tree_map(
+            lambda *parts: jnp.concatenate(parts, axis=0), *chunks)
+
+    def _check_batched(self, arrs):
+        """Validate that inputs are the exported signature with a
+        (shared) different leading dim; returns that batch size."""
+        if len(arrs) != len(self._static_shapes):
+            raise ValueError(
+                f"model takes {len(self._static_shapes)} inputs, got "
+                f"{len(arrs)}")
+        n = None
+        for a, ref in zip(arrs, self._static_shapes):
+            if a.ndim != len(ref) or tuple(a.shape[1:]) != tuple(ref[1:]):
+                raise ValueError(
+                    f"input shape {tuple(a.shape)} does not match the "
+                    f"exported signature {tuple(ref)} (only the leading "
+                    "batch dim may differ)")
+            if n is None:
+                n = int(a.shape[0])
+            elif int(a.shape[0]) != n:
+                raise ValueError(
+                    "all inputs must share one leading batch dim, got "
+                    f"{[int(x.shape[0]) for x in arrs]}")
+        return n
+
+    @property
+    def batch_polymorphic(self):
+        return self._batch_call is not None
+
+    @property
+    def compile_count(self):
+        """Distinct executables traced so far (jit cache sizes).  After
+        ``warmup`` this must not grow while traffic replays warmed
+        shapes — the serving /metrics counter asserts exactly that."""
+        count = 0
+        for fn in (self._call, self._batch_call):
+            if fn is not None:
+                try:
+                    count += fn._cache_size()
+                except Exception:
+                    pass  # probe is best-effort across jax versions
+        return count
+
+    def warmup(self, batch_sizes):
+        """Pre-compile one executable per batch size so no user request
+        pays a cold XLA compile (TPU: every shape is a fresh compile)."""
+        for n in batch_sizes:
+            args = tuple(
+                jnp.zeros((int(n),) + tuple(ref[1:]), dtype)
+                for ref, dtype in zip(self._static_shapes,
+                                      self._static_dtypes))
+            self(*args)   # __call__ materializes to numpy: compile+run
+        return self.compile_count
 
 
 def _unflatten_keystr(flat: dict):

@@ -15,6 +15,8 @@ Injection points (each named where the fault physically occurs):
 * ``engine.push``       — a closure being scheduled on the engine
 * ``checkpoint.write``  — a shard file about to be written
 * ``io.next_batch``     — the data pipeline handing out a batch
+* ``serving.enqueue``   — an inference request entering a model queue
+* ``serving.execute``   — a coalesced batch about to run on the device
 
 Spec grammar (``MXNET_FAULT_SPEC``)::
 
@@ -58,7 +60,8 @@ __all__ = [
 ]
 
 POINTS = ("kvstore.send", "kvstore.recv", "engine.push",
-          "checkpoint.write", "io.next_batch")
+          "checkpoint.write", "io.next_batch",
+          "serving.enqueue", "serving.execute")
 
 
 class FaultInjected(Exception):

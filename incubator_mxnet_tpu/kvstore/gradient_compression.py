@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..base import shard_map_compat
+
 __all__ = ["GradientCompression", "make_compressed_allreduce"]
 
 
@@ -129,10 +131,10 @@ def make_compressed_allreduce(mesh, axis_name="dp", threshold=0.5):
         return mean, res
 
     from jax.sharding import PartitionSpec as P
-    mapped = jax.shard_map(
+    mapped = shard_map_compat(
         body, mesh=mesh,
         in_specs=(P(axis_name), P(axis_name)),
-        out_specs=(P(), P(axis_name)), check_vma=False)
+        out_specs=(P(), P(axis_name)))
     return jax.jit(mapped)
 
 
@@ -179,8 +181,8 @@ def make_compressed_dp_train_step(loss_fn, mesh, lr=0.1, axis_name="dp",
         return new_params, new_res, loss_mean
 
     from jax.sharding import PartitionSpec as P
-    mapped = jax.shard_map(
+    mapped = shard_map_compat(
         body, mesh=mesh,
         in_specs=(P(), P(axis_name), P(axis_name)),
-        out_specs=(P(), P(axis_name), P()), check_vma=False)
+        out_specs=(P(), P(axis_name), P()))
     return jax.jit(mapped)

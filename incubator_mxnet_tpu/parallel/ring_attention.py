@@ -17,6 +17,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..base import axis_size_compat, shard_map_compat
+
 __all__ = ["ring_attention", "_ring_attention_sharded"]
 
 
@@ -41,7 +43,7 @@ def _local_block(q, k, v, m_prev, l_prev, o_prev, scale, mask=None):
 
 def _ring_attention_sharded(q, k, v, axis_name, causal=False):
     """Body run inside shard_map: q,k,v are (B, H, T_local, D) shards."""
-    nsp = lax.axis_size(axis_name)
+    nsp = axis_size_compat(axis_name)
     my_idx = lax.axis_index(axis_name)
     scale = 1.0 / (q.shape[-1] ** 0.5)
     B, H, T, D = q.shape
@@ -80,7 +82,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name="sp", causal=False,
     """
     fn = functools.partial(_ring_attention_sharded, axis_name=axis_name,
                            causal=causal)
-    mapped = jax.shard_map(
+    mapped = shard_map_compat(
         fn, mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec),
-        out_specs=qkv_spec, check_vma=False)
+        out_specs=qkv_spec)
     return mapped(q, k, v)

@@ -19,7 +19,9 @@ import jax
 __all__ = ["set_config", "set_state", "start", "stop", "dump", "dumps",
            "pause", "resume", "Task", "Frame", "Counter", "Marker", "scope",
            "dump_memory_allocations", "bulk_stats", "reset_bulk_stats",
-           "record_bulk_flush", "record_eager_dispatch"]
+           "record_bulk_flush", "record_eager_dispatch",
+           "register_stats_provider", "unregister_stats_provider",
+           "provider_stats"]
 
 _config = {
     "filename": "profile.json",
@@ -143,6 +145,40 @@ def bulk_stats(reset=False):
 
 def reset_bulk_stats():
     bulk_stats(reset=True)
+
+
+# -- pluggable subsystem stats (serving/metrics.py registers here so
+#    profiler dumps carry the serving counters alongside bulk_stats) --
+
+_stats_providers: dict = {}
+
+
+def register_stats_provider(name, fn):
+    """Register ``fn() -> dict`` folded into :func:`dumps` output under
+    ``name`` (idempotent: re-registering replaces the provider)."""
+    _stats_providers[name] = fn
+
+
+def unregister_stats_provider(name, fn=None):
+    """Drop a provider so a torn-down subsystem stops being reported
+    (and stops being kept alive by the registry).  With ``fn`` given,
+    only removes it while it is still the registered provider — a later
+    registration under the same name wins and is left in place."""
+    cur = _stats_providers.get(name)
+    if fn is None or cur == fn:
+        _stats_providers.pop(name, None)
+
+
+def provider_stats():
+    """{provider: stats-dict} for every registered provider; a provider
+    that raises is reported as an error string, never propagated."""
+    out = {}
+    for name, fn in list(_stats_providers.items()):
+        try:
+            out[name] = fn()
+        except Exception as e:
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out
 
 
 # -- per-allocation attribution (reference storage_profiler.cc
@@ -366,7 +402,10 @@ class Counter:
 
 
 def dumps(reset=False, format="table"):
-    """Aggregate stats as a printable table (reference profiler.py:316)."""
+    """Aggregate stats as a printable table (reference profiler.py:316),
+    followed by one section per registered subsystem stats provider
+    (``bulk_stats`` for op bulking, ``serving`` for the inference
+    server) so one dump answers both halves of the perf story."""
     lines = [f"{'Name':<40} {'Calls':>8} {'Total(us)':>12} {'Mean(us)':>12}"]
     with _events_lock:
         for name, durs in sorted(_aggregate.items()):
@@ -374,6 +413,15 @@ def dumps(reset=False, format="table"):
                          f"{sum(durs) / len(durs):>12.1f}")
         if reset:
             _aggregate.clear()
+    sections = {"bulk_stats": bulk_stats()}
+    sections.update(provider_stats())
+    for name, stats in sections.items():
+        if not stats:
+            continue
+        lines.append("")
+        lines.append(f"[{name}]")
+        for k, v in sorted(stats.items()):
+            lines.append(f"{k:<40} {v}")
     return "\n".join(lines)
 
 

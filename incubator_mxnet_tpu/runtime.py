@@ -7,6 +7,8 @@ device counts.
 """
 from __future__ import annotations
 
+import importlib.util
+
 import jax
 
 
@@ -35,7 +37,10 @@ class Features(dict):
         feats["BF16"] = True
         feats["INT8"] = True
         feats["DIST_KVSTORE"] = True
-        feats["SHARD_MAP"] = hasattr(jax, "shard_map")
+        feats["SHARD_MAP"] = (
+            hasattr(jax, "shard_map")
+            or importlib.util.find_spec("jax.experimental.shard_map")
+            is not None)
         feats["OPENCV"] = _has_cv2()
         feats["SIGNAL_HANDLER"] = True
         feats["PROFILER"] = True

@@ -1,0 +1,36 @@
+"""Serving subsystem: dynamic-batching inference over deploy artifacts.
+
+The training side of this framework got dispatch-lean (op bulking) and
+fault-tolerant (kvstore/checkpoint hardening); this package is the
+request path the ROADMAP's "heavy traffic" north star needs — the
+TPU-era analog of the reference's predict-only runtime
+(c_predict_api.cc) grown into a server, in the shape of Clipper's
+adaptive batching layer (NSDI'17) and MXNet Model Server:
+
+* :mod:`.model_repository` — versioned registry over
+  ``deploy.load_predictor`` artifacts with warmup (one pre-compiled
+  executable per padding bucket) and atomic reload.
+* :mod:`.batcher` — per-model dynamic batcher: concurrent single
+  requests coalesce into padded bucket-sized batches (on TPU every
+  distinct shape is a fresh XLA compile, so padding buckets are load-
+  bearing, not a nicety), flushed on size or latency.
+* :mod:`.admission` — bounded queues (429), deadlines (504 with the
+  queue-vs-compute split), graceful drain, fault-injection hooks.
+* :mod:`.server` — stdlib ``ThreadingHTTPServer`` front end:
+  ``POST /v1/models/{name}:predict``, ``/healthz``, ``/metrics`` and
+  admin load/unload/reload.
+* :mod:`.metrics` — Prometheus-text counters/histograms, also folded
+  into ``profiler.dumps()`` alongside ``bulk_stats``.
+
+Everything is pure stdlib + JAX; no new dependencies.
+"""
+from .admission import (DeadlineExceeded, QueueFullError,   # noqa: F401
+                        ServingError, ShuttingDown)
+from .batcher import DynamicBatcher                          # noqa: F401
+from .metrics import ServingMetrics                          # noqa: F401
+from .model_repository import ModelRepository                # noqa: F401
+from .server import InferenceServer                          # noqa: F401
+
+__all__ = ["ModelRepository", "DynamicBatcher", "InferenceServer",
+           "ServingMetrics", "ServingError", "QueueFullError",
+           "DeadlineExceeded", "ShuttingDown"]

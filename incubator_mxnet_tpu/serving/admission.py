@@ -1,0 +1,134 @@
+"""Admission control: backpressure, deadlines, graceful drain.
+
+A server that batches perfectly but falls over under overload is not
+production-shaped.  This module is the policy layer in front of the
+batcher:
+
+* **Bounded queues** — each model's pending queue holds at most
+  ``MXNET_SERVING_QUEUE_DEPTH`` requests; beyond that the front end
+  answers 429 immediately (fail fast beats queueing into timeout).
+* **Deadlines** — every request carries one (client ``timeout_ms`` or
+  ``MXNET_SERVING_DEADLINE_MS``).  A request that exceeds it answers
+  504 carrying the queue-vs-compute time split, so the operator can
+  tell "overloaded" (queue_ms dominates) from "model too slow"
+  (compute_ms dominates).
+* **Graceful drain** — shutdown stops admitting (503), lets in-flight
+  batches finish, then joins the workers.
+
+``fault.py`` integration: :func:`checked_enqueue` fires the
+``serving.enqueue`` injection point and the batcher wraps device
+execution in ``fault.retry`` around ``serving.execute``, so the
+existing chaos machinery (ci/run_ci.py chaos stage grammar) exercises
+the server's retry path like it does the kvstore's.
+"""
+from __future__ import annotations
+
+from ..base import get_env
+from .. import fault
+
+__all__ = ["ServingError", "QueueFullError", "DeadlineExceeded",
+           "ShuttingDown", "ModelNotFound", "BadRequest",
+           "Admission", "checked_enqueue"]
+
+
+class ServingError(Exception):
+    """Base for serving-layer failures; carries the HTTP status."""
+    http_status = 500
+
+    def payload(self):
+        return {"error": type(self).__name__, "message": str(self)}
+
+
+class QueueFullError(ServingError):
+    """Model queue at capacity — answer 429, client should back off."""
+    http_status = 429
+
+
+class DeadlineExceeded(ServingError):
+    """Deadline elapsed; reports where the time went (queue vs compute)."""
+    http_status = 504
+
+    def __init__(self, msg, queue_ms=None, compute_ms=None):
+        super().__init__(msg)
+        self.queue_ms = queue_ms
+        self.compute_ms = compute_ms
+
+    def payload(self):
+        out = super().payload()
+        if self.queue_ms is not None:
+            out["queue_ms"] = round(self.queue_ms, 3)
+        if self.compute_ms is not None:
+            out["compute_ms"] = round(self.compute_ms, 3)
+        return out
+
+
+class ShuttingDown(ServingError):
+    """Server is draining — no new work admitted."""
+    http_status = 503
+
+
+class ModelNotFound(ServingError):
+    http_status = 404
+
+
+class BadRequest(ServingError):
+    http_status = 400
+
+
+class Admission:
+    """Per-server admission policy (shared by all models)."""
+
+    def __init__(self, queue_depth=None, default_deadline_ms=None):
+        self.queue_depth = int(
+            queue_depth if queue_depth is not None
+            else get_env("MXNET_SERVING_QUEUE_DEPTH", 256, int))
+        self.default_deadline_ms = float(
+            default_deadline_ms if default_deadline_ms is not None
+            else get_env("MXNET_SERVING_DEADLINE_MS", 30000.0, float))
+        self._draining = False
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def begin_drain(self):
+        self._draining = True
+
+    def deadline_ms(self, requested=None):
+        """Effective deadline: the client's ask, capped by the server
+        default (a client cannot hold a slot longer than the operator
+        allows)."""
+        if requested is None:
+            return self.default_deadline_ms
+        return min(float(requested), self.default_deadline_ms)
+
+    def admit(self, model_name, current_depth):
+        """Gate one request: drain state, then queue bound.  Raises the
+        matching :class:`ServingError`; fires ``serving.enqueue``.
+        One-shot form of :meth:`gate` for callers outside the batcher
+        lock (the check is advisory there — see ``gate``)."""
+        self.gate(model_name)(current_depth)
+        checked_enqueue(model_name)
+
+    def gate(self, model_name):
+        """Admission check as a callable the batcher runs **under its
+        queue lock** (``submit_async(admit=...)``), making the depth
+        bound atomic with the enqueue — a read-then-submit from here
+        would let a burst of handler threads all pass the bound before
+        any of them increments the depth."""
+        def check(current_depth):
+            if self._draining:
+                raise ShuttingDown(
+                    "server is draining, not accepting work")
+            if current_depth >= self.queue_depth:
+                raise QueueFullError(
+                    f"model {model_name!r} queue full "
+                    f"({current_depth}/{self.queue_depth})")
+        return check
+
+
+def checked_enqueue(model_name):
+    """``serving.enqueue`` fault hook: a transient fault here models a
+    lossy front-end hop and surfaces as 503 (retryable by the client);
+    delays model admission latency."""
+    fault.inject("serving.enqueue", model_name)

@@ -1,0 +1,344 @@
+"""Per-model dynamic batcher: coalesce singles into padded buckets.
+
+Concurrent single-instance requests against one model are stacked into
+a batch, padded up to the next size in ``MXNET_SERVING_BATCH_BUCKETS``
+(default ``1,2,4,8,16,32``), executed once, and sliced back out.  Two
+triggers flush a forming batch, whichever fires first:
+
+* **size** — ``MXNET_SERVING_MAX_BATCH`` requests are waiting, or
+* **time** — the oldest waiting request has aged
+  ``MXNET_SERVING_MAX_LATENCY_MS`` (partial-batch timer flush).
+
+Requests are keyed by input *signature* (per-input instance shape +
+dtype): only like-shaped requests share a batch, so the padded batch is
+always rectangular.  On TPU the bucket set is the entire compile
+universe — after ``ModelRepository`` warmup, every batch the batcher
+can possibly emit replays an already-built executable.
+
+Correctness contract (asserted in tests/test_serving.py): a response
+sliced out of a padded batch is **bitwise identical** to the same
+instance run unbatched, because row-independent inference math computes
+each output row from its input row alone and XLA's reduction order
+within a row does not depend on the number of rows.
+
+``serving.execute`` is a fault-injection point; transient faults are
+retried with :func:`fault.retry` backoff, permanent ones surface to
+every request in the batch.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as onp
+
+from ..base import get_env
+from .. import fault
+from .admission import DeadlineExceeded, ServingError
+
+__all__ = ["DynamicBatcher", "PendingResult", "parse_buckets"]
+
+
+def parse_buckets(text=None):
+    """``MXNET_SERVING_BATCH_BUCKETS`` → sorted unique ints."""
+    raw = (text if text is not None
+           else get_env("MXNET_SERVING_BATCH_BUCKETS", "1,2,4,8,16,32"))
+    try:
+        sizes = sorted({int(v) for v in str(raw).split(",") if v.strip()})
+    except ValueError:
+        raise ValueError(
+            f"MXNET_SERVING_BATCH_BUCKETS must be comma-separated ints, "
+            f"got {raw!r}")
+    if not sizes or sizes[0] < 1:
+        raise ValueError(f"batch buckets must be >= 1, got {raw!r}")
+    return sizes
+
+
+class _Request:
+    __slots__ = ("inputs", "event", "batch_out", "row", "error",
+                 "t_enqueue", "deadline_ms", "queue_ms", "compute_ms",
+                 "cancelled")
+
+    def __init__(self, inputs, deadline_ms):
+        self.inputs = inputs
+        self.event = threading.Event()
+        self.batch_out = None    # whole-batch output pytree
+        self.row = None          # this request's row in it
+        self.error = None
+        self.t_enqueue = time.monotonic()
+        self.deadline_ms = deadline_ms
+        self.queue_ms = None
+        self.compute_ms = None
+        self.cancelled = False
+
+    def age_ms(self, now=None):
+        return ((now if now is not None else time.monotonic())
+                - self.t_enqueue) * 1000.0
+
+    def expired(self, now=None):
+        return (self.deadline_ms is not None
+                and self.age_ms(now) > self.deadline_ms)
+
+
+class PendingResult:
+    """Handle for an in-flight request (``submit_async``)."""
+
+    __slots__ = ("_batcher", "_req")
+
+    def __init__(self, batcher, req):
+        self._batcher = batcher
+        self._req = req
+
+    def result(self):
+        """Block until this instance's slice of a batch is ready;
+        returns ``(outputs, timing)``."""
+        req = self._req
+        # slack on top of the deadline: the worker stamps the 504 with
+        # the queue/compute split; the local timeout is a backstop
+        timeout = (None if req.deadline_ms is None
+                   else req.deadline_ms / 1000.0 + 5.0)
+        if not req.event.wait(timeout):
+            req.cancelled = True
+            raise DeadlineExceeded(
+                f"request to {self._batcher.name!r} timed out awaiting "
+                "batch", queue_ms=req.age_ms())
+        if req.error is not None:
+            raise req.error
+        # slice our row out here, on the caller's thread: the worker's
+        # post-execute critical path stays O(1) per request
+        out = req.batch_out
+        if type(out) is onp.ndarray:       # single-output fast path
+            result = out[req.row]
+        else:
+            import jax
+            result = jax.tree_util.tree_map(
+                lambda o, k=req.row: o[k], out)
+        return result, {"queue_ms": req.queue_ms,
+                        "compute_ms": req.compute_ms}
+
+
+class DynamicBatcher:
+    """One batching queue + worker thread per loaded model version.
+
+    ``submit`` blocks the calling (HTTP handler) thread until its
+    instance's slice of a batch is ready — callers never see batching,
+    only lower tail latency under load.  ``submit_async`` returns a
+    :class:`PendingResult` for callers multiplexing many in-flight
+    requests on one thread.
+    """
+
+    def __init__(self, name, predictor, metrics=None, buckets=None,
+                 max_batch=None, max_latency_ms=None):
+        self.name = name
+        self.predictor = predictor
+        self.metrics = metrics
+        self.buckets = (list(buckets) if buckets is not None
+                        else parse_buckets())
+        self.max_batch = int(
+            max_batch if max_batch is not None
+            else get_env("MXNET_SERVING_MAX_BATCH", self.buckets[-1], int))
+        if self.max_batch < 1:
+            # 0 would make every group "full" while [:0] never drains
+            # it — the worker would spin forever serving nothing
+            raise ValueError(
+                f"MXNET_SERVING_MAX_BATCH must be >= 1, got "
+                f"{self.max_batch}")
+        self.max_latency_ms = float(
+            max_latency_ms if max_latency_ms is not None
+            else get_env("MXNET_SERVING_MAX_LATENCY_MS", 5.0, float))
+        if self.max_latency_ms < 0:
+            raise ValueError(
+                f"MXNET_SERVING_MAX_LATENCY_MS must be >= 0, got "
+                f"{self.max_latency_ms}")
+        self._retries = get_env("MXNET_SERVING_RETRIES", 3, int)
+        self._pending: dict[tuple, list[_Request]] = {}
+        self._depth = 0
+        self._accepting = True
+        self._running = True
+        self._cond = threading.Condition()
+        self._worker = threading.Thread(
+            target=self._loop, name=f"batcher-{name}", daemon=True)
+        self._worker.start()
+
+    # -- client side --------------------------------------------------
+
+    @property
+    def depth(self):
+        """Queued-but-unfinished request count (admission + gauge)."""
+        return self._depth
+
+    def submit_async(self, inputs, deadline_ms=None, admit=None):
+        """Enqueue one instance; returns a :class:`PendingResult` whose
+        ``result()`` blocks.  Lets one client thread keep many single
+        requests in flight (the shape an async HTTP front end has).
+
+        ``inputs``: tuple of instance-level numpy arrays (the exported
+        signature minus the leading batch dim).  ``admit`` is an
+        optional ``callable(depth)`` (see ``Admission.gate``) run under
+        the queue lock so its bound is atomic with the enqueue."""
+        arrs = tuple(onp.asarray(x) for x in inputs)
+        sig = tuple((a.shape, a.dtype) for a in arrs)
+        req = _Request(arrs, deadline_ms)
+        with self._cond:
+            if not (self._accepting and self._running):
+                from .admission import ShuttingDown
+                raise ShuttingDown(
+                    f"batcher for {self.name!r} is draining")
+            if admit is not None:
+                admit(self._depth)
+            group = self._pending.setdefault(sig, [])
+            group.append(req)
+            self._depth += 1
+            # wake the (sole) worker only when this submit changes what
+            # it should do: a new group arms the flush timer, a full
+            # group flushes now.  Intermediate submits would only make
+            # the worker rescan and go back to sleep — under a 64-thread
+            # burst that wake/rescan ping-pong dominates the wall clock.
+            if len(group) == 1 or len(group) >= self.max_batch:
+                self._cond.notify()
+        return PendingResult(self, req)
+
+    def submit(self, inputs, deadline_ms=None, admit=None):
+        """Block until this instance's result is ready; returns
+        ``(outputs, timing)`` — outputs is the instance-level output
+        pytree, timing the queue/compute split in ms."""
+        return self.submit_async(inputs, deadline_ms, admit).result()
+
+    # -- worker side --------------------------------------------------
+
+    def _take_batch(self):
+        """Wait for a flushable signature group; pop up to max_batch of
+        its requests.  Returns None only at shutdown."""
+        with self._cond:
+            while True:
+                if not self._running and not self._pending:
+                    return None
+                now = time.monotonic()
+                best_sig, best_age = None, -1.0
+                for sig, reqs in self._pending.items():
+                    if not reqs:
+                        continue
+                    age = reqs[0].age_ms(now)
+                    full = len(reqs) >= self.max_batch
+                    ripe = age >= self.max_latency_ms
+                    # drain mode flushes immediately: no timer to wait out
+                    if full or ripe or not self._running:
+                        if age > best_age:
+                            best_sig, best_age = sig, age
+                if best_sig is not None:
+                    reqs = self._pending[best_sig]
+                    batch = reqs[:self.max_batch]
+                    rest = reqs[self.max_batch:]
+                    if rest:
+                        self._pending[best_sig] = rest
+                    else:
+                        del self._pending[best_sig]
+                    return batch
+                # sleep until the oldest pending request ripens
+                oldest = max((r[0].age_ms(now)
+                              for r in self._pending.values() if r),
+                             default=None)
+                if oldest is None:
+                    self._cond.wait()
+                else:
+                    self._cond.wait(
+                        max(0.0, (self.max_latency_ms - oldest)) / 1000.0
+                        + 0.0005)
+
+    def _loop(self):
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            try:
+                self._execute(batch)
+            finally:
+                with self._cond:
+                    self._depth -= len(batch)
+                    self._cond.notify_all()
+
+    def _bucket_for(self, n):
+        for b in self.buckets:
+            if b >= n:
+                return b
+        # beyond the largest bucket the flush cap itself is the final
+        # padding bucket: sizes in (buckets[-1], max_batch] must not
+        # each compile their own executable (n never exceeds max_batch
+        # — the worker slices batches to it)
+        return self.max_batch
+
+    def _execute(self, batch):
+        t_start = time.monotonic()
+        live = []
+        for req in batch:
+            if req.cancelled:
+                req.event.set()
+            elif req.expired(t_start):
+                req.queue_ms = req.age_ms(t_start)
+                req.error = DeadlineExceeded(
+                    f"request to {self.name!r} spent {req.queue_ms:.1f}ms "
+                    "queued, past its deadline", queue_ms=req.queue_ms)
+                req.event.set()
+            else:
+                live.append(req)
+        if not live:
+            return
+        n = len(live)
+        padded_to = self._bucket_for(n)
+        try:
+            stacked = tuple(
+                onp.stack([r.inputs[i] for r in live])
+                for i in range(len(live[0].inputs)))
+            if padded_to > n:
+                stacked = tuple(
+                    onp.concatenate(
+                        [s, onp.zeros((padded_to - n,) + s.shape[1:],
+                                      s.dtype)])
+                    for s in stacked)
+
+            def run():
+                fault.inject("serving.execute", self.name)
+                return self.predictor(*stacked)
+
+            t_exec = time.monotonic()
+            out = fault.retry(run, max_attempts=self._retries,
+                              backoff=0.01, max_backoff=0.5)
+            compute_ms = (time.monotonic() - t_exec) * 1000.0
+        except Exception as e:
+            err = e if isinstance(e, ServingError) else ServingError(
+                f"batch execution failed for {self.name!r}: "
+                f"{type(e).__name__}: {e}")
+            for req in live:
+                req.queue_ms = (t_start - req.t_enqueue) * 1000.0
+                req.error = err
+                req.event.set()
+            return
+        if self.metrics is not None:
+            self.metrics.record_batch(self.name, n, padded_to)
+        now = time.monotonic()
+        for i, req in enumerate(live):
+            req.queue_ms = (t_start - req.t_enqueue) * 1000.0
+            req.compute_ms = compute_ms
+            if req.expired(now):
+                req.error = DeadlineExceeded(
+                    f"request to {self.name!r} finished past its "
+                    "deadline", queue_ms=req.queue_ms,
+                    compute_ms=compute_ms)
+            else:
+                req.batch_out, req.row = out, i
+            req.event.set()
+
+    # -- lifecycle ----------------------------------------------------
+
+    def drain(self, timeout=30.0):
+        """Stop admitting, flush everything queued, stop the worker.
+        In-flight requests finish normally — the atomic-reload path
+        relies on this."""
+        with self._cond:
+            self._accepting = False
+            self._running = False
+            self._cond.notify_all()
+        self._worker.join(timeout)
+        return not self._worker.is_alive()
+
+    close = drain

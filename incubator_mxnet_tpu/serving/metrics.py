@@ -1,0 +1,270 @@
+"""Serving observability: Prometheus-text counters + latency quantiles.
+
+Pure stdlib — no prometheus_client.  One :class:`ServingMetrics`
+instance is shared by the repository, batcher, admission layer and HTTP
+front end; ``render()`` is the ``GET /metrics`` body and ``snapshot()``
+the dict the profiler folds into its dumps (alongside ``bulk_stats``)
+and the serving bench emits as JSON.
+
+The load-bearing counter is ``mxnet_serving_compile_total``: the sum of
+every loaded predictor's jit-cache size.  After warmup it must
+flatline — growth under steady traffic means a request paid a cold XLA
+compile, which on TPU is the difference between microseconds and
+seconds.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["ServingMetrics", "Histogram"]
+
+
+def _esc(label_value):
+    """Prometheus label-value escaping (exposition format 0.0.4):
+    one unescaped quote/backslash/newline in a model name would
+    invalidate the whole /metrics page for every model."""
+    return (str(label_value).replace("\\", "\\\\")
+            .replace('"', '\\"').replace("\n", "\\n"))
+
+# defaults chosen for ms-scale serving latencies: sub-ms through 10s
+_LATENCY_BUCKETS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                    500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+_RESERVOIR = 2048   # ring buffer per histogram for quantile estimates
+
+
+class Histogram:
+    """Fixed-bucket histogram + ring-buffer quantiles (p50/p95/p99).
+
+    Prometheus histograms are cumulative-bucket counters; quantiles are
+    computed host-side from the last ``_RESERVOIR`` observations, which
+    is the summary-style view the bench and profiler dumps want."""
+
+    def __init__(self, buckets=_LATENCY_BUCKETS):
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * (len(self.buckets) + 1)   # +Inf tail
+        self.total = 0
+        self.sum = 0.0
+        self._ring = [0.0] * _RESERVOIR
+        self._lock = threading.Lock()
+
+    def observe(self, value):
+        value = float(value)
+        with self._lock:
+            i = 0
+            for i, edge in enumerate(self.buckets):
+                if value <= edge:
+                    break
+            else:
+                i = len(self.buckets)
+            self.counts[i] += 1
+            self._ring[self.total % _RESERVOIR] = value
+            self.total += 1
+            self.sum += value
+
+    def quantile(self, q):
+        with self._lock:
+            n = min(self.total, _RESERVOIR)
+            if n == 0:
+                return 0.0
+            data = sorted(self._ring[:n])
+        idx = min(n - 1, max(0, int(q * n)))
+        return data[idx]
+
+    def snapshot(self):
+        with self._lock:
+            total, s = self.total, self.sum
+        return {"count": total, "sum": round(s, 3),
+                "p50": round(self.quantile(0.50), 3),
+                "p95": round(self.quantile(0.95), 3),
+                "p99": round(self.quantile(0.99), 3)}
+
+    def prom_lines(self, name, labels=""):
+        lab = f"{{{labels}}}" if labels else ""
+        out = []
+        cum = 0
+        with self._lock:
+            counts, total, s = list(self.counts), self.total, self.sum
+        for edge, c in zip(self.buckets, counts):
+            cum += c
+            sep = "," if labels else ""
+            out.append(f'{name}_bucket{{{labels}{sep}le="{edge:g}"}} {cum}')
+        sep = "," if labels else ""
+        out.append(f'{name}_bucket{{{labels}{sep}le="+Inf"}} {total}')
+        out.append(f"{name}_sum{lab} {s:.6f}")
+        out.append(f"{name}_count{lab} {total}")
+        return out
+
+
+class _ModelMetrics:
+    __slots__ = ("requests", "errors", "batches", "batch_hist",
+                 "e2e_ms", "compute_ms", "queue_ms", "padded_rows")
+
+    def __init__(self):
+        self.requests = {}       # {http-code: count}
+        self.errors = 0
+        self.batches = 0
+        self.padded_rows = 0
+        self.batch_hist = Histogram(buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+        self.e2e_ms = Histogram()
+        self.compute_ms = Histogram()
+        self.queue_ms = Histogram()
+
+
+class ServingMetrics:
+    """Process-wide serving counters, shared across models."""
+
+    def __init__(self):
+        self._models: dict[str, _ModelMetrics] = {}
+        self._lock = threading.Lock()
+        self._started = time.monotonic()
+        # callbacks the repository installs: () -> int / dict
+        self._compile_count_fn = None
+        self._queue_depth_fn = None
+
+    def attach_repository(self, repository):
+        """Wire gauges that live in the repository (compile counts per
+        predictor, live queue depths per batcher)."""
+        self._compile_count_fn = repository.compile_counts
+        self._queue_depth_fn = repository.queue_depths
+
+    def _model(self, name):
+        with self._lock:
+            m = self._models.get(name)
+            if m is None:
+                m = self._models[name] = _ModelMetrics()
+            return m
+
+    # -- recording hooks ----------------------------------------------
+
+    def record_request(self, model, code, e2e_ms=None, compute_ms=None,
+                       queue_ms=None):
+        m = self._model(model)
+        with self._lock:
+            m.requests[code] = m.requests.get(code, 0) + 1
+            if code >= 400:
+                m.errors += 1
+        if e2e_ms is not None:
+            m.e2e_ms.observe(e2e_ms)
+        if compute_ms is not None:
+            m.compute_ms.observe(compute_ms)
+        if queue_ms is not None:
+            m.queue_ms.observe(queue_ms)
+
+    def record_batch(self, model, batch_size, padded_to):
+        m = self._model(model)
+        with self._lock:
+            m.batches += 1
+            m.padded_rows += max(0, padded_to - batch_size)
+        m.batch_hist.observe(batch_size)
+
+    # -- exposition ---------------------------------------------------
+
+    def compile_count(self):
+        if self._compile_count_fn is None:
+            return 0
+        return sum(self._compile_count_fn().values())
+
+    def render(self):
+        """Prometheus text exposition format (version 0.0.4)."""
+        L = []
+        L.append("# HELP mxnet_serving_uptime_seconds Server uptime.")
+        L.append("# TYPE mxnet_serving_uptime_seconds gauge")
+        L.append(f"mxnet_serving_uptime_seconds "
+                 f"{time.monotonic() - self._started:.3f}")
+        compiles = (self._compile_count_fn() if self._compile_count_fn
+                    else {})
+        L.append("# HELP mxnet_serving_compile_total Distinct XLA "
+                 "executables per model (must flatline after warmup).")
+        L.append("# TYPE mxnet_serving_compile_total counter")
+        for model, n in sorted(compiles.items()):
+            L.append(f'mxnet_serving_compile_total'
+                     f'{{model="{_esc(model)}"}} {n}')
+        depths = (self._queue_depth_fn() if self._queue_depth_fn else {})
+        L.append("# HELP mxnet_serving_queue_depth In-flight + queued "
+                 "requests per model.")
+        L.append("# TYPE mxnet_serving_queue_depth gauge")
+        for model, n in sorted(depths.items()):
+            L.append(f'mxnet_serving_queue_depth'
+                     f'{{model="{_esc(model)}"}} {n}')
+        with self._lock:
+            models = dict(self._models)
+        L.append("# HELP mxnet_serving_requests_total Requests by "
+                 "model and HTTP code.")
+        L.append("# TYPE mxnet_serving_requests_total counter")
+        for name, m in sorted(models.items()):
+            with self._lock:
+                codes = dict(m.requests)
+            for code, n in sorted(codes.items()):
+                L.append(f'mxnet_serving_requests_total'
+                         f'{{model="{_esc(name)}",code="{code}"}} {n}')
+        L.append("# HELP mxnet_serving_errors_total 4xx/5xx responses.")
+        L.append("# TYPE mxnet_serving_errors_total counter")
+        for name, m in sorted(models.items()):
+            L.append(f'mxnet_serving_errors_total'
+                     f'{{model="{_esc(name)}"}} {m.errors}')
+        L.append("# HELP mxnet_serving_batches_total Coalesced batches "
+                 "executed.")
+        L.append("# TYPE mxnet_serving_batches_total counter")
+        for name, m in sorted(models.items()):
+            L.append(f'mxnet_serving_batches_total'
+                     f'{{model="{_esc(name)}"}} {m.batches}')
+        L.append("# HELP mxnet_serving_padded_rows_total Wasted rows "
+                 "from bucket padding.")
+        L.append("# TYPE mxnet_serving_padded_rows_total counter")
+        for name, m in sorted(models.items()):
+            L.append(f'mxnet_serving_padded_rows_total'
+                     f'{{model="{_esc(name)}"}} {m.padded_rows}')
+        L.append("# HELP mxnet_serving_batch_size Coalesced batch sizes.")
+        L.append("# TYPE mxnet_serving_batch_size histogram")
+        for name, m in sorted(models.items()):
+            L.extend(m.batch_hist.prom_lines("mxnet_serving_batch_size",
+                                             f'model="{_esc(name)}"'))
+        for metric, attr, help_ in (
+                ("mxnet_serving_latency_ms", "e2e_ms",
+                 "End-to-end request latency."),
+                ("mxnet_serving_compute_ms", "compute_ms",
+                 "Device compute time per request."),
+                ("mxnet_serving_queue_ms", "queue_ms",
+                 "Queue wait per request.")):
+            L.append(f"# HELP {metric} {help_}")
+            L.append(f"# TYPE {metric} histogram")
+            for name, m in sorted(models.items()):
+                L.extend(getattr(m, attr).prom_lines(
+                    metric, f'model="{_esc(name)}"'))
+        return "\n".join(L) + "\n"
+
+    def snapshot(self):
+        """Flat dict view: profiler dumps + serving bench JSON."""
+        with self._lock:
+            models = dict(self._models)
+        out = {"compile_total": self.compile_count()}
+        if self._queue_depth_fn is not None:
+            out["queue_depth"] = sum(self._queue_depth_fn().values())
+        for name, m in models.items():
+            with self._lock:
+                reqs = sum(m.requests.values())
+                errs, batches = m.errors, m.batches
+                padded = m.padded_rows
+            out[f"{name}.requests"] = reqs
+            out[f"{name}.errors"] = errs
+            out[f"{name}.batches"] = batches
+            out[f"{name}.padded_rows"] = padded
+            out[f"{name}.batch_size"] = m.batch_hist.snapshot()
+            out[f"{name}.e2e_ms"] = m.e2e_ms.snapshot()
+            out[f"{name}.compute_ms"] = m.compute_ms.snapshot()
+            out[f"{name}.queue_ms"] = m.queue_ms.snapshot()
+        return out
+
+    def register_with_profiler(self):
+        """Fold the serving counters into ``profiler.dumps()`` output
+        alongside ``bulk_stats``."""
+        from .. import profiler
+        profiler.register_stats_provider("serving", self.snapshot)
+
+    def unregister_from_profiler(self):
+        """Detach at server shutdown: a dead server must not keep its
+        repository (predictors, weights) alive through the profiler's
+        provider registry nor report stale counters in later dumps."""
+        from .. import profiler
+        profiler.unregister_stats_provider("serving", self.snapshot)

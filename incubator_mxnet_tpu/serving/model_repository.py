@@ -1,0 +1,239 @@
+"""Versioned model registry over ``deploy.load_predictor`` artifacts.
+
+The reference framework's predict runtime loads one symbol+params pair
+per process; a server needs a *repository*: several named models, each
+with a live version, loadable/unloadable/reloadable while traffic
+flows.  Three properties are load-bearing:
+
+* **Warmup at load time** — ``warmup(bucket_sizes)`` pushes one zeros
+  batch per padding bucket through the predictor, so every executable
+  the batcher can request is compiled before the model is visible to
+  traffic.  No user request ever pays a cold XLA compile (on TPU those
+  are seconds, not microseconds).
+* **Atomic reload** — the replacement version is fully loaded *and
+  warmed* off to the side, then swapped in under the lock; the old
+  version's batcher drains (in-flight requests finish on the weights
+  they started with) and only then is it dropped.
+* **Shared observability** — the repository feeds compile counts and
+  queue depths to :class:`.metrics.ServingMetrics`, which is where the
+  "compile count flatlines after warmup" invariant is scraped from.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..base import get_env
+from .admission import (Admission, ModelNotFound, ServingError,
+                        checked_enqueue)
+from .batcher import DynamicBatcher, parse_buckets
+
+__all__ = ["ModelRepository", "ModelEntry"]
+
+
+class ModelEntry:
+    """One live (name, version) binding: predictor + its batcher."""
+
+    __slots__ = ("name", "version", "path", "predictor", "batcher")
+
+    def __init__(self, name, version, path, predictor, batcher):
+        self.name = name
+        self.version = version
+        self.path = path
+        self.predictor = predictor
+        self.batcher = batcher
+
+    def describe(self):
+        return {
+            "version": self.version,
+            "path": self.path,
+            "buckets": list(self.batcher.buckets),
+            "max_batch": self.batcher.max_batch,
+            "batch_polymorphic": self.predictor.batch_polymorphic,
+            "compile_count": self.predictor.compile_count,
+            "queue_depth": self.batcher.depth,
+            "inputs": self.predictor.meta["inputs"],
+            "outputs": self.predictor.meta["outputs"],
+        }
+
+
+class ModelRepository:
+    def __init__(self, metrics=None, admission=None, buckets=None,
+                 warmup=None):
+        self.metrics = metrics
+        self.admission = admission or Admission()
+        self._buckets = (list(buckets) if buckets is not None
+                         else parse_buckets())
+        self._warmup_default = (
+            warmup if warmup is not None
+            else get_env("MXNET_SERVING_WARMUP", True, bool))
+        self._models: dict[str, ModelEntry] = {}
+        self._retired: list[ModelEntry] = []
+        self._lock = threading.Lock()
+        if self.metrics is not None:
+            self.metrics.attach_repository(self)
+
+    def set_metrics(self, metrics):
+        """Rebind the repository (and every live batcher) to a metrics
+        instance — the server calls this when adopting a repository
+        that was constructed without one, so batch counters don't
+        silently vanish."""
+        self.metrics = metrics
+        with self._lock:
+            entries = list(self._models.values()) + list(self._retired)
+        for e in entries:
+            e.batcher.metrics = metrics
+        if metrics is not None:
+            metrics.attach_repository(self)
+
+    # -- build/teardown ----------------------------------------------
+
+    def _build_entry(self, name, path, version, warmup):
+        from ..deploy import load_predictor
+        predictor = load_predictor(path)
+        batcher = DynamicBatcher(name, predictor, metrics=self.metrics,
+                                 buckets=self._buckets)
+        entry = ModelEntry(name, version, path, predictor, batcher)
+        do_warmup = self._warmup_default if warmup is None else warmup
+        if do_warmup:
+            try:
+                self.warmup_entry(entry)
+            except Exception:
+                # a failed warmup must not leak the worker thread (and
+                # through its closure the predictor's weights)
+                entry.batcher.drain()
+                raise
+        return entry
+
+    def warmup_entry(self, entry, bucket_sizes=None):
+        if bucket_sizes is None:
+            # the batcher's compile universe: every bucket a batch of
+            # 1..max_batch requests can pad to.  That is the buckets
+            # below the flush cap PLUS the bucket covering max_batch
+            # itself — when the cap sits between buckets (max_batch=20,
+            # buckets ...16,32) a 17..20-request batch pads to 32, which
+            # must be warm too or the flatline invariant breaks
+            b = entry.batcher
+            sizes = sorted({s for s in b.buckets if s <= b.max_batch}
+                           | {b._bucket_for(b.max_batch)})
+        else:
+            sizes = list(bucket_sizes)
+        return entry.predictor.warmup(sizes)
+
+    def load(self, name, path, version=None, warmup=None):
+        """Load a new model under ``name``; errors if it exists
+        (``reload`` is the replace verb).  The entry only becomes
+        visible after a successful load + warmup."""
+        entry = self._build_entry(name, path,
+                                  1 if version is None else int(version),
+                                  warmup)
+        with self._lock:
+            if name in self._models:
+                entry.batcher.close()
+                raise ServingError(
+                    f"model {name!r} already loaded (v"
+                    f"{self._models[name].version}); use reload")
+            self._models[name] = entry
+        return entry.describe()
+
+    def reload(self, name, path=None, version=None, warmup=None):
+        """Atomic swap: build + warm the replacement, then swap the
+        name binding; in-flight requests finish on the old version,
+        whose batcher drains in the background."""
+        with self._lock:
+            old = self._models.get(name)
+        if old is None:
+            raise ModelNotFound(f"model {name!r} is not loaded")
+        entry = self._build_entry(
+            name, path or old.path,
+            old.version + 1 if version is None else int(version), warmup)
+        with self._lock:
+            old = self._models.get(name)   # re-read: racing reload/unload
+            if old is not None:
+                self._models[name] = entry
+                self._retired.append(old)
+        if old is None:
+            # lost the race to an unload while building: tear down the
+            # replacement (outside the lock — drain joins the worker)
+            entry.batcher.drain()
+            raise ModelNotFound(
+                f"model {name!r} was unloaded during reload")
+        threading.Thread(target=self._retire, args=(old,),
+                         daemon=True).start()
+        return entry.describe()
+
+    def _retire(self, entry):
+        entry.batcher.drain()
+        with self._lock:
+            try:
+                self._retired.remove(entry)
+            except ValueError:
+                pass
+
+    def unload(self, name):
+        with self._lock:
+            entry = self._models.pop(name, None)
+        if entry is None:
+            raise ModelNotFound(f"model {name!r} is not loaded")
+        entry.batcher.drain()
+        return {"unloaded": name, "version": entry.version}
+
+    def drain_all(self, timeout=30.0):
+        """Graceful shutdown: stop admission, flush every queue."""
+        self.admission.begin_drain()
+        with self._lock:
+            entries = list(self._models.values()) + list(self._retired)
+        for e in entries:
+            e.batcher.drain(timeout)
+
+    # -- request path -------------------------------------------------
+
+    def get(self, name):
+        with self._lock:
+            entry = self._models.get(name)
+        if entry is None:
+            raise ModelNotFound(f"model {name!r} is not loaded")
+        return entry
+
+    def has(self, name):
+        with self._lock:
+            return name in self._models
+
+    def predict(self, name, inputs, deadline_ms=None):
+        """Admission-gated batched predict; the server's hot path.
+        The depth bound runs under the batcher's queue lock
+        (``Admission.gate``) so concurrent arrivals cannot race past
+        it; the ``serving.enqueue`` fault point fires outside the lock
+        (an injected delay must not stall the flush worker)."""
+        entry = self.get(name)
+        checked_enqueue(name)
+        return entry.batcher.submit(
+            inputs, self.admission.deadline_ms(deadline_ms),
+            admit=self.admission.gate(name))
+
+    def predict_async(self, name, inputs, deadline_ms=None):
+        """Admission-gated ``submit_async``: returns a
+        :class:`~.batcher.PendingResult` so one caller thread can keep
+        many single requests in flight."""
+        entry = self.get(name)
+        checked_enqueue(name)
+        return entry.batcher.submit_async(
+            inputs, self.admission.deadline_ms(deadline_ms),
+            admit=self.admission.gate(name))
+
+    # -- introspection ------------------------------------------------
+
+    def models(self):
+        with self._lock:
+            entries = dict(self._models)
+        return {name: e.describe() for name, e in entries.items()}
+
+    def compile_counts(self):
+        with self._lock:
+            entries = dict(self._models)
+        return {name: e.predictor.compile_count
+                for name, e in entries.items()}
+
+    def queue_depths(self):
+        with self._lock:
+            entries = dict(self._models)
+        return {name: e.batcher.depth for name, e in entries.items()}

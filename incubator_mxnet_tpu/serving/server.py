@@ -1,0 +1,314 @@
+"""HTTP front end: stdlib ``ThreadingHTTPServer`` over the repository.
+
+Endpoints (KFServing-style verbs, stdlib-only implementation):
+
+* ``POST /v1/models/{name}:predict``  — ``{"inputs": [tensor, ...],
+  "timeout_ms": n?}`` where each tensor is a nested JSON list shaped
+  like the exported input minus its leading batch dim.  Responds
+  ``{"outputs": [...], "timing": {"queue_ms":, "compute_ms":}}``.
+* ``GET  /healthz``   — liveness + per-model vitals (the serving twin
+  of PR 2's kvstore ``heartbeat`` probe: cheap, never touches the
+  device, and reports queue depths so a scheduler can drain early);
+  503 while draining.
+* ``GET  /metrics``   — Prometheus text exposition.
+* ``POST /v1/models/{name}:load``    — ``{"path":, "version"?:,
+  "warmup"?:}`` admin verbs; ``:unload``; ``:reload`` (atomic swap,
+  in-flight requests finish on the old version).
+
+Each handler thread blocks inside ``DynamicBatcher.submit`` while its
+request rides a coalesced batch — ThreadingHTTPServer gives us the
+per-request threads, the batcher turns them into bucket-sized device
+launches.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as onp
+
+from ..base import get_env
+from .. import fault
+from .admission import Admission, BadRequest, ServingError
+from .metrics import ServingMetrics
+from .model_repository import ModelRepository
+
+__all__ = ["InferenceServer", "main"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing -----------------------------------------------------
+
+    def log_message(self, fmt, *args):
+        if get_env("MXNET_SERVING_VERBOSE", False, bool):
+            BaseHTTPRequestHandler.log_message(self, fmt, *args)
+
+    @property
+    def app(self):
+        return self.server.app
+
+    def _send(self, code, body, content_type="application/json",
+              extra_headers=None):
+        data = (body if isinstance(body, bytes)
+                else json.dumps(body).encode())
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _body(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        if length == 0:
+            return {}
+        try:
+            return json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, UnicodeDecodeError) as e:
+            raise BadRequest(f"request body is not JSON: {e}")
+
+    # -- routes -------------------------------------------------------
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            return self._healthz()
+        if path == "/metrics":
+            return self._send(200, self.app.metrics.render().encode(),
+                              content_type="text/plain; version=0.0.4")
+        if path == "/v1/models":
+            return self._send(200, {"models": self.app.repository.models()})
+        self._send(404, {"error": "NotFound", "message": path})
+
+    def do_POST(self):
+        path = self.path.split("?", 1)[0]
+        if path.startswith("/v1/models/") and ":" in path:
+            name, _, verb = path[len("/v1/models/"):].rpartition(":")
+            handler = {"predict": self._predict, "load": self._load,
+                       "unload": self._unload,
+                       "reload": self._reload}.get(verb)
+            if handler is not None and name:
+                return handler(name)
+        self._send(404, {"error": "NotFound", "message": path})
+
+    # -- handlers -----------------------------------------------------
+
+    def _healthz(self):
+        draining = self.app.repository.admission.draining
+        body = {
+            "status": "draining" if draining else "ok",
+            "uptime_s": round(time.monotonic() - self.app.t_start, 3),
+            "models": {name: {"version": d["version"],
+                              "queue_depth": d["queue_depth"],
+                              "compile_count": d["compile_count"]}
+                       for name, d in
+                       self.app.repository.models().items()},
+        }
+        self._send(503 if draining else 200, body)
+
+    def _predict(self, name):
+        t0 = time.monotonic()
+        code, timing = 500, {}
+        try:
+            # resolve the model FIRST: every later error (400/5xx) is
+            # then attributed to a registry-backed name, so arbitrary
+            # client-supplied names cannot grow the metrics registry
+            entry = self.app.repository.get(name)
+            body = self._body()
+            if "inputs" not in body or not isinstance(body["inputs"],
+                                                      list):
+                raise BadRequest('body needs "inputs": [tensor, ...]')
+            specs = entry.predictor.meta["inputs"]
+            if len(body["inputs"]) != len(specs):
+                raise BadRequest(
+                    f"model {name!r} takes {len(specs)} inputs, got "
+                    f"{len(body['inputs'])}")
+            try:
+                arrs = tuple(
+                    onp.asarray(x, dtype=spec["dtype"])
+                    for x, spec in zip(body["inputs"], specs))
+            except (TypeError, ValueError) as e:
+                raise BadRequest(f"malformed input tensor: {e}")
+            for a, spec in zip(arrs, specs):
+                want = tuple(spec["shape"][1:])
+                if tuple(a.shape) != want:
+                    raise BadRequest(
+                        f"instance shape {tuple(a.shape)} != exported "
+                        f"instance shape {want}")
+            out, timing = self.app.repository.predict(
+                name, arrs, body.get("timeout_ms"))
+            import jax
+            outputs = [o.tolist()
+                       for o in jax.tree_util.tree_leaves(out)]
+            code = 200
+            self._send(200, {"outputs": outputs,
+                             "timing": {k: round(v, 3)
+                                        for k, v in timing.items()
+                                        if v is not None}})
+        except ServingError as e:
+            code = e.http_status
+            hdrs = {"Retry-After": "1"} if code in (429, 503) else None
+            self._send(code, e.payload(), extra_headers=hdrs)
+        except fault.TransientFault as e:
+            code = 503   # injected front-end fault: client may retry
+            self._send(code, {"error": "TransientFault",
+                              "message": str(e)},
+                       extra_headers={"Retry-After": "1"})
+        except Exception as e:
+            code = 500
+            self._send(code, {"error": type(e).__name__,
+                              "message": str(e)})
+        finally:
+            # unknown-model 404s are not attributed per-model: arbitrary
+            # client-supplied names must not grow the metrics registry
+            if code != 404:
+                e2e = (time.monotonic() - t0) * 1000.0
+                self.app.metrics.record_request(
+                    name, code, e2e_ms=e2e,
+                    compute_ms=timing.get("compute_ms"),
+                    queue_ms=timing.get("queue_ms"))
+
+    def _admin(self, name, fn):
+        # errors attribute to the name only when it names a loaded
+        # model (a failed :load of an arbitrary name must not mint a
+        # metrics entry); successes always do — :load just created it
+        try:
+            result = fn(self._body())
+            self._send(200, result)
+            self.app.metrics.record_request(name, 200)
+        except ServingError as e:
+            self._send(e.http_status, e.payload())
+            if e.http_status != 404 and self.app.repository.has(name):
+                self.app.metrics.record_request(name, e.http_status)
+        except Exception as e:
+            self._send(500, {"error": type(e).__name__,
+                             "message": str(e)})
+            if self.app.repository.has(name):
+                self.app.metrics.record_request(name, 500)
+
+    def _load(self, name):
+        def fn(body):
+            if "path" not in body:
+                raise BadRequest('load needs {"path": artifact-prefix}')
+            return self.app.repository.load(
+                name, body["path"], version=body.get("version"),
+                warmup=body.get("warmup"))
+        self._admin(name, fn)
+
+    def _unload(self, name):
+        self._admin(name, lambda body:
+                    self.app.repository.unload(name))
+
+    def _reload(self, name):
+        def fn(body):
+            return self.app.repository.reload(
+                name, path=body.get("path"),
+                version=body.get("version"),
+                warmup=body.get("warmup"))
+        self._admin(name, fn)
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class InferenceServer:
+    """Own the repository + metrics + HTTP listener as one unit."""
+
+    def __init__(self, repository=None, host="127.0.0.1", port=0,
+                 metrics=None):
+        # adopt the repository's metrics when it already has one, so
+        # handler-side counters and batcher-side counters land in the
+        # same instance; otherwise rebind the repository (and its live
+        # batchers) to ours
+        if metrics is None and repository is not None:
+            metrics = repository.metrics
+        self.metrics = metrics or ServingMetrics()
+        self.repository = repository or ModelRepository(
+            metrics=self.metrics)
+        if self.repository.metrics is not self.metrics:
+            self.repository.set_metrics(self.metrics)
+        else:
+            self.metrics.attach_repository(self.repository)
+        self.metrics.register_with_profiler()
+        self.host = host
+        self.port = int(port)
+        self.t_start = time.monotonic()
+        self._httpd = None
+        self._thread = None
+
+    def start(self):
+        """Bind + serve on a background thread; returns the bound port
+        (ephemeral when constructed with port=0)."""
+        self._httpd = _HTTPServer((self.host, self.port), _Handler)
+        self._httpd.app = self
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serving-http",
+            daemon=True)
+        self._thread.start()
+        return self.port
+
+    def shutdown(self, drain=True, timeout=30.0):
+        """Graceful stop: drain queues first so queued requests get
+        real responses, then close the listener."""
+        if drain:
+            self.repository.drain_all(timeout)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        self.metrics.unregister_from_profiler()
+
+
+def main(argv=None):
+    import argparse
+    import signal
+
+    p = argparse.ArgumentParser(
+        description="mxnet-tpu dynamic-batching inference server")
+    p.add_argument("--model", action="append", default=[],
+                   metavar="NAME=PREFIX",
+                   help="load artifact PREFIX as model NAME at startup")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int,
+                   default=get_env("MXNET_SERVING_PORT", 8080, int))
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip per-bucket warmup compiles at load")
+    args = p.parse_args(argv)
+
+    server = InferenceServer(host=args.host, port=args.port)
+    for spec in args.model:
+        name, sep, path = spec.partition("=")
+        if not sep:
+            p.error(f"--model wants NAME=PREFIX, got {spec!r}")
+        server.repository.load(name, path,
+                               warmup=not args.no_warmup)
+        print(f"[serving] loaded {name} from {path}", flush=True)
+    port = server.start()
+    print(f"[serving] listening on {args.host}:{port}", flush=True)
+
+    done = threading.Event()
+
+    def stop(signum, frame):
+        print(f"[serving] signal {signum}: draining", flush=True)
+        done.set()
+
+    signal.signal(signal.SIGTERM, stop)
+    signal.signal(signal.SIGINT, stop)
+    done.wait()
+    server.shutdown(drain=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Export a gluon model_zoo network as a deploy/serving artifact.
+
+Bridges the training stack to the serving path: the CI `serving` stage
+and `benchmark/serving_bench.py --model-zoo` run the batching server
+against a *real* convolutional artifact produced here, not a toy fn.
+
+Usage:
+  python scripts/export_model_zoo.py --model resnet18_v1 \
+      --out /tmp/resnet --image-size 32 --classes 10
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet18_v1",
+                   help="model_zoo.vision factory name (get_model)")
+    p.add_argument("--out", required=True,
+                   help="artifact prefix to write")
+    p.add_argument("--image-size", type=int, default=32,
+                   help="square input resolution (32 keeps CPU CI fast)")
+    p.add_argument("--classes", type=int, default=10)
+    p.add_argument("--batch", type=int, default=1,
+                   help="traced batch size of the static export (any "
+                        "batch serves via the polymorphic twin)")
+    args = p.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from incubator_mxnet_tpu import nd, deploy
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.get_model(args.model, classes=args.classes)
+    net.initialize()
+    x = nd.random.uniform(
+        shape=(args.batch, 3, args.image_size, args.image_size))
+    net(x)   # materialize deferred-shape parameters
+    meta = deploy.export_model(net, (x,), args.out)
+    print(f"[export_model_zoo] {args.model} -> {args.out} "
+          f"inputs={meta['inputs']} outputs={meta['outputs']} "
+          f"batch_export={meta['batch_export']}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

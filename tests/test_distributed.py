@@ -373,7 +373,7 @@ sys.exit(max(p.wait() for p in procs))
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-             "-n", "2", "--launcher", "yarn",
+             "-n", "2", "-s", "1", "--kv-mode", "sync", "--launcher", "yarn",
              "--yarn-cmd", str(yarn), "--yarn-jar", "/dev/null",
              "--yarn-head", "127.0.0.1",
              "--env", "MXT_REPO:" + REPO,
@@ -424,7 +424,7 @@ sys.exit(max(p.wait() for p in procs))
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-             "-n", "2", "--launcher", "sge",
+             "-n", "2", "-s", "1", "--kv-mode", "sync", "--launcher", "sge",
              "--qsub-cmd", str(qsub), "--sge-head", "127.0.0.1",
              "--env", "MXT_REPO:" + REPO,
              "--env", "MXT_TEST_KVTYPE:dist_sync",

@@ -36,7 +36,8 @@ def test_train_dist_kvstore_via_launcher():
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-         "-n", "2", "--launcher", "local", sys.executable,
+         "-n", "2", "-s", "1", "--kv-mode", "sync",
+         "--launcher", "local", sys.executable,
          os.path.join(REPO, "examples", "train_dist_kvstore.py")],
         capture_output=True, text=True, timeout=420, env=env)
     assert proc.returncode == 0, proc.stderr[-800:]

@@ -262,6 +262,96 @@ def test_multithread_concurrency(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# batched predictor surface (ISSUE 3: the dynamic batcher's substrate)
+# ---------------------------------------------------------------------------
+
+def _export_tanh_mlp(tmp_path, name="bm"):
+    import jax.numpy as jnp
+
+    def fwd(params, x):
+        return jnp.tanh(x @ params["w"]) + params["b"]
+
+    rng = onp.random.RandomState(3)
+    params = {"w": rng.randn(12, 5).astype(onp.float32),
+              "b": rng.randn(5).astype(onp.float32)}
+    x = rng.randn(2, 12).astype(onp.float32)
+    prefix = str(tmp_path / name)
+    meta = deploy.export_model(fwd, (x,), prefix, params=params)
+    return prefix, params, meta
+
+
+def test_predictor_accepts_batched_leading_dims(tmp_path):
+    """load_predictor serves any leading batch dim via the shape-
+    polymorphic twin export, matching the traced-shape result rows."""
+    prefix, params, meta = _export_tanh_mlp(tmp_path)
+    assert meta["batch_export"] is True
+    assert os.path.exists(prefix + ".batch.jaxport")
+    pred = deploy.load_predictor(prefix)
+    assert pred.batch_polymorphic
+    rng = onp.random.RandomState(5)
+    xb = rng.randn(16, 12).astype(onp.float32)
+    ref = onp.tanh(xb @ params["w"]) + params["b"]
+    for n in (1, 3, 8, 16):
+        out = pred(xb[:n])
+        assert out.shape == (n, 5)
+        onp.testing.assert_allclose(out, ref[:n], rtol=1e-5, atol=1e-6)
+    # per-row results identical regardless of the batch they rode in
+    assert (pred(xb[:1])[0] == pred(xb[:7])[0]).all()
+
+
+def test_predictor_batched_input_validation(tmp_path):
+    prefix, _, _ = _export_tanh_mlp(tmp_path)
+    pred = deploy.load_predictor(prefix)
+    with pytest.raises(ValueError, match="exported signature"):
+        pred(onp.zeros((4, 9), onp.float32))     # wrong trailing dim
+    with pytest.raises(ValueError, match="exported signature"):
+        pred(onp.zeros((4, 12, 1), onp.float32))  # wrong rank
+
+
+def test_predictor_warm_shapes_do_not_recompile(tmp_path):
+    """Regression for the batcher's core dependency: calls at an
+    already-seen batch size must not re-trace/re-compile (the
+    compile-count probe reads the jit executable caches)."""
+    prefix, _, _ = _export_tanh_mlp(tmp_path)
+    pred = deploy.load_predictor(prefix)
+    warmed = pred.warmup([1, 2, 4, 8])
+    assert warmed == pred.compile_count
+    rng = onp.random.RandomState(1)
+    for n in (1, 2, 4, 8, 8, 4, 2, 1):
+        pred(rng.randn(n, 12).astype(onp.float32))
+    assert pred.compile_count == warmed, \
+        "warm-shape call re-traced the executable"
+    # a genuinely new shape is allowed to compile exactly once more
+    pred(rng.randn(5, 12).astype(onp.float32))
+    assert pred.compile_count == warmed + 1
+    pred(rng.randn(5, 12).astype(onp.float32))
+    assert pred.compile_count == warmed + 1
+
+
+def test_predictor_chunked_fallback_without_batch_export(tmp_path):
+    """Artifacts without the polymorphic twin (older exports, or models
+    that constrain the batch dim) still serve any batch size by
+    chunking/padding to the traced batch size."""
+    import json as _json
+    prefix, params, _ = _export_tanh_mlp(tmp_path)
+    os.remove(prefix + ".batch.jaxport")
+    with open(prefix + ".meta.json") as f:
+        meta = _json.load(f)
+    meta["batch_export"] = False
+    with open(prefix + ".meta.json", "w") as f:
+        _json.dump(meta, f)
+    pred = deploy.load_predictor(prefix)
+    assert not pred.batch_polymorphic
+    rng = onp.random.RandomState(8)
+    for n in (1, 2, 3, 5, 7):   # traced batch is 2: exercises padding
+        xb = rng.randn(n, 12).astype(onp.float32)
+        ref = onp.tanh(xb @ params["w"]) + params["b"]
+        out = pred(xb)
+        assert out.shape == (n, 5)
+        onp.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
 # PJRT-direct predictor (src/pjrt_predict.cc): the NO-python serving
 # path (VERDICT r3 Next #8 option A)
 # ---------------------------------------------------------------------------

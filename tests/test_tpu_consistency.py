@@ -45,9 +45,13 @@ def test_cpu_tpu_consistency_battery():
     out = proc.stdout
     if "no accelerator visible" in out:
         pytest.skip("no accelerator visible to JAX")
-    if "Unable to initialize backend" in proc.stderr:
+    if ("Unable to initialize backend" in proc.stderr
+            or "Unable to initialize backend 'axon'" in out):
         # the axon plugin only registers when its tunnel answers at
-        # import; a wedged tunnel surfaces as an unknown backend
+        # import; a wedged tunnel surfaces as an unknown backend.  The
+        # init failure can also land on stdout: the harness folds a
+        # child's crash traceback into its RESULT line, so a child that
+        # died at backend init (before touching any op) shows up there
         pytest.skip("accelerator plugin failed to register (tunnel down)")
     # wedge → skip; crash → FAIL (the parent labels a finished-but-
     # silent child "child crashed", which must stay red).  The round-5
