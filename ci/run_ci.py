@@ -21,6 +21,16 @@ Stages (each isolated, failures collected, nonzero exit if any fail):
              assert the compile count did not move and responses match
              the unbatched baseline bitwise
 
+  lint       mxlint (docs/static_analysis.md) over the python surface:
+             framework-invariant rules (env-var/docs sync, fault-point
+             registry, monotonic clocks, bulkable purity, lock order,
+             typed-error propagation); fails on any finding not in the
+             (normally empty) ci/mxlint_baseline.json
+  race       engine + bulking test subset re-run under
+             MXNET_ENGINE_RACE_CHECK=1 so every op's actual NDArray
+             accesses are checked against its declared read/write sets
+             (an undeclared access raises EngineRaceError mid-test)
+
 Usage:
   python ci/run_ci.py                  # everything
   python ci/run_ci.py --stages unit --shard 1/4
@@ -176,6 +186,36 @@ def stage_serving(args):
                   f"bitwise={rec['bitwise_equal_unbatched']}")
 
 
+def stage_lint(args):
+    """Framework-aware static analysis (tools/mxlint.py): exit 0 means
+    no findings beyond the baseline — and the baseline stays empty
+    unless an entry carries a written justification."""
+    proc = sh([sys.executable, "tools/mxlint.py", "incubator_mxnet_tpu",
+               "tools", "scripts", "benchmark", "ci"], timeout=300)
+    out = (proc.stdout or proc.stderr).strip()
+    tail = out.splitlines()[-1] if out else ""
+    if proc.returncode != 0:
+        return False, out[-600:]
+    return True, tail
+
+
+def stage_race(args):
+    """Dependency-engine race check: the engine/bulking/ndarray subset
+    must pass with every op's actual accesses verified against its
+    declared const/mutable vars (violations raise EngineRaceError)."""
+    proc = sh([sys.executable, "-m", "pytest", "-q",
+               "tests/test_bulking.py", "tests/test_ndarray.py",
+               "tests/test_native.py",
+               # the C++ selftest subprocess never sees the flag; it is
+               # load-flaky and covered by the unit stage already
+               "-k", "not cpp_selftest",
+               "-m", "not slow", "--continue-on-collection-errors",
+               "-p", "no:cacheprovider"],
+              timeout=1800, env={"MXNET_ENGINE_RACE_CHECK": "1"})
+    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+    return proc.returncode == 0, f"race-check on: {tail}"
+
+
 def stage_multichip(args):
     code = "import __graft_entry__ as g; g.dryrun_multichip(8)"
     proc = sh([sys.executable, "-c", code], timeout=1200)
@@ -194,9 +234,10 @@ def stage_bench(args):
 
 
 STAGES = {"build": stage_build, "sanity": stage_sanity,
+          "lint": stage_lint,
           "unit": stage_unit, "slow": stage_slow,
           "bulking": stage_bulking, "chaos": stage_chaos,
-          "serving": stage_serving,
+          "serving": stage_serving, "race": stage_race,
           "multichip": stage_multichip, "bench": stage_bench}
 
 
@@ -215,7 +256,7 @@ def main(argv=None):
         t0 = time.monotonic()
         try:
             ok, detail = STAGES[name](args)
-        except Exception as e:  # a crashed stage is a FAIL, not an abort
+        except Exception as e:  # mxlint: allow-broad-except(a crashed stage is recorded as a FAIL, not an abort of the pipeline)
             ok, detail = False, f"{type(e).__name__}: {e}"
         dt = time.monotonic() - t0
         print(f"[ci] {name:10s} {'PASS' if ok else 'FAIL'} "

@@ -76,12 +76,12 @@ def _install_fork_handlers():
         try:
             from . import engine
             engine.reset_engine()
-        except Exception:
+        except Exception:  # mxlint: allow-broad-except(post-fork reinit is best-effort; a failure must not kill the child)
             pass
         try:
             from . import random as _random
             _random.seed(int.from_bytes(os.urandom(4), "little"))
-        except Exception:
+        except Exception:  # mxlint: allow-broad-except(post-fork reseed is best-effort; a failure must not kill the child)
             pass
 
     if hasattr(os, "register_at_fork"):

@@ -196,7 +196,7 @@ def register_func(name, f=None, override=False):
                 raise TypeError(
                     f"FFI cannot marshal return {type(out).__name__}")
             return 0
-        except Exception as e:  # noqa: BLE001 — becomes the C error
+        except Exception as e:  # mxlint: allow-broad-except(marshalled into the C error slot and surfaced to the caller via the -1 return)
             err_msg[0] = ctypes.cast(
                 _libc.strdup(f"{type(e).__name__}: {e}".encode()),
                 ctypes.c_char_p)

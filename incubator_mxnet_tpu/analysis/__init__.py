@@ -1,0 +1,27 @@
+"""Static and dynamic correctness analysis for the framework.
+
+Two halves (docs/static_analysis.md):
+
+* :mod:`.mxlint` — AST-based, framework-aware static linter whose rules
+  encode this framework's invariants (env-var/docs sync, fault-point
+  registry wiring, monotonic-clock discipline, bulkable-op purity,
+  lock-order consistency, typed-error propagation).  CLI:
+  ``python tools/mxlint.py`` (pure stdlib — importable without jax).
+* :mod:`.race` — dynamic dependency-engine race detector
+  (``MXNET_ENGINE_RACE_CHECK=1``): verifies each engine op's actual
+  NDArray accesses against its declared ``const_vars``/``mutable_vars``.
+
+``race`` is imported eagerly (the engine hot path reads its flag);
+``mxlint`` stays lazy so importing the package never pays the linter's
+setup, and the linter never pays the package's jax import.
+"""
+from . import race
+
+__all__ = ["race", "mxlint"]
+
+
+def __getattr__(name):
+    if name == "mxlint":
+        import importlib
+        return importlib.import_module(".mxlint", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

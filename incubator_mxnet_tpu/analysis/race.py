@@ -1,0 +1,274 @@
+"""Dynamic dependency-engine race detector.
+
+The engine schedules every op by its *declared* read/write sets
+(``push(fn, const_vars, mutable_vars)`` — reference
+include/mxnet/engine.h:117), but nothing verifies the declaration: a
+closure that touches a buffer it did not declare is a silent race the
+scheduler can legally reorder.  This module is the happens-before
+checker for that contract, in the spirit of FastTrack (Flanagan &
+Freund, PLDI 2009) specialized to the engine's var discipline: instead
+of full vector clocks per memory location, each engine ``Var`` already
+carries a version counter bumped on every write, so the check reduces
+to comparing an op's *observed* accesses against its *declared* sets
+plus a version-stability check over the reads.
+
+Enabled by ``MXNET_ENGINE_RACE_CHECK=1`` (or :func:`set_enabled`).
+While an engine op's closure runs, NDArray chunk reads
+(``NDArray.data``) and writes (``_Chunk.write``) are reported here via
+:func:`note_read`/:func:`note_write` and attributed to the op through a
+thread-local stack (engine workers run one closure at a time per
+thread).  At op completion the record is checked:
+
+* **undeclared write** — the op wrote a var not in ``mutable_vars``;
+  the scheduler never serialized this write against anything.
+* **undeclared read** — the op read a var in neither set; a concurrent
+  writer is free to swap the buffer mid-read.
+* **write-after-read hazard** — a var the op read (without owning the
+  write lock) changed version before the op finished: some other op's
+  write actually interleaved, i.e. the race *happened*, not merely
+  could happen.
+
+Vars created while the op runs (fresh NDArrays built inside the
+closure) are op-local and exempt — nothing else can hold a reference
+to schedule against.
+
+Violation delivery mirrors the engine's async-error contract: the
+synchronous :class:`~..engine.NaiveEngine` raises
+:class:`~..error.EngineRaceError` directly from ``push``; the threaded
+and native engines collect violations and rethrow at
+``wait_for_all``/``wait_for_var`` (reference threaded_engine.cc:422
+sticky-exception discipline).  A ``race_check`` stats provider is
+registered with :mod:`..profiler` while the detector is on, so
+``profiler.dumps()`` reports checked-op and violation counts.
+
+Overhead is confined to the flag-on path: with the flag off the engine
+and NDArray hot paths test one module-level boolean and allocate
+nothing per op.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..base import get_env
+from ..error import EngineRaceError
+
+__all__ = ["enabled", "set_enabled", "begin", "finish", "wrap",
+           "note_read", "note_write", "note_create",
+           "pending", "raise_pending", "clear", "stats"]
+
+#: hot-path gate — read as ``race.enabled`` by engine.py / ndarray.py.
+#: A module-global bool keeps the flag-off cost to one attribute load
+#: and a falsy test: no per-op allocation, no thread-local traffic.
+enabled: bool = get_env("MXNET_ENGINE_RACE_CHECK", False, bool)
+
+_PENDING_CAP = 256     # keep the first N violations; count the rest
+_tls = threading.local()
+_lock = threading.Lock()
+_pending: list[EngineRaceError] = []
+
+
+def _fresh_stats():
+    return {"ops_checked": 0, "violations": 0, "undeclared_write": 0,
+            "undeclared_read": 0, "write_after_read": 0}
+
+
+_stats = _fresh_stats()
+
+
+class _OpRecord:
+    """Per-op access log: declared sets at push, observed sets at run."""
+
+    __slots__ = ("name", "const", "mutable", "reads", "writes", "created")
+
+    def __init__(self, name, const_vars, mutable_vars):
+        self.name = name
+        self.const = const_vars
+        self.mutable = mutable_vars
+        self.reads: dict = {}     # var -> version at first read
+        self.writes: dict = {}    # var -> True
+        self.created: dict = {}   # var -> True (op-local, exempt)
+
+
+def _stack():
+    st = getattr(_tls, "ops", None)
+    if st is None:
+        st = _tls.ops = []
+    return st
+
+
+def set_enabled(flag):
+    """Toggle the detector; ``None`` re-reads ``MXNET_ENGINE_RACE_CHECK``.
+
+    Registers/unregisters the ``race_check`` profiler stats provider so
+    ``profiler.dumps()`` carries the counters exactly while checking is
+    on.  Returns the previous value."""
+    global enabled
+    prev = enabled
+    enabled = (get_env("MXNET_ENGINE_RACE_CHECK", False, bool)
+               if flag is None else bool(flag))
+    from .. import profiler
+    if enabled:
+        profiler.register_stats_provider("race_check", stats)
+    else:
+        profiler.unregister_stats_provider("race_check", stats)
+        # drains are gated on the flag, so anything still banked would
+        # otherwise resurface at the first wait of a later epoch
+        with _lock:
+            _pending[:] = []
+    return prev
+
+
+# ---------------------------------------------------------------------------
+# op lifecycle (called by the engines)
+# ---------------------------------------------------------------------------
+
+def begin(name, const_vars, mutable_vars) -> _OpRecord:
+    """Open an access record for an op about to run on this thread."""
+    rec = _OpRecord(name, tuple(const_vars), tuple(mutable_vars))
+    _stack().append(rec)
+    return rec
+
+
+def _check(rec: _OpRecord):
+    declared = set(rec.const) | set(rec.mutable)
+    mutable = set(rec.mutable)
+    problems = []
+    for var in rec.writes:
+        if var in rec.created:
+            continue
+        if var not in mutable:
+            problems.append(("undeclared_write",
+                             f"op {rec.name!r} wrote {var!r} without "
+                             f"declaring it in mutable_vars — the engine "
+                             f"never serialized this write"))
+    for var, v0 in rec.reads.items():
+        if var in rec.created:
+            continue
+        if var not in declared:
+            problems.append(("undeclared_read",
+                             f"op {rec.name!r} read {var!r} without "
+                             f"declaring it in const_vars — a concurrent "
+                             f"writer may swap the buffer mid-read"))
+            continue  # one root cause, one violation
+        if var in mutable or var in rec.writes:
+            continue  # the op owns (or made) the writes it saw
+        v1 = getattr(var, "_version", v0)
+        if v1 != v0:
+            problems.append(("write_after_read",
+                             f"op {rec.name!r} read {var!r} at version "
+                             f"{v0} but it reached version {v1} before "
+                             f"the op finished — a concurrent write "
+                             f"interleaved with this read"))
+    with _lock:
+        _stats["ops_checked"] += 1
+        for kind, _ in problems:
+            _stats["violations"] += 1
+            _stats[kind] += 1
+    return [EngineRaceError(msg) for _, msg in problems]
+
+
+def finish(rec: _OpRecord, collect: bool):
+    """Close the record and check it.  ``collect=True`` (threaded/native
+    engines) banks violations for the next wait; ``collect=False``
+    (naive engine) raises the first violation directly."""
+    st = _stack()
+    if st and st[-1] is rec:
+        st.pop()
+    elif rec in st:          # defensive: interleaved begin/finish
+        st.remove(rec)
+    errs = _check(rec)
+    if not errs:
+        return
+    if collect:
+        with _lock:
+            for e in errs:
+                if len(_pending) < _PENDING_CAP:
+                    _pending.append(e)
+    else:
+        raise errs[0]
+
+
+def wrap(fn, name, const_vars, mutable_vars):
+    """Closure wrapper for engines that run ops on worker threads:
+    begin/finish bracket the actual execution, violations are banked
+    (collect mode) for the next ``wait_for_*``."""
+    def tracked():
+        rec = begin(name, const_vars, mutable_vars)
+        try:
+            fn()
+        finally:
+            finish(rec, collect=True)
+    return tracked
+
+
+# ---------------------------------------------------------------------------
+# access notifications (called by ndarray.py while enabled)
+# ---------------------------------------------------------------------------
+
+def note_read(var):
+    st = getattr(_tls, "ops", None)
+    if st:
+        rec = st[-1]
+        if var not in rec.reads:
+            rec.reads[var] = getattr(var, "_version", 0)
+
+
+def note_write(var):
+    st = getattr(_tls, "ops", None)
+    if st:
+        st[-1].writes[var] = True
+
+
+def note_create(var):
+    st = getattr(_tls, "ops", None)
+    if st:
+        st[-1].created[var] = True
+
+
+# ---------------------------------------------------------------------------
+# violation delivery / introspection
+# ---------------------------------------------------------------------------
+
+def pending():
+    """Snapshot of banked (not yet rethrown) violations."""
+    with _lock:
+        return list(_pending)
+
+
+def raise_pending():
+    """Rethrow the first banked violation (engine ``wait_for_*`` hook);
+    the full batch is attached as ``__notes__``-style context in the
+    message when several were collected."""
+    with _lock:
+        errs, _pending[:] = list(_pending), []
+    if not errs:
+        return
+    if len(errs) == 1:
+        raise errs[0]
+    head = errs[0]
+    raise EngineRaceError(
+        f"{head} (+{len(errs) - 1} more race violation(s); see "
+        f"analysis.race.stats())") from head
+
+
+def clear():
+    """Drop banked violations and zero the counters (test isolation)."""
+    global _stats
+    with _lock:
+        _pending[:] = []
+        _stats = _fresh_stats()
+
+
+def stats():
+    """Counter snapshot: ops checked, violations by kind, banked count."""
+    with _lock:
+        out = dict(_stats)
+        out["pending"] = len(_pending)
+    out["enabled"] = int(enabled)
+    return out
+
+
+if enabled:
+    # env-enabled at import (the CI race stage path): register the
+    # provider exactly as the runtime toggle would
+    set_enabled(True)

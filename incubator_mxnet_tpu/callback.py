@@ -23,7 +23,7 @@ class Speedometer:
         self.last_count = count
         if self.init:
             if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                speed = self.frequent * self.batch_size / (time.monotonic() - self.tic)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:
@@ -34,10 +34,10 @@ class Speedometer:
                 else:
                     logging.info("Epoch[%d] Batch [%d] Speed: %.2f samples/sec",
                                  param.epoch, count, speed)
-                self.tic = time.time()
+                self.tic = time.monotonic()
         else:
             self.init = True
-            self.tic = time.time()
+            self.tic = time.monotonic()
 
 
 class ProgressBar:

@@ -95,7 +95,7 @@ class AsyncCheckpointManager:
         removed — another manager's in-flight save into the same
         directory must not be torn out from under it."""
         import time
-        now = time.time()
+        now = time.time()  # mxlint: allow-wall-clock(staleness is judged against file mtimes, which are wall-clock)
         for entry in os.listdir(self.directory):
             if not _TMP_RE.match(entry):
                 continue
@@ -186,7 +186,7 @@ class AsyncCheckpointManager:
                 if os.path.exists(final):
                     shutil.rmtree(final)
                 os.rename(tmp, final)  # atomic publish
-        except BaseException as e:  # surfaced at the next wait()/save()
+        except BaseException as e:  # mxlint: allow-broad-except(banked sticky and rethrown at the next wait or save)
             self._error = e
             if single:
                 shutil.rmtree(tmp, ignore_errors=True)

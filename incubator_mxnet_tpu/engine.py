@@ -30,6 +30,7 @@ import traceback
 from collections import deque
 
 from . import fault
+from .analysis import race as _race
 from .base import get_env
 
 __all__ = ["Var", "Engine", "NaiveEngine", "ThreadedEngine", "get_engine", "set_engine"]
@@ -113,22 +114,41 @@ class NaiveEngine(Engine):
     def push(self, fn, const_vars=(), mutable_vars=(), name="op"):
         fault.inject("engine.push", detail=name)
         op = _OpBlock(fn, tuple(const_vars), tuple(mutable_vars), name)
+        rec = (_race.begin(name, op.const_vars, op.mutable_vars)
+               if _race.enabled else None)
         try:
             fn()
-        except Exception as e:  # noqa: BLE001 - engine boundary
+        except Exception as e:  # mxlint: allow-broad-except(engine boundary: banked sticky on the op and its vars, rethrown at wait_for_var)
             op.exc = e
             for v in op.mutable_vars:
                 v._exc = e
+        except BaseException:
+            # KeyboardInterrupt/SystemExit propagate, but the race
+            # record must still come off the thread-local stack or
+            # every later access on this thread leaks into it
+            if rec is not None:
+                _race.finish(rec, collect=True)
+                rec = None
+            raise
         for v in op.mutable_vars:
             v._version += 1
         op.done.set()
+        if rec is not None:
+            # synchronous engine: a declaration violation surfaces at
+            # the push that committed it (collect=False raises)
+            _race.finish(rec, collect=False)
         return op
 
     def wait_for_var(self, var):
         self.throw_pending(var)
+        if _race.enabled:
+            # violations banked on the BaseException push path drain
+            # here, not at some unrelated later engine's wait
+            _race.raise_pending()
 
     def wait_for_all(self):
-        pass
+        if _race.enabled:
+            _race.raise_pending()
 
 
 class ThreadedEngine(Engine):
@@ -161,6 +181,10 @@ class ThreadedEngine(Engine):
         dup = set(const_vars) & set(mutable_vars)
         if dup:
             const_vars = tuple(v for v in const_vars if v not in dup)
+        if _race.enabled:
+            # violations are banked and rethrown at wait_for_* (the
+            # sticky-exception contract); flag-off adds no allocation
+            fn = _race.wrap(fn, name, const_vars, mutable_vars)
         op = _OpBlock(fn, const_vars, mutable_vars, name)
         with self._cv:
             self._inflight += 1
@@ -239,7 +263,7 @@ class ThreadedEngine(Engine):
             exc = None
             try:
                 op.fn()
-            except Exception as e:  # noqa: BLE001 - engine boundary
+            except Exception as e:  # mxlint: allow-broad-except(engine boundary: banked sticky on the op and its vars, rethrown at wait_for_var)
                 exc = e
                 exc._engine_traceback = traceback.format_exc()
                 op.exc = e
@@ -258,11 +282,15 @@ class ThreadedEngine(Engine):
         probe = self.push(lambda: None, const_vars=(var,), name="wait_for_var")
         probe.done.wait()
         self.throw_pending(var)
+        if _race.enabled:
+            _race.raise_pending()
 
     def wait_for_all(self):
         with self._cv:
             while self._inflight:
                 self._cv.wait()
+        if _race.enabled:
+            _race.raise_pending()
 
 
 class NativeEngine(Engine):
@@ -295,7 +323,7 @@ class NativeEngine(Engine):
                     and getattr(eng, "_lib", None) is not None:
                 try:
                     eng._lib.MXTEngineDeleteVar(eng._h, self.handle)
-                except Exception:  # interpreter teardown
+                except Exception:  # mxlint: allow-broad-except(interpreter teardown: the native lib may already be unloaded)
                     pass
                 self.handle = None
 
@@ -333,7 +361,7 @@ class NativeEngine(Engine):
                         upstream_err.decode("utf-8", "replace")))
                 else:
                     fn()
-            except Exception as e:  # noqa: BLE001 - engine boundary
+            except Exception as e:  # mxlint: allow-broad-except(engine boundary: marshalled into the C error slot and rethrown at the next wait)
                 msg = f"{type(e).__name__}: {e}"
                 err_out[0] = libc.strdup(msg.encode("utf-8", "replace"))
                 holder.append(e)
@@ -361,14 +389,29 @@ class NativeEngine(Engine):
         dup = set(id(v) for v in const_vars) & set(id(v) for v in mutable_vars)
         if dup:
             const_vars = tuple(v for v in const_vars if id(v) not in dup)
+        if _race.enabled:
+            # bump python-side versions at op completion (inside the
+            # C-serialized slot), not at push: a push-time bump makes a
+            # correctly-declared concurrent reader look like it saw a
+            # write-after-read hazard
+            inner, bump_vars = fn, mutable_vars
+
+            def _run_and_bump():
+                try:
+                    inner()
+                finally:
+                    for v in bump_vars:
+                        v._version += 1
+            fn = _race.wrap(_run_and_bump, name, const_vars, mutable_vars)
         done_evt = threading.Event()
         holder: list = []
         with self._ops_lock:
             token = self._next_token[0]
             self._next_token[0] += 1
             self._ops[token] = (fn, done_evt, holder)
-        for v in mutable_vars:
-            v._version += 1
+        if not _race.enabled:
+            for v in mutable_vars:
+                v._version += 1
         self._native.check_call(self._lib.MXTEnginePush(
             self._h, self._trampoline, self._ctypes.c_void_p(token),
             self._var_array(const_vars), len(const_vars),
@@ -395,12 +438,16 @@ class NativeEngine(Engine):
         if rc != 0:
             msg = self._lib.MXTGetLastError().decode("utf-8", "replace")
             raise RuntimeError(msg)
+        if _race.enabled:
+            _race.raise_pending()
 
     def wait_for_all(self):
         rc = self._lib.MXTEngineWaitAll(self._h)
         if rc != 0:
             msg = self._lib.MXTGetLastError().decode("utf-8", "replace")
             raise RuntimeError(msg)
+        if _race.enabled:
+            _race.raise_pending()
 
     def throw_pending(self, var):
         self.wait_for_var(var)

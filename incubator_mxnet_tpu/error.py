@@ -14,7 +14,7 @@ from .base import MXNetError
 __all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
            "TypeError", "AttributeError", "NotImplementedError",
            "PSTimeoutError", "PSConnectionError", "CheckpointCorruptError",
-           "register_error", "get_error_class"]
+           "EngineRaceError", "register_error", "get_error_class"]
 
 _ERROR_REGISTRY = {}
 
@@ -83,3 +83,13 @@ class CheckpointCorruptError(MXNetError):
     """A checkpoint shard failed integrity verification (CRC mismatch,
     truncated file, or missing shards) — the checkpoint must not load
     silently."""
+
+
+@register_error
+class EngineRaceError(MXNetError):
+    """An engine op's actual NDArray accesses disagreed with its
+    declared ``const_vars``/``mutable_vars`` (undeclared write,
+    undeclared read, or a write-after-read version hazard), detected
+    under ``MXNET_ENGINE_RACE_CHECK=1`` (``analysis/race.py``).  The
+    message names the op and the variable so the missing declaration is
+    findable from the traceback alone."""

@@ -56,12 +56,26 @@ from .base import get_env
 __all__ = [
     "FaultInjected", "TransientFault", "PermanentFault",
     "parse_spec", "configure", "reset", "inject", "active_points",
-    "stats", "retry",
+    "declared_points", "stats", "retry",
 ]
 
+#: Central injection-point registry: THE authoritative list of names a
+#: ``fault.inject(...)`` call site or an ``MXNET_FAULT_SPEC`` entry may
+#: use.  mxlint's MX-FAULT rules statically cross-check this tuple
+#: against every ``inject`` call site (an undeclared point is a typo
+#: that silently never fires; a declared-but-unwired point is dead
+#: chaos coverage), and :func:`inject` enforces it at runtime whenever
+#: a spec is active.  Add the name HERE when adding an injection point.
 POINTS = ("kvstore.send", "kvstore.recv", "engine.push",
           "checkpoint.write", "io.next_batch",
           "serving.enqueue", "serving.execute")
+
+_POINT_SET = frozenset(POINTS)
+
+
+def declared_points() -> tuple:
+    """The registered injection-point names (static registry)."""
+    return POINTS
 
 
 class FaultInjected(Exception):
@@ -201,6 +215,12 @@ def inject(point: str, detail: str = ""):
     table = _active()
     if not table:
         return
+    if point not in _POINT_SET:
+        # only checked while chaos is configured: the no-spec hot path
+        # above stays a dict-truthiness test
+        raise ValueError(
+            f"fault.inject called with undeclared point {point!r} "
+            f"(declare it in fault.POINTS; known: {', '.join(POINTS)})")
     pt = table.get(point)
     if pt is None or not pt.should_fire():
         return

@@ -103,12 +103,12 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
         self.metrics = metrics
 
     def train_begin(self, estimator, *args, **kwargs):
-        self._start = time.time()
+        self._start = time.monotonic()
         logging.info("Training begin")
 
     def train_end(self, estimator, *args, **kwargs):
         logging.info("Training end; total time %.1fs",
-                     time.time() - self._start)
+                     time.monotonic() - self._start)
 
     def epoch_end(self, estimator, *args, **kwargs):
         msgs = []

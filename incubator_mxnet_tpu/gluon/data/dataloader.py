@@ -77,7 +77,7 @@ def _tree_to_shm(tree):
     # shutdown does not try to destroy segments it no longer owns
     try:
         resource_tracker.unregister("/" + name, "shared_memory")
-    except Exception:
+    except Exception:  # mxlint: allow-broad-except(tracker unregister is best-effort; ownership already transferred to the parent)
         pass
     return ("array", name, tree.shape, str(tree.dtype))
 
@@ -127,7 +127,7 @@ def _worker_loop(dataset, batchify_fn, key_queue, result_queue):
         try:
             batch = batchify_fn([dataset[i] for i in indices])
             result_queue.put((seq, "ok", _tree_to_shm(batch)))
-        except Exception:  # noqa: BLE001 — ship the traceback to the parent
+        except Exception:  # mxlint: allow-broad-except(worker failure ships to the parent as an error result with the traceback)
             result_queue.put((seq, "error", traceback.format_exc()))
 
 

@@ -401,7 +401,7 @@ class P3KVStore(DistKVStore):
             try:
                 self._push_slice(key, idx, chunk)
                 err = None
-            except Exception as e:  # surface on the next pull
+            except Exception as e:  # mxlint: allow-broad-except(banked as the sender error and rethrown on the next pull)
                 err = e
             with self._cv:
                 if err is not None:

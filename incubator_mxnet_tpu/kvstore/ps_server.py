@@ -150,7 +150,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     return
                 try:
                     resp = self._dispatch(st, cmd, key, payload)
-                except Exception as e:  # surfaced client-side as an error
+                except Exception as e:  # mxlint: allow-broad-except(marshalled into the response tuple and raised client-side)
                     resp = (False, f"{type(e).__name__}: {e}")
                 _send_msg(sock, resp)
         except _CleanClose:

@@ -170,7 +170,7 @@ class BaseModule:
             eval_metric = _metric.create(eval_metric)
 
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            tic = time.monotonic()
             eval_metric.reset()
             for nbatch, data_batch in enumerate(train_data):
                 self.forward_backward(data_batch)
@@ -183,7 +183,7 @@ class BaseModule:
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
-                             time.time() - tic)
+                             time.monotonic() - tic)
             arg_p, aux_p = self.get_params()
             if epoch_end_callback is not None:
                 for cb in _as_list(epoch_end_callback):

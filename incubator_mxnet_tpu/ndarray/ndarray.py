@@ -35,6 +35,7 @@ from ..base import dtype_from_any, integer_types, numeric_types
 from ..context import Context, current_context
 from .. import engine as _engine_mod
 from .. import profiler as _profiler
+from ..analysis import race as _race
 from ..ops import bulking as _bulking
 
 __all__ = ["NDArray", "_wrap_outputs", "_to_jax"]
@@ -86,6 +87,10 @@ class _Chunk:
         self.array = array
         self.ctx = ctx
         self.var = _engine_mod.get_engine().new_variable("ndarray")
+        if _race.enabled:
+            # arrays born inside an engine closure are op-local: exempt
+            # from that op's declared read/write sets (analysis/race.py)
+            _race.note_create(self.var)
         if _profiler._alloc_tracking and not _is_tracer(array):
             # storage-profiler hook (reference storage_profiler.cc):
             # tag this chunk's bytes with the active profiler scope
@@ -93,12 +98,14 @@ class _Chunk:
                 _profiler.record_alloc(
                     array.size * array.dtype.itemsize, array.shape,
                     array.dtype, ctx)
-            except Exception:
+            except Exception:  # mxlint: allow-broad-except(best-effort profiler attribution must never fail an allocation)
                 pass
 
     def write(self, new_array):
         self.array = new_array
         self.var._version += 1
+        if _race.enabled:
+            _race.note_write(self.var)
 
 
 class NDArray:
@@ -138,6 +145,8 @@ class NDArray:
         (deferred segment output, ops/bulking.py) flushes its segment
         here and the concrete value is swapped in — no version bump,
         materialization is not a write."""
+        if _race.enabled:
+            _race.note_read(self._chunk.var)
         a = self._chunk.array
         if type(a) is _bulking.PendingArray:
             v = _bulking.resolve(a)

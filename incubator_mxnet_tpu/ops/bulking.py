@@ -229,7 +229,7 @@ def defer(op, all_in, n_pos, kw_names, kwargs):
 
         try:
             out_avals = jax.eval_shape(f, *specs)
-        except Exception:
+        except Exception:  # mxlint: allow-broad-except(abstract-eval probe: any failure means not deferrable, run eagerly)
             return NOT_DEFERRED
         flat = (tuple(out_avals) if isinstance(out_avals, (tuple, list))
                 else (out_avals,))
@@ -311,8 +311,14 @@ def _flush_locked(seg: _Segment):
                     elif a.segment is seg:
                         srcs.append(("n",) + a._slot)
                         continue
-                    else:                  # foreign unflushed (defensive):
-                        a = resolve(a)     # may rethrow that segment's exc
+                    else:
+                        # foreign unflushed (defensive): may rethrow that
+                        # segment's sticky exc.  Safe nested acquire: defer()
+                        # only appends pendings of the thread's CURRENT
+                        # segment raw, so a foreign pending here is always a
+                        # strictly OLDER segment of this thread — lock order
+                        # follows segment age and cannot cycle.
+                        a = resolve(a)  # mxlint: disable=MX-LOCK001(segment locks are ordered by creation age - a foreign pending always belongs to a strictly older segment)
                 i = ext_ids.get(id(a))
                 if i is None:
                     i = ext_ids[id(a)] = len(ext)

@@ -40,7 +40,7 @@ def interpret_mode() -> bool:
     """Pallas interpret mode: on unless running on a real TPU backend."""
     try:
         return jax.default_backend() != "tpu"
-    except Exception:  # backend init failure → interpreter is safe
+    except Exception:  # mxlint: allow-broad-except(backend init failure of any kind means interpret mode is the safe answer)
         return True
 
 

@@ -57,7 +57,7 @@ def start(profile_process="worker"):
         try:
             jax.profiler.start_trace(xdir)
             _state["xprof_active"] = True
-        except Exception:  # already tracing or unsupported platform
+        except Exception:  # mxlint: allow-broad-except(xprof is best-effort: already tracing or unsupported platform)
             _state["xprof_active"] = False
     if _config.get("profile_memory"):
         _start_memory_sampler()
@@ -176,7 +176,7 @@ def provider_stats():
     for name, fn in list(_stats_providers.items()):
         try:
             out[name] = fn()
-        except Exception as e:
+        except Exception as e:  # mxlint: allow-broad-except(a broken stats provider is reported as an error entry, never breaks dumps)
             out[name] = {"error": f"{type(e).__name__}: {e}"}
     return out
 
@@ -249,7 +249,7 @@ def _memory_snapshot():
                 ctypes.byref(allocated), ctypes.byref(pooled)))
             samples["host_pool"] = {"bytes_allocated": allocated.value,
                                     "bytes_pooled": pooled.value}
-    except Exception:
+    except Exception:  # mxlint: allow-broad-except(memory sampling is best-effort; a failed probe skips the sample)
         pass
     return samples
 
@@ -341,7 +341,7 @@ class scope:
         try:
             self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
             self._jax_ctx.__enter__()
-        except Exception:
+        except Exception:  # mxlint: allow-broad-except(TraceAnnotation is cosmetic; scope timing works without it)
             self._jax_ctx = None
         return self
 
@@ -444,6 +444,6 @@ def device_memory_profile():
                 stats[str(d)] = {"bytes_in_use": ms.get("bytes_in_use"),
                                  "peak_bytes_in_use": ms.get("peak_bytes_in_use"),
                                  "bytes_limit": ms.get("bytes_limit")}
-        except Exception:
+        except Exception:  # mxlint: allow-broad-except(per-device stats probe; an unsupported device is skipped)
             continue
     return stats

@@ -70,7 +70,7 @@ class MXRecordIO:
         if getattr(self, "_nh", None) is not None:
             try:
                 self._nh_free(self._nh)
-            except Exception:  # interpreter teardown
+            except Exception:  # mxlint: allow-broad-except(interpreter teardown: the native lib may already be unloaded)
                 pass
             self._nh = None
             self.record = None

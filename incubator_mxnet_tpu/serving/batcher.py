@@ -304,7 +304,7 @@ class DynamicBatcher:
             out = fault.retry(run, max_attempts=self._retries,
                               backoff=0.01, max_backoff=0.5)
             compute_ms = (time.monotonic() - t_exec) * 1000.0
-        except Exception as e:
+        except Exception as e:  # mxlint: allow-broad-except(wrapped as ServingError and delivered to every request in the batch)
             err = e if isinstance(e, ServingError) else ServingError(
                 f"batch execution failed for {self.name!r}: "
                 f"{type(e).__name__}: {e}")

@@ -159,7 +159,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(code, {"error": "TransientFault",
                               "message": str(e)},
                        extra_headers={"Retry-After": "1"})
-        except Exception as e:
+        except Exception as e:  # mxlint: allow-broad-except(HTTP boundary: any error becomes a 500 response)
             code = 500
             self._send(code, {"error": type(e).__name__,
                               "message": str(e)})
@@ -185,7 +185,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(e.http_status, e.payload())
             if e.http_status != 404 and self.app.repository.has(name):
                 self.app.metrics.record_request(name, e.http_status)
-        except Exception as e:
+        except Exception as e:  # mxlint: allow-broad-except(HTTP boundary: any error becomes a 500 response)
             self._send(500, {"error": type(e).__name__,
                              "message": str(e)})
             if self.app.repository.has(name):

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""mxlint CLI — framework-aware static analysis driver.
+
+Usage:
+    python tools/mxlint.py [paths...]            # default: the package
+    python tools/mxlint.py --json                # machine-readable
+    python tools/mxlint.py --write-baseline      # accept current findings
+    python tools/mxlint.py --baseline ci/mxlint_baseline.json
+
+Exit status: 0 when no unsuppressed findings, 1 on regressions (or a
+bad invocation).  Rule catalog / pragma syntax: docs/static_analysis.md.
+
+The analyzer (``incubator_mxnet_tpu/analysis/mxlint.py``) is pure
+stdlib; it is loaded straight from its file here so linting never
+imports the framework (and therefore never needs jax installed).
+"""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ANALYZER = os.path.join(REPO, "incubator_mxnet_tpu", "analysis",
+                         "mxlint.py")
+DEFAULT_BASELINE = os.path.join(REPO, "ci", "mxlint_baseline.json")
+
+
+def _load_analyzer():
+    spec = importlib.util.spec_from_file_location("_mxlint", _ANALYZER)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="mxlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("paths", nargs="*",
+                   default=[os.path.join(REPO, "incubator_mxnet_tpu")],
+                   help="files/directories to lint (default: the package)")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline JSON (default: {DEFAULT_BASELINE} "
+                        "when it exists)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to the baseline file "
+                        "(each entry needs a reason filled in) and exit 0")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings as JSON")
+    p.add_argument("--docs", default=None,
+                   help="env_vars.md path (default: <repo>/docs/env_vars.md)")
+    args = p.parse_args(argv)
+
+    mxlint = _load_analyzer()
+    findings = mxlint.lint_paths(args.paths, repo_root=REPO,
+                                 docs_path=args.docs)
+
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None)
+
+    if args.write_baseline:
+        path = args.baseline or DEFAULT_BASELINE
+        payload = {"findings": [
+            dict(rule=f.rule, file=f.file, message=f.message,
+                 reason="TODO: justify or fix") for f in findings]}
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2)
+            fh.write("\n")
+        print(f"[mxlint] wrote {len(findings)} finding(s) to {path}; "
+              "fill in each 'reason'")
+        return 0
+
+    baseline = (mxlint.load_baseline(baseline_path)
+                if baseline_path else {})
+    regressions, suppressed, stale = mxlint.apply_baseline(findings,
+                                                           baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "regressions": [f.as_dict() for f in regressions],
+            "suppressed": [f.as_dict() for f in suppressed],
+            "stale_baseline": [list(k) for k in stale],
+        }, indent=2))
+    else:
+        if regressions:
+            print(mxlint.render(regressions))
+        for key in stale:
+            print(f"[mxlint] note: stale baseline entry {key} — the "
+                  "finding is gone, drop it from the baseline")
+        print(f"[mxlint] {len(regressions)} finding(s), "
+              f"{len(suppressed)} baselined, {len(stale)} stale "
+              f"baseline entr{'y' if len(stale) == 1 else 'ies'}")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
