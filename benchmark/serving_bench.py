@@ -210,15 +210,36 @@ def bench(args):
 
 def smoke(args):
     """CI serving stage: ephemeral HTTP server end-to-end."""
-    import urllib.request
-    from incubator_mxnet_tpu import deploy
-    from incubator_mxnet_tpu.serving import InferenceServer
-
     prefix = os.path.join(args.workdir, "serving_smoke_model")
     if args.model_zoo:
         _zoo_artifact(prefix, args.model_zoo)
     else:
         _toy_artifact(prefix)
+    # recompile sentinel (docs/graph_analysis.md): observe the
+    # predictor sites through warmup + traffic — the signature count
+    # must be FLAT after warmup (the serving bucketing contract)
+    from incubator_mxnet_tpu.analysis import recompile as _rc
+    _prev_sentinel = _rc.set_mode("warn")
+
+    def _predictor_compiles():
+        return sum(s["compiles"]
+                   for name, s in _rc.stats()["per_site"].items()
+                   if name.startswith("predictor:"))
+
+    try:
+        return _smoke_instrumented(args, prefix, _predictor_compiles)
+    finally:
+        # a failed scrape/request must not leak warn-mode into later
+        # benchmarks in this process (it would instrument new jit
+        # sites and skew the numbers this suite measures)
+        _rc.set_mode(_prev_sentinel)
+
+
+def _smoke_instrumented(args, prefix, _predictor_compiles):
+    import urllib.request
+    from incubator_mxnet_tpu import deploy
+    from incubator_mxnet_tpu.serving import InferenceServer
+
     pred = deploy.load_predictor(prefix)
     n = min(args.requests, 16)
     instances = _instances(pred.meta, n, seed=2)
@@ -238,6 +259,7 @@ def smoke(args):
         raise AssertionError("compile_total not in /metrics")
 
     compiles_warm = scrape_compiles()
+    sentinel_warm = _predictor_compiles()
     codes, results = [None] * n, [None] * n
 
     def call(i):
@@ -257,6 +279,7 @@ def smoke(args):
     for t in threads:
         t.join()
     compiles_after = scrape_compiles()
+    sentinel_after = _predictor_compiles()
     health = json.loads(urllib.request.urlopen(
         f"http://127.0.0.1:{port}/healthz", timeout=30).read())
     srv.shutdown()
@@ -278,6 +301,8 @@ def smoke(args):
         "requests": n,
         "compile_total": compiles_after,
         "compile_stable": compiles_after == compiles_warm,
+        "sentinel_compiles": sentinel_after,
+        "sentinel_flat": sentinel_after == sentinel_warm,
         "bitwise_equal_unbatched": bool(ok_bitwise),
         "allclose_unbatched": bool(ok_close),
         "health": health["status"],
@@ -289,6 +314,10 @@ def smoke(args):
     if not rec["compile_stable"]:
         failures.append(
             f"compile count moved {compiles_warm}->{compiles_after}")
+    if not rec["sentinel_flat"]:
+        failures.append(
+            f"recompile sentinel saw predictor compiles after warmup "
+            f"({sentinel_warm}->{sentinel_after})")
     # conv models (the zoo path) reassociate across batch sizes at ULP
     # level, so the wire gate is allclose; the MLP path must stay
     # bitwise (tests/test_serving.py holds the strict contract)

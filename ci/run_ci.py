@@ -30,6 +30,14 @@ Stages (each isolated, failures collected, nonzero exit if any fail):
              MXNET_ENGINE_RACE_CHECK=1 so every op's actual NDArray
              accesses are checked against its declared read/write sets
              (an undeclared access raises EngineRaceError mid-test)
+  graphlint  IR-level lint of traced graphs (docs/graph_analysis.md):
+             jaxpr passes over a real model-zoo net (infer + train)
+             and the curated central-op sweep must report ZERO
+             findings (f64 leaks, mixed-precision promotion, bf16
+             accumulation, baked constants, dead compute, host
+             callbacks, degenerate tile layouts); plus a recompile-
+             sentinel smoke — a bucketed-shape replay stays inside its
+             per-site XLA compile budget with the sentinel raising
 
 Usage:
   python ci/run_ci.py                  # everything
@@ -216,6 +224,38 @@ def stage_race(args):
     return proc.returncode == 0, f"race-check on: {tail}"
 
 
+def stage_graphlint(args):
+    """IR lint over the compiled surface CI can afford (a real zoo net
+    both modes + the op sweep + the seeded-violation selftest,
+    tools/graphlint.py exit 0 against the empty baseline) and the
+    recompile-sentinel bucketed-replay smoke."""
+    proc = sh([sys.executable, "tools/graphlint.py", "--zoo", "resnet18_v1",
+               "--batch", "4", "--ops-smoke", "--selftest"], timeout=900)
+    if proc.returncode != 0:
+        # stderr first: a crash traceback must not be hidden behind
+        # the selftest's stdout progress lines
+        return False, (proc.stderr or proc.stdout).strip()[-600:]
+    out = (proc.stdout or proc.stderr).strip()
+    tail = out.splitlines()[-1] if out else ""
+    code = (
+        "import incubator_mxnet_tpu as mx\n"
+        "from incubator_mxnet_tpu.analysis import recompile as rc\n"
+        "buckets = [1, 2, 4, 8]\n"
+        "with rc.sentinel_scope('raise', len(buckets) + 1):\n"
+        "    for _ in range(3):\n"
+        "        for b in buckets:\n"
+        "            mx.nd.ones((b, 8)).sum().asscalar()\n"
+        "s = rc.stats()\n"
+        "assert s['storming_sites'] == [], s\n"
+        "assert s['compiles_total'] <= len(buckets) + 1, s\n"
+        "print('sentinel: %d compiles over %d replayed buckets'\n"
+        "      % (s['compiles_total'], len(buckets)))\n")
+    proc2 = sh([sys.executable, "-c", code], timeout=600)
+    if proc2.returncode != 0:
+        return False, f"sentinel smoke: {(proc2.stderr or proc2.stdout)[-300:]}"
+    return True, f"{tail}; {proc2.stdout.strip()}"
+
+
 def stage_multichip(args):
     code = "import __graft_entry__ as g; g.dryrun_multichip(8)"
     proc = sh([sys.executable, "-c", code], timeout=1200)
@@ -238,6 +278,7 @@ STAGES = {"build": stage_build, "sanity": stage_sanity,
           "unit": stage_unit, "slow": stage_slow,
           "bulking": stage_bulking, "chaos": stage_chaos,
           "serving": stage_serving, "race": stage_race,
+          "graphlint": stage_graphlint,
           "multichip": stage_multichip, "bench": stage_bench}
 
 

@@ -1,27 +1,40 @@
 """Static and dynamic correctness analysis for the framework.
 
-Two halves (docs/static_analysis.md):
+Four halves (docs/static_analysis.md, docs/graph_analysis.md):
 
 * :mod:`.mxlint` — AST-based, framework-aware static linter whose rules
   encode this framework's invariants (env-var/docs sync, fault-point
   registry wiring, monotonic-clock discipline, bulkable-op purity,
   lock-order consistency, typed-error propagation).  CLI:
   ``python tools/mxlint.py`` (pure stdlib — importable without jax).
+* :mod:`.graphlint` — IR-level static analysis of *traced* graphs:
+  jaxpr passes over every surface the framework compiles (eager ops,
+  bulked segments, hybridized blocks, Symbol executors, fused train
+  steps, deploy exports) encoding TPU invariants — f64 leaks, implicit
+  mixed-precision promotion, low-precision accumulation, baked-in
+  constants, dead compute, host callbacks, degenerate tile layouts.
+  CLI: ``python tools/graphlint.py``.
+* :mod:`.recompile` — the recompilation sentinel
+  (``MXNET_RECOMPILE_SENTINEL=warn|raise``): every jit-owning layer
+  reports each XLA compilation per site; signature churn past
+  ``MXNET_RECOMPILE_WARN`` is diagnosed (which arg varied) and
+  warned/raised as ``RecompileStormError``.
 * :mod:`.race` — dynamic dependency-engine race detector
   (``MXNET_ENGINE_RACE_CHECK=1``): verifies each engine op's actual
   NDArray accesses against its declared ``const_vars``/``mutable_vars``.
 
-``race`` is imported eagerly (the engine hot path reads its flag);
-``mxlint`` stays lazy so importing the package never pays the linter's
-setup, and the linter never pays the package's jax import.
+``race`` and ``recompile`` are imported eagerly (hot paths read their
+flags); ``mxlint`` and ``graphlint`` stay lazy so importing the package
+never pays their setup — and mxlint never pays (or needs) jax at all.
 """
 from . import race
+from . import recompile
 
-__all__ = ["race", "mxlint"]
+__all__ = ["race", "recompile", "mxlint", "graphlint"]
 
 
 def __getattr__(name):
-    if name == "mxlint":
+    if name in ("mxlint", "graphlint"):
         import importlib
-        return importlib.import_module(".mxlint", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,86 @@
+"""Shared finding / baseline machinery for the analysis tools.
+
+Both analyzers — :mod:`.mxlint` (AST over source text) and
+:mod:`.graphlint` (passes over traced jaxprs) — report through the same
+:class:`Finding` shape and the same baseline contract, so one review
+workflow covers both:
+
+* a finding's identity for baselines is the ``(rule, file, message)``
+  triple — line numbers drift, messages don't;
+* a baseline entry suppresses its finding only with a *written* reason
+  (the ``TODO`` stub ``--write-baseline`` emits keeps CI failing);
+* stale entries (finding fixed, entry left behind) are reported so the
+  baseline shrinks back.
+
+This module is pure stdlib and, like mxlint, must stay loadable
+standalone (``importlib`` straight from the file, no package): the
+mxlint CLI lints without importing jax.
+"""
+from __future__ import annotations
+
+import json
+
+__all__ = ["Finding", "load_baseline", "apply_baseline", "render"]
+
+
+class Finding:
+    """One analysis finding; identity for baselines is
+    ``(rule, file, message)``.  ``severity`` is ``"error"`` (gates CI)
+    or ``"advisory"`` (reported, does not gate by default)."""
+
+    __slots__ = ("rule", "file", "line", "message", "severity")
+
+    def __init__(self, rule, file, line, message, severity="error"):
+        self.rule = rule
+        self.file = file
+        self.line = int(line)
+        self.message = message
+        self.severity = severity
+
+    @property
+    def key(self):
+        return (self.rule, self.file, self.message)
+
+    def as_dict(self):
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "message": self.message, "severity": self.severity}
+
+    def __repr__(self):
+        return f"{self.file}:{self.line}: {self.rule} {self.message}"
+
+
+def load_baseline(path):
+    """Load a baseline file → ``{(rule, file, message): reason}``."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    out = {}
+    for entry in data.get("findings", []):
+        out[(entry["rule"], entry["file"], entry["message"])] = \
+            entry.get("reason", "")
+    return out
+
+
+def _baseline_justified(reason):
+    """Baseline entries need a written reason, exactly like pragmas —
+    the ``TODO`` stub ``--write-baseline`` emits does not suppress."""
+    reason = (reason or "").strip()
+    return bool(reason) and not reason.upper().startswith("TODO")
+
+
+def apply_baseline(findings, baseline):
+    """Split into ``(regressions, suppressed, stale_keys)``.  An entry
+    with an empty or ``TODO`` reason does not suppress its finding."""
+    live = {f.key for f in findings}
+    regressions = [f for f in findings
+                   if not _baseline_justified(baseline.get(f.key))]
+    suppressed = [f for f in findings
+                  if _baseline_justified(baseline.get(f.key))]
+    stale = [k for k in baseline if k not in live]
+    return regressions, suppressed, stale
+
+
+def render(findings):
+    lines = []
+    for f in findings:
+        lines.append(f"{f.file}:{f.line}: {f.rule}: {f.message}")
+    return "\n".join(lines)

@@ -52,14 +52,31 @@ path is a directory.
 
 This module is deliberately import-light (stdlib only): the CLI
 ``tools/mxlint.py`` loads it straight from the file so linting never
-pays — or requires — the framework's jax import.
+pays — or requires — the framework's jax import.  The finding/baseline
+machinery is shared with graphlint via :mod:`.findings` (same identity
+contract, same written-reason rule), loaded by file when this module
+itself was loaded standalone.
 """
 from __future__ import annotations
 
 import ast
-import json
 import os
 import re
+
+try:
+    from .findings import (Finding, load_baseline, apply_baseline,
+                           render)
+except ImportError:   # standalone file-load (tools/mxlint.py, no package)
+    import importlib.util as _ilu
+    _p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "findings.py")
+    _spec = _ilu.spec_from_file_location("_mxlint_findings", _p)
+    _mod = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_mod)
+    Finding = _mod.Finding
+    load_baseline = _mod.load_baseline
+    apply_baseline = _mod.apply_baseline
+    render = _mod.render
 
 __all__ = ["RULES", "Finding", "lint_paths", "load_baseline",
            "apply_baseline", "render"]
@@ -86,29 +103,6 @@ _PRAGMA_RE = re.compile(
     r"\((.+)\)")  # greedy: reasons may themselves contain parens
 _PRAGMA_KEYS = {"allow-broad-except": "MX-EXC001",
                 "allow-wall-clock": "MX-TIME001"}
-
-
-class Finding:
-    """One lint finding; identity for baselines is (rule, file, message)."""
-
-    __slots__ = ("rule", "file", "line", "message")
-
-    def __init__(self, rule, file, line, message):
-        self.rule = rule
-        self.file = file
-        self.line = int(line)
-        self.message = message
-
-    @property
-    def key(self):
-        return (self.rule, self.file, self.message)
-
-    def as_dict(self):
-        return {"rule": self.rule, "file": self.file, "line": self.line,
-                "message": self.message}
-
-    def __repr__(self):
-        return f"{self.file}:{self.line}: {self.rule} {self.message}"
 
 
 class _File:
@@ -718,42 +712,5 @@ def lint_paths(paths, repo_root=None, docs_path=None, fault_points=None):
     return findings
 
 
-# ---------------------------------------------------------------------------
-# baseline
-# ---------------------------------------------------------------------------
-
-def load_baseline(path):
-    """Load a baseline file → {(rule, file, message): reason}."""
-    with open(path, "r", encoding="utf-8") as f:
-        data = json.load(f)
-    out = {}
-    for entry in data.get("findings", []):
-        out[(entry["rule"], entry["file"], entry["message"])] = \
-            entry.get("reason", "")
-    return out
-
-
-def _baseline_justified(reason):
-    """Baseline entries need a written reason, exactly like pragmas —
-    the ``TODO`` stub ``--write-baseline`` emits does not suppress."""
-    reason = (reason or "").strip()
-    return bool(reason) and not reason.upper().startswith("TODO")
-
-
-def apply_baseline(findings, baseline):
-    """Split into (regressions, suppressed, stale_keys).  An entry with
-    an empty or ``TODO`` reason does not suppress its finding."""
-    live = {f.key for f in findings}
-    regressions = [f for f in findings
-                   if not _baseline_justified(baseline.get(f.key))]
-    suppressed = [f for f in findings
-                  if _baseline_justified(baseline.get(f.key))]
-    stale = [k for k in baseline if k not in live]
-    return regressions, suppressed, stale
-
-
-def render(findings):
-    lines = []
-    for f in findings:
-        lines.append(f"{f.file}:{f.line}: {f.rule}: {f.message}")
-    return "\n".join(lines)
+# baseline machinery: shared with graphlint — see .findings
+# (load_baseline / apply_baseline / render imported at the top)

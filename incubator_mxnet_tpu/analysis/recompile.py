@@ -1,0 +1,300 @@
+"""Recompilation sentinel — catches XLA compile storms at their source.
+
+On TPU every distinct (shape, dtype, static-arg) signature a jitted
+entry point sees is a fresh XLA compilation: seconds of latency, HBM
+for another executable, and — in a server — a cold request paying the
+whole bill.  The classic failure is *signature churn*: a varying batch
+dimension, a python float that should be an array, a per-step static
+kwarg.  Each compile looks innocent; the storm only shows up as "TPU
+is slow" hours later.  (The serving layer already buckets shapes for
+exactly this reason — the sentinel is the detector for every OTHER
+entry point, and the proof the bucketing holds.)
+
+Mechanism: every layer of the framework that creates a jitted callable
+(``ops.registry.Op.jitted``, the bulking trace cache, ``CachedOp``,
+the Symbol ``Executor``, ``FusedTrainStep``, the deploy ``Predictor``)
+wraps the *python function it hands to jit* in :func:`instrument`.
+The wrapper body only ever executes while jax is TRACING — a jit cache
+hit never re-enters python — so each execution of the wrapper IS one
+compilation, observed with zero instrumentation on the warm path.
+With the sentinel off, :func:`instrument` returns the function
+untouched: the flag-off cost is one module-global read at jit-creation
+time, nothing per call.
+
+Per site the sentinel keeps a compile count, the last signature, and a
+bounded set of distinct-signature hashes.  When a site exceeds
+``MXNET_RECOMPILE_WARN`` compiles it diagnoses the churn — WHICH
+argument changed between the last two signatures, and whether the same
+signature is being re-traced (a cache being dropped) — and either
+warns (``MXNET_RECOMPILE_SENTINEL=warn``) or raises
+:class:`~..error.RecompileStormError` (``=raise``).  A ``recompile``
+profiler stats provider reports the counters while the sentinel is on.
+"""
+from __future__ import annotations
+
+import threading
+import warnings
+
+from ..base import get_env
+
+__all__ = ["enabled", "set_mode", "sentinel_scope", "instrument",
+           "record_compile", "signature_of", "stats", "reset"]
+
+_lock = threading.Lock()
+_MAX_DISTINCT_TRACKED = 4096   # per-site signature-hash set bound
+
+
+class _Site:
+    __slots__ = ("compiles", "distinct", "retraces", "last_sig",
+                 "last_change", "storms")
+
+    def __init__(self):
+        self.compiles = 0
+        self.distinct = set()
+        self.retraces = 0          # same signature traced again
+        self.last_sig = None
+        self.last_change = None
+        self.storms = 0
+
+
+_sites: dict[str, _Site] = {}
+
+_mode: "str | None | bool" = False      # False = read env at first use
+_limit: "int | None" = None
+
+
+def _env_mode():
+    raw = str(get_env("MXNET_RECOMPILE_SENTINEL", "0")).strip().lower()
+    if raw in ("", "0", "off", "false", "none"):
+        return None
+    if raw == "raise":
+        return "raise"
+    return "warn"          # "1"/"warn"/anything affirmative
+
+
+def enabled() -> "str | None":
+    """Sentinel mode: ``None`` (off), ``"warn"`` or ``"raise"``.  The
+    env var is read once (jit-creation path); runtime toggles go
+    through :func:`set_mode`/:class:`sentinel_scope`."""
+    global _mode
+    if _mode is False:
+        _mode = _env_mode()
+        if _mode is not None:   # env-enabled: report like set_mode does
+            from .. import profiler
+            profiler.register_stats_provider("recompile", stats)
+    return _mode
+
+
+def limit() -> int:
+    global _limit
+    if _limit is None:
+        _limit = max(1, get_env("MXNET_RECOMPILE_WARN", 10, int))
+    return _limit
+
+
+def set_mode(mode, storm_limit=None):
+    """Set the sentinel mode (``None``/``"warn"``/``"raise"``), and
+    optionally the per-site compile limit.  Returns the previous mode.
+
+    NOTE: sites wrap their python fn at jit-creation time, so enabling
+    at runtime only instruments executables compiled afterwards — set
+    the env var (or call this before building the model), or clear the
+    jit caches (``ops.registry.clear_caches()``) to re-wrap.
+    """
+    global _mode, _limit
+    if mode not in (None, "warn", "raise"):
+        raise ValueError(f"sentinel mode must be None/'warn'/'raise', "
+                         f"got {mode!r}")
+    prev = enabled()
+    _mode = mode
+    if storm_limit is not None:
+        _limit = max(1, int(storm_limit))
+    from .. import profiler
+    if mode is not None:
+        profiler.register_stats_provider("recompile", stats)
+    else:
+        profiler.unregister_stats_provider("recompile", stats)
+    return prev
+
+
+class sentinel_scope:
+    """``with sentinel_scope("raise", limit=4): ...`` — tests/benchmarks."""
+
+    def __init__(self, mode, storm_limit=None):
+        self._mode = mode
+        self._storm_limit = storm_limit
+        self._prev = None
+        self._prev_limit = None
+
+    def __enter__(self):
+        self._prev_limit = _limit
+        self._prev = set_mode(self._mode, self._storm_limit)
+        return self
+
+    def __exit__(self, *exc):
+        global _limit
+        set_mode(self._prev)
+        _limit = self._prev_limit
+        return False
+
+
+# ---------------------------------------------------------------------------
+# observation
+# ---------------------------------------------------------------------------
+
+def signature_of(args, kwargs=None):
+    """Compile signature of a call: array args by (shape, dtype) —
+    tracers included, that is what the wrapper sees — everything else
+    (static kwargs) by a short repr."""
+    sig = []
+    for a in args:
+        sig.append(_one(a))
+    for k in sorted(kwargs or ()):
+        # keep the full _one tuple (kind included) so _diff can still
+        # tell a varying static kwarg from a varying array shape
+        sig.append(("kw:" + k,) + _one(kwargs[k]))
+    return tuple(sig)
+
+
+def _one(a):
+    shape = getattr(a, "shape", None)
+    dtype = getattr(a, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("arr", tuple(shape), str(dtype))
+    if isinstance(a, (list, tuple)):
+        return ("tree", tuple(_one(x) for x in a))
+    if isinstance(a, dict):
+        return ("tree", tuple((k, _one(v)) for k, v in sorted(a.items())))
+    r = repr(a)
+    return ("static", r if len(r) <= 80 else r[:77] + "...")
+
+
+def instrument(fn, site):
+    """Wrap ``fn`` so each execution (== each jax trace of it) records
+    one compile event for ``site``.  Identity when the sentinel is off.
+
+    The wrapper forwards ``__wrapped__`` so ``inspect.signature`` (and
+    therefore ``jax.jit(static_argnames=...)``) resolves against the
+    real function.
+    """
+    if enabled() is None:
+        return fn
+
+    def traced(*args, **kwargs):
+        record_compile(site, signature_of(args, kwargs))
+        return fn(*args, **kwargs)
+
+    try:
+        traced.__name__ = fn.__name__
+        traced.__qualname__ = fn.__qualname__
+    except AttributeError:
+        pass   # arbitrary callables (bound methods of C objects)
+    traced.__wrapped__ = fn
+    return traced
+
+
+def record_compile(site, sig):
+    """Record one compilation of ``site`` with signature ``sig`` (from
+    :func:`signature_of`); diagnoses and reports a storm past the
+    limit.  Public so cache layers that detect their own misses (the
+    bulking trace cache) can report without wrapping."""
+    mode = enabled()
+    if mode is None:
+        return
+    lim = limit()
+    with _lock:
+        st = _sites.setdefault(site, _Site())
+        st.compiles += 1
+        n = st.compiles
+        h = hash(sig)
+        if h in st.distinct:
+            st.retraces += 1
+            st.last_change = "identical signature re-traced (a jit " \
+                "cache is being dropped or rebuilt)"
+        else:
+            if len(st.distinct) < _MAX_DISTINCT_TRACKED:
+                st.distinct.add(h)
+            st.last_change = _diff(st.last_sig, sig)
+        st.last_sig = sig
+        storm = n > lim
+        if storm:
+            st.storms += 1
+        change = st.last_change
+    if not storm:
+        return
+    msg = (f"recompile storm at {site}: compile #{n} (limit {lim}); "
+           f"cause of the latest recompile: {change}. Every distinct "
+           "signature is one XLA compilation — bucket/pad the varying "
+           "dimension, make the varying static arg an array, or raise "
+           "MXNET_RECOMPILE_WARN if this site legitimately needs more "
+           "executables")
+    if mode == "raise":
+        from ..error import RecompileStormError
+        raise RecompileStormError(msg)
+    # warn at the crossing, then at every power-of-two compile count —
+    # a storm of 10k compiles must not emit 10k warnings
+    if n == lim + 1 or (n & (n - 1)) == 0:
+        warnings.warn(msg, RuntimeWarning, stacklevel=3)
+
+
+def _diff(old, new):
+    if old is None:
+        return "first compile at this site"
+    if len(old) != len(new):
+        return (f"argument count changed {len(old)} -> {len(new)} "
+                "(a python-level calling-convention change)")
+    for i, (o, nw) in enumerate(zip(old, new)):
+        if o == nw:
+            continue
+        label = f"arg {i}"
+        if o[0].startswith("kw:") and nw[0].startswith("kw:"):
+            if o[0] != nw[0]:
+                return (f"keyword set changed ({o[0][3:]} -> "
+                        f"{nw[0][3:]})")
+            label = f"kwarg {o[0][3:]}"
+            o, nw = o[1:], nw[1:]   # unwrap to the inner _one tuple
+        if o[0] == "arr" and nw[0] == "arr":
+            if o[1] != nw[1]:
+                what = f"shape {o[1]} -> {nw[1]}"
+                if len(o[1]) == len(nw[1]) and o[1][1:] == nw[1][1:]:
+                    what += " (varying leading/batch dimension)"
+            else:
+                what = f"dtype {o[2]} -> {nw[2]}"
+            return f"{label} {what}"
+        if o[0] == "static" and nw[0] == "static":
+            return (f"static {label} value {o[1]} -> {nw[1]} (a static "
+                    "argument that varies per call retraces every time "
+                    "— pass it as an array, or hoist it)")
+        return f"{label} changed kind {o[0]} -> {nw[0]}"
+    return "signatures compare equal but hash differently (pytree " \
+           "structure change)"
+
+
+# ---------------------------------------------------------------------------
+# reporting
+# ---------------------------------------------------------------------------
+
+def stats():
+    """Counters for the profiler's ``recompile`` stats provider."""
+    with _lock:
+        per_site = {
+            name: {"compiles": st.compiles,
+                   "distinct_signatures": len(st.distinct),
+                   "retraces": st.retraces,
+                   "storms": st.storms,
+                   "last_change": st.last_change}
+            for name, st in _sites.items()}
+    return {
+        "sites": len(per_site),
+        "compiles_total": sum(s["compiles"] for s in per_site.values()),
+        "retraces_total": sum(s["retraces"] for s in per_site.values()),
+        "storming_sites": sorted(n for n, s in per_site.items()
+                                 if s["storms"]),
+        "per_site": per_site,
+    }
+
+
+def reset():
+    """Drop all per-site state (tests)."""
+    with _lock:
+        _sites.clear()

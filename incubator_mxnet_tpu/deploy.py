@@ -92,6 +92,12 @@ def export_model(model, example_inputs, prefix, params=None):
     with open(prefix + ".stablehlo.mlir", "w") as f:
         f.write(lowered.as_text())
 
+    # IR lint of the forward being shipped (docs/graph_analysis.md): a
+    # baked-in constant, f64 leak or host callback found NOW is one
+    # found before it serves traffic.  MXNET_EXPORT_GRAPHLINT=warn
+    # (default) | raise | 0.
+    graphlint_summary = _export_graphlint(fwd, params, example, prefix)
+
     exported = jax.export.export(jitted)(params, *example)
     with open(prefix + ".jaxport", "wb") as f:
         f.write(exported.serialize())
@@ -115,10 +121,60 @@ def export_model(model, example_inputs, prefix, params=None):
     }
     meta["batch_export"] = _write_batch_export(jitted, params, example,
                                                prefix)
+    if graphlint_summary is not None:
+        meta["graphlint"] = graphlint_summary
     with open(prefix + ".meta.json", "w") as f:
         json.dump(meta, f, indent=1)
     _write_pjrt_sidecar(prefix, params, meta)
     return meta
+
+
+def _export_graphlint(fwd, params, example, prefix):
+    """Lint the traced forward at export time (jaxpr passes,
+    ``analysis/graphlint.py``); returns the meta.json summary or None
+    when disabled.  ``warn`` mode (default) warns and records; ``raise``
+    fails the export with :class:`~.error.GraphLintError`."""
+    from .base import get_env
+    mode = str(get_env("MXNET_EXPORT_GRAPHLINT", "warn")).strip().lower()
+    if mode in ("", "0", "off", "none", "false"):
+        return None
+    from .analysis import graphlint
+    try:
+        findings = graphlint.lint_fn(
+            fwd, params, *example,
+            where=f"export:{os.path.basename(prefix)}")
+    except Exception as e:  # mxlint: allow-broad-except(the lint is advisory in warn mode; a lint crash must never block an export)
+        import warnings
+        if mode == "raise":
+            raise
+        warnings.warn(f"export graphlint could not run ({e}); exporting "
+                      "without IR analysis")
+        return {"error": f"{type(e).__name__}: {e}"}
+    # advisories never gate (same contract as check_traced and the
+    # CLI): "findings"/"by_rule" count error severity only, so
+    # raise-mode and the serving load-time warning fire only on real
+    # violations and the counts agree with the breakdown
+    errors = [f for f in findings if f.severity == "error"]
+    by_rule: dict[str, int] = {}
+    adv_by_rule: dict[str, int] = {}
+    for f in findings:
+        tgt = by_rule if f.severity == "error" else adv_by_rule
+        tgt[f.rule] = tgt.get(f.rule, 0) + 1
+    summary = {"findings": len(errors),
+               "advisories": len(findings) - len(errors),
+               "by_rule": by_rule,
+               "advisories_by_rule": adv_by_rule,
+               "details": [f.as_dict() for f in findings[:25]]}
+    if errors:
+        msg = (f"graphlint: {len(errors)} finding(s) in the exported "
+               f"forward of {prefix!r}:\n"
+               + graphlint.render(errors[:10]))
+        if mode == "raise":
+            from .error import GraphLintError
+            raise GraphLintError(msg)
+        import warnings
+        warnings.warn(msg)
+    return summary
 
 
 def _write_batch_export(jitted, params, example, prefix):
@@ -242,14 +298,18 @@ class Predictor:
         # jit both entry points: jit's executable cache keyed on concrete
         # input shapes is (a) the warm-path dispatch and (b) the compile
         # counter the serving metrics watch (_cache_size per function)
-        self._call = jax.jit(self._exported.call)
+        from .analysis import recompile as _recompile
+        tag = os.path.basename(prefix)
+        self._call = jax.jit(_recompile.instrument(
+            self._exported.call, f"predictor:{tag}"))
         self._batch_call = None
         bpath = prefix + ".batch.jaxport"
         if self.meta.get("batch_export", os.path.exists(bpath)):
             try:
                 with open(bpath, "rb") as f:
                     self._batch_exported = jax.export.deserialize(f.read())
-                self._batch_call = jax.jit(self._batch_exported.call)
+                self._batch_call = jax.jit(_recompile.instrument(
+                    self._batch_exported.call, f"predictor:{tag}:batch"))
             except (OSError, ValueError) as e:
                 # an artifact set copied without the polymorphic twin
                 # (older tooling, partial copy) must still serve — the

@@ -14,7 +14,8 @@ from .base import MXNetError
 __all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
            "TypeError", "AttributeError", "NotImplementedError",
            "PSTimeoutError", "PSConnectionError", "CheckpointCorruptError",
-           "EngineRaceError", "register_error", "get_error_class"]
+           "EngineRaceError", "RecompileStormError", "GraphLintError",
+           "register_error", "get_error_class"]
 
 _ERROR_REGISTRY = {}
 
@@ -83,6 +84,24 @@ class CheckpointCorruptError(MXNetError):
     """A checkpoint shard failed integrity verification (CRC mismatch,
     truncated file, or missing shards) — the checkpoint must not load
     silently."""
+
+
+@register_error
+class GraphLintError(MXNetError):
+    """The IR linter (``analysis/graphlint.py``) found violations in a
+    graph whose caller demanded a clean bill
+    (``MXNET_EXPORT_GRAPHLINT=raise`` at export, or the graphlint CI
+    stage).  The message lists the findings with rule ids and the
+    traced source lines."""
+
+
+@register_error
+class RecompileStormError(MXNetError):
+    """A jitted entry point exceeded its per-site XLA compile budget
+    under ``MXNET_RECOMPILE_SENTINEL=raise`` (``analysis/recompile.py``).
+    The message names the site and WHAT changed between the last two
+    compile signatures (a varying batch dim, a per-call static arg, a
+    dropped cache) so the churn is fixable from the traceback alone."""
 
 
 @register_error

@@ -17,6 +17,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .analysis import recompile as _recompile
 from .ndarray import NDArray
 
 __all__ = ["FusedTrainStep", "make_fused_train_step", "sgd_init", "adam_init"]
@@ -131,6 +132,7 @@ class FusedTrainStep:
                 f"remat must be None, 'dots' or 'nothing'; got {remat!r}")
         self._key = jax.random.PRNGKey(0)
         self._remat = remat
+        self._lint_done = False
         self._step_fn = self._build(mesh, batch_spec, donate)
         self._last = None
 
@@ -178,6 +180,16 @@ class FusedTrainStep:
             return new_params, new_aux, new_state, loss
 
         donate_argnums = (0, 1, 2) if donate else ()
+        # kept unjitted/uninstrumented for the build-time IR lint
+        # (check_traced at first call; its trace must not count as a
+        # sentinel compile)
+        self._raw_step = step
+        self._donate_argnums = donate_argnums
+        # recompile sentinel: a fused step should compile ONCE per batch
+        # shape — churn here (varying batch, a dtype flip) is the single
+        # most expensive recompile in the framework
+        step = _recompile.instrument(
+            step, f"fused_step:{type(self.block).__name__}")
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             bspec = NamedSharding(mesh, batch_spec or P("dp"))
@@ -189,6 +201,23 @@ class FusedTrainStep:
         xv = x.data if isinstance(x, NDArray) else x
         yv = y.data if isinstance(y, NDArray) else y
         self._key, sub = jax.random.split(self._key)
+        from .analysis import graphlint as _graphlint
+        if not self._lint_done and _graphlint.lint_mode() is not None:
+            # build-time IR lint of the whole train step
+            # (MXNET_GRAPH_LINT).  GL-DEAD001 is ignored here by
+            # documented scope limit: AD transposition leaves dead
+            # primal eqns in every value_and_grad trace.  An undonated
+            # step (donate=False) earns its GL-DONATE001 advisory.
+            # the latch only sets once a lint actually ran, so
+            # enabling the mode after the first step still lints
+            self._lint_done = True
+            _graphlint.check_traced(
+                self._raw_step,
+                (self.params, self.aux, self.opt_state, xv, yv, sub),
+                name=f"fused_step:{type(self.block).__name__}",
+                donate_argnums=self._donate_argnums,
+                check_donation=True,
+                config=_graphlint.Config(ignore={"GL-DEAD001"}))
         self.params, self.aux, self.opt_state, loss = self._step_fn(
             self.params, self.aux, self.opt_state, xv, yv, sub)
         self._last = loss

@@ -30,6 +30,7 @@ import numpy as onp
 
 from .. import autograd
 from .. import random as _random
+from ..analysis import recompile as _recompile
 from ..context import current_context
 from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict, _TraceParams, \
@@ -320,6 +321,14 @@ class CachedOp:
                              for _, v in su)
             return out_vals, upd_vals
 
+        # recompile sentinel: one trace of `pure` == one XLA compile of
+        # this CachedOp; a varying input signature shows up as churn at
+        # this site (instrument is identity with the sentinel off).
+        # the uninstrumented fn is kept for the build-time IR lint,
+        # whose extra trace must not count as a compile
+        entry["pure"] = pure
+        pure = _recompile.instrument(
+            pure, f"cachedop:{type(self.block).__name__}")
         entry["jfn"] = jax.jit(pure, donate_argnums=(1,) if self.static_alloc else ())
         return entry
 
@@ -342,6 +351,19 @@ class CachedOp:
         if entry is None:
             entry = self._build(sig, params, training)
             self._cache[sig] = entry
+            # build-time IR lint (MXNET_GRAPH_LINT, inert by default):
+            # the exact pure fn this executable compiles, with the RNG
+            # key declared intentionally-unused (deterministic nets
+            # ignore it) and params no-donate unless static_alloc
+            from ..analysis import graphlint as _graphlint
+            if _graphlint.lint_mode() is not None:
+                _graphlint.check_traced(
+                    entry["pure"],
+                    (raw_params, raw_inputs, jax.random.PRNGKey(0)),
+                    name=f"cachedop:{type(self.block).__name__}",
+                    allow_unused_args=(2,),
+                    donate_argnums=(1,) if self.static_alloc else (),
+                    check_donation=self.static_alloc)
         jfn = entry["jfn"]
         key = _random.next_key()
 
@@ -457,7 +479,9 @@ class HybridBlock(Block):
 
         def apply_fn(pvals, *input_vals, training=False, key=None,
                      with_updates=False):
-            key = key if key is not None else jax.random.PRNGKey(0)
+            # key=None stays None: key_scope derives PRNGKey(0) lazily,
+            # so a deterministic forward traces no dead PRNG equations
+            # (graphlint GL-DEAD001 on every inference graph otherwise)
             mapping = {name2param[n]: NDArray(v) for n, v in pvals.items()}
             policy = getattr(self, "_amp_policy", None)
             if policy is not None:

@@ -152,6 +152,12 @@ class DataLoader:
         self._timeout = timeout
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * max(num_workers, 1))
+        if num_workers > 0:
+            # prefetch=0 with active workers would submit zero batches
+            # and both worker paths would silently yield an EMPTY
+            # iterator (the whole dataset dropped, no error) — at least
+            # one batch must be in flight for the pipeline to progress
+            self._prefetch = max(1, self._prefetch)
 
     def _make_batch(self, indices):
         samples = [self._dataset[i] for i in indices]
@@ -165,6 +171,9 @@ class DataLoader:
         if not self._thread_pool:
             yield from self._iter_multiprocess()
             return
+        yield from self._iter_threads()
+
+    def _iter_threads(self):
         with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
             futures = []
             it = iter(self._batch_sampler)
@@ -190,6 +199,33 @@ class DataLoader:
                              else "spawn")
         batchify = (self._batchify_fn if self._batchify_fn
                     is not default_batchify_fn else _np_batchify)
+        if ctx.get_start_method() == "spawn":
+            # spawn ships worker args by pickle; a dataset/batchify with
+            # closure or lambda transforms dies inside Process.start
+            # with an opaque PicklingError — probe up front and fall
+            # back to the thread pool with a clear warning instead.
+            # dataset/batchify are fixed at construction, so probe ONCE
+            # per loader (a full-dataset pickle per epoch is not free)
+            ok = getattr(self, "_spawn_picklable", None)
+            if ok is None:
+                import pickle
+                try:
+                    pickle.dumps((self._dataset, batchify))
+                    ok = True
+                except Exception as e:  # mxlint: allow-broad-except(pickle probe: ANY serialization failure means spawn cannot work; the loader degrades to threads with a warning)
+                    ok = False
+                    self._spawn_pickle_error = f"{type(e).__name__}: {e}"
+                self._spawn_picklable = ok
+            if not ok:
+                import warnings
+                warnings.warn(
+                    "multiprocess DataLoader needs picklable "
+                    "dataset/batchify on spawn-only hosts "
+                    f"({self._spawn_pickle_error}); falling back to the "
+                    "thread pool (module-level functions instead of "
+                    "lambdas/closures restore process workers)")
+                yield from self._iter_threads()
+                return
         key_queue = ctx.Queue()
         result_queue = ctx.Queue()
         workers = [ctx.Process(

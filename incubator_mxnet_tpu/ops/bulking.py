@@ -42,6 +42,7 @@ import numpy as _onp
 
 from ..base import get_env
 from .. import profiler as _profiler
+from ..analysis import recompile as _recompile
 
 __all__ = ["enabled", "set_enabled", "bulk_scope", "max_bulk_ops",
            "PendingArray", "defer", "resolve", "flush_current",
@@ -341,6 +342,33 @@ def _flush_locked(seg: _Segment):
             if not hit:
                 prog = jax.jit(_make_program(plan))
                 _trace_cache[key] = prog
+        if not hit and _recompile.enabled() is not None:
+            # the trace cache detects its own misses — report the
+            # compile directly instead of wrapping the program.  The
+            # SITE is keyed by the segment's static structure (op chain
+            # + per-node kwargs, the static half of the trace-cache
+            # key): distinct programs get distinct per-site budgets —
+            # parity with op:{name}/cachedop:{Block} — so many
+            # different segments never exhaust one shared storm budget
+            # (raise-mode would falsely poison working segments), while
+            # ONE structure re-compiling across varying ext shapes is
+            # exactly the churn the sentinel exists to catch
+            import zlib
+            structure = ">".join(
+                f"{n.op.name}{dict(n.kwargs_t) if n.kwargs_t else ''}"
+                for n in nodes)
+            site = f"bulk:segment:{zlib.crc32(structure.encode()):08x}"
+            _recompile.record_compile(site, (
+                ("static", structure),
+                *(("arr", tuple(a.shape), str(a.dtype)) for a in ext)))
+        if not hit:
+            # build-time IR lint of the fresh segment program
+            # (MXNET_GRAPH_LINT; inside the try, so a strict finding
+            # poisons the segment exactly like any other flush error)
+            from ..analysis import graphlint as _graphlint
+            if _graphlint.lint_mode() is not None:
+                _graphlint.check_traced(_make_program(plan), tuple(ext),
+                                        name="bulk:segment")
 
         flat = prog(*ext)
     except Exception as e:  # sticky, like the engine's var exceptions —

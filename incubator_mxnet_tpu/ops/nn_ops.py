@@ -168,17 +168,27 @@ def pooling(x, kernel=None, pool_type="max", global_pool=False, stride=None,
         return lax.reduce_window(x, init, lax.max, window, strides, pads)
     zero = (0.0 if jnp.issubdtype(x.dtype, jnp.floating)
             else _np.dtype(x.dtype).type(0))
-    summed = lax.reduce_window(x, zero, lax.add, window, strides, pads)
+    # sum/avg pooling accumulates in f32 for bf16/f16 inputs
+    # (graphlint GL-PREC001: reduce_window accumulates in the operand
+    # dtype, and a big window in bf16 saturates — ~88% relative error
+    # at 64x64); the result returns in x.dtype, matching the
+    # fused-epilogue convention of the other low-precision ops
+    low_acc = (jnp.issubdtype(x.dtype, jnp.floating)
+               and jnp.finfo(x.dtype).bits < 32)
+    xs = x.astype(jnp.float32) if low_acc else x
+    summed = lax.reduce_window(xs, zero, lax.add, window, strides, pads)
     if pool_type == "sum":
-        return summed
+        return summed.astype(x.dtype) if low_acc else summed
     if count_include_pad or all(p == 0 for p in pad):
         denom = 1.0
         for k in kernel:
             denom *= k
-        return summed / denom
-    ones = jnp.ones_like(x)
-    counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
-    return summed / counts
+        out = summed / denom
+        return out.astype(x.dtype) if low_acc else out
+    counts = lax.reduce_window(jnp.ones_like(xs), 0.0, lax.add, window,
+                               strides, pads)
+    out = summed / counts
+    return out.astype(x.dtype) if low_acc else out
 
 
 # ---------------------------------------------------------------------------

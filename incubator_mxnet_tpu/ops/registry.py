@@ -24,6 +24,7 @@ import jax
 import numpy as _onp
 
 from .. import profiler as _profiler
+from ..analysis import recompile as _recompile
 from . import bulking as _bulking
 
 __all__ = ["Op", "register", "get_op", "list_ops", "invoke",
@@ -84,7 +85,13 @@ class Op:
             return self.fn
         jfn = self._jit_cache.get(kwarg_names)
         if jfn is None:
-            jfn = jax.jit(self.fn, static_argnames=kwarg_names)
+            # recompile sentinel: wraps the fn handed to jit, so the
+            # wrapper body runs only while jax traces — one execution ==
+            # one XLA compile.  instrument() is identity when the
+            # sentinel is off, and this path runs once per (op,
+            # kwarg-name set), never per call.
+            fn = _recompile.instrument(self.fn, f"op:{self.name}")
+            jfn = jax.jit(fn, static_argnames=kwarg_names)
             self._jit_cache[kwarg_names] = jfn
         return jfn
 

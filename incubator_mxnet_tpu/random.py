@@ -39,7 +39,13 @@ def current_seed() -> int:
 
 
 class key_scope:
-    """Install a traced base key: random ops inside derive from it."""
+    """Install a traced base key: random ops inside derive from it.
+
+    ``key=None`` installs a LAZY default: the base key (PRNGKey(0))
+    materializes only if some op actually draws randomness.  A
+    deterministic forward then traces zero PRNG equations — graphlint's
+    GL-DEAD001 flagged the eager default as dead work in every
+    inference graph."""
 
     def __init__(self, key):
         self.key = key
@@ -60,6 +66,8 @@ def next_key():
     stack = getattr(_state, "keys", None)
     if stack:
         entry = stack[-1]
+        if entry[0] is None:          # lazy key_scope default
+            entry[0] = jax.random.PRNGKey(0)
         entry[1] += 1
         return jax.random.fold_in(entry[0], entry[1])
     with _lock:

@@ -53,6 +53,8 @@ class ModelEntry:
             "queue_depth": self.batcher.depth,
             "inputs": self.predictor.meta["inputs"],
             "outputs": self.predictor.meta["outputs"],
+            "graphlint_findings": (self.predictor.meta.get("graphlint")
+                                   or {}).get("findings"),
         }
 
 
@@ -90,6 +92,17 @@ class ModelRepository:
     def _build_entry(self, name, path, version, warmup):
         from ..deploy import load_predictor
         predictor = load_predictor(path)
+        # the artifact carries its export-time IR bill of health
+        # (deploy._export_graphlint, docs/graph_analysis.md); the
+        # deserialized executable is opaque to re-linting, so surface
+        # the recorded findings at the serving boundary instead
+        gl = predictor.meta.get("graphlint") or {}
+        if gl.get("findings"):
+            import warnings
+            warnings.warn(
+                f"model {name!r} ({path}) exported with "
+                f"{gl['findings']} graphlint finding(s) "
+                f"{gl.get('by_rule')} — see its meta.json for details")
         batcher = DynamicBatcher(name, predictor, metrics=self.metrics,
                                  buckets=self._buckets)
         entry = ModelEntry(name, version, path, predictor, batcher)

@@ -15,6 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..analysis import recompile as _recompile
 from ..context import Context
 from ..ndarray import NDArray
 
@@ -84,7 +85,9 @@ class Executor:
         # group-placed executors run eagerly: device_put-committed
         # arrays can't mix inside one jit computation, and the legacy
         # group2ctx path is op-by-op in the reference anyway
-        self._jit_infer = fwd_infer if g2c else jax.jit(fwd_infer)
+        self._jit_infer = fwd_infer if g2c else jax.jit(
+            _recompile.instrument(fwd_infer,
+                                  f"executor:{symbol.name}"))
         self._fwd_train = fwd_train
 
     def forward(self, is_train=False, **kwargs):
