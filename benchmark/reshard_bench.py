@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Reshard-restore benchmark: wall time to land a checkpoint saved on
+mesh shape A onto mesh shape B (docs/fault_tolerance.md "Elasticity").
+
+The elastic runtime's recovery path is
+``AsyncCheckpointManager.reshard_restore``: assemble every global array
+from the shard files a DIFFERENT mesh wrote, CRC-verifying each source
+shard, and place it with the target ``NamedSharding``.  This bench
+gives that path a perf trajectory like serving got — a BENCH-style
+JSON record per run — so a regression in recovery time (the window a
+rejoining worker holds the fleet at reduced size) is visible across
+PRs.
+
+Usage:
+    python benchmark/reshard_bench.py                  # defaults
+    python benchmark/reshard_bench.py --mb 64 --from-dp 8 --to-dp 2,8,1
+    python benchmark/reshard_bench.py --smoke --output out.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--mb", type=float, default=32.0,
+                   help="approximate checkpoint payload size in MiB")
+    p.add_argument("--from-dp", type=int, default=8,
+                   help="dp mesh size the checkpoint is SAVED on")
+    p.add_argument("--to-dp", default="2,8,1",
+                   help="comma-separated dp sizes to restore onto")
+    p.add_argument("--trials", type=int, default=3,
+                   help="restores per target shape; best wins")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny payload + 1 trial (CI)")
+    p.add_argument("--check", action="store_true",
+                   help="verify every restore bitwise against the saved "
+                        "tree (also implied by --smoke)")
+    p.add_argument("--output", default=None,
+                   help="also write the JSON record to this path")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.mb, args.trials, args.check = 1.0, 1, True
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from incubator_mxnet_tpu.checkpoint import AsyncCheckpointManager
+    from incubator_mxnet_tpu.parallel import make_mesh, leading_axis_rule
+
+    # a transformer-ish tree: one big sharded matrix + small leaves
+    rows = max(8, int(args.mb * (1 << 20) / (4 * 1024)) // 8 * 8)
+    mesh_a = make_mesh(dp=args.from_dp)
+    big = jax.device_put(
+        jnp.arange(rows * 1024, dtype=jnp.float32).reshape(rows, 1024),
+        NamedSharding(mesh_a, P("dp", None)))
+    tree = {"layer0.weight": big,
+            "layer0.bias": jnp.ones((1024,), jnp.float32),
+            "scale": jnp.full((8,), 0.5, jnp.bfloat16)}
+    nbytes = sum(onp.dtype(v.dtype).itemsize * int(onp.prod(v.shape))
+                 for v in tree.values())
+
+    tmp = tempfile.mkdtemp(prefix="reshard_bench_")
+    ckpt = AsyncCheckpointManager(tmp)
+    t0 = time.monotonic()
+    ckpt.save(1, tree, wait=True)
+    save_ms = (time.monotonic() - t0) * 1e3
+
+    shapes = {}
+    for dp_to in (int(v) for v in args.to_dp.split(",")):
+        mesh_b = make_mesh(dp=dp_to)
+        rule = leading_axis_rule(mesh_b)
+        best = None
+        for _ in range(args.trials):
+            t0 = time.monotonic()
+            back = ckpt.reshard_restore(mesh=mesh_b, rule_fn=rule)
+            jax.block_until_ready(list(back.values()))
+            ms = (time.monotonic() - t0) * 1e3
+            best = ms if best is None else min(best, ms)
+            if args.check:
+                for name, v in tree.items():
+                    a = onp.asarray(back[name])
+                    b = onp.asarray(v)
+                    if a.dtype.kind == "V" or b.dtype.kind == "V":
+                        a, b = a.view(onp.uint8), b.view(onp.uint8)
+                    if not (a == b).all():
+                        print(f"[reshard_bench] MISMATCH for {name} "
+                              f"restoring dp{args.from_dp}->dp{dp_to}",
+                              file=sys.stderr)
+                        return 1
+        shapes[f"dp{dp_to}"] = round(best, 2)
+
+    primary = f"dp{args.to_dp.split(',')[0]}"
+    rec = {
+        "metric": (f"reshard_restore_ms_dp{args.from_dp}_to_{primary}"),
+        "value": shapes[primary],
+        "unit": "ms",
+        "payload_mb": round(nbytes / (1 << 20), 2),
+        "from_dp": args.from_dp,
+        "restore_ms_by_shape": shapes,
+        "save_ms": round(save_ms, 2),
+        "trials": args.trials,
+        "verified": bool(args.check),
+        "platform": jax.devices()[0].platform,
+    }
+    line = json.dumps(rec)
+    print(line, flush=True)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

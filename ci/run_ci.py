@@ -15,6 +15,13 @@ Stages (each isolated, failures collected, nonzero exit if any fail):
              MXNET_FAULT_SPEC (deterministic transient faults on the
              PS transport, delays on checkpoint writes) so every PR
              exercises the retry/dedup/integrity paths
+  elastic    elastic-runtime scenario under its own pinned seeded spec
+             (lost heartbeats, lost acks, slow checkpoint reads): a
+             worker is killed mid-run, evicted within the heartbeat
+             budget, the survivors converge, the worker rejoins and
+             bootstraps — final weights must match an uninterrupted
+             run; plus the reshard-restore smoke bench (mesh A→B) for
+             the recovery-path perf trajectory
   serving    inference-server smoke: export a real model_zoo resnet,
              start the dynamic-batching HTTP server on an ephemeral
              port, warm it, fire concurrent requests, scrape /metrics,
@@ -172,6 +179,44 @@ def stage_chaos(args):
     return proc.returncode == 0, f"spec={CHAOS_SPEC!r}: {tail}"
 
 
+# Pinned elastic-chaos spec: lost membership beats, lost acks on the PS
+# transport, slow checkpoint-shard reads.  Seeded like CHAOS_SPEC so an
+# elastic failure replays deterministically from the spec string.
+ELASTIC_SPEC = ("kvstore.heartbeat:error:p=0.2:seed=5,"
+                "kvstore.recv:error:p=0.05:seed=11,"
+                "checkpoint.read:delay:ms=5")
+
+
+def stage_elastic(args):
+    """Elastic runtime sweep (docs/fault_tolerance.md "Elasticity"):
+    the kill/evict/rejoin scenario + resharding tests must pass under
+    the pinned seeded spec, and the reshard-restore bench must emit a
+    well-formed BENCH record with every restore verified."""
+    proc = sh([sys.executable, "-m", "pytest", "-q",
+               "tests/test_elastic.py",
+               "-m", "not slow", "--continue-on-collection-errors",
+               "-p", "no:cacheprovider"],
+              timeout=1800, env={"MXNET_FAULT_SPEC": ELASTIC_SPEC})
+    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+    if proc.returncode != 0:
+        return False, f"spec={ELASTIC_SPEC!r}: {tail}"
+    out = os.path.join(REPO, ".ci_reshard_smoke.json")
+    try:
+        proc2 = sh([sys.executable, "benchmark/reshard_bench.py",
+                    "--smoke", "--output", out], timeout=600)
+        if proc2.returncode != 0:
+            return False, (proc2.stderr or proc2.stdout).strip()[-300:]
+        with open(out) as f:
+            rec = json.load(f)
+    finally:
+        if os.path.exists(out):
+            os.remove(out)
+    if not rec.get("verified") or rec.get("value", 0) <= 0:
+        return False, f"reshard bench record malformed: {rec}"
+    return True, (f"spec ok: {tail}; reshard {rec['metric']}="
+                  f"{rec['value']}ms over {rec['restore_ms_by_shape']}")
+
+
 def stage_serving(args):
     """Serving smoke (docs/serving.md): HTTP end-to-end against a real
     gluon model_zoo artifact — warmup, concurrent requests, /metrics
@@ -277,6 +322,7 @@ STAGES = {"build": stage_build, "sanity": stage_sanity,
           "lint": stage_lint,
           "unit": stage_unit, "slow": stage_slow,
           "bulking": stage_bulking, "chaos": stage_chaos,
+          "elastic": stage_elastic,
           "serving": stage_serving, "race": stage_race,
           "graphlint": stage_graphlint,
           "multichip": stage_multichip, "bench": stage_bench}

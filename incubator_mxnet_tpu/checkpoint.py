@@ -29,7 +29,8 @@ import zlib
 import numpy as onp
 
 from . import fault
-from .error import CheckpointCorruptError
+from .error import (CheckpointCorruptError, CheckpointWriteError,
+                    ReshardError)
 
 __all__ = ["AsyncCheckpointManager"]
 
@@ -178,10 +179,13 @@ class AsyncCheckpointManager:
                                        "file": fn,
                                        "crc32": _crc_of(host)}
             # the per-process index is written LAST: its presence marks
-            # this process's contribution complete
+            # this process's contribution complete.  nprocs lets restore
+            # prove EVERY process committed — a directory missing any
+            # index.<i>.json is incomplete, not a smaller fleet's save.
             idx_name = "index.json" if single else f"index.{proc}.json"
             with open(os.path.join(tmp, idx_name), "w") as f:
-                json.dump({"step": step, "params": index}, f)
+                json.dump({"step": step, "nprocs": jax.process_count(),
+                           "params": index}, f)
             if single:
                 if os.path.exists(final):
                     shutil.rmtree(final)
@@ -207,13 +211,19 @@ class AsyncCheckpointManager:
     # ------------------------------------------------------- inspection
     def wait(self):
         """Block until the in-flight checkpoint (if any) is durable;
-        re-raises a writer-thread failure."""
+        re-raises a writer-thread failure as a typed
+        :class:`~incubator_mxnet_tpu.error.CheckpointWriteError`.
+        ``save()`` calls this first, so a banked failure also surfaces
+        at the NEXT save — a silently-failing checkpoint loop cannot
+        run for hours believing it has durable state."""
         if self._thread is not None:
             self._thread.join()
             self._thread = None
         if self._error is not None:
             err, self._error = self._error, None
-            raise RuntimeError("async checkpoint write failed") from err
+            raise CheckpointWriteError(
+                f"async checkpoint write failed: {type(err).__name__}: "
+                f"{err}") from err
 
     def all_steps(self):
         out = []
@@ -233,7 +243,8 @@ class AsyncCheckpointManager:
     def restore(self, step=None):
         """Reassemble a checkpoint into {name: numpy array} (global
         arrays; re-shard with jax.device_put(..., sharding) to resume
-        on a mesh).
+        on a mesh — or use :meth:`reshard_restore` to land directly on
+        a target mesh).
 
         Every shard listed with a ``crc32`` is re-verified against its
         loaded bytes; a mismatch, truncated file, or missing shard
@@ -244,13 +255,47 @@ class AsyncCheckpointManager:
         damage it is recovering from); an explicit ``step`` is strict."""
         if step is not None:
             return self._restore_step(step)
+        return self._newest_first(self._restore_step)
+
+    def reshard_restore(self, tree_spec=None, mesh=None, rule_fn=None,
+                        step=None):
+        """Restore a checkpoint directly onto a (possibly different)
+        mesh: each global array is assembled from whichever shard files
+        cover its slices — regardless of the mesh shape that SAVED it —
+        and placed with the :class:`~jax.sharding.NamedSharding` that
+        ``rule_fn`` chooses (``parallel.mesh.shard_params``-style
+        placement).  Returns ``{name: jax.Array}`` carrying the target
+        sharding.
+
+        ``tree_spec`` selects and validates: ``None`` restores every
+        name in the index; a dict ``{name: template}`` (arrays or
+        ``jax.ShapeDtypeStruct``; ``None`` values skip validation)
+        restores exactly those names, raising
+        :class:`~incubator_mxnet_tpu.error.ReshardError` on a name the
+        index does not carry or a shape/dtype conflict.  ``rule_fn(name,
+        shape_dtype_struct) -> PartitionSpec`` (default: replicate).
+
+        Integrity follows :meth:`restore` exactly: per-source-shard CRC
+        verification on read, ``step=None`` walks newest-first past
+        corrupt checkpoints, an explicit ``step`` is strict.  Spec-level
+        problems (``ReshardError``) are NOT treated as corruption — an
+        impossible request must surface, not silently fall back."""
+        if mesh is None:
+            raise ReshardError("reshard_restore requires a target mesh")
+        loader = lambda s: self._reshard_step(s, tree_spec, mesh, rule_fn)
+        if step is not None:
+            return loader(step)
+        return self._newest_first(loader)
+
+    def _newest_first(self, loader):
+        """Run ``loader(step)`` newest-first, skipping damaged steps."""
         steps = self.all_steps()
         if not steps:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
         last_err = None
         for s in reversed(steps):
             try:
-                return self._restore_step(s)
+                return loader(s)
             except CheckpointCorruptError as e:
                 _log.warning("checkpoint step %d is damaged (%s); "
                              "falling back to the previous one", s, e)
@@ -259,13 +304,17 @@ class AsyncCheckpointManager:
             f"no valid checkpoint in {self.directory}: all of steps "
             f"{steps} failed verification") from last_err
 
-    def _restore_step(self, step):
+    def _step_dir(self, step):
         d = os.path.join(self.directory, f"step_{int(step):08d}")
         if not os.path.isdir(d):
             # absence is not corruption: resume logic starts fresh on
             # FileNotFoundError but must crash loudly on real damage
             raise FileNotFoundError(
                 f"no checkpoint for step {step} in {self.directory}")
+        return d
+
+    def _restore_step(self, step):
+        d = self._step_dir(step)
         try:
             return self._load_dir(d, step)
         except CheckpointCorruptError:
@@ -277,58 +326,213 @@ class AsyncCheckpointManager:
                 f"checkpoint step {step} failed to load: "
                 f"{type(e).__name__}: {e}") from e
 
-    def _load_dir(self, d, step):
-        merged = {}
+    def _merged_index(self, d, step):
+        """Read and merge the step's index(es) into {name: meta}.
+
+        Multi-process layout: the per-process index is the completion
+        marker, and each records ``nprocs`` — any missing
+        ``index.<i>.json`` means a writer process died before
+        committing, which is corruption (fall back newest-first), not a
+        smaller save."""
         if os.path.exists(os.path.join(d, "index.json")):
             with open(os.path.join(d, "index.json")) as f:
-                merged = json.load(f)["params"]
-        else:  # multi-process: merge every per-process index
-            for entry in sorted(os.listdir(d)):
-                if entry.startswith("index.") and entry.endswith(".json"):
-                    with open(os.path.join(d, entry)) as f:
-                        for name, meta in json.load(f)["params"].items():
-                            if name in merged and "shards" in meta:
-                                merged[name]["shards"] += meta["shards"]
-                            else:
-                                merged[name] = meta
+                return json.load(f)["params"]
+        merged, seen_procs, nprocs = {}, set(), 0
+        for entry in sorted(os.listdir(d)):
+            m = re.match(r"^index\.(\d+)\.json$", entry)
+            if not m:
+                continue
+            seen_procs.add(int(m.group(1)))
+            with open(os.path.join(d, entry)) as f:
+                data = json.load(f)
+            nprocs = max(nprocs, int(data.get("nprocs", 0)))
+            for name, meta in data["params"].items():
+                if name in merged and "shards" in meta:
+                    merged[name]["shards"] += meta["shards"]
+                else:
+                    merged[name] = meta
+        if not seen_procs:
+            # the step directory exists but NO completion marker landed
+            # (every writer died pre-commit): an explicit restore(step)
+            # must raise, not hand back an empty parameter tree
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} has no index at all — no "
+                "writer process committed (the per-process index is "
+                "the completion marker)")
+        missing = set(range(nprocs)) - seen_procs
+        if missing:
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} is incomplete: per-process "
+                f"index missing for process(es) {sorted(missing)} of "
+                f"{nprocs} (the index is the completion marker — a "
+                "writer process died before committing)")
+        return merged
+
+    def _read_block(self, d, entry, dtype, step, what):
+        """Load one shard file, CRC-verify it, restore exotic dtypes."""
+        fault.inject("checkpoint.read", detail=entry["file"])
+        block = onp.load(os.path.join(d, entry["file"]))
+        want = entry.get("crc32")
+        # pre-CRC checkpoints stay loadable (no integrity info)
+        if want is not None and _crc_of(block) != want:
+            raise CheckpointCorruptError(
+                f"checkpoint step {step}: CRC mismatch for {what} "
+                f"({entry['file']}): recorded {want:#010x}, file "
+                f"has {_crc_of(block):#010x} (bit rot or a torn "
+                "write)")
+        # numpy serializes exotic dtypes (bf16/fp8) as raw void of the
+        # same itemsize; view restores the logical dtype
+        if block.dtype != dtype and block.dtype.kind == "V":
+            return block.view(dtype)
+        return block
+
+    @staticmethod
+    def _entries_of(meta):
+        """Normalize a meta record to (shape, dtype, [shard entries]),
+        each entry carrying an explicit [[start, stop], ...] index."""
+        shape = list(meta["shape"])
+        dtype = onp.dtype(meta["dtype"])  # ml_dtypes names resolve
+        if "shards" in meta:
+            return shape, dtype, meta["shards"]
+        full = dict(meta)
+        full["index"] = [[0, dim] for dim in shape]
+        return shape, dtype, [full]
+
+    def _load_dir(self, d, step):
         out = {}
-        for name, meta in merged.items():
-            dtype = onp.dtype(meta["dtype"])  # ml_dtypes names resolve
-
-            def _typed(block):
-                # numpy serializes exotic dtypes (bf16/fp8) as raw void
-                # of the same itemsize; view restores the logical dtype
-                if block.dtype != dtype and block.dtype.kind == "V":
-                    return block.view(dtype)
-                return block
-
-            def _verified(entry, what):
-                block = onp.load(os.path.join(d, entry["file"]))
-                want = entry.get("crc32")
-                # pre-CRC checkpoints stay loadable (no integrity info)
-                if want is not None and _crc_of(block) != want:
-                    raise CheckpointCorruptError(
-                        f"checkpoint step {step}: CRC mismatch for {what} "
-                        f"({entry['file']}): recorded {want:#010x}, file "
-                        f"has {_crc_of(block):#010x} (bit rot or a torn "
-                        "write)")
-                return _typed(block)
-
-            if "shards" in meta:
-                full = onp.zeros(meta["shape"], dtype)
-                covered = 0
-                for entry in meta["shards"]:
-                    block = _verified(entry, f"shard of {name!r}")
-                    sl = tuple(slice(a, b) for a, b in entry["index"])
-                    full[sl] = block
-                    covered += int(block.size)
-                if covered < int(onp.prod(meta["shape"])):
-                    raise CheckpointCorruptError(
-                        f"checkpoint step {step} is incomplete for "
-                        f"{name!r}: {covered} of "
-                        f"{int(onp.prod(meta['shape']))} elements present "
-                        "(a writer process likely died mid-save)")
-                out[name] = full
-            else:
-                out[name] = _verified(meta, repr(name))
+        for name, meta in self._merged_index(d, step).items():
+            shape, dtype, entries = self._entries_of(meta)
+            if "shards" not in meta:
+                out[name] = self._read_block(d, meta, dtype, step,
+                                             repr(name))
+                continue
+            full = onp.zeros(shape, dtype)
+            covered = 0
+            for entry in entries:
+                block = self._read_block(d, entry, dtype, step,
+                                         f"shard of {name!r}")
+                sl = tuple(slice(a, b) for a, b in entry["index"])
+                full[sl] = block
+                covered += int(block.size)
+            if covered < int(onp.prod(shape)):
+                raise CheckpointCorruptError(
+                    f"checkpoint step {step} is incomplete for "
+                    f"{name!r}: {covered} of "
+                    f"{int(onp.prod(shape))} elements present "
+                    "(a writer process likely died mid-save)")
+            out[name] = full
         return out
+
+    # ----------------------------------------------------- resharding
+    def _reshard_step(self, step, tree_spec, mesh, rule_fn):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        d = self._step_dir(step)
+        try:
+            merged = self._merged_index(d, step)
+        except CheckpointCorruptError:
+            raise
+        except (OSError, ValueError, EOFError, KeyError) as e:
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} failed to load: "
+                f"{type(e).__name__}: {e}") from e
+        names = list(tree_spec) if tree_spec is not None else sorted(merged)
+        absent = [n for n in names if n not in merged]
+        if absent:
+            raise ReshardError(
+                f"checkpoint step {step} has no entry for {absent}: "
+                f"the index carries {sorted(merged)}")
+        out = {}
+        for name in names:
+            shape, dtype, entries = self._entries_of(merged[name])
+            if tree_spec is not None and tree_spec[name] is not None:
+                want = tree_spec[name]
+                wshape = tuple(getattr(want, "shape", ()) or ())
+                wdtype = getattr(want, "dtype", None)
+                if wshape != tuple(shape) or (
+                        wdtype is not None
+                        and onp.dtype(wdtype) != dtype):
+                    raise ReshardError(
+                        f"target spec for {name!r} wants shape={wshape} "
+                        f"dtype={wdtype}, but the checkpoint recorded "
+                        f"shape={tuple(shape)} dtype={dtype}")
+            struct = jax.ShapeDtypeStruct(tuple(shape), dtype)
+            spec = (rule_fn(name, struct) if rule_fn is not None
+                    else PartitionSpec())
+            try:
+                out[name] = self._assemble_on(
+                    d, step, name, shape, dtype, entries,
+                    NamedSharding(mesh, spec))
+            except CheckpointCorruptError:
+                raise
+            except (ValueError, KeyError, TypeError) as e:
+                # load-level failures were already converted to
+                # CheckpointCorruptError at the read site, so whatever
+                # reaches here is a spec the mesh cannot carry (unknown
+                # axis, indivisible shape) — a REQUEST problem, not
+                # checkpoint damage: surface it, never fall back
+                raise ReshardError(
+                    f"cannot lay out {name!r} (shape {tuple(shape)}, "
+                    f"dtype {dtype}) as {spec} on mesh "
+                    f"{dict(mesh.shape)}: {e}") from e
+        return out
+
+    def _assemble_on(self, d, step, name, shape, dtype, entries,
+                     sharding):
+        """Build one global array on ``sharding``, feeding each target
+        shard only from the source shard files that overlap its slice
+        (every file CRC-verified once, cached across target shards)."""
+        import jax
+        cache: dict = {}
+
+        def _cached(entry):
+            fn = entry["file"]
+            if fn not in cache:
+                try:
+                    cache[fn] = self._read_block(d, entry, dtype, step,
+                                                 f"shard of {name!r}")
+                except CheckpointCorruptError:
+                    raise
+                except (OSError, ValueError, EOFError, KeyError) as e:
+                    # a truncated .npy raises ValueError/EOFError: that
+                    # is DAMAGE (newest-first fallback applies), and it
+                    # must not be mistaken for a layout ValueError
+                    raise CheckpointCorruptError(
+                        f"checkpoint step {step} failed to load shard "
+                        f"{fn!r} of {name!r}: {type(e).__name__}: "
+                        f"{e}") from e
+            return cache[fn]
+
+        def _gather(index):
+            starts = [sl.start or 0 for sl in index]
+            stops = [sl.stop if sl.stop is not None else dim
+                     for sl, dim in zip(index, shape)]
+            if not shape:  # 0-d leaf: single source entry holds it all
+                return onp.asarray(_cached(entries[0]))
+            out = onp.zeros([b - a for a, b in zip(starts, stops)], dtype)
+            covered = 0
+            for entry in entries:
+                src = entry["index"]
+                lo = [max(a, s) for (a, _), s in zip(src, starts)]
+                hi = [min(b, t) for (_, b), t in zip(src, stops)]
+                if any(l >= h for l, h in zip(lo, hi)):
+                    continue  # no overlap with this target shard
+                block = _cached(entry)
+                src_sl = tuple(slice(l - a, h - a)
+                               for (a, _), l, h in zip(src, lo, hi))
+                dst_sl = tuple(slice(l - s, h - s)
+                               for s, l, h in zip(starts, lo, hi))
+                out[dst_sl] = block[src_sl]
+                covered += int(onp.prod([h - l for l, h in zip(lo, hi)]))
+            if covered < int(out.size):
+                raise CheckpointCorruptError(
+                    f"checkpoint step {step} is incomplete for "
+                    f"{name!r}: target slice "
+                    f"{[(a, b) for a, b in zip(starts, stops)]} has "
+                    f"{covered} of {int(out.size)} elements covered by "
+                    "the recorded shards (a writer process likely died "
+                    "mid-save)")
+            return out
+
+        return jax.make_array_from_callback(tuple(shape), sharding,
+                                            _gather)

@@ -14,6 +14,7 @@ from .base import MXNetError
 __all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
            "TypeError", "AttributeError", "NotImplementedError",
            "PSTimeoutError", "PSConnectionError", "CheckpointCorruptError",
+           "CheckpointWriteError", "WorkerEvictedError", "ReshardError",
            "EngineRaceError", "RecompileStormError", "GraphLintError",
            "register_error", "get_error_class"]
 
@@ -84,6 +85,35 @@ class CheckpointCorruptError(MXNetError):
     """A checkpoint shard failed integrity verification (CRC mismatch,
     truncated file, or missing shards) — the checkpoint must not load
     silently."""
+
+
+@register_error
+class CheckpointWriteError(MXNetError, _bi.RuntimeError):
+    """The async checkpoint writer thread failed.  The exception is
+    banked on the manager and re-raised (as this type, chained to the
+    original) at the next ``save()``/``wait()`` — a silently-failing
+    checkpoint loop must not run for hours believing it has durable
+    state.  Also catchable as builtin ``RuntimeError``."""
+
+
+@register_error
+class WorkerEvictedError(MXNetError):
+    """This worker was evicted from the parameter-server membership
+    table (it missed its ``MXNET_KVSTORE_DEAD_AFTER`` heartbeat budget,
+    or the fleet was rebalanced without it).  The elastic trainer
+    checkpoints on this notice; the worker must ``join`` again (and
+    bootstrap by pulling current weights) before pushing more work."""
+
+
+@register_error
+class ReshardError(MXNetError, _bi.ValueError):
+    """A checkpoint could not be restored onto the requested mesh /
+    sharding: a name in the target tree has no entry in the per-shard
+    index, the recorded global shape or dtype conflicts with the target
+    spec, or the placement rule produced a spec the mesh cannot carry.
+    Integrity damage (CRC mismatch, missing shard files) is NOT this
+    error — that stays :class:`CheckpointCorruptError` so newest-first
+    fallback applies.  Also catchable as builtin ``ValueError``."""
 
 
 @register_error

@@ -12,11 +12,17 @@ Injection points (each named where the fault physically occurs):
 
 * ``kvstore.send``      — worker→server request about to hit the wire
 * ``kvstore.recv``      — worker waiting on the server response
+* ``kvstore.heartbeat`` — a liveness probe / membership beat leaving
+  the worker (one-shot budget; a lost beat burns heartbeat budget)
 * ``engine.push``       — a closure being scheduled on the engine
 * ``checkpoint.write``  — a shard file about to be written
+* ``checkpoint.read``   — a shard file about to be read back (restore
+  and reshard-restore verify CRCs against exactly these bytes)
 * ``io.next_batch``     — the data pipeline handing out a batch
 * ``serving.enqueue``   — an inference request entering a model queue
 * ``serving.execute``   — a coalesced batch about to run on the device
+* ``trainer.step``      — an elastic trainer step about to run (the
+  eviction-notice / checkpoint-on-evict path)
 
 Spec grammar (``MXNET_FAULT_SPEC``)::
 
@@ -66,9 +72,10 @@ __all__ = [
 #: that silently never fires; a declared-but-unwired point is dead
 #: chaos coverage), and :func:`inject` enforces it at runtime whenever
 #: a spec is active.  Add the name HERE when adding an injection point.
-POINTS = ("kvstore.send", "kvstore.recv", "engine.push",
-          "checkpoint.write", "io.next_batch",
-          "serving.enqueue", "serving.execute")
+POINTS = ("kvstore.send", "kvstore.recv", "kvstore.heartbeat",
+          "engine.push", "checkpoint.write", "checkpoint.read",
+          "io.next_batch", "serving.enqueue", "serving.execute",
+          "trainer.step")
 
 _POINT_SET = frozenset(POINTS)
 

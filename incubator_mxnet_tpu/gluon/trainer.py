@@ -4,18 +4,41 @@ Reference: python/mxnet/gluon/trainer.py:29 — `_init_kvstore` :183,
 `step` :329, `_allreduce_grads` :380-404.  On TPU the gradient sync is an
 XLA collective (psum over the device mesh) handled by the kvstore layer;
 single-device training is a straight optimizer application.
+
+Elastic mode (``elastic=True``, docs/fault_tolerance.md "Elasticity"):
+the trainer joins the parameter servers' membership table, beats every
+``MXNET_KVSTORE_BEAT_INTERVAL`` seconds from a background thread, and
+treats a :class:`~incubator_mxnet_tpu.error.WorkerEvictedError` — from
+its own beat (the eviction notice) or from a push/pull — as the signal
+to checkpoint synchronously (``checkpoint_dir``) and surface the typed
+error.  The driving loop then either lets this worker die (the
+survivors' sync rounds have already re-balanced server-side) or calls
+:meth:`rejoin` to re-enter the fleet and bootstrap from the current
+server weights.  Fleet-size changes observed between steps are recorded
+(``fleet_changes``) and checkpointed, and :meth:`reshard_restore` lands
+a checkpoint saved on ANY mesh shape back onto the live parameters via
+:meth:`AsyncCheckpointManager.reshard_restore`.
 """
 from __future__ import annotations
 
+import logging
+import threading
+
+from .. import fault
 from .. import optimizer as opt_mod
+from ..base import get_env
+from ..error import WorkerEvictedError
 from ..ndarray import NDArray
 
 __all__ = ["Trainer"]
 
+_log = logging.getLogger("incubator_mxnet_tpu.gluon.trainer")
+
 
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):
+                 compression_params=None, update_on_kvstore=None,
+                 elastic=False, checkpoint_dir=None, checkpoint_keep=5):
         if isinstance(params, (dict,)) or hasattr(params, "values"):
             self._param_names = list(params.keys()) if hasattr(params, "keys") else None
             params = list(params.values())
@@ -40,6 +63,21 @@ class Trainer:
         self._kv_initialized = False
         self._compression_params = compression_params
         self._update_on_kvstore = update_on_kvstore
+        self._uokv = False
+        # -- elastic runtime state ------------------------------------
+        self._elastic = bool(elastic)
+        self._ckpt = None
+        if checkpoint_dir is not None:
+            from ..checkpoint import AsyncCheckpointManager
+            self._ckpt = AsyncCheckpointManager(checkpoint_dir,
+                                                keep=checkpoint_keep)
+        self._step_count = 0
+        self._evicted_reason = None
+        self._live = None              # fleet size from the last beat
+        self._last_fleet = None
+        self.fleet_changes: list = []  # (step, old live, new live)
+        self._beat_stop = threading.Event()
+        self._beat_thread = None
 
     @property
     def optimizer(self):
@@ -65,15 +103,219 @@ class Trainer:
             self._kvstore = self._kvstore_type
         self._kv_initialized = True
         if self._kvstore is not None:
+            # update_on_kvstore: the store/server applies the optimizer
+            # and holds the AUTHORITATIVE weights (reference
+            # trainer.py:183 dist default).  This is the mode in which a
+            # rejoining elastic worker can bootstrap by pulling current
+            # weights — under plain gradient aggregation the server only
+            # holds merged gradients, so there is nothing to pull.
+            self._uokv = bool(self._update_on_kvstore)
+            if self._uokv:
+                import copy
+                opt = copy.copy(self._optimizer)
+                # the server needs the update rule, not this trainer's
+                # param_dict (live Parameters wrap device arrays and
+                # locks — unpicklable, and meaningless server-side)
+                opt.param_dict = {}
+                # the client pre-scales every pushed gradient
+                # (_sync_on_kvstore), so the server copy must not
+                # rescale AGAIN with whatever the constructor captured
+                opt.rescale_grad = 1.0
+                self._kvstore.set_optimizer(opt)
             for i, p in enumerate(self._params):
                 if p.grad_req != "null":
                     self._kvstore.init(i, p.data())
+            if self._elastic:
+                self._join_fleet()
 
+    # ----------------------------------------------------- elasticity
+    def _stop_beats(self):
+        self._beat_stop.set()
+        if self._beat_thread is not None and self._beat_thread.is_alive():
+            self._beat_thread.join(timeout=10.0)
+        self._beat_thread = None
+        # a fresh Event per thread generation: a parked old thread can
+        # never clear the stop flag out from under the new one
+        self._beat_stop = threading.Event()
+
+    def _join_fleet(self):
+        kv = self._kvstore
+        # stop the old heartbeat FIRST: a beat already in flight when we
+        # rejoin could deliver a stale eviction notice and bank it over
+        # the fresh membership
+        self._stop_beats()
+        infos = kv.join(getattr(kv, "rank", 0)) or []
+        self._evicted_reason = None
+        # fleet size from the join acks (the heartbeat probe may be
+        # chaos-degraded; the join already rode the retry pipeline)
+        live = min((i.get("live_workers", 0) for i in infos),
+                   default=0)
+        self._last_fleet = self._live = (
+            live if live > 0 else getattr(kv, "num_workers", 1))
+        stop = self._beat_stop
+        interval = get_env("MXNET_KVSTORE_BEAT_INTERVAL", 5.0, float)
+        self._beat_thread = threading.Thread(
+            target=self._beat_loop, args=(interval, stop), daemon=True,
+            name="trainer-heartbeat")
+        self._beat_thread.start()
+
+    def _beat_loop(self, interval, stop):
+        while not stop.wait(interval):
+            try:
+                vitals = self._kvstore.beat()
+            except WorkerEvictedError as e:
+                # the beat IS the eviction-notice delivery path: bank
+                # it; the next step() checkpoints and surfaces it
+                if not stop.is_set():
+                    self._evicted_reason = str(e)
+                return
+            except Exception as e:  # mxlint: allow-broad-except(a dead heartbeat thread silently evicts a HEALTHY worker — any failure here (injected PermanentFault, marshalled server error) must be logged and survived, never kill the loop)
+                _log.warning("trainer heartbeat failed (%s: %s); a "
+                             "missed beat burns eviction budget, "
+                             "retrying next interval",
+                             type(e).__name__, e)
+                continue
+            if vitals:
+                live = min(v.get("live_workers", 0) for v in vitals)
+                if live > 0:
+                    self._live = live
+
+    def _param_tree(self):
+        tree = {}
+        for i, p in enumerate(self._params):
+            if p._data is None:
+                continue
+            name = (self._param_names[i] if self._param_names is not None
+                    else str(i))
+            tree[name] = p.data()
+        return tree
+
+    def _on_evicted(self, reason):
+        """Checkpoint-on-eviction-notice, then surface the typed error."""
+        self._evicted_reason = reason
+        saved = ""
+        if self._ckpt is not None:
+            self._ckpt.save(self._step_count, self._param_tree(),
+                            wait=True)
+            saved = (f"; eviction checkpoint saved at step "
+                     f"{self._step_count} in {self._ckpt.directory}")
+        raise WorkerEvictedError(
+            f"worker evicted from the fleet at step {self._step_count} "
+            f"({reason}){saved}; call rejoin() to re-enter and "
+            "bootstrap from current weights")
+
+    def rejoin(self, bootstrap=True):
+        """Re-enter the fleet after a
+        :class:`~incubator_mxnet_tpu.error.WorkerEvictedError`: join the
+        membership table again, bootstrap, and restart the heartbeat.
+
+        Bootstrap depends on who holds the weights:
+
+        * ``update_on_kvstore=True`` — the server applies the optimizer
+          and holds the authoritative weights: pull them, so this
+          worker enters the next round on the SURVIVORS' state, not its
+          stale pre-eviction one;
+        * gradient-aggregation mode — the server only holds merged
+          gradients (pulling those into the weights would destroy the
+          model): restore the newest local checkpoint instead, which is
+          exactly the eviction checkpoint this trainer saved on notice.
+        """
+        if not self._kv_initialized:
+            self._init_kvstore()
+            return
+        if self._kvstore is None:
+            # a config mistake, NOT an eviction notice: the documented
+            # `except WorkerEvictedError: rejoin()` recovery loop must
+            # not swallow it and retry forever
+            raise ValueError("rejoin() needs a kvstore-backed trainer")
+        self._join_fleet()
+        if not bootstrap:
+            return
+        if self._uokv:
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null" and p._data is not None:
+                    self._kvstore.pull(i, out=p.data())
+        elif self._ckpt is not None and self._ckpt.all_steps():
+            tree = self._ckpt.restore()
+            for i, p in enumerate(self._params):
+                name = (self._param_names[i]
+                        if self._param_names is not None else str(i))
+                if name in tree and p._data is not None:
+                    p.set_data(tree[name])
+
+    def close(self):
+        """Stop the heartbeat and gracefully leave the fleet (sync
+        rounds re-balance immediately instead of burning the dead-after
+        budget)."""
+        self._stop_beats()
+        if (self._elastic and self._kvstore is not None
+                and self._evicted_reason is None):
+            try:
+                self._kvstore.leave()
+            except (ConnectionError, TimeoutError):
+                pass   # the fleet is gone; eviction will reap us
+
+    @property
+    def live_workers(self):
+        """Live fleet size as of the last heartbeat (elastic mode), or
+        the kvstore's static worker count."""
+        if self._live is not None:
+            return self._live
+        if self._kvstore is not None:
+            return self._kvstore.num_workers
+        return 1
+
+    def _note_fleet(self):
+        live = self._live
+        if live is None:
+            return
+        if self._last_fleet is not None and live != self._last_fleet:
+            self.fleet_changes.append((self._step_count,
+                                       self._last_fleet, live))
+            _log.warning(
+                "trainer: fleet size changed %d -> %d at step %d%s",
+                self._last_fleet, live, self._step_count,
+                "; checkpointing" if self._ckpt is not None else "")
+            if self._ckpt is not None:
+                # a fleet-size change is a reshard point: persist now so
+                # a restore can re-lay the state out on the new shape
+                self._ckpt.save(self._step_count, self._param_tree())
+        self._last_fleet = live
+
+    def reshard_restore(self, mesh, rule_fn=None, step=None):
+        """Load a checkpoint saved on ANY mesh shape back into the live
+        parameters, re-laid out on ``mesh`` via ``rule_fn`` (see
+        :meth:`AsyncCheckpointManager.reshard_restore`).  Returns the
+        restored ``{name: jax.Array}`` tree."""
+        if self._ckpt is None:
+            # config mistake, not an eviction — see rejoin()
+            raise ValueError(
+                "reshard_restore() needs checkpoint_dir configured")
+        names = {}
+        for i, p in enumerate(self._params):
+            if p._data is None:
+                continue
+            name = (self._param_names[i] if self._param_names is not None
+                    else str(i))
+            names[name] = p
+        tree = self._ckpt.reshard_restore(
+            tree_spec={n: None for n in names}, mesh=mesh,
+            rule_fn=rule_fn, step=step)
+        for name, arr in tree.items():
+            names[name].set_data(NDArray(arr))
+        return tree
+
+    # ------------------------------------------------------- training
     def allreduce_grads(self):
         """Sum gradients across devices/workers (reference trainer.py:380)."""
         if not self._kv_initialized:
             self._init_kvstore()
-        if self._kvstore is None or self._kvstore.num_workers <= 1:
+        if self._kvstore is None:
+            return
+        if self._kvstore.num_workers <= 1 and not self._elastic:
+            # elastic mode always syncs through the server: the PS holds
+            # the state a rejoiner bootstraps from, and the push/pull is
+            # where an eviction notice surfaces
             return
         for i, p in enumerate(self._params):
             if p.grad_req != "null":
@@ -81,13 +323,46 @@ class Trainer:
                 self._kvstore.pushpull(i, grad, out=grad,
                                        priority=-i)
 
+    def _sync_on_kvstore(self):
+        """update_on_kvstore step: push (pre-scaled) gradients, pull
+        the server-updated weights back (reference trainer.py:329
+        _update_on_kvstore branch).  The rescale is applied client-side
+        because the server's pickled optimizer was captured at
+        ``set_optimizer`` time."""
+        rescale = self._optimizer.rescale_grad
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null" and p._data is not None:
+                self._kvstore.push(i, p.grad() * rescale, priority=-i)
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null" and p._data is not None:
+                self._kvstore.pull(i, out=p.data(), priority=-i)
+
     def step(self, batch_size, ignore_stale_grad=False):
-        """allreduce + rescale + optimizer update (reference trainer.py:329)."""
+        """allreduce + rescale + optimizer update (reference trainer.py:329).
+
+        ``batch_size`` is the GLOBAL batch: under elastic re-balancing
+        the survivors take over the departed worker's share of the data,
+        so the summed gradient — and this constant rescale — is
+        fleet-size invariant (that is what makes an elastic run converge
+        to the uninterrupted run's weights)."""
+        fault.inject("trainer.step")
         if not self._kv_initialized:
             self._init_kvstore()
+        if self._elastic and self._evicted_reason is not None:
+            self._on_evicted(self._evicted_reason)
         self._optimizer.rescale_grad = self._scale / batch_size
-        self.allreduce_grads()
-        self._update(ignore_stale_grad)
+        try:
+            if self._uokv:
+                self._sync_on_kvstore()
+            else:
+                self.allreduce_grads()
+        except WorkerEvictedError as e:
+            self._on_evicted(str(e))
+        if self._elastic:
+            self._note_fleet()
+        if not self._uokv:
+            self._update(ignore_stale_grad)
+        self._step_count += 1
 
     def update(self, batch_size, ignore_stale_grad=False):
         self._optimizer.rescale_grad = self._scale / batch_size

@@ -53,6 +53,26 @@ class KVStoreBase:
     def num_workers(self):
         return 1
 
+    @property
+    def live_workers(self):
+        """Current live fleet size; equals ``num_workers`` unless the
+        store carries an elastic membership table (DistKVStore over the
+        PS transport)."""
+        return self.num_workers
+
+    def join(self, rank=None):
+        """Enter an elastic fleet's membership table (no-op for stores
+        without membership)."""
+        return None
+
+    def leave(self):
+        """Gracefully exit an elastic fleet (no-op without membership)."""
+        return None
+
+    def beat(self):
+        """Membership heartbeat (no-op without membership)."""
+        return None
+
     def init(self, key, value):
         raise NotImplementedError
 

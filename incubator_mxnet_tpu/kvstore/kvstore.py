@@ -332,6 +332,49 @@ class DistKVStore(_BaseStore):
         Collective transport has no servers: returns []."""
         return [c.heartbeat() for c in self._clients]
 
+    # -- elastic membership (docs/fault_tolerance.md "Elasticity") ------
+    def join(self, rank=None):
+        """Enter the fleet: register this worker (declared dp-rank =
+        launcher rank unless given) with EVERY parameter server's
+        membership table.  Idempotent; also the re-admission step after
+        :class:`~incubator_mxnet_tpu.error.WorkerEvictedError`.
+        Collective transport has no membership: no-op."""
+        rank = self.rank if rank is None else rank
+        return [c.join(rank) for c in self._clients]
+
+    def leave(self):
+        """Gracefully exit the fleet: sync rounds re-balance to the
+        survivors immediately instead of burning the heartbeat budget."""
+        return [c.leave() for c in self._clients]
+
+    def beat(self):
+        """One membership heartbeat against every server; returns their
+        vitals.  Raises the typed
+        :class:`~incubator_mxnet_tpu.error.WorkerEvictedError` when any
+        server has evicted this worker — the beat delivers the
+        eviction notice."""
+        return [c.beat() for c in self._clients]
+
+    @property
+    def live_workers(self):
+        """Live fleet size, BEST-EFFORT: the smallest membership count
+        any reachable server reports (servers evict independently; the
+        tightest view is the safe one to re-balance on).  Falls back to
+        ``num_workers`` when membership is inactive (collective
+        transport, no joins) or no server answered its probe — a
+        property read must never raise or hang on a dead server (use
+        :meth:`check_health` for a raising probe)."""
+        if not self._clients:
+            return self.num_workers
+        counts = []
+        for c in self._clients:
+            try:
+                counts.append(c.heartbeat().get("live_workers", 0))
+            except (ConnectionError, TimeoutError):
+                continue   # unreachable server: skip, don't raise
+        live = min(counts) if counts else 0
+        return live if live > 0 else self.num_workers
+
 
 def _onp_of(v):
     import numpy as onp

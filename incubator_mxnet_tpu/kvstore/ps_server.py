@@ -40,10 +40,25 @@ Fault tolerance (docs/fault_tolerance.md):
   weights + dedup table), so recovery does not double-apply in-flight
   retries.
 
+Elastic membership (docs/fault_tolerance.md "Elasticity"): workers
+``join`` with a declared dp-rank and then ``beat`` periodically; a
+member silent past ``MXNET_KVSTORE_BEAT_INTERVAL`` ×
+``MXNET_KVSTORE_DEAD_AFTER`` seconds is evicted and sync rounds /
+barriers re-balance to the survivors (aggregation counts LIVE members,
+not the static ``num_workers`` — the push/barrier seq dedup makes
+re-balancing mid-round safe).  An evicted worker's next call answers a
+typed :class:`~incubator_mxnet_tpu.error.WorkerEvictedError` (its own
+beat delivers the eviction notice), and a (re)``join`` re-admits it;
+the joiner bootstraps by pulling current weights (a bare pull waits for
+a quiescent point) before entering the next round.
+
 Wire protocol: request = (cmd, key, payload); response = (ok, payload).
 Push payloads may be wrapped as ``{"__ps__": 1, "data": .., "sess": ..,
-"seq": ..}`` for dedup; bare arrays are accepted (no dedup).
-Commands: init, push, pull, set_optimizer, barrier, heartbeat, stop.
+"seq": ..}`` for dedup; bare arrays are accepted (no dedup).  Sync push
+acks carry the round the push joined (``{"round": n}``) so a pull can
+wait for exactly that round even after rejoin resets the client's seq.
+Commands: init, push, pull, set_optimizer, barrier, heartbeat, join,
+leave, beat, stop.
 Error responses carry ``"Kind: message"`` and are re-raised client-side
 as the registered error class (error.get_error_class).
 """
@@ -55,13 +70,14 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 import uuid
 
 import numpy as onp
 
 from .. import fault
 from ..base import get_env
-from ..error import PSTimeoutError, get_error_class
+from ..error import PSTimeoutError, WorkerEvictedError, get_error_class
 
 __all__ = ["PSServer", "PSClient", "serve_forever"]
 
@@ -79,6 +95,15 @@ def _send_msg(sock, obj):
 
 class _CleanClose(ConnectionError):
     """Peer closed at a message boundary — an orderly disconnect."""
+
+
+def _raise_server_error(out):
+    """Re-raise a marshalled ``"Kind: message"`` error response as its
+    registered error class (error.get_error_class)."""
+    kind, sep, msg = str(out).partition(": ")
+    if sep:
+        raise get_error_class(kind)(f"ps server error: {msg}")
+    raise RuntimeError(f"ps server error: {out}")
 
 
 def _recv_msg(sock):
@@ -101,22 +126,132 @@ def _recv_msg(sock):
 
 
 class _State:
-    """Server-side store + sync-round bookkeeping."""
+    """Server-side store + sync-round bookkeeping + membership."""
 
     def __init__(self, mode, num_workers):
         self.mode = mode
         self.num_workers = num_workers
         self.store: dict = {}
         self.merge: dict = {}           # key -> (accum, count) for sync
+        self.merge_need: dict = {}      # key -> open round's threshold
         self.round_done: dict = {}      # key -> round counter
-        self.seen: dict = {}            # (session, key) -> last seq applied
+        self.seen: dict = {}            # (session, key) -> (seq, round)
         self.barrier_seen: dict = {}    # session -> (seq, gen entered)
         self.updater = None
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
         self.barrier_count = 0
         self.barrier_gen = 0
+        self.barrier_need = None        # open barrier's frozen threshold
         self.wait_timeout = _timeout_s()
+        # -- elastic membership (empty table = fixed-fleet semantics) --
+        self.members: dict = {}         # session -> {"rank", "last_beat"}
+        self.evicted: dict = {}         # session -> eviction reason
+        self.departed = 0               # evictions + leaves, net of rejoins
+        self.beat_interval = get_env("MXNET_KVSTORE_BEAT_INTERVAL",
+                                     5.0, float)
+        self.dead_after = get_env("MXNET_KVSTORE_DEAD_AFTER", 3, int)
+
+    # -- membership (every method below is called with the lock held) --
+    def required(self):
+        """Pushes/arrivals a sync round needs.
+
+        Membership shrinks a round only through DEPARTURE (eviction or
+        graceful leave) — never through a worker that has not joined
+        yet: during the startup join window the floor stays at the
+        launcher's ``num_workers``, so a fast first joiner cannot
+        complete a "round" of one with a partial fleet's gradient
+        while its peers' joins are still in flight.  With no membership
+        activity at all, the static reference semantics hold."""
+        if not self.members and self.departed == 0:
+            return self.num_workers
+        return max(1, len(self.members), self.num_workers - self.departed)
+
+    def open_need(self, key):
+        """Threshold for ``key``'s OPEN round: frozen at the membership
+        when the round's first push arrived (a worker joining mid-round
+        must not inflate a round the survivors are already completing;
+        its own pushes count toward the NEXT round's threshold), and
+        only ever lowered — by :meth:`rebalance` when a member departs
+        mid-round."""
+        if key not in self.merge_need:
+            self.merge_need[key] = self.required()
+        return self.merge_need[key]
+
+    def check_not_evicted(self, sess, what):
+        if sess is not None and sess in self.evicted:
+            raise WorkerEvictedError(
+                f"worker session {sess[:8]} was evicted "
+                f"({self.evicted[sess]}); join again (and bootstrap by "
+                f"pulling current weights) before {what}")
+
+    def sweep(self):
+        """Evict members silent past their heartbeat budget and
+        re-balance open rounds/barriers to the survivors.  Returns
+        False so it composes into wait predicates."""
+        if not self.members:
+            return False
+        now = time.monotonic()
+        budget = self.beat_interval * self.dead_after
+        dead = [s for s, m in self.members.items()
+                if now - m["last_beat"] > budget]
+        for s in dead:
+            m = self.members.pop(s)
+            self.departed += 1
+            self.evicted[s] = (
+                f"missed its heartbeat budget: silent "
+                f"{now - m['last_beat']:.2f}s > {self.dead_after} beats "
+                f"x {self.beat_interval:.2f}s")
+            _log.warning(
+                "ps membership: evicted worker rank=%s sess=%s (%s); "
+                "%d live member(s) remain", m["rank"], s[:8],
+                self.evicted[s], len(self.members))
+        if dead:
+            self.rebalance()
+            self.cv.notify_all()
+        return False
+
+    def rebalance(self):
+        """A shrunken fleet may complete open sync rounds and the
+        barrier: aggregation counts live members, and the seq dedup
+        already protects against a straggler's retry re-counting.
+        Open-round thresholds only ever go DOWN here — a join never
+        raises them (see :meth:`open_need`)."""
+        need = self.required()
+        for key, (acc, cnt) in list(self.merge.items()):
+            if cnt == 0:
+                continue
+            self.merge_need[key] = min(
+                self.merge_need.get(key, need), need)
+            if cnt >= self.merge_need[key]:
+                self.apply_update(key, acc)
+                self.merge[key] = (None, 0)
+                del self.merge_need[key]
+                self.round_done[key] = self.round_done.get(key, 0) + 1
+        if self.barrier_count > 0:
+            self.barrier_need = min(self.barrier_need or need, need)
+            if self.barrier_count >= self.barrier_need:
+                self.barrier_count = 0
+                self.barrier_need = None
+                self.barrier_gen += 1
+
+    def wait_with_sweep(self, pred, timeout):
+        """``cv.wait_for`` that additionally wakes at least once per
+        half beat interval to run the eviction sweep — a dead worker
+        cannot stall a round past its heartbeat budget even when every
+        survivor is blocked waiting here."""
+        deadline = time.monotonic() + timeout
+        while True:
+            self.sweep()
+            if pred():
+                return True
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            if self.members or self.evicted:   # membership active
+                remaining = min(remaining,
+                                max(self.beat_interval / 2.0, 0.01))
+            self.cv.wait(remaining)
 
     def apply_update(self, key, grad):
         if self.updater is not None:
@@ -193,58 +328,82 @@ class _Handler(socketserver.BaseRequestHandler):
                 payload = payload["data"]
             if st.mode == "async":
                 with st.lock:
+                    st.sweep()
                     if sess is not None:
-                        if seq <= st.seen.get((sess, key), -1):
+                        prev = st.seen.get((sess, key))
+                        if prev is not None and seq <= prev[0]:
                             return True, None   # duplicate of applied push
-                        st.seen[(sess, key)] = seq
+                        st.check_not_evicted(sess, "pushing")
+                        st.seen[(sess, key)] = (seq, None)
                     # reference async: apply immediately, no aggregation
                     st.apply_update(key, payload)
                 return True, None
             with st.cv:
+                st.sweep()
                 if sess is not None:
-                    if seq <= st.seen.get((sess, key), -1):
-                        return True, None       # retried push: already merged
-                    st.seen[(sess, key)] = seq
+                    prev = st.seen.get((sess, key))
+                    if prev is not None and seq <= prev[0]:
+                        # retried push: already merged — re-ack the round
+                        # the ORIGINAL joined (its ack was lost)
+                        return True, {"round": prev[1]}
+                    st.check_not_evicted(sess, "pushing")
+                # the round this push joins completes when round_done
+                # reaches target; the ack carries it so the client's
+                # pull waits for exactly this round (survives rejoin
+                # resetting the client-side seq counter)
+                target = st.round_done.get(key, 0) + 1
+                if sess is not None:
+                    st.seen[(sess, key)] = (seq, target)
                 acc, cnt = st.merge.get(key, (None, 0))
                 acc = payload if acc is None else acc + payload
                 cnt += 1
-                if cnt >= st.num_workers:
+                if cnt >= st.open_need(key):
                     st.apply_update(key, acc)
                     st.merge[key] = (None, 0)
-                    st.round_done[key] += 1
+                    st.merge_need.pop(key, None)
+                    st.round_done[key] = st.round_done.get(key, 0) + 1
                     st.cv.notify_all()
                 else:
                     st.merge[key] = (acc, cnt)
-            return True, None
+            return True, {"round": target}
         if cmd == "pull":
-            after_seq = None
+            sess = target = None
             if isinstance(payload, dict) and payload.get("__ps__") == 1:
-                after_seq = payload.get("after_seq")
+                sess = payload.get("sess")
+                if payload.get("round") is not None:
+                    target = int(payload["round"])
+                elif payload.get("after_seq") is not None:
+                    target = int(payload["after_seq"]) + 1
             if st.mode == "async":
                 with st.lock:
+                    st.sweep()
+                    st.check_not_evicted(sess, "pulling")
                     return True, onp.array(st.store[key])
             # sync, bounded wait — a dead worker must surface, not hang
             # the fleet.  A puller that has pushed waits for the round
-            # its own push joined (round_done >= seq+1): waiting for
-            # "no partial round" would deadlock when a fast peer opens
-            # the NEXT round before this pull is served (reference
-            # semantics: ApplyUpdates wakes the round's own pulls).
+            # its own push joined (the round target from the push ack):
+            # waiting for "no partial round" would deadlock when a fast
+            # peer opens the NEXT round before this pull is served
+            # (reference semantics: ApplyUpdates wakes the round's own
+            # pulls).
             with st.cv:
-                if after_seq is not None:
-                    target = int(after_seq) + 1
-                    done = st.cv.wait_for(
+                st.check_not_evicted(sess, "pulling")
+                if target is not None:
+                    done = st.wait_with_sweep(
                         lambda: st.round_done.get(key, 0) >= target,
                         timeout=st.wait_timeout)
                 else:  # bare puller (never pushed): any quiescent point
-                    done = st.cv.wait_for(
+                    done = st.wait_with_sweep(
                         lambda: st.merge.get(key, (None, 0))[1] == 0,
                         timeout=st.wait_timeout)
+                # the waiter itself may have been evicted while blocked
+                st.check_not_evicted(sess, "pulling")
                 if not done:
                     cnt = st.merge.get(key, (None, 0))[1]
                     raise PSTimeoutError(
                         f"sync pull of key {key!r} stalled in round "
                         f"{st.round_done.get(key, 0)}: {cnt} of "
-                        f"{st.num_workers} pushes after "
+                        f"{st.required()} pushes after "
                         f"{st.wait_timeout:.0f}s (a worker likely died "
                         "mid-round)")
                 return True, onp.array(st.store[key])
@@ -269,6 +428,8 @@ class _Handler(socketserver.BaseRequestHandler):
             if isinstance(payload, dict) and payload.get("__ps__") == 1:
                 sess, seq = payload["sess"], payload["seq"]
             with st.cv:
+                st.sweep()
+                st.check_not_evicted(sess, "entering a barrier")
                 if sess is not None:
                     prev = st.barrier_seen.get(sess)
                     if prev is not None and seq <= prev[0]:
@@ -279,38 +440,102 @@ class _Handler(socketserver.BaseRequestHandler):
                         gen0 = prev[1]
                         if st.barrier_gen > gen0:
                             return True, None
-                        done = st.cv.wait_for(
+                        done = st.wait_with_sweep(
                             lambda: st.barrier_gen > gen0,
                             timeout=st.wait_timeout)
                         if not done:
                             raise PSTimeoutError(
                                 f"barrier generation {gen0} stalled: "
-                                f"{st.barrier_count} of {st.num_workers} "
+                                f"{st.barrier_count} of {st.required()} "
                                 f"workers arrived after "
                                 f"{st.wait_timeout:.0f}s")
                         return True, None
                     st.barrier_seen[sess] = (seq, st.barrier_gen)
                 gen = st.barrier_gen
                 st.barrier_count += 1
-                if st.barrier_count >= st.num_workers:
+                if st.barrier_need is None:
+                    # threshold frozen at the first arrival's membership
+                    # (a mid-barrier joiner must not inflate it); only
+                    # rebalance() may lower it
+                    st.barrier_need = st.required()
+                if st.barrier_count >= st.barrier_need:
                     st.barrier_count = 0
+                    st.barrier_need = None
                     st.barrier_gen += 1
                     st.cv.notify_all()
                 else:
-                    done = st.cv.wait_for(lambda: st.barrier_gen > gen,
-                                          timeout=st.wait_timeout)
+                    done = st.wait_with_sweep(
+                        lambda: st.barrier_gen > gen,
+                        timeout=st.wait_timeout)
                     if not done:
                         cnt, st.barrier_count = st.barrier_count, \
                             st.barrier_count - 1   # leave the barrier
+                        if st.barrier_count == 0:
+                            st.barrier_need = None  # next barrier refreezes
                         raise PSTimeoutError(
                             f"barrier generation {gen} stalled: {cnt} of "
-                            f"{st.num_workers} workers arrived after "
+                            f"{st.required()} workers arrived after "
                             f"{st.wait_timeout:.0f}s")
             return True, None
+        if cmd == "join":
+            sess, rank = payload["sess"], payload.get("rank")
+            with st.cv:
+                st.sweep()
+                rejoin = st.evicted.pop(sess, None) is not None
+                st.members[sess] = {"rank": rank,
+                                    "last_beat": time.monotonic()}
+                # any join that grows the fleet past its current
+                # expected size (num_workers - departed) is a departed
+                # worker coming back — same-session rejoin after
+                # eviction, rejoin after a graceful leave, or a fresh
+                # replacement process — so net it out of `departed`
+                # (startup joins stay within the expected size and
+                # leave the floor alone)
+                if (st.departed > 0 and len(st.members)
+                        > max(0, st.num_workers - st.departed)):
+                    st.departed -= 1
+                _log.info("ps membership: worker rank=%s sess=%s "
+                          "%sjoined; %d live", rank, sess[:8],
+                          "re" if rejoin else "", len(st.members))
+                return True, {"live_workers": len(st.members),
+                              "rank": rank, "rejoin": rejoin,
+                              "barrier_gen": st.barrier_gen}
+        if cmd == "leave":
+            sess = payload["sess"]
+            with st.cv:
+                m = st.members.pop(sess, None)
+                st.evicted.pop(sess, None)  # a graceful leave, not evict
+                if m is not None:
+                    st.departed += 1
+                    st.rebalance()
+                    st.cv.notify_all()
+                return True, {"live_workers": len(st.members)}
+        if cmd == "beat":
+            sess = payload["sess"]
+            with st.cv:
+                st.sweep()
+                st.check_not_evicted(sess, "beating")
+                m = st.members.get(sess)
+                if m is None:
+                    # a beat from a session the table does not know is
+                    # the same actionable notice as an eviction: (re)join
+                    # and bootstrap before training on
+                    raise WorkerEvictedError(
+                        f"worker session {sess[:8]} is not in the "
+                        "membership table (server restarted, or the "
+                        "worker never joined); join again and bootstrap "
+                        "by pulling current weights")
+                m["last_beat"] = time.monotonic()
+                return True, {"live_workers": len(st.members),
+                              "rank": m["rank"],
+                              "num_keys": len(st.store),
+                              "barrier_gen": st.barrier_gen}
         if cmd == "heartbeat":
             with st.lock:
+                st.sweep()
                 return True, {"mode": st.mode,
                               "num_workers": st.num_workers,
+                              "live_workers": len(st.members),
                               "num_keys": len(st.store),
                               "barrier_gen": st.barrier_gen}
         return False, f"unknown command {cmd!r}"
@@ -396,6 +621,7 @@ class PSClient:
                             else get_env("MXNET_KVSTORE_RETRIES", 5, int))
         self.session = uuid.uuid4().hex
         self._seq: dict = {}       # key -> last sequence number issued
+        self._round_target: dict = {}  # key -> round our pushes reached
         self._barrier_seq = -1
         self.lock = threading.Lock()
         self.sock = None
@@ -432,11 +658,18 @@ class PSClient:
                 seq = self._seq[key] = self._seq.get(key, -1) + 1
                 payload = {"__ps__": 1, "data": payload,
                            "sess": self.session, "seq": seq}
-            elif cmd == "pull" and key in self._seq:
+            elif cmd == "pull":
                 # tell the server which round our own pushes reached so
-                # the sync wait targets that round, not global quiescence
-                payload = {"__ps__": 1, "sess": self.session,
-                           "after_seq": self._seq[key]}
+                # the sync wait targets that round, not global
+                # quiescence; the round target comes from the push acks
+                # (robust across rejoin, which resets the seq counter).
+                # A bare pull still identifies the session so an evicted
+                # worker gets its typed notice instead of stale weights.
+                payload = {"__ps__": 1, "sess": self.session}
+                if key in self._round_target:
+                    payload["round"] = self._round_target[key]
+                elif key in self._seq:
+                    payload["after_seq"] = self._seq[key]
             elif cmd == "barrier":
                 # barriers carry a seq too: a retried arrival must not
                 # count twice or the barrier releases early
@@ -466,32 +699,64 @@ class PSClient:
                     f"{self.max_retries} attempts to {self.host}:"
                     f"{self.port}: {e}") from e
         if not ok:
-            kind, sep, msg = str(out).partition(": ")
-            if sep:
-                raise get_error_class(kind)(f"ps server error: {msg}")
-            raise RuntimeError(f"ps server error: {out}")
+            _raise_server_error(out)
+        if cmd == "push" and isinstance(out, dict) \
+                and out.get("round") is not None:
+            with self.lock:
+                self._round_target[key] = max(
+                    self._round_target.get(key, 0), out["round"])
         return out
 
-    def heartbeat(self, timeout=5.0):
-        """Liveness probe: server vitals, or raises PSTimeoutError.
+    # -- elastic membership (docs/fault_tolerance.md "Elasticity") ------
+    def join(self, rank=None):
+        """Enter the server's membership table with a declared dp-rank.
+        Idempotent (a retried join re-admits the same session); also the
+        re-admission path after a :class:`WorkerEvictedError`."""
+        return self.call("join", None,
+                         {"sess": self.session, "rank": rank})
 
-        One shot on a dedicated connection with a SHORT budget — a
-        health probe that rides the full retry pipeline (minutes
-        against a hung server) answers slower than the failure it is
-        meant to diagnose."""
+    def leave(self):
+        """Gracefully exit the membership table (rounds re-balance to
+        the survivors immediately, no heartbeat budget to burn)."""
+        return self.call("leave", None, {"sess": self.session})
+
+    def _oneshot(self, cmd, payload, timeout):
+        """One request on a DEDICATED short-budget connection.
+
+        Liveness traffic must not ride the main connection: it may be
+        parked in a blocking sync pull under the client lock (a worker
+        must never starve its own heartbeat waiting for slow peers),
+        and it must not ride the retry pipeline either (a probe that
+        retries for minutes answers slower than the failure it
+        diagnoses; a lost beat is simply lost — that IS the missed-beat
+        semantic the eviction budget counts)."""
         try:
+            # injected probe/beat loss is a ConnectionError: it wraps
+            # to the same typed PSTimeoutError a real lost one surfaces
+            fault.inject("kvstore.heartbeat", detail=cmd)
             with socket.create_connection((self.host, self.port),
                                           timeout=timeout) as s:
                 s.settimeout(timeout)
-                _send_msg(s, ("heartbeat", None, None))
+                _send_msg(s, (cmd, None, payload))
                 ok, out = _recv_msg(s)
         except (ConnectionError, TimeoutError, OSError) as e:
             raise PSTimeoutError(
-                f"ps heartbeat to {self.host}:{self.port} failed "
-                f"within {timeout:.0f}s: {e}") from e
+                f"ps {cmd} to {self.host}:{self.port} failed within "
+                f"{timeout:.0f}s: {e}") from e
         if not ok:
-            raise RuntimeError(f"ps server error: {out}")
+            _raise_server_error(out)
         return out
+
+    def beat(self, timeout=5.0):
+        """Membership heartbeat: refreshes this worker's liveness and
+        returns fleet vitals.  An evicted (or unknown) session receives
+        the typed :class:`~incubator_mxnet_tpu.error.WorkerEvictedError`
+        — the beat IS the eviction notice delivery path."""
+        return self._oneshot("beat", {"sess": self.session}, timeout)
+
+    def heartbeat(self, timeout=5.0):
+        """Liveness probe: server vitals, or raises PSTimeoutError."""
+        return self._oneshot("heartbeat", None, timeout)
 
     def close(self):
         if self.sock is not None:

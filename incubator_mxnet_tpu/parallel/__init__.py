@@ -16,7 +16,8 @@ inserted by GSPMD or explicitly via ``shard_map``:
 * ep  — expert parallelism: experts sharded over the mesh with
   all-to-all token routing
 """
-from .mesh import make_mesh, mesh_rules, shard_params, local_mesh
+from .mesh import (make_mesh, mesh_rules, shard_params, local_mesh,
+                   leading_axis_rule)
 from .ring_attention import ring_attention
 from .ulysses import ulysses_attention
 from .pipeline import pipeline_forward

@@ -6,7 +6,8 @@ import numpy as onp
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["make_mesh", "local_mesh", "mesh_rules", "shard_params"]
+__all__ = ["make_mesh", "local_mesh", "mesh_rules", "shard_params",
+           "leading_axis_rule"]
 
 AXES = ("dp", "pp", "tp", "sp", "ep")
 
@@ -48,6 +49,29 @@ def mesh_rules(kind: str):
         "logits": P("dp", "sp", "tp"),
     }
     return rules[kind]
+
+
+def leading_axis_rule(mesh: Mesh, axis: str = "dp"):
+    """``rule_fn(name, leaf) -> PartitionSpec`` sharding the leading
+    dimension over ``axis`` whenever it divides evenly, replicating
+    otherwise — the standard fully-sharded-data-parallel placement for
+    parameter trees.
+
+    Works for both :func:`shard_params` (leaf = array) and
+    ``AsyncCheckpointManager.reshard_restore`` (leaf =
+    ``jax.ShapeDtypeStruct``): only ``.shape`` is consulted, so one rule
+    serves save-side placement and restore-side re-layout across mesh
+    shapes.
+    """
+    n = int(mesh.shape[axis])
+
+    def rule(name, leaf):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if shape and n > 1 and shape[0] % n == 0:
+            return P(axis, *([None] * (len(shape) - 1)))
+        return P()
+
+    return rule
 
 
 def shard_params(params, mesh: Mesh, rule_fn):

@@ -125,8 +125,8 @@ def test_ps_heartbeat_and_reinit_guard():
     srv = _start_server("sync", num_workers=2)
     c = PSClient("127.0.0.1", srv.port)
     hb = c.heartbeat()
-    assert hb == {"mode": "sync", "num_workers": 2, "num_keys": 0,
-                  "barrier_gen": 0}
+    assert hb == {"mode": "sync", "num_workers": 2, "live_workers": 0,
+                  "num_keys": 0, "barrier_gen": 0}
     c.call("init", "w", onp.zeros(3, onp.float32))
     assert c.heartbeat()["num_keys"] == 1
     with pytest.raises(ValueError, match="existing key"):
