@@ -24,6 +24,17 @@ Modes:
                  overhead and CI noise are not what we gate on)
   --model-zoo M  run against a real model_zoo artifact (exported via
                  scripts/export_model_zoo.py) instead of the toy MLP
+  --replicas N   fleet scaling curve (ISSUE 8): closed-loop volleys
+                 through the FleetRouter over 1, 2, ... N replicas
+                 (process backend by default — real per-replica
+                 isolation), reporting throughput + p99 per count.
+                 With --check, enforces zero failed requests, output
+                 parity, and the 2-replica >= 1.6x single-replica
+                 floor — the floor is enforced only where the host
+                 has >= 2 CPUs to express replica parallelism (a
+                 1-core container timeshares the replicas, so the
+                 ratio is physics, not a regression; the record then
+                 carries floor_checked=false with the reason)
 """
 from __future__ import annotations
 
@@ -42,9 +53,11 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import numpy as onp   # noqa: E402
 
 
-def _toy_artifact(prefix):
+def _toy_artifact(prefix, width=128, depth=6):
     """Dispatch-overhead-dominated MLP: the regime a request-per-call
-    server wastes, which batching reclaims."""
+    server wastes, which batching reclaims.  The fleet bench widens it
+    (width 256, depth 8) so replica-side compute dominates the router
+    hop and replica scaling is what gets measured."""
     import jax.numpy as jnp
     from incubator_mxnet_tpu import deploy
 
@@ -55,9 +68,9 @@ def _toy_artifact(prefix):
         return y
 
     rng = onp.random.RandomState(0)
-    params = {"layers": [rng.randn(128, 128).astype(onp.float32) * 0.1
-                         for _ in range(6)]}
-    x = rng.randn(1, 128).astype(onp.float32)
+    params = {"layers": [rng.randn(width, width).astype(onp.float32)
+                         * 0.1 for _ in range(depth)]}
+    x = rng.randn(1, width).astype(onp.float32)
     deploy.export_model(fwd, (x,), prefix, params=params)
     return prefix
 
@@ -208,6 +221,134 @@ def bench(args):
     return rec
 
 
+def fleet_bench(args):
+    """Fleet scaling curve: closed-loop volleys through the router
+    over growing replica counts.  Spawn/warmup time is off-clock; the
+    measured window is pure request traffic."""
+    import json as _json
+
+    from incubator_mxnet_tpu import deploy
+    from incubator_mxnet_tpu.serving import FleetRouter, ReplicaFleet
+
+    prefix = os.path.join(args.workdir, "serving_fleet_model")
+    _toy_artifact(prefix, width=256, depth=8)
+    pred = deploy.load_predictor(prefix)
+    instances = _instances(pred.meta, args.requests, seed=3)
+    refs = [pred(*[x[None] for x in inst]) for inst in instances]
+    encoded = [_json.dumps([x.tolist() for x in inst])
+               for inst in instances]     # one serialization, reused
+    total = args.requests * args.rounds
+
+    counts = [1]
+    c = 2
+    while c < args.replicas:
+        counts.append(c)
+        c *= 2
+    if args.replicas > 1:
+        counts.append(args.replicas)
+    counts = sorted(set(counts))
+
+    curve = {}
+    failed = []
+    verified = True
+    import jax
+    for n in counts:
+        fleet = ReplicaFleet({"bench": prefix}, n=n,
+                             backend=args.backend).spawn()
+        router = FleetRouter(fleet)
+        try:
+            results = [None] * args.requests
+            nclients = min(args.clients, args.requests)
+            bounds = [args.requests * k // nclients
+                      for k in range(nclients + 1)]
+            lat = []
+            lat_lock = threading.Lock()
+            barrier = threading.Barrier(nclients + 1)
+
+            def client(k):
+                barrier.wait()
+                mine = []
+                for _ in range(args.rounds):
+                    for i in range(bounds[k], bounds[k + 1]):
+                        t1 = time.monotonic()
+                        try:
+                            out, _t = router.route(
+                                "bench", instances[i],
+                                inputs_json=encoded[i])
+                            results[i] = out
+                        except Exception as e:  # mxlint: allow-broad-except(bench verdict: every failure is collected and fails --check)
+                            failed.append((n, i, repr(e)))
+                            return
+                        mine.append(
+                            (time.monotonic() - t1) * 1000.0)
+                with lat_lock:
+                    lat.extend(mine)
+
+            threads = [threading.Thread(target=client, args=(k,))
+                       for k in range(nclients)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.monotonic()
+            for t in threads:
+                t.join()
+            dt = time.monotonic() - t0
+            curve[n] = {"rps": round(total / dt, 2),
+                        "p99_ms": round(_p99(lat), 3) if lat else None,
+                        "total_s": round(dt, 3)}
+            for i in range(0, args.requests,
+                           max(1, args.requests // 8)):
+                if results[i] is None:
+                    continue
+                for a, b in zip(results[i],
+                                jax.tree_util.tree_leaves(refs[i])):
+                    got = onp.asarray(a, dtype=onp.asarray(b).dtype)
+                    if not (got == onp.asarray(b)[0]).all():
+                        verified = False
+        finally:
+            router.shutdown()
+
+    cpus = os.cpu_count() or 1
+    scaling_2x = (round(curve[2]["rps"] / curve[1]["rps"], 2)
+                  if 2 in curve and 1 in curve else None)
+    floor_checked = scaling_2x is not None and cpus >= 2
+    top = max(curve)
+    rec = {
+        "metric": "serving_fleet_scaling_rps",
+        "value": curve[top]["rps"],
+        "unit": "req/s",
+        "replicas": top,
+        "backend": args.backend,
+        "per_replicas": {str(n): v for n, v in sorted(curve.items())},
+        "scaling_2x": scaling_2x,
+        "floor_checked": floor_checked,
+        "floor_skip_reason": (
+            "" if floor_checked else
+            (f"host has {cpus} cpu(s); replica parallelism is not "
+             f"expressible" if scaling_2x is not None
+             else "needs --replicas >= 2")),
+        "failed_requests": len(failed),
+        "requests_per_count": total,
+        "verified": bool(verified),
+        "platform": os.environ.get("JAX_PLATFORMS", "tpu"),
+    }
+    failures = []
+    if failed:
+        failures.append(f"{len(failed)} failed requests "
+                        f"(first: {failed[0]})")
+    if not verified:
+        failures.append("fleet outputs diverged from unbatched "
+                        "baseline")
+    if args.check and floor_checked and scaling_2x < 1.6:
+        failures.append(
+            f"2-replica scaling {scaling_2x}x < 1.6x floor")
+    if args.check and not floor_checked:
+        print(f"[serving_bench] scaling floor advisory only: "
+              f"{rec['floor_skip_reason']}", file=sys.stderr,
+              flush=True)
+    return rec, failures
+
+
 def smoke(args):
     """CI serving stage: ephemeral HTTP server end-to-end."""
     prefix = os.path.join(args.workdir, "serving_smoke_model")
@@ -348,11 +489,19 @@ def main(argv=None):
                    help="HTTP end-to-end smoke (CI serving stage)")
     p.add_argument("--model-zoo", default=None, metavar="MODEL",
                    help="bench a model_zoo artifact (e.g. resnet18_v1)")
+    p.add_argument("--replicas", type=int, default=0, metavar="N",
+                   help="fleet scaling mode: volley through the "
+                        "FleetRouter over 1..N replicas")
+    p.add_argument("--backend", choices=("thread", "process"),
+                   default="process",
+                   help="replica backend for --replicas mode")
     p.add_argument("--workdir", default="/tmp")
     args = p.parse_args(argv)
 
     failures = []
-    if args.smoke:
+    if args.replicas:
+        rec, failures = fleet_bench(args)
+    elif args.smoke:
         rec, failures = smoke(args)
     else:
         rec = bench(args)

@@ -27,6 +27,13 @@ Stages (each isolated, failures collected, nonzero exit if any fail):
              port, warm it, fire concurrent requests, scrape /metrics,
              assert the compile count did not move and responses match
              the unbatched baseline bitwise
+  fleet      multi-replica serving sweep under a pinned seeded spec
+             (lossy routing hops, failed probes, replica-side faults):
+             kill-a-replica chaos volley with zero failed client
+             requests, probe quarantine/readmit, rolling reload under
+             load with capacity never below N-1, subprocess-backend
+             SIGKILL end-to-end; plus the --replicas scaling bench
+             with its 2-replica >= 1.6x floor (multicore hosts)
 
   lint       mxlint (docs/static_analysis.md) over the python surface:
              framework-invariant rules (env-var/docs sync, fault-point
@@ -157,24 +164,31 @@ def stage_bulking(args):
 
 # Fixed chaos spec (docs/fault_tolerance.md): seeded so every run
 # replays the same fault schedule — a chaos failure bisects like any
-# other deterministic test failure.
+# other deterministic test failure.  The serving points ride along
+# (seeded errors on batch execution, delays on enqueue) with a retry
+# budget deep enough that p=0.05 per-attempt faults cannot exhaust it
+# on a sustained volley (0.05**6 per batch).
 CHAOS_SPEC = ("kvstore.send:error:p=0.05:seed=7,"
               "kvstore.recv:error:p=0.05:seed=11,"
-              "checkpoint.write:delay:ms=20")
+              "checkpoint.write:delay:ms=20,"
+              "serving.enqueue:delay:ms=1,"
+              "serving.execute:error:p=0.05:seed=13")
 
 
 def stage_chaos(args):
-    """Fault-tolerance sweep: the kvstore + checkpoint subset must pass
-    with deterministic transient faults injected on the PS transport
-    and checkpoint writes (client retries + push dedup + CRC paths)."""
+    """Fault-tolerance sweep: the kvstore + checkpoint + serving subset
+    must pass with deterministic transient faults injected on the PS
+    transport, checkpoint writes, and the serving enqueue/execute path
+    (client retries + push dedup + CRC + batcher-retry paths)."""
     # yarn/sge shim tests exercise scheduler CLIs, not fault paths
     proc = sh([sys.executable, "-m", "pytest", "-q",
                "tests/test_fault.py", "tests/test_distributed.py",
-               "tests/test_checkpoint.py",
+               "tests/test_checkpoint.py", "tests/test_serving.py",
                "-m", "not slow", "-k", "not yarn and not sge",
                "--continue-on-collection-errors",
                "-p", "no:cacheprovider"],
-              timeout=1800, env={"MXNET_FAULT_SPEC": CHAOS_SPEC})
+              timeout=1800, env={"MXNET_FAULT_SPEC": CHAOS_SPEC,
+                                 "MXNET_SERVING_RETRIES": "6"})
     tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
     return proc.returncode == 0, f"spec={CHAOS_SPEC!r}: {tail}"
 
@@ -215,6 +229,49 @@ def stage_elastic(args):
         return False, f"reshard bench record malformed: {rec}"
     return True, (f"spec ok: {tail}; reshard {rec['metric']}="
                   f"{rec['value']}ms over {rec['restore_ms_by_shape']}")
+
+
+# Pinned fleet-chaos spec: slow/lossy routing hops, failed health
+# probes, replica-side execution faults, jittered device execution —
+# the router's failover/hedging/probing paths all under fire, seeded
+# so a fleet failure replays from the spec string.
+FLEET_SPEC = ("serving.route:delay:ms=1:p=0.25:seed=3,"
+              "serving.probe:error:p=0.1:seed=5,"
+              "serving.replica_exec:error:p=0.05:seed=17,"
+              "serving.execute:delay:ms=2:p=0.2:seed=19")
+
+
+def stage_fleet(args):
+    """Fleet sweep (docs/serving.md "fleet"): the whole test_fleet.py
+    battery — kill-a-replica chaos volley, probe quarantine, rolling-
+    reload-under-load, draining-fleet 503s, plus the process-backend
+    (subprocess SIGKILL) end-to-end — under the pinned seeded spec;
+    then the multi-replica scaling bench with its CI-checked floor
+    (2 replicas >= 1.6x one replica where the host has the cores to
+    express it)."""
+    proc = sh([sys.executable, "-m", "pytest", "-q",
+               "tests/test_fleet.py",
+               "--continue-on-collection-errors",
+               "-p", "no:cacheprovider"],
+              timeout=1800, env={"MXNET_FAULT_SPEC": FLEET_SPEC})
+    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+    if proc.returncode != 0:
+        return False, f"spec={FLEET_SPEC!r}: {tail}"
+    out = os.path.join(REPO, ".ci_fleet_bench.json")
+    try:
+        proc2 = sh([sys.executable, "benchmark/serving_bench.py",
+                    "--replicas", "2", "--check", "--requests", "32",
+                    "--rounds", "2", "--output", out], timeout=1200)
+        if proc2.returncode != 0:
+            return False, (proc2.stderr or proc2.stdout).strip()[-300:]
+        with open(out) as f:
+            rec = json.load(f)
+    finally:
+        if os.path.exists(out):
+            os.remove(out)
+    return True, (f"spec ok: {tail}; scaling 2x={rec['scaling_2x']} "
+                  f"(floor {'checked' if rec['floor_checked'] else 'advisory: ' + rec['floor_skip_reason']}), "
+                  f"errors={rec['failed_requests']}")
 
 
 def stage_serving(args):
@@ -323,7 +380,8 @@ STAGES = {"build": stage_build, "sanity": stage_sanity,
           "unit": stage_unit, "slow": stage_slow,
           "bulking": stage_bulking, "chaos": stage_chaos,
           "elastic": stage_elastic,
-          "serving": stage_serving, "race": stage_race,
+          "serving": stage_serving, "fleet": stage_fleet,
+          "race": stage_race,
           "graphlint": stage_graphlint,
           "multichip": stage_multichip, "bench": stage_bench}
 

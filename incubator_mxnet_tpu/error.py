@@ -15,6 +15,7 @@ __all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
            "TypeError", "AttributeError", "NotImplementedError",
            "PSTimeoutError", "PSConnectionError", "CheckpointCorruptError",
            "CheckpointWriteError", "WorkerEvictedError", "ReshardError",
+           "ReplicaUnavailableError", "FleetDrainingError",
            "EngineRaceError", "RecompileStormError", "GraphLintError",
            "register_error", "get_error_class"]
 
@@ -114,6 +115,25 @@ class ReshardError(MXNetError, _bi.ValueError):
     Integrity damage (CRC mismatch, missing shard files) is NOT this
     error — that stays :class:`CheckpointCorruptError` so newest-first
     fallback applies.  Also catchable as builtin ``ValueError``."""
+
+
+@register_error
+class ReplicaUnavailableError(MXNetError, _bi.ConnectionError):
+    """A serving-fleet request could not be placed on any replica: no
+    replica is in the ``ready`` state (all warming, unhealthy, or
+    dead), or the targeted replica refused the connection.  The fleet
+    router answers 503 with ``Retry-After`` — the condition is
+    transient (replicas re-warm, probes re-admit).  Also catchable as
+    builtin ``ConnectionError`` so failover/retry layers treat it like
+    a real refused socket."""
+
+
+@register_error
+class FleetDrainingError(MXNetError):
+    """Every live replica in the serving fleet is draining — the fleet
+    is shutting down (or mid-roll with nothing re-admitted yet) and
+    admits no new work.  Answered as 503 with ``Retry-After``; a
+    client must never hang on a fleet that will not serve it."""
 
 
 @register_error

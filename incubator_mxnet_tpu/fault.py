@@ -21,6 +21,12 @@ Injection points (each named where the fault physically occurs):
 * ``io.next_batch``     — the data pipeline handing out a batch
 * ``serving.enqueue``   — an inference request entering a model queue
 * ``serving.execute``   — a coalesced batch about to run on the device
+* ``serving.route``     — the fleet router about to place a request on
+  a replica (lost/slow routing hop; failover path)
+* ``serving.probe``     — an active health probe about to hit a
+  replica's ``/healthz`` (lost probes burn the health budget)
+* ``serving.replica_exec`` — a replica about to execute a routed
+  request (replica-side crash/stall; absorbed by failover)
 * ``trainer.step``      — an elastic trainer step about to run (the
   eviction-notice / checkpoint-on-evict path)
 
@@ -75,6 +81,7 @@ __all__ = [
 POINTS = ("kvstore.send", "kvstore.recv", "kvstore.heartbeat",
           "engine.push", "checkpoint.write", "checkpoint.read",
           "io.next_batch", "serving.enqueue", "serving.execute",
+          "serving.route", "serving.probe", "serving.replica_exec",
           "trainer.step")
 
 _POINT_SET = frozenset(POINTS)

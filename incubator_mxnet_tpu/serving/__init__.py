@@ -21,16 +21,23 @@ adaptive batching layer (NSDI'17) and MXNet Model Server:
   admin load/unload/reload.
 * :mod:`.metrics` — Prometheus-text counters/histograms, also folded
   into ``profiler.dumps()`` alongside ``bulk_stats``.
+* :mod:`.fleet` + :mod:`.router` — the multi-replica tier: N replicas
+  (in-process or subprocess) behind a health-checked router with
+  least-loaded placement, per-hop deadline budgets, bounded failover,
+  hedged requests and zero-downtime rolling reload.
 
 Everything is pure stdlib + JAX; no new dependencies.
 """
 from .admission import (DeadlineExceeded, QueueFullError,   # noqa: F401
                         ServingError, ShuttingDown)
 from .batcher import DynamicBatcher                          # noqa: F401
-from .metrics import ServingMetrics                          # noqa: F401
+from .fleet import ReplicaFleet                              # noqa: F401
+from .metrics import FleetMetrics, ServingMetrics            # noqa: F401
 from .model_repository import ModelRepository                # noqa: F401
+from .router import FleetRouter                              # noqa: F401
 from .server import InferenceServer                          # noqa: F401
 
 __all__ = ["ModelRepository", "DynamicBatcher", "InferenceServer",
-           "ServingMetrics", "ServingError", "QueueFullError",
-           "DeadlineExceeded", "ShuttingDown"]
+           "ReplicaFleet", "FleetRouter",
+           "ServingMetrics", "FleetMetrics", "ServingError",
+           "QueueFullError", "DeadlineExceeded", "ShuttingDown"]

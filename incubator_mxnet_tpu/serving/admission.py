@@ -28,7 +28,7 @@ from .. import fault
 
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceeded",
            "ShuttingDown", "ModelNotFound", "BadRequest",
-           "Admission", "checked_enqueue"]
+           "Admission", "checked_enqueue", "checked_route"]
 
 
 class ServingError(Exception):
@@ -132,3 +132,11 @@ def checked_enqueue(model_name):
     lossy front-end hop and surfaces as 503 (retryable by the client);
     delays model admission latency."""
     fault.inject("serving.enqueue", model_name)
+
+
+def checked_route(model_name):
+    """``serving.route`` fault hook: the fleet router fires this before
+    placing a request on a replica.  A transient fault models a lost
+    routing hop (503 to the client, who may retry); a delay models a
+    slow front end eating into the per-hop deadline budget."""
+    fault.inject("serving.route", model_name)

@@ -89,6 +89,19 @@ class PendingResult:
         self._batcher = batcher
         self._req = req
 
+    def cancel(self):
+        """Withdraw this request: if its batch has not started
+        executing yet, the flush worker drops it without spending
+        device time (a hedged or failed-over request whose other copy
+        already won, or a caller that stopped caring).  Best-effort —
+        a request already riding an executing batch completes
+        normally; ``result()`` after ``cancel()`` raises
+        :class:`~.admission.DeadlineExceeded` once the worker has
+        acknowledged the cancellation."""
+        self._req.cancelled = True
+        with self._batcher._cond:
+            self._batcher._cond.notify()
+
     def result(self):
         """Block until this instance's slice of a batch is ready;
         returns ``(outputs, timing)``."""
@@ -104,6 +117,12 @@ class PendingResult:
                 "batch", queue_ms=req.age_ms())
         if req.error is not None:
             raise req.error
+        if req.cancelled and req.batch_out is None:
+            # the worker acknowledged a cancel() before execution: no
+            # result was ever produced for this row
+            raise DeadlineExceeded(
+                f"request to {self._batcher.name!r} was cancelled "
+                "before execution", queue_ms=req.age_ms())
         # slice our row out here, on the caller's thread: the worker's
         # post-execute critical path stays O(1) per request
         out = req.batch_out

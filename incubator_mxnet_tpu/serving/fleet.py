@@ -1,0 +1,757 @@
+"""Replica fleet: N inference replicas behind one lifecycle manager.
+
+The single-process server (PR 3) dies whole: one crash, one stuck
+compile, one reload takes 100% of traffic down.  This module is the
+replica layer under the fleet router (:mod:`.router`): it spawns or
+adopts N replicas of the same model set, tracks each through an
+explicit state machine, probes their health, and walks them one at a
+time through zero-downtime rolling reloads.
+
+State machine (per replica)::
+
+    starting ──► warming ──► ready ◄──► draining
+        │            │         │            │
+        └────────────┴────┬────┴────────────┘
+                          ▼
+                        dead
+
+* ``starting``  constructed, worker not yet loading
+* ``warming``   models loading + per-bucket warmup compiling
+* ``ready``     serving; routable iff also probe-``healthy``
+* ``draining``  out of rotation (rolling reload / shutdown);
+                in-flight requests finish
+* ``dead``      killed or exited; never re-admitted
+
+Two replica backends share one interface:
+
+* :class:`ThreadReplica` — an in-process ``ModelRepository`` (its own
+  predictors, batchers, compile caches).  Cheap to spawn, the default
+  for tests and single-host fleets; a *kill* makes every subsequent
+  call raise ``ConnectionResetError``, exactly what a crashed process
+  looks like to the router.
+* :class:`ProcessReplica` — a real ``python -m ...serving.server``
+  subprocess on an ephemeral port, spoken to over HTTP.  True isolation
+  (own GIL, own device client, killable with SIGKILL); the backend the
+  scaling bench and production use.
+
+Health is double-sourced: an **active prober** hits each ready
+replica's ``/healthz`` every ``MXNET_SERVING_FLEET_PROBE_MS`` and
+demands structured per-model ``ready`` state (a warming model is not
+routable), while the router feeds **passive** per-request outcomes
+into the same consecutive-failure budget
+(``MXNET_SERVING_FLEET_PROBE_FAILS``).  One success from either source
+re-admits.
+
+Fault points: ``serving.probe`` fires before each active probe;
+``serving.replica_exec`` fires as a replica accepts a routed request
+(both docs/fault_tolerance.md).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as onp
+
+from ..base import get_env
+from .. import fault
+from ..error import ReplicaUnavailableError
+from .admission import (BadRequest, DeadlineExceeded, ModelNotFound,
+                        QueueFullError, ServingError, ShuttingDown)
+
+__all__ = ["ReplicaFleet", "ThreadReplica", "ProcessReplica",
+           "STARTING", "WARMING", "READY", "DRAINING", "DEAD"]
+
+STARTING = "starting"
+WARMING = "warming"
+READY = "ready"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+class _ReplicaBase:
+    """Shared lifecycle + health bookkeeping for both backends."""
+
+    backend = "?"
+
+    def __init__(self, rid, models, probe_fails=None):
+        self.rid = rid
+        self.models = dict(models)          # name -> artifact prefix
+        self.state = STARTING
+        self._killed = False
+        self._healthy = True
+        self._fails = 0                     # consecutive probe/request
+        self._probe_fails = int(
+            probe_fails if probe_fails is not None
+            else get_env("MXNET_SERVING_FLEET_PROBE_FAILS", 3, int))
+        self._inflight = 0
+        self._lock = threading.Lock()
+
+    # -- routing view -------------------------------------------------
+
+    @property
+    def healthy(self):
+        return self._healthy
+
+    @property
+    def inflight(self):
+        return self._inflight
+
+    def routable(self):
+        return self.state == READY and self._healthy
+
+    def track(self):
+        """Context manager bumping the inflight gauge around one hop."""
+        return _Inflight(self)
+
+    # -- health accounting (active probe + passive request outcomes) --
+
+    def note_success(self):
+        with self._lock:
+            self._fails = 0
+            self._healthy = True
+
+    def note_failure(self):
+        """One failed probe or failed routed request.  Returns True
+        when this failure crossed the consecutive-failure budget and
+        quarantined the replica."""
+        with self._lock:
+            self._fails += 1
+            crossed = self._healthy and self._fails >= self._probe_fails
+            if crossed:
+                self._healthy = False
+        return crossed
+
+    # -- lifecycle ----------------------------------------------------
+
+    def begin_drain(self):
+        if self.state in (READY, WARMING, STARTING):
+            self.state = DRAINING
+
+    def readmit(self):
+        """Back into rotation after a drain (rolling reload step done).
+        A dead replica stays dead."""
+        if self.state == DRAINING and not self._killed:
+            self.state = READY
+            self.note_success()
+
+    def kill(self):
+        """Simulate/perform a crash: the replica answers nothing ever
+        again.  In-flight behaviour is backend-specific (a killed
+        process resets its sockets; a killed thread replica lets
+        already-executing batches finish — admission dies either way)."""
+        self._killed = True
+        self.state = DEAD
+
+    def describe(self):
+        return {"state": self.state, "healthy": self._healthy,
+                "inflight": self._inflight, "backend": self.backend}
+
+    # -- interface the backends implement -----------------------------
+
+    def start(self):
+        raise NotImplementedError
+
+    def predict(self, name, inputs, deadline_ms=None, inputs_json=None):
+        raise NotImplementedError
+
+    def healthz(self):
+        raise NotImplementedError
+
+    def admin(self, verb, name, path=None, version=None, warmup=None):
+        raise NotImplementedError
+
+    def model_meta(self, name):
+        raise NotImplementedError
+
+    def close(self, timeout=30.0):
+        raise NotImplementedError
+
+
+class _Inflight:
+    __slots__ = ("_r",)
+
+    def __init__(self, replica):
+        self._r = replica
+
+    def __enter__(self):
+        with self._r._lock:
+            self._r._inflight += 1
+        return self._r
+
+    def __exit__(self, *exc):
+        with self._r._lock:
+            self._r._inflight -= 1
+        return False
+
+
+def _check_replica_exec(rid, name):
+    """``serving.replica_exec`` fault hook: a transient fault here is a
+    replica-side crash/stall the router's failover must absorb."""
+    fault.inject("serving.replica_exec", f"{rid}:{name}")
+
+
+class ThreadReplica(_ReplicaBase):
+    """In-process replica: its own repository, predictors and batchers.
+
+    No HTTP hop — the router calls straight into the repository.  Each
+    replica still owns separate compile caches and queues, so fleet
+    semantics (independent warmup, independent drain, per-replica
+    load) are faithful; only the failure domain is shared."""
+
+    backend = "thread"
+
+    def __init__(self, rid, models, buckets=None, warmup=None,
+                 probe_fails=None):
+        super().__init__(rid, models, probe_fails=probe_fails)
+        from .model_repository import ModelRepository
+        self.repository = ModelRepository(buckets=buckets)
+        self._warmup = warmup
+        self._t_start = time.monotonic()
+
+    def start(self):
+        self.state = WARMING
+        try:
+            for name, path in self.models.items():
+                self.repository.load(name, path, warmup=self._warmup)
+        except Exception:
+            self.state = DEAD
+            raise
+        if self.state == WARMING:   # a racing kill()/drain wins
+            self.state = READY
+        return self
+
+    def _gone(self):
+        if self._killed:
+            raise ConnectionResetError(
+                f"replica {self.rid} is dead")
+
+    def predict(self, name, inputs, deadline_ms=None, inputs_json=None):
+        # in-process hop: typed arrays only — a JSON fallback would
+        # lose the exported dtypes (json floats decode as f64)
+        self._gone()
+        _check_replica_exec(self.rid, name)
+        with self.track():
+            out, timing = self.repository.predict(name, inputs,
+                                                  deadline_ms)
+            import jax
+            return jax.tree_util.tree_leaves(out), timing
+
+    def healthz(self):
+        self._gone()
+        from .server import health_body
+        return health_body(self.repository, self._t_start)
+
+    def admin(self, verb, name, path=None, version=None, warmup=None):
+        self._gone()
+        if verb == "load":
+            return self.repository.load(name, path, version=version,
+                                        warmup=warmup)
+        if verb == "reload":
+            return self.repository.reload(name, path=path,
+                                          version=version, warmup=warmup)
+        if verb == "unload":
+            return self.repository.unload(name)
+        raise ValueError(f"unknown admin verb {verb!r}")
+
+    def model_meta(self, name):
+        self._gone()
+        return self.repository.get(name).predictor.meta["inputs"]
+
+    def close(self, timeout=30.0):
+        self.state = DEAD
+        self.repository.drain_all(timeout)
+
+
+class ProcessReplica(_ReplicaBase):
+    """Subprocess replica: a real ``serving.server`` on an ephemeral
+    port, isolated down to its own interpreter and device client."""
+
+    backend = "process"
+
+    def __init__(self, rid, models, warmup=None, probe_fails=None,
+                 startup_timeout_s=300.0):
+        super().__init__(rid, models, probe_fails=probe_fails)
+        self._warmup = warmup
+        self._startup_timeout_s = float(startup_timeout_s)
+        self._proc = None
+        self._port = None
+        self._port_event = threading.Event()
+        self._log_tail: list = []
+
+    @property
+    def port(self):
+        return self._port
+
+    def start(self):
+        self.state = WARMING
+        cmd = [sys.executable, "-m",
+               "incubator_mxnet_tpu.serving.server",
+               "--host", "127.0.0.1", "--port", "0"]
+        for name, path in self.models.items():
+            cmd += ["--model", f"{name}={path}"]
+        if self._warmup is False:
+            cmd.append("--no-warmup")
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        self._proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+        threading.Thread(target=self._read_stdout,
+                         name=f"replica-{self.rid}-log",
+                         daemon=True).start()
+        if (not self._port_event.wait(self._startup_timeout_s)
+                or self._port is None):
+            # timed out, or the child exited before binding (the
+            # stdout reader sets the event at EOF so a dead child
+            # cannot hang the spawn — but it must not look READY)
+            self.kill()
+            raise ReplicaUnavailableError(
+                f"replica {self.rid} did not come up within "
+                f"{self._startup_timeout_s:.0f}s: "
+                f"{' | '.join(self._log_tail[-5:])}")
+        # server.main loads + warms every model BEFORE binding the
+        # listener, so "listening" implies warm
+        if self.state == WARMING:
+            self.state = READY
+        return self
+
+    def _read_stdout(self):
+        for line in self._proc.stdout:
+            line = line.rstrip()
+            self._log_tail.append(line)
+            del self._log_tail[:-50]
+            if "] listening on " in line and not self._port_event.is_set():
+                try:
+                    self._port = int(line.rsplit(":", 1)[1])
+                except ValueError:
+                    continue
+                self._port_event.set()
+        self._port_event.set()   # EOF: unblock start() to report death
+
+    def _gone(self):
+        if self._killed or self._port is None:
+            raise ConnectionResetError(f"replica {self.rid} is dead")
+        if self._proc is not None and self._proc.poll() is not None:
+            self.state = DEAD
+            raise ConnectionResetError(
+                f"replica {self.rid} exited rc={self._proc.returncode}")
+
+    def _http(self, method_path, body=None, timeout_s=30.0):
+        import http.client
+        import urllib.error
+        import urllib.request
+        self._gone()
+        method, path = method_path.split(" ", 1)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self._port}{path}", data=body,
+            headers={"Content-Type": "application/json"}, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                status, raw = resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read())
+            except ValueError:
+                payload = {"error": "HTTPError", "message": str(e)}
+            return e.code, payload
+        except (urllib.error.URLError, http.client.HTTPException,
+                TimeoutError, OSError) as e:
+            # ANY transport-level failure on the hop — refused socket,
+            # reset or truncated mid-response (a SIGKILLed replica
+            # raises IncompleteRead, an HTTPException, NOT a
+            # ConnectionError), socket timeout — means this replica is
+            # unavailable for this request; typed so the router fails
+            # over instead of surfacing a 500
+            raise ReplicaUnavailableError(
+                f"replica {self.rid}: {type(e).__name__}: {e}") from e
+        try:
+            return status, json.loads(raw)
+        except ValueError as e:
+            raise ReplicaUnavailableError(
+                f"replica {self.rid}: garbled response body: "
+                f"{e}") from e
+
+    @staticmethod
+    def _raise_for(code, payload, rid, name):
+        msg = f"replica {rid} [{name}]: {payload.get('message', payload)}"
+        if code == 429:
+            raise QueueFullError(msg)
+        if code == 503:
+            raise ShuttingDown(msg)
+        if code == 504:
+            raise DeadlineExceeded(msg,
+                                   queue_ms=payload.get("queue_ms"),
+                                   compute_ms=payload.get("compute_ms"))
+        if code == 404:
+            raise ModelNotFound(msg)
+        if code == 400:
+            raise BadRequest(msg)
+        raise ServingError(msg)
+
+    def predict(self, name, inputs, deadline_ms=None, inputs_json=None):
+        _check_replica_exec(self.rid, name)
+        if inputs_json is None:
+            inputs_json = json.dumps(
+                [onp.asarray(x).tolist() for x in inputs])
+        body = ('{"inputs": %s%s}' % (
+            inputs_json,
+            f', "timeout_ms": {float(deadline_ms)}' if deadline_ms
+            else "")).encode()
+        # socket budget trails the request deadline slightly so the
+        # server's typed 504 (with its queue/compute split) beats the
+        # socket timeout
+        timeout_s = (deadline_ms / 1000.0 + 2.0 if deadline_ms
+                     else 120.0)
+        with self.track():
+            code, payload = self._http(
+                f"POST /v1/models/{name}:predict", body, timeout_s)
+        if code != 200:
+            self._raise_for(code, payload, self.rid, name)
+        return payload["outputs"], payload.get("timing", {})
+
+    def healthz(self):
+        return self._http("GET /healthz", timeout_s=10.0)
+
+    def admin(self, verb, name, path=None, version=None, warmup=None):
+        body = {}
+        if path is not None:
+            body["path"] = path
+        if version is not None:
+            body["version"] = version
+        if warmup is not None:
+            body["warmup"] = warmup
+        code, payload = self._http(
+            f"POST /v1/models/{name}:{verb}",
+            json.dumps(body).encode(), timeout_s=600.0)
+        if code != 200:
+            self._raise_for(code, payload, self.rid, name)
+        return payload
+
+    def model_meta(self, name):
+        code, payload = self._http("GET /v1/models", timeout_s=30.0)
+        if code != 200:
+            self._raise_for(code, payload, self.rid, name)
+        if name not in payload.get("models", {}):
+            raise ModelNotFound(f"model {name!r} not on replica "
+                                f"{self.rid}")
+        return payload["models"][name]["inputs"]
+
+    def kill(self):
+        super().kill()
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.kill()
+
+    def close(self, timeout=30.0):
+        self.state = DEAD
+        self._killed = True
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait(10.0)
+
+
+class ReplicaFleet:
+    """Spawn/adopt N replicas; own their lifecycle, health and rolls.
+
+    ``models`` maps model name -> artifact prefix; every replica loads
+    the same set.  ``spawn()`` brings all replicas up concurrently and
+    starts the active prober.  The router consumes :meth:`pick`
+    (least-loaded routable replica) and :meth:`states` (gauges)."""
+
+    def __init__(self, models, n=None, backend="thread", buckets=None,
+                 warmup=None, probe_ms=None, probe_fails=None,
+                 metrics=None):
+        self.models = dict(models)
+        self.n = int(n if n is not None
+                     else get_env("MXNET_SERVING_FLEET_REPLICAS", 2, int))
+        if self.n < 1:
+            raise ValueError(f"fleet size must be >= 1, got {self.n}")
+        if backend not in ("thread", "process"):
+            raise ValueError(
+                f"backend must be thread|process, got {backend!r}")
+        self.backend = backend
+        self.metrics = metrics            # FleetMetrics or None
+        self._buckets = buckets
+        self._warmup = warmup
+        self._probe_ms = float(
+            probe_ms if probe_ms is not None
+            else get_env("MXNET_SERVING_FLEET_PROBE_MS", 500.0, float))
+        self._probe_fails = probe_fails
+        self._replicas: list = []
+        self._next_rid = 0
+        self._meta_cache: dict = {}       # name -> input specs
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._prober = None
+
+    # -- lifecycle ----------------------------------------------------
+
+    def _new_replica(self):
+        with self._lock:
+            rid = f"r{self._next_rid}"
+            self._next_rid += 1
+        if self.backend == "process":
+            return ProcessReplica(rid, self.models, warmup=self._warmup,
+                                  probe_fails=self._probe_fails)
+        return ThreadReplica(rid, self.models, buckets=self._buckets,
+                             warmup=self._warmup,
+                             probe_fails=self._probe_fails)
+
+    def spawn(self):
+        """Bring up all N replicas concurrently; raises if any failed
+        to reach ``ready``.  Starts the prober.  Returns ``self``."""
+        fresh = [self._new_replica() for _ in range(self.n)]
+        with self._lock:
+            self._replicas.extend(fresh)
+        errors = []
+
+        def up(r):
+            try:
+                r.start()
+            except Exception as e:  # mxlint: allow-broad-except(collected and re-raised below — a failed replica must not strand the spawn barrier)
+                errors.append((r.rid, e))
+
+        threads = [threading.Thread(target=up, args=(r,),
+                                    name=f"spawn-{r.rid}", daemon=True)
+                   for r in fresh]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            self.shutdown()
+            rid, e = errors[0]
+            raise ReplicaUnavailableError(
+                f"{len(errors)}/{self.n} replicas failed to start "
+                f"(first: {rid}: {type(e).__name__}: {e})") from e
+        self.start_prober()
+        return self
+
+    def adopt(self, replica):
+        """Take ownership of an externally-built replica (custom
+        backend, pre-warmed process) — it is probed and routed like a
+        spawned one."""
+        with self._lock:
+            self._replicas.append(replica)
+        return replica
+
+    def shutdown(self, timeout=30.0):
+        self.stop_prober()
+        with self._lock:
+            replicas = list(self._replicas)
+        for r in replicas:
+            r.begin_drain()
+        for r in replicas:
+            try:
+                r.close(timeout)
+            except Exception:  # mxlint: allow-broad-except(best-effort teardown: one broken replica must not leak the rest)
+                pass
+
+    # -- routing view -------------------------------------------------
+
+    @property
+    def replicas(self):
+        with self._lock:
+            return list(self._replicas)
+
+    def get(self, rid):
+        for r in self.replicas:
+            if r.rid == rid:
+                return r
+        raise KeyError(f"no replica {rid!r}")
+
+    def routable(self):
+        return [r for r in self.replicas if r.routable()]
+
+    def ready_count(self):
+        return len(self.routable())
+
+    def all_draining(self):
+        """True when every live replica is draining — the whole fleet
+        is going away and new work must get 503 + Retry-After."""
+        live = [r for r in self.replicas if r.state != DEAD]
+        return bool(live) and all(r.state == DRAINING for r in live)
+
+    def pick(self, exclude=frozenset()):
+        """Least-loaded routable replica, preferring ones not in
+        ``exclude`` (already-failed hops).  When every routable replica
+        has been tried, fall back to the least-loaded one anyway — a
+        transient double-fault on a 2-replica fleet should burn the
+        remaining failover budget, not strand the request."""
+        candidates = self.routable()
+        if not candidates:
+            return None
+        fresh = [r for r in candidates if r.rid not in exclude]
+        pool = fresh or candidates
+        return min(pool, key=lambda r: (r.inflight, r.rid))
+
+    def states(self):
+        """{rid: {state, healthy, inflight, backend}} — the gauges
+        :class:`.metrics.FleetMetrics` exports."""
+        return {r.rid: r.describe() for r in self.replicas}
+
+    def kill(self, rid):
+        """Chaos verb: hard-kill one replica (process: SIGKILL)."""
+        self.get(rid).kill()
+
+    def model_meta(self, name):
+        """Input specs for ``name`` from any live replica (the router
+        validates requests against these before routing).  Cached —
+        for process replicas this is an HTTP hop, and it must not ride
+        along on every predict; admin verbs and rolling reloads
+        invalidate (a reload may point at a different artifact)."""
+        cached = self._meta_cache.get(name)
+        if cached is not None:
+            return cached
+        last = None
+        for r in self.replicas:
+            if r.state == DEAD:
+                continue
+            try:
+                specs = r.model_meta(name)
+                self._meta_cache[name] = specs
+                return specs
+            except ModelNotFound:
+                if r.state == READY:
+                    raise     # authoritative: a serving replica says no
+                last = ModelNotFound(f"model {name!r} not loaded")
+            except (ConnectionError, ServingError) as e:
+                last = e
+        raise ReplicaUnavailableError(
+            f"no replica could describe model {name!r}") from last
+
+    # -- fleet-wide admin ---------------------------------------------
+
+    def load_everywhere(self, name, path, version=None, warmup=None):
+        return self._admin_everywhere("load", name, path=path,
+                                      version=version, warmup=warmup)
+
+    def unload_everywhere(self, name):
+        return self._admin_everywhere("unload", name)
+
+    def _admin_everywhere(self, verb, name, **kw):
+        out = {}
+        for r in self.replicas:
+            if r.state == DEAD:
+                continue
+            out[r.rid] = r.admin(verb, name, **kw)
+        self._meta_cache.pop(name, None)
+        if verb == "load":
+            self.models[name] = kw.get("path")
+        elif verb == "unload":
+            self.models.pop(name, None)
+        return out
+
+    # -- zero-downtime rolling reload ---------------------------------
+
+    def rolling_reload(self, name, path=None, version=None,
+                       drain_timeout_s=30.0):
+        """Reload ``name`` on every replica in rotation, one at a
+        time: drain (out of rotation, in-flight finishes), reload (the
+        repository's atomic swap + warmup), re-admit.  Ready capacity
+        never drops below ``len(ready) - 1``; a reload failure
+        re-admits the replica on its old version and surfaces, leaving
+        a mixed-version fleet rather than a smaller one.
+
+        "In rotation" means state READY including probe-quarantined
+        replicas: quarantine is temporary, and a skipped unhealthy
+        replica would re-admit itself later still serving the OLD
+        version with nothing reporting the mixed fleet."""
+        targets = [r for r in self.replicas if r.state == READY]
+        if not targets:
+            raise ReplicaUnavailableError(
+                f"no replica in rotation to reload {name!r} on")
+        self._meta_cache.pop(name, None)   # new version, new specs
+        report = {"model": name, "replicas": [],
+                  "min_ready": self.ready_count()}
+
+        def note_ready():
+            report["min_ready"] = min(report["min_ready"],
+                                      self.ready_count())
+
+        for r in targets:
+            t0 = time.monotonic()
+            r.begin_drain()
+            note_ready()
+            deadline = t0 + drain_timeout_s
+            while r.inflight > 0 and time.monotonic() < deadline:
+                time.sleep(0.002)
+            try:
+                info = r.admin("reload", name, path=path,
+                               version=version)
+            except BaseException:
+                # old version still swapped in (the repository only
+                # replaces after a successful build) — re-admit rather
+                # than shrink the fleet
+                r.readmit()
+                note_ready()
+                raise
+            r.readmit()
+            note_ready()
+            report["replicas"].append({
+                "replica": r.rid,
+                "version": info.get("version"),
+                "ms": round((time.monotonic() - t0) * 1000.0, 3)})
+        # a meta lookup that raced the roll may have cached the OLD
+        # version's specs; drop it so the next one sees the new fleet
+        self._meta_cache.pop(name, None)
+        return report
+
+    # -- active health probing ----------------------------------------
+
+    def start_prober(self):
+        if self._prober is not None and self._prober.is_alive():
+            return
+        self._stop.clear()
+        self._prober = threading.Thread(target=self._probe_loop,
+                                        name="fleet-prober",
+                                        daemon=True)
+        self._prober.start()
+
+    def stop_prober(self):
+        self._stop.set()
+        if self._prober is not None:
+            self._prober.join(5.0)
+            self._prober = None
+
+    def probe_once(self):
+        """One active probe sweep (the prober loop body; callable
+        directly from tests).  Only replicas in rotation are scored —
+        warming and draining are lifecycle states, not health
+        failures."""
+        for r in self.replicas:
+            if r.state not in (READY,):
+                continue
+            ok = False
+            try:
+                fault.inject("serving.probe", r.rid)
+                code, body = r.healthz()
+                models = body.get("models", {})
+                ok = (code == 200
+                      and set(self.models) <= set(models)
+                      and all(m.get("state") == "ready"
+                              for m in models.values()))
+            except Exception:  # mxlint: allow-broad-except(a probe that cannot complete IS the failure signal being counted)
+                ok = False
+            if ok:
+                r.note_success()
+            else:
+                r.note_failure()
+                if self.metrics is not None:
+                    self.metrics.record_probe_failure(r.rid)
+
+    def _probe_loop(self):
+        while not self._stop.wait(self._probe_ms / 1000.0):
+            self.probe_once()

@@ -17,7 +17,7 @@ from __future__ import annotations
 import threading
 import time
 
-__all__ = ["ServingMetrics", "Histogram"]
+__all__ = ["ServingMetrics", "FleetMetrics", "Histogram"]
 
 
 def _esc(label_value):
@@ -268,3 +268,153 @@ class ServingMetrics:
         provider registry nor report stale counters in later dumps."""
         from .. import profiler
         profiler.unregister_stats_provider("serving", self.snapshot)
+
+
+class FleetMetrics:
+    """Fleet-level observability: the router + replica-lifecycle view.
+
+    Per-replica serving counters (batches, compile counts, latency
+    histograms) live on each replica's own :class:`ServingMetrics`;
+    this class carries what only the fleet layer can see — replica
+    states and inflight load, active-probe failures, failovers, and
+    the hedging win rate.  Rendered into the router's ``/metrics``
+    page and folded into ``profiler.dumps()`` as ``serving_fleet``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._codes: dict = {}            # {http-code: count}
+        self._probe_failures: dict = {}   # {replica-id: count}
+        self.failovers = 0
+        self.hedges_launched = 0
+        self.hedges_won = 0
+        self.route_ms = Histogram()
+        self._fleet_states_fn = None      # () -> {rid: state-dict}
+
+    def attach_fleet(self, fleet):
+        """Wire the live replica-state gauge callback."""
+        self._fleet_states_fn = fleet.states
+
+    # -- recording hooks ----------------------------------------------
+
+    def record_route(self, code, ms=None):
+        with self._lock:
+            self._codes[code] = self._codes.get(code, 0) + 1
+        if ms is not None:
+            self.route_ms.observe(ms)
+
+    def record_failover(self):
+        with self._lock:
+            self.failovers += 1
+
+    def record_hedge(self, won=False):
+        with self._lock:
+            if won:
+                self.hedges_won += 1
+            else:
+                self.hedges_launched += 1
+
+    def record_probe_failure(self, replica_id):
+        with self._lock:
+            self._probe_failures[replica_id] = (
+                self._probe_failures.get(replica_id, 0) + 1)
+
+    # -- exposition ---------------------------------------------------
+
+    def _replica_states(self):
+        return self._fleet_states_fn() if self._fleet_states_fn else {}
+
+    def render(self):
+        """Prometheus text exposition for the router's ``/metrics``."""
+        L = []
+        states = self._replica_states()
+        L.append("# HELP mxnet_serving_fleet_replica_state Replica "
+                 "lifecycle state (1 for the current state).")
+        L.append("# TYPE mxnet_serving_fleet_replica_state gauge")
+        for rid, st in sorted(states.items()):
+            L.append(f'mxnet_serving_fleet_replica_state'
+                     f'{{replica="{_esc(rid)}",'
+                     f'state="{_esc(st["state"])}"}} 1')
+        L.append("# HELP mxnet_serving_fleet_replica_inflight Routed "
+                 "requests currently on each replica.")
+        L.append("# TYPE mxnet_serving_fleet_replica_inflight gauge")
+        for rid, st in sorted(states.items()):
+            L.append(f'mxnet_serving_fleet_replica_inflight'
+                     f'{{replica="{_esc(rid)}"}} {st["inflight"]}')
+        L.append("# HELP mxnet_serving_fleet_replica_healthy Probe "
+                 "verdict: 1 routable, 0 quarantined.")
+        L.append("# TYPE mxnet_serving_fleet_replica_healthy gauge")
+        for rid, st in sorted(states.items()):
+            L.append(f'mxnet_serving_fleet_replica_healthy'
+                     f'{{replica="{_esc(rid)}"}} '
+                     f'{1 if st["healthy"] else 0}')
+        ready = sum(1 for st in states.values()
+                    if st["state"] == "ready" and st["healthy"])
+        L.append("# HELP mxnet_serving_fleet_ready_replicas Replicas "
+                 "ready and healthy (routable).")
+        L.append("# TYPE mxnet_serving_fleet_ready_replicas gauge")
+        L.append(f"mxnet_serving_fleet_ready_replicas {ready}")
+        with self._lock:
+            codes = dict(self._codes)
+            probe_failures = dict(self._probe_failures)
+            failovers = self.failovers
+            launched, won = self.hedges_launched, self.hedges_won
+        L.append("# HELP mxnet_serving_fleet_requests_total Routed "
+                 "requests by final HTTP code.")
+        L.append("# TYPE mxnet_serving_fleet_requests_total counter")
+        for code, n in sorted(codes.items()):
+            L.append(f'mxnet_serving_fleet_requests_total'
+                     f'{{code="{code}"}} {n}')
+        L.append("# HELP mxnet_serving_fleet_failovers_total Request "
+                 "hops retried on a different replica.")
+        L.append("# TYPE mxnet_serving_fleet_failovers_total counter")
+        L.append(f"mxnet_serving_fleet_failovers_total {failovers}")
+        L.append("# HELP mxnet_serving_fleet_probe_failures_total "
+                 "Active health-probe failures per replica.")
+        L.append("# TYPE mxnet_serving_fleet_probe_failures_total "
+                 "counter")
+        for rid, n in sorted(probe_failures.items()):
+            L.append(f'mxnet_serving_fleet_probe_failures_total'
+                     f'{{replica="{_esc(rid)}"}} {n}')
+        L.append("# HELP mxnet_serving_fleet_hedges_total Hedged "
+                 "second requests launched / won the race.")
+        L.append("# TYPE mxnet_serving_fleet_hedges_total counter")
+        L.append(f'mxnet_serving_fleet_hedges_total'
+                 f'{{event="launched"}} {launched}')
+        L.append(f'mxnet_serving_fleet_hedges_total'
+                 f'{{event="won"}} {won}')
+        L.append("# HELP mxnet_serving_fleet_route_ms End-to-end "
+                 "routed request latency (all hops + hedges).")
+        L.append("# TYPE mxnet_serving_fleet_route_ms histogram")
+        L.extend(self.route_ms.prom_lines("mxnet_serving_fleet_route_ms"))
+        return "\n".join(L) + "\n"
+
+    def snapshot(self):
+        """Flat dict view for profiler dumps and the fleet bench."""
+        states = self._replica_states()
+        with self._lock:
+            out = {
+                "replicas": {rid: dict(st)
+                             for rid, st in sorted(states.items())},
+                "ready": sum(1 for st in states.values()
+                             if st["state"] == "ready"
+                             and st["healthy"]),
+                "requests": dict(self._codes),
+                "failovers": self.failovers,
+                "hedges_launched": self.hedges_launched,
+                "hedges_won": self.hedges_won,
+                "probe_failures": dict(self._probe_failures),
+            }
+        out["route_ms"] = self.route_ms.snapshot()
+        return out
+
+    def register_with_profiler(self):
+        from .. import profiler
+        profiler.register_stats_provider("serving_fleet", self.snapshot)
+
+    def unregister_from_profiler(self):
+        """Detach at router shutdown — mirrors
+        :meth:`ServingMetrics.unregister_from_profiler`: a dead fleet
+        must not be kept alive by the provider registry."""
+        from .. import profiler
+        profiler.unregister_stats_provider("serving_fleet",
+                                           self.snapshot)

@@ -20,6 +20,7 @@ flows.  Three properties are load-bearing:
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 
 from ..base import get_env
@@ -70,6 +71,7 @@ class ModelRepository:
             else get_env("MXNET_SERVING_WARMUP", True, bool))
         self._models: dict[str, ModelEntry] = {}
         self._retired: list[ModelEntry] = []
+        self._loading: dict[str, int] = {}   # name -> in-flight builds
         self._lock = threading.Lock()
         if self.metrics is not None:
             self.metrics.attach_repository(self)
@@ -88,6 +90,30 @@ class ModelRepository:
             metrics.attach_repository(self)
 
     # -- build/teardown ----------------------------------------------
+
+    @contextlib.contextmanager
+    def _loading_state(self, name):
+        """Track that ``name`` is being built (load + warmup): health
+        probes report it as ``loading`` so a fleet prober / rolling
+        reload can tell "warming, admit later" from "never heard of
+        it".  Counted, not flagged — a reload racing a load must not
+        clear the other's marker."""
+        with self._lock:
+            self._loading[name] = self._loading.get(name, 0) + 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                n = self._loading.get(name, 1) - 1
+                if n <= 0:
+                    self._loading.pop(name, None)
+                else:
+                    self._loading[name] = n
+
+    def loading_names(self):
+        """Names with a build (load or reload replacement) in flight."""
+        with self._lock:
+            return sorted(self._loading)
 
     def _build_entry(self, name, path, version, warmup):
         from ..deploy import load_predictor
@@ -136,9 +162,10 @@ class ModelRepository:
         """Load a new model under ``name``; errors if it exists
         (``reload`` is the replace verb).  The entry only becomes
         visible after a successful load + warmup."""
-        entry = self._build_entry(name, path,
-                                  1 if version is None else int(version),
-                                  warmup)
+        with self._loading_state(name):
+            entry = self._build_entry(
+                name, path, 1 if version is None else int(version),
+                warmup)
         with self._lock:
             if name in self._models:
                 entry.batcher.close()
@@ -156,9 +183,11 @@ class ModelRepository:
             old = self._models.get(name)
         if old is None:
             raise ModelNotFound(f"model {name!r} is not loaded")
-        entry = self._build_entry(
-            name, path or old.path,
-            old.version + 1 if version is None else int(version), warmup)
+        with self._loading_state(name):
+            entry = self._build_entry(
+                name, path or old.path,
+                old.version + 1 if version is None else int(version),
+                warmup)
         with self._lock:
             old = self._models.get(name)   # re-read: racing reload/unload
             if old is not None:
@@ -211,27 +240,47 @@ class ModelRepository:
         with self._lock:
             return name in self._models
 
+    def _submit_current(self, name, submit):
+        """Resolve the live entry and run ``submit(entry)``, chasing a
+        concurrent reload: between ``get`` and the batcher enqueue the
+        name can be swapped to a new version and the OLD batcher begin
+        draining — such a request is neither in-flight (it never
+        enqueued) nor misaddressed (the model still serves), so it
+        must land on the replacement, not die 503.  A genuine drain
+        (server shutdown) or unload still surfaces typed."""
+        from .admission import ShuttingDown
+        entry = self.get(name)
+        checked_enqueue(name)
+        while True:
+            try:
+                return submit(entry)
+            except ShuttingDown:
+                if self.admission.draining:
+                    raise              # whole-server drain: real 503
+                fresh = self.get(name)  # unloaded -> ModelNotFound
+                if fresh is entry:
+                    raise              # draining for its own reasons
+                entry = fresh          # reload swapped: retry on new
+
     def predict(self, name, inputs, deadline_ms=None):
         """Admission-gated batched predict; the server's hot path.
         The depth bound runs under the batcher's queue lock
         (``Admission.gate``) so concurrent arrivals cannot race past
         it; the ``serving.enqueue`` fault point fires outside the lock
         (an injected delay must not stall the flush worker)."""
-        entry = self.get(name)
-        checked_enqueue(name)
-        return entry.batcher.submit(
-            inputs, self.admission.deadline_ms(deadline_ms),
-            admit=self.admission.gate(name))
+        return self._submit_current(name, lambda entry:
+            entry.batcher.submit(
+                inputs, self.admission.deadline_ms(deadline_ms),
+                admit=self.admission.gate(name)))
 
     def predict_async(self, name, inputs, deadline_ms=None):
         """Admission-gated ``submit_async``: returns a
         :class:`~.batcher.PendingResult` so one caller thread can keep
         many single requests in flight."""
-        entry = self.get(name)
-        checked_enqueue(name)
-        return entry.batcher.submit_async(
-            inputs, self.admission.deadline_ms(deadline_ms),
-            admit=self.admission.gate(name))
+        return self._submit_current(name, lambda entry:
+            entry.batcher.submit_async(
+                inputs, self.admission.deadline_ms(deadline_ms),
+                admit=self.admission.gate(name)))
 
     # -- introspection ------------------------------------------------
 

@@ -1,0 +1,495 @@
+"""Fleet router: health-checked, load-aware HTTP front end over N
+replicas.
+
+One replica dying (crash, stuck compile, reload) must cost the fleet
+one replica's capacity, never an outage.  The router owns the request
+side of that contract (:mod:`.fleet` owns the lifecycle side):
+
+* **Load-aware routing** — every predict goes to the least-loaded
+  *ready* replica (inflight gauge), shedding to the quietest queue
+  before any 429.
+* **Per-hop deadline budgets** — the request's deadline is split
+  across its potential hops: with budget *B* and *a* attempts left,
+  the next hop gets ``max(hop_min, B/a)``.  A slow first hop can never
+  eat the whole budget and leave failover with nothing.
+* **Bounded failover** — a hop that fails with a connection error,
+  503, 429 or hop timeout retries on a *different* replica, up to
+  ``MXNET_SERVING_FLEET_FAILOVERS`` extra hops.  400/404 never fail
+  over (the request itself is wrong).
+* **Hedged requests** — optionally (``MXNET_SERVING_FLEET_HEDGE_MS``)
+  a second copy of a slow request is raced on another replica once the
+  primary exceeds the hedge delay (fixed ms, or ``p95`` of observed
+  hop latency); first answer wins.  Classic tail-at-scale medicine:
+  one stalled replica stops defining the fleet's p99.
+* **Fleet-aware admission** — no routable replica answers 503 with
+  ``Retry-After`` (typed :class:`~..error.ReplicaUnavailableError`);
+  a fully-draining fleet answers 503 via
+  :class:`~..error.FleetDrainingError`.  Never a hang.
+* **Zero-downtime rolls** — ``POST /v1/models/{name}:reload`` runs the
+  fleet's rolling reload: replicas drain/reload/re-warm one at a time,
+  ready capacity never below N-1.
+
+``serving.route`` fires per routed request
+(:func:`.admission.checked_route`); chaos specs for the ``fleet`` CI
+stage land there, on ``serving.probe`` and on
+``serving.replica_exec``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as onp
+
+from ..base import get_env
+from .. import fault
+from ..error import FleetDrainingError, ReplicaUnavailableError
+from .admission import (Admission, BadRequest, DeadlineExceeded,
+                        QueueFullError, ServingError, ShuttingDown,
+                        checked_route)
+from .metrics import FleetMetrics, Histogram
+from .server import JSONRequestHandler, ServingHTTPServer
+
+__all__ = ["FleetRouter", "main"]
+
+
+def _parse_hedge(raw):
+    """``MXNET_SERVING_FLEET_HEDGE_MS`` -> None | 'p95' | float ms."""
+    if raw is None:
+        return None
+    text = str(raw).strip().lower()
+    if text in ("", "0", "off", "false"):
+        return None
+    if text == "p95":
+        return "p95"
+    ms = float(text)
+    return ms if ms > 0 else None
+
+
+class FleetRouter:
+    """Route predicts across a :class:`~.fleet.ReplicaFleet`."""
+
+    def __init__(self, fleet, host="127.0.0.1", port=0, metrics=None,
+                 failovers=None, hedge=None, hop_min_ms=None,
+                 deadline_ms=None):
+        self.fleet = fleet
+        self.metrics = metrics or FleetMetrics()
+        self.metrics.attach_fleet(fleet)
+        if fleet.metrics is None:
+            # the prober records its failures into the router's metrics
+            fleet.metrics = self.metrics
+        self.metrics.register_with_profiler()
+        self.admission = Admission(default_deadline_ms=deadline_ms)
+        self.failovers = int(
+            failovers if failovers is not None
+            else get_env("MXNET_SERVING_FLEET_FAILOVERS", 2, int))
+        if self.failovers < 0:
+            raise ValueError(
+                f"failovers must be >= 0, got {self.failovers}")
+        self.hedge = _parse_hedge(
+            hedge if hedge is not None
+            else get_env("MXNET_SERVING_FLEET_HEDGE_MS", "0"))
+        self.hop_min_ms = float(
+            hop_min_ms if hop_min_ms is not None
+            else get_env("MXNET_SERVING_FLEET_HOP_MIN_MS", 50.0, float))
+        self._hop_ms = Histogram()   # successful-hop latencies (p95)
+        self.host = host
+        self.port = int(port)
+        self.t_start = time.monotonic()
+        self._httpd = None
+        self._thread = None
+
+    # -- routing core (in-process API; the HTTP handler wraps it) -----
+
+    def route(self, name, inputs, deadline_ms=None, inputs_json=None):
+        """Route one predict; returns ``(outputs, timing)`` where
+        outputs is the replica's leaf list.  ``inputs`` is the tuple of
+        instance arrays; ``inputs_json`` optionally carries the
+        pre-encoded JSON tensor list so process-backend hops (and
+        their failover/hedge resends) do not re-serialize."""
+        t0 = time.monotonic()
+        code = 500
+        try:
+            result = self._route(name, inputs, deadline_ms,
+                                 inputs_json, t0)
+            code = 200
+            return result
+        except ServingError as e:
+            code = e.http_status
+            raise
+        except (FleetDrainingError, ConnectionError):
+            code = 503
+            raise
+        finally:
+            self.metrics.record_route(
+                code, (time.monotonic() - t0) * 1000.0)
+
+    def _route(self, name, inputs, deadline_ms, inputs_json, t0):
+        checked_route(name)
+        deadline = self.admission.deadline_ms(deadline_ms)
+        t_end = t0 + deadline / 1000.0
+        attempts = 1 + self.failovers
+        tried: set = set()
+        last = None
+        for k in range(attempts):
+            r = self.fleet.pick(exclude=tried)
+            if r is None:
+                if self.fleet.all_draining():
+                    raise FleetDrainingError(
+                        "fleet is draining, not accepting work")
+                if last is not None:
+                    raise last
+                raise ReplicaUnavailableError(
+                    f"no ready replica for {name!r} "
+                    f"({len(self.fleet.replicas)} known)")
+            if k > 0:
+                self.metrics.record_failover()
+            remaining_ms = (t_end - time.monotonic()) * 1000.0
+            if remaining_ms <= 0:
+                raise DeadlineExceeded(
+                    f"fleet deadline spent after {k} hop(s) for "
+                    f"{name!r}", queue_ms=deadline)
+            hop_ms = min(remaining_ms,
+                         max(self.hop_min_ms,
+                             remaining_ms / (attempts - k)))
+            try:
+                return self._attempt(r, name, inputs, hop_ms,
+                                     inputs_json)
+            except QueueFullError as e:
+                # overload, not ill health: shed to another replica
+                # before surfacing 429
+                tried.add(r.rid)
+                last = e
+            except (ShuttingDown, DeadlineExceeded,
+                    ConnectionError) as e:
+                # 503 / hop timeout / refused socket (includes
+                # injected TransientFault): failover.  The passive
+                # health note happened inside _call, attributed to
+                # whichever replica actually failed (under hedging
+                # that may not be ``r``).
+                tried.add(r.rid)
+                last = e
+        raise last
+
+    def _call(self, r, name, inputs, hop_ms, inputs_json):
+        """One physical hop, with the passive-health note attributed
+        HERE — the only place the per-replica outcome is known.  With
+        hedging on, the winner's success must not be credited to a
+        stalled primary (that would reset its failure budget and keep
+        it routable forever); the stalled hop notes its own failure
+        when its hop deadline resolves it, even after the race moved
+        on."""
+        t0 = time.monotonic()
+        try:
+            out = r.predict(name, inputs, deadline_ms=hop_ms,
+                            inputs_json=inputs_json)
+        except QueueFullError:
+            raise              # overload is load, not ill health
+        except (ShuttingDown, DeadlineExceeded, ConnectionError):
+            r.note_failure()
+            raise
+        r.note_success()
+        self._hop_ms.observe((time.monotonic() - t0) * 1000.0)
+        return out
+
+    def _hedge_delay_ms(self):
+        if self.hedge is None:
+            return None
+        if self.hedge == "p95":
+            # adapt only once there is a latency distribution to trust
+            if self._hop_ms.snapshot()["count"] < 20:
+                return None
+            return max(1.0, self._hop_ms.quantile(0.95))
+        return float(self.hedge)
+
+    def _attempt(self, r, name, inputs, hop_ms, inputs_json):
+        """One hop, optionally hedged: if the primary replica has not
+        answered within the hedge delay, race a second copy on another
+        replica and take whichever answers first."""
+        hedge_ms = self._hedge_delay_ms()
+        if hedge_ms is None or hedge_ms >= hop_ms:
+            return self._call(r, name, inputs, hop_ms, inputs_json)
+        cond = threading.Condition()
+        slots: dict = {}
+        order: list = []
+
+        def run(which, rep, budget_ms):
+            try:
+                res = ("ok", self._call(rep, name, inputs, budget_ms,
+                                        inputs_json))
+            except BaseException as e:  # mxlint: allow-broad-except(delivered through the race slot and re-raised on the routing thread)
+                res = ("err", e)
+            with cond:
+                slots[which] = res
+                order.append(which)
+                cond.notify_all()
+
+        threading.Thread(target=run, args=("primary", r, hop_ms),
+                         name=f"hop-{r.rid}", daemon=True).start()
+        with cond:
+            cond.wait_for(lambda: "primary" in slots,
+                          hedge_ms / 1000.0)
+            if "primary" in slots:
+                kind, val = slots["primary"]
+                if kind == "err":
+                    raise val
+                return val
+        r2 = self.fleet.pick(exclude={r.rid})
+        if r2 is None or r2 is r:
+            # nowhere to hedge: wait the primary out
+            with cond:
+                if not cond.wait_for(lambda: "primary" in slots,
+                                     hop_ms / 1000.0 + 2.0):
+                    raise DeadlineExceeded(
+                        f"hop to {r.rid} exceeded its "
+                        f"{hop_ms:.0f}ms budget", queue_ms=hop_ms)
+                kind, val = slots["primary"]
+            if kind == "err":
+                raise val
+            return val
+        self.metrics.record_hedge(won=False)   # launched
+        threading.Thread(target=run, args=("hedge", r2, hop_ms),
+                         name=f"hedge-{r2.rid}", daemon=True).start()
+        with cond:
+            done = cond.wait_for(
+                lambda: any(v[0] == "ok" for v in slots.values())
+                or len(slots) == 2,
+                hop_ms / 1000.0 + 2.0)
+            winners = [w for w in order if slots[w][0] == "ok"]
+            if winners:
+                if winners[0] == "hedge":
+                    self.metrics.record_hedge(won=True)
+                return slots[winners[0]][1]
+            if not done:
+                raise DeadlineExceeded(
+                    f"hedged hop to {r.rid}/{r2.rid} exceeded its "
+                    f"{hop_ms:.0f}ms budget", queue_ms=hop_ms)
+            # both failed: surface the primary's error (arrival order
+            # is race noise; the primary's cause is the actionable one)
+            raise slots.get("primary", slots[order[0]])[1]
+
+    # -- fleet health view --------------------------------------------
+
+    def health(self):
+        """``(code, body)`` for the router's ``/healthz``: fleet-level
+        status + the per-replica state machine."""
+        states = self.fleet.states()
+        ready = sum(1 for st in states.values()
+                    if st["state"] == "ready" and st["healthy"])
+        if self.fleet.all_draining():
+            status = "draining"
+        elif ready == 0:
+            status = "unavailable"
+        elif ready < len(states):
+            # anything short of full strength — including dead
+            # replicas that will never return — is an operator signal
+            status = "degraded"
+        else:
+            status = "ok"
+        body = {
+            "status": status,
+            "uptime_s": round(time.monotonic() - self.t_start, 3),
+            "ready": ready,
+            "replicas": states,
+            "models": sorted(self.fleet.models),
+        }
+        return (200 if ready else 503), body
+
+    # -- HTTP front end -----------------------------------------------
+
+    def start(self):
+        self._httpd = ServingHTTPServer((self.host, self.port),
+                                        _RouterHandler)
+        self._httpd.app = self
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fleet-router-http",
+            daemon=True)
+        self._thread.start()
+        return self.port
+
+    def shutdown(self, drain=True, timeout=30.0):
+        """Stop routing; with ``drain`` also drain + close the fleet
+        (replicas finish in-flight work first)."""
+        if drain:
+            self.fleet.shutdown(timeout)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        self.metrics.unregister_from_profiler()
+
+
+class _RouterHandler(JSONRequestHandler):
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            code, body = self.app.health()
+            return self._send(code, body)
+        if path == "/metrics":
+            return self._send(200, self.app.metrics.render().encode(),
+                              content_type="text/plain; version=0.0.4")
+        self._send(404, {"error": "NotFound", "message": path})
+
+    def do_POST(self):
+        path = self.path.split("?", 1)[0]
+        if path.startswith("/v1/models/") and ":" in path:
+            name, _, verb = path[len("/v1/models/"):].rpartition(":")
+            handler = {"predict": self._predict,
+                       "reload": self._reload,
+                       "load": self._load,
+                       "unload": self._unload}.get(verb)
+            if handler is not None and name:
+                return handler(name)
+        self._send(404, {"error": "NotFound", "message": path})
+
+    def _guarded(self, fn):
+        """Map the typed routing errors onto HTTP, with Retry-After on
+        every retryable condition."""
+        try:
+            return fn()
+        except ServingError as e:
+            hdrs = ({"Retry-After": "1"}
+                    if e.http_status in (429, 503) else None)
+            self._send(e.http_status, e.payload(), extra_headers=hdrs)
+        except FleetDrainingError as e:
+            self._send(503, {"error": "FleetDrainingError",
+                             "message": str(e)},
+                       extra_headers={"Retry-After": "1"})
+        except fault.TransientFault as e:
+            self._send(503, {"error": "TransientFault",
+                             "message": str(e)},
+                       extra_headers={"Retry-After": "1"})
+        except ConnectionError as e:
+            # ReplicaUnavailableError and raw refused sockets: the
+            # condition clears when a replica re-warms
+            self._send(503, {"error": type(e).__name__,
+                             "message": str(e)},
+                       extra_headers={"Retry-After": "1"})
+        except Exception as e:  # mxlint: allow-broad-except(HTTP boundary: any error becomes a 500 response)
+            self._send(500, {"error": type(e).__name__,
+                             "message": str(e)})
+
+    def _predict(self, name):
+        def fn():
+            specs = self.app.fleet.model_meta(name)
+            body = self._body()
+            if "inputs" not in body or not isinstance(body["inputs"],
+                                                      list):
+                raise BadRequest('body needs "inputs": [tensor, ...]')
+            if len(body["inputs"]) != len(specs):
+                raise BadRequest(
+                    f"model {name!r} takes {len(specs)} inputs, got "
+                    f"{len(body['inputs'])}")
+            try:
+                arrs = tuple(onp.asarray(x, dtype=spec["dtype"])
+                             for x, spec in zip(body["inputs"], specs))
+            except (TypeError, ValueError) as e:
+                raise BadRequest(f"malformed input tensor: {e}")
+            for a, spec in zip(arrs, specs):
+                want = tuple(spec["shape"][1:])
+                if tuple(a.shape) != want:
+                    raise BadRequest(
+                        f"instance shape {tuple(a.shape)} != exported "
+                        f"instance shape {want}")
+            outputs, timing = self.app.route(
+                name, arrs, deadline_ms=body.get("timeout_ms"),
+                inputs_json=json.dumps(body["inputs"]))
+            self._send(200, {
+                "outputs": [o if isinstance(o, list)
+                            else onp.asarray(o).tolist()
+                            for o in outputs],
+                "timing": {k: round(v, 3)
+                           for k, v in (timing or {}).items()
+                           if v is not None}})
+        self._guarded(fn)
+
+    def _reload(self, name):
+        def fn():
+            body = self._body()
+            report = self.app.fleet.rolling_reload(
+                name, path=body.get("path"),
+                version=body.get("version"))
+            self._send(200, report)
+        self._guarded(fn)
+
+    def _load(self, name):
+        def fn():
+            body = self._body()
+            if "path" not in body:
+                raise BadRequest('load needs {"path": artifact-prefix}')
+            self._send(200, self.app.fleet.load_everywhere(
+                name, body["path"], version=body.get("version"),
+                warmup=body.get("warmup")))
+        self._guarded(fn)
+
+    def _unload(self, name):
+        def fn():
+            self._send(200, self.app.fleet.unload_everywhere(name))
+        self._guarded(fn)
+
+
+def main(argv=None):
+    import argparse
+    import signal
+
+    from .fleet import ReplicaFleet
+
+    p = argparse.ArgumentParser(
+        description="mxnet-tpu multi-replica serving fleet router")
+    p.add_argument("--model", action="append", default=[],
+                   metavar="NAME=PREFIX",
+                   help="serve artifact PREFIX as model NAME on every "
+                        "replica")
+    p.add_argument("--replicas", type=int,
+                   default=get_env("MXNET_SERVING_FLEET_REPLICAS", 2,
+                                   int))
+    p.add_argument("--backend", choices=("thread", "process"),
+                   default="process",
+                   help="replica isolation (process = one server "
+                        "subprocess per replica)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int,
+                   default=get_env("MXNET_SERVING_PORT", 8080, int))
+    p.add_argument("--no-warmup", action="store_true")
+    args = p.parse_args(argv)
+
+    models = {}
+    for spec in args.model:
+        name, sep, path = spec.partition("=")
+        if not sep:
+            p.error(f"--model wants NAME=PREFIX, got {spec!r}")
+        models[name] = path
+    if not models:
+        p.error("need at least one --model NAME=PREFIX")
+
+    fleet = ReplicaFleet(models, n=args.replicas, backend=args.backend,
+                         warmup=not args.no_warmup)
+    print(f"[fleet] spawning {args.replicas} {args.backend} "
+          f"replica(s)", flush=True)
+    fleet.spawn()
+    router = FleetRouter(fleet, host=args.host, port=args.port)
+    port = router.start()
+    print(f"[fleet] routing on {args.host}:{port} over "
+          f"{fleet.ready_count()} ready replica(s)", flush=True)
+
+    done = threading.Event()
+
+    def stop(signum, frame):
+        print(f"[fleet] signal {signum}: draining fleet", flush=True)
+        done.set()
+
+    signal.signal(signal.SIGTERM, stop)
+    signal.signal(signal.SIGINT, stop)
+    done.wait()
+    router.shutdown(drain=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -384,6 +384,127 @@ def test_repository_reload_atomic_swap(artifact):
         repo.drain_all()
 
 
+def test_pending_result_cancel_skips_execution(predictor):
+    """A cancelled queued request is dropped by the flush worker
+    without device time; result() reports the withdrawal as a typed
+    DeadlineExceeded instead of returning garbage."""
+    metrics = ServingMetrics()
+    batcher = DynamicBatcher("m", predictor, metrics=metrics,
+                             max_latency_ms=40.0)
+    try:
+        handles = [batcher.submit_async((x,))
+                   for x in _instances(3, seed=31)]
+        for h in handles:
+            h.cancel()
+        for h in handles:
+            with pytest.raises(DeadlineExceeded, match="cancelled"):
+                h.result()
+        assert metrics.snapshot().get("m.batches", 0) == 0  # no exec
+        out, _ = batcher.submit((_instances(1)[0],))  # still serves
+        assert out.shape == (24,)
+    finally:
+        batcher.close()
+
+
+def test_reload_under_sustained_load_window(artifact):
+    """The reload-under-load satellite: a concurrent predict volley
+    runs *through* two :reload swaps — zero errors, every response
+    bitwise-stable across the version flips (same artifact on both
+    sides, so stability == bitwise match with the reference)."""
+    repo = ModelRepository(metrics=ServingMetrics())
+    try:
+        repo.load("m", artifact, warmup=False)
+        pred = deploy.load_predictor(artifact)
+        instances = _instances(8, seed=13)
+        refs = _unbatched_refs(pred, instances)
+        stop = threading.Event()
+        errors, served = [], []
+
+        def hammer(idx):
+            k = 0
+            while not stop.is_set():
+                i = (idx + k) % len(instances)
+                try:
+                    out = repo.predict("m", (instances[i],))[0]
+                    assert (out == refs[i]).all(), \
+                        f"response drifted across swap (instance {i})"
+                    served.append(1)
+                except Exception as e:  # noqa: BLE001 — for assert
+                    errors.append(e)
+                    return
+                k += 1
+
+        threads = [threading.Thread(target=hammer, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)                    # volley in flight
+        info = repo.reload("m")             # swap #1 under load
+        info = repo.reload("m")             # swap #2 under load
+        time.sleep(0.05)                    # volley outlives the roll
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert len(served) > 0
+        assert info["version"] == 3
+        assert repo.get("m").version == 3
+    finally:
+        repo.drain_all()
+
+
+# ---------------------------------------------------------------------------
+# structured /healthz (probe contract)
+# ---------------------------------------------------------------------------
+
+def test_healthz_structured_state_json_shape(artifact):
+    """Pin the /healthz JSON shape: per-model state must distinguish
+    loading (warming, do not admit) / ready / draining, with queue
+    depth — the contract fleet probes and rolling reload route on."""
+    from incubator_mxnet_tpu.serving.server import health_body
+    repo = ModelRepository(metrics=ServingMetrics())
+    try:
+        repo.load("mlp", artifact, warmup=False)
+        code, body = health_body(repo, time.monotonic())
+        assert code == 200
+        assert set(body) == {"status", "uptime_s", "queue_depth",
+                             "models"}
+        assert set(body["models"]["mlp"]) == {"state", "version",
+                                              "queue_depth",
+                                              "compile_count"}
+        assert body["status"] == "ok"
+        assert body["queue_depth"] == 0
+        assert body["models"]["mlp"] == {
+            "state": "ready", "version": 1, "queue_depth": 0,
+            "compile_count": repo.compile_counts()["mlp"]}
+        # a model mid-build reports `loading` (not absent, not ready)
+        with repo._loading_state("incoming"):
+            assert repo.loading_names() == ["incoming"]
+            _, b2 = health_body(repo, time.monotonic())
+            assert b2["models"]["incoming"] == {
+                "state": "loading", "version": None,
+                "queue_depth": 0, "compile_count": None}
+        _, b3 = health_body(repo, time.monotonic())
+        assert "incoming" not in b3["models"]
+        # draining flips status, the code, and every model's state
+        repo.admission.begin_drain()
+        code4, b4 = health_body(repo, time.monotonic())
+        assert code4 == 503 and b4["status"] == "draining"
+        assert b4["models"]["mlp"]["state"] == "draining"
+    finally:
+        repo.drain_all()
+
+
+def test_http_healthz_reports_structured_state(server):
+    """The wire shape matches health_body (one implementation)."""
+    status, raw = _get(server.port, "/healthz")
+    body = json.loads(raw)
+    assert status == 200
+    assert body["models"]["mlp"]["state"] == "ready"
+    assert "queue_depth" in body and "queue_depth" in \
+        body["models"]["mlp"]
+
+
 # ---------------------------------------------------------------------------
 # HTTP server end-to-end
 # ---------------------------------------------------------------------------
