@@ -9,6 +9,14 @@ Stages (each isolated, failures collected, nonzero exit if any fail):
   slow       the slow-marked tests the tier-1 '-m not slow' sweep excludes
   bulking    opperf op-bulking smoke: bulked vs per-op dispatch outputs
              compared, fails on numeric divergence beyond ULP noise
+  memlint    liveness-based HBM analysis (docs/graph_analysis.md): the
+             zoo infer+train sweep must report ZERO error-severity
+             findings with the train step donating 100% of its
+             parameter/optimizer-state buffers, a nonzero
+             donated-bytes-reclaimed profiler gauge, and a BENCH-style
+             per-model peak-HBM record; the seeded-violation selftest
+             (undonated train step under strict mode) must fail its
+             subprocess — the stage's negative control
   multichip  __graft_entry__.dryrun_multichip on a virtual 8-device mesh
   bench      bench.py CPU fallback emits a well-formed JSON line
   chaos      kvstore + checkpoint test subset re-run under a fixed
@@ -358,6 +366,41 @@ def stage_graphlint(args):
     return True, f"{tail}; {proc2.stdout.strip()}"
 
 
+def stage_memlint(args):
+    """HBM planner/analyzer gate (tools/memlint.py): seeded violations
+    must surface (--selftest), the zoo train step must donate every
+    param/opt-state buffer at strict coverage (--check), and the
+    undonated negative control must FAIL its subprocess."""
+    out = os.path.join(REPO, ".ci_memlint.json")
+    try:
+        proc = sh([sys.executable, "tools/memlint.py", "--zoo",
+                   "resnet18_v1", "--batch", "4", "--selftest",
+                   "--check", "--output", out], timeout=900)
+        if proc.returncode != 0:
+            return False, (proc.stderr or proc.stdout).strip()[-600:]
+        with open(out) as f:
+            rec = json.load(f)
+    finally:
+        if os.path.exists(out):
+            os.remove(out)
+    if rec.get("problems"):
+        return False, f"gate problems: {rec['problems']}"
+    if not rec.get("profiler_donated_bytes_reclaimed"):
+        return False, "donated_bytes_reclaimed gauge is zero"
+    # negative control: an undonated train step under strict mode must
+    # fail — a green gate that cannot catch the seeded violation is lying
+    proc2 = sh([sys.executable, "tools/memlint.py", "--seed-violation"],
+               timeout=600)
+    if proc2.returncode == 0:
+        return False, ("seeded undonated-step violation did NOT fail "
+                       "the strict run — enforcement is broken")
+    train = rec["models"]["resnet18_v1"]["train"]
+    return True, (f"peak {train['peak_hbm_bytes'] // (1 << 20)}MiB, "
+                  f"donated {train['donated_bytes_reclaimed'] // (1 << 20)}"
+                  f"MiB reclaimed, coverage {train['donation_coverage']}, "
+                  "seeded violation fails strict")
+
+
 def stage_multichip(args):
     code = "import __graft_entry__ as g; g.dryrun_multichip(8)"
     proc = sh([sys.executable, "-c", code], timeout=1200)
@@ -383,6 +426,7 @@ STAGES = {"build": stage_build, "sanity": stage_sanity,
           "serving": stage_serving, "fleet": stage_fleet,
           "race": stage_race,
           "graphlint": stage_graphlint,
+          "memlint": stage_memlint,
           "multichip": stage_multichip, "bench": stage_bench}
 
 

@@ -1,6 +1,6 @@
 """Static and dynamic correctness analysis for the framework.
 
-Four halves (docs/static_analysis.md, docs/graph_analysis.md):
+Five halves (docs/static_analysis.md, docs/graph_analysis.md):
 
 * :mod:`.mxlint` — AST-based, framework-aware static linter whose rules
   encode this framework's invariants (env-var/docs sync, fault-point
@@ -14,6 +14,11 @@ Four halves (docs/static_analysis.md, docs/graph_analysis.md):
   mixed-precision promotion, low-precision accumulation, baked-in
   constants, dead compute, host callbacks, degenerate tile layouts.
   CLI: ``python tools/graphlint.py``.
+* :mod:`.memlint` — liveness-based static HBM planning over the same
+  traced graphs (``MXNET_GRAPH_MEMLINT=warn|strict``): per-graph
+  peak-HBM estimate, buffer-lifetime report, and ENFORCED buffer
+  donation (an undonated params-in/params-out surface is an error, not
+  an advisory).  CLI: ``python tools/memlint.py``.
 * :mod:`.recompile` — the recompilation sentinel
   (``MXNET_RECOMPILE_SENTINEL=warn|raise``): every jit-owning layer
   reports each XLA compilation per site; signature churn past
@@ -24,17 +29,18 @@ Four halves (docs/static_analysis.md, docs/graph_analysis.md):
   NDArray accesses against its declared ``const_vars``/``mutable_vars``.
 
 ``race`` and ``recompile`` are imported eagerly (hot paths read their
-flags); ``mxlint`` and ``graphlint`` stay lazy so importing the package
-never pays their setup — and mxlint never pays (or needs) jax at all.
+flags); ``mxlint``, ``graphlint`` and ``memlint`` stay lazy so
+importing the package never pays their setup — and mxlint never pays
+(or needs) jax at all.
 """
 from . import race
 from . import recompile
 
-__all__ = ["race", "recompile", "mxlint", "graphlint"]
+__all__ = ["race", "recompile", "mxlint", "graphlint", "memlint"]
 
 
 def __getattr__(name):
-    if name in ("mxlint", "graphlint"):
+    if name in ("mxlint", "graphlint", "memlint"):
         import importlib
         return importlib.import_module("." + name, __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -20,7 +20,8 @@ from __future__ import annotations
 
 import json
 
-__all__ = ["Finding", "load_baseline", "apply_baseline", "render"]
+__all__ = ["Finding", "load_baseline", "apply_baseline",
+           "prune_stale_baseline", "render"]
 
 
 class Finding:
@@ -77,6 +78,30 @@ def apply_baseline(findings, baseline):
                   if _baseline_justified(baseline.get(f.key))]
     stale = [k for k in baseline if k not in live]
     return regressions, suppressed, stale
+
+
+def prune_stale_baseline(path, stale_keys, in_scope=None):
+    """Rewrite the baseline at ``path`` with the stale entries removed
+    (entries whose (rule, file, message) finding no longer exists) —
+    the write half of the stale reporting both CLIs already do, so a
+    shrunk surface shrinks its baseline back without hand-editing.
+
+    ``in_scope(key) -> bool`` guards partial runs: an entry is only
+    "stale" if the surface that could re-produce it was actually
+    scanned — a lint over one subdirectory must not delete (and lose
+    the written justifications of) every entry for the rest of the
+    tree.  Returns the entries kept."""
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    dead = {tuple(k) for k in stale_keys
+            if in_scope is None or in_scope(tuple(k))}
+    kept = [e for e in data.get("findings", [])
+            if (e["rule"], e["file"], e["message"]) not in dead]
+    data["findings"] = kept
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2)
+        fh.write("\n")
+    return kept
 
 
 def render(findings):

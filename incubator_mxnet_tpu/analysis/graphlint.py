@@ -47,7 +47,11 @@ GL-DONATE001   *advisory*: an undonated input whose shape/dtype matches
                an output — the classic params-in/params-out update step
                where ``donate_argnums`` would let XLA alias the buffers
                instead of holding both alive (the memory-planning
-               analog of the reference's in-place flags)
+               analog of the reference's in-place flags).  The
+               ENFORCED form lives in :mod:`.memlint` as ML-DONATE001
+               (``MXNET_GRAPH_MEMLINT``): error severity at surfaces
+               that contract to donate, with the reclaimed bytes
+               measured
 =============  ==========================================================
 
 ``GL-DEAD001`` also covers **unused arguments** at the entry point

@@ -31,6 +31,15 @@ MX-EXC001      broad ``except Exception``/``BaseException``/bare
                the typed errors (``PSTimeoutError``,
                ``CheckpointCorruptError``, ...) the caller contracts on;
                annotate ``# mxlint: allow-broad-except(<reason>)``
+MX-DONATE001   a ``jax.jit``/``pjit`` call site inside
+               ``incubator_mxnet_tpu/`` that passes no
+               ``donate_argnums``/``donate_argnames`` — every jitted
+               entry point must either donate its reusable input
+               buffers or carry a
+               ``# mxlint: disable=MX-DONATE001(<why the inputs are
+               caller-held>)`` pragma, so undonated HBM is a decision,
+               never an accident (the AST half of memlint's enforced
+               donation — docs/graph_analysis.md)
 MX-AST000      file failed to parse
 =============  ==========================================================
 
@@ -65,7 +74,7 @@ import re
 
 try:
     from .findings import (Finding, load_baseline, apply_baseline,
-                           render)
+                           prune_stale_baseline, render)
 except ImportError:   # standalone file-load (tools/mxlint.py, no package)
     import importlib.util as _ilu
     _p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -76,10 +85,11 @@ except ImportError:   # standalone file-load (tools/mxlint.py, no package)
     Finding = _mod.Finding
     load_baseline = _mod.load_baseline
     apply_baseline = _mod.apply_baseline
+    prune_stale_baseline = _mod.prune_stale_baseline
     render = _mod.render
 
 __all__ = ["RULES", "Finding", "lint_paths", "load_baseline",
-           "apply_baseline", "render"]
+           "apply_baseline", "prune_stale_baseline", "render"]
 
 RULES = {
     "MX-ENV001": "env var read in code but not documented in env_vars.md",
@@ -91,6 +101,7 @@ RULES = {
     "MX-BULK001": "bulkable op impl calls a host-effect function",
     "MX-LOCK001": "lock-order cycle (inconsistent acquisition order)",
     "MX-EXC001": "broad except swallows typed errors without a pragma",
+    "MX-DONATE001": "jax.jit/pjit call site passes no donate_argnums",
     "MX-AST000": "file failed to parse",
 }
 
@@ -326,6 +337,65 @@ def _check_broad_except(fobj: "_File", findings):
             "broad except swallows typed errors (PSTimeoutError, "
             "CheckpointCorruptError, ...) — narrow it, re-raise, or "
             "pragma allow-broad-except with a reason"))
+
+
+_DONATE_KWARGS = ("donate_argnums", "donate_argnames")
+
+
+def _is_jit_ref(f):
+    """A reference to ``jax.jit``/``jit``/``pjit`` (the callee of a
+    call site, or a bare ``@jax.jit`` decorator).
+
+    Attribute receivers are restricted to the conventional module
+    names so ``self.jit()`` methods do not false-positive."""
+    if isinstance(f, ast.Name):
+        return f.id in ("jit", "pjit")
+    if isinstance(f, ast.Attribute) and f.attr in ("jit", "pjit"):
+        v = f.value
+        return isinstance(v, ast.Name) and v.id in ("jax", "pjit",
+                                                    "_pjit", "jax_pjit")
+    return False
+
+
+def _check_donate(fobj: "_File", findings):
+    """MX-DONATE001: framework jit/pjit sites must decide donation.
+
+    Only applies inside ``incubator_mxnet_tpu/`` — tools, benchmarks
+    and scripts jit throwaway closures where donation is noise.  The
+    keyword's *presence* satisfies the rule (a conditional value like
+    ``donate_argnums=(1,) if static else ()`` is still a decision).
+    Covers both spellings: ``jax.jit(fn, ...)`` call sites and the
+    bare ``@jax.jit`` decorator (which can never carry the keyword —
+    it must become ``@jax.jit(donate_argnums=...)`` wrapping, wire
+    donation at the call site, or carry the pragma)."""
+    rel = fobj.rel.replace(os.sep, "/")
+    if "incubator_mxnet_tpu/" not in rel \
+            and not rel.startswith("incubator_mxnet_tpu"):
+        return
+
+    def emit(node):
+        findings.append(Finding(
+            "MX-DONATE001", fobj.rel, node.lineno,
+            "jax.jit/pjit site passes no donate_argnums — input "
+            "buffers this entry point could reuse stay live alongside "
+            "the outputs; donate them, or pragma "
+            "disable=MX-DONATE001(reason) stating why the inputs are "
+            "caller-held"))
+
+    for node in ast.walk(fobj.tree):
+        if isinstance(node, ast.Call) and _is_jit_ref(node.func):
+            if any(kw.arg in _DONATE_KWARGS for kw in node.keywords):
+                continue
+            if fobj.suppressed("MX-DONATE001", node):
+                continue
+            emit(node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # bare @jax.jit decorator: no way to carry the keyword
+            for dec in node.decorator_list:
+                if _is_jit_ref(dec) \
+                        and not fobj.suppressed_at("MX-DONATE001",
+                                                   dec.lineno):
+                    emit(dec)
 
 
 _HOST_NS = ("onp", "np", "numpy", "_onp")
@@ -652,6 +722,7 @@ def lint_paths(paths, repo_root=None, docs_path=None, fault_points=None):
         _check_time(fobj, findings)
         _check_broad_except(fobj, findings)
         _check_bulkable_purity(fobj, findings)
+        _check_donate(fobj, findings)
 
     # -- lock-order graph --------------------------------------------------
     _check_lock_order(files, findings)

@@ -62,13 +62,24 @@ def _block_forward_fn(block):
     return params, fwd
 
 
-def export_model(model, example_inputs, prefix, params=None):
+def export_model(model, example_inputs, prefix, params=None,
+                 donate_argnums=()):
     """Compile + serialize a model's forward for deployment.
 
     model: a gluon Block (uses ``functional()``) or a pure
     ``fn(params, *inputs)``; example_inputs: tuple of arrays fixing the
     traced shapes (static-shape contract, like the reference predictor's
     input-shape binding at MXPredCreate time).
+
+    ``donate_argnums`` positions refer to the compiled signature
+    ``fwd(params, *inputs)``: position 0 is the params pytree (never
+    donatable — the predictor reuses it across calls), positions 1..n
+    are the user inputs.  Donated positions are recorded in
+    ``meta.json`` and re-applied by the loaded :class:`Predictor`, so
+    serving executions let XLA reuse the request's input buffers for
+    outputs — callers hand over the donated arrays (the batcher builds
+    each padded batch fresh, so the serving path is donation-safe by
+    construction).
     """
     from .ndarray import NDArray, save as nd_save
 
@@ -78,6 +89,16 @@ def export_model(model, example_inputs, prefix, params=None):
         fwd = model
         if params is None:
             raise ValueError("pure-function export needs params=")
+    donate_argnums = tuple(sorted(set(int(i) for i in donate_argnums)))
+    if any(i == 0 for i in donate_argnums):
+        raise ValueError(
+            "donate_argnums position 0 is the params pytree — the "
+            "predictor holds it across calls; only input positions "
+            "(1..n) are donatable")
+    if any(not 0 < i <= len(example_inputs) for i in donate_argnums):
+        raise ValueError(
+            f"donate_argnums {donate_argnums} out of range for "
+            f"{len(example_inputs)} example input(s)")
     # normalize containers so the traced pytree matches what
     # _unflatten_keystr reconstructs at load time (tuples → lists;
     # keystr cannot distinguish them)
@@ -87,7 +108,7 @@ def export_model(model, example_inputs, prefix, params=None):
         x.data if isinstance(x, NDArray) else jnp.asarray(x)
         for x in example_inputs)
 
-    jitted = jax.jit(fwd)
+    jitted = jax.jit(fwd, donate_argnums=donate_argnums)
     lowered = jitted.lower(params, *example)
     with open(prefix + ".stablehlo.mlir", "w") as f:
         f.write(lowered.as_text())
@@ -97,6 +118,12 @@ def export_model(model, example_inputs, prefix, params=None):
     # found before it serves traffic.  MXNET_EXPORT_GRAPHLINT=warn
     # (default) | raise | 0.
     graphlint_summary = _export_graphlint(fwd, params, example, prefix)
+    # memory plan of the same forward (analysis/memlint.py): peak-HBM
+    # estimate, donated-bytes-reclaimed and the dominant buffer
+    # lifetimes ride along in meta.json so the serving layer can report
+    # per-model HBM without re-tracing the (opaque) deserialized graph
+    memlint_summary = _export_memlint(fwd, params, example,
+                                      donate_argnums, prefix)
 
     exported = jax.export.export(jitted)(params, *example)
     with open(prefix + ".jaxport", "wb") as f:
@@ -121,8 +148,11 @@ def export_model(model, example_inputs, prefix, params=None):
     }
     meta["batch_export"] = _write_batch_export(jitted, params, example,
                                                prefix)
+    meta["donate_argnums"] = list(donate_argnums)
     if graphlint_summary is not None:
         meta["graphlint"] = graphlint_summary
+    if memlint_summary is not None:
+        meta["memlint"] = memlint_summary
     with open(prefix + ".meta.json", "w") as f:
         json.dump(meta, f, indent=1)
     _write_pjrt_sidecar(prefix, params, meta)
@@ -175,6 +205,34 @@ def _export_graphlint(fwd, params, example, prefix):
         import warnings
         warnings.warn(msg)
     return summary
+
+
+def _export_memlint(fwd, params, example, donate_argnums, prefix):
+    """Static memory plan of the exported forward (liveness-based
+    peak-HBM estimate + donation accounting, ``analysis/memlint.py``);
+    returns the meta.json summary or None when export analysis is
+    disabled (same ``MXNET_EXPORT_GRAPHLINT`` gate — it is the
+    export-time IR-analysis switch)."""
+    from .base import get_env
+    mode = str(get_env("MXNET_EXPORT_GRAPHLINT", "warn")).strip().lower()
+    if mode in ("", "0", "off", "none", "false"):
+        return None
+    from .analysis import memlint
+    try:
+        rep = memlint.analyze_fn(
+            fwd, params, *example,
+            where=f"export:{os.path.basename(prefix)}",
+            donate_argnums=donate_argnums,
+            allow_undonated=(0,))   # params are held across calls
+    except Exception as e:  # mxlint: allow-broad-except(the memory plan is advisory at export; a memlint crash must never block an export)
+        import warnings
+        warnings.warn(f"export memlint could not run ({e}); exporting "
+                      "without a memory summary")
+        return {"error": f"{type(e).__name__}: {e}"}
+    d = rep.as_dict()
+    d["buffers"] = d["buffers"][:5]
+    d["findings"] = [f.as_dict() for f in rep.findings]
+    return d
 
 
 def _write_batch_export(jitted, params, example, prefix):
@@ -300,8 +358,15 @@ class Predictor:
         # counter the serving metrics watch (_cache_size per function)
         from .analysis import recompile as _recompile
         tag = os.path.basename(prefix)
+        # donation does not survive serialization: jax.export records
+        # the aliasing in the module, but the re-jitted call needs its
+        # own donate_argnums for the caller-side buffers to be freed —
+        # re-apply the positions export_model recorded in meta.json
+        # (position 0 = params, held across calls, never donated)
+        self._donate = tuple(self.meta.get("donate_argnums") or ())
         self._call = jax.jit(_recompile.instrument(
-            self._exported.call, f"predictor:{tag}"))
+            self._exported.call, f"predictor:{tag}"),
+            donate_argnums=self._donate)
         self._batch_call = None
         bpath = prefix + ".batch.jaxport"
         if self.meta.get("batch_export", os.path.exists(bpath)):
@@ -309,7 +374,8 @@ class Predictor:
                 with open(bpath, "rb") as f:
                     self._batch_exported = jax.export.deserialize(f.read())
                 self._batch_call = jax.jit(_recompile.instrument(
-                    self._batch_exported.call, f"predictor:{tag}:batch"))
+                    self._batch_exported.call, f"predictor:{tag}:batch"),
+                    donate_argnums=self._donate)
             except (OSError, ValueError) as e:
                 # an artifact set copied without the polymorphic twin
                 # (older tooling, partial copy) must still serve — the

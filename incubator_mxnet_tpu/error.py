@@ -146,6 +146,16 @@ class GraphLintError(MXNetError):
 
 
 @register_error
+class MemLintError(GraphLintError):
+    """The memory analyzer (``analysis/memlint.py``) found violations
+    under ``MXNET_GRAPH_MEMLINT=strict`` — an undonated buffer at a
+    surface that contracts to donate (ML-DONATE001), or a peak-HBM
+    estimate over its budget (ML-PEAK001).  Subclasses
+    :class:`GraphLintError` so callers gating on "the IR analysis
+    failed the build" catch both."""
+
+
+@register_error
 class RecompileStormError(MXNetError):
     """A jitted entry point exceeded its per-site XLA compile budget
     under ``MXNET_RECOMPILE_SENTINEL=raise`` (``analysis/recompile.py``).

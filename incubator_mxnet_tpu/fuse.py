@@ -133,6 +133,7 @@ class FusedTrainStep:
         self._key = jax.random.PRNGKey(0)
         self._remat = remat
         self._lint_done = False
+        self._memlint_done = False
         self._step_fn = self._build(mesh, batch_spec, donate)
         self._last = None
 
@@ -218,6 +219,22 @@ class FusedTrainStep:
                 donate_argnums=self._donate_argnums,
                 check_donation=True,
                 config=_graphlint.Config(ignore={"GL-DEAD001"}))
+        from .analysis import memlint as _memlint
+        if not self._memlint_done and _memlint.mem_mode() is not None:
+            # memory plan of the same step (MXNET_GRAPH_MEMLINT): the
+            # fused step CONTRACTS to donate params/aux/optimizer state
+            # — an undonated build (donate=False) is an error-severity
+            # ML-DONATE001, and the per-site peak-HBM estimate +
+            # donated-bytes-reclaimed land in the memlint profiler
+            # provider.  Separate latch from the graphlint one so
+            # enabling either mode after step 1 still analyzes.
+            self._memlint_done = True
+            _memlint.check_memory(
+                self._raw_step,
+                (self.params, self.aux, self.opt_state, xv, yv, sub),
+                name=f"fused_step:{type(self.block).__name__}",
+                donate_argnums=self._donate_argnums,
+                require_donation=True)
         self.params, self.aux, self.opt_state, loss = self._step_fn(
             self.params, self.aux, self.opt_state, xv, yv, sub)
         self._last = loss

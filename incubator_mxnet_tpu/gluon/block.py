@@ -364,6 +364,19 @@ class CachedOp:
                     allow_unused_args=(2,),
                     donate_argnums=(1,) if self.static_alloc else (),
                     check_donation=self.static_alloc)
+            # memory plan (MXNET_GRAPH_MEMLINT): static_alloc contracts
+            # to donate the input activations; without it the params
+            # and inputs are caller-held (allow_undonated), so only the
+            # peak-HBM estimate and lifetime stats are recorded
+            from ..analysis import memlint as _memlint
+            if _memlint.mem_mode() is not None:
+                _memlint.check_memory(
+                    entry["pure"],
+                    (raw_params, raw_inputs, jax.random.PRNGKey(0)),
+                    name=f"cachedop:{type(self.block).__name__}",
+                    donate_argnums=(1,) if self.static_alloc else (),
+                    allow_undonated=(0,) if self.static_alloc else (0, 1),
+                    require_donation=self.static_alloc)
         jfn = entry["jfn"]
         key = _random.next_key()
 
@@ -544,7 +557,7 @@ class HybridBlock(Block):
                 return tuple(o.data for o in outs)
             return outs.data
 
-        exported = jax_export.export(jax.jit(pure))(pvals, ivals)
+        exported = jax_export.export(jax.jit(pure))(pvals, ivals)  # mxlint: disable=MX-DONATE001(export-time trace over the block's live parameter values — serving-side donation is deploy.export_model's donate_argnums contract)
         with open(f"{path}-symbol.stablehlo", "wb") as f:
             f.write(exported.serialize())
         manifest = {

@@ -32,14 +32,14 @@ class GradientCompression:
         self.threshold = float(threshold)
         self._residual: dict = {}
 
-        @jax.jit
+        @jax.jit  # mxlint: disable=MX-DONATE001(grad is the caller's live gradient and the residual read from self._residual stays bound until the returned one replaces it)
         def _round_trip_2bit(grad, residual, threshold):
             acc = grad + residual
             q = jnp.where(acc >= threshold, threshold,
                           jnp.where(acc <= -threshold, -threshold, 0.0))
             return q, acc - q
 
-        @jax.jit
+        @jax.jit  # mxlint: disable=MX-DONATE001(grad is the caller's live gradient and the residual read from self._residual stays bound until the returned one replaces it)
         def _round_trip_1bit(grad, residual, threshold):
             acc = grad + residual
             q = jnp.where(acc >= 0, threshold, -threshold)
@@ -135,7 +135,7 @@ def make_compressed_allreduce(mesh, axis_name="dp", threshold=0.5):
         body, mesh=mesh,
         in_specs=(P(axis_name), P(axis_name)),
         out_specs=(P(), P(axis_name)))
-    return jax.jit(mapped)
+    return jax.jit(mapped)  # mxlint: disable=MX-DONATE001(grad/residual trees are caller-held — callers re-run the sync on the same gradients; the donating surface is the compressed dp train step below)
 
 
 def make_compressed_dp_train_step(loss_fn, mesh, lr=0.1, axis_name="dp",
@@ -185,4 +185,7 @@ def make_compressed_dp_train_step(loss_fn, mesh, lr=0.1, axis_name="dp",
         body, mesh=mesh,
         in_specs=(P(), P(axis_name), P(axis_name)),
         out_specs=(P(), P(axis_name), P()))
-    return jax.jit(mapped)
+    # params and residuals are pure carry state (`params, residuals,
+    # loss = step(params, residuals, batch)`): donate both so the
+    # update aliases them in place; the batch (arg 2) is caller-held
+    return jax.jit(mapped, donate_argnums=(0, 1))

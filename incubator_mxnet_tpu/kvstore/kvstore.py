@@ -310,7 +310,10 @@ class DistKVStore(_BaseStore):
             sharding, local, (self._nprocs,) + local.shape[1:])
         fn = self._psum_cache.get("fn")
         if fn is None:
+            # the stacked global array is built fresh per sync: donate
+            # it so the reduction reuses its buffer (memlint)
             fn = jax.jit(lambda x: jnp.sum(x, axis=0),
+                         donate_argnums=(0,),
                          out_shardings=NamedSharding(mesh, P()))
             self._psum_cache["fn"] = fn
         out = fn(garr)

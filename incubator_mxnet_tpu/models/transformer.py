@@ -302,7 +302,8 @@ class TransformerLM:
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
         return jnp.mean(nll)
 
-    def make_train_step(self, mesh: Mesh, lr=1e-3, n_micro=None):
+    def make_train_step(self, mesh: Mesh, lr=1e-3, n_micro=None,
+                        donate=False):
         """SGD train step jitted over the mesh; GSPMD inserts the dp-psum
         for gradients and tp/sp/ep collectives for the sharded math.
 
@@ -311,6 +312,13 @@ class TransformerLM:
         (``apply_pipelined``) instead of the scan-with-sharded-params
         stage fetch; n_micro defaults to 2*pp (bubble fraction
         (pp-1)/(2*pp+pp-1)) clamped to divide the batch at call time.
+
+        ``donate=True`` donates the params (arg 0) so XLA writes the
+        update in place — HBM for one param copy instead of two.  Only
+        for callers that follow the ``params, loss = step(params,
+        tokens)`` rebinding contract: ``shard_params`` may alias its
+        input (``device_put`` is a no-op for already-placed arrays), so
+        the pre-shard tree dies with the donated one.
         """
         pp = dict(mesh.shape).get("pp", 1)
 
@@ -332,5 +340,6 @@ class TransformerLM:
             return new_params, loss
 
         token_sharding = NamedSharding(mesh, P("dp", None))
-        return jax.jit(step, in_shardings=(None, token_sharding)), \
+        return jax.jit(step, in_shardings=(None, token_sharding),
+                       donate_argnums=(0,) if donate else ()), \
             token_sharding

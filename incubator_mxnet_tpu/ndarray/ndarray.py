@@ -26,6 +26,8 @@ program (the CachedOp analog).
 """
 from __future__ import annotations
 
+import weakref
+
 import numpy as onp
 
 import jax
@@ -81,11 +83,16 @@ def _to_jax(value, ctx: Context | None = None, dtype=None):
 class _Chunk:
     """Shared storage cell: current value + engine var (version counter)."""
 
-    __slots__ = ("array", "var", "ctx")
+    # weakref'd by PendingArray holder tracking: at segment flush a
+    # placeholder no surviving chunk holds is a dead temporary whose
+    # buffer never leaves the compiled program (ops/bulking.py)
+    __slots__ = ("array", "var", "ctx", "__weakref__")
 
     def __init__(self, array, ctx):
         self.array = array
         self.ctx = ctx
+        if type(array) is _bulking.PendingArray:
+            array._holders.append(weakref.ref(self))
         self.var = _engine_mod.get_engine().new_variable("ndarray")
         if _race.enabled:
             # arrays born inside an engine closure are op-local: exempt
@@ -102,6 +109,10 @@ class _Chunk:
                 pass
 
     def write(self, new_array):
+        if type(new_array) is _bulking.PendingArray:
+            # defensive: every chunk holding a placeholder must be in
+            # its holder set or the flush would drop a live output
+            new_array._holders.append(weakref.ref(self))
         self.array = new_array
         self.var._version += 1
         if _race.enabled:

@@ -85,6 +85,20 @@ def set_enabled(enable: "bool | None"):
     return prev
 
 
+_env_drop_dead: "bool | None" = None
+
+
+def drop_dead_enabled() -> bool:
+    """``MXNET_EXEC_BULK_DROP_DEAD`` (default on): exclude dead
+    segment-internal temporaries from the flushed program's outputs so
+    XLA frees them in-program.  Read once (flush path); ``0`` keeps the
+    pre-planning behavior of materializing every node output."""
+    global _env_drop_dead
+    if _env_drop_dead is None:
+        _env_drop_dead = get_env("MXNET_EXEC_BULK_DROP_DEAD", True, bool)
+    return _env_drop_dead
+
+
 def max_bulk_ops() -> int:
     """Segment length cap (reference MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN
     semantics, default 15 like the reference bulk segments)."""
@@ -122,9 +136,22 @@ class PendingArray:
     segment; exposes shape/dtype so shape inspection does not force a
     flush (the reference analog: NDArray metadata is known when the op
     is pushed, only the buffer contents are async).
+
+    ``_holders`` tracks the chunks that adopted this placeholder (weak
+    references, registered by ``_Chunk.__init__``).  At flush time a
+    placeholder none of whose holder chunks survived is a *dead
+    segment-internal temporary* — the intermediate of an expression
+    chain whose NDArray wrapper was already dropped — and its buffer is
+    excluded from the compiled program's outputs entirely, so XLA frees
+    it inside the program instead of materializing it in HBM (the
+    memory-planning analog of the reference engine reusing dead NNVM
+    entries; see docs/graph_analysis.md "memlint").  A placeholder that
+    was never adopted by any chunk counts as live: the flush may run
+    (segment cap) before the defer caller has wrapped its outputs.
     """
 
-    __slots__ = ("segment", "shape", "dtype", "_slot", "_value", "_exc")
+    __slots__ = ("segment", "shape", "dtype", "_slot", "_value", "_exc",
+                 "_holders")
 
     def __init__(self, segment, shape, dtype, slot):
         self.segment = segment
@@ -133,6 +160,16 @@ class PendingArray:
         self._slot = slot          # (node_index, output_index)
         self._value = None
         self._exc = None
+        self._holders: list = []   # weakref.ref(_Chunk), GIL-atomic append
+
+    def _externally_live(self):
+        if not self._holders:
+            return True            # not yet wrapped: must be kept
+        for wr in self._holders:
+            c = wr()
+            if c is not None and c.array is self:
+                return True
+        return False
 
     @property
     def ndim(self):
@@ -144,6 +181,15 @@ class PendingArray:
         for d in self.shape:
             n *= int(d)
         return n
+
+    @property
+    def nbytes(self):
+        """Planned buffer size; shared by the flush reclaim accounting
+        and memlint's op-level alias credit."""
+        try:
+            return self.size * _onp.dtype(self.dtype).itemsize
+        except TypeError:
+            return 0
 
     def __repr__(self):
         state = "resolved" if self._value is not None else (
@@ -303,6 +349,25 @@ def _flush_locked(seg: _Segment):
         ext, ext_ids = [], {}
         node_keys = []
         plan = []
+        # dead-temporary planning (docs/graph_analysis.md "memlint"):
+        # a node output whose placeholder no live NDArray chunk holds
+        # is excluded from the program outputs — XLA frees it inside
+        # the fused program instead of materializing it in HBM.  The
+        # keep mask is part of the trace key (different live sets are
+        # different programs).
+        drop_dead = drop_dead_enabled()
+        keep_masks = []
+        dropped_bytes = dropped_n = 0
+        for node in nodes:
+            if drop_dead:
+                mask = tuple(p._externally_live() for p in node.outs)
+            else:
+                mask = (True,) * len(node.outs)
+            keep_masks.append(mask)
+            for p, kept in zip(node.outs, mask):
+                if not kept:
+                    dropped_n += 1
+                    dropped_bytes += p.nbytes
         for node in nodes:
             srcs = []
             for a in node.args:
@@ -334,13 +399,13 @@ def _flush_locked(seg: _Segment):
             plan.append((node.op.fn, srcs, node.kwargs, node.kw_names,
                          node.n_pos))
 
-        key = (tuple(node_keys),
+        key = (tuple(node_keys), tuple(keep_masks),
                tuple((a.shape, a.dtype) for a in ext))
         with _trace_lock:
             prog = _trace_cache.get(key)
             hit = prog is not None
             if not hit:
-                prog = jax.jit(_make_program(plan))
+                prog = jax.jit(_make_program(plan, keep_masks))  # mxlint: disable=MX-DONATE001(ext inputs are live NDArray chunk values the caller still reads; segment memory wins come from dropping dead outputs, not donating caller buffers)
                 _trace_cache[key] = prog
         if not hit and _recompile.enabled() is not None:
             # the trace cache detects its own misses — report the
@@ -360,6 +425,7 @@ def _flush_locked(seg: _Segment):
             site = f"bulk:segment:{zlib.crc32(structure.encode()):08x}"
             _recompile.record_compile(site, (
                 ("static", structure),
+                ("static", f"keep={keep_masks}"),
                 *(("arr", tuple(a.shape), str(a.dtype)) for a in ext)))
         if not hit:
             # build-time IR lint of the fresh segment program
@@ -367,8 +433,18 @@ def _flush_locked(seg: _Segment):
             # poisons the segment exactly like any other flush error)
             from ..analysis import graphlint as _graphlint
             if _graphlint.lint_mode() is not None:
-                _graphlint.check_traced(_make_program(plan), tuple(ext),
-                                        name="bulk:segment")
+                _graphlint.check_traced(
+                    _make_program(plan, keep_masks), tuple(ext),
+                    name="bulk:segment")
+            # memory plan of the fresh program (MXNET_GRAPH_MEMLINT):
+            # peak-HBM estimate for the site stats.  Ext inputs are
+            # caller-held chunk values (allow_undonated)
+            from ..analysis import memlint as _memlint
+            if _memlint.mem_mode() is not None:
+                _memlint.check_memory(
+                    _make_program(plan, keep_masks), tuple(ext),
+                    name="bulk:segment",
+                    allow_undonated=tuple(range(len(ext))))
 
         flat = prog(*ext)
     except Exception as e:  # sticky, like the engine's var exceptions —
@@ -381,16 +457,40 @@ def _flush_locked(seg: _Segment):
         seg.nodes = []  # drop input refs either way
 
     i = 0
-    for node in nodes:
-        for p in node.outs:
-            p._value = flat[i]
-            i += 1
+    for node, mask in zip(nodes, keep_masks):
+        for p, kept in zip(node.outs, mask):
+            if kept:
+                p._value = flat[i]
+                i += 1
+            else:
+                # unreachable through NDArrays (no chunk holds it); a
+                # raw-placeholder resolve after the drop gets a clear
+                # sticky error instead of a silent wrong answer
+                p._exc = RuntimeError(
+                    "bulked intermediate was dropped at flush: no live "
+                    "NDArray referenced this output "
+                    "(MXNET_EXEC_BULK_DROP_DEAD=0 disables dead-"
+                    "temporary reclamation)")
+    # always-on counters, same accumulation basis (per flush): dead
+    # temporaries dropped + op-level identity-alias credit
+    # (ops/ref_aliases.IDENTITY_ALIASES) — so the two gauges in
+    # profiler.dumps() are directly comparable
+    from ..analysis import memlint as _memlint
+    if dropped_n:
+        _memlint.record_bulk_reclaim(dropped_bytes, dropped_n)
+    _memlint.record_segment_alias_credit(
+        _memlint.segment_alias_credit(nodes))
     _profiler.record_bulk_flush(len(nodes), hit)
 
 
-def _make_program(plan):
+def _make_program(plan, keep_masks=None):
     """Replay closure over a normalized node plan; jitted once per trace
     key and reused for every segment with the same structure.
+
+    ``keep_masks`` (one bool per node output) selects which values the
+    program RETURNS: dead segment-internal temporaries stay inside the
+    program where XLA frees their buffers after last use, instead of
+    being materialized in HBM for a placeholder nothing reads.
 
     Float semantics: the segment compiles as ONE fused XLA program, so
     XLA may contract across op boundaries (a ``mul``→``add`` pair
@@ -403,14 +503,18 @@ def _make_program(plan):
     def program(*ext_args):
         vals = []
         flat_out = []
-        for fn, srcs, kw, kw_names, n_pos in plan:
+        for j, (fn, srcs, kw, kw_names, n_pos) in enumerate(plan):
             args = [ext_args[s[1]] if s[0] == "e" else vals[s[1]][s[2]]
                     for s in srcs]
             o = fn(*args[:n_pos],
                    **dict(zip(kw_names, args[n_pos:])), **kw)
             outs = tuple(o) if isinstance(o, (tuple, list)) else (o,)
             vals.append(outs)
-            flat_out.extend(outs)
+            if keep_masks is None:
+                flat_out.extend(outs)
+            else:
+                flat_out.extend(v for v, kept in zip(outs, keep_masks[j])
+                                if kept)
         return tuple(flat_out)
 
     return program

@@ -48,8 +48,11 @@ _cmp("logical_or", lambda a, b: jnp.logical_or(a, b).astype(jnp.result_type(a)),
 _cmp("logical_xor", lambda a, b: jnp.logical_xor(a, b).astype(jnp.result_type(a)), aliases=("broadcast_logical_xor",))
 
 
-def _unary(name, fn, aliases=(), differentiable=True):
-    register(name, num_inputs=1, aliases=aliases, differentiable=differentiable)(fn)
+def _unary(name, fn, aliases=(), differentiable=True,
+           inplace_identity=None):
+    register(name, num_inputs=1, aliases=aliases,
+             differentiable=differentiable,
+             inplace_identity=inplace_identity)(fn)
 
 
 _unary("negative", jnp.negative, aliases=("_np_negative",))
@@ -106,7 +109,8 @@ _unary("isinf", lambda x: jnp.isinf(x), differentiable=False)
 _unary("isfinite", lambda x: jnp.isfinite(x), differentiable=False)
 _unary("logical_not", lambda x: jnp.logical_not(x).astype(jnp.result_type(x)),
        differentiable=False)
-_unary("stop_gradient", jax.lax.stop_gradient, aliases=("BlockGrad", "block_grad"))
+_unary("stop_gradient", jax.lax.stop_gradient,
+       aliases=("BlockGrad", "block_grad"), inplace_identity=0)
 _unary("identity", lambda x: x + 0, aliases=("_copy",))
 _unary("zeros_like", jnp.zeros_like, differentiable=False)
 _unary("ones_like", jnp.ones_like, differentiable=False)

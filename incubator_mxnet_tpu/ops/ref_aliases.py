@@ -61,6 +61,34 @@ _ALIAS_MAP = {
 }
 
 
+# -- in-place/identity aliasing table -----------------------------------
+# Op name -> index of the input whose BUFFER the output is (a view of):
+# the reference's FInplaceIdentity registrations
+# (elemwise_op_common.h / matrix_op.cc kReshape family).  This is the
+# op-level half of memlint's aliasing credit
+# (analysis/memlint.segment_alias_credit): a bulked segment node whose
+# op appears here allocates no fresh output buffer — XLA plans the
+# output as a bitcast view of the named input.  The table must agree
+# with the registry's ``inplace_identity`` metadata in BOTH directions;
+# tests/test_memlint.py cross-checks it so the credit can trust it.
+# ``identity``/``_copy`` are deliberately absent: the reference's
+# identity COPIES (our lowering is ``x + 0``), so crediting it would
+# overstate the reuse.
+IDENTITY_ALIASES = {
+    "reshape": 0,
+    "Reshape": 0,
+    "flatten": 0,
+    "Flatten": 0,
+    "expand_dims": 0,
+    "squeeze": 0,
+    "reshape_like": 0,
+    "stop_gradient": 0,
+    "BlockGrad": 0,
+    "block_grad": 0,
+    "_identity_with_attr_like_rhs": 0,
+}
+
+
 def _install():
     with _lock:
         for alias, target in _ALIAS_MAP.items():
@@ -75,7 +103,8 @@ def _install():
 _install()
 
 
-@register("_identity_with_attr_like_rhs", num_inputs=2)
+@register("_identity_with_attr_like_rhs", num_inputs=2,
+          inplace_identity=0)
 def identity_with_attr_like_rhs(lhs, rhs):
     """Identity on lhs; rhs only donates shape/storage attrs during
     the reference's graph passes (elemwise_op_common.h role)."""

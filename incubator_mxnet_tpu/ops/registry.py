@@ -54,12 +54,19 @@ class Op:
     """
 
     def __init__(self, name, fn, differentiable=True, num_inputs=-1,
-                 aliases=(), jittable=True, bulkable=None):
+                 aliases=(), jittable=True, bulkable=None,
+                 inplace_identity=None):
         self.name = name
         self.fn = fn
         self.differentiable = differentiable
         self.num_inputs = num_inputs
         self.aliases = tuple(aliases)
+        # inplace_identity=<input index>: the output is (a view of) that
+        # input's buffer — the reference's FInplaceIdentity registration
+        # (elemwise_op_common.h).  memlint's op-level aliasing credit
+        # trusts ops/ref_aliases.IDENTITY_ALIASES, which a unit test
+        # cross-checks against this metadata in both directions.
+        self.inplace_identity = inplace_identity
         # jittable=False: data-dependent output shape (boolean_mask et
         # al.) — runs eagerly on concrete arrays, like the reference's
         # imperative-only FComputeEx ops; tracing raises a shape error
@@ -91,7 +98,7 @@ class Op:
             # sentinel is off, and this path runs once per (op,
             # kwarg-name set), never per call.
             fn = _recompile.instrument(self.fn, f"op:{self.name}")
-            jfn = jax.jit(fn, static_argnames=kwarg_names)
+            jfn = jax.jit(fn, static_argnames=kwarg_names)  # mxlint: disable=MX-DONATE001(eager-path inputs are live NDArray chunk values the caller reads after the op; in-place NDArray ops reuse buffers via Array.at donation inside XLA instead)
             self._jit_cache[kwarg_names] = jfn
         return jfn
 
@@ -105,13 +112,13 @@ class Op:
 
 
 def register(name, differentiable=True, num_inputs=-1, aliases=(),
-             jittable=True, bulkable=None):
+             jittable=True, bulkable=None, inplace_identity=None):
     """Decorator: register a pure JAX function as an operator."""
 
     def deco(fn):
         op = Op(name, fn, differentiable=differentiable,
                 num_inputs=num_inputs, aliases=aliases, jittable=jittable,
-                bulkable=bulkable)
+                bulkable=bulkable, inplace_identity=inplace_identity)
         with _lock:
             _OPS[name] = op
             for a in aliases:

@@ -5,7 +5,8 @@ from jax import lax
 from .registry import register
 
 
-@register("reshape", num_inputs=1, aliases=("Reshape",))
+@register("reshape", num_inputs=1, aliases=("Reshape",),
+          inplace_identity=0)
 def reshape(x, shape=None):
     return jnp.reshape(x, shape)
 
@@ -189,17 +190,18 @@ def swapaxes(x, dim1=0, dim2=1):
     return jnp.swapaxes(x, dim1, dim2)
 
 
-@register("expand_dims", num_inputs=1)
+@register("expand_dims", num_inputs=1, inplace_identity=0)
 def expand_dims(x, axis=0):
     return jnp.expand_dims(x, axis)
 
 
-@register("squeeze", num_inputs=1)
+@register("squeeze", num_inputs=1, inplace_identity=0)
 def squeeze(x, axis=None):
     return jnp.squeeze(x, axis)
 
 
-@register("flatten", num_inputs=1, aliases=("Flatten",))
+@register("flatten", num_inputs=1, aliases=("Flatten",),
+          inplace_identity=0)
 def flatten(x):
     return jnp.reshape(x, (x.shape[0], -1))
 
@@ -329,7 +331,7 @@ def size_array(x):
     return jnp.array([x.size], dtype=jnp.int32)
 
 
-@register("reshape_like", num_inputs=2)
+@register("reshape_like", num_inputs=2, inplace_identity=0)
 def reshape_like(x, like):
     return jnp.reshape(x, like.shape)
 

@@ -27,7 +27,7 @@ def make_data_parallel_train_step(loss_fn, mesh: Mesh, optimizer_update,
         lambda _: NamedSharding(mesh, batch_spec), None,
         is_leaf=lambda x: True)
 
-    @jax.jit
+    @jax.jit  # mxlint: disable=MX-DONATE001(place() device_put may alias the caller's param/opt trees - donating would delete them under the caller's binding, the transformer.make_train_step aliasing hazard)
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         updates, new_opt_state = optimizer_update(grads, opt_state, params)

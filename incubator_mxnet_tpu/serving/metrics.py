@@ -121,12 +121,15 @@ class ServingMetrics:
         # callbacks the repository installs: () -> int / dict
         self._compile_count_fn = None
         self._queue_depth_fn = None
+        self._memory_fn = None
 
     def attach_repository(self, repository):
         """Wire gauges that live in the repository (compile counts per
-        predictor, live queue depths per batcher)."""
+        predictor, live queue depths per batcher, export-time memory
+        plans per model)."""
         self._compile_count_fn = repository.compile_counts
         self._queue_depth_fn = repository.queue_depths
+        self._memory_fn = getattr(repository, "memory_summaries", None)
 
     def _model(self, name):
         with self._lock:
@@ -187,6 +190,25 @@ class ServingMetrics:
         for model, n in sorted(depths.items()):
             L.append(f'mxnet_serving_queue_depth'
                      f'{{model="{_esc(model)}"}} {n}')
+        mem = (self._memory_fn() if self._memory_fn else {})
+        L.append("# HELP mxnet_serving_model_peak_hbm_bytes Static "
+                 "peak-HBM estimate of the exported forward (memlint).")
+        L.append("# TYPE mxnet_serving_model_peak_hbm_bytes gauge")
+        for model, m in sorted(mem.items()):
+            if m.get("peak_hbm_bytes") is not None:
+                L.append(f'mxnet_serving_model_peak_hbm_bytes'
+                         f'{{model="{_esc(model)}"}} '
+                         f'{m["peak_hbm_bytes"]}')
+        L.append("# HELP mxnet_serving_model_donated_bytes_reclaimed "
+                 "Input bytes XLA reuses for outputs via buffer "
+                 "donation (memlint plan).")
+        L.append("# TYPE mxnet_serving_model_donated_bytes_reclaimed "
+                 "gauge")
+        for model, m in sorted(mem.items()):
+            if m.get("donated_bytes_reclaimed") is not None:
+                L.append(f'mxnet_serving_model_donated_bytes_reclaimed'
+                         f'{{model="{_esc(model)}"}} '
+                         f'{m["donated_bytes_reclaimed"]}')
         with self._lock:
             models = dict(self._models)
         L.append("# HELP mxnet_serving_requests_total Requests by "
@@ -241,6 +263,13 @@ class ServingMetrics:
         out = {"compile_total": self.compile_count()}
         if self._queue_depth_fn is not None:
             out["queue_depth"] = sum(self._queue_depth_fn().values())
+        if self._memory_fn is not None:
+            for name, m in self._memory_fn().items():
+                if m.get("peak_hbm_bytes") is not None:
+                    out[f"{name}.peak_hbm_bytes"] = m["peak_hbm_bytes"]
+                if m.get("donated_bytes_reclaimed") is not None:
+                    out[f"{name}.donated_bytes_reclaimed"] = \
+                        m["donated_bytes_reclaimed"]
         for name, m in models.items():
             with self._lock:
                 reqs = sum(m.requests.values())

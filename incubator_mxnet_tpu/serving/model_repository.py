@@ -56,6 +56,19 @@ class ModelEntry:
             "outputs": self.predictor.meta["outputs"],
             "graphlint_findings": (self.predictor.meta.get("graphlint")
                                    or {}).get("findings"),
+            "memlint": self.memory_summary(),
+        }
+
+    def memory_summary(self):
+        """Export-time memory plan (deploy._export_memlint): the
+        per-model peak-HBM estimate and donation accounting the
+        /metrics gauges report."""
+        ml = self.predictor.meta.get("memlint") or {}
+        return {
+            "peak_hbm_bytes": ml.get("peak_hbm_bytes"),
+            "donated_bytes_reclaimed": ml.get("donated_bytes_reclaimed"),
+            "undonated_bytes": ml.get("undonated_bytes"),
+            "donate_argnums": self.predictor.meta.get("donate_argnums"),
         }
 
 
@@ -299,3 +312,10 @@ class ModelRepository:
         with self._lock:
             entries = dict(self._models)
         return {name: e.batcher.depth for name, e in entries.items()}
+
+    def memory_summaries(self):
+        """Per-model export-time memory plans for the /metrics gauges
+        (peak-HBM estimate, donated-bytes-reclaimed)."""
+        with self._lock:
+            entries = dict(self._models)
+        return {name: e.memory_summary() for name, e in entries.items()}

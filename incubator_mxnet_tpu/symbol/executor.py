@@ -86,7 +86,7 @@ class Executor:
         # arrays can't mix inside one jit computation, and the legacy
         # group2ctx path is op-by-op in the reference anyway
         self._jit_infer = fwd_infer if g2c else jax.jit(
-            _recompile.instrument(fwd_infer,
+            _recompile.instrument(fwd_infer,  # mxlint: disable=MX-DONATE001(arg/aux arrays are the executor's bound state, read back via arg_dict across forwards — donation would delete them under the binding)
                                   f"executor:{symbol.name}"))
         self._fwd_train = fwd_train
 

@@ -458,6 +458,118 @@ def test_baseline_stub_reason_does_not_suppress(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# MX-DONATE001 — jit/pjit sites must decide donation
+# ---------------------------------------------------------------------------
+
+def _lint_pkg_src(tmp_path, src, name="mod.py"):
+    """Write the snippet under a fake incubator_mxnet_tpu/ so the
+    package-scoped MX-DONATE001 applies."""
+    pkg = tmp_path / "incubator_mxnet_tpu"
+    pkg.mkdir(exist_ok=True)
+    p = pkg / name
+    p.write_text(textwrap.dedent(src))
+    return mxlint.lint_paths([str(p)], repo_root=str(tmp_path))
+
+
+def test_donate001_flags_bare_jit(tmp_path):
+    fs = _lint_pkg_src(tmp_path, """
+        import jax
+        f = jax.jit(lambda x: x + 1)
+        g = pjit(lambda x: x * 2)
+    """)
+    assert _rules(fs) == ["MX-DONATE001", "MX-DONATE001"]
+
+
+def test_donate001_keyword_presence_passes(tmp_path):
+    # a conditional donate_argnums value is still a decision, and
+    # donate_argnames counts too
+    assert _lint_pkg_src(tmp_path, """
+        import jax
+        f = jax.jit(lambda p, x: p, donate_argnums=(0,))
+        g = jax.jit(lambda p, x: p,
+                    donate_argnums=(0,) if True else ())
+        h = jax.jit(lambda p, x: p, donate_argnames=("p",))
+    """) == []
+
+
+def test_donate001_pragma_suppresses_with_reason(tmp_path):
+    assert _lint_pkg_src(tmp_path, """
+        import jax
+        f = jax.jit(lambda x: x + 1)  # mxlint: disable=MX-DONATE001(inputs are caller-held activations)
+    """) == []
+    fs = _lint_pkg_src(tmp_path, """
+        import jax
+        f = jax.jit(lambda x: x + 1)  # mxlint: disable=MX-DONATE001()
+    """)
+    assert _rules(fs) == ["MX-DONATE001"]
+
+
+def test_donate001_outside_package_not_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """
+        import jax
+        f = jax.jit(lambda x: x + 1)
+    """, name="bench_snippet.py")
+    assert "MX-DONATE001" not in _rules(fs)
+
+
+def test_donate001_method_named_jit_not_flagged(tmp_path):
+    assert _lint_pkg_src(tmp_path, """
+        class C:
+            def jit(self, fn):
+                return fn
+        c = C()
+        f = c.jit(lambda x: x)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# --prune-stale — the baseline shrinks back by command
+# ---------------------------------------------------------------------------
+
+def test_prune_stale_baseline(tmp_path):
+    import json
+    base = tmp_path / "baseline.json"
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nt = time.time()\n")
+    gone = tmp_path / "gone.py"          # scanned, clean: entry is stale
+    gone.write_text("x = 1\n")
+    bad_rel = os.path.relpath(str(bad), REPO)
+    gone_rel = os.path.relpath(str(gone), REPO)
+    live = {"rule": "MX-TIME001", "file": bad_rel,
+            "message": "time.time() is wall-clock: an NTP step skews "
+                       "timeout/deadline/duration math — use "
+                       "time.monotonic() (or pragma allow-wall-clock "
+                       "with a reason)",
+            "reason": "seeded fixture"}
+    stale = {"rule": "MX-TIME001", "file": gone_rel,
+             "message": "whatever", "reason": "obsolete"}
+    # NOT scanned this run: must survive the prune (a partial run must
+    # not delete the rest of the tree's justified entries)
+    out_of_scope = {"rule": "MX-TIME001", "file": "elsewhere/mod.py",
+                    "message": "whatever", "reason": "still justified"}
+    base.write_text(json.dumps({"findings": [live, stale, out_of_scope]}))
+    cli = os.path.join(REPO, "tools", "mxlint.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, cli, str(bad), str(gone), "--baseline",
+         str(base), "--prune-stale"],
+        capture_output=True, text=True, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "pruned 1 stale" in proc.stdout
+    kept = json.loads(base.read_text())["findings"]
+    assert sorted(e["file"] for e in kept) == \
+        sorted([bad_rel, "elsewhere/mod.py"])
+    # idempotent in scope: a second run prunes nothing more and the
+    # live entry still suppresses
+    proc2 = subprocess.run(
+        [sys.executable, cli, str(bad), str(gone), "--baseline",
+         str(base), "--prune-stale"],
+        capture_output=True, text=True, env=env)
+    assert proc2.returncode == 0
+    assert "pruned 0 stale" in proc2.stdout or "pruned" not in proc2.stdout
+
+
+# ---------------------------------------------------------------------------
 # the repo itself is clean — what lets CI run with an empty baseline
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,515 @@
+"""memlint (analysis/memlint.py) — liveness-based HBM planner/analyzer
+and enforced end-to-end buffer donation (docs/graph_analysis.md).
+
+Four batteries:
+
+* the estimator itself — buffer liveness math on known graphs,
+  donation/alias credit, the ML-DONATE001/ML-PEAK001 must-flag and
+  must-pass fixtures, check_memory modes;
+* the compile surfaces — fused train step and CachedOp static_alloc
+  analyzed (and FAILED when seeded undonated under strict), with the
+  CPU aliasing proof: ``unsafe_buffer_pointer`` reuse + donated-input
+  deletion + absence of jax's "donated buffers were not usable"
+  warning show the donation is real, not just planned;
+* bulking dead-temporary reclamation — dropped intermediates never
+  leave the compiled program, held ones still settle;
+* the table contracts — ``ref_aliases.IDENTITY_ALIASES`` agrees with
+  the registry's ``inplace_identity`` metadata in both directions, and
+  the export/serving path records + re-applies ``donate_argnums``.
+"""
+import gc
+import warnings
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import error, gluon, nd, profiler
+from incubator_mxnet_tpu.analysis import memlint as ml
+from incubator_mxnet_tpu.fuse import make_fused_train_step
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.ops import bulking
+from incubator_mxnet_tpu.ops.ref_aliases import IDENTITY_ALIASES
+from incubator_mxnet_tpu.ops.registry import _OPS
+
+
+F32 = 4  # bytes
+
+
+def _step(p, g):
+    return p - 0.1 * g
+
+
+# ---------------------------------------------------------------------------
+# the estimator
+# ---------------------------------------------------------------------------
+
+def test_peak_counts_live_chain():
+    # x (input, pinned) + a + b live together at the add; c is a scalar
+    def chain(x):
+        a = x * 2.0
+        b = a + 1.0
+        return b.sum()
+
+    n = 256 * 256 * F32
+    rep = ml.analyze_fn(chain, jnp.ones((256, 256)))
+    assert rep.peak_bytes >= 2 * n
+    assert rep.peak_bytes < 4 * n          # not everything at once
+    assert rep.input_bytes == n
+    assert rep.n_eqns >= 3
+    # the lifetime report names the dominant buffers with birth/last
+    top = rep.buffers[0]
+    assert top["nbytes"] == n
+    assert top["kind"] in ("input", "temp")
+
+
+def test_donation_reclaims_matched_output():
+    rep = ml.analyze_fn(_step, jnp.ones((1024,)), jnp.ones((1024,)),
+                        donate_argnums=(0,), require_donation=True)
+    assert rep.donated_bytes == 1024 * F32
+    assert rep.donated_reclaimed_bytes == 1024 * F32
+    assert rep.donation_coverage == 1.0
+    assert rep.findings == []
+    # the undonated twin holds input AND output alive: higher peak
+    rep2 = ml.analyze_fn(_step, jnp.ones((1024,)), jnp.ones((1024,)))
+    assert rep2.peak_bytes > rep.peak_bytes
+
+
+def test_donate001_must_flag_and_must_pass():
+    rep = ml.analyze_fn(_step, jnp.ones((1024,)), jnp.ones((1024,)),
+                        require_donation=True)
+    assert [f.rule for f in rep.findings] == ["ML-DONATE001"]
+    assert rep.findings[0].severity == "error"
+    assert rep.undonated_bytes == 1024 * F32
+    # same match without the donation contract is an advisory
+    rep = ml.analyze_fn(_step, jnp.ones((1024,)), jnp.ones((1024,)))
+    assert [f.severity for f in rep.findings] == ["advisory"]
+    # allow_undonated declares the caller-held arguments
+    rep = ml.analyze_fn(_step, jnp.ones((1024,)), jnp.ones((1024,)),
+                        allow_undonated=(0, 1), require_donation=True)
+    assert rep.findings == []
+    # below the byte floor nothing fires
+    rep = ml.analyze_fn(_step, jnp.ones((8,)), jnp.ones((8,)),
+                        require_donation=True)
+    assert rep.findings == []
+
+
+def test_donated_args_claim_slots_first():
+    # p donated and matched; g's advisory must NOT re-claim p's slot —
+    # with only one output there is nothing left for g to match
+    def one_out(p, g):
+        return p - 0.1 * g
+
+    rep = ml.analyze_fn(one_out, jnp.ones((1024,)), jnp.ones((1024,)),
+                        donate_argnums=(0,), require_donation=True)
+    assert rep.findings == []
+
+
+def test_alias_credit_for_views():
+    rep = ml.analyze_fn(lambda x: x.reshape(32, 32) * 2.0,
+                        jnp.ones((1024,)))
+    assert rep.alias_credit_bytes == 1024 * F32
+    # transpose changes layout: no credit
+    rep2 = ml.analyze_fn(lambda x: x.T * 2.0, jnp.ones((64, 16)))
+    assert rep2.alias_credit_bytes == 0
+
+
+def test_subjaxpr_peak_recurses():
+    def scanned(x):
+        def body(c, _):
+            t = jnp.outer(c, c)          # (512, 512) transient inside
+            return c + t.sum() * 0.0, ()
+        c, _ = jax.lax.scan(body, x, None, length=3)
+        return c
+
+    rep = ml.analyze_fn(scanned, jnp.ones((512,)))
+    # the inner outer-product transient dominates: 512*512*4 = 1 MiB
+    assert rep.peak_bytes >= 512 * 512 * F32
+
+
+def test_peak001_budget_and_ignore():
+    cfg = ml.Config(peak_bytes=1024)
+    rep = ml.analyze_fn(lambda x: (x * 2 + 1).sum(), jnp.ones((4096,)),
+                        config=cfg)
+    assert any(f.rule == "ML-PEAK001" and f.severity == "error"
+               for f in rep.findings)
+    cfg2 = ml.Config(peak_bytes=1024, ignore={"ML-PEAK001"})
+    rep2 = ml.analyze_fn(lambda x: (x * 2 + 1).sum(), jnp.ones((4096,)),
+                         config=cfg2)
+    assert rep2.findings == []
+
+
+def test_check_memory_modes_and_scope():
+    p, g = jnp.ones((1024,)), jnp.ones((1024,))
+    # off by default: inert, returns None
+    assert ml.check_memory(_step, (p, g), name="t:off") is None
+    with ml.mem_scope("warn"):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            rep = ml.check_memory(_step, (p, g), name="t:warn",
+                                  require_donation=True)
+        assert rep is not None
+        assert any("ML-DONATE001" in str(x.message) for x in w)
+    with ml.mem_scope("strict"):
+        with pytest.raises(error.MemLintError):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                ml.check_memory(_step, (p, g), name="t:strict",
+                                require_donation=True)
+        # MemLintError IS a GraphLintError (one gate to catch on)
+        assert issubclass(error.MemLintError, error.GraphLintError)
+        # a donated call under strict passes and records its site
+        rep = ml.check_memory(_step, (p, g), name="t:ok",
+                              donate_argnums=(0,), require_donation=True)
+        assert rep.donated_reclaimed_bytes == 1024 * F32
+    assert ml.mem_mode() is None   # scope restored
+    # a crash in the analysis warns, never raises (build must survive)
+    with ml.mem_scope("strict"):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = ml.check_memory(lambda x: undefined_name, (p,),  # noqa: F821
+                                  name="t:crash")
+        assert out is None
+        assert any("could not analyze" in str(x.message) for x in w)
+
+
+def test_stats_provider_in_profiler_dumps():
+    with ml.mem_scope("warn"):
+        ml.check_memory(_step, (jnp.ones((1024,)), jnp.ones((1024,))),
+                        name="t:provider", donate_argnums=(0,))
+    st = ml.stats()
+    assert st["per_site"]["t:provider"]["donated_bytes_reclaimed"] == 4096
+    assert st["donated_bytes_reclaimed"] >= 4096
+    assert "memlint" in profiler.dumps()
+
+
+# ---------------------------------------------------------------------------
+# the fused-train-step surface (+ CPU aliasing proof)
+# ---------------------------------------------------------------------------
+
+def _net(in_units=32, hidden=64):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(hidden, in_units=in_units), nn.Activation("relu"),
+            nn.Dense(3, in_units=hidden))
+    net.initialize()
+    net(nd.ones((2, in_units)))
+    return net
+
+
+def _xy(in_units=32):
+    return nd.ones((2, in_units)), nd.array([0, 1])
+
+
+def test_fused_step_donated_passes_strict_with_full_coverage():
+    ml.reset_stats()
+    step = make_fused_train_step(_net(), gluon.loss.SoftmaxCrossEntropyLoss(),
+                                 "sgd", {"learning_rate": 0.1})
+    x, y = _xy()
+    with ml.mem_scope("strict"):
+        step(x, y)
+    site = ml.stats()["per_site"]["fused_step:HybridSequential"]
+    assert site["donation_coverage"] == 1.0
+    assert site["donated_bytes_reclaimed"] > 0
+    assert site["findings"] == 0
+    assert site["peak_hbm_bytes"] > 0
+
+
+def test_fused_step_undonated_raises_strict():
+    step = make_fused_train_step(_net(), gluon.loss.SoftmaxCrossEntropyLoss(),
+                                 "sgd", {"learning_rate": 0.1},
+                                 donate=False)
+    x, y = _xy()
+    with ml.mem_scope("strict"):
+        with pytest.raises(error.MemLintError) as ei:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                step(x, y)
+    assert "ML-DONATE001" in str(ei.value)
+
+
+def test_fused_step_actually_reuses_donated_buffers():
+    """CPU aliasing proof: the donated param/opt-state buffers are
+    really consumed (deleted) and at least some output buffers land on
+    the donated pointers — and jax emits no 'donated buffers were not
+    usable' warning."""
+    step = make_fused_train_step(_net(), gluon.loss.SoftmaxCrossEntropyLoss(),
+                                 "sgd", {"learning_rate": 0.1})
+    old_arrays = list(step.params.values()) + \
+        list(step.opt_state["mom"].values())
+    old_ptrs = {a.unsafe_buffer_pointer() for a in old_arrays}
+    x, y = _xy()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        step(x, y)
+    assert not any("donated" in str(x.message).lower() for x in w), \
+        [str(x.message) for x in w]
+    # the donated inputs are gone...
+    assert all(a.is_deleted() for a in old_arrays)
+    # ...and the updated params reuse buffers from the donated pool
+    new_ptrs = {a.unsafe_buffer_pointer() for a in step.params.values()}
+    assert new_ptrs & old_ptrs, (new_ptrs, old_ptrs)
+    # the step still trains (second call, buffers rotate again)
+    step(x, y)
+
+
+# ---------------------------------------------------------------------------
+# the CachedOp static_alloc surface (+ CPU aliasing proof)
+# ---------------------------------------------------------------------------
+
+def test_cachedop_static_alloc_donates_input_buffer():
+    """static_alloc's donation is real: the input chunk's device buffer
+    is consumed, and for a shape-preserving block the output lands on
+    the input's pointer (XLA aliased it)."""
+    net = nn.HybridSequential()
+    net.add(nn.Activation("relu"))
+    net.initialize()
+    net.hybridize(static_alloc=True)
+    x = nd.array(onp.random.RandomState(0).randn(64, 64).astype("f"))
+    raw = x.data
+    ptr = raw.unsafe_buffer_pointer()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = net(x)
+        out_val = out.data
+    assert not any("donated" in str(m.message).lower() for m in w), \
+        [str(m.message) for m in w]
+    assert raw.is_deleted()          # the donated input is consumed
+    assert out_val.unsafe_buffer_pointer() == ptr   # aliased in place
+    onp.testing.assert_array_equal(onp.asarray(out_val) >= 0, True)
+
+
+@pytest.mark.filterwarnings("ignore:Some donated buffers were not usable")
+def test_cachedop_static_alloc_strict_memlint_clean():
+    # the (2,32) input has no same-shape output in this net: XLA warns
+    # the donation is unusable (wasted, not wrong) — expected here
+    ml.reset_stats()
+    net = _net()
+    net.hybridize(static_alloc=True)
+    with ml.mem_scope("strict"):
+        net(nd.ones((2, 32)))        # cache-miss build analyzes
+    site = ml.stats()["per_site"]["cachedop:HybridSequential"]
+    assert site["findings"] == 0
+    assert site["peak_hbm_bytes"] > 0
+
+
+def test_cachedop_plain_records_stats_without_errors():
+    ml.reset_stats()
+    net = _net()
+    net.hybridize()
+    with ml.mem_scope("strict"):     # params/inputs caller-held: clean
+        net(nd.ones((2, 32)))
+    site = ml.stats()["per_site"]["cachedop:HybridSequential"]
+    assert site["findings"] == 0
+
+
+# ---------------------------------------------------------------------------
+# bulking dead-temporary reclamation
+# ---------------------------------------------------------------------------
+
+def test_bulk_dead_intermediates_dropped_and_counted():
+    ml.reset_stats()
+    with bulking.bulk_scope(True):
+        a = nd.ones((64, 64))
+        b = nd.ones((64, 64))
+        d = nd.ones((64, 64))
+        c = (a + b) * d + a          # two dead intermediates
+        out = c.asnumpy()
+    onp.testing.assert_array_equal(out, onp.full((64, 64), 3.0, "f"))
+    st = ml.stats()
+    assert st["bulk_temp_reclaimed_bytes"] == 2 * 64 * 64 * F32
+    assert st["bulk_temp_reclaimed_buffers"] == 2
+
+
+def test_bulk_held_intermediate_still_settles():
+    ml.reset_stats()
+    with bulking.bulk_scope(True):
+        a = nd.ones((32,))
+        b = nd.ones((32,))
+        t = a + b
+        c = t * 2
+        cn, tn = c.asnumpy(), t.asnumpy()
+    onp.testing.assert_array_equal(tn, onp.full((32,), 2.0, "f"))
+    onp.testing.assert_array_equal(cn, onp.full((32,), 4.0, "f"))
+    assert ml.stats()["bulk_temp_reclaimed_bytes"] == 0
+
+
+def test_bulk_view_of_dead_wrapper_keeps_buffer():
+    # a view shares the chunk: dropping only the base wrapper must NOT
+    # drop the output another NDArray still reads through the chunk
+    with bulking.bulk_scope(True):
+        a = nd.ones((4, 8))
+        b = nd.ones((4, 8))
+        t = a + b
+        v = t.reshape((8, 4))        # view shares t's chunk
+        del t
+        gc.collect()
+        out = v.asnumpy()
+    onp.testing.assert_array_equal(out, onp.full((8, 4), 2.0, "f"))
+
+
+def test_bulk_drop_dead_kill_switch(monkeypatch):
+    ml.reset_stats()
+    monkeypatch.setattr(bulking, "_env_drop_dead", False)
+    with bulking.bulk_scope(True):
+        a = nd.ones((16,))
+        c = (a + 1) * 2
+        c.asnumpy()
+    assert ml.stats()["bulk_temp_reclaimed_bytes"] == 0
+
+
+def test_bulk_dropped_placeholder_resolve_is_typed():
+    # internal-API misuse: resolving a raw dropped placeholder gets a
+    # clear sticky error, never a silent wrong value
+    with bulking.bulk_scope(True):
+        a = nd.ones((8,))
+        t = a + 1
+        pending = t._chunk.array
+        assert type(pending) is bulking.PendingArray
+        s = t * 2
+        del t
+        gc.collect()
+        s.asnumpy()                  # flush: t's output dropped
+    with pytest.raises(RuntimeError, match="dropped at flush"):
+        bulking.resolve(pending)
+
+
+def test_bulk_mode_parity_with_eager():
+    rng = onp.random.RandomState(3)
+    xs = [rng.randn(16, 16).astype("f") for _ in range(3)]
+
+    def compute():
+        a, b, c = (nd.array(v) for v in xs)
+        return (((a * b) + c) * (a - c)).asnumpy()
+
+    eager = compute()
+    with bulking.bulk_scope(True):
+        bulked = compute()
+    onp.testing.assert_allclose(bulked, eager, rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# table contracts: ref_aliases vs. registry
+# ---------------------------------------------------------------------------
+
+def test_identity_alias_table_matches_registry_both_directions():
+    """memlint's op-level aliasing credit trusts IDENTITY_ALIASES; the
+    registry's inplace_identity metadata must agree exactly."""
+    # every registered name of an op marked inplace_identity is in the
+    # table with the same input index
+    for name, op in _OPS.items():
+        if op.inplace_identity is not None:
+            assert IDENTITY_ALIASES.get(name) == op.inplace_identity, \
+                f"op {name!r} is registered inplace_identity=" \
+                f"{op.inplace_identity} but ref_aliases.IDENTITY_ALIASES " \
+                f"has {IDENTITY_ALIASES.get(name)!r}"
+    # every table entry names a registered op carrying the metadata
+    for name, idx in IDENTITY_ALIASES.items():
+        op = _OPS.get(name)
+        assert op is not None, f"IDENTITY_ALIASES names unregistered {name!r}"
+        assert op.inplace_identity == idx, \
+            f"IDENTITY_ALIASES[{name!r}]={idx} but the registry says " \
+            f"{op.inplace_identity!r}"
+
+
+def test_segment_alias_credit_uses_table():
+    # always-on, per-flush (the same accumulation basis as the reclaim
+    # counter) — no memlint mode and no fresh compile required
+    ml.reset_stats()
+    with bulking.bulk_scope(True):
+        a = nd.ones((64, 64))
+        # the registered reshape OP (the NDArray .reshape method is
+        # a chunk view, not a segment node)
+        b = nd.reshape(a + 1, shape=(4096,))
+        b.asnumpy()
+    assert ml.stats()["bulk_alias_credit_bytes"] == 64 * 64 * F32
+    # cache-hit replay counts again: per flush, like reclaimed bytes
+    with bulking.bulk_scope(True):
+        a = nd.ones((64, 64))
+        nd.reshape(a + 1, shape=(4096,)).asnumpy()
+    assert ml.stats()["bulk_alias_credit_bytes"] == 2 * 64 * 64 * F32
+
+
+# ---------------------------------------------------------------------------
+# export / serving path
+# ---------------------------------------------------------------------------
+
+def _export(tmp_path, donate=(1,)):
+    from incubator_mxnet_tpu import deploy
+
+    def fwd(params, x):
+        return x @ params["w"] + params["b"]
+
+    params = {"w": jnp.ones((16, 16)), "b": jnp.zeros((16,))}
+    prefix = str(tmp_path / "m")
+    meta = deploy.export_model(fwd, (jnp.ones((4, 16)),), prefix,
+                               params=params, donate_argnums=donate)
+    return prefix, meta
+
+
+def test_export_records_memlint_summary_and_donation(tmp_path):
+    prefix, meta = _export(tmp_path)
+    assert meta["donate_argnums"] == [1]
+    s = meta["memlint"]
+    assert s["peak_hbm_bytes"] > 0
+    assert s["donated_bytes_reclaimed"] == 4 * 16 * F32
+    assert s["donation_coverage"] == 1.0
+    # persisted for the serving layer
+    import json
+    disk = json.load(open(prefix + ".meta.json"))
+    assert disk["memlint"]["peak_hbm_bytes"] == s["peak_hbm_bytes"]
+
+
+def test_export_rejects_params_slot_donation(tmp_path):
+    with pytest.raises(ValueError, match="params"):
+        _export(tmp_path, donate=(0,))
+    with pytest.raises(ValueError, match="out of range"):
+        _export(tmp_path, donate=(3,))
+
+
+def test_predictor_reapplies_donation(tmp_path):
+    from incubator_mxnet_tpu import deploy
+    prefix, _ = _export(tmp_path)
+    pred = deploy.load_predictor(prefix)
+    x = jnp.ones((4, 16))
+    out = pred(x)
+    assert x.is_deleted()            # the donated request buffer is gone
+    # numpy callers are unaffected (asarray copies to device) and the
+    # predictor keeps serving — params were never donated
+    out2 = pred(onp.ones((4, 16), onp.float32))
+    onp.testing.assert_allclose(out, out2)
+    # polymorphic batch path carries the same donation
+    out3 = pred(onp.ones((7, 16), onp.float32))
+    assert out3.shape == (7, 16)
+
+
+def test_undonated_export_still_serves(tmp_path):
+    from incubator_mxnet_tpu import deploy
+    prefix, meta = _export(tmp_path, donate=())
+    assert meta["donate_argnums"] == []
+    pred = deploy.load_predictor(prefix)
+    x = jnp.ones((4, 16))
+    pred(x)
+    assert not x.is_deleted()
+
+
+def test_repository_surfaces_memory_summary(tmp_path):
+    from incubator_mxnet_tpu.serving.metrics import ServingMetrics
+    from incubator_mxnet_tpu.serving.model_repository import ModelRepository
+    prefix, _ = _export(tmp_path)
+    metrics = ServingMetrics()
+    repo = ModelRepository(metrics=metrics, warmup=False)
+    try:
+        desc = repo.load("m", prefix)
+        assert desc["memlint"]["peak_hbm_bytes"] > 0
+        assert desc["memlint"]["donated_bytes_reclaimed"] > 0
+        text = metrics.render()
+        assert 'mxnet_serving_model_peak_hbm_bytes{model="m"}' in text
+        assert ('mxnet_serving_model_donated_bytes_reclaimed{model="m"}'
+                in text)
+        snap = metrics.snapshot()
+        assert snap["m.peak_hbm_bytes"] > 0
+    finally:
+        repo.drain_all(timeout=5)

@@ -180,6 +180,9 @@ def main(argv=None):
     p.add_argument("--baseline", default=None,
                    help=f"baseline JSON (default: {DEFAULT_BASELINE} "
                         "when it exists; same contract as mxlint)")
+    p.add_argument("--prune-stale", action="store_true",
+                   help="rewrite the baseline file with its stale "
+                        "entries removed, then report as usual")
     p.add_argument("--json", action="store_true", dest="as_json")
     args = p.parse_args(argv)
 
@@ -199,6 +202,7 @@ def main(argv=None):
 
     config = gl.Config(ignore=args.ignore)
     findings = []
+    wheres = []   # entry labels this run analyzed (prune-stale scope)
 
     for name in args.zoo:
         from incubator_mxnet_tpu import nd
@@ -210,6 +214,7 @@ def main(argv=None):
         net(x)   # materialize deferred-shape parameters
         for training in (False, True):
             mode = "train" if training else "infer"
+            wheres.append(f"zoo:{name}:{mode}")
             findings += gl.lint_block(net, x, training=training,
                                       where=f"zoo:{name}:{mode}",
                                       config=config)
@@ -228,12 +233,18 @@ def main(argv=None):
                 kwargs[k] = ast.literal_eval(v)
             except (ValueError, SyntaxError):
                 kwargs[k] = v
+        from incubator_mxnet_tpu.ops.registry import get_op
+        # canonical name: findings are labeled op:<op.name>, so an
+        # alias spelling (--op Reshape) must scope the same entries
+        wheres.append(f"op:{get_op(args.op).name}")
         findings += gl.lint_op(args.op,
                                *[parse_spec(s) for s in args.spec],
                                config=config, **kwargs)
 
     if args.ops_smoke:
+        from incubator_mxnet_tpu.ops.registry import get_op
         for op, specs, kwargs in _OPS_SMOKE:
+            wheres.append(f"op:{get_op(op).name}")
             findings += gl.lint_op(op, *specs, config=config, **kwargs)
 
     baseline_path = args.baseline or (
@@ -243,6 +254,25 @@ def main(argv=None):
     errors = [f for f in findings if f.severity == "error"]
     advisories = [f for f in findings if f.severity != "error"]
     regressions, suppressed, stale = flib.apply_baseline(errors, baseline)
+
+    if args.prune_stale and stale and baseline_path:
+        # only entries whose analyzed surface ran this invocation are
+        # prunable — a --zoo/--op subset must not delete the rest of
+        # the surfaces' justified entries.  Baseline "file" is the
+        # finding's where+path (path always begins with "/"), so the
+        # "/" boundary keeps op:relu from claiming op:relu6's entries
+        def in_scope(key):
+            return any(key[1] == w or key[1].startswith(w + "/")
+                       for w in wheres)
+
+        pruned = [k for k in stale if in_scope(k)]
+        flib.prune_stale_baseline(baseline_path, stale,
+                                  in_scope=in_scope)
+        print(f"[graphlint] pruned {len(pruned)} stale entr"
+              f"{'y' if len(pruned) == 1 else 'ies'} from {baseline_path}"
+              + (f" ({len(stale) - len(pruned)} out-of-scope kept)"
+                 if len(pruned) != len(stale) else ""))
+        stale = [k for k in stale if not in_scope(k)]
 
     if args.as_json:
         print(json.dumps({

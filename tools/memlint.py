@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""memlint CLI — liveness-based HBM planner/analyzer driver.
+
+Usage:
+    python tools/memlint.py --zoo resnet18_v1 --batch 4   # infer+train sweep
+    python tools/memlint.py --zoo resnet18_v1 --check     # CI gate
+    python tools/memlint.py --selftest      # seeded violations must surface
+    python tools/memlint.py --seed-violation  # MUST exit nonzero (CI control)
+    python tools/memlint.py --json --output mem.json
+
+Per ``--zoo`` model the sweep analyzes the INFERENCE forward (the
+CachedOp/export surface) and the fused TRAIN step (forward + backward +
+optimizer, ``donate_argnums=(0, 1, 2)``), runs one real train step with
+``MXNET_GRAPH_MEMLINT`` active so the ``memlint`` profiler provider
+records the site, and emits a BENCH-style JSON record with the
+per-model peak-HBM estimate, donated-bytes-reclaimed and donation
+coverage.
+
+``--check`` is the CI gate (docs/graph_analysis.md): it fails unless
+every model's train step donates 100% of its parameter/optimizer-state
+buffers (donation coverage 1.0), reclaims a nonzero byte count, reports
+zero error-severity findings, and the profiler gauge is nonzero.
+``--selftest`` seeds one violation per memlint rule (an UNDONATED train
+step must raise under strict mode, an over-budget graph must flag
+ML-PEAK001) and fails unless each surfaces — proving the gate would
+catch the real thing.  ``--seed-violation`` builds the zoo train step
+with donation OFF under strict mode and exits with the resulting
+failure: CI runs it expecting a NONZERO exit (the stage's negative
+control).
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _tiny_net():
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon import nn
+    net = nn.HybridSequential()
+    # weights big enough to clear memlint's donate_min_bytes floor
+    net.add(nn.Dense(64, in_units=32), nn.Activation("relu"),
+            nn.Dense(3, in_units=64))
+    net.initialize()
+    net(nd.ones((2, 32)))
+    return net
+
+
+def selftest():
+    """Seed one violation per rule; each must surface."""
+    import warnings
+
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu import error, gluon, nd
+    from incubator_mxnet_tpu.analysis import memlint as ml
+    from incubator_mxnet_tpu.fuse import make_fused_train_step
+
+    failures = []
+
+    # ML-DONATE001 (error severity): an undonated params-in/params-out
+    # step at a donating surface
+    def step(p, g):
+        return p - 0.1 * g
+
+    rep = ml.analyze_fn(step, jnp.ones((2048,)), jnp.ones((2048,)),
+                        require_donation=True)
+    if any(f.rule == "ML-DONATE001" and f.severity == "error"
+           for f in rep.findings):
+        print("[selftest] ML-DONATE001: undonated step flagged OK")
+    else:
+        failures.append("ML-DONATE001 not raised on an undonated step")
+    rep_ok = ml.analyze_fn(step, jnp.ones((2048,)), jnp.ones((2048,)),
+                           donate_argnums=(0,), require_donation=True)
+    if rep_ok.findings:
+        failures.append(f"donated step still flagged: {rep_ok.findings}")
+    elif rep_ok.donated_reclaimed_bytes != 8192:
+        failures.append("donated step reclaimed "
+                        f"{rep_ok.donated_reclaimed_bytes}, wanted 8192")
+    else:
+        print("[selftest] ML-DONATE001: donated step clean OK")
+
+    # ML-PEAK001: budget gate
+    rep = ml.analyze_fn(lambda x: (x * 2 + 1).sum(), jnp.ones((4096,)),
+                        config=ml.Config(peak_bytes=1024))
+    if any(f.rule == "ML-PEAK001" for f in rep.findings):
+        print("[selftest] ML-PEAK001: over-budget graph flagged OK")
+    else:
+        failures.append("ML-PEAK001 not raised over budget")
+
+    # strict mode at the real fused-step surface: an undonated build
+    # must raise MemLintError on its first step
+    net = _tiny_net()
+    fstep = make_fused_train_step(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, donate=False)
+    x, y = nd.ones((2, 32)), nd.array([0, 1])
+    with ml.mem_scope("strict"):
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                fstep(x, y)
+            failures.append("strict fused_step: MemLintError not raised "
+                            "for donate=False")
+        except error.MemLintError:
+            print("[selftest] strict-mode: undonated fused step raised OK")
+    # and the donated build passes strict with full coverage
+    net2 = _tiny_net()
+    fstep2 = make_fused_train_step(
+        net2, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1})
+    with ml.mem_scope("strict"):
+        fstep2(x, y)
+    site = ml.stats()["per_site"].get("fused_step:HybridSequential", {})
+    if site.get("donation_coverage") != 1.0:
+        failures.append(f"donated fused step coverage {site}")
+    else:
+        print("[selftest] strict-mode: donated fused step clean, "
+              "coverage 1.0 OK")
+
+    for f in failures:
+        print(f"[selftest] FAIL {f}")
+    print("[selftest] " + ("FAILED" if failures
+                           else "all seeded violations caught"))
+    return 1 if failures else 0
+
+
+def sweep_model(name, batch, image_size, train_steps=1):
+    """Analyze one zoo model: inference forward + fused train step
+    (run for real under MXNET_GRAPH_MEMLINT so the profiler provider
+    records the site)."""
+    from incubator_mxnet_tpu import gluon, nd
+    from incubator_mxnet_tpu.analysis import memlint as ml
+    from incubator_mxnet_tpu.fuse import make_fused_train_step
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.get_model(name, classes=10)
+    net.initialize()
+    x = nd.random.uniform(shape=(batch, 3, image_size, image_size))
+    y = nd.array([i % 10 for i in range(batch)])
+    net(x)   # materialize deferred-shape parameters
+
+    infer = ml.analyze_block(net, x, training=False,
+                             where=f"zoo:{name}:infer")
+
+    step = make_fused_train_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                 "sgd", {"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    with ml.mem_scope("warn"):
+        for _ in range(train_steps):
+            step(x, y)
+    train = ml.stats()["per_site"].get(f"fused_step:{type(net).__name__}")
+    if train is None:
+        raise RuntimeError("fused-step site was not recorded — the "
+                           "memlint choke point did not fire")
+    errors = [f for f in infer.findings if f.severity == "error"]
+    return {
+        "infer": {
+            "peak_hbm_bytes": infer.peak_bytes,
+            "input_bytes": infer.input_bytes,
+            "output_bytes": infer.output_bytes,
+            "alias_credit_bytes": infer.alias_credit_bytes,
+        },
+        "train": dict(train),
+        # the fused-step site runs require_donation=True, so its
+        # recorded findings are error severity by construction
+        "error_findings": len(errors) + int(train.get("findings", 0)),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="memlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--zoo", action="append", default=[],
+                   help="model_zoo.vision factory name (repeatable)")
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--check", action="store_true",
+                   help="gate: every train step must donate 100%% of "
+                        "param/opt-state buffers with zero error "
+                        "findings and a nonzero profiler gauge")
+    p.add_argument("--selftest", action="store_true",
+                   help="seed one violation per rule; each must surface")
+    p.add_argument("--seed-violation", action="store_true",
+                   help="build the train step UNDONATED under strict "
+                        "mode: exits nonzero when enforcement works "
+                        "(CI runs this expecting failure)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--output", default=None,
+                   help="write the BENCH-style record to this file")
+    args = p.parse_args(argv)
+
+    if not (args.zoo or args.selftest or args.seed_violation):
+        p.error("nothing to analyze: pass --zoo, --selftest and/or "
+                "--seed-violation")
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import incubator_mxnet_tpu  # noqa: F401  (registers ops)
+    from incubator_mxnet_tpu.analysis import memlint as ml
+
+    if args.seed_violation:
+        # negative control: enforcement must FAIL this process
+        from incubator_mxnet_tpu import error, gluon, nd
+        from incubator_mxnet_tpu.fuse import make_fused_train_step
+        net = _tiny_net()
+        step = make_fused_train_step(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1}, donate=False)
+        with ml.mem_scope("strict"):
+            try:
+                step(nd.ones((2, 32)), nd.array([0, 1]))
+            except error.MemLintError as e:
+                print(f"[memlint] seeded violation caught: {e}",
+                      file=sys.stderr)
+                return 1
+        print("[memlint] seeded violation NOT caught — enforcement is "
+              "broken", file=sys.stderr)
+        return 0   # "success" here means the CI control FAILS the stage
+
+    if args.selftest:
+        rc = selftest()
+        if rc or not args.zoo:
+            return rc
+
+    models = {}
+    problems = []
+    for name in args.zoo:
+        models[name] = sweep_model(name, args.batch, args.image_size)
+        t = models[name]["train"]
+        if models[name]["error_findings"]:
+            problems.append(f"{name}: error-severity findings")
+        if t.get("donation_coverage") != 1.0:
+            problems.append(f"{name}: train donation coverage "
+                            f"{t.get('donation_coverage')} != 1.0")
+        if not t.get("donated_bytes_reclaimed"):
+            problems.append(f"{name}: donated_bytes_reclaimed is zero")
+
+    gauge = ml.stats()["donated_bytes_reclaimed"]
+    record = {
+        "metric": "zoo_peak_hbm_bytes",
+        "unit": "bytes",
+        "value": max((m["train"].get("peak_hbm_bytes", 0)
+                      for m in models.values()), default=0),
+        "models": models,
+        "profiler_donated_bytes_reclaimed": gauge,
+        "check": args.check,
+        "problems": problems,
+    }
+    if args.check and not gauge:
+        problems.append("profiler memlint gauge donated_bytes_reclaimed "
+                        "is zero")
+
+    out = json.dumps(record, indent=2)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out + "\n")
+    if args.as_json or not args.output:
+        print(out)
+    for prob in problems:
+        print(f"[memlint] GATE: {prob}", file=sys.stderr)
+    if args.check and problems:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,10 @@ def main(argv=None):
     p.add_argument("--write-baseline", action="store_true",
                    help="write current findings to the baseline file "
                         "(each entry needs a reason filled in) and exit 0")
+    p.add_argument("--prune-stale", action="store_true",
+                   help="rewrite the baseline file with its stale "
+                        "entries (finding no longer present) removed, "
+                        "then report as usual")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit findings as JSON")
     p.add_argument("--docs", default=None,
@@ -75,6 +79,27 @@ def main(argv=None):
                 if baseline_path else {})
     regressions, suppressed, stale = mxlint.apply_baseline(findings,
                                                            baseline)
+
+    if args.prune_stale and stale and baseline_path:
+        # only entries the scanned paths could have re-produced are
+        # prunable — a partial run must not delete the rest of the
+        # tree's justified entries
+        scanned = [os.path.relpath(os.path.abspath(p), REPO)
+                   for p in args.paths]
+
+        def in_scope(key):
+            f = key[1]
+            return any(f == s or f.startswith(s.rstrip(os.sep) + os.sep)
+                       for s in scanned)
+
+        pruned = [k for k in stale if in_scope(k)]
+        mxlint.prune_stale_baseline(baseline_path, stale,
+                                    in_scope=in_scope)
+        print(f"[mxlint] pruned {len(pruned)} stale entr"
+              f"{'y' if len(pruned) == 1 else 'ies'} from {baseline_path}"
+              + (f" ({len(stale) - len(pruned)} out-of-scope kept)"
+                 if len(pruned) != len(stale) else ""))
+        stale = [k for k in stale if not in_scope(k)]
 
     if args.as_json:
         print(json.dumps({
