@@ -35,6 +35,13 @@ Stages (each isolated, failures collected, nonzero exit if any fail):
              port, warm it, fire concurrent requests, scrape /metrics,
              assert the compile count did not move and responses match
              the unbatched baseline bitwise
+  coldstart  cold-start gate: fresh-subprocess process-start→first-
+             inference must be >= 3x faster with a warm persistent
+             compile cache and with AOT executables in the artifact
+             (which must report compile_total == 0 from process
+             start); corrupted AOT blob must degrade to recompile;
+             then a resnet18 artifact with AOT buckets must load +
+             serve in a fresh subprocess without compiling
   fleet      multi-replica serving sweep under a pinned seeded spec
              (lossy routing hops, failed probes, replica-side faults):
              kill-a-replica chaos volley with zero failed client
@@ -304,6 +311,48 @@ def stage_serving(args):
                   f"bitwise={rec['bitwise_equal_unbatched']}")
 
 
+def stage_coldstart(args):
+    """Cold-start gate (docs/performance.md "Cold start"): the
+    coldstart bench's fresh-subprocess sweep must show the persistent
+    compile cache and the AOT artifact layer working — warm and AOT
+    process-start→first-inference >= 3x faster than cold, the AOT
+    replica reporting compile_total == 0 FROM PROCESS START, and the
+    corrupted-blob negative control degrading to recompile (never a
+    crash); then a real model_zoo resnet18 artifact with AOT buckets
+    must load + serve in a fresh subprocess without compiling."""
+    out = os.path.join(REPO, ".ci_coldstart.json")
+    try:
+        proc = sh([sys.executable, "benchmark/coldstart_bench.py",
+                   "--check", "--output", out], timeout=900)
+        if proc.returncode != 0:
+            return False, (proc.stderr or proc.stdout).strip()[-400:]
+        with open(out) as f:
+            rec = json.load(f)
+    finally:
+        if os.path.exists(out):
+            os.remove(out)
+    try:
+        proc2 = sh([sys.executable, "benchmark/coldstart_bench.py",
+                    "--check", "--model-zoo", "resnet18_v1",
+                    "--buckets", "1,2", "--floor", "1.3",
+                    "--aot-tolerance", "2.0", "--output", out],
+                   timeout=1500)
+        if proc2.returncode != 0:
+            return False, ("zoo: "
+                           + (proc2.stderr or proc2.stdout).strip()[-400:])
+        with open(out) as f:
+            zoo = json.load(f)
+    finally:
+        if os.path.exists(out):
+            os.remove(out)
+    return True, (f"toy warm {rec['value']}x / aot {rec['aot_speedup_x']}x "
+                  f"vs cold {rec['cold_ms']:.0f}ms, aot compiles "
+                  f"{rec['aot_compile_total']}, corrupt-fallback ok; "
+                  f"resnet18 aot {zoo['aot_speedup_x']}x "
+                  f"({zoo['aot_ms']:.0f}ms vs {zoo['cold_ms']:.0f}ms), "
+                  f"compiles {zoo['aot_compile_total']}")
+
+
 def stage_lint(args):
     """Framework-aware static analysis (tools/mxlint.py): exit 0 means
     no findings beyond the baseline — and the baseline stays empty
@@ -424,6 +473,7 @@ STAGES = {"build": stage_build, "sanity": stage_sanity,
           "bulking": stage_bulking, "chaos": stage_chaos,
           "elastic": stage_elastic,
           "serving": stage_serving, "fleet": stage_fleet,
+          "coldstart": stage_coldstart,
           "race": stage_race,
           "graphlint": stage_graphlint,
           "memlint": stage_memlint,

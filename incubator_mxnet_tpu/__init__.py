@@ -138,6 +138,7 @@ from . import test_utils
 from . import util
 from . import library
 from . import rtc
+from . import executor_cache
 from . import deploy
 from . import serving
 from .util import is_np_array, set_np, reset_np

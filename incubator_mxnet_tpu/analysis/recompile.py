@@ -13,7 +13,10 @@ entry point, and the proof the bucketing holds.)
 Mechanism: every layer of the framework that creates a jitted callable
 (``ops.registry.Op.jitted``, the bulking trace cache, ``CachedOp``,
 the Symbol ``Executor``, ``FusedTrainStep``, the deploy ``Predictor``)
-wraps the *python function it hands to jit* in :func:`instrument`.
+builds it through the unified choke point
+(``executor_cache.Executor``), which wraps the *python function it
+hands to jit* in :func:`instrument` — wired once there, not per
+surface.
 The wrapper body only ever executes while jax is TRACING — a jit cache
 hit never re-enters python — so each execution of the wrapper IS one
 compilation, observed with zero instrumentation on the warm path.

@@ -38,6 +38,8 @@ import jax
 import jax.export  # noqa: F401  (jax.export is a lazily-bound submodule)
 import jax.numpy as jnp
 
+from . import executor_cache as _xc
+
 __all__ = ["export_model", "load_predictor"]
 
 
@@ -63,7 +65,7 @@ def _block_forward_fn(block):
 
 
 def export_model(model, example_inputs, prefix, params=None,
-                 donate_argnums=()):
+                 donate_argnums=(), aot_buckets=None):
     """Compile + serialize a model's forward for deployment.
 
     model: a gluon Block (uses ``functional()``) or a pure
@@ -80,6 +82,13 @@ def export_model(model, example_inputs, prefix, params=None,
     outputs — callers hand over the donated arrays (the batcher builds
     each padded batch fresh, so the serving path is donation-safe by
     construction).
+
+    ``aot_buckets`` (or ``MXNET_EXPORT_AOT_BUCKETS``) additionally
+    serializes one *compiled* executable per batch-bucket size next to
+    the artifact (``{prefix}.aot.b{n}``), so a loading process executes
+    instead of compiling — the cold-start killer for serving replicas.
+    The blobs are jax/jaxlib/platform-exact (a loud versioned compat
+    check falls back to recompilation on mismatch).
     """
     from .ndarray import NDArray, save as nd_save
 
@@ -108,7 +117,12 @@ def export_model(model, example_inputs, prefix, params=None,
         x.data if isinstance(x, NDArray) else jnp.asarray(x)
         for x in example_inputs)
 
-    jitted = jax.jit(fwd, donate_argnums=donate_argnums)
+    # through the unified choke point: the export trace is a compile
+    # surface like any other (sentinel site export:<name>, persistent
+    # compile cache enabled at Executor construction)
+    jitted = _xc.Executor(
+        fwd, f"export:{os.path.basename(prefix)}",
+        donate_argnums=donate_argnums).jfn
     lowered = jitted.lower(params, *example)
     with open(prefix + ".stablehlo.mlir", "w") as f:
         f.write(lowered.as_text())
@@ -149,6 +163,9 @@ def export_model(model, example_inputs, prefix, params=None,
     meta["batch_export"] = _write_batch_export(jitted, params, example,
                                                prefix)
     meta["donate_argnums"] = list(donate_argnums)
+    aot = _write_aot_buckets(jitted, params, example, prefix, aot_buckets)
+    if aot is not None:
+        meta["aot"] = aot
     if graphlint_summary is not None:
         meta["graphlint"] = graphlint_summary
     if memlint_summary is not None:
@@ -266,6 +283,77 @@ def _write_batch_export(jitted, params, example, prefix):
         return False
 
 
+def _parse_aot_buckets(aot_buckets):
+    """Resolve the bucket list: explicit arg wins, else the
+    ``MXNET_EXPORT_AOT_BUCKETS`` env (``default``/``true`` = the
+    serving batcher's padding buckets, a comma list = exactly those
+    sizes — ``1`` means the single bucket [1], it is a valid size and
+    must not be hijacked as a boolean — empty/``0``/``off`` = off)."""
+    from .base import get_env
+    if aot_buckets is None:
+        raw = str(get_env("MXNET_EXPORT_AOT_BUCKETS", "")).strip().lower()
+        if raw in ("", "0", "off", "none", "false"):
+            return None
+        if raw in ("default", "true"):
+            from .serving.batcher import parse_buckets
+            aot_buckets = parse_buckets()
+        else:
+            aot_buckets = [int(t) for t in raw.split(",") if t.strip()]
+    buckets = sorted({int(b) for b in aot_buckets})
+    if any(b < 1 for b in buckets):
+        raise ValueError(f"AOT bucket sizes must be >= 1, got {buckets}")
+    return buckets or None
+
+
+def _write_aot_buckets(jitted, params, example, prefix, aot_buckets):
+    """AOT layer of the artifact: one *compiled* executable per batch
+    bucket, serialized with a versioned compat envelope
+    (``executor_cache.serialize_executable``) as ``{prefix}.aot.b{n}``.
+    ``ModelRepository.load`` + warmup then deserialize instead of
+    compiling — XLA never runs in the serving replica.  Executables are
+    jax/jaxlib/platform-exact; the loader's compat check falls back to
+    recompilation (loudly) rather than crash on a foreign blob.
+    Returns the meta.json ``"aot"`` entry or None when off/unavailable."""
+    buckets = _parse_aot_buckets(aot_buckets)
+    if buckets is None:
+        return None
+    written = []
+    try:
+        if not all(x.ndim >= 1 for x in example):
+            raise ValueError(
+                "AOT buckets need a leading batch axis on every input")
+        files = {}
+        for n in buckets:
+            specs = [jax.ShapeDtypeStruct((n,) + tuple(x.shape[1:]),
+                                          x.dtype) for x in example]
+            compiled = jitted.lower(params, *specs).compile()
+            blob = _xc.serialize_executable(compiled)
+            # round-trip self-check BEFORE shipping: an executable
+            # served from a shared compile cache can re-serialize
+            # incompletely (missing kernel symbols) — a blob that does
+            # not load in the exporting environment can never load
+            # anywhere, and must abort the AOT layer here, not crash a
+            # serving replica later.  record=False: validation, not
+            # cold-start cache traffic
+            _xc.deserialize_executable(blob, record=False)
+            path = f"{prefix}.aot.b{n}"
+            with open(path, "wb") as f:
+                f.write(blob)
+            written.append(path)
+            files[str(n)] = os.path.basename(path)
+        return {"buckets": buckets, "files": files,
+                "compat": _xc.aot_compat()}
+    except Exception as e:  # mxlint: allow-broad-except(AOT executables are an optional artifact layer; failure degrades to compile-at-warmup with a warning)
+        import warnings
+        for path in written:   # no partial bucket set: all-or-nothing
+            if os.path.exists(path):
+                os.remove(path)
+        warnings.warn(
+            f"AOT bucket export unavailable ({e}); loading processes "
+            "will compile at warmup instead of deserializing")
+        return None
+
+
 def _write_pjrt_sidecar(prefix, params, meta):
     """Artifacts for the PURE-C++ PJRT predictor (src/pjrt_predict.cc):
     no Python at serving time, so everything the C runtime needs is
@@ -353,10 +441,10 @@ class Predictor:
         # rebuild the params pytree from flattened keystr names
         self._params = _unflatten_keystr(
             {k: v.data for k, v in loaded.items()})
-        # jit both entry points: jit's executable cache keyed on concrete
-        # input shapes is (a) the warm-path dispatch and (b) the compile
-        # counter the serving metrics watch (_cache_size per function)
-        from .analysis import recompile as _recompile
+        # both entry points go through the unified choke point
+        # (executor_cache.Executor): jit's executable cache keyed on
+        # concrete input shapes is (a) the warm-path dispatch and (b)
+        # the compile counter the serving metrics watch
         tag = os.path.basename(prefix)
         # donation does not survive serialization: jax.export records
         # the aliasing in the module, but the re-jitted call needs its
@@ -364,18 +452,21 @@ class Predictor:
         # re-apply the positions export_model recorded in meta.json
         # (position 0 = params, held across calls, never donated)
         self._donate = tuple(self.meta.get("donate_argnums") or ())
-        self._call = jax.jit(_recompile.instrument(
-            self._exported.call, f"predictor:{tag}"),
+        self._call_ex = _xc.Executor(
+            self._exported.call, f"predictor:{tag}",
             donate_argnums=self._donate)
+        self._call = self._call_ex.jfn
+        self._batch_call_ex = None
         self._batch_call = None
         bpath = prefix + ".batch.jaxport"
         if self.meta.get("batch_export", os.path.exists(bpath)):
             try:
                 with open(bpath, "rb") as f:
                     self._batch_exported = jax.export.deserialize(f.read())
-                self._batch_call = jax.jit(_recompile.instrument(
-                    self._batch_exported.call, f"predictor:{tag}:batch"),
+                self._batch_call_ex = _xc.Executor(
+                    self._batch_exported.call, f"predictor:{tag}:batch",
                     donate_argnums=self._donate)
+                self._batch_call = self._batch_call_ex.jfn
             except (OSError, ValueError) as e:
                 # an artifact set copied without the polymorphic twin
                 # (older tooling, partial copy) must still serve — the
@@ -388,16 +479,60 @@ class Predictor:
         self._static_shapes = [tuple(s["shape"])
                                for s in self.meta["inputs"]]
         self._static_dtypes = [s["dtype"] for s in self.meta["inputs"]]
+        # AOT layer: per-bucket *compiled* executables shipped in the
+        # artifact — executing one is pure deserialization + run, no
+        # XLA, so a replica that serves only AOT-covered buckets keeps
+        # compile_count at ZERO from process start.  A mismatched or
+        # corrupted blob is refused by the versioned compat check and
+        # that bucket falls back to the traced path (recompile), loudly.
+        self._aot: dict = {}
+        self.aot_load_failures = 0
+        for n in (self.meta.get("aot") or {}).get("buckets") or ():
+            # blob paths derive from THIS prefix (like .jaxport/.params),
+            # so a renamed/copied artifact set loads its own blobs — the
+            # manifest's "files" entry is informational
+            path = f"{prefix}.aot.b{int(n)}"
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+                self._aot[int(n)] = _xc.deserialize_executable(blob)
+            except (OSError, _xc.AOTCompatError) as e:
+                self.aot_load_failures += 1
+                import warnings
+                warnings.warn(
+                    f"AOT executable for bucket {n} of {prefix} "
+                    f"unusable ({e}); this bucket recompiles at warmup")
 
     def __call__(self, *inputs):
         arrs = tuple(jnp.asarray(x) for x in inputs)
-        if [tuple(a.shape) for a in arrs] == self._static_shapes:
+        n = self._aot_batch(arrs) if self._aot else None
+        if n is not None:
+            out = self._aot[n](self._params, *arrs)
+        elif [tuple(a.shape) for a in arrs] == self._static_shapes:
             out = self._call(self._params, *arrs)
         else:
             out = self._flex_call(arrs)
         return jax.tree_util.tree_map(onp.asarray, out)
 
     # -- batched serving surface -------------------------------------
+
+    def _aot_batch(self, arrs):
+        """The batch size when ``arrs`` exactly matches the exported
+        signature at an AOT-covered bucket (shared leading dim, same
+        trailing shape and dtype); else None."""
+        if len(arrs) != len(self._static_shapes):
+            return None
+        n = None
+        for a, ref, dt in zip(arrs, self._static_shapes,
+                              self._static_dtypes):
+            if (a.ndim != len(ref) or tuple(a.shape[1:]) != tuple(ref[1:])
+                    or jnp.dtype(a.dtype) != jnp.dtype(dt)):
+                return None
+            if n is None:
+                n = int(a.shape[0])
+            elif int(a.shape[0]) != n:
+                return None
+        return n if n in self._aot else None
 
     def _flex_call(self, arrs):
         """Execute at a batch size other than the traced one: the
@@ -407,6 +542,9 @@ class Predictor:
         if self._batch_call is not None:
             return self._batch_call(self._params, *arrs)
         b0 = self._static_shapes[0][0]
+        # each chunk is exactly b0 rows — if the artifact ships an AOT
+        # executable for that size, run it instead of compiling one
+        chunk_call = self._aot.get(b0, None) or self._call
         chunks = []
         for lo in range(0, n, b0):
             part = tuple(a[lo:lo + b0] for a in arrs)
@@ -415,7 +553,7 @@ class Predictor:
                 part = tuple(jnp.concatenate(
                     [p, jnp.zeros((b0 - take,) + tuple(p.shape[1:]),
                                   p.dtype)]) for p in part)
-            out = self._call(self._params, *part)
+            out = chunk_call(self._params, *part)
             chunks.append(jax.tree_util.tree_map(
                 lambda o, k=take: o[:k], out))
         return jax.tree_util.tree_map(
@@ -448,22 +586,28 @@ class Predictor:
         return self._batch_call is not None
 
     @property
+    def aot_buckets(self):
+        """Batch sizes served by AOT-deserialized executables (no XLA
+        compile in this process, ever, for these sizes)."""
+        return sorted(self._aot)
+
+    @property
     def compile_count(self):
-        """Distinct executables traced so far (jit cache sizes).  After
-        ``warmup`` this must not grow while traffic replays warmed
-        shapes — the serving /metrics counter asserts exactly that."""
-        count = 0
-        for fn in (self._call, self._batch_call):
-            if fn is not None:
-                try:
-                    count += fn._cache_size()
-                except Exception:  # mxlint: allow-broad-except(best-effort probe of a private jax internal; a degraded count beats failing a /metrics scrape)
-                    pass
-        return count
+        """Distinct executables traced so far (the executors' jit cache
+        sizes; AOT executions never appear — deserialization is not
+        compilation).  After ``warmup`` this must not grow while
+        traffic replays warmed shapes — the serving /metrics counter
+        asserts exactly that, and an all-AOT artifact keeps it at zero
+        from process start."""
+        return sum(ex.compile_count
+                   for ex in (self._call_ex, self._batch_call_ex)
+                   if ex is not None)
 
     def warmup(self, batch_sizes):
-        """Pre-compile one executable per batch size so no user request
-        pays a cold XLA compile (TPU: every shape is a fresh compile)."""
+        """Pre-build one executable per batch size so no user request
+        pays a cold XLA compile (TPU: every shape is a fresh compile).
+        AOT-covered sizes execute their deserialized executable once
+        (validation, not compilation)."""
         for n in batch_sizes:
             args = tuple(
                 jnp.zeros((int(n),) + tuple(ref[1:]), dtype)

@@ -1,0 +1,495 @@
+"""Unified Executor: the single compile choke point, and the cold-start
+caches stacked on top of it.
+
+The framework has four separately-grown compile surfaces — the Gluon
+``CachedOp`` (gluon/block.py), bulked eager segments (ops/bulking.py),
+the fused train step (fuse.py) and the deploy ``Predictor`` (deploy.py).
+Each used to wire the same three cross-cutting concerns by hand: the
+recompile sentinel's ``instrument``, graphlint's ``check_traced`` and
+memlint's ``check_memory``, plus its own ad-hoc trace-cache dict.  This
+module is the one place all of that lives now:
+
+* :class:`Executor` — wraps the python function a surface hands to
+  ``jax.jit``: sentinel instrumentation, donation/sharding options, and
+  the jit object itself, with a ``compile_count`` probe shared by the
+  serving metrics.  Creating an Executor is also the point where the
+  persistent compilation cache is switched on (below), so *every*
+  compile surface rides it without per-surface wiring.
+* :func:`run_analyses` — THE build-time graphlint/memlint wiring.  A
+  surface states its contract (donation, allowed-undonated positions,
+  ignored rules); the gating on ``MXNET_GRAPH_LINT`` /
+  ``MXNET_GRAPH_MEMLINT`` and the calls into the analysis passes happen
+  here, once.
+* :class:`TraceCache` — the shared trace-cache shape (lock, hit/miss
+  counters, stats) behind ``CachedOp._cache`` and the bulking segment
+  cache, so "did a steady-state loop retrace" is answerable uniformly.
+
+Cold-start persistence (ROADMAP item 2 — replica cold-start from
+minutes to seconds) stacks two layers on this choke point:
+
+* **Persistent XLA compilation cache** — ``MXNET_COMPILE_CACHE_DIR``
+  points JAX's compilation cache at a directory
+  (``jax_compilation_cache_dir``); a second process on the same host
+  (a serving replica spawn, an elastic worker join, a rolling reload)
+  skips XLA compilation for every graph the first process built.
+  Enabled at ONE init point (:func:`ensure_compile_cache`), called by
+  every Executor construction, with min-entry-size / min-compile-time
+  thresholds so tiny graphs don't churn the directory.
+* **AOT-serialized executables** — :func:`serialize_executable` /
+  :func:`deserialize_executable` wrap
+  ``jax.experimental.serialize_executable`` with a versioned
+  compatibility envelope (jax/jaxlib versions + platform), so deploy
+  artifacts can ship per-bucket *compiled* executables and a loader can
+  refuse — loudly, with a recompile fallback — a blob built by a
+  different toolchain instead of crashing inside an unpickler.
+
+Observability: a ``cold_start`` profiler stats provider reports time
+from process start to first executable build, per-site build counts,
+the persistent-cache configuration, and AOT load hits/failures.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import jax
+
+from .base import get_env
+
+__all__ = ["Executor", "TraceCache", "run_analyses", "lint_active",
+           "memlint_active", "ensure_compile_cache", "compile_cache_dir",
+           "serialize_executable", "deserialize_executable", "aot_compat",
+           "AOTCompatError", "record_aot_load", "process_uptime_ms",
+           "stats", "reset_stats"]
+
+_PROCESS_T0 = time.monotonic()
+
+_lock = threading.Lock()
+_state = {
+    "cache_init_done": False,
+    "cache_dir": None,
+    "first_build_ms": None,        # process start -> first Executor build
+    "aot_loads": 0,
+    "aot_load_failures": 0,
+    "analyses": 0,
+}
+_sites: dict[str, dict] = {}       # site -> {"executors": n, "built_ms": t}
+_provider_registered = False
+
+
+class AOTCompatError(RuntimeError):
+    """An AOT-serialized executable was built by an incompatible
+    toolchain (jax/jaxlib version or platform mismatch) or the blob is
+    malformed.  Loaders catch this and fall back to recompilation."""
+
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache — the one shared init point
+# ---------------------------------------------------------------------------
+
+def compile_cache_dir():
+    """The configured persistent-cache directory, or None (off)."""
+    d = get_env("MXNET_COMPILE_CACHE_DIR", "")
+    return d or None
+
+
+def ensure_compile_cache():
+    """Switch on JAX's persistent compilation cache if
+    ``MXNET_COMPILE_CACHE_DIR`` is set.  Idempotent and cheap after the
+    first call; every Executor construction routes through here, so any
+    process that compiles anything gets the cache without per-surface
+    wiring.  Returns the cache dir or None.
+
+    Thresholds (both default to "cache everything" because cold start
+    is what the cache exists to kill; raise them on hosts where the
+    cache directory competes with real data):
+
+    * ``MXNET_COMPILE_CACHE_MIN_ENTRY_BYTES`` — skip persisting
+      executables smaller than this.
+    * ``MXNET_COMPILE_CACHE_MIN_COMPILE_SECS`` — skip persisting
+      compilations faster than this.
+    """
+    with _lock:
+        if _state["cache_init_done"]:
+            return _state["cache_dir"]
+        _state["cache_init_done"] = True
+        d = compile_cache_dir()
+        if d is None:
+            return None
+        try:
+            jax.config.update("jax_compilation_cache_dir", d)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              get_env("MXNET_COMPILE_CACHE_MIN_ENTRY_BYTES",
+                                      0, int))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              get_env("MXNET_COMPILE_CACHE_MIN_COMPILE_SECS",
+                                      0.0, float))
+            # jax's cache module latches its enabled/disabled state at
+            # the first compile; anything compiled before this init
+            # (eager op dispatch during import) would leave it stuck
+            # disabled — drop the latch so the new dir takes effect
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception as e:  # mxlint: allow-broad-except(an unsupported jax config key must degrade to cold compiles, never break model building)
+            import warnings
+            # roll back any config that DID apply before the failure:
+            # the reported state (off) must match reality, not leave a
+            # half-enabled cache behind the "cold compiles" warning
+            try:
+                jax.config.update("jax_compilation_cache_dir", None)
+            except Exception:  # mxlint: allow-broad-except(rollback of a possibly-never-applied key; nothing further to do on failure)
+                pass
+            warnings.warn(
+                f"persistent compilation cache unavailable ({e}); "
+                "compiles will be cold in every process")
+            _state["cache_dir"] = None
+            return None
+        _state["cache_dir"] = d
+        return d
+
+
+def _reset_compile_cache_for_tests():
+    """Allow a test to re-run ensure_compile_cache with a fresh env."""
+    with _lock:
+        _state["cache_init_done"] = False
+        _state["cache_dir"] = None
+
+
+# ---------------------------------------------------------------------------
+# the choke point
+# ---------------------------------------------------------------------------
+
+def _ensure_provider():
+    global _provider_registered
+    if _provider_registered:
+        return
+    _provider_registered = True
+    from . import profiler
+    profiler.register_stats_provider("cold_start", stats)
+
+
+class Executor:
+    """One jitted entry point built through the unified choke point.
+
+    ``Executor(fn, site)`` is the replacement for a bare
+    ``jax.jit(_recompile.instrument(fn, site), ...)``: persistent-cache
+    init, sentinel instrumentation and the jit options live here; the
+    surface keeps only its calling convention.  ``executor.jfn`` is the
+    jitted callable; :attr:`compile_count` probes the jit executable
+    cache (the serving "must flatline after warmup" counter).
+    """
+
+    __slots__ = ("site", "fn", "jfn", "donate_argnums", "_built_at")
+
+    def __init__(self, fn, site, donate_argnums=(), in_shardings=None,
+                 static_argnums=None, static_argnames=None,
+                 instrument=True):
+        from .analysis import recompile as _recompile
+        ensure_compile_cache()
+        _ensure_provider()
+        self.site = site
+        self.fn = fn
+        self.donate_argnums = tuple(donate_argnums)
+        kwargs = {}
+        if self.donate_argnums:
+            kwargs["donate_argnums"] = self.donate_argnums
+        if in_shardings is not None:
+            kwargs["in_shardings"] = in_shardings
+        if static_argnums is not None:
+            kwargs["static_argnums"] = static_argnums
+        if static_argnames is not None:
+            kwargs["static_argnames"] = static_argnames
+        # instrument=False is for surfaces that detect their own cache
+        # misses and report a richer compile signature themselves (the
+        # bulking trace cache) via recompile.record_compile
+        wrapped = _recompile.instrument(fn, site) if instrument else fn
+        self.jfn = jax.jit(wrapped, **kwargs)  # mxlint: disable=MX-DONATE001(donation is threaded via kwargs — every Executor caller states its donate_argnums contract at construction, and () means caller-held inputs)
+        self._built_at = time.monotonic()
+        with _lock:
+            if _state["first_build_ms"] is None:
+                _state["first_build_ms"] = round(
+                    (self._built_at - _PROCESS_T0) * 1000.0, 3)
+            st = _sites.setdefault(site, {"executors": 0})
+            st["executors"] += 1
+            st["built_ms_after_start"] = round(
+                (self._built_at - _PROCESS_T0) * 1000.0, 3)
+
+    def __call__(self, *args, **kwargs):
+        return self.jfn(*args, **kwargs)
+
+    def lower(self, *args, **kwargs):
+        return self.jfn.lower(*args, **kwargs)
+
+    @property
+    def compile_count(self):
+        """Distinct executables this entry point compiled (jit cache
+        probe; AOT-loaded executables never appear here — that is the
+        point)."""
+        try:
+            return int(self.jfn._cache_size())
+        except Exception:  # mxlint: allow-broad-except(best-effort probe of a private jax internal; a degraded count beats failing a metrics scrape)
+            return 0
+
+    def analyze(self, args, graphlint=None, memlint=None):
+        """Run the build-time analyses over the *uninstrumented* fn with
+        this executor's donation contract pre-applied (a surface can
+        still override per-call)."""
+        gl = dict(graphlint) if graphlint is not None else None
+        ml = dict(memlint) if memlint is not None else None
+        if gl is not None:
+            gl.setdefault("donate_argnums", self.donate_argnums)
+        if ml is not None:
+            ml.setdefault("donate_argnums", self.donate_argnums)
+        return run_analyses(self.fn, args, name=self.site,
+                            graphlint=gl, memlint=ml)
+
+
+def lint_active():
+    """Whether build-time graphlint is on (``MXNET_GRAPH_LINT`` /
+    ``graphlint.set_lint_mode``) — for frontends that gate expensive
+    argument prep or manage an analyzed-once latch."""
+    from .analysis import graphlint
+    return graphlint.lint_mode() is not None
+
+
+def memlint_active():
+    """Whether build-time memlint is on (``MXNET_GRAPH_MEMLINT`` /
+    ``memlint.set_mem_mode``)."""
+    from .analysis import memlint
+    return memlint.mem_mode() is not None
+
+
+def run_analyses(fn, args, name, graphlint=None, memlint=None):
+    """THE graphlint/memlint build-time wiring (previously copied at
+    every compile surface).  ``graphlint``/``memlint`` are kwarg dicts
+    for :func:`analysis.graphlint.check_traced` /
+    :func:`analysis.memlint.check_memory` — pass ``None`` to skip a
+    pass entirely, ``{}`` for the defaults.  Inert (two cached env
+    reads) unless the respective mode is on.  Returns
+    ``(findings, mem_report)``.
+    """
+    findings = rep = None
+    if graphlint is not None:
+        from .analysis import graphlint as _graphlint
+        if _graphlint.lint_mode() is not None:
+            findings = _graphlint.check_traced(fn, args, name=name,
+                                               **graphlint)
+    if memlint is not None:
+        from .analysis import memlint as _memlint
+        if _memlint.mem_mode() is not None:
+            rep = _memlint.check_memory(fn, args, name=name, **memlint)
+    if findings is not None or rep is not None:
+        with _lock:
+            _state["analyses"] += 1
+    return findings, rep
+
+
+class TraceCache:
+    """Keyed executable cache with hit/miss accounting — the shared
+    shape behind CachedOp's per-signature cache and the bulking segment
+    cache.  Keys are the caller's business (op sequence / Block
+    signature / bucket + shapes/dtypes/statics); this class owns the
+    lock and the counters so cache behavior is observable uniformly."""
+
+    __slots__ = ("name", "_d", "_lock", "hits", "misses")
+
+    def __init__(self, name):
+        self.name = name
+        self._d: dict = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key):
+        with self._lock:
+            entry = self._d.get(key)
+            if entry is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+            return entry
+
+    def put(self, key, value):
+        with self._lock:
+            self._d[key] = value
+        return value
+
+    def get_or_create(self, key, factory):
+        """Atomic lookup-or-build: ``factory()`` runs under the cache
+        lock, so two threads racing on one key can never build (and
+        report to the sentinel) twice.  Returns ``(entry, hit)``."""
+        with self._lock:
+            entry = self._d.get(key)
+            if entry is not None:
+                self.hits += 1
+                return entry, True
+            self.misses += 1
+            entry = self._d[key] = factory()
+            return entry, False
+
+    def peek(self, key):
+        """Lookup without touching the hit/miss counters (re-checks
+        after a race, stats probes)."""
+        with self._lock:
+            return self._d.get(key)
+
+    def clear(self):
+        with self._lock:
+            n = len(self._d)
+            self._d.clear()
+        return n
+
+    def __len__(self):
+        with self._lock:
+            return len(self._d)
+
+    def stats(self):
+        with self._lock:
+            return {"entries": len(self._d), "hits": self.hits,
+                    "misses": self.misses}
+
+
+# ---------------------------------------------------------------------------
+# AOT executable serialization (versioned envelope over jax.experimental)
+# ---------------------------------------------------------------------------
+
+_AOT_MAGIC = b"MXTAOT1\n"
+
+
+def aot_compat():
+    """The compatibility claim stamped into (and checked against) every
+    AOT blob: serialized executables are jax/jaxlib/platform-exact."""
+    import jaxlib
+    backend = jax.default_backend()
+    return {"format": "mxtpu_aot_v1",
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "platform": backend}
+
+
+def serialize_executable(compiled):
+    """Envelope + payload for a ``jax.stages.Compiled`` (from
+    ``jax.jit(...).lower(...).compile()``).  The envelope is a JSON
+    header checked BEFORE the pickle payload is touched — an
+    incompatible or corrupted blob must be rejected by a version
+    string comparison, not by whatever an unpickler does with garbage.
+    """
+    from jax.experimental.serialize_executable import serialize
+    payload, in_tree, out_tree = serialize(compiled)
+    header = dict(aot_compat())
+    blob_header = json.dumps(header, sort_keys=True).encode()
+    import pickle
+    trees = pickle.dumps((in_tree, out_tree))
+    parts = [_AOT_MAGIC,
+             len(blob_header).to_bytes(8, "little"), blob_header,
+             len(trees).to_bytes(8, "little"), trees,
+             len(payload).to_bytes(8, "little"), payload]
+    return b"".join(parts)
+
+
+def deserialize_executable(blob, record=True):
+    """Load an AOT blob back into a callable executable.
+
+    Raises :class:`AOTCompatError` on any mismatch or corruption — the
+    caller's contract is to catch it, warn loudly, and recompile.  The
+    compat check runs before the pickle payload is deserialized.
+    ``record=False`` keeps the load out of the ``cold_start``
+    aot_loads/failure counters (export-time self-checks are
+    validation, not cold-start cache traffic)."""
+    try:
+        if not blob.startswith(_AOT_MAGIC):
+            raise AOTCompatError(
+                "not an mxtpu AOT executable (bad magic); the artifact "
+                "is corrupted or from an incompatible exporter")
+        off = len(_AOT_MAGIC)
+
+        def take(n):
+            nonlocal off
+            piece = blob[off:off + n]
+            if len(piece) != n:
+                raise AOTCompatError("truncated AOT executable blob")
+            off += n
+            return piece
+
+        hlen = int.from_bytes(take(8), "little")
+        header = json.loads(take(hlen).decode())
+        want = aot_compat()
+        mismatched = {k: (header.get(k), want[k]) for k in want
+                      if header.get(k) != want[k]}
+        if mismatched:
+            raise AOTCompatError(
+                "AOT executable was built by an incompatible toolchain: "
+                + "; ".join(f"{k}: artifact={a!r} runtime={b!r}"
+                            for k, (a, b) in sorted(mismatched.items()))
+                + " — falling back to recompilation is required")
+        import pickle
+        tlen = int.from_bytes(take(8), "little")
+        in_tree, out_tree = pickle.loads(take(tlen))
+        plen = int.from_bytes(take(8), "little")
+        payload = take(plen)
+        from jax.experimental.serialize_executable import \
+            deserialize_and_load
+        loaded = deserialize_and_load(payload, in_tree, out_tree)
+        if record:
+            record_aot_load(ok=True)
+        return loaded
+    except AOTCompatError:
+        if record:
+            record_aot_load(ok=False)
+        raise
+    except Exception as e:  # mxlint: allow-broad-except(any decode/unpickle failure of a foreign blob must surface as the typed compat error the fallback path catches)
+        if record:
+            record_aot_load(ok=False)
+        raise AOTCompatError(
+            f"AOT executable blob unusable ({type(e).__name__}: {e}); "
+            "falling back to recompilation is required") from e
+
+
+def record_aot_load(ok=True):
+    """Count an AOT executable load (success/failure) for the
+    ``cold_start`` stats provider and the serving gauges."""
+    _ensure_provider()
+    with _lock:
+        _state["aot_loads" if ok else "aot_load_failures"] += 1
+
+
+# ---------------------------------------------------------------------------
+# reporting
+# ---------------------------------------------------------------------------
+
+def process_uptime_ms():
+    return round((time.monotonic() - _PROCESS_T0) * 1000.0, 3)
+
+
+def stats():
+    """The ``cold_start`` profiler stats provider."""
+    with _lock:
+        # per-op eager sites (op:*) number in the hundreds — count them
+        # but keep the detail table to the structural surfaces
+        per_site = {k: dict(v) for k, v in _sites.items()
+                    if not k.startswith("op:")}
+        out = {
+            "process_uptime_ms": process_uptime_ms(),
+            "first_executor_build_ms": _state["first_build_ms"],
+            "persistent_cache_dir": _state["cache_dir"],
+            "aot_loads": _state["aot_loads"],
+            "aot_load_failures": _state["aot_load_failures"],
+            "analyses": _state["analyses"],
+            "sites": len(_sites),
+            "op_sites": sum(1 for k in _sites if k.startswith("op:")),
+            "per_site": per_site,
+        }
+    return out
+
+
+def reset_stats():
+    """Drop per-site state (tests).  The persistent-cache init latch is
+    deliberately kept — re-pointing a live process's cache dir is not a
+    supported operation (use _reset_compile_cache_for_tests)."""
+    with _lock:
+        _sites.clear()
+        _state["first_build_ms"] = None
+        _state["aot_loads"] = 0
+        _state["aot_load_failures"] = 0
+        _state["analyses"] = 0

@@ -17,7 +17,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .analysis import recompile as _recompile
+from . import executor_cache as _xc
 from .ndarray import NDArray
 
 __all__ = ["FusedTrainStep", "make_fused_train_step", "sgd_init", "adam_init"]
@@ -181,60 +181,52 @@ class FusedTrainStep:
             return new_params, new_aux, new_state, loss
 
         donate_argnums = (0, 1, 2) if donate else ()
-        # kept unjitted/uninstrumented for the build-time IR lint
-        # (check_traced at first call; its trace must not count as a
-        # sentinel compile)
-        self._raw_step = step
-        self._donate_argnums = donate_argnums
-        # recompile sentinel: a fused step should compile ONCE per batch
-        # shape — churn here (varying batch, a dtype flip) is the single
-        # most expensive recompile in the framework
-        step = _recompile.instrument(
-            step, f"fused_step:{type(self.block).__name__}")
+        # the unified choke point owns sentinel instrumentation + jit
+        # (the executor keeps the raw uninstrumented step as .fn for
+        # the build-time analyses — its lint trace must not count as a
+        # sentinel compile):
+        # a fused step should compile ONCE per batch shape — churn here
+        # (varying batch, a dtype flip) is the single most expensive
+        # recompile in the framework
+        in_shardings = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             bspec = NamedSharding(mesh, batch_spec or P("dp"))
-            return jax.jit(step, donate_argnums=donate_argnums,
-                           in_shardings=(None, None, None, bspec, bspec, None))
-        return jax.jit(step, donate_argnums=donate_argnums)
+            in_shardings = (None, None, None, bspec, bspec, None)
+        self._executor = _xc.Executor(
+            step, f"fused_step:{type(self.block).__name__}",
+            donate_argnums=donate_argnums, in_shardings=in_shardings)
+        return self._executor.jfn
 
     def __call__(self, x, y):
         xv = x.data if isinstance(x, NDArray) else x
         yv = y.data if isinstance(y, NDArray) else y
         self._key, sub = jax.random.split(self._key)
-        from .analysis import graphlint as _graphlint
-        if not self._lint_done and _graphlint.lint_mode() is not None:
-            # build-time IR lint of the whole train step
-            # (MXNET_GRAPH_LINT).  GL-DEAD001 is ignored here by
-            # documented scope limit: AD transposition leaves dead
-            # primal eqns in every value_and_grad trace.  An undonated
-            # step (donate=False) earns its GL-DONATE001 advisory.
-            # the latch only sets once a lint actually ran, so
-            # enabling the mode after the first step still lints
-            self._lint_done = True
-            _graphlint.check_traced(
-                self._raw_step,
-                (self.params, self.aux, self.opt_state, xv, yv, sub),
-                name=f"fused_step:{type(self.block).__name__}",
-                donate_argnums=self._donate_argnums,
-                check_donation=True,
-                config=_graphlint.Config(ignore={"GL-DEAD001"}))
-        from .analysis import memlint as _memlint
-        if not self._memlint_done and _memlint.mem_mode() is not None:
-            # memory plan of the same step (MXNET_GRAPH_MEMLINT): the
-            # fused step CONTRACTS to donate params/aux/optimizer state
-            # — an undonated build (donate=False) is an error-severity
-            # ML-DONATE001, and the per-site peak-HBM estimate +
-            # donated-bytes-reclaimed land in the memlint profiler
-            # provider.  Separate latch from the graphlint one so
-            # enabling either mode after step 1 still analyzes.
-            self._memlint_done = True
-            _memlint.check_memory(
-                self._raw_step,
-                (self.params, self.aux, self.opt_state, xv, yv, sub),
-                name=f"fused_step:{type(self.block).__name__}",
-                donate_argnums=self._donate_argnums,
-                require_donation=True)
+        if not (self._lint_done and self._memlint_done):
+            # build-time analyses of the whole train step through the
+            # unified choke point (MXNET_GRAPH_LINT/MXNET_GRAPH_MEMLINT).
+            # GL-DEAD001 is ignored by documented scope limit: AD
+            # transposition leaves dead primal eqns in every
+            # value_and_grad trace.  An undonated step (donate=False)
+            # earns its GL-DONATE001 advisory and is an error-severity
+            # ML-DONATE001 — the fused step CONTRACTS to donate
+            # params/aux/optimizer state.  Each latch only sets once
+            # its mode is on, so enabling either mode after step 1
+            # still analyzes.
+            from .analysis import graphlint as _graphlint
+            do_lint = not self._lint_done and _xc.lint_active()
+            do_mem = not self._memlint_done and _xc.memlint_active()
+            self._lint_done = self._lint_done or do_lint
+            self._memlint_done = self._memlint_done or do_mem
+            if do_lint or do_mem:
+                self._executor.analyze(
+                    (self.params, self.aux, self.opt_state, xv, yv, sub),
+                    graphlint=dict(
+                        check_donation=True,
+                        config=_graphlint.Config(ignore={"GL-DEAD001"}),
+                    ) if do_lint else None,
+                    memlint=dict(require_donation=True)
+                    if do_mem else None)
         self.params, self.aux, self.opt_state, loss = self._step_fn(
             self.params, self.aux, self.opt_state, xv, yv, sub)
         self._last = loss

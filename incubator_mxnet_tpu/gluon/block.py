@@ -29,8 +29,8 @@ import jax
 import numpy as onp
 
 from .. import autograd
+from .. import executor_cache as _xc
 from .. import random as _random
-from ..analysis import recompile as _recompile
 from ..context import current_context
 from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict, _TraceParams, \
@@ -298,7 +298,8 @@ class CachedOp:
         self.block = block
         self.static_alloc = static_alloc
         self.static_shape = static_shape
-        self._cache: dict = {}
+        self._site = f"cachedop:{type(block).__name__}"
+        self._cache = _xc.TraceCache(self._site)
 
     def _ordered_params(self):
         return list(self.block.collect_params().values())
@@ -321,15 +322,16 @@ class CachedOp:
                              for _, v in su)
             return out_vals, upd_vals
 
-        # recompile sentinel: one trace of `pure` == one XLA compile of
-        # this CachedOp; a varying input signature shows up as churn at
-        # this site (instrument is identity with the sentinel off).
-        # the uninstrumented fn is kept for the build-time IR lint,
-        # whose extra trace must not count as a compile
-        entry["pure"] = pure
-        pure = _recompile.instrument(
-            pure, f"cachedop:{type(self.block).__name__}")
-        entry["jfn"] = jax.jit(pure, donate_argnums=(1,) if self.static_alloc else ())
+        # the unified choke point (executor_cache.Executor) owns the
+        # sentinel instrumentation and the jit: one trace of `pure` ==
+        # one XLA compile of this CachedOp; a varying input signature
+        # shows up as churn at this site.  The uninstrumented fn rides
+        # on the executor for the build-time IR lint, whose extra trace
+        # must not count as a compile.
+        entry["executor"] = _xc.Executor(
+            pure, self._site,
+            donate_argnums=(1,) if self.static_alloc else ())
+        entry["jfn"] = entry["executor"].jfn
         return entry
 
     def __call__(self, *inputs):
@@ -347,36 +349,29 @@ class CachedOp:
         sig = (tuple((tuple(a.shape), str(a.dtype)) for a in raw_inputs),
                training,
                tuple((tuple(a.shape), str(a.dtype)) for a in raw_params))
-        entry = self._cache.get(sig)
-        if entry is None:
-            entry = self._build(sig, params, training)
-            self._cache[sig] = entry
-            # build-time IR lint (MXNET_GRAPH_LINT, inert by default):
-            # the exact pure fn this executable compiles, with the RNG
-            # key declared intentionally-unused (deterministic nets
-            # ignore it) and params no-donate unless static_alloc
-            from ..analysis import graphlint as _graphlint
-            if _graphlint.lint_mode() is not None:
-                _graphlint.check_traced(
-                    entry["pure"],
+        # atomic against concurrent first calls with the same signature:
+        # two threads must not double-build (and double-report to the
+        # sentinel) one executable
+        entry, hit = self._cache.get_or_create(
+            sig, lambda: self._build(sig, params, training))
+        if not hit:
+            # build-time analyses through the unified choke point
+            # (executor_cache.run_analyses; inert by default): the
+            # exact pure fn this executable compiles, with the RNG key
+            # declared intentionally-unused (deterministic nets ignore
+            # it).  static_alloc contracts to donate the input
+            # activations; without it the params and inputs are
+            # caller-held (allow_undonated), so memlint only records
+            # the peak-HBM estimate and lifetime stats.
+            if _xc.lint_active() or _xc.memlint_active():
+                entry["executor"].analyze(
                     (raw_params, raw_inputs, jax.random.PRNGKey(0)),
-                    name=f"cachedop:{type(self.block).__name__}",
-                    allow_unused_args=(2,),
-                    donate_argnums=(1,) if self.static_alloc else (),
-                    check_donation=self.static_alloc)
-            # memory plan (MXNET_GRAPH_MEMLINT): static_alloc contracts
-            # to donate the input activations; without it the params
-            # and inputs are caller-held (allow_undonated), so only the
-            # peak-HBM estimate and lifetime stats are recorded
-            from ..analysis import memlint as _memlint
-            if _memlint.mem_mode() is not None:
-                _memlint.check_memory(
-                    entry["pure"],
-                    (raw_params, raw_inputs, jax.random.PRNGKey(0)),
-                    name=f"cachedop:{type(self.block).__name__}",
-                    donate_argnums=(1,) if self.static_alloc else (),
-                    allow_undonated=(0,) if self.static_alloc else (0, 1),
-                    require_donation=self.static_alloc)
+                    graphlint=dict(allow_unused_args=(2,),
+                                   check_donation=self.static_alloc),
+                    memlint=dict(
+                        allow_undonated=(0,) if self.static_alloc
+                        else (0, 1),
+                        require_donation=self.static_alloc))
         jfn = entry["jfn"]
         key = _random.next_key()
 

@@ -253,8 +253,26 @@ class _State:
                                 max(self.beat_interval / 2.0, 0.01))
             self.cv.wait(remaining)
 
+    def check_initialized(self, key, what):
+        """A push/pull for a key no ``init`` ever stored is a worker
+        ordering/identity bug (classically: a leaked nonzero
+        MXT_WORKER_ID making every worker skip its rank-0 init), not a
+        transient — surface it typed and actionable instead of a bare
+        ``KeyError`` that reads like server corruption."""
+        if key not in self.store:
+            raise ValueError(
+                f"key {key!r} was never initialized on this server "
+                f"({len(self.store)} known key(s)); init() must precede "
+                f"{what} — if no worker ran init, check that rank 0 "
+                "really is rank 0 (a stale MXT_WORKER_ID makes every "
+                "worker skip its rank-0 init calls)")
+
     def apply_update(self, key, grad):
         if self.updater is not None:
+            # the server-side optimizer reads the stored weight; the
+            # no-updater sync branch below overwrites unconditionally
+            # (CopyFromTo semantics), so only this path needs init first
+            self.check_initialized(key, "push")
             w = self.store[key]
             self.updater(key, grad, w)   # in-place numpy update
         elif self.mode == "async":
@@ -378,6 +396,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 with st.lock:
                     st.sweep()
                     st.check_not_evicted(sess, "pulling")
+                    st.check_initialized(key, "pull")
                     return True, onp.array(st.store[key])
             # sync, bounded wait — a dead worker must surface, not hang
             # the fleet.  A puller that has pushed waits for the round
@@ -388,6 +407,11 @@ class _Handler(socketserver.BaseRequestHandler):
             # pulls).
             with st.cv:
                 st.check_not_evicted(sess, "pulling")
+                # fail FAST on a never-initialized key: the round wait
+                # below can never be satisfied for it, and burning the
+                # full sync timeout turns a deterministic client bug
+                # into a load-sensitive flake
+                st.check_initialized(key, "pull")
                 if target is not None:
                     done = st.wait_with_sweep(
                         lambda: st.round_done.get(key, 0) >= target,

@@ -41,6 +41,7 @@ import jax
 import numpy as _onp
 
 from ..base import get_env
+from .. import executor_cache as _xc
 from .. import profiler as _profiler
 from ..analysis import recompile as _recompile
 
@@ -53,8 +54,7 @@ NOT_DEFERRED = object()
 
 _tls = threading.local()
 
-_trace_cache: dict = {}
-_trace_lock = threading.Lock()
+_trace_cache = _xc.TraceCache("bulk:segment")
 
 
 _env_enabled: "bool | None" = None
@@ -401,12 +401,17 @@ def _flush_locked(seg: _Segment):
 
         key = (tuple(node_keys), tuple(keep_masks),
                tuple((a.shape, a.dtype) for a in ext))
-        with _trace_lock:
-            prog = _trace_cache.get(key)
-            hit = prog is not None
-            if not hit:
-                prog = jax.jit(_make_program(plan, keep_masks))  # mxlint: disable=MX-DONATE001(ext inputs are live NDArray chunk values the caller still reads; segment memory wins come from dropping dead outputs, not donating caller buffers)
-                _trace_cache[key] = prog
+        # through the unified choke point (executor_cache), atomically
+        # against concurrent flushes of the same structure;
+        # instrument=False because this cache detects its own misses
+        # and reports them below with the segment-structure signature.
+        # Ext inputs are live NDArray chunk values the caller still
+        # reads; segment memory wins come from dropping dead outputs,
+        # not donating caller buffers.
+        prog, hit = _trace_cache.get_or_create(
+            key, lambda: _xc.Executor(
+                _make_program(plan, keep_masks), "bulk:segment",
+                instrument=False).jfn)
         if not hit and _recompile.enabled() is not None:
             # the trace cache detects its own misses — report the
             # compile directly instead of wrapping the program.  The
@@ -428,23 +433,15 @@ def _flush_locked(seg: _Segment):
                 ("static", f"keep={keep_masks}"),
                 *(("arr", tuple(a.shape), str(a.dtype)) for a in ext)))
         if not hit:
-            # build-time IR lint of the fresh segment program
-            # (MXNET_GRAPH_LINT; inside the try, so a strict finding
-            # poisons the segment exactly like any other flush error)
-            from ..analysis import graphlint as _graphlint
-            if _graphlint.lint_mode() is not None:
-                _graphlint.check_traced(
-                    _make_program(plan, keep_masks), tuple(ext),
-                    name="bulk:segment")
-            # memory plan of the fresh program (MXNET_GRAPH_MEMLINT):
-            # peak-HBM estimate for the site stats.  Ext inputs are
-            # caller-held chunk values (allow_undonated)
-            from ..analysis import memlint as _memlint
-            if _memlint.mem_mode() is not None:
-                _memlint.check_memory(
-                    _make_program(plan, keep_masks), tuple(ext),
-                    name="bulk:segment",
-                    allow_undonated=tuple(range(len(ext))))
+            # build-time analyses of the fresh segment program through
+            # the unified choke point (MXNET_GRAPH_LINT /
+            # MXNET_GRAPH_MEMLINT; inside the try, so a strict finding
+            # poisons the segment exactly like any other flush error).
+            # Ext inputs are caller-held chunk values (allow_undonated)
+            _xc.run_analyses(
+                _make_program(plan, keep_masks), tuple(ext),
+                name="bulk:segment", graphlint={},
+                memlint=dict(allow_undonated=tuple(range(len(ext)))))
 
         flat = prog(*ext)
     except Exception as e:  # sticky, like the engine's var exceptions —
@@ -522,12 +519,8 @@ def _make_program(plan, keep_masks=None):
 
 def clear_trace_cache():
     """Drop every cached segment program (registry.clear_caches hook)."""
-    with _trace_lock:
-        n = len(_trace_cache)
-        _trace_cache.clear()
-    return n
+    return _trace_cache.clear()
 
 
 def trace_cache_stats():
-    with _trace_lock:
-        return {"entries": len(_trace_cache)}
+    return _trace_cache.stats()

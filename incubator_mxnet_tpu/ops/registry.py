@@ -24,7 +24,6 @@ import jax
 import numpy as _onp
 
 from .. import profiler as _profiler
-from ..analysis import recompile as _recompile
 from . import bulking as _bulking
 
 __all__ = ["Op", "register", "get_op", "list_ops", "invoke",
@@ -92,14 +91,20 @@ class Op:
             return self.fn
         jfn = self._jit_cache.get(kwarg_names)
         if jfn is None:
-            # recompile sentinel: wraps the fn handed to jit, so the
-            # wrapper body runs only while jax traces — one execution ==
-            # one XLA compile.  instrument() is identity when the
-            # sentinel is off, and this path runs once per (op,
-            # kwarg-name set), never per call.
-            fn = _recompile.instrument(self.fn, f"op:{self.name}")
-            jfn = jax.jit(fn, static_argnames=kwarg_names)  # mxlint: disable=MX-DONATE001(eager-path inputs are live NDArray chunk values the caller reads after the op; in-place NDArray ops reuse buffers via Array.at donation inside XLA instead)
-            self._jit_cache[kwarg_names] = jfn
+            # per-op jits ride the unified choke point too (sentinel
+            # site op:{name} via Executor's instrument, persistent
+            # compile cache init): eager dispatch is usually the FIRST
+            # thing a process compiles, and it must hit
+            # MXNET_COMPILE_CACHE_DIR like every other surface.  This
+            # path runs once per (op, kwarg-name set), never per call.
+            # Eager-path inputs are live NDArray chunk values the
+            # caller reads after the op, so nothing is donated
+            # (in-place NDArray ops reuse buffers via Array.at inside
+            # XLA instead).
+            from .. import executor_cache as _xc
+            jfn = self._jit_cache[kwarg_names] = _xc.Executor(
+                self.fn, f"op:{self.name}",
+                static_argnames=kwarg_names).jfn
         return jfn
 
     def __call__(self, *arrays, **kwargs):

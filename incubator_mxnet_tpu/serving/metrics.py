@@ -122,6 +122,10 @@ class ServingMetrics:
         self._compile_count_fn = None
         self._queue_depth_fn = None
         self._memory_fn = None
+        # cold-start observability (ROADMAP item 2): per-model load →
+        # ready duration, process-start → ready, and AOT-executable
+        # load outcomes, recorded by ModelRepository._build_entry
+        self._cold_start: dict[str, dict] = {}
 
     def attach_repository(self, repository):
         """Wire gauges that live in the repository (compile counts per
@@ -161,6 +165,30 @@ class ServingMetrics:
             m.padded_rows += max(0, padded_to - batch_size)
         m.batch_hist.observe(batch_size)
 
+    def record_cold_start(self, model, cold_start_ms, aot_loads=0,
+                          aot_load_failures=0, compile_count=0):
+        """One model version reached ready: how long load + warmup
+        took, when after process start it happened, and whether the
+        AOT executables carried it (``compile_count`` 0 with nonzero
+        ``aot_loads`` = cold start was deserialization, not
+        compilation)."""
+        from .. import executor_cache as _xc
+        with self._lock:
+            prev = self._cold_start.get(model, {})
+            self._cold_start[model] = {
+                # gauges: the LIVE version's load cost
+                "cold_start_ms": round(float(cold_start_ms), 3),
+                "time_to_ready_ms": _xc.process_uptime_ms(),
+                "compile_count_at_ready": int(compile_count),
+                # counters: monotonic across reloads — a v2 exported
+                # without AOT must not make the Prometheus series drop
+                # (a decrease reads as a counter reset and fabricates
+                # rate() deltas)
+                "aot_loads": prev.get("aot_loads", 0) + int(aot_loads),
+                "aot_load_failures": (prev.get("aot_load_failures", 0)
+                                      + int(aot_load_failures)),
+            }
+
     # -- exposition ---------------------------------------------------
 
     def compile_count(self):
@@ -183,6 +211,34 @@ class ServingMetrics:
         for model, n in sorted(compiles.items()):
             L.append(f'mxnet_serving_compile_total'
                      f'{{model="{_esc(model)}"}} {n}')
+        with self._lock:
+            cold = {k: dict(v) for k, v in self._cold_start.items()}
+        L.append("# HELP mxnet_serving_cold_start_ms Load + warmup "
+                 "duration of the live model version.")
+        L.append("# TYPE mxnet_serving_cold_start_ms gauge")
+        for model, c in sorted(cold.items()):
+            L.append(f'mxnet_serving_cold_start_ms'
+                     f'{{model="{_esc(model)}"}} {c["cold_start_ms"]}')
+        L.append("# HELP mxnet_serving_time_to_ready_ms Process start "
+                 "to model ready.")
+        L.append("# TYPE mxnet_serving_time_to_ready_ms gauge")
+        for model, c in sorted(cold.items()):
+            L.append(f'mxnet_serving_time_to_ready_ms'
+                     f'{{model="{_esc(model)}"}} {c["time_to_ready_ms"]}')
+        L.append("# HELP mxnet_serving_aot_loads_total AOT executables "
+                 "deserialized per model (cache hits that skipped XLA).")
+        L.append("# TYPE mxnet_serving_aot_loads_total counter")
+        for model, c in sorted(cold.items()):
+            L.append(f'mxnet_serving_aot_loads_total'
+                     f'{{model="{_esc(model)}"}} {c["aot_loads"]}')
+        L.append("# HELP mxnet_serving_aot_load_failures_total AOT "
+                 "blobs refused (compat mismatch/corruption) per model "
+                 "— each one recompiled instead.")
+        L.append("# TYPE mxnet_serving_aot_load_failures_total counter")
+        for model, c in sorted(cold.items()):
+            L.append(f'mxnet_serving_aot_load_failures_total'
+                     f'{{model="{_esc(model)}"}} '
+                     f'{c["aot_load_failures"]}')
         depths = (self._queue_depth_fn() if self._queue_depth_fn else {})
         L.append("# HELP mxnet_serving_queue_depth In-flight + queued "
                  "requests per model.")
@@ -263,6 +319,12 @@ class ServingMetrics:
         out = {"compile_total": self.compile_count()}
         if self._queue_depth_fn is not None:
             out["queue_depth"] = sum(self._queue_depth_fn().values())
+        with self._lock:
+            for name, c in self._cold_start.items():
+                out[f"{name}.cold_start_ms"] = c["cold_start_ms"]
+                out[f"{name}.time_to_ready_ms"] = c["time_to_ready_ms"]
+                out[f"{name}.aot_loads"] = c["aot_loads"]
+                out[f"{name}.aot_load_failures"] = c["aot_load_failures"]
         if self._memory_fn is not None:
             for name, m in self._memory_fn().items():
                 if m.get("peak_hbm_bytes") is not None:

@@ -34,7 +34,8 @@ __all__ = ["ModelRepository", "ModelEntry"]
 class ModelEntry:
     """One live (name, version) binding: predictor + its batcher."""
 
-    __slots__ = ("name", "version", "path", "predictor", "batcher")
+    __slots__ = ("name", "version", "path", "predictor", "batcher",
+                 "cold_start_ms")
 
     def __init__(self, name, version, path, predictor, batcher):
         self.name = name
@@ -42,6 +43,7 @@ class ModelEntry:
         self.path = path
         self.predictor = predictor
         self.batcher = batcher
+        self.cold_start_ms = None      # set once load + warmup finishes
 
     def describe(self):
         return {
@@ -50,6 +52,9 @@ class ModelEntry:
             "buckets": list(self.batcher.buckets),
             "max_batch": self.batcher.max_batch,
             "batch_polymorphic": self.predictor.batch_polymorphic,
+            "cold_start_ms": self.cold_start_ms,
+            "aot_buckets": self.predictor.aot_buckets,
+            "aot_load_failures": self.predictor.aot_load_failures,
             "compile_count": self.predictor.compile_count,
             "queue_depth": self.batcher.depth,
             "inputs": self.predictor.meta["inputs"],
@@ -129,7 +134,9 @@ class ModelRepository:
             return sorted(self._loading)
 
     def _build_entry(self, name, path, version, warmup):
+        import time
         from ..deploy import load_predictor
+        t0 = time.monotonic()
         predictor = load_predictor(path)
         # the artifact carries its export-time IR bill of health
         # (deploy._export_graphlint, docs/graph_analysis.md); the
@@ -154,6 +161,17 @@ class ModelRepository:
                 # through its closure the predictor's weights)
                 entry.batcher.drain()
                 raise
+        # cold start = load (deserialize weights/graph + AOT blobs) +
+        # warmup (executes every bucket); with a full AOT bucket set
+        # this is deserialization, not compilation, and compile_count
+        # at ready is 0 from process start
+        entry.cold_start_ms = round((time.monotonic() - t0) * 1000.0, 3)
+        if self.metrics is not None:
+            self.metrics.record_cold_start(
+                name, entry.cold_start_ms,
+                aot_loads=len(entry.predictor.aot_buckets),
+                aot_load_failures=entry.predictor.aot_load_failures,
+                compile_count=entry.predictor.compile_count)
         return entry
 
     def warmup_entry(self, entry, bucket_sizes=None):

@@ -64,11 +64,18 @@ def health_body(repository, t_start=None):
             "version": d["version"],
             "queue_depth": d["queue_depth"],
             "compile_count": d["compile_count"],
+            # how expensive this replica's readiness was, and whether
+            # the AOT artifact layer carried it (compile_count 0 with
+            # aot_buckets = cold start was deserialization) — the
+            # numbers an autoscaler sizes spawn lead time from
+            "cold_start_ms": d["cold_start_ms"],
+            "aot_buckets": d["aot_buckets"],
         }
     for name in repository.loading_names():
         if name not in models:
             models[name] = {"state": "loading", "version": None,
-                            "queue_depth": 0, "compile_count": None}
+                            "queue_depth": 0, "compile_count": None,
+                            "cold_start_ms": None, "aot_buckets": []}
     body = {
         "status": "draining" if draining else "ok",
         "uptime_s": (round(time.monotonic() - t_start, 3)

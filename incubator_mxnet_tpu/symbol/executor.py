@@ -15,7 +15,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..analysis import recompile as _recompile
 from ..context import Context
 from ..ndarray import NDArray
 
@@ -84,10 +83,14 @@ class Executor:
 
         # group-placed executors run eagerly: device_put-committed
         # arrays can't mix inside one jit computation, and the legacy
-        # group2ctx path is op-by-op in the reference anyway
-        self._jit_infer = fwd_infer if g2c else jax.jit(
-            _recompile.instrument(fwd_infer,  # mxlint: disable=MX-DONATE001(arg/aux arrays are the executor's bound state, read back via arg_dict across forwards — donation would delete them under the binding)
-                                  f"executor:{symbol.name}"))
+        # group2ctx path is op-by-op in the reference anyway.  The jit
+        # goes through the unified choke point (sentinel site
+        # executor:{name}, persistent compile cache); arg/aux arrays
+        # are the executor's bound state, read back via arg_dict across
+        # forwards — donation would delete them under the binding.
+        from .. import executor_cache as _xc
+        self._jit_infer = fwd_infer if g2c else _xc.Executor(
+            fwd_infer, f"executor:{symbol.name}").jfn
         self._fwd_train = fwd_train
 
     def forward(self, is_train=False, **kwargs):

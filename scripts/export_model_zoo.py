@@ -31,6 +31,11 @@ def main(argv=None):
     p.add_argument("--batch", type=int, default=1,
                    help="traced batch size of the static export (any "
                         "batch serves via the polymorphic twin)")
+    p.add_argument("--aot-buckets", default=None, metavar="N,N,...",
+                   help="also ship per-bucket AOT compiled executables "
+                        "({out}.aot.b{n}) so a loading process "
+                        "deserializes instead of compiling; defaults "
+                        "to MXNET_EXPORT_AOT_BUCKETS")
     args = p.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -42,10 +47,13 @@ def main(argv=None):
     x = nd.random.uniform(
         shape=(args.batch, 3, args.image_size, args.image_size))
     net(x)   # materialize deferred-shape parameters
-    meta = deploy.export_model(net, (x,), args.out)
+    aot = ([int(b) for b in args.aot_buckets.split(",") if b.strip()]
+           if args.aot_buckets else None)
+    meta = deploy.export_model(net, (x,), args.out, aot_buckets=aot)
     print(f"[export_model_zoo] {args.model} -> {args.out} "
           f"inputs={meta['inputs']} outputs={meta['outputs']} "
-          f"batch_export={meta['batch_export']}", flush=True)
+          f"batch_export={meta['batch_export']} "
+          f"aot={(meta.get('aot') or {}).get('buckets')}", flush=True)
     return 0
 
 

@@ -511,11 +511,24 @@ def test_mpi_rank_derivation(monkeypatch):
     monkeypatch.delenv("MXT_WORKER_ID", raising=False)
     monkeypatch.delenv("MXT_SERVERS", raising=False)
     monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
-    mx_pkg._join_distributed_from_env()
-    assert calls == {"coordinator_address": "10.0.0.1:9009",
-                     "num_processes": 4, "process_id": 3}
-    # no rank variable at all -> loud failure, not a silent id=0 join
-    monkeypatch.delenv("OMPI_COMM_WORLD_RANK")
-    monkeypatch.delenv("MXT_WORKER_ID", raising=False)
-    with pytest.raises(RuntimeError, match="no MPI rank"):
+    try:
         mx_pkg._join_distributed_from_env()
+        assert calls == {"coordinator_address": "10.0.0.1:9009",
+                         "num_processes": 4, "process_id": 3}
+        # no rank variable at all -> loud failure, not a silent id=0 join
+        monkeypatch.delenv("OMPI_COMM_WORLD_RANK")
+        # drop the derived id directly (NOT via monkeypatch.delenv: it
+        # would snapshot the leaked value and write it back at teardown)
+        os.environ.pop("MXT_WORKER_ID", None)
+        with pytest.raises(RuntimeError, match="no MPI rank"):
+            mx_pkg._join_distributed_from_env()
+    finally:
+        # _join_distributed_from_env SETS MXT_WORKER_ID as a side
+        # effect, outside monkeypatch's bookkeeping.  A delenv here
+        # would record the leaked "3" and RESTORE it at teardown —
+        # every later dist_sync kvstore in the suite would then think
+        # it is rank 3, skip its rank-0 init()s, and the first push
+        # would die with the server's uninitialized-key error (the
+        # "KeyError: 0 under full-suite load" flake).  Pop it for real.
+        os.environ.pop("MXT_WORKER_ID", None)
+    assert "MXT_WORKER_ID" not in os.environ

@@ -293,6 +293,33 @@ def test_eviction_is_deterministic_after_missed_beat_budget(fast_beats):
     c1.call("stop")
 
 
+def test_uninitialized_key_fails_fast_and_typed():
+    """A push/pull for a key no init() ever stored must fail FAST with
+    an actionable message — not a bare ``KeyError: 0`` (the historical
+    symptom of a leaked MXT_WORKER_ID making rank-0 init never run),
+    and never by burning the full sync round timeout."""
+    from incubator_mxnet_tpu.base import MXNetError
+    srv = _start_server("sync", num_workers=1)
+    c = PSClient("127.0.0.1", srv.port)
+    c.call("set_optimizer", None, __import__("pickle").dumps(
+        mx.optimizer.create("sgd", learning_rate=0.1)))
+    t0 = time.monotonic()
+    with pytest.raises(MXNetError, match="never initialized.*init"):
+        c.call("push", 0, onp.ones(3, onp.float32))
+    with pytest.raises(MXNetError, match="never initialized.*init"):
+        c.call("pull", 0)
+    # deterministic: both surface immediately, not after the bounded
+    # sync wait (MXNET_KVSTORE_TIMEOUT-scale) that made this
+    # load-sensitive
+    assert time.monotonic() - t0 < 5.0
+    # a properly initialized key still round-trips
+    c.call("init", 0, onp.zeros(3, onp.float32))
+    c.call("push", 0, onp.ones(3, onp.float32))
+    onp.testing.assert_array_equal(
+        onp.asarray(c.call("pull", 0)), onp.full(3, -0.1, onp.float32))
+    c.call("stop")
+
+
 def test_sync_round_rebalances_when_worker_dies_mid_wait(fast_beats):
     """Survivors blocked in a sync pull are released when the missing
     worker's eviction completes the round — within the heartbeat

@@ -471,19 +471,26 @@ def test_healthz_structured_state_json_shape(artifact):
                              "models"}
         assert set(body["models"]["mlp"]) == {"state", "version",
                                               "queue_depth",
-                                              "compile_count"}
+                                              "compile_count",
+                                              "cold_start_ms",
+                                              "aot_buckets"}
         assert body["status"] == "ok"
         assert body["queue_depth"] == 0
-        assert body["models"]["mlp"] == {
+        m = dict(body["models"]["mlp"])
+        # load+warmup duration: present and positive for a ready model
+        assert m.pop("cold_start_ms") > 0
+        assert m == {
             "state": "ready", "version": 1, "queue_depth": 0,
-            "compile_count": repo.compile_counts()["mlp"]}
+            "compile_count": repo.compile_counts()["mlp"],
+            "aot_buckets": []}
         # a model mid-build reports `loading` (not absent, not ready)
         with repo._loading_state("incoming"):
             assert repo.loading_names() == ["incoming"]
             _, b2 = health_body(repo, time.monotonic())
             assert b2["models"]["incoming"] == {
                 "state": "loading", "version": None,
-                "queue_depth": 0, "compile_count": None}
+                "queue_depth": 0, "compile_count": None,
+                "cold_start_ms": None, "aot_buckets": []}
         _, b3 = health_body(repo, time.monotonic())
         assert "incoming" not in b3["models"]
         # draining flips status, the code, and every model's state
