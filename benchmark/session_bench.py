@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Session benchmark: continuous batching vs sequential decode.
+
+Measures what stateful sessions + continuous batching exist to
+deliver — decode-step throughput when many sessions stream at once —
+and emits a BENCH-style JSON record like serving_bench's:
+
+  sequential  one session at a time stepped to completion through the
+              SessionManager (batch is always 1 — what a
+              session-per-connection server without continuous
+              batching does)
+  continuous  the same total decode steps, but all --sessions stream
+              CONCURRENTLY: every decode step serves up to a full
+              bucket of sessions in one device launch
+
+Also proves, inside the bench run:
+
+  parity        every concurrent stream is bitwise-equal to its
+                sequential twin (continuous batching is invisible)
+  compile flat  a join/leave churn phase moves
+                ``mxnet_serving_compile_total`` by ZERO — decode
+                steps never compile after warmup (the PR 10 bucket
+                set is the whole compile universe)
+  crash smoke   one session restores from its CRC'd snapshot and
+                continues bitwise (the migration contract's local
+                half)
+
+``--check`` gates: speedup >= --floor (default 1.5x — typical is
+~2.1x on a 1-core host with snapshots on, ~3.2x without snapshot IO),
+parity, compile flatline, crash smoke — the ``sessions`` CI stage
+runs it.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as onp   # noqa: E402
+
+
+def _mgr(args, tmp_dir=None, warmup=True):
+    from incubator_mxnet_tpu.serving.sessions import (SessionManager,
+                                                      toy_decoder)
+    model = toy_decoder(dim=args.dim, max_len=max(64, args.steps + 4),
+                        seed=0)
+    return SessionManager(
+        "bench", model, buckets=args.buckets,
+        snapshot_dir=tmp_dir, snapshot_steps=args.snapshot_steps,
+        ttl_s=600.0, max_sessions=4 * args.sessions, warmup=warmup)
+
+
+def _x(i, dim):
+    return (onp.full(dim, 0.05 * (i + 1), onp.float32),)
+
+
+def bench(args):
+    import shutil
+    import tempfile
+
+    tmp_dir = tempfile.mkdtemp(prefix="session_bench_")
+    try:
+        return _bench(args, tmp_dir)
+    finally:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+
+
+def _bench(args, tmp_dir):
+    n, steps = args.sessions, args.steps
+
+    # -- sequential baseline: one stream at a time (batch == 1) ------
+    # same snapshot config as the continuous phase: both pay the
+    # crash-safety tax, so the ratio isolates BATCHING
+    mgr_seq = _mgr(args, tmp_dir=os.path.join(tmp_dir, "seq"))
+    seq_outs = {}
+    t0 = time.monotonic()
+    for i in range(n):
+        mgr_seq.create(f"s{i}")
+        chunks, _ = mgr_seq.step(f"s{i}", _x(i, args.dim),
+                                 steps=steps)
+        seq_outs[i] = [onp.asarray(c[0]) for c in chunks]
+    seq_s = time.monotonic() - t0
+    mgr_seq.batcher.drain()
+
+    # -- continuous: all sessions stream at once ----------------------
+    mgr = _mgr(args, tmp_dir=os.path.join(tmp_dir, "conc"))
+    compile_before = mgr.model.compile_count
+    conc_outs = {}
+    errors = []
+
+    def run(i):
+        try:
+            mgr.create(f"c{i}")
+            chunks, _ = mgr.step(f"c{i}", _x(i, args.dim),
+                                 steps=steps)
+            conc_outs[i] = [onp.asarray(c[0]) for c in chunks]
+        except Exception as e:  # mxlint: allow-broad-except(bench harness: every failure is recorded into the record's errors list, which fails --check)
+            errors.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(n)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    conc_s = time.monotonic() - t0
+
+    parity = not errors and all(
+        (conc_outs[i][k] == seq_outs[i][k]).all()
+        for i in range(n) for k in range(steps))
+
+    # -- churn: join/leave must not compile ---------------------------
+    def churn(j):
+        for k in range(6):
+            sid = f"churn{j}-{k}"
+            mgr.create(sid)
+            mgr.step(sid, _x(j + k, args.dim), steps=1 + (k % 3))
+            mgr.close(sid)
+
+    threads = [threading.Thread(target=churn, args=(j,))
+               for j in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    compile_after = mgr.model.compile_count
+    compile_stable = compile_after == compile_before
+
+    # -- crash smoke: snapshot -> restore -> bitwise continuation -----
+    mgr.create("crash")
+    chunks_a, ta = mgr.step("crash", _x(99, args.dim),
+                            steps=args.snapshot_steps + 2)
+    mgr.drain()    # snapshot-on-drain makes the restore lossless
+    mgr2 = _mgr(args, tmp_dir=os.path.join(tmp_dir, "conc"),
+                warmup=False)
+    try:
+        d = mgr2.restore("crash")
+        cont, _ = mgr2.step("crash", _x(99, args.dim), steps=3)
+        mgr_ref = _mgr(args, warmup=False)
+        mgr_ref.create("ref")
+        ref, _ = mgr_ref.step("ref", _x(99, args.dim),
+                              steps=d["steps"] + 3)
+        crash_smoke = all(
+            (onp.asarray(a[0]) == onp.asarray(b[0])).all()
+            for a, b in zip(cont, ref[d["steps"]:]))
+        mgr_ref.batcher.drain()
+    except Exception as e:  # mxlint: allow-broad-except(bench harness: every failure is recorded into the record's errors list, which fails --check)
+        errors.append(f"crash_smoke: {type(e).__name__}: {e}")
+        crash_smoke = False
+    finally:
+        mgr2.batcher.drain()
+
+    total_steps = n * steps
+    speedup = seq_s / conc_s if conc_s > 0 else 0.0
+    record = {
+        "bench": "session_continuous_batching",
+        "metric": "continuous_vs_sequential_speedup_x",
+        "value": round(speedup, 2),
+        "sessions": n,
+        "steps_per_session": steps,
+        "buckets": list(args.buckets),
+        "sequential_steps_per_s": round(total_steps / seq_s, 1),
+        "continuous_steps_per_s": round(total_steps / conc_s, 1),
+        "parity_bitwise": bool(parity),
+        "compile_total": compile_after,
+        "compile_stable_across_join_leave": bool(compile_stable),
+        "crash_smoke_bitwise": bool(crash_smoke),
+        "errors": errors,
+        "floor": args.floor,
+        "platform": "cpu",
+    }
+    return record
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="continuous-batching session benchmark")
+    p.add_argument("--sessions", type=int, default=16)
+    p.add_argument("--steps", type=int, default=32)
+    p.add_argument("--dim", type=int, default=32)
+    p.add_argument("--buckets", default="1,2,4,8,16")
+    p.add_argument("--snapshot-steps", type=int, default=16,
+                   help="periodic snapshot period (the manager's "
+                        "default); both phases pay it")
+    p.add_argument("--floor", type=float, default=1.5,
+                   help="--check fails unless continuous >= floor x "
+                        "sequential (typical ~2.1x on a 1-core host "
+                        "with snapshots on; ~3.2x without snapshot "
+                        "IO — the floor leaves room for CI noise)")
+    p.add_argument("--check", action="store_true")
+    p.add_argument("--output", default=None)
+    args = p.parse_args(argv)
+    args.buckets = [int(v) for v in args.buckets.split(",")]
+
+    record = bench(args)
+    line = json.dumps(record)
+    print(line, flush=True)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(line + "\n")
+
+    if args.check:
+        problems = []
+        if record["errors"]:
+            problems.append(f"errors: {record['errors']}")
+        if not record["parity_bitwise"]:
+            problems.append("continuous outputs != sequential outputs")
+        if not record["compile_stable_across_join_leave"]:
+            problems.append(
+                "session join/leave cost an XLA compile "
+                f"(compile_total {record['compile_total']})")
+        if not record["crash_smoke_bitwise"]:
+            problems.append("snapshot-restore continuation diverged")
+        if record["value"] < args.floor:
+            problems.append(
+                f"speedup {record['value']}x under the "
+                f"{args.floor}x floor")
+        if problems:
+            print("session_bench --check FAILED:\n  - "
+                  + "\n  - ".join(problems), file=sys.stderr)
+            return 1
+        print(f"session_bench --check ok: {record['value']}x, "
+              f"parity={record['parity_bitwise']}, "
+              f"compiles flat at {record['compile_total']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -49,6 +49,15 @@ Stages (each isolated, failures collected, nonzero exit if any fail):
              load with capacity never below N-1, subprocess-backend
              SIGKILL end-to-end; plus the --replicas scaling bench
              with its 2-replica >= 1.6x floor (multicore hosts)
+  sessions   stateful-session chaos sweep under its own pinned seeded
+             spec (decode-step faults, snapshot faults, replica-side
+             faults, route delays): continuous-batching bitwise
+             parity, SIGKILL-a-replica-mid-stream with sessions
+             resuming bitwise from their CRC'd snapshots or failing
+             typed (never a hang, never a silent restart); then
+             session_bench --check enforces its continuous-vs-
+             sequential floor with the compile count flat across
+             session join/leave
 
   lint       mxlint (docs/static_analysis.md) over the python surface:
              framework-invariant rules (env-var/docs sync, fault-point
@@ -289,6 +298,52 @@ def stage_fleet(args):
                   f"errors={rec['failed_requests']}")
 
 
+# Pinned session-chaos spec: transient faults on the decode step
+# (retried inside the continuous batcher), failed snapshot writes
+# (counted, never fatal — migrations re-base on whatever landed),
+# replica-side faults (absorbed by the router's owner-retry), and
+# jittered routing.  Seeded like the other specs so a failure replays
+# from the spec string alone.
+SESSIONS_SPEC = ("serving.session_step:error:p=0.05:seed=23,"
+                 "serving.session_snapshot:error:p=0.1:seed=29,"
+                 "serving.replica_exec:error:p=0.05:seed=17,"
+                 "serving.route:delay:ms=1:p=0.25:seed=3")
+
+
+def stage_sessions(args):
+    """Stateful-session sweep (docs/serving.md "Sessions"): the whole
+    session battery — continuous-batching parity, TTL/cap eviction,
+    snapshot/restore bitwise continuation, subprocess SIGKILL
+    mid-stream with migration-or-typed-loss — under the pinned seeded
+    spec; then the continuous-batching bench with its floor and the
+    compile-flatline gate."""
+    proc = sh([sys.executable, "-m", "pytest", "-q",
+               "tests/test_sessions.py", "tests/test_session_fleet.py",
+               "--continue-on-collection-errors",
+               "-p", "no:cacheprovider"],
+              timeout=1800, env={"MXNET_FAULT_SPEC": SESSIONS_SPEC,
+                                 "MXNET_SERVING_RETRIES": "6"})
+    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+    if proc.returncode != 0:
+        return False, f"spec={SESSIONS_SPEC!r}: {tail}"
+    out = os.path.join(REPO, ".ci_session_bench.json")
+    try:
+        proc2 = sh([sys.executable, "benchmark/session_bench.py",
+                    "--check", "--output", out], timeout=900)
+        if proc2.returncode != 0:
+            return False, (proc2.stderr or proc2.stdout).strip()[-300:]
+        with open(out) as f:
+            rec = json.load(f)
+    finally:
+        if os.path.exists(out):
+            os.remove(out)
+    return True, (f"spec ok: {tail}; continuous {rec['value']}x "
+                  f"(floor {rec['floor']}), parity="
+                  f"{rec['parity_bitwise']}, compiles flat at "
+                  f"{rec['compile_total']}, crash smoke "
+                  f"{rec['crash_smoke_bitwise']}")
+
+
 def stage_serving(args):
     """Serving smoke (docs/serving.md): HTTP end-to-end against a real
     gluon model_zoo artifact — warmup, concurrent requests, /metrics
@@ -473,6 +528,7 @@ STAGES = {"build": stage_build, "sanity": stage_sanity,
           "bulking": stage_bulking, "chaos": stage_chaos,
           "elastic": stage_elastic,
           "serving": stage_serving, "fleet": stage_fleet,
+          "sessions": stage_sessions,
           "coldstart": stage_coldstart,
           "race": stage_race,
           "graphlint": stage_graphlint,

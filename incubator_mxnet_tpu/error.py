@@ -16,6 +16,7 @@ __all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
            "PSTimeoutError", "PSConnectionError", "CheckpointCorruptError",
            "CheckpointWriteError", "WorkerEvictedError", "ReshardError",
            "ReplicaUnavailableError", "FleetDrainingError",
+           "SessionExpiredError", "SessionLostError",
            "EngineRaceError", "RecompileStormError", "GraphLintError",
            "register_error", "get_error_class"]
 
@@ -134,6 +135,28 @@ class FleetDrainingError(MXNetError):
     is shutting down (or mid-roll with nothing re-admitted yet) and
     admits no new work.  Answered as 503 with ``Retry-After``; a
     client must never hang on a fleet that will not serve it."""
+
+
+@register_error
+class SessionExpiredError(MXNetError):
+    """A serving session was evicted by policy — it ran past its idle
+    TTL (``MXNET_SERVING_SESSION_TTL_S``), was the least-recently-used
+    session when the per-model cap (``MXNET_SERVING_SESSION_MAX``)
+    forced an eviction, or was closed while a step was still queued.
+    The session's carry is gone on purpose; the client must create a
+    new session.  Answered as HTTP 410 (Gone) by the serving front
+    ends — retrying the same session id can never succeed."""
+
+
+@register_error
+class SessionLostError(MXNetError):
+    """A stateful serving session's carry could not be recovered: its
+    replica died (or drained away) and no valid CRC-verified snapshot
+    exists to migrate the session from (``serving/sessions.py``).  This
+    is the failover contract's *typed* failure arm — a dead session
+    must surface as this error, never as a hang and never as a stream
+    silently restarting from scratch.  Answered as HTTP 410 (Gone);
+    the client must create a new session."""
 
 
 @register_error

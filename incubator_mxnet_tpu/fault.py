@@ -27,6 +27,17 @@ Injection points (each named where the fault physically occurs):
   replica's ``/healthz`` (lost probes burn the health budget)
 * ``serving.replica_exec`` — a replica about to execute a routed
   request (replica-side crash/stall; absorbed by failover)
+* ``serving.session_step`` — a continuous-batching decode step about
+  to run over the active sessions' stacked carries (transient faults
+  retried by ``fault.retry``; a permanent fault surfaces to every
+  stream riding the step)
+* ``serving.session_snapshot`` — a session's carry about to be
+  snapshotted to its CRC'd checkpoint dir (failures are counted and
+  retried at the next period — a snapshot fault must never break the
+  live stream, only widen the migration re-base window)
+* ``serving.stream_write`` — a chunked-response chunk about to be
+  written to the client socket (a fault here is a client-side
+  connection loss: the stream is cancelled and counted)
 * ``trainer.step``      — an elastic trainer step about to run (the
   eviction-notice / checkpoint-on-evict path)
 
@@ -82,7 +93,8 @@ POINTS = ("kvstore.send", "kvstore.recv", "kvstore.heartbeat",
           "engine.push", "checkpoint.write", "checkpoint.read",
           "io.next_batch", "serving.enqueue", "serving.execute",
           "serving.route", "serving.probe", "serving.replica_exec",
-          "trainer.step")
+          "serving.session_step", "serving.session_snapshot",
+          "serving.stream_write", "trainer.step")
 
 _POINT_SET = frozenset(POINTS)
 

@@ -25,19 +25,28 @@ adaptive batching layer (NSDI'17) and MXNet Model Server:
   (in-process or subprocess) behind a health-checked router with
   least-loaded placement, per-hop deadline budgets, bounded failover,
   hedged requests and zero-downtime rolling reload.
+* :mod:`.sessions` — stateful sessions with continuous batching:
+  per-session carry trees (KV-cache-style), create/step/close verbs,
+  chunked streaming, TTL + bounded-count eviction, periodic CRC'd
+  snapshots (checkpoint.py shard format) and the crash-safe failover
+  contract: migrate-from-snapshot (bitwise continuation) or typed
+  ``SessionLostError`` — never a hang, never a silent restart.
 
 Everything is pure stdlib + JAX; no new dependencies.
 """
 from .admission import (DeadlineExceeded, QueueFullError,   # noqa: F401
                         ServingError, ShuttingDown)
-from .batcher import DynamicBatcher                          # noqa: F401
+from .batcher import (ContinuousBatcher, DynamicBatcher)     # noqa: F401
 from .fleet import ReplicaFleet                              # noqa: F401
 from .metrics import FleetMetrics, ServingMetrics            # noqa: F401
 from .model_repository import ModelRepository                # noqa: F401
 from .router import FleetRouter                              # noqa: F401
 from .server import InferenceServer                          # noqa: F401
+from .sessions import (SessionHost, SessionManager,          # noqa: F401
+                       SessionModel)
 
-__all__ = ["ModelRepository", "DynamicBatcher", "InferenceServer",
-           "ReplicaFleet", "FleetRouter",
+__all__ = ["ModelRepository", "DynamicBatcher", "ContinuousBatcher",
+           "InferenceServer", "ReplicaFleet", "FleetRouter",
+           "SessionManager", "SessionModel", "SessionHost",
            "ServingMetrics", "FleetMetrics", "ServingError",
            "QueueFullError", "DeadlineExceeded", "ShuttingDown"]

@@ -23,12 +23,15 @@ the server's retry path like it does the kvstore's.
 """
 from __future__ import annotations
 
+import math
+
 from ..base import get_env
 from .. import fault
 
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceeded",
            "ShuttingDown", "ModelNotFound", "BadRequest",
-           "Admission", "checked_enqueue", "checked_route"]
+           "ClientDisconnected", "Admission", "checked_enqueue",
+           "checked_route", "retry_after_s"]
 
 
 class ServingError(Exception):
@@ -73,6 +76,27 @@ class ModelNotFound(ServingError):
 
 class BadRequest(ServingError):
     http_status = 400
+
+
+class ClientDisconnected(ServingError):
+    """The client hung up while its request was still queued (broken
+    pipe / reset detected by the front end).  The request is cancelled
+    so it stops consuming device time; no response is ever written —
+    the 499 status (nginx convention) exists only for the metrics
+    books."""
+    http_status = 499
+
+
+def retry_after_s(depth, service_ms=None, floor=1, cap=30):
+    """Derive a ``Retry-After`` value (seconds, as the header string)
+    from live state instead of a constant: roughly the time the
+    current queue needs to flush — ``depth`` waiting requests times
+    the observed per-request service time (p50 end-to-end; 50 ms
+    until anything has been observed) — clamped to ``[floor, cap]``.
+    A deeper queue tells clients to stay away longer; an idle drain
+    tells them to come back almost immediately."""
+    est = max(0, int(depth)) * (service_ms if service_ms else 50.0)
+    return str(max(int(floor), min(int(cap), math.ceil(est / 1000.0))))
 
 
 class Admission:

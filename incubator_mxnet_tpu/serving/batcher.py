@@ -27,6 +27,7 @@ every request in the batch.
 """
 from __future__ import annotations
 
+import queue as _queue
 import threading
 import time
 
@@ -36,7 +37,8 @@ from ..base import get_env
 from .. import fault
 from .admission import DeadlineExceeded, ServingError
 
-__all__ = ["DynamicBatcher", "PendingResult", "parse_buckets"]
+__all__ = ["DynamicBatcher", "ContinuousBatcher", "PendingResult",
+           "StreamResult", "parse_buckets"]
 
 
 def parse_buckets(text=None):
@@ -291,6 +293,13 @@ class DynamicBatcher:
         live = []
         for req in batch:
             if req.cancelled:
+                # the caller withdrew (client disconnect, lost hedge
+                # race): acknowledged here so the row never reaches
+                # the device — counted, because dead requests that
+                # STILL burn device time are the failure mode the
+                # cancel wire exists to close
+                if self.metrics is not None:
+                    self.metrics.record_cancel(self.name)
                 req.event.set()
             elif req.expired(t_start):
                 req.queue_ms = req.age_ms(t_start)
@@ -355,6 +364,373 @@ class DynamicBatcher:
         relies on this."""
         with self._cond:
             self._accepting = False
+            self._running = False
+            self._cond.notify_all()
+        self._worker.join(timeout)
+        return not self._worker.is_alive()
+
+    close = drain
+
+
+# ---------------------------------------------------------------------------
+# continuous batching (stateful sessions)
+# ---------------------------------------------------------------------------
+
+class _Stream:
+    """One session-step request: a *stream* of ``n_steps`` decode
+    steps riding the running batch, one row per decode step."""
+
+    __slots__ = ("sid", "inputs", "n_steps", "deadline_ms", "event",
+                 "error", "chunks", "queue", "cancelled", "t_enqueue",
+                 "t_admitted", "queue_ms", "compute_ms", "steps_done",
+                 "carry", "checked_out", "session_steps")
+
+    def __init__(self, sid, inputs, n_steps, deadline_ms, stream):
+        self.sid = sid
+        self.inputs = inputs
+        self.n_steps = int(n_steps)
+        self.deadline_ms = deadline_ms
+        self.event = threading.Event()
+        self.error = None
+        self.chunks = []           # per-step output leaf lists
+        self.queue = _queue.SimpleQueue() if stream else None
+        self.cancelled = False
+        self.t_enqueue = time.monotonic()
+        self.t_admitted = None
+        self.queue_ms = None
+        self.compute_ms = 0.0
+        self.steps_done = 0
+        self.carry = None          # checked-out carry row while active
+        self.checked_out = False
+        self.session_steps = None  # session-absolute count (owner's)
+
+    def age_ms(self, now=None):
+        return ((now if now is not None else time.monotonic())
+                - self.t_enqueue) * 1000.0
+
+    def expired(self, now=None):
+        return (self.deadline_ms is not None
+                and self.age_ms(now) > self.deadline_ms)
+
+    def timing(self):
+        # session_steps is the session-ABSOLUTE count after this
+        # stream's last step: a client that remembers it can detect a
+        # migration's snapshot re-base (the count stepping backwards)
+        # — the opposite of a silent restart
+        return {"queue_ms": self.queue_ms, "compute_ms": self.compute_ms,
+                "steps": self.steps_done,
+                "session_steps": self.session_steps}
+
+
+class StreamResult:
+    """Handle for an in-flight session stream (continuous batching).
+
+    ``result()`` blocks until every step ran and returns
+    ``(chunks, timing)`` — chunks is the per-step list of output leaf
+    arrays, whose concatenation is bitwise-identical to the
+    non-streamed response.  With ``stream=True`` at submit, per-step
+    chunks also arrive on :attr:`chunk_queue` as ``("chunk", leaves)``
+    tuples terminated by ``("done", timing)`` or ``("error", exc)`` —
+    the shape an HTTP chunked-response writer consumes."""
+
+    __slots__ = ("_batcher", "_req")
+
+    def __init__(self, batcher, req):
+        self._batcher = batcher
+        self._req = req
+
+    @property
+    def sid(self):
+        return self._req.sid
+
+    @property
+    def chunk_queue(self):
+        return self._req.queue
+
+    @property
+    def steps_done(self):
+        return self._req.steps_done
+
+    def cancel(self):
+        """Withdraw the stream: the worker drops it at the next decode
+        step boundary (the session keeps the carry of every step that
+        already ran — a cancel is a truncation, never a corruption)."""
+        self._req.cancelled = True
+        with self._batcher._cond:
+            self._batcher._cond.notify()
+
+    def wait(self, timeout=None):
+        return self._req.event.wait(timeout)
+
+    def result(self):
+        req = self._req
+        timeout = (None if req.deadline_ms is None
+                   else req.deadline_ms / 1000.0 + 10.0)
+        if not req.event.wait(timeout):
+            req.cancelled = True
+            raise DeadlineExceeded(
+                f"session stream on {self._batcher.name!r} timed out",
+                queue_ms=req.age_ms())
+        if req.error is not None:
+            raise req.error
+        if req.cancelled and req.steps_done < req.n_steps:
+            raise DeadlineExceeded(
+                f"session stream on {self._batcher.name!r} was "
+                f"cancelled after {req.steps_done} step(s)",
+                queue_ms=req.queue_ms)
+        return list(req.chunks), req.timing()
+
+
+class ContinuousBatcher:
+    """Continuous-batching decode loop: streams join and leave a
+    *running* batch between decode steps.
+
+    Where :class:`DynamicBatcher` coalesces-then-flushes independent
+    one-shot predicts, this worker owns a persistent set of *active*
+    streams (one session each) and executes one batched decode step
+    per iteration over their stacked carries.  Between any two steps,
+    completed/cancelled/expired streams leave and queued streams join
+    — admission is re-evaluated at every step boundary, so a new
+    session starts decoding at the very next step, not after someone
+    else's stream finishes.  Each step's batch is padded to the next
+    size in ``buckets`` (the PR 10 AOT bucket set is the natural
+    granularity), so the compile universe is closed after warmup:
+    ``mxnet_serving_compile_total`` must stay flat across join/leave.
+
+    The batcher is tree-agnostic: ``step_batch(carries, inputs,
+    padded_to)`` (the session model's batched executor) does the
+    stacking/padding/unstacking, and ``owner`` (the
+    :class:`~.sessions.SessionManager`) supplies the carry lifecycle —
+    ``checkout(sid)`` / ``writeback(sid, carry, step_ms)`` /
+    ``release(sid)`` — so carries are owned by exactly one party at
+    any instant and every write-back lands *between* decode steps
+    (the crash-consistency point snapshots are taken at).
+
+    ``serving.session_step`` fires per decode step; transient faults
+    retry with ``fault.retry`` (``MXNET_SERVING_RETRIES``), permanent
+    ones surface to every stream riding the step.
+    """
+
+    def __init__(self, name, step_batch, owner, buckets=None,
+                 max_batch=None, metrics=None):
+        self.name = name
+        self.step_batch = step_batch
+        self.owner = owner
+        self.metrics = metrics
+        self.buckets = (list(buckets) if buckets is not None
+                        else parse_buckets())
+        self.max_batch = int(
+            max_batch if max_batch is not None
+            else get_env("MXNET_SERVING_MAX_BATCH", self.buckets[-1],
+                         int))
+        if self.max_batch < 1:
+            raise ValueError(
+                f"MXNET_SERVING_MAX_BATCH must be >= 1, got "
+                f"{self.max_batch}")
+        self._retries = get_env("MXNET_SERVING_RETRIES", 3, int)
+        self._pending: list[_Stream] = []
+        self._active: list[_Stream] = []
+        self._depth = 0
+        self._running = True
+        self._cond = threading.Condition()
+        self._worker = threading.Thread(
+            target=self._loop, name=f"continuous-{name}", daemon=True)
+        self._worker.start()
+
+    # -- client side --------------------------------------------------
+
+    @property
+    def depth(self):
+        """Queued + active stream count (admission bound + gauge)."""
+        return self._depth
+
+    @property
+    def active_streams(self):
+        return len(self._active)
+
+    def submit(self, sid, inputs, n_steps=1, deadline_ms=None,
+               admit=None, stream=False):
+        """Enqueue ``n_steps`` decode steps for session ``sid``;
+        returns a :class:`StreamResult`.  ``admit`` runs under the
+        queue lock (see ``Admission.gate``).  Steps of one session
+        always run in submit order — a second stream for a session
+        already decoding waits its turn."""
+        req = _Stream(sid, tuple(inputs), n_steps, deadline_ms, stream)
+        with self._cond:
+            if not self._running:
+                from .admission import ShuttingDown
+                raise ShuttingDown(
+                    f"session batcher for {self.name!r} is draining")
+            if admit is not None:
+                admit(self._depth)
+            self._pending.append(req)
+            self._depth += 1
+            self._cond.notify()
+        return StreamResult(self, req)
+
+    # -- worker side --------------------------------------------------
+
+    def _bucket_for(self, n):
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.max_batch
+
+    def _finish(self, req, error=None, done=False):
+        """Terminal transition of one stream; releases its session."""
+        if req.checked_out:
+            try:
+                self.owner.release(req.sid)
+            finally:
+                req.checked_out = False
+        req.error = error
+        with self._cond:
+            self._depth -= 1
+        if req.queue is not None:
+            req.queue.put(("error", error) if error is not None
+                          else ("done", req.timing()))
+        req.event.set()
+
+    def _admit_locked(self, now):
+        """Move pending streams into the active set (one per session,
+        up to ``max_batch`` rows) — called under ``_cond`` at every
+        step boundary, which is exactly what makes the batching
+        *continuous*."""
+        active_sids = {r.sid for r in self._active}
+        still = []
+        finished = []
+        for req in self._pending:
+            if req.cancelled:
+                if self.metrics is not None:
+                    self.metrics.record_cancel(self.name)
+                finished.append((req, DeadlineExceeded(
+                    f"stream for session {req.sid!r} cancelled while "
+                    "queued", queue_ms=req.age_ms(now))))
+                continue
+            if req.expired(now):
+                finished.append((req, DeadlineExceeded(
+                    f"stream for session {req.sid!r} spent "
+                    f"{req.age_ms(now):.1f}ms queued, past its "
+                    "deadline", queue_ms=req.age_ms(now))))
+                continue
+            if (req.sid in active_sids
+                    or len(self._active) >= self.max_batch):
+                still.append(req)   # carry serialization / batch full
+                continue
+            try:
+                req.carry = self.owner.checkout(req.sid)
+                req.checked_out = True
+            except Exception as e:  # mxlint: allow-broad-except(typed checkout failures — expired/lost/closed sessions — are delivered to the waiting stream)
+                finished.append((req, e))
+                continue
+            req.t_admitted = now
+            req.queue_ms = req.age_ms(now)
+            self._active.append(req)
+            active_sids.add(req.sid)
+        self._pending = still
+        return finished
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while (self._running and not self._pending
+                       and not self._active):
+                    self._cond.wait()
+                if not self._running:
+                    doomed = self._pending + self._active
+                    self._pending, self._active = [], []
+                else:
+                    doomed = None
+                    now = time.monotonic()
+                    finished = self._admit_locked(now)
+                    active = list(self._active)
+            if doomed is not None:
+                from .admission import ShuttingDown
+                for req in doomed:
+                    self._finish(req, ShuttingDown(
+                        f"session batcher for {self.name!r} is "
+                        "draining"))
+                return
+            for req, err in finished:
+                self._finish(req, err)
+            if active:
+                self._decode_step(active)
+
+    def _decode_step(self, active):
+        now = time.monotonic()
+        live = []
+        left = []
+        for req in active:
+            if req.cancelled:
+                if self.metrics is not None:
+                    self.metrics.record_cancel(self.name)
+                self._finish(req, DeadlineExceeded(
+                    f"stream for session {req.sid!r} cancelled after "
+                    f"{req.steps_done} step(s)", queue_ms=req.queue_ms))
+                left.append(req)
+            elif req.expired(now):
+                self._finish(req, DeadlineExceeded(
+                    f"stream for session {req.sid!r} passed its "
+                    f"deadline after {req.steps_done} step(s)",
+                    queue_ms=req.queue_ms, compute_ms=req.compute_ms))
+                left.append(req)
+            else:
+                live.append(req)
+        if live:
+            t0 = time.monotonic()
+            padded_to = self._bucket_for(len(live))
+            try:
+                def run():
+                    fault.inject("serving.session_step", self.name)
+                    return self.step_batch(
+                        [r.carry for r in live],
+                        [r.inputs for r in live], padded_to)
+                new_rows, out_rows = fault.retry(
+                    run, max_attempts=self._retries, backoff=0.01,
+                    max_backoff=0.5)
+            except Exception as e:  # mxlint: allow-broad-except(wrapped as ServingError and delivered to every stream riding the failed decode step)
+                err = e if isinstance(e, ServingError) else ServingError(
+                    f"decode step failed for {self.name!r}: "
+                    f"{type(e).__name__}: {e}")
+                for req in live:
+                    self._finish(req, err)
+                    left.append(req)
+                live = []
+            if live:
+                step_ms = (time.monotonic() - t0) * 1000.0
+                if self.metrics is not None:
+                    self.metrics.record_batch(self.name, len(live),
+                                              padded_to)
+                for i, req in enumerate(live):
+                    req.carry = new_rows[i]
+                    req.steps_done += 1
+                    req.compute_ms += step_ms
+                    try:
+                        req.session_steps = self.owner.writeback(
+                            req.sid, req.carry, step_ms)
+                    except Exception as e:  # mxlint: allow-broad-except(a session closed/expired mid-stream surfaces typed on ITS stream; the other rows of the step are unaffected)
+                        self._finish(req, e)
+                        left.append(req)
+                        continue
+                    req.chunks.append(out_rows[i])
+                    if req.queue is not None:
+                        req.queue.put(("chunk", out_rows[i]))
+                    if req.steps_done >= req.n_steps:
+                        self._finish(req, done=True)
+                        left.append(req)
+        if left:
+            with self._cond:
+                self._active = [r for r in self._active
+                                if r not in left]
+
+    # -- lifecycle ----------------------------------------------------
+
+    def drain(self, timeout=30.0):
+        """Stop the decode loop: queued and active streams fail typed
+        (``ShuttingDown``) at the next step boundary — the session
+        carries they already produced stay written back, so a
+        drain-then-migrate continuation loses nothing."""
+        with self._cond:
             self._running = False
             self._cond.notify_all()
         self._worker.join(timeout)

@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import json
 import os
+import queue as _queue
 import subprocess
 import sys
 import threading
@@ -171,6 +172,22 @@ class _ReplicaBase:
     def close(self, timeout=30.0):
         raise NotImplementedError
 
+    # stateful sessions (docs/serving.md "Sessions"): the replica owns
+    # the carry; the router owns which replica that is (affinity)
+
+    def session_create(self, model, sid=None):
+        raise NotImplementedError
+
+    def session_step(self, model, sid, inputs, steps=1,
+                     deadline_ms=None, on_chunk=None):
+        raise NotImplementedError
+
+    def session_close(self, model, sid):
+        raise NotImplementedError
+
+    def session_adopt(self, model, sid):
+        raise NotImplementedError
+
 
 class _Inflight:
     __slots__ = ("_r",)
@@ -206,10 +223,16 @@ class ThreadReplica(_ReplicaBase):
     backend = "thread"
 
     def __init__(self, rid, models, buckets=None, warmup=None,
-                 probe_fails=None):
+                 probe_fails=None, session_models=None,
+                 session_dir=None):
         super().__init__(rid, models, probe_fails=probe_fails)
         from .model_repository import ModelRepository
+        from .sessions import SessionHost
         self.repository = ModelRepository(buckets=buckets)
+        self.sessions = SessionHost(
+            admission=self.repository.admission,
+            snapshot_dir=session_dir, buckets=buckets)
+        self._session_models = dict(session_models or {})
         self._warmup = warmup
         self._t_start = time.monotonic()
 
@@ -218,6 +241,10 @@ class ThreadReplica(_ReplicaBase):
         try:
             for name, path in self.models.items():
                 self.repository.load(name, path, warmup=self._warmup)
+            for name, spec in self._session_models.items():
+                self.sessions.add(
+                    name, spec,
+                    warmup=self._warmup is not False)
         except Exception:
             self.state = DEAD
             raise
@@ -244,7 +271,66 @@ class ThreadReplica(_ReplicaBase):
     def healthz(self):
         self._gone()
         from .server import health_body
-        return health_body(self.repository, self._t_start)
+        return health_body(self.repository, self._t_start,
+                           sessions=self.sessions)
+
+    def session_create(self, model, sid=None):
+        self._gone()
+        return self.sessions.get(model).create(sid)
+
+    def session_step(self, model, sid, inputs, steps=1,
+                     deadline_ms=None, on_chunk=None):
+        self._gone()
+        _check_replica_exec(self.rid, f"{model}/{sid}")
+        with self.track():
+            mgr = self.sessions.get(model)
+            if on_chunk is None:
+                return mgr.step(sid, inputs, steps=steps,
+                                deadline_ms=deadline_ms)
+            handle = mgr.step(sid, inputs, steps=steps,
+                              deadline_ms=deadline_ms, stream=True)
+            budget_s = ((deadline_ms or 120000.0) / 1000.0 + 10.0)
+            chunks = []
+            try:
+                while True:
+                    try:
+                        kind, payload = handle.chunk_queue.get(
+                            timeout=budget_s)
+                    except _queue.Empty:
+                        raise DeadlineExceeded(
+                            f"stream {model}/{sid} on replica "
+                            f"{self.rid} stalled") from None
+                    if kind == "chunk":
+                        chunks.append(payload)
+                        on_chunk(payload)
+                    elif kind == "done":
+                        return chunks, payload
+                    else:
+                        raise payload
+            except BaseException:
+                # covers a RAISING on_chunk relay too (client gone):
+                # the decode loop must drop this stream at the next
+                # boundary instead of decoding into the void
+                handle.cancel()
+                raise
+
+    def session_close(self, model, sid):
+        self._gone()
+        return self.sessions.get(model).close(sid)
+
+    def session_adopt(self, model, sid):
+        self._gone()
+        return self.sessions.get(model).restore(sid)
+
+    def kill(self):
+        """Crash simulation, session edition: the decode loops die
+        with the "process" — active streams break typed at the next
+        step boundary and NO parting snapshots are written (graceful
+        snapshots are ``close()``'s job; a crash only has whatever
+        the periodic snapshotter already made durable)."""
+        super().kill()
+        for name in self.sessions.names():
+            self.sessions.get(name).batcher.drain(timeout=5.0)
 
     def admin(self, verb, name, path=None, version=None, warmup=None):
         self._gone()
@@ -265,6 +351,8 @@ class ThreadReplica(_ReplicaBase):
     def close(self, timeout=30.0):
         self.state = DEAD
         self.repository.drain_all(timeout)
+        # final sync snapshots: a post-drain migration is lossless
+        self.sessions.drain_all(timeout)
 
 
 class ProcessReplica(_ReplicaBase):
@@ -274,9 +362,18 @@ class ProcessReplica(_ReplicaBase):
     backend = "process"
 
     def __init__(self, rid, models, warmup=None, probe_fails=None,
-                 startup_timeout_s=300.0):
+                 startup_timeout_s=300.0, session_models=None,
+                 session_dir=None):
         super().__init__(rid, models, probe_fails=probe_fails)
         self._warmup = warmup
+        self._session_models = dict(session_models or {})
+        for name, spec in self._session_models.items():
+            if not isinstance(spec, str):
+                raise ValueError(
+                    f"process replicas rebuild session models from "
+                    f"registry spec strings; got {type(spec).__name__} "
+                    f"for {name!r}")
+        self._session_dir = session_dir
         self._startup_timeout_s = float(startup_timeout_s)
         self._proc = None
         self._port = None
@@ -294,6 +391,10 @@ class ProcessReplica(_ReplicaBase):
                "--host", "127.0.0.1", "--port", "0"]
         for name, path in self.models.items():
             cmd += ["--model", f"{name}={path}"]
+        for name, spec in self._session_models.items():
+            cmd += ["--session-model", f"{name}={spec}"]
+        if self._session_dir is not None:
+            cmd += ["--session-dir", str(self._session_dir)]
         if self._warmup is False:
             cmd.append("--no-warmup")
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -445,6 +546,126 @@ class ProcessReplica(_ReplicaBase):
                                 f"{self.rid}")
         return payload["models"][name]["inputs"]
 
+    # -- sessions over the wire ---------------------------------------
+
+    @classmethod
+    def _raise_session(cls, code, payload, rid, what):
+        """Session errors carry their type in-band; 410 resolves back
+        to the typed eviction/loss error the contract names."""
+        from ..error import SessionExpiredError, SessionLostError
+        err = payload.get("error")
+        msg = (f"replica {rid} [{what}]: "
+               f"{payload.get('message', payload)}")
+        if err == "SessionLostError":
+            raise SessionLostError(msg)
+        if err == "SessionExpiredError" or code == 410:
+            raise SessionExpiredError(msg)
+        # in-band stream errors arrive under HTTP 200: resolve the
+        # typed class by name, not status
+        by_name = {"DeadlineExceeded": DeadlineExceeded,
+                   "ShuttingDown": ShuttingDown,
+                   "QueueFullError": QueueFullError,
+                   "BadRequest": BadRequest,
+                   "ModelNotFound": ModelNotFound,
+                   "SessionNotFound": ModelNotFound}.get(err)
+        if by_name is not None and code == 200:
+            raise by_name(msg)
+        cls._raise_for(code, payload, rid, what)
+
+    def session_create(self, model, sid=None):
+        body = {"session_id": sid} if sid else {}
+        code, payload = self._http(
+            f"POST /v1/sessions/{model}:create",
+            json.dumps(body).encode(), timeout_s=60.0)
+        if code != 200:
+            self._raise_session(code, payload, self.rid, model)
+        return payload
+
+    def session_step(self, model, sid, inputs, steps=1,
+                     deadline_ms=None, on_chunk=None):
+        _check_replica_exec(self.rid, f"{model}/{sid}")
+        body = {"inputs": [onp.asarray(x).tolist() for x in inputs],
+                "steps": int(steps)}
+        if deadline_ms:
+            body["timeout_ms"] = float(deadline_ms)
+        timeout_s = (deadline_ms / 1000.0 + 5.0 if deadline_ms
+                     else 120.0)
+        with self.track():
+            if on_chunk is None:
+                code, payload = self._http(
+                    f"POST /v1/sessions/{model}/{sid}:step",
+                    json.dumps(body).encode(), timeout_s)
+                if code != 200:
+                    self._raise_session(code, payload, self.rid,
+                                        f"{model}/{sid}")
+                return payload["outputs"], payload.get("timing", {})
+            return self._session_stream(model, sid, body, timeout_s,
+                                        on_chunk)
+
+    def _session_stream(self, model, sid, body, timeout_s, on_chunk):
+        """Streamed hop: relay each chunked JSON line as it arrives.
+        A mid-stream transport loss (SIGKILLed replica) surfaces typed
+        ``ReplicaUnavailableError`` — with chunks already delivered the
+        router must NOT transparently re-run the stream (chunks cannot
+        be unsent); the session itself recovers on the next step."""
+        import http.client
+        import urllib.error
+        import urllib.request
+        self._gone()
+        body = dict(body)
+        body["stream"] = True
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self._port}/v1/sessions/{model}/"
+            f"{sid}:step", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        chunks = []
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                for line in resp:
+                    msg = json.loads(line)
+                    if "outputs" in msg:
+                        chunks.append(msg["outputs"])
+                        on_chunk(msg["outputs"])
+                    elif "error" in msg:
+                        self._raise_session(
+                            200, msg, self.rid, f"{model}/{sid}")
+                    else:
+                        return chunks, msg.get("timing", {})
+            raise ReplicaUnavailableError(
+                f"replica {self.rid}: stream for {model}/{sid} ended "
+                "without a done line")
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read())
+            except ValueError:
+                payload = {"error": "HTTPError", "message": str(e)}
+            self._raise_session(e.code, payload, self.rid,
+                                f"{model}/{sid}")
+        except (urllib.error.URLError, http.client.HTTPException,
+                TimeoutError, ValueError, OSError) as e:
+            raise ReplicaUnavailableError(
+                f"replica {self.rid}: stream for {model}/{sid} broke "
+                f"after {len(chunks)} chunk(s): "
+                f"{type(e).__name__}: {e}") from e
+
+    def session_close(self, model, sid):
+        code, payload = self._http(
+            f"POST /v1/sessions/{model}/{sid}:close", b"{}",
+            timeout_s=60.0)
+        if code != 200:
+            self._raise_session(code, payload, self.rid,
+                                f"{model}/{sid}")
+        return payload
+
+    def session_adopt(self, model, sid):
+        code, payload = self._http(
+            f"POST /v1/sessions/{model}/{sid}:adopt", b"{}",
+            timeout_s=120.0)
+        if code != 200:
+            self._raise_session(code, payload, self.rid,
+                                f"{model}/{sid}")
+        return payload
+
     def kill(self):
         super().kill()
         if self._proc is not None and self._proc.poll() is None:
@@ -472,8 +693,15 @@ class ReplicaFleet:
 
     def __init__(self, models, n=None, backend="thread", buckets=None,
                  warmup=None, probe_ms=None, probe_fails=None,
-                 metrics=None):
+                 metrics=None, session_models=None, session_dir=None):
         self.models = dict(models)
+        # name -> registry spec string; every replica hosts the same
+        # session models, snapshotting into the SHARED session_dir so
+        # any survivor can adopt a dead replica's sessions
+        self.session_models = dict(session_models or {})
+        self.session_dir = (
+            session_dir if session_dir is not None
+            else get_env("MXNET_SERVING_SESSION_DIR", None))
         self.n = int(n if n is not None
                      else get_env("MXNET_SERVING_FLEET_REPLICAS", 2, int))
         if self.n < 1:
@@ -504,10 +732,14 @@ class ReplicaFleet:
             self._next_rid += 1
         if self.backend == "process":
             return ProcessReplica(rid, self.models, warmup=self._warmup,
-                                  probe_fails=self._probe_fails)
+                                  probe_fails=self._probe_fails,
+                                  session_models=self.session_models,
+                                  session_dir=self.session_dir)
         return ThreadReplica(rid, self.models, buckets=self._buckets,
                              warmup=self._warmup,
-                             probe_fails=self._probe_fails)
+                             probe_fails=self._probe_fails,
+                             session_models=self.session_models,
+                             session_dir=self.session_dir)
 
     def spawn(self):
         """Bring up all N replicas concurrently; raises if any failed

@@ -98,13 +98,15 @@ class Histogram:
 
 class _ModelMetrics:
     __slots__ = ("requests", "errors", "batches", "batch_hist",
-                 "e2e_ms", "compute_ms", "queue_ms", "padded_rows")
+                 "e2e_ms", "compute_ms", "queue_ms", "padded_rows",
+                 "cancelled")
 
     def __init__(self):
         self.requests = {}       # {http-code: count}
         self.errors = 0
         self.batches = 0
         self.padded_rows = 0
+        self.cancelled = 0
         self.batch_hist = Histogram(buckets=(1, 2, 4, 8, 16, 32, 64, 128))
         self.e2e_ms = Histogram()
         self.compute_ms = Histogram()
@@ -126,6 +128,12 @@ class ServingMetrics:
         # ready duration, process-start → ready, and AOT-executable
         # load outcomes, recorded by ModelRepository._build_entry
         self._cold_start: dict[str, dict] = {}
+        # stateful sessions (SessionHost callbacks): per-model gauges
+        # + the per-session-model compile counts folded into the
+        # compile_total flatline invariant
+        self._session_stats_fn = None
+        self._session_hists_fn = None
+        self._session_compile_fn = None
 
     def attach_repository(self, repository):
         """Wire gauges that live in the repository (compile counts per
@@ -134,6 +142,17 @@ class ServingMetrics:
         self._compile_count_fn = repository.compile_counts
         self._queue_depth_fn = repository.queue_depths
         self._memory_fn = getattr(repository, "memory_summaries", None)
+
+    def attach_sessions(self, host):
+        """Wire the session-host gauges (active sessions, steps,
+        snapshots, stream latency) — and fold the session models'
+        decode-step compile counts into ``mxnet_serving_compile_total``
+        so the flatline-after-warmup invariant covers continuous
+        batching: a session join/leave that cost an XLA compile moves
+        the same counter a cold predict would."""
+        self._session_stats_fn = host.stats
+        self._session_hists_fn = host.stream_hists
+        self._session_compile_fn = host.compile_counts
 
     def _model(self, name):
         with self._lock:
@@ -165,6 +184,13 @@ class ServingMetrics:
             m.padded_rows += max(0, padded_to - batch_size)
         m.batch_hist.observe(batch_size)
 
+    def record_cancel(self, model):
+        """One request/stream withdrawn before (or between) device
+        steps — client disconnects and lost hedge races land here."""
+        m = self._model(model)
+        with self._lock:
+            m.cancelled += 1
+
     def record_cold_start(self, model, cold_start_ms, aot_loads=0,
                           aot_load_failures=0, compile_count=0):
         """One model version reached ready: how long load + warmup
@@ -192,9 +218,21 @@ class ServingMetrics:
     # -- exposition ---------------------------------------------------
 
     def compile_count(self):
-        if self._compile_count_fn is None:
-            return 0
-        return sum(self._compile_count_fn().values())
+        total = 0
+        if self._compile_count_fn is not None:
+            total += sum(self._compile_count_fn().values())
+        if self._session_compile_fn is not None:
+            total += sum(self._session_compile_fn().values())
+        return total
+
+    def service_ms_estimate(self, model):
+        """Recent p50 end-to-end latency for ``model`` (None until
+        observed) — the live term the derived ``Retry-After`` uses."""
+        with self._lock:
+            m = self._models.get(model)
+        if m is None or m.e2e_ms.total == 0:
+            return None
+        return m.e2e_ms.quantile(0.5)
 
     def render(self):
         """Prometheus text exposition format (version 0.0.4)."""
@@ -203,8 +241,11 @@ class ServingMetrics:
         L.append("# TYPE mxnet_serving_uptime_seconds gauge")
         L.append(f"mxnet_serving_uptime_seconds "
                  f"{time.monotonic() - self._started:.3f}")
-        compiles = (self._compile_count_fn() if self._compile_count_fn
-                    else {})
+        compiles = dict(self._compile_count_fn()
+                        if self._compile_count_fn else {})
+        if self._session_compile_fn is not None:
+            for model, n in self._session_compile_fn().items():
+                compiles[model] = compiles.get(model, 0) + n
         L.append("# HELP mxnet_serving_compile_total Distinct XLA "
                  "executables per model (must flatline after warmup).")
         L.append("# TYPE mxnet_serving_compile_total counter")
@@ -293,6 +334,49 @@ class ServingMetrics:
         for name, m in sorted(models.items()):
             L.append(f'mxnet_serving_padded_rows_total'
                      f'{{model="{_esc(name)}"}} {m.padded_rows}')
+        L.append("# HELP mxnet_serving_cancelled_total Requests/"
+                 "streams withdrawn before execution (client "
+                 "disconnects, lost hedge races).")
+        L.append("# TYPE mxnet_serving_cancelled_total counter")
+        for name, m in sorted(models.items()):
+            L.append(f'mxnet_serving_cancelled_total'
+                     f'{{model="{_esc(name)}"}} {m.cancelled}')
+        sess = (self._session_stats_fn() if self._session_stats_fn
+                else {})
+        for metric, key, kind, help_ in (
+                ("mxnet_serving_session_active", "active_sessions",
+                 "gauge", "Live sessions per session model."),
+                ("mxnet_serving_session_steps_total", "steps_total",
+                 "counter", "Decode steps executed."),
+                ("mxnet_serving_session_snapshots_total",
+                 "snapshots_total", "counter",
+                 "Carry snapshots written (CRC'd shard format)."),
+                ("mxnet_serving_session_snapshot_failures_total",
+                 "snapshot_failures_total", "counter",
+                 "Snapshot attempts that failed (stream unaffected)."),
+                ("mxnet_serving_session_evictions_total",
+                 "evictions_total", "counter",
+                 "Sessions evicted (idle TTL / session cap)."),
+                ("mxnet_serving_session_restored_total",
+                 "restored_total", "counter",
+                 "Sessions adopted from a snapshot (migrations in)."),
+                ("mxnet_serving_session_snapshot_age_s",
+                 "snapshot_age_s", "gauge",
+                 "Oldest live session's seconds since last snapshot "
+                 "(the migration re-base window).")):
+            L.append(f"# HELP {metric} {help_}")
+            L.append(f"# TYPE {metric} {kind}")
+            for name, st in sorted(sess.items()):
+                L.append(f'{metric}{{model="{_esc(name)}"}} '
+                         f'{st[key]}')
+        hists = (self._session_hists_fn() if self._session_hists_fn
+                 else {})
+        L.append("# HELP mxnet_serving_session_stream_ms Per-chunk "
+                 "decode-step latency of session streams.")
+        L.append("# TYPE mxnet_serving_session_stream_ms histogram")
+        for name, h in sorted(hists.items()):
+            L.extend(h.prom_lines("mxnet_serving_session_stream_ms",
+                                  f'model="{_esc(name)}"'))
         L.append("# HELP mxnet_serving_batch_size Coalesced batch sizes.")
         L.append("# TYPE mxnet_serving_batch_size histogram")
         for name, m in sorted(models.items()):
@@ -332,15 +416,20 @@ class ServingMetrics:
                 if m.get("donated_bytes_reclaimed") is not None:
                     out[f"{name}.donated_bytes_reclaimed"] = \
                         m["donated_bytes_reclaimed"]
+        if self._session_stats_fn is not None:
+            for name, st in self._session_stats_fn().items():
+                for k, v in st.items():
+                    out[f"{name}.session.{k}"] = v
         for name, m in models.items():
             with self._lock:
                 reqs = sum(m.requests.values())
                 errs, batches = m.errors, m.batches
-                padded = m.padded_rows
+                padded, cancelled = m.padded_rows, m.cancelled
             out[f"{name}.requests"] = reqs
             out[f"{name}.errors"] = errs
             out[f"{name}.batches"] = batches
             out[f"{name}.padded_rows"] = padded
+            out[f"{name}.cancelled"] = cancelled
             out[f"{name}.batch_size"] = m.batch_hist.snapshot()
             out[f"{name}.e2e_ms"] = m.e2e_ms.snapshot()
             out[f"{name}.compute_ms"] = m.compute_ms.snapshot()
@@ -378,12 +467,21 @@ class FleetMetrics:
         self.failovers = 0
         self.hedges_launched = 0
         self.hedges_won = 0
+        self.migrations = 0               # session carries re-homed
+        self.session_losses = 0           # typed SessionLostError out
+        self.route_cancels = 0            # client gone mid-route
         self.route_ms = Histogram()
         self._fleet_states_fn = None      # () -> {rid: state-dict}
+        self._session_count_fn = None     # () -> live affinity entries
 
     def attach_fleet(self, fleet):
         """Wire the live replica-state gauge callback."""
         self._fleet_states_fn = fleet.states
+
+    def attach_session_count(self, fn):
+        """Wire the router's session-affinity gauge (sessions the
+        fleet currently tracks, wherever their carry lives)."""
+        self._session_count_fn = fn
 
     # -- recording hooks ----------------------------------------------
 
@@ -408,6 +506,23 @@ class FleetMetrics:
         with self._lock:
             self._probe_failures[replica_id] = (
                 self._probe_failures.get(replica_id, 0) + 1)
+
+    def record_migration(self):
+        """One session adopted onto a new replica from its snapshot."""
+        with self._lock:
+            self.migrations += 1
+
+    def record_session_loss(self):
+        """One session surfaced typed ``SessionLostError`` — the
+        failover contract's explicit failure arm, never a hang."""
+        with self._lock:
+            self.session_losses += 1
+
+    def record_route_cancel(self):
+        """Client disconnected while its request was still between
+        hops — abandoned before more device time was spent."""
+        with self._lock:
+            self.route_cancels += 1
 
     # -- exposition ---------------------------------------------------
 
@@ -449,6 +564,33 @@ class FleetMetrics:
             probe_failures = dict(self._probe_failures)
             failovers = self.failovers
             launched, won = self.hedges_launched, self.hedges_won
+            migrations, losses = self.migrations, self.session_losses
+            route_cancels = self.route_cancels
+        L.append("# HELP mxnet_serving_fleet_sessions Sessions the "
+                 "router currently tracks affinity for.")
+        L.append("# TYPE mxnet_serving_fleet_sessions gauge")
+        L.append(f"mxnet_serving_fleet_sessions "
+                 f"{self._session_count_fn() if self._session_count_fn else 0}")
+        L.append("# HELP mxnet_serving_fleet_session_migrations_total "
+                 "Sessions re-homed from a snapshot after replica "
+                 "death or drain.")
+        L.append("# TYPE mxnet_serving_fleet_session_migrations_total "
+                 "counter")
+        L.append(f"mxnet_serving_fleet_session_migrations_total "
+                 f"{migrations}")
+        L.append("# HELP mxnet_serving_fleet_session_losses_total "
+                 "Sessions that surfaced typed SessionLostError (no "
+                 "recoverable snapshot).")
+        L.append("# TYPE mxnet_serving_fleet_session_losses_total "
+                 "counter")
+        L.append(f"mxnet_serving_fleet_session_losses_total {losses}")
+        L.append("# HELP mxnet_serving_fleet_route_cancels_total "
+                 "Routed requests abandoned between hops because the "
+                 "client disconnected.")
+        L.append("# TYPE mxnet_serving_fleet_route_cancels_total "
+                 "counter")
+        L.append(f"mxnet_serving_fleet_route_cancels_total "
+                 f"{route_cancels}")
         L.append("# HELP mxnet_serving_fleet_requests_total Routed "
                  "requests by final HTTP code.")
         L.append("# TYPE mxnet_serving_fleet_requests_total counter")
@@ -493,6 +635,11 @@ class FleetMetrics:
                 "failovers": self.failovers,
                 "hedges_launched": self.hedges_launched,
                 "hedges_won": self.hedges_won,
+                "migrations": self.migrations,
+                "session_losses": self.session_losses,
+                "route_cancels": self.route_cancels,
+                "sessions": (self._session_count_fn()
+                             if self._session_count_fn else 0),
                 "probe_failures": dict(self._probe_failures),
             }
         out["route_ms"] = self.route_ms.snapshot()

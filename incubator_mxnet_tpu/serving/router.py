@@ -44,12 +44,14 @@ import numpy as onp
 
 from ..base import get_env
 from .. import fault
-from ..error import FleetDrainingError, ReplicaUnavailableError
-from .admission import (Admission, BadRequest, DeadlineExceeded,
-                        QueueFullError, ServingError, ShuttingDown,
-                        checked_route)
+from ..error import (FleetDrainingError, ReplicaUnavailableError,
+                     SessionExpiredError, SessionLostError)
+from .admission import (Admission, BadRequest, ClientDisconnected,
+                        DeadlineExceeded, QueueFullError, ServingError,
+                        ShuttingDown, checked_route, retry_after_s)
 from .metrics import FleetMetrics, Histogram
 from .server import JSONRequestHandler, ServingHTTPServer
+from .sessions import SessionNotFound
 
 __all__ = ["FleetRouter", "main"]
 
@@ -94,25 +96,52 @@ class FleetRouter:
             hop_min_ms if hop_min_ms is not None
             else get_env("MXNET_SERVING_FLEET_HOP_MIN_MS", 50.0, float))
         self._hop_ms = Histogram()   # successful-hop latencies (p95)
+        # session affinity: a session's carry lives on exactly ONE
+        # replica; the router remembers which (sid -> (model, rid))
+        # and re-homes it from its snapshot when that replica dies
+        self._session_homes: dict = {}
+        self._session_lock = threading.Lock()
+        self.metrics.attach_session_count(
+            lambda: len(self._session_homes))
         self.host = host
         self.port = int(port)
         self.t_start = time.monotonic()
         self._httpd = None
         self._thread = None
 
+    def _retry_headers(self):
+        """Live ``Retry-After``: with nothing routable, the time the
+        prober needs to readmit a replica; under load, the time the
+        current inflight queue needs to flush at the observed p50."""
+        if not self.fleet.routable():
+            probe_s = (self.fleet._probe_ms / 1000.0
+                       * max(1, self.fleet._probe_fails or 1))
+            return {"Retry-After": str(max(1, min(30,
+                                                  int(probe_s + 1))))}
+        inflight = sum(st["inflight"]
+                       for st in self.fleet.states().values())
+        p50 = self._hop_ms.quantile(0.5)
+        return {"Retry-After": retry_after_s(inflight + 1,
+                                             p50 or None)}
+
     # -- routing core (in-process API; the HTTP handler wraps it) -----
 
-    def route(self, name, inputs, deadline_ms=None, inputs_json=None):
+    def route(self, name, inputs, deadline_ms=None, inputs_json=None,
+              live=None):
         """Route one predict; returns ``(outputs, timing)`` where
         outputs is the replica's leaf list.  ``inputs`` is the tuple of
         instance arrays; ``inputs_json`` optionally carries the
         pre-encoded JSON tensor list so process-backend hops (and
-        their failover/hedge resends) do not re-serialize."""
+        their failover/hedge resends) do not re-serialize.  ``live``
+        is an optional ``() -> bool`` client-liveness probe checked
+        between hops: a disconnected client's request is abandoned
+        (typed, counted) instead of burning failover hops for a socket
+        nobody reads."""
         t0 = time.monotonic()
         code = 500
         try:
             result = self._route(name, inputs, deadline_ms,
-                                 inputs_json, t0)
+                                 inputs_json, t0, live)
             code = 200
             return result
         except ServingError as e:
@@ -125,7 +154,8 @@ class FleetRouter:
             self.metrics.record_route(
                 code, (time.monotonic() - t0) * 1000.0)
 
-    def _route(self, name, inputs, deadline_ms, inputs_json, t0):
+    def _route(self, name, inputs, deadline_ms, inputs_json, t0,
+               live=None):
         checked_route(name)
         deadline = self.admission.deadline_ms(deadline_ms)
         t_end = t0 + deadline / 1000.0
@@ -133,6 +163,11 @@ class FleetRouter:
         tried: set = set()
         last = None
         for k in range(attempts):
+            if live is not None and not live():
+                self.metrics.record_route_cancel()
+                raise ClientDisconnected(
+                    f"client of {name!r} disconnected after {k} "
+                    "hop(s)")
             r = self.fleet.pick(exclude=tried)
             if r is None:
                 if self.fleet.all_draining():
@@ -269,6 +304,197 @@ class FleetRouter:
             # is race noise; the primary's cause is the actionable one)
             raise slots.get("primary", slots[order[0]])[1]
 
+    # -- stateful sessions: affinity + the failover contract ----------
+    #
+    # A session's carry lives on exactly one replica.  On replica
+    # death or drain the router either MIGRATES the session — a
+    # surviving replica adopts it from its latest CRC'd snapshot, and
+    # the resumed continuation is bitwise-equal to an unbroken run
+    # from that snapshot — or fails with typed SessionLostError.
+    # Never a hang, never a stream that silently restarts from
+    # scratch (docs/serving.md "Sessions").
+
+    def session_create(self, model, sid=None):
+        code = 500
+        t0 = time.monotonic()
+        try:
+            checked_route(model)
+            r = self.fleet.pick()
+            if r is None:
+                if self.fleet.all_draining():
+                    raise FleetDrainingError(
+                        "fleet is draining, not accepting sessions")
+                raise ReplicaUnavailableError(
+                    f"no ready replica to host a {model!r} session")
+            info = r.session_create(model, sid)
+            with self._session_lock:
+                self._session_homes[info["session_id"]] = (model,
+                                                           r.rid)
+            info["replica"] = r.rid
+            code = 200
+            return info
+        except ServingError as e:
+            code = e.http_status
+            raise
+        except (FleetDrainingError, ConnectionError):
+            code = 503
+            raise
+        finally:
+            self.metrics.record_route(
+                code, (time.monotonic() - t0) * 1000.0)
+
+    def _session_home(self, model, sid):
+        with self._session_lock:
+            entry = self._session_homes.get(sid)
+        if entry is None or entry[0] != model:
+            raise SessionNotFound(
+                f"no session {sid!r} for model {model!r} on this "
+                "fleet")
+        return entry[1]
+
+    def session_step(self, model, sid, inputs, steps=1,
+                     deadline_ms=None, on_chunk=None):
+        code = 500
+        t0 = time.monotonic()
+        try:
+            result = self._session_step(model, sid, inputs, steps,
+                                        deadline_ms, on_chunk)
+            code = 200
+            return result
+        except (SessionExpiredError, SessionLostError):
+            # terminal for this id either way: drop the affinity entry
+            # so churned/expired sessions never accumulate in the
+            # router's map (and the fleet sessions gauge stays honest)
+            code = 410
+            with self._session_lock:
+                self._session_homes.pop(sid, None)
+            raise
+        except ServingError as e:
+            code = e.http_status
+            raise
+        except (FleetDrainingError, ConnectionError):
+            code = 503
+            raise
+        finally:
+            self.metrics.record_route(
+                code, (time.monotonic() - t0) * 1000.0)
+
+    def _session_step(self, model, sid, inputs, steps, deadline_ms,
+                      on_chunk):
+        checked_route(model)
+        deadline = self.admission.deadline_ms(deadline_ms)
+        rid = self._session_home(model, sid)
+        chunks_out = [0]
+        if on_chunk is not None:
+            user_cb = on_chunk
+
+            def on_chunk(chunk):
+                chunks_out[0] += 1
+                user_cb(chunk)
+        try:
+            r = self.fleet.get(rid)
+        except KeyError:
+            r = None
+        last = None
+        from .fleet import DEAD
+        if r is not None and r.state != DEAD:
+            # retry the OWNER first: a transient hop fault (injected
+            # serving.replica_exec fires before any state moves, a
+            # refused connect moves none) must not trigger a spurious
+            # migration that re-bases onto an older snapshot
+            outcome, value = self._try_step(r, model, sid, inputs,
+                                            steps, deadline, on_chunk,
+                                            chunks_out)
+            if outcome == "ok":
+                return value
+            last = value
+        return self._migrate_step(model, sid, {rid}, inputs, steps,
+                                  deadline, on_chunk, chunks_out, last)
+
+    def _try_step(self, r, model, sid, inputs, steps, deadline,
+                  on_chunk, chunks_out, attempts=3):
+        """Step on one replica with bounded transient-fault retries.
+        Returns ``("ok", result)`` or ``("failed", last_error)`` (the
+        caller migrates); raises directly for outcomes that must NOT
+        migrate (overload, deadline, anything after chunks went out —
+        a re-run elsewhere would resend them)."""
+        last = None
+        for attempt in range(attempts):
+            try:
+                return "ok", r.session_step(model, sid, inputs,
+                                            steps=steps,
+                                            deadline_ms=deadline,
+                                            on_chunk=on_chunk)
+            except (QueueFullError, DeadlineExceeded):
+                raise              # overload/deadline: surface as-is
+            except ShuttingDown as e:
+                if chunks_out[0]:
+                    raise          # resend rule: break typed
+                return "failed", e     # draining: migrate now
+            except ConnectionError as e:
+                last = e
+                if chunks_out[0]:
+                    raise          # resend rule: break typed
+                if attempt < attempts - 1:
+                    time.sleep(0.01 * (attempt + 1))
+        return "failed", last
+
+    def _migrate_step(self, model, sid, exclude, inputs, steps,
+                      deadline, on_chunk, chunks_out, last):
+        """Owner is dead/draining: re-home the session from its latest
+        snapshot onto a surviving replica, then run the step there."""
+        candidates = sorted(
+            (r for r in self.fleet.routable()
+             if r.rid not in exclude),
+            key=lambda r: (r.inflight, r.rid))
+        if not candidates:
+            if self.fleet.all_draining():
+                raise FleetDrainingError(
+                    "fleet is draining, not accepting session work")
+            if last is not None:
+                raise last
+            raise ReplicaUnavailableError(
+                f"no surviving replica to adopt session {sid!r}")
+        for r2 in candidates:
+            try:
+                r2.session_adopt(model, sid)
+            except SessionLostError:
+                # the typed arm of the contract: no usable snapshot
+                # anywhere — drop the affinity so a retry 404s fast
+                self.metrics.record_session_loss()
+                with self._session_lock:
+                    self._session_homes.pop(sid, None)
+                raise
+            except (ConnectionError, ServingError) as e:
+                last = e
+                continue
+            self.metrics.record_migration()
+            with self._session_lock:
+                self._session_homes[sid] = (model, r2.rid)
+            # the post-adoption step gets the same transient-fault
+            # retries as the owner path (an injected replica fault on
+            # the hop right after adoption must not leak raw)
+            outcome, value = self._try_step(r2, model, sid, inputs,
+                                            steps, deadline, on_chunk,
+                                            chunks_out)
+            if outcome == "ok":
+                return value
+            raise value
+        raise last
+
+    def session_close(self, model, sid):
+        rid = self._session_home(model, sid)
+        with self._session_lock:
+            self._session_homes.pop(sid, None)
+        try:
+            return self.fleet.get(rid).session_close(model, sid)
+        except (KeyError, ConnectionError, ShuttingDown) as e:
+            # the owner is gone — so is the carry; the close verb's
+            # goal (stop tracking, free resources) is already met
+            return {"session_id": sid, "closed": True, "steps": None,
+                    "note": f"owner {rid} unreachable "
+                            f"({type(e).__name__})"}
+
     # -- fleet health view --------------------------------------------
 
     def health(self):
@@ -346,31 +572,47 @@ class _RouterHandler(JSONRequestHandler):
                        "unload": self._unload}.get(verb)
             if handler is not None and name:
                 return handler(name)
+        parsed = self.parse_session_path(path)
+        if parsed is not None:
+            model, sid, verb = parsed
+            if verb == "create" and sid is None:
+                return self._session_create(model)
+            if sid is not None:
+                handler = {"step": self._session_step,
+                           "close": self._session_close}.get(verb)
+                if handler is not None:
+                    return handler(model, sid)
         self._send(404, {"error": "NotFound", "message": path})
 
     def _guarded(self, fn):
-        """Map the typed routing errors onto HTTP, with Retry-After on
-        every retryable condition."""
+        """Map the typed routing errors onto HTTP, with a live-derived
+        Retry-After on every retryable condition."""
         try:
             return fn()
+        except ClientDisconnected:
+            pass       # socket is gone; counted where it was detected
+        except (SessionExpiredError, SessionLostError) as e:
+            # typed + terminal for that session id: 410 Gone
+            self._send(410, {"error": type(e).__name__,
+                             "message": str(e)})
         except ServingError as e:
-            hdrs = ({"Retry-After": "1"}
+            hdrs = (self.app._retry_headers()
                     if e.http_status in (429, 503) else None)
             self._send(e.http_status, e.payload(), extra_headers=hdrs)
         except FleetDrainingError as e:
             self._send(503, {"error": "FleetDrainingError",
                              "message": str(e)},
-                       extra_headers={"Retry-After": "1"})
+                       extra_headers=self.app._retry_headers())
         except fault.TransientFault as e:
             self._send(503, {"error": "TransientFault",
                              "message": str(e)},
-                       extra_headers={"Retry-After": "1"})
+                       extra_headers=self.app._retry_headers())
         except ConnectionError as e:
             # ReplicaUnavailableError and raw refused sockets: the
             # condition clears when a replica re-warms
             self._send(503, {"error": type(e).__name__,
                              "message": str(e)},
-                       extra_headers={"Retry-After": "1"})
+                       extra_headers=self.app._retry_headers())
         except Exception as e:  # mxlint: allow-broad-except(HTTP boundary: any error becomes a 500 response)
             self._send(500, {"error": type(e).__name__,
                              "message": str(e)})
@@ -399,7 +641,8 @@ class _RouterHandler(JSONRequestHandler):
                         f"instance shape {want}")
             outputs, timing = self.app.route(
                 name, arrs, deadline_ms=body.get("timeout_ms"),
-                inputs_json=json.dumps(body["inputs"]))
+                inputs_json=json.dumps(body["inputs"]),
+                live=lambda: not self._client_gone())
             self._send(200, {
                 "outputs": [o if isinstance(o, list)
                             else onp.asarray(o).tolist()
@@ -433,6 +676,93 @@ class _RouterHandler(JSONRequestHandler):
             self._send(200, self.app.fleet.unload_everywhere(name))
         self._guarded(fn)
 
+    # -- sessions -----------------------------------------------------
+
+    def _session_create(self, model):
+        def fn():
+            body = self._body()
+            self._send(200, self.app.session_create(
+                model, body.get("session_id")))
+        self._guarded(fn)
+
+    def _session_close(self, model, sid):
+        def fn():
+            self._send(200, self.app.session_close(model, sid))
+        self._guarded(fn)
+
+    def _session_step(self, model, sid):
+        def fn():
+            body = self._body()
+            if "inputs" not in body or not isinstance(body["inputs"],
+                                                      list):
+                raise BadRequest('body needs "inputs": [tensor, ...]')
+            steps = body.get("steps", 1)
+            deadline = body.get("timeout_ms")
+            if body.get("stream"):
+                return self._session_stream(model, sid,
+                                            body["inputs"], steps,
+                                            deadline)
+            chunks, timing = self.app.session_step(
+                model, sid, tuple(body["inputs"]), steps=steps,
+                deadline_ms=deadline)
+            self._send(200, {
+                "session_id": sid,
+                "steps": timing.get("steps", len(chunks)),
+                "outputs": [[onp.asarray(leaf).tolist()
+                             for leaf in chunk] for chunk in chunks],
+                "timing": {k: round(v, 3)
+                           for k, v in (timing or {}).items()
+                           if isinstance(v, (int, float))}})
+        self._guarded(fn)
+
+    def _session_stream(self, model, sid, inputs, steps, deadline):
+        """Relay a replica's chunked decode stream to the client,
+        chunk by chunk.  A broken client pipe cancels the relay (the
+        replica sees its socket close and cancels the stream); a
+        replica death mid-relay surfaces as an in-band typed error
+        line — the stream breaks VISIBLY, and the session itself
+        recovers via migration on the next step."""
+        started = [False]
+
+        def relay(chunk):
+            if not started[0]:
+                self._start_chunked(200)
+                started[0] = True
+            try:
+                self._write_chunk({
+                    "session_id": sid,
+                    "outputs": [onp.asarray(leaf).tolist()
+                                for leaf in chunk]})
+            except OSError as e:
+                self.app.metrics.record_route_cancel()
+                raise ClientDisconnected(
+                    f"stream client of {model!r}/{sid} vanished: "
+                    f"{type(e).__name__}") from e
+
+        try:
+            chunks, timing = self.app.session_step(
+                model, sid, tuple(inputs), steps=steps,
+                deadline_ms=deadline, on_chunk=relay)
+        except ClientDisconnected:
+            raise
+        except (ServingError, SessionExpiredError, SessionLostError,
+                FleetDrainingError, ConnectionError) as e:
+            if not started[0]:
+                raise    # nothing sent yet: normal error mapping
+            self._write_chunk({"error": type(e).__name__,
+                               "message": str(e)})
+            self._end_chunked()
+            return
+        if not started[0]:
+            self._start_chunked(200)
+        self._write_chunk({
+            "done": True, "session_id": sid,
+            "steps": timing.get("steps", len(chunks)),
+            "timing": {k: round(v, 3)
+                       for k, v in (timing or {}).items()
+                       if isinstance(v, (int, float))}})
+        self._end_chunked()
+
 
 def main(argv=None):
     import argparse
@@ -446,6 +776,13 @@ def main(argv=None):
                    metavar="NAME=PREFIX",
                    help="serve artifact PREFIX as model NAME on every "
                         "replica")
+    p.add_argument("--session-model", action="append", default=[],
+                   metavar="NAME=SPEC",
+                   help="host a stateful session model on every "
+                        "replica (sessions.SESSION_MODELS spec)")
+    p.add_argument("--session-dir", default=None,
+                   help="shared snapshot dir for session migration "
+                        "(default MXNET_SERVING_SESSION_DIR)")
     p.add_argument("--replicas", type=int,
                    default=get_env("MXNET_SERVING_FLEET_REPLICAS", 2,
                                    int))
@@ -465,11 +802,19 @@ def main(argv=None):
         if not sep:
             p.error(f"--model wants NAME=PREFIX, got {spec!r}")
         models[name] = path
-    if not models:
-        p.error("need at least one --model NAME=PREFIX")
+    session_models = {}
+    for spec in args.session_model:
+        name, sep, model_spec = spec.partition("=")
+        if not sep:
+            p.error(f"--session-model wants NAME=SPEC, got {spec!r}")
+        session_models[name] = model_spec
+    if not models and not session_models:
+        p.error("need at least one --model or --session-model")
 
     fleet = ReplicaFleet(models, n=args.replicas, backend=args.backend,
-                         warmup=not args.no_warmup)
+                         warmup=not args.no_warmup,
+                         session_models=session_models,
+                         session_dir=args.session_dir)
     print(f"[fleet] spawning {args.replicas} {args.backend} "
           f"replica(s)", flush=True)
     fleet.spawn()

@@ -23,6 +23,9 @@ launches.
 from __future__ import annotations
 
 import json
+import queue as _queue
+import select
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -31,14 +34,16 @@ import numpy as onp
 
 from ..base import get_env
 from .. import fault
-from .admission import Admission, BadRequest, ServingError
+from ..error import SessionExpiredError, SessionLostError
+from .admission import (Admission, BadRequest, ClientDisconnected,
+                        ServingError, retry_after_s)
 from .metrics import ServingMetrics
 from .model_repository import ModelRepository
 
 __all__ = ["InferenceServer", "health_body", "main"]
 
 
-def health_body(repository, t_start=None):
+def health_body(repository, t_start=None, sessions=None):
     """Build the structured ``/healthz`` response: ``(code, body)``.
 
     Per-model ``state`` is the probe contract the fleet layer routes
@@ -83,6 +88,14 @@ def health_body(repository, t_start=None):
         "queue_depth": total_depth,
         "models": models,
     }
+    # stateful sessions ride along (additively — probers that pin the
+    # per-model predict shape never see the key unless session models
+    # are actually registered): per session model the pinned describe
+    # dict, docs/serving.md "Sessions"
+    if sessions is not None and sessions.names():
+        body["sessions"] = sessions.describe()
+        body["queue_depth"] += sum(
+            d["queue_depth"] for d in body["sessions"].values())
     return (503 if draining else 200), body
 
 
@@ -132,6 +145,87 @@ class JSONRequestHandler(BaseHTTPRequestHandler):
         except (ValueError, UnicodeDecodeError) as e:
             raise BadRequest(f"request body is not JSON: {e}")
 
+    @staticmethod
+    def parse_session_path(path):
+        """``/v1/sessions/{model}:create`` or
+        ``/v1/sessions/{model}/{sid}:{verb}`` →
+        ``(model, sid_or_None, verb)``; ``None`` for anything else.
+        One parser for both front ends — the server and the fleet
+        router must never grow different session URL surfaces."""
+        if not (path.startswith("/v1/sessions/") and ":" in path):
+            return None
+        target, _, verb = path[len("/v1/sessions/"):].rpartition(":")
+        model, _, sid = target.partition("/")
+        if not model or not verb:
+            return None
+        return model, (sid or None), verb
+
+    # -- client-liveness + chunked streaming --------------------------
+
+    def _client_gone(self):
+        """True when the client hung up (EOF/reset on its socket).
+
+        Non-consuming: the byte is MSG_PEEKed, so a keep-alive
+        client's *next* pipelined request is left intact.  Used while
+        a request is queued — a dead client's request is cancelled so
+        it stops consuming device time (``PendingResult.cancel``).
+
+        Known tradeoff (nginx's 499 makes the same call): a client
+        that half-closes (``shutdown(SHUT_WR)``) after sending its
+        request also reads as EOF here and gets cancelled, even
+        though its read side could still take the response.
+        Half-closing HTTP clients are vanishingly rare; dead clients
+        burning device time are not — the wire optimizes for the
+        latter."""
+        try:
+            r, _, _ = select.select([self.connection], [], [], 0)
+            if not r:
+                return False
+            return self.connection.recv(1, socket.MSG_PEEK) == b""
+        except OSError:
+            return True
+
+    def _await_pending(self, pending, name, deadline_ms=None,
+                       poll_s=0.05):
+        """Block on a :class:`~.batcher.PendingResult` while watching
+        the client socket; a disconnect cancels the queued request
+        (counted in ``mxnet_serving_cancelled_total``) and raises
+        :class:`~.admission.ClientDisconnected`."""
+        backstop = time.monotonic() + (
+            (deadline_ms or 120000.0) / 1000.0 + 10.0)
+        while not pending._req.event.wait(poll_s):
+            if self._client_gone():
+                pending.cancel()
+                raise ClientDisconnected(
+                    f"client of {name!r} disconnected while queued")
+            if time.monotonic() > backstop:
+                break
+        return pending.result()
+
+    def _start_chunked(self, code=200, extra_headers=None):
+        """Begin a ``Transfer-Encoding: chunked`` response (streamed
+        session decode): headers out now, body arrives one
+        ``_write_chunk`` per decode step."""
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+
+    def _write_chunk(self, obj):
+        """One JSON line as one HTTP chunk.  ``serving.stream_write``
+        fires per chunk — an injected fault here is a client-side
+        connection loss and must cancel the stream, not wedge it."""
+        fault.inject("serving.stream_write")
+        data = json.dumps(obj).encode() + b"\n"
+        self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+        self.wfile.flush()
+
+    def _end_chunked(self):
+        self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
+
 
 class _Handler(JSONRequestHandler):
 
@@ -157,12 +251,24 @@ class _Handler(JSONRequestHandler):
                        "reload": self._reload}.get(verb)
             if handler is not None and name:
                 return handler(name)
+        parsed = self.parse_session_path(path)
+        if parsed is not None:
+            model, sid, verb = parsed
+            if verb == "create" and sid is None:
+                return self._session_create(model)
+            if sid is not None:
+                handler = {"step": self._session_step,
+                           "close": self._session_close,
+                           "adopt": self._session_adopt}.get(verb)
+                if handler is not None:
+                    return handler(model, sid)
         self._send(404, {"error": "NotFound", "message": path})
 
     # -- handlers -----------------------------------------------------
 
     def _healthz(self):
-        code, body = health_body(self.app.repository, self.app.t_start)
+        code, body = health_body(self.app.repository, self.app.t_start,
+                                 sessions=self.app.sessions)
         self._send(code, body)
 
     def _predict(self, name):
@@ -194,8 +300,14 @@ class _Handler(JSONRequestHandler):
                     raise BadRequest(
                         f"instance shape {tuple(a.shape)} != exported "
                         f"instance shape {want}")
-            out, timing = self.app.repository.predict(
-                name, arrs, body.get("timeout_ms"))
+            # async submit + disconnect-aware wait: a client that
+            # hangs up while its request is queued gets it CANCELLED
+            # (the flush worker drops the row before it costs device
+            # time) instead of computing into a dead socket
+            deadline = body.get("timeout_ms")
+            pending = self.app.repository.predict_async(
+                name, arrs, deadline)
+            out, timing = self._await_pending(pending, name, deadline)
             import jax
             outputs = [o.tolist()
                        for o in jax.tree_util.tree_leaves(out)]
@@ -204,14 +316,18 @@ class _Handler(JSONRequestHandler):
                        "timing": {k: round(v, 3)
                                   for k, v in timing.items()
                                   if v is not None}}
+        except ClientDisconnected:
+            code = 499   # counted, never sent — the socket is gone
+            payload = None
         except ServingError as e:
             code = e.http_status
-            hdrs = {"Retry-After": "1"} if code in (429, 503) else None
+            hdrs = (self.app.retry_headers(name)
+                    if code in (429, 503) else None)
             payload = e.payload()
         except fault.TransientFault as e:
             code = 503   # injected front-end fault: client may retry
             payload = {"error": "TransientFault", "message": str(e)}
-            hdrs = {"Retry-After": "1"}
+            hdrs = self.app.retry_headers(name)
         except Exception as e:  # mxlint: allow-broad-except(HTTP boundary: any error becomes a 500 response)
             code = 500
             payload = {"error": type(e).__name__, "message": str(e)}
@@ -226,7 +342,8 @@ class _Handler(JSONRequestHandler):
                 name, code, e2e_ms=e2e,
                 compute_ms=timing.get("compute_ms"),
                 queue_ms=timing.get("queue_ms"))
-        self._send(code, payload, extra_headers=hdrs)
+        if payload is not None:
+            self._send(code, payload, extra_headers=hdrs)
 
     def _admin(self, name, fn):
         # errors attribute to the name only when it names a loaded
@@ -267,6 +384,134 @@ class _Handler(JSONRequestHandler):
                 warmup=body.get("warmup"))
         self._admin(name, fn)
 
+    # -- stateful sessions (docs/serving.md "Sessions") ---------------
+
+    def _session_guarded(self, model, fn):
+        """Error→HTTP mapping for the session verbs: eviction/loss are
+        410 Gone (typed, terminal for that id — retrying can never
+        succeed), overload/drain keep the live-derived Retry-After."""
+        code = 500
+        try:
+            fn()
+            code = 200
+        except ClientDisconnected:
+            code = 499               # counted, nothing sendable
+        except (SessionExpiredError, SessionLostError) as e:
+            code = 410
+            self._send(410, {"error": type(e).__name__,
+                             "message": str(e)})
+        except ServingError as e:
+            code = e.http_status
+            hdrs = (self.app.retry_headers(model)
+                    if code in (429, 503) else None)
+            self._send(code, e.payload(), extra_headers=hdrs)
+        except fault.TransientFault as e:
+            code = 503
+            self._send(503, {"error": "TransientFault",
+                             "message": str(e)},
+                       extra_headers=self.app.retry_headers(model))
+        except Exception as e:  # mxlint: allow-broad-except(HTTP boundary: any error becomes a 500 response)
+            code = 500
+            self._send(500, {"error": type(e).__name__,
+                             "message": str(e)})
+        if model in self.app.sessions.names():
+            self.app.metrics.record_request(model, code)
+
+    def _session_create(self, model):
+        def fn():
+            body = self._body()
+            mgr = self.app.sessions.get(model)
+            self._send(200, mgr.create(body.get("session_id")))
+        self._session_guarded(model, fn)
+
+    def _session_close(self, model, sid):
+        def fn():
+            self._send(200, self.app.sessions.get(model).close(sid))
+        self._session_guarded(model, fn)
+
+    def _session_adopt(self, model, sid):
+        """Adopt a session from its latest snapshot (the migration
+        verb the fleet router drives after a replica death)."""
+        def fn():
+            self._send(200, self.app.sessions.get(model).restore(sid))
+        self._session_guarded(model, fn)
+
+    def _session_step(self, model, sid):
+        def fn():
+            body = self._body()
+            if "inputs" not in body or not isinstance(body["inputs"],
+                                                      list):
+                raise BadRequest('body needs "inputs": [tensor, ...]')
+            mgr = self.app.sessions.get(model)
+            arrs = tuple(body["inputs"])  # dtypes land in check_inputs
+            steps = body.get("steps", 1)
+            deadline = body.get("timeout_ms")
+            if body.get("stream"):
+                return self._session_stream(mgr, sid, arrs, steps,
+                                            deadline)
+            chunks, timing = mgr.step(sid, arrs, steps=steps,
+                                      deadline_ms=deadline)
+            self._send(200, {
+                "session_id": sid, "steps": timing["steps"],
+                "outputs": [[onp.asarray(leaf).tolist()
+                             for leaf in chunk] for chunk in chunks],
+                "timing": {k: round(v, 3)
+                           for k, v in timing.items()
+                           if v is not None}})
+        self._session_guarded(model, fn)
+
+    def _session_stream(self, mgr, sid, arrs, steps, deadline):
+        """Chunked-response decode: one JSON line per decode step the
+        moment it lands, a final ``done`` (or in-band ``error``) line,
+        then the terminating chunk.  Concatenating the per-line
+        outputs is bitwise-identical to the non-streamed response
+        (the streaming-parity contract).  A broken pipe cancels the
+        stream at the next step boundary — dead clients must not keep
+        riding the batch."""
+        handle = mgr.step(sid, arrs, steps=steps, deadline_ms=deadline,
+                          stream=True)
+        budget_s = ((deadline or 120000.0) / 1000.0 + 10.0)
+        self._start_chunked(200)
+        try:
+            while True:
+                try:
+                    kind, payload = handle.chunk_queue.get(
+                        timeout=budget_s)
+                except _queue.Empty:
+                    handle.cancel()
+                    self._write_chunk({
+                        "error": "DeadlineExceeded",
+                        "message": "decode loop stalled",
+                        "steps": handle.steps_done})
+                    break
+                if kind == "chunk":
+                    self._write_chunk({
+                        "session_id": sid,
+                        "outputs": [onp.asarray(leaf).tolist()
+                                    for leaf in payload]})
+                elif kind == "done":
+                    self._write_chunk({
+                        "done": True, "session_id": sid,
+                        "steps": payload["steps"],
+                        "timing": {k: round(v, 3)
+                                   for k, v in payload.items()
+                                   if v is not None}})
+                    break
+                else:   # in-band typed error: stream ends, no restart
+                    self._write_chunk({
+                        "error": type(payload).__name__,
+                        "message": str(payload),
+                        "steps": handle.steps_done})
+                    break
+            self._end_chunked()
+        except OSError as e:
+            # broken pipe / reset / injected serving.stream_write
+            # fault: the client is gone — stop decoding for it
+            handle.cancel()
+            raise ClientDisconnected(
+                f"stream client of {mgr.name!r}/{sid} vanished: "
+                f"{type(e).__name__}") from e
+
 
 class InferenceServer:
     """Own the repository + metrics + HTTP listener as one unit."""
@@ -286,12 +531,28 @@ class InferenceServer:
             self.repository.set_metrics(self.metrics)
         else:
             self.metrics.attach_repository(self.repository)
+        # stateful sessions share the repository's admission policy
+        # (one drain drains both) and the server's metrics instance
+        from .sessions import SessionHost
+        self.sessions = SessionHost(
+            metrics=self.metrics,
+            admission=self.repository.admission,
+            snapshot_dir=get_env("MXNET_SERVING_SESSION_DIR", None))
         self.metrics.register_with_profiler()
         self.host = host
         self.port = int(port)
         self.t_start = time.monotonic()
         self._httpd = None
         self._thread = None
+
+    def retry_headers(self, model=None):
+        """Live-state ``Retry-After`` for 429/503 responses: current
+        queue depth times the observed per-request service time."""
+        from .admission import retry_after_s
+        depth = sum(self.repository.queue_depths().values())
+        depth += sum(self.sessions.queue_depths().values())
+        return {"Retry-After": retry_after_s(
+            depth, self.metrics.service_ms_estimate(model))}
 
     def start(self):
         """Bind + serve on a background thread; returns the bound port
@@ -308,9 +569,12 @@ class InferenceServer:
 
     def shutdown(self, drain=True, timeout=30.0):
         """Graceful stop: drain queues first so queued requests get
-        real responses, then close the listener."""
+        real responses (session streams truncate typed and every
+        session snapshots, so migration after a drain is lossless),
+        then close the listener."""
         if drain:
             self.repository.drain_all(timeout)
+            self.sessions.drain_all(timeout)
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -330,6 +594,15 @@ def main(argv=None):
     p.add_argument("--model", action="append", default=[],
                    metavar="NAME=PREFIX",
                    help="load artifact PREFIX as model NAME at startup")
+    p.add_argument("--session-model", action="append", default=[],
+                   metavar="NAME=SPEC",
+                   help="register a stateful session model from the "
+                        "sessions.SESSION_MODELS registry (e.g. "
+                        "toy_decoder:dim=16,max_len=32)")
+    p.add_argument("--session-dir", default=None,
+                   help="shared CRC'd snapshot directory (overrides "
+                        "MXNET_SERVING_SESSION_DIR); required for "
+                        "cross-replica session migration")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int,
                    default=get_env("MXNET_SERVING_PORT", 8080, int))
@@ -338,6 +611,8 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     server = InferenceServer(host=args.host, port=args.port)
+    if args.session_dir:
+        server.sessions.snapshot_dir = args.session_dir
     for spec in args.model:
         name, sep, path = spec.partition("=")
         if not sep:
@@ -345,6 +620,14 @@ def main(argv=None):
         server.repository.load(name, path,
                                warmup=not args.no_warmup)
         print(f"[serving] loaded {name} from {path}", flush=True)
+    for spec in args.session_model:
+        name, sep, model_spec = spec.partition("=")
+        if not sep:
+            p.error(f"--session-model wants NAME=SPEC, got {spec!r}")
+        server.sessions.add(name, model_spec,
+                            warmup=not args.no_warmup)
+        print(f"[serving] session model {name} = {model_spec}",
+              flush=True)
     port = server.start()
     print(f"[serving] listening on {args.host}:{port}", flush=True)
 

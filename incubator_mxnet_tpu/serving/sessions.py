@@ -1,0 +1,884 @@
+"""Server-side stateful sessions with crash-safe carry.
+
+The stateless batcher serves the easy workload; the traffic that
+dominates real fleets — chat sessions, autoregressive decode, streams —
+needs *state on the server*: a per-session carry tree (KV-cache-style
+for sequence models) that every decode step reads and replaces.  That
+state is what makes robustness hard: the carry lives in exactly one
+replica's memory, so replica death, rolling reloads and TTL expiry all
+need defined, typed outcomes.  This module is that contract:
+
+* :class:`SessionModel` — a batched decode step ``step_fn(carry, x) ->
+  (carry, y)`` jitted through the unified
+  :class:`~..executor_cache.Executor` choke point, with a per-row carry
+  template and per-step input specs.  Warmup pre-compiles one
+  executable per padding bucket, so decode steps never compile
+  (``mxnet_serving_compile_total`` stays flat across session
+  join/leave).
+* :class:`SessionManager` — owns the sessions of one model:
+  ``create`` / ``step`` / ``close`` verbs, idle-TTL + bounded-count
+  eviction (typed :class:`~..error.SessionExpiredError`), and a
+  :class:`~.batcher.ContinuousBatcher` running the shared decode loop.
+* **Snapshots** — every ``MXNET_SERVING_SESSION_SNAPSHOT_STEPS`` steps
+  (and synchronously at drain) a session's carry is written through
+  :class:`~..checkpoint.AsyncCheckpointManager` — the same CRC-per-
+  shard, atomic-rename, newest-first-fallback format training
+  checkpoints use.  ``restore()`` rebuilds a session from its latest
+  valid snapshot on ANY replica sharing the directory; a session with
+  no recoverable snapshot raises typed
+  :class:`~..error.SessionLostError`.  Never a hang, never a silently
+  restarted stream.
+
+Determinism contract (asserted in tests/test_sessions.py): the decode
+step is row-independent and batch-size-stable, so a session's output
+stream is bitwise identical whether it decodes alone, rides a full
+bucket, or resumes from a snapshot on another replica.
+
+Fault points: ``serving.session_step`` (fired per decode step, inside
+the batcher's retry), ``serving.session_snapshot`` (before each
+snapshot write; failures are counted, never fatal to the stream).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import threading
+import time
+import uuid
+
+import numpy as onp
+
+from ..base import get_env
+from .. import fault
+from ..error import SessionExpiredError, SessionLostError
+from .admission import (Admission, BadRequest, ModelNotFound,
+                        ServingError, ShuttingDown)
+from .batcher import ContinuousBatcher, parse_buckets
+from .metrics import Histogram
+
+__all__ = ["SessionModel", "SessionManager", "SessionHost",
+           "SessionNotFound", "SESSION_MODELS", "build_session_model",
+           "toy_decoder"]
+
+_log = logging.getLogger("incubator_mxnet_tpu.serving.sessions")
+
+
+class SessionNotFound(ServingError):
+    """No session with that id on this manager (never created here, or
+    already closed).  404 — distinct from the typed eviction/loss
+    errors, which are 410 (the id existed and is gone forever)."""
+    http_status = 404
+
+
+# ---------------------------------------------------------------------------
+# session model: a batched decode step behind the Executor choke point
+# ---------------------------------------------------------------------------
+
+class SessionModel:
+    """One decode-step program + the carry/input signature around it.
+
+    ``step_fn(carry, x) -> (carry, y)`` is *batched*: every leaf of
+    ``carry`` and every array in ``x`` has a leading batch dim (the
+    bucket size).  ``carry_template`` is ONE ROW — the fresh-session
+    carry (position 0, zeroed caches); ``input_specs`` is the per-step
+    per-row input signature ``[(shape, dtype), ...]``.
+    """
+
+    def __init__(self, name, step_fn, carry_template, input_specs,
+                 spec=None):
+        import jax
+        from ..executor_cache import Executor
+        self.name = name
+        self.spec = spec                   # rebuildable description
+        leaves, treedef = jax.tree_util.tree_flatten(carry_template)
+        self._treedef = treedef
+        self._template_rows = [onp.asarray(v) for v in leaves]
+        self.input_specs = [(tuple(sh), onp.dtype(dt))
+                            for sh, dt in input_specs]
+        self._zero_inputs = tuple(onp.zeros(sh, dt)
+                                  for sh, dt in self.input_specs)
+        # donate the stacked carry: the step's output carry has the
+        # same shapes, so XLA reuses the buffers and a decode step
+        # allocates only its outputs
+        self._executor = Executor(step_fn, site=f"session:{name}",
+                                  donate_argnums=(0,))
+
+    # -- carry plumbing ----------------------------------------------
+
+    def fresh_carry(self):
+        """One new session's carry row (leaf list, copied)."""
+        return [onp.array(v) for v in self._template_rows]
+
+    def carry_from_flat(self, flat):
+        """Rebuild a carry row from a snapshot's ``{leaf_i: array}``
+        dict (restore path)."""
+        keys = sorted(flat)
+        want = len(self._template_rows)
+        if len(keys) != want:
+            raise SessionLostError(
+                f"snapshot for a {self.name!r} session carries "
+                f"{len(keys)} leaves, model wants {want}")
+        return [onp.asarray(flat[k]) for k in keys]
+
+    def flat_of_carry(self, rows):
+        return {f"leaf_{i:03d}": onp.asarray(v)
+                for i, v in enumerate(rows)}
+
+    def check_inputs(self, arrs):
+        if len(arrs) != len(self.input_specs):
+            raise BadRequest(
+                f"session model {self.name!r} takes "
+                f"{len(self.input_specs)} step inputs, got {len(arrs)}")
+        out = []
+        for a, (sh, dt) in zip(arrs, self.input_specs):
+            a = onp.asarray(a, dtype=dt)
+            if tuple(a.shape) != sh:
+                raise BadRequest(
+                    f"step input shape {tuple(a.shape)} != session "
+                    f"model instance shape {sh}")
+            out.append(a)
+        return tuple(out)
+
+    # -- batched execution -------------------------------------------
+
+    def _stack(self, rows_list, pad_rows, padded_to):
+        # HOST-side stack: carry rows live as numpy (views of the
+        # previous step's device->host pull), so a decode step costs
+        # O(leaves) device transfers, not O(rows x leaves) jax
+        # dispatches — per-row jnp slicing/stacking was measured to
+        # eat the entire continuous-batching win on CPU
+        n = len(rows_list)
+        stacked = []
+        for j in range(len(pad_rows)):
+            cols = [rows[j] for rows in rows_list]
+            cols += [pad_rows[j]] * (padded_to - n)
+            stacked.append(onp.stack(cols))
+        return stacked
+
+    def step_batch(self, carries, inputs, padded_to):
+        """Run one decode step over ``len(carries)`` live rows padded
+        to ``padded_to``; returns (per-row new carries, per-row output
+        leaf lists — numpy views of the batched result).  The
+        signature seen by jit depends only on ``padded_to`` — the
+        bucket set is the whole compile universe.
+        """
+        import jax
+        n = len(carries)
+        carry_stack = self._treedef.unflatten(
+            self._stack(carries, self._template_rows, padded_to))
+        x_stack = tuple(self._stack(
+            [list(x) for x in inputs], list(self._zero_inputs),
+            padded_to))
+        new_carry, y = self._executor(carry_stack, x_stack)
+        new_leaves = [onp.asarray(leaf)
+                      for leaf in jax.tree_util.tree_leaves(new_carry)]
+        y_leaves = [onp.asarray(leaf)
+                    for leaf in jax.tree_util.tree_leaves(y)]
+        new_rows = [[leaf[i] for leaf in new_leaves] for i in range(n)]
+        out_rows = [[leaf[i] for leaf in y_leaves] for i in range(n)]
+        return new_rows, out_rows
+
+    def warmup(self, buckets):
+        """Pre-compile one decode executable per padding bucket, so no
+        live stream ever pays an XLA compile."""
+        for b in sorted(set(buckets)):
+            self.step_batch([self.fresh_carry()],
+                            [self._zero_inputs], int(b))
+        return self.compile_count
+
+    @property
+    def compile_count(self):
+        return self._executor.compile_count
+
+
+# ---------------------------------------------------------------------------
+# builtin session models (CLI / process replicas / bench)
+# ---------------------------------------------------------------------------
+
+def toy_decoder(dim=16, max_len=32, seed=0):
+    """Single-head autoregressive attention decoder with a fixed-shape
+    KV cache — the reference session workload.
+
+    Carry per row: ``k``/``v`` caches ``(max_len, dim)``, write
+    position ``pos`` (clamped to the last slot past ``max_len``), and
+    the previous output ``y``.  Each step writes a fresh K/V at
+    ``pos`` and attends over the ``pos+1`` live entries — the
+    single-query specialization of the streaming-softmax block in
+    :func:`..parallel.ring_attention._local_block` (same max-subtract
+    flash-attention algebra), restated in **batch-invariant** ops:
+    every contraction is a broadcast-multiply + fixed-axis reduce
+    instead of a ``dot``, because XLA lowers dots differently per
+    batch size (ULP-level drift) while a per-row middle-axis reduce
+    keeps one reduction order regardless of how many rows ride the
+    bucket.  That makes batched decode bitwise-equal to solo decode —
+    the continuous-batching correctness contract this module's tests
+    pin.
+    """
+    import jax.numpy as jnp
+
+    dim, max_len, seed = int(dim), int(max_len), int(seed)
+    rng = onp.random.RandomState(seed)
+
+    def w():
+        return (rng.randn(dim, dim) * (1.0 / dim ** 0.5)).astype(
+            onp.float32)
+
+    Wx, Wh, Wq, Wk, Wv, Wo = w(), w(), w(), w(), w(), w()
+    scale = 1.0 / (dim ** 0.5)
+
+    def mm(x, W):
+        # (B, D) x (D, E) with a per-row reduction order independent
+        # of B — the batch-invariance trick (see class docstring)
+        return (x[:, :, None] * W[None, :, :]).sum(axis=1)
+
+    def step_fn(carry, x):
+        (x,) = x
+        B = x.shape[0]
+        h = jnp.tanh(mm(carry["y"], Wh) + mm(x, Wx))
+        q, k_new, v_new = mm(h, Wq), mm(h, Wk), mm(h, Wv)
+        rows = jnp.arange(B)
+        K = carry["k"].at[rows, carry["pos"]].set(k_new)
+        V = carry["v"].at[rows, carry["pos"]].set(v_new)
+        live = carry["pos"] + 1
+        mask = jnp.arange(max_len)[None, :] < live[:, None]
+        logits = (q[:, None, :] * K).sum(axis=-1) * scale
+        logits = jnp.where(mask, logits, -jnp.inf)
+        m = jnp.max(logits, axis=-1, keepdims=True)  # >= 1 live entry
+        p = jnp.where(mask, jnp.exp(logits - m), 0.0)
+        attn = ((p[:, :, None] * V).sum(axis=1)
+                / p.sum(axis=-1, keepdims=True))
+        y = jnp.tanh(mm(attn, Wo))
+        new = {"k": K, "v": V, "y": y,
+               "pos": jnp.minimum(live, max_len - 1)}
+        return new, y
+
+    template = {"k": onp.zeros((max_len, dim), onp.float32),
+                "v": onp.zeros((max_len, dim), onp.float32),
+                "y": onp.zeros((dim,), onp.float32),
+                "pos": onp.zeros((), onp.int32)}
+    return SessionModel(
+        "toy_decoder", step_fn, template,
+        input_specs=[((dim,), onp.float32)],
+        spec=f"toy_decoder:dim={dim},max_len={max_len},seed={seed}")
+
+
+#: Named session-model builders — the registry the server CLI /
+#: process replicas build from (``--session-model name=spec``): a
+#: subprocess cannot be handed a live python step function, only a
+#: spec string it can rebuild one from.
+SESSION_MODELS = {"toy_decoder": toy_decoder}
+
+
+def build_session_model(spec):
+    """``"toy_decoder"`` or ``"toy_decoder:dim=8,max_len=16"`` →
+    :class:`SessionModel` via the :data:`SESSION_MODELS` registry."""
+    kind, _, opts = str(spec).partition(":")
+    builder = SESSION_MODELS.get(kind)
+    if builder is None:
+        raise ValueError(
+            f"unknown session model {kind!r} (registered: "
+            f"{', '.join(sorted(SESSION_MODELS))})")
+    kw = {}
+    for opt in filter(None, (o.strip() for o in opts.split(","))):
+        k, sep, v = opt.partition("=")
+        if not sep:
+            raise ValueError(
+                f"session model option {opt!r} in {spec!r}: want k=v")
+        kw[k] = float(v) if "." in v else int(v)
+    model = builder(**kw)
+    model.spec = spec
+    return model
+
+
+# ---------------------------------------------------------------------------
+# session manager
+# ---------------------------------------------------------------------------
+
+class _Session:
+    __slots__ = ("sid", "carry", "steps", "t_created", "t_last",
+                 "busy", "closed", "snapshot_step", "t_snapshot",
+                 "ckpt")
+
+    def __init__(self, sid, carry, steps=0):
+        now = time.monotonic()
+        self.sid = sid
+        self.carry = carry          # leaf-row list, owner: manager
+        self.steps = int(steps)
+        self.t_created = now
+        self.t_last = now
+        self.busy = False           # checked out by the decode loop
+        self.closed = False
+        self.snapshot_step = int(steps)   # restored == snapshotted
+        self.t_snapshot = now
+        self.ckpt = None            # lazy AsyncCheckpointManager
+
+
+class SessionManager:
+    """Sessions of one model: create/step/close, eviction, snapshots.
+
+    One :class:`~.batcher.ContinuousBatcher` per manager runs the
+    shared decode loop; the manager owns every carry and hands rows to
+    the loop via ``checkout``/``writeback``/``release`` so a carry is
+    never concurrently stepped and snapshotted (snapshots land at step
+    boundaries — the crash-consistency point).
+    """
+
+    def __init__(self, name, model, metrics=None, admission=None,
+                 snapshot_dir=None, snapshot_steps=None, ttl_s=None,
+                 max_sessions=None, buckets=None, max_batch=None,
+                 warmup=True):
+        self.name = name
+        self.model = model
+        self.metrics = metrics
+        self.admission = admission or Admission()
+        self.snapshot_dir = (
+            snapshot_dir if snapshot_dir is not None
+            else get_env("MXNET_SERVING_SESSION_DIR", None))
+        self.snapshot_steps = int(
+            snapshot_steps if snapshot_steps is not None
+            else get_env("MXNET_SERVING_SESSION_SNAPSHOT_STEPS", 16,
+                         int))
+        self.ttl_s = float(
+            ttl_s if ttl_s is not None
+            else get_env("MXNET_SERVING_SESSION_TTL_S", 600.0, float))
+        self.max_sessions = int(
+            max_sessions if max_sessions is not None
+            else get_env("MXNET_SERVING_SESSION_MAX", 256, int))
+        self.max_stream_steps = get_env(
+            "MXNET_SERVING_SESSION_MAX_STEPS", 1024, int)
+        if self.max_sessions < 1 or self.snapshot_steps < 1:
+            raise ValueError(
+                "MXNET_SERVING_SESSION_MAX and "
+                "MXNET_SERVING_SESSION_SNAPSHOT_STEPS must be >= 1")
+        self.buckets = (list(buckets) if buckets is not None
+                        else parse_buckets())
+        self._sessions: dict[str, _Session] = {}
+        self._expired: dict[str, str] = {}   # sid -> reason (bounded)
+        self._evicted_dirs: list[str] = []   # snapshot trees to drop
+        self._lock = threading.Lock()
+        self.stream_ms = Histogram()
+        self._counters = {"steps": 0, "created": 0, "evicted": 0,
+                          "snapshots": 0, "snapshot_failures": 0,
+                          "restored": 0}
+        # periodic snapshots run on a dedicated thread so the decode
+        # loop NEVER does IO (measured: in-loop snapshots halve decode
+        # throughput); carry rows are immutable once written back, so
+        # the snapshotter works from a consistent (carry, steps) pair
+        # grabbed under the lock
+        self._snap_cond = threading.Condition()
+        self._snap_due: list[str] = []
+        self._snap_stop = False
+        self._snapshotter = None
+        if self.snapshot_dir is not None:
+            self._snapshotter = threading.Thread(
+                target=self._snapshot_loop,
+                name=f"session-snap-{name}", daemon=True)
+            self._snapshotter.start()
+        self.batcher = ContinuousBatcher(
+            name, model.step_batch, owner=self, buckets=self.buckets,
+            max_batch=max_batch, metrics=metrics)
+        if warmup:
+            sizes = sorted({b for b in self.buckets
+                            if b <= self.batcher.max_batch}
+                           | {self.batcher._bucket_for(
+                               self.batcher.max_batch)})
+            model.warmup(sizes)
+
+    # -- verbs --------------------------------------------------------
+
+    def create(self, session_id=None):
+        """New session with a fresh carry; returns its describe dict.
+        Past ``max_sessions`` the least-recently-used idle session is
+        evicted (its next use raises typed ``SessionExpiredError``)."""
+        self.sweep()
+        if self.admission.draining:
+            raise ShuttingDown(
+                f"session model {self.name!r} is draining")
+        sid = str(session_id) if session_id else uuid.uuid4().hex[:16]
+        try:
+            with self._lock:
+                if sid in self._sessions:
+                    raise ServingError(
+                        f"session {sid!r} already exists")
+                while len(self._sessions) >= self.max_sessions:
+                    victim = min(
+                        (s for s in self._sessions.values()
+                         if not s.busy),
+                        key=lambda s: s.t_last, default=None)
+                    if victim is None:
+                        from .admission import QueueFullError
+                        raise QueueFullError(
+                            f"session table for {self.name!r} is "
+                            f"full ({self.max_sessions}) and every "
+                            "session is mid-stream")
+                    self._evict_locked(victim.sid,
+                                       "evicted (session cap reached)")
+                s = _Session(sid, self.model.fresh_carry())
+                self._sessions[sid] = s
+                self._expired.pop(sid, None)
+                self._counters["created"] += 1
+        finally:
+            self._cleanup_evicted()
+        return self.describe_session(sid)
+
+    def step(self, sid, inputs, steps=1, deadline_ms=None,
+             stream=False):
+        """Run ``steps`` decode steps for ``sid`` through the shared
+        continuous batcher.  Returns ``(chunks, timing)``, or the
+        :class:`~.batcher.StreamResult` handle when ``stream=True``
+        (chunks then arrive on its queue as they decode)."""
+        steps = int(steps)
+        if not 1 <= steps <= self.max_stream_steps:
+            raise BadRequest(
+                f"steps must be in [1, {self.max_stream_steps}], got "
+                f"{steps}")
+        arrs = self.model.check_inputs(inputs)
+        self._peek(sid)   # fail fast with the typed error pre-queue
+        handle = self.batcher.submit(
+            sid, arrs, n_steps=steps,
+            deadline_ms=self.admission.deadline_ms(deadline_ms),
+            admit=self.admission.gate(self.name), stream=stream)
+        if stream:
+            return handle
+        return handle.result()
+
+    def close(self, sid):
+        """Forget the session and its snapshots.  A close while a
+        stream is queued/decoding truncates it typed at the next step
+        boundary."""
+        with self._lock:
+            s = self._sessions.pop(sid, None)
+            if s is None:
+                self._raise_gone(sid)
+            s.closed = True
+            self._remember_expired(sid, "closed")
+        self._drop_snapshots(sid)
+        return {"session_id": sid, "closed": True, "steps": s.steps}
+
+    def _peek(self, sid):
+        """Fail fast with the typed gone/expired error before a step
+        even queues (the batcher's checkout re-checks at admission)."""
+        try:
+            with self._lock:
+                s = self._sessions.get(sid)
+                if s is None:
+                    self._raise_gone(sid)
+                if self._ttl_expired(s):
+                    self._evict_locked(sid, "idle TTL expired")
+                    self._raise_gone(sid)
+        finally:
+            self._cleanup_evicted()
+
+    # -- carry lifecycle (called by the ContinuousBatcher worker) -----
+
+    def checkout(self, sid):
+        try:
+            with self._lock:
+                s = self._sessions.get(sid)
+                if s is None:
+                    self._raise_gone(sid)
+                if self._ttl_expired(s):
+                    self._evict_locked(sid, "idle TTL expired")
+                    self._raise_gone(sid)
+                s.busy = True
+                return s.carry
+        finally:
+            self._cleanup_evicted()
+
+    def writeback(self, sid, carry, step_ms):
+        """Land one decode step's new carry — the state every snapshot
+        and migration is based on.  Returns the session-absolute step
+        count (surfaced to clients so a migration's snapshot re-base
+        is *visible*, never silent).  Raises typed when the session
+        was closed mid-stream (the stream truncates)."""
+        with self._lock:
+            s = self._sessions.get(sid)
+            if s is None or s.closed:
+                raise SessionExpiredError(
+                    f"session {sid!r} on {self.name!r} was closed "
+                    "mid-stream")
+            s.carry = carry
+            s.steps += 1
+            steps = s.steps
+            s.t_last = time.monotonic()
+            self._counters["steps"] += 1
+            due = (self.snapshot_dir is not None
+                   and s.steps - s.snapshot_step >= self.snapshot_steps)
+        self.stream_ms.observe(step_ms)
+        if due:
+            with self._snap_cond:
+                if sid not in self._snap_due:
+                    self._snap_due.append(sid)
+                    self._snap_cond.notify()
+        return steps
+
+    def release(self, sid):
+        with self._lock:
+            s = self._sessions.get(sid)
+            if s is not None:
+                s.busy = False
+                s.t_last = time.monotonic()
+
+    # -- snapshots / restore ------------------------------------------
+
+    def _ckpt_of(self, s):
+        from ..checkpoint import AsyncCheckpointManager
+        if s.ckpt is None:
+            s.ckpt = AsyncCheckpointManager(
+                os.path.join(self.snapshot_dir, self.name, s.sid),
+                keep=2)
+        return s.ckpt
+
+    def _snapshot_loop(self):
+        """Dedicated snapshot worker: drains the due list, keeping IO
+        off the decode loop entirely."""
+        while True:
+            with self._snap_cond:
+                while not self._snap_due and not self._snap_stop:
+                    self._snap_cond.wait()
+                if self._snap_stop and not self._snap_due:
+                    return
+                sid = self._snap_due.pop(0)
+            with self._lock:
+                s = self._sessions.get(sid)
+            if s is not None:
+                self._snapshot(s)
+
+    def _snapshot(self, s, sync=False):
+        """CRC'd carry snapshot of a step boundary.  ``(carry,
+        steps)`` is grabbed atomically under the lock — carry rows are
+        never mutated in place, so the pair stays consistent while the
+        decode loop races ahead.  Failures are counted and logged,
+        never fatal: the stream keeps decoding and the next period
+        retries — a lost snapshot only widens the window a migration
+        re-bases over."""
+        with self._lock:
+            rows, steps = s.carry, s.steps
+        try:
+            fault.inject("serving.session_snapshot",
+                         f"{self.name}:{s.sid}")
+            ckpt = self._ckpt_of(s)
+            ckpt.save(steps, self.model.flat_of_carry(rows),
+                      wait=sync)
+            with self._lock:
+                s.snapshot_step = max(s.snapshot_step, steps)
+                s.t_snapshot = time.monotonic()
+                self._counters["snapshots"] += 1
+        except Exception as e:  # mxlint: allow-broad-except(a failed snapshot must never kill the live stream — counted, logged, retried next period)
+            with self._lock:
+                self._counters["snapshot_failures"] += 1
+            _log.warning("session %s/%s: snapshot at step %d failed: "
+                         "%s: %s", self.name, s.sid, steps,
+                         type(e).__name__, e)
+
+    def snapshot_all(self, sync=True):
+        """Snapshot every live session (drain path: a migration after
+        a clean drain continues from the CURRENT step, losslessly).
+        With ``sync`` this also AWAITS snapshots the background
+        snapshotter already dispatched — "drained" must mean durable,
+        not merely scheduled."""
+        if self.snapshot_dir is None:
+            return 0
+        with self._lock:
+            sessions = list(self._sessions.values())
+        live = [s for s in sessions if s.steps > s.snapshot_step]
+        for s in live:
+            self._snapshot(s, sync=sync)
+        if sync:
+            for s in sessions:
+                if s in live or s.ckpt is None:
+                    continue
+                try:
+                    s.ckpt.wait()
+                except Exception as e:  # mxlint: allow-broad-except(a failed in-flight snapshot write is counted like any snapshot failure — the drain itself must not die on it)
+                    with self._lock:
+                        self._counters["snapshot_failures"] += 1
+                    _log.warning("session %s/%s: in-flight snapshot "
+                                 "failed at drain: %s: %s", self.name,
+                                 s.sid, type(e).__name__, e)
+        return len(live)
+
+    def restore(self, sid):
+        """Adopt a session from its latest valid snapshot (written by
+        this replica or any other sharing ``snapshot_dir``).  The
+        rebuilt carry is bitwise the snapshotted one — continuation is
+        bitwise-equal to an unbroken run from that snapshot.  No
+        usable snapshot ⇒ typed :class:`~..error.SessionLostError`."""
+        with self._lock:
+            live = sid in self._sessions
+        if live:
+            # idempotent adopt: a retried adopt whose first response
+            # was lost must not fail — the live carry here is at
+            # least as new as any snapshot
+            return self.describe_session(sid)
+        if self.snapshot_dir is None:
+            raise SessionLostError(
+                f"session {sid!r} cannot be restored: no "
+                "MXNET_SERVING_SESSION_DIR snapshot directory is "
+                "configured")
+        from ..checkpoint import AsyncCheckpointManager
+        d = os.path.join(self.snapshot_dir, self.name, sid)
+        if not os.path.isdir(d):
+            raise SessionLostError(
+                f"session {sid!r} has no snapshot under {d} — its "
+                "replica died before the first snapshot period")
+        try:
+            ckpt = AsyncCheckpointManager(d, keep=2)
+            if not ckpt.all_steps():
+                raise FileNotFoundError("no committed snapshot")
+            # walk newest-first OURSELVES so the restored step counter
+            # always names the snapshot that actually loaded — a
+            # fallback past a torn newest snapshot must re-base the
+            # session's step count along with its carry
+            from ..error import CheckpointCorruptError
+            flat, steps, last_err = None, None, None
+            for step in reversed(ckpt.all_steps()):
+                try:
+                    flat = ckpt.restore(step=step)
+                    steps = step
+                    break
+                except CheckpointCorruptError as e:
+                    last_err = e
+            if flat is None:
+                raise last_err
+        except SessionLostError:
+            raise
+        except Exception as e:  # mxlint: allow-broad-except(every restore failure — corrupt/missing/torn snapshots included — must surface as the ONE typed error the failover contract names)
+            raise SessionLostError(
+                f"session {sid!r} snapshot unusable: "
+                f"{type(e).__name__}: {e}") from e
+        carry = self.model.carry_from_flat(flat)
+        with self._lock:
+            # a racing adopt of the same sid: whoever landed first
+            # wins (its carry may already be ahead of this snapshot)
+            if sid not in self._sessions:
+                s = _Session(sid, carry, steps=steps)
+                s.ckpt = ckpt
+                self._sessions[sid] = s
+                self._expired.pop(sid, None)
+                self._counters["restored"] += 1
+        return self.describe_session(sid)
+
+    def _drop_snapshots(self, sid):
+        if self.snapshot_dir is not None:
+            shutil.rmtree(
+                os.path.join(self.snapshot_dir, self.name, sid),
+                ignore_errors=True)
+
+    # -- eviction -----------------------------------------------------
+
+    def _ttl_expired(self, s):
+        return (not s.busy
+                and time.monotonic() - s.t_last > self.ttl_s)
+
+    def _evict_locked(self, sid, reason):
+        self._sessions.pop(sid, None)
+        self._remember_expired(sid, reason)
+        self._counters["evicted"] += 1
+        # snapshots die with the session (an evicted id must not be
+        # resurrectable via :adopt, and churn must not leak disk) —
+        # but rmtree is IO, so it runs after the lock is released
+        self._evicted_dirs.append(sid)
+
+    def _cleanup_evicted(self):
+        """Drop evicted sessions' snapshot trees (called OUTSIDE the
+        lock by every eviction site)."""
+        while True:
+            with self._lock:
+                if not self._evicted_dirs:
+                    return
+                sid = self._evicted_dirs.pop()
+            self._drop_snapshots(sid)
+
+    def _remember_expired(self, sid, reason):
+        self._expired[sid] = reason
+        while len(self._expired) > 1024:
+            self._expired.pop(next(iter(self._expired)))
+
+    def _raise_gone(self, sid):
+        reason = self._expired.get(sid)
+        if reason is not None:
+            raise SessionExpiredError(
+                f"session {sid!r} on {self.name!r} is gone: {reason}")
+        raise SessionNotFound(
+            f"no session {sid!r} on model {self.name!r}")
+
+    def sweep(self):
+        """Evict idle-past-TTL sessions (run opportunistically on
+        create/describe — eviction also happens lazily at checkout, so
+        an unswept session can never serve stale)."""
+        with self._lock:
+            for sid in [sid for sid, s in self._sessions.items()
+                        if self._ttl_expired(s)]:
+                self._evict_locked(sid, "idle TTL expired")
+        self._cleanup_evicted()
+
+    # -- introspection -------------------------------------------------
+
+    def describe_session(self, sid):
+        with self._lock:
+            s = self._sessions.get(sid)
+            if s is None:
+                self._raise_gone(sid)
+            now = time.monotonic()
+            return {"session_id": sid, "model": self.name,
+                    "steps": s.steps,
+                    "age_s": round(now - s.t_created, 3),
+                    "idle_s": round(now - s.t_last, 3),
+                    "snapshot_step": s.snapshot_step,
+                    "busy": s.busy}
+
+    def describe(self):
+        """The pinned JSON shape ``/healthz`` and tests rely on."""
+        self.sweep()
+        with self._lock:
+            n = len(self._sessions)
+            counters = dict(self._counters)
+        return {"model": self.name,
+                "spec": self.model.spec,
+                "state": "draining" if not self.batcher._running
+                         else "ready",
+                "active_sessions": n,
+                "active_streams": self.batcher.active_streams,
+                "queue_depth": self.batcher.depth,
+                "steps_total": counters["steps"],
+                "snapshots": counters["snapshots"],
+                "snapshot_failures": counters["snapshot_failures"],
+                "evicted": counters["evicted"],
+                "restored": counters["restored"],
+                "compile_count": self.model.compile_count,
+                "buckets": list(self.buckets),
+                "snapshot_steps": self.snapshot_steps,
+                "ttl_s": self.ttl_s,
+                "max_sessions": self.max_sessions}
+
+    def stats(self):
+        """Flat gauge view for metrics/profiler exposition."""
+        with self._lock:
+            n = len(self._sessions)
+            counters = dict(self._counters)
+            oldest = max(
+                (time.monotonic() - s.t_snapshot
+                 for s in self._sessions.values()
+                 if s.steps > 0), default=0.0)
+        out = {"active_sessions": n,
+               "steps_total": counters["steps"],
+               "snapshots_total": counters["snapshots"],
+               "snapshot_failures_total":
+                   counters["snapshot_failures"],
+               "evictions_total": counters["evicted"],
+               "restored_total": counters["restored"],
+               "snapshot_age_s": round(oldest, 3),
+               "compile_count": self.model.compile_count,
+               "stream_ms": self.stream_ms.snapshot()}
+        return out
+
+    # -- lifecycle ----------------------------------------------------
+
+    def drain(self, timeout=30.0):
+        """Stop the decode loop (active streams truncate typed at the
+        next step boundary), retire the snapshotter, then snapshot
+        every session synchronously — a post-drain migration is
+        lossless."""
+        self.batcher.drain(timeout)
+        with self._snap_cond:
+            self._snap_stop = True
+            self._snap_cond.notify_all()
+        if self._snapshotter is not None:
+            self._snapshotter.join(timeout)
+            self._snapshotter = None
+        self.snapshot_all(sync=True)
+
+    close_manager = drain
+
+
+# ---------------------------------------------------------------------------
+# session host: the per-process registry (server + thread replicas)
+# ---------------------------------------------------------------------------
+
+class SessionHost:
+    """Session managers of one serving process, keyed by model name —
+    the sessions-side twin of :class:`~.model_repository
+    .ModelRepository` (shared admission, shared metrics)."""
+
+    def __init__(self, metrics=None, admission=None, snapshot_dir=None,
+                 buckets=None):
+        self.metrics = metrics
+        self.admission = admission or Admission()
+        self.snapshot_dir = snapshot_dir
+        self._buckets = buckets
+        self._managers: dict[str, SessionManager] = {}
+        self._lock = threading.Lock()
+        if metrics is not None:
+            metrics.attach_sessions(self)
+
+    def add(self, name, model, **kw):
+        """Register a session model (a :class:`SessionModel` or a
+        registry spec string) under ``name``; warms its buckets."""
+        with self._lock:
+            # fail BEFORE the expensive build (bucket warmup compiles,
+            # snapshotter thread) — a duplicate name is a caller error,
+            # not worth seconds of work and a thread to tear down
+            if name in self._managers:
+                raise ServingError(
+                    f"session model {name!r} already registered")
+        if isinstance(model, str):
+            model = build_session_model(model)
+        kw.setdefault("snapshot_dir", self.snapshot_dir)
+        kw.setdefault("buckets", self._buckets)
+        manager = SessionManager(name, model, metrics=self.metrics,
+                                 admission=self.admission, **kw)
+        with self._lock:
+            if name in self._managers:
+                # raced another add: full teardown (decode loop AND
+                # snapshotter), then the duplicate error
+                manager.drain()
+                raise ServingError(
+                    f"session model {name!r} already registered")
+            self._managers[name] = manager
+        return manager
+
+    def get(self, name):
+        with self._lock:
+            m = self._managers.get(name)
+        if m is None:
+            raise ModelNotFound(
+                f"session model {name!r} is not registered")
+        return m
+
+    def names(self):
+        with self._lock:
+            return sorted(self._managers)
+
+    def describe(self):
+        with self._lock:
+            managers = dict(self._managers)
+        return {name: m.describe() for name, m in managers.items()}
+
+    def stats(self):
+        with self._lock:
+            managers = dict(self._managers)
+        return {name: m.stats() for name, m in managers.items()}
+
+    def stream_hists(self):
+        with self._lock:
+            managers = dict(self._managers)
+        return {name: m.stream_ms for name, m in managers.items()}
+
+    def compile_counts(self):
+        with self._lock:
+            managers = dict(self._managers)
+        return {name: m.model.compile_count
+                for name, m in managers.items()}
+
+    def queue_depths(self):
+        with self._lock:
+            managers = dict(self._managers)
+        return {name: m.batcher.depth for name, m in managers.items()}
+
+    def drain_all(self, timeout=30.0):
+        with self._lock:
+            managers = list(self._managers.values())
+        for m in managers:
+            m.drain(timeout)

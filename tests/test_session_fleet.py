@@ -1,0 +1,443 @@
+"""Session failover tests (ISSUE 11): affinity, migration, SIGKILL.
+
+The failover contract under test (docs/serving.md "Sessions"): a
+session's carry lives on exactly one replica; on replica death or
+drain the router either migrates the session from its latest CRC'd
+snapshot onto a surviving replica — resumed continuation bitwise-equal
+to an unbroken run from that snapshot — or fails with typed
+``SessionLostError``.  Never a hang, never a stream that silently
+restarts from scratch.  The ``sessions`` CI stage re-runs this file
+under the pinned seeded chaos spec.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu.error import SessionLostError
+from incubator_mxnet_tpu.serving import ReplicaFleet, FleetRouter
+from incubator_mxnet_tpu.serving.sessions import (SessionManager,
+                                                  toy_decoder)
+
+DIM = 8
+SPEC = "toy_decoder:dim=8,max_len=64"
+BUCKETS = [1, 2, 4]
+
+
+def _x(v=0.1):
+    return (onp.full(DIM, v, onp.float32),)
+
+
+def _fleet(tmp_path, n=2, snapshot_steps=2, **kw):
+    # n=2 and no warmup keep tier-1 runtime lean: every test below
+    # kills at most one replica, and decode compiles on demand (the
+    # compile-flatline contract is test_sessions' job)
+    kw.setdefault("backend", "thread")
+    kw.setdefault("buckets", BUCKETS)
+    kw.setdefault("probe_ms", 60000.0)
+    kw.setdefault("warmup", False)
+    fleet = ReplicaFleet({}, n=n, session_models={"dec": SPEC},
+                         session_dir=str(tmp_path / "snaps"),
+                         **kw).spawn()
+    if kw["backend"] == "thread" and snapshot_steps is not None:
+        for r in fleet.replicas:
+            r.sessions.get("dec").snapshot_steps = snapshot_steps
+    return fleet
+
+
+_REF = {"mgr": None, "n": 0}
+
+
+def _ref_chunks(n_steps, v=0.1):
+    """Unbroken single-session reference (same registry spec); one
+    shared manager for the whole module — reference decode is always
+    batch 1, so one bucket-1 executable serves every call."""
+    mgr = _REF["mgr"]
+    if mgr is None:
+        mgr = _REF["mgr"] = SessionManager(
+            "ref", toy_decoder(dim=DIM, max_len=64), buckets=[1],
+            warmup=False)
+    _REF["n"] += 1
+    sid = f"ref{_REF['n']}"
+    mgr.create(sid)
+    chunks, _ = mgr.step(sid, _x(v), steps=n_steps)
+    mgr.close(sid)
+    return [onp.asarray(c[0]) for c in chunks]
+
+
+def _await_durable_snapshot(tmp_path, sid, nudge=None, deadline_s=20):
+    """Block until ``sid`` has >= 1 COMMITTED snapshot on disk.
+
+    Snapshots are async: a replica killed before its first durable
+    snapshot legitimately loses the session (typed) — the tests below
+    exercise the MIGRATE arm, so they pin the precondition.  Under the
+    chaos spec a snapshot write may be injected to fail; ``nudge``
+    (one extra decode step) re-arms the snapshotter, so the wait
+    converges under fault injection too."""
+    d = tmp_path / "snaps" / "dec" / sid
+    end = time.monotonic() + deadline_s
+    last_nudge = 0.0
+    while time.monotonic() < end:
+        if d.is_dir() and any((p / "index.json").exists()
+                              for p in d.glob("step_*")):
+            return
+        now = time.monotonic()
+        if nudge is not None and now - last_nudge > 0.5:
+            last_nudge = now
+            nudge()
+        time.sleep(0.05)
+    raise AssertionError(f"no durable snapshot for {sid!r} within "
+                         f"{deadline_s}s")
+
+
+def _assert_continuation(cont_chunks, timing, v=0.1):
+    """The core bitwise assertion, re-base-aware: wherever the resumed
+    session actually continued from (``session_steps`` tells us — the
+    re-base is VISIBLE, never silent), the continuation must equal an
+    unbroken run from that step."""
+    base = timing["session_steps"] - timing["steps"]
+    ref = _ref_chunks(base + timing["steps"], v=v)
+    for got, want in zip(cont_chunks, ref[base:]):
+        assert (onp.asarray(got[0]) == want).all(), \
+            f"continuation diverged from unbroken run (base {base})"
+    return base
+
+
+# ---------------------------------------------------------------------------
+# affinity + in-fleet lifecycle (thread backend)
+# ---------------------------------------------------------------------------
+
+def test_affinity_create_step_close(tmp_path):
+    fleet = _fleet(tmp_path)
+    router = FleetRouter(fleet)
+    try:
+        info = router.session_create("dec", "s1")
+        assert info["replica"] in {r.rid for r in fleet.replicas}
+        chunks, t = router.session_step("dec", "s1", _x(), steps=4)
+        assert t["steps"] == 4
+        # the carry lives where affinity says it lives
+        with router._session_lock:
+            model, rid = router._session_homes["s1"]
+        assert model == "dec"
+        d = fleet.get(rid).sessions.get("dec").describe_session("s1")
+        assert d["steps"] == t["session_steps"]
+        out = router.session_close("dec", "s1")
+        assert out["closed"] is True
+        from incubator_mxnet_tpu.serving.sessions import \
+            SessionNotFound
+        with pytest.raises(SessionNotFound):
+            router.session_step("dec", "s1", _x())
+    finally:
+        router.shutdown()
+
+
+def test_fleet_sessions_bitwise_equal_solo(tmp_path):
+    """Sessions spread over a fleet, stepped concurrently, each match
+    their solo reference bitwise — batching and routing invisible."""
+    fleet = _fleet(tmp_path)
+    router = FleetRouter(fleet)
+    outs, errors = {}, []
+
+    def run(i):
+        try:
+            sid = f"c{i}"
+            router.session_create("dec", sid)
+            chunks, t = router.session_step(
+                "dec", sid, _x(0.1 * (i + 1)), steps=5)
+            outs[i] = (chunks, t)
+        except Exception as e:  # noqa: BLE001 — recorded for assert
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        for i, (chunks, t) in outs.items():
+            _assert_continuation(chunks, t, v=0.1 * (i + 1))
+    finally:
+        router.shutdown()
+
+
+def test_kill_owner_migrates_bitwise_from_snapshot(tmp_path):
+    fleet = _fleet(tmp_path, snapshot_steps=2)
+    router = FleetRouter(fleet)
+    try:
+        info = router.session_create("dec", "s1")
+        router.session_step("dec", "s1", _x(), steps=5)
+        _await_durable_snapshot(
+            tmp_path, "s1",
+            nudge=lambda: router.session_step("dec", "s1", _x(),
+                                              steps=1))
+        fleet.kill(info["replica"])
+        cont, t = router.session_step("dec", "s1", _x(), steps=3)
+        base = _assert_continuation(cont, t)
+        assert base >= 2      # resumed from a real snapshot
+        assert router.metrics.snapshot()["migrations"] >= 1
+        # the new home answers follow-up steps without drama
+        cont2, t2 = router.session_step("dec", "s1", _x(), steps=2)
+        _assert_continuation(cont2, t2)
+    finally:
+        router.shutdown()
+
+
+def test_kill_without_snapshot_typed_loss_never_hang(tmp_path):
+    fleet = _fleet(tmp_path, snapshot_steps=10 ** 6)  # never snapshots
+    router = FleetRouter(fleet)
+    try:
+        info = router.session_create("dec", "s1")
+        router.session_step("dec", "s1", _x(), steps=3)
+        fleet.kill(info["replica"])
+        t0 = time.monotonic()
+        with pytest.raises(SessionLostError):
+            router.session_step("dec", "s1", _x(), steps=1,
+                                deadline_ms=10000)
+        assert time.monotonic() - t0 < 30   # typed, promptly
+        assert router.metrics.snapshot()["session_losses"] == 1
+        # the affinity entry is dropped: a retry 404s fast
+        from incubator_mxnet_tpu.serving.sessions import \
+            SessionNotFound
+        with pytest.raises(SessionNotFound):
+            router.session_step("dec", "s1", _x())
+    finally:
+        router.shutdown()
+
+
+def test_replica_close_drain_migration_is_lossless(tmp_path):
+    """A clean close (drain path) snapshots every session's CURRENT
+    carry — migration after it loses zero steps."""
+    fleet = _fleet(tmp_path, snapshot_steps=10 ** 6)  # periodic off
+    router = FleetRouter(fleet)
+    try:
+        info = router.session_create("dec", "s1")
+        _, t = router.session_step("dec", "s1", _x(), steps=7)
+        r = fleet.get(info["replica"])
+        r.close()         # graceful: snapshot-on-drain, then DEAD
+        cont, t2 = router.session_step("dec", "s1", _x(), steps=3)
+        base = _assert_continuation(cont, t2)
+        assert base == t["session_steps"]   # lossless
+    finally:
+        router.shutdown()
+
+
+def test_sessions_survive_rolling_reload_of_other_models(tmp_path):
+    """Sessions keep their carry across a drain+readmit cycle of
+    their replica (the rolling-reload shape): affinity steps to a
+    DRAINING replica still run — drain blocks new placements, not
+    live carries."""
+    fleet = _fleet(tmp_path)
+    router = FleetRouter(fleet)
+    try:
+        info = router.session_create("dec", "s1")
+        router.session_step("dec", "s1", _x(), steps=3)
+        r = fleet.get(info["replica"])
+        r.begin_drain()
+        cont, t = router.session_step("dec", "s1", _x(), steps=2)
+        assert t["session_steps"] == 5     # no re-base: same carry
+        _assert_continuation(cont, t)
+        r.readmit()
+        router.session_step("dec", "s1", _x(), steps=1)
+    finally:
+        router.shutdown()
+
+
+def test_stream_through_router_parity_and_midkill_typed(tmp_path):
+    fleet = _fleet(tmp_path, snapshot_steps=2)
+    router = FleetRouter(fleet)
+    try:
+        info = router.session_create("dec", "s1")
+        got = []
+        chunks, t = router.session_step("dec", "s1", _x(), steps=4,
+                                        on_chunk=got.append)
+        assert len(got) == 4
+        _assert_continuation(chunks, t)
+        for a, b in zip(got, chunks):
+            assert (onp.asarray(a[0]) == onp.asarray(b[0])).all()
+        # kill the owner mid-stream: the STREAM breaks typed (chunks
+        # cannot be unsent), the SESSION survives via migration
+        _await_durable_snapshot(
+            tmp_path, "s1",
+            nudge=lambda: router.session_step("dec", "s1", _x(),
+                                              steps=1))
+        owner = router._session_homes["s1"][1]
+        n_before = []
+
+        def kill_after_chunks(chunk):
+            n_before.append(chunk)
+            if len(n_before) == 3:
+                fleet.kill(owner)
+
+        from incubator_mxnet_tpu.serving.admission import ShuttingDown
+        with pytest.raises((ConnectionError, ShuttingDown)):
+            router.session_step("dec", "s1", _x(), steps=500,
+                                deadline_ms=20000,
+                                on_chunk=kill_after_chunks)
+        assert len(n_before) >= 3
+        cont, t2 = router.session_step("dec", "s1", _x(), steps=2)
+        _assert_continuation(cont, t2)
+        assert router.metrics.snapshot()["migrations"] >= 1
+    finally:
+        router.shutdown()
+
+
+def test_router_http_session_endpoints(tmp_path):
+    fleet = _fleet(tmp_path, snapshot_steps=2)
+    router = FleetRouter(fleet)
+    port = router.start()
+
+    def post(path, body, timeout=60):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+
+    try:
+        code, d = post("/v1/sessions/dec:create", {"session_id": "h1"})
+        assert code == 200 and d["replica"]
+        code, d = post("/v1/sessions/dec/h1:step",
+                       {"inputs": [_x()[0].tolist()], "steps": 3})
+        assert code == 200 and d["steps"] == 3
+        assert d["timing"]["session_steps"] == 3
+        # streamed over the wire, then the parity check
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/sessions/dec/h1:step",
+            data=json.dumps({"inputs": [_x()[0].tolist()],
+                             "steps": 3, "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        lines = []
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            for line in resp:
+                lines.append(json.loads(line))
+        assert lines[-1].get("done") is True
+        streamed = [ln["outputs"] for ln in lines if "outputs" in ln]
+        assert len(streamed) == 3
+        # kill everything holding the session and its snapshots are
+        # still there: migration serves the NEXT HTTP step
+        owner = router._session_homes["h1"][1]
+        fleet.kill(owner)
+        code, d = post("/v1/sessions/dec/h1:step",
+                       {"inputs": [_x()[0].tolist()], "steps": 1})
+        assert code == 200
+        code, d = post("/v1/sessions/dec/h1:close", {})
+        assert d["closed"] is True
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("/v1/sessions/dec/h1:step",
+                 {"inputs": [_x()[0].tolist()]})
+        assert ei.value.code in (404, 410)
+    finally:
+        router.shutdown()
+
+
+def test_fleet_metrics_expose_session_counters(tmp_path):
+    fleet = _fleet(tmp_path, snapshot_steps=2)
+    router = FleetRouter(fleet)
+    try:
+        info = router.session_create("dec", "m1")
+        router.session_step("dec", "m1", _x(), steps=4)
+        _await_durable_snapshot(
+            tmp_path, "m1",
+            nudge=lambda: router.session_step("dec", "m1", _x(),
+                                              steps=1))
+        fleet.kill(info["replica"])
+        router.session_step("dec", "m1", _x(), steps=1)
+        text = router.metrics.render()
+        assert "mxnet_serving_fleet_sessions 1" in text
+        assert ("mxnet_serving_fleet_session_migrations_total 1"
+                in text)
+        assert "mxnet_serving_fleet_session_losses_total 0" in text
+        snap = router.metrics.snapshot()
+        assert snap["sessions"] == 1 and snap["migrations"] == 1
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance chaos proof: SIGKILL a process replica mid-stream
+# (real subprocesses; slow — the `sessions` CI stage and the `slow`
+# stage run it, tier-1 skips it, same split as test_fleet's
+# subprocess end-to-end)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_sigkill_process_replica_midstream_two_sessions(tmp_path):
+    """ISSUE 11 acceptance: SIGKILL a real subprocess replica
+    mid-stream with >= 2 active sessions.  Every session must either
+    resume on a surviving replica with continuation bitwise-equal to
+    an unbroken run from its last snapshot, or raise typed
+    ``SessionLostError`` — zero hangs, zero silent restarts."""
+    fleet = ReplicaFleet({}, n=2, backend="process",
+                         probe_ms=60000.0,
+                         session_models={"dec": SPEC},
+                         session_dir=str(tmp_path / "snaps")).spawn()
+    router = FleetRouter(fleet)
+    try:
+        router.session_create("dec", "a")
+        router.session_create("dec", "b")
+        # both sessions decode past the default snapshot period (16)
+        _, ta = router.session_step("dec", "a", _x(0.1), steps=20,
+                                    deadline_ms=60000)
+        _, tb = router.session_step("dec", "b", _x(0.2), steps=18,
+                                    deadline_ms=60000)
+        assert ta["session_steps"] == 20 and tb["session_steps"] == 18
+        # snapshots are async: wait until both sessions have a
+        # durable one, so the kill exercises the MIGRATE arm for both
+        for sid, v in (("a", 0.1), ("b", 0.2)):
+            _await_durable_snapshot(
+                tmp_path, sid,
+                nudge=lambda s=sid, vv=v: router.session_step(
+                    "dec", s, _x(vv), steps=1, deadline_ms=30000))
+        owner_a = router._session_homes["a"][1]
+
+        # SIGKILL the owner while session a is MID-STREAM
+        seen = []
+
+        def killer(chunk):
+            seen.append(chunk)
+            if len(seen) == 5:
+                fleet.kill(owner_a)   # real SIGKILL
+
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            # the visible break: typed, never a hang, chunks already
+            # written are never silently re-sent
+            router.session_step("dec", "a", _x(0.1), steps=500,
+                                deadline_ms=30000, on_chunk=killer)
+        assert len(seen) >= 5
+        assert time.monotonic() - t0 < 60
+
+        # every session now resumes bitwise-from-snapshot or loses
+        # typed — and nothing hangs
+        resumed = {}
+        for sid, v in (("a", 0.1), ("b", 0.2)):
+            t1 = time.monotonic()
+            try:
+                cont, tc = router.session_step(
+                    "dec", sid, _x(v), steps=3, deadline_ms=30000)
+                base = _assert_continuation(cont, tc, v=v)
+                resumed[sid] = base
+            except SessionLostError:
+                resumed[sid] = None
+            assert time.monotonic() - t1 < 60
+        # sessions homed on the dead replica had >= 1 snapshot (they
+        # ran >= 16 steps), so migration must have succeeded for them
+        assert resumed["a"] is not None and resumed["a"] >= 16
+        assert resumed["b"] is not None
+        snap = router.metrics.snapshot()
+        assert snap["migrations"] >= 1
+        assert snap["replicas"][owner_a]["state"] == "dead"
+        # fresh sessions land on the survivor and just work
+        router.session_create("dec", "fresh")
+        _, tf = router.session_step("dec", "fresh", _x(0.3), steps=2,
+                                    deadline_ms=30000)
+        assert tf["session_steps"] == 2
+    finally:
+        router.shutdown()

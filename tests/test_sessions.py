@@ -1,0 +1,806 @@
+"""Stateful-session tests (ISSUE 11 tentpole, manager + HTTP layers).
+
+The contracts under test:
+
+* **Continuous batching** — streams join/leave a running batch between
+  decode steps; outputs are bitwise-equal to solo decode, and the
+  compile count stays flat across join/leave after warmup.
+* **Crash safety** — CRC'd snapshots restore bitwise; every defined
+  ending (TTL, cap eviction, close, drain, loss) is TYPED, never a
+  hang.
+* **Streaming parity** — the chunked stream's concatenation is
+  bitwise-equal to the non-streamed response.
+* **Cancellation** — client disconnects cancel queued work and are
+  counted.
+
+The ``sessions`` CI stage re-runs this file under a pinned seeded
+``MXNET_FAULT_SPEC`` (errors on ``serving.session_step`` /
+``serving.session_snapshot``, replica faults, route delays), so every
+assertion here must hold with chaos injected as well as without.
+"""
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import fault
+from incubator_mxnet_tpu.error import (SessionExpiredError,
+                                       SessionLostError)
+from incubator_mxnet_tpu.serving.admission import (Admission,
+                                                   BadRequest,
+                                                   DeadlineExceeded,
+                                                   QueueFullError,
+                                                   ShuttingDown,
+                                                   retry_after_s)
+from incubator_mxnet_tpu.serving.metrics import ServingMetrics
+from incubator_mxnet_tpu.serving.server import (InferenceServer,
+                                                health_body)
+from incubator_mxnet_tpu.serving.sessions import (SESSION_MODELS,
+                                                  SessionManager,
+                                                  SessionNotFound,
+                                                  build_session_model,
+                                                  toy_decoder)
+
+DIM = 8
+BUCKETS = [1, 2, 4]
+
+
+def _model(max_len=64, seed=0):
+    return toy_decoder(dim=DIM, max_len=max_len, seed=seed)
+
+
+def _mgr(tmp_path=None, **kw):
+    kw.setdefault("buckets", BUCKETS)
+    # decode executables compile on demand (tier-1 lean); the
+    # compile-universe/flatline contract opts into warmup explicitly
+    kw.setdefault("warmup", False)
+    kw.setdefault("snapshot_dir",
+                  str(tmp_path / "snaps") if tmp_path else None)
+    model = kw.pop("model", None) or _model()
+    return SessionManager("dec", model, **kw)
+
+
+def _x(v=0.1):
+    return (onp.full(DIM, v, onp.float32),)
+
+
+_REF = {"mgr": None, "n": 0}
+
+
+def _ref_chunks(n_steps, v=0.1):
+    """Unbroken single-session reference run (fresh carry, shared
+    module-wide manager — reference decode is always batch 1)."""
+    mgr = _REF["mgr"]
+    if mgr is None:
+        mgr = _REF["mgr"] = SessionManager(
+            "ref", _model(), buckets=[1], warmup=False)
+    _REF["n"] += 1
+    sid = f"ref{_REF['n']}"
+    mgr.create(sid)
+    chunks, _ = mgr.step(sid, _x(v), steps=n_steps)
+    mgr.close(sid)
+    return [onp.asarray(c[0]) for c in chunks]
+
+
+@pytest.fixture
+def no_chaos():
+    """Mask the CI stage's pinned fault spec for tests that pin EXACT
+    snapshot schedules (which snapshot landed at which step) — their
+    chaos coverage lives in the dedicated fault-injection tests and
+    the re-base-aware migration tests instead."""
+    fault.configure(None)
+    yield
+    fault.reset()
+
+
+# ---------------------------------------------------------------------------
+# model + manager basics
+# ---------------------------------------------------------------------------
+
+def test_registry_builds_from_spec():
+    m = build_session_model("toy_decoder:dim=8,max_len=16,seed=3")
+    assert m.input_specs == [((8,), onp.dtype(onp.float32))]
+    with pytest.raises(ValueError):
+        build_session_model("no_such_model")
+    assert "toy_decoder" in SESSION_MODELS
+
+
+def test_create_step_close_lifecycle(tmp_path):
+    mgr = _mgr(tmp_path)
+    try:
+        d = mgr.create("s1")
+        assert d["session_id"] == "s1" and d["steps"] == 0
+        chunks, timing = mgr.step("s1", _x(), steps=3)
+        assert timing["steps"] == 3 and timing["session_steps"] == 3
+        assert len(chunks) == 3
+        out = mgr.close("s1")
+        assert out == {"session_id": "s1", "closed": True, "steps": 3}
+        with pytest.raises(SessionExpiredError):
+            mgr.step("s1", _x())
+        with pytest.raises(SessionNotFound):
+            mgr.step("never-created", _x())
+    finally:
+        mgr.batcher.drain()
+
+
+def test_step_input_validation(tmp_path):
+    mgr = _mgr(tmp_path)
+    try:
+        mgr.create("s1")
+        with pytest.raises(BadRequest):
+            mgr.step("s1", (onp.zeros(DIM + 1, onp.float32),))
+        with pytest.raises(BadRequest):
+            mgr.step("s1", ())
+        with pytest.raises(BadRequest):
+            mgr.step("s1", _x(), steps=0)
+        with pytest.raises(BadRequest):
+            mgr.step("s1", _x(), steps=10 ** 9)
+    finally:
+        mgr.batcher.drain()
+
+
+def test_solo_decode_matches_reference(tmp_path):
+    mgr = _mgr(tmp_path)
+    try:
+        mgr.create("s1")
+        chunks, _ = mgr.step("s1", _x(), steps=6)
+        ref = _ref_chunks(6)
+        for got, want in zip(chunks, ref):
+            assert (onp.asarray(got[0]) == want).all()
+    finally:
+        mgr.batcher.drain()
+
+
+# ---------------------------------------------------------------------------
+# continuous batching: join/leave, bitwise parity, compile flatline
+# ---------------------------------------------------------------------------
+
+def test_concurrent_sessions_bitwise_equal_solo(tmp_path):
+    """N sessions decoding concurrently (riding shared padded decode
+    steps) produce bitwise the same streams as each decoding alone —
+    THE continuous-batching correctness contract."""
+    mgr = _mgr(tmp_path)
+    outs = {}
+    errors = []
+
+    def run(i):
+        try:
+            sid = f"c{i}"
+            mgr.create(sid)
+            chunks, _ = mgr.step(sid, _x(0.1 * (i + 1)), steps=6)
+            outs[i] = chunks
+        except Exception as e:  # noqa: BLE001 — recorded for assert
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        for i in range(5):
+            ref = _ref_chunks(6, v=0.1 * (i + 1))
+            for got, want in zip(outs[i], ref):
+                assert (onp.asarray(got[0]) == want).all(), \
+                    f"session {i} diverged from its solo run"
+    finally:
+        mgr.batcher.drain()
+
+
+def test_compile_count_flat_across_join_leave(tmp_path):
+    """After warmup the bucket set is the whole compile universe:
+    sessions joining and leaving mid-decode must not build a single
+    new executable (``mxnet_serving_compile_total`` flatline)."""
+    metrics = ServingMetrics()
+    mgr = _mgr(tmp_path, metrics=metrics, warmup=True)
+    host_like = type("H", (), {
+        "stats": lambda self: {"dec": mgr.stats()},
+        "stream_hists": lambda self: {"dec": mgr.stream_ms},
+        "compile_counts": lambda self: {"dec": mgr.model.compile_count},
+    })()
+    metrics.attach_sessions(host_like)
+    try:
+        warm = mgr.model.compile_count
+        assert warm == len(BUCKETS)
+        assert metrics.compile_count() == warm
+
+        stop = threading.Event()
+
+        def churn(i):
+            k = 0
+            while not stop.is_set() and k < 12:
+                sid = f"churn{i}-{k}"
+                mgr.create(sid)
+                mgr.step(sid, _x(0.05 * i + 0.01 * k),
+                         steps=1 + (k % 3))
+                mgr.close(sid)
+                k += 1
+
+        threads = [threading.Thread(target=churn, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        assert mgr.model.compile_count == warm, \
+            "session churn cost an XLA compile"
+        assert metrics.compile_count() == warm
+    finally:
+        mgr.batcher.drain()
+
+
+def test_streams_join_a_running_batch(tmp_path):
+    """A session submitted while another stream is mid-decode joins at
+    the next step boundary — the late session's stream completes while
+    the long stream is still running."""
+    mgr = _mgr(tmp_path)
+    try:
+        mgr.create("long")
+        mgr.create("late")
+        long_handle = mgr.step("long", _x(0.3), steps=40, stream=True)
+        # join mid-decode
+        chunks, timing = mgr.step("late", _x(0.7), steps=3)
+        assert timing["steps"] == 3
+        assert long_handle.steps_done < 40   # still running (with us)
+        chunks_long, _ = long_handle.result()
+        assert len(chunks_long) == 40
+        # both bitwise-equal their solo runs despite shared batches
+        for got, want in zip(chunks, _ref_chunks(3, v=0.7)):
+            assert (onp.asarray(got[0]) == want).all()
+        for got, want in zip(chunks_long, _ref_chunks(40, v=0.3)):
+            assert (onp.asarray(got[0]) == want).all()
+    finally:
+        mgr.batcher.drain()
+
+
+def test_streaming_chunks_equal_nonstreamed(tmp_path):
+    """Streaming-parity: the chunk sequence == the non-streamed
+    response, bitwise (manager level; the HTTP twin is below)."""
+    mgr = _mgr(tmp_path)
+    try:
+        mgr.create("ns")
+        flat, _ = mgr.step("ns", _x(0.4), steps=5)
+        mgr2 = _mgr(tmp_path, model=_model())
+        mgr2.create("st")
+        handle = mgr2.step("st", _x(0.4), steps=5, stream=True)
+        streamed = []
+        while True:
+            kind, payload = handle.chunk_queue.get(timeout=30)
+            if kind == "chunk":
+                streamed.append(payload)
+            else:
+                assert kind == "done"
+                break
+        assert len(streamed) == len(flat) == 5
+        for got, want in zip(streamed, flat):
+            assert (onp.asarray(got[0]) == onp.asarray(want[0])).all()
+        mgr2.batcher.drain()
+    finally:
+        mgr.batcher.drain()
+
+
+# ---------------------------------------------------------------------------
+# eviction: TTL, bounded count — typed, never silent
+# ---------------------------------------------------------------------------
+
+def test_idle_ttl_eviction_is_typed(tmp_path):
+    mgr = _mgr(tmp_path, ttl_s=0.05)
+    try:
+        mgr.create("s1")
+        mgr.step("s1", _x(), steps=1)
+        time.sleep(0.15)
+        with pytest.raises(SessionExpiredError) as ei:
+            mgr.step("s1", _x())
+        assert "TTL" in str(ei.value)
+        assert mgr.stats()["evictions_total"] == 1
+    finally:
+        mgr.batcher.drain()
+
+
+def test_session_cap_evicts_lru_typed(tmp_path):
+    mgr = _mgr(tmp_path, max_sessions=2, ttl_s=600)
+    try:
+        mgr.create("a")
+        mgr.create("b")
+        mgr.step("a", _x(), steps=1)   # b is now least-recently-used
+        mgr.create("c")                # evicts b
+        with pytest.raises(SessionExpiredError) as ei:
+            mgr.step("b", _x())
+        assert "cap" in str(ei.value)
+        mgr.step("a", _x(), steps=1)   # survivors unaffected
+        mgr.step("c", _x(), steps=1)
+    finally:
+        mgr.batcher.drain()
+
+
+# ---------------------------------------------------------------------------
+# snapshots: CRC format, restore parity, typed loss, fault point
+# ---------------------------------------------------------------------------
+
+def test_snapshot_uses_checkpoint_shard_format(tmp_path, no_chaos):
+    """Snapshots are real AsyncCheckpointManager checkpoints: CRC per
+    leaf in the index, atomic step dirs, loadable by checkpoint.py.
+    Periodic snapshots run on the background snapshotter (the decode
+    loop never does IO) and coalesce; the drain snapshot is sync and
+    lands at the exact final step."""
+    from incubator_mxnet_tpu.checkpoint import AsyncCheckpointManager
+    mgr = _mgr(tmp_path, snapshot_steps=2)
+    mgr.create("s1")
+    mgr.step("s1", _x(), steps=5)
+    mgr.drain()   # final sync snapshot at step 5
+    d = tmp_path / "snaps" / "dec" / "s1"
+    ckpt = AsyncCheckpointManager(str(d), keep=2)
+    assert ckpt.latest_step() == 5
+    flat = ckpt.restore()
+    assert sorted(flat) == [f"leaf_{i:03d}" for i in range(4)]
+    with open(d / "step_00000005" / "index.json") as f:
+        index = json.load(f)["params"]
+    assert all("crc32" in meta for meta in index.values())
+    assert mgr.stats()["snapshots_total"] >= 1
+
+
+def test_restore_continuation_bitwise_equal_unbroken(tmp_path,
+                                                     no_chaos):
+    """THE crash-safety headline: a session restored from its latest
+    snapshot continues bitwise-identically to a run that never
+    stopped (from that snapshot's step)."""
+    mgr = _mgr(tmp_path, snapshot_steps=3)
+    mgr.create("s1")
+    mgr.step("s1", _x(), steps=7)
+    mgr.drain()   # snapshot-on-drain: captures step 7 exactly
+
+    mgr2 = _mgr(tmp_path, model=_model(), snapshot_steps=3)
+    try:
+        d = mgr2.restore("s1")
+        base = d["steps"]
+        assert base == 7   # drain snapshot is lossless
+        cont, _ = mgr2.step("s1", _x(), steps=4)
+        ref = _ref_chunks(base + 4)
+        for got, want in zip(cont, ref[base:]):
+            assert (onp.asarray(got[0]) == want).all()
+        assert mgr2.stats()["restored_total"] == 1
+    finally:
+        mgr2.batcher.drain()
+
+
+def test_restore_without_snapshot_is_typed_loss(tmp_path):
+    mgr = _mgr(tmp_path)
+    try:
+        with pytest.raises(SessionLostError):
+            mgr.restore("never-snapshotted")
+        nodir = SessionManager("dec", _model(), buckets=BUCKETS,
+                               snapshot_dir=None)
+        with pytest.raises(SessionLostError):
+            nodir.restore("whatever")
+        nodir.batcher.drain()
+    finally:
+        mgr.batcher.drain()
+
+
+def test_corrupt_snapshot_falls_back_then_typed(tmp_path, no_chaos):
+    """Newest-first fallback: a torn newest snapshot restores from the
+    previous one — with the step counter RE-BASED to the snapshot that
+    actually loaded; all-corrupt surfaces typed SessionLostError."""
+    # two deterministic snapshot generations via drain (sync):
+    # step_3 from the first manager life, step_5 from the second
+    mgr = _mgr(tmp_path, snapshot_steps=10 ** 6)
+    mgr.create("s1")
+    mgr.step("s1", _x(), steps=3)
+    mgr.drain()
+    mgr2 = _mgr(tmp_path, model=_model(), snapshot_steps=10 ** 6)
+    mgr2.restore("s1")
+    mgr2.step("s1", _x(), steps=2)
+    mgr2.drain()
+    d = tmp_path / "snaps" / "dec" / "s1"
+    assert (d / "step_00000005" / "index.json").exists()
+    # corrupt one leaf of the newest snapshot: CRC catches bit rot
+    victim = next(p for p in (d / "step_00000005").iterdir()
+                  if p.name.endswith(".npy"))
+    victim.write_bytes(b"\x93NUMPYgarbage")
+    mgr3 = _mgr(tmp_path, model=_model(), snapshot_steps=10 ** 6)
+    got = mgr3.restore("s1")
+    assert got["steps"] == 3        # fell back past the damage
+    mgr3.batcher.drain()
+    # now corrupt everything: the typed arm of the contract
+    for step_dir in d.iterdir():
+        for p in step_dir.iterdir():
+            if p.name.endswith(".npy"):
+                p.write_bytes(b"junk")
+    mgr4 = _mgr(tmp_path, model=_model(), snapshot_steps=10 ** 6)
+    try:
+        with pytest.raises(SessionLostError):
+            mgr4.restore("s1")
+    finally:
+        mgr4.batcher.drain()
+
+
+def test_snapshot_fault_never_breaks_the_stream(tmp_path):
+    """``serving.session_snapshot`` faults are counted and swallowed:
+    the decode stream is unaffected, the next period retries."""
+    mgr = _mgr(tmp_path, snapshot_steps=2)
+    try:
+        fault.configure("serving.session_snapshot:error:p=1.0")
+        mgr.create("s1")
+        chunks, timing = mgr.step("s1", _x(), steps=6)
+        assert timing["steps"] == 6          # stream survived
+        deadline = time.monotonic() + 15     # snapshotter is async
+        while (mgr.stats()["snapshot_failures_total"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        st = mgr.stats()
+        assert st["snapshot_failures_total"] >= 1
+        assert st["snapshots_total"] == 0
+        fault.configure(None)
+        mgr.step("s1", _x(), steps=2)        # next period lands
+        deadline = time.monotonic() + 15
+        while (mgr.stats()["snapshots_total"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert mgr.stats()["snapshots_total"] >= 1
+    finally:
+        fault.reset()
+        mgr.batcher.drain()
+
+
+def test_session_step_transient_fault_retried(tmp_path):
+    """``serving.session_step`` transient faults retry inside the
+    decode loop (fault.retry) — streams complete, outputs bitwise."""
+    mgr = _mgr(tmp_path)
+    try:
+        fault.configure("serving.session_step:error:p=0.3:seed=9")
+        mgr.create("s1")
+        chunks, _ = mgr.step("s1", _x(), steps=6)
+        fault.configure(None)
+        for got, want in zip(chunks, _ref_chunks(6)):
+            assert (onp.asarray(got[0]) == want).all()
+    finally:
+        fault.reset()
+        mgr.batcher.drain()
+
+
+def test_session_step_permanent_fault_surfaces(tmp_path):
+    mgr = _mgr(tmp_path)
+    try:
+        mgr.create("s1")
+        fault.configure(
+            "serving.session_step:error:class=permanent:n=1")
+        with pytest.raises(Exception) as ei:
+            mgr.step("s1", _x(), steps=2)
+        assert "permanent" in str(ei.value).lower()
+    finally:
+        fault.reset()
+        mgr.batcher.drain()
+
+
+# ---------------------------------------------------------------------------
+# drain + deadline + cancel
+# ---------------------------------------------------------------------------
+
+def test_drain_truncates_streams_typed_and_snapshots(tmp_path,
+                                                     no_chaos):
+    mgr = _mgr(tmp_path, snapshot_steps=1000)   # periodic never fires
+    mgr.create("s1")
+    handle = mgr.step("s1", _x(), steps=1000, stream=True)
+    deadline = time.monotonic() + 30
+    while handle.steps_done < 3 and time.monotonic() < deadline:
+        time.sleep(0.002)
+    mgr.drain()
+    with pytest.raises(ShuttingDown):
+        handle.result()
+    # ... but every completed step was snapshotted on the way down
+    mgr2 = _mgr(tmp_path, model=_model(), snapshot_steps=1000)
+    try:
+        d = mgr2.restore("s1")
+        assert d["steps"] >= 3
+        with pytest.raises(ShuttingDown):
+            mgr.step("s1", _x())    # drained manager admits nothing
+    finally:
+        mgr2.batcher.drain()
+
+
+def test_stream_deadline_is_typed_never_a_hang(tmp_path):
+    mgr = _mgr(tmp_path)
+    try:
+        mgr.create("s1")
+        with pytest.raises(DeadlineExceeded):
+            mgr.step("s1", _x(), steps=1000, deadline_ms=150)
+        # the session survives a deadline truncation, mid-carry
+        chunks, timing = mgr.step("s1", _x(), steps=1)
+        assert timing["steps"] == 1
+    finally:
+        mgr.batcher.drain()
+
+
+def test_cancel_between_steps_counted(tmp_path):
+    metrics = ServingMetrics()
+    mgr = _mgr(tmp_path, metrics=metrics)
+    try:
+        mgr.create("s1")
+        handle = mgr.step("s1", _x(), steps=1000, stream=True)
+        deadline = time.monotonic() + 30
+        while handle.steps_done < 2 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        handle.cancel()
+        with pytest.raises(DeadlineExceeded):
+            handle.result()
+        snap = metrics.snapshot()
+        assert snap["dec.cancelled"] == 1
+        # truncation, not corruption: the carry kept every step that
+        # ran, so the next step continues from there
+        _, timing = mgr.step("s1", _x(), steps=1)
+        assert timing["session_steps"] == timing["steps"] + \
+            handle.steps_done
+    finally:
+        mgr.batcher.drain()
+
+
+# ---------------------------------------------------------------------------
+# derived Retry-After (satellite)
+# ---------------------------------------------------------------------------
+
+def test_retry_after_derives_from_live_state():
+    assert retry_after_s(0) == "1"
+    assert retry_after_s(10, service_ms=500.0) == "5"
+    assert int(retry_after_s(10 ** 6, service_ms=500.0)) == 30  # cap
+    assert retry_after_s(3, None) == "1"   # 150ms rounds up to floor
+
+
+def test_http_429_carries_derived_retry_after(tmp_path):
+    """A queue-full 429 carries a Retry-After derived from live queue
+    state — present, integral, sane."""
+    srv = InferenceServer()
+    srv.sessions.snapshot_dir = str(tmp_path / "snaps")
+    mgr = srv.sessions.add("dec", _model(), buckets=BUCKETS)
+    srv.repository.admission.queue_depth = 2
+    port = srv.start()
+    try:
+        for sid in ("a", "b", "c"):
+            _post(port, "/v1/sessions/dec:create",
+                  {"session_id": sid})
+        # two long streams fill the shared depth bound (2): the next
+        # step must 429 with the derived header, never queue blindly
+        h1 = mgr.step("a", _x(), steps=1000, stream=True)
+        h2 = mgr.step("b", _x(), steps=1000, stream=True)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(port, "/v1/sessions/dec/c:step",
+                      {"inputs": [_x()[0].tolist()]}, timeout=10)
+            assert ei.value.code == 429
+            ra = ei.value.headers.get("Retry-After")
+            assert ra is not None and 1 <= int(ra) <= 30
+        finally:
+            h1.cancel()
+            h2.cancel()
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer: endpoints, streaming parity, healthz shape, disconnects
+# ---------------------------------------------------------------------------
+
+def _post(port, path, body, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+@pytest.fixture
+def server(tmp_path):
+    srv = InferenceServer()
+    srv.sessions.snapshot_dir = str(tmp_path / "snaps")
+    srv.sessions.add("dec", _model(), buckets=BUCKETS,
+                     snapshot_steps=3)
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+def test_http_session_lifecycle_and_typed_statuses(server):
+    port = server.port
+    code, d = _post(port, "/v1/sessions/dec:create",
+                    {"session_id": "s1"})
+    assert code == 200 and d["session_id"] == "s1"
+    code, d = _post(port, "/v1/sessions/dec/s1:step",
+                    {"inputs": [_x()[0].tolist()], "steps": 2})
+    assert code == 200 and d["steps"] == 2
+    assert d["timing"]["session_steps"] == 2
+    assert len(d["outputs"]) == 2
+    code, d = _post(port, "/v1/sessions/dec/s1:close", {})
+    assert d["closed"] is True
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, "/v1/sessions/dec/s1:step",
+              {"inputs": [_x()[0].tolist()]})
+    assert ei.value.code == 410
+    assert json.loads(ei.value.read())["error"] == \
+        "SessionExpiredError"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, "/v1/sessions/dec/none:step",
+              {"inputs": [_x()[0].tolist()]})
+    assert ei.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, "/v1/sessions/nomodel:create", {})
+    assert ei.value.code == 404
+    # re-creating a closed id is allowed: fresh carry, fresh life —
+    # the tombstone only poisons STEPS addressed at the dead carry
+    code, d = _post(port, "/v1/sessions/dec:create",
+                    {"session_id": "s1"})
+    assert code == 200 and d["steps"] == 0
+
+
+def test_http_stream_concat_bitwise_equals_nonstreamed(server):
+    """Satellite: chunked stream concatenation bitwise-equal to the
+    non-streamed response — over the real wire."""
+    port = server.port
+    _post(port, "/v1/sessions/dec:create", {"session_id": "flat"})
+    _post(port, "/v1/sessions/dec:create", {"session_id": "stream"})
+    body = {"inputs": [_x(0.6)[0].tolist()], "steps": 4}
+    code, flat = _post(port, "/v1/sessions/dec/flat:step", body)
+    assert code == 200
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/v1/sessions/dec/stream:step",
+        data=json.dumps(dict(body, stream=True)).encode(),
+        headers={"Content-Type": "application/json"})
+    lines = []
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert resp.headers.get("Transfer-Encoding") == "chunked"
+        for line in resp:
+            lines.append(json.loads(line))
+    assert lines[-1]["done"] is True
+    assert lines[-1]["steps"] == 4
+    streamed = [ln["outputs"] for ln in lines if "outputs" in ln]
+    assert streamed == flat["outputs"]   # bitwise: same JSON floats
+
+
+def test_http_healthz_sessions_shape_pinned(server, tmp_path):
+    """Pin the sessions /healthz + describe() JSON shape the way PR 8
+    pinned per-model health — the schema probers/operators consume."""
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/healthz",
+            timeout=10) as resp:
+        body = json.loads(resp.read())
+    assert "sessions" in body
+    dec = body["sessions"]["dec"]
+    assert set(dec) == {
+        "model", "spec", "state", "active_sessions", "active_streams",
+        "queue_depth", "steps_total", "snapshots",
+        "snapshot_failures", "evicted", "restored", "compile_count",
+        "buckets", "snapshot_steps", "ttl_s", "max_sessions"}
+    assert dec["state"] == "ready"
+    assert dec["buckets"] == BUCKETS
+    assert dec["compile_count"] == len(BUCKETS)
+    # the bare health_body (no sessions host) keeps the PR 8 shape —
+    # additive, never breaking existing probers
+    from incubator_mxnet_tpu.serving.model_repository import \
+        ModelRepository
+    repo = ModelRepository(metrics=ServingMetrics())
+    code, bare = health_body(repo, time.monotonic())
+    assert "sessions" not in bare
+    assert set(bare) == {"status", "uptime_s", "queue_depth", "models"}
+
+
+def test_http_metrics_expose_session_gauges(server):
+    port = server.port
+    _post(port, "/v1/sessions/dec:create", {"session_id": "m1"})
+    _post(port, "/v1/sessions/dec/m1:step",
+          {"inputs": [_x()[0].tolist()], "steps": 4})
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+        text = resp.read().decode()
+    assert 'mxnet_serving_session_active{model="dec"} 1' in text
+    assert 'mxnet_serving_session_steps_total{model="dec"} 4' in text
+    assert 'mxnet_serving_compile_total{model="dec"} 3' in text
+    for needle in ("mxnet_serving_session_snapshots_total",
+                   "mxnet_serving_session_snapshot_failures_total",
+                   "mxnet_serving_session_snapshot_age_s",
+                   "mxnet_serving_session_stream_ms_bucket",
+                   "mxnet_serving_cancelled_total"):
+        assert needle in text, needle
+
+
+def test_client_disconnect_cancels_queued_stream(server):
+    """Satellite: a client that hangs up mid-stream stops consuming
+    device time — the stream is cancelled and counted."""
+    port = server.port
+    _post(port, "/v1/sessions/dec:create", {"session_id": "gone"})
+    mgr = server.sessions.get("dec")
+    body = json.dumps({"inputs": [_x()[0].tolist()],
+                       "steps": 1000, "stream": True}).encode()
+    raw = (b"POST /v1/sessions/dec/gone:step HTTP/1.1\r\n"
+           b"Host: x\r\nContent-Type: application/json\r\n"
+           b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+    sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+    sock.sendall(raw)
+    sock.recv(256)          # stream started (headers + first bytes)
+    sock.close()            # client vanishes mid-stream
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if mgr.batcher.active_streams == 0 and mgr.batcher.depth == 0:
+            break
+        time.sleep(0.01)
+    assert mgr.batcher.active_streams == 0, \
+        "dead client's stream still decoding"
+    snap = server.metrics.snapshot()
+    assert snap.get("dec.cancelled", 0) >= 1
+
+
+def test_predict_client_disconnect_cancels_queued_request(tmp_path):
+    """The same wire for stateless predicts: disconnect while queued
+    ⇒ PendingResult.cancel() ⇒ the worker never spends device time,
+    and the cancellation is counted."""
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import deploy
+
+    def fwd(params, x):
+        return jnp.tanh(x @ params["w"])
+
+    rng = onp.random.RandomState(0)
+    params = {"w": rng.randn(DIM, DIM).astype(onp.float32)}
+    prefix = str(tmp_path / "mlp")
+    deploy.export_model(fwd, (rng.randn(1, DIM).astype(onp.float32),),
+                        prefix, params=params)
+    srv = InferenceServer()
+    srv.repository.load("mlp", prefix, warmup=False)
+    port = srv.start()
+    try:
+        # occupy the flush worker with a slow blocker batch, so the
+        # victim requests are still QUEUED when their clients vanish
+        fault.configure("serving.execute:delay:ms=400")
+        body = json.dumps({"inputs": [[0.0] * DIM]}).encode()
+        raw = (b"POST /v1/models/mlp:predict HTTP/1.1\r\n"
+               b"Host: x\r\nContent-Type: application/json\r\n"
+               b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+        blocker = socket.create_connection(("127.0.0.1", port),
+                                           timeout=10)
+        blocker.sendall(raw)
+        time.sleep(0.15)     # blocker batch is now executing
+        socks = []
+        for _ in range(3):
+            s = socket.create_connection(("127.0.0.1", port),
+                                         timeout=10)
+            s.sendall(raw)
+            socks.append(s)
+        time.sleep(0.1)      # victims are queued behind the blocker
+        for s in socks:
+            s.close()        # ...and their clients vanish
+        # the blocker client still gets its answer
+        resp = blocker.recv(65536)
+        assert b"200" in resp.split(b"\r\n", 1)[0]
+        blocker.close()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if srv.metrics.snapshot().get("mlp.cancelled", 0) >= 1:
+                break
+            time.sleep(0.02)
+        assert srv.metrics.snapshot().get("mlp.cancelled", 0) >= 1, \
+            "queued requests of dead clients were not cancelled"
+    finally:
+        fault.reset()
+        srv.shutdown()
+
+
+def test_profiler_provider_carries_session_stats(server):
+    from incubator_mxnet_tpu import profiler
+    port = server.port
+    _post(port, "/v1/sessions/dec:create", {"session_id": "p1"})
+    _post(port, "/v1/sessions/dec/p1:step",
+          {"inputs": [_x()[0].tolist()], "steps": 2})
+    table = profiler.dumps()
+    assert "dec.session.steps_total" in table
+    snap = profiler.provider_stats()["serving"]
+    assert snap["dec.session.active_sessions"] == 1
+    assert snap["dec.session.steps_total"] == 2
+    assert snap["compile_total"] == len(BUCKETS)
+    assert "stream_ms" in snap["dec.session.stream_ms"] or \
+        snap["dec.session.stream_ms"]["count"] == 2
