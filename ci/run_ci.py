@@ -58,6 +58,18 @@ Stages (each isolated, failures collected, nonzero exit if any fail):
              session_bench --check enforces its continuous-vs-
              sequential floor with the compile count flat across
              session join/leave
+  autoscale  autoscaling control-plane sweep (docs/serving.md
+             "Autoscaling"): the test_autoscale.py battery — placement
+             under the HBM budget with LRU eviction, SLO shed order,
+             WFQ, scale-from-zero, session-aware shrink — under a
+             pinned seeded spec with errors AND delays on
+             serving.scale (dropped decisions must be re-derived, a
+             laggy control plane must still converge); then
+             autoscale_bench --check replays the bursty two-model
+             trace gating zero dropped interactive requests,
+             scale-from-zero first-request latency < 1.5 s via the
+             AOT path, and total replica-seconds strictly below the
+             equivalent static fleet's
 
   lint       mxlint (docs/static_analysis.md) over the python surface:
              framework-invariant rules (env-var/docs sync, fault-point
@@ -344,6 +356,51 @@ def stage_sessions(args):
                   f"{rec['crash_smoke_bitwise']}")
 
 
+# Pinned autoscale-chaos spec: the control plane's own fault point
+# takes errors (decisions dropped for a tick — the loop must re-derive
+# them) while routing hops are jittered; seeded so a scale-decision
+# failure replays from the spec string.  serving.scale gets the error
+# kind and the route point the delay kind (one kind per point in the
+# spec grammar); the delay side of serving.scale is covered by
+# test_autoscale's own delay-spec test.
+AUTOSCALE_SPEC = ("serving.scale:error:p=0.15:seed=31,"
+                  "serving.route:delay:ms=1:p=0.2:seed=3")
+
+
+def stage_autoscale(args):
+    """Autoscaling sweep (docs/serving.md "Autoscaling"): the whole
+    test_autoscale.py battery under the pinned seeded spec, then the
+    bursty two-model trace bench with its hard gates (zero dropped
+    interactive requests, scale-from-zero < 1.5 s, replica-seconds
+    strictly below static, compile flatline)."""
+    proc = sh([sys.executable, "-m", "pytest", "-q",
+               "tests/test_autoscale.py",
+               "--continue-on-collection-errors",
+               "-p", "no:cacheprovider"],
+              timeout=1800, env={"MXNET_FAULT_SPEC": AUTOSCALE_SPEC})
+    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+    if proc.returncode != 0:
+        return False, f"spec={AUTOSCALE_SPEC!r}: {tail}"
+    out = os.path.join(REPO, ".ci_autoscale_bench.json")
+    try:
+        proc2 = sh([sys.executable, "benchmark/autoscale_bench.py",
+                    "--check", "--output", out], timeout=900)
+        if proc2.returncode != 0:
+            return False, (proc2.stderr or proc2.stdout).strip()[-400:]
+        with open(out) as f:
+            rec = json.load(f)
+    finally:
+        if os.path.exists(out):
+            os.remove(out)
+    return True, (f"spec ok: {tail}; replica-seconds "
+                  f"{rec['replica_seconds']} vs static "
+                  f"{rec['static_replica_seconds']} "
+                  f"(peak {rec['peak_replicas']}), hi p99 "
+                  f"{rec['hi_p99_ms']}ms, dropped {rec['hi_dropped']}, "
+                  f"scale-from-zero {rec['scale_from_zero_ms']}ms, "
+                  f"compiles {rec['compile_total']}")
+
+
 def stage_serving(args):
     """Serving smoke (docs/serving.md): HTTP end-to-end against a real
     gluon model_zoo artifact — warmup, concurrent requests, /metrics
@@ -528,7 +585,7 @@ STAGES = {"build": stage_build, "sanity": stage_sanity,
           "bulking": stage_bulking, "chaos": stage_chaos,
           "elastic": stage_elastic,
           "serving": stage_serving, "fleet": stage_fleet,
-          "sessions": stage_sessions,
+          "sessions": stage_sessions, "autoscale": stage_autoscale,
           "coldstart": stage_coldstart,
           "race": stage_race,
           "graphlint": stage_graphlint,

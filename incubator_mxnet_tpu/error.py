@@ -16,6 +16,7 @@ __all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
            "PSTimeoutError", "PSConnectionError", "CheckpointCorruptError",
            "CheckpointWriteError", "WorkerEvictedError", "ReshardError",
            "ReplicaUnavailableError", "FleetDrainingError",
+           "ModelEvictedError",
            "SessionExpiredError", "SessionLostError",
            "EngineRaceError", "RecompileStormError", "GraphLintError",
            "register_error", "get_error_class"]
@@ -135,6 +136,19 @@ class FleetDrainingError(MXNetError):
     is shutting down (or mid-roll with nothing re-admitted yet) and
     admits no new work.  Answered as 503 with ``Retry-After``; a
     client must never hang on a fleet that will not serve it."""
+
+
+@register_error
+class ModelEvictedError(MXNetError, _bi.ConnectionError):
+    """A request named a model the autoscaler evicted from every
+    replica (LRU bin-packing under the per-replica HBM budget, or
+    idle scale-to-zero) and the on-demand reload could not place it —
+    every replica's budget is held by busier models and the fleet is
+    at its replica ceiling (``serving/autoscaler.py``).  Answered as
+    503 with ``Retry-After``: the condition clears when load recedes
+    or capacity grows, so clients should back off and retry.  Also
+    catchable as builtin ``ConnectionError`` so generic failover
+    layers treat it as a retryable placement failure, not a 500."""
 
 
 @register_error

@@ -38,6 +38,11 @@ Injection points (each named where the fault physically occurs):
 * ``serving.stream_write`` — a chunked-response chunk about to be
   written to the client socket (a fault here is a client-side
   connection loss: the stream is cancelled and counted)
+* ``serving.scale``     — the autoscaler about to apply one scale
+  decision (spawn/shrink a replica, load/unload/evict a model).  A
+  transient fault drops that decision for the tick — the control
+  loop re-evaluates and retries next tick; a delay models a slow
+  control plane lagging behind the load signal
 * ``trainer.step``      — an elastic trainer step about to run (the
   eviction-notice / checkpoint-on-evict path)
 
@@ -94,7 +99,7 @@ POINTS = ("kvstore.send", "kvstore.recv", "kvstore.heartbeat",
           "io.next_batch", "serving.enqueue", "serving.execute",
           "serving.route", "serving.probe", "serving.replica_exec",
           "serving.session_step", "serving.session_snapshot",
-          "serving.stream_write", "trainer.step")
+          "serving.stream_write", "serving.scale", "trainer.step")
 
 _POINT_SET = frozenset(POINTS)
 

@@ -31,15 +31,26 @@ adaptive batching layer (NSDI'17) and MXNet Model Server:
   snapshots (checkpoint.py shard format) and the crash-safe failover
   contract: migrate-from-snapshot (bitwise continuation) or typed
   ``SessionLostError`` — never a hang, never a silent restart.
+* :mod:`.autoscaler` + :mod:`.placement` — the multi-tenant control
+  plane: a level-triggered loop over the router's own metrics that
+  grows/shrinks the fleet per model (scale-from-zero via the AOT
+  artifact path, idle unload), packs models onto replicas under
+  memlint's peak-HBM budget with LRU eviction, and serves each model
+  under an SLO class (priority admission, weighted fair queueing,
+  shed-low-first at 429).
 
 Everything is pure stdlib + JAX; no new dependencies.
 """
 from .admission import (DeadlineExceeded, QueueFullError,   # noqa: F401
-                        ServingError, ShuttingDown)
-from .batcher import (ContinuousBatcher, DynamicBatcher)     # noqa: F401
+                        ServingError, ShuttingDown, SloClass,
+                        slo_class)
+from .autoscaler import Autoscaler, ModelPolicy              # noqa: F401
+from .batcher import (ContinuousBatcher, DynamicBatcher,     # noqa: F401
+                      WeightedFairGate)
 from .fleet import ReplicaFleet                              # noqa: F401
 from .metrics import FleetMetrics, ServingMetrics            # noqa: F401
 from .model_repository import ModelRepository                # noqa: F401
+from .placement import Placer                                # noqa: F401
 from .router import FleetRouter                              # noqa: F401
 from .server import InferenceServer                          # noqa: F401
 from .sessions import (SessionHost, SessionManager,          # noqa: F401
@@ -49,4 +60,6 @@ __all__ = ["ModelRepository", "DynamicBatcher", "ContinuousBatcher",
            "InferenceServer", "ReplicaFleet", "FleetRouter",
            "SessionManager", "SessionModel", "SessionHost",
            "ServingMetrics", "FleetMetrics", "ServingError",
-           "QueueFullError", "DeadlineExceeded", "ShuttingDown"]
+           "QueueFullError", "DeadlineExceeded", "ShuttingDown",
+           "Autoscaler", "ModelPolicy", "Placer", "SloClass",
+           "slo_class", "WeightedFairGate"]

@@ -30,8 +30,9 @@ from .. import fault
 
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceeded",
            "ShuttingDown", "ModelNotFound", "BadRequest",
-           "ClientDisconnected", "Admission", "checked_enqueue",
-           "checked_route", "retry_after_s"]
+           "ClientDisconnected", "Admission", "SloClass", "SLO_CLASSES",
+           "slo_class", "checked_enqueue", "checked_route",
+           "retry_after_s"]
 
 
 class ServingError(Exception):
@@ -87,6 +88,71 @@ class ClientDisconnected(ServingError):
     http_status = 499
 
 
+class SloClass:
+    """One service-level class a model is served under.
+
+    ``priority`` ranks the classes for the bin-packer's eviction
+    protection (a strictly higher tier is never the LRU victim);
+    ``weight`` is the share of device time the batcher's weighted-fair
+    gate grants the model's batches when several models contend on one
+    replica; ``shed_level`` drives overload admission — a class of
+    shed level *k* is admitted only while the queue is below
+    ``queue_depth * shed_fraction**k``.  ``shed_level`` is decoupled
+    from ``priority`` on purpose: ``standard`` is the DEFAULT class of
+    every model loaded without an explicit ``slo``, so it keeps the
+    full pre-SLO queue bound (shed level 0) — only classes that opt
+    into background economics (``batch``) shed early."""
+
+    __slots__ = ("name", "priority", "weight", "shed_level")
+
+    def __init__(self, name, priority, weight, shed_level=0):
+        self.name = name
+        self.priority = int(priority)
+        self.weight = float(weight)
+        self.shed_level = int(shed_level)
+
+    def depth_bound(self, queue_depth, shed_fraction):
+        """Effective queue bound for this class: the full depth scaled
+        down ``shed_fraction`` per shed level below the top."""
+        if self.shed_level <= 0:
+            return queue_depth
+        frac = (max(0.0, min(1.0, float(shed_fraction)))
+                ** self.shed_level)
+        return max(1, int(queue_depth * frac))
+
+    def __repr__(self):
+        return (f"SloClass({self.name!r}, priority={self.priority}, "
+                f"weight={self.weight}, shed_level={self.shed_level})")
+
+
+#: The built-in classes (autoscaler policies and ``:load`` bodies name
+#: them by string).  ``interactive`` is the protected tier the
+#: autoscale bench gates zero drops on; ``batch`` is shed first.
+#: ``standard`` (the default) admits at the full queue bound, exactly
+#: like a pre-SLO deployment.
+SLO_CLASSES = {
+    "interactive": SloClass("interactive", 0, 4.0, shed_level=0),
+    "standard": SloClass("standard", 1, 2.0, shed_level=0),
+    "batch": SloClass("batch", 2, 1.0, shed_level=1),
+}
+
+
+def slo_class(name):
+    """Resolve a class name (or ``None`` / an :class:`SloClass`) to an
+    :class:`SloClass`; unknown names raise ``BadRequest`` (they arrive
+    from ``:load`` HTTP bodies)."""
+    if name is None:
+        return SLO_CLASSES["standard"]
+    if isinstance(name, SloClass):
+        return name
+    cls = SLO_CLASSES.get(str(name))
+    if cls is None:
+        raise BadRequest(
+            f"unknown SLO class {name!r} (known: "
+            f"{', '.join(sorted(SLO_CLASSES))})")
+    return cls
+
+
 def retry_after_s(depth, service_ms=None, floor=1, cap=30):
     """Derive a ``Retry-After`` value (seconds, as the header string)
     from live state instead of a constant: roughly the time the
@@ -109,6 +175,12 @@ class Admission:
         self.default_deadline_ms = float(
             default_deadline_ms if default_deadline_ms is not None
             else get_env("MXNET_SERVING_DEADLINE_MS", 30000.0, float))
+        self.shed_fraction = get_env(
+            "MXNET_SERVING_SLO_SHED_FRACTION", 0.5, float)
+        if not 0.0 < self.shed_fraction <= 1.0:
+            raise ValueError(
+                f"MXNET_SERVING_SLO_SHED_FRACTION must be in (0, 1], "
+                f"got {self.shed_fraction}")
         self._draining = False
 
     @property
@@ -126,28 +198,40 @@ class Admission:
             return self.default_deadline_ms
         return min(float(requested), self.default_deadline_ms)
 
-    def admit(self, model_name, current_depth):
+    def admit(self, model_name, current_depth, slo=None):
         """Gate one request: drain state, then queue bound.  Raises the
         matching :class:`ServingError`; fires ``serving.enqueue``.
         One-shot form of :meth:`gate` for callers outside the batcher
         lock (the check is advisory there — see ``gate``)."""
-        self.gate(model_name)(current_depth)
+        self.gate(model_name, slo=slo)(current_depth)
         checked_enqueue(model_name)
 
-    def gate(self, model_name):
+    def gate(self, model_name, slo=None):
         """Admission check as a callable the batcher runs **under its
         queue lock** (``submit_async(admit=...)``), making the depth
         bound atomic with the enqueue — a read-then-submit from here
         would let a burst of handler threads all pass the bound before
-        any of them increments the depth."""
+        any of them increments the depth.
+
+        ``slo`` (an :class:`SloClass`) scales the depth bound down for
+        lower-priority classes, so under overload they shed first: a
+        ``batch`` request answers 429 while the queue still has
+        headroom reserved for the ``interactive`` tier."""
+        bound = (self.queue_depth if slo is None
+                 else slo.depth_bound(self.queue_depth,
+                                      self.shed_fraction))
+
         def check(current_depth):
             if self._draining:
                 raise ShuttingDown(
                     "server is draining, not accepting work")
-            if current_depth >= self.queue_depth:
+            if current_depth >= bound:
+                tier = (f" ({slo.name} tier sheds at {bound})"
+                        if slo is not None and bound < self.queue_depth
+                        else "")
                 raise QueueFullError(
                     f"model {model_name!r} queue full "
-                    f"({current_depth}/{self.queue_depth})")
+                    f"({current_depth}/{self.queue_depth}){tier}")
         return check
 
 

@@ -1,0 +1,761 @@
+"""Autoscaling control plane: scale-from-zero, HBM bin-packing, SLO
+classes over the replica fleet.
+
+The fleet (PR 8) and router (PR 8/11) serve a *fixed* N replicas of one
+model set; production traffic is hundreds of models with diurnal load.
+This module closes the loop the ROADMAP (item 3) calls for: a control
+loop driven by the router's own metrics that grows and shrinks the
+fleet **per model**, made affordable by two earlier PRs —
+
+* **Scale-from-zero is cheap** because of the AOT artifact path
+  (PR 10): loading a model whose artifact carries per-bucket compiled
+  executables is deserialization, not compilation, so an idle model
+  can be unloaded after ``MXNET_SERVING_IDLE_UNLOAD_S`` and the first
+  request after scale-to-zero pays well under a second
+  (``mxnet_serving_compile_total`` does not move).
+* **Bin-packing has an honest budget** because of memlint (PR 9):
+  every artifact records its forward's peak-HBM estimate, so multiple
+  models pack onto one replica under
+  ``MXNET_SERVING_REPLICA_HBM_BUDGET`` with least-recently-used
+  eviction when a load would exceed it (:mod:`.placement`).
+
+The loop (one :meth:`Autoscaler.run_once` per
+``MXNET_SERVING_SCALE_INTERVAL_S``):
+
+1. **Sense** — per-model queue depth from each replica's vitals,
+   inflight/p99/idle from the router's :class:`~.metrics.FleetMetrics`.
+2. **Decide** — a desired replica count per model: one step up when
+   the per-replica backlog crosses ``MXNET_SERVING_SCALE_QUEUE_HIGH``,
+   one step down when it collapses, down to ``min_replicas`` (0 ⇒
+   scale-to-zero) once idle past the unload threshold.
+3. **Place** — grows go through the :class:`~.placement.Placer`
+   (best-fit under the HBM budget, LRU eviction, spawn a new replica
+   when nothing fits and the fleet is under
+   ``MXNET_SERVING_SCALE_MAX_REPLICAS``).
+4. **Apply** — every action fires the ``serving.scale`` fault point
+   first; an injected fault drops that decision for the tick and the
+   next tick re-derives it from live state (the loop is level-
+   triggered, so chaos can only delay convergence, never corrupt it).
+
+**Sessions are first-class**: a replica picked for shrink begins
+draining immediately but is only closed once its in-flight requests
+and active decode streams have reached a step boundary (sessions keep
+stepping on DRAINING replicas); the close then snapshots every session
+synchronously, so the router's migrate-from-snapshot failover resumes
+them losslessly on a survivor — a shrink never breaks a stream
+mid-carry.
+
+Everything is metrics-visible (desired-vs-actual gauges, decision and
+eviction counters, integrated replica-seconds) and chaos-testable
+(``serving.scale`` in the ``autoscale`` CI stage's pinned spec).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ..base import get_env
+from .. import fault
+from ..error import ModelEvictedError, ReplicaUnavailableError
+from .admission import ModelNotFound, slo_class
+from .placement import Placer, model_footprint_bytes
+
+__all__ = ["Autoscaler", "ModelPolicy"]
+
+_log = logging.getLogger("incubator_mxnet_tpu.serving.autoscaler")
+
+
+class ModelPolicy:
+    """Per-model scaling policy: where the model's artifact lives, how
+    many copies it may have, and which SLO tier it serves under.
+
+    ``min_replicas=0`` opts the model into scale-to-zero: after
+    ``MXNET_SERVING_IDLE_UNLOAD_S`` without a request it is unloaded
+    everywhere, and the next request reloads it on demand through the
+    AOT path (the router blocks that one request on the load instead
+    of 404ing).  ``footprint_bytes`` overrides the artifact's memlint
+    peak-HBM estimate for the bin-packer."""
+
+    def __init__(self, name, path, slo="standard", min_replicas=0,
+                 max_replicas=None, target_queue=None,
+                 footprint_bytes=None, warmup=None):
+        self.name = name
+        self.path = path
+        self.slo = slo_class(slo)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = (None if max_replicas is None
+                             else int(max_replicas))
+        if self.min_replicas < 0:
+            raise ValueError(
+                f"min_replicas must be >= 0, got {self.min_replicas}")
+        if (self.max_replicas is not None
+                and self.max_replicas < max(1, self.min_replicas)):
+            raise ValueError(
+                f"max_replicas {self.max_replicas} < min_replicas for "
+                f"model {name!r}")
+        self.target_queue = (None if target_queue is None
+                             else float(target_queue))
+        self.footprint_bytes = (None if footprint_bytes is None
+                                else int(footprint_bytes))
+        self.warmup = warmup
+
+    def footprint(self):
+        if self.footprint_bytes is None:
+            self.footprint_bytes = model_footprint_bytes(self.path)
+        return self.footprint_bytes
+
+    def __repr__(self):
+        return (f"ModelPolicy({self.name!r}, slo={self.slo.name}, "
+                f"min={self.min_replicas}, max={self.max_replicas})")
+
+
+class Autoscaler:
+    """The control loop over one :class:`~.fleet.ReplicaFleet`.
+
+    ``router`` (a :class:`~.router.FleetRouter`) is optional but is
+    where the interesting signals live — attaching wires the router's
+    on-demand scale-from-zero path (``router.autoscaler``) and the
+    desired-vs-actual metrics into its ``/metrics`` and ``/healthz``.
+    Construct, :meth:`add_policy` the models, then :meth:`start` (or
+    drive :meth:`run_once` directly from tests/benches)."""
+
+    def __init__(self, fleet, router=None, policies=(), placer=None,
+                 interval_s=None, idle_unload_s=None,
+                 queue_high=None, max_replicas=None, min_fleet=1,
+                 drain_s=None, metrics=None):
+        self.fleet = fleet
+        self.router = router
+        self.metrics = (metrics if metrics is not None
+                        else getattr(router, "metrics", None))
+        self.placer = placer or Placer()
+        self.interval_s = float(
+            interval_s if interval_s is not None
+            else get_env("MXNET_SERVING_SCALE_INTERVAL_S", 2.0, float))
+        self.idle_unload_s = float(
+            idle_unload_s if idle_unload_s is not None
+            else get_env("MXNET_SERVING_IDLE_UNLOAD_S", 300.0, float))
+        self.queue_high = float(
+            queue_high if queue_high is not None
+            else get_env("MXNET_SERVING_SCALE_QUEUE_HIGH", 4.0, float))
+        self.max_replicas = int(
+            max_replicas if max_replicas is not None
+            else get_env("MXNET_SERVING_SCALE_MAX_REPLICAS", 4, int))
+        self.min_fleet = int(min_fleet)
+        self.drain_s = float(
+            drain_s if drain_s is not None
+            else get_env("MXNET_SERVING_SCALE_DRAIN_S", 30.0, float))
+        if self.interval_s <= 0 or self.queue_high <= 0:
+            raise ValueError(
+                "MXNET_SERVING_SCALE_INTERVAL_S and "
+                "MXNET_SERVING_SCALE_QUEUE_HIGH must be > 0")
+        if self.max_replicas < 1 or self.min_fleet < 1:
+            raise ValueError(
+                "MXNET_SERVING_SCALE_MAX_REPLICAS and min_fleet must "
+                "be >= 1")
+        self._policies: dict[str, ModelPolicy] = {}
+        for p in policies:
+            self.add_policy(p)
+        self._lock = threading.Lock()
+        self._demand_locks: dict[str, threading.Lock] = {}
+        # planning is serialized and RESERVES budget in the ledger at
+        # plan time (see _plan_grow): two grow decisions derived
+        # against the same books — two models crossing the threshold
+        # in one tick, or the background loop racing an on-demand
+        # ensure_loaded — must not jointly overcommit one replica's
+        # HBM budget.  _reserved marks in-flight loads so _sync_placer
+        # does not drop the reservation before the load lands.
+        self._plan_lock = threading.Lock()
+        self._reserved: set = set()            # {(rid, model)}
+        self._counters = {"scale_up": 0, "scale_down": 0, "spawn": 0,
+                          "shrink": 0, "evict": 0, "faults": 0,
+                          "blocked": 0, "scale_from_zero": 0}
+        self._evictions: dict[str, int] = {}
+        self._scale_from_zero_ms: dict[str, float] = {}
+        self._last_desired: dict[str, int] = {}
+        self._shrinking: dict[str, float] = {}    # rid -> deadline
+        self._replica_seconds = 0.0
+        self._t_last_tick = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = None
+        self._sync_placer()
+        if self.metrics is not None:
+            self.metrics.attach_autoscaler(self.describe)
+        if router is not None:
+            router.autoscaler = self
+
+    # -- policy surface ------------------------------------------------
+
+    def add_policy(self, policy):
+        self._policies[policy.name] = policy
+        return policy
+
+    def manages(self, name):
+        return name in self._policies
+
+    def policy(self, name):
+        return self._policies[name]
+
+    def policies(self):
+        return dict(self._policies)
+
+    # -- bookkeeping ---------------------------------------------------
+
+    def _live_replicas(self):
+        from .fleet import DEAD, DRAINING
+        return [r for r in self.fleet.replicas
+                if r.state not in (DEAD, DRAINING)]
+
+    def _sync_placer(self):
+        """Reconcile the placement ledger with the live fleet: adopt
+        pre-loaded model sets (a classic ``spawn()``), forget dead or
+        removed replicas — killed replicas free their budget."""
+        live = {r.rid: r for r in self._live_replicas()}
+        for rid, r in live.items():
+            self.placer.register_replica(rid)
+            on = self.placer.models_on(rid)
+            for name, path in r.models.items():
+                if name not in on:
+                    p = self._policies.get(name)
+                    nbytes = (p.footprint() if p is not None
+                              else model_footprint_bytes(path))
+                    self.placer.record_load(rid, name, nbytes)
+            for name in list(on):
+                with self._lock:
+                    reserved = (rid, name) in self._reserved
+                if name not in r.models and not reserved:
+                    self.placer.record_unload(rid, name)
+        for rid in list(self.placer.assignments()):
+            if rid not in live and rid not in self._shrinking:
+                self.placer.forget_replica(rid)
+
+    def actual(self, name):
+        """Replica copies of ``name`` currently live (the gauge next
+        to ``desired``)."""
+        live = {r.rid for r in self._live_replicas()}
+        return len([rid for rid in self.placer.replicas_of(name)
+                    if rid in live])
+
+    def replica_seconds(self):
+        """Integrated live-replica time since construction — the
+        fleet-economics number the autoscale bench compares against a
+        static fleet (``peak_replicas * wall_time``)."""
+        with self._lock:
+            now = time.monotonic()
+            self._replica_seconds += (
+                len(self._live_replicas()) * (now - self._t_last_tick))
+            self._t_last_tick = now
+            return self._replica_seconds
+
+    def _model_idle_s(self, name):
+        if self.metrics is None:
+            return float("inf")
+        return self.metrics.model_idle_s(name)
+
+    # -- sense + decide ------------------------------------------------
+
+    def _collect_vitals(self):
+        """ONE combined probe per live replica (``replica.vitals()``
+        — a single /healthz round trip on the process backend):
+        ``{rid: {"queues":…, "sessions":…, "streams":…}}``.  Shared
+        by every consumer of a tick so the control loop's I/O stays
+        one probe per replica, not one per signal."""
+        out = {}
+        for r in self._live_replicas():
+            try:
+                out[r.rid] = r.vitals()
+            except Exception:  # mxlint: allow-broad-except(a replica dying mid-probe simply contributes no load signal this tick)
+                out[r.rid] = {"queues": {}, "sessions": 0,
+                              "streams": 0}
+        return out
+
+    def signals(self, vitals=None):
+        """One sensing sweep: ``{model: {queued, inflight, p99_ms,
+        idle_s, actual}}`` for every managed model (plus any model a
+        replica reports vitals for)."""
+        vitals = (vitals if vitals is not None
+                  else self._collect_vitals())
+        queued: dict[str, int] = {}
+        for v in vitals.values():
+            for name, depth in v["queues"].items():
+                queued[name] = queued.get(name, 0) + int(depth)
+        stats = (self.metrics.model_stats()
+                 if self.metrics is not None else {})
+        out = {}
+        for name in set(self._policies) | set(queued):
+            st = stats.get(name, {})
+            out[name] = {
+                "queued": queued.get(name, 0),
+                "inflight": st.get("inflight", 0),
+                "p99_ms": st.get("p99_ms", 0.0),
+                "idle_s": st.get("idle_s", self._model_idle_s(name)),
+                "actual": self.actual(name),
+            }
+        return out
+
+    def desired(self, signals=None):
+        """The level-triggered decision: desired copies per managed
+        model.  One step per tick in either direction — the loop
+        converges over ticks rather than thrashing on a noisy
+        signal."""
+        signals = signals if signals is not None else self.signals()
+        out = {}
+        for name, p in self._policies.items():
+            sig = signals.get(name, {})
+            a = sig.get("actual", 0)
+            load = sig.get("queued", 0) + sig.get("inflight", 0)
+            idle = sig.get("idle_s", float("inf"))
+            cap = min(self.max_replicas,
+                      p.max_replicas if p.max_replicas is not None
+                      else self.max_replicas)
+            floor = p.min_replicas
+            high = (p.target_queue if p.target_queue is not None
+                    else self.queue_high)
+            if a == 0:
+                # scaled to zero: stay there until a request arrives
+                # (the router's on-demand path handles the first one)
+                want = floor
+            elif load / a >= high:
+                want = a + 1
+            elif load == 0 and idle >= self.idle_unload_s:
+                want = floor            # idle: unload toward zero
+            elif a > 1 and load / (a - 1) < high * 0.5:
+                want = a - 1            # a smaller fleet still has slack
+            else:
+                want = a
+            out[name] = max(floor, min(cap, want))
+        self._last_desired = dict(out)
+        return out
+
+    def evaluate(self):
+        """Derive this tick's scale decisions.  Grow plans RESERVE
+        their budget in the ledger as they are made (under
+        ``_plan_lock``), so two models crossing the threshold in one
+        tick cannot both be planned into the same free bytes; a plan
+        that is later dropped rolls its reservation back
+        (:meth:`_apply_one`)."""
+        vitals = self._collect_vitals()
+        with self._plan_lock:
+            self._sync_placer()
+            signals = self.signals(vitals)
+            desired = self.desired(signals)
+            decisions = []
+            # highest-priority models place first: when budget is
+            # tight the interactive tier wins the bin-packing race
+            for name in sorted(
+                    desired,
+                    key=lambda n: (self._policies[n].slo.priority, n)):
+                p = self._policies[name]
+                a = signals.get(name, {}).get("actual",
+                                              self.actual(name))
+                d = desired[name]
+                if d > a:
+                    decisions.append(self._plan_grow(name, p, desired))
+                elif d < a:
+                    rid = self._pick_unload(name, vitals)
+                    if rid is not None:
+                        decisions.append({"action": "unload",
+                                          "model": name, "rid": rid})
+            decisions = [d for d in decisions if d is not None]
+            decisions.extend(self._plan_shrinks(vitals))
+        return decisions
+
+    def _plan_grow(self, name, policy, desired):
+        """One more copy of ``name``: best-fit placement, then a fresh
+        replica while the fleet has headroom, and only then LRU
+        eviction of lower-priority/idle tenants — evicting a live
+        model is the last resort, never a convenience."""
+        live = self._live_replicas()
+        candidates = [r.rid for r in live]
+        rid, _ = self.placer.choose(
+            name, policy.footprint(), candidates, evict=False)
+        if rid is not None:
+            self._reserve(rid, name, policy.footprint())
+            return {"action": "load", "model": name, "rid": rid,
+                    "evict": []}
+        if len(live) < self.max_replicas:
+            return {"action": "spawn_load", "model": name}
+        # strictly higher tiers are untouchable; within a tier the
+        # budget is a working set and LRU decides who pages out — an
+        # oversubscribed fleet must thrash at the margin, not deadlock
+        protected = {
+            m for m, pol in self._policies.items()
+            if desired.get(m, 0) > 0
+            and pol.slo.priority < policy.slo.priority}
+        protected.add(name)
+        # unmanaged models were placed by an operator, not this loop —
+        # never evict what we do not own
+        for r in live:
+            for m in r.models:
+                if m not in self._policies:
+                    protected.add(m)
+        # an in-flight reservation is not yet a loadable/unloadable
+        # model on the replica: evicting it would unload nothing and
+        # double-book the bytes it claimed
+        with self._lock:
+            protected |= {m for _rid, m in self._reserved}
+        rid, evictions = self.placer.choose(
+            name, policy.footprint(), candidates,
+            idle_s_fn=self._model_idle_s, protected=protected)
+        if rid is not None:
+            self._reserve(rid, name, policy.footprint())
+            return {"action": "load", "model": name, "rid": rid,
+                    "evict": evictions}
+        with self._lock:
+            self._counters["blocked"] += 1
+        return None
+
+    def _reserve(self, rid, name, nbytes):
+        """Claim budget for an in-flight load at PLAN time: the ledger
+        entry stops concurrent planners handing the same free bytes to
+        another model; the marker stops ``_sync_placer`` dropping the
+        claim before the (possibly slow) load lands."""
+        with self._lock:
+            self._reserved.add((rid, name))
+        self.placer.record_load(rid, name, nbytes)
+
+    def _unreserve(self, rid, name, loaded):
+        """Resolve a reservation: a landed load keeps its ledger entry
+        (now backed by ``replica.models``); a dropped/failed plan rolls
+        the claimed bytes back."""
+        with self._lock:
+            self._reserved.discard((rid, name))
+        if not loaded:
+            self.placer.record_unload(rid, name)
+
+    def _pick_unload(self, name, vitals):
+        """Which copy to retire: the replica where the model is doing
+        the least (fewest queued for it, then least loaded overall).
+        ``vitals`` is the tick's shared probe sweep."""
+        live = {r.rid: r for r in self._live_replicas()}
+        holders = [live[rid] for rid in self.placer.replicas_of(name)
+                   if rid in live]
+        if not holders:
+            return None
+
+        def load_of(r):
+            v = vitals.get(r.rid)
+            if v is None:
+                return (-1, -1)   # unreachable: cheapest to retire
+            return (v["queues"].get(name, 0), r.inflight)
+
+        return min(holders, key=lambda r: (load_of(r), r.rid)).rid
+
+    def _plan_shrinks(self, vitals):
+        """Empty replicas (no models, no sessions) above the fleet
+        floor begin draining; quiesced draining replicas close."""
+        out = []
+        live = self._live_replicas()
+        floor = self.min_fleet
+        empty = [r for r in live
+                 if not r.models
+                 and not self.placer.models_on(r.rid)
+                 and vitals.get(r.rid, {}).get("sessions", 0) == 0]
+        can_drop = len(live) - floor
+        for r in empty[:max(0, can_drop)]:
+            out.append({"action": "shrink", "rid": r.rid})
+        return out
+
+    # -- apply ---------------------------------------------------------
+
+    def run_once(self):
+        """One control iteration: sense → decide → apply, plus the
+        replica-seconds integral and finishing any quiesced shrinks.
+        Never raises — the loop survives anything a replica or the
+        chaos harness throws at it."""
+        self.replica_seconds()
+        try:
+            decisions = self.evaluate()
+        except Exception as e:  # mxlint: allow-broad-except(a sensing crash must not kill the control loop; next tick re-senses)
+            _log.warning("autoscaler: evaluate failed: %s: %s",
+                         type(e).__name__, e)
+            decisions = []
+        applied = []
+        for d in decisions:
+            if self._stop.is_set():
+                # shutting down: drop the remaining decisions (and
+                # their reservations) instead of racing the fleet's
+                # teardown with fresh loads/spawns
+                self._rollback(d)
+                continue
+            if self._apply_one(d):
+                applied.append(d)
+        self._finish_shrinks()
+        return applied
+
+    def _rollback(self, d):
+        if d.get("action") == "load":
+            self._unreserve(d["rid"], d["model"], loaded=False)
+
+    def _apply_one(self, d):
+        """Apply one decision behind the ``serving.scale`` fault point;
+        a fault (or any replica-side failure) drops the decision for
+        this tick — its budget reservation rolls back and level-
+        triggered re-evaluation retries it."""
+        action = d["action"]
+        what = f"{action}:{d.get('model') or d.get('rid')}"
+        try:
+            fault.inject("serving.scale", what)
+            if action == "load":
+                try:
+                    self._do_load(d["model"], d["rid"],
+                                  d.get("evict") or [])
+                except BaseException:
+                    self._unreserve(d["rid"], d["model"], loaded=False)
+                    raise
+                self._unreserve(d["rid"], d["model"], loaded=True)
+                self._count("scale_up")
+            elif action == "spawn_load":
+                r = self.fleet.spawn_one(models={})
+                self.placer.register_replica(r.rid)
+                self._count("spawn")
+                if self._stop.is_set():
+                    # stop() raced the (slow) spawn: the fleet may
+                    # already have shut down, and a replica appended
+                    # after its teardown snapshot would leak a live
+                    # subprocess nothing will ever close
+                    self.fleet.remove(r.rid, timeout=5.0)
+                    self.placer.forget_replica(r.rid)
+                    return False
+                self._reserve(r.rid, d["model"],
+                              self._policies[d["model"]].footprint())
+                try:
+                    self._do_load(d["model"], r.rid, [])
+                except BaseException:
+                    self._unreserve(r.rid, d["model"], loaded=False)
+                    raise
+                self._unreserve(r.rid, d["model"], loaded=True)
+                self._count("scale_up")
+            elif action == "unload":
+                self.fleet.get(d["rid"]).admin("unload", d["model"])
+                self.placer.record_unload(d["rid"], d["model"])
+                self._count("scale_down")
+            elif action == "shrink":
+                r = self.fleet.get(d["rid"])
+                r.begin_drain()
+                with self._lock:
+                    self._shrinking.setdefault(
+                        d["rid"],
+                        time.monotonic() + self.drain_s)
+            else:
+                raise ValueError(f"unknown scale action {action!r}")
+            return True
+        except fault.FaultInjected as e:
+            self._rollback(d)
+            self._count("faults")
+            _log.warning("autoscaler: %s dropped this tick (injected "
+                         "fault: %s)", what, e)
+            return False
+        except Exception as e:  # mxlint: allow-broad-except(one failed decision must not kill the loop; re-derived next tick from live state)
+            self._count("faults")
+            _log.warning("autoscaler: %s failed: %s: %s", what,
+                         type(e).__name__, e)
+            return False
+
+    def _do_load(self, name, rid, evictions):
+        p = self._policies[name]
+        r = self.fleet.get(rid)
+        for victim in evictions:
+            r.admin("unload", victim)
+            self.placer.record_unload(rid, victim)
+            self._count("evict")
+            with self._lock:
+                self._evictions[victim] = (
+                    self._evictions.get(victim, 0) + 1)
+            _log.info("autoscaler: evicted %s from %s (LRU, making "
+                      "room for %s)", victim, rid, name)
+        r.admin("load", name, path=p.path, warmup=p.warmup,
+                slo=p.slo.name)
+        self.placer.record_load(rid, name, p.footprint())
+
+    def _finish_shrinks(self):
+        """Close draining replicas once quiesced (in-flight == 0, no
+        active streams) or past the drain budget.  Sessions kept
+        stepping while draining; the close snapshots them all
+        synchronously, so migration onto a survivor is lossless —
+        never a mid-stream kill."""
+        with self._lock:
+            pending = dict(self._shrinking)
+        now = time.monotonic()
+        for rid, deadline in pending.items():
+            try:
+                r = self.fleet.get(rid)
+            except KeyError:
+                with self._lock:
+                    self._shrinking.pop(rid, None)
+                self.placer.forget_replica(rid)
+                continue
+            quiesced = (r.inflight == 0 and r.active_streams() == 0)
+            if not quiesced and now < deadline:
+                continue
+            try:
+                self.fleet.remove(rid, timeout=self.drain_s)
+            except Exception as e:  # mxlint: allow-broad-except(a replica that will not close cleanly is still removed from the books; its process dies with the fleet)
+                _log.warning("autoscaler: shrink of %s: %s: %s", rid,
+                             type(e).__name__, e)
+            self.placer.forget_replica(rid)
+            with self._lock:
+                self._shrinking.pop(rid, None)
+            self._count("shrink")
+
+    def _count(self, key):
+        with self._lock:
+            self._counters[key] += 1
+
+    # -- scale-from-zero (the router's on-demand path) -----------------
+
+    def ensure_loaded(self, name, _retries=3):
+        """Synchronous scale-from-zero: called by the router when a
+        request names a managed model with no live copy.  Loads one
+        copy (AOT path ⇒ sub-second), records the first-request
+        latency gauge, and returns once the model is routable.  No
+        budget anywhere and the fleet at its ceiling ⇒ typed
+        :class:`~..error.ModelEvictedError` (503 + Retry-After)."""
+        p = self._policies.get(name)
+        if p is None:
+            raise ModelNotFound(
+                f"model {name!r} is not managed by the autoscaler")
+        lock = self._demand_locks.setdefault(name, threading.Lock())
+        with lock:
+            if self.fleet.routable(name):
+                return None        # raced another request: already up
+            t0 = time.monotonic()
+            # eviction-protection counts WITHOUT replica I/O: a full
+            # desired() sweep would serialize one healthz round trip
+            # per replica inside the live request path (one hung
+            # replica = +10 s on the first request).  What protection
+            # actually needs is "does this model have live traffic" —
+            # placer residency + the router-side idle gauge answer
+            # that from memory
+            want = {
+                m: (1 if (self.actual(m) > 0
+                          and self._model_idle_s(m)
+                          < self.idle_unload_s)
+                    else pol.min_replicas)
+                for m, pol in self._policies.items()}
+            want[name] = max(1, want.get(name, 0))
+
+            def place():
+                # re-planned EVERY attempt against live state (a
+                # replica chosen by a previous attempt may have died
+                # or been shrunk meanwhile); the plan RESERVES its
+                # budget under _plan_lock — the background loop
+                # planning concurrently cannot hand the same free
+                # bytes to another model — and any failure (including
+                # the injected fault) rolls the reservation back
+                # before the retry re-plans
+                if self.fleet.routable(name):
+                    return
+                with self._plan_lock:
+                    self._sync_placer()
+                    plan = self._plan_grow(name, p, want)
+                if plan is None:
+                    raise ModelEvictedError(
+                        f"model {name!r} cannot be placed: every "
+                        f"replica's HBM budget is held by busier "
+                        f"models and the fleet is at its "
+                        f"{self.max_replicas}-replica ceiling")
+                rid = plan.get("rid")
+                try:
+                    fault.inject("serving.scale",
+                                 f"on_demand:{name}")
+                    if plan["action"] == "spawn_load":
+                        r = self.fleet.spawn_one(models={})
+                        self.placer.register_replica(r.rid)
+                        self._count("spawn")
+                        rid = r.rid
+                        self._reserve(rid, name, p.footprint())
+                        self._do_load(name, rid, [])
+                    else:
+                        self._do_load(name, rid,
+                                      plan.get("evict") or [])
+                except KeyError as e:
+                    if rid is not None:
+                        self._unreserve(rid, name, loaded=False)
+                    # the planned replica vanished between plan and
+                    # place: typed + retryable (the next attempt
+                    # re-plans), never a raw 500 to the live request
+                    raise ReplicaUnavailableError(
+                        f"replica vanished while placing {name!r}: "
+                        f"{e}") from e
+                except BaseException:
+                    if rid is not None:
+                        self._unreserve(rid, name, loaded=False)
+                    raise
+                self._unreserve(rid, name, loaded=True)
+
+            # unlike the background loop, a dropped decision here
+            # would fail a live request — retry injected transients
+            # and vanished-replica races, but NOT the deterministic
+            # no-capacity verdict (ModelEvictedError is a
+            # ConnectionError for the router's 503 mapping, yet
+            # re-planning it three times cannot change the answer)
+            fault.retry(place, max_attempts=_retries, backoff=0.01,
+                        max_backoff=0.2,
+                        retryable=(fault.TransientFault,
+                                   ReplicaUnavailableError,
+                                   ConnectionResetError,
+                                   TimeoutError))
+            ms = (time.monotonic() - t0) * 1000.0
+            with self._lock:
+                self._counters["scale_from_zero"] += 1
+                self._scale_from_zero_ms[name] = round(ms, 3)
+            self._count("scale_up")
+            _log.info("autoscaler: scale-from-zero %s in %.0f ms",
+                      name, ms)
+            return ms
+
+    # -- exposition ----------------------------------------------------
+
+    def describe(self):
+        """Desired-vs-actual per model + decision counters — rendered
+        on the router's ``/metrics`` and under ``/healthz``
+        ``"autoscale"`` (additive)."""
+        desired = dict(self._last_desired)
+        models = {}
+        for name, p in self._policies.items():
+            models[name] = {
+                "desired": desired.get(name, p.min_replicas),
+                "actual": self.actual(name),
+                "slo": p.slo.name,
+                "min_replicas": p.min_replicas,
+                "scale_from_zero_ms":
+                    self._scale_from_zero_ms.get(name),
+            }
+        with self._lock:
+            counters = dict(self._counters)
+            evictions = dict(self._evictions)
+            shrinking = sorted(self._shrinking)
+        return {
+            "models": models,
+            "decisions": counters,
+            "evictions": evictions,
+            "replicas": len(self._live_replicas()),
+            "shrinking": shrinking,
+            "replica_seconds": round(self.replica_seconds(), 3),
+            "budget_bytes": self.placer.budget_bytes,
+            "interval_s": self.interval_s,
+            "idle_unload_s": self.idle_unload_s,
+        }
+
+    # -- loop ----------------------------------------------------------
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="autoscaler",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(max(5.0, self.interval_s * 2))
+            self._thread = None
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            self.run_once()

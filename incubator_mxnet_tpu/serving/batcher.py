@@ -27,6 +27,7 @@ every request in the batch.
 """
 from __future__ import annotations
 
+import heapq
 import queue as _queue
 import threading
 import time
@@ -38,7 +39,62 @@ from .. import fault
 from .admission import DeadlineExceeded, ServingError
 
 __all__ = ["DynamicBatcher", "ContinuousBatcher", "PendingResult",
-           "StreamResult", "parse_buckets"]
+           "StreamResult", "WeightedFairGate", "parse_buckets"]
+
+
+class WeightedFairGate:
+    """Weighted fair queueing of device launches across the models of
+    one replica (multi-tenant packing, docs/serving.md "Autoscaling").
+
+    Each model's batcher owns its own worker thread; when several
+    models share a replica those workers would otherwise contend for
+    the device in OS-scheduler order, letting a chatty ``batch``-tier
+    model starve an ``interactive`` one.  The gate serializes batch
+    executions and admits them in virtual-finish-time order (classic
+    WFQ): a batch of model *m* with weight *w* finishes at
+    ``max(vtime, finish[m]) + cost/w``, and the pending batch with the
+    smallest finish time runs next — so over any contended window each
+    model gets device time proportional to its SLO weight, regardless
+    of how many batches it queues.
+
+    With a single model (or no contention) the gate degenerates to an
+    uncontended lock acquire per batch."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._vtime = 0.0
+        self._finish: dict[str, float] = {}   # per-key virtual finish
+        self._heap: list = []                 # (finish, seq, key)
+        self._seq = 0
+        self._busy = False
+
+    def acquire(self, key, weight=1.0, cost=1.0):
+        """Block until it is ``key``'s turn; returns the token to hand
+        :meth:`release`.  ``cost`` is the batch's nominal service
+        demand (rows); ``weight`` the model's SLO share."""
+        with self._cond:
+            start = max(self._vtime, self._finish.get(key, 0.0))
+            finish = start + float(cost) / max(float(weight), 1e-6)
+            self._finish[key] = finish
+            self._seq += 1
+            ticket = (finish, self._seq, key)
+            heapq.heappush(self._heap, ticket)
+            while self._busy or self._heap[0] != ticket:
+                self._cond.wait()
+            heapq.heappop(self._heap)
+            self._busy = True
+        return finish
+
+    def release(self, token):
+        with self._cond:
+            self._busy = False
+            self._vtime = max(self._vtime, float(token))
+            self._cond.notify_all()
+
+    def forget(self, key):
+        """Drop a retired model's virtual-time state (unload path)."""
+        with self._cond:
+            self._finish.pop(key, None)
 
 
 def parse_buckets(text=None):
@@ -149,10 +205,15 @@ class DynamicBatcher:
     """
 
     def __init__(self, name, predictor, metrics=None, buckets=None,
-                 max_batch=None, max_latency_ms=None):
+                 max_batch=None, max_latency_ms=None, exec_gate=None,
+                 weight=1.0):
         self.name = name
         self.predictor = predictor
         self.metrics = metrics
+        # multi-tenant replicas share one WeightedFairGate across all
+        # model batchers; weight comes from the model's SLO class
+        self.exec_gate = exec_gate
+        self.weight = float(weight)
         self.buckets = (list(buckets) if buckets is not None
                         else parse_buckets())
         self.max_batch = int(
@@ -325,8 +386,22 @@ class DynamicBatcher:
                     for s in stacked)
 
             def run():
+                # fault point + WFQ slot both live INSIDE the retry:
+                # the gate is held only for the real device launch —
+                # holding it across fault.retry's backoff sleeps would
+                # stall every co-packed model behind one tenant's
+                # transient faults (the priority inversion the gate
+                # exists to prevent)
                 fault.inject("serving.execute", self.name)
-                return self.predictor(*stacked)
+                token = (None if self.exec_gate is None
+                         else self.exec_gate.acquire(
+                             self.name, self.weight,
+                             cost=float(padded_to)))
+                try:
+                    return self.predictor(*stacked)
+                finally:
+                    if token is not None:
+                        self.exec_gate.release(token)
 
             t_exec = time.monotonic()
             out = fault.retry(run, max_attempts=self._retries,

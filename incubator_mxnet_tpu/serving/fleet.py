@@ -148,9 +148,36 @@ class _ReplicaBase:
         self._killed = True
         self.state = DEAD
 
+    def has_model(self, name):
+        """True when this replica serves ``name`` (multi-tenant
+        routing filter: replicas no longer all hold the same set)."""
+        return name in self.models
+
     def describe(self):
         return {"state": self.state, "healthy": self._healthy,
-                "inflight": self._inflight, "backend": self.backend}
+                "inflight": self._inflight, "backend": self.backend,
+                "models": sorted(self.models)}
+
+    # -- autoscaler signals (defaults; backends refine) ----------------
+
+    def vitals(self):
+        """One combined load probe: ``{"queues": {model: depth},
+        "sessions": live-session-count, "streams": active-stream-
+        count}``.  The autoscaler calls this ONCE per replica per
+        tick — for a process replica it is a single ``/healthz``
+        round trip, and splitting it per-signal would multiply the
+        control loop's I/O.  A dead/unreachable replica reports
+        empty."""
+        return {"queues": {}, "sessions": 0, "streams": 0}
+
+    def active_streams(self):
+        """Streams currently riding this replica's decode loops —
+        re-probed fresh each time the shrink path re-checks quiesce
+        (a shrink only closes a replica once they reach a step
+        boundary).  Queue depths and session counts ride the same
+        :meth:`vitals` probe and have no separate accessor: the
+        autoscaler consumes the combined sweep."""
+        return self.vitals()["streams"]
 
     # -- interface the backends implement -----------------------------
 
@@ -332,17 +359,33 @@ class ThreadReplica(_ReplicaBase):
         for name in self.sessions.names():
             self.sessions.get(name).batcher.drain(timeout=5.0)
 
-    def admin(self, verb, name, path=None, version=None, warmup=None):
+    def admin(self, verb, name, path=None, version=None, warmup=None,
+              slo=None):
         self._gone()
         if verb == "load":
-            return self.repository.load(name, path, version=version,
-                                        warmup=warmup)
+            out = self.repository.load(name, path, version=version,
+                                       warmup=warmup, slo=slo)
+            self.models[name] = path
+            return out
         if verb == "reload":
-            return self.repository.reload(name, path=path,
-                                          version=version, warmup=warmup)
+            out = self.repository.reload(name, path=path,
+                                         version=version, warmup=warmup,
+                                         slo=slo)
+            if path is not None:
+                self.models[name] = path
+            return out
         if verb == "unload":
-            return self.repository.unload(name)
+            out = self.repository.unload(name)
+            self.models.pop(name, None)
+            return out
         raise ValueError(f"unknown admin verb {verb!r}")
+
+    def vitals(self):
+        if self._killed:
+            return {"queues": {}, "sessions": 0, "streams": 0}
+        return {"queues": self.repository.queue_depths(),
+                "sessions": self.sessions.active_sessions(),
+                "streams": self.sessions.active_streams()}
 
     def model_meta(self, name):
         self._gone()
@@ -522,7 +565,8 @@ class ProcessReplica(_ReplicaBase):
     def healthz(self):
         return self._http("GET /healthz", timeout_s=10.0)
 
-    def admin(self, verb, name, path=None, version=None, warmup=None):
+    def admin(self, verb, name, path=None, version=None, warmup=None,
+              slo=None):
         body = {}
         if path is not None:
             body["path"] = path
@@ -530,12 +574,37 @@ class ProcessReplica(_ReplicaBase):
             body["version"] = version
         if warmup is not None:
             body["warmup"] = warmup
+        if slo is not None:
+            body["slo"] = getattr(slo, "name", slo)
         code, payload = self._http(
             f"POST /v1/models/{name}:{verb}",
             json.dumps(body).encode(), timeout_s=600.0)
         if code != 200:
             self._raise_for(code, payload, self.rid, name)
+        if verb == "load" or (verb == "reload" and path is not None):
+            self.models[name] = path
+        elif verb == "unload":
+            self.models.pop(name, None)
         return payload
+
+    def vitals(self):
+        empty = {"queues": {}, "sessions": 0, "streams": 0}
+        try:
+            code, body = self.healthz()
+        except (ConnectionError, ServingError):
+            return empty
+        if code not in (200, 503) or not isinstance(body, dict):
+            return empty
+        sessions = (body.get("sessions") or {}).values()
+        return {
+            "queues": {name: int(m.get("queue_depth") or 0)
+                       for name, m in (body.get("models")
+                                       or {}).items()},
+            "sessions": sum(int(s.get("active_sessions") or 0)
+                            for s in sessions),
+            "streams": sum(int(s.get("active_streams") or 0)
+                           for s in sessions),
+        }
 
     def model_meta(self, name):
         code, payload = self._http("GET /v1/models", timeout_s=30.0)
@@ -726,16 +795,17 @@ class ReplicaFleet:
 
     # -- lifecycle ----------------------------------------------------
 
-    def _new_replica(self):
+    def _new_replica(self, models=None):
         with self._lock:
             rid = f"r{self._next_rid}"
             self._next_rid += 1
+        models = self.models if models is None else models
         if self.backend == "process":
-            return ProcessReplica(rid, self.models, warmup=self._warmup,
+            return ProcessReplica(rid, models, warmup=self._warmup,
                                   probe_fails=self._probe_fails,
                                   session_models=self.session_models,
                                   session_dir=self.session_dir)
-        return ThreadReplica(rid, self.models, buckets=self._buckets,
+        return ThreadReplica(rid, models, buckets=self._buckets,
                              warmup=self._warmup,
                              probe_fails=self._probe_fails,
                              session_models=self.session_models,
@@ -771,6 +841,42 @@ class ReplicaFleet:
         self.start_prober()
         return self
 
+    def spawn_one(self, models=None):
+        """Bring up ONE additional replica (the autoscaler's grow
+        verb), optionally with its own model subset — ``models=None``
+        loads the fleet default set, ``{}`` spawns an empty replica
+        the bin-packer then places models onto.  Blocks through load +
+        warmup; a failed start leaves the replica out of the list and
+        raises."""
+        r = self._new_replica(models=None if models is None
+                              else dict(models))
+        try:
+            r.start()
+        except Exception as e:
+            raise ReplicaUnavailableError(
+                f"replica {r.rid} failed to start: "
+                f"{type(e).__name__}: {e}") from e
+        with self._lock:
+            self._replicas.append(r)
+        return r
+
+    def remove(self, rid, timeout=30.0):
+        """Drain + close one replica and drop it from the fleet (the
+        autoscaler's shrink verb — the caller has already waited out
+        sessions/in-flight work; ``close`` still snapshots whatever
+        remains so a post-shrink migration is lossless)."""
+        r = self.get(rid)
+        r.begin_drain()
+        try:
+            r.close(timeout)
+        finally:
+            with self._lock:
+                try:
+                    self._replicas.remove(r)
+                except ValueError:
+                    pass
+        return r
+
     def adopt(self, replica):
         """Take ownership of an externally-built replica (custom
         backend, pre-warmed process) — it is probed and routed like a
@@ -804,8 +910,11 @@ class ReplicaFleet:
                 return r
         raise KeyError(f"no replica {rid!r}")
 
-    def routable(self):
-        return [r for r in self.replicas if r.routable()]
+    def routable(self, name=None):
+        """Routable replicas; with ``name``, only those serving that
+        model (multi-tenant packing means replicas differ)."""
+        return [r for r in self.replicas
+                if r.routable() and (name is None or r.has_model(name))]
 
     def ready_count(self):
         return len(self.routable())
@@ -816,13 +925,28 @@ class ReplicaFleet:
         live = [r for r in self.replicas if r.state != DEAD]
         return bool(live) and all(r.state == DRAINING for r in live)
 
-    def pick(self, exclude=frozenset()):
+    def pick(self, exclude=frozenset(), name=None):
         """Least-loaded routable replica, preferring ones not in
-        ``exclude`` (already-failed hops).  When every routable replica
-        has been tried, fall back to the least-loaded one anyway — a
-        transient double-fault on a 2-replica fleet should burn the
-        remaining failover budget, not strand the request."""
-        candidates = self.routable()
+        ``exclude`` (already-failed hops).  With ``name``, only
+        replicas serving that model are candidates.  When every
+        routable replica has been tried, fall back to the least-loaded
+        one anyway — a transient double-fault on a 2-replica fleet
+        should burn the remaining failover budget, not strand the
+        request.
+
+        Last resort: with nothing healthy, READY-but-quarantined
+        replicas are still offered.  Quarantine demotes a replica
+        below its healthy peers; it must not blackhole a fleet whose
+        every survivor is mid-probe-window (a killed peer plus one
+        unlucky probe burst used to 503 live requests for up to a
+        probe interval).  A successful hop re-admits the replica
+        (passive health note); a failed one costs what the immediate
+        503 would have cost anyway."""
+        candidates = self.routable(name)
+        if not candidates:
+            candidates = [r for r in self.replicas
+                          if r.state == READY
+                          and (name is None or r.has_model(name))]
         if not candidates:
             return None
         fresh = [r for r in candidates if r.rid not in exclude]
@@ -848,9 +972,16 @@ class ReplicaFleet:
         if cached is not None:
             return cached
         last = None
-        for r in self.replicas:
-            if r.state == DEAD:
-                continue
+        claimants = [r for r in self.replicas
+                     if r.state != DEAD and r.has_model(name)]
+        if not claimants:
+            # nobody is assigned the model.  On a classic fleet (every
+            # replica loads self.models) that is an authoritative 404;
+            # under autoscaling the router consults the control plane
+            # (scale-from-zero) before surfacing it.
+            raise ModelNotFound(f"model {name!r} not loaded on any "
+                                "replica")
+        for r in claimants:
             try:
                 specs = r.model_meta(name)
                 self._meta_cache[name] = specs
@@ -866,9 +997,11 @@ class ReplicaFleet:
 
     # -- fleet-wide admin ---------------------------------------------
 
-    def load_everywhere(self, name, path, version=None, warmup=None):
+    def load_everywhere(self, name, path, version=None, warmup=None,
+                        slo=None):
         return self._admin_everywhere("load", name, path=path,
-                                      version=version, warmup=warmup)
+                                      version=version, warmup=warmup,
+                                      slo=slo)
 
     def unload_everywhere(self, name):
         return self._admin_everywhere("unload", name)
@@ -971,8 +1104,11 @@ class ReplicaFleet:
                 fault.inject("serving.probe", r.rid)
                 code, body = r.healthz()
                 models = body.get("models", {})
+                # the contract is per-REPLICA: a multi-tenant replica
+                # only owes the models packed onto it, not the fleet
+                # union (on a classic fleet r.models == self.models)
                 ok = (code == 200
-                      and set(self.models) <= set(models)
+                      and set(r.models) <= set(models)
                       and all(m.get("state") == "ready"
                               for m in models.values()))
             except Exception:  # mxlint: allow-broad-except(a probe that cannot complete IS the failure signal being counted)

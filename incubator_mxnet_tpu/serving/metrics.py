@@ -99,7 +99,7 @@ class Histogram:
 class _ModelMetrics:
     __slots__ = ("requests", "errors", "batches", "batch_hist",
                  "e2e_ms", "compute_ms", "queue_ms", "padded_rows",
-                 "cancelled")
+                 "cancelled", "t_last_request")
 
     def __init__(self):
         self.requests = {}       # {http-code: count}
@@ -107,6 +107,10 @@ class _ModelMetrics:
         self.batches = 0
         self.padded_rows = 0
         self.cancelled = 0
+        # monotonic stamp of the last request (None until one lands):
+        # the idle-seconds gauge the autoscaler's scale-to-zero /
+        # idle-unload decision reads
+        self.t_last_request = None
         self.batch_hist = Histogram(buckets=(1, 2, 4, 8, 16, 32, 64, 128))
         self.e2e_ms = Histogram()
         self.compute_ms = Histogram()
@@ -168,6 +172,7 @@ class ServingMetrics:
         m = self._model(model)
         with self._lock:
             m.requests[code] = m.requests.get(code, 0) + 1
+            m.t_last_request = time.monotonic()
             if code >= 400:
                 m.errors += 1
         if e2e_ms is not None:
@@ -224,6 +229,36 @@ class ServingMetrics:
         if self._session_compile_fn is not None:
             total += sum(self._session_compile_fn().values())
         return total
+
+    def idle_seconds(self, model=None):
+        """Seconds since the model's last request — the autoscaler's
+        idle-unload input signal.  A model that has never seen a
+        request reports its full metrics-instance age (idle since
+        "forever" as far as scale-to-zero is concerned).  With
+        ``model=None`` returns the ``{name: idle_s}`` dict."""
+        now = time.monotonic()
+        with self._lock:
+            if model is not None:
+                m = self._models.get(model)
+                last = (m.t_last_request if m is not None else None)
+                return now - (last if last is not None
+                              else self._started)
+            return {name: now - (m.t_last_request
+                                 if m.t_last_request is not None
+                                 else self._started)
+                    for name, m in self._models.items()}
+
+    def last_request_uptime_s(self, model):
+        """Monotonic stamp of the model's last request, expressed as
+        seconds after this metrics instance started (``None`` until a
+        request lands).  Monotonic by design — wall-clock timestamps
+        are banned repo-wide (mxlint MX-TIME001); operators correlate
+        via ``mxnet_serving_uptime_seconds`` on the same scrape."""
+        with self._lock:
+            m = self._models.get(model)
+            if m is None or m.t_last_request is None:
+                return None
+            return m.t_last_request - self._started
 
     def service_ms_estimate(self, model):
         """Recent p50 end-to-end latency for ``model`` (None until
@@ -341,6 +376,25 @@ class ServingMetrics:
         for name, m in sorted(models.items()):
             L.append(f'mxnet_serving_cancelled_total'
                      f'{{model="{_esc(name)}"}} {m.cancelled}')
+        L.append("# HELP mxnet_serving_model_idle_seconds Seconds "
+                 "since the model's last request (the autoscaler's "
+                 "idle-unload signal).")
+        L.append("# TYPE mxnet_serving_model_idle_seconds gauge")
+        idle = self.idle_seconds()
+        for name in sorted(models):
+            L.append(f'mxnet_serving_model_idle_seconds'
+                     f'{{model="{_esc(name)}"}} {idle[name]:.3f}')
+        L.append("# HELP mxnet_serving_model_last_request_uptime_"
+                 "seconds Last request's monotonic stamp as seconds "
+                 "after metrics start (-1 until a request lands; "
+                 "correlate with mxnet_serving_uptime_seconds).")
+        L.append("# TYPE mxnet_serving_model_last_request_uptime_"
+                 "seconds gauge")
+        for name in sorted(models):
+            last = self.last_request_uptime_s(name)
+            L.append(f'mxnet_serving_model_last_request_uptime_seconds'
+                     f'{{model="{_esc(name)}"}} '
+                     f'{-1 if last is None else round(last, 3)}')
         sess = (self._session_stats_fn() if self._session_stats_fn
                 else {})
         for metric, key, kind, help_ in (
@@ -426,6 +480,7 @@ class ServingMetrics:
                 errs, batches = m.errors, m.batches
                 padded, cancelled = m.padded_rows, m.cancelled
             out[f"{name}.requests"] = reqs
+            out[f"{name}.idle_s"] = round(self.idle_seconds(name), 3)
             out[f"{name}.errors"] = errs
             out[f"{name}.batches"] = batches
             out[f"{name}.padded_rows"] = padded
@@ -450,6 +505,18 @@ class ServingMetrics:
         profiler.unregister_stats_provider("serving", self.snapshot)
 
 
+class _RouteModel:
+    """Per-model router-side counters (the autoscaler's load signal)."""
+
+    __slots__ = ("requests", "e2e_ms", "t_last", "inflight")
+
+    def __init__(self):
+        self.requests = {}       # {final-http-code: count}
+        self.e2e_ms = Histogram()
+        self.t_last = None       # monotonic stamp of last route
+        self.inflight = 0
+
+
 class FleetMetrics:
     """Fleet-level observability: the router + replica-lifecycle view.
 
@@ -462,6 +529,7 @@ class FleetMetrics:
 
     def __init__(self):
         self._lock = threading.Lock()
+        self._started = time.monotonic()
         self._codes: dict = {}            # {http-code: count}
         self._probe_failures: dict = {}   # {replica-id: count}
         self.failovers = 0
@@ -471,8 +539,13 @@ class FleetMetrics:
         self.session_losses = 0           # typed SessionLostError out
         self.route_cancels = 0            # client gone mid-route
         self.route_ms = Histogram()
+        # per-model router view: the autoscaler's input signal (queue
+        # depth rides on replica healthz; p99 / inflight / idle live
+        # here, where every routed request passes)
+        self._by_model: dict = {}         # {model: _RouteModel}
         self._fleet_states_fn = None      # () -> {rid: state-dict}
         self._session_count_fn = None     # () -> live affinity entries
+        self._autoscale_fn = None         # () -> autoscaler.describe()
 
     def attach_fleet(self, fleet):
         """Wire the live replica-state gauge callback."""
@@ -483,13 +556,73 @@ class FleetMetrics:
         fleet currently tracks, wherever their carry lives)."""
         self._session_count_fn = fn
 
+    def attach_autoscaler(self, fn):
+        """Wire the autoscaler's describe callback so desired-vs-
+        actual replica counts and scale-decision counters render on
+        the router's ``/metrics`` page."""
+        self._autoscale_fn = fn
+
+    def _route_model(self, model):
+        with self._lock:
+            m = self._by_model.get(model)
+            if m is None:
+                m = self._by_model[model] = _RouteModel()
+            return m
+
     # -- recording hooks ----------------------------------------------
 
-    def record_route(self, code, ms=None):
+    def record_route(self, code, ms=None, model=None):
         with self._lock:
             self._codes[code] = self._codes.get(code, 0) + 1
         if ms is not None:
             self.route_ms.observe(ms)
+        if model is not None:
+            m = self._route_model(model)
+            with self._lock:
+                m.requests[code] = m.requests.get(code, 0) + 1
+                m.t_last = time.monotonic()
+            if ms is not None:
+                m.e2e_ms.observe(ms)
+
+    def note_model_inflight(self, model, delta):
+        """Routed-requests-in-flight gauge per model (bumped around
+        each route; part of the autoscaler's load signal)."""
+        m = self._route_model(model)
+        with self._lock:
+            m.inflight = max(0, m.inflight + int(delta))
+
+    def model_idle_s(self, model):
+        """Seconds since the last routed request for ``model``; a
+        model never routed reports this instance's full age."""
+        with self._lock:
+            m = self._by_model.get(model)
+            last = m.t_last if m is not None else None
+            return time.monotonic() - (last if last is not None
+                                       else self._started)
+
+    def model_stats(self):
+        """{model: {requests, dropped, p50_ms, p99_ms, inflight,
+        idle_s}} — the router-side half of the autoscaler's signal."""
+        now = time.monotonic()
+        with self._lock:
+            items = list(self._by_model.items())
+        out = {}
+        for name, m in items:
+            with self._lock:
+                reqs = dict(m.requests)
+                inflight = m.inflight
+                last = m.t_last
+            out[name] = {
+                "requests": sum(reqs.values()),
+                "dropped": sum(n for c, n in reqs.items()
+                               if c in (429, 503)),
+                "p50_ms": m.e2e_ms.quantile(0.50),
+                "p99_ms": m.e2e_ms.quantile(0.99),
+                "inflight": inflight,
+                "idle_s": round(now - (last if last is not None
+                                       else self._started), 3),
+            }
+        return out
 
     def record_failover(self):
         with self._lock:
@@ -597,6 +730,72 @@ class FleetMetrics:
         for code, n in sorted(codes.items()):
             L.append(f'mxnet_serving_fleet_requests_total'
                      f'{{code="{code}"}} {n}')
+        with self._lock:
+            by_model = dict(self._by_model)
+        L.append("# HELP mxnet_serving_fleet_model_requests_total "
+                 "Routed requests by model and final HTTP code.")
+        L.append("# TYPE mxnet_serving_fleet_model_requests_total "
+                 "counter")
+        for name, m in sorted(by_model.items()):
+            with self._lock:
+                mcodes = dict(m.requests)
+            for code, n in sorted(mcodes.items()):
+                L.append(f'mxnet_serving_fleet_model_requests_total'
+                         f'{{model="{_esc(name)}",code="{code}"}} {n}')
+        L.append("# HELP mxnet_serving_fleet_model_inflight Routed "
+                 "requests currently in flight per model.")
+        L.append("# TYPE mxnet_serving_fleet_model_inflight gauge")
+        for name, m in sorted(by_model.items()):
+            L.append(f'mxnet_serving_fleet_model_inflight'
+                     f'{{model="{_esc(name)}"}} {m.inflight}')
+        L.append("# HELP mxnet_serving_model_idle_seconds Seconds "
+                 "since the model's last routed request (the "
+                 "autoscaler's idle-unload signal).")
+        L.append("# TYPE mxnet_serving_model_idle_seconds gauge")
+        for name in sorted(by_model):
+            L.append(f'mxnet_serving_model_idle_seconds'
+                     f'{{model="{_esc(name)}"}} '
+                     f'{self.model_idle_s(name):.3f}')
+        scale = (self._autoscale_fn() if self._autoscale_fn else None)
+        if scale is not None:
+            L.append("# HELP mxnet_serving_autoscale_desired_replicas "
+                     "Replica copies the control loop wants per model.")
+            L.append("# TYPE mxnet_serving_autoscale_desired_replicas "
+                     "gauge")
+            for name, st in sorted(scale.get("models", {}).items()):
+                L.append(f'mxnet_serving_autoscale_desired_replicas'
+                         f'{{model="{_esc(name)}"}} {st["desired"]}')
+            L.append("# HELP mxnet_serving_autoscale_actual_replicas "
+                     "Replica copies currently serving per model.")
+            L.append("# TYPE mxnet_serving_autoscale_actual_replicas "
+                     "gauge")
+            for name, st in sorted(scale.get("models", {}).items()):
+                L.append(f'mxnet_serving_autoscale_actual_replicas'
+                         f'{{model="{_esc(name)}"}} {st["actual"]}')
+            L.append("# HELP mxnet_serving_autoscale_decisions_total "
+                     "Scale decisions applied, by action.")
+            L.append("# TYPE mxnet_serving_autoscale_decisions_total "
+                     "counter")
+            for action, n in sorted(
+                    scale.get("decisions", {}).items()):
+                L.append(f'mxnet_serving_autoscale_decisions_total'
+                         f'{{action="{_esc(action)}"}} {n}')
+            L.append("# HELP mxnet_serving_autoscale_evictions_total "
+                     "Models evicted from a replica by the HBM "
+                     "bin-packer (LRU), by model.")
+            L.append("# TYPE mxnet_serving_autoscale_evictions_total "
+                     "counter")
+            for name, n in sorted(
+                    scale.get("evictions", {}).items()):
+                L.append(f'mxnet_serving_autoscale_evictions_total'
+                         f'{{model="{_esc(name)}"}} {n}')
+            L.append("# HELP mxnet_serving_autoscale_replica_seconds_"
+                     "total Integrated live-replica time (the fleet-"
+                     "economics number the autoscale bench gates).")
+            L.append("# TYPE mxnet_serving_autoscale_replica_seconds_"
+                     "total counter")
+            L.append(f"mxnet_serving_autoscale_replica_seconds_total "
+                     f"{scale.get('replica_seconds', 0.0):.3f}")
         L.append("# HELP mxnet_serving_fleet_failovers_total Request "
                  "hops retried on a different replica.")
         L.append("# TYPE mxnet_serving_fleet_failovers_total counter")
@@ -643,6 +842,9 @@ class FleetMetrics:
                 "probe_failures": dict(self._probe_failures),
             }
         out["route_ms"] = self.route_ms.snapshot()
+        out["models"] = self.model_stats()
+        if self._autoscale_fn is not None:
+            out["autoscale"] = self._autoscale_fn()
         return out
 
     def register_with_profiler(self):

@@ -25,8 +25,8 @@ import threading
 
 from ..base import get_env
 from .admission import (Admission, ModelNotFound, ServingError,
-                        checked_enqueue)
-from .batcher import DynamicBatcher, parse_buckets
+                        checked_enqueue, slo_class)
+from .batcher import DynamicBatcher, WeightedFairGate, parse_buckets
 
 __all__ = ["ModelRepository", "ModelEntry"]
 
@@ -35,20 +35,23 @@ class ModelEntry:
     """One live (name, version) binding: predictor + its batcher."""
 
     __slots__ = ("name", "version", "path", "predictor", "batcher",
-                 "cold_start_ms")
+                 "cold_start_ms", "slo")
 
-    def __init__(self, name, version, path, predictor, batcher):
+    def __init__(self, name, version, path, predictor, batcher,
+                 slo=None):
         self.name = name
         self.version = version
         self.path = path
         self.predictor = predictor
         self.batcher = batcher
         self.cold_start_ms = None      # set once load + warmup finishes
+        self.slo = slo_class(slo)      # SLO class (admission + WFQ)
 
     def describe(self):
         return {
             "version": self.version,
             "path": self.path,
+            "slo": self.slo.name,
             "buckets": list(self.batcher.buckets),
             "max_batch": self.batcher.max_batch,
             "batch_polymorphic": self.predictor.batch_polymorphic,
@@ -90,6 +93,9 @@ class ModelRepository:
         self._models: dict[str, ModelEntry] = {}
         self._retired: list[ModelEntry] = []
         self._loading: dict[str, int] = {}   # name -> in-flight builds
+        # one WFQ gate per repository: batches of co-packed models are
+        # admitted to the device in SLO-weighted fair order
+        self.exec_gate = WeightedFairGate()
         self._lock = threading.Lock()
         if self.metrics is not None:
             self.metrics.attach_repository(self)
@@ -133,9 +139,10 @@ class ModelRepository:
         with self._lock:
             return sorted(self._loading)
 
-    def _build_entry(self, name, path, version, warmup):
+    def _build_entry(self, name, path, version, warmup, slo=None):
         import time
         from ..deploy import load_predictor
+        slo = slo_class(slo)
         t0 = time.monotonic()
         predictor = load_predictor(path)
         # the artifact carries its export-time IR bill of health
@@ -150,8 +157,11 @@ class ModelRepository:
                 f"{gl['findings']} graphlint finding(s) "
                 f"{gl.get('by_rule')} — see its meta.json for details")
         batcher = DynamicBatcher(name, predictor, metrics=self.metrics,
-                                 buckets=self._buckets)
-        entry = ModelEntry(name, version, path, predictor, batcher)
+                                 buckets=self._buckets,
+                                 exec_gate=self.exec_gate,
+                                 weight=slo.weight)
+        entry = ModelEntry(name, version, path, predictor, batcher,
+                           slo=slo)
         do_warmup = self._warmup_default if warmup is None else warmup
         if do_warmup:
             try:
@@ -189,14 +199,16 @@ class ModelRepository:
             sizes = list(bucket_sizes)
         return entry.predictor.warmup(sizes)
 
-    def load(self, name, path, version=None, warmup=None):
+    def load(self, name, path, version=None, warmup=None, slo=None):
         """Load a new model under ``name``; errors if it exists
         (``reload`` is the replace verb).  The entry only becomes
-        visible after a successful load + warmup."""
+        visible after a successful load + warmup.  ``slo`` names the
+        model's :class:`~.admission.SloClass` (admission shed order +
+        WFQ weight); default ``standard``."""
         with self._loading_state(name):
             entry = self._build_entry(
                 name, path, 1 if version is None else int(version),
-                warmup)
+                warmup, slo=slo)
         with self._lock:
             if name in self._models:
                 entry.batcher.close()
@@ -206,10 +218,12 @@ class ModelRepository:
             self._models[name] = entry
         return entry.describe()
 
-    def reload(self, name, path=None, version=None, warmup=None):
+    def reload(self, name, path=None, version=None, warmup=None,
+               slo=None):
         """Atomic swap: build + warm the replacement, then swap the
         name binding; in-flight requests finish on the old version,
-        whose batcher drains in the background."""
+        whose batcher drains in the background.  ``slo`` defaults to
+        the old version's class (a reload is not a policy change)."""
         with self._lock:
             old = self._models.get(name)
         if old is None:
@@ -218,7 +232,7 @@ class ModelRepository:
             entry = self._build_entry(
                 name, path or old.path,
                 old.version + 1 if version is None else int(version),
-                warmup)
+                warmup, slo=slo if slo is not None else old.slo)
         with self._lock:
             old = self._models.get(name)   # re-read: racing reload/unload
             if old is not None:
@@ -248,6 +262,7 @@ class ModelRepository:
         if entry is None:
             raise ModelNotFound(f"model {name!r} is not loaded")
         entry.batcher.drain()
+        self.exec_gate.forget(name)
         return {"unloaded": name, "version": entry.version}
 
     def drain_all(self, timeout=30.0):
@@ -302,7 +317,7 @@ class ModelRepository:
         return self._submit_current(name, lambda entry:
             entry.batcher.submit(
                 inputs, self.admission.deadline_ms(deadline_ms),
-                admit=self.admission.gate(name)))
+                admit=self.admission.gate(name, slo=entry.slo)))
 
     def predict_async(self, name, inputs, deadline_ms=None):
         """Admission-gated ``submit_async``: returns a
@@ -311,7 +326,7 @@ class ModelRepository:
         return self._submit_current(name, lambda entry:
             entry.batcher.submit_async(
                 inputs, self.admission.deadline_ms(deadline_ms),
-                admit=self.admission.gate(name)))
+                admit=self.admission.gate(name, slo=entry.slo)))
 
     # -- introspection ------------------------------------------------
 

@@ -1,0 +1,180 @@
+"""Multi-tenant placement: pack models onto replicas under an HBM
+budget, evicting least-recently-used tenants when a load won't fit.
+
+The fleet (PR 8) scales one model set out across N identical replicas;
+production traffic is hundreds of models whose *sum* does not fit one
+chip.  This module is the bin-packing half of the autoscaling control
+plane (:mod:`.autoscaler` is the control-loop half): it keeps the
+per-replica ledger of which model occupies how many bytes, answers
+"where can this model go", and — when no replica has room — plans an
+LRU eviction that frees exactly enough.
+
+The budget currency is **memlint's export-time peak-HBM estimate**
+(PR 9, ``analysis/memlint.py``): every exported artifact records its
+forward's peak allocation in ``{prefix}.meta.json`` under
+``memlint.peak_hbm_bytes``, which is the honest per-model bill — it
+counts weights *and* the activation high-water mark of the largest
+padded batch, not just parameter bytes.  Artifacts exported before the
+memlint era fall back to ``MXNET_SERVING_MODEL_BYTES_DEFAULT``.
+
+The placer is pure bookkeeping + decision math — it never touches a
+replica.  The autoscaler applies its plans (and is the only writer),
+which keeps every packing decision unit-testable without a fleet.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+from ..base import get_env
+
+__all__ = ["Placer", "model_footprint_bytes"]
+
+
+def model_footprint_bytes(path, default=None):
+    """Peak-HBM bytes of the artifact at ``prefix`` ``path``, from its
+    export-time memlint plan (``meta.json`` ``memlint.peak_hbm_bytes``).
+    Falls back to ``default`` / ``MXNET_SERVING_MODEL_BYTES_DEFAULT``
+    when the artifact predates the memlint era (or the plan was
+    skipped at export)."""
+    fallback = int(
+        default if default is not None
+        else get_env("MXNET_SERVING_MODEL_BYTES_DEFAULT",
+                     64 * 1024 * 1024, int))
+    try:
+        with open(str(path) + ".meta.json") as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        return fallback
+    peak = (meta.get("memlint") or {}).get("peak_hbm_bytes")
+    if not peak or int(peak) <= 0:
+        return fallback
+    return int(peak)
+
+
+class Placer:
+    """Per-replica HBM ledger + packing decisions.
+
+    ``budget_bytes`` caps the summed footprints of the models packed
+    onto one replica (``MXNET_SERVING_REPLICA_HBM_BUDGET``; 0 =
+    unlimited, the single-tenant default).  The ledger is written only
+    through :meth:`record_load` / :meth:`record_unload` /
+    :meth:`forget_replica`, which the autoscaler calls as it applies
+    decisions — a planned-but-failed load never corrupts the books.
+    """
+
+    def __init__(self, budget_bytes=None):
+        self.budget_bytes = int(
+            budget_bytes if budget_bytes is not None
+            else get_env("MXNET_SERVING_REPLICA_HBM_BUDGET", 0, int))
+        self._lock = threading.Lock()
+        self._assigned: dict[str, dict[str, int]] = {}  # rid -> {m: b}
+
+    # -- ledger --------------------------------------------------------
+
+    def register_replica(self, rid):
+        with self._lock:
+            self._assigned.setdefault(rid, {})
+
+    def forget_replica(self, rid):
+        with self._lock:
+            self._assigned.pop(rid, None)
+
+    def record_load(self, rid, name, nbytes):
+        with self._lock:
+            self._assigned.setdefault(rid, {})[name] = int(nbytes)
+
+    def record_unload(self, rid, name):
+        with self._lock:
+            models = self._assigned.get(rid)
+            if models is not None:
+                models.pop(name, None)
+
+    # -- views ---------------------------------------------------------
+
+    def replicas_of(self, name):
+        """Replica ids currently holding ``name`` (the "actual" side
+        of the desired-vs-actual gauge)."""
+        with self._lock:
+            return sorted(rid for rid, models in self._assigned.items()
+                          if name in models)
+
+    def models_on(self, rid):
+        with self._lock:
+            return dict(self._assigned.get(rid, {}))
+
+    def used_bytes(self, rid):
+        with self._lock:
+            return sum(self._assigned.get(rid, {}).values())
+
+    def free_bytes(self, rid):
+        """Remaining budget on ``rid`` (``None`` = unlimited)."""
+        if self.budget_bytes <= 0:
+            return None
+        return self.budget_bytes - self.used_bytes(rid)
+
+    def assignments(self):
+        with self._lock:
+            return {rid: dict(models)
+                    for rid, models in self._assigned.items()}
+
+    # -- packing decisions ---------------------------------------------
+
+    def choose(self, name, nbytes, candidates, idle_s_fn=None,
+               protected=frozenset(), evict=True):
+        """Pick where to load ``name`` (``nbytes`` footprint) among
+        ``candidates`` (replica ids); returns ``(rid, evictions)``
+        where ``evictions`` is the (possibly empty) list of model
+        names to unload from ``rid`` first, in eviction order.
+
+        Strategy: **best-fit** — the replica already fitting the model
+        with the least free room left (keeps big holes for big
+        models); if none fits and ``evict`` is allowed, the replica
+        where evicting the fewest longest-idle tenants
+        (``idle_s_fn(model) -> idle seconds``, LRU = largest idle
+        first) frees enough.  Models in ``protected`` (e.g. the target
+        itself, or pinned tenants) are never evicted.  Returns
+        ``(None, [])`` when no candidate can make room — the caller's
+        "spawn a new replica or fail typed" branch.  The autoscaler
+        calls with ``evict=False`` first: spawning a fresh replica
+        (when the fleet has headroom) always beats evicting a live
+        tenant.
+        """
+        nbytes = int(nbytes)
+        candidates = [rid for rid in candidates
+                      if name not in self.models_on(rid)]
+        if not candidates:
+            return None, []
+        if self.budget_bytes <= 0:
+            # unlimited: pack onto the emptiest replica for balance
+            return min(candidates,
+                       key=lambda rid: (self.used_bytes(rid), rid)), []
+        fits = [rid for rid in candidates
+                if self.free_bytes(rid) >= nbytes]
+        if fits:
+            return min(fits,
+                       key=lambda rid: (self.free_bytes(rid), rid)), []
+        if not evict or nbytes > self.budget_bytes:
+            return None, []     # no fit without eviction (or ever)
+        idle_of = idle_s_fn or (lambda _m: 0.0)
+        best = None             # (evict_count, -freed_idle, rid, plan)
+        for rid in candidates:
+            need = nbytes - self.free_bytes(rid)
+            victims = sorted(
+                ((m, b) for m, b in self.models_on(rid).items()
+                 if m not in protected),
+                key=lambda mb: -idle_of(mb[0]))   # most idle first
+            plan, freed, idle_sum = [], 0, 0.0
+            for m, b in victims:
+                if freed >= need:
+                    break
+                plan.append(m)
+                freed += b
+                idle_sum += idle_of(m)
+            if freed >= need:
+                key = (len(plan), -idle_sum, rid)
+                if best is None or key < best[0]:
+                    best = (key, rid, plan)
+        if best is None:
+            return None, []
+        return best[1], best[2]

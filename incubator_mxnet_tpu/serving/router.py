@@ -47,8 +47,9 @@ from .. import fault
 from ..error import (FleetDrainingError, ReplicaUnavailableError,
                      SessionExpiredError, SessionLostError)
 from .admission import (Admission, BadRequest, ClientDisconnected,
-                        DeadlineExceeded, QueueFullError, ServingError,
-                        ShuttingDown, checked_route, retry_after_s)
+                        DeadlineExceeded, ModelNotFound, QueueFullError,
+                        ServingError, ShuttingDown, checked_route,
+                        retry_after_s)
 from .metrics import FleetMetrics, Histogram
 from .server import JSONRequestHandler, ServingHTTPServer
 from .sessions import SessionNotFound
@@ -96,6 +97,11 @@ class FleetRouter:
             hop_min_ms if hop_min_ms is not None
             else get_env("MXNET_SERVING_FLEET_HOP_MIN_MS", 50.0, float))
         self._hop_ms = Histogram()   # successful-hop latencies (p95)
+        # the autoscaling control plane attaches itself here
+        # (Autoscaler.__init__): routing then consults it for models
+        # currently scaled to zero (on-demand reload) and /healthz
+        # gains the additive desired-vs-actual view
+        self.autoscaler = None
         # session affinity: a session's carry lives on exactly ONE
         # replica; the router remembers which (sid -> (model, rid))
         # and re-homes it from its snapshot when that replica dies
@@ -108,6 +114,17 @@ class FleetRouter:
         self.t_start = time.monotonic()
         self._httpd = None
         self._thread = None
+
+    def _known_model(self, name):
+        """True when ``name`` is in the fleet's catalog (models,
+        session models, or autoscaler-managed).  Per-model metrics
+        only label KNOWN names — arbitrary client-supplied names must
+        not grow the registry (unbounded label cardinality; the PR 3
+        hardening, kept)."""
+        if name in self.fleet.models or name in self.fleet.session_models:
+            return True
+        return (self.autoscaler is not None
+                and self.autoscaler.manages(name))
 
     def _retry_headers(self):
         """Live ``Retry-After``: with nothing routable, the time the
@@ -139,6 +156,9 @@ class FleetRouter:
         nobody reads."""
         t0 = time.monotonic()
         code = 500
+        label = name if self._known_model(name) else None
+        if label is not None:
+            self.metrics.note_model_inflight(label, +1)
         try:
             result = self._route(name, inputs, deadline_ms,
                                  inputs_json, t0, live)
@@ -151,8 +171,10 @@ class FleetRouter:
             code = 503
             raise
         finally:
+            if label is not None:
+                self.metrics.note_model_inflight(label, -1)
             self.metrics.record_route(
-                code, (time.monotonic() - t0) * 1000.0)
+                code, (time.monotonic() - t0) * 1000.0, model=label)
 
     def _route(self, name, inputs, deadline_ms, inputs_json, t0,
                live=None):
@@ -168,7 +190,14 @@ class FleetRouter:
                 raise ClientDisconnected(
                     f"client of {name!r} disconnected after {k} "
                     "hop(s)")
-            r = self.fleet.pick(exclude=tried)
+            r = self.fleet.pick(exclude=tried, name=name)
+            if r is None and self.autoscaler is not None \
+                    and self.autoscaler.manages(name):
+                # scale-from-zero: the model was idle-unloaded (or
+                # evicted); this request pays the (AOT-cheap) reload
+                # instead of a 404/503
+                self.autoscaler.ensure_loaded(name)
+                r = self.fleet.pick(exclude=tried, name=name)
             if r is None:
                 if self.fleet.all_draining():
                     raise FleetDrainingError(
@@ -194,6 +223,13 @@ class FleetRouter:
             except QueueFullError as e:
                 # overload, not ill health: shed to another replica
                 # before surfacing 429
+                tried.add(r.rid)
+                last = e
+            except ModelNotFound as e:
+                # the autoscaler unloaded/evicted the model from THIS
+                # replica between pick and execute: another holder (or
+                # the on-demand reload path) may still serve it — only
+                # when no replica is left does the 404 surface
                 tried.add(r.rid)
                 last = e
             except (ShuttingDown, DeadlineExceeded,
@@ -270,7 +306,7 @@ class FleetRouter:
                 if kind == "err":
                     raise val
                 return val
-        r2 = self.fleet.pick(exclude={r.rid})
+        r2 = self.fleet.pick(exclude={r.rid}, name=name)
         if r2 is None or r2 is r:
             # nowhere to hedge: wait the primary out
             with cond:
@@ -303,6 +339,19 @@ class FleetRouter:
             # both failed: surface the primary's error (arrival order
             # is race noise; the primary's cause is the actionable one)
             raise slots.get("primary", slots[order[0]])[1]
+
+    def model_meta(self, name):
+        """Input specs for ``name`` — like ``fleet.model_meta`` but
+        autoscale-aware: a managed model currently scaled to zero is
+        reloaded on demand instead of 404ing its first request."""
+        try:
+            return self.fleet.model_meta(name)
+        except ModelNotFound:
+            if (self.autoscaler is not None
+                    and self.autoscaler.manages(name)):
+                self.autoscaler.ensure_loaded(name)
+                return self.fleet.model_meta(name)
+            raise
 
     # -- stateful sessions: affinity + the failover contract ----------
     #
@@ -341,7 +390,8 @@ class FleetRouter:
             raise
         finally:
             self.metrics.record_route(
-                code, (time.monotonic() - t0) * 1000.0)
+                code, (time.monotonic() - t0) * 1000.0,
+                model=model if self._known_model(model) else None)
 
     def _session_home(self, model, sid):
         with self._session_lock:
@@ -377,7 +427,8 @@ class FleetRouter:
             raise
         finally:
             self.metrics.record_route(
-                code, (time.monotonic() - t0) * 1000.0)
+                code, (time.monotonic() - t0) * 1000.0,
+                model=model if self._known_model(model) else None)
 
     def _session_step(self, model, sid, inputs, steps, deadline_ms,
                       on_chunk):
@@ -513,14 +564,45 @@ class FleetRouter:
             status = "degraded"
         else:
             status = "ok"
+        models = set(self.fleet.models)
+        if self.autoscaler is not None:
+            # managed models belong in the catalog even while scaled
+            # to zero — absent would read as "never heard of it"
+            models |= set(self.autoscaler.policies())
         body = {
             "status": status,
             "uptime_s": round(time.monotonic() - self.t_start, 3),
             "ready": ready,
             "replicas": states,
-            "models": sorted(self.fleet.models),
+            "models": sorted(models),
         }
+        if self.autoscaler is not None:
+            # additive (docs/serving.md "Autoscaling"): probers that
+            # pin the PR 8 shape never see the key without a control
+            # plane attached
+            body["autoscale"] = self.autoscaler.describe()
         return (200 if ready else 503), body
+
+    def describe(self):
+        """Operator view of the routing tier: fleet states, session
+        affinity count, and — when a control plane is attached — the
+        additive ``"autoscale"`` desired-vs-actual block."""
+        states = self.fleet.states()
+        out = {
+            "replicas": states,
+            "ready": sum(1 for st in states.values()
+                         if st["state"] == "ready" and st["healthy"]),
+            "models": sorted(set(self.fleet.models)
+                             | (set(self.autoscaler.policies())
+                                if self.autoscaler is not None
+                                else set())),
+            "sessions": len(self._session_homes),
+            "failovers": self.failovers,
+            "hedge": self.hedge,
+        }
+        if self.autoscaler is not None:
+            out["autoscale"] = self.autoscaler.describe()
+        return out
 
     # -- HTTP front end -----------------------------------------------
 
@@ -538,6 +620,8 @@ class FleetRouter:
     def shutdown(self, drain=True, timeout=30.0):
         """Stop routing; with ``drain`` also drain + close the fleet
         (replicas finish in-flight work first)."""
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         if drain:
             self.fleet.shutdown(timeout)
         if self._httpd is not None:
@@ -619,7 +703,7 @@ class _RouterHandler(JSONRequestHandler):
 
     def _predict(self, name):
         def fn():
-            specs = self.app.fleet.model_meta(name)
+            specs = self.app.model_meta(name)
             body = self._body()
             if "inputs" not in body or not isinstance(body["inputs"],
                                                       list):
@@ -783,6 +867,17 @@ def main(argv=None):
     p.add_argument("--session-dir", default=None,
                    help="shared snapshot dir for session migration "
                         "(default MXNET_SERVING_SESSION_DIR)")
+    p.add_argument("--managed-model", action="append", default=[],
+                   metavar="NAME=PREFIX[,slo=CLASS][,min=N][,max=N]",
+                   help="hand model NAME to the autoscaling control "
+                        "plane instead of pre-loading it everywhere: "
+                        "scale-to-zero when idle, on-demand AOT "
+                        "reload, HBM bin-packing (docs/serving.md "
+                        "\"Autoscaling\")")
+    p.add_argument("--hbm-budget", type=int, default=None,
+                   help="per-replica packing budget in bytes "
+                        "(default MXNET_SERVING_REPLICA_HBM_BUDGET; "
+                        "0 = unlimited)")
     p.add_argument("--replicas", type=int,
                    default=get_env("MXNET_SERVING_FLEET_REPLICAS", 2,
                                    int))
@@ -808,8 +903,29 @@ def main(argv=None):
         if not sep:
             p.error(f"--session-model wants NAME=SPEC, got {spec!r}")
         session_models[name] = model_spec
-    if not models and not session_models:
-        p.error("need at least one --model or --session-model")
+    policies = []
+    for spec in args.managed_model:
+        name, sep, rest = spec.partition("=")
+        if not sep:
+            p.error(f"--managed-model wants NAME=PREFIX[,k=v...], "
+                    f"got {spec!r}")
+        path, *opts = rest.split(",")
+        kw = {}
+        for opt in opts:
+            k, sep2, v = opt.partition("=")
+            if not sep2 or k not in ("slo", "min", "max"):
+                p.error(f"--managed-model option {opt!r}: want "
+                        f"slo=CLASS, min=N or max=N")
+            if k == "slo":
+                kw["slo"] = v
+            else:
+                kw["min_replicas" if k == "min"
+                   else "max_replicas"] = int(v)
+        from .autoscaler import ModelPolicy
+        policies.append(ModelPolicy(name, path, **kw))
+    if not models and not session_models and not policies:
+        p.error("need at least one --model, --session-model or "
+                "--managed-model")
 
     fleet = ReplicaFleet(models, n=args.replicas, backend=args.backend,
                          warmup=not args.no_warmup,
@@ -819,6 +935,17 @@ def main(argv=None):
           f"replica(s)", flush=True)
     fleet.spawn()
     router = FleetRouter(fleet, host=args.host, port=args.port)
+    if policies:
+        from .autoscaler import Autoscaler
+        from .placement import Placer
+        scaler = Autoscaler(
+            fleet, router=router, policies=policies,
+            placer=Placer(budget_bytes=args.hbm_budget))
+        scaler.start()
+        print(f"[fleet] autoscaling {len(policies)} managed model(s) "
+              f"every {scaler.interval_s:g}s "
+              f"(idle-unload {scaler.idle_unload_s:g}s, "
+              f"<= {scaler.max_replicas} replicas)", flush=True)
     port = router.start()
     print(f"[fleet] routing on {args.host}:{port} over "
           f"{fleet.ready_count()} ready replica(s)", flush=True)

@@ -369,7 +369,7 @@ class _Handler(JSONRequestHandler):
                 raise BadRequest('load needs {"path": artifact-prefix}')
             return self.app.repository.load(
                 name, body["path"], version=body.get("version"),
-                warmup=body.get("warmup"))
+                warmup=body.get("warmup"), slo=body.get("slo"))
         self._admin(name, fn)
 
     def _unload(self, name):
@@ -381,7 +381,7 @@ class _Handler(JSONRequestHandler):
             return self.app.repository.reload(
                 name, path=body.get("path"),
                 version=body.get("version"),
-                warmup=body.get("warmup"))
+                warmup=body.get("warmup"), slo=body.get("slo"))
         self._admin(name, fn)
 
     # -- stateful sessions (docs/serving.md "Sessions") ---------------

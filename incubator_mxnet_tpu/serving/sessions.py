@@ -877,6 +877,26 @@ class SessionHost:
             managers = dict(self._managers)
         return {name: m.batcher.depth for name, m in managers.items()}
 
+    def active_sessions(self):
+        """Total live sessions across every manager — the signal the
+        autoscaler's shrink victim-selection reads (a replica holding
+        sessions is never preferred over a session-free one)."""
+        with self._lock:
+            managers = list(self._managers.values())
+        total = 0
+        for m in managers:
+            with m._lock:
+                total += len(m._sessions)
+        return total
+
+    def active_streams(self):
+        """Streams currently riding any decode loop — a shrink only
+        closes a replica once this reaches zero (or the drain budget
+        expires): never mid-stream."""
+        with self._lock:
+            managers = list(self._managers.values())
+        return sum(m.batcher.active_streams for m in managers)
+
     def drain_all(self, timeout=30.0):
         with self._lock:
             managers = list(self._managers.values())

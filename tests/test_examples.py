@@ -69,6 +69,11 @@ def test_train_bert_smoke():
     assert "loss" in out
 
 
+@pytest.mark.slow
 def test_train_resnet_fused_smoke():
+    # heaviest subprocess smoke in the suite (161s of the 870s tier-1
+    # budget measured in PR 12): a fresh python+jax process training 4
+    # fused-conv steps.  The `slow` CI stage keeps it covered, same
+    # split as the fleet-SIGKILL / session-chaos subprocess proofs.
     _run("train_resnet_fused.py", "--cpu", "--batch", "2",
          "--image-size", "32", "--steps", "4")

@@ -109,7 +109,8 @@ def test_spawn_states_and_gauges(artifact):
         assert sorted(states) == ["r0", "r1", "r2"]
         for st in states.values():
             assert set(st) == {"state", "healthy", "inflight",
-                               "backend"}
+                               "backend", "models"}
+            assert st["models"] == ["m"]
             assert st["state"] == READY and st["healthy"]
             assert st["inflight"] == 0 and st["backend"] == "thread"
         assert fleet.ready_count() == 3
@@ -531,7 +532,11 @@ def test_router_http_end_to_end(artifact, predictor):
         assert status == 200 and health["status"] == "ok"
         assert health["ready"] == 2 and health["models"] == ["m"]
         assert set(health["replicas"]["r0"]) == {"state", "healthy",
-                                                 "inflight", "backend"}
+                                                 "inflight", "backend",
+                                                 "models"}
+        # additive autoscale contract: no control plane attached, no
+        # "autoscale" key (the PR 8 shape is preserved)
+        assert "autoscale" not in health
 
         status, raw = _get(port, "/metrics")
         text = raw.decode()
