@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Whole-loop compilation benchmark: chunked (lax.scan over K fused
+steps, one XLA dispatch per chunk) vs the per-step fused path, at the
+small batch where per-step Python dispatch dominates step time
+(ROADMAP item 4 — the largest CPU-measurable step-time lever left).
+
+Model is the coldstart bench's MLP shape (gluon Dense stack) so the
+two training benches bracket the same workload family.  Three gates
+under ``--check``:
+
+* **throughput floor** — chunked steps/s >= ``--floor`` (1.5) x the
+  per-step fused steps/s on CPU;
+* **compile flatline** — exactly ONE loop executable per batch bucket
+  driven (the block shape ``(K, bucket)`` is the trace key), and ZERO
+  new compiles mid-epoch after warmup: a retracing loop would silently
+  pay compile time every epoch;
+* **weight parity** — the chunked run's final weights against a
+  per-step fused run over the identical batch/PRNG-key schedule.
+  Bitwise when the scanned body compiles to the same numerics (CPU
+  MLPs typically do); otherwise within the pinned tolerance
+  rtol=2e-5 / atol=1e-6 — XLA may re-fuse the scan body, which moves
+  float rounding, not math.
+
+Emits one BENCH-style JSON record.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# pinned parity tolerance (the --check gate and the docs table quote it)
+PARITY_RTOL = 2e-5
+PARITY_ATOL = 1e-6
+
+
+def _net(width, depth, classes, seed=0):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    in_units = width
+    for _ in range(depth):
+        net.add(nn.Dense(width, in_units=in_units, activation="relu"))
+        in_units = width
+    net.add(nn.Dense(classes, in_units=in_units))
+    net.initialize()
+    net(nd.random.uniform(shape=(1, width)))
+    return net
+
+
+def _batches(n, bs, width, classes, seed=1):
+    import numpy as onp
+    from incubator_mxnet_tpu import nd
+
+    rng = onp.random.RandomState(seed)
+    return [(nd.array(rng.rand(bs, width).astype("float32")),
+             nd.array(rng.randint(0, classes, (bs,)).astype("int32")))
+            for _ in range(n)]
+
+
+def _fused_step(args, chunk_steps=1, seed=0):
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.fuse import make_fused_train_step
+
+    net = _net(args.width, args.depth, args.classes, seed=seed)
+    return make_fused_train_step(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.05, "momentum": 0.9},
+        chunk_steps=chunk_steps)
+
+
+def bench(args):
+    import jax
+    import numpy as onp
+
+    batches = _batches(args.steps, args.batch, args.width, args.classes)
+    n = len(batches)
+
+    # -- per-step fused baseline (and the parity reference) ----------
+    step_seq = _fused_step(args)
+    for x, y in batches[:args.warmup]:
+        step_seq(x, y)
+    t0 = time.perf_counter()
+    loss = None
+    for x, y in batches:
+        loss = step_seq(x, y)
+    jax.block_until_ready(loss)
+    seq_s = time.perf_counter() - t0
+    # parity reference: a FRESH sequential run over the exact schedule
+    # (the timed one above already consumed warmup steps)
+    step_ref = _fused_step(args)
+    for x, y in batches:
+        step_ref(x, y)
+
+    # -- chunked loop ------------------------------------------------
+    step_ch = _fused_step(args, chunk_steps=args.chunk_steps)
+    loop = step_ch.chunked_loop()
+    # parity epoch IS the warmup epoch: same schedule as step_ref
+    loop.run_epoch(batches)
+    compiles_after_warmup = loop.compile_count
+    ref_leaves = jax.tree_util.tree_leaves(
+        {**step_ref.params, **step_ref.aux})
+    ch_leaves = jax.tree_util.tree_leaves(
+        {**step_ch.params, **step_ch.aux})
+    bitwise = all(bool((a == b).all())
+                  for a, b in zip(ref_leaves, ch_leaves))
+    max_err = max(
+        float(abs(onp.asarray(a) - onp.asarray(b)).max())
+        for a, b in zip(ref_leaves, ch_leaves))
+    parity_ok = all(
+        onp.allclose(onp.asarray(a), onp.asarray(b),
+                     rtol=PARITY_RTOL, atol=PARITY_ATOL)
+        for a, b in zip(ref_leaves, ch_leaves))
+    key_match = bool((step_ref._key == step_ch._key).all())
+
+    t0 = time.perf_counter()
+    records = loop.run_epoch(batches)
+    jax.block_until_ready(records[-1]["loss"])
+    ch_s = time.perf_counter() - t0
+    mid_epoch_compiles = loop.compile_count - compiles_after_warmup
+
+    # -- second bucket: one loop executable per (K, bucket) shape ----
+    # (doubling when batch == 1 keeps the probe bucket distinct from
+    # the main one, else the compiles_total gate trips spuriously)
+    second_bs = args.batch // 2 if args.batch > 1 else args.batch * 2
+    small = _batches(args.chunk_steps * 2, second_bs,
+                     args.width, args.classes, seed=2)
+    loop.run_epoch(small)
+    compiles_total = loop.compile_count
+
+    seq_sps = round(n / seq_s, 1)
+    ch_sps = round(n / ch_s, 1)
+    rec = {
+        "bench": "train_loop",
+        "metric": "chunked_speedup_x",
+        "value": round((n / ch_s) / (n / seq_s), 2),
+        "unit": "x_vs_per_step_fused",
+        "per_step_steps_per_s": seq_sps,
+        "chunked_steps_per_s": ch_sps,
+        "chunk_steps": args.chunk_steps,
+        "batch": args.batch,
+        "buckets_driven": 2,
+        "loop_compiles_main_bucket": compiles_after_warmup,
+        "loop_compiles_total": compiles_total,
+        "mid_epoch_compiles": mid_epoch_compiles,
+        "weights_bitwise": bitwise,
+        "weights_max_abs_err": max_err,
+        "parity_rtol": PARITY_RTOL,
+        "parity_atol": PARITY_ATOL,
+        "prng_key_schedule_match": key_match,
+        "model": f"mlp{args.width}x{args.depth}",
+        "steps": n,
+        "platform": jax.devices()[0].platform,
+    }
+    failures = []
+    if args.check:
+        if rec["value"] < args.floor:
+            failures.append(
+                f"chunked speedup {rec['value']}x < {args.floor}x floor "
+                "(whole-loop compilation not paying for itself)")
+        if compiles_after_warmup != 1:
+            failures.append(
+                f"{compiles_after_warmup} loop compiles for one bucket "
+                "— must be exactly 1")
+        if mid_epoch_compiles != 0:
+            failures.append(
+                f"{mid_epoch_compiles} compile(s) mid-epoch — the loop "
+                "program must be shape-stable after warmup")
+        if compiles_total != 2:
+            failures.append(
+                f"{compiles_total} loop compiles over 2 buckets — must "
+                "be exactly one per bucket")
+        if not key_match:
+            failures.append(
+                "PRNG key diverged from the sequential split schedule")
+        if not (bitwise or parity_ok):
+            failures.append(
+                f"final weights diverged (max abs err {max_err}) beyond "
+                f"rtol={PARITY_RTOL}/atol={PARITY_ATOL}")
+    return rec, failures
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=256,
+                   help="steps per timed epoch")
+    p.add_argument("--batch", type=int, default=8,
+                   help="small batch: per-step overhead dominates here")
+    p.add_argument("--chunk-steps", type=int, default=32)
+    p.add_argument("--width", type=int, default=64)
+    p.add_argument("--depth", type=int, default=3)
+    p.add_argument("--classes", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=8)
+    p.add_argument("--check", action="store_true",
+                   help="enforce the ISSUE 13 floors")
+    p.add_argument("--floor", type=float, default=1.5,
+                   help="min chunked/per-step speedup (--check)")
+    p.add_argument("--output", default=None)
+    args = p.parse_args(argv)
+
+    rec, failures = bench(args)
+    line = json.dumps(rec)
+    print(line, flush=True)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(line + "\n")
+    if failures:
+        print(f"[train_loop_bench] FAIL: {failures}", file=sys.stderr,
+              flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -465,6 +465,41 @@ def stage_coldstart(args):
                   f"compiles {zoo['aot_compile_total']}")
 
 
+def stage_trainloop(args):
+    """Whole-loop compilation sweep (docs/performance.md "Chunked
+    training loop"): chunked-vs-sequential parity tests (weights, PRNG
+    streams, tail fallback, K=1 degeneration, graphlint/memlint pins
+    on the scanned program), then the train-loop bench with its hard
+    gates — chunked steps/s >= 1.5x the per-step fused path at small
+    batch, exactly one loop compile per bucket, zero compiles
+    mid-epoch, final-weight parity."""
+    proc = sh([sys.executable, "-m", "pytest", "-q",
+               "tests/test_fuse_loop.py",
+               "-m", "not slow", "--continue-on-collection-errors",
+               "-p", "no:cacheprovider"], timeout=1200)
+    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+    if proc.returncode != 0:
+        return False, tail
+    out = os.path.join(REPO, ".ci_trainloop_bench.json")
+    try:
+        proc2 = sh([sys.executable, "benchmark/train_loop_bench.py",
+                    "--check", "--output", out], timeout=900)
+        if proc2.returncode != 0:
+            return False, (proc2.stderr or proc2.stdout).strip()[-400:]
+        with open(out) as f:
+            rec = json.load(f)
+    finally:
+        if os.path.exists(out):
+            os.remove(out)
+    return True, (f"{tail}; chunked {rec['value']}x per-step at "
+                  f"bs={rec['batch']} K={rec['chunk_steps']}, "
+                  f"{rec['loop_compiles_total']} compiles/"
+                  f"{rec['buckets_driven']} buckets, "
+                  f"mid-epoch {rec['mid_epoch_compiles']}, "
+                  f"{'bitwise' if rec['weights_bitwise'] else 'allclose'}"
+                  " parity")
+
+
 def stage_lint(args):
     """Framework-aware static analysis (tools/mxlint.py): exit 0 means
     no findings beyond the baseline — and the baseline stays empty
@@ -587,6 +622,7 @@ STAGES = {"build": stage_build, "sanity": stage_sanity,
           "serving": stage_serving, "fleet": stage_fleet,
           "sessions": stage_sessions, "autoscale": stage_autoscale,
           "coldstart": stage_coldstart,
+          "trainloop": stage_trainloop,
           "race": stage_race,
           "graphlint": stage_graphlint,
           "memlint": stage_memlint,

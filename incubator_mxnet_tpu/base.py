@@ -170,6 +170,19 @@ def get_env(name: str, default, dtype=str):
     return default
 
 
+def resolve_chunk_steps(chunk_steps=None):
+    """K for the whole-loop-compiled training path (fuse_loop.py):
+    an explicit value wins, else ``MXNET_TRAIN_CHUNK_STEPS`` (default
+    1 — the per-step fused path).  Single point of truth for the env
+    fallback and the >= 1 validation shared by Trainer,
+    FusedTrainStep, ChunkedTrainLoop and DevicePrefetchRing."""
+    k = int(chunk_steps if chunk_steps is not None
+            else get_env("MXNET_TRAIN_CHUNK_STEPS", 1, int))
+    if k < 1:
+        raise ValueError(f"chunk_steps must be >= 1, got {k}")
+    return k
+
+
 def shard_map_compat(fn, mesh, in_specs, out_specs):
     """``jax.shard_map`` across jax versions: new jax exposes it at the
     top level (replication check switch ``check_vma=``), 0.4.x under

@@ -260,6 +260,29 @@ def memlint_active():
     return memlint.mem_mode() is not None
 
 
+def latch_train_analyses(executor, args, lint_done, memlint_done):
+    """One-shot build-time graphlint/memlint for a donated train
+    program (the fused step and the chunked loop share this exact
+    discipline): each latch sets only once its mode is on, so
+    enabling a mode after step 1 still analyzes; GL-DEAD001 is
+    ignored by documented scope limit (AD transposition leaves dead
+    primal eqns in every value_and_grad trace — straight-line or
+    scanned); donation is REQUIRED (the train-state carry contracts
+    to donate).  Returns the updated ``(lint_done, memlint_done)``."""
+    do_lint = not lint_done and lint_active()
+    do_mem = not memlint_done and memlint_active()
+    if do_lint or do_mem:
+        from .analysis import graphlint as _graphlint
+        executor.analyze(
+            args,
+            graphlint=dict(
+                check_donation=True,
+                config=_graphlint.Config(ignore={"GL-DEAD001"}),
+            ) if do_lint else None,
+            memlint=dict(require_donation=True) if do_mem else None)
+    return lint_done or do_lint, memlint_done or do_mem
+
+
 def run_analyses(fn, args, name, graphlint=None, memlint=None):
     """THE graphlint/memlint build-time wiring (previously copied at
     every compile surface).  ``graphlint``/``memlint`` are kwarg dicts

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from . import executor_cache as _xc
+from .base import resolve_chunk_steps
 from .ndarray import NDArray
 
 __all__ = ["FusedTrainStep", "make_fused_train_step", "sgd_init", "adam_init"]
@@ -99,7 +100,8 @@ class FusedTrainStep:
     """
 
     def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
-                 mesh=None, batch_spec=None, donate=True, remat=None):
+                 mesh=None, batch_spec=None, donate=True, remat=None,
+                 chunk_steps=None):
         self.block = block
         self.loss_block = loss_fn
         opt_params = dict(optimizer_params or {})
@@ -130,8 +132,29 @@ class FusedTrainStep:
         if remat not in (None, "dots", "nothing"):
             raise ValueError(
                 f"remat must be None, 'dots' or 'nothing'; got {remat!r}")
+        # chunk budget for the whole-loop compilation path (fuse_loop):
+        # K == 1 stays on this per-step program, K > 1 lets a
+        # ChunkedTrainLoop scan K steps per dispatch
+        self.chunk_steps = resolve_chunk_steps(chunk_steps)
         self._key = jax.random.PRNGKey(0)
+        if mesh is None:
+            # commit the whole train state to its device up front: jit
+            # outputs are committed arrays, so an uncommitted first
+            # call would compile one executable for step 1 and a
+            # second — the real steady-state one — for step 2+.  One
+            # program per batch shape, from the first dispatch (the
+            # mesh path leaves placement to the pjit shardings)
+            dev = jax.devices()[0]
+            self.params, self.aux, self.opt_state, self._key = \
+                jax.device_put(
+                    (self.params, self.aux, self.opt_state, self._key),
+                    dev)
         self._remat = remat
+        # kept for the chunked loop (fuse_loop): the scanned program
+        # re-applies the same batch sharding to its (K, batch, ...)
+        # blocks, with the scan axis unsharded
+        self._mesh = mesh
+        self._batch_spec = batch_spec
         self._lint_done = False
         self._memlint_done = False
         self._step_fn = self._build(mesh, batch_spec, donate)
@@ -205,32 +228,36 @@ class FusedTrainStep:
         if not (self._lint_done and self._memlint_done):
             # build-time analyses of the whole train step through the
             # unified choke point (MXNET_GRAPH_LINT/MXNET_GRAPH_MEMLINT).
-            # GL-DEAD001 is ignored by documented scope limit: AD
-            # transposition leaves dead primal eqns in every
-            # value_and_grad trace.  An undonated step (donate=False)
-            # earns its GL-DONATE001 advisory and is an error-severity
-            # ML-DONATE001 — the fused step CONTRACTS to donate
-            # params/aux/optimizer state.  Each latch only sets once
-            # its mode is on, so enabling either mode after step 1
-            # still analyzes.
-            from .analysis import graphlint as _graphlint
-            do_lint = not self._lint_done and _xc.lint_active()
-            do_mem = not self._memlint_done and _xc.memlint_active()
-            self._lint_done = self._lint_done or do_lint
-            self._memlint_done = self._memlint_done or do_mem
-            if do_lint or do_mem:
-                self._executor.analyze(
+            # An undonated step (donate=False) earns its GL-DONATE001
+            # advisory and is an error-severity ML-DONATE001 — the
+            # fused step CONTRACTS to donate params/aux/optimizer
+            # state.  Latch/exemption discipline lives in
+            # latch_train_analyses (shared with ChunkedTrainLoop).
+            self._lint_done, self._memlint_done = \
+                _xc.latch_train_analyses(
+                    self._executor,
                     (self.params, self.aux, self.opt_state, xv, yv, sub),
-                    graphlint=dict(
-                        check_donation=True,
-                        config=_graphlint.Config(ignore={"GL-DEAD001"}),
-                    ) if do_lint else None,
-                    memlint=dict(require_donation=True)
-                    if do_mem else None)
+                    self._lint_done, self._memlint_done)
         self.params, self.aux, self.opt_state, loss = self._step_fn(
             self.params, self.aux, self.opt_state, xv, yv, sub)
         self._last = loss
         return loss
+
+    @property
+    def step_fn(self):
+        """The raw (uninstrumented) pure step function
+        ``(params, aux, opt_state, x, y, key) -> (params, aux,
+        opt_state, loss)`` — the body a :class:`~.fuse_loop.
+        ChunkedTrainLoop` scans over."""
+        return self._executor.fn
+
+    def chunked_loop(self, chunk_steps=None):
+        """A :class:`~.fuse_loop.ChunkedTrainLoop` over this step
+        (state stays shared: the loop reads and writes this step's
+        params/aux/opt_state/key, so tail batches and ``write_back``
+        keep working unchanged)."""
+        from .fuse_loop import ChunkedTrainLoop
+        return ChunkedTrainLoop(self, chunk_steps=chunk_steps)
 
     def write_back(self):
         """Copy updated params back into the Block's Parameters."""

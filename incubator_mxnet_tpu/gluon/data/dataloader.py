@@ -30,6 +30,100 @@ from ...ndarray import NDArray
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 
+# ---------------------------------------------------------------------------
+# device-side prefetch ring (whole-loop compilation, fuse_loop.py)
+# ---------------------------------------------------------------------------
+
+def _block_to_device(arrs):
+    """Stack per-step batches into one (K, batch, ...) device block.
+
+    One host-side stack + one async ``jax.device_put`` is the fast
+    path (the transfer the ring overlaps with the previous chunk's
+    compute).  CPU-backend jax arrays take it too — ``onp.asarray``
+    on host-resident buffers is near-zero-copy, and K per-array jnp
+    dispatches cost more than the whole chunk saves (measured 0.67 ms
+    vs 0.13 ms for a 16-step block).  Only accelerator-resident
+    inputs stack device-side: downloading them to restack on host
+    would force the sync this class exists to avoid.
+    """
+    import jax
+
+    vals = [a.data if isinstance(a, NDArray) else a for a in arrs]
+    if not all(isinstance(v, onp.ndarray) for v in vals):
+        on_host = all(
+            (not hasattr(v, "devices"))
+            or all(d.platform == "cpu" for d in v.devices())
+            for v in vals)
+        if not on_host:
+            import jax.numpy as jnp
+            return jnp.stack(vals, axis=0)
+        vals = [onp.asarray(v) for v in vals]
+    return jax.device_put(onp.stack(vals, axis=0))
+
+
+class DevicePrefetchRing:
+    """Group a loader's per-step ``(x, y)`` batches into K-step device
+    blocks, keeping ``depth`` blocks' host→device transfers in flight
+    ahead of the consumer (double-buffered by default).
+
+    ``jax.device_put``/``jnp.stack`` dispatch asynchronously, so
+    building block *t+1* while the chunked train loop computes block
+    *t* overlaps the copy with compute — the scanned program never
+    waits on the host.  The existing host-side prefetcher threads
+    (``DataLoader(num_workers=...)``) feed this ring unchanged: it
+    consumes whatever batch iterator it is given.
+
+    Yields ``("chunk", xs, ys)`` for full K-step blocks and one final
+    ``("tail", [(x, y), ...])`` when the epoch length is not divisible
+    by K — the consumer runs tail steps through the per-step path
+    rather than compiling a second, shorter loop program.
+    """
+
+    def __init__(self, batches, chunk_steps, depth=2):
+        from ...base import resolve_chunk_steps
+        self.chunk_steps = resolve_chunk_steps(chunk_steps)
+        self.depth = int(depth)
+        if self.depth < 1:
+            raise ValueError(f"depth must be >= 1, got {self.depth}")
+        self._it = iter(batches)
+        self.blocks = 0
+        self.tail_steps = 0
+
+    def _next_block(self):
+        pairs = []
+        for _ in range(self.chunk_steps):
+            try:
+                pairs.append(next(self._it))
+            except StopIteration:
+                break
+        if not pairs:
+            return None
+        if len(pairs) < self.chunk_steps:
+            self.tail_steps = len(pairs)
+            return ("tail", pairs)
+        xs = _block_to_device([x for x, _ in pairs])
+        ys = _block_to_device([y for _, y in pairs])
+        self.blocks += 1
+        return ("chunk", xs, ys)
+
+    def __iter__(self):
+        from collections import deque
+        q = deque()
+        exhausted = False
+        while True:
+            while not exhausted and len(q) < self.depth:
+                block = self._next_block()
+                if block is None:
+                    exhausted = True
+                    break
+                q.append(block)
+                if block[0] == "tail":
+                    exhausted = True
+            if not q:
+                return
+            yield q.popleft()
+
+
 def default_batchify_fn(data):
     """Stack samples into a batch (reference dataloader.py default_batchify_fn)."""
     if isinstance(data[0], NDArray):

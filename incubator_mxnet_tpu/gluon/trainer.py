@@ -18,6 +18,14 @@ server weights.  Fleet-size changes observed between steps are recorded
 (``fleet_changes``) and checkpointed, and :meth:`reshard_restore` lands
 a checkpoint saved on ANY mesh shape back onto the live parameters via
 :meth:`AsyncCheckpointManager.reshard_restore`.
+
+With chunked training (``chunk_steps=K`` / ``MXNET_TRAIN_CHUNK_STEPS``,
+docs/fault_tolerance.md "Chunk boundaries"): a banked eviction notice
+drains the current K-step chunk and surfaces — with its checkpoint —
+only at the chunk boundary (worst case K steps), matching the
+whole-loop-compiled path where mid-chunk steps live inside one XLA
+dispatch.  Hard evictions raised by the sync itself still surface
+immediately.
 """
 from __future__ import annotations
 
@@ -26,7 +34,7 @@ import threading
 
 from .. import fault
 from .. import optimizer as opt_mod
-from ..base import get_env
+from ..base import get_env, resolve_chunk_steps
 from ..error import WorkerEvictedError
 from ..ndarray import NDArray
 
@@ -38,7 +46,8 @@ _log = logging.getLogger("incubator_mxnet_tpu.gluon.trainer")
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
                  compression_params=None, update_on_kvstore=None,
-                 elastic=False, checkpoint_dir=None, checkpoint_keep=5):
+                 elastic=False, checkpoint_dir=None, checkpoint_keep=5,
+                 chunk_steps=None):
         if isinstance(params, (dict,)) or hasattr(params, "values"):
             self._param_names = list(params.keys()) if hasattr(params, "keys") else None
             params = list(params.values())
@@ -71,6 +80,13 @@ class Trainer:
             from ..checkpoint import AsyncCheckpointManager
             self._ckpt = AsyncCheckpointManager(checkpoint_dir,
                                                 keep=checkpoint_keep)
+        # chunk budget (MXNET_TRAIN_CHUNK_STEPS, docs/performance.md
+        # "Chunked training loop"): elastic checkpoint/eviction
+        # boundaries land BETWEEN K-step chunks — a banked eviction
+        # notice drains the current chunk before surfacing, mirroring
+        # the scanned loop where mid-chunk steps are inside one XLA
+        # dispatch and cannot be interrupted anyway
+        self._chunk_steps = resolve_chunk_steps(chunk_steps)
         self._step_count = 0
         self._evicted_reason = None
         self._live = None              # fleet size from the last beat
@@ -179,6 +195,13 @@ class Trainer:
                 live = min(v.get("live_workers", 0) for v in vitals)
                 if live > 0:
                     self._live = live
+
+    def _at_chunk_boundary(self):
+        """Whether the trainer sits between chunks: ``_step_count``
+        completed steps, so a boundary is any multiple of the chunk
+        budget (including 0 — before the first chunk starts)."""
+        return (self._chunk_steps <= 1
+                or self._step_count % self._chunk_steps == 0)
 
     def _param_tree(self):
         tree = {}
@@ -348,7 +371,14 @@ class Trainer:
         fault.inject("trainer.step")
         if not self._kv_initialized:
             self._init_kvstore()
-        if self._elastic and self._evicted_reason is not None:
+        if (self._elastic and self._evicted_reason is not None
+                and self._at_chunk_boundary()):
+            # a notice banked by the beat thread drains the current
+            # chunk before surfacing: the eviction checkpoint then
+            # lands ON a chunk boundary (worst-case notice latency =
+            # chunk_steps steps, docs/fault_tolerance.md).  A hard
+            # eviction raised by the sync itself (below) cannot be
+            # deferred — the server already dropped us
             self._on_evicted(self._evicted_reason)
         self._optimizer.rescale_grad = self._scale / batch_size
         try:
@@ -358,7 +388,7 @@ class Trainer:
                 self.allreduce_grads()
         except WorkerEvictedError as e:
             self._on_evicted(str(e))
-        if self._elastic:
+        if self._elastic and self._at_chunk_boundary():
             self._note_fleet()
         if not self._uokv:
             self._update(ignore_stale_grad)

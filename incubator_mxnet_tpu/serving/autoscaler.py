@@ -57,7 +57,8 @@ import time
 
 from ..base import get_env
 from .. import fault
-from ..error import ModelEvictedError, ReplicaUnavailableError
+from ..error import (FleetDrainingError, ModelEvictedError,
+                     ReplicaUnavailableError)
 from .admission import ModelNotFound, slo_class
 from .placement import Placer, model_footprint_bytes
 
@@ -167,6 +168,12 @@ class Autoscaler:
         # does not drop the reservation before the load lands.
         self._plan_lock = threading.Lock()
         self._reserved: set = set()            # {(rid, model)}
+        # in-flight spawns count against the replica ceiling from PLAN
+        # time: a spawn decision racing a second planner (two
+        # on-demand ensure_loaded calls, or the background loop) used
+        # to let both see the pre-spawn fleet size and jointly
+        # overshoot MXNET_SERVING_SCALE_MAX_REPLICAS by one
+        self._spawns_pending = 0
         self._counters = {"scale_up": 0, "scale_down": 0, "spawn": 0,
                           "shrink": 0, "evict": 0, "faults": 0,
                           "blocked": 0, "scale_from_zero": 0}
@@ -340,24 +347,39 @@ class Autoscaler:
             signals = self.signals(vitals)
             desired = self.desired(signals)
             decisions = []
-            # highest-priority models place first: when budget is
-            # tight the interactive tier wins the bin-packing race
-            for name in sorted(
-                    desired,
-                    key=lambda n: (self._policies[n].slo.priority, n)):
-                p = self._policies[name]
-                a = signals.get(name, {}).get("actual",
-                                              self.actual(name))
-                d = desired[name]
-                if d > a:
-                    decisions.append(self._plan_grow(name, p, desired))
-                elif d < a:
-                    rid = self._pick_unload(name, vitals)
-                    if rid is not None:
-                        decisions.append({"action": "unload",
-                                          "model": name, "rid": rid})
-            decisions = [d for d in decisions if d is not None]
-            decisions.extend(self._plan_shrinks(vitals))
+            try:
+                # highest-priority models place first: when budget is
+                # tight the interactive tier wins the bin-packing race
+                for name in sorted(
+                        desired,
+                        key=lambda n: (self._policies[n].slo.priority,
+                                       n)):
+                    p = self._policies[name]
+                    a = signals.get(name, {}).get("actual",
+                                                  self.actual(name))
+                    d = desired[name]
+                    if d > a:
+                        decisions.append(
+                            self._plan_grow(name, p, desired))
+                    elif d < a:
+                        rid = self._pick_unload(name, vitals)
+                        if rid is not None:
+                            decisions.append({"action": "unload",
+                                              "model": name,
+                                              "rid": rid})
+                decisions.extend(self._plan_shrinks(vitals))
+            except BaseException:
+                # a crash mid-planning (run_once logs and drops the
+                # tick) must not strand the ledger bytes / ceiling
+                # slots the completed plans already reserved
+                for d in decisions:
+                    if d is not None:
+                        self._rollback(d)
+                raise
+            # wait_spawn is demand-path-only: the background loop
+            # re-derives from live state next tick anyway
+            decisions = [d for d in decisions
+                         if d is not None and d["action"] != "wait_spawn"]
         return decisions
 
     def _plan_grow(self, name, policy, desired):
@@ -373,8 +395,22 @@ class Autoscaler:
             self._reserve(rid, name, policy.footprint())
             return {"action": "load", "model": name, "rid": rid,
                     "evict": []}
-        if len(live) < self.max_replicas:
-            return {"action": "spawn_load", "model": name}
+        with self._lock:
+            pending = self._spawns_pending
+        if len(live) + pending < self.max_replicas:
+            # the slot is claimed under _plan_lock; _release_spawn
+            # returns it once the spawn lands (the replica then counts
+            # as live) or the decision is dropped
+            with self._lock:
+                self._spawns_pending += 1
+            return {"action": "spawn_load", "model": name,
+                    "_spawn_reserved": True}
+        if pending and len(live) < self.max_replicas:
+            # the ceiling is consumed by a spawn still in flight — not
+            # a capacity verdict: the demand path retries and places
+            # onto the replica once it lands; the background loop just
+            # re-derives next tick
+            return {"action": "wait_spawn", "model": name}
         # strictly higher tiers are untouchable; within a tier the
         # budget is a working set and LRU decides who pages out — an
         # oversubscribed fleet must thrash at the margin, not deadlock
@@ -486,6 +522,15 @@ class Autoscaler:
     def _rollback(self, d):
         if d.get("action") == "load":
             self._unreserve(d["rid"], d["model"], loaded=False)
+        self._release_spawn(d)
+
+    def _release_spawn(self, d):
+        """Return a planned spawn's ceiling slot — exactly once per
+        decision (the flag pops), whether the spawn landed, failed,
+        or the decision was dropped."""
+        if d.pop("_spawn_reserved", None):
+            with self._lock:
+                self._spawns_pending = max(0, self._spawns_pending - 1)
 
     def _apply_one(self, d):
         """Apply one decision behind the ``serving.scale`` fault point;
@@ -506,7 +551,12 @@ class Autoscaler:
                 self._unreserve(d["rid"], d["model"], loaded=True)
                 self._count("scale_up")
             elif action == "spawn_load":
-                r = self.fleet.spawn_one(models={})
+                try:
+                    r = self.fleet.spawn_one(models={})
+                finally:
+                    # landed or failed, the replica either counts as
+                    # live now or never will — the ceiling slot frees
+                    self._release_spawn(d)
                 self.placer.register_replica(r.rid)
                 self._count("spawn")
                 if self._stop.is_set():
@@ -646,23 +696,60 @@ class Autoscaler:
                 # before the retry re-plans
                 if self.fleet.routable(name):
                     return
-                with self._plan_lock:
-                    self._sync_placer()
-                    plan = self._plan_grow(name, p, want)
-                if plan is None:
-                    raise ModelEvictedError(
-                        f"model {name!r} cannot be placed: every "
-                        f"replica's HBM budget is held by busier "
-                        f"models and the fleet is at its "
-                        f"{self.max_replicas}-replica ceiling")
+                for _ in range(max(1, _retries)):
+                    with self._plan_lock:
+                        self._sync_placer()
+                        plan = self._plan_grow(name, p, want)
+                    if plan is None:
+                        raise ModelEvictedError(
+                            f"model {name!r} cannot be placed: every "
+                            f"replica's HBM budget is held by busier "
+                            f"models and the fleet is at its "
+                            f"{self.max_replicas}-replica ceiling")
+                    if plan["action"] != "wait_spawn":
+                        break
+                    # another caller's spawn holds the last ceiling
+                    # slot.  BLOCK until it lands (a ~300 ms process
+                    # spawn outlives this path's entire retry-backoff
+                    # budget) and RE-PLAN in place: waiting on someone
+                    # else's spawn must not consume one of this
+                    # caller's fault-retry attempts, or a loser that
+                    # then hits an injected transient is down to a
+                    # thinner budget than a solo caller
+                    deadline = time.monotonic() + self.drain_s
+                    while time.monotonic() < deadline:
+                        with self._lock:
+                            pending = self._spawns_pending
+                        if pending == 0:
+                            break
+                        time.sleep(0.02)
+                else:
+                    raise ReplicaUnavailableError(
+                        f"a replica spawn was in flight with the fleet "
+                        f"at its {self.max_replicas}-replica ceiling; "
+                        f"retrying placement of {name!r}")
                 rid = plan.get("rid")
                 try:
                     fault.inject("serving.scale",
                                  f"on_demand:{name}")
                     if plan["action"] == "spawn_load":
-                        r = self.fleet.spawn_one(models={})
+                        try:
+                            r = self.fleet.spawn_one(models={})
+                        finally:
+                            self._release_spawn(plan)
                         self.placer.register_replica(r.rid)
                         self._count("spawn")
+                        if self._stop.is_set():
+                            # stop() raced the (slow) spawn — same
+                            # leak guard as _apply_one: a replica
+                            # appended after the fleet's teardown
+                            # snapshot would outlive it, and a retry
+                            # against a stopping fleet cannot succeed
+                            self.fleet.remove(r.rid, timeout=5.0)
+                            self.placer.forget_replica(r.rid)
+                            raise FleetDrainingError(
+                                f"autoscaler stopped while spawning a "
+                                f"replica for {name!r}")
                         rid = r.rid
                         self._reserve(rid, name, p.footprint())
                         self._do_load(name, rid, [])
@@ -670,6 +757,7 @@ class Autoscaler:
                         self._do_load(name, rid,
                                       plan.get("evict") or [])
                 except KeyError as e:
+                    self._release_spawn(plan)
                     if rid is not None:
                         self._unreserve(rid, name, loaded=False)
                     # the planned replica vanished between plan and
@@ -679,6 +767,7 @@ class Autoscaler:
                         f"replica vanished while placing {name!r}: "
                         f"{e}") from e
                 except BaseException:
+                    self._release_spawn(plan)
                     if rid is not None:
                         self._unreserve(rid, name, loaded=False)
                     raise

@@ -336,6 +336,98 @@ def test_scale_from_zero_first_request(artifacts):
         router.shutdown()
 
 
+def test_concurrent_scale_from_zero_respects_replica_ceiling(artifacts):
+    """ISSUE 13 satellite: two on-demand ``ensure_loaded`` calls racing
+    a replica spawn used to each see the pre-spawn fleet size and
+    jointly overshoot MXNET_SERVING_SCALE_MAX_REPLICAS by one.  The
+    ceiling check now consumes a reservation under the planner's lock:
+    the loser waits (typed, retryable) and places onto the replica the
+    winner's spawn lands — never a second spawn past the ceiling."""
+    fleet, router, scaler = _stack(artifacts, max_replicas=1)
+    try:
+        # empty the fleet: 0 live replicas, ceiling 1 — both loads
+        # below need the same single spawn slot
+        fleet.kill(fleet.replicas[0].rid)
+        orig = fleet.spawn_one
+
+        def slow_spawn(models=None):
+            time.sleep(0.1)       # hold the race window open
+            return orig(models=models)
+
+        fleet.spawn_one = slow_spawn
+        errs = []
+
+        def load(name):
+            try:
+                # DEFAULT retry budget on purpose: the wait_spawn path
+                # blocks until the in-flight spawn lands, so the loser
+                # must succeed without an inflated retry count
+                scaler.ensure_loaded(name)
+            except Exception as e:  # noqa: BLE001 — collected and asserted below
+                errs.append(e)
+
+        ts = [threading.Thread(target=load, args=(n,))
+              for n in ("a", "b")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30.0)
+        live = [r for r in fleet.replicas if r.state != "dead"]
+        assert len(live) == 1, (
+            f"spawn race overshot the ceiling: {len(live)} live "
+            f"replicas with max_replicas=1")
+        assert not errs, errs
+        # the loser placed onto the winner's replica (budget is
+        # unlimited here) — both models serve from the one copy
+        assert fleet.routable("a") and fleet.routable("b")
+        assert scaler.describe()["decisions"]["spawn"] == 1
+    finally:
+        fleet.spawn_one = orig
+        router.shutdown()
+
+
+def test_stop_racing_demand_spawn_removes_the_replica(artifacts):
+    """stop() landing while an on-demand ``ensure_loaded`` spawn is in
+    flight must not leak the replica into a torn-down fleet: the
+    demand path carries the same guard as the background loop's
+    _apply_one — remove + forget, and the caller gets a typed
+    FleetDrainingError (shutdown is not retryable)."""
+    from incubator_mxnet_tpu.error import FleetDrainingError
+
+    fleet, router, scaler = _stack(artifacts, max_replicas=2)
+    orig = fleet.spawn_one
+    try:
+        fleet.kill(fleet.replicas[0].rid)   # force the spawn path
+        entered = threading.Event()
+
+        def slow_spawn(models=None):
+            entered.set()
+            time.sleep(0.2)                 # hold the race window open
+            return orig(models=models)
+
+        fleet.spawn_one = slow_spawn
+        errs = []
+
+        def load():
+            try:
+                scaler.ensure_loaded("a")
+            except Exception as e:  # noqa: BLE001 — asserted below
+                errs.append(e)
+
+        t = threading.Thread(target=load)
+        t.start()
+        assert entered.wait(10.0)
+        scaler.stop()                       # races the in-flight spawn
+        t.join(30.0)
+        assert len(errs) == 1 and isinstance(errs[0], FleetDrainingError), errs
+        live = [r for r in fleet.replicas if r.state != "dead"]
+        assert not live, f"stop() leaked a live replica: {live}"
+        assert not fleet.routable("a")
+    finally:
+        fleet.spawn_one = orig
+        router.shutdown()
+
+
 def test_idle_unload_then_reload_on_demand(artifacts):
     fleet, router, scaler = _stack(artifacts, idle_unload_s=0.3)
     try:

@@ -639,6 +639,78 @@ def test_update_on_kvstore_server_holds_weights(tmp_path, monkeypatch,
     kv._clients[0].call("stop")
 
 
+def test_chunked_trainer_drains_eviction_to_chunk_boundary(
+        tmp_path, monkeypatch, scenario_beats):
+    """Chunked training (ISSUE 13): with ``chunk_steps=K`` a banked
+    eviction notice arriving MID-chunk drains the remaining steps of
+    the chunk, surfaces exactly ON the boundary (worst-case latency K
+    steps, docs/fault_tolerance.md), checkpoints there, and the
+    rejoin-and-finish run lands weight parity with an uninterrupted
+    run of the same schedule — bare and under the pinned elastic
+    chaos spec (this file's CI stage)."""
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon import Trainer, nn
+    from incubator_mxnet_tpu.gluon import loss as gloss
+
+    K, total = 3, 6
+    rng = onp.random.RandomState(7)
+    xs = [rng.rand(4, 3).astype("f") for _ in range(total)]
+    ys = [rng.rand(4, 2).astype("f") for _ in range(total)]
+
+    def fresh_net():
+        mx.random.seed(0)
+        net = nn.Dense(2, in_units=3)
+        net.initialize()
+        net(nd.zeros((1, 3)))
+        return net
+
+    def one_step(net, tr, i):
+        with autograd.record():
+            l = gloss.L2Loss()(net(nd.array(xs[i])), nd.array(ys[i]))
+        l.backward()
+        tr.step(4)
+
+    # uninterrupted reference (single worker: the PS sync returns the
+    # worker's own summed gradient, so the local path is the same math)
+    ref = fresh_net()
+    tr_ref = Trainer(ref.collect_params(), "sgd",
+                     {"learning_rate": 0.1}, kvstore=None)
+    for i in range(total):
+        one_step(ref, tr_ref, i)
+
+    srv = _start_server("sync", num_workers=1)
+    monkeypatch.setenv("MXT_SERVERS", f"127.0.0.1:{srv.port}")
+    monkeypatch.setenv("MXT_KV_MODE", "sync")
+    net = fresh_net()
+    kv = mx.kv.create("dist_sync")
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                 kvstore=kv, elastic=True, chunk_steps=K,
+                 checkpoint_dir=str(tmp_path / "ckpt"))
+    one_step(net, tr, 0)
+    # bank a notice mid-chunk: steps 2 and 3 must still complete
+    tr._evicted_reason = "test: notice banked mid-chunk"
+    one_step(net, tr, 1)
+    one_step(net, tr, 2)
+    assert tr._step_count == K          # chunk drained, not interrupted
+    with pytest.raises(WorkerEvictedError, match="eviction checkpoint"):
+        one_step(net, tr, 3)            # surfaces AT the boundary
+    # the eviction checkpoint landed exactly on the chunk boundary
+    assert tr._step_count % K == 0
+    assert K in tr._ckpt.all_steps()
+    # rejoin (restores the boundary checkpoint in grad-agg mode),
+    # finish the schedule: parity with the uninterrupted run
+    tr.rejoin()
+    for i in range(K, total):
+        one_step(net, tr, i)
+    for (n1, p1), (n2, p2) in zip(ref.collect_params().items(),
+                                  net.collect_params().items()):
+        onp.testing.assert_allclose(
+            p1.data().asnumpy(), p2.data().asnumpy(),
+            rtol=1e-6, atol=1e-7, err_msg=n1)
+    tr.close()
+    kv._clients[0].call("stop")
+
+
 def test_beat_thread_survives_unexpected_errors(tmp_path, monkeypatch,
                                                 scenario_beats):
     """A beat failure that is neither a transport error nor an eviction

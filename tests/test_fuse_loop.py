@@ -1,0 +1,385 @@
+"""Chunked train loop (fuse_loop.py): lax.scan over K fused steps.
+
+The contract (docs/performance.md "Chunked training loop"): a chunked
+run over a batch schedule must land the same weights as the per-step
+fused loop over the identical schedule — same PRNG split sequence,
+same optimizer math — while dispatching once per K steps; the epoch
+tail that does not fill a chunk reuses the per-step program (never a
+second loop executable); K=1 degenerates to the existing fused step
+exactly.  The graphlint/memlint pins keep the scanned program
+zero-finding with donation coverage 1.0 on the scan carry.
+"""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon
+from incubator_mxnet_tpu.fuse import make_fused_train_step
+from incubator_mxnet_tpu.fuse_loop import ChunkedTrainLoop
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.gluon.data.dataloader import DevicePrefetchRing
+
+# the pinned parity tolerance (train_loop_bench quotes the same):
+# XLA may re-fuse the scan body, which moves float rounding, not math
+RTOL, ATOL = 2e-5, 1e-6
+
+
+def _net(seed=0, dropout=0.0):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"))
+    if dropout:
+        net.add(nn.Dropout(dropout))
+    net.add(nn.Dense(5, in_units=16))
+    net.initialize()
+    net(nd.random.uniform(shape=(1, 8)))
+    return net
+
+
+def _batches(n, bs=4, seed=1):
+    rng = onp.random.RandomState(seed)
+    return [(nd.array(rng.rand(bs, 8).astype("f")),
+             nd.array(rng.randint(0, 5, (bs,)).astype("i4")))
+            for _ in range(n)]
+
+
+def _step(opt="sgd", dropout=0.0, seed=0, **kw):
+    return make_fused_train_step(
+        _net(seed, dropout), gluon.loss.SoftmaxCrossEntropyLoss(),
+        opt, {"learning_rate": 0.1, "momentum": 0.9}
+        if opt in ("sgd", "nag") else {"learning_rate": 0.01}, **kw)
+
+
+def _leaves(step):
+    import jax
+    return jax.tree_util.tree_leaves(
+        {**step.params, **step.aux, **step.opt_state})
+
+
+def _assert_state_close(a, b, rtol=RTOL, atol=ATOL):
+    for x, y in zip(_leaves(a), _leaves(b)):
+        onp.testing.assert_allclose(onp.asarray(x), onp.asarray(y),
+                                    rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# parity: chunked == sequential fused over the same schedule
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("opt", ["sgd", "adam"])
+def test_chunked_matches_sequential_fused(opt):
+    batches = _batches(8)
+    seq = _step(opt)
+    for x, y in batches:
+        seq(x, y)
+
+    ch = _step(opt, chunk_steps=4)
+    loop = ch.chunked_loop()
+    records = loop.run_epoch(batches)
+    assert [r["kind"] for r in records] == ["chunk", "chunk"]
+    assert loop.chunks_run == 2 and loop.tail_steps_run == 0
+    _assert_state_close(seq, ch)
+    # the scan split the PRNG key exactly as the host loop did
+    assert bool((seq._key == ch._key).all())
+
+
+def test_chunk_mean_loss_matches_sequential_step_losses():
+    batches = _batches(4)
+    seq = _step()
+    losses = [float(seq(x, y)) for x, y in batches]
+    ch = _step(chunk_steps=4)
+    rec = ch.chunked_loop().run_epoch(batches)
+    assert float(rec[0]["loss"]) == pytest.approx(
+        sum(losses) / len(losses), rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# the epoch tail (length not divisible by K)
+# ---------------------------------------------------------------------------
+
+def test_tail_runs_per_step_and_compiles_no_second_loop():
+    batches = _batches(10)
+    seq = _step()
+    for x, y in batches:
+        seq(x, y)
+
+    ch = _step(chunk_steps=4)
+    loop = ch.chunked_loop()
+    records = loop.run_epoch(batches)
+    assert [r["kind"] for r in records] == ["chunk", "chunk", "tail"]
+    assert records[-1]["steps"] == 2
+    assert loop.chunks_run == 2 and loop.tail_steps_run == 2
+    # exactly one loop executable — the 2-step tail reused the
+    # per-step program instead of compiling a (2, bucket) loop
+    assert loop.compile_count == 1
+    _assert_state_close(seq, ch)
+    assert bool((seq._key == ch._key).all())
+
+
+def test_tail_steps_bitwise_equal_to_per_step_continuation():
+    """The tail IS the per-step fused program: continuing a chunked
+    prefix by hand through step() must land bitwise-identical state to
+    what run_epoch's tail produced (same executable, same inputs)."""
+    batches = _batches(10)
+    full = _step(chunk_steps=4)
+    full.chunked_loop().run_epoch(batches)
+
+    manual = _step(chunk_steps=4)           # same seed ⇒ same state
+    manual.chunked_loop().run_epoch(batches[:8])
+    for x, y in batches[8:]:
+        manual(x, y)                        # per-step continuation
+
+    for a, b in zip(_leaves(full), _leaves(manual)):
+        assert bool((a == b).all())
+    assert bool((full._key == manual._key).all())
+
+
+# ---------------------------------------------------------------------------
+# K=1 degenerates to the existing fused step
+# ---------------------------------------------------------------------------
+
+def test_k1_is_the_per_step_fused_path_bitwise():
+    batches = _batches(6)
+    seq = _step()
+    for x, y in batches:
+        seq(x, y)
+
+    ch = _step(chunk_steps=1)
+    loop = ch.chunked_loop()
+    records = loop.run_epoch(batches)
+    # no loop program exists at K=1 — nothing scanned, nothing compiled
+    assert loop._executor is None and loop.compile_count == 0
+    assert all(r["kind"] == "step" for r in records)
+    for a, b in zip(_leaves(seq), _leaves(ch)):
+        assert bool((a == b).all())
+    assert bool((seq._key == ch._key).all())
+
+
+def test_run_chunk_rejects_k1_and_wrong_block_length():
+    ch = _step(chunk_steps=1)
+    with pytest.raises(RuntimeError, match="chunk_steps == 1"):
+        ch.chunked_loop().run_chunk(None, None)
+    ch4 = _step(chunk_steps=4)
+    loop = ch4.chunked_loop()
+    import jax.numpy as jnp
+    with pytest.raises(ValueError, match="loop compiled for"):
+        loop.run_chunk(jnp.zeros((2, 4, 8)), jnp.zeros((2, 4), "int32"))
+    with pytest.raises(ValueError, match="chunk_steps"):
+        ChunkedTrainLoop(ch4, chunk_steps=0)
+    with pytest.raises(ValueError, match="chunk_steps"):
+        make_fused_train_step(_net(), gluon.loss.SoftmaxCrossEntropyLoss(),
+                              "sgd", {}, chunk_steps=-2)
+
+
+# ---------------------------------------------------------------------------
+# PRNG stream parity (dropout)
+# ---------------------------------------------------------------------------
+
+def test_dropout_sees_identical_keys_chunked_and_sequential():
+    """Dropout masks are drawn from the per-step key: the scan must
+    split keys exactly as the sequential host loop does, or training
+    trajectories silently diverge."""
+    batches = _batches(8)
+    seq = _step(dropout=0.5)
+    for x, y in batches:
+        seq(x, y)
+    ch = _step(dropout=0.5, chunk_steps=4)
+    ch.chunked_loop().run_epoch(batches)
+    _assert_state_close(seq, ch)
+    assert bool((seq._key == ch._key).all())
+
+
+def test_key_schedule_is_the_sequential_split_chain():
+    import jax
+    import jax.numpy as jnp
+    ch = _step(chunk_steps=4)
+    k0 = jnp.array(ch._key)     # copy: the loop donates the key buffer
+    ch.chunked_loop().run_epoch(_batches(4))
+    expect = k0
+    for _ in range(4):
+        expect, _sub = jax.random.split(expect)
+    assert bool((ch._key == expect).all())
+
+
+# ---------------------------------------------------------------------------
+# trace-key / sentinel behavior: one executable per (K, bucket)
+# ---------------------------------------------------------------------------
+
+def test_one_loop_compile_per_bucket_and_flat_across_epochs():
+    ch = _step(chunk_steps=2)
+    loop = ch.chunked_loop()
+    loop.run_epoch(_batches(4, bs=4))
+    assert loop.compile_count == 1
+    loop.run_epoch(_batches(4, bs=4, seed=2))   # same bucket: no retrace
+    assert loop.compile_count == 1
+    loop.run_epoch(_batches(4, bs=2, seed=3))   # new bucket: one more
+    assert loop.compile_count == 2
+    loop.run_epoch(_batches(4, bs=2, seed=4))
+    assert loop.compile_count == 2
+
+
+def test_chunked_loop_carries_mesh_batch_sharding():
+    """A mesh-built step's chunked loop must shard its blocks with the
+    step's batch spec (scan axis unsharded) — not silently replicate
+    them across the mesh — and still match the unsharded run."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the 8-device CPU dryrun mesh")
+    from incubator_mxnet_tpu.parallel import make_mesh
+
+    batches = _batches(4, bs=4)
+    seq = _step()
+    for x, y in batches:
+        seq(x, y)
+
+    mesh = make_mesh(dp=2)
+    ch = make_fused_train_step(
+        _net(), gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9},
+        mesh=mesh, batch_spec=P("dp"), chunk_steps=2)
+    loop = ch.chunked_loop()
+    # the compiled loop demands dp-sharded blocks on the batch axis
+    # (scan axis unsharded) — probe the executable's input shardings
+    from jax.sharding import NamedSharding
+    sds = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+        (ch.params, ch.aux, ch.opt_state, ch._key))
+    xs_sd = jax.ShapeDtypeStruct((2, 4, 8), "float32")
+    ys_sd = jax.ShapeDtypeStruct((2, 4), "int32")
+    compiled = loop._executor.jfn.lower(*sds, xs_sd, ys_sd).compile()
+    want = NamedSharding(mesh, P(None, "dp"))
+    block_shardings = compiled.input_shardings[0][-2:]
+    assert all(s == want for s in block_shardings), block_shardings
+    loop.run_epoch(batches)
+    _assert_state_close(seq, ch)
+    # value compare via host: the mesh run's key is replicated across
+    # devices, the single-device run's is not — == across placements
+    # is a jit device error, not a parity statement
+    onp.testing.assert_array_equal(onp.asarray(seq._key),
+                                   onp.asarray(ch._key))
+
+
+# ---------------------------------------------------------------------------
+# graphlint/memlint pins (satellite): the scanned program analyzes clean
+# ---------------------------------------------------------------------------
+
+def test_scanned_loop_zero_findings_and_full_donation_coverage():
+    """The fused-step GL-DEAD001 exemption carries into the scan-body
+    walk (zero findings on the chunked MLP loop), and memlint sees the
+    scan carry fully donated: donation_coverage == 1.0."""
+    from incubator_mxnet_tpu.analysis import graphlint as gl
+
+    ch = _step(chunk_steps=4)
+    loop = ch.chunked_loop()
+    (x0, y0) = _batches(1)[0]
+    import jax.numpy as jnp
+    xs = jnp.stack([x0.data] * 4)
+    ys = jnp.stack([y0.data] * 4)
+    args = (ch.params, ch.aux, ch.opt_state, ch._key, xs, ys)
+    prev = gl.set_lint_mode("warn")
+    try:
+        findings, _ = loop._executor.analyze(
+            args, graphlint=dict(
+                check_donation=True,
+                config=gl.Config(ignore={"GL-DEAD001"})))
+    finally:
+        gl.set_lint_mode(prev)
+    assert findings == []
+
+    from incubator_mxnet_tpu.analysis import memlint as ml
+    prev = ml.set_mem_mode("warn")
+    try:
+        _, rep = loop._executor.analyze(
+            args, memlint=dict(require_donation=True))
+    finally:
+        ml.set_mem_mode(prev)
+    assert rep is not None
+    assert rep.donation_coverage == 1.0
+    assert not [f for f in rep.findings if f.severity == "error"]
+
+
+def test_lint_latch_runs_through_run_chunk():
+    """Enabling strict modes before the first chunk must analyze the
+    scanned program through the choke point (and pass)."""
+    from incubator_mxnet_tpu.analysis import graphlint as gl
+    from incubator_mxnet_tpu.analysis import memlint as ml
+
+    ch = _step(chunk_steps=2)
+    loop = ch.chunked_loop()
+    pg, pm = gl.set_lint_mode("strict"), ml.set_mem_mode("strict")
+    try:
+        loop.run_epoch(_batches(2))
+    finally:
+        gl.set_lint_mode(pg)
+        ml.set_mem_mode(pm)
+    assert loop._lint_done and loop._memlint_done
+
+
+# ---------------------------------------------------------------------------
+# DevicePrefetchRing
+# ---------------------------------------------------------------------------
+
+def test_ring_groups_blocks_in_order_with_tail():
+    rng = onp.random.RandomState(0)
+    batches = [(rng.rand(2, 3).astype("f"), rng.rand(2).astype("f"))
+               for _ in range(7)]
+    out = list(DevicePrefetchRing(batches, 3))
+    assert [b[0] for b in out] == ["chunk", "chunk", "tail"]
+    for i, (_, xs, ys) in enumerate(out[:2]):
+        assert xs.shape == (3, 2, 3) and ys.shape == (3, 2)
+        for k in range(3):
+            onp.testing.assert_array_equal(onp.asarray(xs[k]),
+                                           batches[3 * i + k][0])
+    assert len(out[2][1]) == 1
+    onp.testing.assert_array_equal(onp.asarray(out[2][1][0][0]),
+                                   batches[6][0])
+
+
+def test_ring_nd_and_numpy_sources_agree():
+    rng = onp.random.RandomState(0)
+    np_batches = [(rng.rand(2, 3).astype("f"),
+                   rng.randint(0, 4, (2,)).astype("i4"))
+                  for _ in range(4)]
+    nd_batches = [(nd.array(x), nd.array(y)) for x, y in np_batches]
+    a = list(DevicePrefetchRing(np_batches, 2))
+    b = list(DevicePrefetchRing(nd_batches, 2))
+    assert len(a) == len(b) == 2
+    for (ka, xa, ya), (kb, xb, yb) in zip(a, b):
+        assert ka == kb == "chunk"
+        onp.testing.assert_array_equal(onp.asarray(xa), onp.asarray(xb))
+        onp.testing.assert_array_equal(onp.asarray(ya), onp.asarray(yb))
+
+
+def test_ring_exact_multiple_has_no_tail_and_empty_source_is_empty():
+    rng = onp.random.RandomState(0)
+    batches = [(rng.rand(2, 3).astype("f"), rng.rand(2).astype("f"))
+               for _ in range(4)]
+    out = list(DevicePrefetchRing(batches, 2))
+    assert [b[0] for b in out] == ["chunk", "chunk"]
+    assert list(DevicePrefetchRing([], 2)) == []
+    with pytest.raises(ValueError):
+        DevicePrefetchRing(batches, 0)
+    with pytest.raises(ValueError):
+        DevicePrefetchRing(batches, 2, depth=0)
+
+
+def test_trainer_chunk_steps_env_default(monkeypatch):
+    from incubator_mxnet_tpu.gluon import Trainer
+    net = _net()
+    monkeypatch.setenv("MXNET_TRAIN_CHUNK_STEPS", "5")
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                 kvstore=None)
+    assert tr._chunk_steps == 5
+    assert not tr._at_chunk_boundary() or tr._step_count == 0
+    tr._step_count = 4
+    assert not tr._at_chunk_boundary()
+    tr._step_count = 5
+    assert tr._at_chunk_boundary()
+    step = make_fused_train_step(
+        _net(), gluon.loss.SoftmaxCrossEntropyLoss(), "sgd", {})
+    assert step.chunk_steps == 5
+    with pytest.raises(ValueError, match="chunk_steps"):
+        Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                kvstore=None, chunk_steps=0)
