@@ -71,6 +71,15 @@ Stages (each isolated, failures collected, nonzero exit if any fail):
              AOT path, and total replica-seconds strictly below the
              equivalent static fleet's
 
+  trace      request-scoped tracing sweep (docs/observability.md):
+             tests/test_trace.py under a pinned seeded spec — span
+             recorder semantics, header-propagation edge cases, ring
+             wraparound, failover/hedge spans with typed outcomes,
+             the subprocess end-to-end merged-timeline coverage gate
+             — with full pytest output teed to .ci_trace_stage.log;
+             then serving_bench --trace-check (tracing-off hook cost,
+             sampled-at-1.0 overhead, bitwise parity with tracing on)
+
   lint       mxlint (docs/static_analysis.md) over the python surface:
              framework-invariant rules (env-var/docs sync, fault-point
              registry, monotonic clocks, bulkable purity, lock order,
@@ -401,6 +410,61 @@ def stage_autoscale(args):
                   f"compiles {rec['compile_total']}")
 
 
+# Pinned trace-chaos spec: replica-side faults (absorbed by failover —
+# each failed hop must land as a SPAN with a typed outcome and the
+# injected fault as a span event) plus jittered device execution.
+# Seeded like every other spec so a trace-stage failure replays from
+# the spec string alone.
+TRACE_SPEC = ("serving.replica_exec:error:p=0.1:seed=17,"
+              "serving.execute:delay:ms=1:p=0.2:seed=19")
+
+
+def stage_trace(args):
+    """Request-scoped tracing sweep (docs/observability.md): the whole
+    test_trace.py battery — span recorder semantics, header
+    propagation edge cases, ring wraparound, router failover/hedge
+    spans with typed outcomes, the subprocess-replica end-to-end
+    merged-timeline coverage gate — under the pinned seeded spec, with
+    FULL pytest output teed to a log (this stage has no lastfailed
+    cache; a bare exit code is undebuggable); then the tracing
+    overhead gate (tracing off = one measured branch, sampled-at-1.0
+    reported, bitwise parity with tracing on)."""
+    log = os.path.join(REPO, ".ci_trace_stage.log")
+    proc = sh([sys.executable, "-m", "pytest", "-q",
+               "tests/test_trace.py",
+               "--continue-on-collection-errors",
+               "-p", "no:cacheprovider"],
+              timeout=1800, env={"MXNET_FAULT_SPEC": TRACE_SPEC,
+                                 "MXNET_SERVING_RETRIES": "6"})
+    with open(log, "w") as f:
+        f.write(proc.stdout or "")
+        if proc.stderr:
+            f.write("\n--- stderr ---\n" + proc.stderr)
+    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+    if proc.returncode != 0:
+        return False, (f"spec={TRACE_SPEC!r}: {tail} "
+                       f"(full output: {log})")
+    out = os.path.join(REPO, ".ci_trace_bench.json")
+    try:
+        proc2 = sh([sys.executable, "benchmark/serving_bench.py",
+                    "--trace-check", "--check", "--requests", "32",
+                    "--rounds", "2", "--output", out], timeout=900)
+        if proc2.returncode != 0:
+            return False, (proc2.stderr or proc2.stdout).strip()[-400:]
+        with open(out) as f:
+            rec = json.load(f)
+    finally:
+        if os.path.exists(out):
+            os.remove(out)
+    return True, (f"spec ok: {tail}; off {rec['trace_off_rps']} rps "
+                  f"(noise {rec['trace_off_noise_pct']}%), sampled "
+                  f"{rec['trace_sampled_rps']} rps "
+                  f"({rec['sampled_overhead_pct']}% overhead, "
+                  f"{rec['sampled_spans']} spans), hook "
+                  f"{rec['offpath_ns_per_hook']}ns, parity="
+                  f"{rec['bitwise_equal_with_tracing']}")
+
+
 def stage_serving(args):
     """Serving smoke (docs/serving.md): HTTP end-to-end against a real
     gluon model_zoo artifact — warmup, concurrent requests, /metrics
@@ -621,6 +685,7 @@ STAGES = {"build": stage_build, "sanity": stage_sanity,
           "elastic": stage_elastic,
           "serving": stage_serving, "fleet": stage_fleet,
           "sessions": stage_sessions, "autoscale": stage_autoscale,
+          "trace": stage_trace,
           "coldstart": stage_coldstart,
           "trainloop": stage_trainloop,
           "race": stage_race,

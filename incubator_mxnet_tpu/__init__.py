@@ -94,6 +94,7 @@ from . import base
 from .base import MXNetError
 from . import error
 from . import fault
+from . import trace
 from . import libinfo
 from . import log
 from . import checkpoint

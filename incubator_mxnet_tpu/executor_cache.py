@@ -205,6 +205,12 @@ class Executor:
         # bulking trace cache) via recompile.record_compile
         wrapped = _recompile.instrument(fn, site) if instrument else fn
         self.jfn = jax.jit(wrapped, **kwargs)  # mxlint: disable=MX-DONATE001(donation is threaded via kwargs — every Executor caller states its donate_argnums contract at construction, and () means caller-held inputs)
+        # an Executor built while a request trace is active means that
+        # request is paying a build the warm path would not — stamp it
+        # on the trace (the XLA compile itself lands inside whatever
+        # span is timing the call; this event names the site)
+        from . import trace as _trace
+        _trace.add_event("executor.created", site=site)
         self._built_at = time.monotonic()
         with _lock:
             if _state["first_build_ms"] is None:
@@ -341,14 +347,23 @@ class TraceCache:
     def get_or_create(self, key, factory):
         """Atomic lookup-or-build: ``factory()`` runs under the cache
         lock, so two threads racing on one key can never build (and
-        report to the sentinel) twice.  Returns ``(entry, hit)``."""
+        report to the sentinel) twice.  Returns ``(entry, hit)``.
+
+        Build-vs-cache-hit is trace-visible: a hit adds an instant
+        event to the active request span, a miss times ``factory()``
+        as an ``executor.build`` span — the difference between "paid a
+        compile" and "replayed an executable" for exactly the request
+        that paid it (docs/observability.md)."""
+        from . import trace as _trace
         with self._lock:
             entry = self._d.get(key)
             if entry is not None:
                 self.hits += 1
+                _trace.add_event("trace_cache.hit", cache=self.name)
                 return entry, True
             self.misses += 1
-            entry = self._d[key] = factory()
+            with _trace.span("executor.build", cache=self.name):
+                entry = self._d[key] = factory()
             return entry, False
 
     def peek(self, key):

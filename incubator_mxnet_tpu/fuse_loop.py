@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 
 from . import executor_cache as _xc
+from . import trace
 from .base import resolve_chunk_steps as _resolve_chunk_steps
 from .gluon.data.dataloader import DevicePrefetchRing
 
@@ -159,9 +160,14 @@ class ChunkedTrainLoop:
         if not (self._lint_done and self._memlint_done):
             args = (s.params, s.aux, s.opt_state, s._key, xs, ys)
             self._analyze(args)
-        s.params, s.aux, s.opt_state, s._key, loss = \
-            self._executor.jfn(s.params, s.aux, s.opt_state, s._key,
-                               xs, ys)
+        # one span per chunk dispatch (K steps, one XLA program):
+        # dispatch is async, so the span measures host-side cost — the
+        # thing chunking exists to amortize (no-op without a trace)
+        with trace.span("train.chunk", steps=self.chunk_steps,
+                        chunk=self.chunks_run):
+            s.params, s.aux, s.opt_state, s._key, loss = \
+                self._executor.jfn(s.params, s.aux, s.opt_state,
+                                   s._key, xs, ys)
         s._last = loss
         self.chunks_run += 1
         return loss
@@ -176,6 +182,21 @@ class ChunkedTrainLoop:
         checkpoint/eviction logic keys on.  Returns the per-chunk
         records ``[{"steps", "loss", "kind"}, ...]`` where ``loss`` is
         always the per-step mean over the record's steps."""
+        # an epoch gets its own trace when sampling is on and nothing
+        # upstream started one — the training-side analog of a request
+        # trace: chunk dispatches and prefetch fill/drain land as
+        # spans on one timeline (docs/observability.md)
+        root = (trace.start_trace("train.epoch",
+                                  chunk_steps=self.chunk_steps)
+                if trace.current_span() is None else None)
+        try:
+            with trace.activate(root):
+                return self._run_epoch(batches, on_chunk)
+        finally:
+            if root is not None:
+                root.finish()
+
+    def _run_epoch(self, batches, on_chunk):
         records = []
         if self.chunk_steps == 1:
             # degenerate case: the existing fused step IS the loop

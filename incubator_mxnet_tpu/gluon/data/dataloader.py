@@ -20,12 +20,14 @@ strategies exist here:
 """
 from __future__ import annotations
 
+import contextlib as _contextlib
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as onp
 
 from ... import ndarray as nd
+from ... import trace
 from ...ndarray import NDArray
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
@@ -90,35 +92,46 @@ class DevicePrefetchRing:
         self.tail_steps = 0
 
     def _next_block(self):
-        pairs = []
-        for _ in range(self.chunk_steps):
-            try:
-                pairs.append(next(self._it))
-            except StopIteration:
-                break
-        if not pairs:
-            return None
-        if len(pairs) < self.chunk_steps:
-            self.tail_steps = len(pairs)
-            return ("tail", pairs)
-        xs = _block_to_device([x for x, _ in pairs])
-        ys = _block_to_device([y for _, y in pairs])
-        self.blocks += 1
-        return ("chunk", xs, ys)
+        # fill span: draw K batches from the loader + launch the
+        # host→device upload (async device_put) — the producer half of
+        # the overlap the ring exists for (no-op without a trace)
+        with trace.span("prefetch.fill", steps=self.chunk_steps,
+                        block=self.blocks):
+            pairs = []
+            for _ in range(self.chunk_steps):
+                try:
+                    pairs.append(next(self._it))
+                except StopIteration:
+                    break
+            if not pairs:
+                return None
+            if len(pairs) < self.chunk_steps:
+                self.tail_steps = len(pairs)
+                return ("tail", pairs)
+            xs = _block_to_device([x for x, _ in pairs])
+            ys = _block_to_device([y for _, y in pairs])
+            self.blocks += 1
+            return ("chunk", xs, ys)
 
     def __iter__(self):
         from collections import deque
         q = deque()
         exhausted = False
         while True:
-            while not exhausted and len(q) < self.depth:
-                block = self._next_block()
-                if block is None:
-                    exhausted = True
-                    break
-                q.append(block)
-                if block[0] == "tail":
-                    exhausted = True
+            # drain span only when the consumer actually has to WAIT
+            # for a fill (ring empty): nonzero drain time here is the
+            # "dataloader can't keep up" signal a chunk timeline shows
+            starved = not q and not exhausted
+            with (trace.span("prefetch.drain") if starved
+                  else _contextlib.nullcontext()):
+                while not exhausted and len(q) < self.depth:
+                    block = self._next_block()
+                    if block is None:
+                        exhausted = True
+                        break
+                    q.append(block)
+                    if block[0] == "tail":
+                        exhausted = True
             if not q:
                 return
             yield q.popleft()

@@ -405,16 +405,32 @@ def dumps(reset=False, format="table"):
     """Aggregate stats as a printable table (reference profiler.py:316),
     followed by one section per registered subsystem stats provider
     (``bulk_stats`` for op bulking, ``serving`` for the inference
-    server) so one dump answers both halves of the perf story."""
-    lines = [f"{'Name':<40} {'Calls':>8} {'Total(us)':>12} {'Mean(us)':>12}"]
+    server) so one dump answers both halves of the perf story.
+
+    ``format="json"`` returns the same content machine-readable (one
+    JSON object: ``{"aggregate": {name: {calls, total_us, mean_us}},
+    "providers": {provider: stats}}``) so CI gates and
+    ``tools/traceview.py`` consume provider stats without screen-
+    scraping the table."""
+    if format not in ("table", "json"):
+        raise ValueError(
+            f'dumps format must be "table" or "json", got {format!r}')
     with _events_lock:
-        for name, durs in sorted(_aggregate.items()):
-            lines.append(f"{name:<40} {len(durs):>8} {sum(durs):>12.1f} "
-                         f"{sum(durs) / len(durs):>12.1f}")
+        agg = {name: {"calls": len(durs),
+                      "total_us": round(sum(durs), 1),
+                      "mean_us": round(sum(durs) / len(durs), 1)}
+               for name, durs in sorted(_aggregate.items())}
         if reset:
             _aggregate.clear()
     sections = {"bulk_stats": bulk_stats()}
     sections.update(provider_stats())
+    if format == "json":
+        return json.dumps({"aggregate": agg, "providers": sections},
+                          default=str)
+    lines = [f"{'Name':<40} {'Calls':>8} {'Total(us)':>12} {'Mean(us)':>12}"]
+    for name, a in agg.items():
+        lines.append(f"{name:<40} {a['calls']:>8} {a['total_us']:>12.1f} "
+                     f"{a['mean_us']:>12.1f}")
     for name, stats in sections.items():
         if not stats:
             continue

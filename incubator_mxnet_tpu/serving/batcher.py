@@ -35,7 +35,7 @@ import time
 import numpy as onp
 
 from ..base import get_env
-from .. import fault
+from .. import fault, trace
 from .admission import DeadlineExceeded, ServingError
 
 __all__ = ["DynamicBatcher", "ContinuousBatcher", "PendingResult",
@@ -115,7 +115,7 @@ def parse_buckets(text=None):
 class _Request:
     __slots__ = ("inputs", "event", "batch_out", "row", "error",
                  "t_enqueue", "deadline_ms", "queue_ms", "compute_ms",
-                 "cancelled")
+                 "cancelled", "span")
 
     def __init__(self, inputs, deadline_ms):
         self.inputs = inputs
@@ -128,6 +128,10 @@ class _Request:
         self.queue_ms = None
         self.compute_ms = None
         self.cancelled = False
+        # captured HERE (the caller's thread) because the flush worker
+        # has no request context: the worker parents its queue/execute
+        # spans on this.  None for unsampled requests — the usual case
+        self.span = trace.current_span()
 
     def age_ms(self, now=None):
         return ((now if now is not None else time.monotonic())
@@ -374,6 +378,18 @@ class DynamicBatcher:
             return
         n = len(live)
         padded_to = self._bucket_for(n)
+        # sampled riders get the queue-wait vs compute split as spans
+        # (usually zero of them — the per-request cost is one attribute
+        # test).  The execute span opens HERE so stack+pad cost is
+        # inside it; injected serving.execute faults and retry events
+        # attach to the oldest rider's span (the activated one).
+        traced = [r for r in live if r.span is not None]
+        for r in traced:
+            trace.record_span("batch.queue", r.span, r.t_enqueue,
+                              t_start, model=self.name)
+        espans = [r.span.child("batch.execute", model=self.name,
+                               rows=n, padded_to=padded_to)
+                  for r in traced]
         try:
             stacked = tuple(
                 onp.stack([r.inputs[i] for r in live])
@@ -404,10 +420,15 @@ class DynamicBatcher:
                         self.exec_gate.release(token)
 
             t_exec = time.monotonic()
-            out = fault.retry(run, max_attempts=self._retries,
-                              backoff=0.01, max_backoff=0.5)
+            with trace.activate(espans[0] if espans else None):
+                out = fault.retry(run, max_attempts=self._retries,
+                                  backoff=0.01, max_backoff=0.5)
             compute_ms = (time.monotonic() - t_exec) * 1000.0
+            for es in espans:
+                es.finish()
         except Exception as e:  # mxlint: allow-broad-except(wrapped as ServingError and delivered to every request in the batch)
+            for es in espans:
+                es.finish(outcome=type(e).__name__)
             err = e if isinstance(e, ServingError) else ServingError(
                 f"batch execution failed for {self.name!r}: "
                 f"{type(e).__name__}: {e}")
@@ -458,7 +479,7 @@ class _Stream:
     __slots__ = ("sid", "inputs", "n_steps", "deadline_ms", "event",
                  "error", "chunks", "queue", "cancelled", "t_enqueue",
                  "t_admitted", "queue_ms", "compute_ms", "steps_done",
-                 "carry", "checked_out", "session_steps")
+                 "carry", "checked_out", "session_steps", "span")
 
     def __init__(self, sid, inputs, n_steps, deadline_ms, stream):
         self.sid = sid
@@ -478,6 +499,9 @@ class _Stream:
         self.carry = None          # checked-out carry row while active
         self.checked_out = False
         self.session_steps = None  # session-absolute count (owner's)
+        # caller-thread trace context (same contract as _Request.span):
+        # the decode worker parents its per-step spans on this
+        self.span = trace.current_span()
 
     def age_ms(self, now=None):
         return ((now if now is not None else time.monotonic())
@@ -754,16 +778,36 @@ class ContinuousBatcher:
         if live:
             t0 = time.monotonic()
             padded_to = self._bucket_for(len(live))
+            # decode-step boundary spans for sampled streams: queue
+            # wait recorded once (first step), then one span per step
+            # so a stalled stream shows WHICH step stalled; injected
+            # session_step faults attach to the oldest rider's span
+            traced = [r for r in live if r.span is not None]
+            for r in traced:
+                if r.steps_done == 0 and r.t_admitted is not None:
+                    trace.record_span("session.queue", r.span,
+                                      r.t_enqueue, r.t_admitted,
+                                      model=self.name, sid=r.sid)
+            sspans = [r.span.child("session.decode_step",
+                                   model=self.name, sid=r.sid,
+                                   step=r.steps_done, rows=len(live),
+                                   padded_to=padded_to)
+                      for r in traced]
             try:
                 def run():
                     fault.inject("serving.session_step", self.name)
                     return self.step_batch(
                         [r.carry for r in live],
                         [r.inputs for r in live], padded_to)
-                new_rows, out_rows = fault.retry(
-                    run, max_attempts=self._retries, backoff=0.01,
-                    max_backoff=0.5)
+                with trace.activate(sspans[0] if sspans else None):
+                    new_rows, out_rows = fault.retry(
+                        run, max_attempts=self._retries, backoff=0.01,
+                        max_backoff=0.5)
+                for ss in sspans:
+                    ss.finish()
             except Exception as e:  # mxlint: allow-broad-except(wrapped as ServingError and delivered to every stream riding the failed decode step)
+                for ss in sspans:
+                    ss.finish(outcome=type(e).__name__)
                 err = e if isinstance(e, ServingError) else ServingError(
                     f"decode step failed for {self.name!r}: "
                     f"{type(e).__name__}: {e}")

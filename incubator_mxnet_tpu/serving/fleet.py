@@ -59,7 +59,7 @@ import time
 import numpy as onp
 
 from ..base import get_env
-from .. import fault
+from .. import fault, trace
 from ..error import ReplicaUnavailableError
 from .admission import (BadRequest, DeadlineExceeded, ModelNotFound,
                         QueueFullError, ServingError, ShuttingDown)
@@ -489,15 +489,19 @@ class ProcessReplica(_ReplicaBase):
             raise ConnectionResetError(
                 f"replica {self.rid} exited rc={self._proc.returncode}")
 
-    def _http(self, method_path, body=None, timeout_s=30.0):
+    def _http(self, method_path, body=None, timeout_s=30.0,
+              headers=None):
         import http.client
         import urllib.error
         import urllib.request
         self._gone()
         method, path = method_path.split(" ", 1)
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
         req = urllib.request.Request(
             f"http://127.0.0.1:{self._port}{path}", data=body,
-            headers={"Content-Type": "application/json"}, method=method)
+            headers=hdrs, method=method)
         try:
             with urllib.request.urlopen(req, timeout=timeout_s) as resp:
                 status, raw = resp.status, resp.read()
@@ -555,9 +559,15 @@ class ProcessReplica(_ReplicaBase):
         # socket timeout
         timeout_s = (deadline_ms / 1000.0 + 2.0 if deadline_ms
                      else 120.0)
+        # propagate the active trace across the process hop: the hop
+        # span's id becomes the replica-side parent, so one timeline
+        # covers router AND replica (a replica that predates the
+        # header just ignores it — single-process trace)
+        hval = trace.header_value(trace.current_span())
         with self.track():
             code, payload = self._http(
-                f"POST /v1/models/{name}:predict", body, timeout_s)
+                f"POST /v1/models/{name}:predict", body, timeout_s,
+                headers={trace.HEADER: hval} if hval else None)
         if code != 200:
             self._raise_for(code, payload, self.rid, name)
         return payload["outputs"], payload.get("timing", {})
@@ -659,11 +669,13 @@ class ProcessReplica(_ReplicaBase):
             body["timeout_ms"] = float(deadline_ms)
         timeout_s = (deadline_ms / 1000.0 + 5.0 if deadline_ms
                      else 120.0)
+        hval = trace.header_value(trace.current_span())
         with self.track():
             if on_chunk is None:
                 code, payload = self._http(
                     f"POST /v1/sessions/{model}/{sid}:step",
-                    json.dumps(body).encode(), timeout_s)
+                    json.dumps(body).encode(), timeout_s,
+                    headers={trace.HEADER: hval} if hval else None)
                 if code != 200:
                     self._raise_session(code, payload, self.rid,
                                         f"{model}/{sid}")
@@ -683,10 +695,14 @@ class ProcessReplica(_ReplicaBase):
         self._gone()
         body = dict(body)
         body["stream"] = True
+        hdrs = {"Content-Type": "application/json"}
+        hval = trace.header_value(trace.current_span())
+        if hval:
+            hdrs[trace.HEADER] = hval
         req = urllib.request.Request(
             f"http://127.0.0.1:{self._port}/v1/sessions/{model}/"
             f"{sid}:step", data=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"})
+            headers=hdrs)
         chunks = []
         try:
             with urllib.request.urlopen(req, timeout=timeout_s) as resp:

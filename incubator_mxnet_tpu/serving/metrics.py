@@ -17,7 +17,10 @@ from __future__ import annotations
 import threading
 import time
 
-__all__ = ["ServingMetrics", "FleetMetrics", "Histogram"]
+from .. import trace
+
+__all__ = ["ServingMetrics", "FleetMetrics", "Histogram",
+           "SlowExemplars"]
 
 
 def _esc(label_value):
@@ -96,10 +99,59 @@ class Histogram:
         return out
 
 
+class SlowExemplars:
+    """Trace-id exemplars for a latency histogram: the K slowest
+    requests per observation window (``MXNET_TRACE_SLOW_K``).
+
+    Histograms tell you THAT p99 spiked; an exemplar names a concrete
+    trace id to pull from ``/v1/trace`` and see WHERE the time went.
+    Windowing (default 512 observations) keeps the set current — a
+    one-off stall from an hour ago ages out instead of squatting on
+    the top-K forever.  The previous window is kept so a scrape right
+    after rollover still sees exemplars."""
+
+    __slots__ = ("_k", "_window", "_cur", "_prev", "_count", "_lock")
+
+    def __init__(self, k=None, window=512):
+        self._k = k
+        self._window = int(window)
+        self._cur: list = []     # [(ms, trace_id)] sorted desc
+        self._prev: list = []
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def note(self, ms, trace_id):
+        """Record one traced observation (untraced requests never get
+        here — the caller gates on trace_id)."""
+        if trace_id is None:
+            return
+        k = self._k if self._k is not None else trace.slow_k()
+        if k <= 0:
+            return
+        with self._lock:
+            self._count += 1
+            if self._count % self._window == 0:
+                self._prev, self._cur = self._cur, []
+            cur = self._cur
+            cur.append((float(ms), str(trace_id)))
+            cur.sort(key=lambda t: -t[0])
+            del cur[k:]
+
+    def exemplars(self):
+        """Top-K ``[{"ms", "trace_id"}]`` over the current + previous
+        window, slowest first."""
+        k = self._k if self._k is not None else trace.slow_k()
+        with self._lock:
+            merged = sorted(self._cur + self._prev,
+                            key=lambda t: -t[0])[:max(0, k)]
+        return [{"ms": round(ms, 3), "trace_id": tid}
+                for ms, tid in merged]
+
+
 class _ModelMetrics:
     __slots__ = ("requests", "errors", "batches", "batch_hist",
                  "e2e_ms", "compute_ms", "queue_ms", "padded_rows",
-                 "cancelled", "t_last_request")
+                 "cancelled", "t_last_request", "slow")
 
     def __init__(self):
         self.requests = {}       # {http-code: count}
@@ -115,6 +167,7 @@ class _ModelMetrics:
         self.e2e_ms = Histogram()
         self.compute_ms = Histogram()
         self.queue_ms = Histogram()
+        self.slow = SlowExemplars()   # K slowest traced requests
 
 
 class ServingMetrics:
@@ -168,7 +221,7 @@ class ServingMetrics:
     # -- recording hooks ----------------------------------------------
 
     def record_request(self, model, code, e2e_ms=None, compute_ms=None,
-                       queue_ms=None):
+                       queue_ms=None, trace_id=None):
         m = self._model(model)
         with self._lock:
             m.requests[code] = m.requests.get(code, 0) + 1
@@ -177,6 +230,10 @@ class ServingMetrics:
                 m.errors += 1
         if e2e_ms is not None:
             m.e2e_ms.observe(e2e_ms)
+            if trace_id is not None:
+                # exemplar: the histogram bucket gets a concrete trace
+                # to name when someone asks "which request was that?"
+                m.slow.note(e2e_ms, trace_id)
         if compute_ms is not None:
             m.compute_ms.observe(compute_ms)
         if queue_ms is not None:
@@ -448,6 +505,16 @@ class ServingMetrics:
             for name, m in sorted(models.items()):
                 L.extend(getattr(m, attr).prom_lines(
                     metric, f'model="{_esc(name)}"'))
+        # slow-request exemplars as comments (docs/observability.md):
+        # the trace ids of the K slowest traced requests per window —
+        # text-format-legal ('#' lines), so a plain scraper ignores
+        # them while a human (or traceview) reads the ids right off
+        # the /metrics page
+        for name, m in sorted(models.items()):
+            for ex in m.slow.exemplars():
+                L.append(f'# exemplar mxnet_serving_latency_ms'
+                         f'{{model="{_esc(name)}"}} '
+                         f'trace_id={ex["trace_id"]} ms={ex["ms"]}')
         return "\n".join(L) + "\n"
 
     def snapshot(self):
@@ -489,6 +556,9 @@ class ServingMetrics:
             out[f"{name}.e2e_ms"] = m.e2e_ms.snapshot()
             out[f"{name}.compute_ms"] = m.compute_ms.snapshot()
             out[f"{name}.queue_ms"] = m.queue_ms.snapshot()
+            slow = m.slow.exemplars()
+            if slow:
+                out[f"{name}.slow_traces"] = slow
         return out
 
     def register_with_profiler(self):
@@ -508,13 +578,14 @@ class ServingMetrics:
 class _RouteModel:
     """Per-model router-side counters (the autoscaler's load signal)."""
 
-    __slots__ = ("requests", "e2e_ms", "t_last", "inflight")
+    __slots__ = ("requests", "e2e_ms", "t_last", "inflight", "slow")
 
     def __init__(self):
         self.requests = {}       # {final-http-code: count}
         self.e2e_ms = Histogram()
         self.t_last = None       # monotonic stamp of last route
         self.inflight = 0
+        self.slow = SlowExemplars()   # K slowest traced routes
 
 
 class FleetMetrics:
@@ -539,6 +610,7 @@ class FleetMetrics:
         self.session_losses = 0           # typed SessionLostError out
         self.route_cancels = 0            # client gone mid-route
         self.route_ms = Histogram()
+        self.slow = SlowExemplars()       # fleet-level slow exemplars
         # per-model router view: the autoscaler's input signal (queue
         # depth rides on replica healthz; p99 / inflight / idle live
         # here, where every routed request passes)
@@ -571,11 +643,13 @@ class FleetMetrics:
 
     # -- recording hooks ----------------------------------------------
 
-    def record_route(self, code, ms=None, model=None):
+    def record_route(self, code, ms=None, model=None, trace_id=None):
         with self._lock:
             self._codes[code] = self._codes.get(code, 0) + 1
         if ms is not None:
             self.route_ms.observe(ms)
+            if trace_id is not None:
+                self.slow.note(ms, trace_id)
         if model is not None:
             m = self._route_model(model)
             with self._lock:
@@ -583,6 +657,8 @@ class FleetMetrics:
                 m.t_last = time.monotonic()
             if ms is not None:
                 m.e2e_ms.observe(ms)
+                if trace_id is not None:
+                    m.slow.note(ms, trace_id)
 
     def note_model_inflight(self, model, delta):
         """Routed-requests-in-flight gauge per model (bumped around
@@ -818,6 +894,16 @@ class FleetMetrics:
                  "routed request latency (all hops + hedges).")
         L.append("# TYPE mxnet_serving_fleet_route_ms histogram")
         L.extend(self.route_ms.prom_lines("mxnet_serving_fleet_route_ms"))
+        # slow-route exemplars: trace ids to feed tools/traceview.py
+        # (fleet-wide, then per model) — comment lines, scraper-inert
+        for ex in self.slow.exemplars():
+            L.append(f'# exemplar mxnet_serving_fleet_route_ms '
+                     f'trace_id={ex["trace_id"]} ms={ex["ms"]}')
+        for name, m in sorted(by_model.items()):
+            for ex in m.slow.exemplars():
+                L.append(f'# exemplar mxnet_serving_fleet_route_ms'
+                         f'{{model="{_esc(name)}"}} '
+                         f'trace_id={ex["trace_id"]} ms={ex["ms"]}')
         return "\n".join(L) + "\n"
 
     def snapshot(self):
@@ -843,6 +929,9 @@ class FleetMetrics:
             }
         out["route_ms"] = self.route_ms.snapshot()
         out["models"] = self.model_stats()
+        slow = self.slow.exemplars()
+        if slow:
+            out["slow_traces"] = slow
         if self._autoscale_fn is not None:
             out["autoscale"] = self._autoscale_fn()
         return out

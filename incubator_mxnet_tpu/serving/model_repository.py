@@ -24,6 +24,7 @@ import contextlib
 import threading
 
 from ..base import get_env
+from .. import trace
 from .admission import (Admission, ModelNotFound, ServingError,
                         checked_enqueue, slo_class)
 from .batcher import DynamicBatcher, WeightedFairGate, parse_buckets
@@ -144,38 +145,46 @@ class ModelRepository:
         from ..deploy import load_predictor
         slo = slo_class(slo)
         t0 = time.monotonic()
-        predictor = load_predictor(path)
-        # the artifact carries its export-time IR bill of health
-        # (deploy._export_graphlint, docs/graph_analysis.md); the
-        # deserialized executable is opaque to re-linting, so surface
-        # the recorded findings at the serving boundary instead
-        gl = predictor.meta.get("graphlint") or {}
-        if gl.get("findings"):
-            import warnings
-            warnings.warn(
-                f"model {name!r} ({path}) exported with "
-                f"{gl['findings']} graphlint finding(s) "
-                f"{gl.get('by_rule')} — see its meta.json for details")
-        batcher = DynamicBatcher(name, predictor, metrics=self.metrics,
-                                 buckets=self._buckets,
-                                 exec_gate=self.exec_gate,
-                                 weight=slo.weight)
-        entry = ModelEntry(name, version, path, predictor, batcher,
-                           slo=slo)
-        do_warmup = self._warmup_default if warmup is None else warmup
-        if do_warmup:
-            try:
-                self.warmup_entry(entry)
-            except Exception:
-                # a failed warmup must not leak the worker thread (and
-                # through its closure the predictor's weights)
-                entry.batcher.drain()
-                raise
-        # cold start = load (deserialize weights/graph + AOT blobs) +
-        # warmup (executes every bucket); with a full AOT bucket set
-        # this is deserialization, not compilation, and compile_count
-        # at ready is 0 from process start
-        entry.cold_start_ms = round((time.monotonic() - t0) * 1000.0, 3)
+        # a load paid inside a request trace (scale-from-zero, cold
+        # admin verbs) shows up as its own span — the cold-start cost
+        # attributed to exactly the request that paid it
+        with trace.span("model.load", model=name, version=version):
+            predictor = load_predictor(path)
+            # the artifact carries its export-time IR bill of health
+            # (deploy._export_graphlint, docs/graph_analysis.md); the
+            # deserialized executable is opaque to re-linting, so
+            # surface the recorded findings at the serving boundary
+            gl = predictor.meta.get("graphlint") or {}
+            if gl.get("findings"):
+                import warnings
+                warnings.warn(
+                    f"model {name!r} ({path}) exported with "
+                    f"{gl['findings']} graphlint finding(s) "
+                    f"{gl.get('by_rule')} — see its meta.json for "
+                    "details")
+            batcher = DynamicBatcher(name, predictor,
+                                     metrics=self.metrics,
+                                     buckets=self._buckets,
+                                     exec_gate=self.exec_gate,
+                                     weight=slo.weight)
+            entry = ModelEntry(name, version, path, predictor, batcher,
+                               slo=slo)
+            do_warmup = (self._warmup_default if warmup is None
+                         else warmup)
+            if do_warmup:
+                try:
+                    self.warmup_entry(entry)
+                except Exception:
+                    # a failed warmup must not leak the worker thread
+                    # (and through its closure the predictor's weights)
+                    entry.batcher.drain()
+                    raise
+            # cold start = load (deserialize weights/graph + AOT
+            # blobs) + warmup (executes every bucket); with a full AOT
+            # bucket set this is deserialization, not compilation, and
+            # compile_count at ready is 0 from process start
+            entry.cold_start_ms = round(
+                (time.monotonic() - t0) * 1000.0, 3)
         if self.metrics is not None:
             self.metrics.record_cold_start(
                 name, entry.cold_start_ms,

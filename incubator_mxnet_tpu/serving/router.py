@@ -36,6 +36,7 @@ stage land there, on ``serving.probe`` and on
 """
 from __future__ import annotations
 
+import contextvars
 import json
 import threading
 import time
@@ -43,7 +44,7 @@ import time
 import numpy as onp
 
 from ..base import get_env
-from .. import fault
+from .. import fault, trace
 from ..error import (FleetDrainingError, ReplicaUnavailableError,
                      SessionExpiredError, SessionLostError)
 from .admission import (Admission, BadRequest, ClientDisconnected,
@@ -159,9 +160,17 @@ class FleetRouter:
         label = name if self._known_model(name) else None
         if label is not None:
             self.metrics.note_model_inflight(label, +1)
+        # a trace is born at the front end: when the HTTP handler (or
+        # any caller) already activated one, ride it; otherwise the
+        # in-process route() API IS the front end and makes the head-
+        # sampling decision itself (None when sampling is off — one
+        # contextvar read + one float compare)
+        root = (trace.start_trace("router.request", model=name)
+                if trace.current_span() is None else None)
         try:
-            result = self._route(name, inputs, deadline_ms,
-                                 inputs_json, t0, live)
+            with trace.activate(root):
+                result = self._route(name, inputs, deadline_ms,
+                                     inputs_json, t0, live)
             code = 200
             return result
         except ServingError as e:
@@ -171,10 +180,16 @@ class FleetRouter:
             code = 503
             raise
         finally:
+            if root is not None:
+                root.set(code=code)
+                root.finish(
+                    outcome="ok" if code == 200 else f"http_{code}")
             if label is not None:
                 self.metrics.note_model_inflight(label, -1)
             self.metrics.record_route(
-                code, (time.monotonic() - t0) * 1000.0, model=label)
+                code, (time.monotonic() - t0) * 1000.0, model=label,
+                trace_id=(root.trace_id if root is not None
+                          else trace.current_trace_id()))
 
     def _route(self, name, inputs, deadline_ms, inputs_json, t0,
                live=None):
@@ -196,7 +211,8 @@ class FleetRouter:
                 # scale-from-zero: the model was idle-unloaded (or
                 # evicted); this request pays the (AOT-cheap) reload
                 # instead of a 404/503
-                self.autoscaler.ensure_loaded(name)
+                with trace.span("router.scale_from_zero", model=name):
+                    self.autoscaler.ensure_loaded(name)
                 r = self.fleet.pick(exclude=tried, name=name)
             if r is None:
                 if self.fleet.all_draining():
@@ -209,6 +225,13 @@ class FleetRouter:
                     f"({len(self.fleet.replicas)} known)")
             if k > 0:
                 self.metrics.record_failover()
+                # the retry hop that follows is its own span; this
+                # event marks WHY it exists (the previous hop's typed
+                # failure is that hop span's outcome)
+                trace.add_event("router.failover", attempt=k,
+                                model=name,
+                                cause=type(last).__name__
+                                if last is not None else None)
             remaining_ms = (t_end - time.monotonic()) * 1000.0
             if remaining_ms <= 0:
                 raise DeadlineExceeded(
@@ -243,23 +266,32 @@ class FleetRouter:
                 last = e
         raise last
 
-    def _call(self, r, name, inputs, hop_ms, inputs_json):
+    def _call(self, r, name, inputs, hop_ms, inputs_json, kind="hop"):
         """One physical hop, with the passive-health note attributed
         HERE — the only place the per-replica outcome is known.  With
         hedging on, the winner's success must not be credited to a
         stalled primary (that would reset its failure budget and keep
         it routable forever); the stalled hop notes its own failure
         when its hop deadline resolves it, even after the race moved
-        on."""
+        on.
+
+        Every physical attempt is its own trace span
+        (``router.hop`` / ``router.hedge``), finishing with the typed
+        outcome — a chaos timeline shows each failed hop AND the hop
+        that recovered.  The span is the active context for the hop,
+        so a process replica's header and a thread replica's batcher
+        spans both parent onto it."""
         t0 = time.monotonic()
-        try:
-            out = r.predict(name, inputs, deadline_ms=hop_ms,
-                            inputs_json=inputs_json)
-        except QueueFullError:
-            raise              # overload is load, not ill health
-        except (ShuttingDown, DeadlineExceeded, ConnectionError):
-            r.note_failure()
-            raise
+        with trace.span(f"router.{kind}", replica=r.rid, model=name,
+                        budget_ms=round(hop_ms, 1)):
+            try:
+                out = r.predict(name, inputs, deadline_ms=hop_ms,
+                                inputs_json=inputs_json)
+            except QueueFullError:
+                raise          # overload is load, not ill health
+            except (ShuttingDown, DeadlineExceeded, ConnectionError):
+                r.note_failure()
+                raise
         r.note_success()
         self._hop_ms.observe((time.monotonic() - t0) * 1000.0)
         return out
@@ -285,10 +317,17 @@ class FleetRouter:
         slots: dict = {}
         order: list = []
 
-        def run(which, rep, budget_ms):
+        def run(which, rep, budget_ms, ctx):
+            # ctx is a per-thread contextvars copy taken on the
+            # routing thread: the hop span parents onto the request
+            # span even though the race runs off-thread (each thread
+            # gets its OWN copy — a single Context cannot be entered
+            # by two OS threads)
             try:
-                res = ("ok", self._call(rep, name, inputs, budget_ms,
-                                        inputs_json))
+                res = ("ok", ctx.run(
+                    self._call, rep, name, inputs, budget_ms,
+                    inputs_json, "hedge" if which == "hedge"
+                    else "hop"))
             except BaseException as e:  # mxlint: allow-broad-except(delivered through the race slot and re-raised on the routing thread)
                 res = ("err", e)
             with cond:
@@ -296,7 +335,9 @@ class FleetRouter:
                 order.append(which)
                 cond.notify_all()
 
-        threading.Thread(target=run, args=("primary", r, hop_ms),
+        threading.Thread(target=run,
+                         args=("primary", r, hop_ms,
+                               contextvars.copy_context()),
                          name=f"hop-{r.rid}", daemon=True).start()
         with cond:
             cond.wait_for(lambda: "primary" in slots,
@@ -320,7 +361,11 @@ class FleetRouter:
                 raise val
             return val
         self.metrics.record_hedge(won=False)   # launched
-        threading.Thread(target=run, args=("hedge", r2, hop_ms),
+        trace.add_event("router.hedge_launched", replica=r2.rid,
+                        primary=r.rid, after_ms=round(hedge_ms, 1))
+        threading.Thread(target=run,
+                         args=("hedge", r2, hop_ms,
+                               contextvars.copy_context()),
                          name=f"hedge-{r2.rid}", daemon=True).start()
         with cond:
             done = cond.wait_for(
@@ -331,6 +376,8 @@ class FleetRouter:
             if winners:
                 if winners[0] == "hedge":
                     self.metrics.record_hedge(won=True)
+                    trace.add_event("router.hedge_won",
+                                    replica=r2.rid, primary=r.rid)
                 return slots[winners[0]][1]
             if not done:
                 raise DeadlineExceeded(
@@ -391,7 +438,8 @@ class FleetRouter:
         finally:
             self.metrics.record_route(
                 code, (time.monotonic() - t0) * 1000.0,
-                model=model if self._known_model(model) else None)
+                model=model if self._known_model(model) else None,
+                trace_id=trace.current_trace_id())
 
     def _session_home(self, model, sid):
         with self._session_lock:
@@ -428,7 +476,8 @@ class FleetRouter:
         finally:
             self.metrics.record_route(
                 code, (time.monotonic() - t0) * 1000.0,
-                model=model if self._known_model(model) else None)
+                model=model if self._known_model(model) else None,
+                trace_id=trace.current_trace_id())
 
     def _session_step(self, model, sid, inputs, steps, deadline_ms,
                       on_chunk):
@@ -472,10 +521,15 @@ class FleetRouter:
         last = None
         for attempt in range(attempts):
             try:
-                return "ok", r.session_step(model, sid, inputs,
-                                            steps=steps,
-                                            deadline_ms=deadline,
-                                            on_chunk=on_chunk)
+                # each owner-retry attempt is its own span, typed
+                # outcome and all — the session failover contract made
+                # visible per attempt
+                with trace.span("router.session_hop", replica=r.rid,
+                                model=model, sid=sid, attempt=attempt):
+                    return "ok", r.session_step(model, sid, inputs,
+                                                steps=steps,
+                                                deadline_ms=deadline,
+                                                on_chunk=on_chunk)
             except (QueueFullError, DeadlineExceeded):
                 raise              # overload/deadline: surface as-is
             except ShuttingDown as e:
@@ -520,6 +574,8 @@ class FleetRouter:
                 last = e
                 continue
             self.metrics.record_migration()
+            trace.add_event("router.session_migrated", sid=sid,
+                            to_replica=r2.rid)
             with self._session_lock:
                 self._session_homes[sid] = (model, r2.rid)
             # the post-adoption step gets the same transient-fault
@@ -581,6 +637,9 @@ class FleetRouter:
             # pin the PR 8 shape never see the key without a control
             # plane attached
             body["autoscale"] = self.autoscaler.describe()
+        if trace.active():
+            # same additive discipline for request-scoped tracing
+            body["trace"] = trace.health_block()
         return (200 if ready else 503), body
 
     def describe(self):
@@ -602,6 +661,8 @@ class FleetRouter:
         }
         if self.autoscaler is not None:
             out["autoscale"] = self.autoscaler.describe()
+        if trace.active():
+            out["trace"] = trace.health_block()
         return out
 
     # -- HTTP front end -----------------------------------------------
@@ -644,6 +705,8 @@ class _RouterHandler(JSONRequestHandler):
         if path == "/metrics":
             return self._send(200, self.app.metrics.render().encode(),
                               content_type="text/plain; version=0.0.4")
+        if path == "/v1/trace":
+            return self._trace_dump("router")
         self._send(404, {"error": "NotFound", "message": path})
 
     def do_POST(self):
@@ -702,39 +765,67 @@ class _RouterHandler(JSONRequestHandler):
                              "message": str(e)})
 
     def _predict(self, name):
+        # the trace is born at the fleet's front door (or adopted from
+        # the client's own header): every hop, hedge and failover below
+        # parents onto this span, and the header echo hands the id
+        # back to the client for /v1/trace
+        tspan = trace.from_header(self.headers.get(trace.HEADER),
+                                  "router.request", model=name)
+
         def fn():
-            specs = self.app.model_meta(name)
-            body = self._body()
-            if "inputs" not in body or not isinstance(body["inputs"],
-                                                      list):
-                raise BadRequest('body needs "inputs": [tensor, ...]')
-            if len(body["inputs"]) != len(specs):
-                raise BadRequest(
-                    f"model {name!r} takes {len(specs)} inputs, got "
-                    f"{len(body['inputs'])}")
-            try:
-                arrs = tuple(onp.asarray(x, dtype=spec["dtype"])
-                             for x, spec in zip(body["inputs"], specs))
-            except (TypeError, ValueError) as e:
-                raise BadRequest(f"malformed input tensor: {e}")
-            for a, spec in zip(arrs, specs):
-                want = tuple(spec["shape"][1:])
-                if tuple(a.shape) != want:
-                    raise BadRequest(
-                        f"instance shape {tuple(a.shape)} != exported "
-                        f"instance shape {want}")
-            outputs, timing = self.app.route(
-                name, arrs, deadline_ms=body.get("timeout_ms"),
-                inputs_json=json.dumps(body["inputs"]),
-                live=lambda: not self._client_gone())
+            with trace.activate(tspan):
+                # parse/validate is its own span: the no-dark-latency
+                # budget (queue + batch + execute + hops accounted)
+                # includes the front end's own body handling
+                with trace.span("router.parse", model=name):
+                    specs = self.app.model_meta(name)
+                    body = self._body()
+                    if "inputs" not in body or not isinstance(
+                            body["inputs"], list):
+                        raise BadRequest(
+                            'body needs "inputs": [tensor, ...]')
+                    if len(body["inputs"]) != len(specs):
+                        raise BadRequest(
+                            f"model {name!r} takes {len(specs)} "
+                            f"inputs, got {len(body['inputs'])}")
+                    try:
+                        arrs = tuple(
+                            onp.asarray(x, dtype=spec["dtype"])
+                            for x, spec in zip(body["inputs"], specs))
+                    except (TypeError, ValueError) as e:
+                        raise BadRequest(
+                            f"malformed input tensor: {e}")
+                    for a, spec in zip(arrs, specs):
+                        want = tuple(spec["shape"][1:])
+                        if tuple(a.shape) != want:
+                            raise BadRequest(
+                                f"instance shape {tuple(a.shape)} != "
+                                f"exported instance shape {want}")
+                outputs, timing = self.app.route(
+                    name, arrs, deadline_ms=body.get("timeout_ms"),
+                    inputs_json=json.dumps(body["inputs"]),
+                    live=lambda: not self._client_gone())
+            if tspan is not None:
+                tspan.set(code=200)
+                tspan.finish()
             self._send(200, {
                 "outputs": [o if isinstance(o, list)
                             else onp.asarray(o).tolist()
                             for o in outputs],
                 "timing": {k: round(v, 3)
                            for k, v in (timing or {}).items()
-                           if v is not None}})
-        self._guarded(fn)
+                           if v is not None}},
+                extra_headers={trace.HEADER: trace.header_value(tspan)}
+                if tspan is not None else None)
+
+        try:
+            self._guarded(fn)
+        finally:
+            # error paths were answered by _guarded; the span closes
+            # with a generic error outcome (the failing hop span below
+            # it carries the typed one)
+            if tspan is not None and not tspan.done:
+                tspan.finish(outcome="error")
 
     def _reload(self, name):
         def fn():

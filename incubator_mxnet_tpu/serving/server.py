@@ -33,7 +33,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as onp
 
 from ..base import get_env
-from .. import fault
+from .. import fault, trace
 from ..error import SessionExpiredError, SessionLostError
 from .admission import (Admission, BadRequest, ClientDisconnected,
                         ServingError, retry_after_s)
@@ -96,6 +96,11 @@ def health_body(repository, t_start=None, sessions=None):
         body["sessions"] = sessions.describe()
         body["queue_depth"] += sum(
             d["queue_depth"] for d in body["sessions"].values())
+    # request-scoped tracing rides along additively too: the key only
+    # appears while tracing is observably on (sampling enabled or
+    # spans recorded), so bare deployments keep their pinned shape
+    if trace.active():
+        body["trace"] = trace.health_block()
     return (503 if draining else 200), body
 
 
@@ -144,6 +149,24 @@ class JSONRequestHandler(BaseHTTPRequestHandler):
             return json.loads(self.rfile.read(length) or b"{}")
         except (ValueError, UnicodeDecodeError) as e:
             raise BadRequest(f"request body is not JSON: {e}")
+
+    def _query(self):
+        """Query-string params of the current request (stdlib-only,
+        no cgi): ``?a=b&c=d`` → ``{"a": "b", "c": "d"}``."""
+        qs = self.path.partition("?")[2]
+        out = {}
+        for pair in qs.split("&"):
+            k, sep, v = pair.partition("=")
+            if sep and k:
+                out[k] = v
+        return out
+
+    def _trace_dump(self, service):
+        """``GET /v1/trace[?trace_id=...]`` — this process's span ring
+        as Chrome trace-event JSON (tools/traceview.py merges several
+        of these into one cross-process timeline)."""
+        tid = self._query().get("trace_id") or None
+        self._send(200, trace.export(tid, service=service))
 
     @staticmethod
     def parse_session_path(path):
@@ -240,6 +263,8 @@ class _Handler(JSONRequestHandler):
                               content_type="text/plain; version=0.0.4")
         if path == "/v1/models":
             return self._send(200, {"models": self.app.repository.models()})
+        if path == "/v1/trace":
+            return self._trace_dump("server")
         self._send(404, {"error": "NotFound", "message": path})
 
     def do_POST(self):
@@ -274,48 +299,15 @@ class _Handler(JSONRequestHandler):
     def _predict(self, name):
         t0 = time.monotonic()
         code, timing, payload, hdrs = 500, {}, None, None
+        # born here, or adopted from the router's hop span via the
+        # X-MXNET-TRACE header (garbled → ignored, absent → the local
+        # head-sampling decision).  None for unsampled requests — the
+        # whole per-request cost of tracing-off is this one call.
+        tspan = trace.from_header(self.headers.get(trace.HEADER),
+                                  "server.request", model=name)
         try:
-            # resolve the model FIRST: every later error (400/5xx) is
-            # then attributed to a registry-backed name, so arbitrary
-            # client-supplied names cannot grow the metrics registry
-            entry = self.app.repository.get(name)
-            body = self._body()
-            if "inputs" not in body or not isinstance(body["inputs"],
-                                                      list):
-                raise BadRequest('body needs "inputs": [tensor, ...]')
-            specs = entry.predictor.meta["inputs"]
-            if len(body["inputs"]) != len(specs):
-                raise BadRequest(
-                    f"model {name!r} takes {len(specs)} inputs, got "
-                    f"{len(body['inputs'])}")
-            try:
-                arrs = tuple(
-                    onp.asarray(x, dtype=spec["dtype"])
-                    for x, spec in zip(body["inputs"], specs))
-            except (TypeError, ValueError) as e:
-                raise BadRequest(f"malformed input tensor: {e}")
-            for a, spec in zip(arrs, specs):
-                want = tuple(spec["shape"][1:])
-                if tuple(a.shape) != want:
-                    raise BadRequest(
-                        f"instance shape {tuple(a.shape)} != exported "
-                        f"instance shape {want}")
-            # async submit + disconnect-aware wait: a client that
-            # hangs up while its request is queued gets it CANCELLED
-            # (the flush worker drops the row before it costs device
-            # time) instead of computing into a dead socket
-            deadline = body.get("timeout_ms")
-            pending = self.app.repository.predict_async(
-                name, arrs, deadline)
-            out, timing = self._await_pending(pending, name, deadline)
-            import jax
-            outputs = [o.tolist()
-                       for o in jax.tree_util.tree_leaves(out)]
-            code = 200
-            payload = {"outputs": outputs,
-                       "timing": {k: round(v, 3)
-                                  for k, v in timing.items()
-                                  if v is not None}}
+            with trace.activate(tspan):
+                code, timing, payload = self._predict_inner(name)
         except ClientDisconnected:
             code = 499   # counted, never sent — the socket is gone
             payload = None
@@ -341,9 +333,66 @@ class _Handler(JSONRequestHandler):
             self.app.metrics.record_request(
                 name, code, e2e_ms=e2e,
                 compute_ms=timing.get("compute_ms"),
-                queue_ms=timing.get("queue_ms"))
+                queue_ms=timing.get("queue_ms"),
+                trace_id=tspan.trace_id if tspan is not None else None)
+        if tspan is not None:
+            tspan.set(code=code,
+                      queue_ms=timing.get("queue_ms"),
+                      compute_ms=timing.get("compute_ms"))
+            tspan.finish(
+                outcome="ok" if code == 200 else f"http_{code}")
+            # echo the id so the client (and the router's hop span)
+            # can fetch /v1/trace for exactly this request
+            hdrs = dict(hdrs or {})
+            hdrs[trace.HEADER] = trace.header_value(tspan)
         if payload is not None:
             self._send(code, payload, extra_headers=hdrs)
+
+    def _predict_inner(self, name):
+        """The predict body proper, run under the request's trace
+        context; returns ``(code, timing, payload)`` — errors
+        propagate to :meth:`_predict`'s HTTP mapping."""
+        # resolve the model FIRST: every later error (400/5xx) is
+        # then attributed to a registry-backed name, so arbitrary
+        # client-supplied names cannot grow the metrics registry
+        entry = self.app.repository.get(name)
+        body = self._body()
+        if "inputs" not in body or not isinstance(body["inputs"],
+                                                  list):
+            raise BadRequest('body needs "inputs": [tensor, ...]')
+        specs = entry.predictor.meta["inputs"]
+        if len(body["inputs"]) != len(specs):
+            raise BadRequest(
+                f"model {name!r} takes {len(specs)} inputs, got "
+                f"{len(body['inputs'])}")
+        try:
+            arrs = tuple(
+                onp.asarray(x, dtype=spec["dtype"])
+                for x, spec in zip(body["inputs"], specs))
+        except (TypeError, ValueError) as e:
+            raise BadRequest(f"malformed input tensor: {e}")
+        for a, spec in zip(arrs, specs):
+            want = tuple(spec["shape"][1:])
+            if tuple(a.shape) != want:
+                raise BadRequest(
+                    f"instance shape {tuple(a.shape)} != exported "
+                    f"instance shape {want}")
+        # async submit + disconnect-aware wait: a client that
+        # hangs up while its request is queued gets it CANCELLED
+        # (the flush worker drops the row before it costs device
+        # time) instead of computing into a dead socket
+        deadline = body.get("timeout_ms")
+        pending = self.app.repository.predict_async(
+            name, arrs, deadline)
+        out, timing = self._await_pending(pending, name, deadline)
+        import jax
+        outputs = [o.tolist()
+                   for o in jax.tree_util.tree_leaves(out)]
+        payload = {"outputs": outputs,
+                   "timing": {k: round(v, 3)
+                              for k, v in timing.items()
+                              if v is not None}}
+        return 200, timing, payload
 
     def _admin(self, name, fn):
         # errors attribute to the name only when it names a loaded
@@ -391,8 +440,11 @@ class _Handler(JSONRequestHandler):
         410 Gone (typed, terminal for that id — retrying can never
         succeed), overload/drain keep the live-derived Retry-After."""
         code = 500
+        tspan = trace.from_header(self.headers.get(trace.HEADER),
+                                  "server.session", model=model)
         try:
-            fn()
+            with trace.activate(tspan):
+                fn()
             code = 200
         except ClientDisconnected:
             code = 499               # counted, nothing sendable
@@ -414,6 +466,10 @@ class _Handler(JSONRequestHandler):
             code = 500
             self._send(500, {"error": type(e).__name__,
                              "message": str(e)})
+        if tspan is not None:
+            tspan.set(code=code)
+            tspan.finish(
+                outcome="ok" if code == 200 else f"http_{code}")
         if model in self.app.sessions.names():
             self.app.metrics.record_request(model, code)
 

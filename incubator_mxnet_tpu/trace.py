@@ -1,0 +1,501 @@
+"""Request-scoped distributed tracing: spans across router → replica →
+batcher → device (docs/observability.md).
+
+The stack has deep *aggregate* observability — Prometheus counters,
+latency histograms, a dozen profiler stats providers — but none of it
+answers "where did THIS slow request spend its time?".  This module is
+the request-scoped layer: a pure-stdlib, monotonic-clock span recorder
+with context propagation, near-zero off cost, and Chrome trace-event
+export, threaded through every stage a request crosses:
+
+* **Birth / adoption** — a trace is born at a front end (router or
+  server) by a head-sampling decision (``MXNET_TRACE_SAMPLE``, default
+  0 ⇒ the hot path pays one branch), or adopted from an
+  ``X-MXNET-TRACE`` header (``traceid-spanid-sampled``).  The header's
+  sampled flag is authoritative: an upstream "1" records even when
+  local sampling is off; a garbled header is ignored, never a 500.
+* **Propagation** — within a process the active span rides a
+  ``contextvars.ContextVar``; across process-replica HTTP hops it
+  rides the header (the hop span's id becomes the replica-side
+  parent).  A replica that predates the header simply records nothing
+  — the trace degrades to the router's single-process view.
+* **Storage** — a bounded per-process ring (``MXNET_TRACE_RING``
+  spans); overflow evicts oldest-first whole spans, counted, so a
+  wrapped ring can never splice spans from two different traces into
+  one record.
+* **Export** — Chrome trace-event JSON via :func:`export` (served at
+  ``GET /v1/trace`` on server and router), a ``trace`` profiler stats
+  provider, and ``tools/traceview.py`` which merges router + replica
+  dumps into one timeline by trace id.  Span timestamps are monotonic
+  (mxlint MX-TIME001); export places them on a shared timeline via
+  ONE wall-clock anchor captured per process.
+
+Span vocabulary (what the instrumented call sites record):
+``router.request`` / ``server.request`` roots; ``router.hop`` /
+``router.hedge`` per physical attempt (each retry and hedge is its own
+span, finishing with a typed ``outcome``); ``batch.queue`` /
+``batch.execute`` (admission wait vs device compute, with the chosen
+padding bucket); ``session.queue`` / ``session.decode_step``
+(continuous batching); ``executor.build`` vs ``trace_cache.hit``
+(compile-vs-cache on the Executor choke point); ``model.load``;
+``train.epoch`` / ``train.chunk`` / ``prefetch.fill`` /
+``prefetch.drain`` on the training side.  ``fault.py`` injections add
+a ``fault.<point>`` event to the active span, so a chaos-run artifact
+shows the injected fault and the recovery path in one timeline.
+"""
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import random
+import threading
+import time
+from collections import deque
+
+from .base import get_env
+
+__all__ = [
+    "HEADER", "Span", "enabled", "active", "sample_rate", "configure",
+    "reset", "start_trace", "start_child", "record_span", "from_header",
+    "parse_header", "header_value", "current_span", "current_trace_id",
+    "activate", "span", "add_event", "export", "spans", "stats",
+    "health_block", "slow_k",
+]
+
+#: The propagation header: ``traceid(16 hex)-spanid(8 hex)-sampled``.
+HEADER = "X-MXNET-TRACE"
+
+_HEX = set("0123456789abcdef")
+
+# ONE wall-clock anchor per process: every span timestamp is monotonic
+# (durations can never jump on an NTP step — the MX-TIME001 contract);
+# export maps them onto a shared cross-process timeline by adding the
+# delta-to-anchor to this single wall reading.
+_ANCHOR_WALL = time.time()  # mxlint: allow-wall-clock(single per-process anchor aligning monotonic span times across processes at export; all arithmetic stays monotonic)
+_ANCHOR_MONO = time.monotonic()
+
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "mxnet_trace_span", default=None)
+
+_lock = threading.Lock()
+_cfg = {"sample": None, "ring": None, "slow_k": None}  # None = env
+_rng = random.Random()
+_provider_registered = False
+
+
+def _new_id(nibbles):
+    return "%0*x" % (nibbles, _rng.getrandbits(4 * nibbles))
+
+
+class Span:
+    """One timed region of one trace.  Created by the helpers below;
+    recorded into the ring at :meth:`finish` (never before — a crashed
+    holder simply never lands, it cannot half-record)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "t0",
+                 "t1", "args", "events", "tid", "_done")
+
+    def __init__(self, name, trace_id, parent_id=None, t0=None,
+                 **args):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = _new_id(8)
+        self.parent_id = parent_id
+        self.t0 = time.monotonic() if t0 is None else float(t0)
+        self.t1 = None
+        self.args = dict(args)
+        self.events = []           # [(t_mono, name, args), ...]
+        self.tid = threading.get_ident()
+        self._done = False
+
+    def set(self, **args):
+        self.args.update(args)
+        return self
+
+    def event(self, name, **args):
+        """Timestamped instant event on this span (fault injections,
+        cache hits, failover notes)."""
+        self.events.append((time.monotonic(), name, args))
+
+    def child(self, name, **args):
+        return Span(name, self.trace_id, parent_id=self.span_id,
+                    **args)
+
+    def finish(self, outcome=None, t1=None):
+        """Close the span and push it into the ring.  Idempotent —
+        double-finish records once.  ``outcome`` defaults to ``"ok"``;
+        error paths pass the typed error's class name."""
+        if self._done:
+            return self
+        self._done = True
+        self.t1 = time.monotonic() if t1 is None else float(t1)
+        self.args.setdefault("outcome", outcome or "ok")
+        _ring().push(self)
+        return self
+
+    @property
+    def done(self):
+        return self._done
+
+    def duration_ms(self):
+        end = self.t1 if self.t1 is not None else time.monotonic()
+        return (end - self.t0) * 1000.0
+
+
+# ---------------------------------------------------------------------------
+# configuration + ring
+# ---------------------------------------------------------------------------
+
+def sample_rate():
+    s = _cfg["sample"]
+    if s is None:
+        s = _cfg["sample"] = get_env("MXNET_TRACE_SAMPLE", 0.0, float)
+    return s
+
+
+def ring_capacity():
+    n = _cfg["ring"]
+    if n is None:
+        n = _cfg["ring"] = max(
+            1, get_env("MXNET_TRACE_RING", 4096, int))
+    return n
+
+
+def slow_k():
+    """K for the slow-request exemplars the latency histograms keep
+    (metrics.py); lives here so one module owns the trace knobs."""
+    k = _cfg["slow_k"]
+    if k is None:
+        k = _cfg["slow_k"] = max(
+            0, get_env("MXNET_TRACE_SLOW_K", 4, int))
+    return k
+
+
+def enabled():
+    """Head sampling on (``MXNET_TRACE_SAMPLE`` > 0)."""
+    return sample_rate() > 0.0
+
+
+class _Ring:
+    """Bounded span store.  Eviction is whole-span oldest-first, so a
+    wrapped ring drops complete spans (counted) — it can never splice
+    two traces into one record."""
+
+    __slots__ = ("cap", "_d", "_lock", "pushed", "dropped")
+
+    def __init__(self, cap):
+        self.cap = int(cap)
+        self._d = deque()
+        self._lock = threading.Lock()
+        self.pushed = 0
+        self.dropped = 0
+
+    def push(self, span_obj):
+        with self._lock:
+            self.pushed += 1
+            self._d.append(span_obj)
+            while len(self._d) > self.cap:
+                self._d.popleft()
+                self.dropped += 1
+        _ensure_provider()
+
+    def snapshot(self, trace_id=None):
+        with self._lock:
+            out = list(self._d)
+        if trace_id is not None:
+            out = [s for s in out if s.trace_id == trace_id]
+        return out
+
+    def clear(self):
+        with self._lock:
+            self._d.clear()
+            self.pushed = 0
+            self.dropped = 0
+
+
+_ring_obj = None
+
+
+def _ring():
+    global _ring_obj
+    if _ring_obj is None:
+        with _lock:
+            if _ring_obj is None:
+                _ring_obj = _Ring(ring_capacity())
+    return _ring_obj
+
+
+def configure(sample=None, ring=None, slow=None):
+    """Programmatic override of the env knobs (tests, benches).  Any
+    argument left ``None`` keeps its current value; changing the ring
+    capacity re-allocates an empty ring."""
+    global _ring_obj
+    with _lock:
+        if sample is not None:
+            _cfg["sample"] = float(sample)
+        if slow is not None:
+            _cfg["slow_k"] = int(slow)
+        if ring is not None:
+            _cfg["ring"] = max(1, int(ring))
+            _ring_obj = _Ring(_cfg["ring"])
+    if sample is not None and sample > 0:
+        _ensure_provider()
+
+
+def reset():
+    """Forget overrides and recorded spans; next use re-reads the env
+    (test isolation)."""
+    global _ring_obj
+    with _lock:
+        _cfg["sample"] = None
+        _cfg["ring"] = None
+        _cfg["slow_k"] = None
+        _ring_obj = None
+
+
+def active():
+    """Tracing is observably on: sampling enabled, or spans already
+    recorded (an adopted forced-sample header counts).  Gates the
+    additive ``"trace"`` block in /healthz + describe()."""
+    return enabled() or (_ring_obj is not None and _ring_obj.pushed > 0)
+
+
+def _ensure_provider():
+    global _provider_registered
+    if _provider_registered:
+        return
+    _provider_registered = True
+    from . import profiler
+    profiler.register_stats_provider("trace", stats)
+
+
+# ---------------------------------------------------------------------------
+# creation + context propagation
+# ---------------------------------------------------------------------------
+
+def start_trace(name, **args):
+    """Head-sampled root span: returns a :class:`Span` or ``None``
+    (the per-request sampling branch — when ``MXNET_TRACE_SAMPLE`` is
+    0 this is one float compare)."""
+    rate = sample_rate()
+    if rate <= 0.0:
+        return None
+    if rate < 1.0 and _rng.random() >= rate:
+        return None
+    return Span(name, _new_id(16), **args)
+
+
+def start_child(name, parent=None, **args):
+    """Child span of ``parent`` (default: the context's current span);
+    ``None`` parent ⇒ ``None`` (unsampled requests stay free)."""
+    p = parent if parent is not None else _current.get()
+    if p is None:
+        return None
+    return p.child(name, **args)
+
+
+def record_span(name, parent, t0, t1, **args):
+    """Create AND finish a child span with explicit monotonic
+    timestamps — for recorders that learn about a region after the
+    fact (the batcher's queue-wait split)."""
+    if parent is None:
+        return None
+    s = parent.child(name, t0=t0, **args)
+    return s.finish(t1=t1)
+
+
+def current_span():
+    return _current.get()
+
+
+def current_trace_id():
+    s = _current.get()
+    return s.trace_id if s is not None else None
+
+
+class activate:
+    """``with trace.activate(span):`` — install ``span`` as the
+    context's current span (``None`` ⇒ no-op passthrough, so callers
+    need no branch)."""
+
+    __slots__ = ("_span", "_token")
+
+    def __init__(self, span_obj):
+        self._span = span_obj
+        self._token = None
+
+    def __enter__(self):
+        if self._span is not None:
+            self._token = _current.set(self._span)
+        return self._span
+
+    def __exit__(self, *exc):
+        if self._token is not None:
+            _current.reset(self._token)
+        return False
+
+
+class span:
+    """``with trace.span("router.hop", replica=rid):`` — child of the
+    current span, activated for the body, finished on exit with
+    ``outcome`` = the escaping exception's class name (or "ok").
+    No current span ⇒ complete no-op."""
+
+    __slots__ = ("_name", "_args", "_span", "_token")
+
+    def __init__(self, name, **args):
+        self._name = name
+        self._args = args
+        self._span = None
+        self._token = None
+
+    def __enter__(self):
+        parent = _current.get()
+        if parent is not None:
+            self._span = parent.child(self._name, **self._args)
+            self._token = _current.set(self._span)
+        return self._span
+
+    def __exit__(self, etype, evalue, tb):
+        if self._token is not None:
+            _current.reset(self._token)
+        if self._span is not None:
+            self._span.finish(
+                outcome=etype.__name__ if etype is not None else None)
+        return False
+
+
+def add_event(name, **args):
+    """Instant event on the active span, if any — the hook fault.py
+    fires on every injection (one contextvar read when untraced)."""
+    s = _current.get()
+    if s is not None:
+        s.event(name, **args)
+
+
+# ---------------------------------------------------------------------------
+# header propagation
+# ---------------------------------------------------------------------------
+
+def parse_header(text):
+    """``traceid-spanid-sampled`` → ``(trace_id, span_id, sampled)``;
+    ``None`` for anything malformed (a garbled header is ignored, not
+    an error — the request must still serve)."""
+    if not text or not isinstance(text, str):
+        return None
+    parts = text.strip().lower().split("-")
+    if len(parts) != 3:
+        return None
+    tid, sid, flag = parts
+    if len(tid) != 16 or not set(tid) <= _HEX:
+        return None
+    if len(sid) != 8 or not set(sid) <= _HEX:
+        return None
+    if flag not in ("0", "1"):
+        return None
+    return tid, sid, flag == "1"
+
+
+def header_value(span_obj):
+    """The ``X-MXNET-TRACE`` value carrying ``span_obj`` downstream
+    (its id becomes the callee-side parent); ``None`` span ⇒ ``None``
+    (caller sends no header)."""
+    if span_obj is None:
+        return None
+    return f"{span_obj.trace_id}-{span_obj.span_id}-1"
+
+
+def from_header(text, name, **args):
+    """Adopt a propagated trace, or fall back to the local sampling
+    decision.  A valid header is AUTHORITATIVE either way: sampled=1
+    records regardless of local sampling (the head decision was
+    upstream's), sampled=0 suppresses recording entirely (the
+    upstream already decided not to trace this request); only a
+    garbled/absent header degrades to :func:`start_trace`."""
+    parsed = parse_header(text)
+    if parsed is None:
+        return start_trace(name, **args)
+    tid, parent_sid, sampled = parsed
+    if not sampled:
+        return None
+    s = Span(name, tid, parent_id=parent_sid, **args)
+    s.args["adopted"] = True
+    return s
+
+
+# ---------------------------------------------------------------------------
+# export + stats
+# ---------------------------------------------------------------------------
+
+def _wall_us(t_mono):
+    return int((_ANCHOR_WALL + (t_mono - _ANCHOR_MONO)) * 1e6)
+
+
+def spans(trace_id=None):
+    """Recorded spans, newest last (optionally one trace's)."""
+    return _ring().snapshot(trace_id)
+
+
+def export(trace_id=None, service=None):
+    """Chrome trace-event JSON (``chrome://tracing`` /
+    ``ui.perfetto.dev`` loadable): one ``ph:"X"`` complete event per
+    span, one ``ph:"i"`` instant per span event.  ``service`` labels
+    the process (router/replica) for merged views."""
+    pid = os.getpid()
+    svc = service or f"pid:{pid}"
+    events = []
+    for s in _ring().snapshot(trace_id):
+        t1 = s.t1 if s.t1 is not None else s.t0
+        args = dict(s.args)
+        args.update(trace_id=s.trace_id, span_id=s.span_id,
+                    parent_id=s.parent_id, service=svc)
+        events.append({
+            "name": s.name, "cat": "trace", "ph": "X",
+            "ts": _wall_us(s.t0),
+            "dur": max(0, _wall_us(t1) - _wall_us(s.t0)),
+            "pid": pid, "tid": s.tid, "args": args,
+        })
+        for t_ev, ev_name, ev_args in s.events:
+            ia = dict(ev_args)
+            ia.update(trace_id=s.trace_id, span_id=s.span_id,
+                      service=svc)
+            events.append({
+                "name": ev_name, "cat": "trace_event", "ph": "i",
+                "ts": _wall_us(t_ev), "s": "t",
+                "pid": pid, "tid": s.tid, "args": ia,
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_json(trace_id=None, service=None):
+    return json.dumps(export(trace_id, service))
+
+
+def stats():
+    """The ``trace`` profiler stats provider."""
+    r = _ring()
+    with r._lock:
+        in_ring = len(r._d)
+        pushed, dropped = r.pushed, r.dropped
+        traces = len({s.trace_id for s in r._d})
+    return {
+        "enabled": enabled(),
+        "sample": sample_rate(),
+        "ring_capacity": r.cap,
+        "spans_recorded": pushed,
+        "spans_dropped": dropped,
+        "spans_in_ring": in_ring,
+        "traces_in_ring": traces,
+        "slow_k": slow_k(),
+    }
+
+
+def health_block():
+    """The additive ``"trace"`` block for /healthz + describe() —
+    present only while :func:`active` (bare deployments keep their
+    pinned shape)."""
+    st = stats()
+    return {"sample": st["sample"], "ring": st["ring_capacity"],
+            "spans": st["spans_recorded"],
+            "dropped": st["spans_dropped"], "slow_k": st["slow_k"]}

@@ -498,6 +498,20 @@ def test_healthz_structured_state_json_shape(artifact):
         code4, b4 = health_body(repo, time.monotonic())
         assert code4 == 503 and b4["status"] == "draining"
         assert b4["models"]["mlp"]["state"] == "draining"
+        # request-scoped tracing is ADDITIVE: the "trace" block
+        # appears only while tracing is on (everything pinned above
+        # ran with it off — the bare-server shape), with this exact
+        # subshape (docs/observability.md)
+        from incubator_mxnet_tpu import trace
+        try:
+            trace.configure(sample=1.0)
+            _, b5 = health_body(repo, time.monotonic())
+            assert set(b5) == {"status", "uptime_s", "queue_depth",
+                               "models", "trace"}
+            assert set(b5["trace"]) == {"sample", "ring", "spans",
+                                        "dropped", "slow_k"}
+        finally:
+            trace.reset()
     finally:
         repo.drain_all()
 

@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""traceview: merge per-process trace dumps into one request timeline.
+
+Each serving process (router, every replica) exposes its own span ring
+as Chrome trace-event JSON at ``GET /v1/trace`` (``incubator_mxnet_tpu/
+trace.py``).  One request's spans are therefore scattered across
+several processes; this tool merges any number of dumps — files or
+``http://`` URLs — keys them by trace id, and renders one indented
+timeline per trace: offsets, durations, typed outcomes, and instant
+events (fault injections, hedge launches, cache hits) in tree order.
+
+Stdlib-only and jax-free (usable on a laptop against a remote fleet's
+dumps).  The merged view works because every process exports span
+times on a shared wall-anchored timeline (one anchor per process);
+clock skew between hosts shows up as offset, never as reordering
+within a process.
+
+Usage::
+
+    python tools/traceview.py router.json replica0.json replica1.json
+    python tools/traceview.py http://127.0.0.1:8080/v1/trace \
+        --trace 3f2a...  --coverage
+    python tools/traceview.py dumps/*.json --json merged.json
+    python tools/traceview.py --stats profile.json   # provider stats
+                                                     # from
+                                                     # profiler.dumps(
+                                                     #   format="json")
+
+``--coverage`` prints, per trace, the fraction of the root span's wall
+time covered by the union of its descendant spans — the "no dark
+latency" number the trace CI gate enforces (a request whose spans
+account for < 95% of its wall time has an uninstrumented stage).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_events(source):
+    """One dump — a file path or an http(s) URL — → its traceEvents."""
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+        with urllib.request.urlopen(source, timeout=30) as resp:
+            payload = json.loads(resp.read())
+    else:
+        with open(source) as f:
+            payload = json.load(f)
+    if isinstance(payload, dict):
+        return list(payload.get("traceEvents", []))
+    return list(payload)   # a bare event list is accepted too
+
+
+def merge(sources):
+    events = []
+    for src in sources:
+        events.extend(load_events(src))
+    events.sort(key=lambda e: e.get("ts", 0))
+    return events
+
+
+def by_trace(events):
+    """{trace_id: [events]} — events without a trace_id are dropped
+    (other profiler output may share a dump file)."""
+    out = {}
+    for e in events:
+        tid = (e.get("args") or {}).get("trace_id")
+        if tid:
+            out.setdefault(tid, []).append(e)
+    return out
+
+
+def _spans_and_instants(events):
+    spans = [e for e in events if e.get("ph") == "X"]
+    instants = {}
+    for e in events:
+        if e.get("ph") == "i":
+            sid = (e.get("args") or {}).get("span_id")
+            instants.setdefault(sid, []).append(e)
+    return spans, instants
+
+
+def _roots_and_children(spans):
+    ids = {(s["args"].get("span_id")) for s in spans}
+    children = {}
+    roots = []
+    for s in spans:
+        parent = s["args"].get("parent_id")
+        if parent in ids:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    for sibs in children.values():
+        sibs.sort(key=lambda e: e["ts"])
+    roots.sort(key=lambda e: e["ts"])
+    return roots, children
+
+
+_ARG_SKIP = {"trace_id", "span_id", "parent_id", "service", "outcome"}
+
+
+def _fmt_args(args):
+    keep = {k: v for k, v in args.items()
+            if k not in _ARG_SKIP and v is not None}
+    if not keep:
+        return ""
+    return " " + " ".join(f"{k}={v}" for k, v in sorted(keep.items()))
+
+
+def render(trace_id, events, out=sys.stdout):
+    """One indented timeline for one trace, all processes merged."""
+    spans, instants = _spans_and_instants(events)
+    if not spans:
+        print(f"trace {trace_id}: no spans", file=out)
+        return
+    roots, children = _roots_and_children(spans)
+    t0 = min(s["ts"] for s in spans)
+    print(f"trace {trace_id} "
+          f"({len(spans)} span(s), "
+          f"{len({s['args'].get('service') for s in spans})} "
+          f"process(es))", file=out)
+
+    def walk(s, depth):
+        off_ms = (s["ts"] - t0) / 1000.0
+        dur_ms = s.get("dur", 0) / 1000.0
+        outcome = s["args"].get("outcome", "ok")
+        svc = s["args"].get("service", "?")
+        mark = "" if outcome == "ok" else f"  !! {outcome}"
+        print(f"  {'  ' * depth}+{off_ms:9.3f}ms "
+              f"{dur_ms:9.3f}ms  {s['name']}  [{svc}]"
+              f"{_fmt_args(s['args'])}{mark}", file=out)
+        for ev in instants.get(s["args"].get("span_id"), []):
+            ev_off = (ev["ts"] - t0) / 1000.0
+            print(f"  {'  ' * (depth + 1)}@{ev_off:9.3f}ms "
+                  f"           * {ev['name']}"
+                  f"{_fmt_args(ev.get('args') or {})}", file=out)
+        for c in children.get(s["args"].get("span_id"), []):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+
+
+def coverage(events):
+    """Fraction of the (longest) root span's duration covered by the
+    union of its descendant spans — "no dark latency" when close to
+    1.  Descendants are clipped to the root's interval; gaps between
+    them are exactly the unattributed time."""
+    spans, _ = _spans_and_instants(events)
+    if not spans:
+        return 0.0
+    roots, children = _roots_and_children(spans)
+    root = max(roots, key=lambda s: s.get("dur", 0))
+    r0, r1 = root["ts"], root["ts"] + root.get("dur", 0)
+    if r1 <= r0:
+        return 0.0
+    intervals = []
+
+    def collect(span_id):
+        for c in children.get(span_id, []):
+            a = max(r0, c["ts"])
+            b = min(r1, c["ts"] + c.get("dur", 0))
+            if b > a:
+                intervals.append((a, b))
+            collect(c["args"].get("span_id"))
+
+    collect(root["args"].get("span_id"))
+    intervals.sort()
+    covered = 0
+    cur_a = cur_b = None
+    for a, b in intervals:
+        if cur_b is None or a > cur_b:
+            if cur_b is not None:
+                covered += cur_b - cur_a
+            cur_a, cur_b = a, b
+        else:
+            cur_b = max(cur_b, b)
+    if cur_b is not None:
+        covered += cur_b - cur_a
+    return covered / (r1 - r0)
+
+
+def show_stats(path, out=sys.stdout):
+    """Pretty-print the provider sections of a machine-readable
+    ``profiler.dumps(format="json")`` dump (the trace provider first
+    — this tool's natural companion)."""
+    with open(path) as f:
+        payload = json.load(f)
+    providers = payload.get("providers", payload)
+    order = sorted(providers,
+                   key=lambda name: (name != "trace", name))
+    for name in order:
+        print(f"[{name}]", file=out)
+        stats = providers[name]
+        if isinstance(stats, dict):
+            for k, v in sorted(stats.items()):
+                print(f"  {k} = {v}", file=out)
+        else:
+            print(f"  {stats}", file=out)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="merge + render mxnet-tpu trace dumps")
+    p.add_argument("sources", nargs="*",
+                   help="trace dumps: files or /v1/trace URLs")
+    p.add_argument("--trace", default=None, metavar="ID",
+                   help="render only this trace id")
+    p.add_argument("--json", default=None, metavar="OUT",
+                   help="also write the merged Chrome trace JSON")
+    p.add_argument("--coverage", action="store_true",
+                   help="print per-trace root-span coverage fraction")
+    p.add_argument("--min-coverage", type=float, default=None,
+                   metavar="F", help="exit 1 if any rendered trace "
+                   "covers less than F of its root span (CI gate)")
+    p.add_argument("--stats", default=None, metavar="FILE",
+                   help="pretty-print a profiler.dumps(format='json') "
+                        "file instead of rendering traces")
+    args = p.parse_args(argv)
+
+    if args.stats:
+        show_stats(args.stats)
+        return 0
+    if not args.sources:
+        p.error("need at least one dump file/URL (or --stats)")
+    events = merge(args.sources)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+    traces = by_trace(events)
+    if args.trace:
+        traces = {tid: evs for tid, evs in traces.items()
+                  if tid == args.trace}
+        if not traces:
+            print(f"trace {args.trace!r} not found "
+                  f"({len(by_trace(events))} trace(s) in the dumps)",
+                  file=sys.stderr)
+            return 1
+    failed = False
+    for tid in sorted(traces):
+        render(tid, traces[tid])
+        if args.coverage or args.min_coverage is not None:
+            cov = coverage(traces[tid])
+            print(f"  coverage: {cov:.1%} of root span accounted")
+            if args.min_coverage is not None \
+                    and cov < args.min_coverage:
+                print(f"  FAIL: below --min-coverage "
+                      f"{args.min_coverage:.0%}", file=sys.stderr)
+                failed = True
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
