@@ -349,30 +349,26 @@ def fleet_bench(args):
     return rec, failures
 
 
-def trace_overhead(args):
-    """Tracing overhead gate (docs/observability.md): the router path
-    volleyed three times — tracing OFF, head-sampled at 1.0, OFF
-    again.  The off/off spread is the measurement noise band; the
-    sampled run reports the full-tracing cost and must stay bitwise
-    equal to the unbatched baseline.  The off-path per-call cost of
-    the tracing hooks (one branch + one contextvar read) is measured
-    directly — THAT is the "within noise of the pre-PR baseline"
-    contract made checkable: with sampling off the only new code on
-    the hot path is the measured hook."""
-    from incubator_mxnet_tpu import deploy, trace
+def _overhead_rig(args, prefix_name, seed):
+    """Shared rig for the trace/flight overhead gates: toy artifact,
+    1-replica thread fleet behind a router, a closed-loop volley
+    closure, and the bitwise-parity checker — ONE harness, so a fix
+    to the volley/parity machinery cannot diverge between the two
+    gates.  Returns ``(router, volley, parity_of, total)``; the caller
+    owns ``router.shutdown()``."""
+    from incubator_mxnet_tpu import deploy
     from incubator_mxnet_tpu.serving import FleetRouter, ReplicaFleet
 
-    prefix = os.path.join(args.workdir, "serving_trace_model")
+    prefix = os.path.join(args.workdir, prefix_name)
     _toy_artifact(prefix)
     pred = deploy.load_predictor(prefix)
-    instances = _instances(pred.meta, args.requests, seed=5)
+    instances = _instances(pred.meta, args.requests, seed=seed)
     refs = [pred(*[x[None] for x in inst]) for inst in instances]
     total = args.requests * args.rounds
 
     fleet = ReplicaFleet({"bench": prefix}, n=1, backend="thread",
                          probe_ms=60000.0).spawn()
     router = FleetRouter(fleet)
-    import jax
 
     def volley():
         results = [None] * args.requests
@@ -404,6 +400,36 @@ def trace_overhead(args):
         rps = total / (time.monotonic() - t0)
         return rps, results, errors
 
+    def parity_of(results):
+        import jax
+        ok = True
+        for i in range(args.requests):
+            if results[i] is None:
+                continue
+            for a, b in zip(results[i],
+                            jax.tree_util.tree_leaves(refs[i])):
+                got = onp.asarray(a, dtype=onp.asarray(b).dtype)
+                if not (got == onp.asarray(b)[0]).all():
+                    ok = False
+        return ok
+
+    return router, volley, parity_of, total
+
+
+def trace_overhead(args):
+    """Tracing overhead gate (docs/observability.md): the router path
+    volleyed three times — tracing OFF, head-sampled at 1.0, OFF
+    again.  The off/off spread is the measurement noise band; the
+    sampled run reports the full-tracing cost and must stay bitwise
+    equal to the unbatched baseline.  The off-path per-call cost of
+    the tracing hooks (one branch + one contextvar read) is measured
+    directly — THAT is the "within noise of the pre-PR baseline"
+    contract made checkable: with sampling off the only new code on
+    the hot path is the measured hook."""
+    from incubator_mxnet_tpu import trace
+
+    router, volley, parity_of, total = _overhead_rig(
+        args, "serving_trace_model", seed=5)
     failures = []
     try:
         volley()                       # warm the route path off-clock
@@ -417,15 +443,7 @@ def trace_overhead(args):
         if err1 or err2 or err3:
             failures.append(f"failed requests: "
                             f"{(err1 + err2 + err3)[:1]}")
-        parity = True
-        for i in range(args.requests):
-            if on_results[i] is None:
-                continue
-            for a, b in zip(on_results[i],
-                            jax.tree_util.tree_leaves(refs[i])):
-                got = onp.asarray(a, dtype=onp.asarray(b).dtype)
-                if not (got == onp.asarray(b)[0]).all():
-                    parity = False
+        parity = parity_of(on_results)
     finally:
         trace.reset()
         router.shutdown()
@@ -473,6 +491,87 @@ def trace_overhead(args):
             failures.append(
                 f"sampled-at-1.0 overhead "
                 f"{rec['sampled_overhead_pct']}% > 25%")
+    return rec, failures
+
+
+def flight_overhead(args):
+    """Flight-recorder overhead gate (docs/observability.md "Flight
+    recorder"): the router path volleyed ring-off / ring-on (the
+    always-on default) / ring-off.  The off/off spread is the noise
+    band; ring-on must sit inside it — a HEALTHY request appends
+    nothing to the ring, so the only per-request cost is the emitters'
+    enabled checks.  The emit cost itself (what a quarantine or
+    failover pays) is microbenched directly and gated < 2 µs."""
+    from incubator_mxnet_tpu import flightrec
+
+    router, volley, parity_of, total = _overhead_rig(
+        args, "serving_flight_model", seed=9)
+    failures = []
+    try:
+        volley()                       # warm the route path off-clock
+        flightrec.configure(ring=0)
+        off1, _res, err1 = volley()
+        flightrec.configure(ring=4096)
+        on_rps, on_results, err2 = volley()
+        on_events = flightrec.stats()["events_recorded"]
+        flightrec.configure(ring=0)
+        off2, _res, err3 = volley()
+        if err1 or err2 or err3:
+            failures.append(f"failed requests: "
+                            f"{(err1 + err2 + err3)[:1]}")
+        parity = parity_of(on_results)
+        # the emit cost: what one operationally-interesting event (a
+        # quarantine, a failover, a scale decision) pays to land in
+        # the ring — the ONLY hot-path-adjacent cost of the recorder
+        flightrec.configure(ring=4096)
+        n = 200_000
+        t0 = time.monotonic()
+        for k in range(n):
+            flightrec.record("health", "bench.emit", i=k)
+        emit_ns = (time.monotonic() - t0) / n * 1e9
+        # and the disabled-path cost (ring=0): one cached int compare
+        flightrec.configure(ring=0)
+        t0 = time.monotonic()
+        for k in range(n):
+            flightrec.record("health", "bench.emit", i=k)
+        disabled_ns = (time.monotonic() - t0) / n * 1e9
+    finally:
+        flightrec.reset()
+        router.shutdown()
+
+    off_best = max(off1, off2)
+    rec = {
+        "metric": "serving_flight_overhead",
+        "value": round(off_best, 2),
+        "unit": "req/s",
+        "flight_off_rps": round(off_best, 2),
+        "flight_off_noise_pct": round(
+            abs(off1 - off2) / off_best * 100.0, 2),
+        "flight_on_rps": round(on_rps, 2),
+        "flight_on_overhead_pct": round(
+            (1.0 - on_rps / off_best) * 100.0, 2),
+        "flight_on_events": on_events,
+        "emit_ns_per_event": round(emit_ns, 1),
+        "disabled_ns_per_call": round(disabled_ns, 1),
+        "bitwise_equal_with_flight": bool(parity),
+        "requests_per_volley": total,
+        "platform": os.environ.get("JAX_PLATFORMS", "tpu"),
+    }
+    if args.check:
+        if not parity:
+            failures.append("outputs with flight recording on != "
+                            "unbatched baseline")
+        if emit_ns > 2000:
+            failures.append(
+                f"emitter cost {emit_ns:.0f}ns > 2µs")
+        # a healthy volley appends nothing: ring-on must be flat
+        # within the measurement noise (generous floor — CPU CI boxes
+        # jitter more than the recorder costs)
+        band = max(3.0 * rec["flight_off_noise_pct"], 10.0)
+        if rec["flight_on_overhead_pct"] > band:
+            failures.append(
+                f"flight-on overhead {rec['flight_on_overhead_pct']}% "
+                f"outside the noise band ({band:.1f}%)")
     return rec, failures
 
 
@@ -623,6 +722,10 @@ def main(argv=None):
                    help="tracing overhead gate: off/sampled/off "
                         "router volleys + off-path hook microbench "
                         "(docs/observability.md)")
+    p.add_argument("--flight-check", action="store_true",
+                   help="flight-recorder overhead gate: ring-off/"
+                        "ring-on/ring-off router volleys + emitter "
+                        "microbench (docs/observability.md)")
     p.add_argument("--backend", choices=("thread", "process"),
                    default="process",
                    help="replica backend for --replicas mode")
@@ -632,6 +735,8 @@ def main(argv=None):
     failures = []
     if args.trace_check:
         rec, failures = trace_overhead(args)
+    elif args.flight_check:
+        rec, failures = flight_overhead(args)
     elif args.replicas:
         rec, failures = fleet_bench(args)
     elif args.smoke:

@@ -71,6 +71,18 @@ Stages (each isolated, failures collected, nonzero exit if any fail):
              AOT path, and total replica-seconds strictly below the
              equivalent static fleet's
 
+  flight     always-on flight recorder sweep (docs/observability.md
+             "Flight recorder"): tests/test_flightrec.py under a
+             pinned seeded spec — ring semantics, crash-dump safety
+             (write failures swallowed+counted, never masking the
+             typed error), SIGUSR2 wedge dumps, per-subsystem
+             emitters, the SIGKILL-a-replica postmortem
+             reconstruction gated by tools/postmortem.py --gate —
+             with full pytest output teed to .ci_flight_stage.log;
+             then serving_bench --flight-check (ring-on vs ring-off
+             router volley flat within noise, emitter microbench
+             < 2 µs, bitwise parity)
+
   trace      request-scoped tracing sweep (docs/observability.md):
              tests/test_trace.py under a pinned seeded spec — span
              recorder semantics, header-propagation edge cases, ring
@@ -410,6 +422,60 @@ def stage_autoscale(args):
                   f"compiles {rec['compile_total']}")
 
 
+# Pinned flight-chaos spec: jittered routing hops, lost probes and
+# dropped scale decisions — the control-plane paths whose events the
+# flight assertions pin must hold WITH chaos landing in the same ring.
+# Seeded like every other spec so a failure replays from the string.
+FLIGHT_SPEC = ("serving.route:delay:ms=1:p=0.2:seed=3,"
+               "serving.probe:error:p=0.1:seed=5,"
+               "serving.scale:error:p=0.1:seed=31")
+
+
+def stage_flight(args):
+    """Flight-recorder sweep (docs/observability.md "Flight
+    recorder"): the whole test_flightrec.py battery — ring/eviction
+    semantics, dump-safety (never masks the typed error), SIGUSR2
+    re-entrancy, emitter coverage across the subsystems, postmortem
+    merge/narrow/report/gate, and the SIGKILL-and-reconstruct
+    end-to-end — under the pinned seeded spec with FULL pytest output
+    teed to a log (no lastfailed cache in stages); then the
+    serving_bench overhead gate (ring-on within noise of ring-off,
+    emitter < 2 µs, bitwise parity)."""
+    log = os.path.join(REPO, ".ci_flight_stage.log")
+    proc = sh([sys.executable, "-m", "pytest", "-q",
+               "tests/test_flightrec.py",
+               "--continue-on-collection-errors",
+               "-p", "no:cacheprovider"],
+              timeout=1800, env={"MXNET_FAULT_SPEC": FLIGHT_SPEC,
+                                 "MXNET_SERVING_RETRIES": "6"})
+    with open(log, "w") as f:
+        f.write(proc.stdout or "")
+        if proc.stderr:
+            f.write("\n--- stderr ---\n" + proc.stderr)
+    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+    if proc.returncode != 0:
+        return False, (f"spec={FLIGHT_SPEC!r}: {tail} "
+                       f"(full output: {log})")
+    out = os.path.join(REPO, ".ci_flight_bench.json")
+    try:
+        proc2 = sh([sys.executable, "benchmark/serving_bench.py",
+                    "--flight-check", "--check", "--requests", "32",
+                    "--rounds", "2", "--output", out], timeout=900)
+        if proc2.returncode != 0:
+            return False, (proc2.stderr or proc2.stdout).strip()[-400:]
+        with open(out) as f:
+            rec = json.load(f)
+    finally:
+        if os.path.exists(out):
+            os.remove(out)
+    return True, (f"spec ok: {tail}; off {rec['flight_off_rps']} rps "
+                  f"(noise {rec['flight_off_noise_pct']}%), on "
+                  f"{rec['flight_on_rps']} rps "
+                  f"({rec['flight_on_overhead_pct']}% overhead), emit "
+                  f"{rec['emit_ns_per_event']}ns, parity="
+                  f"{rec['bitwise_equal_with_flight']}")
+
+
 # Pinned trace-chaos spec: replica-side faults (absorbed by failover —
 # each failed hop must land as a SPAN with a typed outcome and the
 # injected fault as a span event) plus jittered device execution.
@@ -686,6 +752,7 @@ STAGES = {"build": stage_build, "sanity": stage_sanity,
           "serving": stage_serving, "fleet": stage_fleet,
           "sessions": stage_sessions, "autoscale": stage_autoscale,
           "trace": stage_trace,
+          "flight": stage_flight,
           "coldstart": stage_coldstart,
           "trainloop": stage_trainloop,
           "race": stage_race,

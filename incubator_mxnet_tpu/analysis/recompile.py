@@ -231,6 +231,17 @@ def record_compile(site, sig):
            "dimension, make the varying static arg an array, or raise "
            "MXNET_RECOMPILE_WARN if this site legitimately needs more "
            "executables")
+    # the storm diagnosis lands in the flight ring (same crossing +
+    # power-of-two throttle as the warning — a 10k-compile storm must
+    # not flood the whole ring out of its own black box), so a
+    # postmortem sees a compile storm precede an incident even with
+    # tracing off and warnings swallowed
+    if mode == "raise" or n == lim + 1 or (n & (n - 1)) == 0:
+        from .. import flightrec as _flightrec
+        _flightrec.record(
+            _flightrec.COMPILE, "compile.storm",
+            severity="error" if mode == "raise" else "warn",
+            site=site, compiles=n, limit=lim, cause=change)
     if mode == "raise":
         from ..error import RecompileStormError
         raise RecompileStormError(msg)

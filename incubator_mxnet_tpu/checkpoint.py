@@ -122,6 +122,9 @@ class AsyncCheckpointManager:
         thread."""
         self.wait()  # one in-flight checkpoint at a time, oldest first
         flat = {str(k): _leaf_array(v) for k, v in tree.items()}
+        from . import flightrec
+        flightrec.record(flightrec.CHECKPOINT, "checkpoint.save",
+                         step=int(step), leaves=len(flat))
         self._thread = threading.Thread(
             target=self._write, args=(int(step), flat), daemon=True)
         self._thread.start()
@@ -221,6 +224,11 @@ class AsyncCheckpointManager:
             self._thread = None
         if self._error is not None:
             err, self._error = self._error, None
+            from . import flightrec
+            flightrec.record(flightrec.CHECKPOINT,
+                             "checkpoint.write_failed",
+                             severity="error",
+                             error=type(err).__name__)
             raise CheckpointWriteError(
                 f"async checkpoint write failed: {type(err).__name__}: "
                 f"{err}") from err
@@ -254,7 +262,12 @@ class AsyncCheckpointManager:
         are logged and skipped (crash-restart must not die on the very
         damage it is recovering from); an explicit ``step`` is strict."""
         if step is not None:
-            return self._restore_step(step)
+            tree = self._restore_step(step)
+            from . import flightrec
+            flightrec.record(flightrec.CHECKPOINT,
+                             "checkpoint.restored", step=int(step),
+                             fell_back=False)
+            return tree
         return self._newest_first(self._restore_step)
 
     def reshard_restore(self, tree_spec=None, mesh=None, rule_fn=None,
@@ -282,24 +295,42 @@ class AsyncCheckpointManager:
         impossible request must surface, not silently fall back."""
         if mesh is None:
             raise ReshardError("reshard_restore requires a target mesh")
-        loader = lambda s: self._reshard_step(s, tree_spec, mesh, rule_fn)
+
+        def loader(s):
+            tree = self._reshard_step(s, tree_spec, mesh, rule_fn)
+            from . import flightrec
+            flightrec.record(flightrec.CHECKPOINT, "checkpoint.reshard",
+                             step=s, mesh=list(mesh.shape.values()))
+            return tree
+
         if step is not None:
             return loader(step)
         return self._newest_first(loader)
 
     def _newest_first(self, loader):
         """Run ``loader(step)`` newest-first, skipping damaged steps."""
+        from . import flightrec
         steps = self.all_steps()
         if not steps:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
         last_err = None
         for s in reversed(steps):
             try:
-                return loader(s)
+                tree = loader(s)
+                flightrec.record(flightrec.CHECKPOINT,
+                                 "checkpoint.restored", step=s,
+                                 fell_back=last_err is not None)
+                return tree
             except CheckpointCorruptError as e:
+                flightrec.record(flightrec.CHECKPOINT,
+                                 "checkpoint.fallback", severity="warn",
+                                 step=s, error=str(e)[:200])
                 _log.warning("checkpoint step %d is damaged (%s); "
                              "falling back to the previous one", s, e)
                 last_err = e
+        flightrec.record(flightrec.CHECKPOINT,
+                         "checkpoint.unrecoverable", severity="error",
+                         steps=len(steps))
         raise CheckpointCorruptError(
             f"no valid checkpoint in {self.directory}: all of steps "
             f"{steps} failed verification") from last_err

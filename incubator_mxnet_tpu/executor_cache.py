@@ -208,9 +208,14 @@ class Executor:
         # an Executor built while a request trace is active means that
         # request is paying a build the warm path would not — stamp it
         # on the trace (the XLA compile itself lands inside whatever
-        # span is timing the call; this event names the site)
+        # span is timing the call; this event names the site) AND on
+        # the always-on flight ring, where a postmortem can see a
+        # compile burst precede an incident even with tracing off
         from . import trace as _trace
         _trace.add_event("executor.created", site=site)
+        from . import flightrec as _flightrec
+        _flightrec.record(_flightrec.COMPILE, "executor.created",
+                          site=site)
         self._built_at = time.monotonic()
         with _lock:
             if _state["first_build_ms"] is None:

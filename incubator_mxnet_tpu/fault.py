@@ -255,14 +255,20 @@ def inject(point: str, detail: str = ""):
     pt = table.get(point)
     if pt is None or not pt.should_fire():
         return
-    # a fired injection annotates the active request trace (if any):
-    # chaos CI artifacts then SHOW the fault and the recovery path on
-    # one timeline (docs/observability.md).  Lazy import + only on
-    # fire, so the no-spec and no-fire paths pay nothing.
+    # a fired injection annotates the active request trace (if any)
+    # AND the always-on flight ring: chaos CI artifacts then SHOW the
+    # fault and the recovery path on one timeline in BOTH systems
+    # (docs/observability.md).  Lazy imports + only on fire, so the
+    # no-spec and no-fire paths pay nothing.
     from . import trace as _trace
     _trace.add_event(f"fault.{point}", kind=pt.kind,
                      permanent=pt.permanent, fire=pt.fired,
                      detail=detail or None)
+    from . import flightrec as _flightrec
+    _flightrec.record(_flightrec.FAULT, f"fault.{point}",
+                      severity="warn", kind=pt.kind,
+                      permanent=pt.permanent, fire=pt.fired,
+                      detail=detail or None)
     if pt.kind == "delay":
         time.sleep(pt.ms / 1000.0)
         return

@@ -222,10 +222,20 @@ class Trainer:
                             wait=True)
             saved = (f"; eviction checkpoint saved at step "
                      f"{self._step_count} in {self._ckpt.directory}")
-        raise WorkerEvictedError(
+        from .. import flightrec
+        flightrec.record(flightrec.MEMBERSHIP, "trainer.evicted",
+                         severity="error", step=self._step_count,
+                         reason=str(reason)[:200],
+                         checkpointed=self._ckpt is not None)
+        err = WorkerEvictedError(
             f"worker evicted from the fleet at step {self._step_count} "
             f"({reason}){saved}; call rejoin() to re-enter and "
             "bootstrap from current weights")
+        # the eviction is about to cross the trainer's top boundary:
+        # the black box dumps the membership/checkpoint history that
+        # led here (rate-limited, best-effort, never masks the raise)
+        flightrec.note_error("trainer", err)
+        raise err
 
     def rejoin(self, bootstrap=True):
         """Re-enter the fleet after a
@@ -252,6 +262,9 @@ class Trainer:
             # not swallow it and retry forever
             raise ValueError("rejoin() needs a kvstore-backed trainer")
         self._join_fleet()
+        from .. import flightrec
+        flightrec.record(flightrec.MEMBERSHIP, "trainer.rejoined",
+                         step=self._step_count, bootstrap=bootstrap)
         if not bootstrap:
             return
         if self._uokv:

@@ -75,7 +75,7 @@ import uuid
 
 import numpy as onp
 
-from .. import fault
+from .. import fault, flightrec
 from ..base import get_env
 from ..error import PSTimeoutError, WorkerEvictedError, get_error_class
 
@@ -202,6 +202,10 @@ class _State:
                 f"missed its heartbeat budget: silent "
                 f"{now - m['last_beat']:.2f}s > {self.dead_after} beats "
                 f"x {self.beat_interval:.2f}s")
+            flightrec.record(flightrec.MEMBERSHIP, "worker.evicted",
+                             severity="warn", rank=m["rank"],
+                             sess=s[:8], live=len(self.members),
+                             silent_s=round(now - m["last_beat"], 2))
             _log.warning(
                 "ps membership: evicted worker rank=%s sess=%s (%s); "
                 "%d live member(s) remain", m["rank"], s[:8],
@@ -518,6 +522,9 @@ class _Handler(socketserver.BaseRequestHandler):
                 if (st.departed > 0 and len(st.members)
                         > max(0, st.num_workers - st.departed)):
                     st.departed -= 1
+                flightrec.record(flightrec.MEMBERSHIP, "worker.joined",
+                                 rank=rank, sess=sess[:8],
+                                 rejoin=rejoin, live=len(st.members))
                 _log.info("ps membership: worker rank=%s sess=%s "
                           "%sjoined; %d live", rank, sess[:8],
                           "re" if rejoin else "", len(st.members))
@@ -531,6 +538,10 @@ class _Handler(socketserver.BaseRequestHandler):
                 st.evicted.pop(sess, None)  # a graceful leave, not evict
                 if m is not None:
                     st.departed += 1
+                    flightrec.record(flightrec.MEMBERSHIP,
+                                     "worker.left", rank=m["rank"],
+                                     sess=sess[:8],
+                                     live=len(st.members))
                     st.rebalance()
                     st.cv.notify_all()
                 return True, {"live_workers": len(st.members)}

@@ -56,7 +56,7 @@ import threading
 import time
 
 from ..base import get_env
-from .. import fault
+from .. import fault, flightrec
 from ..error import (FleetDrainingError, ModelEvictedError,
                      ReplicaUnavailableError)
 from .admission import ModelNotFound, slo_class
@@ -321,16 +321,26 @@ class Autoscaler:
             if a == 0:
                 # scaled to zero: stay there until a request arrives
                 # (the router's on-demand path handles the first one)
-                want = floor
+                want, why = floor, "at_zero"
             elif load / a >= high:
-                want = a + 1
+                want, why = a + 1, "backlog_high"
             elif load == 0 and idle >= self.idle_unload_s:
-                want = floor            # idle: unload toward zero
+                want, why = floor, "idle"   # unload toward zero
             elif a > 1 and load / (a - 1) < high * 0.5:
-                want = a - 1            # a smaller fleet still has slack
+                want, why = a - 1, "slack"  # smaller fleet suffices
             else:
-                want = a
+                want, why = a, None
             out[name] = max(floor, min(cap, want))
+            if out[name] != a and why is not None:
+                # the DECISION and the signal that tripped it — the
+                # record a postmortem explains a bad scale-down from
+                flightrec.record(
+                    flightrec.SCALING, "scale.decide", model=name,
+                    actual=a, desired=out[name], why=why,
+                    queued=sig.get("queued", 0),
+                    inflight=sig.get("inflight", 0),
+                    idle_s=None if idle == float("inf")
+                    else round(idle, 1))
         self._last_desired = dict(out)
         return out
 
@@ -439,6 +449,10 @@ class Autoscaler:
                     "evict": evictions}
         with self._lock:
             self._counters["blocked"] += 1
+        flightrec.record(flightrec.PLACEMENT, "placer.blocked",
+                         severity="warn", model=name,
+                         tier=policy.slo.name,
+                         footprint=policy.footprint())
         return None
 
     def _reserve(self, rid, name, nbytes):
@@ -589,15 +603,26 @@ class Autoscaler:
                         time.monotonic() + self.drain_s)
             else:
                 raise ValueError(f"unknown scale action {action!r}")
+            flightrec.record(flightrec.SCALING, "scale.apply",
+                             action=action, model=d.get("model"),
+                             rid=d.get("rid"))
             return True
         except fault.FaultInjected as e:
             self._rollback(d)
             self._count("faults")
+            flightrec.record(flightrec.SCALING, "scale.dropped",
+                             severity="warn", action=action,
+                             model=d.get("model"), rid=d.get("rid"),
+                             cause=type(e).__name__)
             _log.warning("autoscaler: %s dropped this tick (injected "
                          "fault: %s)", what, e)
             return False
         except Exception as e:  # mxlint: allow-broad-except(one failed decision must not kill the loop; re-derived next tick from live state)
             self._count("faults")
+            flightrec.record(flightrec.SCALING, "scale.failed",
+                             severity="warn", action=action,
+                             model=d.get("model"), rid=d.get("rid"),
+                             error=type(e).__name__)
             _log.warning("autoscaler: %s failed: %s: %s", what,
                          type(e).__name__, e)
             return False
@@ -612,6 +637,12 @@ class Autoscaler:
             with self._lock:
                 self._evictions[victim] = (
                     self._evictions.get(victim, 0) + 1)
+            vp = self._policies.get(victim)
+            flightrec.record(flightrec.PLACEMENT, "placer.evict",
+                             severity="warn", model=victim, rid=rid,
+                             for_model=name,
+                             tier=vp.slo.name if vp is not None
+                             else None)
             _log.info("autoscaler: evicted %s from %s (LRU, making "
                       "room for %s)", victim, rid, name)
         r.admin("load", name, path=p.path, warmup=p.warmup,
@@ -701,6 +732,10 @@ class Autoscaler:
                         self._sync_placer()
                         plan = self._plan_grow(name, p, want)
                     if plan is None:
+                        flightrec.record(
+                            flightrec.PLACEMENT, "model.unplaceable",
+                            severity="error", model=name,
+                            max_replicas=self.max_replicas)
                         raise ModelEvictedError(
                             f"model {name!r} cannot be placed: every "
                             f"replica's HBM budget is held by busier "
@@ -790,6 +825,8 @@ class Autoscaler:
                 self._counters["scale_from_zero"] += 1
                 self._scale_from_zero_ms[name] = round(ms, 3)
             self._count("scale_up")
+            flightrec.record(flightrec.SCALING, "scale.from_zero",
+                             model=name, ms=round(ms, 3))
             _log.info("autoscaler: scale-from-zero %s in %.0f ms",
                       name, ms)
             return ms

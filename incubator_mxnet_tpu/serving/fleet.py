@@ -59,7 +59,7 @@ import time
 import numpy as onp
 
 from ..base import get_env
-from .. import fault, trace
+from .. import fault, flightrec, trace
 from ..error import ReplicaUnavailableError
 from .admission import (BadRequest, DeadlineExceeded, ModelNotFound,
                         QueueFullError, ServingError, ShuttingDown)
@@ -92,6 +92,20 @@ class _ReplicaBase:
         self._inflight = 0
         self._lock = threading.Lock()
 
+    def _to(self, new_state):
+        """One state-machine transition, recorded in the flight ring —
+        the replica lifecycle IS the story a dead-fleet postmortem
+        reconstructs.  No-op (and no event) when the state is already
+        ``new_state``."""
+        old = self.state
+        if old == new_state:
+            return
+        self.state = new_state
+        flightrec.record(flightrec.LIFECYCLE, "replica.state",
+                         severity="warn" if new_state == DEAD
+                         else "info",
+                         replica=self.rid, frm=old, to=new_state)
+
     # -- routing view -------------------------------------------------
 
     @property
@@ -114,7 +128,11 @@ class _ReplicaBase:
     def note_success(self):
         with self._lock:
             self._fails = 0
+            readmitted = not self._healthy
             self._healthy = True
+        if readmitted:
+            flightrec.record(flightrec.HEALTH, "replica.readmitted",
+                             replica=self.rid)
 
     def note_failure(self):
         """One failed probe or failed routed request.  Returns True
@@ -125,19 +143,23 @@ class _ReplicaBase:
             crossed = self._healthy and self._fails >= self._probe_fails
             if crossed:
                 self._healthy = False
+        if crossed:
+            flightrec.record(flightrec.HEALTH, "replica.quarantined",
+                             severity="warn", replica=self.rid,
+                             fails=self._fails)
         return crossed
 
     # -- lifecycle ----------------------------------------------------
 
     def begin_drain(self):
         if self.state in (READY, WARMING, STARTING):
-            self.state = DRAINING
+            self._to(DRAINING)
 
     def readmit(self):
         """Back into rotation after a drain (rolling reload step done).
         A dead replica stays dead."""
         if self.state == DRAINING and not self._killed:
-            self.state = READY
+            self._to(READY)
             self.note_success()
 
     def kill(self):
@@ -146,7 +168,7 @@ class _ReplicaBase:
         process resets its sockets; a killed thread replica lets
         already-executing batches finish — admission dies either way)."""
         self._killed = True
-        self.state = DEAD
+        self._to(DEAD)
 
     def has_model(self, name):
         """True when this replica serves ``name`` (multi-tenant
@@ -264,7 +286,7 @@ class ThreadReplica(_ReplicaBase):
         self._t_start = time.monotonic()
 
     def start(self):
-        self.state = WARMING
+        self._to(WARMING)
         try:
             for name, path in self.models.items():
                 self.repository.load(name, path, warmup=self._warmup)
@@ -273,10 +295,10 @@ class ThreadReplica(_ReplicaBase):
                     name, spec,
                     warmup=self._warmup is not False)
         except Exception:
-            self.state = DEAD
+            self._to(DEAD)
             raise
         if self.state == WARMING:   # a racing kill()/drain wins
-            self.state = READY
+            self._to(READY)
         return self
 
     def _gone(self):
@@ -392,7 +414,7 @@ class ThreadReplica(_ReplicaBase):
         return self.repository.get(name).predictor.meta["inputs"]
 
     def close(self, timeout=30.0):
-        self.state = DEAD
+        self._to(DEAD)
         self.repository.drain_all(timeout)
         # final sync snapshots: a post-drain migration is lossless
         self.sessions.drain_all(timeout)
@@ -428,7 +450,7 @@ class ProcessReplica(_ReplicaBase):
         return self._port
 
     def start(self):
-        self.state = WARMING
+        self._to(WARMING)
         cmd = [sys.executable, "-m",
                "incubator_mxnet_tpu.serving.server",
                "--host", "127.0.0.1", "--port", "0"]
@@ -465,7 +487,7 @@ class ProcessReplica(_ReplicaBase):
         # server.main loads + warms every model BEFORE binding the
         # listener, so "listening" implies warm
         if self.state == WARMING:
-            self.state = READY
+            self._to(READY)
         return self
 
     def _read_stdout(self):
@@ -485,7 +507,14 @@ class ProcessReplica(_ReplicaBase):
         if self._killed or self._port is None:
             raise ConnectionResetError(f"replica {self.rid} is dead")
         if self._proc is not None and self._proc.poll() is not None:
-            self.state = DEAD
+            if self.state != DEAD:
+                # an UNEXPECTED subprocess exit (vs kill()/close(),
+                # which transition first) — the event a postmortem
+                # anchors a replica death on
+                flightrec.record(flightrec.LIFECYCLE, "replica.exited",
+                                 severity="error", replica=self.rid,
+                                 rc=self._proc.returncode)
+            self._to(DEAD)
             raise ConnectionResetError(
                 f"replica {self.rid} exited rc={self._proc.returncode}")
 
@@ -757,7 +786,7 @@ class ProcessReplica(_ReplicaBase):
             self._proc.kill()
 
     def close(self, timeout=30.0):
-        self.state = DEAD
+        self._to(DEAD)
         self._killed = True
         if self._proc is not None and self._proc.poll() is None:
             self._proc.terminate()
@@ -1023,16 +1052,31 @@ class ReplicaFleet:
         return self._admin_everywhere("unload", name)
 
     def _admin_everywhere(self, verb, name, **kw):
+        # control-plane verbs get the same observability as requests
+        # (PR 14 traced requests; admin verbs record into the flight
+        # ring with their latency, so a slow :load is attributable)
+        t0 = time.monotonic()
         out = {}
-        for r in self.replicas:
-            if r.state == DEAD:
-                continue
-            out[r.rid] = r.admin(verb, name, **kw)
+        try:
+            for r in self.replicas:
+                if r.state == DEAD:
+                    continue
+                out[r.rid] = r.admin(verb, name, **kw)
+        except BaseException as e:
+            flightrec.record(flightrec.SCALING, f"fleet.{verb}",
+                             severity="error", model=name,
+                             error=type(e).__name__,
+                             replicas=len(out),
+                             ms=round((time.monotonic() - t0) * 1e3, 3))
+            raise
         self._meta_cache.pop(name, None)
         if verb == "load":
             self.models[name] = kw.get("path")
         elif verb == "unload":
             self.models.pop(name, None)
+        flightrec.record(flightrec.SCALING, f"fleet.{verb}",
+                         model=name, replicas=len(out),
+                         ms=round((time.monotonic() - t0) * 1e3, 3))
         return out
 
     # -- zero-downtime rolling reload ---------------------------------
@@ -1072,10 +1116,14 @@ class ReplicaFleet:
             try:
                 info = r.admin("reload", name, path=path,
                                version=version)
-            except BaseException:
+            except BaseException as e:
                 # old version still swapped in (the repository only
                 # replaces after a successful build) — re-admit rather
                 # than shrink the fleet
+                flightrec.record(
+                    flightrec.SCALING, "fleet.rolling_reload",
+                    severity="error", model=name, replica=r.rid,
+                    error=type(e).__name__)
                 r.readmit()
                 note_ready()
                 raise
@@ -1088,6 +1136,11 @@ class ReplicaFleet:
         # a meta lookup that raced the roll may have cached the OLD
         # version's specs; drop it so the next one sees the new fleet
         self._meta_cache.pop(name, None)
+        flightrec.record(
+            flightrec.SCALING, "fleet.rolling_reload", model=name,
+            replicas=len(report["replicas"]),
+            min_ready=report["min_ready"],
+            ms=round(sum(r["ms"] for r in report["replicas"]), 3))
         return report
 
     # -- active health probing ----------------------------------------

@@ -191,6 +191,11 @@ class ModelRepository:
                 aot_loads=len(entry.predictor.aot_buckets),
                 aot_load_failures=entry.predictor.aot_load_failures,
                 compile_count=entry.predictor.compile_count)
+        from .. import flightrec
+        flightrec.record(flightrec.LIFECYCLE, "model.loaded",
+                         model=name, version=version,
+                         ms=entry.cold_start_ms,
+                         compiles=entry.predictor.compile_count)
         return entry
 
     def warmup_entry(self, entry, bucket_sizes=None):
@@ -272,6 +277,9 @@ class ModelRepository:
             raise ModelNotFound(f"model {name!r} is not loaded")
         entry.batcher.drain()
         self.exec_gate.forget(name)
+        from .. import flightrec
+        flightrec.record(flightrec.LIFECYCLE, "model.unloaded",
+                         model=name, version=entry.version)
         return {"unloaded": name, "version": entry.version}
 
     def drain_all(self, timeout=30.0):

@@ -44,7 +44,7 @@ import time
 import numpy as onp
 
 from ..base import get_env
-from .. import fault, trace
+from .. import fault, flightrec, trace
 from ..error import (FleetDrainingError, ReplicaUnavailableError,
                      SessionExpiredError, SessionLostError)
 from .admission import (Admission, BadRequest, ClientDisconnected,
@@ -175,9 +175,19 @@ class FleetRouter:
             return result
         except ServingError as e:
             code = e.http_status
+            if code >= 500:
+                # a typed framework error is crossing the router's
+                # top-level boundary: the black box writes its crash
+                # dump HERE (rate-limited, best-effort — the typed
+                # error below surfaces untouched)
+                flightrec.note_error("router", e)
             raise
-        except (FleetDrainingError, ConnectionError):
+        except (FleetDrainingError, ConnectionError) as e:
             code = 503
+            flightrec.note_error("router", e)
+            raise
+        except Exception as e:  # mxlint: allow-broad-except(recorded in the flight ring and re-raised unchanged — the surfacing 500 stays the original error)
+            flightrec.note_error("router", e)
             raise
         finally:
             if root is not None:
@@ -210,9 +220,15 @@ class FleetRouter:
                     and self.autoscaler.manages(name):
                 # scale-from-zero: the model was idle-unloaded (or
                 # evicted); this request pays the (AOT-cheap) reload
-                # instead of a 404/503
+                # instead of a 404/503.  Span AND flight event — the
+                # latency is attributable even with tracing off
+                t_sfz = time.monotonic()
                 with trace.span("router.scale_from_zero", model=name):
                     self.autoscaler.ensure_loaded(name)
+                flightrec.record(
+                    flightrec.SCALING, "router.scale_from_zero",
+                    model=name,
+                    ms=round((time.monotonic() - t_sfz) * 1e3, 3))
                 r = self.fleet.pick(exclude=tried, name=name)
             if r is None:
                 if self.fleet.all_draining():
@@ -228,10 +244,13 @@ class FleetRouter:
                 # the retry hop that follows is its own span; this
                 # event marks WHY it exists (the previous hop's typed
                 # failure is that hop span's outcome)
+                cause = (type(last).__name__ if last is not None
+                         else None)
                 trace.add_event("router.failover", attempt=k,
-                                model=name,
-                                cause=type(last).__name__
-                                if last is not None else None)
+                                model=name, cause=cause)
+                flightrec.record(flightrec.HEALTH, "router.failover",
+                                 severity="warn", attempt=k,
+                                 model=name, cause=cause)
             remaining_ms = (t_end - time.monotonic()) * 1000.0
             if remaining_ms <= 0:
                 raise DeadlineExceeded(
@@ -289,7 +308,15 @@ class FleetRouter:
                                 inputs_json=inputs_json)
             except QueueFullError:
                 raise          # overload is load, not ill health
-            except (ShuttingDown, DeadlineExceeded, ConnectionError):
+            except (ShuttingDown, DeadlineExceeded,
+                    ConnectionError) as e:
+                # the typed failed hop, in the black box: with
+                # tracing off (the common case) this is the record a
+                # postmortem hangs the failover story on
+                flightrec.record(flightrec.HEALTH, "router.hop_failed",
+                                 severity="warn", replica=r.rid,
+                                 model=name, kind=kind,
+                                 error=type(e).__name__)
                 r.note_failure()
                 raise
         r.note_success()
@@ -363,6 +390,9 @@ class FleetRouter:
         self.metrics.record_hedge(won=False)   # launched
         trace.add_event("router.hedge_launched", replica=r2.rid,
                         primary=r.rid, after_ms=round(hedge_ms, 1))
+        flightrec.record(flightrec.HEALTH, "router.hedge_launched",
+                         replica=r2.rid, primary=r.rid,
+                         after_ms=round(hedge_ms, 1))
         threading.Thread(target=run,
                          args=("hedge", r2, hop_ms,
                                contextvars.copy_context()),
@@ -378,6 +408,9 @@ class FleetRouter:
                     self.metrics.record_hedge(won=True)
                     trace.add_event("router.hedge_won",
                                     replica=r2.rid, primary=r.rid)
+                    flightrec.record(flightrec.HEALTH,
+                                     "router.hedge_won",
+                                     replica=r2.rid, primary=r.rid)
                 return slots[winners[0]][1]
             if not done:
                 raise DeadlineExceeded(
@@ -459,19 +492,26 @@ class FleetRouter:
                                         deadline_ms, on_chunk)
             code = 200
             return result
-        except (SessionExpiredError, SessionLostError):
+        except (SessionExpiredError, SessionLostError) as e:
             # terminal for this id either way: drop the affinity entry
             # so churned/expired sessions never accumulate in the
             # router's map (and the fleet sessions gauge stays honest)
             code = 410
+            if isinstance(e, SessionLostError):
+                # loss (vs policy expiry) is a crash-class incident:
+                # the black box dumps the history that led here
+                flightrec.note_error("router", e)
             with self._session_lock:
                 self._session_homes.pop(sid, None)
             raise
         except ServingError as e:
             code = e.http_status
+            if code >= 500:
+                flightrec.note_error("router", e)
             raise
-        except (FleetDrainingError, ConnectionError):
+        except (FleetDrainingError, ConnectionError) as e:
             code = 503
+            flightrec.note_error("router", e)
             raise
         finally:
             self.metrics.record_route(
@@ -567,6 +607,9 @@ class FleetRouter:
                 # the typed arm of the contract: no usable snapshot
                 # anywhere — drop the affinity so a retry 404s fast
                 self.metrics.record_session_loss()
+                flightrec.record(flightrec.SESSION, "session.lost",
+                                 severity="error", sid=sid,
+                                 model=model)
                 with self._session_lock:
                     self._session_homes.pop(sid, None)
                 raise
@@ -576,6 +619,8 @@ class FleetRouter:
             self.metrics.record_migration()
             trace.add_event("router.session_migrated", sid=sid,
                             to_replica=r2.rid)
+            flightrec.record(flightrec.SESSION, "session.migrated",
+                             sid=sid, model=model, to_replica=r2.rid)
             with self._session_lock:
                 self._session_homes[sid] = (model, r2.rid)
             # the post-adoption step gets the same transient-fault
@@ -640,6 +685,10 @@ class FleetRouter:
         if trace.active():
             # same additive discipline for request-scoped tracing
             body["trace"] = trace.health_block()
+        if flightrec.active():
+            # and for the always-on flight recorder: present only once
+            # events were recorded (a bare router keeps its shape)
+            body["flight"] = flightrec.health_block()
         return (200 if ready else 503), body
 
     def describe(self):
@@ -663,6 +712,8 @@ class FleetRouter:
             out["autoscale"] = self.autoscaler.describe()
         if trace.active():
             out["trace"] = trace.health_block()
+        if flightrec.active():
+            out["flight"] = flightrec.health_block()
         return out
 
     # -- HTTP front end -----------------------------------------------
@@ -707,6 +758,8 @@ class _RouterHandler(JSONRequestHandler):
                               content_type="text/plain; version=0.0.4")
         if path == "/v1/trace":
             return self._trace_dump("router")
+        if path == "/v1/flight":
+            return self._flight_dump("router")
         self._send(404, {"error": "NotFound", "message": path})
 
     def do_POST(self):
@@ -1017,6 +1070,12 @@ def main(argv=None):
     if not models and not session_models and not policies:
         p.error("need at least one --model, --session-model or "
                 "--managed-model")
+
+    # black box: name this process in flight dumps and arm the SIGUSR2
+    # wedge-dump path (docs/observability.md "Flight recorder")
+    flightrec.install_signal_handler(proc="router")
+    flightrec.record(flightrec.LIFECYCLE, "router.started",
+                     replicas=args.replicas, backend=args.backend)
 
     fleet = ReplicaFleet(models, n=args.replicas, backend=args.backend,
                          warmup=not args.no_warmup,

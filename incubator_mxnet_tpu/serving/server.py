@@ -33,7 +33,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as onp
 
 from ..base import get_env
-from .. import fault, trace
+from .. import fault, flightrec, trace
 from ..error import SessionExpiredError, SessionLostError
 from .admission import (Admission, BadRequest, ClientDisconnected,
                         ServingError, retry_after_s)
@@ -101,6 +101,10 @@ def health_body(repository, t_start=None, sessions=None):
     # spans recorded), so bare deployments keep their pinned shape
     if trace.active():
         body["trace"] = trace.health_block()
+    # same additive discipline for the always-on flight recorder:
+    # present only once events were actually recorded
+    if flightrec.active():
+        body["flight"] = flightrec.health_block()
     return (503 if draining else 200), body
 
 
@@ -167,6 +171,13 @@ class JSONRequestHandler(BaseHTTPRequestHandler):
         of these into one cross-process timeline)."""
         tid = self._query().get("trace_id") or None
         self._send(200, trace.export(tid, service=service))
+
+    def _flight_dump(self, service):
+        """``GET /v1/flight`` — this process's flight-recorder ring as
+        a dump (tools/postmortem.py merges several of these, plus any
+        crash/SIGUSR2 dump files, into one incident timeline)."""
+        self._send(200, flightrec.export(service=service,
+                                         reason="http"))
 
     @staticmethod
     def parse_session_path(path):
@@ -265,6 +276,8 @@ class _Handler(JSONRequestHandler):
             return self._send(200, {"models": self.app.repository.models()})
         if path == "/v1/trace":
             return self._trace_dump("server")
+        if path == "/v1/flight":
+            return self._flight_dump("server")
         self._send(404, {"error": "NotFound", "message": path})
 
     def do_POST(self):
@@ -320,9 +333,14 @@ class _Handler(JSONRequestHandler):
             code = 503   # injected front-end fault: client may retry
             payload = {"error": "TransientFault", "message": str(e)}
             hdrs = self.app.retry_headers(name)
+            flightrec.note_error("server", e)
         except Exception as e:  # mxlint: allow-broad-except(HTTP boundary: any error becomes a 500 response)
             code = 500
             payload = {"error": type(e).__name__, "message": str(e)}
+            # a framework error crossed the server's top boundary: the
+            # black box dumps (rate-limited, best-effort) — the 500
+            # below still carries the original error
+            flightrec.note_error("server", e)
         # record BEFORE sending: the moment the response bytes go out,
         # the client may scrape /metrics, and its own request must
         # already be counted.  Unknown-model 404s are not attributed
@@ -666,6 +684,9 @@ def main(argv=None):
                    help="skip per-bucket warmup compiles at load")
     args = p.parse_args(argv)
 
+    # black box: name this process in flight dumps and arm the SIGUSR2
+    # wedge-dump path (docs/observability.md "Flight recorder")
+    flightrec.install_signal_handler(proc="server")
     server = InferenceServer(host=args.host, port=args.port)
     if args.session_dir:
         server.sessions.snapshot_dir = args.session_dir
@@ -685,6 +706,8 @@ def main(argv=None):
         print(f"[serving] session model {name} = {model_spec}",
               flush=True)
     port = server.start()
+    flightrec.record(flightrec.LIFECYCLE, "server.started", port=port,
+                     models=sorted(server.repository.models()))
     print(f"[serving] listening on {args.host}:{port}", flush=True)
 
     done = threading.Event()

@@ -50,7 +50,7 @@ import uuid
 import numpy as onp
 
 from ..base import get_env
-from .. import fault
+from .. import fault, flightrec
 from ..error import SessionExpiredError, SessionLostError
 from .admission import (Admission, BadRequest, ModelNotFound,
                         ServingError, ShuttingDown)
@@ -418,6 +418,8 @@ class SessionManager:
                 self._sessions[sid] = s
                 self._expired.pop(sid, None)
                 self._counters["created"] += 1
+                flightrec.record(flightrec.SESSION, "session.created",
+                                 model=self.name, sid=sid)
         finally:
             self._cleanup_evicted()
         return self.describe_session(sid)
@@ -658,6 +660,8 @@ class SessionManager:
                 self._sessions[sid] = s
                 self._expired.pop(sid, None)
                 self._counters["restored"] += 1
+                flightrec.record(flightrec.SESSION, "session.restored",
+                                 model=self.name, sid=sid, steps=steps)
         return self.describe_session(sid)
 
     def _drop_snapshots(self, sid):
@@ -676,6 +680,9 @@ class SessionManager:
         self._sessions.pop(sid, None)
         self._remember_expired(sid, reason)
         self._counters["evicted"] += 1
+        flightrec.record(flightrec.SESSION, "session.evicted",
+                         severity="warn", model=self.name, sid=sid,
+                         reason=reason)
         # snapshots die with the session (an evicted id must not be
         # resurrectable via :adopt, and churn must not leak disk) —
         # but rmtree is IO, so it runs after the lock is released

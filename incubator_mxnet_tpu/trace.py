@@ -428,6 +428,16 @@ def from_header(text, name, **args):
 # export + stats
 # ---------------------------------------------------------------------------
 
+def anchor():
+    """The per-process ``(wall, monotonic)`` anchor pair.  Captured
+    ONCE per process (the MX-TIME001 contract) and shared by every
+    exporter that needs to place monotonic timestamps on a cross-
+    process timeline — this module's span export and the flight
+    recorder's event dumps both use it, so their merged timelines can
+    never disagree about when "now" was."""
+    return _ANCHOR_WALL, _ANCHOR_MONO
+
+
 def _wall_us(t_mono):
     return int((_ANCHOR_WALL + (t_mono - _ANCHOR_MONO)) * 1e6)
 

@@ -597,7 +597,11 @@ def test_healthz_and_describe_autoscale_shape(artifacts):
     unchanged (test_fleet pins the bare-router shape), the
     ``autoscale`` block appears only with a control plane attached,
     with this exact schema."""
+    from incubator_mxnet_tpu import flightrec
     fleet, router, scaler = _stack(artifacts)
+    # flight recording off for the exact-shape pins below (its block
+    # is additive and pinned by tests/test_flightrec.py)
+    flightrec.configure(ring=0)
     try:
         router.route("a", _x())
         code, body = router.health()
@@ -619,6 +623,7 @@ def test_healthz_and_describe_autoscale_shape(artifacts):
                 "autoscale"} <= set(desc)
         assert desc["autoscale"]["models"]["a"]["actual"] == 1
     finally:
+        flightrec.reset()
         router.shutdown()
 
 

@@ -1,0 +1,790 @@
+"""Always-on flight recorder + crash postmortem (ISSUE 15 tentpole).
+
+The contract under test (docs/observability.md "Flight recorder"): a
+bounded always-on per-process ring of control-plane events — replica
+state transitions, quarantine/readmit, scaling decisions, placement
+evictions, membership changes, checkpoint lifecycle, compile events,
+fault injections — with crash dumps on typed boundary errors
+(rate-limited, best-effort, NEVER masking the original error), a
+SIGUSR2 wedge dump (ring + thread stacks + metrics, re-entrant-safe),
+``GET /v1/flight`` on both front ends, and ``tools/postmortem.py``
+reconstructing an incident across processes.  The ``flight`` CI stage
+re-runs this file under a pinned seeded ``MXNET_FAULT_SPEC``, so every
+assertion must hold with chaos injected as well as without.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as onp
+import pytest
+
+import jax.numpy as jnp
+
+from incubator_mxnet_tpu import deploy, fault, flightrec, profiler, trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+POSTMORTEM = os.path.join(REPO, "tools", "postmortem.py")
+
+
+@pytest.fixture(autouse=True)
+def _flight_isolation():
+    """Every test leaves the recorder exactly as it found it: leaked
+    events would flip the additive "flight" healthz block on for
+    unrelated shape-pinning tests (and leaked dump counters would
+    corrupt rate-limit assertions)."""
+    yield
+    flightrec.reset()
+    trace.reset()
+    fault.reset()
+
+
+def _mlp_fwd(params, x):
+    y = x
+    for w in params["layers"]:
+        y = jnp.tanh(y @ w)
+    return y
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    rng = onp.random.RandomState(7)
+    params = {"layers": [rng.randn(16, 16).astype(onp.float32) * 0.3
+                         for _ in range(2)]}
+    x = rng.randn(2, 16).astype(onp.float32)
+    prefix = str(tmp_path_factory.mktemp("flight") / "mlp")
+    deploy.export_model(_mlp_fwd, (x,), prefix, params=params)
+    return prefix
+
+
+def _x(seed=0):
+    return onp.random.RandomState(seed).randn(16).astype(onp.float32)
+
+
+def _names(**kw):
+    return [e.name for e in flightrec.events(**kw)]
+
+
+# ---------------------------------------------------------------------------
+# ring core
+# ---------------------------------------------------------------------------
+
+def test_ring_bounded_oldest_first_eviction_counted():
+    flightrec.configure(ring=4)
+    for i in range(10):
+        flightrec.record("health", f"e{i}", i=i)
+    st = flightrec.stats()
+    assert st["events_recorded"] == 10
+    assert st["events_in_ring"] == 4
+    assert st["events_evicted"] == 6
+    assert _names() == ["e6", "e7", "e8", "e9"]   # oldest-first out
+    hb = flightrec.health_block()
+    assert set(hb) == {"ring", "events", "evictions", "dumps"}
+    assert hb["evictions"] == 6
+
+
+def test_record_validates_vocabulary_and_captures_trace_id():
+    flightrec.configure(ring=64)
+    with pytest.raises(ValueError):
+        flightrec.record("not-a-category", "x")
+    with pytest.raises(ValueError):
+        flightrec.record("health", "x", severity="fatal")
+    # trace id: explicit beats ambient, ambient beats none
+    trace.configure(sample=1.0)
+    root = trace.start_trace("r")
+    with trace.activate(root):
+        flightrec.record("health", "ambient")
+        flightrec.record("health", "explicit", trace_id="ff" * 8)
+    flightrec.record("health", "bare")
+    by = {e.name: e for e in flightrec.events()}
+    assert by["ambient"].trace_id == root.trace_id
+    assert by["explicit"].trace_id == "ff" * 8
+    assert by["bare"].trace_id is None
+
+
+def test_disabled_ring_is_inert_and_keeps_bare_shapes():
+    flightrec.configure(ring=0)
+    assert not flightrec.enabled()
+    flightrec.record("health", "dropped")       # no-op, no error
+    assert not flightrec.active()
+    assert flightrec.events() == []
+    # re-enable: active only once something records
+    flightrec.configure(ring=8)
+    assert not flightrec.active()
+    flightrec.record("health", "first")
+    assert flightrec.active()
+
+
+def test_profiler_provider_registered_on_first_event():
+    flightrec.configure(ring=16)
+    flightrec.record("lifecycle", "tick")
+    payload = json.loads(profiler.dumps(format="json"))
+    st = payload["providers"]["flight"]
+    assert st["events_recorded"] >= 1
+    assert st["enabled"] is True
+    assert "[flight]" in profiler.dumps()
+
+
+def test_export_is_wall_anchored_and_merge_ready():
+    flightrec.configure(ring=16, proc="unit")
+    t_wall = time.time()
+    flightrec.record("health", "now")
+    dump = flightrec.export()
+    assert dump["flight"] == 1 and dump["proc"] == "unit"
+    ev = dump["events"][-1]
+    assert ev["name"] == "now"
+    # the anchored wall timestamp is within drift distance of a
+    # direct wall reading taken around the record
+    assert abs(ev["ts_us"] / 1e6 - t_wall) < 5.0
+    json.dumps(dump)                       # JSON-serializable whole
+
+
+# ---------------------------------------------------------------------------
+# dumps: crash-triggered, rate-limited, best-effort
+# ---------------------------------------------------------------------------
+
+def test_note_error_writes_rate_limited_dump(tmp_path):
+    flightrec.configure(ring=32, dir=str(tmp_path), proc="unit",
+                        dump_min_s=30.0)
+    flightrec.record("health", "before")
+    path = flightrec.note_error("router", ConnectionError("boom"))
+    assert path is not None and os.path.exists(path)
+    payload = json.loads(open(path).read())
+    assert payload["reason"] == "error:ConnectionError"
+    names = [e["name"] for e in payload["events"]]
+    assert "before" in names and "boundary.error" in names
+    err = [e for e in payload["events"]
+           if e["name"] == "boundary.error"][0]
+    assert err["severity"] == "error"
+    assert err["fields"]["boundary"] == "router"
+    # second error inside the rate-limit window: event recorded, dump
+    # skipped + counted
+    assert flightrec.note_error("router", ValueError("again")) is None
+    st = flightrec.stats()
+    assert st["dumps_written"] == 1
+    assert st["dumps_rate_limited"] == 1
+    assert len(_names(name="boundary.error")) == 2
+
+
+def test_dump_failures_swallowed_and_counted(tmp_path, monkeypatch):
+    # (a) unwritable dump path: a FILE squats on a directory component
+    # (chmod is no barrier for a root test runner)
+    (tmp_path / "ro").write_text("not a directory")
+    flightrec.configure(ring=32, dir=str(tmp_path / "ro" / "sub"),
+                        proc="unit", dump_min_s=0.0)
+    assert flightrec.note_error("server", RuntimeError("x")) is None
+    assert flightrec.stats()["dump_failures"] == 1
+    # (b) injected OSError mid-write (disk-full simulation)
+    flightrec.configure(dir=str(tmp_path))
+
+    real_open = open
+
+    def bad_open(path, *a, **kw):
+        if str(path).endswith(".flight.json.tmp"):
+            raise OSError(28, "No space left on device")
+        return real_open(path, *a, **kw)
+
+    monkeypatch.setattr("builtins.open", bad_open)
+    assert flightrec.note_error("server", RuntimeError("y")) is None
+    monkeypatch.undo()
+    assert flightrec.stats()["dump_failures"] == 2
+    # the events themselves were never lost
+    assert len(_names(name="boundary.error")) == 2
+
+
+def test_http_500_answers_typed_even_when_dump_fails(artifact,
+                                                     tmp_path):
+    """The never-masks contract over the wire: a crash dump that
+    cannot be written must not change the (typed) error response."""
+    from incubator_mxnet_tpu.serving import InferenceServer
+    (tmp_path / "nope").write_text("file, not dir")   # blocks makedirs
+    flightrec.configure(ring=64, dir=str(tmp_path / "nope" / "deeper"),
+                        proc="server", dump_min_s=0.0)
+    srv = InferenceServer()
+    srv.repository.load("m", artifact, warmup=False)
+    port = srv.start()
+    try:
+        # a permanent injected fault crosses the server boundary as a
+        # 500 — the typed error class must reach the client untouched
+        fault.configure(
+            "serving.enqueue:error:class=permanent:n=1")
+        body = json.dumps({"inputs": [_x().tolist()]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/m:predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=60)
+        assert ei.value.code == 500
+        payload = json.loads(ei.value.read())
+        assert payload["error"] == "PermanentFault"
+        assert flightrec.stats()["dump_failures"] >= 1
+        assert "boundary.error" in _names()
+        # and with the fault spent, the server still serves
+        fault.configure(None)
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 200
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# SIGUSR2: wedge dump + re-entrancy
+# ---------------------------------------------------------------------------
+
+def test_sigusr2_dump_contains_stacks_metrics_and_trace_ids(tmp_path):
+    flightrec.configure(ring=32, dir=str(tmp_path), proc="unit")
+    trace.configure(sample=1.0)
+    trace.start_trace("wedge-probe").finish()
+    flightrec.record("lifecycle", "pre-wedge")
+
+    parked = threading.Event()
+    release = threading.Event()
+
+    def park():
+        parked.set()
+        release.wait(30.0)
+
+    t = threading.Thread(target=park, name="parked-worker")
+    t.start()
+    try:
+        parked.wait(5.0)
+        path = flightrec.sigusr2_dump()
+        assert path is not None and os.path.exists(path)
+        payload = json.loads(open(path).read())
+        assert payload["reason"] == "sigusr2"
+        assert any("parked-worker" in k for k in payload["threads"])
+        stack_text = "".join(sum(payload["threads"].values(), []))
+        assert "release.wait" in stack_text       # the wedge, visible
+        assert payload["metrics"] is None or \
+            "providers" in payload["metrics"]
+        assert payload["active_traces"]           # the probe trace id
+        names = [e["name"] for e in payload["events"]]
+        assert "pre-wedge" in names and "sigusr2.dump" in names
+        assert flightrec.stats()["sigusr2_dumps"] == 1
+    finally:
+        release.set()
+        t.join(5.0)
+
+
+def test_sigusr2_reentrant_signal_dropped_and_counted(tmp_path):
+    flightrec.configure(ring=16, dir=str(tmp_path), proc="unit")
+    # simulate "second signal while a dump is in flight"
+    flightrec._dump_state["dumping"] = True
+    try:
+        assert flightrec.sigusr2_dump() is None
+        assert flightrec.stats()["sigusr2_dropped"] == 1
+    finally:
+        flightrec._dump_state["dumping"] = False
+    assert flightrec.sigusr2_dump() is not None
+    assert flightrec.stats()["sigusr2_dumps"] == 1
+
+
+def test_real_sigusr2_signal_delivery(tmp_path):
+    """The actual signal path: install the handler, kill(SIGUSR2) our
+    own pid, and find the dump on disk."""
+    flightrec.configure(ring=16, dir=str(tmp_path), proc="sig")
+    flightrec.record("lifecycle", "armed")
+    assert flightrec.install_signal_handler()
+    old = signal.getsignal(signal.SIGUSR2)
+    try:
+        os.kill(os.getpid(), signal.SIGUSR2)
+        deadline = time.monotonic() + 10.0
+        path = flightrec.dump_path(".sigusr2")
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                break
+            time.sleep(0.02)
+        assert os.path.exists(path)
+        payload = json.loads(open(path).read())
+        assert [e for e in payload["events"] if e["name"] == "armed"]
+    finally:
+        signal.signal(signal.SIGUSR2, old)
+
+
+# ---------------------------------------------------------------------------
+# emitters: the control-plane story lands in the ring
+# ---------------------------------------------------------------------------
+
+def test_fault_injection_mirrors_into_flight_ring():
+    flightrec.configure(ring=64)
+    fault.configure("serving.execute:error:n=1")
+    with pytest.raises(fault.TransientFault):
+        fault.inject("serving.execute", "unit")
+    evs = flightrec.events(category="fault")
+    assert [e.name for e in evs] == ["fault.serving.execute"]
+    assert evs[0].fields["kind"] == "error"
+    assert evs[0].fields["detail"] == "unit"
+
+
+def test_fleet_lifecycle_and_quarantine_events(artifact):
+    from incubator_mxnet_tpu.serving import ReplicaFleet
+    flightrec.configure(ring=256)
+    fleet = ReplicaFleet({"m": artifact}, n=1, backend="thread",
+                         buckets=[1, 2], warmup=False,
+                         probe_ms=60000.0, probe_fails=2).spawn()
+    try:
+        r = fleet.replicas[0]
+        states = [(e.fields["frm"], e.fields["to"])
+                  for e in flightrec.events(name="replica.state")]
+        assert ("starting", "warming") in states
+        assert ("warming", "ready") in states
+        # passive health: two failures quarantine, one success readmits
+        r.note_failure()
+        assert _names(name="replica.quarantined") == []
+        r.note_failure()
+        q = flightrec.events(name="replica.quarantined")
+        assert len(q) == 1 and q[0].fields["replica"] == r.rid
+        assert q[0].severity == "warn"
+        r.note_success()
+        assert len(flightrec.events(name="replica.readmitted")) == 1
+        # model loads rode along
+        assert "model.loaded" in _names(category="lifecycle")
+    finally:
+        fleet.shutdown()
+    states = [(e.fields["frm"], e.fields["to"])
+              for e in flightrec.events(name="replica.state")]
+    # shutdown drains before closing: the full lifecycle is recorded
+    assert ("ready", "draining") in states
+    assert ("draining", "dead") in states
+
+
+def test_router_failover_and_hop_failure_events(artifact):
+    from incubator_mxnet_tpu.serving import FleetRouter, ReplicaFleet
+    flightrec.configure(ring=256)
+    fleet = ReplicaFleet({"m": artifact}, n=2, backend="thread",
+                         buckets=[1, 2], probe_ms=60000.0).spawn()
+    router = FleetRouter(fleet)
+    try:
+        fault.configure("serving.replica_exec:error:n=1")
+        out, _ = router.route("m", (_x(),))
+        hop = flightrec.events(name="router.hop_failed")
+        assert len(hop) == 1
+        assert hop[0].fields["error"] == "TransientFault"
+        fo = flightrec.events(name="router.failover")
+        assert len(fo) == 1 and fo[0].fields["cause"] == "TransientFault"
+        # the injected fault sits in the same ring, before the hop
+        # failure it caused — the self-explaining chaos artifact
+        names = _names()
+        assert (names.index("fault.serving.replica_exec")
+                < names.index("router.hop_failed")
+                < names.index("router.failover"))
+    finally:
+        router.shutdown()
+
+
+def test_admin_verbs_record_scaling_events(artifact):
+    """Satellite: control-plane verbs (:load/:unload/reload) record
+    flight events with their latency — they are no longer dark."""
+    from incubator_mxnet_tpu.serving import FleetRouter, ReplicaFleet
+    flightrec.configure(ring=256)
+    fleet = ReplicaFleet({"m": artifact}, n=1, backend="thread",
+                         buckets=[1, 2], warmup=False,
+                         probe_ms=60000.0).spawn()
+    router = FleetRouter(fleet)
+    port = router.start()
+    try:
+        body = json.dumps({"path": artifact}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/m2:load", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.status == 200
+        loads = flightrec.events(name="fleet.load")
+        assert len(loads) == 1
+        assert loads[0].fields["model"] == "m2"
+        assert loads[0].fields["ms"] > 0
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/m2:reload", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req2, timeout=120) as resp:
+            assert resp.status == 200
+        assert len(flightrec.events(name="fleet.rolling_reload")) == 1
+        req3 = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/m2:unload", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req3, timeout=120) as resp:
+            assert resp.status == 200
+        assert len(flightrec.events(name="fleet.unload")) == 1
+    finally:
+        router.shutdown()
+
+
+def test_autoscaler_decisions_and_scale_from_zero_events(artifact):
+    from incubator_mxnet_tpu.serving import FleetRouter, ReplicaFleet
+    from incubator_mxnet_tpu.serving.autoscaler import (Autoscaler,
+                                                        ModelPolicy)
+    flightrec.configure(ring=512)
+    fleet = ReplicaFleet({}, n=1, backend="thread", buckets=[1, 2],
+                         warmup=False, probe_ms=60000.0).spawn()
+    router = FleetRouter(fleet)
+    scaler = Autoscaler(fleet, router=router,
+                        policies=[ModelPolicy("z", artifact,
+                                              min_replicas=0)],
+                        interval_s=3600.0)
+    try:
+        # scale-from-zero through the routing path: the latency is
+        # attributable from the flight ring alone (satellite 2)
+        out, _ = router.route("z", (_x(),))
+        sfz = flightrec.events(name="scale.from_zero")
+        assert len(sfz) == 1 and sfz[0].fields["ms"] > 0
+        routed = flightrec.events(name="router.scale_from_zero")
+        assert len(routed) == 1 and routed[0].fields["model"] == "z"
+        # the idle decision records the tripping signal
+        scaler.idle_unload_s = 0.0
+        scaler.run_once()
+        dec = flightrec.events(name="scale.decide")
+        assert dec and dec[-1].fields["why"] == "idle"
+        assert dec[-1].fields["model"] == "z"
+        applied = flightrec.events(name="scale.apply")
+        assert applied and applied[-1].fields["action"] == "unload"
+    finally:
+        scaler.stop()
+        router.shutdown()
+
+
+def test_checkpoint_save_restore_fallback_events(tmp_path):
+    from incubator_mxnet_tpu.checkpoint import AsyncCheckpointManager
+    flightrec.configure(ring=256)
+    mgr = AsyncCheckpointManager(str(tmp_path), keep=5)
+    tree = {"w": onp.arange(6, dtype=onp.float32)}
+    mgr.save(1, tree, wait=True)
+    mgr.save(2, tree, wait=True)
+    assert len(flightrec.events(name="checkpoint.save")) == 2
+    mgr.restore()
+    ok = flightrec.events(name="checkpoint.restored")
+    assert ok[-1].fields["step"] == 2
+    assert ok[-1].fields["fell_back"] is False
+    # corrupt the newest shard's data tail: restore falls back, and
+    # the ring tells it
+    shard = next(p for p in os.listdir(tmp_path / "step_00000002")
+                 if p.endswith(".npy"))
+    with open(tmp_path / "step_00000002" / shard, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        f.seek(f.tell() - 4)
+        f.write(b"\xff\xff\xff\xff")
+    mgr.restore()
+    fb = flightrec.events(name="checkpoint.fallback")
+    assert len(fb) == 1 and fb[0].fields["step"] == 2
+    assert fb[0].severity == "warn"
+    ok2 = flightrec.events(name="checkpoint.restored")
+    assert ok2[-1].fields["step"] == 1
+    assert ok2[-1].fields["fell_back"] is True
+
+
+def test_ps_membership_events():
+    from incubator_mxnet_tpu.kvstore.ps_server import PSClient, PSServer
+    flightrec.configure(ring=256)
+    srv = PSServer(num_workers=1)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        c = PSClient("127.0.0.1", srv.port)
+        c.join(rank=0)
+        j = flightrec.events(name="worker.joined")
+        assert len(j) == 1 and j[0].fields["rank"] == 0
+        assert j[0].fields["rejoin"] is False
+        c.leave()
+        left = flightrec.events(name="worker.left")
+        assert len(left) == 1 and left[0].fields["live"] == 0
+    finally:
+        srv.kill()
+        t.join(5.0)
+
+
+def test_compile_storm_event_recorded():
+    from incubator_mxnet_tpu.analysis import recompile as rc
+    flightrec.configure(ring=64)
+    with rc.sentinel_scope("warn", 2):
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for k in range(4):
+                rc.record_compile("flight:unit",
+                                  (("arr", (k, 8), "float32"),))
+    storms = flightrec.events(name="compile.storm")
+    assert storms, "storm crossing must land in the ring"
+    assert storms[0].fields["site"] == "flight:unit"
+    assert storms[0].severity == "warn"
+
+
+def test_session_lifecycle_events(tmp_path):
+    from incubator_mxnet_tpu.serving.sessions import SessionHost
+    flightrec.configure(ring=256)
+    host = SessionHost(snapshot_dir=str(tmp_path))
+    host.add("dec", "toy_decoder:dim=4,max_len=8", warmup=False)
+    mgr = host.get("dec")
+    info = mgr.create("s1")
+    created = flightrec.events(name="session.created")
+    assert len(created) == 1 and created[0].fields["sid"] == "s1"
+    mgr.ttl_s = 0.0
+    time.sleep(0.01)
+    mgr.sweep()
+    ev = flightrec.events(name="session.evicted")
+    assert len(ev) == 1 and ev[0].fields["sid"] == "s1"
+    host.drain_all()
+
+
+# ---------------------------------------------------------------------------
+# /v1/flight + additive healthz/describe block
+# ---------------------------------------------------------------------------
+
+def test_server_flight_endpoint_and_healthz_block(artifact):
+    from incubator_mxnet_tpu.serving import InferenceServer
+    flightrec.configure(ring=128, proc="srv-unit")
+    srv = InferenceServer()
+    srv.repository.load("m", artifact, warmup=False)
+    port = srv.start()
+    try:
+        dump = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/flight", timeout=30).read())
+        assert dump["flight"] == 1 and dump["proc"] == "server"
+        names = [e["name"] for e in dump["events"]]
+        assert "model.loaded" in names
+        health = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30).read())
+        assert set(health["flight"]) == {"ring", "events", "evictions",
+                                         "dumps"}
+    finally:
+        srv.shutdown()
+
+
+def test_router_flight_endpoint_and_describe_block(artifact):
+    from incubator_mxnet_tpu.serving import FleetRouter, ReplicaFleet
+    flightrec.configure(ring=128)
+    fleet = ReplicaFleet({"m": artifact}, n=1, backend="thread",
+                         buckets=[1, 2], warmup=False,
+                         probe_ms=60000.0).spawn()
+    router = FleetRouter(fleet)
+    port = router.start()
+    try:
+        dump = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/flight", timeout=30).read())
+        assert dump["flight"] == 1 and dump["proc"] == "router"
+        assert [e for e in dump["events"]
+                if e["name"] == "replica.state"]
+        _, health = router.health()
+        assert "flight" in health
+        assert "flight" in router.describe()
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# postmortem tool
+# ---------------------------------------------------------------------------
+
+def _flight_dump_file(tmp_path, name, proc, events):
+    p = tmp_path / name
+    p.write_text(json.dumps({
+        "flight": 1, "proc": proc, "pid": 1,
+        "events": [
+            {"ts_us": ts, "category": cat, "name": nm,
+             "severity": sev, "fields": fields, "trace_id": tid}
+            for ts, cat, nm, sev, fields, tid in events]}))
+    return str(p)
+
+
+def test_postmortem_merges_and_orders_across_processes(tmp_path):
+    a = _flight_dump_file(tmp_path, "a.json", "router", [
+        (2_000_000, "health", "router.hop_failed", "warn",
+         {"replica": "r0"}, None),
+        (3_000_000, "health", "replica.quarantined", "warn",
+         {"replica": "r0"}, None)])
+    b = _flight_dump_file(tmp_path, "b.json", "replica", [
+        (1_000_000, "lifecycle", "model.loaded", "info",
+         {"model": "m"}, None)])
+    proc = subprocess.run(
+        [sys.executable, POSTMORTEM, a, b], capture_output=True,
+        text=True)
+    assert proc.returncode == 0, proc.stderr
+    lines = [ln for ln in proc.stdout.splitlines() if "+" in ln]
+    assert "model.loaded" in lines[0]          # wall order wins
+    assert "replica.quarantined" in lines[-1]
+    assert "2 process(es)" in proc.stdout
+
+
+def test_postmortem_gate_orders_and_fails_typed(tmp_path):
+    d = _flight_dump_file(tmp_path, "d.json", "router", [
+        (1_000_000, "fault", "fault.serving.replica_exec", "warn",
+         {}, None),
+        (2_000_000, "health", "replica.quarantined", "warn", {}, None),
+    ])
+    ok = subprocess.run(
+        [sys.executable, POSTMORTEM, d, "--gate",
+         "fault.serving.replica_exec,replica.quarantined"],
+        capture_output=True, text=True)
+    assert ok.returncode == 0 and "gate ok" in ok.stdout
+    bad = subprocess.run(
+        [sys.executable, POSTMORTEM, d, "--gate",
+         "replica.quarantined,fault.serving.replica_exec"],
+        capture_output=True, text=True)
+    assert bad.returncode == 1
+    assert "out of order" in bad.stderr
+    missing = subprocess.run(
+        [sys.executable, POSTMORTEM, d, "--gate", "no.such.event"],
+        capture_output=True, text=True)
+    assert missing.returncode == 1 and "absent" in missing.stderr
+
+
+def test_postmortem_incident_narrowing_and_report(tmp_path):
+    d = _flight_dump_file(tmp_path, "d.json", "router", [
+        (1_000_000, "lifecycle", "far.before", "info", {}, None),
+        (100_000_000, "fault", "fault.serving.route", "warn", {},
+         None),
+        (100_100_000, "health", "router.hop_failed", "warn",
+         {"replica": "r7"}, None),
+        (100_200_000, "lifecycle", "boundary.error", "error",
+         {"boundary": "router", "error": "ReplicaUnavailableError"},
+         None),
+        (200_000_000, "lifecycle", "far.after", "info", {}, None)])
+    proc = subprocess.run(
+        [sys.executable, POSTMORTEM, d, "--incident", "r7",
+         "--report", "--json", str(tmp_path / "out.json")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "terminal event" in out
+    assert "boundary.error" in out
+    assert "correlated fault injections" in out
+    assert "far.before" not in out and "far.after" not in out
+    payload = json.loads((tmp_path / "out.json").read_text())
+    assert payload["report"]["terminal"]["name"] == "boundary.error"
+    # trace-dump auto-detection rides the same merge
+    tdump = tmp_path / "t.json"
+    tdump.write_text(json.dumps({"traceEvents": [
+        {"name": "router.hop", "ph": "X", "ts": 100_050_000,
+         "dur": 100, "args": {"trace_id": "ab" * 8, "span_id": "s",
+                              "service": "router",
+                              "outcome": "TransientFault"}}]}))
+    proc2 = subprocess.run(
+        [sys.executable, POSTMORTEM, d, str(tdump), "--incident",
+         "r7"], capture_output=True, text=True)
+    assert proc2.returncode == 0 and "router.hop" in proc2.stdout
+    # a dump that is neither kind fails loudly, never silently skipped
+    garbage = tmp_path / "g.json"
+    garbage.write_text("{}")
+    proc3 = subprocess.run(
+        [sys.executable, POSTMORTEM, str(garbage)],
+        capture_output=True, text=True)
+    assert proc3.returncode != 0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: SIGKILL a replica, reconstruct the incident
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_sigkill_postmortem_reconstructs_incident(artifact, tmp_path):
+    """The ISSUE 15 acceptance drive: SIGKILL a subprocess replica
+    mid-volley, collect the router's crash-triggered dump plus the
+    survivors' /v1/flight, and postmortem --report/--gate must
+    reconstruct injected fault → typed failed hop → quarantine →
+    winning failover → readmit as ONE ordered cross-process
+    timeline."""
+    from incubator_mxnet_tpu.serving import FleetRouter, ReplicaFleet
+    flightrec.configure(ring=1024, dir=str(tmp_path), proc="router",
+                        dump_min_s=0.0)
+    fleet = ReplicaFleet({"m": artifact}, n=2, backend="process",
+                         probe_ms=60000.0, probe_fails=1).spawn()
+    router = FleetRouter(fleet)
+    port = router.start()
+    try:
+        body = json.dumps({"inputs": [_x().tolist()]}).encode()
+
+        def predict():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/models/m:predict",
+                data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.status, json.loads(resp.read())
+
+        # healthy volley first: the meta cache + request paths warm
+        for _ in range(4):
+            status, _out = predict()
+            assert status == 200
+        ref = predict()[1]["outputs"]
+
+        # SIGKILL one replica's PROCESS directly (no fleet bookkeeping
+        # — the router must DISCOVER the death through a failed hop);
+        # arm ONE injected fault so the surviving replica's first hop
+        # fails typed too — both replicas quarantine (probe_fails=1),
+        # the last-resort pick re-offers the survivor, the hop wins,
+        # the survivor readmits
+        r0 = fleet.get("r0")
+        os.kill(r0._proc.pid, signal.SIGKILL)
+        r0._proc.wait(10.0)
+        # after=1: the first replica_exec fire (the hop that discovers
+        # r0's corpse) passes through; the SECOND — the survivor's
+        # first hop — takes the injected fault
+        fault.configure("serving.replica_exec:error:n=1:after=1")
+        status, out = predict()
+        assert status == 200
+        assert out["outputs"] == ref        # failover, bitwise intact
+        # the discovery landed in the ring as the unexpected-exit
+        # anchor event a postmortem hangs the replica death on
+        exited = flightrec.events(name="replica.exited")
+        assert exited and exited[0].fields["replica"] == "r0"
+        assert exited[0].fields["rc"] == -signal.SIGKILL
+
+        # crash-triggered dump: one more request with an injected
+        # route fault that crosses the router's top boundary as a
+        # typed 503 — the response stays typed AND the black box wrote
+        # its dump
+        fault.configure("serving.route:error:n=1")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            predict()
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["error"] == "TransientFault"
+        fault.configure(None)
+        crash_dump = flightrec.dump_path()
+        assert crash_dump is not None and os.path.exists(crash_dump)
+
+        # survivors' live rings over HTTP
+        dumps = [crash_dump]
+        for r in fleet.replicas:
+            if r.state == "dead":
+                continue
+            raw = urllib.request.urlopen(
+                f"http://127.0.0.1:{r.port}/v1/flight",
+                timeout=30).read()
+            p = tmp_path / f"{r.rid}.flight.json"
+            p.write_text(raw.decode())
+            dumps.append(str(p))
+        assert len(dumps) == 2              # router + the survivor
+
+        # the ordered reconstruction, gated exactly as the CI stage
+        # will gate it
+        proc = subprocess.run(
+            [sys.executable, POSTMORTEM, *dumps, "--report", "--gate",
+             "fault.serving.replica_exec,router.hop_failed,"
+             "replica.quarantined,router.failover,"
+             "replica.readmitted"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        assert "gate ok" in proc.stdout
+        # the raw merged timeline carries the whole story: the killed
+        # replica's state flip to dead, the survivor's quarantine, and
+        # the survivor's own lifecycle (model load) interleaved from
+        # its process's ring
+        plain = subprocess.run(
+            [sys.executable, POSTMORTEM, *dumps],
+            capture_output=True, text=True)
+        assert plain.returncode == 0
+        assert "replica.exited" in plain.stdout   # r0's SIGKILL
+        assert plain.stdout.count("replica.quarantined") >= 2
+        assert "model.loaded" in plain.stdout
+        assert "2 process(es)" in plain.stdout
+        # narrowing by the dead replica's id keeps its window only
+        narrowed = subprocess.run(
+            [sys.executable, POSTMORTEM, *dumps, "--incident", "r0"],
+            capture_output=True, text=True)
+        assert narrowed.returncode == 0
+        assert "replica.exited" in narrowed.stdout
+    finally:
+        router.shutdown()

@@ -4,6 +4,7 @@ example/rnn's LSTM LM).  SSD has its own suite in test_contrib_det.py;
 TransformerLM sharding is covered in test_parallel.py.
 """
 import numpy as onp
+import pytest
 
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import nd, autograd, gluon
@@ -104,6 +105,8 @@ def test_bert_amp_bf16_conversion():
     assert corr > 0.98, corr
 
 
+@pytest.mark.slow   # ~69 s convergence run: the tier-1 budget's top
+                    # hog (ISSUE 15 relief); the `slow` CI stage keeps it
 def test_lstm_lm_overfits():
     from incubator_mxnet_tpu.models.lstm_lm import LSTMLanguageModel
     rng = onp.random.RandomState(4)

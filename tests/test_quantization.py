@@ -166,6 +166,8 @@ def test_int8_dot_reaches_xla():
         assert str(eq.outvars[0].aval.dtype) == "int32"
 
 
+@pytest.mark.slow   # ~39 s fresh-python example subprocess: tier-1
+                    # budget relief (ISSUE 15); the `slow` CI stage keeps it
 def test_quantize_resnet_example_end_to_end():
     """VERDICT r3 Next #5: the full calibrate -> int8-convert -> infer
     flow at model-zoo scale, via the shipped example (reduced size for

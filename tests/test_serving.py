@@ -461,7 +461,12 @@ def test_healthz_structured_state_json_shape(artifact):
     """Pin the /healthz JSON shape: per-model state must distinguish
     loading (warming, do not admit) / ready / draining, with queue
     depth — the contract fleet probes and rolling reload route on."""
+    from incubator_mxnet_tpu import flightrec
     from incubator_mxnet_tpu.serving.server import health_body
+    # the always-on flight recorder is additive the same way tracing
+    # is: with recording off the PR 3 bare shape below stays pinned
+    # exactly; the flight-on additive subshape is pinned at the end
+    flightrec.configure(ring=0)
     repo = ModelRepository(metrics=ServingMetrics())
     try:
         repo.load("mlp", artifact, warmup=False)
@@ -510,8 +515,21 @@ def test_healthz_structured_state_json_shape(artifact):
                                "models", "trace"}
             assert set(b5["trace"]) == {"sample", "ring", "spans",
                                         "dropped", "slow_k"}
+            # flight recorder: additive the same way — the key appears
+            # only once recording is on AND something recorded, with
+            # this exact subshape (docs/observability.md)
+            flightrec.configure(ring=64)
+            _, b6 = health_body(repo, time.monotonic())
+            assert "flight" not in b6          # nothing recorded yet
+            flightrec.record("lifecycle", "shape-pin")
+            _, b7 = health_body(repo, time.monotonic())
+            assert set(b7) == {"status", "uptime_s", "queue_depth",
+                               "models", "trace", "flight"}
+            assert set(b7["flight"]) == {"ring", "events", "evictions",
+                                         "dumps"}
         finally:
             trace.reset()
+            flightrec.reset()
     finally:
         repo.drain_all()
 

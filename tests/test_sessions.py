@@ -680,12 +680,17 @@ def test_http_healthz_sessions_shape_pinned(server, tmp_path):
     assert dec["state"] == "ready"
     assert dec["buckets"] == BUCKETS
     assert dec["compile_count"] == len(BUCKETS)
-    # the bare health_body (no sessions host) keeps the PR 8 shape —
-    # additive, never breaking existing probers
+    # the bare health_body (no sessions host, flight recording off)
+    # keeps the PR 8 shape — additive, never breaking existing probers
+    from incubator_mxnet_tpu import flightrec
     from incubator_mxnet_tpu.serving.model_repository import \
         ModelRepository
     repo = ModelRepository(metrics=ServingMetrics())
-    code, bare = health_body(repo, time.monotonic())
+    flightrec.configure(ring=0)
+    try:
+        code, bare = health_body(repo, time.monotonic())
+    finally:
+        flightrec.reset()
     assert "sessions" not in bare
     assert set(bare) == {"status", "uptime_s", "queue_depth", "models"}
 

@@ -247,10 +247,12 @@ def test_fleet_metrics_route_exemplars():
 # ---------------------------------------------------------------------------
 
 def test_healthz_trace_block_additive():
+    from incubator_mxnet_tpu import flightrec
     from incubator_mxnet_tpu.serving.model_repository import \
         ModelRepository
     from incubator_mxnet_tpu.serving.server import health_body
     repo = ModelRepository()
+    flightrec.configure(ring=0)    # flight off: the PR 3 bare shape
     try:
         # bare server: pinned PR 3 shape, no "trace" key
         _, body = health_body(repo, time.monotonic())
@@ -263,6 +265,7 @@ def test_healthz_trace_block_additive():
         assert set(body2["trace"]) == {"sample", "ring", "spans",
                                        "dropped", "slow_k"}
     finally:
+        flightrec.reset()
         repo.drain_all()
 
 

@@ -1,0 +1,335 @@
+#!/usr/bin/env python
+"""postmortem: reconstruct one incident from N processes' black boxes.
+
+Every process keeps an always-on flight-recorder ring
+(``incubator_mxnet_tpu/flightrec.py``): control-plane events — replica
+state transitions, quarantines, scaling decisions, evictions,
+membership changes, compile storms, fault injections — dumped on typed
+boundary errors, on ``SIGUSR2``, or served live at ``GET /v1/flight``.
+This tool merges any number of those dumps (files or URLs), plus
+optional request-trace dumps (``GET /v1/trace`` Chrome trace-event
+JSON, auto-detected), into ONE causal timeline ordered by the shared
+wall-clock anchors, then answers "what happened":
+
+* default      — the merged timeline, one line per event/span;
+* ``--incident X`` — narrow to the relevant window: ``X`` is a trace
+  id (keep that trace's window), any field value such as a replica id
+  (keep the window around events mentioning it), or an explicit
+  ``t0..t1`` wall-seconds range;
+* ``--report`` — a structured diagnosis: the terminal (last error)
+  event, the last N events per category leading up to it, correlated
+  fault injections, and compile storms in the window;
+* ``--gate a,b,c`` — CI assertion: the named events must appear as an
+  ordered subsequence of the merged timeline (exit 1 otherwise) — "the
+  dump must contain the injected fault and the quarantine that
+  followed", made checkable.
+
+Stdlib-only and jax-free (usable on a laptop against a dead fleet's
+dump directory).  Clock skew between hosts shows up as offset, never
+as reordering within a process — same contract as traceview.
+
+Usage::
+
+    python tools/postmortem.py dumps/*.flight.json
+    python tools/postmortem.py router-123.flight.json \
+        http://replica0:P0/v1/flight http://replica1:P1/v1/flight \
+        --incident r0 --report
+    python tools/postmortem.py dumps/* --gate \
+        fault.serving.replica_exec,router.hop_failed,replica.quarantined
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load(source):
+    """One dump — file path or http(s) URL — as a parsed payload."""
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+        with urllib.request.urlopen(source, timeout=30) as resp:
+            return json.loads(resp.read())
+    with open(source) as f:
+        return json.load(f)
+
+
+def normalize(payload, source):
+    """One payload → a list of uniform records::
+
+        {ts, proc, kind, category, name, severity, fields, trace_id,
+         dur_us}
+
+    ``ts`` is wall microseconds (both dump kinds export via their
+    process's single wall anchor, so records from different processes
+    interleave correctly).  Flight dumps carry ``"flight": 1``; trace
+    dumps carry ``"traceEvents"``; anything else is rejected loudly —
+    a silently-skipped dump would read as "nothing happened there".
+    """
+    records = []
+    if isinstance(payload, dict) and payload.get("flight"):
+        proc = f"{payload.get('proc', '?')}-{payload.get('pid', '?')}"
+        for e in payload.get("events", []):
+            records.append({
+                "ts": int(e.get("ts_us", 0)),
+                "proc": proc,
+                "kind": "flight",
+                "category": e.get("category", "?"),
+                "name": e.get("name", "?"),
+                "severity": e.get("severity", "info"),
+                "fields": e.get("fields") or {},
+                "trace_id": e.get("trace_id"),
+                "dur_us": None,
+            })
+        return records
+    if isinstance(payload, dict) and "traceEvents" in payload:
+        for e in payload["traceEvents"]:
+            args = e.get("args") or {}
+            outcome = args.get("outcome", "ok")
+            records.append({
+                "ts": int(e.get("ts", 0)),
+                "proc": str(args.get("service", "?")),
+                "kind": "span" if e.get("ph") == "X" else "span_event",
+                "category": "trace",
+                "name": e.get("name", "?"),
+                "severity": ("info" if outcome in ("ok", None)
+                             else "error"),
+                "fields": {k: v for k, v in args.items()
+                           if k not in ("trace_id", "span_id",
+                                        "parent_id", "service")
+                           and v is not None},
+                "trace_id": args.get("trace_id"),
+                "dur_us": e.get("dur") if e.get("ph") == "X" else None,
+            })
+        return records
+    raise ValueError(
+        f"{source}: neither a flight dump ('flight': 1) nor a trace "
+        "dump ('traceEvents') — refusing to silently skip it")
+
+
+def merge(sources):
+    records = []
+    for src in sources:
+        records.extend(normalize(load(src), src))
+    records.sort(key=lambda r: (r["ts"], r["proc"]))
+    return records
+
+
+# ---------------------------------------------------------------------------
+# incident narrowing
+# ---------------------------------------------------------------------------
+
+def _mentions(r, needle):
+    if r["trace_id"] == needle or r["name"] == needle:
+        return True
+    return any(str(v) == needle for v in r["fields"].values())
+
+
+def narrow(records, incident, pad_s=0.5):
+    """Keep the records relevant to ``incident``:
+
+    * ``t0..t1``  — explicit wall-seconds window;
+    * a trace id / replica id / any field value — the window spanned
+      by the records that mention it, padded by ``pad_s`` either side
+      (context from OTHER processes inside the window is kept — that
+      is the point of a cross-process reconstruction).
+    """
+    if ".." in incident:
+        lo_s, _, hi_s = incident.partition("..")
+        try:
+            lo, hi = float(lo_s) * 1e6, float(hi_s) * 1e6
+        except ValueError:
+            raise SystemExit(
+                f"--incident {incident!r}: t0..t1 must be wall "
+                "seconds (floats)")
+        return [r for r in records if lo <= r["ts"] <= hi]
+    hits = [r for r in records if _mentions(r, incident)]
+    if not hits:
+        return []
+    lo = min(r["ts"] for r in hits) - int(pad_s * 1e6)
+    hi = max(r["ts"] for r in hits) + int(pad_s * 1e6)
+    return [r for r in records if lo <= r["ts"] <= hi]
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+_FIELD_SKIP = {"outcome"}
+
+
+def _fmt_fields(fields):
+    keep = {k: v for k, v in fields.items()
+            if k not in _FIELD_SKIP and v is not None}
+    if not keep:
+        return ""
+    return " " + " ".join(f"{k}={v}" for k, v in sorted(keep.items()))
+
+
+def render(records, out=sys.stdout):
+    if not records:
+        print("no records", file=out)
+        return
+    t0 = records[0]["ts"]
+    procs = sorted({r["proc"] for r in records})
+    print(f"{len(records)} record(s) across {len(procs)} process(es): "
+          f"{', '.join(procs)}", file=out)
+    for r in records:
+        off_ms = (r["ts"] - t0) / 1000.0
+        dur = (f" ({r['dur_us'] / 1000.0:.3f}ms)"
+               if r["dur_us"] else "")
+        sev = {"info": " ", "warn": "!", "error": "E"}[r["severity"]]
+        tid = f" ~{r['trace_id'][:8]}" if r["trace_id"] else ""
+        print(f"  +{off_ms:10.3f}ms {sev} [{r['proc']:>14s}] "
+              f"{r['category']:>10s}  {r['name']}{dur}"
+              f"{_fmt_fields(r['fields'])}{tid}", file=out)
+
+
+# ---------------------------------------------------------------------------
+# --report: structured diagnosis
+# ---------------------------------------------------------------------------
+
+def diagnose(records, last_n=5):
+    """The postmortem narrative as data: terminal event, the lead-up
+    per category, correlated fault injections, compile storms."""
+    if not records:
+        return {"terminal": None, "lead_up": {}, "faults": [],
+                "compile_storms": [], "errors": 0}
+    errors = [r for r in records if r["severity"] == "error"]
+    terminal = errors[-1] if errors else records[-1]
+    before = [r for r in records if r["ts"] <= terminal["ts"]]
+    lead_up = {}
+    for r in before:
+        lead_up.setdefault(r["category"], []).append(r)
+    lead_up = {cat: rs[-last_n:] for cat, rs in sorted(lead_up.items())}
+    return {
+        "terminal": terminal,
+        "lead_up": lead_up,
+        "faults": [r for r in records
+                   if r["category"] == "fault"
+                   or r["name"].startswith("fault.")],
+        "compile_storms": [r for r in records
+                           if r["name"] == "compile.storm"],
+        "errors": len(errors),
+    }
+
+
+def _line(r):
+    return (f"{r['ts'] / 1e6:.6f}s [{r['proc']}] {r['category']}:"
+            f"{r['name']}{_fmt_fields(r['fields'])}")
+
+
+def print_report(diag, out=sys.stdout):
+    t = diag["terminal"]
+    print("== postmortem report ==", file=out)
+    if t is None:
+        print("no records — nothing to diagnose", file=out)
+        return
+    print(f"terminal event ({diag['errors']} error(s) total):",
+          file=out)
+    print(f"  {_line(t)}  [{t['severity']}]", file=out)
+    print(f"\nlead-up (last events per category before the terminal "
+          f"event):", file=out)
+    for cat, rs in diag["lead_up"].items():
+        print(f"  [{cat}]", file=out)
+        for r in rs:
+            mark = {"info": "", "warn": "  !", "error": "  !!"}[
+                r["severity"]]
+            print(f"    {_line(r)}{mark}", file=out)
+    if diag["faults"]:
+        print(f"\ncorrelated fault injections "
+              f"({len(diag['faults'])}):", file=out)
+        for r in diag["faults"][-10:]:
+            print(f"  {_line(r)}", file=out)
+    if diag["compile_storms"]:
+        print(f"\ncompile storms in the window "
+              f"({len(diag['compile_storms'])}):", file=out)
+        for r in diag["compile_storms"][-10:]:
+            print(f"  {_line(r)}", file=out)
+
+
+# ---------------------------------------------------------------------------
+# --gate: CI assertion
+# ---------------------------------------------------------------------------
+
+def gate(records, names):
+    """The named events must appear as an ordered subsequence of the
+    merged timeline.  Returns (ok, detail)."""
+    want = list(names)
+    i = 0
+    matched = []
+    for r in records:
+        if i < len(want) and r["name"] == want[i]:
+            matched.append((want[i], r["ts"], r["proc"]))
+            i += 1
+    if i == len(want):
+        return True, matched
+    present = {r["name"] for r in records}
+    missing = want[i]
+    hint = ("present somewhere but out of order"
+            if missing in present else "absent from every dump")
+    return False, (f"gate failed at step {i + 1}/{len(want)}: "
+                   f"{missing!r} {hint}; matched so far: "
+                   f"{[m[0] for m in matched]}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="merge + reconstruct mxnet-tpu flight dumps")
+    p.add_argument("sources", nargs="+",
+                   help="flight/trace dumps: files or /v1/flight "
+                        "(/v1/trace) URLs")
+    p.add_argument("--incident", default=None, metavar="X",
+                   help="narrow to a trace id, a replica/field value, "
+                        "or an explicit t0..t1 wall-seconds window")
+    p.add_argument("--pad", type=float, default=0.5,
+                   help="context window padding (s) around an "
+                        "incident match")
+    p.add_argument("--report", action="store_true",
+                   help="structured diagnosis instead of the raw "
+                        "timeline")
+    p.add_argument("--last", type=int, default=5, metavar="N",
+                   help="--report: lead-up events kept per category")
+    p.add_argument("--gate", default=None, metavar="EV1,EV2,...",
+                   help="exit 1 unless the named events appear in "
+                        "this order in the merged timeline")
+    p.add_argument("--json", default=None, metavar="OUT",
+                   help="also write the merged records (and the "
+                        "report, with --report) as JSON")
+    args = p.parse_args(argv)
+
+    records = merge(args.sources)
+    if args.incident:
+        records = narrow(records, args.incident, pad_s=args.pad)
+        if not records:
+            print(f"incident {args.incident!r} matched nothing in "
+                  f"{len(args.sources)} dump(s)", file=sys.stderr)
+            return 1
+
+    payload = {"records": records}
+    if args.report:
+        diag = diagnose(records, last_n=args.last)
+        print_report(diag)
+        payload["report"] = diag
+    else:
+        render(records)
+
+    rc = 0
+    if args.gate:
+        names = [n for n in args.gate.split(",") if n]
+        ok, detail = gate(records, names)
+        if ok:
+            print(f"gate ok: {' -> '.join(n for n, _t, _p in detail)}")
+        else:
+            print(f"GATE FAIL: {detail}", file=sys.stderr)
+            rc = 1
+        payload["gate"] = {"names": names, "ok": ok}
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(payload, f)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
