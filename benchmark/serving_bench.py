@@ -575,6 +575,131 @@ def flight_overhead(args):
     return rec, failures
 
 
+def routerha_overhead(args):
+    """Router-HA overhead gate (docs/serving.md "Router high
+    availability"): the router path volleyed HA-off / HA-on (leased
+    member of a two-wide membership, beat thread running against a
+    file store) / HA-off.  The off/off spread is the noise band;
+    HA-on must sit inside it — the stateless route path never touches
+    the lease store, so the only candidate costs are the background
+    beat thread and the attach itself.  The per-session-request cost
+    (``owner_of``: registry scan + consistent-hash ring lookup) is
+    microbenched directly and gated < 50 µs."""
+    import shutil
+    from incubator_mxnet_tpu.serving.routerha import (FileLeaseStore,
+                                                      RouterHA)
+
+    router, volley, parity_of, total = _overhead_rig(
+        args, "serving_routerha_model", seed=11)
+    store_dir = os.path.join(args.workdir, "serving_routerha_store")
+    shutil.rmtree(store_dir, ignore_errors=True)
+    failures = []
+    ha = None
+    try:
+        volley()                       # warm the route path off-clock
+        off1, _res, err1 = volley()
+        store = FileLeaseStore(store_dir)
+        # a fake second member makes the membership two-wide so every
+        # sweep and every ownership lookup does real multi-router
+        # work; its registry carries the microbench sids so owner_of
+        # below exercises the common (registry-hit) path
+        store.publish({"router_id": "bench-peer", "addr": None,
+                       "deadline": time.monotonic() + 3600.0,
+                       "ttl_s": 3600.0, "epoch": 1,
+                       "sessions": {f"bench-sid-{k}": "bench"
+                                    for k in range(256)},
+                       "fleet": None})
+        ha = RouterHA("bench-r1", store, lease_ttl_s=1.0,
+                      addr="127.0.0.1:0")
+        ha.attach(router)
+        ha.start()
+        on_rps, on_results, err2 = volley()
+        on_beats = ha.describe()["counters"]["beats"]
+        ha.stop(leave=True)
+        router.ha = None
+        router.fleet.membership = None
+        ha = None
+        off2, _res, err3 = volley()
+        if err1 or err2 or err3:
+            failures.append(f"failed requests: "
+                            f"{(err1 + err2 + err3)[:1]}")
+        parity = parity_of(on_results)
+        # the per-session-request cost: one owner_of lookup — the
+        # common path hits a peer's published registry (dict lookups
+        # only); the miss path additionally builds the consistent-hash
+        # ring (64 sha1 vnodes per member), paid only by unknown or
+        # orphaned sids
+        ha2 = RouterHA("bench-r1", store, lease_ttl_s=60.0,
+                       addr="127.0.0.1:0").attach(router)
+        ha2.beat_once()
+        n = 20_000
+        t0 = time.monotonic()
+        for k in range(n):
+            ha2.owner_of(f"bench-sid-{k % 256}")
+        owner_ns = (time.monotonic() - t0) / n * 1e9
+        n_miss = 2_000
+        t0 = time.monotonic()
+        for k in range(n_miss):
+            ha2.owner_of(f"orphan-sid-{k % 256}")
+        owner_miss_ns = (time.monotonic() - t0) / n_miss * 1e9
+        ha2.stop(leave=True)
+        router.ha = None
+        router.fleet.membership = None
+    finally:
+        if ha is not None:
+            ha.stop(leave=True)
+        router.ha = None
+        if getattr(router, "fleet", None) is not None:
+            router.fleet.membership = None
+        router.shutdown()
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+    off_best = max(off1, off2)
+    rec = {
+        "metric": "serving_routerha_overhead",
+        "value": round(off_best, 2),
+        "unit": "req/s",
+        "routerha_off_rps": round(off_best, 2),
+        "routerha_off_noise_pct": round(
+            abs(off1 - off2) / off_best * 100.0, 2),
+        "routerha_on_rps": round(on_rps, 2),
+        "routerha_on_overhead_pct": round(
+            (1.0 - on_rps / off_best) * 100.0, 2),
+        "routerha_on_beats": on_beats,
+        "owner_lookup_ns": round(owner_ns, 1),
+        "owner_lookup_miss_ns": round(owner_miss_ns, 1),
+        "bitwise_equal_with_ha": bool(parity),
+        "requests_per_volley": total,
+        "platform": os.environ.get("JAX_PLATFORMS", "tpu"),
+    }
+    if args.check:
+        if not parity:
+            failures.append("outputs with router HA on != unbatched "
+                            "baseline")
+        if on_beats <= 0:
+            failures.append("HA-on volley recorded no lease beats")
+        # the common (registry-hit) lookup is dict reads only; 50µs
+        # is a generous ceiling even on loaded CI boxes.  The miss
+        # path builds the ring — gate it at 2ms so a vnode blowup or
+        # an accidental store read on the request path still fails.
+        if owner_ns > 50_000:
+            failures.append(
+                f"owner_of lookup {owner_ns:.0f}ns > 50µs")
+        if owner_miss_ns > 2_000_000:
+            failures.append(
+                f"owner_of ring-miss lookup {owner_miss_ns:.0f}ns "
+                f"> 2ms")
+        # the route path never touches the store: HA-on must be flat
+        # within the measurement noise (same generous floor as the
+        # trace/flight gates — CPU CI boxes jitter)
+        band = max(3.0 * rec["routerha_off_noise_pct"], 10.0)
+        if rec["routerha_on_overhead_pct"] > band:
+            failures.append(
+                f"router-HA overhead {rec['routerha_on_overhead_pct']}%"
+                f" outside the noise band ({band:.1f}%)")
+    return rec, failures
+
+
 def smoke(args):
     """CI serving stage: ephemeral HTTP server end-to-end."""
     prefix = os.path.join(args.workdir, "serving_smoke_model")
@@ -726,6 +851,10 @@ def main(argv=None):
                    help="flight-recorder overhead gate: ring-off/"
                         "ring-on/ring-off router volleys + emitter "
                         "microbench (docs/observability.md)")
+    p.add_argument("--routerha-check", action="store_true",
+                   help="router-HA overhead gate: off/leased-member/"
+                        "off router volleys + owner_of microbench "
+                        "(docs/serving.md)")
     p.add_argument("--backend", choices=("thread", "process"),
                    default="process",
                    help="replica backend for --replicas mode")
@@ -737,6 +866,8 @@ def main(argv=None):
         rec, failures = trace_overhead(args)
     elif args.flight_check:
         rec, failures = flight_overhead(args)
+    elif args.routerha_check:
+        rec, failures = routerha_overhead(args)
     elif args.replicas:
         rec, failures = fleet_bench(args)
     elif args.smoke:

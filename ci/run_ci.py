@@ -83,6 +83,18 @@ Stages (each isolated, failures collected, nonzero exit if any fail):
              router volley flat within noise, emitter microbench
              < 2 µs, bitwise parity)
 
+  routerha   highly-available router tier sweep (docs/serving.md
+             "Router high availability"): tests/test_routerha.py —
+             lease join/renew/expire, consistent-hash ring stability,
+             bounded X-MXNET-ROUTER forward hops, crash takeover with
+             bitwise session resume, the SIGKILL-a-router-mid-stream
+             subprocess end-to-end gated by postmortem --gate — under
+             a pinned seeded spec (jittered lease beats and forward
+             hops, retried decode-step faults), full pytest output
+             teed to .ci_routerha_stage.log; then serving_bench
+             --routerha-check (leased-member volley flat within noise
+             of HA-off, owner_of microbench, bitwise parity)
+
   trace      request-scoped tracing sweep (docs/observability.md):
              tests/test_trace.py under a pinned seeded spec — span
              recorder semantics, header-propagation edge cases, ring
@@ -476,6 +488,67 @@ def stage_flight(args):
                   f"{rec['bitwise_equal_with_flight']}")
 
 
+# Pinned router-HA chaos spec: jittered lease beats and forward hops
+# (the membership layer must tolerate a laggy store and a slow peer
+# without spurious expiry) plus retried decode-step faults (absorbed by
+# the router's failover machinery — the HA battery's bitwise
+# continuation contracts must hold with replica faults landing).
+# Delay-only on the HA points: a lease beat that ERRORS is a scenario
+# the battery stages deterministically (typed RouterLeaseError tests);
+# injecting it at random would race those pins.  Seeded so a failure
+# replays from the spec string alone.
+ROUTERHA_SPEC = ("serving.router_lease:delay:ms=2:p=0.2:seed=41,"
+                 "serving.router_forward:delay:ms=2:p=0.2:seed=43,"
+                 "serving.session_step:error:p=0.05:seed=23")
+
+
+def stage_routerha(args):
+    """Router-HA sweep (docs/serving.md "Router high availability"):
+    the whole test_routerha.py battery — forward-header hygiene,
+    ring stability, lease store semantics, expire/rejoin obituaries,
+    crash takeover with bitwise resume, HTTP forward hop + loop
+    bounds, the restore-vs-snapshotter race 20/20, and the
+    SIGKILL-a-router-mid-stream subprocess end-to-end (postmortem
+    --gate asserts lease.expired → takeover.started →
+    session.restored) — under the pinned seeded spec with FULL pytest
+    output teed to a log; then the serving_bench overhead gate (a
+    leased two-wide member within noise of HA-off, owner_of
+    microbench, bitwise parity)."""
+    log = os.path.join(REPO, ".ci_routerha_stage.log")
+    proc = sh([sys.executable, "-m", "pytest", "-q",
+               "tests/test_routerha.py",
+               "--continue-on-collection-errors",
+               "-p", "no:cacheprovider"],
+              timeout=1800, env={"MXNET_FAULT_SPEC": ROUTERHA_SPEC,
+                                 "MXNET_SERVING_RETRIES": "6"})
+    with open(log, "w") as f:
+        f.write(proc.stdout or "")
+        if proc.stderr:
+            f.write("\n--- stderr ---\n" + proc.stderr)
+    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+    if proc.returncode != 0:
+        return False, (f"spec={ROUTERHA_SPEC!r}: {tail} "
+                       f"(full output: {log})")
+    out = os.path.join(REPO, ".ci_routerha_bench.json")
+    try:
+        proc2 = sh([sys.executable, "benchmark/serving_bench.py",
+                    "--routerha-check", "--check", "--requests", "32",
+                    "--rounds", "2", "--output", out], timeout=900)
+        if proc2.returncode != 0:
+            return False, (proc2.stderr or proc2.stdout).strip()[-400:]
+        with open(out) as f:
+            rec = json.load(f)
+    finally:
+        if os.path.exists(out):
+            os.remove(out)
+    return True, (f"spec ok: {tail}; off {rec['routerha_off_rps']} rps "
+                  f"(noise {rec['routerha_off_noise_pct']}%), on "
+                  f"{rec['routerha_on_rps']} rps "
+                  f"({rec['routerha_on_overhead_pct']}% overhead), "
+                  f"owner_of {rec['owner_lookup_ns']}ns, parity="
+                  f"{rec['bitwise_equal_with_ha']}")
+
+
 # Pinned trace-chaos spec: replica-side faults (absorbed by failover —
 # each failed hop must land as a SPAN with a typed outcome and the
 # injected fault as a span event) plus jittered device execution.
@@ -753,6 +826,7 @@ STAGES = {"build": stage_build, "sanity": stage_sanity,
           "sessions": stage_sessions, "autoscale": stage_autoscale,
           "trace": stage_trace,
           "flight": stage_flight,
+          "routerha": stage_routerha,
           "coldstart": stage_coldstart,
           "trainloop": stage_trainloop,
           "race": stage_race,

@@ -17,6 +17,7 @@ __all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
            "CheckpointWriteError", "WorkerEvictedError", "ReshardError",
            "ReplicaUnavailableError", "FleetDrainingError",
            "ModelEvictedError",
+           "RouterLeaseError", "RouterForwardError",
            "SessionExpiredError", "SessionLostError",
            "EngineRaceError", "RecompileStormError", "GraphLintError",
            "register_error", "get_error_class"]
@@ -149,6 +150,29 @@ class ModelEvictedError(MXNetError, _bi.ConnectionError):
     or capacity grows, so clients should back off and retry.  Also
     catchable as builtin ``ConnectionError`` so generic failover
     layers treat it as a retryable placement failure, not a 500."""
+
+
+@register_error
+class RouterLeaseError(MXNetError, _bi.ConnectionError):
+    """A router's lease on the shared HA membership store could not be
+    acquired, renewed, or trusted (``serving/routerha.py``): the store
+    is unreachable, the lease expired while the router was wedged, or
+    a peer named by a forwarded request holds no live lease.  Also
+    catchable as builtin ``ConnectionError`` so retry/failover layers
+    treat it as transient — leases re-acquire on the next beat.
+    Answered as 503 with ``Retry-After`` by the router front end."""
+
+
+@register_error
+class RouterForwardError(MXNetError):
+    """A mis-hashed session request exhausted its
+    ``X-MXNET-ROUTER`` forward-hop budget
+    (``MXNET_SERVING_ROUTER_FORWARD_HOPS``) without reaching the
+    session's owning router — a routing loop (stale membership views
+    disagreeing about ring ownership) or a peer list naming routers
+    that no longer exist.  The hop cap turns an infinite forward loop
+    into this typed error (HTTP 508); the client should retry after
+    the membership view converges (one lease TTL)."""
 
 
 @register_error

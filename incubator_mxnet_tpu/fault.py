@@ -43,6 +43,15 @@ Injection points (each named where the fault physically occurs):
   transient fault drops that decision for the tick — the control
   loop re-evaluates and retries next tick; a delay models a slow
   control plane lagging behind the load signal
+* ``serving.router_lease`` — a router about to publish its HA lease
+  beat to the shared membership store (``serving/routerha.py``).  A
+  lost beat ages the lease; enough in a row and the router's lease
+  expires, handing its session affinities to the survivors — exactly
+  the takeover path the ``routerha`` chaos stage drives
+* ``serving.router_forward`` — a mis-hashed session request about to
+  be forwarded to its ring-owning peer router (the ``X-MXNET-ROUTER``
+  hop).  A delay models a slow peer hop; an error is a lost forward
+  (surfaced typed — the hop budget bounds the loop either way)
 * ``trainer.step``      — an elastic trainer step about to run (the
   eviction-notice / checkpoint-on-evict path)
 
@@ -99,7 +108,9 @@ POINTS = ("kvstore.send", "kvstore.recv", "kvstore.heartbeat",
           "io.next_batch", "serving.enqueue", "serving.execute",
           "serving.route", "serving.probe", "serving.replica_exec",
           "serving.session_step", "serving.session_snapshot",
-          "serving.stream_write", "serving.scale", "trainer.step")
+          "serving.stream_write", "serving.scale",
+          "serving.router_lease", "serving.router_forward",
+          "trainer.step")
 
 _POINT_SET = frozenset(POINTS)
 

@@ -23,7 +23,13 @@ cockpit voice recorder:
   (every fired injection, mirroring the span event so chaos artifacts
   are self-explaining in BOTH systems), ``compile`` (executor builds,
   sentinel storms), ``checkpoint``, ``membership`` (PS join/leave/
-  evict, trainer evict/rejoin), ``session``.
+  evict, trainer evict/rejoin, and the HA router tier's lease
+  lifecycle: ``router.lease.acquired/renewed/expired``,
+  ``router.lease.beat_lost``, ``router.takeover.started/completed``,
+  ``router.forwarded``, ``router.exited`` — the chain
+  ``router.lease.expired → router.takeover.started →
+  session.restored`` is what ``tools/postmortem.py --gate`` asserts
+  after a router kill), ``session``.
 * **Monotonic-anchored** — event timestamps are monotonic
   (MX-TIME001); export places them on a shared cross-process timeline
   via :func:`.trace.anchor`, the ONE wall-clock anchor this process

@@ -31,6 +31,14 @@ adaptive batching layer (NSDI'17) and MXNet Model Server:
   snapshots (checkpoint.py shard format) and the crash-safe failover
   contract: migrate-from-snapshot (bitwise continuation) or typed
   ``SessionLostError`` — never a hang, never a silent restart.
+* :mod:`.routerha` — the highly-available router tier: N routers
+  share one view of the fleet and of session ownership through leased
+  membership (join/heartbeat/expire over a pluggable shared store),
+  consistent-hash session affinity with bounded ``X-MXNET-ROUTER``
+  forward hops, and crash takeover — an expired router's sessions
+  rehash to the survivors and resume via the same snapshot-restore
+  path a replica death uses.  Fully off (zero threads, zero lease
+  traffic, pinned bare shapes) unless explicitly configured.
 * :mod:`.autoscaler` + :mod:`.placement` — the multi-tenant control
   plane: a level-triggered loop over the router's own metrics that
   grows/shrinks the fleet per model (scale-from-zero via the AOT
@@ -52,6 +60,7 @@ from .metrics import FleetMetrics, ServingMetrics            # noqa: F401
 from .model_repository import ModelRepository                # noqa: F401
 from .placement import Placer                                # noqa: F401
 from .router import FleetRouter                              # noqa: F401
+from .routerha import RouterHA                               # noqa: F401
 from .server import InferenceServer                          # noqa: F401
 from .sessions import (SessionHost, SessionManager,          # noqa: F401
                        SessionModel)
@@ -62,4 +71,4 @@ __all__ = ["ModelRepository", "DynamicBatcher", "ContinuousBatcher",
            "ServingMetrics", "FleetMetrics", "ServingError",
            "QueueFullError", "DeadlineExceeded", "ShuttingDown",
            "Autoscaler", "ModelPolicy", "Placer", "SloClass",
-           "slo_class", "WeightedFairGate"]
+           "slo_class", "WeightedFairGate", "RouterHA"]

@@ -837,6 +837,32 @@ class ReplicaFleet:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._prober = None
+        # the router-HA membership layer, when one is attached: this
+        # fleet's summary() rides every lease beat, so every router in
+        # the tier shares one view of every fleet (routerha.fleet_view)
+        self.membership = None
+
+    # -- shared membership view ---------------------------------------
+
+    def attach_membership(self, membership):
+        """Wire a :class:`~.routerha.RouterHA` to this fleet: the HA
+        lease then publishes :meth:`summary` each beat, making this
+        fleet part of the router tier's shared membership view."""
+        self.membership = membership
+        return self
+
+    def summary(self):
+        """Compact cross-router fleet view (published in the HA lease
+        entry — small on purpose: it is re-written every beat)."""
+        states = self.states()
+        return {
+            "backend": self.backend,
+            "replicas": len(states),
+            "ready": sum(1 for st in states.values()
+                         if st["state"] == "ready" and st["healthy"]),
+            "models": sorted(self.models),
+            "session_models": sorted(self.session_models),
+        }
 
     # -- lifecycle ----------------------------------------------------
 

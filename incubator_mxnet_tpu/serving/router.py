@@ -46,6 +46,7 @@ import numpy as onp
 from ..base import get_env
 from .. import fault, flightrec, trace
 from ..error import (FleetDrainingError, ReplicaUnavailableError,
+                     RouterForwardError, RouterLeaseError,
                      SessionExpiredError, SessionLostError)
 from .admission import (Admission, BadRequest, ClientDisconnected,
                         DeadlineExceeded, ModelNotFound, QueueFullError,
@@ -54,6 +55,7 @@ from .admission import (Admission, BadRequest, ClientDisconnected,
 from .metrics import FleetMetrics, Histogram
 from .server import JSONRequestHandler, ServingHTTPServer
 from .sessions import SessionNotFound
+from . import routerha
 
 __all__ = ["FleetRouter", "main"]
 
@@ -76,7 +78,8 @@ class FleetRouter:
 
     def __init__(self, fleet, host="127.0.0.1", port=0, metrics=None,
                  failovers=None, hedge=None, hop_min_ms=None,
-                 deadline_ms=None):
+                 deadline_ms=None, ha=None, router_id=None,
+                 ha_dir=None, lease_ttl_s=None):
         self.fleet = fleet
         self.metrics = metrics or FleetMetrics()
         self.metrics.attach_fleet(fleet)
@@ -112,6 +115,16 @@ class FleetRouter:
             lambda: len(self._session_homes))
         self.host = host
         self.port = int(port)
+        # router high availability (docs/serving.md "Router high
+        # availability"): OFF unless explicitly configured — a bare
+        # single-router deployment starts no HA thread, publishes no
+        # lease, and keeps its pinned healthz/describe shapes
+        self.ha = None
+        if ha is None:
+            ha = routerha.from_env(router_id=router_id, ha_dir=ha_dir,
+                                   lease_ttl_s=lease_ttl_s)
+        if ha is not None:
+            ha.attach(self)     # sets self.ha + fleet.membership
         self.t_start = time.monotonic()
         self._httpd = None
         self._thread = None
@@ -460,6 +473,7 @@ class FleetRouter:
                 self._session_homes[info["session_id"]] = (model,
                                                            r.rid)
             info["replica"] = r.rid
+            self._ha_publish()   # peers' owner_of() must see it
             code = 200
             return info
         except ServingError as e:
@@ -482,6 +496,26 @@ class FleetRouter:
                 f"no session {sid!r} for model {model!r} on this "
                 "fleet")
         return entry[1]
+
+    def _ha_publish(self):
+        """Push the session registry to the HA store now (best
+        effort — the periodic beat re-publishes anyway)."""
+        if self.ha is not None:
+            try:
+                self.ha.beat_once()
+            except RouterLeaseError:
+                pass   # counted in the HA block; next beat retries
+
+    def _adopt_orphan(self, model, sid):
+        """Takeover (called by :class:`~.routerha.RouterHA`): adopt a
+        dead peer router's session affinity.  The replica-side restore
+        happens lazily on the next step through the normal
+        migrate-from-snapshot path — ``record_migration`` fires, the
+        ``session_steps`` re-base stays visible, chunks already
+        delivered are never re-sent."""
+        with self._session_lock:
+            if sid not in self._session_homes:
+                self._session_homes[sid] = (model, None)
 
     def session_step(self, model, sid, inputs, steps=1,
                      deadline_ms=None, on_chunk=None):
@@ -523,7 +557,15 @@ class FleetRouter:
                       on_chunk):
         checked_route(model)
         deadline = self.admission.deadline_ms(deadline_ms)
-        rid = self._session_home(model, sid)
+        try:
+            rid = self._session_home(model, sid)
+        except SessionNotFound:
+            # HA: the sid may belong to a dead peer router whose lease
+            # just expired and whose ring-share hashes to us — claim it
+            # (sweeps + adopts) before giving up with a 404
+            if self.ha is None or self.ha.claim_orphan(sid) != model:
+                raise
+            rid = self._session_home(model, sid)
         chunks_out = [0]
         if on_chunk is not None:
             user_cb = on_chunk
@@ -531,6 +573,14 @@ class FleetRouter:
             def on_chunk(chunk):
                 chunks_out[0] += 1
                 user_cb(chunk)
+        if rid is None:
+            # takeover-adopted orphan: no local owner replica yet —
+            # restore from the latest durable snapshot through the
+            # normal migrate path (empty exclude set: any routable
+            # replica may adopt)
+            return self._migrate_step(model, sid, set(), inputs, steps,
+                                      deadline, on_chunk, chunks_out,
+                                      None)
         try:
             r = self.fleet.get(rid)
         except KeyError:
@@ -638,6 +688,12 @@ class FleetRouter:
         rid = self._session_home(model, sid)
         with self._session_lock:
             self._session_homes.pop(sid, None)
+        self._ha_publish()   # peers must stop seeing it as ours
+        if rid is None:
+            # adopted orphan that never stepped here: nothing replica-
+            # side to tear down, the affinity drop above is the close
+            return {"session_id": sid, "closed": True, "steps": None,
+                    "note": "adopted orphan, no local replica owner"}
         try:
             return self.fleet.get(rid).session_close(model, sid)
         except (KeyError, ConnectionError, ShuttingDown) as e:
@@ -689,6 +745,10 @@ class FleetRouter:
             # and for the always-on flight recorder: present only once
             # events were recorded (a bare router keeps its shape)
             body["flight"] = flightrec.health_block()
+        if self.ha is not None:
+            # additive (docs/serving.md "Router high availability"):
+            # only a router with HA configured grows the block
+            body["router_ha"] = self.ha.describe()
         return (200 if ready else 503), body
 
     def describe(self):
@@ -714,6 +774,8 @@ class FleetRouter:
             out["trace"] = trace.health_block()
         if flightrec.active():
             out["flight"] = flightrec.health_block()
+        if self.ha is not None:
+            out["router_ha"] = self.ha.describe()
         return out
 
     # -- HTTP front end -----------------------------------------------
@@ -727,6 +789,14 @@ class FleetRouter:
             target=self._httpd.serve_forever, name="fleet-router-http",
             daemon=True)
         self._thread.start()
+        if self.ha is not None:
+            # advertise a reachable address to peers, then join the
+            # membership (first beat is synchronous: a router that
+            # cannot lease fails loudly at startup, not silently later)
+            adv = ("127.0.0.1" if self.host in ("", "0.0.0.0", "::")
+                   else self.host)
+            self.ha.addr = f"{adv}:{self.port}"
+            self.ha.start()
         return self.port
 
     def shutdown(self, drain=True, timeout=30.0):
@@ -734,6 +804,10 @@ class FleetRouter:
         (replicas finish in-flight work first)."""
         if self.autoscaler is not None:
             self.autoscaler.stop()
+        if self.ha is not None:
+            # leave the membership FIRST: peers see a clean
+            # ``router.exited`` departure, not a lease expiry + takeover
+            self.ha.stop(leave=True)
         if drain:
             self.fleet.shutdown(timeout)
         if self._httpd is not None:
@@ -778,11 +852,89 @@ class _RouterHandler(JSONRequestHandler):
             if verb == "create" and sid is None:
                 return self._session_create(model)
             if sid is not None:
+                if (self.app.ha is not None
+                        and self._forward_session(path, sid)):
+                    return   # proxied to the owning peer router
                 handler = {"step": self._session_step,
                            "close": self._session_close}.get(verb)
                 if handler is not None:
                     return handler(model, sid)
         self._send(404, {"error": "NotFound", "message": path})
+
+    def _forward_session(self, path, sid):
+        """HA session affinity: if ``sid`` is owned by a live PEER
+        router, proxy the request there (one ``X-MXNET-ROUTER`` hop)
+        and relay the answer.  Returns True when the request was
+        handled here (forwarded, or answered with a typed loop/hop
+        error), False when the local router should serve it.
+
+        Garbled or stale headers are *ignored*, never 500'd — the
+        header is advisory loop-accounting, not an auth token."""
+        ha = self.app.ha
+        hops, via = routerha.parse_forward_header(
+            self.headers.get(routerha.HEADER))
+        target = ha.forward_target(sid)
+        if target is None:
+            return False          # ours (or claimable): serve locally
+        rid, addr = target
+        if hops >= ha.forward_hops or ha.router_id in via:
+            # loop detected / budget exhausted — typed, bounded, 508
+            self._send(508, {
+                "error": "RouterForwardError",
+                "message": (
+                    f"session {sid!r}: forward-hop budget "
+                    f"({ha.forward_hops}) exhausted at router "
+                    f"{ha.router_id!r} (via {list(via)}); membership "
+                    f"views disagree about ring ownership")})
+            return True
+
+        def fn():
+            import urllib.error
+            import urllib.request
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b""
+            fault.inject("serving.router_forward",
+                         f"{sid}->{rid}")
+            req = urllib.request.Request(
+                f"http://{addr}{path}", data=raw,
+                headers={"Content-Type": "application/json",
+                         routerha.HEADER:
+                             routerha.forward_header_value(
+                                 hops + 1, via + (ha.router_id,))})
+            tid = self.headers.get(trace.HEADER)
+            if tid:
+                req.add_header(trace.HEADER, tid)
+            ha.note_forward()
+            trace.add_event("router.forwarded", sid=sid, to_router=rid)
+            flightrec.record(flightrec.MEMBERSHIP, "router.forwarded",
+                             sid=sid, to_router=rid)
+            try:
+                resp = urllib.request.urlopen(req, timeout=120)
+            except urllib.error.HTTPError as e:
+                # relay the peer's typed answer verbatim (410/503/...)
+                body = e.read()
+                self._send(e.code, body or b"{}",
+                           content_type="application/json")
+                return
+            except (urllib.error.URLError, OSError) as e:
+                raise RouterLeaseError(
+                    f"forward of session {sid!r} to router {rid!r} "
+                    f"({addr}) failed: {e}") from None
+            with resp:
+                if (resp.headers.get("Transfer-Encoding", "")
+                        .lower() == "chunked"):
+                    # relay the peer's decode stream line by line
+                    self._start_chunked(resp.status)
+                    for line in resp:
+                        line = line.strip()
+                        if line:
+                            self._write_chunk(json.loads(line))
+                    self._end_chunked()
+                else:
+                    self._send(resp.status, resp.read() or b"{}",
+                               content_type="application/json")
+        self._guarded(fn)
+        return True
 
     def _guarded(self, fn):
         """Map the typed routing errors onto HTTP, with a live-derived
@@ -803,6 +955,12 @@ class _RouterHandler(JSONRequestHandler):
             self._send(503, {"error": "FleetDrainingError",
                              "message": str(e)},
                        extra_headers=self.app._retry_headers())
+        except RouterForwardError as e:
+            # forward-hop budget exhausted: a routing loop, not a
+            # transient — 508 Loop Detected, retry after the
+            # membership view converges
+            self._send(508, {"error": "RouterForwardError",
+                             "message": str(e)})
         except fault.TransientFault as e:
             self._send(503, {"error": "TransientFault",
                              "message": str(e)},
@@ -1033,6 +1191,17 @@ def main(argv=None):
     p.add_argument("--port", type=int,
                    default=get_env("MXNET_SERVING_PORT", 8080, int))
     p.add_argument("--no-warmup", action="store_true")
+    p.add_argument("--ha-dir", default=None,
+                   help="shared lease directory enabling the HA "
+                        "router tier (default "
+                        "MXNET_SERVING_ROUTER_HA_DIR; unset = HA off)")
+    p.add_argument("--router-id", default=None,
+                   help="stable member id in the HA lease store "
+                        "(default MXNET_SERVING_ROUTER_ID or "
+                        "router-<pid>)")
+    p.add_argument("--lease-ttl", type=float, default=None,
+                   help="HA lease TTL seconds (default "
+                        "MXNET_SERVING_ROUTER_LEASE_TTL_S)")
     args = p.parse_args(argv)
 
     models = {}
@@ -1084,7 +1253,13 @@ def main(argv=None):
     print(f"[fleet] spawning {args.replicas} {args.backend} "
           f"replica(s)", flush=True)
     fleet.spawn()
-    router = FleetRouter(fleet, host=args.host, port=args.port)
+    router = FleetRouter(fleet, host=args.host, port=args.port,
+                         router_id=args.router_id, ha_dir=args.ha_dir,
+                         lease_ttl_s=args.lease_ttl)
+    if router.ha is not None:
+        print(f"[fleet] router HA member {router.ha.router_id!r} "
+              f"(lease ttl {router.ha.lease_ttl_s:g}s, store "
+              f"{args.ha_dir or 'env'})", flush=True)
     if policies:
         from .autoscaler import Autoscaler
         from .placement import Placer

@@ -1,0 +1,555 @@
+"""Highly-available router tier: leased membership, consistent-hash
+session affinity, and crash takeover (docs/serving.md "Router high
+availability").
+
+The data plane already survives a replica SIGKILL (fleet failover +
+session migration); this module removes the LAST single point of
+failure — the router process itself.  N routers share one view of the
+fleet and of session ownership through a small shared state store:
+
+* **Leased membership** — every router publishes a lease entry
+  (``join``), re-publishes it each beat (``renew``), and is considered
+  dead once its deadline passes without a renewal.  The same
+  join/heartbeat/expire shape as the PS-server elastic membership
+  (``kvstore/ps_server.py``), with the same monotonic-deadline
+  discipline: deadlines are ``time.monotonic()`` values, which Linux
+  guarantees comparable across processes on one host (CLOCK_MONOTONIC
+  is boot-wide) — exactly the scope of the file-backed store.  A beat
+  that cannot land raises typed
+  :class:`~..error.RouterLeaseError` (catchable as
+  ``ConnectionError``; the next beat re-acquires).
+* **Consistent-hash session affinity** — a :class:`HashRing` over the
+  live members maps ``sid → owning router`` without any broadcast;
+  the owning router's own affinity table maps ``sid → owning
+  replica``.  Adding or removing a router moves only ~K/N session
+  affinities (the ring test pins that bound).
+* **Crash takeover** — when a router's lease expires, each survivor
+  adopts the ring-share of the dead router's published sessions
+  (``router.takeover.started`` / ``router.takeover.completed``
+  MEMBERSHIP events) and resumes them through the existing
+  snapshot-restore path: the replica-side ``session.restored`` re-base
+  is visible in ``session_steps``, chunks already delivered are never
+  re-sent — the PR 11 invariant, now across a *router* death.
+* **Forward hop** — a session request landing on a non-owning router
+  is forwarded to the owner with an ``X-MXNET-ROUTER`` hop header.
+  Garbled or stale headers are ignored (never a 500 — the same
+  discipline as ``X-MXNET-TRACE``); the hop budget
+  (``MXNET_SERVING_ROUTER_FORWARD_HOPS``) turns a routing loop into
+  typed :class:`~..error.RouterForwardError` instead of an infinite
+  hop.
+
+The store is pluggable: :class:`FileLeaseStore` (shared directory, one
+atomically-renamed JSON file per router — no locks, no torn reads) for
+cross-process fleets on one host, :class:`MemoryLeaseStore` for
+in-process tests.  A PS-backed store only needs the same three
+methods (``publish`` / ``read_all`` / ``remove``) over PSClient verbs.
+
+Single-router deployments are bit-for-bit unaffected: with no
+``MXNET_SERVING_ROUTER_HA_DIR`` (and no explicit ``RouterHA``), the
+router starts no HA thread, publishes no lease, and its
+``/healthz`` / ``describe()`` shapes stay exactly the pinned bare
+ones — the ``"router_ha"`` block is additive, present only when HA is
+configured.
+"""
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import os
+import threading
+import time
+
+from ..base import get_env
+from .. import fault, flightrec
+from ..error import RouterLeaseError
+
+__all__ = ["HEADER", "HashRing", "MemoryLeaseStore", "FileLeaseStore",
+           "RouterHA", "parse_forward_header", "forward_header_value"]
+
+#: Forward-hop header a router adds when relaying a mis-hashed session
+#: request to its ring owner: ``"<hops>;<via,...>"``.  Parsed with
+#: :func:`parse_forward_header`; anything garbled reads as hop 0.
+HEADER = "X-MXNET-ROUTER"
+
+
+def parse_forward_header(raw):
+    """``"2;rA,rB"`` → ``(2, ("rA", "rB"))``.  Garbled, stale, or
+    absent headers parse as ``(0, ())`` — a client-supplied (or
+    corrupted) hop header must never 500 a request, it only loses its
+    loop-accounting (the hop cap still bounds the loop)."""
+    if not raw or not isinstance(raw, str) or len(raw) > 512:
+        return 0, ()
+    hops_part, _, via_part = raw.partition(";")
+    try:
+        hops = int(hops_part.strip())
+    except (TypeError, ValueError):
+        return 0, ()
+    if hops < 0 or hops > 1024:
+        return 0, ()
+    via = tuple(v.strip() for v in via_part.split(",") if v.strip())
+    return hops, via
+
+
+def forward_header_value(hops, via):
+    return f"{int(hops)};{','.join(via)}"
+
+
+class HashRing:
+    """Consistent-hash ring over router ids.
+
+    Each member lands ``vnodes`` virtual points on a 160-bit circle
+    (sha1 — stable across processes and Python runs, unlike
+    ``hash()``); a key is owned by the first point clockwise from its
+    own hash.  Removing a member re-homes ONLY the keys its points
+    owned (~K/N of them); every other key keeps its owner — the
+    stability bound the affinity tests pin."""
+
+    def __init__(self, members, vnodes=64):
+        self.members = tuple(sorted(set(members)))
+        self.vnodes = int(vnodes)
+        self._points = []
+        for m in self.members:
+            for v in range(self.vnodes):
+                self._points.append((self._hash(f"{m}#{v}"), m))
+        self._points.sort()
+        self._keys = [p[0] for p in self._points]
+
+    @staticmethod
+    def _hash(key):
+        return int.from_bytes(
+            hashlib.sha1(str(key).encode()).digest()[:8], "big")
+
+    def owner(self, key):
+        """The member owning ``key``, or None on an empty ring."""
+        if not self._points:
+            return None
+        h = self._hash(key)
+        i = bisect.bisect_right(self._keys, h)
+        if i == len(self._keys):
+            i = 0
+        return self._points[i][1]
+
+
+# ---------------------------------------------------------------------------
+# pluggable lease stores
+# ---------------------------------------------------------------------------
+
+class MemoryLeaseStore:
+    """In-process store (tests, single-process multi-router rigs):
+    a dict behind a lock, same contract as the file store."""
+
+    def __init__(self):
+        self._entries: dict = {}
+        self._lock = threading.Lock()
+
+    def publish(self, entry):
+        with self._lock:
+            self._entries[entry["router_id"]] = dict(entry)
+
+    def read_all(self):
+        with self._lock:
+            return {rid: dict(e) for rid, e in self._entries.items()}
+
+    def remove(self, router_id):
+        with self._lock:
+            self._entries.pop(router_id, None)
+
+
+class FileLeaseStore:
+    """Shared-directory store: one ``<router_id>.json`` per router,
+    written atomically (tmp + rename), so readers never see a torn
+    entry and writers never contend — there is no shared file and no
+    lock.  Scoped to one host (monotonic deadlines are boot-wide, not
+    cluster-wide); a cross-host fleet wants a PS-backed store with the
+    same three methods."""
+
+    def __init__(self, directory):
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _path(self, router_id):
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in str(router_id))
+        return os.path.join(self.directory, f"{safe}.lease.json")
+
+    def publish(self, entry):
+        p = self._path(entry["router_id"])
+        tmp = f"{p}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(entry, f)
+            os.replace(tmp, p)   # atomic publish
+        except OSError as e:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise RouterLeaseError(
+                f"cannot publish lease for "
+                f"{entry['router_id']!r} under {self.directory}: "
+                f"{type(e).__name__}: {e}") from e
+
+    def read_all(self):
+        out = {}
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".lease.json"):
+                continue
+            try:
+                with open(os.path.join(self.directory, name)) as f:
+                    entry = json.load(f)
+            except (OSError, ValueError):
+                continue   # racing a writer's replace, or torn: skip
+            rid = entry.get("router_id")
+            if rid:
+                out[rid] = entry
+        return out
+
+    def remove(self, router_id):
+        try:
+            os.unlink(self._path(router_id))
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# the HA membership layer
+# ---------------------------------------------------------------------------
+
+class RouterHA:
+    """Leased membership + consistent-hash affinity for one router.
+
+    Attach to a :class:`~.router.FleetRouter` (``attach``), then either
+    ``start()`` the beat/sweep thread (production) or drive
+    ``beat_once()`` / ``sweep_once()`` by hand (tests — every state
+    transition is reachable deterministically).  The lease entry a
+    beat publishes carries everything the survivors need: the lease
+    deadline, the router's HTTP address, its session registry
+    (``sid → model``) and a compact summary of its replica fleet —
+    the shared view of the fleet, one atomic read per peer."""
+
+    def __init__(self, router_id, store, lease_ttl_s=None,
+                 forward_hops=None, addr=None, vnodes=64):
+        self.router_id = str(router_id)
+        self.store = store
+        self.lease_ttl_s = float(
+            lease_ttl_s if lease_ttl_s is not None
+            else get_env("MXNET_SERVING_ROUTER_LEASE_TTL_S", 3.0,
+                         float))
+        if self.lease_ttl_s <= 0:
+            raise ValueError(
+                f"lease TTL must be > 0, got {self.lease_ttl_s}")
+        self.forward_hops = int(
+            forward_hops if forward_hops is not None
+            else get_env("MXNET_SERVING_ROUTER_FORWARD_HOPS", 3, int))
+        self.addr = addr
+        self.vnodes = int(vnodes)
+        self.router = None
+        self._epoch = 0
+        self._joined = False
+        self._announced_dead: set = set()
+        self._taken_over: set = set()    # sids this router adopted
+        self._counters = {"beats": 0, "beat_failures": 0,
+                          "takeovers": 0, "adopted_sessions": 0,
+                          "forwards": 0}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        # the view refreshed by each sweep (store reads are cheap but
+        # request-path lookups must not touch the store at all)
+        self._view: dict = {}
+
+    # -- wiring -------------------------------------------------------
+
+    def attach(self, router):
+        self.router = router
+        router.ha = self
+        if getattr(router, "fleet", None) is not None:
+            router.fleet.attach_membership(self)
+        return self
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self.beat_once()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"router-ha-{self.router_id}",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self, leave=True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.lease_ttl_s + 2.0)
+            self._thread = None
+        if leave and self._joined:
+            self.store.remove(self.router_id)
+            self._joined = False
+            flightrec.record(flightrec.MEMBERSHIP, "router.exited",
+                             router=self.router_id)
+
+    def _loop(self):
+        interval = self.lease_ttl_s / 3.0
+        while not self._stop.wait(interval):
+            try:
+                self.beat_once()
+            except RouterLeaseError:
+                pass   # counted; the next beat re-acquires
+            self.sweep_once()
+
+    # -- lease beats --------------------------------------------------
+
+    def _entry(self):
+        sessions = {}
+        fleet_summary = None
+        if self.router is not None:
+            with self.router._session_lock:
+                sessions = {sid: mr[0] for sid, mr
+                            in self.router._session_homes.items()}
+            if getattr(self.router, "fleet", None) is not None:
+                fleet_summary = self.router.fleet.summary()
+        self._epoch += 1
+        return {"router_id": self.router_id,
+                "addr": self.addr,
+                "deadline": time.monotonic() + self.lease_ttl_s,
+                "ttl_s": self.lease_ttl_s,
+                "epoch": self._epoch,
+                "sessions": sessions,
+                "fleet": fleet_summary}
+
+    def beat_once(self):
+        """Publish (join or renew) this router's lease.  A failed
+        publish raises typed :class:`RouterLeaseError` — the lease
+        simply ages; enough missed beats in a row and the survivors
+        take over (exactly the PS heartbeat-budget semantics)."""
+        try:
+            fault.inject("serving.router_lease", self.router_id)
+            entry = self._entry()
+            self.store.publish(entry)
+        except Exception as e:
+            with self._lock:
+                self._counters["beat_failures"] += 1
+            flightrec.record(flightrec.MEMBERSHIP, "router.lease.beat_lost",
+                             severity="warn", router=self.router_id,
+                             error=type(e).__name__)
+            if isinstance(e, RouterLeaseError):
+                raise
+            raise RouterLeaseError(
+                f"router {self.router_id!r} lease beat failed: "
+                f"{type(e).__name__}: {e}") from e
+        with self._lock:
+            self._counters["beats"] += 1
+        if not self._joined:
+            self._joined = True
+            flightrec.record(flightrec.MEMBERSHIP,
+                             "router.lease.acquired",
+                             router=self.router_id, addr=self.addr,
+                             ttl_s=self.lease_ttl_s)
+        else:
+            flightrec.record(flightrec.MEMBERSHIP,
+                             "router.lease.renewed",
+                             router=self.router_id,
+                             epoch=entry["epoch"])
+        return entry
+
+    # -- membership view ----------------------------------------------
+
+    def members(self, refresh=False):
+        """{router_id: entry} of LIVE members (deadline not passed).
+        Served from the last sweep's cached view unless ``refresh``."""
+        if refresh or not self._view:
+            self._view = self.store.read_all()
+        now = time.monotonic()
+        return {rid: e for rid, e in self._view.items()
+                if float(e.get("deadline", 0)) > now}
+
+    def expired(self, refresh=False):
+        if refresh or not self._view:
+            self._view = self.store.read_all()
+        now = time.monotonic()
+        return {rid: e for rid, e in self._view.items()
+                if float(e.get("deadline", 0)) <= now
+                and rid != self.router_id}
+
+    def fleet_view(self):
+        """The shared fleet view: every live router's published
+        replica summary, one read per peer — no broadcast."""
+        return {rid: e.get("fleet") for rid, e in
+                self.members().items() if e.get("fleet") is not None}
+
+    def ring(self):
+        live = set(self.members())
+        live.add(self.router_id)   # self is always a candidate owner
+        return HashRing(live, vnodes=self.vnodes)
+
+    def owner_of(self, sid):
+        """``sid → owning router`` without a broadcast: a LIVE peer
+        that published the sid in its session registry owns it
+        (affinity survives ring changes); otherwise the consistent-
+        hash ring decides."""
+        members = self.members()
+        if self.router is not None:
+            with self.router._session_lock:
+                if sid in self.router._session_homes:
+                    return self.router_id
+        for rid, e in members.items():
+            if rid != self.router_id and sid in (e.get("sessions")
+                                                 or {}):
+                return rid
+        return self.ring().owner(sid)
+
+    def addr_of(self, rid):
+        e = self.members().get(rid)
+        return e.get("addr") if e else None
+
+    def forward_target(self, sid):
+        """None to handle locally, else ``(rid, addr)`` of the live
+        owner to forward to.  A stale view naming an owner with no
+        live lease (or no address) resolves to local handling — the
+        takeover path will claim the sid here if the ring agrees."""
+        owner = self.owner_of(sid)
+        if owner is None or owner == self.router_id:
+            return None
+        addr = self.addr_of(owner)
+        if not addr:
+            return None
+        return owner, addr
+
+    # -- crash takeover -----------------------------------------------
+
+    def sweep_once(self):
+        """Refresh the membership view; adopt this router's ring-share
+        of any expired peer's sessions.  Every survivor runs the same
+        deterministic partition, so the dead router's affinities
+        rehash across the survivors with no coordination and no double
+        owner."""
+        self._view = self.store.read_all()
+        members = self.members()
+        adopted = 0
+        for rid, e in self.expired().items():
+            if rid not in self._announced_dead:
+                self._announced_dead.add(rid)
+                flightrec.record(flightrec.MEMBERSHIP,
+                                 "router.lease.expired",
+                                 severity="warn", router=rid,
+                                 ttl_s=e.get("ttl_s"),
+                                 survivors=len(members))
+            adopted += self._takeover(rid, e)
+        # a rejoin (same id, fresh lease) clears the obituary so a
+        # LATER death is announced again
+        self._announced_dead -= set(members)
+        # garbage-collect long-expired entries: every survivor has had
+        # many sweeps to adopt its share by 10 lease TTLs
+        now = time.monotonic()
+        for rid, e in self.expired().items():
+            if now - float(e.get("deadline", now)) > 10 * self.lease_ttl_s:
+                self.store.remove(rid)
+        return adopted
+
+    def _takeover(self, dead_rid, entry):
+        if self.router is None:
+            return 0
+        sessions = entry.get("sessions") or {}
+        if not sessions:
+            return 0
+        ring = self.ring()
+        with self.router._session_lock:
+            mine = {sid: model for sid, model in sessions.items()
+                    if ring.owner(sid) == self.router_id
+                    and sid not in self.router._session_homes
+                    and sid not in self._taken_over}
+        if not mine:
+            return 0
+        flightrec.record(flightrec.MEMBERSHIP, "router.takeover.started",
+                         severity="warn", router=self.router_id,
+                         from_router=dead_rid, sessions=len(mine))
+        for sid, model in mine.items():
+            self.router._adopt_orphan(model, sid)
+            self._taken_over.add(sid)
+        with self._lock:
+            self._counters["takeovers"] += 1
+            self._counters["adopted_sessions"] += len(mine)
+        # publish immediately so peers' owner_of() resolves to us
+        # before our next periodic beat
+        try:
+            self.beat_once()
+        except RouterLeaseError:
+            pass
+        flightrec.record(flightrec.MEMBERSHIP,
+                         "router.takeover.completed",
+                         router=self.router_id, from_router=dead_rid,
+                         sessions=len(mine))
+        return len(mine)
+
+    def claim_orphan(self, sid):
+        """Request-path takeover: a step for an unknown sid whose
+        publisher's lease has expired.  Returns the model name when
+        this router adopts it (ring-owner check included — a request
+        mis-sent to a non-owner must not steal the sid), else None."""
+        self._view = self.store.read_all()
+        ring = self.ring()
+        if ring.owner(sid) != self.router_id:
+            return None
+        for rid, e in self.expired().items():
+            model = (e.get("sessions") or {}).get(sid)
+            if model is not None:
+                self.sweep_once()   # full takeover path: events + beat
+                return model
+        return None
+
+    def note_forward(self):
+        with self._lock:
+            self._counters["forwards"] += 1
+
+    # -- observability ------------------------------------------------
+
+    def describe(self):
+        """The additive ``"router_ha"`` healthz/describe block
+        (docs/serving.md "Router high availability"); shape pinned by
+        the routerha tests."""
+        members = self.members()
+        now = time.monotonic()
+        self_entry = self._view.get(self.router_id)
+        with self._lock:
+            counters = dict(self._counters)
+        return {
+            "router_id": self.router_id,
+            "addr": self.addr,
+            "lease_ttl_s": self.lease_ttl_s,
+            "forward_hops": self.forward_hops,
+            "leased": self.router_id in members,
+            "lease_remaining_s": (
+                round(float(self_entry["deadline"]) - now, 3)
+                if self_entry else None),
+            "peers": {
+                rid: {"addr": e.get("addr"),
+                      "sessions": len(e.get("sessions") or {}),
+                      "fleet": e.get("fleet")}
+                for rid, e in members.items()
+                if rid != self.router_id},
+            "expired": sorted(self.expired()),
+            "counters": counters,
+        }
+
+
+def from_env(host=None, port=None, router_id=None, ha_dir=None,
+             lease_ttl_s=None, forward_hops=None):
+    """Build a :class:`RouterHA` from the ``MXNET_SERVING_ROUTER_*``
+    environment (returns None when ``MXNET_SERVING_ROUTER_HA_DIR`` is
+    unset and no explicit ``ha_dir`` is given — HA stays fully off:
+    no store, no thread, no lease traffic)."""
+    ha_dir = ha_dir or get_env("MXNET_SERVING_ROUTER_HA_DIR", None)
+    if not ha_dir:
+        return None
+    router_id = (router_id
+                 or get_env("MXNET_SERVING_ROUTER_ID", None)
+                 or f"router-{os.getpid()}")
+    addr = f"{host}:{port}" if host and port else None
+    return RouterHA(router_id, FileLeaseStore(ha_dir),
+                    lease_ttl_s=lease_ttl_s,
+                    forward_hops=forward_hops, addr=addr)

@@ -360,7 +360,7 @@ class SessionManager:
         self.stream_ms = Histogram()
         self._counters = {"steps": 0, "created": 0, "evicted": 0,
                           "snapshots": 0, "snapshot_failures": 0,
-                          "restored": 0}
+                          "restored": 0, "restore_retries": 0}
         # periodic snapshots run on a dedicated thread so the decode
         # loop NEVER does IO (measured: in-loop snapshots halve decode
         # throughput); carry rows are immutable once written back, so
@@ -627,23 +627,7 @@ class SessionManager:
                 "replica died before the first snapshot period")
         try:
             ckpt = AsyncCheckpointManager(d, keep=2)
-            if not ckpt.all_steps():
-                raise FileNotFoundError("no committed snapshot")
-            # walk newest-first OURSELVES so the restored step counter
-            # always names the snapshot that actually loaded — a
-            # fallback past a torn newest snapshot must re-base the
-            # session's step count along with its carry
-            from ..error import CheckpointCorruptError
-            flat, steps, last_err = None, None, None
-            for step in reversed(ckpt.all_steps()):
-                try:
-                    flat = ckpt.restore(step=step)
-                    steps = step
-                    break
-                except CheckpointCorruptError as e:
-                    last_err = e
-            if flat is None:
-                raise last_err
+            flat, steps = self._restore_newest(ckpt, d)
         except SessionLostError:
             raise
         except Exception as e:  # mxlint: allow-broad-except(every restore failure — corrupt/missing/torn snapshots included — must surface as the ONE typed error the failover contract names)
@@ -663,6 +647,70 @@ class SessionManager:
                 flightrec.record(flightrec.SESSION, "session.restored",
                                  model=self.name, sid=sid, steps=steps)
         return self.describe_session(sid)
+
+    #: Restore-vs-snapshotter race budget (seconds).  A restore that
+    #: fails while the SOURCE replica's async snapshotter is visibly
+    #: mid-publish — a ``step_N.tmp`` staging dir in the session's
+    #: snapshot tree, or the committed-step list changing between two
+    #: attempts — retries within this window: the commit is one atomic
+    #: rename away, and failing the adopt because we looked 5ms early
+    #: was the known session-restore flake.  Failures with NO in-flight
+    #: evidence still surface immediately (typed, no added latency).
+    RESTORE_RACE_WAIT_S = 2.0
+
+    def _restore_newest(self, ckpt, d):
+        """Load the newest loadable committed snapshot in ``d``,
+        newest-first past torn entries, retrying (bounded by
+        :data:`RESTORE_RACE_WAIT_S`) when the failure coincides with a
+        concurrent snapshot publish.  Walking newest-first OURSELVES
+        keeps the restored step counter naming the snapshot that
+        actually loaded — a fallback past a torn newest snapshot
+        re-bases the session's step count along with its carry."""
+        from ..error import CheckpointCorruptError
+        deadline = time.monotonic() + self.RESTORE_RACE_WAIT_S
+        prev_committed = None
+        while True:
+            committed = ckpt.all_steps()
+            try:
+                if not committed:
+                    raise FileNotFoundError("no committed snapshot")
+                flat, steps, last_err = None, None, None
+                for step in reversed(committed):
+                    try:
+                        flat = ckpt.restore(step=step)
+                        steps = step
+                        break
+                    except CheckpointCorruptError as e:
+                        last_err = e
+                if flat is None:
+                    raise last_err
+                return flat, steps
+            except Exception:
+                racing = self._snapshot_in_flight(d, committed,
+                                                  prev_committed)
+                prev_committed = committed
+                if racing and time.monotonic() < deadline:
+                    with self._lock:
+                        self._counters["restore_retries"] += 1
+                    time.sleep(0.05)
+                    continue
+                raise
+
+    @staticmethod
+    def _snapshot_in_flight(d, committed, prev_committed):
+        """True when a concurrent snapshot publish is in evidence: a
+        ``step_N.tmp`` staging dir (the async writer is mid-write, its
+        atomic rename imminent), or the committed-step list moved
+        between two restore attempts."""
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return False
+        if any(n.startswith("step_") and n.endswith(".tmp")
+               for n in names):
+            return True
+        return (prev_committed is not None
+                and committed != prev_committed)
 
     def _drop_snapshots(self, sid):
         if self.snapshot_dir is not None:

@@ -41,7 +41,11 @@ padding bucket); ``session.queue`` / ``session.decode_step``
 ``train.epoch`` / ``train.chunk`` / ``prefetch.fill`` /
 ``prefetch.drain`` on the training side.  ``fault.py`` injections add
 a ``fault.<point>`` event to the active span, so a chaos-run artifact
-shows the injected fault and the recovery path in one timeline.
+shows the injected fault and the recovery path in one timeline.  The
+HA router tier adds ``router.forwarded`` events (mis-hashed session
+request proxied to its ring owner, ``serving/routerha.py``) — the
+``X-MXNET-ROUTER`` hop propagates the trace header, so a forwarded
+request stays ONE trace across both routers.
 """
 from __future__ import annotations
 

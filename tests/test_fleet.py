@@ -537,6 +537,9 @@ def test_router_http_end_to_end(artifact, predictor):
         # additive autoscale contract: no control plane attached, no
         # "autoscale" key (the PR 8 shape is preserved)
         assert "autoscale" not in health
+        # same discipline for router HA: no peers configured, no
+        # "router_ha" key — the bare single-router shape stays pinned
+        assert "router_ha" not in health
 
         status, raw = _get(port, "/metrics")
         text = raw.decode()
