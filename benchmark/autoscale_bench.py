@@ -54,7 +54,13 @@ os.environ.setdefault("MXNET_SERVING_MAX_BATCH", "4")
 
 import numpy as onp   # noqa: E402
 
+from incubator_mxnet_tpu.serving.loadgen.clients import (  # noqa: E402
+    ClosedLoopPhase, percentile, provenance)
+
 BUCKETS = [1, 2, 4]
+
+DIURNAL_WORKLOAD = ("diurnal:duration=120,base=2,peak=10,"
+                    "tenants=hi@interactive*1")
 
 
 def _artifact(tmp, name, width, depth, seed):
@@ -77,63 +83,11 @@ def _artifact(tmp, name, width, depth, seed):
     return prefix
 
 
-class _Phase:
-    """Closed-loop client volley for one trace phase."""
-
-    def __init__(self, router, width):
-        self.router = router
-        self.width = width
-        self.lat_ms = {}      # model -> [ms]
-        self.errors = {}      # model -> [repr]
-        self.shed = {}        # model -> count (429/503 — the SLO arm)
-        self._lock = threading.Lock()
-
-    def _client(self, model, stop, rng):
-        from incubator_mxnet_tpu.serving.admission import (
-            QueueFullError)
-        x = rng.randn(self.width).astype(onp.float32)
-        while not stop.is_set():
-            t0 = time.monotonic()
-            try:
-                self.router.route(model, (x,), deadline_ms=10000.0)
-                ms = (time.monotonic() - t0) * 1000.0
-                with self._lock:
-                    self.lat_ms.setdefault(model, []).append(ms)
-            except (QueueFullError, ConnectionError) as e:
-                # shed / placement backpressure: the SLO contract's
-                # explicit arm — counted, and fatal for the hi tier
-                with self._lock:
-                    self.shed[model] = self.shed.get(model, 0) + 1
-                    self.errors.setdefault(model, []).append(
-                        type(e).__name__)
-                time.sleep(0.005)
-            except Exception as e:  # mxlint: allow-broad-except(bench harness: every failure lands in the record's per-model error list, which fails --check)
-                with self._lock:
-                    self.errors.setdefault(model, []).append(
-                        f"{type(e).__name__}: {e}")
-                time.sleep(0.005)
-
-    def run(self, clients, duration_s, seed=7):
-        stop = threading.Event()
-        threads = []
-        for i, model in enumerate(clients):
-            rng = onp.random.RandomState(seed + i)
-            t = threading.Thread(target=self._client,
-                                 args=(model, stop, rng), daemon=True)
-            t.start()
-            threads.append(t)
-        time.sleep(duration_s)
-        stop.set()
-        for t in threads:
-            t.join(10.0)
-        return self
-
-
-def _p(latencies, q):
-    data = sorted(latencies)
-    if not data:
-        return 0.0
-    return data[min(len(data) - 1, int(q * len(data)))]
+def _phase(router, width):
+    """One closed-loop trace phase (loadgen.clients owns the engine)."""
+    return ClosedLoopPhase(
+        lambda model, x: router.route(model, (x,),
+                                      deadline_ms=10000.0), width)
 
 
 def _note_compiles(fleet, seen):
@@ -194,9 +148,9 @@ def bench(args):
         threading.Thread(target=sample, daemon=True).start()
 
         t_trace = time.monotonic()
-        burst = _Phase(router, args.width).run(
+        burst = _phase(router, args.width).run(
             ["hi"] * args.clients, args.phase_s)
-        mixed = _Phase(router, args.width).run(
+        mixed = _phase(router, args.width).run(
             ["hi"] * (args.clients // 2) + ["lo"] * args.clients,
             args.phase_s)
 
@@ -222,7 +176,7 @@ def bench(args):
         except Exception as e:  # mxlint: allow-broad-except(bench harness: the scale-from-zero failure lands in errors, which fails --check)
             sfz_ms = float("inf")
             errors.append(f"scale-from-zero: {type(e).__name__}: {e}")
-        resume = _Phase(router, args.width).run(
+        resume = _phase(router, args.width).run(
             ["hi"] * 2, args.phase_s / 2)
 
         trace_s = time.monotonic() - t_trace
@@ -262,8 +216,8 @@ def bench(args):
             "peak_replicas": peak[0],
             "hi_requests": len(hi_lat),
             "hi_dropped": hi_dropped,
-            "hi_p50_ms": round(_p(hi_lat, 0.50), 1),
-            "hi_p99_ms": round(_p(hi_lat, 0.99), 1),
+            "hi_p50_ms": round(percentile(hi_lat, 0.50), 1),
+            "hi_p99_ms": round(percentile(hi_lat, 0.99), 1),
             "lo_requests": sum(len(p.lat_ms.get("lo", []))
                                for p in (burst, mixed, resume)),
             "lo_shed_429": lo_shed,
@@ -279,6 +233,145 @@ def bench(args):
         return record
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def diurnal_bench(args):
+    """Replay the ROADMAP 4(a) diurnal trace (a seeded loadgen
+    workload, raised-cosine day curve) against the LEVEL-TRIGGERED
+    autoscaler and bank its numbers — replica-seconds, peak replicas,
+    per-virtual-minute SLO verdicts.  The predictive desired-count
+    policy is later gated on beating this record on replica-seconds
+    AND zero violating minutes, so this record is the baseline side
+    of that comparison, captured now."""
+    from incubator_mxnet_tpu.serving import (Autoscaler, FleetRouter,
+                                             ModelPolicy, Placer,
+                                             ReplicaFleet)
+    from incubator_mxnet_tpu.serving.loadgen import parse_workload
+    from incubator_mxnet_tpu.serving.loadgen.harness import SloMonitor
+
+    spec = parse_workload(args.workload)
+    sched = spec.compile(seed=args.seed, time_scale=args.time_scale)
+    again = parse_workload(spec.describe()).compile(
+        seed=args.seed, time_scale=args.time_scale)
+
+    tmp = tempfile.mkdtemp(prefix="autoscale_diurnal_")
+    errors = []
+    try:
+        hi = _artifact(tmp, "hi", args.width, args.depth, seed=0)
+        fleet = ReplicaFleet({}, n=1, backend="thread").spawn()
+        router = FleetRouter(fleet)
+        scaler = Autoscaler(
+            fleet, router=router, placer=Placer(budget_bytes=0),
+            interval_s=args.interval_s,
+            idle_unload_s=args.idle_unload_s,
+            queue_high=4.0, max_replicas=args.max_replicas,
+            min_fleet=1)
+        scaler.add_policy(ModelPolicy("hi", hi, slo="interactive",
+                                      min_replicas=0))
+        scaler.start()
+
+        peak = [len(fleet.replicas)]
+        sampler_stop = threading.Event()
+
+        def sample():
+            while not sampler_stop.wait(0.05):
+                peak[0] = max(peak[0], len([
+                    r for r in fleet.replicas
+                    if r.state not in ("dead",)]))
+
+        threading.Thread(target=sample, daemon=True).start()
+
+        monitor = SloMonitor({"interactive": args.p99_ms})
+        rng = onp.random.RandomState(args.seed)
+        xs = [rng.randn(args.width).astype(onp.float32)
+              for _ in range(16)]
+        gate = threading.Semaphore(64)
+
+        def fire(arr):
+            with gate:
+                t1 = time.monotonic()
+                try:
+                    router.route(arr.model, (xs[arr.client % 16],),
+                                 deadline_ms=10000.0)
+                    monitor.observe(arr.t,  arr.slo,
+                                    (time.monotonic() - t1) * 1000.0)
+                except Exception as e:  # mxlint: allow-broad-except(bench harness: every failure is an SLO-failed observation and lands in errors, which the diurnal gates judge)
+                    monitor.observe(arr.t, arr.slo, 0.0, ok=False)
+                    errors.append(f"{type(e).__name__}: {e}")
+
+        threads = []
+        t_trace = time.monotonic()
+        for arr in sched.arrivals:
+            wait = sched.real_time(arr.t) - (time.monotonic()
+                                             - t_trace)
+            if wait > 0:
+                time.sleep(wait)
+            t = threading.Thread(target=fire, args=(arr,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(30.0)
+        trace_s = time.monotonic() - t_trace
+        sampler_stop.set()
+        scaler.stop()
+        replica_seconds = scaler.replica_seconds()
+        router.shutdown()
+
+        slo = monitor.report().get("interactive", {})
+        record = {
+            "bench": "autoscale_diurnal_trace",
+            "metric": "slo_violating_minutes",
+            "value": len(slo.get("violating_minutes", [])),
+            "policy": "level_triggered",
+            "time_scale": args.time_scale,
+            "trace_s": round(trace_s, 2),
+            "arrivals": len(sched.arrivals),
+            "completed": slo.get("requests", 0),
+            "failures": slo.get("failures", 0),
+            "replica_seconds": round(replica_seconds, 2),
+            "static_replica_seconds": round(peak[0] * trace_s, 2),
+            "peak_replicas": peak[0],
+            "hi_p50_ms": slo.get("p50_ms", 0.0),
+            "hi_p99_ms": slo.get("p99_ms", 0.0),
+            "p99_target_ms": args.p99_ms,
+            "violating_minutes": slo.get("violating_minutes", []),
+            "fingerprint": sched.fingerprint(),
+            "schedule_deterministic":
+                sched.fingerprint() == again.fingerprint(),
+            "errors": errors[:20],
+            "platform": "cpu",
+        }
+        return record
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def diurnal_check(record):
+    """The baseline bank's own gates: the trace must be REAL (all
+    arrivals answered, schedule reproducible, the curve scaled the
+    fleet out) — violating minutes are allowed; they are the number
+    the predictive policy must drive to zero."""
+    problems = []
+    if not record["schedule_deterministic"]:
+        problems.append("same seed did NOT reproduce the schedule")
+    if record["failures"]:
+        problems.append(
+            f"{record['failures']} arrival(s) failed outright: "
+            f"{record['errors'][:3]}")
+    if record["completed"] < record["arrivals"]:
+        problems.append(
+            f"only {record['completed']}/{record['arrivals']} "
+            "arrivals answered")
+    if record["peak_replicas"] < 2:
+        problems.append("the diurnal peak never scaled the fleet "
+                        f"out (peak {record['peak_replicas']})")
+    if record["replica_seconds"] >= record["static_replica_seconds"]:
+        problems.append(
+            f"replica-seconds {record['replica_seconds']} not "
+            f"strictly below the static fleet's "
+            f"{record['static_replica_seconds']}")
+    return problems
 
 
 def main(argv=None):
@@ -298,16 +391,46 @@ def main(argv=None):
     p.add_argument("--sfz-ms", type=float, default=1500.0,
                    help="--check bound on the scale-from-zero first "
                         "request (the ISSUE 12 acceptance number)")
+    p.add_argument("--diurnal", action="store_true",
+                   help="replay the ROADMAP 4(a) diurnal workload "
+                        "instead of the bursty phase trace, banking "
+                        "the level-triggered baseline record")
+    p.add_argument("--workload", default=DIURNAL_WORKLOAD,
+                   help="loadgen workload spec for --diurnal")
+    p.add_argument("--seed", type=int,
+                   default=int(os.environ.get("MXNET_SOAK_SEED", 7)))
+    p.add_argument("--time-scale", type=float, default=10.0,
+                   help="--diurnal virtual->real compression")
     p.add_argument("--check", action="store_true")
     p.add_argument("--output", default=None)
     args = p.parse_args(argv)
 
-    record = bench(args)
+    record = diurnal_bench(args) if args.diurnal else bench(args)
+    # reproduction keys (loadgen discipline)
+    record.update(provenance(
+        args.workload if args.diurnal
+        else (f"autoscale:bursty,clients={args.clients},"
+              f"phase_s={args.phase_s:g}"),
+        args.seed))
     line = json.dumps(record)
     print(line, flush=True)
     if args.output:
         with open(args.output, "w") as f:
             f.write(line + "\n")
+
+    if args.check and args.diurnal:
+        problems = diurnal_check(record)
+        if problems:
+            print("autoscale_bench --diurnal --check FAILED:\n  - "
+                  + "\n  - ".join(problems), file=sys.stderr)
+            return 1
+        print(f"autoscale_bench --diurnal ok: "
+              f"{record['completed']}/{record['arrivals']} arrivals, "
+              f"peak {record['peak_replicas']}, replica-seconds "
+              f"{record['replica_seconds']} vs static "
+              f"{record['static_replica_seconds']}, "
+              f"{record['value']} violating minute(s) banked")
+        return 0
 
     if args.check:
         problems = []

@@ -52,6 +52,9 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import numpy as onp   # noqa: E402
 
+from incubator_mxnet_tpu.serving.loadgen.clients import (  # noqa: E402
+    percentile, provenance, sync_volley, wave_volley)
+
 
 def _toy_artifact(prefix, width=128, depth=6):
     """Dispatch-overhead-dominated MLP: the regime a request-per-call
@@ -90,11 +93,6 @@ def _instances(meta, n, seed=1):
                   for sh, dt in zip(shapes, dtypes)) for _ in range(n)]
 
 
-def _p99(latencies_ms):
-    data = sorted(latencies_ms)
-    return data[min(len(data) - 1, int(0.99 * len(data)))]
-
-
 def bench(args):
     from incubator_mxnet_tpu import deploy
     from incubator_mxnet_tpu.serving import InferenceServer
@@ -127,50 +125,24 @@ def bench(args):
             pred(*[x[None] for x in instances[k % args.requests]])
             lat.append((time.monotonic() - t1) * 1000.0)
         dt = time.monotonic() - t0
-        return {"rps": total / dt, "p99_ms": _p99(lat), "total_s": dt}
+        return {"rps": total / dt, "p99_ms": percentile(lat, 0.99),
+                "total_s": dt}
 
     def batched_volley():
         # args.requests single requests stay concurrently in flight,
         # multiplexed over a few client threads via predict_async —
-        # the shape an async HTTP front end gives the batcher.  (One
-        # OS thread per request measures CPython thread wakeups, not
-        # the serving stack.)
-        nclients = min(args.clients, args.requests)
-        # split every index across clients (remainder spread over the
-        # first few): dropping leftovers would overstate rps (total is
-        # divided by wall clock) and leave result rows unverified
-        bounds = [args.requests * c // nclients
-                  for c in range(nclients + 1)]
-        lat2 = []
-        lat_lock = threading.Lock()
-        barrier = threading.Barrier(nclients + 1)
-
-        def client(c):
-            barrier.wait()
-            mine = []
-            for _ in range(args.rounds):
-                t1 = time.monotonic()
-                ids = range(bounds[c], bounds[c + 1])
-                handles = [
-                    (i, srv.repository.predict_async(
-                        "bench", instances[i])) for i in ids]
-                for i, h in handles:
-                    results[i], _timing = h.result()
-                dt_ms = (time.monotonic() - t1) * 1000.0
-                mine.extend([dt_ms] * len(ids))  # whole-wave latency
-            with lat_lock:
-                lat2.extend(mine)
-
-        threads = [threading.Thread(target=client, args=(c,))
-                   for c in range(nclients)]
-        for t in threads:
-            t.start()
-        barrier.wait()
-        t0 = time.monotonic()
-        for t in threads:
-            t.join()
-        dt = time.monotonic() - t0
-        return {"rps": total / dt, "p99_ms": _p99(lat2), "total_s": dt}
+        # the shape an async HTTP front end gives the batcher
+        # (loadgen.clients.wave_volley owns the engine)
+        res = wave_volley(
+            lambda i: srv.repository.predict_async(
+                "bench", instances[i]),
+            args.requests, rounds=args.rounds, clients=args.clients,
+            resolve=lambda h: h.result()[0])
+        if res.errors:
+            raise res.errors[0][1]
+        results[:] = res.results
+        return {"rps": res.rps, "p99_ms": res.p99_ms(),
+                "total_s": res.total_s}
 
     # interleave baseline/batched trials and take the best of each:
     # shared-box throughput wobbles run to run, so measuring the two
@@ -257,45 +229,20 @@ def fleet_bench(args):
                              backend=args.backend).spawn()
         router = FleetRouter(fleet)
         try:
-            results = [None] * args.requests
-            nclients = min(args.clients, args.requests)
-            bounds = [args.requests * k // nclients
-                      for k in range(nclients + 1)]
-            lat = []
-            lat_lock = threading.Lock()
-            barrier = threading.Barrier(nclients + 1)
+            def call(i):
+                out, _t = router.route("bench", instances[i],
+                                       inputs_json=encoded[i])
+                return out
 
-            def client(k):
-                barrier.wait()
-                mine = []
-                for _ in range(args.rounds):
-                    for i in range(bounds[k], bounds[k + 1]):
-                        t1 = time.monotonic()
-                        try:
-                            out, _t = router.route(
-                                "bench", instances[i],
-                                inputs_json=encoded[i])
-                            results[i] = out
-                        except Exception as e:  # mxlint: allow-broad-except(bench verdict: every failure is collected and fails --check)
-                            failed.append((n, i, repr(e)))
-                            return
-                        mine.append(
-                            (time.monotonic() - t1) * 1000.0)
-                with lat_lock:
-                    lat.extend(mine)
-
-            threads = [threading.Thread(target=client, args=(k,))
-                       for k in range(nclients)]
-            for t in threads:
-                t.start()
-            barrier.wait()
-            t0 = time.monotonic()
-            for t in threads:
-                t.join()
-            dt = time.monotonic() - t0
-            curve[n] = {"rps": round(total / dt, 2),
-                        "p99_ms": round(_p99(lat), 3) if lat else None,
-                        "total_s": round(dt, 3)}
+            res = sync_volley(call, args.requests,
+                              rounds=args.rounds,
+                              clients=args.clients)
+            results = res.results
+            failed.extend((n, i, repr(e)) for i, e in res.errors)
+            curve[n] = {"rps": round(res.rps, 2),
+                        "p99_ms": (round(res.p99_ms(), 3)
+                                   if res.lat_ms else None),
+                        "total_s": round(res.total_s, 3)}
             for i in range(0, args.requests,
                            max(1, args.requests // 8)):
                 if results[i] is None:
@@ -371,34 +318,11 @@ def _overhead_rig(args, prefix_name, seed):
     router = FleetRouter(fleet)
 
     def volley():
-        results = [None] * args.requests
-        nclients = min(args.clients, args.requests)
-        bounds = [args.requests * k // nclients
-                  for k in range(nclients + 1)]
-        errors = []
-        barrier = threading.Barrier(nclients + 1)
-
-        def client(k):
-            barrier.wait()
-            for _ in range(args.rounds):
-                for i in range(bounds[k], bounds[k + 1]):
-                    try:
-                        out, _t = router.route("bench", instances[i])
-                        results[i] = out
-                    except Exception as e:  # mxlint: allow-broad-except(bench verdict: failures fail --check)
-                        errors.append(repr(e))
-                        return
-
-        threads = [threading.Thread(target=client, args=(k,))
-                   for k in range(nclients)]
-        for t in threads:
-            t.start()
-        barrier.wait()
-        t0 = time.monotonic()
-        for t in threads:
-            t.join()
-        rps = total / (time.monotonic() - t0)
-        return rps, results, errors
+        res = sync_volley(
+            lambda i: router.route("bench", instances[i])[0],
+            args.requests, rounds=args.rounds, clients=args.clients,
+            collect_latency=False)
+        return res.rps, res.results, [repr(e) for _, e in res.errors]
 
     def parity_of(results):
         import jax
@@ -882,6 +806,23 @@ def main(argv=None):
                 failures.append("compile count grew after warmup")
             if not rec["bitwise_equal_unbatched"]:
                 failures.append("batched outputs != unbatched outputs")
+    # reproduction keys (loadgen discipline): which volley, which
+    # instance seed, and whatever chaos spec the environment carried
+    if args.trace_check:
+        wl, seed = "volley:overhead=trace", 5
+    elif args.flight_check:
+        wl, seed = "volley:overhead=flight", 9
+    elif args.routerha_check:
+        wl, seed = "volley:overhead=routerha", 11
+    elif args.replicas:
+        wl, seed = (f"volley:fleet,requests={args.requests},"
+                    f"rounds={args.rounds}"), 3
+    elif args.smoke:
+        wl, seed = "volley:smoke", 2
+    else:
+        wl, seed = (f"volley:batched,requests={args.requests},"
+                    f"rounds={args.rounds}"), 1
+    rec.update(provenance(wl, seed))
     line = json.dumps(rec)
     print(line, flush=True)
     if args.output:

@@ -36,7 +36,6 @@ import argparse
 import json
 import os
 import sys
-import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -45,6 +44,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import numpy as onp   # noqa: E402
+
+from incubator_mxnet_tpu.serving.loadgen.clients import (  # noqa: E402
+    provenance, sync_volley)
 
 
 def _mgr(args, tmp_dir=None, warmup=True):
@@ -91,28 +93,21 @@ def _bench(args, tmp_dir):
     mgr_seq.batcher.drain()
 
     # -- continuous: all sessions stream at once ----------------------
+    # one sync_volley client per session keeps every stream
+    # concurrently in flight — the shape continuous batching exists for
     mgr = _mgr(args, tmp_dir=os.path.join(tmp_dir, "conc"))
     compile_before = mgr.model.compile_count
-    conc_outs = {}
-    errors = []
 
-    def run(i):
-        try:
-            mgr.create(f"c{i}")
-            chunks, _ = mgr.step(f"c{i}", _x(i, args.dim),
-                                 steps=steps)
-            conc_outs[i] = [onp.asarray(c[0]) for c in chunks]
-        except Exception as e:  # mxlint: allow-broad-except(bench harness: every failure is recorded into the record's errors list, which fails --check)
-            errors.append(f"{type(e).__name__}: {e}")
+    def stream(i):
+        mgr.create(f"c{i}")
+        chunks, _ = mgr.step(f"c{i}", _x(i, args.dim), steps=steps)
+        return [onp.asarray(c[0]) for c in chunks]
 
-    threads = [threading.Thread(target=run, args=(i,))
-               for i in range(n)]
-    t0 = time.monotonic()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    conc_s = time.monotonic() - t0
+    res = sync_volley(stream, n, clients=n, collect_latency=False,
+                      stop_on_error=False)
+    conc_s = res.total_s
+    conc_outs = res.results
+    errors = [f"{type(e).__name__}: {e}" for _, e in res.errors]
 
     parity = not errors and all(
         (conc_outs[i][k] == seq_outs[i][k]).all()
@@ -126,12 +121,9 @@ def _bench(args, tmp_dir):
             mgr.step(sid, _x(j + k, args.dim), steps=1 + (k % 3))
             mgr.close(sid)
 
-    threads = [threading.Thread(target=churn, args=(j,))
-               for j in range(4)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
+    churned = sync_volley(churn, 4, clients=4, collect_latency=False)
+    if churned.errors:
+        raise churned.errors[0][1]
     compile_after = mgr.model.compile_count
     compile_stable = compile_after == compile_before
 
@@ -202,6 +194,11 @@ def main(argv=None):
     args.buckets = [int(v) for v in args.buckets.split(",")]
 
     record = bench(args)
+    # reproduction keys (loadgen discipline): the volley shape, the
+    # decoder seed, and whatever chaos spec the environment carried
+    record.update(provenance(
+        f"sessions:continuous,n={args.sessions},steps={args.steps}",
+        0))
     line = json.dumps(record)
     print(line, flush=True)
     if args.output:

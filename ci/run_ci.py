@@ -95,6 +95,18 @@ Stages (each isolated, failures collected, nonzero exit if any fail):
              --routerha-check (leased-member volley flat within noise
              of HA-off, owner_of microbench, bitwise parity)
 
+  soak       production-shaped soak (docs/capacity.md):
+             tests/test_loadgen.py — schedule determinism, the
+             heavy-tail sampler's pinned statistics, virtual-time
+             incident scheduling, the zero-lost-streams ledger's
+             negative controls, the SLO reader on real /metrics text
+             — teed to .ci_soak_stage.log; then soak_bench --check:
+             a time-compressed flash crowd + mid-crowd replica
+             SIGKILL + pre-armed fault burst on a 2-replica
+             subprocess fleet, gated on the capacity curve (knee
+             identified), per-class SLO conformance, postmortem
+             --gate per incident, and zero lost streams (bitwise)
+
   trace      request-scoped tracing sweep (docs/observability.md):
              tests/test_trace.py under a pinned seeded spec — span
              recorder semantics, header-propagation edge cases, ring
@@ -549,6 +561,68 @@ def stage_routerha(args):
                   f"{rec['bitwise_equal_with_ha']}")
 
 
+# Pinned soak chaos spec: a low-probability route fault burst (armed
+# in every subprocess, verified post-hoc by its fault.serving.route
+# flight events) plus a perturbed incident-scheduler tick — chaos on
+# the chaos injector itself.  Seeded so a soak failure replays from
+# the spec string alone (the bench also prints its one-line repro).
+SOAK_SPEC = ("serving.route:error:p=0.01:seed=3,"
+             "loadgen.tick:delay:ms=5:n=3")
+
+
+def stage_soak(args):
+    """Production-shaped soak (docs/capacity.md): the test_loadgen.py
+    battery — deterministic schedule compilation, pinned heavy-tail
+    sampler statistics, virtual-time incident scheduling, the
+    zero-lost-streams ledger's negative controls, the SLO reader on
+    real /metrics exposition — teed to a log; then soak_bench
+    --check: capacity curve (>=2 replica counts x >=3 offered points,
+    knee identified) + a time-compressed flash crowd over a
+    2-replica subprocess fleet with a mid-crowd replica SIGKILL and a
+    pre-armed fault burst, gated on per-class SLO conformance,
+    postmortem --gate per incident, and zero lost streams."""
+    log = os.path.join(REPO, ".ci_soak_stage.log")
+    proc = sh([sys.executable, "-m", "pytest", "-q",
+               "tests/test_loadgen.py",
+               "--continue-on-collection-errors",
+               "-p", "no:cacheprovider"], timeout=600)
+    with open(log, "w") as f:
+        f.write(proc.stdout or "")
+        if proc.stderr:
+            f.write("\n--- stderr ---\n" + proc.stderr)
+    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+    if proc.returncode != 0:
+        return False, f"{tail} (full output: {log})"
+    out = os.path.join(REPO, ".ci_soak_bench.json")
+    try:
+        proc2 = sh([sys.executable, "benchmark/soak_bench.py",
+                    "--check", "--chaos", SOAK_SPEC,
+                    "--output", out], timeout=600)
+        with open(log, "a") as f:
+            f.write("\n--- soak_bench ---\n")
+            f.write(proc2.stdout or "")
+            if proc2.stderr:
+                f.write("\n--- soak_bench stderr ---\n" + proc2.stderr)
+        if proc2.returncode != 0:
+            return False, (proc2.stderr or proc2.stdout).strip()[-400:]
+        with open(out) as f:
+            rec = json.load(f)
+    finally:
+        if os.path.exists(out):
+            os.remove(out)
+    soak = rec["soak"]
+    inter = soak["slo"].get("interactive", {})
+    return True, (f"{tail}; knee "
+                  f"{rec['capacity']['knee']['knee_replicas']} "
+                  f"replica(s) @ {rec['value']} rps, "
+                  f"{soak['sessions']} streams / "
+                  f"{soak['lost_streams']} lost, interactive p99 "
+                  f"{inter.get('p99_ms')}ms "
+                  f"({len(inter.get('violating_minutes', []))} "
+                  f"violating min), "
+                  f"{len(soak['incidents'])} incidents gated")
+
+
 # Pinned trace-chaos spec: replica-side faults (absorbed by failover —
 # each failed hop must land as a SPAN with a typed outcome and the
 # injected fault as a span event) plus jittered device execution.
@@ -827,6 +901,7 @@ STAGES = {"build": stage_build, "sanity": stage_sanity,
           "trace": stage_trace,
           "flight": stage_flight,
           "routerha": stage_routerha,
+          "soak": stage_soak,
           "coldstart": stage_coldstart,
           "trainloop": stage_trainloop,
           "race": stage_race,

@@ -54,6 +54,10 @@ Injection points (each named where the fault physically occurs):
   (surfaced typed — the hop budget bounds the loop either way)
 * ``trainer.step``      — an elastic trainer step about to run (the
   eviction-notice / checkpoint-on-evict path)
+* ``loadgen.tick``      — the soak harness's incident scheduler about
+  to poll its virtual clock (serving/loadgen).  A delay models a late
+  incident injector (chaos landing mid-recovery); an error perturbs a
+  tick without skipping the incident
 
 Spec grammar (``MXNET_FAULT_SPEC``)::
 
@@ -110,7 +114,7 @@ POINTS = ("kvstore.send", "kvstore.recv", "kvstore.heartbeat",
           "serving.session_step", "serving.session_snapshot",
           "serving.stream_write", "serving.scale",
           "serving.router_lease", "serving.router_forward",
-          "trainer.step")
+          "trainer.step", "loadgen.tick")
 
 _POINT_SET = frozenset(POINTS)
 

@@ -1,0 +1,37 @@
+"""Workload-replay + capacity-planning harness (docs/capacity.md).
+
+The bench suite gates point floors; this package is the subsystem that
+replays *production-shaped* traffic — diurnal ramps, flash crowds,
+heavy-tailed session lengths, multi-tenant mixes, adversarial
+burst-on-shrink — as closed-loop clients against a real fleet under a
+seeded chaos spec, and asserts the north-star claim with the
+observability stack: per-class SLO conformance from ``/metrics`` +
+exemplars, ``tools/postmortem.py --gate`` for every injected incident,
+and zero lost streams (bitwise).
+
+Modules:
+
+* :mod:`.workload` — declarative, seeded workload specs that compile
+  to a deterministic virtual-time arrival schedule (same seed ⇒ same
+  schedule, bit for bit; a ``time_scale`` knob compresses replay).
+* :mod:`.clients`  — the closed-loop client machinery every bench
+  shares (volley engines, duration phases, HTTP predict/session
+  clients with per-request SLO-class headers).
+* :mod:`.harness`  — subprocess fleet under chaos with scheduled
+  incident injection (SIGKILL replica/router at *t*), the
+  ``/metrics`` conformance reader, the zero-lost-streams ledger and
+  the postmortem gate driver.
+* :mod:`.capacity` — offered-load x replica-count sweeps emitting the
+  capacity curve (offered QPS vs replicas at SLO) with knee detection.
+"""
+from .workload import (Arrival, Schedule, WorkloadSpec,  # noqa: F401
+                       parse_workload, pareto_steps)
+from .clients import (percentile, sync_volley, wave_volley,  # noqa: F401
+                      VolleyResult, ClosedLoopPhase,
+                      PredictClient, SessionClient, StreamBroken,
+                      post_json, post_retry, scrape, SLO_HEADER,
+                      provenance)
+from .harness import (Incident, IncidentScheduler,  # noqa: F401
+                      SloMonitor, StreamLedger, SoakHarness,
+                      parse_prometheus, slo_targets)
+from .capacity import sweep_capacity, find_knee  # noqa: F401

@@ -1,0 +1,153 @@
+"""Capacity planning: offered-load x replica-count sweeps.
+
+The north-star claim ("serves heavy traffic from millions of users")
+becomes a measured curve here: for each replica count, calibrate the
+fleet's closed-loop ceiling, then probe open-loop offered rates
+against the SLO targets and record which offered points CONFORM
+(achieved/offered >= 0.9, in-run p99 under the class target, zero
+errors).  :func:`find_knee` reduces the curve to the per-replica
+capacity and the knee — the replica count past which marginal
+capacity stops scaling (docs/capacity.md "Reading a capacity curve").
+
+The sweep runs in-process (thread-backend fleet, direct
+``router.route``) so its numbers measure the serving stack, not HTTP
+parsing; the chaos-laden subprocess verdict is the harness's job.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as onp
+
+from .clients import percentile, sync_volley
+from .harness import slo_targets
+
+__all__ = ["sweep_capacity", "find_knee", "open_loop"]
+
+
+def open_loop(call, rate, n, max_inflight=32, join_s=60.0):
+    """Offer ``n`` requests at a constant ``rate``/s regardless of
+    completions (open loop — the arrival process does not slow down
+    when the server queues, which is what saturates a fleet the way
+    production traffic does).  Returns achieved rps / p99 / errors."""
+    lat, errors = [], []
+    lock = threading.Lock()
+    sem = threading.Semaphore(max_inflight)
+    threads = []
+    t0 = time.monotonic()
+    for i in range(n):
+        wait = i / rate - (time.monotonic() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        sem.acquire()
+
+        def one(i=i):
+            t1 = time.monotonic()
+            try:
+                call(i)
+                with lock:
+                    lat.append((time.monotonic() - t1) * 1000.0)
+            except Exception as e:  # mxlint: allow-broad-except(sweep probe: failures are the measurement — they mark the offered point non-conformant)
+                with lock:
+                    errors.append((i, f"{type(e).__name__}: {e}"))
+            finally:
+                sem.release()
+
+        th = threading.Thread(target=one, daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(join_s)
+    elapsed = max(time.monotonic() - t0, 1e-9)
+    return {"achieved_rps": len(lat) / elapsed,
+            "p99_ms": percentile(lat, 0.99),
+            "completed": len(lat),
+            "errors": len(errors),
+            "error_sample": [e for _, e in errors[:3]]}
+
+
+def sweep_capacity(prefix, replica_counts=(1, 2),
+                   load_fractions=(0.25, 0.5, 1.0), requests=48,
+                   clients=8, width=16, model="bench",
+                   slo_class="standard", backend="thread"):
+    """Sweep offered load across replica counts against the exported
+    artifact at ``prefix``.  Returns the capacity-curve dict the soak
+    bench embeds: per-point conformance plus the knee reduction."""
+    from .. import FleetRouter, ReplicaFleet
+
+    target_ms = slo_targets().get(slo_class)
+    rng = onp.random.RandomState(3)
+    xs = [rng.randn(width).astype(onp.float32)
+          for _ in range(requests)]
+    points = []
+    for n in sorted(replica_counts):
+        fleet = ReplicaFleet({model: prefix}, n=n, backend=backend,
+                             warmup=False, probe_ms=60000.0,
+                             buckets=[1, 2, 4]).spawn()
+        router = FleetRouter(fleet)
+        try:
+            def call(i):
+                out, _t = router.route(model, (xs[i % requests],),
+                                       deadline_ms=10000.0)
+                return out
+
+            # calibration: closed-loop ceiling for THIS replica count
+            sync_volley(call, min(16, requests), clients=clients)
+            cal = sync_volley(call, requests, clients=clients)
+            if cal.errors:
+                raise RuntimeError(
+                    f"calibration volley failed at n={n}: "
+                    f"{cal.errors[0][1]!r}")
+            for frac in sorted(load_fractions):
+                offered = max(cal.rps * frac, 0.5)
+                probe = open_loop(call, offered, requests)
+                conformant = (probe["errors"] == 0
+                              and probe["completed"] >= 0.9 * requests
+                              and probe["achieved_rps"]
+                              >= 0.8 * offered
+                              and (target_ms is None
+                                   or probe["p99_ms"] <= target_ms))
+                points.append({
+                    "replicas": n,
+                    "load_fraction": frac,
+                    "offered_rps": round(offered, 2),
+                    "achieved_rps": round(probe["achieved_rps"], 2),
+                    "p99_ms": round(probe["p99_ms"], 3),
+                    "errors": probe["errors"],
+                    "conformant": bool(conformant),
+                })
+        finally:
+            router.shutdown()
+    return {"points": points, "knee": find_knee(points),
+            "slo_class": slo_class, "target_ms": target_ms,
+            "requests_per_point": requests}
+
+
+def find_knee(points) -> dict:
+    """Reduce sweep points to per-replica-count SLO capacity and the
+    knee: the last replica count whose marginal capacity gain still
+    reaches half the first count's per-replica capacity (past it,
+    adding replicas stops paying — the planning answer a capacity
+    curve exists to give)."""
+    caps: dict = {}
+    for pt in points:
+        if pt["conformant"]:
+            caps[pt["replicas"]] = max(caps.get(pt["replicas"], 0.0),
+                                       pt["offered_rps"])
+    counts = sorted(caps)
+    if not counts:
+        return {"capacity_rps": {}, "knee_replicas": None,
+                "per_replica_rps": None}
+    base = caps[counts[0]] / counts[0]
+    knee = counts[0]
+    for prev, cur in zip(counts, counts[1:]):
+        marginal = (caps[cur] - caps[prev]) / (cur - prev)
+        if marginal >= 0.5 * base:
+            knee = cur
+        else:
+            break
+    return {"capacity_rps": {str(c): round(caps[c], 2)
+                             for c in counts},
+            "knee_replicas": knee,
+            "per_replica_rps": round(base, 2)}

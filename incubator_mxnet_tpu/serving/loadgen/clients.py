@@ -1,0 +1,397 @@
+"""Closed-loop client machinery shared by every bench and the soak
+harness.
+
+Before this module, ``benchmark/serving_bench.py``,
+``session_bench.py`` and ``autoscale_bench.py`` each carried a
+near-duplicate copy of the same volley engine (bounds-split client
+threads behind a start barrier, latency + error collection).  The one
+implementation lives here now; the benches are thin scenario drivers
+on top of it.
+
+Three engines, one per traffic shape the benches need:
+
+* :func:`sync_volley`  — N requests x R rounds of synchronous calls,
+  per-request latency (the fleet/overhead volleys).
+* :func:`wave_volley`  — async submit-then-resolve waves with
+  whole-wave latency (the dynamic-batching volley, where per-handle
+  latency would measure CPython thread wakeups, not the server).
+* :class:`ClosedLoopPhase` — duration-based closed loop with SLO shed
+  accounting (the autoscale trace phases).
+
+Plus the HTTP clients the soak harness replays workloads through:
+:class:`PredictClient` and :class:`SessionClient` speak the router's
+wire API with per-request SLO-class headers (``X-MXNET-SLO-CLASS``)
+and bounded retry over failover/takeover windows.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+__all__ = ["percentile", "VolleyResult", "sync_volley", "wave_volley",
+           "ClosedLoopPhase", "post_json", "post_retry", "scrape",
+           "PredictClient", "SessionClient", "StreamBroken",
+           "SLO_HEADER", "provenance"]
+
+
+def provenance(workload, seed):
+    """The reproduction keys every bench/harness JSON artifact
+    records (reproduction discipline: a failure replays from the
+    artifact alone): the workload name, the seed, and whatever chaos
+    spec was live in the environment."""
+    return {"workload": str(workload), "seed": int(seed),
+            "chaos_spec": os.environ.get("MXNET_FAULT_SPEC", "")}
+
+#: Per-request SLO-class tag: clients label every request with the
+#: class they expect conformance against, so a front end (or a future
+#: per-request admission path) can tell tiers apart on the wire.
+SLO_HEADER = "X-MXNET-SLO-CLASS"
+
+
+def percentile(latencies, q):
+    """Nearest-rank percentile (0 for an empty sample)."""
+    data = sorted(latencies)
+    if not data:
+        return 0.0
+    return data[min(len(data) - 1, int(q * len(data)))]
+
+
+class VolleyResult:
+    """What a volley measured: throughput, latencies, results, errors.
+
+    ``errors`` is a list of ``(index, exception)`` tuples — callers
+    decide whether an error fails the bench or is an expected shed.
+    """
+
+    def __init__(self, rps, total_s, results, lat_ms, errors):
+        self.rps = rps
+        self.total_s = total_s
+        self.results = results
+        self.lat_ms = lat_ms
+        self.errors = errors
+
+    def p99_ms(self):
+        return percentile(self.lat_ms, 0.99)
+
+
+def _client_bounds(n, clients):
+    """Split indices 0..n-1 across client threads, remainder spread
+    over the first few — dropping leftovers would overstate rps and
+    leave result rows unverified."""
+    nclients = max(1, min(clients, n))
+    return nclients, [n * c // nclients for c in range(nclients + 1)]
+
+
+def sync_volley(call, n, rounds=1, clients=8, collect_latency=True,
+                stop_on_error=True):
+    """Closed-loop synchronous volley: ``call(i)`` for every index,
+    ``rounds`` times, across ``clients`` threads behind one start
+    barrier.  Per-request latency; the wall clock starts when the
+    barrier releases, so thread spawn time is off-clock."""
+    nclients, bounds = _client_bounds(n, clients)
+    results = [None] * n
+    lat, errors = [], []
+    lock = threading.Lock()
+    barrier = threading.Barrier(nclients + 1)
+
+    def client(c):
+        barrier.wait()
+        mine = []
+        for _ in range(rounds):
+            for i in range(bounds[c], bounds[c + 1]):
+                t1 = time.monotonic()
+                try:
+                    results[i] = call(i)
+                except Exception as e:  # mxlint: allow-broad-except(volley engine: every failure is collected into VolleyResult.errors for the caller's verdict)
+                    with lock:
+                        errors.append((i, e))
+                    if stop_on_error:
+                        return
+                    continue
+                if collect_latency:
+                    mine.append((time.monotonic() - t1) * 1000.0)
+        if mine:
+            with lock:
+                lat.extend(mine)
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(nclients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.monotonic()
+    for t in threads:
+        t.join()
+    dt = time.monotonic() - t0
+    return VolleyResult(n * rounds / dt, dt, results, lat, errors)
+
+
+def wave_volley(submit, n, rounds=1, clients=8, resolve=None):
+    """Async wave volley: each client submits handles for its whole
+    index range, then resolves them — the shape an async HTTP front
+    end gives a dynamic batcher.  Latency is whole-wave per index
+    (one OS thread per request would measure CPython thread wakeups,
+    not the serving stack)."""
+    resolve = resolve or (lambda h: h.result())
+    nclients, bounds = _client_bounds(n, clients)
+    results = [None] * n
+    lat, errors = [], []
+    lock = threading.Lock()
+    barrier = threading.Barrier(nclients + 1)
+
+    def client(c):
+        barrier.wait()
+        mine = []
+        for _ in range(rounds):
+            t1 = time.monotonic()
+            ids = range(bounds[c], bounds[c + 1])
+            try:
+                handles = [(i, submit(i)) for i in ids]
+                for i, h in handles:
+                    results[i] = resolve(h)
+            except Exception as e:  # mxlint: allow-broad-except(volley engine: every failure is collected into VolleyResult.errors for the caller's verdict)
+                with lock:
+                    errors.append((bounds[c], e))
+                return
+            dt_ms = (time.monotonic() - t1) * 1000.0
+            mine.extend([dt_ms] * len(ids))       # whole-wave latency
+        with lock:
+            lat.extend(mine)
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(nclients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.monotonic()
+    for t in threads:
+        t.join()
+    dt = time.monotonic() - t0
+    return VolleyResult(n * rounds / dt, dt, results, lat, errors)
+
+
+class ClosedLoopPhase:
+    """Duration-based closed-loop clients with SLO shed accounting —
+    one trace phase of the autoscale bench, or one plateau of a soak.
+
+    ``route(model, x)`` is the request; shed (429 / placement
+    backpressure) is counted separately from organic errors because
+    shedding the batch tier is the SLO contract's *explicit* arm while
+    any interactive shed fails the trace.
+    """
+
+    def __init__(self, route, width):
+        self.route = route
+        self.width = width
+        self.lat_ms = {}      # model -> [ms]
+        self.errors = {}      # model -> [repr]
+        self.shed = {}        # model -> count (429/503 — the SLO arm)
+        self._lock = threading.Lock()
+
+    def _client(self, model, stop, rng):
+        from ..admission import QueueFullError
+        x = rng.randn(self.width).astype("float32")
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                self.route(model, x)
+                ms = (time.monotonic() - t0) * 1000.0
+                with self._lock:
+                    self.lat_ms.setdefault(model, []).append(ms)
+            except (QueueFullError, ConnectionError) as e:
+                # shed / placement backpressure: counted, and fatal
+                # for the interactive tier
+                with self._lock:
+                    self.shed[model] = self.shed.get(model, 0) + 1
+                    self.errors.setdefault(model, []).append(
+                        type(e).__name__)
+                time.sleep(0.005)
+            except Exception as e:  # mxlint: allow-broad-except(bench harness: every failure lands in the per-model error list, which fails --check)
+                with self._lock:
+                    self.errors.setdefault(model, []).append(
+                        f"{type(e).__name__}: {e}")
+                time.sleep(0.005)
+
+    def run(self, clients, duration_s, seed=7):
+        import numpy as onp
+        stop = threading.Event()
+        threads = []
+        for i, model in enumerate(clients):
+            rng = onp.random.RandomState(seed + i)
+            t = threading.Thread(target=self._client,
+                                 args=(model, stop, rng), daemon=True)
+            t.start()
+            threads.append(t)
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(10.0)
+        return self
+
+
+# ---------------------------------------------------------------------------
+# HTTP clients (router wire API)
+# ---------------------------------------------------------------------------
+
+def post_json(port, path, body, headers=None, timeout=60):
+    """One JSON POST against a local router/server; returns
+    ``(status, parsed_body)``."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def post_retry(port, path, body, deadline_s=30, headers=None,
+               retry_codes=(503,), backoff_s=0.25):
+    """POST with bounded retry over a failover/takeover window: 503s
+    and refused sockets are the EXPECTED transient while a dead
+    replica quarantines or a dead router's lease ages out — a lost
+    request is anything that still fails past the deadline."""
+    end = time.monotonic() + deadline_s
+    last = None
+    while time.monotonic() < end:
+        try:
+            return post_json(port, path, body, headers=headers,
+                             timeout=60)
+        except urllib.error.HTTPError as e:
+            last = e
+            if e.code not in retry_codes:
+                raise
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            last = e
+        time.sleep(backoff_s)
+    raise TimeoutError(
+        f"request {path} did not land within {deadline_s}s: {last!r}")
+
+
+def scrape(port, path="/metrics", timeout=30):
+    """GET a text endpoint (``/metrics``) and return the body."""
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+class StreamBroken(ConnectionError):
+    """A chunked session stream broke before its ``done`` terminator
+    (replica or router died mid-relay): the chunks received cannot be
+    placed at absolute step indices, so the caller retries the step —
+    the server re-bases from its last durable snapshot."""
+
+
+class PredictClient:
+    """Closed-loop predict client tagging every request with its SLO
+    class; retries over failover windows via :func:`post_retry`."""
+
+    def __init__(self, port, model, slo="standard"):
+        self.port = port
+        self.model = model
+        self.slo = slo
+
+    def __call__(self, inputs, timeout_ms=None, deadline_s=30):
+        body = {"inputs": [x.tolist() if hasattr(x, "tolist") else x
+                           for x in inputs]}
+        if timeout_ms is not None:
+            body["timeout_ms"] = timeout_ms
+        code, out = post_retry(
+            self.port, f"/v1/models/{self.model}:predict", body,
+            deadline_s=deadline_s, headers={SLO_HEADER: self.slo},
+            backoff_s=0.1)
+        return code, out
+
+
+class SessionClient:
+    """Session-stream client: creates a session, steps it in chunks,
+    and yields only COMPLETED steps (a broken stream surfaces as
+    :class:`StreamBroken`; the retry re-bases server-side).
+
+    Every completed step reports ``(base, chunks, timing)`` where
+    ``base = session_steps - steps`` — the absolute index of the first
+    chunk, which is what makes the zero-lost-streams ledger's bitwise
+    coverage check possible across migrations and re-bases.
+    """
+
+    def __init__(self, port, model, sid, slo="interactive"):
+        self.port = port
+        self.model = model
+        self.sid = sid
+        self.slo = slo
+        self.recreates = 0
+
+    def _headers(self):
+        return {SLO_HEADER: self.slo}
+
+    def create(self, deadline_s=30):
+        code, _ = post_retry(
+            self.port, f"/v1/sessions/{self.model}:create",
+            {"session_id": self.sid}, deadline_s=deadline_s,
+            headers=self._headers())
+        if code != 200:
+            raise ConnectionError(
+                f"session {self.sid!r} create answered {code}")
+
+    def step(self, inputs, steps, stream=False, deadline_s=45):
+        """One decode call of ``steps`` steps.  Returns
+        ``(base, chunks, timing)`` for a COMPLETED call; raises
+        :class:`StreamBroken` on a mid-stream break and
+        :class:`SessionLost` (as ConnectionError subclass via 410)
+        handling is the caller's: a 410 Gone re-raises as-is."""
+        body = {"inputs": [x.tolist() if hasattr(x, "tolist") else x
+                           for x in inputs], "steps": steps}
+        if not stream:
+            code, d = post_retry(
+                self.port,
+                f"/v1/sessions/{self.model}/{self.sid}:step", body,
+                deadline_s=deadline_s, headers=self._headers())
+            timing = d["timing"]
+            base = int(timing["session_steps"]) - int(d["steps"])
+            return base, d["outputs"], timing
+        body["stream"] = True
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.port}/v1/sessions/"
+            f"{self.model}/{self.sid}:step",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     **self._headers()})
+        lines = []
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                for raw in resp:
+                    raw = raw.strip()
+                    if raw:
+                        lines.append(json.loads(raw))
+        except urllib.error.HTTPError:
+            # a typed HTTP verdict (410 session-lost, 503 draining) is
+            # NOT a broken stream — the caller's error mapping owns it
+            raise
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            raise StreamBroken(
+                f"stream of {self.sid!r} broke after "
+                f"{len(lines)} line(s): {type(e).__name__}") from e
+        done = lines[-1] if lines else {}
+        if not done.get("done"):
+            # an in-band typed error line or a truncation: either way
+            # the step did not complete — visible, never silent
+            raise StreamBroken(
+                f"stream of {self.sid!r} ended without its done "
+                f"terminator ({done.get('error') or 'truncated'})")
+        timing = done.get("timing", {})
+        chunks = [ln["outputs"] for ln in lines[:-1]]
+        base = int(timing["session_steps"]) - int(done["steps"])
+        return base, chunks, timing
+
+    def close(self, deadline_s=15):
+        try:
+            post_retry(self.port,
+                       f"/v1/sessions/{self.model}/{self.sid}:close",
+                       {}, deadline_s=deadline_s,
+                       headers=self._headers())
+        except (TimeoutError, urllib.error.HTTPError):
+            pass   # close is best-effort; TTL reaps stragglers

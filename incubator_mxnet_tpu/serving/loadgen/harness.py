@@ -1,0 +1,781 @@
+"""Soak harness: a real subprocess fleet under seeded chaos, driven
+by a compiled workload schedule, judged by the observability stack.
+
+The harness stands up router subprocess(es) (two + ``--ha-dir`` for
+router-kill scenarios) over thread- or process-backend replicas, then
+replays a :class:`~.workload.Schedule` through the HTTP clients while
+an :class:`IncidentScheduler` fires scripted incidents (SIGKILL a
+replica at virtual *t*, SIGKILL a router at *t*; fault-point bursts
+are pre-armed in the chaos spec's ``after=``/``n=`` counters and
+gated post-hoc).  Verdicts come from three independent witnesses:
+
+* :class:`SloMonitor` — per-class, per-virtual-minute latency
+  conformance against the ``MXNET_SOAK_SLO_MS`` targets;
+* :class:`StreamLedger` — zero lost streams, bitwise: every session's
+  chunks placed at absolute step indices must cover ``0..N-1`` and
+  equal an unbroken single-session reference run;
+* ``tools/postmortem.py --gate`` — every injected incident must be
+  reconstructable from the surviving flight rings.
+
+Everything a failed soak needs to replay is in the report:
+``(workload, seed, time_scale, chaos_spec)``.
+"""
+from __future__ import annotations
+
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+from typing import NamedTuple
+
+import numpy as onp
+
+from ... import fault
+from ...base import get_env
+from .clients import (PredictClient, SessionClient, StreamBroken,
+                      percentile, scrape, SLO_HEADER)
+
+__all__ = ["Incident", "IncidentScheduler", "SloMonitor",
+           "StreamLedger", "SoakHarness", "parse_prometheus",
+           "slo_targets", "metric_sum"]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+POSTMORTEM = os.path.join(_REPO, "tools", "postmortem.py")
+
+SESSION_SPEC = "toy_decoder:dim=8,max_len=64"
+SESSION_DIM = 8
+
+
+def slo_targets() -> dict:
+    """Per-class latency targets (ms) from ``MXNET_SOAK_SLO_MS``
+    (``class=ms`` entries, comma-joined)."""
+    raw = get_env("MXNET_SOAK_SLO_MS",
+                  "interactive=500,standard=2000,batch=10000")
+    targets = {}
+    for entry in filter(None, (e.strip() for e in raw.split(","))):
+        k, sep, v = entry.partition("=")
+        if not sep:
+            raise ValueError(
+                f"MXNET_SOAK_SLO_MS entry {entry!r}: want class=ms")
+        targets[k.strip()] = float(v)
+    return targets
+
+
+# ---------------------------------------------------------------------------
+# /metrics conformance reader
+# ---------------------------------------------------------------------------
+
+_LABELS_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="([^"]*)"')
+
+
+def _split_series(tok: str):
+    if "{" in tok:
+        name, _, rest = tok.partition("{")
+        return name, dict(_LABELS_RE.findall(rest))
+    return tok, {}
+
+
+def parse_prometheus(text: str) -> dict:
+    """Parse a Prometheus text exposition (the router's ``/metrics``)
+    into ``{"samples": [(name, labels, value)], "exemplars": [...]}``.
+    Exemplar comments (``# exemplar name{labels} k=v ...``) are the
+    slow-trace breadcrumbs the soak report surfaces."""
+    out = {"samples": [], "exemplars": []}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            if line.startswith("# exemplar "):
+                tok, _, rest = line[len("# exemplar "):].partition(" ")
+                name, labels = _split_series(tok)
+                fields = dict(kv.split("=", 1)
+                              for kv in rest.split() if "=" in kv)
+                out["exemplars"].append(
+                    {"name": name, "labels": labels, "fields": fields})
+            continue
+        tok, _, val = line.rpartition(" ")
+        try:
+            value = float(val)
+        except ValueError:
+            continue
+        name, labels = _split_series(tok)
+        out["samples"].append((name, labels, value))
+    return out
+
+
+def metric_sum(parsed: dict, name: str, **labels) -> float:
+    """Sum every sample of ``name`` whose labels include ``labels``."""
+    return sum(v for n, lab, v in parsed["samples"]
+               if n == name and all(lab.get(k) == want
+                                    for k, want in labels.items()))
+
+
+# ---------------------------------------------------------------------------
+# SLO conformance
+# ---------------------------------------------------------------------------
+
+class SloMonitor:
+    """Per-class latency observations binned by VIRTUAL minute.
+
+    A minute violates its class when any request in it failed outright
+    or its in-minute p99 exceeds the class target.  Latencies are real
+    milliseconds (time compression squeezes arrival spacing, never the
+    server's actual response time), binned by the virtual clock so a
+    compressed 30-minute diurnal still reports 30 one-minute verdicts.
+    """
+
+    def __init__(self, targets: dict | None = None):
+        self.targets = dict(slo_targets() if targets is None
+                            else targets)
+        self._obs = []
+        self._lock = threading.Lock()
+
+    def observe(self, t_virtual, slo, ms, ok=True):
+        with self._lock:
+            self._obs.append((int(t_virtual // 60.0), str(slo),
+                              float(ms), bool(ok)))
+
+    def report(self) -> dict:
+        with self._lock:
+            obs = list(self._obs)
+        per: dict = {}
+        for minute, slo, ms, ok in obs:
+            d = per.setdefault(slo, {"lat": [], "minutes": {},
+                                     "failures": 0})
+            d["lat"].append(ms)
+            m = d["minutes"].setdefault(minute,
+                                        {"lat": [], "failures": 0})
+            m["lat"].append(ms)
+            if not ok:
+                d["failures"] += 1
+                m["failures"] += 1
+        out = {}
+        for slo, d in sorted(per.items()):
+            target = self.targets.get(slo)
+            violating = []
+            for minute, m in sorted(d["minutes"].items()):
+                p99 = percentile(m["lat"], 0.99)
+                if m["failures"] or (target is not None
+                                     and p99 > target):
+                    violating.append(minute)
+            out[slo] = {"requests": len(d["lat"]),
+                        "failures": d["failures"],
+                        "p50_ms": round(percentile(d["lat"], 0.5), 3),
+                        "p99_ms": round(percentile(d["lat"], 0.99), 3),
+                        "target_ms": target,
+                        "violating_minutes": violating}
+        return out
+
+
+# ---------------------------------------------------------------------------
+# zero lost streams, bitwise
+# ---------------------------------------------------------------------------
+
+def _freeze(row):
+    return tuple(float(x)
+                 for x in onp.asarray(row, dtype=onp.float64).ravel())
+
+
+class StreamLedger:
+    """Absolute-index chunk ledger: the zero-lost-streams witness.
+
+    Clients record only COMPLETED stream calls, each as
+    ``(base, chunks)`` with ``base = session_steps - steps`` — so
+    after any number of migrations, re-bases and replays, the ledger
+    holds every session's rows keyed by absolute step index.  A lost
+    stream is then undeniable: a hole in ``0..N-1`` coverage, a
+    bitwise divergence from the unbroken reference, or two deliveries
+    of the same index that disagree.
+    """
+
+    def __init__(self):
+        self._rows: dict = {}    # sid -> {step index: frozen row}
+        self._meta: dict = {}    # sid -> {"steps": N, "value": v}
+        self.conflicts: list = []
+        self._lock = threading.Lock()
+
+    def expect(self, sid, steps, value):
+        with self._lock:
+            self._meta[sid] = {"steps": int(steps),
+                               "value": float(value)}
+
+    def meta(self) -> dict:
+        with self._lock:
+            return dict(self._meta)
+
+    def record(self, sid, base, chunks):
+        with self._lock:
+            rows = self._rows.setdefault(sid, {})
+            for j, chunk in enumerate(chunks):
+                idx = int(base) + j
+                row = _freeze(chunk)
+                if idx in rows and rows[idx] != row:
+                    self.conflicts.append(
+                        {"sid": sid, "kind": "conflict",
+                         "steps": [idx], "total": 1})
+                rows[idx] = row
+
+    def verify(self, references: dict) -> list:
+        """``references`` maps sid -> full unbroken row list.  Returns
+        the failure list (empty == zero lost streams)."""
+        with self._lock:
+            failures = list(self.conflicts)
+            for sid, ref in sorted(references.items()):
+                rows = self._rows.get(sid, {})
+                want = [_freeze(r) for r in ref]
+                missing = [i for i in range(len(want))
+                           if i not in rows]
+                if missing:
+                    failures.append({"sid": sid, "kind": "missing",
+                                     "steps": missing[:8],
+                                     "total": len(missing)})
+                    continue
+                diverged = [i for i, w in enumerate(want)
+                            if rows[i] != w]
+                if diverged:
+                    failures.append({"sid": sid, "kind": "diverged",
+                                     "steps": diverged[:8],
+                                     "total": len(diverged)})
+                phantom = sorted(i for i in rows if i >= len(want))
+                if phantom:
+                    failures.append({"sid": sid, "kind": "phantom",
+                                     "steps": phantom[:8],
+                                     "total": len(phantom)})
+        return failures
+
+
+# ---------------------------------------------------------------------------
+# scripted incidents in virtual time
+# ---------------------------------------------------------------------------
+
+class Incident(NamedTuple):
+    """One scripted incident: fire ``kind`` at virtual second ``t``,
+    then demand that ``gate`` (a ``postmortem --gate`` event chain)
+    reconstructs from the surviving flight rings."""
+
+    t: float
+    kind: str        # 'kill_replica' | 'kill_router' | 'fault_burst'
+    target: int = 0  # replica ordinal / router index / unused
+    gate: str = ""
+
+
+class IncidentScheduler:
+    """Fires incidents when the virtual clock passes their ``t``.
+
+    The loop runs on an injectable ``(clock, sleep)`` pair so tests
+    drive it in fake time; each tick passes through the
+    ``loadgen.tick`` fault point, so a chaos spec can delay or error
+    the scheduler itself (a late incident injector is a production
+    scenario too — chaos that arrives during recovery).
+    """
+
+    def __init__(self, incidents, time_scale=1.0,
+                 clock=time.monotonic, sleep=time.sleep,
+                 tick_s=0.05):
+        self.incidents = sorted(incidents, key=lambda i: i.t)
+        self.time_scale = float(time_scale)
+        self.clock = clock
+        self.sleep = sleep
+        self.tick_s = float(tick_s)
+        self.fired: list = []
+        self.perturbed_ticks = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    def run(self, fire) -> list:
+        t0 = self.clock()
+        pending = list(self.incidents)
+        while pending and not self._stop.is_set():
+            try:
+                fault.inject("loadgen.tick",
+                             detail=f"pending={len(pending)}")
+            except fault.FaultInjected:
+                self.perturbed_ticks += 1
+            now_virtual = (self.clock() - t0) * self.time_scale
+            while pending and pending[0].t <= now_virtual:
+                inc = pending.pop(0)
+                fire(inc)
+                self.fired.append((round(now_virtual, 6), inc))
+            if pending:
+                self.sleep(self.tick_s)
+        return self.fired
+
+    def start(self, fire):
+        self._thread = threading.Thread(target=self.run, args=(fire,),
+                                        daemon=True)
+        self._thread.start()
+        return self._thread
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(10.0)
+
+
+# ---------------------------------------------------------------------------
+# the harness
+# ---------------------------------------------------------------------------
+
+class SoakHarness:
+    """Subprocess fleet + schedule replay + incident verdicts.
+
+    ``routers > 1`` spawns a leased HA tier (``--ha-dir``) so
+    ``kill_router`` incidents are survivable; ``backend='process'``
+    makes replicas real child processes so ``kill_replica`` is a true
+    SIGKILL.  The chaos spec string goes to every subprocess via
+    ``MXNET_FAULT_SPEC`` — fault bursts are armed there with
+    ``after=``/``n=`` counters and verified post-hoc by their
+    ``fault.<point>`` flight events.
+    """
+
+    def __init__(self, workdir, schedule, chaos_spec="",
+                 incidents=(), routers=1, replicas=2,
+                 backend="process", width=16, session_model="dec",
+                 max_inflight=64, warmup=True):
+        self.workdir = str(workdir)
+        self.schedule = schedule
+        self.chaos_spec = chaos_spec or ""
+        self.incidents = tuple(incidents)
+        self.routers = int(routers)
+        self.replicas = int(replicas)
+        self.backend = backend
+        self.width = int(width)
+        self.session_model = session_model
+        self.max_inflight = int(max_inflight)
+        self.warmup = bool(warmup)
+        self.procs: list = []      # [(proc, port) or None (killed)]
+        self.killed: set = set()
+        self.errors: list = []
+        self.recreates = 0
+        self._err_lock = threading.Lock()
+        self._prefix = None
+
+    # -- fleet lifecycle -------------------------------------------------
+
+    def _export(self):
+        import jax.numpy as jnp
+        from ... import deploy
+
+        def fwd(params, x):
+            y = x
+            for w in params["layers"]:
+                y = jnp.tanh(y @ w)
+            return y
+
+        rng = onp.random.RandomState(11)
+        params = {"layers": [
+            rng.randn(self.width, self.width).astype(onp.float32)
+            * 0.1 for _ in range(2)]}
+        x = rng.randn(1, self.width).astype(onp.float32)
+        prefix = os.path.join(self.workdir, "soak_model")
+        deploy.export_model(fwd, (x,), prefix, params=params,
+                            aot_buckets=[1, 2, 4])
+        return prefix
+
+    def _env(self):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        env["MXNET_SERVING_SESSION_SNAPSHOT_STEPS"] = "2"
+        env["MXNET_SERVING_BATCH_BUCKETS"] = "1,2,4"
+        env["MXNET_SERVING_MAX_BATCH"] = "4"
+        env["MXNET_FLIGHT_RING"] = "4096"
+        env.pop("MXNET_FAULT_SPEC", None)
+        if self.chaos_spec:
+            env["MXNET_FAULT_SPEC"] = self.chaos_spec
+        return env
+
+    def _spawn_router(self, idx, prefix):
+        models = sorted({a.model for a in self.schedule.arrivals
+                         if a.kind == "predict"}) or ["bench"]
+        cmd = [sys.executable, "-m",
+               "incubator_mxnet_tpu.serving.router"]
+        for m in models:
+            cmd += ["--model", f"{m}={prefix}"]
+        cmd += ["--session-model",
+                f"{self.session_model}={SESSION_SPEC}",
+                "--session-dir", os.path.join(self.workdir, "snaps"),
+                "--replicas", str(self.replicas),
+                "--backend", self.backend,
+                "--host", "127.0.0.1", "--port", "0"]
+        if not self.warmup:
+            cmd.append("--no-warmup")
+        if self.routers > 1:
+            cmd += ["--ha-dir", os.path.join(self.workdir, "ha"),
+                    "--router-id", f"soak-r{idx}",
+                    "--lease-ttl", "1.0"]
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=self._env(), start_new_session=True,
+            cwd=_REPO)
+        port = None
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"soak router {idx} died at startup")
+            if "routing on" in line:
+                port = int(line.rsplit(":", 1)[1].split()[0])
+                break
+        if not port:
+            raise RuntimeError(
+                f"soak router {idx} never reported its port")
+        # drain stdout so the pipe can't wedge the router
+        threading.Thread(target=lambda: [None for _ in proc.stdout],
+                         daemon=True).start()
+        return proc, port
+
+    def start(self):
+        self._prefix = self._export()
+        for idx in range(self.routers):
+            self.procs.append(self._spawn_router(idx, self._prefix))
+        return self
+
+    def stop(self):
+        for ent in self.procs:
+            if ent is None:
+                continue
+            proc, _ = ent
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait()
+        self.procs = [None] * len(self.procs)
+
+    def live_ports(self) -> list:
+        return [port for i, ent in enumerate(self.procs)
+                if ent is not None and i not in self.killed
+                for _, port in [ent]]
+
+    def _live_port(self, k: int) -> int:
+        ports = self.live_ports()
+        if not ports:
+            raise ConnectionError("no live soak router")
+        return ports[k % len(ports)]
+
+    # -- incident arms ---------------------------------------------------
+
+    def replica_pids(self, router_idx=0) -> list:
+        """With ``--backend process``, replicas are child server
+        subprocesses of the router — read them off /proc."""
+        ent = self.procs[router_idx]
+        if ent is None:
+            return []
+        pids = []
+        task_dir = f"/proc/{ent[0].pid}/task"
+        try:
+            for tid in os.listdir(task_dir):
+                with open(f"{task_dir}/{tid}/children") as f:
+                    pids.extend(int(p) for p in f.read().split())
+        except OSError:
+            pass
+        return sorted(set(pids))
+
+    def kill_replica(self, router_idx=0, which=0):
+        pids = self.replica_pids(router_idx)
+        if not pids:
+            raise RuntimeError("no replica child pids to kill")
+        os.kill(pids[which % len(pids)], signal.SIGKILL)
+        return pids[which % len(pids)]
+
+    def kill_router(self, idx):
+        ent = self.procs[idx]
+        if ent is None:
+            return None
+        os.killpg(ent[0].pid, signal.SIGKILL)
+        ent[0].wait()
+        self.killed.add(idx)
+        return ent[0].pid
+
+    def _fire(self, inc: Incident):
+        try:
+            if inc.kind == "kill_replica":
+                self.kill_replica(router_idx=0, which=inc.target)
+            elif inc.kind == "kill_router":
+                self.kill_router(inc.target)
+            # 'fault_burst' is pre-armed in the chaos spec (after=/n=)
+            # — nothing to trigger here; the gate verifies it fired.
+        except Exception as e:  # mxlint: allow-broad-except(incident arm: a misfire must land in the report, not kill the replay thread)
+            with self._err_lock:
+                self.errors.append(
+                    f"incident {inc.kind}@{inc.t}: "
+                    f"{type(e).__name__}: {e}")
+
+    def warm(self):
+        """Pre-warm every replica's predict + decode path (a few
+        concurrent volleys so the router spreads them) — the replay
+        then measures serving, not first-compile."""
+        from .clients import sync_volley
+        n = max(2 * self.replicas, 2)
+        models = sorted({a.model for a in self.schedule.arrivals
+                         if a.kind == "predict"}) or ["bench"]
+        row = [0.05] * self.width
+        for m in models:
+            res = sync_volley(
+                lambda i, m=m: PredictClient(
+                    self._live_port(i), m)([row], deadline_s=90),
+                n, clients=n)
+            if res.errors:
+                raise RuntimeError(
+                    f"warmup predict volley failed for {m!r}: "
+                    f"{res.errors[0][1]!r}")
+        if any(a.kind == "session" for a in self.schedule.arrivals):
+            srow = [0.05] * SESSION_DIM
+
+            def sess(i):
+                c = SessionClient(self._live_port(i),
+                                  self.session_model, f"warm{i}")
+                c.create(deadline_s=90)
+                c.step([srow], 2)
+                c.close()
+
+            res = sync_volley(sess, n, clients=n)
+            if res.errors:
+                raise RuntimeError(
+                    f"warmup session volley failed: "
+                    f"{res.errors[0][1]!r}")
+        return self
+
+    # -- replay ----------------------------------------------------------
+
+    def _note_error(self, what, e):
+        with self._err_lock:
+            self.errors.append(f"{what}: {type(e).__name__}: {e}")
+
+    def _run_predict(self, arr, monitor, t0):
+        cli = PredictClient(self._live_port(arr.client), arr.model,
+                            slo=arr.slo)
+        row = [arr.value] * self.width
+        t1 = time.monotonic()
+        try:
+            code, _ = cli([row], deadline_s=45)
+            ok = code == 200
+        except (TimeoutError, urllib.error.HTTPError,
+                ConnectionError, OSError) as e:
+            ok = False
+            self._note_error(f"predict c{arr.client}", e)
+        ms = (time.monotonic() - t1) * 1000.0
+        monitor.observe((time.monotonic() - t0)
+                        * self.schedule.time_scale,
+                        arr.slo, ms, ok=ok)
+
+    def _recreate(self, cli, deadline_s=30):
+        """Close + re-create a session (replay-from-zero path); one
+        retry covers a close the server hadn't applied yet."""
+        cli.close()
+        try:
+            cli.create(deadline_s=deadline_s)
+        except urllib.error.HTTPError:
+            cli.close()
+            time.sleep(0.2)
+            cli.create(deadline_s=deadline_s)
+
+    def _run_session(self, arr, ledger, monitor, t0):
+        sid = f"s{arr.client}"
+        ledger.expect(sid, arr.steps, arr.value)
+        cli = SessionClient(self._live_port(arr.client),
+                            self.session_model, sid, slo=arr.slo)
+        row = [arr.value] * SESSION_DIM
+        deadline = time.monotonic() + 120
+        try:
+            cli.create(deadline_s=45)
+        except (TimeoutError, ConnectionError,
+                urllib.error.HTTPError) as e:
+            self._note_error(f"session {sid} create", e)
+            return
+        done = 0
+        while done < arr.steps and time.monotonic() < deadline:
+            k = min(4, arr.steps - done)
+            t1 = time.monotonic()
+            try:
+                base, chunks, timing = cli.step([row], k, stream=True)
+            except StreamBroken:
+                # visible break: re-target a live router and retry —
+                # the server re-bases from its last durable snapshot
+                cli.port = self._live_port(arr.client + 1)
+                time.sleep(0.25)
+                continue
+            except urllib.error.HTTPError as e:
+                if e.code == 410:      # session lost: recreate+replay
+                    self.recreates += 1
+                    cli.recreates += 1
+                    cli.port = self._live_port(arr.client + 1)
+                    try:
+                        self._recreate(cli)
+                    except (TimeoutError, ConnectionError,
+                            urllib.error.HTTPError) as e2:
+                        self._note_error(f"session {sid} recreate",
+                                         e2)
+                        return
+                    done = 0
+                    continue
+                if e.code in (503, 429):    # draining / shed: retry
+                    cli.port = self._live_port(arr.client + 1)
+                    time.sleep(0.25)
+                    continue
+                self._note_error(f"session {sid} step", e)
+                return
+            except (TimeoutError, ConnectionError, OSError) as e:
+                cli.port = self._live_port(arr.client + 1)
+                self._note_error(f"session {sid} step", e)
+                time.sleep(0.25)
+                continue
+            ms = (time.monotonic() - t1) * 1000.0
+            monitor.observe((time.monotonic() - t0)
+                            * self.schedule.time_scale,
+                            arr.slo, ms, ok=True)
+            # never record past the reference length (a re-based
+            # replay can overshoot the target step count)
+            ledger.record(sid, base, chunks[:max(0, arr.steps - base)])
+            if base > done:
+                # a broken attempt's steps executed server-side but
+                # were never delivered — the gap can only be refilled
+                # by replaying the (deterministic) session from zero
+                try:
+                    self._recreate(cli)
+                except (TimeoutError, ConnectionError,
+                        urllib.error.HTTPError) as e:
+                    self._note_error(f"session {sid} gap-replay", e)
+                    return
+                self.recreates += 1
+                done = 0
+                continue
+            done = int(timing.get("session_steps", base + k))
+        if done < arr.steps:
+            self._note_error(f"session {sid}",
+                             TimeoutError(
+                                 f"stalled at {done}/{arr.steps}"))
+        cli.close()
+
+    def _references(self, ledger) -> dict:
+        from ..sessions import SessionManager, toy_decoder
+        mgr = SessionManager("soakref",
+                             toy_decoder(dim=SESSION_DIM, max_len=64),
+                             buckets=[1], warmup=False)
+        refs = {}
+        for sid, meta in sorted(ledger.meta().items()):
+            mgr.create(sid)
+            chunks, _ = mgr.step(
+                sid, (onp.full(SESSION_DIM, meta["value"],
+                               onp.float32),),
+                steps=meta["steps"])
+            mgr.close(sid)
+            refs[sid] = [onp.asarray(c[0]) for c in chunks]
+        return refs
+
+    def gate_incidents(self) -> list:
+        """Run ``postmortem --gate`` for every incident that declared
+        a chain, against every surviving router's flight ring."""
+        sources = [f"http://127.0.0.1:{p}/v1/flight"
+                   for p in self.live_ports()]
+        results = []
+        for inc in self.incidents:
+            if not inc.gate:
+                continue
+            r = subprocess.run(
+                [sys.executable, POSTMORTEM, "--gate", inc.gate]
+                + sources, capture_output=True, text=True,
+                cwd=_REPO, timeout=120)
+            results.append({"t": inc.t, "kind": inc.kind,
+                            "gate": inc.gate,
+                            "gate_ok": r.returncode == 0,
+                            "detail": (r.stdout + r.stderr)
+                            .strip()[-400:]})
+        return results
+
+    def run(self) -> dict:
+        """Replay the schedule against the running fleet; returns the
+        full soak report (callers assert on it)."""
+        monitor = SloMonitor()
+        ledger = StreamLedger()
+        scheduler = IncidentScheduler(self.incidents,
+                                      self.schedule.time_scale)
+        threads: list = []
+        gate = threading.Semaphore(self.max_inflight)
+
+        def dispatch(arr):
+            try:
+                if arr.kind == "session":
+                    self._run_session(arr, ledger, monitor, t0)
+                else:
+                    self._run_predict(arr, monitor, t0)
+            finally:
+                gate.release()
+
+        t0 = time.monotonic()
+        if self.incidents:
+            scheduler.start(self._fire)
+        for arr in self.schedule.arrivals:
+            wait = self.schedule.real_time(arr.t) \
+                - (time.monotonic() - t0)
+            if wait > 0:
+                time.sleep(wait)
+            gate.acquire()
+            th = threading.Thread(target=dispatch, args=(arr,),
+                                  daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(180)
+        scheduler.stop()
+
+        stream_failures = ledger.verify(self._references(ledger))
+        lost = len({f["sid"] for f in stream_failures})
+        metrics = {}
+        for port in self.live_ports():
+            try:
+                parsed = parse_prometheus(scrape(port))
+            except (OSError, ConnectionError):
+                continue
+            metrics = {
+                "requests_200": metric_sum(
+                    parsed, "mxnet_serving_fleet_requests_total",
+                    code="200"),
+                "session_losses": metric_sum(
+                    parsed,
+                    "mxnet_serving_fleet_session_losses_total"),
+                "session_migrations": metric_sum(
+                    parsed,
+                    "mxnet_serving_fleet_session_migrations_total"),
+                "exemplars": len(parsed["exemplars"]),
+            }
+            break
+        report = dict(self.schedule.describe())
+        report.update({
+            "chaos_spec": self.chaos_spec,
+            "slo": monitor.report(),
+            "slo_header": SLO_HEADER,
+            "sessions": len(ledger.meta()),
+            "lost_streams": lost,
+            "stream_failures": stream_failures[:8],
+            "recreates": self.recreates,
+            "errors": sorted(self.errors)[:8],
+            "error_count": len(self.errors),
+            "perturbed_ticks": scheduler.perturbed_ticks,
+            "incidents": self.gate_incidents(),
+            "metrics": metrics,
+        })
+        return report
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def main():  # pragma: no cover - exercised via benchmark/soak_bench.py
+    raise SystemExit(
+        "use benchmark/soak_bench.py to drive the soak harness")
+
+
+if __name__ == "__main__":
+    main()

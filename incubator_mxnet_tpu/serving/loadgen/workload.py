@@ -1,0 +1,335 @@
+"""Declarative, seeded workload specs → deterministic schedules.
+
+A :class:`WorkloadSpec` names a traffic *shape* (diurnal ramp, flash
+crowd, steady, multi-tenant mix, burst-on-shrink) plus its parameters,
+and :meth:`WorkloadSpec.compile` turns it into a concrete per-client
+arrival schedule in **virtual seconds**.  Everything is drawn from one
+``random.Random(seed)``: the same spec string + seed reproduce the
+same schedule bit for bit, on any host — a soak failure replays from
+the ``(workload, seed, time_scale, chaos_spec)`` quadruple alone.
+
+Virtual vs real time: the schedule is laid out in virtual seconds and
+never consults a clock.  At replay, ``time_scale`` compresses it —
+``t_real = t_virtual / time_scale`` — so a 30-minute diurnal window
+can drive a CI-sized run in seconds.  Rates compress accordingly: a
+shape offering R virtual-QPS replays at ``R * time_scale`` real QPS
+(docs/capacity.md "Time compression").
+
+Spec grammar (the string recorded in every JSON artifact)::
+
+    workload := shape [':' key '=' value (',' key '=' value)*]
+    shape    := steady | diurnal | flash_crowd | multi_tenant
+                | burst_on_shrink
+    keys     := duration   virtual seconds               (default 30)
+                base       baseline virtual QPS          (default 4)
+                peak       peak virtual QPS              (shapes with
+                                                          a peak)
+                cycles     diurnal peak count            (default 1)
+                peak_at    flash-crowd center, 0..1      (default 0.5)
+                peak_width flash-crowd width, 0..1       (default 0.2)
+                quiet      burst_on_shrink trough QPS    (default 0)
+                sessions   fraction of arrivals that are
+                           session streams, 0..1         (default 0)
+                steps_alpha/steps_min/steps_cap
+                           bounded-Pareto session-length
+                           draw parameters               (1.2 / 4 / 48)
+                tenants    '+'-joined NAME@CLASS*WEIGHT
+                           entries (default bench@standard)
+
+Example::
+
+    flash_crowd:duration=24,base=4,peak=24,sessions=0.25,
+    tenants=hi@interactive*3+lo@batch*1
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import random
+import time
+from typing import Callable, NamedTuple
+
+from ...base import get_env
+
+__all__ = ["Arrival", "Schedule", "WorkloadSpec", "parse_workload",
+           "pareto_steps", "SHAPES"]
+
+# the single permitted wall-clock anchor: stamps replay artifacts with
+# a human-readable start; NEVER used in scheduling math (the schedule
+# is pure virtual time, replay maps it onto time.monotonic)
+_ANCHOR_WALL = time.time()  # mxlint: allow-wall-clock(one-time artifact stamp; scheduling math is virtual-time + monotonic only)
+
+
+class Arrival(NamedTuple):
+    """One scheduled client arrival, in virtual seconds."""
+
+    t: float          # virtual arrival time (seconds from replay start)
+    client: int       # stable client id (0-based, arrival order)
+    kind: str         # 'predict' | 'session'
+    model: str        # tenant model name
+    slo: str          # SLO class the client tags its requests with
+    steps: int        # session decode steps (0 for predict)
+    value: float      # deterministic per-client payload scalar
+
+
+def pareto_steps(rng: random.Random, alpha: float = 1.2,
+                 xmin: int = 4, cap: int = 48) -> int:
+    """Bounded-Pareto session length: inverse-CDF draw clamped to
+    ``[xmin, cap]``.  Heavy-tailed by construction — most sessions are
+    short, a fat tail pins the continuous batcher's long-stream path —
+    and fully determined by ``rng``'s state (no numpy, no platform
+    variance)."""
+    u = rng.random()
+    x = xmin / ((1.0 - u) ** (1.0 / alpha))
+    return int(min(cap, max(xmin, math.floor(x))))
+
+
+# ---------------------------------------------------------------------------
+# rate shapes: virtual QPS as a function of virtual time
+# ---------------------------------------------------------------------------
+
+def _rate_steady(p: dict) -> Callable[[float], float]:
+    return lambda t: p["base"]
+
+
+def _rate_diurnal(p: dict) -> Callable[[float], float]:
+    """Smooth trough→peak→trough ramp(s): the stated production shape.
+    ``cycles`` peaks across the window, raised-cosine so the ramp has
+    no step discontinuities for a predictive policy to cheat on."""
+    span = max(p["peak"] - p["base"], 0.0)
+
+    def rate(t):
+        phase = 2.0 * math.pi * p["cycles"] * t / p["duration"]
+        return p["base"] + span * 0.5 * (1.0 - math.cos(phase))
+    return rate
+
+
+def _rate_flash_crowd(p: dict) -> Callable[[float], float]:
+    """Baseline with one sharp crowd: a linear spike-up over the first
+    tenth of the burst window, a hold at ``peak``, and a hard drop —
+    the shape that punishes slow scale-out and queue shed ladders."""
+    center = p["peak_at"] * p["duration"]
+    half = 0.5 * p["peak_width"] * p["duration"]
+    ramp = max(0.1 * p["peak_width"] * p["duration"], 1e-9)
+
+    def rate(t):
+        if abs(t - center) > half:
+            return p["base"]
+        lead = t - (center - half)
+        if lead < ramp:
+            return p["base"] + (p["peak"] - p["base"]) * lead / ramp
+        return p["peak"]
+    return rate
+
+
+def _rate_burst_on_shrink(p: dict) -> Callable[[float], float]:
+    """Adversarial for the autoscaler: burst, a quiet trough long
+    enough to trigger shrink/unload, then an instant second burst that
+    lands exactly on the shrunk fleet."""
+    third = p["duration"] / 3.0
+
+    def rate(t):
+        if t < third:
+            return p["peak"]
+        if t < 2.0 * third:
+            return p["quiet"]
+        return p["peak"]
+    return rate
+
+
+SHAPES = {
+    "steady": _rate_steady,
+    "multi_tenant": _rate_steady,   # the mix lives in `tenants`
+    "diurnal": _rate_diurnal,
+    "flash_crowd": _rate_flash_crowd,
+    "burst_on_shrink": _rate_burst_on_shrink,
+}
+
+_DEFAULTS = {"duration": 30.0, "base": 4.0, "peak": 16.0,
+             "cycles": 1.0, "peak_at": 0.5, "peak_width": 0.2,
+             "quiet": 0.0, "sessions": 0.0,
+             "steps_alpha": 1.2, "steps_min": 4, "steps_cap": 48}
+
+
+class Schedule:
+    """A compiled arrival schedule: pure virtual-time data.
+
+    ``arrivals`` is a tuple of :class:`Arrival` sorted by ``t``.  The
+    schedule is a value object — :meth:`fingerprint` hashes its exact
+    contents, and the soak gate's determinism check compares two
+    independent compiles bit for bit.
+    """
+
+    def __init__(self, spec: "WorkloadSpec", seed: int,
+                 time_scale: float, arrivals: tuple):
+        self.spec = spec
+        self.seed = int(seed)
+        self.time_scale = float(time_scale)
+        self.arrivals = arrivals
+
+    def real_time(self, t_virtual: float) -> float:
+        """Replay offset in real seconds for a virtual timestamp."""
+        return t_virtual / self.time_scale
+
+    @property
+    def duration_virtual_s(self) -> float:
+        return self.spec.params["duration"]
+
+    @property
+    def duration_real_s(self) -> float:
+        return self.real_time(self.duration_virtual_s)
+
+    def fingerprint(self) -> str:
+        """sha256 over the canonical schedule contents — the
+        bit-for-bit determinism witness recorded in soak artifacts."""
+        blob = json.dumps(
+            {"workload": self.spec.describe(), "seed": self.seed,
+             "time_scale": self.time_scale,
+             "arrivals": [[round(a.t, 9), a.client, a.kind, a.model,
+                           a.slo, a.steps, round(a.value, 9)]
+                          for a in self.arrivals]},
+            sort_keys=True, separators=(",", ":"))
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+    def by_kind(self, kind: str):
+        return [a for a in self.arrivals if a.kind == kind]
+
+    def minutes(self) -> int:
+        """Virtual-minute bin count (SLO conformance is per-minute)."""
+        return max(1, math.ceil(self.duration_virtual_s / 60.0))
+
+    def describe(self) -> dict:
+        """The reproduction block every JSON artifact embeds."""
+        return {"workload": self.spec.describe(), "seed": self.seed,
+                "time_scale": self.time_scale,
+                "arrivals": len(self.arrivals),
+                "fingerprint": self.fingerprint(),
+                "anchored_at": round(_ANCHOR_WALL, 3)}
+
+
+class WorkloadSpec:
+    """A named traffic shape + parameters; see the module grammar."""
+
+    def __init__(self, shape: str, params: dict | None = None,
+                 tenants: tuple | None = None):
+        if shape not in SHAPES:
+            raise ValueError(
+                f"unknown workload shape {shape!r} "
+                f"(known: {', '.join(sorted(SHAPES))})")
+        self.shape = shape
+        self.params = dict(_DEFAULTS)
+        self.params.update(params or {})
+        # (model, slo_class, weight) — the multi-tenant mix
+        self.tenants = tuple(tenants or (("bench", "standard", 1.0),))
+        if self.shape == "multi_tenant" and len(self.tenants) < 2:
+            raise ValueError("multi_tenant shape needs >= 2 tenants")
+        for _, slo, w in self.tenants:
+            if w <= 0:
+                raise ValueError(f"tenant weight must be > 0, got {w}")
+
+    def describe(self) -> str:
+        """Canonical spec string (round-trips through
+        :func:`parse_workload`)."""
+        keys = sorted(k for k in self.params
+                      if self.params[k] != _DEFAULTS.get(k))
+        opts = [f"{k}={self.params[k]:g}" for k in keys]
+        opts.append("tenants=" + "+".join(
+            f"{m}@{s}*{w:g}" for m, s, w in self.tenants))
+        return f"{self.shape}:" + ",".join(opts)
+
+    def rate_fn(self) -> Callable[[float], float]:
+        return SHAPES[self.shape](self.params)
+
+    def compile(self, seed: int | None = None,
+                time_scale: float | None = None) -> Schedule:
+        """Compile to a deterministic schedule.
+
+        Arrivals come from an inhomogeneous Poisson process (thinning
+        against the shape's peak rate); tenancy, kind and session
+        length are further draws from the SAME seeded stream, so the
+        whole schedule is one function of ``(spec, seed)``.  No clock
+        is consulted — compile is pure.
+        """
+        seed = int(get_env("MXNET_SOAK_SEED", 7, int)
+                   if seed is None else seed)
+        time_scale = float(get_env("MXNET_SOAK_TIME_SCALE", 1.0, float)
+                           if time_scale is None else time_scale)
+        if time_scale <= 0:
+            raise ValueError(f"time_scale must be > 0, got {time_scale}")
+        p = self.params
+        rate = self.rate_fn()
+        rate_max = max(rate(p["duration"] * k / 512.0)
+                       for k in range(513))
+        rng = random.Random(seed)
+        weights = [w for _, _, w in self.tenants]
+        wsum = sum(weights)
+        arrivals = []
+        t = 0.0
+        client = 0
+        while True:
+            if rate_max <= 0:
+                break
+            t += rng.expovariate(rate_max)       # thinning envelope
+            if t >= p["duration"]:
+                break
+            if rng.random() * rate_max >= rate(t):
+                continue                          # thinned out
+            pick = rng.random() * wsum
+            acc = 0.0
+            model, slo = self.tenants[-1][0], self.tenants[-1][1]
+            for m, s, w in self.tenants:
+                acc += w
+                if pick < acc:
+                    model, slo = m, s
+                    break
+            is_session = rng.random() < p["sessions"]
+            steps = (pareto_steps(rng, p["steps_alpha"],
+                                  int(p["steps_min"]),
+                                  int(p["steps_cap"]))
+                     if is_session else 0)
+            value = round(0.02 + 0.18 * rng.random(), 6)
+            arrivals.append(Arrival(
+                t=t, client=client,
+                kind="session" if is_session else "predict",
+                model=model, slo=slo, steps=steps, value=value))
+            client += 1
+        return Schedule(self, seed, time_scale, tuple(arrivals))
+
+
+def parse_workload(spec: str) -> WorkloadSpec:
+    """Parse the grammar in the module docstring into a
+    :class:`WorkloadSpec` (the inverse of :meth:`~WorkloadSpec.describe`)."""
+    shape, sep, rest = spec.partition(":")
+    shape = shape.strip()
+    params: dict = {}
+    tenants = None
+    if sep and rest.strip():
+        for opt in rest.split(","):
+            opt = opt.strip()
+            if not opt:
+                continue
+            k, sep2, v = opt.partition("=")
+            if not sep2:
+                raise ValueError(
+                    f"workload option {opt!r}: want key=value")
+            if k == "tenants":
+                tenants = []
+                for ent in v.split("+"):
+                    name, sep3, rest3 = ent.partition("@")
+                    if not sep3 or not name:
+                        raise ValueError(
+                            f"tenant entry {ent!r}: want "
+                            f"NAME@CLASS[*WEIGHT]")
+                    slo, _, w = rest3.partition("*")
+                    tenants.append((name, slo or "standard",
+                                    float(w) if w else 1.0))
+                tenants = tuple(tenants)
+            elif k in _DEFAULTS:
+                params[k] = (int(v) if k in ("steps_min", "steps_cap")
+                             else float(v))
+            else:
+                raise ValueError(
+                    f"unknown workload option {k!r} "
+                    f"(known: {', '.join(sorted(_DEFAULTS))}, tenants)")
+    return WorkloadSpec(shape, params, tenants)

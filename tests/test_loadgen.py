@@ -1,0 +1,255 @@
+"""Loadgen subsystem tests (docs/capacity.md).
+
+The soak harness's whole value is that its verdicts are trustworthy:
+the schedule is deterministic (a failure replays from workload + seed
++ time_scale alone), the heavy-tail sampler draws what it claims, the
+incident scheduler fires in VIRTUAL time, the zero-lost-streams
+ledger actually catches a lost/diverged/phantom stream (negative
+controls), and the SLO reader parses the real ``/metrics`` exposition
+the router serves.  Each of those claims is pinned here.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import fault
+from incubator_mxnet_tpu.serving.loadgen.workload import (
+    WorkloadSpec, parse_workload, pareto_steps)
+from incubator_mxnet_tpu.serving.loadgen.harness import (
+    Incident, IncidentScheduler, SloMonitor, StreamLedger,
+    metric_sum, parse_prometheus, slo_targets)
+
+SPEC = ("flash_crowd:duration=20,base=3,peak=9,sessions=0.2,"
+        "tenants=hi@interactive*2+lo@standard*1")
+
+
+# ---------------------------------------------------------------------------
+# schedule determinism
+# ---------------------------------------------------------------------------
+
+class TestScheduleDeterminism:
+    def test_same_seed_same_schedule_bitwise(self):
+        spec = parse_workload(SPEC)
+        s1 = spec.compile(seed=7, time_scale=5.0)
+        s2 = parse_workload(SPEC).compile(seed=7, time_scale=5.0)
+        assert s1.fingerprint() == s2.fingerprint()
+        assert s1.arrivals == s2.arrivals
+
+    def test_different_seed_different_schedule(self):
+        spec = parse_workload(SPEC)
+        assert (spec.compile(seed=7).fingerprint()
+                != spec.compile(seed=8).fingerprint())
+
+    def test_describe_round_trips(self):
+        spec = parse_workload(SPEC)
+        again = parse_workload(spec.describe())
+        assert again.describe() == spec.describe()
+        assert (again.compile(seed=3).fingerprint()
+                == spec.compile(seed=3).fingerprint())
+
+    def test_time_scale_compresses_replay_not_schedule(self):
+        spec = parse_workload(SPEC)
+        slow = spec.compile(seed=7, time_scale=1.0)
+        fast = spec.compile(seed=7, time_scale=10.0)
+        # virtual timeline identical; only the replay clock differs
+        assert ([a.t for a in slow.arrivals]
+                == [a.t for a in fast.arrivals])
+        assert fast.real_time(10.0) == pytest.approx(1.0)
+        assert slow.real_time(10.0) == pytest.approx(10.0)
+
+    def test_session_arrivals_carry_steps(self):
+        sched = parse_workload(SPEC).compile(seed=7)
+        kinds = {a.kind for a in sched.arrivals}
+        assert kinds == {"predict", "session"}
+        for a in sched.arrivals:
+            if a.kind == "session":
+                assert a.steps >= 4
+            else:
+                assert a.steps == 0
+
+    def test_parse_errors_are_typed(self):
+        with pytest.raises(ValueError, match="unknown workload shape"):
+            parse_workload("sawtooth:duration=5")
+        with pytest.raises(ValueError, match="unknown workload option"):
+            parse_workload("steady:frobnicate=1")
+
+    def test_multi_tenant_needs_tenants(self):
+        with pytest.raises(ValueError):
+            WorkloadSpec("multi_tenant", {"duration": 5.0})
+
+
+# ---------------------------------------------------------------------------
+# heavy-tail sampler
+# ---------------------------------------------------------------------------
+
+class TestParetoSteps:
+    def test_first_draws_pinned(self):
+        # exact inverse-CDF draws from a pinned stdlib rng — any
+        # change to the sampler's arithmetic shows up here first
+        rng = random.Random(123)
+        assert [pareto_steps(rng) for _ in range(5)] == [4, 4, 6, 4, 27]
+
+    def test_bounded_and_heavy_tailed(self):
+        rng = random.Random(123)
+        draws = [pareto_steps(rng) for _ in range(2000)]
+        assert min(draws) >= 4 and max(draws) == 48   # cap is reached
+        ordered = sorted(draws)
+        median = ordered[1000]
+        mean = sum(draws) / len(draws)
+        assert median <= 8                 # most sessions are short
+        assert mean > 1.3 * median         # ...but the tail is fat
+        assert 0.10 < sum(d > 16 for d in draws) / 2000 < 0.25
+
+
+# ---------------------------------------------------------------------------
+# incident scheduler in virtual time
+# ---------------------------------------------------------------------------
+
+class _FakeTime:
+    def __init__(self):
+        self.t = 0.0
+
+    def clock(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+class TestIncidentScheduler:
+    def test_fires_on_the_virtual_clock(self):
+        ft = _FakeTime()
+        incs = [Incident(t=12.0, kind="fault_burst"),
+                Incident(t=5.0, kind="kill_replica", target=1)]
+        sched = IncidentScheduler(incs, time_scale=10.0,
+                                  clock=ft.clock, sleep=ft.sleep,
+                                  tick_s=0.1)
+        fired = []
+        sched.run(lambda inc: fired.append(inc))
+        # sorted by t, fired exactly once each, in order
+        assert [i.kind for i in fired] == ["kill_replica",
+                                           "fault_burst"]
+        # virtual t=12 at scale 10 is real t=1.2: the fake clock
+        # advanced only through sleep(), so the loop ran in fake time
+        assert 1.2 <= ft.t <= 1.5
+        assert [round(v, 1) for v, _ in sched.fired] == [5.0, 12.0]
+
+    def test_tick_passes_through_the_fault_point(self):
+        fault.configure("loadgen.tick:error:p=1:n=2")
+        try:
+            ft = _FakeTime()
+            sched = IncidentScheduler(
+                [Incident(t=1.0, kind="fault_burst")], time_scale=1.0,
+                clock=ft.clock, sleep=ft.sleep, tick_s=0.25)
+            sched.run(lambda inc: None)
+            assert sched.perturbed_ticks == 2
+            assert len(sched.fired) == 1
+        finally:
+            fault.reset()
+
+
+# ---------------------------------------------------------------------------
+# zero-lost-streams ledger: negative controls
+# ---------------------------------------------------------------------------
+
+def _rows(v, n):
+    return [onp.full(4, v, onp.float32) * (i + 1) for i in range(n)]
+
+
+class TestStreamLedger:
+    def test_complete_stream_verifies_clean(self):
+        led = StreamLedger()
+        ref = _rows(0.5, 6)
+        led.record("s0", 0, ref[:3])
+        led.record("s0", 3, ref[3:])        # resumed after a break
+        assert led.verify({"s0": ref}) == []
+
+    def test_missing_steps_are_caught(self):
+        led = StreamLedger()
+        ref = _rows(0.5, 6)
+        led.record("s0", 0, ref[:2])        # steps 2..5 never landed
+        (fail,) = led.verify({"s0": ref})
+        assert fail["kind"] == "missing" and fail["total"] == 4
+
+    def test_never_seen_stream_is_fully_missing(self):
+        led = StreamLedger()
+        (fail,) = led.verify({"ghost": _rows(0.1, 3)})
+        assert fail["kind"] == "missing" and fail["total"] == 3
+
+    def test_divergence_is_caught_bitwise(self):
+        led = StreamLedger()
+        ref = _rows(0.5, 4)
+        wrong = [r.copy() for r in ref]
+        wrong[2][0] += 1e-7                 # one float, one ULP-ish
+        led.record("s0", 0, wrong)
+        (fail,) = led.verify({"s0": ref})
+        assert fail["kind"] == "diverged" and fail["steps"] == [2]
+
+    def test_conflicting_redelivery_is_caught(self):
+        led = StreamLedger()
+        ref = _rows(0.5, 4)
+        led.record("s0", 0, ref)
+        led.record("s0", 1, _rows(0.9, 1))  # re-delivers step 1, wrong
+        failures = led.verify({"s0": ref})
+        assert any(f["kind"] == "conflict" for f in failures)
+
+    def test_phantom_rows_are_caught(self):
+        led = StreamLedger()
+        ref = _rows(0.5, 3)
+        led.record("s0", 0, _rows(0.5, 5))  # 2 rows past the end
+        failures = led.verify({"s0": ref})
+        kinds = {f["kind"] for f in failures}
+        assert "phantom" in kinds
+
+
+# ---------------------------------------------------------------------------
+# SLO reader on real /metrics exposition
+# ---------------------------------------------------------------------------
+
+class TestSloReader:
+    def test_parses_real_fleet_metrics_page(self):
+        from incubator_mxnet_tpu.serving.metrics import FleetMetrics
+        fm = FleetMetrics()
+        fm.record_route(200, ms=3.25, model="hi", trace_id="t-1")
+        fm.record_route(200, ms=1.0, model="hi")
+        fm.record_route(503, model="hi")
+        fm.record_session_loss()
+        fm.record_migration()
+        parsed = parse_prometheus(fm.render())
+        assert metric_sum(parsed, "mxnet_serving_fleet_requests_total",
+                          code="200") == 2
+        assert metric_sum(parsed, "mxnet_serving_fleet_requests_total",
+                          code="503") == 1
+        assert metric_sum(
+            parsed, "mxnet_serving_fleet_session_losses_total") == 1
+        assert metric_sum(
+            parsed, "mxnet_serving_fleet_session_migrations_total") == 1
+
+    def test_exemplars_survive_parsing(self):
+        from incubator_mxnet_tpu.serving.metrics import FleetMetrics
+        fm = FleetMetrics()
+        fm.record_route(200, ms=250.0, model="hi", trace_id="t-slow")
+        parsed = parse_prometheus(fm.render())
+        assert any("t-slow" in str(e["fields"].values())
+                   or "t-slow" in str(e)
+                   for e in parsed["exemplars"])
+
+    def test_slo_targets_env(self, monkeypatch):
+        monkeypatch.setenv("MXNET_SOAK_SLO_MS",
+                           "interactive=100,standard=900")
+        t = slo_targets()
+        assert t["interactive"] == 100.0 and t["standard"] == 900.0
+
+    def test_monitor_bins_by_virtual_minute(self):
+        mon = SloMonitor({"interactive": 50.0})
+        for k in range(10):
+            mon.observe(30.0 + k, "interactive", 5.0)       # minute 0
+        for k in range(10):
+            mon.observe(70.0 + k, "interactive", 500.0)     # minute 1
+        mon.observe(130.0, "interactive", 5.0, ok=False)    # minute 2
+        rep = mon.report()["interactive"]
+        assert rep["violating_minutes"] == [1, 2]
+        assert rep["failures"] == 1 and rep["requests"] == 21
