@@ -118,9 +118,19 @@ Stages (each isolated, failures collected, nonzero exit if any fail):
 
   lint       mxlint (docs/static_analysis.md) over the python surface:
              framework-invariant rules (env-var/docs sync, fault-point
-             registry, monotonic clocks, bulkable purity, lock order,
-             typed-error propagation); fails on any finding not in the
-             (normally empty) ci/mxlint_baseline.json
+             registry, flight-event vocabulary, monotonic clocks,
+             bulkable purity, lock order, typed-error propagation);
+             fails on any finding not in the (normally empty)
+             ci/mxlint_baseline.json
+  locklint   whole-program lock-discipline gate (tools/locklint.py):
+             zero findings over the named-lock registry (cross-module
+             order cycles, blocking calls under a held lock,
+             half-guarded attributes), --selftest proving every rule +
+             the runtime witness fire, and a seeded violation failing
+             its own subprocess as the negative control; the fleet and
+             sessions chaos stages additionally run their whole pytest
+             battery under MXNET_LOCK_WITNESS=1 gating zero observed
+             lock-order violations
   race       engine + bulking test subset re-run under
              MXNET_ENGINE_RACE_CHECK=1 so every op's actual NDArray
              accesses are checked against its declared read/write sets
@@ -329,15 +339,24 @@ def stage_fleet(args):
     (subprocess SIGKILL) end-to-end — under the pinned seeded spec;
     then the multi-replica scaling bench with its CI-checked floor
     (2 replicas >= 1.6x one replica where the host has the cores to
-    express it)."""
+    express it).  Runs under MXNET_LOCK_WITNESS=1: every named-lock
+    order the chaos interleavings draw is witnessed, and any observed
+    cycle fails its test at teardown (tests/conftest.py gate)."""
+    log = os.path.join(REPO, ".ci_fleet_stage.log")
     proc = sh([sys.executable, "-m", "pytest", "-q",
                "tests/test_fleet.py",
                "--continue-on-collection-errors",
                "-p", "no:cacheprovider"],
-              timeout=1800, env={"MXNET_FAULT_SPEC": FLEET_SPEC})
+              timeout=1800, env={"MXNET_FAULT_SPEC": FLEET_SPEC,
+                                 "MXNET_LOCK_WITNESS": "1"})
+    with open(log, "w") as f:
+        f.write(proc.stdout or "")
+        if proc.stderr:
+            f.write("\n--- stderr ---\n" + proc.stderr)
     tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
     if proc.returncode != 0:
-        return False, f"spec={FLEET_SPEC!r}: {tail}"
+        return False, (f"spec={FLEET_SPEC!r} witness=1: {tail} "
+                       f"(full output: {log})")
     out = os.path.join(REPO, ".ci_fleet_bench.json")
     try:
         proc2 = sh([sys.executable, "benchmark/serving_bench.py",
@@ -373,16 +392,25 @@ def stage_sessions(args):
     snapshot/restore bitwise continuation, subprocess SIGKILL
     mid-stream with migration-or-typed-loss — under the pinned seeded
     spec; then the continuous-batching bench with its floor and the
-    compile-flatline gate."""
+    compile-flatline gate.  Runs under MXNET_LOCK_WITNESS=1: any
+    lock-order cycle a chaos interleaving draws fails its test at
+    teardown (tests/conftest.py gate)."""
+    log = os.path.join(REPO, ".ci_sessions_stage.log")
     proc = sh([sys.executable, "-m", "pytest", "-q",
                "tests/test_sessions.py", "tests/test_session_fleet.py",
                "--continue-on-collection-errors",
                "-p", "no:cacheprovider"],
               timeout=1800, env={"MXNET_FAULT_SPEC": SESSIONS_SPEC,
-                                 "MXNET_SERVING_RETRIES": "6"})
+                                 "MXNET_SERVING_RETRIES": "6",
+                                 "MXNET_LOCK_WITNESS": "1"})
+    with open(log, "w") as f:
+        f.write(proc.stdout or "")
+        if proc.stderr:
+            f.write("\n--- stderr ---\n" + proc.stderr)
     tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
     if proc.returncode != 0:
-        return False, f"spec={SESSIONS_SPEC!r}: {tail}"
+        return False, (f"spec={SESSIONS_SPEC!r} witness=1: {tail} "
+                       f"(full output: {log})")
     out = os.path.join(REPO, ".ci_session_bench.json")
     try:
         proc2 = sh([sys.executable, "benchmark/session_bench.py",
@@ -790,6 +818,41 @@ def stage_lint(args):
     return True, tail
 
 
+def stage_locklint(args):
+    """Lock-discipline gate (tools/locklint.py, docs/static_analysis.md
+    "locklint"): the package must lint clean against the (empty)
+    baseline, --selftest must prove every static rule AND the runtime
+    witness fire on seeded violations, and a seeded blocking-under-lock
+    file must FAIL its own lint subprocess — the negative control that
+    keeps a green gate honest."""
+    proc = sh([sys.executable, "tools/locklint.py"], timeout=300)
+    if proc.returncode != 0:
+        return False, (proc.stdout or proc.stderr).strip()[-600:]
+    out = proc.stdout.strip()
+    tail = out.splitlines()[-1] if out else ""
+    proc2 = sh([sys.executable, "tools/locklint.py", "--selftest"],
+               timeout=300)
+    if proc2.returncode != 0:
+        return False, ("selftest: "
+                       + (proc2.stdout or proc2.stderr).strip()[-600:])
+    import tempfile
+    seed = ("import time\n"
+            "import threading\n"
+            "_lock = threading.Lock()\n"
+            "def poll():\n"
+            "    with _lock:\n"
+            "        time.sleep(1.0)\n")
+    with tempfile.TemporaryDirectory(prefix="ci_locklint_") as td:
+        bad = os.path.join(td, "seeded.py")
+        with open(bad, "w") as f:
+            f.write(seed)
+        proc3 = sh([sys.executable, "tools/locklint.py", bad], timeout=300)
+    if proc3.returncode == 0:
+        return False, ("seeded blocking-under-lock violation did NOT "
+                       "fail the lint run — enforcement is broken")
+    return True, f"{tail}; selftest ok; seeded violation fails"
+
+
 def stage_race(args):
     """Dependency-engine race check: the engine/bulking/ndarray subset
     must pass with every op's actual accesses verified against its
@@ -892,7 +955,7 @@ def stage_bench(args):
 
 
 STAGES = {"build": stage_build, "sanity": stage_sanity,
-          "lint": stage_lint,
+          "lint": stage_lint, "locklint": stage_locklint,
           "unit": stage_unit, "slow": stage_slow,
           "bulking": stage_bulking, "chaos": stage_chaos,
           "elastic": stage_elastic,

@@ -1,0 +1,778 @@
+"""locklint — whole-program lock-discipline analysis (pure stdlib AST).
+
+mxlint's MX-LOCK001 sees lock-order cycles *inside one module* (bare
+``threading.Lock`` attributes resolved by name heuristics).  Four
+control-plane deadlocks/races shipped anyway, each invisible to it
+because the discipline violation crossed a module or a lock boundary:
+a WFQ gate held across ``fault.retry`` backoff sleeps, a signal
+handler blocking on a lock its interrupted thread held, spawn-vs-stop
+races on unguarded counters, a restore-vs-snapshotter race.  locklint
+is the whole-program upgrade, built on the :mod:`..locks` named-lock
+factory (every control-plane lock now carries a stable dotted name):
+
+=============  ==========================================================
+MX-LOCK002     cross-module lock-order cycle over *named* locks: the
+               acquire-set of every function is propagated to a
+               fixpoint across the call graph (bare calls, imported
+               functions, ``self.m()``, unique-method resolution), and
+               an edge A→B means some path acquires B while holding A
+               — cycles are reported once, with the closing edge's site
+MX-LOCK003     blocking call while a lock is held: ``time.sleep``,
+               socket/HTTP IO (``urlopen``, ``requests.*``,
+               ``.recv``/``.accept``/``.connect``/``.getresponse``),
+               ``subprocess.*``, ``Event.wait``-style ``.wait()``,
+               blocking ``Queue`` ops (no-arg ``.get()``, queue-ish
+               ``.put()``), ``Future.result()``, thread ``.join()``,
+               and ``fault.retry`` (its backoff sleeps while your lock
+               starves every other thread).  A Condition waiting on
+               *itself* (``with cv: cv.wait()``) releases the lock and
+               is exempt; audited sites carry
+               ``# mxlint: allow-blocking-under-lock(reason)``
+MX-GUARD001    guarded-by inference: in a thread-spawning class, an
+               instance attribute written under a lock in one method
+               but read/written lock-free in another (the spawn-
+               ceiling race shape — the guard exists, one path skips
+               it).  ``__init__``/``__del__`` accesses are exempt
+               (construction is single-threaded)
+MX-AST000      file failed to parse
+=============  ==========================================================
+
+Suppression mirrors mxlint: a trailing pragma on the flagged line —
+``# mxlint: allow-blocking-under-lock(reason)`` or the generic
+``# mxlint: disable=MX-XXXNNN(reason)`` (reason mandatory) — or a
+baseline JSON entry with a written reason (shared machinery,
+:mod:`.findings`).  A ``disable=MX-LOCK002`` pragma on an acquisition
+or call line removes that site from the order graph entirely.
+
+Like mxlint this module is import-light (stdlib only) and loadable
+standalone: ``tools/locklint.py`` loads it straight from the file so
+linting never pays — or requires — the framework's jax import.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+try:
+    from .findings import (Finding, load_baseline, apply_baseline,
+                           prune_stale_baseline, render)
+except ImportError:   # standalone file-load (tools/locklint.py)
+    import importlib.util as _ilu
+    _p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "findings.py")
+    _spec = _ilu.spec_from_file_location("_locklint_findings", _p)
+    _mod = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_mod)
+    Finding = _mod.Finding
+    load_baseline = _mod.load_baseline
+    apply_baseline = _mod.apply_baseline
+    prune_stale_baseline = _mod.prune_stale_baseline
+    render = _mod.render
+
+__all__ = ["RULES", "Finding", "lint_paths", "load_baseline",
+           "apply_baseline", "prune_stale_baseline", "render"]
+
+RULES = {
+    "MX-LOCK002": "cross-module lock-order cycle over named locks",
+    "MX-LOCK003": "blocking call while holding a lock "
+                  "(pragma allow-blocking-under-lock for audited sites)",
+    "MX-GUARD001": "lock-guarded attribute accessed lock-free in a "
+                   "thread-spawning class",
+    "MX-AST000": "file failed to parse",
+}
+
+_FACTORIES = ("named_lock", "named_rlock", "named_condition")
+_LOCK_ATTR_RE = re.compile(r"(?:^|_)(lock|rlock|cv|cond|mutex|gate)$")
+_QUEUEISH_RE = re.compile(r"(?:^|_)(q|queue|queues|ready|inbox|outbox|"
+                          r"jobs|work|backlog)$")
+_THREADISH_RE = re.compile(r"(?:^|_)(t|th|thread|threads|worker|"
+                           r"workers|proc|procs)$")
+_PRAGMA_RE = re.compile(
+    r"#\s*mxlint:\s*"
+    r"(allow-blocking-under-lock|disable=(MX-[A-Z]+\d+))"
+    r"\((.+)\)")  # greedy: reasons may themselves contain parens
+_PRAGMA_KEYS = {"allow-blocking-under-lock": "MX-LOCK003"}
+
+
+class _File:
+    """One parsed source file plus its pragma map."""
+
+    def __init__(self, path, rel):
+        self.path = path
+        self.rel = rel
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            self.src = f.read()
+        self.tree = None
+        self.parse_error = None
+        try:
+            self.tree = ast.parse(self.src, filename=path)
+        except SyntaxError as e:
+            self.parse_error = e
+        self.pragmas: dict[int, set] = {}
+        for i, line in enumerate(self.src.splitlines(), 1):
+            for m in _PRAGMA_RE.finditer(line):
+                kind, disabled_rule, reason = m.groups()
+                if not reason.strip():
+                    continue
+                rule = disabled_rule or _PRAGMA_KEYS[kind]
+                self.pragmas.setdefault(i, set()).add(rule)
+        # dotted module path: pkg/sub/mod.py -> pkg.sub.mod;
+        # pkg/__init__.py -> pkg
+        mod = os.path.splitext(rel)[0].replace(os.sep, "/")
+        if mod.endswith("/__init__"):
+            mod = mod[: -len("/__init__")]
+        self.mod = mod.replace("/", ".")
+
+    def suppressed_at(self, rule, line) -> bool:
+        return rule in self.pragmas.get(line, ())
+
+    def suppressed(self, rule, node) -> bool:
+        last = getattr(node, "end_lineno", node.lineno) or node.lineno
+        return any(rule in self.pragmas.get(ln, ())
+                   for ln in range(node.lineno, last + 1))
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers
+# ---------------------------------------------------------------------------
+
+def _const_str(node):
+    return (node.value if isinstance(node, ast.Constant)
+            and isinstance(node.value, str) else None)
+
+
+def _factory_name_of(value):
+    """``named_lock("x")`` / ``locks.named_condition("x", ...)`` →
+    the lock name literal, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    fname = (f.id if isinstance(f, ast.Name)
+             else f.attr if isinstance(f, ast.Attribute) else None)
+    if fname not in _FACTORIES or not value.args:
+        return None
+    return _const_str(value.args[0])
+
+
+def _expr_str(node):
+    try:
+        return ast.unparse(node)
+    except Exception:  # mxlint: allow-broad-except(unparse of an exotic expr is display-only; the canonical key falls back to object identity)
+        return f"<expr@{getattr(node, 'lineno', 0)}>"
+
+
+def _resolve_relative(mod_dotted, is_pkg, level, module):
+    """Dotted target of ``from <dots><module> import ...`` seen inside
+    ``mod_dotted`` (a package itself when ``is_pkg``)."""
+    if level == 0:
+        return module or ""
+    parts = mod_dotted.split(".")
+    # level 1 = current package; each extra dot climbs one more
+    keep = len(parts) - (0 if is_pkg else 1) - (level - 1)
+    if keep < 0:
+        return module or ""
+    base = ".".join(parts[:keep])
+    if module:
+        return f"{base}.{module}" if base else module
+    return base
+
+
+# ---------------------------------------------------------------------------
+# pass 1: named-lock bindings + import maps + class/method inventory
+# ---------------------------------------------------------------------------
+
+class _ModInfo:
+    __slots__ = ("fobj", "module_vars", "class_attrs", "imports",
+                 "from_imports", "classes")
+
+    def __init__(self, fobj):
+        self.fobj = fobj
+        self.module_vars = {}    # var -> lock name
+        self.class_attrs = {}    # (cls, attr) -> lock name
+        self.imports = {}        # alias -> dotted module
+        self.from_imports = {}   # name -> (dotted module, orig name)
+        self.classes = {}        # cls -> set of method names
+
+
+def _collect_bindings(fobj):
+    info = _ModInfo(fobj)
+    is_pkg = fobj.rel.replace(os.sep, "/").endswith("__init__.py")
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.cls = None
+            self.depth = 0       # function nesting depth
+
+        def visit_Import(self, node):
+            for a in node.names:
+                info.imports[a.asname or a.name.split(".")[0]] = a.name
+            # note: ``import a.b`` binds ``a``; the map above keeps the
+            # full dotted path for ``a.b.f()`` resolution via the alias
+
+        def visit_ImportFrom(self, node):
+            target = _resolve_relative(fobj.mod, is_pkg,
+                                       node.level, node.module)
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                info.from_imports[a.asname or a.name] = (target, a.name)
+            self.generic_visit(node)
+
+        def visit_ClassDef(self, node):
+            prev, self.cls = self.cls, node.name
+            info.classes[node.name] = {
+                n.name for n in node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+            self.generic_visit(node)
+            self.cls = prev
+
+        def visit_FunctionDef(self, node):
+            self.depth += 1
+            self.generic_visit(node)
+            self.depth -= 1
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Assign(self, node):
+            lockname = _factory_name_of(node.value)
+            if lockname:
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and self.depth == 0 \
+                            and self.cls is None:
+                        info.module_vars[t.id] = lockname
+                    elif isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self" and self.cls:
+                        info.class_attrs[(self.cls, t.attr)] = lockname
+            self.generic_visit(node)
+
+    V().visit(fobj.tree)
+    return info
+
+
+# ---------------------------------------------------------------------------
+# pass 2: per-function acquires / calls / blocking sites / attr accesses
+# ---------------------------------------------------------------------------
+
+class _FuncInfo:
+    __slots__ = ("key", "direct_locks", "calls", "edges")
+
+    def __init__(self, key):
+        self.key = key
+        self.direct_locks = set()   # named locks acquired in the body
+        self.calls = set()          # tuples of candidate callee keys
+        self.edges = []             # (held_name, target, line)
+
+
+_SOCKET_ATTRS = ("recv", "recv_into", "recvfrom", "accept", "connect",
+                 "sendall", "getresponse", "makefile")
+_SUBPROCESS_FNS = ("run", "call", "check_call", "check_output", "Popen")
+_REQUESTS_FNS = ("get", "post", "put", "delete", "head", "patch",
+                 "request")
+
+
+def _has_false_const(call, kwname):
+    for kw in call.keywords:
+        if kw.arg == kwname and isinstance(kw.value, ast.Constant):
+            return kw.value.value is False or kw.value.value == 0
+    return False
+
+
+def _blocking_kind(call, held_exprs, sleep_aliases, retry_aliases):
+    """What blocking primitive a call is, or None.  ``held_exprs`` is
+    the set of unparsed lock expressions currently held — a Condition
+    waiting on itself releases the lock and is exempt."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        if f.id in sleep_aliases:
+            return "time.sleep"
+        if f.id == "urlopen":
+            return "urlopen (HTTP IO)"
+        if f.id in retry_aliases:
+            return "fault.retry (backoff sleeps)"
+        return None
+    if not isinstance(f, ast.Attribute):
+        return None
+    recv, attr = f.value, f.attr
+    recv_name = (recv.id if isinstance(recv, ast.Name)
+                 else recv.attr if isinstance(recv, ast.Attribute)
+                 else None)
+    if attr == "sleep" and recv_name == "time":
+        return "time.sleep"
+    if attr == "urlopen":
+        return "urlopen (HTTP IO)"
+    if attr == "retry" and recv_name in ("fault", "_fault"):
+        return "fault.retry (backoff sleeps)"
+    if recv_name == "subprocess" and attr in _SUBPROCESS_FNS:
+        return f"subprocess.{attr}"
+    # the bare module name only — ``m.requests.get(...)`` is a dict
+    # attribute that happens to be called "requests"
+    if isinstance(recv, ast.Name) and recv_name == "requests" \
+            and attr in _REQUESTS_FNS:
+        return f"requests.{attr} (HTTP IO)"
+    if attr in _SOCKET_ATTRS and not isinstance(recv, ast.Constant):
+        return f".{attr}() (socket/HTTP IO)"
+    if attr in ("wait", "wait_for"):
+        if _expr_str(recv) in held_exprs:
+            return None   # Condition wait on a held lock: it releases
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and call.args[0].value == 0:
+            return None   # wait(0): a poll, not a block
+        if _has_false_const(call, "blocking") \
+                or _has_false_const(call, "timeout"):
+            return None
+        return f".{attr}() (Event/Condition wait)"
+    if attr == "get" and not call.args:
+        if _has_false_const(call, "block"):
+            return None
+        return ".get() (blocking queue read)"
+    if attr == "put" and recv_name and _QUEUEISH_RE.search(recv_name):
+        if _has_false_const(call, "block"):
+            return None
+        return ".put() (blocking queue write)"
+    if attr == "result" and not call.args and recv_name:
+        return ".result() (future wait)"
+    if attr == "join" and recv_name and _THREADISH_RE.search(recv_name):
+        return ".join() (thread join)"
+    return None
+
+
+def _walk_mod(info: _ModInfo, findings, funcs, method_defs):
+    """One visitor computes everything per-function: held-lock stacks,
+    MX-LOCK003 blocking sites, the MX-LOCK002 edge material, and the
+    MX-GUARD001 attribute-access record."""
+    fobj = info.fobj
+    mod = fobj.mod
+
+    sleep_aliases = set()
+    retry_aliases = set()
+    for node in ast.walk(fobj.tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if node.module == "time" and a.name == "sleep":
+                    sleep_aliases.add(a.asname or "sleep")
+        # ``from .fault import retry`` / from-import map fallback
+    for name, (target, orig) in info.from_imports.items():
+        if orig == "retry" and target.rsplit(".", 1)[-1] == "fault":
+            retry_aliases.add(name)
+        if orig == "sleep" and target == "time":
+            sleep_aliases.add(name)
+
+    # class -> {attr: [(method, is_write, locked, line)]} for GUARD001
+    attr_access = {}
+    thread_spawning = set()
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.cls = None
+            self.fn = None
+            self.method = None      # outermost method name for GUARD001
+            self.held = []          # [(key_or_None, expr_str, line)]
+            self.locals = [{}]      # named-lock local bindings per scope
+
+        # -- scope plumbing ------------------------------------------------
+        def visit_ClassDef(self, node):
+            prev, self.cls = self.cls, node.name
+            self.generic_visit(node)
+            self.cls = prev
+
+        def visit_FunctionDef(self, node):
+            prev_fn, prev_held, prev_m = self.fn, self.held, self.method
+            key = (mod, self.cls, node.name)
+            if prev_fn is None or prev_fn.key[1] != self.cls:
+                self.fn = funcs.setdefault(key, _FuncInfo(key))
+                method_defs.setdefault(node.name, set()).add(
+                    (mod, self.cls))
+            # nested defs contribute to the ENCLOSING function's
+            # acquire-set (they run later, possibly on a thread), but
+            # with a fresh hold stack
+            self.held = []
+            if self.cls and prev_m is None:
+                self.method = node.name
+            self.locals.append({})
+            self.generic_visit(node)
+            self.locals.pop()
+            self.fn, self.held, self.method = prev_fn, prev_held, prev_m
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        # -- named-lock locals --------------------------------------------
+        def visit_Assign(self, node):
+            lockname = _factory_name_of(node.value)
+            if lockname:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.locals[-1][t.id] = lockname
+            self._note_attr_targets(node)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            if isinstance(node.target, ast.Attribute):
+                self._attr_access(node.target, is_write=True)
+            self.generic_visit(node)
+
+        def _note_attr_targets(self, node):
+            for t in node.targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Attribute):
+                        self._attr_access(sub, is_write=True)
+
+        # -- lock resolution ----------------------------------------------
+        def _lock_key(self, expr):
+            """(kind, key) for a with-item guard expression: a named
+            lock resolves to its dotted name, a bare lock-ish attr to
+            an anonymous per-module key, anything else to None."""
+            if isinstance(expr, ast.Name):
+                for scope in reversed(self.locals):
+                    if expr.id in scope:
+                        return ("named", scope[expr.id])
+                if expr.id in info.module_vars:
+                    return ("named", info.module_vars[expr.id])
+                hit = info.from_imports.get(expr.id)
+                if hit and hit in _MODVAR_GLOBAL:
+                    return ("named", _MODVAR_GLOBAL[hit])
+                if _LOCK_ATTR_RE.search(expr.id):
+                    return ("anon", f"{mod}:{expr.id}")
+                return None
+            if not isinstance(expr, ast.Attribute):
+                return None
+            attr = expr.attr
+            if isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self" and self.cls:
+                hit = info.class_attrs.get((self.cls, attr))
+                if hit:
+                    return ("named", hit)
+            # any receiver: unique attr-name resolution over the whole
+            # scanned surface (Var._lock through a parameter, a peer
+            # object's named lock)
+            hits = _ATTR_GLOBAL.get(attr, ())
+            if len(hits) == 1:
+                return ("named", next(iter(hits)))
+            if _LOCK_ATTR_RE.search(attr):
+                owner = (self.cls if isinstance(expr.value, ast.Name)
+                         and expr.value.id == "self" and self.cls
+                         else "*")
+                return ("anon", f"{mod}:{owner}.{attr}")
+            return None
+
+        # -- with blocks ---------------------------------------------------
+        def visit_With(self, node):
+            acquired = 0
+            for item in node.items:
+                lk = self._lock_key(item.context_expr)
+                if lk and fobj.suppressed_at("MX-LOCK002",
+                                             item.context_expr.lineno):
+                    # the pragma removes the site from the order graph
+                    # but the lock is still *held* for LOCK003/GUARD001
+                    pass
+                elif lk and lk[0] == "named" and self.fn is not None:
+                    self.fn.direct_locks.add(lk[1])
+                    for held_kind, held_key, _expr, _ln in self.held:
+                        if held_kind == "named":
+                            self.fn.edges.append(
+                                (held_key, ("lock", lk[1]),
+                                 item.context_expr.lineno))
+                if lk:
+                    self.held.append(
+                        (lk[0], lk[1], _expr_str(item.context_expr),
+                         item.context_expr.lineno))
+                    acquired += 1
+                else:
+                    self.visit(item.context_expr)
+            for stmt in node.body:
+                self.visit(stmt)
+            for _ in range(acquired):
+                self.held.pop()
+
+        visit_AsyncWith = visit_With
+
+        # -- calls ----------------------------------------------------------
+        def _callee_candidates(self, f):
+            if isinstance(f, ast.Name):
+                hit = info.from_imports.get(f.id)
+                if hit:
+                    m2, orig = hit
+                    return ((m2, None, orig), (m2, orig, "__init__"))
+                return ((mod, None, f.id),)
+            if isinstance(f, ast.Attribute):
+                recv, attr = f.value, f.attr
+                if isinstance(recv, ast.Name):
+                    if recv.id == "self" and self.cls:
+                        cands = [(mod, self.cls, attr),
+                                 (mod, None, attr)]
+                        hits = method_defs.get(attr, ())
+                        if len(hits) == 1:
+                            m2, c2 = next(iter(hits))
+                            cands.append((m2, c2, attr))
+                        return tuple(cands)
+                    if recv.id in info.imports:
+                        m2 = info.imports[recv.id]
+                        return ((m2, None, attr),
+                                (m2, attr, "__init__"))
+                    hit = info.from_imports.get(recv.id)
+                    if hit and hit[1][:1].isupper():
+                        # Class.method through a from-import
+                        return ((hit[0], hit[1], attr),)
+                hits = method_defs.get(attr, ())
+                if len(hits) == 1:
+                    m2, c2 = next(iter(hits))
+                    return ((m2, c2, attr),)
+            return ()
+
+        def visit_Call(self, node):
+            f = node.func
+            # thread-spawning classes (GUARD001 applicability)
+            fn_name = (f.id if isinstance(f, ast.Name)
+                       else f.attr if isinstance(f, ast.Attribute)
+                       else None)
+            if fn_name == "Thread" and self.cls:
+                thread_spawning.add(self.cls)
+
+            if self.held and self.fn is not None:
+                kind = _blocking_kind(
+                    node, {e for _k, _key, e, _ln in self.held},
+                    sleep_aliases, retry_aliases)
+                if kind and not fobj.suppressed_at("MX-LOCK003",
+                                                   node.lineno) \
+                        and not fobj.suppressed("MX-LOCK003", node):
+                    _hk, held_key, _he, held_ln = self.held[-1]
+                    findings.append(Finding(
+                        "MX-LOCK003", fobj.rel, node.lineno,
+                        f"{kind} called while holding lock "
+                        f"{held_key!r} (acquired line {held_ln}) — "
+                        "every other thread contending on it stalls "
+                        "for the full blocking duration; move the "
+                        "call outside the critical section or pragma "
+                        "allow-blocking-under-lock with a reason"))
+
+            if self.fn is not None \
+                    and not fobj.suppressed_at("MX-LOCK002", node.lineno):
+                cands = self._callee_candidates(f)
+                if cands:
+                    self.fn.calls.add(cands)
+                    for held_kind, held_key, _e, _ln in self.held:
+                        if held_kind == "named":
+                            self.fn.edges.append(
+                                (held_key, ("call", cands), node.lineno))
+            self.generic_visit(node)
+
+        # -- attribute accesses (GUARD001) ---------------------------------
+        def visit_Attribute(self, node):
+            if isinstance(node.ctx, ast.Load):
+                self._attr_access(node, is_write=False)
+            self.generic_visit(node)
+
+        def _attr_access(self, node, is_write):
+            if not (isinstance(node.value, ast.Name)
+                    and node.value.id == "self" and self.cls
+                    and self.method):
+                return
+            attr = node.attr
+            if attr.startswith("__") or _LOCK_ATTR_RE.search(attr):
+                return
+            if (self.cls, attr) in info.class_attrs:
+                return   # the lock itself
+            if attr in info.classes.get(self.cls, ()):
+                return   # method reference
+            # ``*_locked`` methods are held-by-contract (the repo's
+            # convention: callers take the lock before calling them)
+            held = bool(self.held) or self.method.endswith("_locked")
+            attr_access.setdefault(self.cls, {}).setdefault(
+                attr, []).append(
+                    (self.method, is_write, held, node.lineno))
+
+    V().visit(fobj.tree)
+
+    # -- MX-GUARD001 -------------------------------------------------------
+    for cls in sorted(thread_spawning & set(attr_access)):
+        for attr, recs in sorted(attr_access[cls].items()):
+            locked_writes = [(m, ln) for m, w, locked, ln in recs
+                             if w and locked and m != "__init__"]
+            if not locked_writes:
+                continue
+            guard_methods = {m for m, _ in locked_writes}
+            seen_lines = set()
+            for m, w, locked, ln in recs:
+                if locked or m in ("__init__", "__del__"):
+                    continue
+                if m in guard_methods and not w:
+                    # a lock-free read inside the guarding method
+                    # itself is the same method's business (often a
+                    # fast-path recheck); cross-method is the race
+                    continue
+                if ln in seen_lines:
+                    continue
+                seen_lines.add(ln)
+                if fobj.suppressed_at("MX-GUARD001", ln):
+                    continue
+                gm, gl = locked_writes[0]
+                findings.append(Finding(
+                    "MX-GUARD001", fobj.rel, ln,
+                    f"{cls}.{attr} is written under a lock in "
+                    f"{gm}() (line {gl}) but "
+                    f"{'written' if w else 'read'} lock-free in "
+                    f"{m}() — this class spawns threads, so the "
+                    "unguarded access races the guarded writer; "
+                    "take the same lock (or pragma "
+                    "disable=MX-GUARD001 with the reason the access "
+                    "is safe)"))
+
+
+# attr -> set of lock names bound to that attr anywhere (pass-1 global)
+_ATTR_GLOBAL: dict = {}
+# (module, var) -> lock name for module-level bindings, so a
+# from-imported lock resolves across the module boundary
+_MODVAR_GLOBAL: dict = {}
+
+
+# ---------------------------------------------------------------------------
+# MX-LOCK002: fixpoint + cycle report
+# ---------------------------------------------------------------------------
+
+def _resolve_callee(cands, summary):
+    for key in cands:
+        s = summary.get(key)
+        if s is not None:
+            return key
+    return None
+
+
+def _check_lock_order(mods, funcs, findings):
+    summary = {k: set(fi.direct_locks) for k, fi in funcs.items()}
+    changed = True
+    while changed:
+        changed = False
+        for k, fi in funcs.items():
+            for cands in fi.calls:
+                target = _resolve_callee(cands, summary)
+                if target is None:
+                    continue
+                s = summary[target]
+                if s and not s <= summary[k]:
+                    summary[k] |= s
+                    changed = True
+
+    edges = {}   # (a, b) -> (file, line)
+    rel_of_mod = {mi.fobj.mod: mi.fobj.rel for mi in mods}
+    for (m, _cls, _name), fi in funcs.items():
+        rel = rel_of_mod.get(m, m)
+        for held, target, line in fi.edges:
+            if target[0] == "lock":
+                locks = (target[1],)
+            else:
+                key = _resolve_callee(target[1], summary)
+                locks = tuple(summary.get(key, ())) if key else ()
+            for lk in locks:
+                if lk != held:
+                    edges.setdefault((held, lk), (rel, line))
+                elif target[0] == "lock":
+                    # lexically nested same-name acquisition of two
+                    # instances: a self-cycle, report it
+                    edges.setdefault((held, lk), (rel, line))
+
+    graph = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+
+    seen_cycles = set()
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+
+    def dfs(start):
+        stack = [(start, iter(graph.get(start, ())))]
+        path = [start]
+        color[start] = GREY
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                if color.get(nxt, WHITE) == GREY:
+                    i = path.index(nxt)
+                    cyc = tuple(sorted(set(path[i:])))
+                    if cyc not in seen_cycles:
+                        seen_cycles.add(cyc)
+                        rel, line = edges[(node, nxt)]
+                        order = " -> ".join(path[i:] + [nxt])
+                        findings.append(Finding(
+                            "MX-LOCK002", rel, line,
+                            f"cross-module lock-order cycle: {order} "
+                            "— some path acquires these named locks "
+                            "in the opposite order; pick one global "
+                            "order (the closing edge is here)"))
+                elif color.get(nxt, WHITE) == WHITE:
+                    color[nxt] = GREY
+                    stack.append((nxt, iter(graph.get(nxt, ()))))
+                    path.append(nxt)
+                    advanced = True
+                    break
+            if not advanced:
+                stack.pop()
+                path.pop()
+                color[node] = BLACK
+
+    for n in list(graph):
+        if color.get(n, WHITE) == WHITE:
+            dfs(n)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _discover(paths):
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d != "__pycache__" and not d.startswith(".")]
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        out.append(os.path.join(root, n))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def lint_paths(paths, repo_root=None):
+    """Lint ``paths`` (files and/or directories); returns Findings.
+    The lock-order graph spans every scanned file — scan the whole
+    package for the cross-module rule to mean anything."""
+    repo_root = os.path.abspath(repo_root or os.getcwd())
+
+    findings: list[Finding] = []
+    mods = []
+    for path in _discover(paths):
+        fobj = _File(path, os.path.relpath(os.path.abspath(path),
+                                           repo_root))
+        if fobj.parse_error is not None:
+            findings.append(Finding("MX-AST000", fobj.rel,
+                                    fobj.parse_error.lineno or 1,
+                                    f"syntax error: {fobj.parse_error.msg}"))
+            continue
+        mods.append(_collect_bindings(fobj))
+
+    _ATTR_GLOBAL.clear()
+    _MODVAR_GLOBAL.clear()
+    for mi in mods:
+        for (_cls, attr), lockname in mi.class_attrs.items():
+            _ATTR_GLOBAL.setdefault(attr, set()).add(lockname)
+        for var, lockname in mi.module_vars.items():
+            _MODVAR_GLOBAL[(mi.fobj.mod, var)] = lockname
+
+    funcs = {}
+    method_defs = {}
+    # pre-pass so unique-method resolution sees every scanned class
+    for mi in mods:
+        for cls, methods in mi.classes.items():
+            for m in methods:
+                method_defs.setdefault(m, set()).add((mi.fobj.mod, cls))
+
+    for mi in mods:
+        _walk_mod(mi, findings, funcs, method_defs)
+
+    _check_lock_order(mods, funcs, findings)
+
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings

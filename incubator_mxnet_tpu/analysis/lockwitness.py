@@ -1,0 +1,462 @@
+"""Runtime lock witness — the dynamic half of locklint.
+
+Static analysis (``analysis/locklint.py``) proves lock-order
+discipline over the code paths it can resolve; this module witnesses
+the orders that *actually execute*.  Under ``MXNET_LOCK_WITNESS=1``
+the :mod:`..locks` factory returns the instrumented wrappers defined
+here instead of bare ``threading`` primitives, and every acquire
+feeds three structures:
+
+* **per-thread held-set** — a stack of (lock, name, t\\ :sub:`acquire`,
+  depth) entries in a ``threading.local``; reentrant (RLock)
+  reacquisition bumps ``depth`` instead of fabricating a self-edge;
+* **global acquisition-order graph** — a directed edge ``A -> B`` the
+  first time any thread acquires named lock B while holding A.  A new
+  edge that closes a cycle is a *lock-order violation*: the typed
+  :class:`~..error.LockOrderError` is **banked** (and emitted as a
+  ``lock.order_violation`` flight event + counted in the profiler
+  provider), then rethrown from :func:`check` — NEVER from inside the
+  victim's ``acquire``, which must stay well-formed mid-flight;
+* **hold-time histograms + contention counters** — per lock name,
+  exported via the ``lockwitness`` profiler stats provider so
+  ``profiler.dumps()`` carries them while the witness is on.
+
+Flag-off cost is paid at *construction* (``locks.named_lock`` returns
+a bare lock — one module-bool branch, no isinstance anywhere on an
+acquire path); nothing in this module runs at all.
+
+Like :mod:`.race` this checker mirrors the mxlint pairing: the static
+rule is the CI gate, the dynamic witness is what the chaos stages
+(``fleet``, ``sessions``) run under, catching orders only a real
+interleaving reaches.  Like :mod:`.mxlint` this module must stay
+loadable standalone (``tools/locklint.py --selftest`` file-loads it,
+jax-free), so every framework import is lazy and guarded.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = ["enabled", "set_enabled", "WitnessLock", "WitnessRLock",
+           "WitnessCondition", "pending", "check", "clear", "stats",
+           "order_edges"]
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+#: witness flag (the :mod:`..locks` factory consults its own copy at
+#: construction; this one gates bookkeeping + provider registration).
+enabled: bool = os.environ.get(
+    "MXNET_LOCK_WITNESS", "").strip().lower() in _TRUTHY
+
+_PENDING_CAP = 64          # keep the first N violations; count the rest
+_HOLD_BUCKETS = (10e-6, 100e-6, 1e-3, 10e-3, 100e-3, 1.0)
+_BUCKET_KEYS = ("le_10us", "le_100us", "le_1ms", "le_10ms",
+                "le_100ms", "le_1s", "gt_1s")
+
+_tls = threading.local()
+# The witness's own mutex is deliberately a BARE lock: instrumenting
+# the instrument would recurse, and no user lock is ever acquired
+# under it (leaf by construction).
+_glock = threading.Lock()
+
+_adj: dict = {}            # name -> set of names acquired while held
+_edge_site: dict = {}      # (a, b) -> thread name that first drew it
+_pending: list = []
+_seen_cycles: set = set()
+_holds: dict = {}          # name -> per-lock counters/histogram
+
+
+def _fresh_stats():
+    return {"acquires": 0, "contended": 0, "order_edges": 0,
+            "order_violations": 0, "violations_dropped": 0}
+
+
+_stats = _fresh_stats()
+
+
+def _error_class():
+    """The typed error — :class:`~..error.LockOrderError` when the
+    framework is importable, a local stand-in when file-loaded
+    standalone (the CLI selftest asserts on the NAME, which matches
+    either way)."""
+    try:
+        from ..error import LockOrderError
+        return LockOrderError
+    except ImportError:
+        cls = globals().get("_FallbackLockOrderError")
+        if cls is None:
+            cls = type("LockOrderError", (RuntimeError,), {})
+            globals()["_FallbackLockOrderError"] = cls
+        return cls
+
+
+def _register_provider():
+    try:
+        from .. import profiler
+        profiler.register_stats_provider("lockwitness", stats)
+    except ImportError:
+        pass  # standalone file-load: no profiler to report through
+
+
+def _unregister_provider():
+    try:
+        from .. import profiler
+        profiler.unregister_stats_provider("lockwitness", stats)
+    except ImportError:
+        pass
+
+
+def set_enabled(flag):
+    """Toggle witness bookkeeping; ``None`` re-reads
+    ``MXNET_LOCK_WITNESS``.  Registers/unregisters the ``lockwitness``
+    profiler provider; disabling drops banked violations (they belong
+    to the run that observed them).  Returns the previous value."""
+    global enabled
+    prev = enabled
+    enabled = (os.environ.get(
+        "MXNET_LOCK_WITNESS", "").strip().lower() in _TRUTHY
+        if flag is None else bool(flag))
+    if enabled:
+        _register_provider()
+    else:
+        _unregister_provider()
+        with _glock:
+            _pending[:] = []
+    return prev
+
+
+# ---------------------------------------------------------------------------
+# bookkeeping core
+# ---------------------------------------------------------------------------
+
+def _held():
+    st = getattr(_tls, "held", None)
+    if st is None:
+        st = _tls.held = []
+    return st
+
+
+def _hold_rec(name):
+    rec = _holds.get(name)
+    if rec is None:
+        rec = _holds[name] = {"acquires": 0, "contended": 0,
+                              "held_total_s": 0.0, "held_max_s": 0.0,
+                              "buckets": [0] * len(_BUCKET_KEYS)}
+    return rec
+
+
+def _cycle_path(frm, to):
+    """A path ``to -> ... -> frm`` in the edge graph (DFS; caller
+    holds ``_glock``), or None.  Appending ``frm -> to`` to it closes
+    the reported cycle."""
+    stack = [(to, (to,))]
+    seen = {to}
+    while stack:
+        node, path = stack.pop()
+        if node == frm:
+            return path
+        for nxt in _adj.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + (nxt,)))
+    return None
+
+
+def _flight_violation(cycle_str):
+    try:
+        from .. import flightrec
+        flightrec.record(flightrec.HEALTH, "lock.order_violation",
+                         severity="error", cycle=cycle_str,
+                         thread=threading.current_thread().name)
+    except Exception:  # mxlint: allow-broad-except(the witness must never break the victim's acquire; a failed flight emit is dropped — the banked typed error still carries the cycle)
+        pass
+
+
+def _note_acquired(lock, name):
+    """Record a successful acquire: held-set push, order edges, cycle
+    check.  Violations are banked, never raised from here."""
+    held = _held()
+    for ent in held:
+        if ent[0] is lock:          # reentrant reacquire (RLock)
+            ent[3] += 1
+            return
+    now = time.monotonic()
+    violations = []
+    with _glock:
+        _stats["acquires"] += 1
+        _hold_rec(name)["acquires"] += 1
+        me = threading.current_thread().name
+        for ent in held:
+            a = ent[1]
+            if name in _adj.get(a, ()):
+                continue            # edge already witnessed
+            if a == name:
+                # distinct instances sharing a name (a lock CLASS like
+                # engine.var): nesting within the class has no defined
+                # order — a self-cycle
+                cycle = (name, name)
+            else:
+                path = _cycle_path(a, name)   # name -> ... -> a ?
+                cycle = path + (name,) if path is not None else None
+            _adj.setdefault(a, set()).add(name)
+            _edge_site.setdefault((a, name), me)
+            _stats["order_edges"] += 1
+            if cycle is None:
+                continue
+            key = frozenset(cycle)
+            if key in _seen_cycles:
+                continue
+            _seen_cycles.add(key)
+            _stats["order_violations"] += 1
+            cycle_str = " -> ".join(cycle)
+            if len(_pending) < _PENDING_CAP:
+                _pending.append(_error_class()(
+                    f"lock-order cycle observed: {cycle_str} "
+                    f"(closing edge {a} -> {name} drawn by thread "
+                    f"{me!r}; opposite edge first drawn by "
+                    f"{_edge_site.get((name, a), '?')!r}) — two paths "
+                    "acquire these named locks in opposite orders; "
+                    "pick one global order "
+                    "(docs/static_analysis.md 'locklint')"))
+            else:
+                _stats["violations_dropped"] += 1
+            violations.append(cycle_str)
+    held.append([lock, name, now, 1])
+    # flight emit outside _glock: the witness's critical section stays
+    # minimal, and flightrec's append path is lock-free anyway
+    for cycle_str in violations:
+        _flight_violation(cycle_str)
+
+
+def _note_released(lock, name):
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        ent = held[i]
+        if ent[0] is lock:
+            ent[3] -= 1
+            if ent[3] > 0:
+                return
+            dt = time.monotonic() - ent[2]
+            del held[i]
+            with _glock:
+                rec = _hold_rec(name)
+                rec["held_total_s"] += dt
+                if dt > rec["held_max_s"]:
+                    rec["held_max_s"] = dt
+                for k, edge in enumerate(_HOLD_BUCKETS):
+                    if dt <= edge:
+                        rec["buckets"][k] += 1
+                        break
+                else:
+                    rec["buckets"][-1] += 1
+            return
+    # release of a lock this thread never witnessed acquiring (e.g. a
+    # Condition handed a pre-acquired raw lock): nothing to unwind
+
+
+def _note_contended(name):
+    with _glock:
+        _stats["contended"] += 1
+        _hold_rec(name)["contended"] += 1
+
+
+# ---------------------------------------------------------------------------
+# the instrumented primitives
+# ---------------------------------------------------------------------------
+
+class WitnessLock:
+    """``threading.Lock`` wrapper with witness bookkeeping.  Supports
+    the full acquire signature (``blocking``/``timeout``) — the flight
+    recorder's SIGUSR2 path does ``acquire(blocking=False)``."""
+
+    __slots__ = ("name", "_raw")
+    _reentrant = False
+
+    def __init__(self, name, raw=None):
+        self.name = name
+        self._raw = raw if raw is not None else threading.Lock()
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._raw.acquire(False)
+        if not got:
+            _note_contended(self.name)
+            if not blocking:
+                return False
+            got = self._raw.acquire(True, timeout)
+            if not got:
+                return False
+        _note_acquired(self, self.name)
+        return True
+
+    def release(self):
+        _note_released(self, self.name)
+        self._raw.release()
+
+    def locked(self):
+        return self._raw.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} {self.name!r} "
+                f"{'locked' if self._raw.locked() else 'unlocked'}>")
+
+
+class WitnessRLock(WitnessLock):
+    """Reentrant variant: reacquisition by the owning thread bumps the
+    held-entry depth (no self-edge, no double hold-time)."""
+
+    __slots__ = ()
+    _reentrant = True
+
+    def __init__(self, name):
+        super().__init__(name, raw=threading.RLock())
+
+    def locked(self):  # RLock has no .locked() before 3.12
+        if self._raw.acquire(False):
+            self._raw.release()
+            return False
+        return True
+
+
+class WitnessCondition:
+    """``threading.Condition`` over a witnessed lock.  ``wait()``
+    drops the lock from the held-set for the duration (the underlying
+    Condition releases the raw lock), then re-records the acquire —
+    including its order edges — on wakeup."""
+
+    __slots__ = ("name", "_wlock", "_cond")
+
+    def __init__(self, name, lock=None):
+        if isinstance(lock, WitnessLock):
+            self._wlock = lock
+        elif lock is None:
+            self._wlock = WitnessLock(name)
+        else:                       # a bare lock handed in: adopt it
+            self._wlock = WitnessLock(name, raw=lock)
+        self.name = name
+        self._cond = threading.Condition(self._wlock._raw)
+
+    def acquire(self, *a, **kw):
+        return self._wlock.acquire(*a, **kw)
+
+    def release(self):
+        self._wlock.release()
+
+    def __enter__(self):
+        self._wlock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._wlock.release()
+        return False
+
+    def wait(self, timeout=None):
+        _note_released(self._wlock, self.name)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            _note_acquired(self._wlock, self.name)
+
+    def wait_for(self, predicate, timeout=None):
+        # delegate to wait() so each sleep/wake cycle keeps the
+        # held-set honest even across spurious wakeups
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + timeout
+                waittime = endtime - time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait()
+            result = predicate()
+        return result
+
+    def notify(self, n=1):
+        self._cond.notify(n)
+
+    def notify_all(self):
+        self._cond.notify_all()
+
+    def __repr__(self):
+        return f"<WitnessCondition {self.name!r}>"
+
+
+# ---------------------------------------------------------------------------
+# violation delivery / introspection
+# ---------------------------------------------------------------------------
+
+def pending():
+    """Snapshot of banked (not yet rethrown) violations."""
+    with _glock:
+        return list(_pending)
+
+
+def check():
+    """The check boundary: rethrow the first banked violation (the
+    rest ride along in the message count).  Chaos stages and tests
+    call this where a failure is actionable — never the acquire."""
+    with _glock:
+        errs, _pending[:] = list(_pending), []
+    if not errs:
+        return
+    if len(errs) == 1:
+        raise errs[0]
+    raise type(errs[0])(
+        f"{errs[0]} (+{len(errs) - 1} more lock-order violation(s); "
+        "see lockwitness.stats())") from errs[0]
+
+
+def clear():
+    """Drop banked violations, edges and counters (test isolation)."""
+    global _stats
+    with _glock:
+        _pending[:] = []
+        _adj.clear()
+        _edge_site.clear()
+        _seen_cycles.clear()
+        _holds.clear()
+        _stats = _fresh_stats()
+
+
+def order_edges():
+    """Snapshot of the acquisition-order edge set: {(a, b), ...}."""
+    with _glock:
+        return {(a, b) for a, nbrs in _adj.items() for b in nbrs}
+
+
+def stats():
+    """The ``lockwitness`` profiler stats provider."""
+    with _glock:
+        out = dict(_stats)
+        out["pending"] = len(_pending)
+        out["locks_tracked"] = len(_holds)
+        holds = {}
+        for name, rec in _holds.items():
+            holds[name] = {
+                "acquires": rec["acquires"],
+                "contended": rec["contended"],
+                "held_total_ms": round(rec["held_total_s"] * 1e3, 3),
+                "held_max_ms": round(rec["held_max_s"] * 1e3, 3),
+                "hold_hist": dict(zip(_BUCKET_KEYS, rec["buckets"])),
+            }
+        out["locks"] = holds
+    out["enabled"] = int(enabled)
+    return out
+
+
+if enabled:
+    # env-enabled at import (the chaos-stage path): register the
+    # provider exactly as the runtime toggle would
+    set_enabled(True)

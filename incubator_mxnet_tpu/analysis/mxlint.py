@@ -15,6 +15,16 @@ MX-FAULT001    ``fault.inject("point")`` call site names a point not
                typo'd point silently never fires
 MX-FAULT002    point declared in ``fault.POINTS`` but never wired to an
                ``inject`` call site — dead chaos coverage
+MX-FLIGHT001   flight-recorder event name not registered: a static
+               ``flightrec.record(cat, "name")`` emit names something
+               missing from ``flightrec.EVENTS``, or a ``postmortem
+               --gate ev1,ev2`` string (subprocess argv or
+               ``gate=``/``--gate`` in ``tests/``, ``ci/``,
+               ``benchmark/``) names an event no emitter registers —
+               gate-string drift used to fail only at chaos-stage
+               runtime.  Dynamic names must fall in an
+               ``EVENT_PREFIXES`` family; ``fault.*`` gate entries are
+               additionally checked against ``fault.POINTS``
 MX-TIME001     wall-clock ``time.time()`` — timeout/deadline/duration
                arithmetic must use ``time.monotonic()`` (an NTP step
                fires spurious timeouts); genuinely wall-clock sites
@@ -96,6 +106,8 @@ RULES = {
     "MX-ENV002": "env var documented in env_vars.md but never read in code",
     "MX-FAULT001": "fault.inject names a point not declared in fault.POINTS",
     "MX-FAULT002": "fault point declared in fault.POINTS but never wired",
+    "MX-FLIGHT001": "flight event name not registered in flightrec.EVENTS "
+                    "(emit site or postmortem gate string)",
     "MX-TIME001": "wall-clock time.time(); use time.monotonic() "
                   "(pragma allow-wall-clock for true wall-clock needs)",
     "MX-BULK001": "bulkable op impl calls a host-effect function",
@@ -260,6 +272,78 @@ def _inject_calls(tree):
         if not is_inject or not node.args:
             continue
         yield _const_str(node.args[0]), node.lineno
+
+
+def _flight_vocab(flight_file: "_File"):
+    """Parse ``EVENTS`` and ``EVENT_PREFIXES`` tuple literals out of
+    flightrec.py: ({name: lineno}, (prefix, ...)) — or (None, ()) when
+    the vocabulary is absent (older tree)."""
+    if flight_file is None or flight_file.tree is None:
+        return None, ()
+    events, prefixes = None, ()
+    for node in ast.walk(flight_file.tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            continue
+        names = {t.id for t in node.targets if isinstance(t, ast.Name)}
+        if "EVENTS" in names:
+            events = {}
+            for elt in node.value.elts:
+                v = _const_str(elt)
+                if v:
+                    events[v] = elt.lineno
+        elif "EVENT_PREFIXES" in names:
+            prefixes = tuple(v for v in map(_const_str, node.value.elts)
+                             if v)
+    return events, prefixes
+
+
+def _record_calls(tree):
+    """Yield (name, prefix, lineno) for flightrec.record(...) emit
+    sites.  Exactly one of name/prefix is non-None: a string-literal
+    second argument gives ``name``; an f-string gives its static
+    leading ``prefix`` (may be ``""``)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "record"
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("flightrec", "_flightrec")):
+            continue
+        if len(node.args) < 2:
+            continue
+        n = node.args[1]
+        name = _const_str(n)
+        if name is not None:
+            yield name, None, n.lineno
+        elif isinstance(n, ast.JoinedStr):
+            first = n.values[0] if n.values else None
+            prefix = (first.value if isinstance(first, ast.Constant)
+                      and isinstance(first.value, str) else "")
+            yield None, prefix, n.lineno
+        # a plain variable name stays unchecked (runtime territory)
+
+
+def _gate_strings(tree):
+    """Yield (gate_string, lineno) for postmortem gate sites — both
+    shapes: a ``"--gate"`` argv constant followed by the gate list in
+    the same ``list`` literal (subprocess calls in tests), and a
+    ``gate="ev1,ev2"`` keyword argument (soak_bench Incidents)."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.List, ast.Tuple)):
+            elts = node.elts
+            for i, elt in enumerate(elts[:-1]):
+                if _const_str(elt) == "--gate":
+                    gate = _const_str(elts[i + 1])
+                    if gate:
+                        yield gate, elts[i + 1].lineno
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "gate":
+                    gate = _const_str(kw.value)
+                    if gate:
+                        yield gate, kw.value.lineno
 
 
 # ---------------------------------------------------------------------------
@@ -778,6 +862,74 @@ def lint_paths(paths, repo_root=None, docs_path=None, fault_points=None):
                         f"fault point {point!r} is declared in "
                         "fault.POINTS but no inject() call site names it "
                         "— dead chaos coverage"))
+
+    # -- flight-event registry ------------------------------------------------
+    flight_file = next((f for f in files
+                        if os.path.basename(f.path) == "flightrec.py"
+                        and "analysis" not in f.rel.split(os.sep)), None)
+    events, prefixes = _flight_vocab(flight_file)
+
+    def _flight_name_ok(tok):
+        if tok in events:
+            return True
+        for pfx in prefixes:
+            if tok.startswith(pfx) and len(tok) > len(pfx):
+                # the fault.* family composes with the fault-point
+                # registry: the suffix must be a declared point
+                if pfx == "fault." and declared is not None:
+                    return tok[len(pfx):] in declared
+                return True
+        return False
+
+    def _check_flight_gates(fobj):
+        for gate, line in _gate_strings(fobj.tree):
+            if fobj.suppressed_at("MX-FLIGHT001", line):
+                continue
+            for tok in gate.split(","):
+                tok = tok.strip()
+                if tok and not _flight_name_ok(tok):
+                    findings.append(Finding(
+                        "MX-FLIGHT001", fobj.rel, line,
+                        f"postmortem gate names {tok!r} but no emitter "
+                        "registers it in flightrec.EVENTS — this gate "
+                        "can only fail at chaos-stage runtime"))
+
+    if events is not None:
+        for fobj in files:
+            if fobj is flight_file:
+                continue
+            for name, prefix, line in _record_calls(fobj.tree):
+                if fobj.suppressed_at("MX-FLIGHT001", line):
+                    continue
+                if name is not None and not _flight_name_ok(name):
+                    findings.append(Finding(
+                        "MX-FLIGHT001", fobj.rel, line,
+                        f"flightrec.record emits {name!r} which is not "
+                        "registered in flightrec.EVENTS — add the row "
+                        "(postmortem gates can only name registered "
+                        "events)"))
+                elif prefix is not None and not any(
+                        p.startswith(prefix) or prefix.startswith(p)
+                        for p in prefixes):
+                    findings.append(Finding(
+                        "MX-FLIGHT001", fobj.rel, line,
+                        f"flightrec.record emits a dynamic name with "
+                        f"static prefix {prefix!r} outside every "
+                        "flightrec.EVENT_PREFIXES family"))
+            _check_flight_gates(fobj)
+        # gate strings also live in tests/ (subprocess postmortem
+        # runs), which the lint surface does not otherwise scan —
+        # sweep them for gate sites only when linting whole-surface
+        tests_dir = os.path.join(repo_root, "tests")
+        if whole_surface and os.path.isdir(tests_dir):
+            scanned = {f.path for f in files}
+            for name in sorted(os.listdir(tests_dir)):
+                path = os.path.join(tests_dir, name)
+                if not name.endswith(".py") or path in scanned:
+                    continue
+                tobj = _File(path, os.path.relpath(path, repo_root))
+                if tobj.parse_error is None:
+                    _check_flight_gates(tobj)
 
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return findings

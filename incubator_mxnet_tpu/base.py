@@ -11,6 +11,7 @@ import threading
 import numpy as onp
 
 import jax.numpy as jnp
+from .locks import named_lock
 
 __all__ = [
     "MXNetError",
@@ -99,7 +100,7 @@ class _Registry:
     def __init__(self, kind: str):
         self.kind = kind
         self._entries: dict[str, object] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("base.registry")
 
     def register(self, obj=None, name: str | None = None):
         def do(o):

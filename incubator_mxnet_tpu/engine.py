@@ -32,6 +32,7 @@ from collections import deque
 from . import fault
 from .analysis import race as _race
 from .base import get_env
+from .locks import named_condition, named_lock
 
 __all__ = ["Var", "Engine", "NaiveEngine", "ThreadedEngine", "get_engine", "set_engine"]
 
@@ -48,7 +49,7 @@ class Var:
                  "_queue", "_exc", "name")
 
     def __init__(self, name: str = ""):
-        self._lock = threading.Lock()
+        self._lock = named_lock("engine.var")
         self._version = 0
         self._pending_writes = 0
         self._pending_reads = 0
@@ -73,7 +74,7 @@ class _OpBlock:
         self.const_vars = const_vars
         self.mutable_vars = mutable_vars
         self.wait_count = 0
-        self.lock = threading.Lock()
+        self.lock = named_lock("engine.op")
         self.done = threading.Event()
         self.exc = None
         self.name = name
@@ -100,6 +101,10 @@ class Engine:
 
     def wait_for_all(self):
         raise NotImplementedError
+
+    def stop(self):
+        """Join any worker threads.  The engine is done after this —
+        callers build a fresh one via ``reset_engine()`` if needed."""
 
     def throw_pending(self, var: Var):
         with var._lock:
@@ -162,7 +167,7 @@ class ThreadedEngine(Engine):
     def __init__(self, num_workers: int | None = None):
         self._num_workers = num_workers or get_env("MXNET_CPU_WORKER_NTHREADS", 4, int)
         self._ready: deque = deque()
-        self._cv = threading.Condition()
+        self._cv = named_condition("engine.ready")
         self._inflight = 0
         self._shutdown = False
         self._threads = [
@@ -277,6 +282,16 @@ class ThreadedEngine(Engine):
                 if self._inflight == 0:
                     self._cv.notify_all()
 
+    def stop(self):
+        """Drain the ready queue, then join every worker.  Workers exit
+        only once ``_ready`` is empty, so queued ops still run."""
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=10.0)
+        self._threads = [t for t in self._threads if t.is_alive()]
+
     # -- waits ------------------------------------------------------------
     def wait_for_var(self, var: Var):
         probe = self.push(lambda: None, const_vars=(var,), name="wait_for_var")
@@ -343,7 +358,7 @@ class NativeEngine(Engine):
         native.check_call(self._lib.MXTEngineCreate(nw, ctypes.byref(h)))
         self._h = h
         self._ops: dict[int, object] = {}
-        self._ops_lock = threading.Lock()
+        self._ops_lock = named_lock("engine.ops")
         self._next_token = [1]
 
         libc = self._libc
@@ -453,7 +468,7 @@ class NativeEngine(Engine):
         self.wait_for_var(var)
 
 
-_engine_lock = threading.Lock()
+_engine_lock = named_lock("engine.singleton")
 _engine: Engine | None = None
 
 

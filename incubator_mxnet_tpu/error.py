@@ -20,6 +20,7 @@ __all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
            "RouterLeaseError", "RouterForwardError",
            "SessionExpiredError", "SessionLostError",
            "EngineRaceError", "RecompileStormError", "GraphLintError",
+           "LockOrderError",
            "register_error", "get_error_class"]
 
 _ERROR_REGISTRY = {}
@@ -233,3 +234,16 @@ class EngineRaceError(MXNetError):
     under ``MXNET_ENGINE_RACE_CHECK=1`` (``analysis/race.py``).  The
     message names the op and the variable so the missing declaration is
     findable from the traceback alone."""
+
+
+@register_error
+class LockOrderError(MXNetError, _bi.RuntimeError):
+    """The runtime lock witness (``MXNET_LOCK_WITNESS=1``,
+    ``analysis/lockwitness.py``) observed a cycle in the global
+    acquisition-order graph over named locks — two code paths acquire
+    the same locks in opposite orders, i.e. a latent deadlock.  Banked
+    at the offending acquire and rethrown from
+    ``lockwitness.check()``-style boundaries, never from inside the
+    victim's ``acquire`` (the acquire itself stays well-formed).  The
+    message carries the cycle (``a -> b -> a``) and the acquiring
+    threads so the ordering fix is findable from the error alone."""

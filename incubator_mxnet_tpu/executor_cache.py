@@ -56,6 +56,7 @@ import time
 import jax
 
 from .base import get_env
+from .locks import named_lock
 
 __all__ = ["Executor", "TraceCache", "run_analyses", "lint_active",
            "memlint_active", "ensure_compile_cache", "compile_cache_dir",
@@ -65,7 +66,7 @@ __all__ = ["Executor", "TraceCache", "run_analyses", "lint_active",
 
 _PROCESS_T0 = time.monotonic()
 
-_lock = threading.Lock()
+_lock = named_lock("executor.state")
 _state = {
     "cache_init_done": False,
     "cache_dir": None,
@@ -331,7 +332,7 @@ class TraceCache:
     def __init__(self, name):
         self.name = name
         self._d: dict = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("executor.cache")
         self.hits = 0
         self.misses = 0
 

@@ -93,6 +93,7 @@ import threading
 import time
 
 from .base import get_env
+from .locks import named_lock
 
 __all__ = [
     "FaultInjected", "TransientFault", "PermanentFault",
@@ -154,7 +155,7 @@ class _Point:
         self.calls = 0
         self.fired = 0
         self._rng = random.Random(self.seed)
-        self._lock = threading.Lock()
+        self._lock = named_lock("fault.point")
 
     def should_fire(self):
         with self._lock:
@@ -214,7 +215,7 @@ def parse_spec(spec: str) -> dict:
     return points
 
 
-_lock = threading.Lock()
+_lock = named_lock("fault.registry")
 _points: dict | None = None   # None = env not consulted yet
 
 

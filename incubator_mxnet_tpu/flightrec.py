@@ -58,9 +58,11 @@ from collections import deque
 
 from .base import get_env
 from . import trace as _trace
+from .locks import named_lock
 
 __all__ = [
-    "CATEGORIES", "LIFECYCLE", "SCALING", "PLACEMENT", "HEALTH",
+    "CATEGORIES", "EVENTS", "EVENT_PREFIXES",
+    "LIFECYCLE", "SCALING", "PLACEMENT", "HEALTH",
     "FAULT", "COMPILE", "CHECKPOINT", "MEMBERSHIP", "SESSION",
     "Event", "enabled", "active", "configure", "reset", "record",
     "events", "stats", "health_block", "export", "export_json",
@@ -83,6 +85,75 @@ SESSION = "session"
 CATEGORIES = (LIFECYCLE, SCALING, PLACEMENT, HEALTH, FAULT, COMPILE,
               CHECKPOINT, MEMBERSHIP, SESSION)
 _CATEGORY_SET = frozenset(CATEGORIES)
+
+#: The registered event-NAME vocabulary (mxlint MX-FLIGHT001).  Names
+#: were free strings until a ``postmortem --gate`` list drifted from
+#: its emitter and the mismatch surfaced only at chaos-stage runtime —
+#: exactly the failure mode fault.POINTS closed for inject sites.  Now
+#: every static ``record(category, "name")`` call in the linted
+#: surface must name an entry here, and every gate string
+#: (``postmortem --gate ev1,ev2`` argv or ``Incident(gate=...)``) must
+#: too.  Keep the tuple sorted; an emitter with a new name adds its
+#: row in the same PR.
+EVENTS = (
+    "bench.emit",
+    "boundary.error",
+    "checkpoint.fallback",
+    "checkpoint.reshard",
+    "checkpoint.restored",
+    "checkpoint.save",
+    "checkpoint.unrecoverable",
+    "checkpoint.write_failed",
+    "compile.storm",
+    "executor.created",
+    "fleet.rolling_reload",
+    "lock.order_violation",
+    "model.loaded",
+    "model.unloaded",
+    "model.unplaceable",
+    "placer.blocked",
+    "placer.evict",
+    "replica.exited",
+    "replica.quarantined",
+    "replica.readmitted",
+    "replica.state",
+    "router.exited",
+    "router.failover",
+    "router.forwarded",
+    "router.hedge_launched",
+    "router.hedge_won",
+    "router.hop_failed",
+    "router.lease.acquired",
+    "router.lease.beat_lost",
+    "router.lease.expired",
+    "router.lease.renewed",
+    "router.scale_from_zero",
+    "router.started",
+    "router.takeover.completed",
+    "router.takeover.started",
+    "scale.apply",
+    "scale.decide",
+    "scale.dropped",
+    "scale.failed",
+    "scale.from_zero",
+    "server.started",
+    "session.created",
+    "session.evicted",
+    "session.lost",
+    "session.migrated",
+    "session.restored",
+    "sigusr2.dump",
+    "trainer.evicted",
+    "trainer.rejoined",
+    "worker.evicted",
+    "worker.joined",
+    "worker.left",
+)
+
+#: Prefix families for dynamically-formed names: ``fault.{point}``
+#: (suffix validated against ``fault.POINTS`` — the two registries
+#: compose) and ``fleet.{verb}`` (admin verbs fan out per call site).
+EVENT_PREFIXES = ("fault.", "fleet.")
 
 _SEVERITIES = frozenset(("info", "warn", "error"))
 
@@ -111,7 +182,7 @@ class Event:
 # configuration + ring
 # ---------------------------------------------------------------------------
 
-_lock = threading.Lock()
+_lock = named_lock("flightrec.cfg")
 _cfg = {"ring": None, "dir": None, "dump_min_s": None, "proc": None}
 _provider_registered = False
 

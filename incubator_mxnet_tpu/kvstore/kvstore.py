@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from ..base import get_env
+from ..locks import named_condition
 from ..error import PSTimeoutError
 from ..ndarray import NDArray
 from .. import optimizer as opt_mod
@@ -135,6 +136,10 @@ class _BaseStore(KVStoreBase):
 
     def set_gradient_compression(self, compression_params):
         self._compression = GradientCompression(**dict(compression_params))
+
+    def close(self):
+        """Release any background resources (threads, sockets).  Base
+        stores own none; transports with senders override and join."""
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         with open(fname, "wb") as f:
@@ -430,7 +435,7 @@ class P3KVStore(DistKVStore):
         self._q: "queue.PriorityQueue" = queue.PriorityQueue()
         self._pending: dict = {}
         self._seq = 0
-        self._cv = threading.Condition()
+        self._cv = named_condition("kvstore.sendq")
         self._gate = threading.Event()
         self._gate.set()           # tests clear this to stage a backlog
         self._sender = threading.Thread(target=self._drain, daemon=True)
@@ -476,6 +481,22 @@ class P3KVStore(DistKVStore):
         return [(i // self._slice, flat[i:i + self._slice])
                 for i in range(0, n, self._slice)]
 
+    def close(self):
+        """Flush the priority queue and join the background sender.
+
+        The sentinel sorts after every real slice (``inf`` priority), so
+        pending traffic still drains in wire order before the thread
+        exits.  Idempotent."""
+        sender = self._sender
+        if sender is None:
+            return
+        self._gate.set()        # a test-staged backlog must not wedge the join
+        self._seq += 1
+        self._q.put((float("inf"), self._seq, None))
+        sender.join(timeout=10.0)
+        if not sender.is_alive():
+            self._sender = None
+
     def init(self, key, value):
         keys = key if isinstance(key, (list, tuple)) else [key]
         values = value if isinstance(value, (list, tuple)) else [value]
@@ -519,12 +540,13 @@ class P3KVStore(DistKVStore):
                 flushed = self._cv.wait_for(
                     lambda: self._pending.get(k, 0) == 0, timeout=timeout)
                 err = getattr(self, "_sender_error", None)
+                remaining = self._pending.get(k, 0)
             if err is not None:
                 raise RuntimeError(
                     f"p3 background sender failed: {err}") from err
             if not flushed:
                 raise PSTimeoutError(
-                    f"p3 pull: {self._pending.get(k, 0)} pushed slice(s) "
+                    f"p3 pull: {remaining} pushed slice(s) "
                     f"for key {k!r} not flushed in {timeout:.0f}s")
             shape = self._shapes[k]
             parts = []

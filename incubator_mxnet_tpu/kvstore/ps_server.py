@@ -78,6 +78,7 @@ import numpy as onp
 from .. import fault, flightrec
 from ..base import get_env
 from ..error import PSTimeoutError, WorkerEvictedError, get_error_class
+from ..locks import named_condition, named_lock
 
 __all__ = ["PSServer", "PSClient", "serve_forever"]
 
@@ -138,8 +139,8 @@ class _State:
         self.seen: dict = {}            # (session, key) -> (seq, round)
         self.barrier_seen: dict = {}    # session -> (seq, gen entered)
         self.updater = None
-        self.lock = threading.Lock()
-        self.cv = threading.Condition(self.lock)
+        self.lock = named_lock("ps.server")
+        self.cv = named_condition("ps.server", self.lock)
         self.barrier_count = 0
         self.barrier_gen = 0
         self.barrier_need = None        # open barrier's frozen threshold
@@ -658,7 +659,7 @@ class PSClient:
         self._seq: dict = {}       # key -> last sequence number issued
         self._round_target: dict = {}  # key -> round our pushes reached
         self._barrier_seq = -1
-        self.lock = threading.Lock()
+        self.lock = named_lock("ps.client")
         self.sock = None
         self._connect()
 
@@ -722,7 +723,7 @@ class PSClient:
                     self.close()
                 return None
             try:
-                ok, out = fault.retry(
+                ok, out = fault.retry(  # mxlint: allow-blocking-under-lock(the client lock serializes the single shared socket; the retry+reconnect roundtrip IS the critical section — concurrent callers must queue behind it, not interleave frames on a dead socket)
                     lambda: self._roundtrip(req),
                     max_attempts=self.max_retries,
                     retryable=(ConnectionError, TimeoutError, OSError),

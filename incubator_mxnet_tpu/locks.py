@@ -1,0 +1,131 @@
+"""Named lock factory — the one place the control plane makes locks.
+
+Every ``threading.Lock``/``RLock``/``Condition`` the threaded control
+plane holds (fleet, router tier, autoscaler, sessions, batchers,
+kvstore, engine, loadgen, observability) is constructed here with a
+stable dotted *name* (``fleet.state``, ``placer.ledger``,
+``sessions.registry`` — docs/static_analysis.md "locklint" for the
+naming convention).  The name is what makes lock discipline analyzable:
+
+* **statically** — ``analysis/locklint.py`` resolves ``named_lock``
+  bindings to their names and builds the cross-module lock-order graph
+  (MX-LOCK002), something attribute-regex heuristics over bare
+  ``threading.Lock()`` constructions could only do per module;
+* **dynamically** — under ``MXNET_LOCK_WITNESS=1`` this factory
+  returns instrumented wrappers (``analysis/lockwitness.py``) that
+  maintain per-thread held-sets and a global acquisition-order graph,
+  banking a typed :class:`~.error.LockOrderError` on any observed
+  order cycle.
+
+Flag-off cost: the witness decision is ONE module-bool branch at
+*construction* time — ``named_lock`` then returns a bare
+``threading.Lock``, so the acquire/release hot path carries zero
+wrapper overhead (pinned by ``tests/test_locklint.py``'s
+microbenchmark: < 2 µs per acquire/release pair).
+
+This module is deliberately a leaf (stdlib only, no framework
+imports): it is imported by ``base.py`` and the observability layer
+before the rest of the package exists, and the witness module is
+loaded by file exactly like the mxlint CLI loads its analyzer — so
+enabling the witness can never introduce an import cycle.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["named_lock", "named_rlock", "named_condition",
+           "witness_enabled", "set_witness"]
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def _env_flag():
+    # documented in docs/env_vars.md (MX-ENV001); read directly —
+    # base.get_env would import jax into this leaf module
+    return os.environ.get(
+        "MXNET_LOCK_WITNESS", "").strip().lower() in _TRUTHY
+
+
+#: construction-time gate — one module-bool branch per factory call.
+_witness: bool = _env_flag()
+
+_WITNESS_MOD = "incubator_mxnet_tpu.analysis.lockwitness"
+
+
+def _witness_module():
+    """The lockwitness module, loaded by FILE under its canonical name
+    (and registered in ``sys.modules`` so a later package import sees
+    the same instance).  File-loading keeps this path cycle-proof:
+    ``base.py`` constructs named locks while the package is still
+    importing, and a normal ``from .analysis import lockwitness``
+    would re-enter the half-initialized package."""
+    import sys
+    mod = sys.modules.get(_WITNESS_MOD)
+    if mod is None:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "analysis", "lockwitness.py")
+        spec = importlib.util.spec_from_file_location(_WITNESS_MOD, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[_WITNESS_MOD] = mod
+        try:
+            spec.loader.exec_module(mod)
+        except BaseException:
+            sys.modules.pop(_WITNESS_MOD, None)
+            raise
+    return mod
+
+
+def witness_enabled() -> bool:
+    """Whether new ``named_*`` constructions are witness-instrumented."""
+    return _witness
+
+
+def set_witness(flag):
+    """Toggle witnessing for locks constructed AFTER this call;
+    ``None`` re-reads ``MXNET_LOCK_WITNESS``.  Existing locks keep
+    whatever shape they were built with (a bare lock cannot be
+    retrofitted), so tests flip this before constructing the component
+    under test.  Returns the previous value."""
+    global _witness
+    prev = _witness
+    _witness = _env_flag() if flag is None else bool(flag)
+    if _witness:
+        _witness_module().set_enabled(True)
+    return prev
+
+
+def named_lock(name: str):
+    """A ``threading.Lock`` carrying a stable dotted name.
+
+    Flag-off: returns a bare ``threading.Lock`` (zero acquire
+    overhead).  Under ``MXNET_LOCK_WITNESS=1``: returns a
+    ``lockwitness.WitnessLock`` with the full acquire/release
+    signature (``blocking=``/``timeout=`` included — the flight
+    recorder's signal path does non-blocking tries)."""
+    if _witness:
+        return _witness_module().WitnessLock(name)
+    return threading.Lock()
+
+
+def named_rlock(name: str):
+    """Reentrant variant of :func:`named_lock` — witness bookkeeping
+    counts reacquisition depth instead of fabricating self-edges."""
+    if _witness:
+        return _witness_module().WitnessRLock(name)
+    return threading.RLock()
+
+
+def named_condition(name: str, lock=None):
+    """A ``threading.Condition`` over a named lock.
+
+    ``lock`` may be an earlier ``named_lock`` result (the
+    ``ps_server`` pattern — one mutex, one condition over it) or
+    ``None`` for a private lock.  Witness-on, ``wait()`` correctly
+    drops the lock from the per-thread held-set for the duration of
+    the wait (a Condition wait *releases*, which is why audited waits
+    are exempt from MX-LOCK003)."""
+    if _witness:
+        return _witness_module().WitnessCondition(name, lock)
+    return threading.Condition(lock)

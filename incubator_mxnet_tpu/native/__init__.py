@@ -16,12 +16,14 @@ import os
 import subprocess
 import threading
 
+from ..locks import named_lock
+
 __all__ = ["lib", "check_call", "ImageIterParams", "ENGINE_FN", "available"]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(os.path.dirname(_HERE))
 _LIB_PATH = os.path.join(_HERE, "libmxtpu.so")
-_lock = threading.Lock()
+_lock = named_lock("native.lib")
 
 
 class ImageIterParams(ctypes.Structure):

@@ -44,6 +44,7 @@ from ..base import get_env
 from .. import executor_cache as _xc
 from .. import profiler as _profiler
 from ..analysis import recompile as _recompile
+from ..locks import named_lock
 
 __all__ = ["enabled", "set_enabled", "bulk_scope", "max_bulk_ops",
            "PendingArray", "defer", "resolve", "flush_current",
@@ -217,7 +218,7 @@ class _Segment:
 
     def __init__(self):
         self.nodes: list[_Node] = []
-        self.lock = threading.Lock()
+        self.lock = named_lock("bulking.segment")
         self.flushed = False
         self.exc = None
         # env read once per segment, not per op (the append hot path)

@@ -25,12 +25,13 @@ import numpy as _onp
 
 from .. import profiler as _profiler
 from . import bulking as _bulking
+from ..locks import named_lock
 
 __all__ = ["Op", "register", "get_op", "list_ops", "invoke",
            "clear_caches", "cache_stats"]
 
 _OPS: dict[str, "Op"] = {}
-_lock = threading.Lock()
+_lock = named_lock("ops.registry")
 
 
 def _hashable(v):

@@ -16,6 +16,8 @@ import time
 
 import jax
 
+from .locks import named_lock
+
 __all__ = ["set_config", "set_state", "start", "stop", "dump", "dumps",
            "pause", "resume", "Task", "Frame", "Counter", "Marker", "scope",
            "dump_memory_allocations", "bulk_stats", "reset_bulk_stats",
@@ -35,7 +37,7 @@ _config = {
 }
 _state = {"running": False, "xprof_active": False}
 _events: list[dict] = []
-_events_lock = threading.Lock()
+_events_lock = named_lock("profiler.events")
 _aggregate: dict[str, list[float]] = {}
 
 
@@ -86,7 +88,7 @@ def stop(profile_process="worker"):
 #    eager dispatch count for comparison — the observability half of the
 #    reference's bulk-exec engine segments (graph_executor.cc InitOpSegs) --
 
-_bulk_lock = threading.Lock()
+_bulk_lock = named_lock("profiler.bulk")
 
 
 def _fresh_bulk_stats():

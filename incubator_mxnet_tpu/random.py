@@ -19,12 +19,14 @@ import threading
 
 import jax
 
+from .locks import named_lock
+
 __all__ = ["seed", "next_key", "key_scope", "uniform", "normal", "randint",
            "current_seed"]
 
 _state = threading.local()
 _global = {"seed": 0, "counter": 0}
-_lock = threading.Lock()
+_lock = named_lock("random.state")
 
 
 def seed(seed_state: int, ctx=None):  # ctx accepted for API parity

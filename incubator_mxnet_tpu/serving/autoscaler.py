@@ -59,6 +59,7 @@ from ..base import get_env
 from .. import fault, flightrec
 from ..error import (FleetDrainingError, ModelEvictedError,
                      ReplicaUnavailableError)
+from ..locks import named_lock
 from .admission import ModelNotFound, slo_class
 from .placement import Placer, model_footprint_bytes
 
@@ -157,8 +158,8 @@ class Autoscaler:
         self._policies: dict[str, ModelPolicy] = {}
         for p in policies:
             self.add_policy(p)
-        self._lock = threading.Lock()
-        self._demand_locks: dict[str, threading.Lock] = {}
+        self._lock = named_lock("autoscaler.state")
+        self._demand_locks: dict = {}
         # planning is serialized and RESERVES budget in the ledger at
         # plan time (see _plan_grow): two grow decisions derived
         # against the same books — two models crossing the threshold
@@ -166,7 +167,7 @@ class Autoscaler:
         # ensure_loaded — must not jointly overcommit one replica's
         # HBM budget.  _reserved marks in-flight loads so _sync_placer
         # does not drop the reservation before the load lands.
-        self._plan_lock = threading.Lock()
+        self._plan_lock = named_lock("autoscaler.plan")
         self._reserved: set = set()            # {(rid, model)}
         # in-flight spawns count against the replica ceiling from PLAN
         # time: a spawn decision racing a second planner (two
@@ -696,7 +697,8 @@ class Autoscaler:
         if p is None:
             raise ModelNotFound(
                 f"model {name!r} is not managed by the autoscaler")
-        lock = self._demand_locks.setdefault(name, threading.Lock())
+        lock = self._demand_locks.setdefault(
+            name, named_lock("autoscaler.demand"))
         with lock:
             if self.fleet.routable(name):
                 return None        # raced another request: already up
@@ -814,7 +816,7 @@ class Autoscaler:
             # no-capacity verdict (ModelEvictedError is a
             # ConnectionError for the router's 503 mapping, yet
             # re-planning it three times cannot change the answer)
-            fault.retry(place, max_attempts=_retries, backoff=0.01,
+            fault.retry(place, max_attempts=_retries, backoff=0.01,  # mxlint: allow-blocking-under-lock(the per-model demand lock exists precisely to serialize concurrent scale-from-zero requests through ONE load+retry; queued requests re-check routable() on entry and return immediately)
                         max_backoff=0.2,
                         retryable=(fault.TransientFault,
                                    ReplicaUnavailableError,
@@ -838,6 +840,8 @@ class Autoscaler:
         on the router's ``/metrics`` and under ``/healthz``
         ``"autoscale"`` (additive)."""
         desired = dict(self._last_desired)
+        with self._lock:
+            sfz = dict(self._scale_from_zero_ms)
         models = {}
         for name, p in self._policies.items():
             models[name] = {
@@ -845,8 +849,7 @@ class Autoscaler:
                 "actual": self.actual(name),
                 "slo": p.slo.name,
                 "min_replicas": p.min_replicas,
-                "scale_from_zero_ms":
-                    self._scale_from_zero_ms.get(name),
+                "scale_from_zero_ms": sfz.get(name),
             }
         with self._lock:
             counters = dict(self._counters)

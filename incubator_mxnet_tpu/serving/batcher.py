@@ -36,6 +36,7 @@ import numpy as onp
 
 from ..base import get_env
 from .. import fault, trace
+from ..locks import named_condition
 from .admission import DeadlineExceeded, ServingError
 
 __all__ = ["DynamicBatcher", "ContinuousBatcher", "PendingResult",
@@ -61,7 +62,7 @@ class WeightedFairGate:
     uncontended lock acquire per batch."""
 
     def __init__(self):
-        self._cond = threading.Condition()
+        self._cond = named_condition("batcher.wfq")
         self._vtime = 0.0
         self._finish: dict[str, float] = {}   # per-key virtual finish
         self._heap: list = []                 # (finish, seq, key)
@@ -241,7 +242,7 @@ class DynamicBatcher:
         self._depth = 0
         self._accepting = True
         self._running = True
-        self._cond = threading.Condition()
+        self._cond = named_condition("batcher.dynamic")
         self._worker = threading.Thread(
             target=self._loop, name=f"batcher-{name}", daemon=True)
         self._worker.start()
@@ -251,7 +252,7 @@ class DynamicBatcher:
     @property
     def depth(self):
         """Queued-but-unfinished request count (admission + gauge)."""
-        return self._depth
+        return self._depth  # mxlint: disable=MX-GUARD001(GIL-atomic int read used as an advisory gauge; the atomic admission bound runs under the lock via admit())
 
     def submit_async(self, inputs, deadline_ms=None, admit=None):
         """Enqueue one instance; returns a :class:`PendingResult` whose
@@ -631,7 +632,7 @@ class ContinuousBatcher:
         self._active: list[_Stream] = []
         self._depth = 0
         self._running = True
-        self._cond = threading.Condition()
+        self._cond = named_condition("batcher.continuous")
         self._worker = threading.Thread(
             target=self._loop, name=f"continuous-{name}", daemon=True)
         self._worker.start()
@@ -641,11 +642,11 @@ class ContinuousBatcher:
     @property
     def depth(self):
         """Queued + active stream count (admission bound + gauge)."""
-        return self._depth
+        return self._depth  # mxlint: disable=MX-GUARD001(GIL-atomic int read used as an advisory gauge; the atomic admission bound runs under the lock via admit())
 
     @property
     def active_streams(self):
-        return len(self._active)
+        return len(self._active)  # mxlint: disable=MX-GUARD001(GIL-atomic len() of a list the worker swaps under its lock; advisory gauge only)
 
     def submit(self, sid, inputs, n_steps=1, deadline_ms=None,
                admit=None, stream=False):

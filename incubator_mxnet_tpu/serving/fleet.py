@@ -61,6 +61,7 @@ import numpy as onp
 from ..base import get_env
 from .. import fault, flightrec, trace
 from ..error import ReplicaUnavailableError
+from ..locks import named_lock
 from .admission import (BadRequest, DeadlineExceeded, ModelNotFound,
                         QueueFullError, ServingError, ShuttingDown)
 
@@ -90,7 +91,7 @@ class _ReplicaBase:
             probe_fails if probe_fails is not None
             else get_env("MXNET_SERVING_FLEET_PROBE_FAILS", 3, int))
         self._inflight = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("fleet.replica")
 
     def _to(self, new_state):
         """One state-machine transition, recorded in the flight ring —
@@ -834,7 +835,7 @@ class ReplicaFleet:
         self._replicas: list = []
         self._next_rid = 0
         self._meta_cache: dict = {}       # name -> input specs
-        self._lock = threading.Lock()
+        self._lock = named_lock("fleet.state")
         self._stop = threading.Event()
         self._prober = None
         # the router-HA membership layer, when one is attached: this

@@ -20,6 +20,7 @@ import time
 
 import numpy as onp
 
+from ...locks import named_lock
 from .clients import percentile, sync_volley
 from .harness import slo_targets
 
@@ -32,7 +33,7 @@ def open_loop(call, rate, n, max_inflight=32, join_s=60.0):
     when the server queues, which is what saturates a fleet the way
     production traffic does).  Returns achieved rps / p99 / errors."""
     lat, errors = [], []
-    lock = threading.Lock()
+    lock = named_lock("loadgen.capacity")
     sem = threading.Semaphore(max_inflight)
     threads = []
     t0 = time.monotonic()

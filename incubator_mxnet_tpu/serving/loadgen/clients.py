@@ -32,6 +32,8 @@ import time
 import urllib.error
 import urllib.request
 
+from ...locks import named_lock
+
 __all__ = ["percentile", "VolleyResult", "sync_volley", "wave_volley",
            "ClosedLoopPhase", "post_json", "post_retry", "scrape",
            "PredictClient", "SessionClient", "StreamBroken",
@@ -95,7 +97,7 @@ def sync_volley(call, n, rounds=1, clients=8, collect_latency=True,
     nclients, bounds = _client_bounds(n, clients)
     results = [None] * n
     lat, errors = [], []
-    lock = threading.Lock()
+    lock = named_lock("loadgen.closed")
     barrier = threading.Barrier(nclients + 1)
 
     def client(c):
@@ -140,7 +142,7 @@ def wave_volley(submit, n, rounds=1, clients=8, resolve=None):
     nclients, bounds = _client_bounds(n, clients)
     results = [None] * n
     lat, errors = [], []
-    lock = threading.Lock()
+    lock = named_lock("loadgen.waves")
     barrier = threading.Barrier(nclients + 1)
 
     def client(c):
@@ -190,7 +192,7 @@ class ClosedLoopPhase:
         self.lat_ms = {}      # model -> [ms]
         self.errors = {}      # model -> [repr]
         self.shed = {}        # model -> count (429/503 — the SLO arm)
-        self._lock = threading.Lock()
+        self._lock = named_lock("loadgen.mixed")
 
     def _client(self, model, stop, rng):
         from ..admission import QueueFullError

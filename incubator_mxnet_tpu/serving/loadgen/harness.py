@@ -36,6 +36,7 @@ import numpy as onp
 
 from ... import fault
 from ...base import get_env
+from ...locks import named_lock
 from .clients import (PredictClient, SessionClient, StreamBroken,
                       percentile, scrape, SLO_HEADER)
 
@@ -134,7 +135,7 @@ class SloMonitor:
         self.targets = dict(slo_targets() if targets is None
                             else targets)
         self._obs = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("loadgen.slo")
 
     def observe(self, t_virtual, slo, ms, ok=True):
         with self._lock:
@@ -198,7 +199,7 @@ class StreamLedger:
         self._rows: dict = {}    # sid -> {step index: frozen row}
         self._meta: dict = {}    # sid -> {"steps": N, "value": v}
         self.conflicts: list = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("loadgen.consistency")
 
     def expect(self, sid, steps, value):
         with self._lock:
@@ -353,7 +354,7 @@ class SoakHarness:
         self.killed: set = set()
         self.errors: list = []
         self.recreates = 0
-        self._err_lock = threading.Lock()
+        self._err_lock = named_lock("loadgen.errors")
         self._prefix = None
 
     # -- fleet lifecycle -------------------------------------------------

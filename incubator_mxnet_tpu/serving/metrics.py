@@ -18,6 +18,7 @@ import threading
 import time
 
 from .. import trace
+from ..locks import named_lock
 
 __all__ = ["ServingMetrics", "FleetMetrics", "Histogram",
            "SlowExemplars"]
@@ -49,7 +50,7 @@ class Histogram:
         self.total = 0
         self.sum = 0.0
         self._ring = [0.0] * _RESERVOIR
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.histogram")
 
     def observe(self, value):
         value = float(value)
@@ -118,7 +119,7 @@ class SlowExemplars:
         self._cur: list = []     # [(ms, trace_id)] sorted desc
         self._prev: list = []
         self._count = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.slowk")
 
     def note(self, ms, trace_id):
         """Record one traced observation (untraced requests never get
@@ -175,7 +176,7 @@ class ServingMetrics:
 
     def __init__(self):
         self._models: dict[str, _ModelMetrics] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.serving")
         self._started = time.monotonic()
         # callbacks the repository installs: () -> int / dict
         self._compile_count_fn = None
@@ -599,7 +600,7 @@ class FleetMetrics:
     page and folded into ``profiler.dumps()`` as ``serving_fleet``."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.fleet")
         self._started = time.monotonic()
         self._codes: dict = {}            # {http-code: count}
         self._probe_failures: dict = {}   # {replica-id: count}

@@ -25,6 +25,7 @@ import threading
 
 from ..base import get_env
 from .. import trace
+from ..locks import named_lock
 from .admission import (Admission, ModelNotFound, ServingError,
                         checked_enqueue, slo_class)
 from .batcher import DynamicBatcher, WeightedFairGate, parse_buckets
@@ -97,7 +98,7 @@ class ModelRepository:
         # one WFQ gate per repository: batches of co-packed models are
         # admitted to the device in SLO-weighted fair order
         self.exec_gate = WeightedFairGate()
-        self._lock = threading.Lock()
+        self._lock = named_lock("models.repository")
         if self.metrics is not None:
             self.metrics.attach_repository(self)
 

@@ -27,6 +27,7 @@ import json
 import threading
 
 from ..base import get_env
+from ..locks import named_lock
 
 __all__ = ["Placer", "model_footprint_bytes"]
 
@@ -67,7 +68,7 @@ class Placer:
         self.budget_bytes = int(
             budget_bytes if budget_bytes is not None
             else get_env("MXNET_SERVING_REPLICA_HBM_BUDGET", 0, int))
-        self._lock = threading.Lock()
+        self._lock = named_lock("placer.ledger")
         self._assigned: dict[str, dict[str, int]] = {}  # rid -> {m: b}
 
     # -- ledger --------------------------------------------------------

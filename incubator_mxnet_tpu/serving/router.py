@@ -48,6 +48,7 @@ from .. import fault, flightrec, trace
 from ..error import (FleetDrainingError, ReplicaUnavailableError,
                      RouterForwardError, RouterLeaseError,
                      SessionExpiredError, SessionLostError)
+from ..locks import named_condition, named_lock
 from .admission import (Admission, BadRequest, ClientDisconnected,
                         DeadlineExceeded, ModelNotFound, QueueFullError,
                         ServingError, ShuttingDown, checked_route,
@@ -110,7 +111,7 @@ class FleetRouter:
         # replica; the router remembers which (sid -> (model, rid))
         # and re-homes it from its snapshot when that replica dies
         self._session_homes: dict = {}
-        self._session_lock = threading.Lock()
+        self._session_lock = named_lock("router.sessions")
         self.metrics.attach_session_count(
             lambda: len(self._session_homes))
         self.host = host
@@ -353,7 +354,7 @@ class FleetRouter:
         hedge_ms = self._hedge_delay_ms()
         if hedge_ms is None or hedge_ms >= hop_ms:
             return self._call(r, name, inputs, hop_ms, inputs_json)
-        cond = threading.Condition()
+        cond = named_condition("router.hedge")
         slots: dict = {}
         order: list = []
 
@@ -764,7 +765,7 @@ class FleetRouter:
                              | (set(self.autoscaler.policies())
                                 if self.autoscaler is not None
                                 else set())),
-            "sessions": len(self._session_homes),
+            "sessions": len(self._session_homes),  # mxlint: disable=MX-GUARD001(GIL-atomic len() for an advisory gauge — same contract as the attach_session_count lambda)
             "failovers": self.failovers,
             "hedge": self.hedge,
         }

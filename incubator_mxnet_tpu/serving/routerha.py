@@ -63,6 +63,7 @@ import time
 from ..base import get_env
 from .. import fault, flightrec
 from ..error import RouterLeaseError
+from ..locks import named_lock
 
 __all__ = ["HEADER", "HashRing", "MemoryLeaseStore", "FileLeaseStore",
            "RouterHA", "parse_forward_header", "forward_header_value"]
@@ -141,7 +142,7 @@ class MemoryLeaseStore:
 
     def __init__(self):
         self._entries: dict = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("routerha.store")
 
     def publish(self, entry):
         with self._lock:
@@ -256,7 +257,7 @@ class RouterHA:
         self._counters = {"beats": 0, "beat_failures": 0,
                           "takeovers": 0, "adopted_sessions": 0,
                           "forwards": 0}
-        self._lock = threading.Lock()
+        self._lock = named_lock("routerha.member")
         self._stop = threading.Event()
         self._thread = None
         # the view refreshed by each sweep (store reads are cheap but

@@ -52,6 +52,7 @@ import numpy as onp
 from ..base import get_env
 from .. import fault, flightrec
 from ..error import SessionExpiredError, SessionLostError
+from ..locks import named_condition, named_lock
 from .admission import (Admission, BadRequest, ModelNotFound,
                         ServingError, ShuttingDown)
 from .batcher import ContinuousBatcher, parse_buckets
@@ -356,7 +357,7 @@ class SessionManager:
         self._sessions: dict[str, _Session] = {}
         self._expired: dict[str, str] = {}   # sid -> reason (bounded)
         self._evicted_dirs: list[str] = []   # snapshot trees to drop
-        self._lock = threading.Lock()
+        self._lock = named_lock("sessions.registry")
         self.stream_ms = Histogram()
         self._counters = {"steps": 0, "created": 0, "evicted": 0,
                           "snapshots": 0, "snapshot_failures": 0,
@@ -366,7 +367,7 @@ class SessionManager:
         # throughput); carry rows are immutable once written back, so
         # the snapshotter works from a consistent (carry, steps) pair
         # grabbed under the lock
-        self._snap_cond = threading.Condition()
+        self._snap_cond = named_condition("sessions.snapshot")
         self._snap_due: list[str] = []
         self._snap_stop = False
         self._snapshotter = None
@@ -864,7 +865,7 @@ class SessionHost:
         self.snapshot_dir = snapshot_dir
         self._buckets = buckets
         self._managers: dict[str, SessionManager] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("sessions.store")
         if metrics is not None:
             metrics.attach_sessions(self)
 

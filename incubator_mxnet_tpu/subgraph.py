@@ -15,13 +15,14 @@ import os
 import threading
 
 import jax
+from .locks import named_lock
 
 __all__ = ["SubgraphSelector", "SubgraphProperty", "register_backend",
            "get_backend", "list_backends", "partition",
            "default_backend_from_env"]
 
 _BACKENDS: dict = {}
-_lock = threading.Lock()
+_lock = named_lock("subgraph.backends")
 
 
 class SubgraphSelector:

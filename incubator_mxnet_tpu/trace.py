@@ -58,6 +58,7 @@ import time
 from collections import deque
 
 from .base import get_env
+from .locks import named_lock
 
 __all__ = [
     "HEADER", "Span", "enabled", "active", "sample_rate", "configure",
@@ -82,7 +83,7 @@ _ANCHOR_MONO = time.monotonic()
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "mxnet_trace_span", default=None)
 
-_lock = threading.Lock()
+_lock = named_lock("trace.cfg")
 _cfg = {"sample": None, "ring": None, "slow_k": None}  # None = env
 _rng = random.Random()
 _provider_registered = False
@@ -191,7 +192,7 @@ class _Ring:
     def __init__(self, cap):
         self.cap = int(cap)
         self._d = deque()
-        self._lock = threading.Lock()
+        self._lock = named_lock("trace.ring")
         self.pushed = 0
         self.dropped = 0
 

@@ -28,6 +28,20 @@ def pytest_configure(config):
 
 
 @pytest.fixture(autouse=True)
+def _lock_witness_gate():
+    """Zero-violations gate for witness-enabled runs (the CI `fleet` and
+    `sessions` chaos stages export MXNET_LOCK_WITNESS=1): any lock-order
+    cycle a test's interleaving draws fails THAT test at teardown with
+    the typed cycle message — check() drains the bank, so the failure is
+    localized, never smeared across the session."""
+    yield
+    if os.environ.get("MXNET_LOCK_WITNESS", "").strip().lower() in (
+            "1", "true", "yes", "on"):
+        from incubator_mxnet_tpu.analysis import lockwitness
+        lockwitness.check()
+
+
+@pytest.fixture(autouse=True)
 def _seed_everything():
     """Reproducible RNG per test (reference @with_seed fixture,
     tests/python/unittest/common.py)."""

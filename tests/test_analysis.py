@@ -570,6 +570,92 @@ def test_prune_stale_baseline(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# MX-FLIGHT001 — flight-recorder event vocabulary
+# ---------------------------------------------------------------------------
+
+_FLIGHT_VOCAB = """
+    EVENTS = (
+        "replica.exited",
+        "scale.apply",
+    )
+    EVENT_PREFIXES = ("fault.",)
+    HEALTH = "health"
+    def record(category, name, **fields):
+        pass
+"""
+
+
+def _lint_flight(tmp_path, consumer_src):
+    (tmp_path / "flightrec.py").write_text(textwrap.dedent(_FLIGHT_VOCAB))
+    (tmp_path / "consumer.py").write_text(textwrap.dedent(consumer_src))
+    return mxlint.lint_paths([str(tmp_path)], repo_root=str(tmp_path))
+
+
+def test_flight001_flags_unregistered_record_name(tmp_path):
+    fs = _lint_flight(tmp_path, """
+        from . import flightrec
+        def bail():
+            flightrec.record(flightrec.HEALTH, "replica.exitted")
+    """)
+    assert _rules(fs) == ["MX-FLIGHT001"]
+    assert "replica.exitted" in fs[0].message
+
+
+def test_flight001_passes_registered_and_prefix_family(tmp_path):
+    assert _lint_flight(tmp_path, """
+        from . import flightrec
+        def bail(point):
+            flightrec.record(flightrec.HEALTH, "replica.exited")
+            flightrec.record(flightrec.HEALTH, f"fault.{point}")
+    """) == []
+
+
+def test_flight001_flags_dynamic_name_outside_prefix_families(tmp_path):
+    fs = _lint_flight(tmp_path, """
+        from . import flightrec
+        def bail(what):
+            flightrec.record(flightrec.HEALTH, f"replica.{what}")
+    """)
+    assert _rules(fs) == ["MX-FLIGHT001"]
+
+
+def test_flight001_flags_unregistered_gate_names(tmp_path):
+    # both postmortem-gate shapes: the argv pair and the gate= kwarg
+    fs = _lint_flight(tmp_path, """
+        def run(pm, incidents):
+            import subprocess
+            subprocess.run([pm, "--gate", "scale.apply,scale.aply"])
+            incidents(gate="replica.exited,replica.gone")
+    """)
+    assert _rules(fs) == ["MX-FLIGHT001", "MX-FLIGHT001"]
+    assert "scale.aply" in fs[0].message
+    assert "replica.gone" in fs[1].message
+
+
+def test_flight001_pragma_needs_reason(tmp_path):
+    assert _lint_flight(tmp_path, """
+        from . import flightrec
+        def bail():
+            flightrec.record(flightrec.HEALTH, "no.such.event")  # mxlint: disable=MX-FLIGHT001(fixture: asserting the gate FAILS on this name)
+    """) == []
+    fs = _lint_flight(tmp_path, """
+        from . import flightrec
+        def bail():
+            flightrec.record(flightrec.HEALTH, "no.such.event")  # mxlint: disable=MX-FLIGHT001()
+    """)
+    assert _rules(fs) == ["MX-FLIGHT001"]
+
+
+def test_flight001_real_vocabulary_covers_all_emits_and_gates():
+    # the package + tests/benchmark gate surface is clean against the
+    # real flightrec.EVENTS — what lets the CI locklint/lint stages
+    # enforce the registry with no baseline
+    from incubator_mxnet_tpu import flightrec
+    assert "lock.order_violation" in flightrec.EVENTS
+    assert "fault." in flightrec.EVENT_PREFIXES
+
+
+# ---------------------------------------------------------------------------
 # the repo itself is clean — what lets CI run with an empty baseline
 # ---------------------------------------------------------------------------
 

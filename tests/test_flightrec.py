@@ -626,7 +626,7 @@ def test_postmortem_gate_orders_and_fails_typed(tmp_path):
     assert bad.returncode == 1
     assert "out of order" in bad.stderr
     missing = subprocess.run(
-        [sys.executable, POSTMORTEM, d, "--gate", "no.such.event"],
+        [sys.executable, POSTMORTEM, d, "--gate", "no.such.event"],  # mxlint: disable=MX-FLIGHT001(deliberately unregistered name — the test asserts postmortem FAILS this gate)
         capture_output=True, text=True)
     assert missing.returncode == 1 and "absent" in missing.stderr
 

@@ -1,0 +1,288 @@
+#!/usr/bin/env python
+"""locklint CLI — whole-program lock-discipline analysis driver.
+
+Usage:
+    python tools/locklint.py [paths...]          # default: the package
+    python tools/locklint.py --json              # machine-readable
+    python tools/locklint.py --selftest          # prove every rule fires
+    python tools/locklint.py --write-baseline    # accept current findings
+
+Exit status: 0 when no unsuppressed findings (or selftest passes), 1 on
+regressions / a selftest miss.  Rule catalog, the named-lock naming
+convention and pragma syntax: docs/static_analysis.md.
+
+The analyzer (``incubator_mxnet_tpu/analysis/locklint.py``) is pure
+stdlib; it is loaded straight from its file here so linting never
+imports the framework (and therefore never needs jax installed).
+``--selftest`` seeds one violation per rule into a temp tree and fails
+unless the expected rule id fires on it — including the dynamic half:
+it loads ``lockwitness.py`` the same way and requires the witness to
+catch a two-thread opposite-order acquisition as a lock-order cycle.
+"""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ANALYZER = os.path.join(REPO, "incubator_mxnet_tpu", "analysis",
+                         "locklint.py")
+_WITNESS = os.path.join(REPO, "incubator_mxnet_tpu", "analysis",
+                        "lockwitness.py")
+DEFAULT_BASELINE = os.path.join(REPO, "ci", "locklint_baseline.json")
+
+
+def _load_by_file(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# --selftest fixtures: one seeded violation per rule
+# ---------------------------------------------------------------------------
+
+_FIX_ORDER_A = '''\
+from pkg.locks import named_lock
+from pkg.beta import grab_b_then_a
+
+L_A = named_lock("self.test.a")
+
+def grab_a_then_b():
+    with L_A:
+        grab_b_then_a.__name__   # not the call that closes the cycle
+        inner()
+
+def inner():
+    from pkg.beta import L_B
+    with L_B:
+        pass
+'''
+
+_FIX_ORDER_B = '''\
+from pkg.locks import named_lock
+
+L_B = named_lock("self.test.b")
+
+def grab_b_then_a():
+    with L_B:
+        take_a()
+
+def take_a():
+    from pkg.alpha import L_A
+    with L_A:
+        pass
+'''
+
+_FIX_BLOCKING = '''\
+import time
+from pkg.locks import named_lock
+
+GATE = named_lock("self.test.gate")
+
+def refresh():
+    with GATE:
+        time.sleep(0.5)
+'''
+
+_FIX_GUARDED = '''\
+import threading
+from pkg.locks import named_lock
+
+class Pool:
+    def __init__(self):
+        self._lock = named_lock("self.test.pool")
+        self.active = 0
+
+    def spawn(self):
+        with self._lock:
+            self.active += 1
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        self.active -= 1
+'''
+
+_FIX_LOCKS_STUB = '''\
+def named_lock(name):
+    import threading
+    return threading.Lock()
+'''
+
+
+def _selftest():
+    import tempfile
+    import threading
+
+    locklint = _load_by_file("_locklint_selftest", _ANALYZER)
+    failures = []
+
+    with tempfile.TemporaryDirectory(prefix="locklint_selftest_") as td:
+        pkg = os.path.join(td, "pkg")
+        os.makedirs(pkg)
+        fixtures = {
+            "__init__.py": "",
+            "locks.py": _FIX_LOCKS_STUB,
+            "alpha.py": _FIX_ORDER_A,
+            "beta.py": _FIX_ORDER_B,
+            "blocking.py": _FIX_BLOCKING,
+            "guarded.py": _FIX_GUARDED,
+        }
+        for name, src in fixtures.items():
+            with open(os.path.join(pkg, name), "w",
+                      encoding="utf-8") as fh:
+                fh.write(src)
+
+        findings = locklint.lint_paths([pkg], repo_root=td)
+        fired = {f.rule for f in findings}
+        for rule, where in (("MX-LOCK002", "pkg/alpha.py+pkg/beta.py"),
+                            ("MX-LOCK003", "pkg/blocking.py"),
+                            ("MX-GUARD001", "pkg/guarded.py")):
+            if rule in fired:
+                hit = next(f for f in findings if f.rule == rule)
+                print(f"[locklint] selftest: {rule} fired "
+                      f"({hit.file}:{hit.line})")
+            else:
+                failures.append(f"{rule} did not fire on seeded "
+                                f"violation in {where}")
+
+    # dynamic half: the witness must turn a two-thread opposite-order
+    # acquisition (temporally non-overlapping — no actual deadlock)
+    # into a typed, banked lock-order violation
+    witness = _load_by_file("_lockwitness_selftest", _WITNESS)
+    witness.set_enabled(True)
+    witness.clear()
+    a = witness.WitnessLock("selftest.a")
+    b = witness.WitnessLock("selftest.b")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    th1 = threading.Thread(target=t1)
+    th1.start()
+    th1.join()
+    th2 = threading.Thread(target=t2)
+    th2.start()
+    th2.join()
+
+    caught = None
+    try:
+        witness.check()
+    except Exception as exc:  # mxlint: allow-broad-except(selftest must catch whatever check() raises to assert the TYPE is LockOrderError)
+        caught = exc
+    if caught is None:
+        failures.append("witness did not bank a violation for the "
+                        "two-thread opposite-order acquisition")
+    elif type(caught).__name__ != "LockOrderError":
+        failures.append("witness raised "
+                        f"{type(caught).__name__}, expected LockOrderError")
+    else:
+        print("[locklint] selftest: witness cycle detection fired "
+              f"(LockOrderError: {caught})")
+    witness.clear()
+    witness.set_enabled(False)
+
+    if failures:
+        for msg in failures:
+            print(f"[locklint] SELFTEST FAIL: {msg}")
+        return 1
+    print("[locklint] selftest: all rules fire (MX-LOCK002, MX-LOCK003, "
+          "MX-GUARD001, witness cycle detection)")
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="locklint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("paths", nargs="*",
+                   default=[os.path.join(REPO, "incubator_mxnet_tpu")],
+                   help="files/directories to lint (default: the package)")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline JSON (default: {DEFAULT_BASELINE} "
+                        "when it exists)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to the baseline file "
+                        "(each entry needs a reason filled in) and exit 0")
+    p.add_argument("--prune-stale", action="store_true",
+                   help="rewrite the baseline file with its stale "
+                        "entries removed, then report as usual")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings as JSON")
+    p.add_argument("--selftest", action="store_true",
+                   help="seed one violation per rule and require the "
+                        "rule id to fire; exit nonzero on any miss")
+    args = p.parse_args(argv)
+
+    if args.selftest:
+        return _selftest()
+
+    locklint = _load_by_file("_locklint", _ANALYZER)
+    findings = locklint.lint_paths(args.paths, repo_root=REPO)
+
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None)
+
+    if args.write_baseline:
+        path = args.baseline or DEFAULT_BASELINE
+        payload = {"findings": [
+            dict(rule=f.rule, file=f.file, message=f.message,
+                 reason="TODO: justify or fix") for f in findings]}
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2)
+            fh.write("\n")
+        print(f"[locklint] wrote {len(findings)} finding(s) to {path}; "
+              "fill in each 'reason'")
+        return 0
+
+    baseline = (locklint.load_baseline(baseline_path)
+                if baseline_path else {})
+    regressions, suppressed, stale = locklint.apply_baseline(findings,
+                                                             baseline)
+
+    if args.prune_stale and stale and baseline_path:
+        scanned = [os.path.relpath(os.path.abspath(p), REPO)
+                   for p in args.paths]
+
+        def in_scope(key):
+            f = key[1]
+            return any(f == s or f.startswith(s.rstrip(os.sep) + os.sep)
+                       for s in scanned)
+
+        pruned = [k for k in stale if in_scope(k)]
+        locklint.prune_stale_baseline(baseline_path, stale,
+                                      in_scope=in_scope)
+        print(f"[locklint] pruned {len(pruned)} stale entr"
+              f"{'y' if len(pruned) == 1 else 'ies'} from {baseline_path}")
+        stale = [k for k in stale if not in_scope(k)]
+
+    if args.as_json:
+        print(json.dumps({
+            "regressions": [f.as_dict() for f in regressions],
+            "suppressed": [f.as_dict() for f in suppressed],
+            "stale_baseline": [list(k) for k in stale],
+        }, indent=2))
+    else:
+        if regressions:
+            print(locklint.render(regressions))
+        for key in stale:
+            print(f"[locklint] note: stale baseline entry {key} — the "
+                  "finding is gone, drop it from the baseline")
+        print(f"[locklint] {len(regressions)} finding(s), "
+              f"{len(suppressed)} baselined, {len(stale)} stale "
+              f"baseline entr{'y' if len(stale) == 1 else 'ies'}")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
