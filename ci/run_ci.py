@@ -17,6 +17,14 @@ Stages (each isolated, failures collected, nonzero exit if any fail):
              per-model peak-HBM record; the seeded-violation selftest
              (undonated train step under strict mode) must fail its
              subprocess — the stage's negative control
+  shardlint  SPMD sharding analysis gate (docs/graph_analysis.md
+             "shardlint"): the tests/test_shardlint.py battery (full
+             pytest output teed to .ci_shardlint_stage.log), the
+             tools/shardlint.py --selftest proving every SL-* rule
+             fires plus a seeded over-budget shard, the parallel-stack
+             dryrun-mesh sweep at ZERO error findings (--check), and
+             a seeded reshard violation failing its own strict-mode
+             subprocess — the stage's negative control
   multichip  __graft_entry__.dryrun_multichip on a virtual 8-device mesh
   bench      bench.py CPU fallback emits a well-formed JSON line
   chaos      kvstore + checkpoint test subset re-run under a fixed
@@ -937,6 +945,51 @@ def stage_memlint(args):
                   "seeded violation fails strict")
 
 
+def stage_shardlint(args):
+    """SPMD sharding gate (tools/shardlint.py, docs/graph_analysis.md
+    "shardlint"): the pytest battery (rule fixtures, collective cost
+    model, per-module parallel-stack pins, export/placement round
+    trip), the CLI --selftest firing every SL-* rule, the dryrun-mesh
+    parallel sweep at zero error findings, and the seeded reshard
+    violation failing its own strict subprocess."""
+    log = os.path.join(REPO, ".ci_shardlint_stage.log")
+    proc = sh([sys.executable, "-m", "pytest", "-q",
+               "tests/test_shardlint.py",
+               "--continue-on-collection-errors",
+               "-p", "no:cacheprovider"], timeout=1800)
+    with open(log, "w") as f:
+        f.write(proc.stdout or "")
+        if proc.stderr:
+            f.write("\n--- stderr ---\n" + proc.stderr)
+    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+    if proc.returncode != 0:
+        return False, f"{tail} (full output: {log})"
+    out = os.path.join(REPO, ".ci_shardlint.json")
+    try:
+        proc2 = sh([sys.executable, "tools/shardlint.py", "--selftest",
+                    "--check", "--output", out], timeout=900)
+        if proc2.returncode != 0:
+            return False, (proc2.stderr or proc2.stdout).strip()[-600:]
+        with open(out) as f:
+            rec = json.load(f)
+    finally:
+        if os.path.exists(out):
+            os.remove(out)
+    if rec.get("error_findings"):
+        return False, f"sweep error findings: {rec['error_findings']}"
+    # negative control: a seeded cross-mesh reshard under strict mode
+    # must fail — a green gate that cannot catch it is lying
+    proc3 = sh([sys.executable, "tools/shardlint.py",
+                "--seed-violation"], timeout=600)
+    if proc3.returncode == 0:
+        return False, ("seeded reshard violation did NOT fail the "
+                       "strict run — enforcement is broken")
+    comm = rec.get("value", 0)   # parallel_stack_comm_bytes_per_step
+    return True, (f"{tail}; {len(rec.get('surfaces', {}))} surfaces "
+                  f"clean, comm {comm}B/step, seeded violation "
+                  "fails strict")
+
+
 def stage_multichip(args):
     code = "import __graft_entry__ as g; g.dryrun_multichip(8)"
     proc = sh([sys.executable, "-c", code], timeout=1200)
@@ -970,6 +1023,7 @@ STAGES = {"build": stage_build, "sanity": stage_sanity,
           "race": stage_race,
           "graphlint": stage_graphlint,
           "memlint": stage_memlint,
+          "shardlint": stage_shardlint,
           "multichip": stage_multichip, "bench": stage_bench}
 
 

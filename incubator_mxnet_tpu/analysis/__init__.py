@@ -19,6 +19,13 @@ Five halves (docs/static_analysis.md, docs/graph_analysis.md):
   peak-HBM estimate, buffer-lifetime report, and ENFORCED buffer
   donation (an undonated params-in/params-out surface is an error, not
   an advisory).  CLI: ``python tools/memlint.py``.
+* :mod:`.shardlint` — SPMD sharding analysis over the same traced
+  graphs (``MXNET_GRAPH_SHARDLINT=warn|strict``): propagates declared
+  ``NamedSharding``/``PartitionSpec``s through the equation graph and
+  produces the per-shard HBM plan, the collective-cost bill
+  (``comm_bytes_per_step``) and the spec-conformance findings
+  (SL-SHARD-PEAK001/SL-RESHARD001/SL-REPL001/SL-SPEC001/SL-DONATE001).
+  CLI: ``python tools/shardlint.py``.
 * :mod:`.recompile` — the recompilation sentinel
   (``MXNET_RECOMPILE_SENTINEL=warn|raise``): every jit-owning layer
   reports each XLA compilation per site; signature churn past
@@ -29,18 +36,19 @@ Five halves (docs/static_analysis.md, docs/graph_analysis.md):
   NDArray accesses against its declared ``const_vars``/``mutable_vars``.
 
 ``race`` and ``recompile`` are imported eagerly (hot paths read their
-flags); ``mxlint``, ``graphlint`` and ``memlint`` stay lazy so
-importing the package never pays their setup — and mxlint never pays
-(or needs) jax at all.
+flags); ``mxlint``, ``graphlint``, ``memlint`` and ``shardlint`` stay
+lazy so importing the package never pays their setup — and mxlint
+never pays (or needs) jax at all.
 """
 from . import race
 from . import recompile
 
-__all__ = ["race", "recompile", "mxlint", "graphlint", "memlint"]
+__all__ = ["race", "recompile", "mxlint", "graphlint", "memlint",
+           "shardlint"]
 
 
 def __getattr__(name):
-    if name in ("mxlint", "graphlint", "memlint"):
+    if name in ("mxlint", "graphlint", "memlint", "shardlint"):
         import importlib
         return importlib.import_module("." + name, __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

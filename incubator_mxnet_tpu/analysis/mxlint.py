@@ -114,6 +114,8 @@ RULES = {
     "MX-LOCK001": "lock-order cycle (inconsistent acquisition order)",
     "MX-EXC001": "broad except swallows typed errors without a pragma",
     "MX-DONATE001": "jax.jit/pjit call site passes no donate_argnums",
+    "MX-SHARD001": "shard_map/pjit call site passes no explicit "
+                   "mesh/sharding argument",
     "MX-AST000": "file failed to parse",
 }
 
@@ -482,6 +484,62 @@ def _check_donate(fobj: "_File", findings):
                     emit(dec)
 
 
+_SHARD_CALLEES = ("shard_map", "shard_map_compat", "pjit")
+_SHARD_RECEIVERS = ("jax", "pjit", "_pjit", "base", "_base",
+                    "shard_map")
+_SHARD_KWARGS = ("mesh", "in_specs", "out_specs", "in_shardings",
+                 "out_shardings")
+
+
+def _is_shard_ref(f):
+    """A reference to ``shard_map``/``shard_map_compat``/``pjit`` as a
+    call-site callee.  Attribute receivers are restricted to the
+    conventional module names (``jax.shard_map``,
+    ``shard_map.shard_map``) so unrelated methods do not
+    false-positive."""
+    if isinstance(f, ast.Name):
+        return f.id in _SHARD_CALLEES
+    if isinstance(f, ast.Attribute) and f.attr in _SHARD_CALLEES:
+        v = f.value
+        return isinstance(v, ast.Name) and v.id in _SHARD_RECEIVERS
+    return False
+
+
+def _check_shard(fobj: "_File", findings):
+    """MX-SHARD001: framework shard_map/pjit sites must say where the
+    computation lands.
+
+    Only applies inside ``incubator_mxnet_tpu/`` (the MX-DONATE001
+    scope rule: tools and benchmarks map throwaway closures).  A
+    ``mesh=``/``in_specs=``/``in_shardings=``-family keyword satisfies
+    the rule, as do two or more positional arguments (the
+    ``shard_map_compat(fn, mesh, ...)`` positional spelling) — the
+    point is that the mesh/sharding decision is VISIBLE at the call
+    site, where shardlint (analysis/shardlint.py) can hold the declared
+    specs against the propagated ones, not inherited from ambient
+    context."""
+    rel = fobj.rel.replace(os.sep, "/")
+    if "incubator_mxnet_tpu/" not in rel \
+            and not rel.startswith("incubator_mxnet_tpu"):
+        return
+    for node in ast.walk(fobj.tree):
+        if not (isinstance(node, ast.Call) and _is_shard_ref(node.func)):
+            continue
+        if any(kw.arg in _SHARD_KWARGS for kw in node.keywords):
+            continue
+        if len(node.args) >= 2:
+            continue
+        if fobj.suppressed("MX-SHARD001", node):
+            continue
+        findings.append(Finding(
+            "MX-SHARD001", fobj.rel, node.lineno,
+            "shard_map/pjit site passes no explicit mesh/sharding "
+            "argument — the placement decision is invisible here and "
+            "unanalyzable by shardlint; pass mesh=/in_specs= (or "
+            "in_shardings=), or pragma disable=MX-SHARD001(reason) "
+            "stating where the mesh comes from"))
+
+
 _HOST_NS = ("onp", "np", "numpy", "_onp")
 _HOST_NS_FNS = ("asarray", "array", "save", "load", "fromfile")
 _HOST_NAME_FNS = ("print", "open", "input")
@@ -807,6 +865,7 @@ def lint_paths(paths, repo_root=None, docs_path=None, fault_points=None):
         _check_broad_except(fobj, findings)
         _check_bulkable_purity(fobj, findings)
         _check_donate(fobj, findings)
+        _check_shard(fobj, findings)
 
     # -- lock-order graph --------------------------------------------------
     _check_lock_order(files, findings)

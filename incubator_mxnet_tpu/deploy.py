@@ -65,7 +65,8 @@ def _block_forward_fn(block):
 
 
 def export_model(model, example_inputs, prefix, params=None,
-                 donate_argnums=(), aot_buckets=None):
+                 donate_argnums=(), aot_buckets=None,
+                 sharding_rule=None, sharding_mesh=None):
     """Compile + serialize a model's forward for deployment.
 
     model: a gluon Block (uses ``functional()``) or a pure
@@ -89,6 +90,17 @@ def export_model(model, example_inputs, prefix, params=None,
     instead of compiling — the cold-start killer for serving replicas.
     The blobs are jax/jaxlib/platform-exact (a loud versioned compat
     check falls back to recompilation on mismatch).
+
+    ``sharding_rule`` (with ``sharding_mesh``) declares how the params
+    are laid out on a mesh: either ``rule_fn(name, leaf) ->
+    PartitionSpec`` (the :func:`~.parallel.mesh.shard_params`
+    convention) or a pytree of PartitionSpecs matching ``params``.
+    When given, the sharding analysis (``analysis/shardlint.py``) runs
+    over the exported forward and meta.json gains a ``"shardlint"``
+    entry: the sharding-spec tree, the per-shard HBM plan
+    (``peak_hbm_bytes_per_shard``), the collective bill and any
+    findings — which ``serving/placement.py`` reads as the per-shard
+    footprint when placing the artifact on a mesh-sharded replica.
     """
     from .ndarray import NDArray, save as nd_save
 
@@ -138,6 +150,13 @@ def export_model(model, example_inputs, prefix, params=None,
     # per-model HBM without re-tracing the (opaque) deserialized graph
     memlint_summary = _export_memlint(fwd, params, example,
                                       donate_argnums, prefix)
+    # sharding plan of the same forward (analysis/shardlint.py): the
+    # declared spec tree, the per-shard peak and the collective bill
+    # ride along so a mesh-sharded serving tier charges each replica
+    # its SHARD, not the whole graph
+    shardlint_summary = _export_shardlint(fwd, params, example,
+                                          donate_argnums, prefix,
+                                          sharding_rule, sharding_mesh)
 
     exported = jax.export.export(jitted)(params, *example)
     with open(prefix + ".jaxport", "wb") as f:
@@ -170,6 +189,8 @@ def export_model(model, example_inputs, prefix, params=None,
         meta["graphlint"] = graphlint_summary
     if memlint_summary is not None:
         meta["memlint"] = memlint_summary
+    if shardlint_summary is not None:
+        meta["shardlint"] = shardlint_summary
     with open(prefix + ".meta.json", "w") as f:
         json.dump(meta, f, indent=1)
     _write_pjrt_sidecar(prefix, params, meta)
@@ -249,6 +270,48 @@ def _export_memlint(fwd, params, example, donate_argnums, prefix):
     d = rep.as_dict()
     d["buffers"] = d["buffers"][:5]
     d["findings"] = [f.as_dict() for f in rep.findings]
+    return d
+
+
+def _export_shardlint(fwd, params, example, donate_argnums, prefix,
+                      sharding_rule, sharding_mesh):
+    """Sharding analysis of the exported forward
+    (``analysis/shardlint.py``); returns the meta.json summary or None
+    when no sharding was declared / export analysis is disabled (same
+    ``MXNET_EXPORT_GRAPHLINT`` gate as its siblings)."""
+    if sharding_rule is None or sharding_mesh is None:
+        return None
+    from .base import get_env
+    mode = str(get_env("MXNET_EXPORT_GRAPHLINT", "warn")).strip().lower()
+    if mode in ("", "0", "off", "none", "false"):
+        return None
+    from .analysis import shardlint
+    try:
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        if callable(sharding_rule):
+            leaf_specs = [sharding_rule(jax.tree_util.keystr(p), leaf)
+                          for p, leaf in flat]
+            spec_tree = jax.tree_util.tree_unflatten(treedef, leaf_specs)
+        else:
+            spec_tree = sharding_rule
+            leaf_specs = jax.tree_util.tree_leaves(
+                spec_tree, is_leaf=lambda x: x is None or isinstance(
+                    x, jax.sharding.PartitionSpec))
+        rep = shardlint.analyze_fn(
+            fwd, params, *example, mesh=sharding_mesh,
+            in_specs=(spec_tree,) + (None,) * len(example),
+            where=f"export:{os.path.basename(prefix)}",
+            donate_argnums=donate_argnums)
+    except Exception as e:  # mxlint: allow-broad-except(the sharding plan is advisory at export; a shardlint crash must never block an export)
+        import warnings
+        warnings.warn(f"export shardlint could not run ({e}); exporting "
+                      "without a sharding summary")
+        return {"error": f"{type(e).__name__}: {e}"}
+    d = rep.as_dict()
+    d["collectives"] = d["collectives"][:10]
+    d["sharding_spec_tree"] = {
+        jax.tree_util.keystr(p): str(s if s is not None else "P()")
+        for (p, _), s in zip(flat, leaf_specs)}
     return d
 
 

@@ -20,7 +20,7 @@ __all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
            "RouterLeaseError", "RouterForwardError",
            "SessionExpiredError", "SessionLostError",
            "EngineRaceError", "RecompileStormError", "GraphLintError",
-           "LockOrderError",
+           "LockOrderError", "ShardLintError",
            "register_error", "get_error_class"]
 
 _ERROR_REGISTRY = {}
@@ -215,6 +215,18 @@ class MemLintError(GraphLintError):
     estimate over its budget (ML-PEAK001).  Subclasses
     :class:`GraphLintError` so callers gating on "the IR analysis
     failed the build" catch both."""
+
+
+@register_error
+class ShardLintError(GraphLintError):
+    """The sharding analyzer (``analysis/shardlint.py``) found
+    violations under ``MXNET_GRAPH_SHARDLINT=strict`` — a per-shard
+    peak over the chip budget (SL-SHARD-PEAK001), incompatible declared
+    shardings on one value (SL-RESHARD001), a large fully replicated
+    weight (SL-REPL001), a spec naming a missing mesh axis (SL-SPEC001),
+    or a donated input resharded before reuse (SL-DONATE001).
+    Subclasses :class:`GraphLintError` so callers gating on "the IR
+    analysis failed the build" catch all three analyzers."""
 
 
 @register_error

@@ -243,18 +243,22 @@ class Executor:
         except Exception:  # mxlint: allow-broad-except(best-effort probe of a private jax internal; a degraded count beats failing a metrics scrape)
             return 0
 
-    def analyze(self, args, graphlint=None, memlint=None):
+    def analyze(self, args, graphlint=None, memlint=None,
+                shardlint=None):
         """Run the build-time analyses over the *uninstrumented* fn with
         this executor's donation contract pre-applied (a surface can
         still override per-call)."""
         gl = dict(graphlint) if graphlint is not None else None
         ml = dict(memlint) if memlint is not None else None
+        sl = dict(shardlint) if shardlint is not None else None
         if gl is not None:
             gl.setdefault("donate_argnums", self.donate_argnums)
         if ml is not None:
             ml.setdefault("donate_argnums", self.donate_argnums)
+        if sl is not None:
+            sl.setdefault("donate_argnums", self.donate_argnums)
         return run_analyses(self.fn, args, name=self.site,
-                            graphlint=gl, memlint=ml)
+                            graphlint=gl, memlint=ml, shardlint=sl)
 
 
 def lint_active():
@@ -270,6 +274,13 @@ def memlint_active():
     ``memlint.set_mem_mode``)."""
     from .analysis import memlint
     return memlint.mem_mode() is not None
+
+
+def shardlint_active():
+    """Whether build-time shardlint is on (``MXNET_GRAPH_SHARDLINT`` /
+    ``shardlint.set_shard_mode``)."""
+    from .analysis import shardlint
+    return shardlint.shard_mode() is not None
 
 
 def latch_train_analyses(executor, args, lint_done, memlint_done):
@@ -295,14 +306,18 @@ def latch_train_analyses(executor, args, lint_done, memlint_done):
     return lint_done or do_lint, memlint_done or do_mem
 
 
-def run_analyses(fn, args, name, graphlint=None, memlint=None):
-    """THE graphlint/memlint build-time wiring (previously copied at
-    every compile surface).  ``graphlint``/``memlint`` are kwarg dicts
-    for :func:`analysis.graphlint.check_traced` /
-    :func:`analysis.memlint.check_memory` — pass ``None`` to skip a
-    pass entirely, ``{}`` for the defaults.  Inert (two cached env
+def run_analyses(fn, args, name, graphlint=None, memlint=None,
+                 shardlint=None):
+    """THE graphlint/memlint/shardlint build-time wiring (previously
+    copied at every compile surface).  ``graphlint``/``memlint``/
+    ``shardlint`` are kwarg dicts for
+    :func:`analysis.graphlint.check_traced` /
+    :func:`analysis.memlint.check_memory` /
+    :func:`analysis.shardlint.check_sharding` — pass ``None`` to skip a
+    pass entirely, ``{}`` for the defaults.  Inert (three cached env
     reads) unless the respective mode is on.  Returns
-    ``(findings, mem_report)``.
+    ``(findings, mem_report)``; the shard report is recorded in the
+    ``shardlint`` profiler provider's per-site stats.
     """
     findings = rep = None
     if graphlint is not None:
@@ -314,7 +329,13 @@ def run_analyses(fn, args, name, graphlint=None, memlint=None):
         from .analysis import memlint as _memlint
         if _memlint.mem_mode() is not None:
             rep = _memlint.check_memory(fn, args, name=name, **memlint)
-    if findings is not None or rep is not None:
+    srep = None
+    if shardlint is not None:
+        from .analysis import shardlint as _shardlint
+        if _shardlint.shard_mode() is not None:
+            srep = _shardlint.check_sharding(fn, args, name=name,
+                                             **shardlint)
+    if findings is not None or rep is not None or srep is not None:
         with _lock:
             _state["analyses"] += 1
     return findings, rep

@@ -157,6 +157,7 @@ class FusedTrainStep:
         self._batch_spec = batch_spec
         self._lint_done = False
         self._memlint_done = False
+        self._shardlint_done = False
         self._step_fn = self._build(mesh, batch_spec, donate)
         self._last = None
 
@@ -238,6 +239,21 @@ class FusedTrainStep:
                     self._executor,
                     (self.params, self.aux, self.opt_state, xv, yv, sub),
                     self._lint_done, self._memlint_done)
+        if not self._shardlint_done and _xc.shardlint_active():
+            # one-shot shardlint over the same step: the batch args
+            # carry the declared dp spec when a mesh was given; the
+            # train state is legitimately replicated (dp), so only
+            # the collective bill and per-shard peak are of interest
+            from jax.sharding import PartitionSpec as P
+            bspec = (self._batch_spec or P("dp")) \
+                if self._mesh is not None else None
+            self._executor.analyze(
+                (self.params, self.aux, self.opt_state, xv, yv, sub),
+                shardlint=dict(
+                    mesh=self._mesh,
+                    in_specs=(None, None, None, bspec, bspec, None),
+                    allow_replicated=(0, 1, 2, 5)))
+            self._shardlint_done = True
         self.params, self.aux, self.opt_state, loss = self._step_fn(
             self.params, self.aux, self.opt_state, xv, yv, sub)
         self._last = loss

@@ -363,7 +363,8 @@ class CachedOp:
             # activations; without it the params and inputs are
             # caller-held (allow_undonated), so memlint only records
             # the peak-HBM estimate and lifetime stats.
-            if _xc.lint_active() or _xc.memlint_active():
+            if _xc.lint_active() or _xc.memlint_active() \
+                    or _xc.shardlint_active():
                 entry["executor"].analyze(
                     (raw_params, raw_inputs, jax.random.PRNGKey(0)),
                     graphlint=dict(allow_unused_args=(2,),
@@ -371,7 +372,12 @@ class CachedOp:
                     memlint=dict(
                         allow_undonated=(0,) if self.static_alloc
                         else (0, 1),
-                        require_donation=self.static_alloc))
+                        require_donation=self.static_alloc),
+                    # no declared entry specs here (a hybridized block
+                    # is single-chip unless export/fused-step paths say
+                    # otherwise): shardlint still prices any collectives
+                    # and records the per-site per-shard stats
+                    shardlint=dict(allow_replicated=(0, 1, 2)))
         jfn = entry["jfn"]
         key = _random.next_key()
 

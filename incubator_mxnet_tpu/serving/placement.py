@@ -33,11 +33,15 @@ __all__ = ["Placer", "model_footprint_bytes"]
 
 
 def model_footprint_bytes(path, default=None):
-    """Peak-HBM bytes of the artifact at ``prefix`` ``path``, from its
-    export-time memlint plan (``meta.json`` ``memlint.peak_hbm_bytes``).
-    Falls back to ``default`` / ``MXNET_SERVING_MODEL_BYTES_DEFAULT``
-    when the artifact predates the memlint era (or the plan was
-    skipped at export)."""
+    """Peak-HBM bytes of the artifact at ``prefix`` ``path``, per chip.
+
+    A mesh-sharded export (``export_model(sharding_rule=...)``) carries
+    a per-shard plan in ``meta.json`` ``shardlint.
+    peak_hbm_bytes_per_shard`` — each replica chip holds one shard, so
+    THAT is its ledger charge.  Unsharded artifacts fall back to the
+    whole-graph ``memlint.peak_hbm_bytes``, then to ``default`` /
+    ``MXNET_SERVING_MODEL_BYTES_DEFAULT`` when the artifact predates
+    the memlint era (or the plan was skipped at export)."""
     fallback = int(
         default if default is not None
         else get_env("MXNET_SERVING_MODEL_BYTES_DEFAULT",
@@ -47,6 +51,10 @@ def model_footprint_bytes(path, default=None):
             meta = json.load(f)
     except (OSError, ValueError):
         return fallback
+    per_shard = (meta.get("shardlint") or {}).get(
+        "peak_hbm_bytes_per_shard")
+    if per_shard and int(per_shard) > 0:
+        return int(per_shard)
     peak = (meta.get("memlint") or {}).get("peak_hbm_bytes")
     if not peak or int(peak) <= 0:
         return fallback

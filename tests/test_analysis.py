@@ -477,7 +477,8 @@ def test_donate001_flags_bare_jit(tmp_path):
         f = jax.jit(lambda x: x + 1)
         g = pjit(lambda x: x * 2)
     """)
-    assert _rules(fs) == ["MX-DONATE001", "MX-DONATE001"]
+    # the bare pjit additionally draws MX-SHARD001: no sharding decision
+    assert _rules(fs) == ["MX-DONATE001", "MX-DONATE001", "MX-SHARD001"]
 
 
 def test_donate001_keyword_presence_passes(tmp_path):
@@ -520,6 +521,41 @@ def test_donate001_method_named_jit_not_flagged(tmp_path):
         c = C()
         f = c.jit(lambda x: x)
     """) == []
+
+
+# ---------------------------------------------------------------------------
+# MX-SHARD001 — shard_map/pjit sites must decide placement
+# ---------------------------------------------------------------------------
+
+def test_shard001_flags_bare_shard_map(tmp_path):
+    fs = _lint_pkg_src(tmp_path, """
+        from jax.experimental.shard_map import shard_map
+        f = shard_map(lambda x: x)
+        g = jax.pjit(lambda x: x, donate_argnums=(0,))
+    """)
+    assert _rules(fs) == ["MX-SHARD001", "MX-SHARD001"]
+
+
+def test_shard001_explicit_sharding_passes(tmp_path):
+    # keyword spelling, positional spelling, and in_shardings all count
+    assert "MX-SHARD001" not in _rules(_lint_pkg_src(tmp_path, """
+        from jax.experimental.shard_map import shard_map
+        f = shard_map(body, mesh=mesh, in_specs=specs, out_specs=out)
+        g = shard_map(body, mesh, specs, out)
+        h = jax.pjit(fn, in_shardings=s, out_shardings=s,
+                     donate_argnums=(0,))
+    """))
+
+
+def test_shard001_pragma_and_scope(tmp_path):
+    assert "MX-SHARD001" not in _rules(_lint_pkg_src(tmp_path, """
+        f = shard_map(body)  # mxlint: disable=MX-SHARD001(ambient mesh installed by caller)
+    """))
+    # outside the package the rule does not apply
+    fs = _lint_src(tmp_path, """
+        f = shard_map(lambda x: x)
+    """, name="bench_snippet.py")
+    assert "MX-SHARD001" not in _rules(fs)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,306 @@
+#!/usr/bin/env python
+"""shardlint CLI — SPMD sharding lint / collective-cost / per-shard HBM
+driver.
+
+Usage:
+    python tools/shardlint.py --check       # parallel-stack sweep gate
+    python tools/shardlint.py --selftest    # every SL-* rule must fire
+    python tools/shardlint.py --seed-violation  # MUST exit nonzero (CI)
+    python tools/shardlint.py --zoo resnet18_v1 --batch 8   # dp-mesh sweep
+    python tools/shardlint.py --json --output shard.json
+
+``--check`` is the CI gate (docs/graph_analysis.md): it analyzes every
+surface of the ``parallel/`` stack (mesh rules, pipeline, ulysses,
+ring_attention, moe) plus the kvstore compressed all-reduce on the
+8-device CPU dryrun mesh and fails on any error-severity finding — the
+zero-finding pin the per-module tests also hold.  ``--selftest`` seeds
+one violation per rule (SL-SHARD-PEAK001 / SL-RESHARD001 / SL-REPL001 /
+SL-SPEC001 / SL-DONATE001, plus a seeded over-budget shard and a
+strict-mode raise) and fails unless each surfaces.  ``--seed-violation``
+runs a resharding violation under ``MXNET_GRAPH_SHARDLINT=strict``
+enforcement and exits with the resulting failure: CI runs it expecting
+a NONZERO exit (the stage's negative control).  ``--zoo`` analyzes a
+model-zoo forward under data-parallel batch sharding on the dryrun
+mesh.
+
+Findings flow through the shared baseline machinery
+(``analysis/findings.py``): ``--write-baseline`` accepts the current
+findings into ``ci/shardlint_baseline.json`` (each entry needs a
+written reason), ``--baseline`` points elsewhere.  Rule catalog and
+cost-model assumptions: docs/graph_analysis.md.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+DEFAULT_BASELINE = os.path.join(REPO, "ci", "shardlint_baseline.json")
+
+
+def selftest():
+    """Seed one violation per rule; each must surface."""
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from incubator_mxnet_tpu import error
+    from incubator_mxnet_tpu.analysis import shardlint as sl
+    from incubator_mxnet_tpu.parallel.mesh import make_mesh
+
+    failures = []
+    mesh = make_mesh(dp=4, tp=2)
+    x = jnp.zeros((64, 64), jnp.float32)
+
+    def expect(rule, rep, label):
+        if any(f.rule == rule and f.severity == "error"
+               for f in rep.findings):
+            print(f"[selftest] {rule}: {label} flagged OK")
+        else:
+            failures.append(f"{rule} did not fire on {label} "
+                            f"(got {[f.rule for f in rep.findings]})")
+
+    # SL-SPEC001: declared spec names an axis the mesh does not have
+    rep = sl.analyze_fn(lambda a: a + 1.0, x, mesh=mesh,
+                        in_specs=(P("zz", None),))
+    expect("SL-SPEC001", rep, "a spec naming a missing axis")
+
+    # SL-REPL001: a large fully replicated entry buffer
+    rep = sl.analyze_fn(lambda a: a + 1.0, x, mesh=mesh,
+                        in_specs=(P(None, None),),
+                        config=sl.Config(repl_bytes=1024))
+    expect("SL-REPL001", rep, "a large replicated weight")
+    rep = sl.analyze_fn(lambda a: a + 1.0, x, mesh=mesh,
+                        in_specs=(P(None, None),), allow_replicated=(0,),
+                        config=sl.Config(repl_bytes=1024))
+    if rep.findings:
+        failures.append("allow_replicated did not clear SL-REPL001")
+    else:
+        print("[selftest] SL-REPL001: allow_replicated escape clean OK")
+
+    # SL-RESHARD001: producer declares dp, consumer constrains to tp
+    def reshard(a):
+        return jax.lax.with_sharding_constraint(
+            a * 2.0, NamedSharding(mesh, P(None, "tp")))
+
+    rep = sl.analyze_fn(reshard, x, mesh=mesh, in_specs=(P("dp", None),))
+    expect("SL-RESHARD001", rep, "a mid-graph spec disagreement")
+    if rep.comm_bytes_per_step <= 0:
+        failures.append("the implied reshard was not priced into "
+                        "comm_bytes_per_step")
+    else:
+        print("[selftest] SL-RESHARD001: reshard priced "
+              f"({rep.comm_bytes_per_step} bytes) OK")
+
+    # SL-DONATE001: donated dp-sharded input, output resharded to tp
+    def donate_mismatch(a):
+        return jax.lax.with_sharding_constraint(
+            a + 1.0, NamedSharding(mesh, P(None, "tp")))
+
+    rep = sl.analyze_fn(donate_mismatch, x, mesh=mesh,
+                        in_specs=(P("dp", None),), donate_argnums=(0,))
+    expect("SL-DONATE001", rep, "a donated input resharded before reuse")
+
+    # SL-SHARD-PEAK001: the seeded over-budget shard — dp-sharding one
+    # dim divides the peak by 4, but the budget is below even that
+    rep = sl.analyze_fn(lambda a: a @ a, x, mesh=mesh,
+                        in_specs=(P("dp", None),),
+                        config=sl.Config(chip_bytes=100))
+    expect("SL-SHARD-PEAK001", rep, "a seeded over-budget shard")
+    if not (0 < rep.peak_hbm_bytes_per_shard < rep.peak_hbm_bytes):
+        failures.append(
+            "sharding did not shrink the per-shard peak "
+            f"({rep.peak_hbm_bytes_per_shard} vs whole-graph "
+            f"{rep.peak_hbm_bytes})")
+    else:
+        print("[selftest] per-shard plan: "
+              f"{rep.peak_hbm_bytes_per_shard} < whole-graph "
+              f"{rep.peak_hbm_bytes} OK")
+
+    # strict mode raises the typed error through the choke point
+    with sl.shard_scope("strict"):
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                sl.check_sharding(reshard, (x,), name="selftest:strict",
+                                  mesh=mesh, in_specs=(P("dp", None),))
+            failures.append("strict mode did not raise ShardLintError")
+        except error.ShardLintError:
+            print("[selftest] strict-mode: ShardLintError raised OK")
+
+    for f in failures:
+        print(f"[selftest] FAIL {f}")
+    print("[selftest] " + ("FAILED" if failures
+                           else "all seeded violations caught"))
+    return 1 if failures else 0
+
+
+def zoo_sweep(name, batch, image_size):
+    """Analyze one zoo model's inference forward under data-parallel
+    batch sharding on the dryrun dp mesh."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.analysis import shardlint as sl
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    from incubator_mxnet_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(dp=jax.device_count())
+    net = vision.get_model(name, classes=10)
+    net.initialize()
+    x = nd.random.uniform(shape=(batch, 3, image_size, image_size))
+    net(x)   # materialize deferred-shape parameters
+    params, apply_fn = net.functional()
+
+    def fwd(p, xin):
+        return apply_fn(p, xin, training=False)
+
+    rep = sl.analyze_fn(
+        fwd, params, x.data, mesh=mesh,
+        in_specs=(None, P("dp", None, None, None)),
+        where=f"zoo:{name}", allow_replicated=(0,))
+    return rep
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="shardlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--check", action="store_true",
+                   help="gate: the parallel-stack sweep on the 8-device "
+                        "dryrun mesh must report zero error findings")
+    p.add_argument("--selftest", action="store_true",
+                   help="seed one violation per rule; each must surface")
+    p.add_argument("--seed-violation", action="store_true",
+                   help="run a resharding violation under strict mode: "
+                        "exits nonzero when enforcement works (CI runs "
+                        "this expecting failure)")
+    p.add_argument("--zoo", action="append", default=[],
+                   help="model_zoo.vision factory name (repeatable)")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline JSON (default: {DEFAULT_BASELINE} "
+                        "when it exists)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current sweep findings to the baseline "
+                        "file (each entry needs a reason) and exit 0")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--output", default=None,
+                   help="write the record to this file")
+    args = p.parse_args(argv)
+
+    if not (args.check or args.selftest or args.seed_violation
+            or args.zoo or args.write_baseline):
+        p.error("nothing to analyze: pass --check, --selftest, "
+                "--seed-violation, --write-baseline and/or --zoo")
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import incubator_mxnet_tpu  # noqa: F401  (registers ops)
+    from incubator_mxnet_tpu.analysis import findings as fnd
+    from incubator_mxnet_tpu.analysis import shardlint as sl
+
+    if args.seed_violation:
+        # negative control: enforcement must FAIL this process
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from incubator_mxnet_tpu import error
+        from incubator_mxnet_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(dp=4, tp=2)
+        x = jnp.zeros((64, 64), jnp.float32)
+
+        def reshard(a):
+            return jax.lax.with_sharding_constraint(
+                a * 2.0, NamedSharding(mesh, P(None, "tp")))
+
+        with sl.shard_scope("strict"):
+            try:
+                sl.check_sharding(reshard, (x,), name="seed-violation",
+                                  mesh=mesh, in_specs=(P("dp", None),))
+            except error.ShardLintError as e:
+                print(f"[shardlint] seeded violation caught: {e}",
+                      file=sys.stderr)
+                return 1
+        print("[shardlint] seeded violation NOT caught — enforcement "
+              "is broken", file=sys.stderr)
+        return 0   # "success" here means the CI control FAILS the stage
+
+    if args.selftest:
+        rc = selftest()
+        if rc or not (args.check or args.zoo or args.write_baseline):
+            return rc
+
+    reports = []
+    if args.check or args.write_baseline:
+        reports.extend(sl.sweep_parallel())
+    for name in args.zoo:
+        reports.append((f"zoo:{name}",
+                        zoo_sweep(name, args.batch, args.image_size)))
+
+    all_findings = [f for _, rep in reports for f in rep.findings]
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None)
+
+    if args.write_baseline:
+        path = args.baseline or DEFAULT_BASELINE
+        payload = {"findings": [
+            dict(rule=f.rule, file=f"{f.where}{f.path}",
+                 message=f.message, reason="TODO: justify or fix")
+            for f in all_findings]}
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2)
+            fh.write("\n")
+        print(f"[shardlint] wrote {len(all_findings)} finding(s) to "
+              f"{path}; fill in each 'reason'")
+        return 0
+
+    baseline = (fnd.load_baseline(baseline_path) if baseline_path else {})
+    regressions, suppressed, stale = fnd.apply_baseline(all_findings,
+                                                        baseline)
+    errors = [f for f in regressions if f.severity == "error"]
+
+    record = {
+        "metric": "parallel_stack_comm_bytes_per_step",
+        "unit": "bytes",
+        "value": sum(rep.comm_bytes_per_step for _, rep in reports),
+        "surfaces": {name: {
+            "peak_hbm_bytes_per_shard": rep.peak_hbm_bytes_per_shard,
+            "peak_hbm_bytes": rep.peak_hbm_bytes,
+            "comm_bytes_per_step": rep.comm_bytes_per_step,
+            "collectives": len(rep.collectives),
+            "mesh_axes": rep.mesh_axes,
+            "findings": [f.as_dict() for f in rep.findings],
+        } for name, rep in reports},
+        "error_findings": len(errors),
+        "baselined": len(suppressed),
+        "check": args.check,
+    }
+    out = json.dumps(record, indent=2)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out + "\n")
+    if args.as_json or not args.output:
+        print(out)
+    if errors:
+        from incubator_mxnet_tpu.analysis.graphlint import render
+        print(render(errors), file=sys.stderr)
+    for key in stale:
+        print(f"[shardlint] note: stale baseline entry {key} — the "
+              "finding is gone, drop it from the baseline",
+              file=sys.stderr)
+    if args.check and errors:
+        print(f"[shardlint] GATE: {len(errors)} error finding(s) on "
+              "the parallel-stack sweep", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
